@@ -1,0 +1,40 @@
+"""Test session config: force an 8-device virtual CPU mesh BEFORE jax backend init.
+
+Mirrors the reference's persistent 2-process gloo pool
+(/root/reference/tests/unittests/conftest.py:62-68) — but JAX needs no
+processes: ``--xla_force_host_platform_device_count=8`` gives 8 local CPU
+devices, and shard_map over a Mesh exercises the exact collective code paths
+that run over ICI on a real pod slice.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_DEVICES = 8
+SEED = 42
+
+
+@pytest.fixture(scope="session")
+def mesh():
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) == NUM_DEVICES, f"expected {NUM_DEVICES} virtual devices, got {len(devices)}"
+    return Mesh(np.asarray(devices).reshape(NUM_DEVICES), ("data",))
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(SEED)

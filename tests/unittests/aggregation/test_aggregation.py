@@ -1,0 +1,88 @@
+"""Aggregation metric tests (reference: tests/unittests/bases/test_aggregation.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+
+
+@pytest.mark.parametrize("cls,np_fn", [
+    (SumMetric, np.sum),
+    (MaxMetric, np.max),
+    (MinMetric, np.min),
+    (MeanMetric, np.mean),
+])
+def test_aggregator_vs_numpy(cls, np_fn):
+    m = cls()
+    data = np.random.randn(5, 10).astype(np.float32)
+    for row in data:
+        m.update(jnp.asarray(row))
+    np.testing.assert_allclose(float(m.compute()), np_fn(data), rtol=1e-5)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_mean_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 2.0]), weight=jnp.asarray([0.5, 1.5]))
+    expected = (1.0 * 0.5 + 2.0 * 1.5) / 2.0
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("cls", [SumMetric, MeanMetric, MaxMetric, MinMetric])
+def test_nan_error_strategy(cls):
+    m = cls(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, float("nan")]))
+
+
+def test_nan_ignore_strategy():
+    m = SumMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 2.0]))
+    np.testing.assert_allclose(float(m.compute()), 3.0)
+
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([1.0, float("nan"), 3.0]))
+    np.testing.assert_allclose(float(m.compute()), 2.0)
+
+
+def test_nan_impute_strategy():
+    m = SumMetric(nan_strategy=0.5)
+    m.update(jnp.asarray([1.0, float("nan")]))
+    np.testing.assert_allclose(float(m.compute()), 1.5)
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError):
+        SumMetric(nan_strategy="bogus")
+
+
+def test_running_mean():
+    m = RunningMean(window=3)
+    values = [1.0, 2.0, 3.0, 4.0, 5.0]
+    for v in values:
+        m.update(v)
+    # window of last 3: mean(3,4,5)
+    np.testing.assert_allclose(float(m.compute()), 4.0)
+
+
+def test_running_sum():
+    m = RunningSum(window=2)
+    for v in [1.0, 2.0, 3.0]:
+        m.update(v)
+    np.testing.assert_allclose(float(m.compute()), 5.0)
+
+
+def test_aggregation_composition():
+    s = SumMetric()
+    mx = MaxMetric()
+    combined = s + mx
+    s.update(jnp.asarray([1.0, 2.0]))
+    mx.update(jnp.asarray([1.0, 5.0]))
+    np.testing.assert_allclose(float(combined.compute()), 3.0 + 5.0)

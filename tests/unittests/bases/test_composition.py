@@ -1,0 +1,97 @@
+"""Metric arithmetic tests (reference: tests/unittests/bases/test_composition.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.core.composition import CompositionalMetric
+
+
+class DummyMetric(Metric):
+    def __init__(self, val=0.0):
+        super().__init__()
+        self._init_val = float(val)
+        self.add_state("x", jnp.asarray(float(val)), dist_reduce_fx="sum")
+
+    def _update(self, state, x=0.0):
+        return {"x": state["x"] + jnp.asarray(x, dtype=jnp.float32)}
+
+    def _compute(self, state):
+        return state["x"]
+
+
+@pytest.mark.parametrize("op,expected", [
+    (lambda a, b: a + b, 5.0),
+    (lambda a, b: a - b, -1.0),
+    (lambda a, b: a * b, 6.0),
+    (lambda a, b: a / b, 2.0 / 3.0),
+    (lambda a, b: a**b, 8.0),
+    (lambda a, b: a % b, 2.0),
+])
+def test_binary_ops_metric_metric(op, expected):
+    a, b = DummyMetric(2.0), DummyMetric(3.0)
+    comp = op(a, b)
+    assert isinstance(comp, CompositionalMetric)
+    np.testing.assert_allclose(float(comp.compute()), expected, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,expected", [
+    (lambda a: a + 1.0, 3.0),
+    (lambda a: 1.0 + a, 3.0),
+    (lambda a: a * 4, 8.0),
+    (lambda a: 10 - a, 8.0),
+    (lambda a: -a, -2.0),
+    (lambda a: abs(a), 2.0),
+])
+def test_ops_with_scalar(op, expected):
+    a = DummyMetric(2.0)
+    comp = op(a)
+    np.testing.assert_allclose(float(comp.compute()), expected, rtol=1e-6)
+
+
+def test_comparison_ops():
+    a, b = DummyMetric(2.0), DummyMetric(3.0)
+    assert bool((a < b).compute())
+    assert bool((a <= b).compute())
+    assert not bool((a > b).compute())
+    assert bool((a != b).compute())
+    assert not bool((a == b).compute())
+
+
+def test_update_propagates():
+    a, b = DummyMetric(), DummyMetric()
+    comp = a + b
+    comp.update(x=1.0)
+    np.testing.assert_allclose(float(comp.compute()), 2.0)
+
+
+def test_reset_propagates():
+    a, b = DummyMetric(), DummyMetric()
+    comp = a + b
+    comp.update(x=5.0)
+    comp.reset()
+    np.testing.assert_allclose(float(comp.compute()), 0.0)
+
+
+def test_nested_composition():
+    a, b, c = DummyMetric(1.0), DummyMetric(2.0), DummyMetric(3.0)
+    comp = (a + b) * c
+    np.testing.assert_allclose(float(comp.compute()), 9.0)
+
+
+def test_getitem():
+    class VecMetric(DummyMetric):
+        def __init__(self):
+            Metric.__init__(self)
+            self.add_state("x", jnp.asarray([1.0, 2.0, 3.0]), dist_reduce_fx="sum")
+
+    comp = VecMetric()[1]
+    np.testing.assert_allclose(float(comp.compute()), 2.0)
+
+
+def test_forward_composition():
+    a, b = DummyMetric(), DummyMetric()
+    comp = a + b
+    out = comp(x=2.0)
+    np.testing.assert_allclose(float(out), 4.0)

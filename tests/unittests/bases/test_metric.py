@@ -1,0 +1,199 @@
+"""Core Metric lifecycle tests.
+
+Semantics ported from the reference's tests/unittests/bases/test_metric.py
+(lifecycle, cache, reset, state_dict, pickling) — re-expressed for the
+functional-core design.
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+
+
+class DummyMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum", persistent=True)
+
+    def _update(self, state, x):
+        return {"x": state["x"] + jnp.asarray(x, dtype=jnp.float32)}
+
+    def _compute(self, state):
+        return state["x"]
+
+
+class DummyListMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat", persistent=True)
+
+    def _update(self, state, x):
+        return {"x": tuple(state["x"]) + (jnp.atleast_1d(jnp.asarray(x, dtype=jnp.float32)),)}
+
+    def _compute(self, state):
+        from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+        return dim_zero_cat(state["x"])
+
+
+def test_add_state_validation():
+    m = DummyMetric()
+    with pytest.raises(ValueError):
+        m.add_state("_bad", jnp.zeros(()), "sum")
+    with pytest.raises(ValueError):
+        m.add_state("bad", [1, 2], "cat")
+    with pytest.raises(ValueError):
+        m.add_state("bad", jnp.zeros(()), "nonsense")
+
+
+def test_update_accumulates():
+    m = DummyMetric()
+    m.update(1.0)
+    m.update(2.0)
+    assert float(m.compute()) == 3.0
+    assert m.update_count == 2
+
+
+def test_reset():
+    m = DummyMetric()
+    m.update(5.0)
+    m.reset()
+    assert not m.update_called
+    assert float(m.compute()) == 0.0
+
+    ml = DummyListMetric()
+    ml.update(jnp.asarray([1.0, 2.0]))
+    ml.reset()
+    assert ml._state["x"] == ()
+
+
+def test_compute_cache():
+    m = DummyMetric()
+    m.update(1.0)
+    v1 = m.compute()
+    assert m._computed is not None
+    m.update(1.0)
+    assert m._computed is None
+    assert float(m.compute()) == 2.0
+
+
+def test_compute_before_update_warns():
+    m = DummyMetric()
+    with pytest.warns(UserWarning, match="called before"):
+        m.compute()
+
+
+def test_forward_returns_batch_and_accumulates():
+    m = DummyMetric()
+    out = m(2.0)
+    assert float(out) == 2.0  # batch value
+    out = m(3.0)
+    assert float(out) == 3.0
+    assert float(m.compute()) == 5.0  # accumulated
+
+
+def test_forward_full_state_update_path():
+    class FullState(DummyMetric):
+        full_state_update = True
+
+    m = FullState()
+    assert float(m(2.0)) == 2.0
+    assert float(m(3.0)) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_merge_states():
+    m = DummyMetric()
+    a = m.update_state(m.init_state(), 1.0)
+    b = m.update_state(m.init_state(), 2.0)
+    merged = m.merge_states(a, b)
+    assert float(m.compute_state(merged)) == 3.0
+    assert int(merged["_n"]) == 2
+
+
+def test_clone_independent():
+    m = DummyMetric()
+    m.update(1.0)
+    m2 = m.clone()
+    m2.update(1.0)
+    assert float(m.compute()) == 1.0
+    assert float(m2.compute()) == 2.0
+
+
+def test_pickle_roundtrip():
+    m = DummyMetric()
+    m.update(3.0)
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 3.0
+    ml = DummyListMetric()
+    ml.update(jnp.asarray([1.0]))
+    ml2 = pickle.loads(pickle.dumps(ml))
+    assert np.allclose(np.asarray(ml2.compute()), [1.0])
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetric()
+    m.update(4.0)
+    sd = m.state_dict()
+    assert "x" in sd
+    m2 = DummyMetric()
+    m2.load_state_dict(sd)
+    assert float(m2._state["x"]) == 4.0
+
+
+def test_state_dict_only_persistent():
+    class NonPersistent(DummyMetric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("y", jnp.zeros(()), "sum", persistent=False)
+
+        def _update(self, state, x):
+            return {"x": state["x"] + x, "y": state["y"] + x}
+
+    m = NonPersistent()
+    m.update(1.0)
+    sd = m.state_dict()
+    assert "x" in sd and "y" not in sd
+
+
+def test_jitted_facade_update():
+    m = DummyMetric(jit=True)
+    m.update(1.0)
+    m.update(2.0)
+    assert float(m.compute()) == 3.0
+
+
+def test_functional_core_under_jit():
+    m = DummyMetric()
+
+    @jax.jit
+    def step(state, x):
+        return m.update_state(state, x)
+
+    st = m.init_state()
+    for i in range(3):
+        st = step(st, float(i))
+    assert float(m.compute_state(st)) == 3.0
+
+
+def test_set_dtype():
+    m = DummyMetric()
+    m.set_dtype(jnp.bfloat16)
+    assert m._state["x"].dtype == jnp.bfloat16
+
+
+def test_filter_kwargs():
+    m = DummyMetric()
+    filtered = m._filter_kwargs(x=1.0, bogus=2.0)
+    assert filtered == {"x": 1.0}
+
+
+def test_metric_state_property():
+    m = DummyMetric()
+    m.update(1.0)
+    assert "x" in m.metric_state

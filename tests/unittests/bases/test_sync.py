@@ -1,0 +1,115 @@
+"""Cross-device sync tests on the virtual 8-CPU-device mesh.
+
+Semantics ported from the reference's tests/unittests/bases/test_ddp.py
+(reduction correctness :34-60, uneven gather :63-77, list-state sync) —
+replayed via shard_map collectives instead of a gloo process pool.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.parallel import sharded_update, sync_state
+
+
+class StatMetric(Metric):
+    def __init__(self, reduce="sum", **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx=reduce)
+
+    def _update(self, state, x):
+        r = self._reductions["x"]
+        val = jnp.sum(x) if r == Reduce.SUM else (
+            jnp.mean(x) if r == Reduce.MEAN else (jnp.max(x) if r == Reduce.MAX else jnp.min(x))
+        )
+        if r == Reduce.SUM:
+            return {"x": state["x"] + val}
+        if r == Reduce.MEAN:
+            return {"x": val}  # per-shard mean; rank-equal weighting on sync
+        if r == Reduce.MAX:
+            return {"x": jnp.maximum(state["x"], val)}
+        return {"x": jnp.minimum(state["x"], val)}
+
+    def _compute(self, state):
+        return state["x"]
+
+
+@pytest.mark.parametrize("reduce,expected_fn", [
+    ("sum", lambda x: x.sum()),
+    ("mean", lambda x: x.reshape(8, -1).mean(axis=1).mean()),
+    ("max", lambda x: x.max()),
+])
+def test_sync_reductions(mesh, reduce, expected_fn):
+    data = jnp.arange(16.0)
+    m = StatMetric(reduce=reduce)
+
+    def step(shard):
+        st = m.update_state(m.init_state(), shard)
+        return m.sync_states(st, "data")["x"]
+
+    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
+    np.testing.assert_allclose(np.asarray(out), float(expected_fn(np.arange(16.0))), rtol=1e-6)
+
+
+def test_sync_cat_tensor_state(mesh):
+    class CatState(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", jnp.zeros((0,)), dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"x": jnp.concatenate([state["x"], x])}
+
+        def _compute(self, state):
+            return state["x"]
+
+    data = jnp.arange(16.0)
+    m = CatState()
+
+    def step(shard):
+        st = m.update_state(m.init_state(), shard)
+        return m.sync_states(st, "data")["x"]
+
+    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)(data)
+    assert out.shape == (16,)
+    np.testing.assert_allclose(np.sort(np.asarray(out)), np.arange(16.0))
+
+
+def test_sharded_update_helper(mesh):
+    m = StatMetric(reduce="sum")
+    data = jnp.arange(32.0)
+    state = sharded_update(m, data, mesh=mesh)
+    np.testing.assert_allclose(float(m.compute_state(state)), 32 * 31 / 2)
+    assert int(state["_n"]) == 8  # one update per device
+
+
+def test_sync_update_counter(mesh):
+    m = StatMetric(reduce="sum")
+
+    def step(shard):
+        st = m.update_state(m.init_state(), shard)
+        st = m.update_state(st, shard)
+        return m.sync_states(st, "data")["_n"]
+
+    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(jnp.arange(16.0))
+    assert int(out) == 16  # 2 updates x 8 devices
+
+
+def test_sync_inside_jit_fuses(mesh):
+    """sync_states must be traceable under jit (the whole point of the design)."""
+    m = StatMetric(reduce="sum")
+
+    @jax.jit
+    def full_step(data):
+        def inner(shard):
+            st = m.update_state(m.init_state(), shard)
+            return m.sync_states(st, "data")["x"]
+
+        return jax.shard_map(inner, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
+
+    out = full_step(jnp.arange(16.0))
+    assert float(out) == 120.0

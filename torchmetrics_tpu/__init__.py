@@ -1,0 +1,36 @@
+"""torchmetrics_tpu — a TPU-native metrics framework.
+
+Brand-new JAX/XLA re-design with the capability surface of the reference
+TorchMetrics library (/root/reference): stateful metrics whose state is a
+shardable ``jax.Array`` pytree, cross-device sync lowering to
+``jax.lax.psum``/``all_gather`` over ICI/DCN, and a pure functional core
+(`init_state`/`update_state`/`compute_state`/`merge_states`/`sync_states`)
+traceable under ``jax.jit``/``pjit`` so per-step metric accumulation fuses
+into the XLA step graph.
+"""
+
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from torchmetrics_tpu.core import CompositionalMetric, Metric, Reduce
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "Reduce",
+    "RunningMean",
+    "RunningSum",
+    "SumMetric",
+]

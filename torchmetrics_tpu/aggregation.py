@@ -1,0 +1,218 @@
+"""Aggregation metrics: Sum/Mean/Max/Min/Cat + running variants.
+
+TPU-native counterpart of the reference's ``aggregation.py``
+(/root/reference/src/torchmetrics/aggregation.py:30-727).  NaN handling is
+expressed with ``jnp.where`` masks so ``ignore``/impute strategies stay fully
+jittable; ``error``/``warn`` strategies require a host readback and therefore
+only fire on the eager facade path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base for aggregation metrics (reference: aggregation.py:30-111).
+
+    Args:
+        fn: reduction applied between current state and new input
+        default_value: initial state value
+        nan_strategy: ``error`` | ``warn`` | ``ignore`` | ``disable`` | float (impute)
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        state_name: str,
+        default_value: Union[Array, list],
+        dist_reduce_fx: str,
+        nan_strategy: Union[str, float] = "error",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore", "disable")
+        if not (isinstance(nan_strategy, (int, float)) and not isinstance(nan_strategy, bool)) and nan_strategy not in allowed:
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.state_name = state_name
+        self.add_state(state_name, default=default_value, dist_reduce_fx=dist_reduce_fx)
+
+    def _handle_nan(self, x: Array) -> Array:
+        """Apply the NaN strategy; error/warn need a host readback (eager only)."""
+        if self.nan_strategy == "disable":
+            return x
+        if isinstance(self.nan_strategy, (int, float)) and not isinstance(self.nan_strategy, bool):
+            return jnp.where(jnp.isnan(x), jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
+        if self.nan_strategy == "ignore":
+            return x  # masking handled per-subclass (needs the identity element)
+        # error / warn: host readback — only valid outside jit
+        try:
+            has_nan = bool(jnp.isnan(x).any())
+        except Exception:  # traced: silently fall through (jit path)
+            return x
+        if has_nan:
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+        return x
+
+    def _cast_input(self, x: Union[float, Array]) -> Array:
+        x = jnp.asarray(x, dtype=self.dtype)
+        return self._handle_nan(jnp.atleast_1d(x) if x.ndim == 0 else x)
+
+    def _nan_mask_reduce(self, x: Array, reduce_fn: Callable, identity: float) -> Array:
+        """Reduce ``x`` with NaNs replaced by the reduction identity."""
+        if self.nan_strategy == "disable":
+            return reduce_fn(x)
+        return reduce_fn(jnp.where(jnp.isnan(x), jnp.asarray(identity, dtype=x.dtype), x))
+
+    def _compute(self, state: State) -> Array:
+        value = state[self.state_name]
+        if isinstance(value, tuple):
+            return dim_zero_cat(value)
+        return value
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference: aggregation.py:114-218)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max_value", jnp.asarray(-jnp.inf), "max", nan_strategy, **kwargs)
+
+    def _update(self, state: State, value: Union[float, Array]) -> State:
+        value = self._cast_input(value)
+        return {"max_value": jnp.maximum(state["max_value"], self._nan_mask_reduce(value, jnp.max, -jnp.inf))}
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference: aggregation.py:219-323)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min_value", jnp.asarray(jnp.inf), "min", nan_strategy, **kwargs)
+
+    def _update(self, state: State, value: Union[float, Array]) -> State:
+        value = self._cast_input(value)
+        return {"min_value": jnp.minimum(state["min_value"], self._nan_mask_reduce(value, jnp.min, jnp.inf))}
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference: aggregation.py:324-428)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum_value", jnp.zeros(()), "sum", nan_strategy, **kwargs)
+
+    def _update(self, state: State, value: Union[float, Array]) -> State:
+        value = self._cast_input(value)
+        return {"sum_value": state["sum_value"] + self._nan_mask_reduce(value, jnp.sum, 0.0)}
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference: aggregation.py:429-492)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("value", [], "cat", nan_strategy, **kwargs)
+
+    def _update(self, state: State, value: Union[float, Array]) -> State:
+        value = self._cast_input(value)
+        if self.nan_strategy == "ignore":
+            value = value[~jnp.isnan(value)]
+        return {"value": tuple(state["value"]) + (value,)}
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference: aggregation.py:493-615)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("mean_value", jnp.zeros(()), "sum", nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.state_name = "mean_value"
+
+    def _update(self, state: State, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> State:
+        value = self._cast_input(value)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=self.dtype), value.shape)
+        nan_mask = jnp.isnan(value)
+        if self.nan_strategy != "disable":
+            weight = jnp.where(nan_mask, 0.0, weight)
+            value = jnp.where(nan_mask, 0.0, value)
+        return {
+            "mean_value": state["mean_value"] + jnp.sum(value * weight),
+            "weight": state["weight"] + jnp.sum(weight),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return state["mean_value"] / jnp.maximum(state["weight"], jnp.finfo(self.dtype).eps)
+
+
+class RunningMean(MeanMetric):
+    """Mean over a sliding window of the last ``window`` updates.
+
+    Reference (aggregation.py:616-672) duplicates states × window and
+    round-robin-overwrites; here the ring buffer is two fixed-shape arrays —
+    static shapes, so it jits.
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(nan_strategy=nan_strategy, **kwargs)
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Argument `window` should be a positive integer but got {window}")
+        self.window = window
+        self.add_state("ring_value", default=jnp.zeros(window), dist_reduce_fx=None)
+        self.add_state("ring_weight", default=jnp.zeros(window), dist_reduce_fx=None)
+
+    def _update(self, state: State, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> State:
+        value = self._cast_input(value)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=self.dtype), value.shape)
+        nan_mask = jnp.isnan(value)
+        if self.nan_strategy != "disable":
+            weight = jnp.where(nan_mask, 0.0, weight)
+            value = jnp.where(nan_mask, 0.0, value)
+        slot = jnp.mod(state["_n"], self.window)
+        return {
+            "mean_value": state["mean_value"],
+            "weight": state["weight"],
+            "ring_value": state["ring_value"].at[slot].set(jnp.sum(value * weight)),
+            "ring_weight": state["ring_weight"].at[slot].set(jnp.sum(weight)),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return jnp.sum(state["ring_value"]) / jnp.maximum(jnp.sum(state["ring_weight"]), jnp.finfo(self.dtype).eps)
+
+
+class RunningSum(SumMetric):
+    """Sum over a sliding window of the last ``window`` updates (reference: aggregation.py:673-727)."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(nan_strategy=nan_strategy, **kwargs)
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Argument `window` should be a positive integer but got {window}")
+        self.window = window
+        self.add_state("ring_value", default=jnp.zeros(window), dist_reduce_fx=None)
+
+    def _update(self, state: State, value: Union[float, Array]) -> State:
+        value = self._cast_input(value)
+        slot = jnp.mod(state["_n"], self.window)
+        return {
+            "sum_value": state["sum_value"],
+            "ring_value": state["ring_value"].at[slot].set(self._nan_mask_reduce(value, jnp.sum, 0.0)),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return jnp.sum(state["ring_value"])

@@ -1,0 +1,5 @@
+from torchmetrics_tpu.core.composition import CompositionalMetric
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.core.reductions import Reduce
+
+__all__ = ["CompositionalMetric", "Metric", "Reduce"]

@@ -1,0 +1,103 @@
+"""Lazy metric arithmetic DAGs.
+
+Equivalent of the reference's ``CompositionalMetric``
+(/root/reference/src/torchmetrics/metric.py:1122-1245): operator dunders on
+``Metric`` build a lazy DAG whose ``update``/``reset`` fan out to the operand
+metrics and whose ``compute`` applies the operator to the operand results.
+The composition does no syncing of its own (the operands sync themselves —
+reference metric.py:1161).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+
+
+class CompositionalMetric(Metric):
+    """Composition of two metrics (or a metric and a constant) via an operator."""
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Any],
+        metric_b: Optional[Union[Metric, float, int, Any]],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else jnp.asarray(metric_a) if metric_a is not None else None
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (jnp.asarray(metric_b) if metric_b is not None else None)
+
+    def _sync_dist(self, *args: Any, **kwargs: Any) -> None:
+        # No syncing of composition leaves — operands sync themselves.
+        pass
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._computed = None
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    @property
+    def update_called(self) -> bool:
+        a = self.metric_a.update_called if isinstance(self.metric_a, Metric) else True
+        b = self.metric_b.update_called if isinstance(self.metric_b, Metric) else True
+        return a and b
+
+    def compute(self) -> Any:
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        result = self.op(val_a) if val_b is None else self.op(val_a, val_b)
+        if self.compute_with_cache:
+            self._computed = result
+        return result
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            self._forward_cache = None
+        elif val_b is None and self.metric_b is None:
+            self._forward_cache = self.op(val_a)
+        elif val_b is None:
+            self._forward_cache = None
+        else:
+            self._forward_cache = self.op(val_a, val_b)
+        self._computed = None
+        return self._forward_cache
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._computed = None
+        self._forward_cache = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode)
+
+    def __repr__(self) -> str:
+        _op_name = getattr(self.op, "__name__", str(self.op))
+        repr_str = self.__class__.__name__ + f"(\n  {_op_name}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return repr_str

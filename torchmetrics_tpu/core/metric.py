@@ -1,0 +1,512 @@
+"""``Metric`` — the core runtime.
+
+TPU-native re-design of the reference's ``Metric`` base
+(/root/reference/src/torchmetrics/metric.py:51-1245).  The torch version is a
+stateful ``nn.Module`` that mutates state tensors in place — impossible under
+``jax.jit``.  Here the *functional core* is primary and the familiar stateful
+API is a thin eager facade over it:
+
+functional core (pure, jittable — usable directly inside a pjit'd step):
+    ``init_state() -> State``
+    ``update_state(state, *inputs) -> State``
+    ``compute_state(state) -> result``
+    ``merge_states(a, b) -> State``        (reference ``_reduce_states``, metric.py:401)
+    ``sync_states(state, axis_name)``      (reference ``_sync_dist``, metric.py:435)
+
+facade (reference-API parity):
+    ``update / compute / forward / reset / state_dict / clone / plot`` and the
+    ~30 arithmetic dunders building :class:`CompositionalMetric` DAGs.
+
+State is a dict pytree ``{name: Array | tuple[Array, ...]}`` plus a reserved
+``"_n"`` update-count leaf (int32).  List ("cat") states are tuples of arrays
+— still a pytree, so every state is shardable, donat-able and checkpointable
+with orbax as-is.  ``sync`` is pure and returns a *new* state, which deletes
+the reference's cache/restore sync-unsync dance (metric.py:507-608) wholesale.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import pickle
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.reductions import (
+    Reduce,
+    canonical_reduce,
+    is_list_state,
+    merge_leaf,
+    sync_leaf,
+)
+from torchmetrics_tpu.parallel.sync import distributed_available, host_sync_state
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+State = Dict[str, Any]
+
+_N = "_n"  # reserved state key: int32 update counter, always psum/sum-merged
+
+
+class Metric:
+    """Base class for all metrics.
+
+    Args (mirroring the reference ctor kwargs, metric.py:101-150, with the
+    torch.distributed knobs mapped to their mesh equivalents):
+        sync_on_compute: host-sync state across processes inside ``compute``.
+        dist_sync_on_step: sync on every ``forward`` (expensive; off by default).
+        compute_with_cache: cache the ``compute`` result until next update/reset.
+        axis_name: mesh axis used by the in-graph ``sync_states``.
+        jit: jit-compile the facade ``update`` path (tensor-state metrics only).
+    """
+
+    __jit_state_exclude__: Tuple[str, ...] = ()
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._defaults: Dict[str, Any] = {}
+        self._reductions: Dict[str, Union[Reduce, Callable]] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._state: State = {_N: jnp.zeros((), dtype=jnp.int32)}
+        self._computed: Any = None
+        self._forward_cache: Any = None
+        self._dtype: Optional[jnp.dtype] = None
+
+        self.sync_on_compute: bool = kwargs.pop("sync_on_compute", True)
+        self.dist_sync_on_step: bool = kwargs.pop("dist_sync_on_step", False)
+        self.compute_with_cache: bool = kwargs.pop("compute_with_cache", True)
+        self.axis_name: str = kwargs.pop("axis_name", "data")
+        self._enable_jit: bool = kwargs.pop("jit", False)
+        self.dist_sync_fn: Optional[Callable] = kwargs.pop("dist_sync_fn", None)
+        self.distributed_available_fn: Callable = kwargs.pop(
+            "distributed_available_fn", distributed_available
+        )
+        self.process_group: Optional[Any] = kwargs.pop("process_group", None)
+        kwargs.pop("compute_on_cpu", None)  # accepted for API parity; host state is the default here
+        if kwargs:
+            raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
+
+        self._jitted_update: Optional[Callable] = None
+        self._update_signature = inspect.signature(self._update)
+
+    # ------------------------------------------------------------------ state
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list, Sequence],
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a state leaf (reference: metric.py:197-280).
+
+        ``default`` is an array (tensor state) or an empty list (list state,
+        stored as a tuple of arrays).  ``dist_reduce_fx`` ∈
+        sum|mean|max|min|cat|callable|None.
+        """
+        if name.startswith("_"):
+            raise ValueError(f"State name {name!r} must not start with '_'")
+        if not isinstance(default, (list, tuple)) and not isinstance(
+            default, (jnp.ndarray, np.ndarray, jax.Array, int, float)
+        ):
+            raise ValueError("state variable must be an array or an empty list")
+        if isinstance(default, (list, tuple)) and len(default) != 0:
+            raise ValueError("list-type state must start empty")
+
+        reduce = canonical_reduce(dist_reduce_fx)
+        if is_list_state(default):
+            self._defaults[name] = ()
+            self._state[name] = ()
+        else:
+            arr = jnp.asarray(default)
+            self._defaults[name] = arr
+            self._state[name] = arr
+        self._reductions[name] = reduce
+        self._persistent[name] = persistent
+
+    @property
+    def _has_list_states(self) -> bool:
+        return any(is_list_state(v) for v in self._defaults.values())
+
+    # -------------------------------------------------------- functional core
+    def init_state(self) -> State:
+        """Fresh state pytree (pure)."""
+        st = {k: v for k, v in self._defaults.items()}
+        st[_N] = jnp.zeros((), dtype=jnp.int32)
+        return st
+
+    def update_state(self, state: State, *args: Any, **kwargs: Any) -> State:
+        """Pure update: returns a new state with this batch folded in."""
+        new = dict(self._update(state, *args, **kwargs))
+        new[_N] = state[_N] + 1
+        return new
+
+    def compute_state(self, state: State) -> Any:
+        """Pure compute on a state pytree."""
+        return self._compute(state)
+
+    def merge_states(self, a: State, b: State) -> State:
+        """Combine two states under the per-leaf reduction table (pure).
+
+        This is the reference's ``_reduce_states`` (metric.py:401-433) promoted
+        to a public primitive — it powers ``forward`` accumulation, compute
+        groups, and checkpoint joining.
+        """
+        out: State = {}
+        for name, reduce in self._reductions.items():
+            out[name] = merge_leaf(reduce, a[name], b[name], n_a=a[_N], n_b=b[_N])
+        out[_N] = a[_N] + b[_N]
+        return out
+
+    def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
+        """In-graph cross-device sync (pure; call under shard_map/pmap)."""
+        axis_name = axis_name or self.axis_name
+        out: State = {}
+        for name, reduce in self._reductions.items():
+            out[name] = sync_leaf(reduce, state[name], axis_name)
+        out[_N] = jax.lax.psum(state[_N], axis_name)
+        return out
+
+    # ------------------------------------------------------- subclass contract
+    def _update(self, state: State, *args: Any, **kwargs: Any) -> State:
+        raise NotImplementedError
+
+    def _compute(self, state: State) -> Any:
+        raise NotImplementedError
+
+    # ----------------------------------------------------------------- facade
+    @property
+    def update_called(self) -> bool:
+        return int(self._state[_N]) > 0
+
+    @property
+    def update_count(self) -> int:
+        return int(self._state[_N])
+
+    @property
+    def metric_state(self) -> State:
+        """The current raw state pytree (including the ``_n`` counter)."""
+        return self._state
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate a batch into the global state."""
+        self._computed = None
+        if self._enable_jit and not self._has_list_states:
+            if self._jitted_update is None:
+                self._jitted_update = jax.jit(self.update_state)
+            self._state = self._jitted_update(self._state, *args, **kwargs)
+        else:
+            self._state = self.update_state(self._state, *args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute over accumulated (and, if multi-host, synced) state."""
+        if not self.update_called:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {self.__class__.__name__} was called before "
+                "the ``update`` method which may lead to errors, as metric states have not yet been updated.",
+                UserWarning,
+            )
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+
+        state = self._state
+        if self.sync_on_compute and self.distributed_available_fn():
+            if self.dist_sync_fn is not None:
+                state = self.dist_sync_fn(state, self._reductions)
+            else:
+                state = host_sync_state(state, self._reductions)
+        value = self.compute_state(state)
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value + global accumulation in one call (reference metric.py:283-432).
+
+        The reduce-state fast path is the default: compute the batch state
+        fresh, merge into the global state, return ``compute`` on the batch
+        state.  Metrics whose ``update`` is not merge-distributive set
+        ``full_state_update=True`` and take the two-update path.
+        """
+        if self.full_state_update:
+            self._state = self.update_state(self._state, *args, **kwargs)
+            batch_state = self.update_state(self.init_state(), *args, **kwargs)
+        else:
+            batch_state = self.update_state(self.init_state(), *args, **kwargs)
+            self._state = self.merge_states(self._state, batch_state)
+        self._computed = None
+        if self.dist_sync_on_step and self.distributed_available_fn():
+            batch_state = host_sync_state(batch_state, self._reductions)
+        self._forward_cache = self.compute_state(batch_state)
+        return self._forward_cache
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        """Restore default state (reference: metric.py:692-707)."""
+        self._state = self.init_state()
+        self._computed = None
+        self._forward_cache = None
+
+    # ------------------------------------------------------------- lifecycle
+    def clone(self) -> "Metric":
+        return deepcopy(self)
+
+    def __copy__(self) -> "Metric":
+        return deepcopy(self)
+
+    def persistent(self, mode: bool = False) -> None:
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
+        """Persistent states as host numpy (orbax/np.savez-compatible)."""
+        destination = destination if destination is not None else {}
+        for name, persistent in self._persistent.items():
+            if not persistent:
+                continue
+            value = self._state[name]
+            if isinstance(value, tuple):
+                destination[prefix + name] = [np.asarray(v) for v in value]
+            else:
+                destination[prefix + name] = np.asarray(value)
+        return destination
+
+    def load_state_dict(self, state_dict: Mapping[str, Any], prefix: str = "") -> None:
+        for name in self._defaults:
+            key = prefix + name
+            if key in state_dict:
+                value = state_dict[key]
+                if isinstance(value, (list, tuple)):
+                    self._state[name] = tuple(jnp.asarray(v) for v in value)
+                else:
+                    self._state[name] = jnp.asarray(value)
+        self._computed = None
+
+    def state_pytree(self) -> State:
+        """Full state as a pytree for orbax checkpointing."""
+        return self._state
+
+    def load_state_pytree(self, state: State) -> None:
+        self._state = jax.tree.map(jnp.asarray, state)
+        self._computed = None
+
+    # pickling: state arrays -> numpy for portability (reference metric.py:713-732)
+    def __getstate__(self) -> Dict[str, Any]:
+        d = self.__dict__.copy()
+        d.pop("_jitted_update", None)
+        d.pop("_update_signature", None)
+        d["_state"] = jax.tree.map(np.asarray, self._state)
+        d["_defaults"] = jax.tree.map(np.asarray, self._defaults)
+        d["_computed"] = None
+        return d
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._state = {
+            k: tuple(jnp.asarray(x) for x in v) if isinstance(v, (list, tuple)) else jnp.asarray(v)
+            for k, v in self._state.items()
+        }
+        self._defaults = {
+            k: v if isinstance(v, tuple) else jnp.asarray(v) for k, v in self._defaults.items()
+        }
+        self._jitted_update = None
+        self._update_signature = inspect.signature(self._update)
+
+    # ------------------------------------------------------------ dtype/device
+    @property
+    def dtype(self) -> jnp.dtype:
+        return self._dtype or jnp.float32
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Cast float state leaves (reference: metric.py:789-799)."""
+        dst = jnp.dtype(dst_type)
+        self._dtype = dst
+
+        def cast(x):
+            if isinstance(x, tuple):
+                return tuple(cast(xi) for xi in x)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dst)
+            return x
+
+        self._state = {k: cast(v) for k, v in self._state.items()}
+        self._defaults = {k: cast(v) for k, v in self._defaults.items()}
+        self._jitted_update = None
+        return self
+
+    def to_device(self, device: Any) -> "Metric":
+        """Move state to a device/sharding (reference ``_apply``, metric.py:801-851)."""
+        self._state = jax.device_put(self._state, device)
+        self._defaults = jax.device_put(self._defaults, device)
+        return self
+
+    # ----------------------------------------------------------------- kwargs
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs that this metric's ``_update`` accepts.
+
+        Lets ``MetricCollection`` broadcast one kwargs dict to heterogeneous
+        metrics (reference: metric.py:926-945).
+        """
+        params = self._update_signature.parameters
+        has_var_kw = any(p.kind == p.VAR_KEYWORD for p in params.values())
+        if has_var_kw:
+            return kwargs
+        names = {
+            n for n, p in params.items()
+            if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY) and n not in ("state", "self")
+        }
+        return {k: v for k, v in kwargs.items() if k in names}
+
+    # ------------------------------------------------------------------ repr
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    def __hash__(self) -> int:
+        # hash on identity + state names (reference: metric.py:947-957)
+        return hash((id(self), tuple(self._defaults.keys())))
+
+    # ------------------------------------------------------------------ plot
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        """Single-value plot; see utilities/plot.py (reference metric.py:656-690)."""
+        from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=self.__class__.__name__,
+        )
+
+    # ------------------------------------------------------------- arithmetic
+    def _compose(self, op: Callable, other: Any, reverse: bool = False) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        if reverse:
+            return CompositionalMetric(op, other, self)
+        return CompositionalMetric(op, self, other)
+
+    def __add__(self, other: Any) -> "Metric":
+        return self._compose(jnp.add, other)
+
+    def __radd__(self, other: Any) -> "Metric":
+        return self._compose(jnp.add, other, reverse=True)
+
+    def __sub__(self, other: Any) -> "Metric":
+        return self._compose(jnp.subtract, other)
+
+    def __rsub__(self, other: Any) -> "Metric":
+        return self._compose(jnp.subtract, other, reverse=True)
+
+    def __mul__(self, other: Any) -> "Metric":
+        return self._compose(jnp.multiply, other)
+
+    def __rmul__(self, other: Any) -> "Metric":
+        return self._compose(jnp.multiply, other, reverse=True)
+
+    def __truediv__(self, other: Any) -> "Metric":
+        return self._compose(jnp.divide, other)
+
+    def __rtruediv__(self, other: Any) -> "Metric":
+        return self._compose(jnp.divide, other, reverse=True)
+
+    def __floordiv__(self, other: Any) -> "Metric":
+        return self._compose(jnp.floor_divide, other)
+
+    def __rfloordiv__(self, other: Any) -> "Metric":
+        return self._compose(jnp.floor_divide, other, reverse=True)
+
+    def __mod__(self, other: Any) -> "Metric":
+        return self._compose(jnp.mod, other)
+
+    def __rmod__(self, other: Any) -> "Metric":
+        return self._compose(jnp.mod, other, reverse=True)
+
+    def __pow__(self, other: Any) -> "Metric":
+        return self._compose(jnp.power, other)
+
+    def __rpow__(self, other: Any) -> "Metric":
+        return self._compose(jnp.power, other, reverse=True)
+
+    def __matmul__(self, other: Any) -> "Metric":
+        return self._compose(jnp.matmul, other)
+
+    def __rmatmul__(self, other: Any) -> "Metric":
+        return self._compose(jnp.matmul, other, reverse=True)
+
+    def __and__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_and, other)
+
+    def __rand__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_and, other, reverse=True)
+
+    def __or__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_or, other)
+
+    def __ror__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_or, other, reverse=True)
+
+    def __xor__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_xor, other)
+
+    def __rxor__(self, other: Any) -> "Metric":
+        return self._compose(jnp.bitwise_xor, other, reverse=True)
+
+    def __eq__(self, other: Any) -> "Metric":  # type: ignore[override]
+        return self._compose(jnp.equal, other)
+
+    def __ne__(self, other: Any) -> "Metric":  # type: ignore[override]
+        return self._compose(jnp.not_equal, other)
+
+    def __lt__(self, other: Any) -> "Metric":
+        return self._compose(jnp.less, other)
+
+    def __le__(self, other: Any) -> "Metric":
+        return self._compose(jnp.less_equal, other)
+
+    def __gt__(self, other: Any) -> "Metric":
+        return self._compose(jnp.greater, other)
+
+    def __ge__(self, other: Any) -> "Metric":
+        return self._compose(jnp.greater_equal, other)
+
+    def __neg__(self) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        return CompositionalMetric(jnp.negative, self, None)
+
+    def __pos__(self) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __abs__(self) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx: Any) -> "Metric":
+        from torchmetrics_tpu.core.composition import CompositionalMetric
+
+        return CompositionalMetric(lambda x: x[idx], self, None)

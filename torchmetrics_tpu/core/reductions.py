@@ -1,0 +1,162 @@
+"""Per-state reduction specs — the contract between ``update``, ``merge`` and ``sync``.
+
+The reference attaches a ``dist_reduce_fx`` string to every state registered
+via ``Metric.add_state`` (/root/reference/src/torchmetrics/metric.py:197-280)
+and applies it *after* a ``torch.distributed`` all_gather
+(metric.py:459-474).  In the TPU-native design the same spec drives three
+different lowerings of one semantic operation:
+
+* ``merge(a, b)``   — local pairwise combine (the reference's
+  ``_reduce_states``, metric.py:401-433) used by ``forward`` accumulation and
+  checkpoint joining;
+* ``sync``          — in-graph cross-device combine lowering to
+  ``jax.lax.psum/pmax/pmin/all_gather`` over a named mesh axis (ICI);
+* ``host_sync``     — out-of-graph cross-process combine via
+  ``multihost_utils.process_allgather`` (DCN) for the eager facade.
+
+List ("cat") states are represented as *tuples of arrays* so the whole state
+stays a valid JAX pytree.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+class Reduce(str, Enum):
+    SUM = "sum"
+    MEAN = "mean"
+    MAX = "max"
+    MIN = "min"
+    CAT = "cat"
+    NONE = "none"
+
+
+ReduceFx = Union[Reduce, str, Callable, None]
+
+
+def canonical_reduce(fx: ReduceFx) -> Union[Reduce, Callable]:
+    """Normalize a user-provided ``dist_reduce_fx`` into a :class:`Reduce` or callable."""
+    if fx is None:
+        return Reduce.NONE
+    if callable(fx):
+        return fx
+    if isinstance(fx, Reduce):
+        return fx
+    try:
+        return Reduce(str(fx))
+    except ValueError:
+        raise ValueError(
+            f"`dist_reduce_fx` must be one of {[r.value for r in Reduce]}, a callable, or None; got {fx!r}"
+        )
+
+
+ListState = Tuple[Array, ...]
+
+
+def is_list_state(default: Any) -> bool:
+    return isinstance(default, (list, tuple))
+
+
+def merge_leaf(
+    reduce: Union[Reduce, Callable],
+    a: Union[Array, ListState],
+    b: Union[Array, ListState],
+    n_a: Optional[Array] = None,
+    n_b: Optional[Array] = None,
+) -> Union[Array, ListState]:
+    """Pairwise merge of two state leaves under the given reduction.
+
+    For ``MEAN`` the merge is the running-mean correction weighted by update
+    counts (the reference's metric.py:415-420).
+    """
+    if callable(reduce) and not isinstance(reduce, Reduce):
+        return reduce(jnp.stack([a, b]))
+    if reduce == Reduce.SUM:
+        return a + b
+    if reduce == Reduce.MEAN:
+        if n_a is None or n_b is None:
+            return (a + b) / 2.0
+        tot = n_a + n_b
+        return (a * n_a + b * n_b) / jnp.maximum(tot, 1)
+    if reduce == Reduce.MAX:
+        return jnp.maximum(a, b)
+    if reduce == Reduce.MIN:
+        return jnp.minimum(a, b)
+    if reduce in (Reduce.CAT, Reduce.NONE):
+        return tuple(a) + tuple(b)
+    raise ValueError(f"Unknown reduction {reduce}")
+
+
+def sync_leaf(
+    reduce: Union[Reduce, Callable],
+    value: Union[Array, ListState],
+    axis_name: str,
+) -> Union[Array, ListState]:
+    """In-graph cross-device combine of one leaf over ``axis_name``.
+
+    Must be called inside ``shard_map``/``pmap``/``pjit``-with-axis context.
+    sum/mean/max/min lower to single ICI collectives; cat/none lower to
+    ``all_gather`` (tiled concat along dim 0 for cat — matching the
+    reference's dim_zero_cat-after-gather at metric.py:467-470).
+    """
+    if callable(reduce) and not isinstance(reduce, Reduce):
+        gathered = jax.lax.all_gather(value, axis_name)
+        return reduce(gathered)
+    if reduce == Reduce.SUM:
+        return jax.lax.psum(value, axis_name)
+    if reduce == Reduce.MEAN:
+        return jax.lax.pmean(value, axis_name)
+    if reduce == Reduce.MAX:
+        return jax.lax.pmax(value, axis_name)
+    if reduce == Reduce.MIN:
+        return jax.lax.pmin(value, axis_name)
+    if reduce == Reduce.CAT:
+        if isinstance(value, tuple):
+            return tuple(jax.lax.all_gather(v, axis_name, axis=0, tiled=True) for v in value)
+        return jax.lax.all_gather(value, axis_name, axis=0, tiled=True)
+    if reduce == Reduce.NONE:
+        if isinstance(value, tuple):
+            return tuple(jax.lax.all_gather(v, axis_name) for v in value)
+        return jax.lax.all_gather(value, axis_name)
+    raise ValueError(f"Unknown reduction {reduce}")
+
+
+def host_sync_leaf(
+    reduce: Union[Reduce, Callable],
+    value: Union[Array, ListState],
+) -> Union[Array, ListState]:
+    """Cross-process (multi-host) combine of one leaf, outside any jit graph.
+
+    Uses ``multihost_utils.process_allgather`` — the DCN path.  A no-op when
+    ``jax.process_count() == 1``.
+    """
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    if isinstance(value, tuple):
+        local = jnp.concatenate([jnp.atleast_1d(v) for v in value]) if value else jnp.zeros((0,))
+        gathered = multihost_utils.process_allgather(local, tiled=True)
+        return (gathered,)
+    gathered = multihost_utils.process_allgather(value)  # (n_proc, ...)
+    if callable(reduce) and not isinstance(reduce, Reduce):
+        return reduce(gathered)
+    if reduce == Reduce.SUM:
+        return gathered.sum(0)
+    if reduce == Reduce.MEAN:
+        return gathered.mean(0)
+    if reduce == Reduce.MAX:
+        return gathered.max(0)
+    if reduce == Reduce.MIN:
+        return gathered.min(0)
+    if reduce == Reduce.CAT:
+        return jnp.concatenate(list(gathered), axis=0)
+    if reduce == Reduce.NONE:
+        return gathered
+    raise ValueError(f"Unknown reduction {reduce}")

@@ -1,0 +1,31 @@
+"""Distributed backend: in-graph collectives over a device mesh + host-level DCN sync.
+
+The reference's entire comm backend is ``gather_all_tensors``
+(/root/reference/src/torchmetrics/utilities/distributed.py:97-147) over
+``torch.distributed``.  Here the equivalent surface is:
+
+* :func:`sync_state` / :func:`sync_leaf` — in-graph, inside shard_map/pjit,
+  lowering to XLA collectives over ICI;
+* :func:`gather_all_arrays` — host-level all-gather across processes (DCN);
+* :func:`metric_mesh`, :func:`sharded_update` — mesh construction and a
+  one-call helper that runs a metric ``update`` on batch-sharded inputs and
+  psum-merges the partial states.
+"""
+
+from torchmetrics_tpu.parallel.sync import (
+    distributed_available,
+    gather_all_arrays,
+    metric_mesh,
+    reduce as reduce_op,
+    sharded_update,
+    sync_state,
+)
+
+__all__ = [
+    "distributed_available",
+    "gather_all_arrays",
+    "metric_mesh",
+    "reduce_op",
+    "sharded_update",
+    "sync_state",
+]

@@ -1,0 +1,106 @@
+"""Shared numeric kernels.
+
+TPU-native counterpart of the reference's ``utilities/compute.py``
+(/root/reference/src/torchmetrics/utilities/compute.py:20-162).  All functions
+are pure, jittable, static-shape, and avoid data-dependent Python control
+flow so they fuse into the surrounding XLA graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul; kept as a named hook so large cases can be chunked later.
+
+    Reference: utilities/compute.py:20-28 (chunks to avoid CUDA OOM — on TPU
+    we let XLA tile onto the MXU instead).
+    """
+    return x @ y.T
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x * log(y) with 0*log(0) := 0 (reference: compute.py:31-43)."""
+    res = jax.scipy.special.xlogy(x, y)
+    return res
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Elementwise num/denom, returning ``zero_division`` where denom == 0.
+
+    Reference: utilities/compute.py:46-62.
+    """
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, jnp.float32)
+    zero_mask = denom == 0
+    safe_denom = jnp.where(zero_mask, 1.0, denom)
+    return jnp.where(zero_mask, jnp.asarray(zero_division, dtype=safe_denom.dtype), num / safe_denom)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array,
+    top_k: int = 1,
+) -> Array:
+    """Weighted/macro reduction over per-class scores (reference: compute.py:65-90)."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = tp + fn
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            weights = jnp.where(tp + fp + fn == 0 * jnp.minimum(1, top_k), 0.0, weights)
+    return _safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)).sum(-1)
+
+
+def _auc_compute(x: Array, y: Array, direction: Optional[float] = None, reorder: bool = False) -> Array:
+    """Trapezoidal area under the (x, y) curve.
+
+    Reference: utilities/compute.py:93-136.  The dynamic direction check is
+    done with ``jnp.sign`` on the diffs so it stays traceable; ``reorder``
+    sorts by x (static-shape argsort).
+    """
+    if reorder:
+        order = jnp.argsort(x, kind="stable")
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    if direction is None:
+        # all diffs must share a sign; use the sign of the summed diffs
+        direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return (jnp.trapezoid(y, x) * direction).astype(y.dtype)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Public AUC wrapper (trapezoidal)."""
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation mirroring ``np.interp``.
+
+    Reference: utilities/compute.py:139-162; jnp has a native vectorized one.
+    """
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> Array:
+    """Apply sigmoid/softmax iff values fall outside [0, 1].
+
+    Reference pattern (functional/classification/*_format): ``if not
+    ((0 <= preds) & (preds <= 1)).all(): preds = preds.sigmoid()``.  Under
+    jit that data-dependent branch must be a ``jnp.where`` — both branches are
+    cheap elementwise ops that XLA fuses away.
+    """
+    if normalization is None:
+        return tensor
+    outside = jnp.logical_or(jnp.any(tensor < 0), jnp.any(tensor > 1))
+    if normalization == "sigmoid":
+        return jnp.where(outside, jax.nn.sigmoid(tensor), tensor)
+    if normalization == "softmax":
+        return jnp.where(outside, jax.nn.softmax(tensor, axis=1), tensor)
+    raise ValueError(f"Unknown normalization: {normalization}")

@@ -1,0 +1,127 @@
+"""Core data ops on ``jax.Array``.
+
+TPU-native counterpart of the reference's ``utilities/data.py``
+(/root/reference/src/torchmetrics/utilities/data.py:28-245).  Notable design
+differences from the torch version:
+
+* ``_bincount`` — the reference hand-rolls an arange+eq fallback *specifically
+  for XLA* (data.py:203-205).  Here XLA is the native target, so we use a
+  scatter-add (``zeros.at[x].add(1)``), which lowers to a single efficient XLA
+  scatter and requires a **static** ``minlength`` (always known for
+  classification metrics).
+* ``dim_zero_cat`` accepts the tuple-of-arrays representation our list states
+  use (a tuple of arrays is a valid pytree leaf-set, so states stay jittable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def dim_zero_cat(x: Union[Array, Sequence[Array]]) -> Array:
+    """Concatenation along the zero dimension; accepts array, list or tuple of arrays."""
+    if isinstance(x, (list, tuple)):
+        if len(x) == 0:
+            raise ValueError("No samples to concatenate")
+        x = [jnp.atleast_1d(xi) for xi in x]
+        return jnp.concatenate(x, axis=0)
+    return x
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into a single list."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Tuple[Dict, bool]:
+    """Flatten dict of dicts into a single dict; returns (flat, all_unique)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, not duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert a dense label tensor ``(N, ...)`` to one-hot ``(N, C, ...)``.
+
+    Reference: utilities/data.py:80-122.
+    """
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32, axis=1)
+    return onehot
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Inverse of :func:`to_onehot` via argmax along ``argmax_dim``."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k largest entries along ``dim``.
+
+    Reference: utilities/data.py:125-176.  Implemented with
+    ``jax.lax.top_k`` (static k) + scatter — both MXU/XLA friendly.
+    """
+    if topk == 1:  # fast path: pure argmax one-hot
+        idx = jnp.argmax(prob_tensor, axis=dim)
+        return jax.nn.one_hot(idx, prob_tensor.shape[dim], dtype=jnp.int32, axis=dim)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    onehots = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return jnp.moveaxis(onehots, -1, dim)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Count occurrences of each value in 0..minlength-1.
+
+    Static-length scatter-add — single XLA scatter op, deterministic, and
+    (unlike ``torch.bincount``) well-defined under jit.  ``minlength`` must be
+    static.  Reference context: utilities/data.py:179-207.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Cumulative sum — XLA's is already deterministic on TPU.
+
+    (Reference works around nondeterministic CUDA cumsum at data.py:210-219;
+    no workaround is needed here.)
+    """
+    return jnp.cumsum(x, axis=axis)
+
+
+def allclose(t1: Array, t2: Array, atol: float = 1e-8) -> bool:
+    """dtype-robust allclose (reference: utilities/data.py:241-245)."""
+    if t1.shape != t2.shape:
+        return False
+    return bool(jnp.allclose(t1.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+                             t2.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+                             atol=atol))

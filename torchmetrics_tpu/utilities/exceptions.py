@@ -1,0 +1,13 @@
+"""Exception types.
+
+TPU-native re-design of the reference's ``utilities/exceptions.py``
+(see /root/reference/src/torchmetrics/utilities/exceptions.py:16,20).
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised on wrong usage of the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on questionable usage of the metric API."""

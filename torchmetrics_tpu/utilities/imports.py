@@ -1,0 +1,35 @@
+"""Dependency-availability flags.
+
+The reference keeps ~40 ``RequirementCache`` flags
+(/root/reference/src/torchmetrics/utilities/imports.py:22-63) as its de-facto
+feature-flag system.  We reproduce the pattern with a tiny, dependency-free
+probe so optional integrations (matplotlib plotting, HF transformers for
+BERTScore/CLIP, scipy for Hungarian assignment, ...) degrade gracefully.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_MATPLOTLIB_AVAILABLE: bool = _package_available("matplotlib")
+_SCIPY_AVAILABLE: bool = _package_available("scipy")
+_SKLEARN_AVAILABLE: bool = _package_available("sklearn")
+_TRANSFORMERS_AVAILABLE: bool = _package_available("transformers")
+_FLAX_AVAILABLE: bool = _package_available("flax")
+_ORBAX_AVAILABLE: bool = _package_available("orbax")
+_EINOPS_AVAILABLE: bool = _package_available("einops")
+_TORCH_AVAILABLE: bool = _package_available("torch")
+_PANDAS_AVAILABLE: bool = _package_available("pandas")
+_PYCOCOTOOLS_AVAILABLE: bool = _package_available("pycocotools")
+_REGEX_AVAILABLE: bool = _package_available("regex")
+_NLTK_AVAILABLE: bool = _package_available("nltk")

@@ -1,0 +1,156 @@
+"""Plotting helpers (matplotlib optional).
+
+Counterpart of the reference's ``utilities/plot.py``
+(/root/reference/src/torchmetrics/utilities/plot.py:64,220,296).
+"""
+
+from __future__ import annotations
+
+from itertools import product
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import _MATPLOTLIB_AVAILABLE
+
+if _MATPLOTLIB_AVAILABLE:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+else:  # pragma: no cover
+    plt = None
+
+
+def _error_on_missing_matplotlib() -> None:
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(
+            "Plot function expects `matplotlib` to be installed. Install with `pip install matplotlib`"
+        )
+
+
+def plot_single_or_multi_val(
+    val: Any,
+    ax: Optional[Any] = None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Plot a single scalar result, a per-class vector, a dict, or a sequence over time.
+
+    Reference: utilities/plot.py:64-217.
+    """
+    _error_on_missing_matplotlib()
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+
+    def _as_np(v):
+        return np.asarray(v)
+
+    if isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            arr = _as_np(v)
+            if arr.ndim == 0:
+                ax.plot([i], [float(arr)], "o", label=k)
+            else:
+                ax.plot(arr, label=k)
+        ax.legend()
+    elif isinstance(val, (list, tuple)) and len(val) > 0 and not np.isscalar(val[0]):
+        arrs = [_as_np(v) for v in val]
+        stacked = np.stack([a.reshape(-1) for a in arrs])
+        for c in range(stacked.shape[1]):
+            label = f"{legend_name or 'class'}_{c}" if stacked.shape[1] > 1 else (name or "value")
+            ax.plot(np.arange(len(arrs)), stacked[:, c], "-o", label=label)
+        ax.legend()
+        ax.set_xlabel("step")
+    else:
+        arr = _as_np(val)
+        if arr.ndim == 0:
+            ax.plot([0], [float(arr)], "o", label=name or "value")
+        else:
+            for c, v in enumerate(arr.reshape(-1)):
+                ax.plot([c], [float(v)], "o", label=f"{legend_name or 'class'}_{c}")
+        ax.legend()
+
+    if lower_bound is not None and upper_bound is not None:
+        ax.set_ylim(lower_bound, upper_bound)
+    if name is not None:
+        ax.set_title(name)
+    ax.grid(True, alpha=0.3)
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat: Any,
+    ax: Optional[Any] = None,
+    add_text: bool = True,
+    labels: Optional[List[str]] = None,
+    cmap: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Heatmap plot of a (C, C) or (N, C, C) confusion matrix.
+
+    Reference: utilities/plot.py:220-293.
+    """
+    _error_on_missing_matplotlib()
+    confmat = np.asarray(confmat)
+    if confmat.ndim == 3:  # multilabel
+        nb, n_classes = confmat.shape[0], 2
+        rows, cols = 1, nb
+    else:
+        nb, n_classes = 1, confmat.shape[0]
+        rows = cols = 1
+        confmat = confmat[None]
+
+    if labels is None:
+        labels = list(map(str, range(n_classes)))
+
+    fig, axs = (ax.get_figure(), [ax]) if ax is not None else plt.subplots(rows, cols, squeeze=False)
+    axs = np.asarray(axs).reshape(-1)
+    for i in range(nb):
+        a = axs[i] if i < len(axs) else axs[0]
+        a.imshow(confmat[i], cmap=cmap or "viridis")
+        a.set_xlabel("Predicted class")
+        a.set_ylabel("True class")
+        a.set_xticks(range(n_classes))
+        a.set_yticks(range(n_classes))
+        a.set_xticklabels(labels)
+        a.set_yticklabels(labels)
+        if add_text:
+            for ii, jj in product(range(n_classes), range(n_classes)):
+                a.text(jj, ii, str(round(float(confmat[i, ii, jj]), 2)), ha="center", va="center")
+    return fig, axs[0] if nb == 1 else axs
+
+
+def plot_curve(
+    curve: Tuple[Any, Any, Any],
+    score: Optional[Any] = None,
+    ax: Optional[Any] = None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Plot a (x, y, thresholds) curve family — ROC / PR curves.
+
+    Reference: utilities/plot.py:296-365.
+    """
+    _error_on_missing_matplotlib()
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    fig, ax = (ax.get_figure(), ax) if ax is not None else plt.subplots()
+    if x.ndim == 1:
+        label = name or "curve"
+        if score is not None:
+            label += f" (score={float(np.asarray(score)):.3f})"
+        ax.plot(x, y, linestyle="-", linewidth=2, label=label)
+    else:
+        for c in range(x.shape[0]):
+            label = f"{legend_name or 'class'}_{c}"
+            ax.plot(x[c], y[c], linestyle="-", linewidth=2, label=label)
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    if label_names is not None:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name is not None:
+        ax.set_title(name)
+    return fig, ax

@@ -1,0 +1,69 @@
+"""Rank-zero logging helpers.
+
+Equivalent of the reference's ``utilities/prints.py``
+(/root/reference/src/torchmetrics/utilities/prints.py:22-73), re-keyed on
+``jax.process_index()`` instead of the ``LOCAL_RANK`` env var: in a JAX
+multi-host program the process index is the rank.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax uninitialized
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 of a multi-host program."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    kwargs.setdefault("stacklevel", 5)
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, *args: Any, **kwargs: Any) -> None:
+    log.info(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, *args: Any, **kwargs: Any) -> None:
+    log.debug(message, *args, **kwargs)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    rank_zero_warn(
+        f"`torchmetrics_tpu.{name}` was deprecated and will be removed. "
+        f"Import `torchmetrics_tpu.{domain}.{name}` instead.",
+        DeprecationWarning,
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    rank_zero_warn(
+        f"`torchmetrics_tpu.functional.{name}` was deprecated and will be removed. "
+        f"Import `torchmetrics_tpu.functional.{domain}.{name}` instead.",
+        DeprecationWarning,
+    )
