@@ -1,0 +1,365 @@
+"""Classification tower tests vs sklearn (reference test strategy: SURVEY.md §4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn import metrics as skm
+
+from tests.helpers.testers import run_class_metric_test, run_functional_metric_test
+
+from torchmetrics_tpu.classification import (
+    AUROC,
+    Accuracy,
+    BinaryAccuracy,
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryCalibrationError,
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryFairness,
+    BinaryHingeLoss,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    BinaryPrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryRecall,
+    BinaryROC,
+    BinarySpecificity,
+    BinaryStatScores,
+    Dice,
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MulticlassCalibrationError,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassExactMatch,
+    MulticlassF1Score,
+    MulticlassHingeLoss,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelCoverageError,
+    MultilabelExactMatch,
+    MultilabelF1Score,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_calibration_error,
+    multiclass_exact_match,
+    multilabel_exact_match,
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+N_BATCHES, BATCH, C, L = 4, 32, 5, 4
+rng = np.random.default_rng(7)
+
+MC_TARGET = rng.integers(0, C, (N_BATCHES, BATCH))
+MC_LOGITS = rng.normal(size=(N_BATCHES, BATCH, C)).astype(np.float32)
+MC_PROBS = np.exp(MC_LOGITS) / np.exp(MC_LOGITS).sum(-1, keepdims=True)
+MC_PREDS = MC_PROBS.argmax(-1)
+
+BIN_TARGET = rng.integers(0, 2, (N_BATCHES, BATCH))
+BIN_PROBS = np.round(rng.random((N_BATCHES, BATCH)), 2).astype(np.float32)  # with ties
+BIN_PREDS = (BIN_PROBS > 0.5).astype(int)
+
+ML_TARGET = rng.integers(0, 2, (N_BATCHES, BATCH, L))
+ML_PROBS = rng.random((N_BATCHES, BATCH, L)).astype(np.float32)
+ML_PREDS = (ML_PROBS > 0.5).astype(int)
+
+
+def _flat(x):
+    return x.reshape((-1,) + x.shape[2:])
+
+
+# ------------------------------------------------------------------ binary
+@pytest.mark.parametrize("factory,ref", [
+    (lambda: BinaryAccuracy(), lambda p, t: skm.accuracy_score(t, p > 0.5)),
+    (lambda: BinaryPrecision(), lambda p, t: skm.precision_score(t, p > 0.5)),
+    (lambda: BinaryRecall(), lambda p, t: skm.recall_score(t, p > 0.5)),
+    (lambda: BinaryF1Score(), lambda p, t: skm.f1_score(t, p > 0.5)),
+    (lambda: BinarySpecificity(), lambda p, t: skm.recall_score(1 - t, ~(p > 0.5))),
+    (lambda: BinaryCohenKappa(), lambda p, t: skm.cohen_kappa_score(t, p > 0.5)),
+    (lambda: BinaryMatthewsCorrCoef(), lambda p, t: skm.matthews_corrcoef(t, p > 0.5)),
+    (lambda: BinaryJaccardIndex(), lambda p, t: skm.jaccard_score(t, p > 0.5)),
+    (lambda: BinaryConfusionMatrix(), lambda p, t: skm.confusion_matrix(t, p > 0.5)),
+    (lambda: BinaryAUROC(), lambda p, t: skm.roc_auc_score(t, p)),
+    (lambda: BinaryAveragePrecision(), lambda p, t: skm.average_precision_score(t, p)),
+])
+def test_binary_metrics_vs_sklearn(factory, ref):
+    run_class_metric_test(factory, BIN_PROBS, BIN_TARGET, ref)
+
+
+def test_binary_stat_scores():
+    def ref(p, t):
+        pl = (p > 0.5).astype(int)
+        tp = ((pl == 1) & (t == 1)).sum()
+        fp = ((pl == 1) & (t == 0)).sum()
+        tn = ((pl == 0) & (t == 0)).sum()
+        fn = ((pl == 0) & (t == 1)).sum()
+        return np.array([tp, fp, tn, fn, tp + fn])
+
+    run_class_metric_test(lambda: BinaryStatScores(), BIN_PROBS, BIN_TARGET, ref)
+
+
+def test_binary_roc_binned_sane():
+    m = BinaryROC(thresholds=20)
+    for i in range(N_BATCHES):
+        m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+    fpr, tpr, thr = m.compute()
+    assert fpr.shape == (20,) and tpr.shape == (20,)
+    assert bool(jnp.all(jnp.diff(fpr) >= -1e-7)) and bool(jnp.all(jnp.diff(tpr) >= -1e-7))
+
+
+def test_binary_prc_binned_close_to_exact():
+    exact, binned = BinaryAveragePrecision(), BinaryAveragePrecision(thresholds=500)
+    for i in range(N_BATCHES):
+        exact.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+        binned.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+    np.testing.assert_allclose(float(exact.compute()), float(binned.compute()), atol=5e-3)
+
+
+# ------------------------------------------------------------------ multiclass
+@pytest.mark.parametrize("average,sk_average", [("micro", "micro"), ("macro", "macro"), ("weighted", "weighted"), ("none", None)])
+def test_multiclass_f1_averages(average, sk_average):
+    run_class_metric_test(
+        lambda: MulticlassF1Score(num_classes=C, average=average),
+        MC_PROBS, MC_TARGET,
+        lambda p, t: skm.f1_score(t, p.argmax(-1), average=sk_average, labels=range(C)),
+    )
+
+
+@pytest.mark.parametrize("factory,ref", [
+    (lambda: MulticlassAccuracy(num_classes=C, average="micro"), lambda p, t: skm.accuracy_score(t, p.argmax(-1))),
+    (lambda: MulticlassPrecision(num_classes=C, average="macro"), lambda p, t: skm.precision_score(t, p.argmax(-1), average="macro")),
+    (lambda: MulticlassRecall(num_classes=C, average="weighted"), lambda p, t: skm.recall_score(t, p.argmax(-1), average="weighted")),
+    (lambda: MulticlassCohenKappa(num_classes=C), lambda p, t: skm.cohen_kappa_score(t, p.argmax(-1))),
+    (lambda: MulticlassMatthewsCorrCoef(num_classes=C), lambda p, t: skm.matthews_corrcoef(t, p.argmax(-1))),
+    (lambda: MulticlassJaccardIndex(num_classes=C), lambda p, t: skm.jaccard_score(t, p.argmax(-1), average="macro")),
+    (lambda: MulticlassConfusionMatrix(num_classes=C), lambda p, t: skm.confusion_matrix(t, p.argmax(-1))),
+    (lambda: MulticlassAUROC(num_classes=C), lambda p, t: skm.roc_auc_score(t, p, multi_class="ovr", average="macro")),
+    (lambda: MulticlassAveragePrecision(num_classes=C), lambda p, t: np.mean([
+        skm.average_precision_score((t == c).astype(int), p[:, c]) for c in range(C)
+    ])),
+])
+def test_multiclass_metrics_vs_sklearn(factory, ref):
+    run_class_metric_test(factory, MC_PROBS, MC_TARGET, ref)
+
+
+def test_multiclass_accuracy_topk():
+    run_class_metric_test(
+        lambda: MulticlassAccuracy(num_classes=C, average="micro", top_k=2),
+        MC_PROBS, MC_TARGET,
+        lambda p, t: skm.top_k_accuracy_score(t, p, k=2, labels=range(C)),
+    )
+
+
+def test_multiclass_ignore_index():
+    t2 = MC_TARGET.copy()
+    t2[:, :5] = -1
+    m = MulticlassAccuracy(num_classes=C, average="micro", ignore_index=-1)
+    for i in range(N_BATCHES):
+        m.update(jnp.asarray(MC_PROBS[i]), jnp.asarray(t2[i]))
+    expected = skm.accuracy_score(_flat(MC_TARGET[:, 5:]), _flat(MC_PREDS[:, 5:]))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_multiclass_exact_match():
+    t = rng.integers(0, C, (8, 16))
+    p = rng.integers(0, C, (8, 16))
+    res = multiclass_exact_match(jnp.asarray(p), jnp.asarray(t), C)
+    expected = np.mean([(p[i] == t[i]).all() for i in range(8)])
+    np.testing.assert_allclose(float(res), expected)
+
+
+def test_multiclass_samplewise():
+    m = MulticlassAccuracy(num_classes=C, average="micro", multidim_average="samplewise")
+    t = rng.integers(0, C, (2, 8, 6))
+    p = rng.integers(0, C, (2, 8, 6))
+    for i in range(2):
+        m.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    res = np.asarray(m.compute())
+    expected = np.concatenate([(p[i] == t[i]).mean(-1) for i in range(2)])
+    np.testing.assert_allclose(res, expected, atol=1e-6)
+
+
+# ------------------------------------------------------------------ multilabel
+@pytest.mark.parametrize("factory,ref", [
+    (lambda: MultilabelAccuracy(num_labels=L, average="macro"), lambda p, t: np.mean([
+        skm.accuracy_score(t[:, i], p[:, i] > 0.5) for i in range(L)
+    ])),
+    (lambda: MultilabelF1Score(num_labels=L, average="macro"), lambda p, t: skm.f1_score(t, p > 0.5, average="macro")),
+])
+def test_multilabel_metrics_vs_sklearn(factory, ref):
+    run_class_metric_test(factory, ML_PROBS, ML_TARGET, ref)
+
+
+def test_multilabel_exact_match():
+    res = multilabel_exact_match(jnp.asarray(_flat(ML_PROBS)), jnp.asarray(_flat(ML_TARGET)), L)
+    expected = np.mean([(row_p == row_t).all() for row_p, row_t in zip(_flat(ML_PREDS), _flat(ML_TARGET))])
+    np.testing.assert_allclose(float(res), expected)
+
+
+# ------------------------------------------------------------------ ranking
+def test_ranking_vs_sklearn():
+    p, t = _flat(ML_PROBS), _flat(ML_TARGET)
+    np.testing.assert_allclose(
+        float(multilabel_coverage_error(jnp.asarray(p), jnp.asarray(t), L)),
+        skm.coverage_error(t, p), atol=1e-5)
+    np.testing.assert_allclose(
+        float(multilabel_ranking_average_precision(jnp.asarray(p), jnp.asarray(t), L)),
+        skm.label_ranking_average_precision_score(t, p), atol=1e-5)
+    np.testing.assert_allclose(
+        float(multilabel_ranking_loss(jnp.asarray(p), jnp.asarray(t), L)),
+        skm.label_ranking_loss(t, p), atol=1e-5)
+
+
+def test_ranking_classes():
+    for cls, fn in [
+        (MultilabelCoverageError, skm.coverage_error),
+        (MultilabelRankingAveragePrecision, skm.label_ranking_average_precision_score),
+        (MultilabelRankingLoss, skm.label_ranking_loss),
+    ]:
+        m = cls(num_labels=L)
+        for i in range(N_BATCHES):
+            m.update(jnp.asarray(ML_PROBS[i]), jnp.asarray(ML_TARGET[i]))
+        # mean of per-batch values (batch-weighted), matches reference accumulation
+        expected = np.mean([fn(ML_TARGET[i], ML_PROBS[i]) for i in range(N_BATCHES)])
+        np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+# ------------------------------------------------------------------ calibration / hinge
+def test_binary_calibration_error():
+    p, t = _flat(BIN_PROBS), _flat(BIN_TARGET)
+    res = binary_calibration_error(jnp.asarray(p), jnp.asarray(t), n_bins=10, norm="l1")
+    # manual ECE on predicted-class confidence
+    conf = np.where(p > 0.5, p, 1 - p)
+    acc = np.where(p > 0.5, t, 1 - t)
+    bins = np.clip((conf * 10).astype(int), 0, 9)
+    ece = 0.0
+    for b in range(10):
+        mask = bins == b
+        if mask.sum():
+            ece += np.abs(acc[mask].mean() - conf[mask].mean()) * mask.mean()
+    np.testing.assert_allclose(float(res), ece, atol=1e-6)
+
+
+def test_calibration_error_class_accumulation():
+    m = BinaryCalibrationError(n_bins=10)
+    for i in range(N_BATCHES):
+        m.update(jnp.asarray(BIN_PROBS[i]), jnp.asarray(BIN_TARGET[i]))
+    f = binary_calibration_error(jnp.asarray(_flat(BIN_PROBS)), jnp.asarray(_flat(BIN_TARGET)), n_bins=10)
+    np.testing.assert_allclose(float(m.compute()), float(f), atol=1e-6)
+
+
+def test_hinge_loss():
+    m = MulticlassHingeLoss(num_classes=C)
+    for i in range(N_BATCHES):
+        m.update(jnp.asarray(MC_PROBS[i]), jnp.asarray(MC_TARGET[i]))
+    p, t = _flat(MC_PROBS), _flat(MC_TARGET)
+    ts = p[np.arange(len(t)), t]
+    other = p.copy()
+    other[np.arange(len(t)), t] = -np.inf
+    margin = ts - other.max(-1)
+    expected = np.maximum(1 - margin, 0).mean()
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+# ------------------------------------------------------------------ task dispatch + misc
+def test_task_dispatch_returns_subclass():
+    m = Accuracy(task="multiclass", num_classes=C)
+    assert type(m).__name__ == "MulticlassAccuracy"
+    m = Accuracy(task="binary")
+    assert type(m).__name__ == "BinaryAccuracy"
+    m = AUROC(task="binary")
+    assert type(m).__name__ == "BinaryAUROC"
+    with pytest.raises(ValueError, match="not supported"):
+        Accuracy(task="bogus")
+
+
+def test_dice():
+    m = Dice(num_classes=C, average="micro")
+    for i in range(N_BATCHES):
+        m.update(jnp.asarray(MC_PREDS[i]), jnp.asarray(MC_TARGET[i]))
+    expected = skm.f1_score(_flat(MC_TARGET), _flat(MC_PREDS), average="micro")
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_binary_fairness():
+    m = BinaryFairness(num_groups=2)
+    preds = jnp.asarray(_flat(BIN_PROBS))
+    target = jnp.asarray(_flat(BIN_TARGET))
+    groups = jnp.asarray(rng.integers(0, 2, preds.shape[0]))
+    m.update(preds, target, groups)
+    out = m.compute()
+    assert any(k.startswith("DP") for k in out) and any(k.startswith("EO") for k in out)
+    for v in out.values():
+        assert 0 <= float(v) <= 1.0 + 1e-6
+
+
+# ------------------------------------------------------------------ review regressions
+def test_multiclass_prc_multidim_layout():
+    """(N, C, S) inputs must pair spatial positions with their class scores."""
+    from torchmetrics_tpu.functional.classification import multiclass_average_precision
+
+    p = rng.random((6, 3, 4)).astype(np.float32)
+    p = p / p.sum(1, keepdims=True)
+    t = rng.integers(0, 3, (6, 4))
+    res = multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), 3, average="macro")
+    p_flat = np.moveaxis(p, 1, -1).reshape(-1, 3)
+    t_flat = t.reshape(-1)
+    expected = np.mean([skm.average_precision_score((t_flat == c).astype(int), p_flat[:, c]) for c in range(3)])
+    np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+
+def test_macro_topk_weighting():
+    """With top_k > 1, classes absent from target (tp+fn==0) are excluded from macro."""
+    from torchmetrics_tpu.functional.classification import multiclass_accuracy
+
+    # class 2 never in target but often in top-2 preds
+    t = np.array([0, 1, 0, 1])
+    p = np.array([[0.5, 0.2, 0.3], [0.2, 0.5, 0.3], [0.5, 0.2, 0.3], [0.2, 0.5, 0.3]], dtype=np.float32)
+    res = multiclass_accuracy(jnp.asarray(p), jnp.asarray(t), 3, average="macro", top_k=2)
+    np.testing.assert_allclose(float(res), 1.0)  # classes 0,1 perfect; class 2 excluded
+
+
+def test_jaccard_ignore_index_excluded_from_macro():
+    t = np.array([0, 0, 1, 1, 2, 2])
+    p = np.array([0, 0, 1, 1, 0, 1])  # class-2 preds hit 0/1
+    res = MulticlassJaccardIndex(num_classes=3, average="macro", ignore_index=2)
+    res.update(jnp.asarray(p), jnp.asarray(t))
+    # class 2 rows dropped; remaining: t=[0,0,1,1] p=[0,0,1,1] -> classes 0,1 perfect
+    np.testing.assert_allclose(float(res.compute()), 1.0)
+
+
+def test_coverage_error_ignore_index():
+    t = np.array([[1, 0, -1], [0, 1, -1]])
+    p = np.array([[0.9, 0.1, 0.95], [0.2, 0.8, 0.99]], dtype=np.float32)
+    res = multilabel_coverage_error(jnp.asarray(p), jnp.asarray(t), 3, ignore_index=-1)
+    # ignored label must not count toward coverage: both samples cover at rank 1
+    np.testing.assert_allclose(float(res), 1.0)
+
+
+def test_confmat_validate_args():
+    from torchmetrics_tpu.functional.classification import multiclass_confusion_matrix
+
+    with pytest.raises(ValueError, match="normalize"):
+        multiclass_confusion_matrix(jnp.asarray([0]), jnp.asarray([0]), 2, normalize="bogus")
+    with pytest.raises(ValueError, match="num_classes"):
+        multiclass_confusion_matrix(jnp.asarray([0]), jnp.asarray([0]), 0)
+
+
+def test_exact_match_class():
+    m = MulticlassExactMatch(num_classes=C)
+    t = rng.integers(0, C, (2, 8, 6))
+    p = rng.integers(0, C, (2, 8, 6))
+    for i in range(2):
+        m.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    expected = np.mean([(p[i, j] == t[i, j]).all() for i in range(2) for j in range(8)])
+    np.testing.assert_allclose(float(m.compute()), expected)

@@ -1,0 +1,76 @@
+"""Class factory for the stat-scores-derived metric tower.
+
+The reference hand-writes ~27 near-identical classes
+(classification/{precision_recall,specificity,hamming,...}.py); here each
+(kind, task) class is generated once with proper names so
+pickling/introspection behave like hand-written classes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Type
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+
+
+def make_stat_metric_classes(
+    kind: str,
+    binary_name: str,
+    multiclass_name: str,
+    multilabel_name: str,
+    wrapper_name: str,
+    module: str,
+    higher_is_better: bool = True,
+) -> Tuple[type, type, type, type]:
+    """Build (Binary*, Multiclass*, Multilabel*, task-wrapper) classes for a stat kind."""
+
+    def _binary_compute(self, state: State):
+        return self._reduce_kind(state, "binary")
+
+    def _avg_compute(self, state: State):
+        return self._reduce_kind(state, self.average)
+
+    common = {
+        "_stat_kind": kind,
+        "is_differentiable": False,
+        "higher_is_better": higher_is_better,
+        "full_state_update": False,
+        "plot_lower_bound": 0.0,
+        "plot_upper_bound": 1.0,
+        "__module__": module,
+    }
+    binary_cls = type(binary_name, (BinaryStatScores,), {**common, "_compute": _binary_compute})
+    multiclass_cls = type(
+        multiclass_name, (MulticlassStatScores,), {**common, "plot_legend_name": "Class", "_compute": _avg_compute}
+    )
+    multilabel_cls = type(
+        multilabel_name, (MultilabelStatScores,), {**common, "plot_legend_name": "Label", "_compute": _avg_compute}
+    )
+
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average", "top_k")}
+            return binary_cls(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return multiclass_cls(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("top_k", None)
+            return multilabel_cls(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")
+
+    wrapper_cls = type(
+        wrapper_name,
+        (_ClassificationTaskWrapper,),
+        {"__module__": module, "_create_task_metric": classmethod(_create_task_metric)},
+    )
+    return binary_cls, multiclass_cls, multilabel_cls, wrapper_cls

@@ -1,0 +1,71 @@
+"""Accuracy metric classes (reference: classification/accuracy.py:31,151,306,461)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+
+
+class BinaryAccuracy(BinaryStatScores):
+    _stat_kind = "accuracy"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, "binary")
+
+
+class MulticlassAccuracy(MulticlassStatScores):
+    _stat_kind = "accuracy"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, self.average)
+
+
+class MultilabelAccuracy(MultilabelStatScores):
+    _stat_kind = "accuracy"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, self.average)
+
+
+class Accuracy(_ClassificationTaskWrapper):
+    """Task dispatch: Accuracy(task="binary"|"multiclass"|"multilabel", ...)."""
+
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average", "top_k")}
+            return BinaryAccuracy(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassAccuracy(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("top_k", None)
+            return MultilabelAccuracy(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,147 @@
+"""AUROC metric classes (reference: classification/auroc.py:43,169,326)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.auroc import _binary_auroc_compute
+from torchmetrics_tpu.functional.classification.roc import _binary_roc_compute_binned
+from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide
+
+
+class BinaryAUROC(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, max_fpr: Optional[float] = None, thresholds=None, ignore_index=None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.max_fpr = max_fpr
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            return _binary_auroc_compute(p, t, w, None, self.max_fpr)
+        fpr, tpr, _ = _binary_roc_compute_binned(state["confmat"], self.thresholds)
+        if self.max_fpr is None:
+            return _auc_compute(fpr, tpr, direction=1.0)
+        # binned partial AUC path shares the exact-path implementation
+        raise NotImplementedError("max_fpr with binned thresholds: use thresholds=None")
+
+
+class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", thresholds=None,
+                 ignore_index=None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, average=None,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.average_auroc = average
+
+    def _auc_per_class(self, state: State) -> Array:
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
+            aucs = jnp.stack([
+                _binary_auroc_compute(p[:, c], onehot[:, c], w, None) for c in range(self.num_classes)
+            ])
+            support = jnp.stack([(onehot[:, c] * w).sum() for c in range(self.num_classes)])
+        else:
+            confmat = state["confmat"]
+            aucs, support = [], []
+            for c in range(self.num_classes):
+                fpr, tpr, _ = _binary_roc_compute_binned(confmat[:, c], self.thresholds)
+                aucs.append(_auc_compute(fpr, tpr, direction=1.0))
+                support.append(confmat[0, c, 1, :].sum())
+            aucs, support = jnp.stack(aucs), jnp.stack(support)
+        return aucs, support
+
+    def _compute(self, state: State):
+        aucs, support = self._auc_per_class(state)
+        if self.average_auroc in (None, "none"):
+            return aucs
+        if self.average_auroc == "macro":
+            return jnp.mean(aucs)
+        if self.average_auroc == "weighted":
+            return jnp.sum(aucs * _safe_divide(support, support.sum()))
+        raise ValueError(f"Unknown average {self.average_auroc}")
+
+
+class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, average: Optional[str] = "macro", thresholds=None,
+                 ignore_index=None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, thresholds=thresholds,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.average_auroc = average
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            if self.average_auroc == "micro":
+                return _binary_auroc_compute(p.reshape(-1), t.reshape(-1), w.reshape(-1), None)
+            aucs = jnp.stack([
+                _binary_auroc_compute(p[:, c], t[:, c], w[:, c], None) for c in range(self.num_labels)
+            ])
+            support = (t * w).sum(0).astype(jnp.float32)
+        else:
+            confmat = state["confmat"]
+            aucs, support = [], []
+            for c in range(self.num_labels):
+                fpr, tpr, _ = _binary_roc_compute_binned(confmat[:, c], self.thresholds)
+                aucs.append(_auc_compute(fpr, tpr, direction=1.0))
+                support.append(confmat[0, c, 1, :].sum())
+            aucs, support = jnp.stack(aucs), jnp.stack(support)
+            if self.average_auroc == "micro":
+                fpr, tpr, _ = _binary_roc_compute_binned(confmat.sum(1), self.thresholds)
+                return _auc_compute(fpr, tpr, direction=1.0)
+        if self.average_auroc in (None, "none"):
+            return aucs
+        if self.average_auroc == "macro":
+            return jnp.mean(aucs)
+        if self.average_auroc == "weighted":
+            return jnp.sum(aucs * _safe_divide(support, support.sum()))
+        raise ValueError(f"Unknown average {self.average_auroc}")
+
+
+class AUROC(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average")}
+            return BinaryAUROC(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("max_fpr", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassAUROC(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("max_fpr", None)
+            kwargs.pop("num_classes", None)
+            return MultilabelAUROC(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,130 @@
+"""Average precision metric classes (reference: classification/average_precision.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.average_precision import _ap_from_curve, _binary_ap_compute
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_compute_binned,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            return _binary_ap_compute(*self._exact_state(state), None)
+        precision, recall, _ = _binary_precision_recall_curve_compute_binned(state["confmat"], self.thresholds)
+        return _ap_from_curve(precision, recall)
+
+
+class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", thresholds=None,
+                 ignore_index=None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, thresholds=thresholds, average=None,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.average_ap = average
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
+            aps = jnp.stack([_binary_ap_compute(p[:, c], onehot[:, c], w, None) for c in range(self.num_classes)])
+            support = jnp.stack([(onehot[:, c] * w).sum() for c in range(self.num_classes)])
+        else:
+            confmat = state["confmat"]
+            aps, support = [], []
+            for c in range(self.num_classes):
+                precision, recall, _ = _binary_precision_recall_curve_compute_binned(confmat[:, c], self.thresholds)
+                aps.append(_ap_from_curve(precision, recall))
+                support.append(confmat[0, c, 1, :].sum())
+            aps, support = jnp.stack(aps), jnp.stack(support)
+        if self.average_ap in (None, "none"):
+            return aps
+        if self.average_ap == "macro":
+            return jnp.mean(aps)
+        if self.average_ap == "weighted":
+            return jnp.sum(aps * _safe_divide(support, support.sum()))
+        raise ValueError(f"Unknown average {self.average_ap}")
+
+
+class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, average: Optional[str] = "macro", thresholds=None,
+                 ignore_index=None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, thresholds=thresholds,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.average_ap = average
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            if self.average_ap == "micro":
+                return _binary_ap_compute(p.reshape(-1), t.reshape(-1), w.reshape(-1), None)
+            aps = jnp.stack([_binary_ap_compute(p[:, c], t[:, c], w[:, c], None) for c in range(self.num_labels)])
+            support = (t * w).sum(0).astype(jnp.float32)
+        else:
+            confmat = state["confmat"]
+            if self.average_ap == "micro":
+                precision, recall, _ = _binary_precision_recall_curve_compute_binned(confmat.sum(1), self.thresholds)
+                return _ap_from_curve(precision, recall)
+            aps, support = [], []
+            for c in range(self.num_labels):
+                precision, recall, _ = _binary_precision_recall_curve_compute_binned(confmat[:, c], self.thresholds)
+                aps.append(_ap_from_curve(precision, recall))
+                support.append(confmat[0, c, 1, :].sum())
+            aps, support = jnp.stack(aps), jnp.stack(support)
+        if self.average_ap in (None, "none"):
+            return aps
+        if self.average_ap == "macro":
+            return jnp.mean(aps)
+        if self.average_ap == "weighted":
+            return jnp.sum(aps * _safe_divide(support, support.sum()))
+        raise ValueError(f"Unknown average {self.average_ap}")
+
+
+class AveragePrecision(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average")}
+            return BinaryAveragePrecision(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("num_labels", None)
+            return MulticlassAveragePrecision(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelAveragePrecision(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

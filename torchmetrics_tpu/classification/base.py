@@ -1,0 +1,30 @@
+"""Task-string dispatch base (reference: classification/base.py:19).
+
+``Accuracy(task="multiclass", num_classes=5)`` returns a
+``MulticlassAccuracy`` instance via ``__new__`` — the same ergonomic the
+reference's ``_ClassificationTaskWrapper`` provides.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.core.metric import Metric
+
+
+class _ClassificationTaskWrapper(Metric):
+    """Base for wrapper classes that dispatch to task-specific metrics in ``__new__``."""
+
+    def __new__(cls, task: Any = None, *args: Any, **kwargs: Any) -> "Metric":
+        task = kwargs.pop("task", task)
+        return cls._create_task_metric(task, *args, **kwargs)
+
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        raise NotImplementedError
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        raise NotImplementedError(f"{self.__class__.__name__} metric does not exist for the chosen task")
+
+    def compute(self) -> None:
+        raise NotImplementedError(f"{self.__class__.__name__} metric does not exist for the chosen task")

@@ -1,0 +1,91 @@
+"""Calibration error metric classes (reference: classification/calibration_error.py:41,189).
+
+State = binned sufficient statistics (conf_sum/acc_sum/count per bin),
+``sum``-reduced — fixed shape, jittable, psum-able (see the functional module
+docstring for why this is equivalent to the reference's raw lists).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.calibration_error import (
+    _bin_update,
+    _binary_ce_confidences,
+    _ce_compute_from_bins,
+    _multiclass_ce_confidences,
+)
+
+
+class _CalibrationErrorBase(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _init_bins(self, n_bins: int, norm: str) -> None:
+        if norm not in ("l1", "l2", "max"):
+            raise ValueError(f"Argument `norm` is expected to be one of ('l1', 'l2', 'max') but got {norm}")
+        if not (isinstance(n_bins, int) and n_bins > 0):
+            raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+        self.n_bins = n_bins
+        self.norm = norm
+        self.add_state("conf_sum", jnp.zeros(n_bins), dist_reduce_fx="sum")
+        self.add_state("acc_sum", jnp.zeros(n_bins), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(n_bins), dist_reduce_fx="sum")
+
+    def _accumulate(self, state: State, conf: Array, acc: Array, w: Array) -> State:
+        cs, as_, ct = _bin_update(conf, acc, w, self.n_bins)
+        return {
+            "conf_sum": state["conf_sum"] + cs,
+            "acc_sum": state["acc_sum"] + as_,
+            "count": state["count"] + ct,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _ce_compute_from_bins(state["conf_sum"], state["acc_sum"], state["count"], self.norm)
+
+
+class BinaryCalibrationError(_CalibrationErrorBase):
+    def __init__(self, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_bins(n_bins, norm)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        conf, acc, w = _binary_ce_confidences(preds, target, self.ignore_index)
+        return self._accumulate(state, conf, acc, w)
+
+
+class MulticlassCalibrationError(_CalibrationErrorBase):
+    def __init__(self, num_classes: int, n_bins: int = 15, norm: str = "l1",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_bins(n_bins, norm)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        conf, acc, w = _multiclass_ce_confidences(preds, target, self.num_classes, self.ignore_index)
+        return self._accumulate(state, conf, acc, w)
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs.pop("num_classes", None)
+            return BinaryCalibrationError(*args, **kwargs)
+        if task == "multiclass":
+            return MulticlassCalibrationError(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported! (multilabel not supported for CalibrationError)")

@@ -1,0 +1,60 @@
+"""Cohen kappa metric classes (reference: classification/cohen_kappa.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_reduce
+
+
+class BinaryCohenKappa(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, weights: Optional[str] = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        self.weights = weights
+
+    def _compute(self, state: State):
+        return _cohen_kappa_reduce(state["confmat"], self.weights)
+
+
+class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, weights: Optional[str] = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        self.weights = weights
+
+    def _compute(self, state: State):
+        return _cohen_kappa_reduce(state["confmat"], self.weights)
+
+
+class CohenKappa(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs.pop("num_classes", None)
+            return BinaryCohenKappa(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            return MulticlassCohenKappa(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported! (multilabel not supported for CohenKappa)")

@@ -1,0 +1,97 @@
+"""Confusion matrix metric classes (reference: classification/confusion_matrix.py:51,191,335)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_update,
+    _normalize_confmat,
+)
+
+
+class _ConfusionMatrixBase(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def _compute(self, state: State) -> Array:
+        out = _normalize_confmat(state["confmat"], self.normalize)
+        return out if self.normalize not in (None, "none") else out.astype(jnp.int32)
+
+    def plot(self, val: Optional[Array] = None, ax: Optional[Any] = None,
+             add_text: bool = True, labels: Optional[list] = None):
+        from torchmetrics_tpu.utilities.plot import plot_confusion_matrix
+
+        val = val if val is not None else self.compute()
+        return plot_confusion_matrix(val, ax=ax, add_text=add_text, labels=labels)
+
+
+class BinaryConfusionMatrix(_ConfusionMatrixBase):
+    def __init__(self, threshold: float = 0.5, normalize: Optional[str] = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.threshold = threshold
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((2, 2)), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        cm = _binary_confusion_matrix_update(preds, target, self.threshold, self.ignore_index)
+        return {"confmat": state["confmat"] + cm}
+
+
+class MulticlassConfusionMatrix(_ConfusionMatrixBase):
+    def __init__(self, num_classes: int, normalize: Optional[str] = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        cm = _multiclass_confusion_matrix_update(preds, target, self.num_classes, self.ignore_index)
+        return {"confmat": state["confmat"] + cm}
+
+
+class MultilabelConfusionMatrix(_ConfusionMatrixBase):
+    def __init__(self, num_labels: int, threshold: float = 0.5, normalize: Optional[str] = None,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.normalize = normalize
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("confmat", jnp.zeros((num_labels, 2, 2)), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        cm = _multilabel_confusion_matrix_update(preds, target, self.num_labels, self.threshold, self.ignore_index)
+        return {"confmat": state["confmat"] + cm}
+
+
+class ConfusionMatrix(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels")}
+            return BinaryConfusionMatrix(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassConfusionMatrix(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelConfusionMatrix(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,35 @@
+"""Dice metric class (reference: classification/dice.py:31)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.stat_scores import MulticlassStatScores
+from torchmetrics_tpu.core.metric import State
+from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+class Dice(MulticlassStatScores):
+    """Dice score: 2*tp / (2*tp + fp + fn) over multiclass stat scores."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, average: Optional[str] = "micro",
+                 ignore_index: Optional[int] = None, top_k: int = 1, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, top_k=top_k, average=average,
+                         ignore_index=ignore_index, **kwargs)
+
+    def _compute(self, state: State) -> Array:
+        tp, fp, tn, fn = self._final_state(state)
+        if self.average == "micro":
+            tp, fp, fn = tp.sum(), fp.sum(), fn.sum()
+            return _safe_divide(2 * tp, 2 * tp + fp + fn)
+        score = _safe_divide(2 * tp, 2 * tp + fp + fn)
+        return _adjust_weights_safe_divide(score, self.average, False, tp, fp, fn)

@@ -1,0 +1,90 @@
+"""Exact match metric classes (reference: classification/exact_match.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.exact_match import (
+    multiclass_exact_match,
+    multilabel_exact_match,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _ExactMatchBase(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _init_em_state(self, multidim_average: str) -> None:
+        self.multidim_average = multidim_average
+        if multidim_average == "samplewise":
+            self.add_state("correct", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("correct", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _accumulate(self, state: State, samplewise: Array) -> State:
+        if self.multidim_average == "samplewise":
+            return {"correct": tuple(state["correct"]) + (samplewise,)}
+        return {"correct": state["correct"] + jnp.sum(samplewise), "total": state["total"] + samplewise.shape[0]}
+
+    def _compute(self, state: State) -> Array:
+        if self.multidim_average == "samplewise":
+            return dim_zero_cat(state["correct"])
+        return _safe_divide(state["correct"], state["total"])
+
+
+class MulticlassExactMatch(_ExactMatchBase):
+    def __init__(self, num_classes: int, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_em_state(multidim_average)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        samplewise = multiclass_exact_match(
+            preds, target, self.num_classes, "samplewise", self.ignore_index, self.validate_args
+        )
+        return self._accumulate(state, samplewise)
+
+
+class MultilabelExactMatch(_ExactMatchBase):
+    def __init__(self, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_em_state(multidim_average)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        samplewise = multilabel_exact_match(
+            preds, target, self.num_labels, self.threshold, "samplewise", self.ignore_index, self.validate_args
+        )
+        return self._accumulate(state, samplewise)
+
+
+class ExactMatch(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassExactMatch(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelExactMatch(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported! (binary not supported for ExactMatch)")

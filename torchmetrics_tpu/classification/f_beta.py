@@ -1,0 +1,136 @@
+"""F-beta / F1 metric classes (reference: classification/f_beta.py:43-915)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.f_beta import _validate_beta
+
+
+class BinaryFBetaScore(BinaryStatScores):
+    _stat_kind = "fbeta"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, beta: float, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, multidim_average=multidim_average,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        if validate_args:
+            _validate_beta(beta)
+        self._beta = beta
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, "binary")
+
+
+class MulticlassFBetaScore(MulticlassStatScores):
+    _stat_kind = "fbeta"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, beta: float, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, top_k=top_k, average=average,
+                         multidim_average=multidim_average, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        if validate_args:
+            _validate_beta(beta)
+        self._beta = beta
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, self.average)
+
+
+class MultilabelFBetaScore(MultilabelStatScores):
+    _stat_kind = "fbeta"
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, beta: float, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, threshold=threshold, average=average,
+                         multidim_average=multidim_average, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        if validate_args:
+            _validate_beta(beta)
+        self._beta = beta
+
+    def _compute(self, state: State):
+        return self._reduce_kind(state, self.average)
+
+
+class BinaryF1Score(BinaryFBetaScore):
+    def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, threshold, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class MulticlassF1Score(MulticlassFBetaScore):
+    def __init__(self, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, num_classes, top_k, average, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class MultilabelF1Score(MultilabelFBetaScore):
+    def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 multidim_average: str = "global", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args, **kwargs)
+
+
+class FBetaScore(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average", "top_k")}
+            return BinaryFBetaScore(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassFBetaScore(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("top_k", None)
+            return MultilabelFBetaScore(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")
+
+
+class F1Score(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average", "top_k")}
+            return BinaryF1Score(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassF1Score(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("top_k", None)
+            return MultilabelF1Score(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,79 @@
+"""Group fairness metric classes (reference: classification/group_fairness.py:59,157)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.group_fairness import _groups_stat_scores
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+class BinaryGroupStatRates(Metric):
+    """Per-group tp/fp/tn/fn rates (reference: classification/group_fairness.py:59)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, num_groups: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_groups, int) and num_groups > 1):
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        for name in ("tp", "fp", "tn", "fn"):
+            self.add_state(name, jnp.zeros(num_groups), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array, groups: Array) -> State:
+        tp, fp, tn, fn = _groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index
+        )
+        return {
+            "tp": state["tp"] + tp, "fp": state["fp"] + fp,
+            "tn": state["tn"] + tn, "fn": state["fn"] + fn,
+        }
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        total = state["tp"] + state["fp"] + state["tn"] + state["fn"]
+        return {
+            f"group_{g}": jnp.stack([state["tp"][g], state["fp"][g], state["tn"][g], state["fn"][g]])
+            / jnp.maximum(total[g], 1.0)
+            for g in range(self.num_groups)
+        }
+
+
+class BinaryFairness(BinaryGroupStatRates):
+    """Demographic parity / equal opportunity (reference: classification/group_fairness.py:157)."""
+
+    def __init__(self, num_groups: int, task: str = "all", threshold: float = 0.5,
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        if task not in ("demographic_parity", "equal_opportunity", "all"):
+            raise ValueError(
+                f"Expected argument `task` to either be 'demographic_parity', 'equal_opportunity' or 'all' but got {task}."
+            )
+        super().__init__(num_groups, threshold, ignore_index, validate_args, **kwargs)
+        self.task = task
+
+    def _update(self, state: State, preds: Array, target: Array, groups: Array) -> State:
+        if self.task == "demographic_parity":
+            target = jnp.zeros_like(jnp.asarray(target))
+        return super()._update(state, preds, target, groups)
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        results: Dict[str, Array] = {}
+        if self.task in ("demographic_parity", "all"):
+            pos_rate = _safe_divide(state["tp"] + state["fp"], state["tp"] + state["fp"] + state["tn"] + state["fn"])
+            lo, hi = int(jnp.argmin(pos_rate)), int(jnp.argmax(pos_rate))
+            results[f"DP_{lo}_{hi}"] = _safe_divide(pos_rate[lo], pos_rate[hi])
+        if self.task in ("equal_opportunity", "all"):
+            tpr = _safe_divide(state["tp"], state["tp"] + state["fn"])
+            lo, hi = int(jnp.argmin(tpr)), int(jnp.argmax(tpr))
+            results[f"EO_{lo}_{hi}"] = _safe_divide(tpr[lo], tpr[hi])
+        return results

@@ -1,0 +1,10 @@
+"""Hamming distance metric classes (reference: classification/hamming.py)."""
+
+from torchmetrics_tpu.classification._factory import make_stat_metric_classes
+
+BinaryHammingDistance, MulticlassHammingDistance, MultilabelHammingDistance, HammingDistance = (
+    make_stat_metric_classes(
+        "hamming", "BinaryHammingDistance", "MulticlassHammingDistance", "MultilabelHammingDistance",
+        "HammingDistance", __name__, higher_is_better=False,
+    )
+)

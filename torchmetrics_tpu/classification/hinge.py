@@ -1,0 +1,84 @@
+"""Hinge loss metric classes (reference: classification/hinge.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.hinge import binary_hinge_loss, multiclass_hinge_loss
+
+
+class BinaryHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, squared: bool = False, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        n = jnp.asarray(preds).reshape(-1).shape[0]
+        if self.ignore_index is not None:
+            n_valid = jnp.sum(jnp.asarray(target).reshape(-1) != self.ignore_index)
+        else:
+            n_valid = jnp.asarray(float(n))
+        loss = binary_hinge_loss(preds, target, self.squared, self.ignore_index, self.validate_args)
+        return {"measures": state["measures"] + loss * n_valid, "total": state["total"] + n_valid}
+
+    def _compute(self, state: State) -> Array:
+        return state["measures"] / jnp.maximum(state["total"], 1.0)
+
+
+class MulticlassHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_classes: int, squared: bool = False, multiclass_mode: str = "crammer-singer",
+                 ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        size = num_classes if multiclass_mode == "one-vs-all" else 1
+        self.add_state("measures", jnp.zeros(size) if size > 1 else jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        if self.ignore_index is not None:
+            n_valid = jnp.sum(jnp.asarray(target).reshape(-1) != self.ignore_index).astype(jnp.float32)
+        else:
+            n_valid = jnp.asarray(float(jnp.asarray(target).reshape(-1).shape[0]))
+        loss = multiclass_hinge_loss(
+            preds, target, self.num_classes, self.squared, self.multiclass_mode,
+            self.ignore_index, self.validate_args,
+        )
+        return {"measures": state["measures"] + loss * n_valid, "total": state["total"] + n_valid}
+
+    def _compute(self, state: State) -> Array:
+        return state["measures"] / jnp.maximum(state["total"], 1.0)
+
+
+class HingeLoss(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("multiclass_mode", None)
+            return BinaryHingeLoss(*args, **kwargs)
+        if task == "multiclass":
+            return MulticlassHingeLoss(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported! (multilabel not supported for HingeLoss)")

@@ -1,0 +1,87 @@
+"""Jaccard index metric classes (reference: classification/jaccard.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.jaccard import _jaccard_reduce
+
+
+class BinaryJaccardIndex(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, zero_division: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        self.zero_division = zero_division
+
+    def _compute(self, state: State):
+        return _jaccard_reduce(state["confmat"], "binary", zero_division=self.zero_division)
+
+
+class MulticlassJaccardIndex(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(self, num_classes: int, average: Optional[str] = "macro", ignore_index: Optional[int] = None,
+                 validate_args: bool = True, zero_division: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+        self.average = average
+        self.zero_division = zero_division
+
+    def _compute(self, state: State):
+        return _jaccard_reduce(state["confmat"], self.average, self.ignore_index, self.zero_division)
+
+
+class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+                 ignore_index: Optional[int] = None, validate_args: bool = True,
+                 zero_division: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, threshold=threshold, normalize=None,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self.average = average
+        self.zero_division = zero_division
+
+    def _compute(self, state: State):
+        return _jaccard_reduce(state["confmat"], self.average, zero_division=self.zero_division)
+
+
+class JaccardIndex(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average")}
+            return BinaryJaccardIndex(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassJaccardIndex(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelJaccardIndex(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,79 @@
+"""Matthews correlation coefficient metric classes (reference: classification/matthews_corrcoef.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.matthews_corrcoef import _matthews_corrcoef_reduce
+
+
+class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(threshold=threshold, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+
+    def _compute(self, state: State):
+        return _matthews_corrcoef_reduce(state["confmat"])
+
+
+class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_classes: int, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, normalize=None, ignore_index=ignore_index,
+                         validate_args=validate_args, **kwargs)
+
+    def _compute(self, state: State):
+        return _matthews_corrcoef_reduce(state["confmat"])
+
+
+class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_labels=num_labels, threshold=threshold, normalize=None,
+                         ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+
+    def _compute(self, state: State):
+        return _matthews_corrcoef_reduce(state["confmat"])
+
+
+class MatthewsCorrCoef(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels")}
+            return BinaryMatthewsCorrCoef(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassMatthewsCorrCoef(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelMatthewsCorrCoef(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

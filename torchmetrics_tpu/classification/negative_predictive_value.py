@@ -1,0 +1,13 @@
+"""Negative predictive value metric classes (reference: classification/negative_predictive_value.py)."""
+
+from torchmetrics_tpu.classification._factory import make_stat_metric_classes
+
+(
+    BinaryNegativePredictiveValue,
+    MulticlassNegativePredictiveValue,
+    MultilabelNegativePredictiveValue,
+    NegativePredictiveValue,
+) = make_stat_metric_classes(
+    "npv", "BinaryNegativePredictiveValue", "MulticlassNegativePredictiveValue",
+    "MultilabelNegativePredictiveValue", "NegativePredictiveValue", __name__,
+)

@@ -1,0 +1,11 @@
+"""Precision / Recall metric classes (reference: classification/precision_recall.py:40-796)."""
+
+from torchmetrics_tpu.classification._factory import make_stat_metric_classes
+
+BinaryPrecision, MulticlassPrecision, MultilabelPrecision, Precision = make_stat_metric_classes(
+    "precision", "BinaryPrecision", "MulticlassPrecision", "MultilabelPrecision", "Precision", __name__
+)
+
+BinaryRecall, MulticlassRecall, MultilabelRecall, Recall = make_stat_metric_classes(
+    "recall", "BinaryRecall", "MulticlassRecall", "MultilabelRecall", "Recall", __name__
+)

@@ -1,0 +1,220 @@
+"""Precision-recall curve metric classes (reference: classification/precision_recall_curve.py:55,228,430).
+
+Two state layouts, as in the reference:
+* ``thresholds=None`` — exact: cat-list states of (preds, target, weights);
+* ``thresholds`` given — binned (T, ..., 2, 2) confusion state, sum-reduced
+  (the TPU-friendly layout: static shape, psum-able in-graph).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_compute_binned,
+    _binary_precision_recall_curve_compute_exact,
+    _binary_prc_format,
+    _binned_curve_update,
+    _multiclass_prc_format,
+    _multilabel_prc_format,
+    _validate_thresholds,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _CurveBase(Metric):
+    """Shared state handling for all curve metrics."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def _init_curve_state(self, thresholds, confmat_shape: Tuple[int, ...]) -> None:
+        self.thresholds = _adjust_threshold_arg(thresholds)
+        if self.thresholds is None:
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("weight", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", jnp.zeros((self.thresholds.shape[0], *confmat_shape, 2, 2)), dist_reduce_fx="sum")
+
+    def _accumulate(self, state: State, p: Array, t: Array, w: Array, binned: Array) -> State:
+        if self.thresholds is None:
+            return {
+                "preds": tuple(state["preds"]) + (p,),
+                "target": tuple(state["target"]) + (t,),
+                "weight": tuple(state["weight"]) + (w,),
+            }
+        return {"confmat": state["confmat"] + binned}
+
+
+class BinaryPrecisionRecallCurve(_CurveBase):
+    def __init__(
+        self,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _validate_thresholds(thresholds)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_curve_state(thresholds, ())
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        p, t, w = _binary_prc_format(preds, target, self.ignore_index)
+        binned = None if self.thresholds is None else _binned_curve_update(p, t, w, self.thresholds)
+        return self._accumulate(state, p, t, w, binned)
+
+    def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
+        return dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]), dim_zero_cat(state["weight"])
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            return _binary_precision_recall_curve_compute_exact(*self._exact_state(state))
+        return _binary_precision_recall_curve_compute_binned(state["confmat"], self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=self.__class__.__name__,
+        )
+
+
+class MulticlassPrecisionRecallCurve(_CurveBase):
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _validate_thresholds(thresholds)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_curve_state(thresholds, (num_classes,))
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        p, t, w = _multiclass_prc_format(preds, target, self.num_classes, self.ignore_index)
+        if self.thresholds is None:
+            binned = None
+        else:
+            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
+            binned = jnp.moveaxis(
+                jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, self.thresholds), in_axes=(1, 1))(p, onehot),
+                0, 1,
+            )
+        return self._accumulate(state, p, t, w, binned)
+
+    def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
+        return dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]), dim_zero_cat(state["weight"])
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
+            out = [
+                _binary_precision_recall_curve_compute_exact(p[:, c], onehot[:, c], w)
+                for c in range(self.num_classes)
+            ]
+            return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+        confmat = state["confmat"]
+        tp = confmat[:, :, 1, 1]
+        fp = confmat[:, :, 0, 1]
+        fn = confmat[:, :, 1, 0]
+        precision = jnp.concatenate([_safe_divide(tp, tp + fp), jnp.ones((1, self.num_classes))], axis=0).T
+        recall = jnp.concatenate([_safe_divide(tp, tp + fn), jnp.zeros((1, self.num_classes))], axis=0).T
+        return precision, recall, self.thresholds
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[1], curve[0], curve[2]), score=score, ax=ax,
+            label_names=("Recall", "Precision"), name=self.__class__.__name__,
+        )
+
+
+class MultilabelPrecisionRecallCurve(_CurveBase):
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _validate_thresholds(thresholds)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._init_curve_state(thresholds, (num_labels,))
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        p, t, w = _multilabel_prc_format(preds, target, self.num_labels, self.ignore_index)
+        if self.thresholds is None:
+            binned = None
+        else:
+            binned = jnp.moveaxis(
+                jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, self.thresholds), in_axes=(1, 1, 1))(p, t, w),
+                0, 1,
+            )
+        return self._accumulate(state, p, t, w, binned)
+
+    def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
+        return dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]), dim_zero_cat(state["weight"])
+
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            out = [
+                _binary_precision_recall_curve_compute_exact(p[:, c], t[:, c], w[:, c])
+                for c in range(self.num_labels)
+            ]
+            return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+        confmat = state["confmat"]
+        tp = confmat[:, :, 1, 1]
+        fp = confmat[:, :, 0, 1]
+        fn = confmat[:, :, 1, 0]
+        precision = jnp.concatenate([_safe_divide(tp, tp + fp), jnp.ones((1, self.num_labels))], axis=0).T
+        recall = jnp.concatenate([_safe_divide(tp, tp + fn), jnp.zeros((1, self.num_labels))], axis=0).T
+        return precision, recall, self.thresholds
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average")}
+            return BinaryPrecisionRecallCurve(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("num_labels", None)
+            return MulticlassPrecisionRecallCurve(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("average", None)
+            return MultilabelPrecisionRecallCurve(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,54 @@
+"""Multilabel ranking metric classes (reference: classification/ranking.py:40,160,280)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    _fn = None
+
+    def __init__(self, num_labels: int, ignore_index: Optional[int] = None,
+                 validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        n = jnp.asarray(preds).shape[0]
+        value = type(self)._fn(preds, target, self.num_labels, self.ignore_index, self.validate_args)
+        return {"measure": state["measure"] + value * n, "total": state["total"] + n}
+
+    def _compute(self, state: State) -> Array:
+        return state["measure"] / jnp.maximum(state["total"], 1.0)
+
+
+class MultilabelCoverageError(_RankingBase):
+    higher_is_better = False
+    _fn = staticmethod(multilabel_coverage_error)
+
+
+class MultilabelRankingAveragePrecision(_RankingBase):
+    higher_is_better = True
+    _fn = staticmethod(multilabel_ranking_average_precision)
+
+
+class MultilabelRankingLoss(_RankingBase):
+    higher_is_better = False
+    _fn = staticmethod(multilabel_ranking_loss)

@@ -1,0 +1,86 @@
+"""ROC metric classes (reference: classification/roc.py:42,175,346)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute_binned,
+    _binary_roc_compute_exact,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            return _binary_roc_compute_exact(*self._exact_state(state))
+        return _binary_roc_compute_binned(state["confmat"], self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from torchmetrics_tpu.utilities.plot import plot_curve
+
+        curve = curve if curve is not None else self.compute()
+        return plot_curve(
+            (curve[0], curve[1], curve[2]), score=score, ax=ax,
+            label_names=("False positive rate", "True positive rate"), name=self.__class__.__name__,
+        )
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
+            out = [_binary_roc_compute_exact(p[:, c], onehot[:, c], w) for c in range(self.num_classes)]
+            return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+        confmat = state["confmat"]
+        tp = confmat[:, :, 1, 1]
+        fp = confmat[:, :, 0, 1]
+        fn = confmat[:, :, 1, 0]
+        tn = confmat[:, :, 0, 0]
+        tpr = _safe_divide(tp, tp + fn)[::-1].T
+        fpr = _safe_divide(fp, fp + tn)[::-1].T
+        return fpr, tpr, self.thresholds[::-1]
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    def _compute(self, state: State):
+        if self.thresholds is None:
+            p, t, w = self._exact_state(state)
+            out = [_binary_roc_compute_exact(p[:, c], t[:, c], w[:, c]) for c in range(self.num_labels)]
+            return [o[0] for o in out], [o[1] for o in out], [o[2] for o in out]
+        confmat = state["confmat"]
+        tp = confmat[:, :, 1, 1]
+        fp = confmat[:, :, 0, 1]
+        fn = confmat[:, :, 1, 0]
+        tn = confmat[:, :, 0, 0]
+        tpr = _safe_divide(tp, tp + fn)[::-1].T
+        fpr = _safe_divide(fp, fp + tn)[::-1].T
+        return fpr, tpr, self.thresholds[::-1]
+
+
+class ROC(_ClassificationTaskWrapper):
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels")}
+            return BinaryROC(*args, **kwargs)
+        if task == "multiclass":
+            kwargs.pop("num_labels", None)
+            return MulticlassROC(*args, **kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            return MultilabelROC(*args, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,7 @@
+"""Specificity metric classes (reference: classification/specificity.py)."""
+
+from torchmetrics_tpu.classification._factory import make_stat_metric_classes
+
+BinarySpecificity, MulticlassSpecificity, MultilabelSpecificity, Specificity = make_stat_metric_classes(
+    "specificity", "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity", __name__
+)

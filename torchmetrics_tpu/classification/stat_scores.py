@@ -1,0 +1,227 @@
+"""Stateful stat-scores base classes and the StatScores metric family.
+
+Reference: classification/stat_scores.py:43-197 (shared tp/fp/tn/fn states
+that the whole Accuracy/Precision/Recall/FBeta tower subclasses).
+
+State layout: ``global`` averaging keeps fixed-shape tp/fp/tn/fn arrays with
+``sum`` reduction (psum-able in-graph); ``samplewise`` keeps cat-tuples of
+per-sample stats.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.classification._reduce import _stat_reduce
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_format,
+    _binary_stat_scores_update,
+    _binary_validate_args,
+    _indicator_stat_scores,
+    _multiclass_indicators,
+    _multiclass_validate_args,
+    _multilabel_format,
+    _multilabel_stat_scores_update,
+    _multilabel_validate_args,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _AbstractStatScores(Metric):
+    """Shared state management for the stat-scores tower."""
+
+    _stat_kind: str = "stat_scores"  # overridden by subclasses (accuracy, precision, ...)
+    _beta: float = 1.0
+    _multilabel: bool = False
+
+    def _create_state(self, size: int, multidim_average: str) -> None:
+        if multidim_average == "samplewise":
+            default: Any = []
+            reduce = "cat"
+        else:
+            default = jnp.zeros(size, dtype=jnp.float32) if size > 1 else jnp.zeros((), dtype=jnp.float32)
+            reduce = "sum"
+        for name in ("tp", "fp", "tn", "fn"):
+            self.add_state(name, default if isinstance(default, list) else default, dist_reduce_fx=reduce)
+
+    def _update_stats(self, state: State, tp, fp, tn, fn) -> State:
+        if self.multidim_average == "samplewise":
+            return {
+                "tp": tuple(state["tp"]) + (tp,),
+                "fp": tuple(state["fp"]) + (fp,),
+                "tn": tuple(state["tn"]) + (tn,),
+                "fn": tuple(state["fn"]) + (fn,),
+            }
+        return {
+            "tp": state["tp"] + tp,
+            "fp": state["fp"] + fp,
+            "tn": state["tn"] + tn,
+            "fn": state["fn"] + fn,
+        }
+
+    def _final_state(self, state: State) -> Tuple[Array, Array, Array, Array]:
+        if self.multidim_average == "samplewise":
+            return (
+                dim_zero_cat(state["tp"]),
+                dim_zero_cat(state["fp"]),
+                dim_zero_cat(state["tn"]),
+                dim_zero_cat(state["fn"]),
+            )
+        return state["tp"], state["fp"], state["tn"], state["fn"]
+
+    def _reduce_kind(self, state: State, average: Optional[str]) -> Array:
+        tp, fp, tn, fn = self._final_state(state)
+        return _stat_reduce(
+            self._stat_kind, tp, fp, tn, fn,
+            average=average, multilabel=self._multilabel, beta=self._beta,
+            top_k=getattr(self, "top_k", 1), zero_division=getattr(self, "zero_division", 0.0),
+        )
+
+
+class BinaryStatScores(_AbstractStatScores):
+    """Binary tp/fp/tn/fn (reference: classification/stat_scores.py:91)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_validate_args(threshold, multidim_average, ignore_index)
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(1, multidim_average)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        p, t, v = _binary_format(preds, target, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(p, t, v, self.multidim_average)
+        return self._update_stats(state, tp, fp, tn, fn)
+
+    def _compute(self, state: State) -> Array:
+        tp, fp, tn, fn = self._final_state(state)
+        return jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1).astype(jnp.int32)
+
+
+class MulticlassStatScores(_AbstractStatScores):
+    """Multiclass per-class tp/fp/tn/fn (reference: classification/stat_scores.py:198)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        top_k: int = 1,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_validate_args(num_classes, top_k, average, multidim_average, ignore_index)
+        self.num_classes = num_classes
+        self.top_k = top_k
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(num_classes, multidim_average)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        pred_ind, targ_ind, valid = _multiclass_indicators(
+            preds, target, self.num_classes, self.top_k, self.ignore_index
+        )
+        tp, fp, tn, fn = _indicator_stat_scores(pred_ind, targ_ind, valid, self.multidim_average)
+        return self._update_stats(state, tp, fp, tn, fn)
+
+    def _compute(self, state: State) -> Array:
+        tp, fp, tn, fn = self._final_state(state)
+        if self.average == "micro":
+            tp, fp, tn, fn = tp.sum(-1), fp.sum(-1), tn.sum(-1), fn.sum(-1)
+        return jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1).astype(jnp.int32)
+
+
+class MultilabelStatScores(_AbstractStatScores):
+    """Multilabel per-label tp/fp/tn/fn (reference: classification/stat_scores.py:354)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    _multilabel = True
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_validate_args(num_labels, threshold, average, multidim_average, ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(num_labels, multidim_average)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        p, t, v = _multilabel_format(preds, target, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _multilabel_stat_scores_update(p, t, v, self.multidim_average)
+        return self._update_stats(state, tp, fp, tn, fn)
+
+    def _compute(self, state: State) -> Array:
+        tp, fp, tn, fn = self._final_state(state)
+        if self.average == "micro":
+            tp, fp, tn, fn = tp.sum(-1), fp.sum(-1), tn.sum(-1), fn.sum(-1)
+        return jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1).astype(jnp.int32)
+
+
+class StatScores(_ClassificationTaskWrapper):
+    """Task-dispatch wrapper (reference: classification/stat_scores.py:471)."""
+
+    @classmethod
+    def _create_task_metric(cls, task: str, *args: Any, **kwargs: Any) -> Metric:
+        kwargs.pop("task", None)
+        task = str(task)
+        if task == "binary":
+            kwargs = {k: v for k, v in kwargs.items() if k not in ("num_classes", "num_labels", "average", "top_k")}
+            return BinaryStatScores(**kwargs)
+        if task == "multiclass":
+            kwargs.pop("threshold", None)
+            kwargs.pop("num_labels", None)
+            return MulticlassStatScores(**kwargs)
+        if task == "multilabel":
+            kwargs.pop("num_classes", None)
+            kwargs.pop("top_k", None)
+            return MultilabelStatScores(**kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,0 +1,102 @@
+"""Accuracy kernels (reference: functional/classification/accuracy.py:30-406)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification._reduce import _stat_reduce
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_format,
+    _binary_stat_scores_update,
+    _binary_validate_args,
+    _indicator_stat_scores,
+    _multiclass_indicators,
+    _multiclass_validate_args,
+    _multilabel_format,
+    _multilabel_stat_scores_update,
+    _multilabel_validate_args,
+)
+
+
+def binary_accuracy(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_validate_args(threshold, multidim_average, ignore_index)
+    p, t, v = _binary_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(p, t, v, multidim_average)
+    return _stat_reduce("accuracy", tp, fp, tn, fn, average="binary")
+
+
+def multiclass_accuracy(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_validate_args(num_classes, top_k, average, multidim_average, ignore_index)
+    pred_ind, targ_ind, valid = _multiclass_indicators(preds, target, num_classes, top_k, ignore_index)
+    tp, fp, tn, fn = _indicator_stat_scores(pred_ind, targ_ind, valid, multidim_average)
+    return _stat_reduce("accuracy", tp, fp, tn, fn, average=average, top_k=top_k)
+
+
+def multilabel_accuracy(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_validate_args(num_labels, threshold, average, multidim_average, ignore_index)
+    p, t, v = _multilabel_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(p, t, v, multidim_average)
+    return _stat_reduce("accuracy", tp, fp, tn, fn, average=average, multilabel=True)
+
+
+def accuracy(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-string dispatch (reference: functional/classification/accuracy.py:341-406)."""
+    task = str(task)
+    if task == "binary":
+        return binary_accuracy(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_accuracy(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.`")
+        return multilabel_accuracy(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Unsupported task `{task}` passed to `accuracy`.")

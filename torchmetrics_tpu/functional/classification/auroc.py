@@ -1,0 +1,142 @@
+"""AUROC kernels (reference: functional/classification/auroc.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_prc_format,
+    _binned_curve_update,
+    _multiclass_prc_format,
+    _multilabel_prc_format,
+    _validate_thresholds,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute_binned,
+    _binary_roc_compute_exact,
+)
+from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide
+
+
+def _binary_auroc_compute(
+    preds: Array, target: Array, weights: Array, thresholds: Optional[Array], max_fpr: Optional[float] = None
+) -> Array:
+    if thresholds is None:
+        fpr, tpr, _ = _binary_roc_compute_exact(preds, target, weights)
+    else:
+        confmat = _binned_curve_update(preds, target, weights, thresholds)
+        fpr, tpr, _ = _binary_roc_compute_binned(confmat, thresholds)
+    if max_fpr is None:
+        return _auc_compute(fpr, tpr, direction=1.0)
+    # partial AUC with McClish standardization (reference: auroc.py binary path)
+    stop = jnp.clip(jnp.searchsorted(fpr, max_fpr, side="right"), 1, fpr.shape[0] - 1)
+    weight = (max_fpr - fpr[stop - 1]) / jnp.maximum(fpr[stop] - fpr[stop - 1], 1e-12)
+    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
+    mask = jnp.arange(fpr.shape[0]) < stop
+    fpr_c = jnp.where(mask, fpr, max_fpr)
+    tpr_c = jnp.where(mask, tpr, interp_tpr)
+    partial = _auc_compute(fpr_c, tpr_c, direction=1.0)
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return 0.5 * (1 + _safe_divide(partial - min_area, max_area - min_area))
+
+
+def binary_auroc(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    p, t, w = _binary_prc_format(preds, target, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    return _binary_auroc_compute(p, t, w, thr, max_fpr)
+
+
+def multiclass_auroc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+        if average not in ("macro", "weighted", "none", None):
+            raise ValueError(f"Argument `average` must be one of ('macro', 'weighted', 'none', None), got {average}")
+    p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
+    aucs = jnp.stack(
+        [_binary_auroc_compute(p[:, c], onehot[:, c], w, thr) for c in range(num_classes)]
+    )
+    if average in (None, "none"):
+        return aucs
+    if average == "macro":
+        return jnp.mean(aucs)
+    if average == "weighted":
+        support = jnp.asarray([(onehot[:, c] * w).sum() for c in range(num_classes)])
+        return jnp.sum(aucs * _safe_divide(support, support.sum()))
+    raise ValueError(f"Unknown average {average}")
+
+
+def multilabel_auroc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multilabel_prc_format(preds, target, num_labels, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if average == "micro":
+        return _binary_auroc_compute(p.reshape(-1), t.reshape(-1), w.reshape(-1), thr)
+    aucs = jnp.stack(
+        [_binary_auroc_compute(p[:, c], t[:, c], w[:, c], thr) for c in range(num_labels)]
+    )
+    if average in (None, "none"):
+        return aucs
+    if average == "macro":
+        return jnp.mean(aucs)
+    if average == "weighted":
+        support = (t * w).sum(0).astype(jnp.float32)
+        return jnp.sum(aucs * _safe_divide(support, support.sum()))
+    raise ValueError(f"Unknown average {average}")
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "binary":
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `auroc`.")

@@ -1,0 +1,126 @@
+"""Average precision kernels (reference: functional/classification/average_precision.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_compute_binned,
+    _binary_precision_recall_curve_compute_exact,
+    _binary_prc_format,
+    _binned_curve_update,
+    _multiclass_prc_format,
+    _multilabel_prc_format,
+    _validate_thresholds,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def _ap_from_curve(precision: Array, recall: Array) -> Array:
+    """AP = sum_n (R_n - R_{n-1}) P_n over the descending-recall curve.
+
+    Curves arrive ascending-threshold (recall descending) with a final (1, 0)
+    sentinel; each recall gap is weighted by the precision of its
+    higher-recall endpoint (sklearn's step-function convention).
+    """
+    return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+
+def _binary_ap_compute(preds: Array, target: Array, weights: Array, thresholds: Optional[Array]) -> Array:
+    if thresholds is None:
+        precision, recall, _ = _binary_precision_recall_curve_compute_exact(preds, target, weights)
+    else:
+        confmat = _binned_curve_update(preds, target, weights, thresholds)
+        precision, recall, _ = _binary_precision_recall_curve_compute_binned(confmat, thresholds)
+    return _ap_from_curve(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _binary_prc_format(preds, target, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    return _binary_ap_compute(p, t, w, thr)
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
+    aps = jnp.stack([_binary_ap_compute(p[:, c], onehot[:, c], w, thr) for c in range(num_classes)])
+    if average in (None, "none"):
+        return aps
+    if average == "macro":
+        return jnp.mean(aps)
+    if average == "weighted":
+        support = jnp.asarray([(onehot[:, c] * w).sum() for c in range(num_classes)])
+        return jnp.sum(aps * _safe_divide(support, support.sum()))
+    raise ValueError(f"Argument `average` must be one of ('macro', 'weighted', 'none', None), got {average}")
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multilabel_prc_format(preds, target, num_labels, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if average == "micro":
+        return _binary_ap_compute(p.reshape(-1), t.reshape(-1), w.reshape(-1), thr)
+    aps = jnp.stack([_binary_ap_compute(p[:, c], t[:, c], w[:, c], thr) for c in range(num_labels)])
+    if average in (None, "none"):
+        return aps
+    if average == "macro":
+        return jnp.mean(aps)
+    if average == "weighted":
+        support = (t * w).sum(0).astype(jnp.float32)
+        return jnp.sum(aps * _safe_divide(support, support.sum()))
+    raise ValueError(f"Argument `average` must be one of ('micro', 'macro', 'weighted', 'none', None), got {average}")
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "binary":
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `average_precision`.")

@@ -1,0 +1,125 @@
+"""Calibration error kernels (reference: functional/classification/calibration_error.py).
+
+TPU-native design difference: the reference stores raw confidence/accuracy
+*lists* and bins at compute.  Binning is a pure function of each sample's
+confidence, so here the state is the **binned sufficient statistics**
+(conf_sum, acc_sum, count per bin) — fixed shape (n_bins,), ``sum``-reduced,
+accumulated with one XLA scatter-add.  Identical ECE, jittable, psum-able.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed, _safe_divide
+
+
+def _bin_update(
+    confidences: Array, accuracies: Array, weights: Array, n_bins: int
+) -> Tuple[Array, Array, Array]:
+    """Scatter confidences/accuracies into uniform bins over [0, 1]."""
+    bin_idx = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
+    conf_sum = jnp.zeros(n_bins).at[bin_idx].add(confidences * weights)
+    acc_sum = jnp.zeros(n_bins).at[bin_idx].add(accuracies * weights)
+    count = jnp.zeros(n_bins).at[bin_idx].add(weights)
+    return conf_sum, acc_sum, count
+
+
+def _ce_compute_from_bins(conf_sum: Array, acc_sum: Array, count: Array, norm: str = "l1") -> Array:
+    total = jnp.sum(count)
+    prop = _safe_divide(count, total)
+    avg_conf = _safe_divide(conf_sum, count)
+    avg_acc = _safe_divide(acc_sum, count)
+    gap = jnp.abs(avg_acc - avg_conf)
+    if norm == "l1":
+        return jnp.sum(gap * prop)
+    if norm == "l2":
+        return jnp.sqrt(jnp.sum(gap**2 * prop))
+    if norm == "max":
+        return jnp.max(jnp.where(count > 0, gap, 0.0))
+    raise ValueError(f"Argument `norm` is expected to be one of ('l1', 'l2', 'max') but got {norm}")
+
+
+def _binary_ce_confidences(
+    preds: Array, target: Array, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    weights = jnp.ones_like(preds)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    # confidence in the *predicted* class, accuracy of that prediction
+    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
+    accuracies = jnp.where(preds > 0.5, target, 1 - target).astype(jnp.float32)
+    return confidences, accuracies, weights
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args and not (isinstance(n_bins, int) and n_bins > 0):
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    conf, acc, w = _binary_ce_confidences(preds, target, ignore_index)
+    return _ce_compute_from_bins(*_bin_update(conf, acc, w, n_bins), norm)
+
+
+def _multiclass_ce_confidences(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = preds.reshape(-1, num_classes)
+    weights = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    confidences = jnp.max(preds, axis=-1)
+    accuracies = (jnp.argmax(preds, axis=-1) == target).astype(jnp.float32)
+    return confidences, accuracies, weights
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args and not (isinstance(n_bins, int) and n_bins > 0):
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    conf, acc, w = _multiclass_ce_confidences(preds, target, num_classes, ignore_index)
+    return _ce_compute_from_bins(*_bin_update(conf, acc, w, n_bins), norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "binary":
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `calibration_error` (multilabel is not supported).")

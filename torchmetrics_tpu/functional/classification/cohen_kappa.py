@@ -1,0 +1,54 @@
+"""Cohen's kappa kernels (reference: functional/classification/cohen_kappa.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+)
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """kappa = (p_o - p_e) / (1 - p_e), with optional linear/quadratic weighting."""
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[-1]
+    total = jnp.sum(confmat)
+    p = confmat / total
+    row = p.sum(1)  # true marginals
+    col = p.sum(0)  # pred marginals
+    expected = jnp.outer(row, col)
+
+    if weights is None:
+        w = 1.0 - jnp.eye(n_classes)
+    elif weights in ("linear", "quadratic"):
+        idx = jnp.arange(n_classes, dtype=jnp.float32)
+        diff = jnp.abs(idx[:, None] - idx[None, :])
+        w = diff if weights == "linear" else diff**2
+    else:
+        raise ValueError(f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'")
+    k = jnp.sum(w * p) / jnp.sum(w * expected)
+    return 1.0 - k
+
+
+def binary_cohen_kappa(preds, target, threshold=0.5, weights=None, ignore_index=None, validate_args=True):
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def multiclass_cohen_kappa(preds, target, num_classes, weights=None, ignore_index=None, validate_args=True):
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def cohen_kappa(preds, target, task, threshold=0.5, num_classes=None, weights=None, ignore_index=None, validate_args=True):
+    task = str(task)
+    if task == "binary":
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `cohen_kappa` (multilabel is not supported).")

@@ -1,0 +1,178 @@
+"""Confusion-matrix kernels.
+
+Reference: functional/classification/confusion_matrix.py.  The TPU-native
+formulation is a single static-length scatter-add (``_bincount`` over
+``C * target + pred``) — one XLA scatter, no dynamic shapes.
+``ignore_index`` contributes weight 0 via the scatter's update operand
+instead of boolean indexing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed, _safe_divide
+
+_ALLOWED_NORMALIZE = ("true", "pred", "all", "none", None)
+
+
+def _confusion_matrix_validate_args(
+    normalize: Optional[str],
+    ignore_index: Optional[int],
+    threshold: Optional[float] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+) -> None:
+    if normalize not in _ALLOWED_NORMALIZE:
+        raise ValueError(f"Argument `normalize` needs to be one of {_ALLOWED_NORMALIZE}, but got {normalize}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if threshold is not None and not (isinstance(threshold, float) and 0 <= threshold <= 1):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if num_classes is not None and not (isinstance(num_classes, int) and num_classes > 1):
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if num_labels is not None and not (isinstance(num_labels, int) and num_labels > 1):
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+
+
+def _normalize_confmat(confmat: Array, normalize: Optional[str]) -> Array:
+    if normalize is None or normalize == "none":
+        return confmat
+    confmat = confmat.astype(jnp.float32)
+    if normalize == "true":
+        return _safe_divide(confmat, confmat.sum(axis=-1, keepdims=True))
+    if normalize == "pred":
+        return _safe_divide(confmat, confmat.sum(axis=-2, keepdims=True))
+    if normalize == "all":
+        return _safe_divide(confmat, confmat.sum(axis=(-2, -1), keepdims=True))
+    raise ValueError(f"Argument `normalize` needs to one of the following: ['true', 'pred', 'all', 'none', None] but got {normalize}")
+
+
+def _weighted_pair_count(pred: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    """(C, C) count of (target, pred) pairs with per-element weights."""
+    idx = (target.reshape(-1) * num_classes + pred.reshape(-1)).astype(jnp.int32)
+    flat = jnp.zeros(num_classes * num_classes, dtype=jnp.float32).at[idx].add(valid.reshape(-1))
+    return flat.reshape(num_classes, num_classes)
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, threshold: float, ignore_index: Optional[int]) -> Array:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    valid = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        valid = jnp.where(target == ignore_index, 0.0, valid)
+        target = jnp.where(target == ignore_index, 0, target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    return _weighted_pair_count(preds.astype(jnp.int32), target.astype(jnp.int32), valid, 2)
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _confusion_matrix_validate_args(normalize, ignore_index, threshold=threshold)
+    confmat = _binary_confusion_matrix_update(preds, target, threshold, ignore_index)
+    out = _normalize_confmat(confmat, normalize)
+    return out if normalize not in (None, "none") else out.astype(jnp.int32)
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, num_classes: int, ignore_index: Optional[int]) -> Array:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    valid = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        valid = jnp.where(target == ignore_index, 0.0, valid)
+        target = jnp.where(target == ignore_index, 0, target)
+    return _weighted_pair_count(preds.astype(jnp.int32), target.astype(jnp.int32), valid, num_classes)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _confusion_matrix_validate_args(normalize, ignore_index, num_classes=num_classes)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
+    out = _normalize_confmat(confmat, normalize)
+    return out if normalize not in (None, "none") else out.astype(jnp.int32)
+
+
+def _multilabel_confusion_matrix_update(
+    preds: Array, target: Array, num_labels: int, threshold: float, ignore_index: Optional[int]
+) -> Array:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    valid = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        valid = jnp.where(target == ignore_index, 0.0, valid)
+        target = jnp.where(target == ignore_index, 0, target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    p = preds.astype(jnp.float32).reshape(preds.shape[0], num_labels, -1)
+    t = target.astype(jnp.float32).reshape(target.shape[0], num_labels, -1)
+    v = valid.reshape(valid.shape[0], num_labels, -1)
+    tp = jnp.sum(p * t * v, axis=(0, 2))
+    fp = jnp.sum(p * (1 - t) * v, axis=(0, 2))
+    fn = jnp.sum((1 - p) * t * v, axis=(0, 2))
+    tn = jnp.sum((1 - p) * (1 - t) * v, axis=(0, 2))
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (L, 2, 2)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _confusion_matrix_validate_args(normalize, ignore_index, threshold=threshold, num_labels=num_labels)
+    confmat = _multilabel_confusion_matrix_update(preds, target, num_labels, threshold, ignore_index)
+    out = _normalize_confmat(confmat, normalize)
+    return out if normalize not in (None, "none") else out.astype(jnp.int32)
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "binary":
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.`")
+        return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `confusion_matrix`.")

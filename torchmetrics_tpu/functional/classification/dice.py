@@ -1,0 +1,38 @@
+"""Dice score kernel (reference: functional/classification/dice.py / classification/dice.py:31).
+
+Dice == F1 on the stat-scores decomposition: 2*tp / (2*tp + fp + fn).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _indicator_stat_scores,
+    _multiclass_indicators,
+)
+from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+    ignore_index: Optional[int] = None,
+    top_k: int = 1,
+) -> Array:
+    """Dice score from multiclass stat scores."""
+    preds = jnp.asarray(preds)
+    if num_classes is None:
+        raise ValueError("`num_classes` must be provided for the TPU-native dice (static shapes).")
+    pred_ind, targ_ind, valid = _multiclass_indicators(preds, target, num_classes, top_k, ignore_index)
+    tp, fp, tn, fn = _indicator_stat_scores(pred_ind, targ_ind, valid, "global")
+    if average == "micro":
+        tp, fp, fn = tp.sum(), fp.sum(), fn.sum()
+        return _safe_divide(2 * tp, 2 * tp + fp + fn)
+    score = _safe_divide(2 * tp, 2 * tp + fp + fn)
+    return _adjust_weights_safe_divide(score, average, False, tp, fp, fn)

@@ -1,0 +1,84 @@
+"""Exact match kernels (reference: functional/classification/exact_match.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_indicators,
+    _multiclass_validate_args,
+    _multilabel_format,
+    _multilabel_validate_args,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Fraction of samples where EVERY (multidim) position is predicted correctly."""
+    if validate_args:
+        _multiclass_validate_args(num_classes, 1, None, multidim_average, ignore_index)
+    pred_ind, targ_ind, valid = _multiclass_indicators(preds, target, num_classes, 1, ignore_index)
+    # position correct if the predicted one-hot matches the target one-hot
+    correct = jnp.sum(pred_ind * targ_ind, axis=1)  # (N, S)
+    v = valid[:, 0, :]
+    sample_match = jnp.all(jnp.logical_or(correct > 0, v == 0), axis=1).astype(jnp.float32)
+    # samples that are entirely ignored don't count
+    sample_valid = jnp.any(v > 0, axis=1).astype(jnp.float32)
+    if multidim_average == "global":
+        return _safe_divide(jnp.sum(sample_match * sample_valid), jnp.sum(sample_valid))
+    return sample_match * sample_valid
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Fraction of samples where every label is predicted correctly."""
+    if validate_args:
+        _multilabel_validate_args(num_labels, threshold, None, multidim_average, ignore_index)
+    p, t, v = _multilabel_format(preds, target, threshold, ignore_index)
+    n = p.shape[0]
+    p, t, vv = p.reshape(n, -1), t.reshape(n, -1), v.reshape(n, -1)
+    correct = jnp.logical_or(p == t, vv == 0)
+    sample_match = jnp.all(correct, axis=1).astype(jnp.float32)
+    if multidim_average == "global":
+        return jnp.mean(sample_match)
+    return sample_match
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.`")
+        return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `exact_match` (binary is not supported).")

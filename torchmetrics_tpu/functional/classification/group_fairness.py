@@ -1,0 +1,87 @@
+"""Group fairness kernels (reference: functional/classification/group_fairness.py:59-157)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.stat_scores import _binary_format
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def _groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-group (tp, fp, tn, fn), each of shape (num_groups,) — one scatter-add per stat."""
+    p, t, v = _binary_format(preds, target, threshold, ignore_index)
+    g = jnp.asarray(groups).reshape(-1).astype(jnp.int32)
+    p, t, v = p.reshape(-1).astype(jnp.float32), t.reshape(-1).astype(jnp.float32), v.reshape(-1)
+    tp = jnp.zeros(num_groups).at[g].add(p * t * v)
+    fp = jnp.zeros(num_groups).at[g].add(p * (1 - t) * v)
+    fn = jnp.zeros(num_groups).at[g].add((1 - p) * t * v)
+    tn = jnp.zeros(num_groups).at[g].add((1 - p) * (1 - t) * v)
+    return tp, fp, tn, fn
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Normalized per-group stat rates (reference: group_fairness.py:59)."""
+    tp, fp, tn, fn = _groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index)
+    total = tp + fp + tn + fn
+    return {
+        f"group_{g}": jnp.stack([tp[g], fp[g], tn[g], fn[g]]) / jnp.maximum(total[g], 1.0)
+        for g in range(num_groups)
+    }
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    num_groups: Optional[int] = None,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity & equal opportunity ratios (reference: group_fairness.py:157).
+
+    demographic_parity: min/max ratio of positive prediction rates across groups.
+    equal_opportunity: min/max ratio of true positive rates across groups.
+    Keys are suffixed with the argmin/argmax group indices.
+    """
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be 'demographic_parity', 'equal_opportunity' or 'all' but got {task}."
+        )
+    if num_groups is None:
+        num_groups = int(jnp.max(jnp.asarray(groups))) + 1
+    if task == "demographic_parity":
+        target = jnp.zeros_like(jnp.asarray(target))  # DP ignores the target
+    tp, fp, tn, fn = _groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index)
+
+    results: Dict[str, Array] = {}
+    if task in ("demographic_parity", "all"):
+        pos_rate = _safe_divide(tp + fp, tp + fp + tn + fn)
+        lo, hi = int(jnp.argmin(pos_rate)), int(jnp.argmax(pos_rate))
+        results[f"DP_{lo}_{hi}"] = _safe_divide(pos_rate[lo], pos_rate[hi])
+    if task in ("equal_opportunity", "all"):
+        tpr = _safe_divide(tp, tp + fn)
+        lo, hi = int(jnp.argmin(tpr)), int(jnp.argmax(tpr))
+        results[f"EO_{lo}_{hi}"] = _safe_divide(tpr[lo], tpr[hi])
+    return results

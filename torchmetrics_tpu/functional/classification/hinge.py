@@ -1,0 +1,92 @@
+"""Hinge loss kernels (reference: functional/classification/hinge.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Hinge loss for binary tasks; target in {0,1} is mapped to {-1,1}."""
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    weights = jnp.ones_like(preds)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    t = 2.0 * target.astype(jnp.float32) - 1.0
+    margin = 1.0 - t * preds
+    loss = jnp.maximum(margin, 0.0)
+    if squared:
+        loss = loss**2
+    return jnp.sum(loss * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args and multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all'), got {multiclass_mode}"
+        )
+    preds = jnp.asarray(preds).astype(jnp.float32).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    weights = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    onehot = jax.nn.one_hot(target, num_classes)
+    if multiclass_mode == "crammer-singer":
+        target_score = jnp.sum(preds * onehot, axis=-1)
+        best_other = jnp.max(preds - onehot * 1e9, axis=-1)
+        margin = 1.0 - (target_score - best_other)
+        loss = jnp.maximum(margin, 0.0)
+        if squared:
+            loss = loss**2
+        return jnp.sum(loss * weights) / jnp.maximum(jnp.sum(weights), 1.0)
+    # one-vs-all: per-class binary hinge, mean over samples -> (C,)
+    t = 2.0 * onehot - 1.0
+    margin = 1.0 - t * preds
+    loss = jnp.maximum(margin, 0.0)
+    if squared:
+        loss = loss**2
+    return jnp.sum(loss * weights[:, None], axis=0) / jnp.maximum(jnp.sum(weights), 1.0)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task)
+    if task == "binary":
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `hinge_loss` (multilabel is not supported).")

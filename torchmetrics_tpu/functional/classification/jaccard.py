@@ -1,0 +1,75 @@
+"""Jaccard index (IoU) kernels (reference: functional/classification/jaccard.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def _jaccard_reduce(confmat: Array, average: Optional[str], ignore_index: Optional[int] = None, zero_division: float = 0.0) -> Array:
+    """Reduce a confusion matrix to the Jaccard score (reference: jaccard.py:28-77)."""
+    confmat = confmat.astype(jnp.float32)
+    if confmat.ndim == 3:  # multilabel (L, 2, 2)
+        tn, fp, fn, tp = confmat[:, 0, 0], confmat[:, 0, 1], confmat[:, 1, 0], confmat[:, 1, 1]
+        num, denom = tp, tp + fp + fn
+    elif confmat.shape[-1] == 2 and confmat.ndim == 2 and average == "binary":
+        tn, fp, fn, tp = confmat[0, 0], confmat[0, 1], confmat[1, 0], confmat[1, 1]
+        return _safe_divide(tp, tp + fp + fn, zero_division)
+    else:  # multiclass (C, C)
+        intersection = jnp.diagonal(confmat)
+        union = confmat.sum(0) + confmat.sum(1) - intersection
+        num, denom = intersection, union
+    ignore_mask = jnp.ones_like(num)
+    if ignore_index is not None and confmat.ndim == 2:
+        ignore_mask = ignore_mask.at[ignore_index].set(0.0)
+    if average == "micro":
+        return _safe_divide((num * ignore_mask).sum(), (denom * ignore_mask).sum(), zero_division)
+    scores = _safe_divide(num, denom, zero_division)
+    if average in (None, "none"):
+        return scores
+    if average == "macro":
+        present = (denom > 0).astype(jnp.float32) * ignore_mask
+        return _safe_divide(jnp.sum(scores * present), jnp.sum(present), zero_division)
+    if average == "weighted":
+        if confmat.ndim == 3:
+            weights = confmat[:, 1, :].sum(-1)
+        else:
+            weights = confmat.sum(1)
+        weights = weights * ignore_mask
+        return _safe_divide(jnp.sum(scores * weights), jnp.sum(weights), zero_division)
+    raise ValueError(f"Argument `average` should be one of ['binary', 'micro', 'macro', 'weighted', 'none', None], got {average}")
+
+
+def binary_jaccard_index(preds, target, threshold=0.5, ignore_index=None, validate_args=True, zero_division=0.0):
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _jaccard_reduce(confmat, "binary", zero_division=zero_division)
+
+
+def multiclass_jaccard_index(preds, target, num_classes, average="macro", ignore_index=None, validate_args=True, zero_division=0.0):
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _jaccard_reduce(confmat, average, ignore_index, zero_division)
+
+
+def multilabel_jaccard_index(preds, target, num_labels, threshold=0.5, average="macro", ignore_index=None, validate_args=True, zero_division=0.0):
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, None, ignore_index, validate_args)
+    return _jaccard_reduce(confmat, average, zero_division=zero_division)
+
+
+def jaccard_index(preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="macro", ignore_index=None, validate_args=True, zero_division=0.0):
+    task = str(task)
+    if task == "binary":
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args, zero_division)
+    if task == "multiclass":
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args, zero_division)
+    if task == "multilabel":
+        return multilabel_jaccard_index(preds, target, num_labels, threshold, average, ignore_index, validate_args, zero_division)
+    raise ValueError(f"Unsupported task `{task}` passed to `jaccard_index`.")

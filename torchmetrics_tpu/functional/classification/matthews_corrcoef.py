@@ -1,0 +1,57 @@
+"""Matthews correlation coefficient kernels (reference: functional/classification/matthews_corrcoef.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Generalized R_k statistic over a (C, C) confusion matrix."""
+    confmat = confmat.astype(jnp.float32)
+    if confmat.ndim == 3:  # multilabel (L, 2, 2): aggregate into one 2x2
+        confmat = confmat.sum(0)
+    tk = confmat.sum(1)  # true counts
+    pk = confmat.sum(0)  # pred counts
+    c = jnp.trace(confmat)
+    s = confmat.sum()
+    cov_ytyp = c * s - jnp.dot(tk, pk)
+    cov_ypyp = s**2 - jnp.dot(pk, pk)
+    cov_ytyt = s**2 - jnp.dot(tk, tk)
+    denom = jnp.sqrt(cov_ypyp * cov_ytyt)
+    # degenerate cases: single-class preds or targets -> 0 (sklearn convention)
+    return jnp.where(denom == 0, 0.0, cov_ytyp / jnp.where(denom == 0, 1.0, denom))
+
+
+def binary_matthews_corrcoef(preds, target, threshold=0.5, ignore_index=None, validate_args=True):
+    confmat = binary_confusion_matrix(preds, target, threshold, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index=None, validate_args=True):
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(preds, target, num_labels, threshold=0.5, ignore_index=None, validate_args=True):
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, None, ignore_index, validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(preds, target, task, threshold=0.5, num_classes=None, num_labels=None, ignore_index=None, validate_args=True):
+    task = str(task)
+    if task == "binary":
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `matthews_corrcoef`.")

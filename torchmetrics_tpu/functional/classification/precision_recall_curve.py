@@ -1,0 +1,251 @@
+"""Precision-recall curve kernels — exact and binned paths.
+
+Reference: functional/classification/precision_recall_curve.py (exact
+``_binary_clf_curve`` at :29, binned confmat state in the class init).  Two
+state layouts, as in the reference:
+
+* ``thresholds=None`` — exact curve.  The reference removes duplicate
+  thresholds with dynamic-shape indexing; XLA cannot.  Instead we use a
+  **static-shape tie collapse**: every non-final point of a tie group is
+  replaced by the group's final point (reverse-cummin gather), producing
+  zero-length segments that change neither the curve nor any area under it.
+* ``thresholds=int/array`` — binned (T, 2, 2) confusion-matrix state,
+  ``sum``-reduced: the TPU-friendly path (static shape, psum-able).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+
+
+def _adjust_threshold_arg(thresholds: Union[int, Sequence[float], Array, None]) -> Optional[Array]:
+    if thresholds is None:
+        return None
+    if isinstance(thresholds, int):
+        return jnp.linspace(0.0, 1.0, thresholds)
+    return jnp.asarray(thresholds, dtype=jnp.float32)
+
+
+def _validate_thresholds(thresholds) -> None:
+    if thresholds is not None and not isinstance(thresholds, (int, list, tuple, jnp.ndarray, jax.Array)):
+        raise ValueError(
+            f"Expected argument `thresholds` to either be an integer, list of floats or tensor of floats, but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}")
+
+
+def _binary_prc_format(
+    preds: Array, target: Array, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    """Flatten + sigmoid-normalize; returns (preds, target, weights)."""
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    weights = jnp.ones_like(target, dtype=jnp.float32)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    return preds, target.astype(jnp.int32), weights
+
+
+def _binary_clf_curve(
+    preds: Array, target: Array, weights: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Exact cumulative (fps, tps, thresholds), descending score order.
+
+    Static-shape: returns length-N arrays where tie groups are collapsed onto
+    their final point (duplicated coordinates, zero-length segments).
+    """
+    preds = preds.reshape(-1)
+    target = target.reshape(-1).astype(jnp.float32)
+    n = preds.shape[0]
+    w = jnp.ones(n, dtype=jnp.float32) if weights is None else weights.reshape(-1)
+
+    order = jnp.argsort(-preds, stable=True)
+    preds_s, target_s, w_s = preds[order], target[order], w[order]
+    tps = jnp.cumsum(target_s * w_s)
+    fps = jnp.cumsum((1.0 - target_s) * w_s)
+
+    # tie collapse: point i is a group end iff preds[i] != preds[i+1] (or last)
+    group_end = jnp.concatenate([preds_s[:-1] != preds_s[1:], jnp.array([True])])
+    idx = jnp.where(group_end, jnp.arange(n), n - 1)
+    next_end = jax.lax.associative_scan(jnp.minimum, idx[::-1])[::-1]
+    return fps[next_end], tps[next_end], preds_s[next_end]
+
+
+def _binary_precision_recall_curve_compute_exact(
+    preds: Array, target: Array, weights: Array
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, weights)
+    precision = _safe_divide(tps, tps + fps)
+    recall = _safe_divide(tps, tps[-1])
+    # reverse (ascending threshold order) + final (1, 0) point, sklearn-style
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1)])
+    thresholds = thresholds[::-1]
+    return precision, recall, thresholds
+
+
+def _binned_curve_update(
+    preds: Array, target: Array, weights: Array, thresholds: Array
+) -> Array:
+    """(T, 2, 2) threshold-confusion state: state[t] = [[tn, fp], [fn, tp]]."""
+    pred_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # (N, T)
+    t = target.astype(jnp.float32)[:, None]
+    w = weights[:, None]
+    tp = jnp.sum(pred_t * t * w, axis=0)
+    fp = jnp.sum(pred_t * (1 - t) * w, axis=0)
+    fn = jnp.sum((1 - pred_t) * t * w, axis=0)
+    tn = jnp.sum((1 - pred_t) * (1 - t) * w, axis=0)
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T, 2, 2)
+
+
+def _binary_precision_recall_curve_compute_binned(confmat: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    tp = confmat[:, 1, 1]
+    fp = confmat[:, 0, 1]
+    fn = confmat[:, 1, 0]
+    precision = jnp.concatenate([_safe_divide(tp, tp + fp), jnp.ones(1)])
+    recall = jnp.concatenate([_safe_divide(tp, tp + fn), jnp.zeros(1)])
+    return precision, recall, thresholds
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _binary_prc_format(preds, target, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if thr is None:
+        return _binary_precision_recall_curve_compute_exact(p, t, w)
+    confmat = _binned_curve_update(p, t, w, thr)
+    return _binary_precision_recall_curve_compute_binned(confmat, thr)
+
+
+def _multiclass_prc_format(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    """Returns (probs (N, C), target (N,), weights (N,)) with softmax normalization."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target).reshape(-1)
+    # (N, C, ...) -> (N*S, C): move the class axis last before flattening so
+    # spatial positions stay paired with their class scores
+    if preds.ndim > 2:
+        preds = jnp.moveaxis(preds, 1, -1)
+    preds = preds.reshape(-1, num_classes)
+    weights = jnp.ones_like(target, dtype=jnp.float32)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "softmax")
+    return preds, target.astype(jnp.int32), weights
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Union[Array, List[Array]], Union[Array, List[Array]], Union[Array, List[Array]]]:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
+    if thr is None:
+        precisions, recalls, thrs = [], [], []
+        for c in range(num_classes):
+            pr, rc, th = _binary_precision_recall_curve_compute_exact(p[:, c], onehot[:, c], w)
+            precisions.append(pr)
+            recalls.append(rc)
+            thrs.append(th)
+        return precisions, recalls, thrs
+    confmat = jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, thr), in_axes=(1, 1))(p, onehot)
+    # confmat: (C, T, 2, 2) -> reference layout (T, C, 2, 2)
+    confmat = jnp.moveaxis(confmat, 0, 1)
+    tp = confmat[:, :, 1, 1]
+    fp = confmat[:, :, 0, 1]
+    fn = confmat[:, :, 1, 0]
+    precision = jnp.concatenate([_safe_divide(tp, tp + fp), jnp.ones((1, num_classes))], axis=0).T
+    recall = jnp.concatenate([_safe_divide(tp, tp + fn), jnp.zeros((1, num_classes))], axis=0).T
+    return precision, recall, thr
+
+
+def _multilabel_prc_format(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds).reshape(-1, num_labels)
+    target = jnp.asarray(target).reshape(-1, num_labels)
+    weights = jnp.ones_like(target, dtype=jnp.float32)
+    if ignore_index is not None:
+        weights = jnp.where(target == ignore_index, 0.0, weights)
+        target = jnp.where(target == ignore_index, 0, target)
+    preds = normalize_logits_if_needed(preds.astype(jnp.float32), "sigmoid")
+    return preds, target.astype(jnp.int32), weights
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Union[Array, List[Array]], Union[Array, List[Array]], Union[Array, List[Array]]]:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multilabel_prc_format(preds, target, num_labels, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if thr is None:
+        precisions, recalls, thrs = [], [], []
+        for c in range(num_labels):
+            pr, rc, th = _binary_precision_recall_curve_compute_exact(p[:, c], t[:, c], w[:, c])
+            precisions.append(pr)
+            recalls.append(rc)
+            thrs.append(th)
+        return precisions, recalls, thrs
+    confmat = jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, thr), in_axes=(1, 1, 1))(p, t, w)
+    confmat = jnp.moveaxis(confmat, 0, 1)  # (T, L, 2, 2)
+    tp = confmat[:, :, 1, 1]
+    fp = confmat[:, :, 0, 1]
+    fn = confmat[:, :, 1, 0]
+    precision = jnp.concatenate([_safe_divide(tp, tp + fp), jnp.ones((1, num_labels))], axis=0).T
+    recall = jnp.concatenate([_safe_divide(tp, tp + fn), jnp.zeros((1, num_labels))], axis=0).T
+    return precision, recall, thr
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = str(task)
+    if task == "binary":
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `precision_recall_curve`.")

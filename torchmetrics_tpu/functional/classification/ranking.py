@@ -1,0 +1,71 @@
+"""Multilabel ranking kernels (reference: functional/classification/ranking.py:40-280)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _format_ranking_inputs(
+    preds: Array, target: Array, ignore_index: Optional[int]
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds).astype(jnp.float32)
+    target = jnp.asarray(target)
+    valid = jnp.ones(target.shape, dtype=jnp.float32)
+    if ignore_index is not None:
+        valid = jnp.where(target == ignore_index, 0.0, valid)
+        target = jnp.where(target == ignore_index, 0, target)
+    return preds, target.astype(jnp.float32), valid
+
+
+def multilabel_coverage_error(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """How far down the ranking to go to cover all true labels (sklearn coverage_error)."""
+    preds, target, valid = _format_ranking_inputs(preds, target, ignore_index)
+    min_relevant = jnp.min(jnp.where((target * valid) > 0, preds, jnp.inf), axis=1)
+    coverage = jnp.sum((preds >= min_relevant[:, None]) * valid, axis=1).astype(jnp.float32)
+    coverage = jnp.where(jnp.isinf(min_relevant), 0.0, coverage)
+    return jnp.mean(coverage)
+
+
+def multilabel_ranking_average_precision(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """Label-ranking average precision (sklearn label_ranking_average_precision_score)."""
+    preds, target, valid = _format_ranking_inputs(preds, target, ignore_index)
+    n, l = preds.shape
+    rel = target * valid
+
+    # rank among valid labels (descending score): rank_i = #valid labels with score >= score_i
+    ge = (preds[:, :, None] <= preds[:, None, :]).astype(jnp.float32)  # ge[n, i, j] = score_j >= score_i
+    rank_all = jnp.einsum("nij,nj->ni", ge, valid)
+    # rank among relevant labels only
+    rank_rel = jnp.einsum("nij,nj->ni", ge, rel)
+    per_label = jnp.where(rel > 0, rank_rel / rank_all, 0.0)
+    n_rel = jnp.sum(rel, axis=1)
+    per_sample = jnp.where(n_rel > 0, jnp.sum(per_label, axis=1) / jnp.maximum(n_rel, 1.0), 1.0)
+    # samples with all labels relevant also give 1.0 in sklearn
+    all_rel = n_rel == jnp.sum(valid, axis=1)
+    per_sample = jnp.where(all_rel, 1.0, per_sample)
+    return jnp.mean(per_sample)
+
+
+def multilabel_ranking_loss(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    """Average fraction of mis-ordered (relevant, irrelevant) label pairs (sklearn label_ranking_loss)."""
+    preds, target, valid = _format_ranking_inputs(preds, target, ignore_index)
+    rel = target * valid
+    irr = (1.0 - target) * valid
+    # count pairs (i relevant, j irrelevant) with score_j >= score_i
+    ge = (preds[:, None, :] >= preds[:, :, None]).astype(jnp.float32)  # ge[n, i, j] = score_j >= score_i
+    bad = jnp.einsum("nij,ni,nj->n", ge, rel, irr)
+    n_rel = jnp.sum(rel, axis=1)
+    n_irr = jnp.sum(irr, axis=1)
+    denom = n_rel * n_irr
+    per_sample = jnp.where(denom > 0, bad / jnp.maximum(denom, 1.0), 0.0)
+    return jnp.mean(per_sample)

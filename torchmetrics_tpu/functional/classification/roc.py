@@ -1,0 +1,144 @@
+"""ROC curve kernels (reference: functional/classification/roc.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_clf_curve,
+    _binary_prc_format,
+    _binned_curve_update,
+    _multiclass_prc_format,
+    _multilabel_prc_format,
+    _validate_thresholds,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def _binary_roc_compute_exact(preds: Array, target: Array, weights: Array) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, weights)
+    # prepend the (0, 0) origin with threshold just above the max score
+    tps = jnp.concatenate([jnp.zeros(1), tps])
+    fps = jnp.concatenate([jnp.zeros(1), fps])
+    thresholds = jnp.concatenate([jnp.ones(1) + thresholds[:1] * 0, thresholds])
+    tpr = _safe_divide(tps, tps[-1])
+    fpr = _safe_divide(fps, fps[-1])
+    return fpr, tpr, thresholds
+
+
+def _binary_roc_compute_binned(confmat: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    tp = confmat[:, 1, 1]
+    fp = confmat[:, 0, 1]
+    fn = confmat[:, 1, 0]
+    tn = confmat[:, 0, 0]
+    # flip so fpr is increasing (thresholds descending), reference-style
+    tpr = _safe_divide(tp, tp + fn)[::-1]
+    fpr = _safe_divide(fp, fp + tn)[::-1]
+    return fpr, tpr, thresholds[::-1]
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _binary_prc_format(preds, target, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if thr is None:
+        return _binary_roc_compute_exact(p, t, w)
+    confmat = _binned_curve_update(p, t, w, thr)
+    return _binary_roc_compute_binned(confmat, thr)
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
+    if thr is None:
+        fprs, tprs, thrs = [], [], []
+        for c in range(num_classes):
+            fp_, tp_, th_ = _binary_roc_compute_exact(p[:, c], onehot[:, c], w)
+            fprs.append(fp_)
+            tprs.append(tp_)
+            thrs.append(th_)
+        return fprs, tprs, thrs
+    confmat = jnp.moveaxis(
+        jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, thr), in_axes=(1, 1))(p, onehot), 0, 1
+    )  # (T, C, 2, 2)
+    tp = confmat[:, :, 1, 1]
+    fp = confmat[:, :, 0, 1]
+    fn = confmat[:, :, 1, 0]
+    tn = confmat[:, :, 0, 0]
+    tpr = _safe_divide(tp, tp + fn)[::-1].T  # (C, T)
+    fpr = _safe_divide(fp, fp + tn)[::-1].T
+    return fpr, tpr, thr[::-1]
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _validate_thresholds(thresholds)
+    p, t, w = _multilabel_prc_format(preds, target, num_labels, ignore_index)
+    thr = _adjust_threshold_arg(thresholds)
+    if thr is None:
+        fprs, tprs, thrs = [], [], []
+        for c in range(num_labels):
+            fp_, tp_, th_ = _binary_roc_compute_exact(p[:, c], t[:, c], w[:, c])
+            fprs.append(fp_)
+            tprs.append(tp_)
+            thrs.append(th_)
+        return fprs, tprs, thrs
+    confmat = jnp.moveaxis(
+        jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, thr), in_axes=(1, 1, 1))(p, t, w), 0, 1
+    )
+    tp = confmat[:, :, 1, 1]
+    fp = confmat[:, :, 0, 1]
+    fn = confmat[:, :, 1, 0]
+    tn = confmat[:, :, 0, 0]
+    tpr = _safe_divide(tp, tp + fn)[::-1].T
+    fpr = _safe_divide(fp, fp + tn)[::-1].T
+    return fpr, tpr, thr[::-1]
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = str(task)
+    if task == "binary":
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Unsupported task `{task}` passed to `roc`.")

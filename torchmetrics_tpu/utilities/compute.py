@@ -54,7 +54,11 @@ def _adjust_weights_safe_divide(
     else:
         weights = jnp.ones_like(score)
         if not multilabel:
-            weights = jnp.where(tp + fp + fn == 0 * jnp.minimum(1, top_k), 0.0, weights)
+            # exclude classes absent from both preds and target; with top_k > 1 a
+            # class can appear in top-k preds without being a "present" class, so
+            # the absence test drops the fp term (reference: utilities/compute.py:73)
+            absent = (tp + fp + fn == 0) if top_k == 1 else (tp + fn == 0)
+            weights = jnp.where(absent, 0.0, weights)
     return _safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)).sum(-1)
 
 
@@ -66,7 +70,7 @@ def _auc_compute(x: Array, y: Array, direction: Optional[float] = None, reorder:
     sorts by x (static-shape argsort).
     """
     if reorder:
-        order = jnp.argsort(x, kind="stable")
+        order = jnp.argsort(x, stable=True)
         x, y = x[order], y[order]
     dx = jnp.diff(x)
     if direction is None:
