@@ -1,0 +1,127 @@
+"""MetricCollection tests incl. compute groups (reference: tests/unittests/bases/test_collections.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn import metrics as skm
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassConfusionMatrix,
+    MulticlassCohenKappa,
+)
+
+C = 5
+rng = np.random.default_rng(3)
+PROBS = [rng.random((32, C)).astype(np.float32) for _ in range(4)]
+PROBS = [p / p.sum(1, keepdims=True) for p in PROBS]
+TARGET = [rng.integers(0, C, 32) for _ in range(4)]
+ALL_P = np.concatenate(PROBS)
+ALL_T = np.concatenate(TARGET)
+
+
+def _mk_collection(**kwargs):
+    return MetricCollection([
+        MulticlassAccuracy(num_classes=C, average="micro"),
+        MulticlassPrecision(num_classes=C, average="macro"),
+        MulticlassRecall(num_classes=C, average="macro"),
+        MulticlassF1Score(num_classes=C, average="macro"),
+    ], **kwargs)
+
+
+def test_collection_results_match_sklearn():
+    mc = _mk_collection()
+    for p, t in zip(PROBS, TARGET):
+        mc.update(jnp.asarray(p), jnp.asarray(t))
+    res = mc.compute()
+    pred_lbl = ALL_P.argmax(1)
+    np.testing.assert_allclose(float(res["MulticlassAccuracy"]), skm.accuracy_score(ALL_T, pred_lbl), atol=1e-5)
+    np.testing.assert_allclose(float(res["MulticlassPrecision"]), skm.precision_score(ALL_T, pred_lbl, average="macro"), atol=1e-5)
+    np.testing.assert_allclose(float(res["MulticlassF1Score"]), skm.f1_score(ALL_T, pred_lbl, average="macro"), atol=1e-5)
+
+
+def test_compute_groups_merge():
+    mc = _mk_collection()
+    for p, t in zip(PROBS, TARGET):
+        mc.update(jnp.asarray(p), jnp.asarray(t))
+    # all four share tp/fp/tn/fn states -> one group
+    assert len(mc.compute_groups) == 1, mc.compute_groups
+    # heterogenous states -> separate group
+    mc2 = MetricCollection([
+        MulticlassAccuracy(num_classes=C, average="micro"),
+        MulticlassConfusionMatrix(num_classes=C),
+    ])
+    for p, t in zip(PROBS, TARGET):
+        mc2.update(jnp.asarray(p), jnp.asarray(t))
+    assert len(mc2.compute_groups) == 2
+
+
+def test_compute_groups_correctness():
+    """Grouped and ungrouped collections must agree."""
+    grouped = _mk_collection(compute_groups=True)
+    ungrouped = _mk_collection(compute_groups=False)
+    for p, t in zip(PROBS, TARGET):
+        grouped.update(jnp.asarray(p), jnp.asarray(t))
+        ungrouped.update(jnp.asarray(p), jnp.asarray(t))
+    rg, ru = grouped.compute(), ungrouped.compute()
+    for k in rg:
+        np.testing.assert_allclose(np.asarray(rg[k]), np.asarray(ru[k]), atol=1e-6)
+
+
+def test_prefix_postfix():
+    mc = _mk_collection(prefix="val_", postfix="_epoch")
+    mc.update(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    res = mc.compute()
+    assert all(k.startswith("val_") and k.endswith("_epoch") for k in res)
+
+
+def test_dict_input():
+    mc = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes=C, average="micro"),
+        "kappa": MulticlassCohenKappa(num_classes=C),
+    })
+    mc.update(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    res = mc.compute()
+    assert set(res.keys()) == {"acc", "kappa"}
+
+
+def test_forward_returns_batch_values():
+    mc = _mk_collection()
+    out = mc(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    expected = skm.accuracy_score(TARGET[0], PROBS[0].argmax(1))
+    np.testing.assert_allclose(float(out["MulticlassAccuracy"]), expected, atol=1e-5)
+
+
+def test_reset():
+    mc = _mk_collection()
+    mc.update(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    mc.reset()
+    assert not next(iter(mc.values())).update_called
+
+
+def test_clone_with_prefix():
+    mc = _mk_collection()
+    mc2 = mc.clone(prefix="train_")
+    mc2.update(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    assert all(k.startswith("train_") for k in mc2.compute())
+
+
+def test_duplicate_names_raises():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([MulticlassAccuracy(num_classes=C), MulticlassAccuracy(num_classes=C)])
+
+
+def test_invalid_input_raises():
+    with pytest.raises(ValueError):
+        MetricCollection([1, 2, 3])
+
+
+def test_nested_collection():
+    inner = MetricCollection([MulticlassAccuracy(num_classes=C, average="micro")])
+    outer = MetricCollection([inner, MulticlassCohenKappa(num_classes=C)])
+    outer.update(jnp.asarray(PROBS[0]), jnp.asarray(TARGET[0]))
+    assert len(outer.compute()) == 2

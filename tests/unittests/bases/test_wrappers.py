@@ -1,0 +1,124 @@
+"""Wrapper tests (reference: tests/unittests/wrappers/)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn import metrics as skm
+
+from torchmetrics_tpu import MeanSquaredError, MetricCollection, MeanMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.wrappers import (
+    BinaryTargetTransformer,
+    BootStrapper,
+    ClasswiseWrapper,
+    LambdaInputTransformer,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+C = 3
+rng = np.random.default_rng(5)
+
+
+def test_classwise_wrapper():
+    m = ClasswiseWrapper(MulticlassPrecision(num_classes=C, average="none"), labels=["a", "b", "c"])
+    p = rng.integers(0, C, 64)
+    t = rng.integers(0, C, 64)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    res = m.compute()
+    assert set(res.keys()) == {"multiclassprecision_a", "multiclassprecision_b", "multiclassprecision_c"}
+    expected = skm.precision_score(t, p, average=None, labels=range(C))
+    np.testing.assert_allclose([float(res[f"multiclassprecision_{k}"]) for k in "abc"], expected, atol=1e-5)
+
+
+def test_minmax():
+    m = MinMaxMetric(MeanMetric())
+    m.update(jnp.asarray([1.0]))
+    r1 = m.compute()
+    m.update(jnp.asarray([9.0]))
+    r2 = m.compute()
+    m.update(jnp.asarray([2.0]))
+    r3 = m.compute()
+    assert float(r3["max"]) == float(r2["raw"])
+    assert float(r3["min"]) == 1.0
+
+
+def test_multioutput():
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    p = rng.normal(size=(32, 2)).astype(np.float32)
+    t = rng.normal(size=(32, 2)).astype(np.float32)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    res = np.asarray(m.compute())
+    expected = [skm.mean_squared_error(t[:, i], p[:, i]) for i in range(2)]
+    np.testing.assert_allclose(res, expected, rtol=1e-5)
+
+
+def test_multitask():
+    mt = MultitaskWrapper({
+        "cls": BinaryAccuracy(),
+        "reg": MeanSquaredError(),
+    })
+    preds = {"cls": jnp.asarray([1, 0, 1]), "reg": jnp.asarray([1.0, 2.0, 3.0])}
+    target = {"cls": jnp.asarray([1, 1, 1]), "reg": jnp.asarray([1.0, 2.0, 2.0])}
+    mt.update(preds, target)
+    res = mt.compute()
+    np.testing.assert_allclose(float(res["cls"]), 2 / 3, rtol=1e-5)
+    np.testing.assert_allclose(float(res["reg"]), 1 / 3, rtol=1e-5)
+    with pytest.raises(ValueError, match="same keys"):
+        mt.update({"cls": preds["cls"]}, target)
+
+
+def test_running():
+    m = Running(MeanSquaredError(), window=2)
+    vals = [(1.0, 0.0), (2.0, 0.0), (3.0, 0.0)]
+    for p, t in vals:
+        m.update(jnp.asarray([p]), jnp.asarray([t]))
+    # window = last two: mse over [2, 3] vs 0 -> (4+9)/2
+    np.testing.assert_allclose(float(m.compute()), 6.5, rtol=1e-6)
+
+
+def test_tracker():
+    tracker = MetricTracker(MulticlassAccuracy(num_classes=C, average="micro"), maximize=True)
+    accs = []
+    for step in range(3):
+        tracker.increment()
+        p = rng.integers(0, C, 64)
+        t = p.copy()
+        flip = rng.random(64) < (0.5 - 0.2 * step)  # improving accuracy
+        t[flip] = (t[flip] + 1) % C
+        tracker.update(jnp.asarray(p), jnp.asarray(t))
+        accs.append(float(tracker.compute()))
+    assert tracker.n_steps == 3
+    all_res = np.asarray(tracker.compute_all())
+    np.testing.assert_allclose(all_res, accs, atol=1e-6)
+    best, step = tracker.best_metric(return_step=True)
+    assert step == int(np.argmax(accs))
+    with pytest.raises(ValueError, match="increment"):
+        MetricTracker(MulticlassAccuracy(num_classes=C)).update(jnp.asarray([0]), jnp.asarray([0]))
+
+
+def test_bootstrapper():
+    m = BootStrapper(MeanSquaredError(), num_bootstraps=20, seed=42, quantile=0.5, raw=True)
+    p = rng.normal(size=128).astype(np.float32)
+    t = p + 0.1 * rng.normal(size=128).astype(np.float32)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    res = m.compute()
+    true_mse = skm.mean_squared_error(t, p)
+    assert abs(float(res["mean"]) - true_mse) < 0.01
+    assert float(res["std"]) > 0
+    assert res["raw"].shape == (20,)
+
+
+def test_lambda_transformer():
+    m = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)
+    m.update(jnp.asarray([0.1, 0.9]), jnp.asarray([1, 0]))
+    np.testing.assert_allclose(float(m.compute()), 1.0)
+
+
+def test_binary_target_transformer():
+    m = BinaryTargetTransformer(BinaryAccuracy(), threshold=0.5)
+    m.update(jnp.asarray([1.0, 0.0]), jnp.asarray([0.9, 0.1]))  # continuous targets
+    np.testing.assert_allclose(float(m.compute()), 1.0)

@@ -1,0 +1,179 @@
+"""Regression metric tests vs sklearn/scipy."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from scipy import stats
+from sklearn import metrics as skm
+
+from tests.helpers.testers import run_class_metric_test
+
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from torchmetrics_tpu.functional.regression import (
+    kendall_rank_corrcoef,
+    pearson_corrcoef,
+    spearman_corrcoef,
+)
+
+N_BATCHES, BATCH = 4, 32
+rng = np.random.default_rng(11)
+PREDS = rng.normal(size=(N_BATCHES, BATCH)).astype(np.float32)
+TARGET = (PREDS + 0.5 * rng.normal(size=(N_BATCHES, BATCH))).astype(np.float32)
+POS_PREDS = np.abs(PREDS) + 0.1
+POS_TARGET = np.abs(TARGET) + 0.1
+
+
+@pytest.mark.parametrize("factory,ref,preds,target", [
+    (lambda: MeanSquaredError(), lambda p, t: skm.mean_squared_error(t, p), PREDS, TARGET),
+    (lambda: MeanSquaredError(squared=False), lambda p, t: np.sqrt(skm.mean_squared_error(t, p)), PREDS, TARGET),
+    (lambda: MeanAbsoluteError(), lambda p, t: skm.mean_absolute_error(t, p), PREDS, TARGET),
+    (lambda: MeanAbsolutePercentageError(), lambda p, t: skm.mean_absolute_percentage_error(t, p), POS_PREDS, POS_TARGET),
+    (lambda: MeanSquaredLogError(), lambda p, t: skm.mean_squared_log_error(t, p), POS_PREDS, POS_TARGET),
+    (lambda: R2Score(), lambda p, t: skm.r2_score(t, p), PREDS, TARGET),
+    (lambda: ExplainedVariance(), lambda p, t: skm.explained_variance_score(t, p), PREDS, TARGET),
+    (lambda: TweedieDevianceScore(power=0.0), lambda p, t: skm.mean_tweedie_deviance(t, p, power=0), PREDS, TARGET),
+    (lambda: TweedieDevianceScore(power=1.0), lambda p, t: skm.mean_tweedie_deviance(t, p, power=1), POS_PREDS, POS_TARGET),
+    (lambda: TweedieDevianceScore(power=2.0), lambda p, t: skm.mean_tweedie_deviance(t, p, power=2), POS_PREDS, POS_TARGET),
+    (lambda: PearsonCorrCoef(), lambda p, t: stats.pearsonr(t, p)[0], PREDS, TARGET),
+    (lambda: SpearmanCorrCoef(), lambda p, t: stats.spearmanr(t, p)[0], PREDS, TARGET),
+    (lambda: KendallRankCorrCoef(), lambda p, t: stats.kendalltau(t, p)[0], PREDS, TARGET),
+])
+def test_regression_vs_reference(factory, ref, preds, target):
+    run_class_metric_test(factory, preds, target, ref, atol=1e-4)
+
+
+def test_symmetric_mape():
+    p, t = POS_PREDS.reshape(-1), POS_TARGET.reshape(-1)
+    m = SymmetricMeanAbsolutePercentageError()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_weighted_mape():
+    p, t = POS_PREDS.reshape(-1), POS_TARGET.reshape(-1)
+    m = WeightedMeanAbsolutePercentageError()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_log_cosh():
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    m = LogCoshError()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.mean(np.log(np.cosh(p - t)))
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_minkowski():
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    m = MinkowskiDistance(p=3)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.sum(np.abs(p - t) ** 3) ** (1 / 3)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_rse():
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    m = RelativeSquaredError()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_concordance():
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    m = ConcordanceCorrCoef()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    sx, sy = p.var(), t.var()
+    ccc = 2 * np.cov(p, t, bias=True)[0, 1] / (sx + sy + (p.mean() - t.mean()) ** 2)
+    np.testing.assert_allclose(float(m.compute()), ccc, rtol=1e-4)
+
+
+def test_kl_divergence():
+    p = np.abs(rng.normal(size=(16, 8))).astype(np.float32)
+    q = np.abs(rng.normal(size=(16, 8))).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    q /= q.sum(1, keepdims=True)
+    m = KLDivergence()
+    m.update(jnp.asarray(p), jnp.asarray(q))
+    expected = np.mean([stats.entropy(q[i], p[i]) for i in range(16)])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_cosine_similarity():
+    p = rng.normal(size=(16, 8)).astype(np.float32)
+    t = rng.normal(size=(16, 8)).astype(np.float32)
+    m = CosineSimilarity(reduction="mean")
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.mean([np.dot(p[i], t[i]) / (np.linalg.norm(p[i]) * np.linalg.norm(t[i])) for i in range(16)])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
+
+
+def test_csi():
+    p = rng.random((64,)).astype(np.float32)
+    t = rng.random((64,)).astype(np.float32)
+    m = CriticalSuccessIndex(threshold=0.5)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    hits = ((p >= 0.5) & (t >= 0.5)).sum()
+    misses = ((p < 0.5) & (t >= 0.5)).sum()
+    fa = ((p >= 0.5) & (t < 0.5)).sum()
+    np.testing.assert_allclose(float(m.compute()), hits / (hits + misses + fa), rtol=1e-5)
+
+
+def test_pearson_merge_and_sync(mesh):
+    """Pearson's custom Welford merge must be exact, incl. in-graph sync."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    p, t = PREDS.reshape(-1), TARGET.reshape(-1)
+    m = PearsonCorrCoef()
+    # merge two halves
+    s1 = m.update_state(m.init_state(), jnp.asarray(p[:64]), jnp.asarray(t[:64]))
+    s2 = m.update_state(m.init_state(), jnp.asarray(p[64:]), jnp.asarray(t[64:]))
+    merged = m.merge_states(s1, s2)
+    np.testing.assert_allclose(float(m.compute_state(merged)), stats.pearsonr(t, p)[0], rtol=1e-4)
+
+    def step(ps, ts):
+        st = m.update_state(m.init_state(), ps, ts)
+        return m.sync_states(st, "data")
+
+    st = jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)(
+        jnp.asarray(p), jnp.asarray(t)
+    )
+    np.testing.assert_allclose(float(m.compute_state(st)), stats.pearsonr(t, p)[0], rtol=1e-4)
+
+
+def test_spearman_ties():
+    p = np.round(rng.random(100), 1).astype(np.float32)
+    t = np.round(rng.random(100), 1).astype(np.float32)
+    res = spearman_corrcoef(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(res), stats.spearmanr(t, p)[0], rtol=1e-4)
+
+
+def test_kendall_ties():
+    p = np.round(rng.random(50), 1).astype(np.float32)
+    t = np.round(rng.random(50), 1).astype(np.float32)
+    res = kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(res), stats.kendalltau(t, p)[0], rtol=1e-4)

@@ -1,0 +1,283 @@
+"""MetricCollection with compute groups.
+
+Reference: collections.py:34-673.  The flagship optimization — compute groups
+(:238-317) — merges metrics whose states are identical after the first update
+so only the group leader runs ``update``.  In the TPU design this is *safer*
+than the reference: states are immutable ``jax.Array`` pytrees, so sharing is
+literal reference assignment with no copy-on-read dance (the reference must
+break references in ``items()``/``values()`` to guard user mutation,
+collections.py:524-547 — here nothing can be mutated).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
+
+
+class MetricCollection(dict):
+    """Dict-like container of metrics sharing one ``update``/``compute`` call."""
+
+    _groups: Dict[int, List[str]]
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        super().__init__()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._state_is_copy = False
+        self._groups = {}
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    # ------------------------------------------------------------- population
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `torchmetrics_tpu.Metric` or `torchmetrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[k] = v
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected, `Metric`, `MetricCollection` or `dict`/`sequence` of the"
+                f" previous, but got {metrics}"
+            )
+        self._groups_checked = False
+
+    # ------------------------------------------------------------ group logic
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """True if the two metrics hold identical state (reference: collections.py:274-297)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            s1, s2 = metric1._state[key], metric2._state[key]
+            if isinstance(s1, tuple) and isinstance(s2, tuple):
+                if len(s1) != len(s2):
+                    return False
+                if not all(a.shape == b.shape and allclose(a, b) for a, b in zip(s1, s2)):
+                    return False
+            elif isinstance(s1, tuple) or isinstance(s2, tuple):
+                return False
+            else:
+                if s1.shape != s2.shape or not allclose(s1, s2):
+                    return False
+        return True
+
+    def _merge_compute_groups(self) -> None:
+        """O(n²) state-equality scan after the first update (reference: collections.py:238-272)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self[cg_members1[0]]
+                    metric2 = self[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    def _init_groups(self) -> None:
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            self._groups_checked = True
+        elif self._enable_compute_groups:
+            self._groups = {i: [name] for i, name in enumerate(self.keys(keep_base=True))}
+        else:
+            self._groups = {i: [name] for i, name in enumerate(self.keys(keep_base=True))}
+            self._groups_checked = True
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    # ------------------------------------------------------------- lifecycle
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if not self._groups:
+            self._init_groups()
+        if self._groups_checked:
+            # steady state: update leaders, share state with members
+            for members in self._groups.values():
+                leader = self[members[0]]
+                leader.update(*args, **leader._filter_kwargs(**kwargs))
+                for name in members[1:]:
+                    member = self[name]
+                    member._state = leader._state
+                    member._computed = None
+        else:
+            for m in self.values(copy_state=False):
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
+                self._merge_compute_groups()
+            self._groups_checked = True
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        res = {}
+        for k, m in self.items(keep_base=True, copy_state=False):
+            res[k] = m(*args, **m._filter_kwargs(**kwargs))
+        # forward bypasses group sharing; re-sync group state next update
+        self._groups_checked = False
+        return self._to_renamed_dict(res)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        return self._to_renamed_dict(res)
+
+    def reset(self) -> None:
+        for m in self.values(copy_state=False):
+            m.reset()
+
+    def _to_renamed_dict(self, res: Dict[str, Any]) -> Dict[str, Any]:
+        res, _ = _flatten_dict(res)
+        out = {}
+        for k, v in res.items():
+            name = k
+            if self.prefix:
+                name = self.prefix + name
+            if self.postfix:
+                name = name + self.postfix
+            out[name] = v
+        return out
+
+    # -------------------------------------------------------------- dict api
+    def keys(self, keep_base: bool = False):  # type: ignore[override]
+        if keep_base:
+            return super().keys()
+        return [self._set_name(k) for k in super().keys()]
+
+    def values(self, copy_state: bool = True):  # type: ignore[override]
+        # states are immutable jax arrays: no defensive copy needed (see module docstring)
+        return super().values()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True):  # type: ignore[override]
+        if keep_base:
+            return super().items()
+        return [(self._set_name(k), v) for k, v in super().items()]
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(f"'{self.__class__.__name__}' object has no attribute '{name}'")
+
+    def __iter__(self):
+        return iter(self.keys(keep_base=True))
+
+    # ------------------------------------------------------------------ misc
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self.values(copy_state=False):
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out = {}
+        for k, m in self.items(keep_base=True):
+            out[k] = m.state_dict()
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for k, m in self.items(keep_base=True):
+            if k in state_dict:
+                m.load_state_dict(state_dict[k])
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False):
+        from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        if together:
+            return plot_single_or_multi_val(val, ax=ax)
+        return [plot_single_or_multi_val({k: v}) for k, v in val.items()]
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        for k, v in self.items(keep_base=True):
+            repr_str += f"\n  ({k}): {v!r}"
+        return repr_str + "\n)"

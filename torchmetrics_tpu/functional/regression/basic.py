@@ -1,0 +1,235 @@
+"""Elementwise-error regression kernels.
+
+Reference: functional/regression/{mse,mae,mape,symmetric_mape,weighted_mape,
+msle,log_cosh,minkowski,tweedie_deviance,csi,kl_divergence,cosine_similarity}.py.
+All are (sum-of-errors, count) sufficient-statistic metrics — every update
+function returns the pair so the stateful classes just add, and the one-shot
+functional wrappers divide.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import _safe_divide, _safe_xlogy
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+# ------------------------------------------------------------------ MSE / MAE / MSLE
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds, target = preds.reshape(-1), target.reshape(-1)
+        n = preds.shape[0]
+    else:
+        preds, target = preds.reshape(-1, num_outputs), target.reshape(-1, num_outputs)
+        n = preds.shape[0]
+    return jnp.sum((preds - target) ** 2, axis=0), jnp.asarray(n, jnp.float32)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    sse, n = _mean_squared_error_update(preds, target, num_outputs)
+    mse = sse / n
+    return mse if squared else jnp.sqrt(mse)
+
+
+def _mean_absolute_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds, target = preds.reshape(-1), target.reshape(-1)
+    else:
+        preds, target = preds.reshape(-1, num_outputs), target.reshape(-1, num_outputs)
+    return jnp.sum(jnp.abs(preds - target), axis=0), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def mean_absolute_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    sae, n = _mean_absolute_error_update(preds, target, num_outputs)
+    return sae / n
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    return jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    s, n = _mean_squared_log_error_update(preds, target)
+    return s / n
+
+
+# ------------------------------------------------------------------ percentage errors
+_EPS = 1.17e-6
+
+
+def _mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    ape = jnp.abs(preds - target) / jnp.maximum(jnp.abs(target), _EPS)
+    return jnp.sum(ape), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    s, n = _mean_absolute_percentage_error_update(preds, target)
+    return s / n
+
+
+def _symmetric_mape_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    sape = 2.0 * jnp.abs(preds - target) / jnp.maximum(jnp.abs(target) + jnp.abs(preds), _EPS)
+    return jnp.sum(sape), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    s, n = _symmetric_mape_update(preds, target)
+    return s / n
+
+
+def _weighted_mape_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    num, denom = _weighted_mape_update(preds, target)
+    return num / jnp.maximum(denom, _EPS)
+
+
+# ------------------------------------------------------------------ log-cosh / minkowski
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    preds = preds.reshape(-1) if num_outputs == 1 else preds.reshape(-1, num_outputs)
+    target = target.reshape(-1) if num_outputs == 1 else target.reshape(-1, num_outputs)
+    diff = preds - target
+    # numerically stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    val = diff + jax.nn.softplus(-2.0 * diff) - jnp.log(2.0)
+    return jnp.sum(val, axis=0), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def log_cosh_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return s / n
+
+
+def _minkowski_distance_update(preds: Array, target: Array, p: float) -> Array:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target) ** p)
+
+
+def minkowski_distance(preds: Array, target: Array, p: float) -> Array:
+    if not (isinstance(p, (int, float)) and p >= 1):
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+        raise TorchMetricsUserError(f"Argument ``p`` should be a float or int greater than 1, but got {p}")
+    return _minkowski_distance_update(preds, target, p) ** (1.0 / p)
+
+
+# ------------------------------------------------------------------ tweedie
+def _tweedie_deviance_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    if power < 0:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    if power == 0:
+        dev = (preds - target) ** 2
+    elif power == 1:
+        dev = 2 * (_safe_xlogy(target, target / preds) - target + preds)
+    elif power == 2:
+        dev = 2 * (jnp.log(preds / target) + target / preds - 1)
+    elif 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    else:
+        t1 = jnp.maximum(target, 0.0) ** (2 - power) / ((1 - power) * (2 - power))
+        t2 = target * preds ** (1 - power) / (1 - power)
+        t3 = preds ** (2 - power) / (2 - power)
+        dev = 2 * (t1 - t2 + t3)
+    return jnp.sum(dev), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    s, n = _tweedie_deviance_update(preds, target, power)
+    return s / n
+
+
+# ------------------------------------------------------------------ CSI
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    p = preds >= threshold
+    t = target >= threshold
+    if keep_sequence_dim is None:
+        axes = None
+    else:
+        axes = tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
+    hits = jnp.sum(p & t, axis=axes).astype(jnp.float32)
+    misses = jnp.sum(~p & t, axis=axes).astype(jnp.float32)
+    false_alarms = jnp.sum(p & ~t, axis=axes).astype(jnp.float32)
+    return hits, misses, false_alarms
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Array:
+    hits, misses, fa = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _safe_divide(hits, hits + misses + fa)
+
+
+# ------------------------------------------------------------------ KL divergence
+def _kl_divergence_update(preds: Array, target: Array, log_prob: bool = False) -> Tuple[Array, Array]:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    if preds.ndim != 2 or target.ndim != 2:
+        raise ValueError(f"Expected both predictions and target to be 2D but got {preds.ndim} and {target.ndim} respectively")
+    if log_prob:
+        measures = jnp.sum(jnp.exp(target) * (target - preds), axis=-1)
+    else:
+        p = preds / jnp.sum(preds, axis=-1, keepdims=True)
+        t = target / jnp.sum(target, axis=-1, keepdims=True)
+        measures = jnp.sum(_safe_xlogy(t, t / jnp.maximum(p, 1e-24)), axis=-1)
+    return jnp.sum(measures), jnp.asarray(preds.shape[0], jnp.float32)
+
+
+def kl_divergence(preds: Array, target: Array, log_prob: bool = False, reduction: str = "mean") -> Array:
+    s, n = _kl_divergence_update(preds, target, log_prob)
+    if reduction == "mean":
+        return s / n
+    if reduction == "sum":
+        return s
+    raise ValueError(f"Expected argument `reduction` to be one of ('mean', 'sum'), got {reduction}")
+
+
+# ------------------------------------------------------------------ cosine similarity
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: str = "sum") -> Array:
+    dot = jnp.sum(preds * target, axis=-1)
+    denom = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = _safe_divide(dot, denom)
+    if reduction == "mean":
+        return jnp.mean(sim)
+    if reduction == "sum":
+        return jnp.sum(sim)
+    if reduction in ("none", None):
+        return sim
+    raise ValueError(f"Expected reduction to be one of ('mean', 'sum', 'none', None), got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: str = "sum") -> Array:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

@@ -1,0 +1,171 @@
+"""Correlation kernels: Pearson, Spearman, Kendall, Concordance.
+
+Reference: functional/regression/{pearson,spearman,kendall,concordance}.py.
+Pearson keeps Welford-style parallel-mergeable moments
+(reference pearson.py:73: mean_x, mean_y, var_x, var_y, corr_xy, n_total);
+`_final_aggregation` below is the parallel combine used by both local merge
+and cross-device sync.  Kendall is O(n²) pairwise — fine on the MXU for the
+sizes the reference supports (it cat-gathers full data anyway).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.basic import _check_same_shape
+
+
+def _pearson_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Welford-style streaming update of correlation moments."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim == 1:
+        preds, target = preds[:, None], target[:, None]
+    n = preds.shape[0]
+    num_obs = num_prior + n
+    bm_x = jnp.mean(preds, axis=0)
+    bm_y = jnp.mean(target, axis=0)
+    mx_new = (num_prior * mean_x + n * bm_x) / num_obs
+    my_new = (num_prior * mean_y + n * bm_y) / num_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x), axis=0)
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y), axis=0)
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y), axis=0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_obs
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Combine per-device/per-shard moment blocks (stacked along axis 0).
+
+    Statically-unrolled pairwise Welford merge — the number of blocks is the
+    (static) world size, so this jits cleanly.
+    """
+    if means_x.ndim == 1:
+        return means_x, means_y, vars_x, vars_y, corrs_xy, nbs
+    mx, my, vx, vy, cxy, n = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nt = n + n2
+        safe_nt = jnp.maximum(nt, 1.0)
+        mean_x = (n * mx + n2 * mx2) / safe_nt
+        mean_y = (n * my + n2 * my2) / safe_nt
+        # element_x1 terms from reference pearson.py:_final_aggregation
+        vx = vx + vx2 + n * (mx - mean_x) ** 2 + n2 * (mx2 - mean_x) ** 2
+        vy = vy + vy2 + n * (my - mean_y) ** 2 + n2 * (my2 - mean_y) ** 2
+        cxy = cxy + cxy2 + n * (mx - mean_x) * (my - mean_y) + n2 * (mx2 - mean_x) * (my2 - mean_y)
+        mx, my, n = mean_x, mean_y, nt
+    return mx, my, vx, vy, cxy, n
+
+
+def _pearson_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    denom = jnp.sqrt(var_x) * jnp.sqrt(var_y)
+    corr = corr_xy / jnp.where(denom == 0, 1.0, denom)
+    corr = jnp.where(denom == 0, 0.0, corr)
+    return jnp.clip(corr, -1.0, 1.0).squeeze()
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    d = 1 if preds.ndim == 1 else preds.shape[-1]
+    z = jnp.zeros(d)
+    mx, my, vx, vy, cxy, n = _pearson_update(preds, target, z, z, z, z, z, jnp.zeros(()))
+    return _pearson_compute(vx, vy, cxy, n)
+
+
+def _rank_data_average(x: Array) -> Array:
+    """Fractional (average-tie) ranks, 1-based — matches scipy.stats.rankdata."""
+    n = x.shape[0]
+    order = jnp.argsort(x, stable=True)
+    xs = x[order]
+    ordinal = jnp.arange(1, n + 1, dtype=jnp.float32)
+    # for ties: average ordinal rank within each equal-value group
+    same_as_prev = jnp.concatenate([jnp.array([False]), xs[1:] == xs[:-1]])
+    group_start = jnp.where(~same_as_prev, ordinal, 0.0)
+    group_start = jax.lax.associative_scan(jnp.maximum, group_start)  # start ordinal per group
+    same_as_next = jnp.concatenate([xs[:-1] == xs[1:], jnp.array([False])])
+    group_end = jnp.where(~same_as_next, ordinal, jnp.inf)
+    group_end = jax.lax.associative_scan(jnp.minimum, group_end[::-1])[::-1]
+    avg_rank = (group_start + group_end) / 2.0
+    ranks = jnp.zeros(n, dtype=jnp.float32).at[order].set(avg_rank)
+    return ranks
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman = Pearson on average-tie ranks (reference: functional/regression/spearman.py)."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim == 1:
+        rp, rt = _rank_data_average(preds), _rank_data_average(target)
+        return pearson_corrcoef(rp, rt)
+    outs = [pearson_corrcoef(_rank_data_average(preds[:, i]), _rank_data_average(target[:, i]))
+            for i in range(preds.shape[1])]
+    return jnp.stack(outs)
+
+
+def kendall_rank_corrcoef(
+    preds: Array, target: Array, variant: str = "b", t_test: bool = False, alternative: str = "two-sided"
+) -> Array:
+    """Kendall's tau via O(n²) pairwise signs (tau-a / tau-b / tau-c).
+
+    Reference: functional/regression/kendall.py.
+    """
+    preds, target = jnp.asarray(preds, jnp.float32).reshape(-1), jnp.asarray(target, jnp.float32).reshape(-1)
+    _check_same_shape(preds, target)
+    n = preds.shape[0]
+    dx = preds[:, None] - preds[None, :]
+    dy = target[:, None] - target[None, :]
+    sign_prod = jnp.sign(dx) * jnp.sign(dy)
+    iu = jnp.triu_indices(n, k=1)
+    s = sign_prod[iu]
+    concordant = jnp.sum(s > 0)
+    discordant = jnp.sum(s < 0)
+    n_pairs = n * (n - 1) / 2.0
+    if variant == "a":
+        return (concordant - discordant) / n_pairs
+    ties_x = jnp.sum((jnp.sign(dx) == 0)[iu] & (jnp.sign(dy) != 0)[iu])
+    ties_y = jnp.sum((jnp.sign(dy) == 0)[iu] & (jnp.sign(dx) != 0)[iu])
+    ties_both = jnp.sum((jnp.sign(dx) == 0)[iu] & (jnp.sign(dy) == 0)[iu])
+    if variant == "b":
+        tx = ties_x + ties_both
+        ty = ties_y + ties_both
+        denom = jnp.sqrt((n_pairs - tx) * (n_pairs - ty))
+        return (concordant - discordant) / jnp.maximum(denom, 1e-12)
+    if variant == "c":
+        n_distinct_x = jnp.sum(jnp.diff(jnp.sort(preds)) != 0) + 1
+        n_distinct_y = jnp.sum(jnp.diff(jnp.sort(target)) != 0) + 1
+        m = jnp.minimum(n_distinct_x, n_distinct_y).astype(jnp.float32)
+        return 2 * (concordant - discordant) / (n**2 * (m - 1) / m)
+    raise ValueError(f"Argument `variant` is expected to be one of ('a', 'b', 'c'), got {variant}")
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Lin's concordance correlation (reference: functional/regression/concordance.py)."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    if preds.ndim == 1:
+        preds, target = preds[:, None], target[:, None]
+    n = preds.shape[0]
+    mx, my = jnp.mean(preds, axis=0), jnp.mean(target, axis=0)
+    vx = jnp.var(preds, axis=0)
+    vy = jnp.var(target, axis=0)
+    cxy = jnp.mean((preds - mx) * (target - my), axis=0)
+    ccc = 2 * cxy / (vx + vy + (mx - my) ** 2)
+    return ccc.squeeze()

@@ -1,0 +1,116 @@
+"""Variance-explained regression kernels: R², explained variance, RSE.
+
+Reference: functional/regression/{r2,explained_variance,rse}.py.  All keep
+sum-reducible sufficient statistics (Σt, Σt², Σ(p−t)², n) so state merge and
+cross-device psum are exact.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.regression.basic import _check_same_shape
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+    """Returns (sum_squared_error, sum_target, sum_squared_target... ) wait: (Σ(p−t)², Σt, Σt², n)."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim == 1:
+        preds, target = preds[:, None], target[:, None]
+    sum_error = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target**2, axis=0)
+    residual = jnp.sum((target - preds) ** 2, axis=0)
+    n = jnp.asarray(target.shape[0], jnp.float32)
+    return residual, sum_error, sum_squared_target, n
+
+
+def _r2_score_compute(
+    sum_squared_residual: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    n_obs: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    mean_target = sum_target / n_obs
+    ss_tot = sum_squared_target - sum_target * mean_target
+    raw = 1.0 - sum_squared_residual / jnp.where(ss_tot == 0, 1.0, ss_tot)
+    raw = jnp.where(ss_tot == 0, 0.0, raw)
+    if multioutput == "raw_values":
+        r2 = raw if raw.shape[0] > 1 else raw[0]
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw)
+    elif multioutput == "variance_weighted":
+        r2 = jnp.sum(ss_tot / jnp.sum(ss_tot) * raw)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+            f" Received {multioutput}."
+        )
+    if adjusted:
+        if not isinstance(adjusted, int) or adjusted < 0:
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        r2 = 1.0 - (1.0 - r2) * (n_obs - 1) / (n_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average"
+) -> Array:
+    return _r2_score_compute(*_r2_score_update(preds, target), adjusted, multioutput)
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[Array, ...]:
+    """(n, Σerr, Σerr², Σt, Σt²) with err = t − p."""
+    preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim == 1:
+        preds, target = preds[:, None], target[:, None]
+    diff = target - preds
+    return (
+        jnp.asarray(target.shape[0], jnp.float32),
+        jnp.sum(diff, axis=0),
+        jnp.sum(diff**2, axis=0),
+        jnp.sum(target, axis=0),
+        jnp.sum(target**2, axis=0),
+    )
+
+
+def _explained_variance_compute(
+    n: Array, sum_error: Array, sum_squared_error: Array, sum_target: Array, sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    diff_avg = sum_error / n
+    numerator = sum_squared_error / n - diff_avg**2
+    target_avg = sum_target / n
+    denominator = sum_squared_target / n - target_avg**2
+    raw = 1.0 - numerator / jnp.where(denominator == 0, 1.0, denominator)
+    raw = jnp.where(denominator == 0, jnp.where(numerator == 0, 1.0, 0.0), raw)
+    if multioutput == "raw_values":
+        return raw if raw.shape[0] > 1 else raw[0]
+    if multioutput == "uniform_average":
+        return jnp.mean(raw)
+    if multioutput == "variance_weighted":
+        return jnp.sum(denominator / jnp.sum(denominator) * raw)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+        f" Received {multioutput}."
+    )
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    return _explained_variance_compute(*_explained_variance_update(preds, target), multioutput)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """RSE = Σ(t−p)² / Σ(t−t̄)² (reference: functional/regression/rse.py)."""
+    residual, sum_target, sum_squared_target, n = _r2_score_update(preds, target)
+    mean_target = sum_target / n
+    ss_tot = sum_squared_target - sum_target * mean_target
+    rse = jnp.sum(residual) / jnp.maximum(jnp.sum(ss_tot), 1e-24)
+    return rse if squared else jnp.sqrt(rse)

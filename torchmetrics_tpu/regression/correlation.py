@@ -1,0 +1,140 @@
+"""Correlation metric classes (reference: regression/{pearson,spearman,kendall,concordance}.py).
+
+PearsonCorrCoef keeps Welford-mergeable moment states and overrides
+``merge_states``/``sync_states`` with the parallel combine — the reference
+equivalently gathers per-rank moments and runs ``_final_aggregation``
+(reference pearson.py:73).  Spearman/Kendall cat-gather raw data (rank
+statistics are not sum-decomposable), as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State, _N
+from torchmetrics_tpu.functional.regression.correlation import (
+    _final_aggregation,
+    _pearson_compute,
+    _pearson_update,
+    _rank_data_average,
+    kendall_rank_corrcoef,
+    pearson_corrcoef,
+    spearman_corrcoef,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class PearsonCorrCoef(Metric):
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        d = jnp.zeros(num_outputs)
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy"):
+            self.add_state(name, d, dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros(()), dist_reduce_fx=None)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        mx, my, vx, vy, cxy, n = _pearson_update(
+            preds, target, state["mean_x"], state["mean_y"], state["var_x"],
+            state["var_y"], state["corr_xy"], state["n_total"],
+        )
+        return {"mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy, "corr_xy": cxy, "n_total": n}
+
+    def merge_states(self, a: State, b: State) -> State:
+        mx, my, vx, vy, cxy, n = _final_aggregation(
+            jnp.stack([a["mean_x"], b["mean_x"]]),
+            jnp.stack([a["mean_y"], b["mean_y"]]),
+            jnp.stack([a["var_x"], b["var_x"]]),
+            jnp.stack([a["var_y"], b["var_y"]]),
+            jnp.stack([a["corr_xy"], b["corr_xy"]]),
+            jnp.stack([a["n_total"], b["n_total"]]),
+        )
+        return {
+            "mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy,
+            "corr_xy": cxy, "n_total": n, _N: a[_N] + b[_N],
+        }
+
+    def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
+        axis_name = axis_name or self.axis_name
+        gathered = {k: jax.lax.all_gather(v, axis_name) for k, v in state.items() if k != _N}
+        mx, my, vx, vy, cxy, n = _final_aggregation(
+            gathered["mean_x"], gathered["mean_y"], gathered["var_x"],
+            gathered["var_y"], gathered["corr_xy"], gathered["n_total"],
+        )
+        return {
+            "mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy,
+            "corr_xy": cxy, "n_total": n, _N: jax.lax.psum(state[_N], axis_name),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _pearson_compute(state["var_x"], state["var_y"], state["corr_xy"], state["n_total"])
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Lin's CCC from the same moment states (reference: regression/concordance.py)."""
+
+    def _compute(self, state: State) -> Array:
+        n = jnp.maximum(state["n_total"], 1.0)
+        vx = state["var_x"] / n
+        vy = state["var_y"] / n
+        cxy = state["corr_xy"] / n
+        ccc = 2 * cxy / (vx + vy + (state["mean_x"] - state["mean_y"]) ** 2)
+        return ccc.squeeze()
+
+
+class _CatCorrBase(Metric):
+    """Base for metrics requiring the full data (rank statistics)."""
+
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        return {
+            "preds": tuple(state["preds"]) + (jnp.asarray(preds, jnp.float32),),
+            "target": tuple(state["target"]) + (jnp.asarray(target, jnp.float32),),
+        }
+
+
+class SpearmanCorrCoef(_CatCorrBase):
+    higher_is_better = None
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return spearman_corrcoef(dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]))
+
+
+class KendallRankCorrCoef(_CatCorrBase):
+    higher_is_better = None
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, variant: str = "b", t_test: bool = False,
+                 alternative: str = "two-sided", num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(num_outputs=num_outputs, **kwargs)
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative
+
+    def _compute(self, state: State) -> Array:
+        return kendall_rank_corrcoef(
+            dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]), self.variant
+        )

@@ -1,0 +1,89 @@
+"""KL divergence and cosine similarity metric classes (reference: regression/{kl_divergence,cosine_similarity}.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.regression.basic import (
+    _cosine_similarity_compute,
+    _kl_divergence_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class KLDivergence(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: str = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        allowed = ("mean", "sum", "none", None)
+        if reduction not in allowed:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed} but got {reduction}")
+        self.log_prob = log_prob
+        self.reduction = reduction
+        if reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, p: Array, q: Array) -> State:
+        s, n = _kl_divergence_update(p, q, self.log_prob)
+        if self.reduction in ("mean", "sum"):
+            return {"measures": state["measures"] + s, "total": state["total"] + n}
+        # for none: recompute per-sample measures
+        p = jnp.asarray(p, jnp.float32)
+        q = jnp.asarray(q, jnp.float32)
+        from torchmetrics_tpu.utilities.compute import _safe_xlogy
+
+        if self.log_prob:
+            m = jnp.sum(jnp.exp(q) * (q - p), axis=-1)
+        else:
+            pn = p / jnp.sum(p, axis=-1, keepdims=True)
+            qn = q / jnp.sum(q, axis=-1, keepdims=True)
+            m = jnp.sum(_safe_xlogy(qn, qn / jnp.maximum(pn, 1e-24)), axis=-1)
+        return {"measures": tuple(state["measures"]) + (m,), "total": state["total"] + n}
+
+    def _compute(self, state: State) -> Array:
+        if self.reduction == "mean":
+            return state["measures"] / jnp.maximum(state["total"], 1.0)
+        if self.reduction == "sum":
+            return state["measures"]
+        return dim_zero_cat(state["measures"])
+
+
+class CosineSimilarity(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed = ("sum", "mean", "none", None)
+        if reduction not in allowed:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        return {
+            "preds": tuple(state["preds"]) + (jnp.asarray(preds, jnp.float32),),
+            "target": tuple(state["target"]) + (jnp.asarray(target, jnp.float32),),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _cosine_similarity_compute(
+            dim_zero_cat(state["preds"]), dim_zero_cat(state["target"]), self.reduction
+        )

@@ -1,0 +1,183 @@
+"""Elementwise-error regression metric classes.
+
+Reference: regression/{mse,mae,mape,symmetric_mape,weighted_mape,msle,
+log_cosh,minkowski,tweedie_deviance,csi}.py (e.g. mse.py:28).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.regression.basic import (
+    _critical_success_index_update,
+    _log_cosh_error_update,
+    _mean_absolute_error_update,
+    _mean_absolute_percentage_error_update,
+    _mean_squared_error_update,
+    _mean_squared_log_error_update,
+    _minkowski_distance_update,
+    _symmetric_mape_update,
+    _tweedie_deviance_update,
+    _weighted_mape_update,
+    _EPS,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+
+class _SumCountMetric(Metric):
+    """Base for (Σerror, n) metrics."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        default = jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(())
+        self.add_state("measure", default, dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _compute(self, state: State) -> Array:
+        return state["measure"] / jnp.maximum(state["total"], 1.0)
+
+
+class MeanSquaredError(_SumCountMetric):
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(num_outputs=num_outputs, **kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        sse, n = _mean_squared_error_update(preds, target, self.num_outputs)
+        return {"measure": state["measure"] + sse, "total": state["total"] + n}
+
+    def _compute(self, state: State) -> Array:
+        mse = super()._compute(state)
+        return mse if self.squared else jnp.sqrt(mse)
+
+
+class MeanAbsoluteError(_SumCountMetric):
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(num_outputs=num_outputs, **kwargs)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        sae, n = _mean_absolute_error_update(preds, target, self.num_outputs)
+        return {"measure": state["measure"] + sae, "total": state["total"] + n}
+
+
+class MeanAbsolutePercentageError(_SumCountMetric):
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        s, n = _mean_absolute_percentage_error_update(preds, target)
+        return {"measure": state["measure"] + s, "total": state["total"] + n}
+
+
+class SymmetricMeanAbsolutePercentageError(_SumCountMetric):
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        s, n = _symmetric_mape_update(preds, target)
+        return {"measure": state["measure"] + s, "total": state["total"] + n}
+
+
+class WeightedMeanAbsolutePercentageError(_SumCountMetric):
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        num, denom = _weighted_mape_update(preds, target)
+        return {"measure": state["measure"] + num, "total": state["total"] + denom}
+
+    def _compute(self, state: State) -> Array:
+        return state["measure"] / jnp.maximum(state["total"], _EPS)
+
+
+class MeanSquaredLogError(_SumCountMetric):
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        s, n = _mean_squared_log_error_update(preds, target)
+        return {"measure": state["measure"] + s, "total": state["total"] + n}
+
+
+class LogCoshError(_SumCountMetric):
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(num_outputs=num_outputs, **kwargs)
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        s, n = _log_cosh_error_update(preds, target, self.num_outputs)
+        return {"measure": state["measure"] + s, "total": state["total"] + n}
+
+
+class MinkowskiDistance(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (int, float)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` should be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        return {"minkowski_dist_sum": state["minkowski_dist_sum"] + _minkowski_distance_update(preds, target, self.p)}
+
+    def _compute(self, state: State) -> Array:
+        return state["minkowski_dist_sum"] ** (1.0 / self.p)
+
+
+class TweedieDevianceScore(_SumCountMetric):
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        s, n = _tweedie_deviance_update(preds, target, self.power)
+        return {"measure": state["measure"] + s, "total": state["total"] + n}
+
+
+class CriticalSuccessIndex(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.threshold = threshold
+        self.keep_sequence_dim = keep_sequence_dim
+        if keep_sequence_dim is None:
+            self.add_state("hits", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("misses", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("false_alarms", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits", [], dist_reduce_fx="cat")
+            self.add_state("misses", [], dist_reduce_fx="cat")
+            self.add_state("false_alarms", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        hits, misses, fa = _critical_success_index_update(preds, target, self.threshold, self.keep_sequence_dim)
+        if self.keep_sequence_dim is None:
+            return {
+                "hits": state["hits"] + hits,
+                "misses": state["misses"] + misses,
+                "false_alarms": state["false_alarms"] + fa,
+            }
+        return {
+            "hits": tuple(state["hits"]) + (hits,),
+            "misses": tuple(state["misses"]) + (misses,),
+            "false_alarms": tuple(state["false_alarms"]) + (fa,),
+        }
+
+    def _compute(self, state: State) -> Array:
+        from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+        if self.keep_sequence_dim is None:
+            hits, misses, fa = state["hits"], state["misses"], state["false_alarms"]
+        else:
+            hits = dim_zero_cat(state["hits"])
+            misses = dim_zero_cat(state["misses"])
+            fa = dim_zero_cat(state["false_alarms"])
+        return _safe_divide(hits, hits + misses + fa)

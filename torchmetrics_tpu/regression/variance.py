@@ -1,0 +1,122 @@
+"""R² / explained variance / RSE metric classes (reference: regression/{r2,explained_variance,rse}.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.regression.variance import (
+    _explained_variance_compute,
+    _explained_variance_update,
+    _r2_score_compute,
+    _r2_score_update,
+)
+
+
+class R2Score(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0,
+                 multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed}")
+        self.multioutput = multioutput
+        d = jnp.zeros(num_outputs)
+        self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", d, dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        residual, sum_target, sum_sq_target, n = _r2_score_update(preds, target)
+        return {
+            "sum_squared_error": state["sum_squared_error"] + residual,
+            "sum_error": state["sum_error"] + sum_target,
+            "sum_squared_target": state["sum_squared_target"] + sum_sq_target,
+            "total": state["total"] + n,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _r2_score_compute(
+            state["sum_squared_error"], state["sum_error"], state["sum_squared_target"],
+            state["total"], self.adjusted, self.multioutput,
+        )
+
+
+class ExplainedVariance(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed}")
+        self.multioutput = multioutput
+        d = jnp.zeros(num_outputs)
+        self.add_state("num_obs", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_target", d, dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", d, dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        n, se, sse, st, sst = _explained_variance_update(preds, target)
+        return {
+            "num_obs": state["num_obs"] + n,
+            "sum_error": state["sum_error"] + se,
+            "sum_squared_error": state["sum_squared_error"] + sse,
+            "sum_target": state["sum_target"] + st,
+            "sum_squared_target": state["sum_squared_target"] + sst,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _explained_variance_compute(
+            state["num_obs"], state["sum_error"], state["sum_squared_error"],
+            state["sum_target"], state["sum_squared_target"], self.multioutput,
+        )
+
+
+class RelativeSquaredError(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        d = jnp.zeros(num_outputs)
+        self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_error", d, dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", d, dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        residual, sum_target, sum_sq_target, n = _r2_score_update(preds, target)
+        return {
+            "sum_squared_error": state["sum_squared_error"] + residual,
+            "sum_error": state["sum_error"] + sum_target,
+            "sum_squared_target": state["sum_squared_target"] + sum_sq_target,
+            "total": state["total"] + n,
+        }
+
+    def _compute(self, state: State) -> Array:
+        mean_target = state["sum_error"] / state["total"]
+        ss_tot = state["sum_squared_target"] - state["sum_error"] * mean_target
+        rse = jnp.sum(state["sum_squared_error"]) / jnp.maximum(jnp.sum(ss_tot), 1e-24)
+        return rse if self.squared else jnp.sqrt(rse)

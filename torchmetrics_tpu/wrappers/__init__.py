@@ -1,0 +1,30 @@
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.bootstrapping import BootStrapper
+from torchmetrics_tpu.wrappers.classwise import ClasswiseWrapper
+from torchmetrics_tpu.wrappers.feature_share import FeatureShare, NetworkCache
+from torchmetrics_tpu.wrappers.minmax import MinMaxMetric
+from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper
+from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper
+from torchmetrics_tpu.wrappers.running import Running
+from torchmetrics_tpu.wrappers.tracker import MetricTracker
+from torchmetrics_tpu.wrappers.transformations import (
+    BinaryTargetTransformer,
+    LambdaInputTransformer,
+    MetricInputTransformer,
+)
+
+__all__ = [
+    "BinaryTargetTransformer",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "FeatureShare",
+    "LambdaInputTransformer",
+    "MetricInputTransformer",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "NetworkCache",
+    "Running",
+    "WrapperMetric",
+]

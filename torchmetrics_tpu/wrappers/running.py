@@ -1,0 +1,60 @@
+"""Running window wrapper (reference: wrappers/running.py:27).
+
+The reference duplicates base states × window and round-robin-overwrites
+(:103-117).  The functional-core design makes this direct: keep the last
+``window`` *batch states* and merge them at compute — `merge_states` is the
+primitive the reference lacked.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class Running(WrapperMetric):
+    """Metric over a sliding window of the last ``window`` updates."""
+
+    def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected argument `base_metric` to be an instance of `Metric` but got {base_metric}")
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        if base_metric.full_state_update:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self._batch_states: List[State] = []
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        batch_state = self.base_metric.update_state(self.base_metric.init_state(), *args, **kwargs)
+        self._batch_states.append(batch_state)
+        if len(self._batch_states) > self.window:
+            self._batch_states.pop(0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        batch_state = self.base_metric.update_state(self.base_metric.init_state(), *args, **kwargs)
+        self._batch_states.append(batch_state)
+        if len(self._batch_states) > self.window:
+            self._batch_states.pop(0)
+        return self.base_metric.compute_state(batch_state)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Any:
+        if not self._batch_states:
+            return self.base_metric.compute_state(self.base_metric.init_state())
+        state = self._batch_states[0]
+        for s in self._batch_states[1:]:
+            state = self.base_metric.merge_states(state, s)
+        return self.base_metric.compute_state(state)
+
+    def reset(self) -> None:
+        self._batch_states = []
+        self.base_metric.reset()

@@ -1,0 +1,114 @@
+"""MetricTracker (reference: wrappers/tracker.py:31)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricTracker(WrapperMetric):
+    """Keep historical copies of a metric (or collection) across ``increment()`` steps."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        self.maximize = maximize
+        self._increment_called = False
+        self._history: List[Union[Metric, MetricCollection]] = []
+
+    @property
+    def n_steps(self) -> int:
+        return len(self._history)
+
+    def increment(self) -> None:
+        """Create a fresh copy of the base metric for a new tracking step."""
+        self._increment_called = True
+        m = deepcopy(self._base_metric)
+        m.reset()
+        self._history.append(m)
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._history[-1].update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._history[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._history[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute over every tracked step; stacks scalar results."""
+        self._check_for_increment("compute_all")
+        res = [m.compute() for m in self._history]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Array, Tuple[Array, int], Dict[str, Array], Tuple[Dict[str, Array], Dict[str, int]]]:
+        """Best value (and optionally the step index it occurred at)."""
+        res = self.compute_all()
+
+        def _best(values: Array, maximize: bool) -> Tuple[Array, int]:
+            idx = int(jnp.argmax(values)) if maximize else int(jnp.argmin(values))
+            return values[idx], idx
+
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            best, steps = {}, {}
+            for (k, v), mx in zip(res.items(), maximize):
+                try:
+                    best[k], steps[k] = _best(v, mx)
+                except (ValueError, TypeError) as err:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}: {err}",
+                        UserWarning,
+                    )
+                    best[k], steps[k] = None, None
+            return (best, steps) if return_step else best
+        try:
+            b, i = _best(res, bool(self.maximize))
+        except (ValueError, TypeError) as err:
+            rank_zero_warn(f"Encountered the following error when trying to get the best metric: {err}", UserWarning)
+            b, i = None, None
+        return (b, i) if return_step else b
+
+    def reset(self) -> None:
+        """Reset the current step's metric."""
+        if self._history:
+            self._history[-1].reset()
+
+    def reset_all(self) -> None:
+        """Drop all history."""
+        self._history = []
+        self._increment_called = False

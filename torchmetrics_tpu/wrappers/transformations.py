@@ -1,0 +1,80 @@
+"""Input-transforming wrappers (reference: wrappers/transformations.py:23,79,132)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricInputTransformer(WrapperMetric):
+    """Base: apply ``transform_pred``/``transform_target`` before the wrapped update."""
+
+    def __init__(self, wrapped_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(wrapped_metric, Metric):
+            raise TypeError(f"Expected wrapped metric to be an instance of `Metric` but received {wrapped_metric}")
+        self.wrapped_metric = wrapped_metric
+
+    def transform_pred(self, pred: Array) -> Array:
+        return pred
+
+    def transform_target(self, target: Array) -> Array:
+        return target
+
+    def update(self, pred: Array, target: Array, *args: Any, **kwargs: Any) -> None:
+        self.wrapped_metric.update(self.transform_pred(pred), self.transform_target(target), *args, **kwargs)
+
+    def compute(self) -> Any:
+        return self.wrapped_metric.compute()
+
+    def forward(self, pred: Array, target: Array, *args: Any, **kwargs: Any) -> Any:
+        return self.wrapped_metric(self.transform_pred(pred), self.transform_target(target), *args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        self.wrapped_metric.reset()
+
+
+class LambdaInputTransformer(MetricInputTransformer):
+    """Apply user lambdas to pred/target (reference: transformations.py:79)."""
+
+    def __init__(
+        self,
+        wrapped_metric: Metric,
+        transform_pred: Callable = None,
+        transform_target: Callable = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if transform_pred is not None and not callable(transform_pred):
+            raise TypeError(f"Expected `transform_pred` to be a callable but received {transform_pred}")
+        if transform_target is not None and not callable(transform_target):
+            raise TypeError(f"Expected `transform_target` to be a callable but received {transform_target}")
+        self._transform_pred = transform_pred
+        self._transform_target = transform_target
+
+    def transform_pred(self, pred: Array) -> Array:
+        return self._transform_pred(pred) if self._transform_pred is not None else pred
+
+    def transform_target(self, target: Array) -> Array:
+        return self._transform_target(target) if self._transform_target is not None else target
+
+
+class BinaryTargetTransformer(MetricInputTransformer):
+    """Threshold continuous targets to {0, 1} (reference: transformations.py:132)."""
+
+    def __init__(self, wrapped_metric: Metric, threshold: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise TypeError(f"Expected `threshold` to be a float but received {threshold}")
+        self.threshold = threshold
+
+    def transform_target(self, target: Array) -> Array:
+        return (target > self.threshold).astype(jnp.int32)
