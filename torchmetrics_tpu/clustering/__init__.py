@@ -1,0 +1,33 @@
+"""Modular clustering metrics (reference: src/torchmetrics/clustering/__init__.py)."""
+
+from torchmetrics_tpu.clustering.extrinsic import (
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CompletenessScore,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.clustering.intrinsic import (
+    CalinskiHarabaszScore,
+    DaviesBouldinScore,
+    DunnIndex,
+)
+
+__all__ = [
+    "AdjustedMutualInfoScore",
+    "AdjustedRandScore",
+    "CalinskiHarabaszScore",
+    "CompletenessScore",
+    "DaviesBouldinScore",
+    "DunnIndex",
+    "FowlkesMallowsIndex",
+    "HomogeneityScore",
+    "MutualInfoScore",
+    "NormalizedMutualInfoScore",
+    "RandScore",
+    "VMeasureScore",
+]

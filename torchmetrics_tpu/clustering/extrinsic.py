@@ -1,0 +1,174 @@
+"""Extrinsic (label-comparison) clustering metric classes.
+
+Reference: clustering/{mutual_info_score.py:28, adjusted_mutual_info_score.py:31,
+normalized_mutual_info_score.py:31, rand_score.py:28, adjusted_rand_score.py:28,
+fowlkes_mallows_index.py:28, homogeneity_completeness_v_measure.py:32,129,225}.
+Cluster-label ids are arbitrary per run, so state is the raw label stream
+(cat-reduced list states) and the contingency matrix is built once at compute —
+same layout the reference uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.clustering.extrinsic import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    completeness_score,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.functional.clustering.utils import _validate_average_method_arg
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _LabelPairMetric(Metric):
+    """Base for metrics over accumulated (preds, target) label streams."""
+
+    is_differentiable = False
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        return {
+            "preds": tuple(state["preds"]) + (jnp.asarray(preds),),
+            "target": tuple(state["target"]) + (jnp.asarray(target),),
+        }
+
+    def _labels(self, state: State):
+        return dim_zero_cat(state["preds"]), dim_zero_cat(state["target"])
+
+
+class MutualInfoScore(_LabelPairMetric):
+    """Mutual information between cluster assignments (clustering/mutual_info_score.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def _compute(self, state: State) -> Array:
+        return mutual_info_score(*self._labels(state))
+
+
+class AdjustedMutualInfoScore(_LabelPairMetric):
+    """Chance-adjusted MI (clustering/adjusted_mutual_info_score.py:31)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _compute(self, state: State) -> Array:
+        return adjusted_mutual_info_score(*self._labels(state), average_method=self.average_method)
+
+
+class NormalizedMutualInfoScore(_LabelPairMetric):
+    """Entropy-normalized MI (clustering/normalized_mutual_info_score.py:31)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _compute(self, state: State) -> Array:
+        return normalized_mutual_info_score(*self._labels(state), average_method=self.average_method)
+
+
+class RandScore(_LabelPairMetric):
+    """Pair-counting agreement (clustering/rand_score.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return rand_score(*self._labels(state))
+
+
+class AdjustedRandScore(_LabelPairMetric):
+    """Chance-adjusted Rand index (clustering/adjusted_rand_score.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = -0.5
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return adjusted_rand_score(*self._labels(state))
+
+
+class FowlkesMallowsIndex(_LabelPairMetric):
+    """Geometric mean of pairwise precision/recall (clustering/fowlkes_mallows_index.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return fowlkes_mallows_index(*self._labels(state))
+
+
+class HomogeneityScore(_LabelPairMetric):
+    """Each cluster holds one class (clustering/homogeneity_completeness_v_measure.py:32)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return homogeneity_score(*self._labels(state))
+
+
+class CompletenessScore(_LabelPairMetric):
+    """Each class lands in one cluster (clustering/homogeneity_completeness_v_measure.py:129)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state: State) -> Array:
+        return completeness_score(*self._labels(state))
+
+
+class VMeasureScore(_LabelPairMetric):
+    """Harmonic mean of homogeneity/completeness (clustering/homogeneity_completeness_v_measure.py:225)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def _compute(self, state: State) -> Array:
+        return v_measure_score(*self._labels(state), beta=self.beta)

@@ -1,0 +1,74 @@
+"""Intrinsic (no-ground-truth) clustering metric classes.
+
+Reference: clustering/{calinski_harabasz_score.py:28, davies_bouldin_score.py:28,
+dunn_index.py:28}.  State = accumulated (data, labels) streams, cat-reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.clustering.intrinsic import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _DataLabelMetric(Metric):
+    is_differentiable = False
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", [], dist_reduce_fx="cat")
+        self.add_state("labels", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, data: Array, labels: Array) -> State:
+        return {
+            "data": tuple(state["data"]) + (jnp.asarray(data),),
+            "labels": tuple(state["labels"]) + (jnp.asarray(labels),),
+        }
+
+    def _gathered(self, state: State):
+        return dim_zero_cat(state["data"]), dim_zero_cat(state["labels"])
+
+
+class CalinskiHarabaszScore(_DataLabelMetric):
+    """Variance-ratio criterion (clustering/calinski_harabasz_score.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def _compute(self, state: State) -> Array:
+        return calinski_harabasz_score(*self._gathered(state))
+
+
+class DaviesBouldinScore(_DataLabelMetric):
+    """Average worst-case cluster similarity (clustering/davies_bouldin_score.py:28)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def _compute(self, state: State) -> Array:
+        return davies_bouldin_score(*self._gathered(state))
+
+
+class DunnIndex(_DataLabelMetric):
+    """Separation/compactness ratio (clustering/dunn_index.py:28)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _compute(self, state: State) -> Array:
+        data, labels = self._gathered(state)
+        return dunn_index(data, labels, self.p)

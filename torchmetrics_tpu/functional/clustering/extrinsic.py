@@ -1,0 +1,178 @@
+"""Label-comparison (extrinsic) clustering metrics.
+
+Reference: functional/clustering/{mutual_info_score,normalized_mutual_info_score,
+adjusted_mutual_info_score,rand_score,adjusted_rand_score,fowlkes_mallows_index,
+homogeneity_completeness_v_measure}.py.  All are contingency-matrix based; the
+matrix is produced by an MXU matmul (see utils.calculate_contingency_matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax.numpy as jnp
+from jax import Array
+from jax.scipy.special import gammaln
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _entropy_from_counts,
+    _pair_counts,
+    _validate_average_method_arg,
+    _validate_clustering_inputs,
+    calculate_contingency_matrix,
+    calculate_generalized_mean,
+)
+
+
+def _mutual_info_from_contingency(contingency: Array) -> Array:
+    n = jnp.sum(contingency)
+    row = jnp.sum(contingency, axis=1, keepdims=True)
+    col = jnp.sum(contingency, axis=0, keepdims=True)
+    outer = row * col
+    nz = contingency > 0
+    ratio = jnp.where(nz, n * contingency / jnp.where(outer > 0, outer, 1.0), 1.0)
+    return jnp.sum(jnp.where(nz, (contingency / n) * jnp.log(ratio), 0.0))
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """Mutual information between two clusterings (nats)."""
+    _validate_clustering_inputs(preds, target)
+    return _mutual_info_from_contingency(calculate_contingency_matrix(preds, target))
+
+
+def expected_mutual_info_score(contingency: Array, n_samples: int) -> Array:
+    """E[MI] under the permutation (hypergeometric) model.
+
+    Vectorized over a padded ``nij`` axis with a validity mask, instead of the
+    reference's python double loop (functional/clustering/adjusted_mutual_info_score.py:64)
+    — one fused XLA kernel.
+    """
+    n = float(n_samples)
+    a = jnp.sum(contingency, axis=1)  # (R,)
+    b = jnp.sum(contingency, axis=0)  # (C,)
+    ai = a[:, None]  # (R,1)
+    bj = b[None, :]  # (1,C)
+    start = jnp.maximum(1.0, ai + bj - n)  # (R,C)
+    end = jnp.minimum(ai, bj)  # (R,C) inclusive
+    max_len = int(jnp.max(end - start)) + 1
+    k = jnp.arange(max_len, dtype=contingency.dtype)  # (K,)
+    nij = start[:, :, None] + k[None, None, :]  # (R,C,K)
+    valid = nij <= end[:, :, None]
+    nij_safe = jnp.where(valid, nij, 1.0)
+    log_term = jnp.log(n) + jnp.log(nij_safe) - jnp.log(ai[:, :, None]) - jnp.log(bj[:, :, None])
+    # log P(nij) via gammaln (hypergeometric pmf)
+    gln = (
+        gammaln(ai[:, :, None] + 1)
+        + gammaln(bj[:, :, None] + 1)
+        + gammaln(n - ai[:, :, None] + 1)
+        + gammaln(n - bj[:, :, None] + 1)
+        - gammaln(n + 1)
+        - gammaln(nij_safe + 1)
+        - gammaln(ai[:, :, None] - nij_safe + 1)
+        - gammaln(bj[:, :, None] - nij_safe + 1)
+        - gammaln(n - ai[:, :, None] - bj[:, :, None] + nij_safe + 1)
+    )
+    term = (nij_safe / n) * log_term * jnp.exp(gln)
+    return jnp.sum(jnp.where(valid, term, 0.0))
+
+
+def adjusted_mutual_info_score(
+    preds: Array,
+    target: Array,
+    average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic",
+) -> Array:
+    """AMI: (MI - E[MI]) / (mean(H(U),H(V)) - E[MI])."""
+    _validate_clustering_inputs(preds, target)
+    _validate_average_method_arg(average_method)
+    contingency = calculate_contingency_matrix(preds, target)
+    mi = _mutual_info_from_contingency(contingency)
+    h_pred = _entropy_from_counts(jnp.sum(contingency, axis=0))
+    h_target = _entropy_from_counts(jnp.sum(contingency, axis=1))
+    normalizer = calculate_generalized_mean(jnp.stack([h_pred, h_target]), average_method)
+    emi = expected_mutual_info_score(contingency, int(preds.shape[0]))
+    denom = normalizer - emi
+    # sklearn convention: tiny denominators snap to the dominant sign's epsilon
+    denom = jnp.where(
+        denom < 0, jnp.minimum(denom, -jnp.finfo(jnp.float32).eps), jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    )
+    return (mi - emi) / denom
+
+
+def normalized_mutual_info_score(
+    preds: Array,
+    target: Array,
+    average_method: Literal["min", "geometric", "arithmetic", "max"] = "arithmetic",
+) -> Array:
+    """NMI: MI / mean(H(U), H(V))."""
+    _validate_clustering_inputs(preds, target)
+    _validate_average_method_arg(average_method)
+    contingency = calculate_contingency_matrix(preds, target)
+    mi = _mutual_info_from_contingency(contingency)
+    h_pred = _entropy_from_counts(jnp.sum(contingency, axis=0))
+    h_target = _entropy_from_counts(jnp.sum(contingency, axis=1))
+    normalizer = calculate_generalized_mean(jnp.stack([h_pred, h_target]), average_method)
+    return jnp.where(
+        jnp.abs(mi) < 1e-10, jnp.zeros_like(mi), mi / jnp.maximum(normalizer, jnp.finfo(jnp.float32).eps)
+    )
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Rand index: fraction of sample pairs on which the clusterings agree."""
+    _validate_clustering_inputs(preds, target)
+    tp, fp, fn, tn = _pair_counts(calculate_contingency_matrix(preds, target))
+    return (tp + tn) / (tp + fp + fn + tn)
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """ARI: Rand index corrected for chance."""
+    _validate_clustering_inputs(preds, target)
+    tp, fp, fn, tn = _pair_counts(calculate_contingency_matrix(preds, target))
+    # (2(tp*tn - fp*fn)) / ((tp+fn)(fn+tn) + (tp+fp)(fp+tn))
+    denom = (tp + fn) * (fn + tn) + (tp + fp) * (fp + tn)
+    return jnp.where(denom == 0, jnp.ones_like(denom), 2.0 * (tp * tn - fp * fn) / jnp.where(denom == 0, 1.0, denom))
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """FMI = TP / sqrt((TP+FP)(TP+FN)) over sample pairs."""
+    _validate_clustering_inputs(preds, target)
+    tp, fp, fn, _ = _pair_counts(calculate_contingency_matrix(preds, target))
+    denom = jnp.sqrt((tp + fp) * (tp + fn))
+    return jnp.where(denom > 0, tp / jnp.where(denom > 0, denom, 1.0), jnp.zeros_like(denom))
+
+
+def _conditional_entropies(preds: Array, target: Array):
+    contingency = calculate_contingency_matrix(preds, target)
+    n = jnp.sum(contingency)
+    row = jnp.sum(contingency, axis=1)  # target cluster sizes
+    col = jnp.sum(contingency, axis=0)  # pred cluster sizes
+    # H(target | preds) = -sum_ij (nij/n) log(nij / col_j)
+    nz = contingency > 0
+    safe_c = jnp.where(nz, contingency, 1.0)
+    h_t_given_p = -jnp.sum(jnp.where(nz, (contingency / n) * jnp.log(safe_c / col[None, :]), 0.0))
+    h_p_given_t = -jnp.sum(jnp.where(nz, (contingency / n) * jnp.log(safe_c / row[:, None]), 0.0))
+    h_t = _entropy_from_counts(row)
+    h_p = _entropy_from_counts(col)
+    return h_t_given_p, h_p_given_t, h_t, h_p
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """1 - H(target|preds)/H(target): each cluster contains a single class."""
+    _validate_clustering_inputs(preds, target)
+    h_t_given_p, _, h_t, _ = _conditional_entropies(preds, target)
+    return jnp.where(h_t > 0, 1.0 - h_t_given_p / jnp.where(h_t > 0, h_t, 1.0), jnp.ones_like(h_t))
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """1 - H(preds|target)/H(preds): all members of a class share a cluster."""
+    _validate_clustering_inputs(preds, target)
+    _, h_p_given_t, _, h_p = _conditional_entropies(preds, target)
+    return jnp.where(h_p > 0, 1.0 - h_p_given_t / jnp.where(h_p > 0, h_p, 1.0), jnp.ones_like(h_p))
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """Weighted harmonic mean of homogeneity and completeness."""
+    _validate_clustering_inputs(preds, target)
+    hom = homogeneity_score(preds, target)
+    com = completeness_score(preds, target)
+    denom = beta * hom + com
+    return jnp.where(denom > 0, (1 + beta) * hom * com / jnp.where(denom > 0, denom, 1.0), jnp.zeros_like(denom))

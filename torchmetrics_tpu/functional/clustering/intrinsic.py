@@ -1,0 +1,71 @@
+"""Geometry-based (intrinsic) clustering metrics over raw embeddings.
+
+Reference: functional/clustering/{calinski_harabasz_score,davies_bouldin_score,
+dunn_index}.py.  All three reduce to per-cluster means/dispersions computed by
+one-hot matmuls (MXU) rather than per-cluster python loops.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _dense_relabel,
+    _validate_intrinsic_inputs,
+)
+
+
+def _cluster_stats(data: Array, labels: Array):
+    """Per-cluster (counts, means) via one-hot matmul; returns dense labels too."""
+    dense, k = _dense_relabel(labels)
+    onehot = jnp.eye(k, dtype=data.dtype)[dense]  # (n, k)
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+    sums = onehot.T @ data  # (k, d)
+    means = sums / jnp.maximum(counts, 1.0)[:, None]
+    return dense, k, onehot, counts, means
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Between/within dispersion ratio (higher = better separated)."""
+    _validate_intrinsic_inputs(data, labels)
+    n = data.shape[0]
+    dense, k, onehot, counts, means = _cluster_stats(data, labels)
+    overall = jnp.mean(data, axis=0)
+    between = jnp.sum(counts * jnp.sum((means - overall[None, :]) ** 2, axis=1))
+    within = jnp.sum((data - means[dense]) ** 2)
+    return (between / jnp.maximum(within, 1e-12)) * ((n - k) / max(k - 1, 1))
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Mean over clusters of the worst (si+sj)/dij similarity (lower = better)."""
+    _validate_intrinsic_inputs(data, labels)
+    dense, k, onehot, counts, means = _cluster_stats(data, labels)
+    # per-cluster mean distance to centroid
+    dist_to_centroid = jnp.linalg.norm(data - means[dense], axis=1)
+    s = (onehot.T @ dist_to_centroid) / jnp.maximum(counts, 1.0)  # (k,)
+    centroid_dist = jnp.linalg.norm(means[:, None, :] - means[None, :, :], axis=-1)  # (k,k)
+    ratio = (s[:, None] + s[None, :]) / jnp.where(centroid_dist > 0, centroid_dist, jnp.inf)
+    ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
+    return jnp.mean(jnp.max(ratio, axis=1))
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2) -> Array:
+    """min centroid-pair distance / max point-to-own-centroid distance.
+
+    Matches the reference's centroid formulation
+    (functional/clustering/dunn_index.py:21-46): inter-cluster distance is the
+    p-norm between centroid pairs; intra-cluster extent is the max p-norm from
+    a point to its own centroid.  Computed with dense (k,k)/(n,) kernels, no
+    per-cluster python loops.
+    """
+    _validate_intrinsic_inputs(data, labels)
+    dense, k, _, _, means = _cluster_stats(data, labels)
+    pair_diff = jnp.abs(means[:, None, :] - means[None, :, :])  # (k, k, d)
+    pair_dist = jnp.sum(pair_diff**p, axis=-1) ** (1.0 / p)
+    inter = jnp.min(jnp.where(jnp.eye(k, dtype=bool), jnp.inf, pair_dist))
+    to_centroid = jnp.sum(jnp.abs(data - means[dense]) ** p, axis=-1) ** (1.0 / p)
+    intra = jnp.max(to_centroid)
+    return inter / jnp.maximum(intra, 1e-12)

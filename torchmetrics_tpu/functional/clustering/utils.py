@@ -1,0 +1,105 @@
+"""Shared clustering kernels: contingency matrix, entropies, generalized means.
+
+Reference: functional/clustering/utils.py (calculate_contingency_matrix :119,
+calculate_entropy :47, calculate_generalized_mean :78).  TPU-first design: the
+contingency matrix is built as a one-hot × one-hot matmul so it lands on the
+MXU, instead of the reference's sparse-COO path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _validate_clustering_inputs(preds: Array, target: Array) -> None:
+    if preds.ndim != 1 or target.ndim != 1:
+        raise ValueError(
+            f"Expected 1d label arrays, got preds.ndim={preds.ndim} target.ndim={target.ndim}"
+        )
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected preds and target to have the same shape, got {preds.shape} and {target.shape}"
+        )
+
+
+def _validate_intrinsic_inputs(data: Array, labels: Array) -> None:
+    if data.ndim != 2 or labels.ndim != 1:
+        raise ValueError(
+            f"Expected data of shape (n, d) and 1d labels, got {data.shape} and {labels.shape}"
+        )
+    if data.shape[0] != labels.shape[0]:
+        raise ValueError("data and labels must agree on the number of samples")
+
+
+def _validate_average_method_arg(average_method: str) -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`, "
+            f"but got {average_method}"
+        )
+
+
+def _dense_relabel(labels: Array) -> Tuple[Array, int]:
+    """Map arbitrary integer labels to dense ``0..k-1`` ids (host-side compute path)."""
+    uniq, dense = jnp.unique(labels, return_inverse=True)
+    return dense.reshape(labels.shape), int(uniq.shape[0])
+
+
+def calculate_contingency_matrix(preds: Array, target: Array) -> Array:
+    """``(n_target_clusters, n_pred_clusters)`` co-occurrence counts.
+
+    One-hot matmul formulation: ``C = onehot(target)^T @ onehot(preds)`` — a
+    single MXU-friendly matmul (reference builds a sparse COO tensor instead,
+    functional/clustering/utils.py:119-160).
+    """
+    p_dense, kp = _dense_relabel(preds)
+    t_dense, kt = _dense_relabel(target)
+    p_oh = jnp.eye(kp, dtype=jnp.float32)[p_dense]
+    t_oh = jnp.eye(kt, dtype=jnp.float32)[t_dense]
+    return t_oh.T @ p_oh
+
+
+def calculate_entropy(labels: Array) -> Array:
+    """Shannon entropy (nats) of a label assignment."""
+    _, counts = jnp.unique(labels, return_counts=True)
+    p = counts / labels.shape[0]
+    return -jnp.sum(p * jnp.log(p))
+
+
+def _entropy_from_counts(counts: Array) -> Array:
+    n = jnp.sum(counts)
+    p = counts / jnp.maximum(n, 1)
+    return -jnp.sum(jnp.where(counts > 0, p * jnp.log(jnp.where(counts > 0, p, 1.0)), 0.0))
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, float, str]) -> Array:
+    """Power mean; string shortcuts min/geometric/arithmetic/max."""
+    if isinstance(p, str):
+        if p == "min":
+            return jnp.min(x)
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(x)))
+        if p == "arithmetic":
+            return jnp.mean(x)
+        if p == "max":
+            return jnp.max(x)
+        raise ValueError(f"Unknown generalized mean {p!r}")
+    return jnp.mean(x ** p) ** (1.0 / p)
+
+
+def _pair_counts(contingency: Array) -> Tuple[Array, Array, Array, Array]:
+    """(tp, fp, fn, tn) pair counts from a contingency matrix (pairs of samples)."""
+    n = jnp.sum(contingency)
+    sum_sq = jnp.sum(contingency**2)
+    row = jnp.sum(contingency, axis=1)
+    col = jnp.sum(contingency, axis=0)
+    sum_row_sq = jnp.sum(row**2)
+    sum_col_sq = jnp.sum(col**2)
+    tp = (sum_sq - n) / 2.0
+    fp = (sum_col_sq - sum_sq) / 2.0
+    fn = (sum_row_sq - sum_sq) / 2.0
+    tn = (n**2 + sum_sq - sum_row_sq - sum_col_sq) / 2.0
+    return tp, fp, fn, tn
