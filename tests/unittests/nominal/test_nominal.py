@@ -1,0 +1,178 @@
+"""Nominal metrics vs scipy/numpy references.
+
+scipy.stats.contingency.association covers the uncorrected χ² family; Theil's U
+and Fleiss' kappa are checked against straightforward numpy re-derivations and
+known-value examples (mirroring tests/unittests/nominal/* in the reference).
+"""
+
+import numpy as np
+import pytest
+from scipy.stats.contingency import association
+
+from torchmetrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from torchmetrics_tpu.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+N = 200
+K = 4
+
+
+def _series(seed=0):
+    rng = np.random.RandomState(seed)
+    target = rng.randint(0, K, size=N)
+    # correlated preds: mostly copy target, sometimes random
+    noise = rng.randint(0, K, size=N)
+    preds = np.where(rng.rand(N) < 0.7, target, noise)
+    return preds.astype(np.int32), target.astype(np.int32)
+
+
+def _observed(preds, target):
+    cm = np.zeros((K, K), dtype=np.int64)
+    for p, t in zip(preds, target):
+        cm[t, p] += 1
+    return cm
+
+
+@pytest.mark.parametrize(
+    "cls,fn,method",
+    [
+        (CramersV, cramers_v, "cramer"),
+        (TschuprowsT, tschuprows_t, "tschuprow"),
+        (PearsonsContingencyCoefficient, pearsons_contingency_coefficient, "pearson"),
+    ],
+)
+def test_chi2_family_vs_scipy(cls, fn, method):
+    preds, target = _series()
+    observed = _observed(preds, target)
+    expected = association(observed, method=method, correction=False)
+
+    kwargs = {"bias_correction": False} if method != "pearson" else {}
+    assert np.allclose(float(fn(preds, target, **kwargs)), expected, atol=1e-5)
+
+    metric = cls(num_classes=K, **kwargs)
+    for i in range(0, N, 50):
+        metric.update(preds[i : i + 50], target[i : i + 50])
+    assert np.allclose(float(metric.compute()), expected, atol=1e-5)
+
+
+def test_bias_corrected_in_range_and_perfect():
+    preds, target = _series(3)
+    v = float(cramers_v(preds, target))
+    t = float(tschuprows_t(preds, target))
+    assert 0.0 <= v <= 1.0 and 0.0 <= t <= 1.0
+    x = np.arange(N) % K
+    assert float(cramers_v(x, x)) > 0.95
+
+
+def test_theils_u_properties():
+    preds, target = _series(5)
+    x = np.arange(N) % K
+    assert np.allclose(float(theils_u(x, x)), 1.0, atol=1e-6)
+    u = float(theils_u(preds, target))
+    assert 0.0 < u < 1.0
+    # numpy re-derivation: U(X|Y) with rows=target(Y), cols=preds(X)
+    cm = _observed(preds, target)
+    n = cm.sum()
+    p_xy = cm / n
+    p_y = cm.sum(1) / n
+    p_x = cm.sum(0) / n
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s_xy = np.nansum(p_xy * np.log(p_y[:, None] / p_xy))
+    s_x = -np.sum(p_x[p_x > 0] * np.log(p_x[p_x > 0]))
+    assert np.allclose(u, (s_x - s_xy) / s_x, atol=1e-5)
+
+    m = TheilsU(num_classes=K)
+    m.update(preds, target)
+    assert np.allclose(float(m.compute()), u, atol=1e-6)
+
+
+def test_fleiss_kappa_known_value():
+    # Classic Wikipedia worked example: kappa ≈ 0.210
+    counts = np.array(
+        [
+            [0, 0, 0, 0, 14],
+            [0, 2, 6, 4, 2],
+            [0, 0, 3, 5, 6],
+            [0, 3, 9, 2, 0],
+            [2, 2, 8, 1, 1],
+            [7, 7, 0, 0, 0],
+            [3, 2, 6, 3, 0],
+            [2, 5, 3, 2, 2],
+            [6, 5, 2, 1, 0],
+            [0, 2, 2, 3, 7],
+        ],
+        dtype=np.int32,
+    )
+    assert np.allclose(float(fleiss_kappa(counts)), 0.20993, atol=1e-3)
+    m = FleissKappa(mode="counts")
+    m.update(counts[:5])
+    m.update(counts[5:])
+    assert np.allclose(float(m.compute()), 0.20993, atol=1e-3)
+
+
+def test_fleiss_kappa_probs_mode():
+    rng = np.random.RandomState(0)
+    probs = rng.rand(12, 5, 3).astype(np.float32)
+    out = float(fleiss_kappa(probs, mode="probs"))
+    counts = np.zeros((12, 5), dtype=np.int32)
+    arg = probs.argmax(axis=1)
+    for i in range(12):
+        for r in range(3):
+            counts[i, arg[i, r]] += 1
+    assert np.allclose(out, float(fleiss_kappa(counts)), atol=1e-6)
+
+
+def test_nan_strategies():
+    preds, target = _series(7)
+    preds_nan = preds.astype(np.float32)
+    preds_nan[::10] = np.nan
+    # replace: NaNs become class 0
+    preds_replaced = preds.copy()
+    preds_replaced[::10] = 0
+    expected = association(_observed(preds_replaced, target), method="cramer", correction=False)
+    got = float(cramers_v(preds_nan, target, bias_correction=False, nan_strategy="replace"))
+    assert np.allclose(got, expected, atol=1e-5)
+    # drop: NaN rows excluded
+    keep = ~np.isnan(preds_nan)
+    expected = association(
+        _observed(preds[keep], target[keep]), method="cramer", correction=False
+    )
+    got = float(cramers_v(preds_nan, target, bias_correction=False, nan_strategy="drop"))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_2d_probability_inputs():
+    preds, target = _series(9)
+    probs = np.eye(K, dtype=np.float32)[preds] * 0.9 + 0.025  # soft one-hot, argmax = preds
+    expected = association(_observed(preds, target), method="cramer", correction=False)
+    got = float(cramers_v(probs, target, bias_correction=False))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_modular_jit_with_drop_strategy():
+    preds, target = _series(11)
+    m = CramersV(num_classes=K, bias_correction=False, nan_strategy="drop", jit=True)
+    m.update(preds.astype(np.float32), target.astype(np.float32))
+    expected = association(_observed(preds, target), method="cramer", correction=False)
+    assert np.allclose(float(m.compute()), expected, atol=1e-5)
+
+
+def test_matrix_variant():
+    rng = np.random.RandomState(1)
+    matrix = rng.randint(0, 3, size=(100, 3)).astype(np.int32)
+    out = np.asarray(cramers_v_matrix(matrix, bias_correction=False))
+    assert out.shape == (3, 3)
+    assert np.allclose(np.diag(out), 1.0)
+    assert np.allclose(out, out.T, atol=1e-5)

@@ -1,0 +1,132 @@
+"""Segmentation + pairwise metrics vs sklearn/scipy/numpy references."""
+
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+from sklearn.metrics import jaccard_score
+from sklearn.metrics.pairwise import cosine_similarity as sk_cosine, linear_kernel
+
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+from torchmetrics_tpu.functional.segmentation import generalized_dice_score, mean_iou
+from torchmetrics_tpu.segmentation import GeneralizedDiceScore, MeanIoU
+
+N, C, H, W = 4, 3, 16, 16
+
+
+def _seg_inputs(seed=0, input_format="one-hot"):
+    rng = np.random.RandomState(seed)
+    preds_idx = rng.randint(0, C, size=(N, H, W))
+    target_idx = rng.randint(0, C, size=(N, H, W))
+    if input_format == "index":
+        return preds_idx, target_idx
+    oh = lambda x: np.moveaxis(np.eye(C, dtype=np.int32)[x], -1, 1)
+    return oh(preds_idx), oh(target_idx)
+
+
+def test_mean_iou_vs_sklearn_jaccard():
+    preds_idx, target_idx = _seg_inputs(0, "index")
+    out = np.asarray(mean_iou(preds_idx, target_idx, num_classes=C, per_class=True, input_format="index"))
+    for i in range(N):
+        expected = jaccard_score(
+            target_idx[i].flatten(), preds_idx[i].flatten(), average=None, labels=list(range(C))
+        )
+        assert np.allclose(out[i], expected, atol=1e-5)
+
+
+def test_mean_iou_formats_agree():
+    preds_idx, target_idx = _seg_inputs(1, "index")
+    oh = lambda x: np.moveaxis(np.eye(C, dtype=np.int32)[x], -1, 1)
+    a = np.asarray(mean_iou(preds_idx, target_idx, num_classes=C, input_format="index"))
+    b = np.asarray(mean_iou(oh(preds_idx), oh(target_idx), num_classes=C, input_format="one-hot"))
+    assert np.allclose(a, b)
+
+
+def test_mean_iou_modular_accumulation():
+    preds, target = _seg_inputs(2)
+    metric = MeanIoU(num_classes=C)
+    for i in range(N):
+        metric.update(preds[i : i + 1], target[i : i + 1])
+    per_sample = np.asarray(mean_iou(preds, target, num_classes=C))
+    assert np.allclose(float(metric.compute()), per_sample.mean(), atol=1e-6)
+
+
+def test_generalized_dice_perfect_and_range():
+    preds, target = _seg_inputs(3)
+    score = np.asarray(generalized_dice_score(target, target, num_classes=C))
+    assert np.allclose(score, 1.0, atol=1e-6)
+    score = np.asarray(generalized_dice_score(preds, target, num_classes=C))
+    assert np.all((score >= 0) & (score <= 1))
+
+
+@pytest.mark.parametrize("weight_type", ["square", "simple", "linear"])
+def test_generalized_dice_numpy_reference(weight_type):
+    preds, target = _seg_inputs(4)
+    out = np.asarray(generalized_dice_score(preds, target, num_classes=C, weight_type=weight_type))
+    # numpy re-derivation
+    p = preds.reshape(N, C, -1).astype(np.float64)
+    t = target.reshape(N, C, -1).astype(np.float64)
+    inter = (p * t).sum(-1)
+    tsum, psum = t.sum(-1), p.sum(-1)
+    if weight_type == "simple":
+        w = 1.0 / tsum
+    elif weight_type == "linear":
+        w = np.ones_like(tsum)
+    else:
+        w = 1.0 / tsum**2
+    infs = np.isinf(w)
+    w[infs] = 0
+    w_max = w.max(0, keepdims=True).repeat(N, 0)
+    w[infs] = w_max[infs]
+    num = (2 * inter * w).sum(1)
+    den = ((tsum + psum) * w).sum(1)
+    expected = np.where(den > 0, num / den, 0.0)
+    assert np.allclose(out, expected, atol=1e-5)
+
+
+def test_generalized_dice_modular():
+    preds, target = _seg_inputs(5)
+    metric = GeneralizedDiceScore(num_classes=C, per_class=True)
+    metric.update(preds[:2], target[:2])
+    metric.update(preds[2:], target[2:])
+    per_sample = np.asarray(generalized_dice_score(preds, target, num_classes=C, per_class=True))
+    assert np.allclose(np.asarray(metric.compute()), per_sample.mean(0), atol=1e-5)
+
+
+# ---------------------------------------------------------------- pairwise
+def _xy(seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(10, 6).astype(np.float32), rng.randn(8, 6).astype(np.float32)
+
+
+def test_pairwise_cosine():
+    x, y = _xy()
+    assert np.allclose(np.asarray(pairwise_cosine_similarity(x, y)), sk_cosine(x, y), atol=1e-5)
+    # self-similarity zeroes the diagonal by default
+    self_sim = np.asarray(pairwise_cosine_similarity(x))
+    assert np.allclose(np.diag(self_sim), 0.0)
+
+
+def test_pairwise_euclidean_manhattan_minkowski():
+    x, y = _xy(1)
+    assert np.allclose(np.asarray(pairwise_euclidean_distance(x, y)), cdist(x, y), atol=1e-4)
+    assert np.allclose(np.asarray(pairwise_manhattan_distance(x, y)), cdist(x, y, "cityblock"), atol=1e-4)
+    assert np.allclose(
+        np.asarray(pairwise_minkowski_distance(x, y, exponent=3)), cdist(x, y, "minkowski", p=3), atol=1e-4
+    )
+
+
+def test_pairwise_linear_and_reduction():
+    x, y = _xy(2)
+    assert np.allclose(np.asarray(pairwise_linear_similarity(x, y)), linear_kernel(x, y), atol=1e-4)
+    assert np.allclose(
+        np.asarray(pairwise_linear_similarity(x, y, reduction="mean")), linear_kernel(x, y).mean(-1), atol=1e-4
+    )
+    assert np.allclose(
+        np.asarray(pairwise_linear_similarity(x, y, reduction="sum")), linear_kernel(x, y).sum(-1), atol=1e-4
+    )

@@ -1,0 +1,256 @@
+"""Contingency-table association statistics: Cramér's V, Tschuprow's T,
+Pearson's contingency coefficient, Theil's U.
+
+Reference: functional/nominal/{cramers,tschuprows,pearson,theils_u}.py.  Each
+metric accumulates a static (C, C) confusion matrix (sum-reduced — just a
+psum across devices) and evaluates the statistic once at compute.
+"""
+
+from __future__ import annotations
+
+from typing import Literal, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _weighted_pair_count,
+)
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_chi_squared,
+    _compute_phi_squared_corrected,
+    _compute_rows_and_cols_corrected,
+    _drop_empty_rows_and_cols,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+NanStrategy = Literal["replace", "drop"]
+
+
+def _nominal_confmat_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Categorical series → (C, C) contingency table (rows=target, cols=preds).
+
+    NaN handling is mask-based (not index-based) so the whole update stays
+    static-shaped and traceable under ``jit`` for both strategies.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = jnp.argmax(preds, axis=1) if preds.ndim == 2 else preds
+    target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    nan_mask = jnp.isnan(preds) | jnp.isnan(target)
+    if nan_strategy == "replace":
+        valid = jnp.ones(target.shape, dtype=jnp.float32)
+        preds = jnp.nan_to_num(preds, nan=nan_replace_value)
+        target = jnp.nan_to_num(target, nan=nan_replace_value)
+    else:  # drop: zero-weight NaN rows instead of physically removing them
+        valid = jnp.where(nan_mask, 0.0, 1.0)
+        preds = jnp.nan_to_num(preds, nan=0.0)
+        target = jnp.nan_to_num(target, nan=0.0)
+    return _weighted_pair_count(
+        jnp.asarray(preds, jnp.int32), jnp.asarray(target, jnp.int32), valid, num_classes
+    )
+
+
+def _infer_num_classes(preds: Array, target: Array, nan_replace_value: Optional[float]) -> int:
+    """Max dense label over both (cleaned) series + 1; argmax-reduces 2D inputs first."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = jnp.argmax(preds, axis=1) if preds.ndim == 2 else preds
+    target = jnp.argmax(target, axis=1) if target.ndim == 2 else target
+    fill = 0.0 if nan_replace_value is None else nan_replace_value
+    hi = max(
+        float(jnp.max(jnp.nan_to_num(jnp.asarray(preds, jnp.float32), nan=fill))),
+        float(jnp.max(jnp.nan_to_num(jnp.asarray(target, jnp.float32), nan=fill))),
+    )
+    return int(hi) + 1
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    confmat = _drop_empty_rows_and_cols(confmat)
+    n = jnp.sum(confmat)
+    phi_squared = _compute_chi_squared(confmat, bias_correction) / n
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_c = _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, n)
+        rows_c, cols_c = _compute_rows_and_cols_corrected(num_rows, num_cols, n)
+        if float(jnp.minimum(rows_c, cols_c)) == 1:
+            _unable_to_use_bias_correction_warning("Cramer's V")
+            return jnp.asarray(jnp.nan)
+        value = jnp.sqrt(phi_c / jnp.minimum(rows_c - 1, cols_c - 1))
+    else:
+        value = jnp.sqrt(phi_squared / min(num_rows - 1, num_cols - 1))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramér's V association between two categorical series, in [0, 1]."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = _infer_num_classes(preds, target, nan_replace_value)
+    confmat = _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    confmat = _drop_empty_rows_and_cols(confmat)
+    n = jnp.sum(confmat)
+    phi_squared = _compute_chi_squared(confmat, bias_correction) / n
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_c = _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, n)
+        rows_c, cols_c = _compute_rows_and_cols_corrected(num_rows, num_cols, n)
+        if float(jnp.minimum(rows_c, cols_c)) == 1:
+            _unable_to_use_bias_correction_warning("Tschuprow's T")
+            return jnp.asarray(jnp.nan)
+        value = jnp.sqrt(phi_c / jnp.sqrt((rows_c - 1) * (cols_c - 1)))
+    else:
+        value = jnp.sqrt(phi_squared / jnp.sqrt(float((num_rows - 1) * (num_cols - 1))))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T association between two categorical series, in [0, 1]."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = _infer_num_classes(preds, target, nan_replace_value)
+    confmat = _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    confmat = _drop_empty_rows_and_cols(confmat)
+    n = jnp.sum(confmat)
+    phi_squared = _compute_chi_squared(confmat, bias_correction=False) / n
+    value = jnp.sqrt(phi_squared / (1 + phi_squared))
+    return jnp.clip(value, 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient, in [0, 1)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = _infer_num_classes(preds, target, nan_replace_value)
+    confmat = _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """H(X|Y) from a contingency table (rows = Y)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    n = jnp.sum(confmat)
+    p_xy = confmat / n
+    p_y = jnp.sum(confmat, axis=1) / n
+    ratio = p_y[:, None] / jnp.where(p_xy > 0, p_xy, 1.0)
+    return jnp.sum(jnp.where(p_xy > 0, p_xy * jnp.log(ratio), 0.0))
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    confmat = _drop_empty_rows_and_cols(confmat)
+    s_xy = _conditional_entropy_compute(confmat)
+    n = jnp.sum(confmat)
+    p_x = jnp.sum(confmat, axis=0) / n
+    s_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
+    if float(s_x) == 0:
+        return jnp.zeros(())
+    return (s_x - s_xy) / s_x
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U uncertainty coefficient U(preds|target), in [0, 1]; asymmetric."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    num_classes = _infer_num_classes(preds, target, nan_replace_value)
+    confmat = _nominal_confmat_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def _matrix_of(stat_fn, matrix: Array, symmetric: bool = True, **kwargs) -> Array:
+    """Pairwise column-vs-column statistic matrix (reference *_matrix variants).
+
+    Symmetric statistics evaluate each unordered pair once and mirror.
+    """
+    matrix = jnp.asarray(matrix)
+    num_vars = matrix.shape[1]
+    out = jnp.ones((num_vars, num_vars))
+    for i in range(num_vars):
+        for j in range(i + 1 if symmetric else 0, num_vars):
+            if i == j:
+                continue
+            value = stat_fn(matrix[:, i], matrix[:, j], **kwargs)
+            out = out.at[i, j].set(value)
+            if symmetric:
+                out = out.at[j, i].set(value)
+    return out
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Symmetric matrix of Cramér's V between all column pairs."""
+    return _matrix_of(
+        cramers_v, matrix, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Symmetric matrix of Tschuprow's T between all column pairs."""
+    return _matrix_of(
+        tschuprows_t, matrix, bias_correction=bias_correction, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Symmetric matrix of Pearson's contingency coefficient between column pairs."""
+    return _matrix_of(
+        pearsons_contingency_coefficient, matrix, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: NanStrategy = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Asymmetric matrix of Theil's U between all column pairs."""
+    return _matrix_of(
+        theils_u, matrix, symmetric=False, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value
+    )

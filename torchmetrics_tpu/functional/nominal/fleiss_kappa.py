@@ -1,0 +1,50 @@
+"""Fleiss' kappa inter-rater agreement.
+
+Reference: functional/nominal/fleiss_kappa.py:61 (+ update/compute helpers).
+"""
+
+from __future__ import annotations
+
+from typing import Literal
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _fleiss_kappa_update(ratings: Array, mode: Literal["counts", "probs"] = "counts") -> Array:
+    """Normalize ratings to a (n_samples, n_categories) counts matrix."""
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        n_categories = ratings.shape[1]
+        argmax = jnp.argmax(ratings, axis=1)  # (n_samples, n_raters)
+        one_hot = jnp.eye(n_categories, dtype=jnp.int32)[argmax]  # (n_samples, n_raters, n_categories)
+        return jnp.sum(one_hot, axis=1)
+    if mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    counts = jnp.asarray(counts, jnp.float32)
+    total = counts.shape[0]
+    num_raters = jnp.max(jnp.sum(counts, axis=1))
+    p_i = jnp.sum(counts, axis=0) / (total * num_raters)
+    p_j = (jnp.sum(counts**2, axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = jnp.mean(p_j)
+    pe_bar = jnp.sum(p_i**2)
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: Literal["counts", "probs"] = "counts") -> Array:
+    """κ = (p̄ - p̄ₑ) / (1 - p̄ₑ); agreement between raters beyond chance."""
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+    return _fleiss_kappa_compute(_fleiss_kappa_update(ratings, mode))

@@ -1,0 +1,86 @@
+"""Shared nominal-association helpers.
+
+Reference: functional/nominal/utils.py (chi², bias corrections, NaN handling,
+empty row/col dropping).  These run in the eager ``compute`` path, so dynamic
+shapes from row/col dropping are fine; the accumulated state itself is a
+static ``(num_classes, num_classes)`` confusion matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ("replace", "drop"):
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace NaNs with a fill value or drop rows where either series is NaN."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if nan_strategy == "replace":
+        return (
+            jnp.nan_to_num(preds, nan=nan_replace_value),
+            jnp.nan_to_num(target, nan=nan_replace_value),
+        )
+    keep = ~(jnp.isnan(preds) | jnp.isnan(target))
+    return preds[keep], target[keep]
+
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    confmat = confmat[jnp.sum(confmat, axis=1) != 0]
+    return confmat[:, jnp.sum(confmat, axis=0) != 0]
+
+
+def _compute_expected_freqs(confmat: Array) -> Array:
+    rows = jnp.sum(confmat, axis=1)
+    cols = jnp.sum(confmat, axis=0)
+    return jnp.outer(rows, cols) / jnp.sum(confmat)
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """χ² independence statistic (Yates-corrected at df=1, matching scipy)."""
+    expected = _compute_expected_freqs(confmat)
+    df = expected.size - sum(expected.shape) + expected.ndim - 1
+    if df == 0:
+        return jnp.zeros(())
+    if df == 1 and bias_correction:
+        diff = expected - confmat
+        direction = jnp.sign(diff)
+        confmat = confmat + direction * jnp.minimum(0.5, jnp.abs(diff))
+    return jnp.sum((confmat - expected) ** 2 / expected)
+
+
+def _compute_phi_squared_corrected(phi_squared: Array, num_rows: int, num_cols: int, n: Array) -> Array:
+    return jnp.maximum(0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / (n - 1))
+
+
+def _compute_rows_and_cols_corrected(num_rows: int, num_cols: int, n: Array) -> Tuple[Array, Array]:
+    rows_c = num_rows - (num_rows - 1) ** 2 / (n - 1)
+    cols_c = num_cols - (num_cols - 1) ** 2 / (n - 1)
+    return rows_c, cols_c
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )

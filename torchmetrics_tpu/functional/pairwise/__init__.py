@@ -1,0 +1,22 @@
+"""Batched pairwise similarity/distance kernels.
+
+Reference: functional/pairwise/{cosine,euclidean,linear,manhattan,minkowski}.py.
+All are single dense (N, M) kernels — the cosine/linear/euclidean paths are one
+MXU matmul each.
+"""
+
+from torchmetrics_tpu.functional.pairwise.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

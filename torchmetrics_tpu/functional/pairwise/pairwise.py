@@ -1,0 +1,118 @@
+"""Pairwise kernels: x (N, d) vs y (M, d) → (N, M) matrix.
+
+Reference: functional/pairwise/*.py — `_check_input`, `_reduce_distance_matrix`
+and one kernel per metric.  Euclidean uses the ‖x‖²+‖y‖²-2x·y expansion so the
+inner term is a single MXU matmul (reference helpers use the same trick,
+functional/pairwise/euclidean.py).
+"""
+
+from __future__ import annotations
+
+from typing import Literal, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _check_input(x: Array, y: Optional[Array], zero_diagonal: Optional[bool]) -> Tuple[Array, Array, bool]:
+    x = jnp.asarray(x, jnp.float32)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y, jnp.float32)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                f" `d` should be same as the last dimension of `x`, but got {y.shape}"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _reduce_distance_matrix(
+    distmat: Array, reduction: Optional[Literal["mean", "sum", "none"]] = None
+) -> Array:
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction in (None, "none"):
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _maybe_zero_diagonal(distmat: Array, zero_diagonal: bool) -> Array:
+    if not zero_diagonal:
+        return distmat
+    return distmat * (1.0 - jnp.eye(distmat.shape[0], distmat.shape[1]))
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[Literal["mean", "sum", "none"]] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Cosine similarity matrix: xᵢ·yⱼ / (‖xᵢ‖‖yⱼ‖)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+    y_norm = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-12)
+    distmat = x_norm @ y_norm.T
+    return _reduce_distance_matrix(_maybe_zero_diagonal(distmat, zero_diagonal), reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[Literal["mean", "sum", "none"]] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Euclidean distance matrix via the ‖x‖² + ‖y‖² - 2x·y expansion (one matmul)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (N, 1)
+    y_sq = jnp.sum(y * y, axis=1, keepdims=True).T  # (1, M)
+    sq = x_sq + y_sq - 2.0 * (x @ y.T)
+    distmat = jnp.sqrt(jnp.maximum(sq, 0.0))
+    return _reduce_distance_matrix(_maybe_zero_diagonal(distmat, zero_diagonal), reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[Literal["mean", "sum", "none"]] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Dot-product similarity matrix x @ yᵀ."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distmat = x @ y.T
+    return _reduce_distance_matrix(_maybe_zero_diagonal(distmat, zero_diagonal), reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[Literal["mean", "sum", "none"]] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """L1 distance matrix Σ|xᵢ - yⱼ|."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distmat = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _reduce_distance_matrix(_maybe_zero_diagonal(distmat, zero_diagonal), reduction)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: float = 2,
+    reduction: Optional[Literal["mean", "sum", "none"]] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Minkowski distance matrix (Σ|xᵢ - yⱼ|^p)^(1/p)."""
+    if not (isinstance(exponent, (int, float)) and exponent > 0):
+        raise ValueError(f"Argument `exponent` must be a positive number, but got {exponent}")
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distmat = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent, axis=-1) ** (1.0 / exponent)
+    return _reduce_distance_matrix(_maybe_zero_diagonal(distmat, zero_diagonal), reduction)

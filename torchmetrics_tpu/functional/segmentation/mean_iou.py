@@ -1,0 +1,85 @@
+"""Mean IoU for semantic segmentation.
+
+Reference: functional/segmentation/mean_iou.py:25-110.  Per-sample, per-class
+intersection/union reduced over spatial axes — pure elementwise + reduction
+ops that XLA fuses into one kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Literal, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+
+def _segmentation_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    input_format: str,
+) -> None:
+    if num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if input_format not in ("one-hot", "index"):
+        raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', but got {input_format}.")
+
+
+def _to_onehot_format(preds: Array, target: Array, num_classes: int, input_format: str) -> Tuple[Array, Array]:
+    """index → one-hot with class axis at dim 1 (N, C, *spatial)."""
+    if input_format == "index":
+        preds = jnp.moveaxis(jnp.eye(num_classes, dtype=jnp.int32)[preds], -1, 1)
+        target = jnp.moveaxis(jnp.eye(num_classes, dtype=jnp.int32)[target], -1, 1)
+    return preds, target
+
+
+def _ignore_background(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop class 0 (assumed background) from the class axis."""
+    return preds[:, 1:], target[:, 1:]
+
+
+def _mean_iou_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = False,
+    input_format: Literal["one-hot", "index"] = "one-hot",
+) -> Tuple[Array, Array]:
+    if preds.shape != target.shape:
+        raise ValueError(f"Expected same shapes, got {preds.shape} and {target.shape}")
+    preds, target = _to_onehot_format(preds, target, num_classes, input_format)
+    if not include_background:
+        preds, target = _ignore_background(preds, target)
+    reduce_axis = tuple(range(2, preds.ndim))
+    preds_b = jnp.asarray(preds, bool)
+    target_b = jnp.asarray(target, bool)
+    intersection = jnp.sum(preds_b & target_b, axis=reduce_axis)
+    pred_sum = jnp.sum(preds_b, axis=reduce_axis)
+    target_sum = jnp.sum(target_b, axis=reduce_axis)
+    union = pred_sum + target_sum - intersection
+    return intersection, union
+
+
+def _mean_iou_compute(intersection: Array, union: Array, per_class: bool = False) -> Array:
+    val = _safe_divide(jnp.asarray(intersection, jnp.float32), jnp.asarray(union, jnp.float32))
+    return val if per_class else jnp.mean(val, axis=1)
+
+
+def mean_iou(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    input_format: Literal["one-hot", "index"] = "one-hot",
+) -> Array:
+    """Per-sample mean IoU; shape (N,) or (N, C) when ``per_class``."""
+    _segmentation_validate_args(num_classes, include_background, per_class, input_format)
+    intersection, union = _mean_iou_update(preds, target, num_classes, include_background, input_format)
+    return _mean_iou_compute(intersection, union, per_class)

@@ -1,0 +1,17 @@
+"""Modular nominal-association metrics (reference: src/torchmetrics/nominal/__init__.py)."""
+
+from torchmetrics_tpu.nominal.nominal import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+__all__ = [
+    "CramersV",
+    "FleissKappa",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

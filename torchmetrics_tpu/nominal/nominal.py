@@ -1,0 +1,139 @@
+"""Nominal-association metric classes.
+
+Reference: nominal/{cramers.py:30, tschuprows.py:30, pearson.py:33,
+theils_u.py:30, fleiss_kappa.py:29}.  The χ²-family accumulates a static
+(num_classes, num_classes) contingency table (sum/psum-reduced — no ragged
+gathers); FleissKappa accumulates per-sample category counts (cat-reduced).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.nominal.contingency import (
+    _cramers_v_compute,
+    _nominal_confmat_update,
+    _pearsons_contingency_coefficient_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+from torchmetrics_tpu.functional.nominal.fleiss_kappa import (
+    _fleiss_kappa_compute,
+    _fleiss_kappa_update,
+)
+from torchmetrics_tpu.functional.nominal.utils import _nominal_input_validation
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+NanStrategy = Literal["replace", "drop"]
+
+
+class _ContingencyMetric(Metric):
+    """Base: (C, C) contingency-table state, statistic evaluated at compute."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: NanStrategy = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_classes, int) and num_classes > 0):
+            raise ValueError(f"Argument `num_classes` must be a positive integer, got {num_classes}")
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.num_classes = num_classes
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state(
+            "confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum"
+        )
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        cm = _nominal_confmat_update(
+            preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value
+        )
+        return {"confmat": state["confmat"] + cm}
+
+
+class CramersV(_ContingencyMetric):
+    """Cramér's V association (nominal/cramers.py:30)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: NanStrategy = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute(self, state: State) -> Array:
+        return _cramers_v_compute(state["confmat"], self.bias_correction)
+
+
+class TschuprowsT(_ContingencyMetric):
+    """Tschuprow's T association (nominal/tschuprows.py:30)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: NanStrategy = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute(self, state: State) -> Array:
+        return _tschuprows_t_compute(state["confmat"], self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ContingencyMetric):
+    """Pearson's contingency coefficient (nominal/pearson.py:33)."""
+
+    def _compute(self, state: State) -> Array:
+        return _pearsons_contingency_coefficient_compute(state["confmat"])
+
+
+class TheilsU(_ContingencyMetric):
+    """Theil's U uncertainty coefficient (nominal/theils_u.py:30); asymmetric."""
+
+    def _compute(self, state: State) -> Array:
+        return _theils_u_compute(state["confmat"])
+
+
+class FleissKappa(Metric):
+    """Fleiss' kappa inter-rater agreement (nominal/fleiss_kappa.py:29)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, mode: Literal["counts", "probs"] = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+        self.mode = mode
+        self.add_state("counts", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, ratings: Array) -> State:
+        counts = _fleiss_kappa_update(ratings, self.mode)
+        return {"counts": tuple(state["counts"]) + (counts,)}
+
+    def _compute(self, state: State) -> Array:
+        return _fleiss_kappa_compute(dim_zero_cat(state["counts"]))

@@ -1,0 +1,6 @@
+"""Modular segmentation metrics (reference: src/torchmetrics/segmentation/__init__.py)."""
+
+from torchmetrics_tpu.segmentation.generalized_dice import GeneralizedDiceScore
+from torchmetrics_tpu.segmentation.mean_iou import MeanIoU
+
+__all__ = ["GeneralizedDiceScore", "MeanIoU"]

@@ -1,0 +1,63 @@
+"""MeanIoU metric class.
+
+Reference: segmentation/mean_iou.py:29.  State = (Σ per-sample score, n) —
+static shapes, sum/psum-reduced, so the distributed merge is exact (the
+reference's mean-reduced running state loses batch-count weighting).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.segmentation.mean_iou import (
+    _mean_iou_compute,
+    _mean_iou_update,
+    _segmentation_validate_args,
+)
+
+
+class MeanIoU(Metric):
+    """Mean Intersection over Union for semantic segmentation."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        input_format: Literal["one-hot", "index"] = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _segmentation_validate_args(num_classes, include_background, per_class, input_format)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.input_format = input_format
+
+        n_out = num_classes - 1 if not include_background else num_classes
+        self.add_state("score", jnp.zeros(n_out if per_class else 1), dist_reduce_fx="sum")
+        self.add_state("num_samples", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        intersection, union = _mean_iou_update(
+            preds, target, self.num_classes, self.include_background, self.input_format
+        )
+        score = _mean_iou_compute(intersection, union, per_class=self.per_class)
+        return {
+            "score": state["score"] + (jnp.sum(score, axis=0) if self.per_class else jnp.sum(score)),
+            "num_samples": state["num_samples"] + preds.shape[0],
+        }
+
+    def _compute(self, state: State) -> Array:
+        out = state["score"] / jnp.maximum(state["num_samples"], 1.0)
+        return out if self.per_class else jnp.squeeze(out)
