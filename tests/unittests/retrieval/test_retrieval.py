@@ -1,0 +1,371 @@
+"""Retrieval metric tests vs per-query numpy references.
+
+The references below re-implement the reference library's per-query semantics
+(/root/reference/src/torchmetrics/functional/retrieval/*.py) directly in numpy
+with an explicit Python loop — the thing our vectorized kernels must match.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.retrieval import (
+    retrieval_auroc,
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
+
+SEED = 42
+
+
+def _query_data(rng, n_queries=12, min_docs=3, max_docs=14, empty_frac=0.2, graded=False):
+    """Variable-length queries, some with no positive target."""
+    queries = []
+    for q in range(n_queries):
+        n = int(rng.integers(min_docs, max_docs + 1))
+        preds = rng.random(n)
+        if graded:
+            target = rng.integers(0, 4, size=n)
+        else:
+            target = rng.integers(0, 2, size=n)
+        if rng.random() < empty_frac:
+            target = np.zeros(n, dtype=target.dtype)
+        queries.append((preds, target))
+    return queries
+
+
+def _flat(queries):
+    preds = np.concatenate([p for p, _ in queries])
+    target = np.concatenate([t for _, t in queries])
+    indexes = np.concatenate([np.full(len(p), i) for i, (p, _) in enumerate(queries)])
+    return preds, target, indexes
+
+
+# ------------------------------------------------------- numpy per-query refs
+def np_precision(p, t, top_k=None, adaptive_k=False):
+    n = len(p)
+    k = n if top_k is None else top_k
+    if adaptive_k:
+        k = min(k, n)
+    if t.sum() == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return t[order][: min(k, n)].sum() / k
+
+
+def np_recall(p, t, top_k=None):
+    if t.sum() == 0:
+        return 0.0
+    k = len(p) if top_k is None else top_k
+    order = np.argsort(-p, kind="stable")
+    return t[order][:k].sum() / t.sum()
+
+
+def np_hit_rate(p, t, top_k=None):
+    k = len(p) if top_k is None else top_k
+    order = np.argsort(-p, kind="stable")
+    return float(t[order][:k].sum() > 0)
+
+
+def np_fall_out(p, t, top_k=None):
+    k = len(p) if top_k is None else top_k
+    neg = 1 - t
+    if neg.sum() == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return neg[order][:k].sum() / neg.sum()
+
+
+def np_average_precision(p, t, top_k=None):
+    k = len(p) if top_k is None else min(top_k, len(p))
+    order = np.argsort(-p, kind="stable")
+    tk = t[order][:k]
+    if tk.sum() == 0:
+        return 0.0
+    positions = np.arange(1, k + 1)[tk > 0]
+    return np.mean(np.arange(1, len(positions) + 1) / positions)
+
+
+def np_reciprocal_rank(p, t, top_k=None):
+    k = len(p) if top_k is None else min(top_k, len(p))
+    order = np.argsort(-p, kind="stable")
+    tk = t[order][:k]
+    if tk.sum() == 0:
+        return 0.0
+    return 1.0 / (np.nonzero(tk)[0][0] + 1)
+
+
+def np_r_precision(p, t):
+    r = int(t.sum())
+    if r == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")
+    return t[order][:r].sum() / r
+
+
+def np_ndcg(p, t, top_k=None):
+    n = len(p)
+    k = n if top_k is None else min(top_k, n)
+    disc = 1.0 / np.log2(np.arange(n) + 2.0)
+    disc = np.where(np.arange(n) < k, disc, 0.0)
+    order = np.argsort(-p, kind="stable")
+    dcg = (t[order] * disc).sum()
+    idcg = (np.sort(t)[::-1] * disc).sum()
+    return 0.0 if idcg == 0 else dcg / idcg
+
+
+def np_auroc(p, t, top_k=None):
+    k = len(p) if top_k is None else min(top_k, len(p))
+    order = np.argsort(-p, kind="stable")
+    pk, tk = p[order][:k], t[order][:k]
+    n_pos, n_neg = tk.sum(), (1 - tk).sum()
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    # count pairs (pos, neg) with pos scored higher (+ half credit for ties)
+    pos_scores = pk[tk == 1]
+    neg_scores = pk[tk == 0]
+    wins = (pos_scores[:, None] > neg_scores[None, :]).sum() + 0.5 * (
+        pos_scores[:, None] == neg_scores[None, :]
+    ).sum()
+    return wins / (n_pos * n_neg)
+
+
+def np_pr_curve(p, t, max_k, adaptive_k=False):
+    n = len(p)
+    order = np.argsort(-p, kind="stable")
+    tk = t[order][: min(max_k, n)].astype(float)
+    tk = np.pad(tk, (0, max(0, max_k - n)))
+    rel_cum = np.cumsum(tk)
+    ks = np.arange(1, max_k + 1)
+    denom = np.minimum(ks, n) if adaptive_k else ks
+    precision = rel_cum / denom
+    recall = rel_cum / t.sum() if t.sum() else np.zeros(max_k)
+    if t.sum() == 0:
+        precision = np.zeros(max_k)
+    return precision, recall
+
+
+FUNCTIONAL_CASES = [
+    (retrieval_precision, np_precision, {}),
+    (retrieval_precision, np_precision, {"top_k": 3}),
+    (retrieval_precision, np_precision, {"top_k": 100, "adaptive_k": True}),
+    (retrieval_recall, np_recall, {}),
+    (retrieval_recall, np_recall, {"top_k": 3}),
+    (retrieval_hit_rate, np_hit_rate, {"top_k": 2}),
+    (retrieval_fall_out, np_fall_out, {"top_k": 3}),
+    (retrieval_average_precision, np_average_precision, {}),
+    (retrieval_average_precision, np_average_precision, {"top_k": 4}),
+    (retrieval_reciprocal_rank, np_reciprocal_rank, {}),
+    (retrieval_reciprocal_rank, np_reciprocal_rank, {"top_k": 2}),
+    (retrieval_r_precision, np_r_precision, {}),
+    (retrieval_normalized_dcg, np_ndcg, {}),
+    (retrieval_normalized_dcg, np_ndcg, {"top_k": 4}),
+    (retrieval_auroc, np_auroc, {}),
+    (retrieval_auroc, np_auroc, {"top_k": 5}),
+]
+
+
+@pytest.mark.parametrize("fn,ref,kwargs", FUNCTIONAL_CASES)
+def test_functional_single_query(fn, ref, kwargs):
+    rng = np.random.default_rng(SEED)
+    for _ in range(8):
+        n = int(rng.integers(3, 20))
+        preds = rng.random(n)
+        target = rng.integers(0, 2, size=n)
+        got = float(fn(jnp.asarray(preds), jnp.asarray(target), **kwargs))
+        want = float(ref(preds, target, **kwargs))
+        assert got == pytest.approx(want, abs=1e-5), (kwargs, preds, target)
+
+
+def test_functional_pr_curve():
+    rng = np.random.default_rng(SEED)
+    for adaptive in (False, True):
+        n = 10
+        preds = rng.random(n)
+        target = rng.integers(0, 2, size=n)
+        prec, rec, topk = retrieval_precision_recall_curve(
+            jnp.asarray(preds), jnp.asarray(target), max_k=6, adaptive_k=adaptive
+        )
+        ref_p, ref_r = np_pr_curve(preds, target, 6, adaptive)
+        np.testing.assert_allclose(np.asarray(prec), ref_p, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(rec), ref_r, atol=1e-5)
+
+
+CLASS_CASES = [
+    (RetrievalMAP, np_average_precision, {}),
+    (RetrievalMAP, np_average_precision, {"top_k": 3}),
+    (RetrievalMRR, np_reciprocal_rank, {}),
+    (RetrievalPrecision, np_precision, {"top_k": 3}),
+    (RetrievalPrecision, np_precision, {"top_k": 20, "adaptive_k": True}),
+    (RetrievalRecall, np_recall, {"top_k": 3}),
+    (RetrievalHitRate, np_hit_rate, {"top_k": 2}),
+    (RetrievalRPrecision, np_r_precision, {}),
+    (RetrievalNormalizedDCG, np_ndcg, {}),
+    (RetrievalNormalizedDCG, np_ndcg, {"top_k": 4}),
+    (RetrievalAUROC, np_auroc, {}),
+]
+
+
+@pytest.mark.parametrize("cls,ref,kwargs", CLASS_CASES)
+@pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+def test_class_metrics(cls, ref, kwargs, empty_action):
+    rng = np.random.default_rng(SEED)
+    graded = cls is RetrievalNormalizedDCG
+    queries = _query_data(rng, graded=graded)
+    preds, target, indexes = _flat(queries)
+
+    metric = cls(empty_target_action=empty_action, **kwargs)
+    # feed in two chunks to exercise accumulation
+    half = len(preds) // 2
+    metric.update(jnp.asarray(preds[:half]), jnp.asarray(target[:half]), jnp.asarray(indexes[:half]))
+    metric.update(jnp.asarray(preds[half:]), jnp.asarray(target[half:]), jnp.asarray(indexes[half:]))
+    got = float(metric.compute())
+
+    ref_kwargs = {k: v for k, v in kwargs.items()}
+    scores = []
+    for p, t in queries:
+        if t.sum() == 0:
+            if empty_action == "skip":
+                continue
+            scores.append(1.0 if empty_action == "pos" else 0.0)
+        else:
+            scores.append(float(ref(p, t, **ref_kwargs)))
+    want = float(np.mean(scores)) if scores else 0.0
+    assert got == pytest.approx(want, abs=1e-5)
+
+
+def test_fall_out_class():
+    rng = np.random.default_rng(SEED)
+    queries = _query_data(rng, empty_frac=0.0)
+    preds, target, indexes = _flat(queries)
+    metric = RetrievalFallOut(top_k=3)
+    metric.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    got = float(metric.compute())
+    scores = []
+    for p, t in queries:
+        if (1 - t).sum() == 0:
+            scores.append(0.0)
+        else:
+            scores.append(np_fall_out(p, t, top_k=3))
+    assert got == pytest.approx(float(np.mean(scores)), abs=1e-5)
+
+
+def test_pr_curve_class_and_recall_at_precision():
+    rng = np.random.default_rng(SEED)
+    queries = _query_data(rng, empty_frac=0.0, min_docs=6, max_docs=10)
+    preds, target, indexes = _flat(queries)
+
+    max_k = 5
+    metric = RetrievalPrecisionRecallCurve(max_k=max_k)
+    metric.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    prec, rec, topk = metric.compute()
+
+    ps, rs = [], []
+    for p, t in queries:
+        rp, rr = np_pr_curve(p, t, max_k)
+        ps.append(rp)
+        rs.append(rr)
+    np.testing.assert_allclose(np.asarray(prec), np.mean(ps, axis=0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(rec), np.mean(rs, axis=0), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(topk), np.arange(1, max_k + 1))
+
+    # recall at fixed precision: brute-force over the averaged curve
+    m2 = RetrievalRecallAtFixedPrecision(min_precision=0.4, max_k=max_k)
+    m2.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    best_r, best_k = m2.compute()
+    avg_p, avg_r = np.mean(ps, axis=0), np.mean(rs, axis=0)
+    cands = [(r, k) for p_, r, k in zip(avg_p, avg_r, range(1, max_k + 1)) if p_ >= 0.4]
+    want_r, want_k = max(cands) if cands else (0.0, max_k)
+    assert float(best_r) == pytest.approx(want_r, abs=1e-5)
+    assert int(best_k) == want_k
+
+
+def test_auroc_tie_half_credit():
+    # tied pos/neg score pairs must get 0.5 credit, not win/lose by sort order
+    assert float(retrieval_auroc(jnp.asarray([0.5, 0.5]), jnp.asarray([1, 0]))) == pytest.approx(0.5)
+    assert float(retrieval_auroc(jnp.asarray([0.5, 0.5]), jnp.asarray([0, 1]))) == pytest.approx(0.5)
+    p = np.array([0.9, 0.5, 0.5, 0.5, 0.1])
+    t = np.array([1, 1, 0, 0, 1])
+    assert float(retrieval_auroc(jnp.asarray(p), jnp.asarray(t))) == pytest.approx(np_auroc(p, t))
+
+
+def test_functional_rejects_graded_target():
+    with pytest.raises(ValueError, match="binary"):
+        retrieval_precision(jnp.asarray([0.9, 0.1]), jnp.asarray([2, 0]))
+
+
+def test_pr_curve_compute_before_update():
+    prec, rec, topk = RetrievalPrecisionRecallCurve(max_k=3).compute()
+    np.testing.assert_array_equal(np.asarray(prec), np.zeros(3))
+    np.testing.assert_array_equal(np.asarray(topk), [1, 2, 3])
+
+
+def test_aggregation_modes():
+    rng = np.random.default_rng(SEED)
+    queries = _query_data(rng, empty_frac=0.0)
+    preds, target, indexes = _flat(queries)
+    scores = [np_precision(p, t, top_k=2) for p, t in queries]
+    for agg, ref in [("median", np.median), ("min", np.min), ("max", np.max)]:
+        m = RetrievalPrecision(top_k=2, aggregation=agg)
+        m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+        assert float(m.compute()) == pytest.approx(float(ref(scores)), abs=1e-5)
+
+
+def test_empty_target_error_raises():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 0]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    m = RetrievalMAP(ignore_index=-1)
+    preds = jnp.asarray([0.9, 0.2, 0.5, 0.3])
+    target = jnp.asarray([1, -1, 0, 1])
+    idx = jnp.asarray([0, 0, 0, 0])
+    m.update(preds, target, idx)
+    want = np_average_precision(np.array([0.9, 0.5, 0.3]), np.array([1, 0, 1]))
+    assert float(m.compute()) == pytest.approx(want, abs=1e-5)
+
+
+def test_non_binary_raises():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="binary"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 2]), jnp.asarray([0, 0]))
+
+
+def test_merge_and_reset():
+    rng = np.random.default_rng(SEED)
+    queries = _query_data(rng, empty_frac=0.0)
+    preds, target, indexes = _flat(queries)
+    m = RetrievalMAP()
+    s1 = m.update_state(m.init_state(), jnp.asarray(preds[:10]), jnp.asarray(target[:10]), jnp.asarray(indexes[:10]))
+    s2 = m.update_state(m.init_state(), jnp.asarray(preds[10:]), jnp.asarray(target[10:]), jnp.asarray(indexes[10:]))
+    merged = m.merge_states(s1, s2)
+    full = m.update_state(m.init_state(), jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    assert float(m.compute_state(merged)) == pytest.approx(float(m.compute_state(full)), abs=1e-6)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    m.reset()
+    assert m.metric_state["preds"] == ()

@@ -1,0 +1,31 @@
+"""Retrieval metrics (reference: src/torchmetrics/retrieval/__init__.py)."""
+
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+from torchmetrics_tpu.retrieval.metrics import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
+
+__all__ = [
+    "RetrievalMetric",
+    "RetrievalAUROC",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRPrecision",
+    "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
+]

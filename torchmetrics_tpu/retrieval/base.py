@@ -1,0 +1,152 @@
+"""Retrieval metric base — grouped-by-query metrics over accumulated triples.
+
+Reference: /root/reference/src/torchmetrics/retrieval/base.py:43-200
+(``RetrievalMetric``).  The reference splits the concatenated arrays per query
+and runs a Python loop; here ``compute`` hands the flat arrays to the
+vectorized sort+segment kernels (functional/retrieval/kernels.py) and gets all
+per-query scores in one XLA call — empty-query policy and aggregation are then
+cheap masked reductions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.retrieval.kernels import RankedGroups, rank_groups
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+_AGG_OPTIONS = ("mean", "median", "min", "max")
+
+
+def _retrieval_aggregate(
+    values: Array,
+    aggregation: Union[str, Callable] = "mean",
+    axis: Optional[int] = None,
+) -> Array:
+    """Aggregate per-query scores (reference base.py:26-41)."""
+    if aggregation == "mean":
+        return values.mean() if axis is None else values.mean(axis=axis)
+    if aggregation == "median":
+        return jnp.median(values) if axis is None else jnp.median(values, axis=axis)
+    if aggregation == "min":
+        return values.min() if axis is None else values.min(axis=axis)
+    if aggregation == "max":
+        return values.max() if axis is None else values.max(axis=axis)
+    return aggregation(values, axis=axis)
+
+
+class RetrievalMetric(Metric):
+    """Base for metrics grouped by query index.
+
+    Accepts ``update(preds, target, indexes)``; scores are computed per query
+    then aggregated.  ``empty_target_action`` controls queries with no positive
+    target: ``'neg'`` → 0, ``'pos'`` → 1, ``'skip'`` → dropped, ``'error'`` →
+    raise (reference base.py:105-132).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    allow_non_binary_target = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if empty_target_action not in ("error", "skip", "neg", "pos"):
+            raise ValueError(
+                f"Argument `empty_target_action` received a wrong value `{empty_target_action}`."
+            )
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        if not (aggregation in _AGG_OPTIONS or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom "
+                f"callable function which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+
+        self.add_state("indexes", [], dist_reduce_fx="cat")
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _check_inputs(self, preds: Array, target: Array, indexes: Array) -> tuple:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        preds = jnp.ravel(jnp.asarray(preds)).astype(jnp.float32)
+        target = jnp.ravel(jnp.asarray(target))
+        indexes = jnp.ravel(jnp.asarray(indexes))
+        if not (preds.shape == target.shape == indexes.shape):
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        # data-dependent validation/filtering happens eagerly only; under a
+        # trace (e.g. sharded_update inside shard_map) shapes are static and
+        # values unavailable, so these host checks are skipped
+        tracing = isinstance(target, jax.core.Tracer)
+        if self.ignore_index is not None:
+            if tracing:
+                raise TorchMetricsUserError(
+                    "`ignore_index` filtering changes shapes and cannot run inside a traced "
+                    "update; filter the inputs before the jitted step instead."
+                )
+            keep = np.asarray(target) != self.ignore_index
+            preds, target, indexes = preds[keep], target[keep], indexes[keep]
+        if not self.allow_non_binary_target and not tracing:
+            tnp = np.asarray(target)
+            if ((tnp != 0) & (tnp != 1)).any():
+                raise ValueError("`target` must contain binary values")
+        return preds, target.astype(jnp.float32), indexes
+
+    def _update(self, state: State, preds: Array, target: Array, indexes: Array) -> State:
+        preds, target, indexes = self._check_inputs(preds, target, indexes)
+        return {
+            "indexes": state["indexes"] + (indexes,),
+            "preds": state["preds"] + (preds,),
+            "target": state["target"] + (target,),
+        }
+
+    # subclass hook: per-group scores from the ranked view
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        raise NotImplementedError
+
+    def _empty_mask(self, rg: RankedGroups) -> Array:
+        """True for queries hit by ``empty_target_action`` (no positive target)."""
+        return rg.n_rel == 0
+
+    def _compute(self, state: State) -> Array:
+        if not state["preds"]:
+            return jnp.zeros(())
+        preds = dim_zero_cat(state["preds"])
+        target = dim_zero_cat(state["target"])
+        indexes = dim_zero_cat(state["indexes"])
+        rg = rank_groups(preds, target, indexes)
+        scores = self._metric_grouped(rg)
+        empty = self._empty_mask(rg)
+        return self._aggregate_scores(scores, empty)
+
+    def _aggregate_scores(self, scores: Array, empty: Array) -> Array:
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "skip":
+            keep = np.asarray(~empty)
+            scores = scores[keep]
+            if scores.size == 0:
+                return jnp.zeros(())
+        elif self.empty_target_action == "pos":
+            scores = jnp.where(empty, 1.0, scores)
+        else:  # neg
+            scores = jnp.where(empty, 0.0, scores)
+        return _retrieval_aggregate(scores, self.aggregation)

@@ -1,0 +1,243 @@
+"""Modular retrieval metrics.
+
+Reference classes: /root/reference/src/torchmetrics/retrieval/{average_precision
+.py:28, fall_out.py:29, hit_rate.py:28, ndcg.py:28, precision.py:28, r_precision
+.py:28, recall.py:28, reciprocal_rank.py:28, auroc.py:30,
+precision_recall_curve.py:63,296}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import State
+from torchmetrics_tpu.functional.retrieval.kernels import (
+    RankedGroups,
+    grouped_auroc,
+    grouped_average_precision,
+    grouped_fall_out,
+    grouped_hit_rate,
+    grouped_ndcg,
+    grouped_precision,
+    grouped_precision_recall_curve,
+    grouped_r_precision,
+    grouped_recall,
+    grouped_reciprocal_rank,
+    rank_groups,
+)
+from torchmetrics_tpu.functional.retrieval.kernels import _check_top_k as _validate_top_k
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _retrieval_aggregate
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean Average Precision (reference retrieval/average_precision.py:28)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_average_precision(rg, self.top_k)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:28)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_reciprocal_rank(rg, self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k (reference retrieval/precision.py:28)."""
+
+    def __init__(
+        self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_precision(rg, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k (reference retrieval/recall.py:28)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_recall(rg, self.top_k)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """HitRate@k (reference retrieval/hit_rate.py:28)."""
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_hit_rate(rg, self.top_k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """FallOut@k; lower is better; empty = queries with no NEGATIVE target
+    (reference retrieval/fall_out.py:29, compute override :136)."""
+
+    higher_is_better = False
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_fall_out(rg, self.top_k)
+
+    def _empty_mask(self, rg: RankedGroups) -> Array:
+        return (rg.sizes - rg.n_rel) == 0
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-Precision (reference retrieval/r_precision.py:28)."""
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        return grouped_r_precision(rg)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """NDCG@k; allows graded (non-binary) relevance (reference retrieval/ndcg.py:28)."""
+
+    allow_non_binary_target = True
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _compute(self, state: State) -> Array:
+        if not state["preds"]:
+            return jnp.zeros(())
+        preds = dim_zero_cat(state["preds"])
+        target = dim_zero_cat(state["target"])
+        indexes = dim_zero_cat(state["indexes"])
+        ndcg, n_rel = grouped_ndcg(preds, target, indexes, self.top_k)
+        return self._aggregate_scores(ndcg, n_rel == 0)
+
+
+class RetrievalAUROC(RetrievalMetric):
+    """Per-query AUROC over retrieved docs (reference retrieval/auroc.py:30)."""
+
+    def __init__(
+        self, top_k: Optional[int] = None, max_fpr: Optional[float] = None, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.top_k = top_k
+        self.max_fpr = max_fpr
+
+    def _metric_grouped(self, rg: RankedGroups) -> Array:
+        if self.max_fpr is not None:
+            # partial AUC needs the per-query ROC curve; delegate per group
+            from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+
+            gid = np.asarray(rg.gid)
+            p, t = np.asarray(rg.preds), np.asarray(rg.target)
+            vals = []
+            for g in range(rg.num_groups):
+                sel = gid == g
+                pg, tg = p[sel], t[sel]
+                if self.top_k is not None:
+                    pg, tg = pg[: self.top_k], tg[: self.top_k]
+                if tg.sum() == 0 or tg.sum() == len(tg):
+                    vals.append(0.0)
+                else:
+                    vals.append(float(binary_auroc(jnp.asarray(pg), jnp.asarray(tg, dtype=jnp.int32), max_fpr=self.max_fpr)))
+            return jnp.asarray(vals, dtype=jnp.float32)
+        return grouped_auroc(rg, self.top_k)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged precision/recall at k=1..max_k across queries
+    (reference retrieval/precision_recall_curve.py:63)."""
+
+    def __init__(
+        self, max_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _compute(self, state: State) -> Tuple[Array, Array, Array]:
+        if not state["preds"]:
+            k = self.max_k or 1
+            return jnp.zeros(k), jnp.zeros(k), jnp.arange(1, k + 1)
+        preds = dim_zero_cat(state["preds"])
+        target = dim_zero_cat(state["target"])
+        indexes = dim_zero_cat(state["indexes"])
+        rg = rank_groups(preds, target, indexes)
+        max_k = self.max_k if self.max_k is not None else int(rg.sizes.max())
+        prec, rec, topk = grouped_precision_recall_curve(rg, max_k, self.adaptive_k)
+        empty = rg.n_rel == 0
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "skip":
+            keep = np.asarray(~empty)
+            prec, rec = prec[keep], rec[keep]
+        else:
+            fill = 1.0 if self.empty_target_action == "pos" else 0.0
+            prec = jnp.where(empty[:, None], fill, prec)
+            rec = jnp.where(empty[:, None], fill, rec)
+        if prec.shape[0] == 0:
+            return jnp.zeros(max_k), jnp.zeros(max_k), topk
+        return (
+            _retrieval_aggregate(prec, self.aggregation, axis=0),
+            _retrieval_aggregate(rec, self.aggregation, axis=0),
+            topk,
+        )
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall with precision >= min_precision, plus the k achieving it
+    (reference retrieval/precision_recall_curve.py:296, helper :36-60)."""
+
+    def __init__(self, min_precision: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a float between 0 and 1")
+        self.min_precision = min_precision
+
+    def _compute(self, state: State) -> Tuple[Array, Array]:
+        precision, recall, top_k = super()._compute(state)
+        p, r, k = np.asarray(precision), np.asarray(recall), np.asarray(top_k)
+        ok = p >= self.min_precision
+        if not ok.any():
+            return jnp.asarray(0.0), jnp.asarray(k[-1] if k.size else 0)
+        pairs = sorted(zip(r[ok].tolist(), k[ok].tolist()))
+        best_r, best_k = pairs[-1]
+        return jnp.asarray(best_r, dtype=jnp.float32), jnp.asarray(int(best_k))
