@@ -1,0 +1,328 @@
+"""Text metric tests.
+
+Oracles are the reference library's own doctest outputs
+(/root/reference/src/torchmetrics/functional/text/*.py docstring examples) —
+the exact values the upstream implementation prints for the same inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text import (
+    bleu_score,
+    char_error_rate,
+    chrf_score,
+    edit_distance,
+    extended_edit_distance,
+    infolm,
+    match_error_rate,
+    perplexity,
+    rouge_score,
+    sacre_bleu_score,
+    squad,
+    translation_edit_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.text import (
+    BERTScore,
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    InfoLM,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+BLEU_PREDS = ["the cat is on the mat"]
+BLEU_TARGET = [["there is a cat on the mat", "a cat is on the mat"]]
+
+ASR_PREDS = ["this is the prediction", "there is an other sample"]
+ASR_TARGET = ["this is the reference", "there is another one"]
+
+EED_PREDS = ["this is the prediction", "here is an other sample"]
+EED_TARGET = ["this is the reference", "here is another one"]
+
+
+# ---------------------------------------------------------------- functional
+def test_bleu_oracle():
+    assert float(bleu_score(BLEU_PREDS, BLEU_TARGET)) == pytest.approx(0.7598, abs=1e-4)
+
+
+def test_sacre_bleu_oracle():
+    assert float(sacre_bleu_score(BLEU_PREDS, BLEU_TARGET)) == pytest.approx(0.7598, abs=1e-4)
+
+
+def test_sacre_bleu_tokenizers_run():
+    for tok in ("none", "13a", "char", "intl", "zh"):
+        v = float(sacre_bleu_score(BLEU_PREDS, BLEU_TARGET, tokenize=tok))
+        assert 0.0 <= v <= 1.0
+
+
+def test_chrf_oracle():
+    assert float(chrf_score(BLEU_PREDS, BLEU_TARGET)) == pytest.approx(0.8640, abs=1e-4)
+
+
+def test_ter_oracle():
+    assert float(translation_edit_rate(BLEU_PREDS, BLEU_TARGET)) == pytest.approx(0.1538, abs=1e-4)
+
+
+def test_eed_oracle():
+    assert float(extended_edit_distance(EED_PREDS, EED_TARGET)) == pytest.approx(0.3078, abs=1e-4)
+
+
+def test_wer_oracle():
+    assert float(word_error_rate(ASR_PREDS, ASR_TARGET)) == pytest.approx(0.5, abs=1e-4)
+
+
+def test_cer_oracle():
+    assert float(char_error_rate(ASR_PREDS, ASR_TARGET)) == pytest.approx(0.3415, abs=1e-4)
+
+
+def test_mer_oracle():
+    assert float(match_error_rate(ASR_PREDS, ASR_TARGET)) == pytest.approx(0.4444, abs=1e-4)
+
+
+def test_wil_oracle():
+    assert float(word_information_lost(ASR_PREDS, ASR_TARGET)) == pytest.approx(0.6528, abs=1e-4)
+
+
+def test_wip_oracle():
+    assert float(word_information_preserved(ASR_PREDS, ASR_TARGET)) == pytest.approx(0.3472, abs=1e-4)
+
+
+def test_edit_distance_oracles():
+    assert float(edit_distance(["rain"], ["shine"])) == 3.0
+    assert float(edit_distance(["rain"], ["shine"], substitution_cost=2)) == 5.0
+    np.testing.assert_array_equal(
+        np.asarray(edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction=None)), [3, 4]
+    )
+    assert float(edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction="mean")) == 3.5
+
+
+def test_perplexity_oracle():
+    import torch
+
+    gen = torch.manual_seed(42)
+    preds = torch.rand(2, 8, 5, generator=gen)
+    target = torch.randint(5, (2, 8), generator=gen)
+    target[0, 6:] = -100
+    got = float(perplexity(jnp.asarray(preds.numpy()), jnp.asarray(target.numpy()), ignore_index=-100))
+    assert got == pytest.approx(5.8540, abs=1e-3)
+
+
+def test_squad_oracle():
+    preds = [{"prediction_text": "1976", "id": "56e10a3be3433e1400422b22"}]
+    target = [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "56e10a3be3433e1400422b22"}]
+    res = squad(preds, target)
+    assert float(res["exact_match"]) == 100.0
+    assert float(res["f1"]) == 100.0
+
+
+def test_rouge_oracle():
+    res = rouge_score("My name is John", "Is your name John")
+    assert float(res["rouge1_fmeasure"]) == pytest.approx(0.75, abs=1e-4)
+    assert float(res["rouge1_precision"]) == pytest.approx(0.75, abs=1e-4)
+    assert float(res["rouge2_fmeasure"]) == pytest.approx(0.0, abs=1e-4)
+    assert float(res["rougeL_fmeasure"]) == pytest.approx(0.5, abs=1e-4)
+    assert float(res["rougeLsum_fmeasure"]) == pytest.approx(0.5, abs=1e-4)
+
+
+def test_rouge_multi_ref_avg_vs_best():
+    preds = ["the cat sat on the mat"]
+    targets = [["a cat sat on the mat", "the dog sat on the rug"]]
+    best = rouge_score(preds, targets, accumulate="best")
+    avg = rouge_score(preds, targets, accumulate="avg")
+    assert float(best["rouge1_fmeasure"]) >= float(avg["rouge1_fmeasure"])
+
+
+def test_bert_score_identical_higher():
+    from torchmetrics_tpu.functional.text import bert_score
+
+    out_same = bert_score(["the cat sat"], ["the cat sat"])
+    out_diff = bert_score(["the cat sat"], ["a completely different sentence here"])
+    assert float(out_same["f1"][0]) == pytest.approx(1.0, abs=1e-5)
+    assert float(out_diff["f1"][0]) < 1.0
+
+
+def test_infolm_measures_run():
+    preds = ["he read the book because he was interested in world history"]
+    target = ["he was interested in world history because he read the book"]
+    for measure, kw in [
+        ("kl_divergence", {}),
+        ("alpha_divergence", {"alpha": 0.5}),
+        ("beta_divergence", {"beta": 0.5}),
+        ("ab_divergence", {"alpha": 0.5, "beta": 0.5}),
+        ("renyi_divergence", {"alpha": 0.5}),
+        ("l1_distance", {}),
+        ("l2_distance", {}),
+        ("l_infinity_distance", {}),
+        ("fisher_rao_distance", {}),
+    ]:
+        v = float(infolm(preds, target, information_measure=measure, **kw))
+        assert np.isfinite(v), measure
+    # identical sentences => zero distance for symmetric measures
+    same = float(infolm(["a b c"], ["a b c"], information_measure="l1_distance"))
+    assert same == pytest.approx(0.0, abs=1e-5)
+
+
+def test_infolm_param_validation():
+    with pytest.raises(ValueError, match="alpha"):
+        infolm(["a"], ["a"], information_measure="alpha_divergence")
+    with pytest.raises(ValueError, match="information_measure"):
+        infolm(["a"], ["a"], information_measure="bogus")
+    with pytest.raises(ValueError, match="alpha"):
+        InfoLM(information_measure="alpha_divergence")
+
+
+def test_infolm_idf_changes_score():
+    # 'the' appears in both target docs (idf 0) while others appear in one —
+    # non-uniform idf weights must change the aggregated distributions
+    preds = ["the cat sat quietly", "the dog ran fast"]
+    target = ["the cat sat there", "the dog ran away"]
+    with_idf = float(infolm(preds, target, information_measure="l2_distance", idf=True))
+    without = float(infolm(preds, target, information_measure="l2_distance", idf=False))
+    assert with_idf != without
+
+
+def test_sacre_bleu_intl_tokenizer():
+    from torchmetrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+
+    tok = _SacreBLEUTokenizer("intl")
+    assert tok("1!a") == ["1", "!", "a"]
+    # punct between digit and non-digit contexts (sacrebleu \P{N}\p{P} rules):
+    # '5%' alone has no non-digit neighbor => stays joined; with a following
+    # word the trailing rule splits it
+    assert tok("5%") == ["5%"]
+    assert tok("5% off") == ["5", "%", "off"]
+    assert tok("end 1.") == ["end", "1."]
+    assert float(sacre_bleu_score(["so 1!a works"], [["so 1 ! a works"]], tokenize="intl")) > 0.99
+
+
+# ------------------------------------------------------------------- classes
+@pytest.mark.parametrize(
+    "cls,fn,preds,target,kwargs",
+    [
+        (BLEUScore, bleu_score, BLEU_PREDS, BLEU_TARGET, {}),
+        (SacreBLEUScore, sacre_bleu_score, BLEU_PREDS, BLEU_TARGET, {}),
+        (CHRFScore, chrf_score, BLEU_PREDS, BLEU_TARGET, {}),
+        (TranslationEditRate, translation_edit_rate, BLEU_PREDS, BLEU_TARGET, {}),
+        (ExtendedEditDistance, extended_edit_distance, EED_PREDS, EED_TARGET, {}),
+        (WordErrorRate, word_error_rate, ASR_PREDS, ASR_TARGET, {}),
+        (CharErrorRate, char_error_rate, ASR_PREDS, ASR_TARGET, {}),
+        (MatchErrorRate, match_error_rate, ASR_PREDS, ASR_TARGET, {}),
+        (WordInfoLost, word_information_lost, ASR_PREDS, ASR_TARGET, {}),
+        (WordInfoPreserved, word_information_preserved, ASR_PREDS, ASR_TARGET, {}),
+    ],
+)
+def test_class_matches_functional(cls, fn, preds, target, kwargs):
+    metric = cls(**kwargs)
+    metric.update(preds, target)
+    assert float(metric.compute()) == pytest.approx(float(fn(preds, target)), abs=1e-5)
+
+
+def test_class_accumulation_wer():
+    # feeding two batches must equal one concatenated call
+    m = WordErrorRate()
+    m.update([ASR_PREDS[0]], [ASR_TARGET[0]])
+    m.update([ASR_PREDS[1]], [ASR_TARGET[1]])
+    assert float(m.compute()) == pytest.approx(float(word_error_rate(ASR_PREDS, ASR_TARGET)), abs=1e-6)
+
+
+def test_class_accumulation_bleu_merge():
+    m1, m2 = BLEUScore(), BLEUScore()
+    s1 = m1.update_state(m1.init_state(), ["the cat is on the mat"], [["a cat is on the mat"]])
+    s2 = m2.update_state(m2.init_state(), ["there is a dog"], [["there is a dog outside"]])
+    merged = m1.merge_states(s1, s2)
+    full = m1.update_state(
+        m1.init_state(),
+        ["the cat is on the mat", "there is a dog"],
+        [["a cat is on the mat"], ["there is a dog outside"]],
+    )
+    np.testing.assert_allclose(
+        np.asarray(m1.compute_state(merged)), np.asarray(m1.compute_state(full)), atol=1e-6
+    )
+
+
+def test_rouge_class():
+    m = ROUGEScore()
+    m.update("My name is John", "Is your name John")
+    res = m.compute()
+    assert float(res["rouge1_fmeasure"]) == pytest.approx(0.75, abs=1e-4)
+
+
+def test_perplexity_class_jit():
+    import torch
+
+    gen = torch.manual_seed(42)
+    preds = torch.rand(2, 8, 5, generator=gen)
+    target = torch.randint(5, (2, 8), generator=gen)
+    m = Perplexity(jit=True)
+    m.update(jnp.asarray(preds.numpy()), jnp.asarray(target.numpy()))
+    v = float(m.compute())
+    ref = float(perplexity(jnp.asarray(preds.numpy()), jnp.asarray(target.numpy())))
+    assert v == pytest.approx(ref, rel=1e-5)
+
+
+def test_squad_class():
+    m = SQuAD()
+    m.update(
+        [{"prediction_text": "1976", "id": "1"}],
+        [{"answers": {"answer_start": [97], "text": ["1976"]}, "id": "1"}],
+    )
+    m.update(
+        [{"prediction_text": "wrong", "id": "2"}],
+        [{"answers": {"answer_start": [1], "text": ["right"]}, "id": "2"}],
+    )
+    res = m.compute()
+    assert float(res["exact_match"]) == pytest.approx(50.0)
+
+
+def test_bert_score_class():
+    m = BERTScore()
+    m.update(["the cat sat"], ["the cat sat"])
+    m.update(["hello world"], ["goodbye world"])
+    res = m.compute()
+    assert res["f1"].shape == (2,)
+    assert float(res["f1"][0]) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_infolm_class():
+    m = InfoLM(information_measure="l2_distance")
+    m.update(["a b c"], ["a b c"])
+    assert float(m.compute()) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_edit_distance_class_none_reduction():
+    m = EditDistance(reduction="none")
+    m.update(["rain"], ["shine"])
+    m.update(["lnaguaeg"], ["language"])
+    np.testing.assert_array_equal(np.asarray(m.compute()), [3, 4])
+
+
+def test_chrf_sentence_level():
+    m = CHRFScore(return_sentence_level_score=True)
+    m.update(BLEU_PREDS, BLEU_TARGET)
+    corpus, sentences = m.compute()
+    assert sentences.shape == (1,)
+    assert float(corpus) == pytest.approx(0.8640, abs=1e-4)
+
+
+def test_bleu_empty_and_no_match():
+    assert float(bleu_score(["x y z"], [["a b c"]])) == 0.0
+    m = BLEUScore()
+    m.update(["x y z"], [["a b c"]])
+    assert float(m.compute()) == 0.0

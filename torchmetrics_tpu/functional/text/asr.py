@@ -1,0 +1,116 @@
+"""Word/char error-rate family: WER, CER, MER, WIL, WIP, EditDistance.
+
+Reference: /root/reference/src/torchmetrics/functional/text/{wer.py:24,
+cer.py:24, mer.py:24, wil.py:24, wip.py:24, edit.py:24}.  All are host-side
+token DP feeding scalar count states; the reference stores (errors, total)
+the same way.  WIL/WIP store hits = Σmax(len) − Σedits directly instead of the
+reference's negated-errors trick (wil.py/wip.py `errors - total`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+
+def _as_list(x: Union[str, List[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)
+
+
+def _wer_update(preds, target) -> Tuple[Array, Array]:
+    errors = total = 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        p, t = pred.split(), tgt.split()
+        errors += _edit_distance(p, t)
+        total += len(t)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def word_error_rate(preds, target) -> Array:
+    errors, total = _wer_update(preds, target)
+    return errors / total
+
+
+def _cer_update(preds, target) -> Tuple[Array, Array]:
+    errors = total = 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def char_error_rate(preds, target) -> Array:
+    errors, total = _cer_update(preds, target)
+    return errors / total
+
+
+def _mer_update(preds, target) -> Tuple[Array, Array]:
+    errors = total = 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        p, t = pred.split(), tgt.split()
+        errors += _edit_distance(p, t)
+        total += max(len(t), len(p))
+    return jnp.asarray(float(errors)), jnp.asarray(float(total))
+
+
+def match_error_rate(preds, target) -> Array:
+    errors, total = _mer_update(preds, target)
+    return errors / total
+
+
+def _wil_wip_update(preds, target) -> Tuple[Array, Array, Array]:
+    """Returns (hits, target_total, preds_total); hits = Σ max(len) − Σ edits."""
+    edits = total = target_total = preds_total = 0
+    for pred, tgt in zip(_as_list(preds), _as_list(target)):
+        p, t = pred.split(), tgt.split()
+        edits += _edit_distance(p, t)
+        target_total += len(t)
+        preds_total += len(p)
+        total += max(len(t), len(p))
+    hits = total - edits
+    return (
+        jnp.asarray(float(hits)),
+        jnp.asarray(float(target_total)),
+        jnp.asarray(float(preds_total)),
+    )
+
+
+def word_information_preserved(preds, target) -> Array:
+    hits, tt, pt = _wil_wip_update(preds, target)
+    return (hits / tt) * (hits / pt)
+
+
+def word_information_lost(preds, target) -> Array:
+    return 1.0 - word_information_preserved(preds, target)
+
+
+def _edit_update(
+    preds, target, substitution_cost: int = 1
+) -> List[int]:
+    preds_l, target_l = _as_list(preds), _as_list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds_l)} and {len(target_l)}"
+        )
+    return [
+        _edit_distance(list(pred), list(tgt), substitution_cost)
+        for pred, tgt in zip(preds_l, target_l)
+    ]
+
+
+def edit_distance(
+    preds, target, substitution_cost: int = 1, reduction: Optional[str] = "mean"
+) -> Array:
+    """Char-level Levenshtein distance (reference functional/text/edit.py:79)."""
+    dists = jnp.asarray(_edit_update(preds, target, substitution_cost), dtype=jnp.float32)
+    if reduction == "mean":
+        return dists.mean()
+    if reduction == "sum":
+        return dists.sum()
+    if reduction is None or reduction == "none":
+        return dists
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")

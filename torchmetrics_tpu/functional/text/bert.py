@@ -1,0 +1,183 @@
+"""BERTScore (reference: functional/text/bert.py + text/bert.py:54).
+
+Greedy token matching over contextual-embedding cosine similarity.  The
+embedding model is pluggable: any ``(input_ids, attention_mask) -> (B, T, H)``
+callable (a Flax/HF model, or a custom encoder).  Tokenization happens
+host-side and tokenized ids — not strings — are what accumulates, exactly the
+reference's design (text/bert.py:194-197 stores input_ids/attention_mask as
+"cat" states so sync never moves Python strings).
+
+The similarity/matching core (`_bert_score_from_embeddings`) is pure JAX and
+jittable — one (B, Tp, Tt) batched matmul on the MXU instead of the
+reference's per-pair loop.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+class WhitespaceTokenizer:
+    """Minimal host tokenizer building a vocab on the fly (test/fallback path).
+
+    Real use plugs an HF tokenizer via ``user_tokenizer`` (reference bert.py
+    accepts the same).
+    """
+
+    def __init__(self, max_length: int = 128) -> None:
+        self.vocab: Dict[str, int] = {"<pad>": 0, "<unk>": 1}
+        self.max_length = max_length
+
+    def __call__(self, texts: Sequence[str]) -> Dict[str, np.ndarray]:
+        ids = []
+        for text in texts:
+            toks = text.lower().split()[: self.max_length]
+            row = []
+            for t in toks:
+                if t not in self.vocab:
+                    self.vocab[t] = len(self.vocab)
+                row.append(self.vocab[t])
+            ids.append(row)
+        max_len = max((len(r) for r in ids), default=1) or 1
+        input_ids = np.zeros((len(texts), max_len), dtype=np.int32)
+        attention_mask = np.zeros((len(texts), max_len), dtype=np.int32)
+        for i, row in enumerate(ids):
+            input_ids[i, : len(row)] = row
+            attention_mask[i, : len(row)] = 1
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _compute_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Inverse-document-frequency weights over the target corpus
+    (reference functional/text/bert.py idf rescaling)."""
+    n_docs = input_ids.shape[0]
+    df: Counter = Counter()
+    for row, mask in zip(input_ids, attention_mask):
+        df.update(set(int(t) for t, m in zip(row, mask) if m))
+    return {tok: float(np.log((n_docs + 1) / (cnt + 1))) for tok, cnt in df.items()}
+
+
+def _idf_weights(input_ids: np.ndarray, attention_mask: np.ndarray, idf: Dict[int, float]) -> np.ndarray:
+    w = np.zeros(input_ids.shape, dtype=np.float32)
+    for i in range(input_ids.shape[0]):
+        for j in range(input_ids.shape[1]):
+            if attention_mask[i, j]:
+                w[i, j] = idf.get(int(input_ids[i, j]), float(np.log((input_ids.shape[0] + 1) / 1)))
+    return w
+
+
+def _bert_score_from_embeddings(
+    pred_emb: Array,
+    pred_mask: Array,
+    target_emb: Array,
+    target_mask: Array,
+    pred_weights: Optional[Array] = None,
+    target_weights: Optional[Array] = None,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matching P/R/F1 per pair — pure JAX, jittable.
+
+    pred_emb: (B, Tp, H); target_emb: (B, Tt, H); masks are 0/1.
+    """
+    pred_n = pred_emb / jnp.maximum(jnp.linalg.norm(pred_emb, axis=-1, keepdims=True), 1e-12)
+    tgt_n = target_emb / jnp.maximum(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), 1e-12)
+    sim = jnp.einsum("bph,bth->bpt", pred_n, tgt_n)
+    valid = pred_mask[:, :, None] * target_mask[:, None, :]
+    sim = jnp.where(valid > 0, sim, -1e9)
+
+    pm = pred_mask.astype(jnp.float32)
+    tm = target_mask.astype(jnp.float32)
+    pw = pm if pred_weights is None else pred_weights * pm
+    tw = tm if target_weights is None else target_weights * tm
+
+    best_for_pred = jnp.where(pm > 0, sim.max(axis=2), 0.0)
+    best_for_tgt = jnp.where(tm > 0, sim.max(axis=1), 0.0)
+    precision = (best_for_pred * pw).sum(-1) / jnp.maximum(pw.sum(-1), 1e-12)
+    recall = (best_for_tgt * tw).sum(-1) / jnp.maximum(tw.sum(-1), 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+    truncation: bool = False,
+) -> Dict[str, Array]:
+    """BERTScore P/R/F1 per sentence pair (reference functional/text/bert.py:bert_score).
+
+    ``model`` (or ``user_forward_fn``) must map (input_ids, attention_mask) to
+    (B, T, H) embeddings.  Without a model, a deterministic hash-embedding
+    encoder is used so the metric is runnable hermetically (pretrained weights
+    cannot be downloaded in this environment; reference downloads
+    roberta-large at import time, bert.py:40-52).
+    """
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
+    embed_fn = user_forward_fn or model or _hash_embedding_model
+
+    pred_tok = tokenizer(preds_l)
+    tgt_tok = tokenizer(target_l)
+    pred_ids, pred_mask = np.asarray(pred_tok["input_ids"]), np.asarray(pred_tok["attention_mask"])
+    tgt_ids, tgt_mask = np.asarray(tgt_tok["input_ids"]), np.asarray(tgt_tok["attention_mask"])
+
+    # pad to common length so one batched matmul covers every pair
+    t_max = max(pred_ids.shape[1], tgt_ids.shape[1])
+    pred_ids = np.pad(pred_ids, ((0, 0), (0, t_max - pred_ids.shape[1])))
+    pred_mask = np.pad(pred_mask, ((0, 0), (0, t_max - pred_mask.shape[1])))
+    tgt_ids = np.pad(tgt_ids, ((0, 0), (0, t_max - tgt_ids.shape[1])))
+    tgt_mask = np.pad(tgt_mask, ((0, 0), (0, t_max - tgt_mask.shape[1])))
+
+    pred_emb = jnp.asarray(embed_fn(jnp.asarray(pred_ids), jnp.asarray(pred_mask)))
+    tgt_emb = jnp.asarray(embed_fn(jnp.asarray(tgt_ids), jnp.asarray(tgt_mask)))
+
+    pw = tw = None
+    if idf:
+        idf_map = _compute_idf(tgt_ids, tgt_mask)
+        pw = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
+        tw = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
+
+    precision, recall, f1 = _bert_score_from_embeddings(
+        pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pw, tw
+    )
+    out = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        out["hash"] = f"tpu_bert_score(model={model_name_or_path or 'hash-embedding'})"  # type: ignore[assignment]
+    return out
+
+
+def _hash_embedding_model(input_ids: Array, attention_mask: Array, dim: int = 128) -> Array:
+    """Deterministic token-hash embeddings — hermetic fallback encoder."""
+    ids = input_ids.astype(jnp.uint32)
+    ar = jnp.arange(dim, dtype=jnp.uint32)
+    x = ids[..., None] * jnp.uint32(2654435761) + ar * jnp.uint32(40503)
+    x ^= x >> 16
+    x = x * jnp.uint32(2246822519)
+    x ^= x >> 13
+    vals = (x % jnp.uint32(10007)).astype(jnp.float32) / 10007.0 - 0.5
+    return vals * attention_mask[..., None]

@@ -1,0 +1,107 @@
+"""BLEU score (reference: functional/text/bleu.py:60-220).
+
+N-gram counting is host-side (strings never reach the device); the metric
+state is four arrays — clipped-match numerator/denominator per n-gram order
+plus candidate/reference length sums — exactly the reference's state layout
+(text/bleu.py:33 class states), which makes cross-device sync a plain psum.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.text.helper import _count_ngram
+
+
+def _tokenize_fn(line: str) -> Sequence[str]:
+    return line.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    numerator: np.ndarray,
+    denominator: np.ndarray,
+    preds_len: float,
+    target_len: float,
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[float, float]:
+    """Accumulate clipped n-gram matches (reference bleu.py:60-107)."""
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        target_lens = [len(t) for t in targets]
+        diffs = [abs(len(pred) - x) for x in target_lens]
+        target_len += target_lens[diffs.index(min(diffs))]
+
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+        clipped = preds_counter & target_counter
+        for ng in clipped:
+            numerator[len(ng) - 1] += clipped[ng]
+        for ng in preds_counter:
+            denominator[len(ng) - 1] += preds_counter[ng]
+    return preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Geometric mean of modified precisions × brevity penalty (bleu.py:109-147)."""
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    if float(numerator.min()) == 0.0:
+        return jnp.asarray(0.0)
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    log_precision = jnp.asarray(list(weights), jnp.float32) * jnp.log(precision)
+    geometric_mean = jnp.exp(log_precision.sum())
+    brevity = jnp.where(preds_len > target_len, 1.0, jnp.exp(1.0 - target_len / preds_len))
+    return brevity * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """Corpus BLEU with one or more references per sample (bleu.py:149-220)."""
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len, target_len = _bleu_score_update(
+        preds_, target_, numerator, denominator, 0.0, 0.0, n_gram
+    )
+    return _bleu_score_compute(
+        jnp.asarray(preds_len), jnp.asarray(target_len),
+        jnp.asarray(numerator), jnp.asarray(denominator), n_gram, weights, smooth
+    )

@@ -1,0 +1,189 @@
+"""InfoLM (reference: functional/text/infolm.py:54-560).
+
+Information measures between per-sentence token distributions produced by a
+masked language model.  The LM is pluggable — any
+``(input_ids, attention_mask) -> (B, T, V)`` logits/probability callable —
+because pretrained weights cannot be fetched hermetically (the reference
+downloads ``google/bert_uncased_L-2_H-128_A-2`` at runtime, infolm.py:~100).
+All nine information measures are pure JAX and jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.text.bert import (
+    WhitespaceTokenizer,
+    _compute_idf,
+    _hash_embedding_model,
+    _idf_weights,
+)
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """Measure dispatch + parameter validation (reference infolm.py:72-296)."""
+
+    def __init__(
+        self,
+        information_measure: str = "kl_divergence",
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+    ) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` is expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+            )
+        needs_alpha = information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        needs_beta = information_measure in ("beta_divergence", "ab_divergence")
+        if needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+        if needs_beta and not isinstance(beta, float):
+            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and alpha in (0.0, 1.0):
+            raise ValueError("Parameter `alpha` is expected to be differened from 0 and 1 for alpha divergence.")
+        if information_measure == "beta_divergence" and beta in (0.0, -1.0):
+            raise ValueError("Parameter `beta` is expected to be differened from 0 and -1 for beta divergence.")
+        if information_measure == "ab_divergence" and (
+            0.0 in (alpha, beta) or alpha + beta == 0.0  # type: ignore[operator]
+        ):
+            raise ValueError(
+                "Parameters `alpha`, `beta` and their sum are expected to differ from 0 for AB divergence."
+            )
+        if information_measure == "renyi_divergence" and alpha == 1.0:
+            raise ValueError("Parameter `alpha` is expected to be differened from 1 for Rényi divergence.")
+        self.information_measure = information_measure
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        return getattr(self, f"_calculate_{self.information_measure}")(
+            preds_distribution, target_distribution
+        )
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.abs(t - p).sum(axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.square(t - p).sum(axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.abs(t - p).max(axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sqrt(p * t).sum(axis=-1), 0, 1))
+
+
+def _hash_lm(input_ids: Array, attention_mask: Array, vocab_size: int = 512) -> Array:
+    """Deterministic fallback masked-LM distribution (hermetic testing)."""
+    emb = _hash_embedding_model(input_ids, attention_mask, dim=vocab_size)
+    return jax.nn.softmax(emb * 8.0, axis=-1)
+
+
+def _sentence_distribution(
+    logits_or_probs: Array, attention_mask: Array, idf_weights: Optional[Array] = None
+) -> Array:
+    """Aggregate per-token distributions to one per-sentence distribution."""
+    probs = logits_or_probs
+    if (jnp.abs(probs.sum(-1) - 1.0) > 1e-3).any():
+        probs = jax.nn.softmax(probs, axis=-1)
+    w = attention_mask.astype(jnp.float32)
+    if idf_weights is not None:
+        w = w * idf_weights
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-12)
+    return (probs * w[..., None]).sum(axis=1)
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    device: Optional[Any] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
+    return_sentence_level_score: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Optional[Any] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM score (reference infolm.py:560-680); ``model`` maps
+    (input_ids, attention_mask) to (B, T, V) distributions."""
+    preds_l = [preds] if isinstance(preds, str) else list(preds)
+    target_l = [target] if isinstance(target, str) else list(target)
+    if len(preds_l) != len(target_l):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length or 128)
+    lm = model or _hash_lm
+
+    pred_tok = tokenizer(preds_l)
+    tgt_tok = tokenizer(target_l)
+    p_ids, p_mask = jnp.asarray(pred_tok["input_ids"]), jnp.asarray(pred_tok["attention_mask"])
+    t_ids, t_mask = jnp.asarray(tgt_tok["input_ids"]), jnp.asarray(tgt_tok["attention_mask"])
+
+    p_idf = t_idf = None
+    if idf:
+        # idf-weighted token aggregation over the target corpus (reference infolm.py:409-419)
+        idf_map = _compute_idf(np.asarray(t_ids), np.asarray(t_mask))
+        p_idf = jnp.asarray(_idf_weights(np.asarray(p_ids), np.asarray(p_mask), idf_map))
+        t_idf = jnp.asarray(_idf_weights(np.asarray(t_ids), np.asarray(t_mask), idf_map))
+
+    p_dist = _sentence_distribution(jnp.asarray(lm(p_ids, p_mask)) / temperature, p_mask, p_idf)
+    t_dist = _sentence_distribution(jnp.asarray(lm(t_ids, t_mask)) / temperature, t_mask, t_idf)
+    # floor to keep log/ratio measures finite
+    p_dist = jnp.maximum(p_dist, 1e-12)
+    t_dist = jnp.maximum(t_dist, 1e-12)
+
+    per_sentence = measure(p_dist, t_dist)
+    score = per_sentence.mean()
+    if return_sentence_level_score:
+        return score, per_sentence
+    return score

@@ -1,0 +1,58 @@
+"""Perplexity — fully jittable tensor kernel (reference: functional/text/
+perplexity.py:65-130).
+
+The only text metric whose inputs are already tensors (B, T, V logits), so
+unlike the host-side string metrics this one runs on-device and fuses into the
+eval step under ``jit``; ``ignore_index`` is a static argument so the mask
+compiles to a select.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _perplexity_update(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """Returns (total −log-prob, token count)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            f"Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size], but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+
+    logp = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        mask = target != ignore_index
+        safe_target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+        safe_target = target
+    picked = jnp.take_along_axis(logp, safe_target[:, None], axis=1)[:, 0]
+    total = -(picked * mask).sum()
+    count = mask.sum().astype(jnp.float32)
+    return total, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """exp of mean negative log-likelihood of target tokens."""
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

@@ -1,0 +1,38 @@
+"""Text metrics (reference: src/torchmetrics/text/__init__.py)."""
+
+from torchmetrics_tpu.text.asr import (
+    CharErrorRate,
+    EditDistance,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from torchmetrics_tpu.text.bert import BERTScore
+from torchmetrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
+from torchmetrics_tpu.text.chrf import CHRFScore
+from torchmetrics_tpu.text.eed import ExtendedEditDistance
+from torchmetrics_tpu.text.infolm import InfoLM
+from torchmetrics_tpu.text.perplexity import Perplexity
+from torchmetrics_tpu.text.rouge import ROUGEScore
+from torchmetrics_tpu.text.squad import SQuAD
+from torchmetrics_tpu.text.ter import TranslationEditRate
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

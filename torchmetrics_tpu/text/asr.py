@@ -1,0 +1,145 @@
+"""ASR error-rate modular metrics: WER/CER/MER/WIL/WIP/EditDistance.
+
+Reference: text/{wer.py:28, cer.py:28, mer.py:28, wil.py:28, wip.py:28,
+edit.py:29}.  All keep scalar sum states; EditDistance with
+``reduction='none'`` keeps a cat state of per-sample distances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.asr import (
+    _cer_update,
+    _edit_update,
+    _mer_update,
+    _wer_update,
+    _wil_wip_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _ErrorRateMetric(Metric):
+    """Base for (errors, total) ratio metrics."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    _update_fn = None  # set by subclass
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Union[str, List[str]], target: Union[str, List[str]]) -> State:
+        errors, total = type(self)._update_fn(preds, target)
+        return {"errors": state["errors"] + errors, "total": state["total"] + total}
+
+    def _compute(self, state: State) -> Array:
+        return state["errors"] / state["total"]
+
+
+class WordErrorRate(_ErrorRateMetric):
+    """WER (reference text/wer.py:28)."""
+
+    _update_fn = staticmethod(_wer_update)
+
+
+class CharErrorRate(_ErrorRateMetric):
+    """CER (reference text/cer.py:28)."""
+
+    _update_fn = staticmethod(_cer_update)
+
+
+class MatchErrorRate(_ErrorRateMetric):
+    """MER (reference text/mer.py:28)."""
+
+    _update_fn = staticmethod(_mer_update)
+
+
+class _WordInfoBase(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("hits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Union[str, List[str]], target: Union[str, List[str]]) -> State:
+        hits, tt, pt = _wil_wip_update(preds, target)
+        return {
+            "hits": state["hits"] + hits,
+            "target_total": state["target_total"] + tt,
+            "preds_total": state["preds_total"] + pt,
+        }
+
+    def _wip(self, state: State) -> Array:
+        return (state["hits"] / state["target_total"]) * (state["hits"] / state["preds_total"])
+
+
+class WordInfoPreserved(_WordInfoBase):
+    """WIP (reference text/wip.py:28)."""
+
+    higher_is_better = True
+
+    def _compute(self, state: State) -> Array:
+        return self._wip(state)
+
+
+class WordInfoLost(_WordInfoBase):
+    """WIL (reference text/wil.py:28)."""
+
+    higher_is_better = False
+
+    def _compute(self, state: State) -> Array:
+        return 1.0 - self._wip(state)
+
+
+class EditDistance(Metric):
+    """Char-level Levenshtein (reference text/edit.py:29)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, substitution_cost: int = 1, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
+            raise ValueError(
+                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+            )
+        if reduction not in ("mean", "sum", "none", None):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.substitution_cost = substitution_cost
+        self.reduction = reduction
+
+        if reduction in ("none", None):
+            self.add_state("values", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("values", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Union[str, List[str]], target: Union[str, List[str]]) -> State:
+        dists = _edit_update(preds, target, self.substitution_cost)
+        if self.reduction in ("none", None):
+            return {"values": state["values"] + (jnp.asarray(dists, jnp.float32),)}
+        return {
+            "values": state["values"] + float(sum(dists)),
+            "count": state["count"] + float(len(dists)),
+        }
+
+    def _compute(self, state: State) -> Array:
+        if self.reduction in ("none", None):
+            return dim_zero_cat(state["values"]) if state["values"] else jnp.zeros(0)
+        if self.reduction == "sum":
+            return state["values"]
+        return state["values"] / jnp.maximum(state["count"], 1.0)

@@ -1,0 +1,117 @@
+"""BERTScore modular metric (reference: text/bert.py:54-260).
+
+Stores tokenized input_ids/attention_mask as cat states — strings never enter
+the sync path (reference text/bert.py:194-197, the precedent SURVEY.md
+§2.4-text calls out).  The embedding model is pluggable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.bert import (
+    WhitespaceTokenizer,
+    _bert_score_from_embeddings,
+    _compute_idf,
+    _hash_embedding_model,
+    _idf_weights,
+)
+
+
+class BERTScore(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        truncation: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.idf = idf
+        self.return_hash = return_hash
+        self.tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
+        self.embed_fn = user_forward_fn or model or _hash_embedding_model
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, state: State, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+    ) -> State:
+        preds_l = [preds] if isinstance(preds, str) else list(preds)
+        target_l = [target] if isinstance(target, str) else list(target)
+        if len(preds_l) != len(target_l):
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        p = self.tokenizer(preds_l)
+        t = self.tokenizer(target_l)
+        return {
+            "preds_input_ids": state["preds_input_ids"] + (jnp.asarray(p["input_ids"]),),
+            "preds_attention_mask": state["preds_attention_mask"] + (jnp.asarray(p["attention_mask"]),),
+            "target_input_ids": state["target_input_ids"] + (jnp.asarray(t["input_ids"]),),
+            "target_attention_mask": state["target_attention_mask"] + (jnp.asarray(t["attention_mask"]),),
+        }
+
+    @staticmethod
+    def _pad_cat(chunks: Sequence[Array]) -> np.ndarray:
+        t_max = max(c.shape[1] for c in chunks)
+        rows = [np.pad(np.asarray(c), ((0, 0), (0, t_max - c.shape[1]))) for c in chunks]
+        return np.concatenate(rows, axis=0)
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        if not state["preds_input_ids"]:
+            return {"precision": jnp.zeros(0), "recall": jnp.zeros(0), "f1": jnp.zeros(0)}
+        p_ids = self._pad_cat(state["preds_input_ids"])
+        p_mask = self._pad_cat(state["preds_attention_mask"])
+        t_ids = self._pad_cat(state["target_input_ids"])
+        t_mask = self._pad_cat(state["target_attention_mask"])
+
+        t_max = max(p_ids.shape[1], t_ids.shape[1])
+        p_ids = np.pad(p_ids, ((0, 0), (0, t_max - p_ids.shape[1])))
+        p_mask = np.pad(p_mask, ((0, 0), (0, t_max - p_mask.shape[1])))
+        t_ids = np.pad(t_ids, ((0, 0), (0, t_max - t_ids.shape[1])))
+        t_mask = np.pad(t_mask, ((0, 0), (0, t_max - t_mask.shape[1])))
+
+        pred_emb = jnp.asarray(self.embed_fn(jnp.asarray(p_ids), jnp.asarray(p_mask)))
+        tgt_emb = jnp.asarray(self.embed_fn(jnp.asarray(t_ids), jnp.asarray(t_mask)))
+
+        pw = tw = None
+        if self.idf:
+            idf_map = _compute_idf(t_ids, t_mask)
+            pw = jnp.asarray(_idf_weights(p_ids, p_mask, idf_map))
+            tw = jnp.asarray(_idf_weights(t_ids, t_mask, idf_map))
+
+        precision, recall, f1 = _bert_score_from_embeddings(
+            pred_emb, jnp.asarray(p_mask), tgt_emb, jnp.asarray(t_mask), pw, tw
+        )
+        out: Dict[str, Any] = {"precision": precision, "recall": recall, "f1": f1}
+        if self.return_hash:
+            out["hash"] = f"tpu_bert_score(model={self.model_name_or_path or 'hash-embedding'})"
+        return out

@@ -1,0 +1,88 @@
+"""BLEU / SacreBLEU modular metrics (reference: text/bleu.py:33, text/sacre_bleu.py:34)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+
+
+class BLEUScore(Metric):
+    """Corpus BLEU; states = per-order numerator/denominator + length sums
+    (reference text/bleu.py:33-130)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+        self._tokenizer = _tokenize_fn
+
+        self.add_state("preds_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Union[str, Sequence[str]], target: Sequence) -> State:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[t] if isinstance(t, str) else list(t) for t in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator = np.asarray(state["numerator"]).copy()
+        denominator = np.asarray(state["denominator"]).copy()
+        preds_len, target_len = _bleu_score_update(
+            preds_, target_, numerator, denominator,
+            float(state["preds_len"]), float(state["target_len"]),
+            self.n_gram, self._tokenizer,
+        )
+        return {
+            "preds_len": jnp.asarray(preds_len),
+            "target_len": jnp.asarray(target_len),
+            "numerator": jnp.asarray(numerator),
+            "denominator": jnp.asarray(denominator),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _bleu_score_compute(
+            state["preds_len"], state["target_len"],
+            state["numerator"], state["denominator"],
+            self.n_gram, self.weights, self.smooth,
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with canonical tokenization (reference text/sacre_bleu.py:34-140)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {list(AVAILABLE_TOKENIZERS)}")
+        self._tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

@@ -1,0 +1,92 @@
+"""chrF modular metric (reference: text/chrf.py:52-230)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.chrf import _ChrFStats, _chrf_score_update, _fscore
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class CHRFScore(Metric):
+    """chrF/chrF++; state = six per-order count arrays, sum-reduced
+    (reference text/chrf.py:52 keeps the same counts as dict states)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("matching_char", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("matching_word", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("preds_char", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("preds_word", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("target_char", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("target_word", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, state: State, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]
+    ) -> State:
+        stats = _ChrFStats(self.n_char_order, self.n_word_order)
+        sentence_scores: Optional[List[float]] = [] if self.return_sentence_level_score else None
+        _chrf_score_update(
+            preds, target, stats, self.n_char_order, self.n_word_order,
+            self.beta, self.lowercase, self.whitespace, sentence_scores,
+        )
+        new = {
+            "matching_char": state["matching_char"] + jnp.asarray(stats.matching_char),
+            "matching_word": state["matching_word"] + jnp.asarray(stats.matching_word),
+            "preds_char": state["preds_char"] + jnp.asarray(stats.preds_char),
+            "preds_word": state["preds_word"] + jnp.asarray(stats.preds_word),
+            "target_char": state["target_char"] + jnp.asarray(stats.target_char),
+            "target_word": state["target_word"] + jnp.asarray(stats.target_word),
+        }
+        if self.return_sentence_level_score:
+            new["sentence_chrf"] = state["sentence_chrf"] + (jnp.asarray(sentence_scores, jnp.float32),)
+        return new
+
+    def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:
+        corpus = jnp.asarray(
+            _fscore(
+                np.asarray(state["matching_char"]), np.asarray(state["matching_word"]),
+                np.asarray(state["preds_char"]), np.asarray(state["preds_word"]),
+                np.asarray(state["target_char"]), np.asarray(state["target_word"]),
+                float(self.n_char_order + self.n_word_order), self.beta,
+            ),
+            jnp.float32,
+        )
+        if self.return_sentence_level_score:
+            return corpus, dim_zero_cat(state["sentence_chrf"])
+        return corpus

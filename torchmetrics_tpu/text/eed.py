@@ -1,0 +1,64 @@
+"""EED modular metric (reference: text/eed.py:28-140)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.eed import _eed_update
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class ExtendedEditDistance(Metric):
+    """Corpus EED = mean of per-sentence scores; state = cat of scores
+    (reference text/eed.py:28 keeps `sentence_eed` list state)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(val, float) or val < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, state: State, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> State:
+        scores: List[float] = []
+        _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion, scores)
+        return {"sentence_eed": state["sentence_eed"] + (jnp.asarray(scores, jnp.float32),)}
+
+    def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:
+        if not state["sentence_eed"]:
+            return jnp.zeros(())
+        scores = dim_zero_cat(state["sentence_eed"])
+        avg = scores.mean()
+        if self.return_sentence_level_score:
+            return avg, scores
+        return avg

@@ -1,0 +1,82 @@
+"""InfoLM modular metric (reference: text/infolm.py:41-220)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.infolm import infolm
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class InfoLM(Metric):
+    """InfoLM; per-sentence scores kept as cat state (reference text/infolm.py
+    stores tokenized inputs; scores are equivalent and smaller)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
+        return_sentence_level_score: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        # validate measure/alpha/beta now, not on the first update
+        from torchmetrics_tpu.functional.text.infolm import _InformationMeasure
+
+        _InformationMeasure(information_measure, alpha, beta)
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.return_sentence_level_score = return_sentence_level_score
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+
+        self.add_state("scores", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, state: State, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+    ) -> State:
+        _, per_sentence = infolm(
+            preds, target,
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            max_length=self.max_length,
+            return_sentence_level_score=True,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
+        )
+        return {"scores": state["scores"] + (per_sentence,)}
+
+    def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:
+        if not state["scores"]:
+            return jnp.zeros(())
+        scores = dim_zero_cat(state["scores"])
+        if self.return_sentence_level_score:
+            return scores.mean(), scores
+        return scores.mean()

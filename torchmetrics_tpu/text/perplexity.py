@@ -1,0 +1,41 @@
+"""Perplexity modular metric (reference: text/perplexity.py:28-110).
+
+The one text metric whose ``update`` is fully jittable — construct with
+``jit=True`` (or call ``update_state`` inside a pjit'd eval step) and the
+accumulation fuses into the step graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+
+
+class Perplexity(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        total, count = _perplexity_update(preds, target, self.ignore_index)
+        return {
+            "total_log_probs": state["total_log_probs"] + total,
+            "count": state["count"] + count,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _perplexity_compute(state["total_log_probs"], state["count"])

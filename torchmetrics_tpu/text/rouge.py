@@ -1,0 +1,100 @@
+"""ROUGE modular metric (reference: text/rouge.py:36-220)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class ROUGEScore(Metric):
+    """ROUGE-N/L/Lsum; per-sample P/R/F stored as cat states so the sync path
+    moves only tensors (reference text/rouge.py:143 stores the same)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer:
+            try:
+                from nltk.stem.porter import PorterStemmer  # type: ignore  # noqa: F401
+            except ImportError as err:
+                raise ModuleNotFoundError("Stemmer requires the `nltk` package which is not installed.") from err
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        if isinstance(rouge_keys, str):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(
+                    f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}"
+                )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[k] for k in rouge_keys]
+        self.use_stemmer = use_stemmer
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        if use_stemmer:
+            from nltk.stem.porter import PorterStemmer  # type: ignore
+
+            self.stemmer = PorterStemmer()
+        else:
+            self.stemmer = None
+
+        for key in self.rouge_keys:
+            for stat in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{key}_{stat}", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Union[str, Sequence[str]], target) -> State:
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        elif len(target) > 0 and isinstance(target[0], str):
+            target = [[t] for t in target]
+        results = _rouge_score_update(
+            preds, target, self.rouge_keys_values, self.accumulate,
+            self.stemmer, self.normalizer, self.tokenizer,
+        )
+        new = dict(state)
+        inv = {v: k for k, v in ALLOWED_ROUGE_KEYS.items()}
+        for key_val, samples in results.items():
+            name = inv[key_val]
+            for stat in ("fmeasure", "precision", "recall"):
+                vals = jnp.asarray([s[stat] for s in samples], jnp.float32)
+                new[f"{name}_{stat}"] = new[f"{name}_{stat}"] + (vals,)
+        return new
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        for key in self.rouge_keys:
+            for stat in ("fmeasure", "precision", "recall"):
+                vals = state[f"{key}_{stat}"]
+                out[f"{key}_{stat}"] = (
+                    dim_zero_cat(vals).mean() if vals else jnp.zeros(())
+                )
+        return out

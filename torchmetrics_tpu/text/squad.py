@@ -1,0 +1,43 @@
+"""SQuAD modular metric (reference: text/squad.py:34-120)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.squad import (
+    PREDS_TYPE,
+    TARGETS_TYPE,
+    _squad_compute,
+    _squad_input_check,
+    _squad_update,
+)
+
+
+class SQuAD(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: PREDS_TYPE, target: TARGETS_TYPE) -> State:
+        preds_dict, articles = _squad_input_check(preds, target)
+        f1, em, total = _squad_update(preds_dict, articles)
+        return {
+            "f1_score": state["f1_score"] + f1,
+            "exact_match": state["exact_match"] + em,
+            "total": state["total"] + total,
+        }
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        return _squad_compute(state["f1_score"], state["exact_match"], state["total"])

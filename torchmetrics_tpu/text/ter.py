@@ -1,0 +1,74 @@
+"""TER modular metric (reference: text/ter.py:29-160)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.text.ter import (
+    _compute_ter_score_from_statistics,
+    _TercomTokenizer,
+    _ter_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class TranslationEditRate(Metric):
+    """Corpus TER; state = total edits + total reference length, sum-reduced
+    (reference text/ter.py:29)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        for name, val in (
+            ("normalize", normalize), ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase), ("asian_support", asian_support),
+        ):
+            if not isinstance(val, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+        self._tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_tgt_length", jnp.zeros(()), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def _update(
+        self, state: State, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
+    ) -> State:
+        sentence_ter: Optional[List[float]] = [] if self.return_sentence_level_score else None
+        num_edits, tgt_length = _ter_update(preds, target, self._tokenizer, 0.0, 0.0, sentence_ter)
+        new = {
+            "total_num_edits": state["total_num_edits"] + num_edits,
+            "total_tgt_length": state["total_tgt_length"] + tgt_length,
+        }
+        if self.return_sentence_level_score:
+            new["sentence_ter"] = state["sentence_ter"] + (jnp.asarray(sentence_ter, jnp.float32),)
+        return new
+
+    def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:
+        score = jnp.asarray(
+            _compute_ter_score_from_statistics(
+                float(state["total_num_edits"]), float(state["total_tgt_length"])
+            ),
+            jnp.float32,
+        )
+        if self.return_sentence_level_score:
+            return score, dim_zero_cat(state["sentence_ter"])
+        return score
