@@ -1,0 +1,283 @@
+"""Image signal-metric tests.
+
+Oracles are the reference library's doctest outputs
+(/root/reference/src/torchmetrics/functional/image/*.py examples), with torch
+generating bit-identical inputs from the documented seeds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+import torch
+
+import torchmetrics_tpu.functional.image as F
+from torchmetrics_tpu.image import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    QualityWithNoReference,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpatialCorrelationCoefficient,
+    SpatialDistortionIndex,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+
+
+def J(t: torch.Tensor) -> jnp.ndarray:
+    return jnp.asarray(t.numpy())
+
+
+def test_ssim_oracle():
+    torch.manual_seed(42)
+    preds = torch.rand([3, 3, 256, 256])
+    target = preds * 0.75
+    got = float(F.structural_similarity_index_measure(J(preds), J(target)))
+    assert got == pytest.approx(0.9219, abs=1e-4)
+
+
+def test_ms_ssim_oracle():
+    torch.manual_seed(42)
+    preds = torch.rand([3, 3, 256, 256])
+    target = preds * 0.75
+    got = float(F.multiscale_structural_similarity_index_measure(J(preds), J(target), data_range=1.0))
+    assert got == pytest.approx(0.9627, abs=1e-4)
+
+
+def test_sam_oracle():
+    gen = torch.manual_seed(42)
+    preds = torch.rand([16, 3, 16, 16], generator=gen)
+    target = torch.rand([16, 3, 16, 16], generator=gen)
+    assert float(F.spectral_angle_mapper(J(preds), J(target))) == pytest.approx(0.5914, abs=1e-4)
+
+
+def test_ergas_oracle():
+    gen = torch.manual_seed(42)
+    preds = torch.rand([16, 1, 16, 16], generator=gen)
+    target = preds * 0.75
+    assert round(float(F.error_relative_global_dimensionless_synthesis(J(preds), J(target)))) == 10
+
+
+def test_uqi_oracle():
+    torch.manual_seed(42)
+    preds = torch.rand([16, 1, 16, 16])
+    target = preds * 0.75
+    assert float(F.universal_image_quality_index(J(preds), J(target))) == pytest.approx(0.9216, abs=1e-4)
+
+
+def test_rase_oracle():
+    torch.manual_seed(22)
+    preds = torch.rand(4, 3, 16, 16)
+    target = torch.rand(4, 3, 16, 16)
+    assert float(F.relative_average_spectral_error(J(preds), J(target))) == pytest.approx(5114.66, abs=0.5)
+
+
+def test_rmse_sw_oracle():
+    torch.manual_seed(22)
+    preds = torch.rand(4, 3, 16, 16)
+    target = torch.rand(4, 3, 16, 16)
+    got = float(F.root_mean_squared_error_using_sliding_window(J(preds), J(target)))
+    assert got == pytest.approx(0.3999, abs=1e-4)
+
+
+def test_scc_identity():
+    torch.manual_seed(42)
+    x = torch.randn(5, 3, 16, 16)
+    assert float(F.spatial_correlation_coefficient(J(x), J(x))) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_tv_oracle():
+    torch.manual_seed(42)
+    img = torch.rand(5, 3, 28, 28)
+    assert float(F.total_variation(J(img))) == pytest.approx(7546.8018, rel=1e-5)
+
+
+def test_psnr_oracle():
+    preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+    target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+    assert float(F.peak_signal_noise_ratio(preds, target)) == pytest.approx(2.5527, abs=1e-4)
+
+
+def test_d_lambda_oracle():
+    torch.manual_seed(42)
+    preds = torch.rand([16, 3, 16, 16])
+    target = torch.rand([16, 3, 16, 16])
+    assert float(F.spectral_distortion_index(J(preds), J(target))) == pytest.approx(0.0234, abs=1e-4)
+
+
+def test_d_s_and_qnr_oracle():
+    torch.manual_seed(42)
+    preds = torch.rand([16, 3, 32, 32])
+    ms = torch.rand([16, 3, 16, 16])
+    pan = torch.rand([16, 3, 32, 32])
+    assert float(F.spatial_distortion_index(J(preds), J(ms), J(pan))) == pytest.approx(0.0090, abs=2e-4)
+    assert float(F.quality_with_no_reference(J(preds), J(ms), J(pan))) == pytest.approx(0.9694, abs=2e-4)
+
+
+def test_psnr_inferred_range_target_only():
+    # range must come from target alone (reference psnr.py:145)
+    target = jnp.asarray([[0.0, 1.0]])
+    preds = jnp.asarray([[0.0, 3.0]])  # overshoots target range
+    got = float(F.peak_signal_noise_ratio(preds, target))
+    want = 10 * np.log10(1.0**2 / np.mean((np.array([0.0, 3.0]) - np.array([0.0, 1.0])) ** 2))
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_qnr_norm_order_forwarded():
+    torch.manual_seed(7)
+    preds = torch.rand(2, 3, 32, 32)
+    ms = torch.rand(2, 3, 16, 16)
+    pan = torch.rand(2, 3, 32, 32)
+    q1 = float(F.quality_with_no_reference(J(preds), J(ms), J(pan), norm_order=1))
+    q2 = float(F.quality_with_no_reference(J(preds), J(ms), J(pan), norm_order=2))
+    d1 = float(F.spectral_distortion_index(J(preds), J(ms), p=1))
+    d2 = float(F.spectral_distortion_index(J(preds), J(ms), p=2))
+    assert d1 != d2 and q1 != q2
+
+
+def test_d_s_shape_validation():
+    with pytest.raises(ValueError, match="batch and channel"):
+        F.spatial_distortion_index(jnp.zeros((2, 3, 32, 32)), jnp.zeros((2, 1, 16, 16)), jnp.zeros((2, 3, 32, 32)))
+    with pytest.raises(ValueError, match="spatial size"):
+        F.spatial_distortion_index(jnp.zeros((2, 3, 32, 32)), jnp.zeros((2, 3, 16, 16)), jnp.zeros((2, 3, 16, 16)))
+
+
+def test_image_gradients():
+    img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    dy, dx = F.image_gradients(img)
+    assert dy.shape == img.shape and dx.shape == img.shape
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, :3], np.full((3, 4), 4.0))
+    np.testing.assert_allclose(np.asarray(dy)[0, 0, 3], np.zeros(4))
+    np.testing.assert_allclose(np.asarray(dx)[0, 0, :, :3], np.full((4, 3), 1.0))
+
+
+def test_psnrb_runs():
+    torch.manual_seed(42)
+    preds = torch.rand(2, 1, 48, 48)
+    target = torch.rand(2, 1, 48, 48)
+    v = float(F.peak_signal_noise_ratio_with_blocked_effect(J(preds), J(target)))
+    assert np.isfinite(v)
+    with pytest.raises(ValueError, match="grayscale"):
+        F.peak_signal_noise_ratio_with_blocked_effect(jnp.zeros((1, 3, 16, 16)), jnp.ones((1, 3, 16, 16)))
+
+
+def test_vif_full_similarity():
+    torch.manual_seed(42)
+    x = torch.rand(1, 1, 41, 41)
+    assert float(F.visual_information_fidelity(J(x), J(x))) == pytest.approx(1.0, abs=1e-4)
+    with pytest.raises(ValueError, match="41x41"):
+        F.visual_information_fidelity(jnp.zeros((1, 1, 16, 16)), jnp.zeros((1, 1, 16, 16)))
+
+
+# ------------------------------------------------------------------- classes
+def test_psnr_class_accumulation():
+    torch.manual_seed(0)
+    a1, b1 = torch.rand(2, 1, 8, 8), torch.rand(2, 1, 8, 8)
+    a2, b2 = torch.rand(2, 1, 8, 8), torch.rand(2, 1, 8, 8)
+    m = PeakSignalNoiseRatio(data_range=1.0)
+    m.update(J(a1), J(b1))
+    m.update(J(a2), J(b2))
+    full = float(
+        F.peak_signal_noise_ratio(
+            J(torch.cat([a1, a2])), J(torch.cat([b1, b2])), data_range=1.0
+        )
+    )
+    assert float(m.compute()) == pytest.approx(full, abs=1e-4)
+
+
+def test_psnr_class_inferred_range():
+    torch.manual_seed(0)
+    a, b = torch.rand(4, 1, 8, 8), torch.rand(4, 1, 8, 8)
+    m = PeakSignalNoiseRatio()
+    m.update(J(a), J(b))
+    assert float(m.compute()) == pytest.approx(float(F.peak_signal_noise_ratio(J(a), J(b))), abs=1e-4)
+
+
+def test_ssim_class_matches_functional():
+    torch.manual_seed(3)
+    a = torch.rand(4, 1, 32, 32)
+    b = a * 0.9
+    m = StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(J(a[:2]), J(b[:2]))
+    m.update(J(a[2:]), J(b[2:]))
+    want = float(F.structural_similarity_index_measure(J(a), J(b), data_range=1.0))
+    assert float(m.compute()) == pytest.approx(want, abs=1e-5)
+
+
+def test_ms_ssim_class():
+    torch.manual_seed(3)
+    a = torch.rand(2, 1, 192, 192)
+    b = a * 0.9
+    m = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(J(a), J(b))
+    want = float(F.multiscale_structural_similarity_index_measure(J(a), J(b), data_range=1.0))
+    assert float(m.compute()) == pytest.approx(want, abs=1e-5)
+
+
+@pytest.mark.parametrize(
+    "cls,fn,shape",
+    [
+        (UniversalImageQualityIndex, F.universal_image_quality_index, (4, 1, 16, 16)),
+        (SpectralAngleMapper, F.spectral_angle_mapper, (4, 3, 16, 16)),
+        (ErrorRelativeGlobalDimensionlessSynthesis, F.error_relative_global_dimensionless_synthesis, (4, 1, 16, 16)),
+        (RelativeAverageSpectralError, F.relative_average_spectral_error, (4, 3, 16, 16)),
+        (RootMeanSquaredErrorUsingSlidingWindow, F.root_mean_squared_error_using_sliding_window, (4, 3, 16, 16)),
+        (SpatialCorrelationCoefficient, F.spatial_correlation_coefficient, (4, 3, 16, 16)),
+        (SpectralDistortionIndex, F.spectral_distortion_index, (4, 3, 16, 16)),
+        (VisualInformationFidelity, F.visual_information_fidelity, (2, 1, 41, 41)),
+    ],
+)
+def test_cat_state_classes_match_functional(cls, fn, shape):
+    torch.manual_seed(7)
+    a, b = torch.rand(*shape), torch.rand(*shape)
+    m = cls()
+    half = shape[0] // 2
+    m.update(J(a[:half]), J(b[:half]))
+    m.update(J(a[half:]), J(b[half:]))
+    got = float(m.compute())
+    want = float(fn(J(a), J(b)))
+    assert got == pytest.approx(want, abs=1e-4)
+
+
+def test_d_s_qnr_classes():
+    torch.manual_seed(7)
+    preds = torch.rand(2, 3, 32, 32)
+    ms = torch.rand(2, 3, 16, 16)
+    pan = torch.rand(2, 3, 32, 32)
+    m = SpatialDistortionIndex()
+    m.update(J(preds), {"ms": J(ms), "pan": J(pan)})
+    want = float(F.spatial_distortion_index(J(preds), J(ms), J(pan)))
+    assert float(m.compute()) == pytest.approx(want, abs=1e-5)
+
+    q = QualityWithNoReference()
+    q.update(J(preds), {"ms": J(ms), "pan": J(pan)})
+    want_q = float(F.quality_with_no_reference(J(preds), J(ms), J(pan)))
+    assert float(q.compute()) == pytest.approx(want_q, abs=1e-5)
+
+
+def test_tv_class():
+    torch.manual_seed(42)
+    img = torch.rand(5, 3, 28, 28)
+    m = TotalVariation()
+    m.update(J(img[:2]))
+    m.update(J(img[2:]))
+    assert float(m.compute()) == pytest.approx(7546.8018, rel=1e-5)
+    m2 = TotalVariation(reduction="none")
+    m2.update(J(img))
+    assert m2.compute().shape == (5,)
+
+
+def test_psnrb_class():
+    torch.manual_seed(42)
+    a, b = torch.rand(2, 1, 48, 48), torch.rand(2, 1, 48, 48)
+    m = PeakSignalNoiseRatioWithBlockedEffect()
+    m.update(J(a), J(b))
+    assert np.isfinite(float(m.compute()))

@@ -1,0 +1,134 @@
+"""Shared image kernels: gaussian/uniform windows, padding, depthwise conv.
+
+Reference: /root/reference/src/torchmetrics/functional/image/utils.py.
+Convolutions lower to ``lax.conv_general_dilated`` with
+``feature_group_count=channels`` (depthwise) — XLA tiles these onto the MXU;
+the reference's per-channel Python loop (utils.py:_uniform_filter) is a single
+grouped conv here.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D gaussian, normalized (reference utils.py:_gaussian)."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return gauss / gauss.sum()
+
+
+def _gaussian_kernel_2d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    """(C, 1, kh, kw) separable gaussian (reference utils.py:_gaussian_kernel_2d)."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = jnp.outer(kx, ky)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(
+    channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32
+) -> Array:
+    k2d = _gaussian_kernel_2d(1, kernel_size[:2], sigma[:2], dtype)[0, 0]
+    kz = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel = k2d[:, :, None] * kz[None, None, :]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """VALID depthwise conv; x (B, C, H, W), kernel (C, 1, kh, kw)."""
+    return jax.lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    return jax.lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=x.shape[1],
+    )
+
+
+def _conv2d(x: Array, kernel: Array) -> Array:
+    """Plain single-channel VALID conv; kernel (O, I, kh, kw)."""
+    return jax.lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def _reflect_pad_2d(x: Array, pad_h: int, pad_w: int) -> Array:
+    """Mirror padding without edge repeat (torch F.pad mode='reflect')."""
+    return jnp.pad(x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _reflect_pad_3d(x: Array, pad_d: int, pad_w: int, pad_h: int) -> Array:
+    return jnp.pad(
+        x, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w), (pad_d, pad_d)), mode="reflect"
+    )
+
+
+def _symmetric_pad_2d(x: Array, pad: int, outer_pad: int = 0) -> Array:
+    """Edge-repeating pad: left/top ``pad``, right/bottom ``pad + outer_pad − 1``
+    (reference utils.py:_single_dimension_pad semantics used by _uniform_filter)."""
+    right = pad + outer_pad - 1
+    return jnp.pad(x, ((0, 0), (0, 0), (pad, right), (pad, right)), mode="symmetric")
+
+
+def _uniform_filter(x: Array, window_size: int) -> Array:
+    """Same-size local mean with symmetric padding (reference utils.py:_uniform_filter)."""
+    x = _symmetric_pad_2d(x, window_size // 2, window_size % 2)
+    c = x.shape[1]
+    kernel = jnp.ones((c, 1, window_size, window_size), x.dtype) / (window_size**2)
+    return _depthwise_conv2d(x, kernel)
+
+
+def _avg_pool2d(x: Array) -> Array:
+    """2x2 average pool, stride 2 (floor semantics like F.avg_pool2d)."""
+    b, c, h, w = x.shape
+    x = x[:, :, : h // 2 * 2, : w // 2 * 2]
+    x = x.reshape(b, c, h // 2, 2, w // 2, 2)
+    return x.mean(axis=(3, 5))
+
+
+def _avg_pool3d(x: Array) -> Array:
+    b, c, d, h, w = x.shape
+    x = x[:, :, : d // 2 * 2, : h // 2 * 2, : w // 2 * 2]
+    x = x.reshape(b, c, d // 2, 2, h // 2, 2, w // 2, 2)
+    return x.mean(axis=(3, 5, 7))
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _resolve_data_range(preds: Array, target: Array, data_range) -> Tuple[Array, Array, Array]:
+    """None → max-min over both; tuple → clamp + span (reference ssim.py:115-121)."""
+    if data_range is None:
+        rng = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        rng = jnp.asarray(data_range[1] - data_range[0], preds.dtype)
+    else:
+        rng = jnp.asarray(data_range, preds.dtype)
+    return preds, target, rng

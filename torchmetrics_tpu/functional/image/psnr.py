@@ -1,0 +1,128 @@
+"""PSNR and PSNR-B (reference: functional/image/psnr.py:23-150, psnrb.py:20-120)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.parallel.sync import reduce
+from torchmetrics_tpu.functional.image.helper import _check_same_shape
+
+
+def _psnr_update(
+    preds: Array, target: Array, dim: Optional[Union[int, Tuple[int, ...]]] = None
+) -> Tuple[Array, Array]:
+    """(sum squared error, observation count), optionally per-dim (psnr.py:58-87)."""
+    if dim is None:
+        sum_squared_error = jnp.sum(jnp.square(preds - target))
+        num_obs = jnp.asarray(target.size, jnp.float32)
+        return sum_squared_error, num_obs
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    num_obs = jnp.asarray(
+        np.prod([target.shape[d] for d in dim_list]), jnp.float32
+    ) * jnp.ones_like(sum_squared_error)
+    return sum_squared_error, num_obs
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / math.log(base))
+    return reduce(psnr_vals, reduction or "none")
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR (reference psnr.py:90-150)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    if dim is None and reduction != "elementwise_mean":
+        from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        rng = target.max() - target.min()
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        rng = jnp.asarray(data_range[1] - data_range[0])
+    else:
+        rng = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, rng, base=base, reduction=reduction)
+
+
+def _compute_bef(x: Array, block_size: int = 8) -> Array:
+    """Blocking effect factor (reference psnrb.py:20-75)."""
+    _, channels, height, width = x.shape
+    if channels > 1:
+        raise ValueError(f"`psnrb` metric expects grayscale images, but got images with {channels} channels.")
+
+    h = np.arange(width - 1)
+    h_b = np.arange(block_size - 1, width - 1, block_size)
+    h_bc = np.asarray(sorted(set(h.tolist()) - set(h_b.tolist())))
+    v = np.arange(height - 1)
+    v_b = np.arange(block_size - 1, height - 1, block_size)
+    v_bc = np.asarray(sorted(set(v.tolist()) - set(v_b.tolist())))
+
+    d_b = jnp.square(x[:, :, :, h_b] - x[:, :, :, h_b + 1]).sum()
+    d_bc = jnp.square(x[:, :, :, h_bc] - x[:, :, :, h_bc + 1]).sum()
+    d_b += jnp.square(x[:, :, v_b, :] - x[:, :, v_b + 1, :]).sum()
+    d_bc += jnp.square(x[:, :, v_bc, :] - x[:, :, v_bc + 1, :]).sum()
+
+    n_hb = height * (width / block_size) - 1
+    n_hbc = (height * (width - 1)) - n_hb
+    n_vb = width * (height / block_size) - 1
+    n_vbc = (width * (height - 1)) - n_vb
+    d_b = d_b / (n_hb + n_vb)
+    d_bc = d_bc / (n_hbc + n_vbc)
+    t = math.log2(block_size) / math.log2(min(height, width))
+    return jnp.where(d_b > d_bc, t * (d_b - d_bc), 0.0)
+
+
+def _psnrb_update(preds: Array, target: Array, block_size: int = 8) -> Tuple[Array, Array, Array]:
+    sum_squared_error = jnp.sum(jnp.square(preds - target))
+    num_obs = jnp.asarray(target.size, jnp.float32)
+    bef = _compute_bef(preds, block_size=block_size)
+    return sum_squared_error, bef, num_obs
+
+
+def _psnrb_compute(sum_squared_error: Array, bef: Array, num_obs: Array, data_range: Array) -> Array:
+    mse_bef = sum_squared_error / num_obs + bef
+    return jnp.where(
+        data_range > 2,
+        10 * jnp.log10(data_range**2 / mse_bef),
+        10 * jnp.log10(1.0 / mse_bef),
+    )
+
+
+def peak_signal_noise_ratio_with_blocked_effect(
+    preds: Array, target: Array, block_size: int = 8
+) -> Array:
+    """PSNR-B (reference psnrb.py:90-130)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    data_range = target.max() - target.min()
+    sum_squared_error, bef, num_obs = _psnrb_update(preds, target, block_size=block_size)
+    return _psnrb_compute(sum_squared_error, bef, num_obs, data_range)

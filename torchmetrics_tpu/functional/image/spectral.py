@@ -1,0 +1,453 @@
+"""Spectral / remote-sensing image metrics: UQI, SAM, ERGAS, RASE, RMSE-SW,
+SCC, D-lambda, D-s, QNR, VIF-p.
+
+Reference: functional/image/{uqi.py:22, sam.py:20, ergas.py:21, rase.py:20,
+rmse_sw.py:20, scc.py:20, d_lambda.py:22, d_s.py:24, qnr.py:22, vif.py:20}.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.parallel.sync import reduce
+from torchmetrics_tpu.functional.image.helper import (
+    _check_same_shape,
+    _conv2d,
+    _depthwise_conv2d,
+    _gaussian_kernel_2d,
+    _reflect_pad_2d,
+    _uniform_filter,
+)
+
+
+def _check_4d(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    return preds, target
+
+
+# ----------------------------------------------------------------------- UQI
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """UQI — SSIM with C1=C2=0 (reference uqi.py:22-150)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _check_4d(preds, target)
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds = _reflect_pad_2d(preds, pad_h, pad_w)
+    target = _reflect_pad_2d(target, pad_h, pad_w)
+
+    b = preds.shape[0]
+    stacked = jnp.concatenate((preds, target, preds * preds, target * target, preds * target), axis=0)
+    out = _depthwise_conv2d(stacked, kernel)
+    mu_p, mu_t, e_pp, e_tt, e_pt = (out[i * b : (i + 1) * b] for i in range(5))
+    mu_p_sq, mu_t_sq, mu_pt = mu_p**2, mu_t**2, mu_p * mu_t
+    sigma_p_sq = jnp.clip(e_pp - mu_p_sq, 0.0)
+    sigma_t_sq = jnp.clip(e_tt - mu_t_sq, 0.0)
+    sigma_pt = e_pt - mu_pt
+    upper = 2 * sigma_pt
+    lower = sigma_p_sq + sigma_t_sq
+    eps = jnp.finfo(preds.dtype).eps
+    uqi_idx = ((2 * mu_pt) * upper) / ((mu_p_sq + mu_t_sq) * lower + eps)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return reduce(uqi_idx, reduction or "none")
+
+
+# ----------------------------------------------------------------------- SAM
+def spectral_angle_mapper(
+    preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """Per-pixel spectral angle in radians (reference sam.py:20-110)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _check_4d(preds, target)
+    if preds.shape[1] <= 1:
+        raise ValueError(f"Expected channel dimension of `preds` and `target` to be larger than 1. Got {preds.shape[1]}.")
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction or "none")
+
+
+# --------------------------------------------------------------------- ERGAS
+def error_relative_global_dimensionless_synthesis(
+    preds: Array, target: Array, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """ERGAS (reference ergas.py:21-110)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _check_4d(preds, target)
+    b, c, h, w = preds.shape
+    preds_f = preds.reshape(b, c, h * w)
+    target_f = target.reshape(b, c, h * w)
+    diff = preds_f - target_f
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target_f, axis=2)
+    ergas_score = 100 / ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction or "none")
+
+
+# ------------------------------------------------------------------- RMSE-SW
+def _rmse_sw_update(
+    preds: Array, target: Array, window_size: int,
+    rmse_val_sum: Optional[Array], rmse_map: Optional[Array], total_images: Optional[Array],
+) -> Tuple[Array, Array, Array]:
+    """(running rmse sum, running rmse map, image count) (rmse_sw.py:20-80)."""
+    preds, target = _check_4d(preds, target)
+    if round(window_size / 2) >= target.shape[2] or round(window_size / 2) >= target.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(target.shape[2], target.shape[3])} but got {round(window_size / 2)}."
+        )
+    total = (total_images if total_images is not None else 0) + target.shape[0]
+    error = _uniform_filter((target - preds) ** 2, window_size)
+    _rmse_map = jnp.sqrt(error)
+    crop = round(window_size / 2)
+    val = _rmse_map[:, :, crop:-crop, crop:-crop].sum(axis=0).mean()
+    rmse_val_sum = val if rmse_val_sum is None else rmse_val_sum + val
+    new_map = _rmse_map.sum(axis=0)
+    rmse_map = new_map if rmse_map is None else rmse_map + new_map
+    return rmse_val_sum, rmse_map, jnp.asarray(total, jnp.float32)
+
+
+def _rmse_sw_compute(
+    rmse_val_sum: Optional[Array], rmse_map: Array, total_images: Array
+) -> Tuple[Optional[Array], Array]:
+    rmse = rmse_val_sum / total_images if rmse_val_sum is not None else None
+    return rmse, rmse_map / total_images
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+):
+    """Sliding-window RMSE (reference rmse_sw.py:100-150)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    rmse_val_sum, rmse_map, total_images = _rmse_sw_update(preds, target, window_size, None, None, None)
+    rmse, rmse_map = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse
+
+
+# ---------------------------------------------------------------------- RASE
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """RASE (reference rase.py:20-110)."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds, target = _check_4d(preds, target)
+    _, rmse_map, total_images = _rmse_sw_update(preds, target, window_size, None, None, None)
+    # the reference divides the filtered target by window_size**2 again
+    # (rase.py:_rase_update) — kept for output parity
+    target_sum = (_uniform_filter(target, window_size) / (window_size**2)).sum(axis=0)
+    _, rmse_map = _rmse_sw_compute(None, rmse_map, total_images)
+    target_mean = (target_sum / total_images).mean(axis=0)
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop = round(window_size / 2)
+    return jnp.mean(rase_map[crop:-crop, crop:-crop])
+
+
+# ----------------------------------------------------------------------- SCC
+def _symmetric_reflect_pad_2d(x: Array, pads: Tuple[int, int, int, int]) -> Array:
+    left, right, top, bottom = pads
+    return jnp.pad(x, ((0, 0), (0, 0), (top, bottom), (left, right)), mode="symmetric")
+
+
+def _signal_convolve_2d(x: Array, kernel: Array) -> Array:
+    """True (flipped-kernel) convolution with symmetric padding (scc.py:60-75)."""
+    kh, kw = kernel.shape[2], kernel.shape[3]
+    left, right = (kw - 1) // 2, math.ceil((kw - 1) / 2)
+    top, bottom = (kh - 1) // 2, math.ceil((kh - 1) / 2)
+    padded = _symmetric_reflect_pad_2d(x, (left, right, top, bottom))
+    return _conv2d(padded, jnp.flip(kernel, axis=(2, 3)))
+
+
+def _local_variance_covariance(preds: Array, target: Array, window: Array):
+    kw = window.shape[3]
+    left, right = math.ceil((kw - 1) / 2), (kw - 1) // 2
+    preds = jnp.pad(preds, ((0, 0), (0, 0), (left, right), (left, right)))
+    target = jnp.pad(target, ((0, 0), (0, 0), (left, right), (left, right)))
+    mu_p = _conv2d(preds, window)
+    mu_t = _conv2d(target, window)
+    var_p = _conv2d(preds**2, window) - mu_p**2
+    var_t = _conv2d(target**2, window) - mu_t**2
+    cov = _conv2d(target * preds, window) - mu_t * mu_p
+    return var_p, var_t, cov
+
+
+def spatial_correlation_coefficient(
+    preds: Array,
+    target: Array,
+    hp_filter: Optional[Array] = None,
+    window_size: int = 8,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """SCC (reference scc.py:130-210)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if hp_filter is None:
+        hp_filter = jnp.asarray([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+    if reduction is None:
+        reduction = "none"
+    if reduction not in ("mean", "none"):
+        raise ValueError(f"Expected reduction to be 'mean' or 'none', but got {reduction}")
+    _check_same_shape(preds, target)
+    if preds.ndim not in (3, 4):
+        raise ValueError(
+            "Expected `preds` and `target` to have batch of colored images with BxCxHxW shape"
+            f" or batch of grayscale images of BxHxW shape. Got preds: {preds.shape}."
+        )
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    if window_size <= 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got {window_size}.")
+    if window_size > preds.shape[2] or window_size > preds.shape[3]:
+        raise ValueError(
+            f"Expected `window_size` to be less than or equal to the size of the image."
+            f" Got window_size: {window_size} and image size: {preds.shape[2]}x{preds.shape[3]}."
+        )
+    hp = jnp.asarray(hp_filter, preds.dtype)[None, None]
+    window = jnp.ones((1, 1, window_size, window_size), preds.dtype) / (window_size**2)
+
+    scores = []
+    for i in range(preds.shape[1]):
+        p = preds[:, i : i + 1]
+        t = target[:, i : i + 1]
+        p_hp = _signal_convolve_2d(p, hp) * 2.0
+        t_hp = _signal_convolve_2d(t, hp) * 2.0
+        var_p, var_t, cov = _local_variance_covariance(p_hp, t_hp, window)
+        var_p = jnp.clip(var_p, 0.0)
+        var_t = jnp.clip(var_t, 0.0)
+        den = jnp.sqrt(var_t) * jnp.sqrt(var_p)
+        scc = jnp.where(den == 0, 0.0, cov / jnp.where(den == 0, 1.0, den))
+        scores.append(scc)
+    scc_all = jnp.concatenate(scores, axis=1)
+    if reduction == "none":
+        return scc_all
+    return scc_all.mean(axis=(1, 2, 3)).mean()
+
+
+# ----------------------------------------------------------------------- VIF
+def _vif_filter(win_size: float, sigma: float, dtype) -> Array:
+    coords = jnp.arange(win_size, dtype=dtype) - (win_size - 1) / 2
+    g = coords**2
+    g = jnp.exp(-(g[None, :] + g[:, None]) / (2.0 * sigma**2))
+    return g / g.sum()
+
+
+def _vif_per_channel(preds: Array, target: Array, sigma_n_sq: float) -> Array:
+    """VIF-p for one channel (reference vif.py:20-75)."""
+    dtype = preds.dtype
+    preds = preds[:, None]
+    target = target[:, None]
+    eps = jnp.asarray(1e-10, dtype)
+    sigma_n = jnp.asarray(sigma_n_sq, dtype)
+    preds_vif = jnp.zeros((1,), dtype)
+    target_vif = jnp.zeros((1,), dtype)
+    for scale in range(4):
+        n = 2.0 ** (4 - scale) + 1
+        kernel = _vif_filter(n, n / 5, dtype)[None, None]
+        if scale > 0:
+            target = _conv2d(target, kernel)[:, :, ::2, ::2]
+            preds = _conv2d(preds, kernel)[:, :, ::2, ::2]
+        mu_t = _conv2d(target, kernel)
+        mu_p = _conv2d(preds, kernel)
+        mu_t_sq, mu_p_sq, mu_tp = mu_t**2, mu_p**2, mu_t * mu_p
+        sigma_t_sq = jnp.clip(_conv2d(target**2, kernel) - mu_t_sq, 0.0)
+        sigma_p_sq = jnp.clip(_conv2d(preds**2, kernel) - mu_p_sq, 0.0)
+        sigma_tp = _conv2d(target * preds, kernel) - mu_tp
+
+        g = sigma_tp / (sigma_t_sq + eps)
+        sigma_v_sq = sigma_p_sq - g * sigma_tp
+        mask = sigma_t_sq < eps
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.where(mask, sigma_p_sq, sigma_v_sq)
+        sigma_t_sq = jnp.where(mask, 0.0, sigma_t_sq)
+        mask = sigma_p_sq < eps
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.where(mask, 0.0, sigma_v_sq)
+        mask = g < 0
+        sigma_v_sq = jnp.where(mask, sigma_p_sq, sigma_v_sq)
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.clip(sigma_v_sq, eps)
+
+        preds_vif = preds_vif + jnp.sum(
+            jnp.log10(1.0 + (g**2.0) * sigma_t_sq / (sigma_v_sq + sigma_n)), axis=(1, 2, 3)
+        )
+        target_vif = target_vif + jnp.sum(jnp.log10(1.0 + sigma_t_sq / sigma_n), axis=(1, 2, 3))
+    return preds_vif / target_vif
+
+
+def visual_information_fidelity(preds: Array, target: Array, sigma_n_sq: float = 2.0) -> Array:
+    """VIF-p (reference vif.py:78-120)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.shape[-1] < 41 or preds.shape[-2] < 41:
+        raise ValueError(f"Invalid size of preds. Expected at least 41x41, but got {preds.shape[-1]}x{preds.shape[-2]}!")
+    if target.shape[-1] < 41 or target.shape[-2] < 41:
+        raise ValueError(f"Invalid size of target. Expected at least 41x41, but got {target.shape[-1]}x{target.shape[-2]}!")
+    per_channel = [
+        _vif_per_channel(preds[:, i], target[:, i], sigma_n_sq) for i in range(preds.shape[1])
+    ]
+    return jnp.concatenate(per_channel).mean()
+
+
+# ---------------------------------------------------------- D-lambda / D-s / QNR
+def spectral_distortion_index(
+    preds: Array, target: Array, p: int = 1, reduction: Optional[str] = "elementwise_mean"
+) -> Array:
+    """D-lambda for pan-sharpening (reference d_lambda.py:22-140)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    if preds.ndim != 4 or target.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    # spatial sizes may differ (fused vs low-res ms); only batch/channel must
+    # match since UQI runs within each tensor separately (d_lambda.py:update)
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            "Expected `preds` and `target` to have same batch and channel sizes."
+            f"Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+
+    length = preds.shape[1]
+    m1 = jnp.zeros((length, length))
+    m2 = jnp.zeros((length, length))
+    for k in range(length):
+        num = length - (k + 1)
+        if num == 0:
+            continue
+        stack1 = jnp.tile(target[:, k : k + 1], (num, 1, 1, 1))
+        stack2 = jnp.concatenate([target[:, r : r + 1] for r in range(k + 1, length)], axis=0)
+        vals = universal_image_quality_index(stack1, stack2, reduction="none")
+        score = jnp.asarray([v.mean() for v in jnp.split(vals, num)])
+        m1 = m1.at[k, k + 1 :].set(score)
+        stack1 = jnp.tile(preds[:, k : k + 1], (num, 1, 1, 1))
+        stack2 = jnp.concatenate([preds[:, r : r + 1] for r in range(k + 1, length)], axis=0)
+        vals = universal_image_quality_index(stack1, stack2, reduction="none")
+        score = jnp.asarray([v.mean() for v in jnp.split(vals, num)])
+        m2 = m2.at[k, k + 1 :].set(score)
+    m1 = m1 + m1.T
+    m2 = m2 + m2.T
+    diff = jnp.abs(m1 - m2) ** p
+    if length == 1:
+        output = diff ** (1.0 / p)
+    else:
+        output = (1.0 / (length * (length - 1)) * jnp.sum(diff)) ** (1.0 / p)
+    return reduce(output, reduction or "none")
+
+
+def spatial_distortion_index(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """D-s for pan-sharpening (reference d_s.py:24-190); the torchvision resize
+    becomes ``jax.image.resize`` (bilinear, no antialias — matching
+    antialias=False in the reference)."""
+    preds = jnp.asarray(preds)
+    ms = jnp.asarray(ms)
+    pan = jnp.asarray(pan)
+    if preds.ndim != 4 or ms.ndim != 4 or pan.ndim != 4:
+        raise ValueError("Expected `preds`, `ms` and `pan` to have BxCxHxW shape.")
+    if not isinstance(norm_order, int) or norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    if not isinstance(window_size, int) or window_size <= 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+    if preds.shape[:2] != ms.shape[:2] or preds.shape[:2] != pan.shape[:2]:
+        raise ValueError(
+            "Expected `preds`, `ms` and `pan` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape}, ms: {ms.shape} and pan: {pan.shape}."
+        )
+    if preds.shape[-2:] != pan.shape[-2:]:
+        raise ValueError(
+            f"Expected `preds` and `pan` to have the same spatial size. Got preds: {preds.shape} and pan: {pan.shape}."
+        )
+    if pan_lr is not None and jnp.asarray(pan_lr).shape != ms.shape:
+        raise ValueError(
+            f"Expected `pan_lr` to have the same shape as `ms`. Got pan_lr: {jnp.asarray(pan_lr).shape} and ms: {ms.shape}."
+        )
+    ms_h, ms_w = ms.shape[-2:]
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
+
+    if pan_lr is None:
+        pan_degraded = _uniform_filter(pan, window_size=window_size)
+        pan_degraded = jax.image.resize(
+            pan_degraded, (*pan_degraded.shape[:2], ms_h, ms_w), method="bilinear", antialias=False
+        )
+    else:
+        pan_degraded = jnp.asarray(pan_lr)
+
+    length = preds.shape[1]
+    m1 = jnp.asarray(
+        [float(universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1])) for i in range(length)]
+    )
+    m2 = jnp.asarray(
+        [float(universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1])) for i in range(length)]
+    )
+    diff = jnp.abs(m1 - m2) ** norm_order
+    return reduce(diff, reduction or "none") ** (1 / norm_order)
+
+
+def quality_with_no_reference(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """QNR = (1−D_lambda)^alpha (1−D_s)^beta (reference qnr.py:22-120)."""
+    if not isinstance(alpha, (int, float)) or alpha < 0:
+        raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+    if not isinstance(beta, (int, float)) or beta < 0:
+        raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+    d_lambda = spectral_distortion_index(preds, ms, p=norm_order, reduction=reduction)
+    d_s = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction)
+    return (1 - d_lambda) ** alpha * (1 - d_s) ** beta

@@ -1,0 +1,128 @@
+"""PSNR / PSNR-B modular metrics (reference: image/psnr.py:31, image/psnrb.py:29)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.image.psnr import (
+    _psnr_compute,
+    _psnr_update,
+    _psnrb_compute,
+    _psnrb_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR; scalar sum states when ``dim`` is None, cat states otherwise;
+    data range inferred via min/max states when not given (reference
+    image/psnr.py:31-150)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        self.base = base
+        self.reduction = reduction
+        self.dim = (dim,) if isinstance(dim, int) else dim
+        self._clamp: Optional[Tuple[float, float]] = None
+
+        if dim is None:
+            self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", jnp.asarray(jnp.inf), dist_reduce_fx="min")
+            self.add_state("max_target", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        elif isinstance(data_range, tuple):
+            self.data_range = jnp.asarray(data_range[1] - data_range[0])
+            self._clamp = data_range
+        else:
+            self.data_range = jnp.asarray(float(data_range))
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        if self._clamp is not None:
+            preds = jnp.clip(preds, self._clamp[0], self._clamp[1])
+            target = jnp.clip(target, self._clamp[0], self._clamp[1])
+        sse, n = _psnr_update(preds, target, dim=self.dim)
+        new = dict(state)
+        if self.dim is None:
+            new["sum_squared_error"] = state["sum_squared_error"] + sse
+            new["total"] = state["total"] + n
+            if self.data_range is None:
+                # range inferred from target only (reference psnr.py:145)
+                new["min_target"] = jnp.minimum(state["min_target"], target.min())
+                new["max_target"] = jnp.maximum(state["max_target"], target.max())
+        else:
+            new["sum_squared_error"] = state["sum_squared_error"] + (sse.ravel(),)
+            new["total"] = state["total"] + (n.ravel(),)
+        return new
+
+    def _compute(self, state: State) -> Array:
+        if self.data_range is not None:
+            rng = self.data_range
+        else:
+            rng = state["max_target"] - state["min_target"]
+        if self.dim is None:
+            sse, total = state["sum_squared_error"], state["total"]
+        else:
+            sse = dim_zero_cat(state["sum_squared_error"])
+            total = dim_zero_cat(state["total"])
+        return _psnr_compute(sse, total, rng, base=self.base, reduction=self.reduction)
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNR-B (reference image/psnrb.py:29-110); grayscale only."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, block_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("bef", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("data_range", jnp.zeros(()), dist_reduce_fx="max")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        sse, bef, n = _psnrb_update(preds, target, block_size=self.block_size)
+        return {
+            "sum_squared_error": state["sum_squared_error"] + sse,
+            "total": state["total"] + n,
+            "bef": state["bef"] + bef,
+            "data_range": jnp.maximum(state["data_range"], target.max() - target.min()),
+        }
+
+    def _compute(self, state: State) -> Array:
+        return _psnrb_compute(
+            state["sum_squared_error"], state["bef"], state["total"], state["data_range"]
+        )

@@ -1,0 +1,324 @@
+"""Spectral/remote-sensing modular metrics: UQI, SAM, SCC, ERGAS, RASE,
+RMSE-SW, D-lambda, D-s, QNR, VIF, TotalVariation.
+
+Reference: image/{uqi.py:29, sam.py:30, scc.py:25, ergas.py:30, rase.py:28,
+rmse_sw.py:28, d_lambda.py:29, d_s.py:31, qnr.py:30, vif.py:26, tv.py:24}.
+Metrics whose formula is not sum-decomposable keep preds/target cat states,
+exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.image.spectral import (
+    _rmse_sw_compute,
+    error_relative_global_dimensionless_synthesis,
+    quality_with_no_reference,
+    relative_average_spectral_error,
+    spatial_correlation_coefficient,
+    spatial_distortion_index,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+from torchmetrics_tpu.functional.image.tv import _total_variation_compute, _total_variation_update
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class _CatPredsTargetMetric(Metric):
+    """Base: accumulate raw preds/target, apply functional at compute."""
+
+    is_differentiable = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        return {
+            "preds": state["preds"] + (jnp.asarray(preds),),
+            "target": state["target"] + (jnp.asarray(target),),
+        }
+
+    def _cat(self, state: State):
+        return dim_zero_cat(state["preds"]), dim_zero_cat(state["target"])
+
+
+class UniversalImageQualityIndex(_CatPredsTargetMetric):
+    """UQI (reference image/uqi.py:29)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return universal_image_quality_index(preds, target, self.kernel_size, self.sigma, self.reduction)
+
+
+class SpectralAngleMapper(_CatPredsTargetMetric):
+    """SAM (reference image/sam.py:30)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return spectral_angle_mapper(preds, target, self.reduction)
+
+
+class SpatialCorrelationCoefficient(_CatPredsTargetMetric):
+    """SCC (reference image/scc.py:25)."""
+
+    higher_is_better = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, hp_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.hp_filter = hp_filter
+        self.window_size = window_size
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return spatial_correlation_coefficient(preds, target, self.hp_filter, self.window_size)
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(_CatPredsTargetMetric):
+    """ERGAS (reference image/ergas.py:30)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return error_relative_global_dimensionless_synthesis(preds, target, self.ratio, self.reduction)
+
+
+class RelativeAverageSpectralError(_CatPredsTargetMetric):
+    """RASE (reference image/rase.py:28)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return relative_average_spectral_error(preds, target, self.window_size)
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(_CatPredsTargetMetric):
+    """RMSE-SW (reference image/rmse_sw.py:28)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+
+    def _compute(self, state: State) -> Array:
+        from torchmetrics_tpu.functional.image.spectral import _rmse_sw_update
+
+        preds, target = self._cat(state)
+        rmse_val_sum, rmse_map, total = _rmse_sw_update(preds, target, self.window_size, None, None, None)
+        rmse, _ = _rmse_sw_compute(rmse_val_sum, rmse_map, total)
+        return rmse
+
+
+class SpectralDistortionIndex(_CatPredsTargetMetric):
+    """D-lambda (reference image/d_lambda.py:29)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        self.reduction = reduction
+
+    def _compute(self, state: State) -> Array:
+        preds, target = self._cat(state)
+        return spectral_distortion_index(preds, target, self.p, self.reduction)
+
+
+class SpatialDistortionIndex(Metric):
+    """D-s (reference image/d_s.py:31); update takes dict target with ms/pan."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        for name in ("preds", "ms", "pan", "pan_lr"):
+            self.add_state(name, [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: dict) -> State:
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to have keys ('ms', 'pan'). Got {list(target)}.")
+        new = dict(state)
+        new["preds"] = state["preds"] + (jnp.asarray(preds),)
+        new["ms"] = state["ms"] + (jnp.asarray(target["ms"]),)
+        new["pan"] = state["pan"] + (jnp.asarray(target["pan"]),)
+        if "pan_lr" in target:
+            new["pan_lr"] = state["pan_lr"] + (jnp.asarray(target["pan_lr"]),)
+        return new
+
+    def _compute(self, state: State) -> Array:
+        preds = dim_zero_cat(state["preds"])
+        ms = dim_zero_cat(state["ms"])
+        pan = dim_zero_cat(state["pan"])
+        pan_lr = dim_zero_cat(state["pan_lr"]) if state["pan_lr"] else None
+        return spatial_distortion_index(
+            preds, ms, pan, pan_lr, self.norm_order, self.window_size, self.reduction
+        )
+
+
+class QualityWithNoReference(SpatialDistortionIndex):
+    """QNR (reference image/qnr.py:30)."""
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        alpha: float = 1.0,
+        beta: float = 1.0,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(norm_order=norm_order, window_size=window_size, reduction=reduction, **kwargs)
+        if not isinstance(alpha, (int, float)) or alpha < 0:
+            raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+        if not isinstance(beta, (int, float)) or beta < 0:
+            raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+        self.alpha = alpha
+        self.beta = beta
+
+    def _compute(self, state: State) -> Array:
+        preds = dim_zero_cat(state["preds"])
+        ms = dim_zero_cat(state["ms"])
+        pan = dim_zero_cat(state["pan"])
+        pan_lr = dim_zero_cat(state["pan_lr"]) if state["pan_lr"] else None
+        return quality_with_no_reference(
+            preds, ms, pan, pan_lr, self.alpha, self.beta, self.norm_order, self.window_size, self.reduction
+        )
+
+
+class VisualInformationFidelity(Metric):
+    """VIF-p; sum-decomposable over images (reference image/vif.py:26)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (int, float)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = sigma_n_sq
+        self.add_state("vif_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        score = visual_information_fidelity(preds, target, self.sigma_n_sq)
+        return {
+            "vif_score": state["vif_score"] + score * preds.shape[0],
+            "total": state["total"] + preds.shape[0],
+        }
+
+    def _compute(self, state: State) -> Array:
+        return state["vif_score"] / state["total"]
+
+
+class TotalVariation(Metric):
+    """TV (reference image/tv.py:24)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        if reduction in (None, "none"):
+            self.add_state("score_list", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("num_elements", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, img: Array) -> State:
+        score, num = _total_variation_update(jnp.asarray(img))
+        if self.reduction in (None, "none"):
+            return {"score_list": state["score_list"] + (score,)}
+        return {
+            "score": state["score"] + score.sum(),
+            "num_elements": state["num_elements"] + num,
+        }
+
+    def _compute(self, state: State) -> Array:
+        if self.reduction in (None, "none"):
+            return dim_zero_cat(state["score_list"])
+        return _total_variation_compute(state["score"], state["num_elements"], self.reduction)

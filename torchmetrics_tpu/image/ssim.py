@@ -1,0 +1,166 @@
+"""SSIM / MS-SSIM modular metrics (reference: image/ssim.py:30,220)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.image.ssim import (
+    _multiscale_ssim_update,
+    _ssim_check_inputs,
+    _ssim_update,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """SSIM; per-image similarity kept as scalar sum (mean reduction) or cat
+    state (reference image/ssim.py:30-210)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+        if reduction in ("none", None) or return_full_image or return_contrast_sensitivity:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        if return_full_image or return_contrast_sensitivity:
+            self.add_state("image_return", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        preds, target = _ssim_check_inputs(jnp.asarray(preds), jnp.asarray(target))
+        out = _ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2,
+            self.return_full_image, self.return_contrast_sensitivity,
+        )
+        new = dict(state)
+        if isinstance(out, tuple):
+            sim, extra = out
+            new["image_return"] = state["image_return"] + (extra,)
+        else:
+            sim = out
+        if isinstance(state["similarity"], tuple):
+            new["similarity"] = state["similarity"] + (sim,)
+        else:
+            new["similarity"] = state["similarity"] + sim.sum()
+            new["total"] = state["total"] + sim.shape[0]
+        return new
+
+    def _compute(self, state: State):
+        if isinstance(state["similarity"], tuple):
+            sim = dim_zero_cat(state["similarity"])
+            if self.reduction == "elementwise_mean":
+                sim = sim.mean()
+            elif self.reduction == "sum":
+                sim = sim.sum()
+            if self.return_full_image or self.return_contrast_sensitivity:
+                return sim, dim_zero_cat(state["image_return"])
+            return sim
+        if self.reduction == "sum":
+            return state["similarity"]
+        return state["similarity"] / state["total"]
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """MS-SSIM (reference image/ssim.py:220-330)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
+        if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+            raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+        if normalize is not None and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+        self.gaussian_kernel = gaussian_kernel
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+        if reduction in ("none", None):
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        preds, target = _ssim_check_inputs(jnp.asarray(preds), jnp.asarray(target))
+        sim = _multiscale_ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2, self.betas, self.normalize,
+        )
+        new = dict(state)
+        if isinstance(state["similarity"], tuple):
+            new["similarity"] = state["similarity"] + (sim,)
+        else:
+            new["similarity"] = state["similarity"] + sim.sum()
+            new["total"] = state["total"] + sim.shape[0]
+        return new
+
+    def _compute(self, state: State) -> Array:
+        if isinstance(state["similarity"], tuple):
+            return dim_zero_cat(state["similarity"])
+        if self.reduction == "sum":
+            return state["similarity"]
+        return state["similarity"] / state["total"]
