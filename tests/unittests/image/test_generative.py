@@ -1,0 +1,282 @@
+"""Generative image metric tests.
+
+FID math is validated against an independent numpy/scipy computation of the
+Fréchet distance on controlled feature distributions (feeding features through
+an identity extractor); KID/IS against hand-rolled numpy implementations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.generative import (
+    _compute_fid,
+    inception_score_from_logits,
+    poly_mmd,
+)
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
+from torchmetrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    LearnedPerceptualImagePatchSimilarity,
+    MemorizationInformedFrechetInceptionDistance,
+    PerceptualPathLength,
+)
+
+
+class IdentityExtractor:
+    """Pass-through: 'images' ARE the features (shape B, D)."""
+
+    num_features = 8
+
+    def __call__(self, x):
+        return x
+
+
+def np_frechet(mu1, s1, mu2, s2):
+    from scipy import linalg
+
+    diff = mu1 - mu2
+    covmean = linalg.sqrtm(s1 @ s2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return float(diff @ diff + np.trace(s1) + np.trace(s2) - 2 * np.trace(covmean))
+
+
+def test_compute_fid_vs_scipy():
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        a = rng.normal(size=(200, 8))
+        b = rng.normal(size=(200, 8)) * 1.5 + 0.3
+        mu1, s1 = a.mean(0), np.cov(a.T)
+        mu2, s2 = b.mean(0), np.cov(b.T)
+        got = float(_compute_fid(jnp.asarray(mu1), jnp.asarray(s1), jnp.asarray(mu2), jnp.asarray(s2)))
+        want = np_frechet(mu1, s1, mu2, s2)
+        assert got == pytest.approx(want, rel=1e-3)
+
+
+def test_fid_metric_streaming_stats():
+    rng = np.random.default_rng(1)
+    real = rng.normal(size=(256, 8)).astype(np.float32)
+    fake = (rng.normal(size=(256, 8)) * 1.3 + 0.5).astype(np.float32)
+
+    m = FrechetInceptionDistance(feature=IdentityExtractor())
+    # two chunks per distribution to exercise streaming accumulation
+    m.update(jnp.asarray(real[:128]), real=True)
+    m.update(jnp.asarray(real[128:]), real=True)
+    m.update(jnp.asarray(fake[:100]), real=False)
+    m.update(jnp.asarray(fake[100:]), real=False)
+    got = float(m.compute())
+
+    mu1, s1 = real.mean(0), np.cov(real.T)
+    mu2, s2 = fake.mean(0), np.cov(fake.T)
+    want = np_frechet(mu1, s1, mu2, s2)
+    assert got == pytest.approx(want, rel=1e-2)
+
+    # identical distributions => FID ~ 0
+    m2 = FrechetInceptionDistance(feature=IdentityExtractor())
+    m2.update(jnp.asarray(real), real=True)
+    m2.update(jnp.asarray(real), real=False)
+    assert float(m2.compute()) == pytest.approx(0.0, abs=1e-2)
+
+
+def test_fid_reset_real_features():
+    rng = np.random.default_rng(2)
+    real = rng.normal(size=(64, 8)).astype(np.float32)
+    m = FrechetInceptionDistance(feature=IdentityExtractor(), reset_real_features=False)
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(real), real=False)
+    m.reset()
+    assert float(m.metric_state["real_features_num_samples"]) == 64
+    assert float(m.metric_state["fake_features_num_samples"]) == 0
+
+
+def test_fid_requires_samples():
+    m = FrechetInceptionDistance(feature=IdentityExtractor())
+    with pytest.raises(RuntimeError, match="More than one sample"):
+        m.compute()
+
+
+def np_poly_mmd(x, y, degree=3, coef=1.0):
+    gamma = 1.0 / x.shape[1]
+    kxx = (x @ x.T * gamma + coef) ** degree
+    kyy = (y @ y.T * gamma + coef) ** degree
+    kxy = (x @ y.T * gamma + coef) ** degree
+    m = x.shape[0]
+    val = (kxx.sum() - np.trace(kxx) + kyy.sum() - np.trace(kyy)) / (m * (m - 1))
+    return val - 2 * kxy.sum() / m**2
+
+
+def test_poly_mmd_vs_numpy():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(50, 8))
+    y = rng.normal(size=(50, 8)) + 0.5
+    got = float(poly_mmd(jnp.asarray(x), jnp.asarray(y)))
+    assert got == pytest.approx(np_poly_mmd(x, y), rel=1e-5)
+
+
+def test_kid_metric():
+    rng = np.random.default_rng(4)
+    real = rng.normal(size=(80, 8)).astype(np.float32)
+    fake = (rng.normal(size=(80, 8)) + 1.0).astype(np.float32)
+    m = KernelInceptionDistance(feature=IdentityExtractor(), subsets=4, subset_size=40)
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(fake), real=False)
+    mean, std = m.compute()
+    assert float(mean) > 0
+    assert float(std) >= 0
+    # same-distribution KID must be far below the shifted-distribution KID
+    m2 = KernelInceptionDistance(feature=IdentityExtractor(), subsets=4, subset_size=40)
+    m2.update(jnp.asarray(real), real=True)
+    m2.update(jnp.asarray(real), real=False)
+    mean2, _ = m2.compute()
+    assert abs(float(mean2)) < float(mean) / 2
+
+
+def test_kid_subset_size_validation():
+    m = KernelInceptionDistance(feature=IdentityExtractor(), subsets=2, subset_size=1000)
+    m.update(jnp.ones((10, 8)), real=True)
+    m.update(jnp.ones((10, 8)), real=False)
+    with pytest.raises(ValueError, match="subset_size"):
+        m.compute()
+
+
+def np_inception_score(logits, splits):
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    prob = e / e.sum(axis=1, keepdims=True)
+    n = prob.shape[0]
+    size = n // splits
+    scores = []
+    for i in range(splits):
+        p = prob[i * size : (i + 1) * size]
+        kl = p * (np.log(p) - np.log(p.mean(axis=0, keepdims=True)))
+        scores.append(np.exp(kl.sum(axis=1).mean()))
+    return np.mean(scores), np.std(scores, ddof=1)
+
+
+def test_inception_score_vs_numpy():
+    rng = np.random.default_rng(5)
+    logits = rng.normal(size=(100, 10)).astype(np.float32) * 3
+    got_mean, got_std = inception_score_from_logits(jnp.asarray(logits), splits=5)
+    want_mean, want_std = np_inception_score(logits, 5)
+    assert float(got_mean) == pytest.approx(want_mean, rel=1e-4)
+    assert float(got_std) == pytest.approx(want_std, rel=1e-3)
+
+
+def test_inception_score_metric():
+    rng = np.random.default_rng(6)
+    logits = rng.normal(size=(64, 8)).astype(np.float32)
+    m = InceptionScore(feature=IdentityExtractor(), splits=4)
+    m.update(jnp.asarray(logits[:32]))
+    m.update(jnp.asarray(logits[32:]))
+    mean, std = m.compute()
+    want_mean, _ = np_inception_score(logits, 4)
+    assert float(mean) == pytest.approx(want_mean, rel=1e-4)
+
+
+def test_mifid_metric():
+    rng = np.random.default_rng(7)
+    real = rng.normal(size=(100, 8)).astype(np.float32)
+    fake = (rng.normal(size=(100, 8)) * 1.2 + 0.3).astype(np.float32)
+    m = MemorizationInformedFrechetInceptionDistance(feature=IdentityExtractor())
+    m.update(jnp.asarray(real), real=True)
+    m.update(jnp.asarray(fake), real=False)
+    v = float(m.compute())
+    assert np.isfinite(v) and v > 0
+    # memorized (identical) features: distance gate fires, mifid >> fid is avoided
+    m2 = MemorizationInformedFrechetInceptionDistance(feature=IdentityExtractor())
+    m2.update(jnp.asarray(real), real=True)
+    m2.update(jnp.asarray(real + 1e-6), real=False)
+    assert float(m2.compute()) == pytest.approx(0.0, abs=1e-3)
+
+
+def test_kid_reset_real_features_preserved():
+    rng = np.random.default_rng(10)
+    real = jnp.asarray(rng.normal(size=(50, 8)), jnp.float32)
+    m = KernelInceptionDistance(feature=IdentityExtractor(), subsets=2, subset_size=20, reset_real_features=False)
+    m.update(real, real=True)
+    m.reset()
+    assert len(m.metric_state["real_features"]) == 1
+    assert len(m.metric_state["fake_features"]) == 0
+
+
+def test_inception_score_small_n():
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(size=(8, 5)), jnp.float32)
+    mean, std = inception_score_from_logits(logits, splits=10)  # n < splits
+    assert np.isfinite(float(mean))
+    mean25, _ = inception_score_from_logits(jnp.asarray(rng.normal(size=(25, 5)), jnp.float32), splits=10)
+    assert np.isfinite(float(mean25))
+
+
+def test_ppl_conditional():
+    class CondGen(ToyGenerator):
+        num_classes = 4
+
+        def __call__(self, z, labels=None):
+            img = super().__call__(z)
+            if labels is not None:
+                img = img + labels[:, None, None, None] * 0.01
+            return img
+
+    m = PerceptualPathLength(num_samples=16, batch_size=8, resize=16, conditional=True)
+    m.update(CondGen())
+    mean, _, _ = m.compute()
+    assert np.isfinite(float(mean))
+    with pytest.raises(AttributeError, match="num_classes"):
+        m2 = PerceptualPathLength(num_samples=8, conditional=True)
+        m2.update(ToyGenerator())
+
+
+def test_lpips_functional():
+    rng = np.random.default_rng(8)
+    a = jnp.asarray(rng.random((4, 3, 32, 32)), jnp.float32)
+    same = float(learned_perceptual_image_patch_similarity(a, a, normalize=True))
+    assert same == pytest.approx(0.0, abs=1e-6)
+    b = jnp.asarray(rng.random((4, 3, 32, 32)), jnp.float32)
+    diff = float(learned_perceptual_image_patch_similarity(a, b, normalize=True))
+    assert diff > 0
+    with pytest.raises(ValueError, match="net_type"):
+        learned_perceptual_image_patch_similarity(a, b, net_type="bogus")
+
+
+def test_lpips_metric_accumulation():
+    rng = np.random.default_rng(9)
+    a = jnp.asarray(rng.random((8, 3, 32, 32)), jnp.float32)
+    b = jnp.asarray(rng.random((8, 3, 32, 32)), jnp.float32)
+    m = LearnedPerceptualImagePatchSimilarity(normalize=True)
+    m.update(a[:4], b[:4])
+    m.update(a[4:], b[4:])
+    got = float(m.compute())
+    want = float(learned_perceptual_image_patch_similarity(a, b, normalize=True))
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+class ToyGenerator:
+    """Latent (B, 8) -> images (B, 3, 16, 16) via fixed random projection."""
+
+    def __init__(self):
+        key = jax.random.PRNGKey(0)
+        self.w = jax.random.normal(key, (8, 3 * 16 * 16)) * 0.1
+
+    def sample(self, key, n):
+        return jax.random.normal(key, (n, 8))
+
+    def __call__(self, z):
+        img = jnp.tanh(z @ self.w).reshape(z.shape[0], 3, 16, 16)
+        return img
+
+
+def test_perceptual_path_length():
+    gen = ToyGenerator()
+    m = PerceptualPathLength(num_samples=32, batch_size=16, resize=16)
+    m.update(gen)
+    mean, std, dists = m.compute()
+    assert np.isfinite(float(mean)) and float(mean) >= 0
+    assert dists.shape[0] > 0
+    with pytest.raises(ValueError, match="interpolation_method"):
+        PerceptualPathLength(interpolation_method="bogus")

@@ -21,8 +21,10 @@ from torchmetrics_tpu.functional.image.ssim import (
     structural_similarity_index_measure,
 )
 from torchmetrics_tpu.functional.image.tv import image_gradients, total_variation
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
 
 __all__ = [
+    "learned_perceptual_image_patch_similarity",
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
     "multiscale_structural_similarity_index_measure",

@@ -422,11 +422,11 @@ def spatial_distortion_index(
         pan_degraded = jnp.asarray(pan_lr)
 
     length = preds.shape[1]
-    m1 = jnp.asarray(
-        [float(universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1])) for i in range(length)]
+    m1 = jnp.stack(
+        [universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1]) for i in range(length)]
     )
-    m2 = jnp.asarray(
-        [float(universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1])) for i in range(length)]
+    m2 = jnp.stack(
+        [universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1]) for i in range(length)]
     )
     diff = jnp.abs(m1 - m2) ** norm_order
     return reduce(diff, reduction or "none") ** (1 / norm_order)
