@@ -1,0 +1,258 @@
+"""Modular audio metrics.
+
+Reference: audio/{snr.py:35,145,244, sdr.py:37,173,282, pit.py:30, pesq.py:29,
+stoi.py:29, srmr.py:37}.  Every class keeps the reference's
+(sum-of-per-sample-values, count) scalar states, so distributed sync is two
+psums regardless of batch shape.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
+from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
+from torchmetrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from torchmetrics_tpu.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from torchmetrics_tpu.functional.audio.srmr import (
+    speech_reverberation_modulation_energy_ratio,
+)
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+
+
+class _AveragedAudioMetric(Metric):
+    """Base: (Σ per-sample value, n) states; subclass supplies ``_values``."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_value", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        values = self._values(preds, target)
+        return {
+            "sum_value": state["sum_value"] + values.sum(),
+            "total": state["total"] + values.size,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return state["sum_value"] / state["total"]
+
+
+class SignalNoiseRatio(_AveragedAudioMetric):
+    """SNR (reference audio/snr.py:35)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class ScaleInvariantSignalNoiseRatio(_AveragedAudioMetric):
+    """SI-SNR (reference audio/snr.py:145)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_noise_ratio(preds, target)
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_AveragedAudioMetric):
+    """C-SI-SNR (reference audio/snr.py:244)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return complex_scale_invariant_signal_noise_ratio(preds, target, self.zero_mean)
+
+
+class SignalDistortionRatio(_AveragedAudioMetric):
+    """SDR (reference audio/sdr.py:37)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+
+
+class ScaleInvariantSignalDistortionRatio(_AveragedAudioMetric):
+    """SI-SDR (reference audio/sdr.py:173)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_distortion_ratio(preds, target, self.zero_mean)
+
+
+class SourceAggregatedSignalDistortionRatio(_AveragedAudioMetric):
+    """SA-SDR (reference audio/sdr.py:282)."""
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return source_aggregated_signal_distortion_ratio(
+            preds, target, self.scale_invariant, self.zero_mean
+        )
+
+
+class PermutationInvariantTraining(_AveragedAudioMetric):
+    """PIT (reference audio/pit.py:30)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        from torchmetrics_tpu.core.metric import METRIC_BASE_KWARGS
+
+        base_kwargs = {k: kwargs.pop(k) for k in list(kwargs) if k in METRIC_BASE_KWARGS}
+        super().__init__(**base_kwargs)
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.metric_kwargs = kwargs  # remaining kwargs forward to metric_func
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        best_metric, _ = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.metric_kwargs
+        )
+        return best_metric
+
+
+class PerceptualEvaluationSpeechQuality(_AveragedAudioMetric):
+    """PESQ (reference audio/pesq.py:29); requires the native backend or a
+    custom ``backend`` callable."""
+
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = -0.5
+    plot_upper_bound = 4.5
+
+    def __init__(
+        self,
+        fs: int,
+        mode: str,
+        n_processes: int = 1,
+        backend: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.fs = fs
+        self.mode = mode
+        self.backend = backend
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return jnp.atleast_1d(
+            perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode, backend=self.backend)
+        )
+
+
+class ShortTimeObjectiveIntelligibility(_AveragedAudioMetric):
+    """STOI (reference audio/stoi.py:29)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.extended = extended
+
+    def _values(self, preds: Array, target: Array) -> Array:
+        return jnp.atleast_1d(
+            short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+        )
+
+
+class SpeechReverberationModulationEnergyRatio(_AveragedAudioMetric):
+    """SRMR (reference audio/srmr.py:37) — no target needed."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        self.fs = fs
+
+    def _update(self, state: State, preds: Array) -> State:
+        values = jnp.atleast_1d(speech_reverberation_modulation_energy_ratio(preds, self.fs))
+        return {
+            "sum_value": state["sum_value"] + values.sum(),
+            "total": state["total"] + values.size,
+        }

@@ -52,6 +52,22 @@ State = Dict[str, Any]
 
 _N = "_n"  # reserved state key: int32 update counter, always psum/sum-merged
 
+# ctor kwargs consumed by Metric.__init__ — wrappers that forward leftover
+# kwargs elsewhere (e.g. PermutationInvariantTraining) split on this set
+METRIC_BASE_KWARGS = frozenset(
+    {
+        "sync_on_compute",
+        "dist_sync_on_step",
+        "compute_with_cache",
+        "axis_name",
+        "jit",
+        "dist_sync_fn",
+        "distributed_available_fn",
+        "process_group",
+        "compute_on_cpu",
+    }
+)
+
 
 class Metric:
     """Base class for all metrics.

@@ -1,0 +1,61 @@
+"""PESQ (reference: functional/audio/pesq.py wraps the native ``pesq`` C
+package, gated by RequirementCache — same gating here; a pure reimplementation
+of ITU-T P.862 is out of scope and the C package is not in this image).
+
+A custom backend callable ``(fs, target, preds, mode) -> float`` may be
+supplied for hermetic use.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+try:  # pragma: no cover - exercised only when the native package exists
+    import pesq as _pesq_backend  # type: ignore
+
+    _PESQ_AVAILABLE = True
+except ImportError:
+    _pesq_backend = None
+    _PESQ_AVAILABLE = False
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+    backend: Optional[Callable] = None,
+) -> Array:
+    """PESQ score per sample (reference functional/audio/pesq.py:30-120)."""
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    if mode == "wb" and fs == 8000:
+        raise ValueError("In wide band mode only sample rate of 16000 is supported")
+
+    if backend is None:
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]` "
+                "or `pip install pesq`, or pass a custom `backend` callable."
+            )
+        backend = lambda _fs, t, p, _mode: _pesq_backend.pesq(_fs, t, p, _mode)  # noqa: E731
+
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if preds_np.shape != target_np.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds_np.shape} and {target_np.shape}."
+        )
+    flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+    flat_t = target_np.reshape(-1, target_np.shape[-1])
+    vals = [float(backend(fs, t, p, mode)) for p, t in zip(flat_p, flat_t)]
+    out = jnp.asarray(vals, jnp.float32).reshape(preds_np.shape[:-1] or (1,))
+    return out[0] if preds_np.ndim == 1 else out

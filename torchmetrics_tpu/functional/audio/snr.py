@@ -1,0 +1,52 @@
+"""SNR family (reference: functional/audio/snr.py:22-150)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.image.helper import _check_same_shape
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SNR = 10 log10(||target||² / ||target − preds||²) (snr.py:22-62)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - target.mean(axis=-1, keepdims=True)
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR (snr.py:64-88) — identical to SI-SDR with zero_mean=True."""
+    from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+    return scale_invariant_signal_distortion_ratio(preds, target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(
+    preds: Array, target: Array, zero_mean: bool = False
+) -> Array:
+    """C-SI-SNR on complex spectrograms (..., F, T, 2) or complex (..., F, T)
+    (snr.py:90-150)."""
+    from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)

@@ -1,0 +1,124 @@
+"""SRMR — speech-to-reverberation modulation energy ratio.
+
+Reference: functional/audio/srmr.py wraps the ``gammatone``/``torchaudio``
+stack (RequirementCache-gated).  Implemented here natively: a gammatone
+filterbank (4th-order IIR approximated with FFT-domain magnitude response),
+modulation filterbank over the temporal envelope, and the ratio of low (first
+4) to high modulation-band energy.  Follows the SRMR toolbox structure
+[Falk et al., 2010] with norm=False defaults.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+@functools.lru_cache(maxsize=8)
+def _erb_center_freqs(low_freq: float, high_freq: float, n_bands: int) -> np.ndarray:
+    """Equally-spaced center frequencies on the ERB scale."""
+    ear_q = 9.26449
+    min_bw = 24.7
+    cfs = -(ear_q * min_bw) + np.exp(
+        np.arange(1, n_bands + 1)
+        * (-np.log(high_freq + ear_q * min_bw) + np.log(low_freq + ear_q * min_bw))
+        / n_bands
+    ) * (high_freq + ear_q * min_bw)
+    return cfs[::-1].copy()
+
+
+def _gammatone_fft_weights(fs: int, n_samples: int, cfs: np.ndarray) -> np.ndarray:
+    """(n_bands, n_freqs) gammatone magnitude response sampled on the rFFT grid."""
+    ear_q = 9.26449
+    min_bw = 24.7
+    order = 4
+    freqs = np.fft.rfftfreq(n_samples, 1.0 / fs)
+    erb = ((cfs / ear_q) ** order + min_bw**order) ** (1.0 / order)
+    b = 1.019 * 2 * np.pi * erb
+    # 4th-order gammatone magnitude response
+    resp = (1.0 + ((2 * np.pi * (freqs[None, :] - cfs[:, None])) / b[:, None]) ** 2) ** (-order / 2)
+    return resp
+
+
+def _modulation_band_centers(min_cf: float, max_cf: float, n_bands: int = 8) -> np.ndarray:
+    """Log-spaced modulation filter centers (SRMR toolbox: 4..128 Hz default)."""
+    return np.exp(np.linspace(np.log(min_cf), np.log(max_cf), n_bands))
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125.0,
+    min_cf: float = 4.0,
+    max_cf: float = 128.0,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR per sample (reference functional/audio/srmr.py:60-200)."""
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if fast:
+        raise NotImplementedError(
+            "`fast=True` (gammatonegram approximation) is not implemented; use fast=False."
+        )
+    preds_np = np.asarray(preds, np.float64)
+    flat = preds_np.reshape(-1, preds_np.shape[-1])
+
+    n = flat.shape[-1]
+    cfs = _erb_center_freqs(low_freq, fs / 2 * 0.9, n_cochlear_filters)
+    gt = _gammatone_fft_weights(fs, n, cfs)  # (C, F)
+
+    spec = np.fft.rfft(flat, axis=-1)  # (B, F)
+    # per-band time signals via masked inverse FFT: (B, C, T)
+    band_sig = np.fft.irfft(spec[:, None, :] * gt[None, :, :], n=n, axis=-1)
+
+    # temporal envelope via Hilbert magnitude (FFT method)
+    analytic = _hilbert(band_sig)
+    env = np.abs(analytic)
+
+    # modulation spectrogram: frame the envelope (256 ms window, 64 ms shift)
+    wlen = int(0.256 * fs)
+    shift = int(0.064 * fs)
+    if env.shape[-1] < wlen:
+        # zero-pad short signals up to one full analysis window
+        pad = wlen - env.shape[-1]
+        env = np.pad(env, [(0, 0)] * (env.ndim - 1) + [(0, pad)])
+    n_frames = (env.shape[-1] - wlen) // shift + 1
+    idx = np.arange(wlen)[None, :] + shift * np.arange(n_frames)[:, None]
+    frames = env[..., idx] * np.hamming(wlen)  # (B, C, T', W)
+    mod_spec = np.abs(np.fft.rfft(frames, axis=-1))  # (B, C, T', Fm)
+    mod_freqs = np.fft.rfftfreq(wlen, 1.0 / fs)
+
+    centers = _modulation_band_centers(min_cf, max_cf)
+    edges = np.sqrt(np.concatenate([[centers[0] ** 2 / centers[1]], centers])
+                    * np.concatenate([centers, [centers[-1] ** 2 / centers[-2]]]))
+    energies = []
+    for k in range(8):
+        sel = (mod_freqs >= edges[k]) & (mod_freqs < edges[k + 1])
+        energies.append((mod_spec[..., sel] ** 2).sum(axis=-1))  # (B, C, T')
+    e = np.stack(energies, axis=-1)  # (B, C, T', 8)
+    e = e.mean(axis=2)  # avg over frames -> (B, C, 8)
+    if norm:
+        e = e / (e.sum(axis=-1, keepdims=True) + 1e-16)
+    total = e.sum(axis=1)  # (B, 8) summed over cochlear bands
+    srmr = total[:, :4].sum(axis=-1) / (total[:, 4:].sum(axis=-1) + 1e-16)
+    out = jnp.asarray(srmr, jnp.float32).reshape(preds_np.shape[:-1] or (1,))
+    return out[0] if preds_np.ndim == 1 else out
+
+
+def _hilbert(x: np.ndarray) -> np.ndarray:
+    n = x.shape[-1]
+    xf = np.fft.fft(x, axis=-1)
+    h = np.zeros(n)
+    if n % 2 == 0:
+        h[0] = h[n // 2] = 1
+        h[1 : n // 2] = 2
+    else:
+        h[0] = 1
+        h[1 : (n + 1) // 2] = 2
+    return np.fft.ifft(xf * h, axis=-1)
