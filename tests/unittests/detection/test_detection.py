@@ -1,0 +1,338 @@
+"""Detection metric tests.
+
+mAP oracles: the reference's doctest output (detection/mean_ap.py:230-276) and
+hand-derived COCO 101-point interpolation cases.  Panoptic oracles: reference
+doctest values (functional/detection/panoptic_qualities.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+
+
+# ------------------------------------------------------------------ box IoU
+def test_iou_functional_basic():
+    a = jnp.asarray([[0.0, 0.0, 10.0, 10.0]])
+    b = jnp.asarray([[5.0, 5.0, 15.0, 15.0]])
+    got = float(intersection_over_union(a, b, aggregate=False)[0, 0])
+    assert got == pytest.approx(25.0 / 175.0, abs=1e-6)
+    # GIoU of identical boxes = 1; far-apart boxes < 0
+    assert float(generalized_intersection_over_union(a, a, aggregate=False)[0, 0]) == pytest.approx(1.0)
+    far = jnp.asarray([[100.0, 100.0, 110.0, 110.0]])
+    assert float(generalized_intersection_over_union(a, far, aggregate=False)[0, 0]) < 0
+    assert float(distance_intersection_over_union(a, far, aggregate=False)[0, 0]) < 0
+    assert float(complete_intersection_over_union(a, a, aggregate=False)[0, 0]) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_iou_class_oracle():
+    preds = [{
+        "boxes": jnp.asarray([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+        "labels": jnp.asarray([4, 5]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[300.00, 100.00, 315.00, 150.00]]),
+        "labels": jnp.asarray([5]),
+    }]
+    m = IntersectionOverUnion()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["iou"]) == pytest.approx(0.8614, abs=1e-4)
+
+
+def test_iou_class_respect_labels_false():
+    preds = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]),
+        "labels": jnp.asarray([1]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0]]),
+        "labels": jnp.asarray([2]),
+    }]
+    m1 = IntersectionOverUnion(respect_labels=True)
+    m1.update(preds, target)
+    assert float(m1.compute()["iou"]) == 0.0  # nothing valid
+    m2 = IntersectionOverUnion(respect_labels=False)
+    m2.update(preds, target)
+    assert float(m2.compute()["iou"]) == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize(
+    "cls", [GeneralizedIntersectionOverUnion, DistanceIntersectionOverUnion, CompleteIntersectionOverUnion]
+)
+def test_iou_variants_classes_run(cls):
+    preds = [{
+        "boxes": jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+        "labels": jnp.asarray([0, 0]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[1.0, 1.0, 11.0, 11.0], [20.0, 20.0, 30.0, 30.0]]),
+        "labels": jnp.asarray([0, 0]),
+    }]
+    m = cls()
+    m.update(preds, target)
+    out = m.compute()
+    assert np.isfinite(float(out[m._iou_type]))
+
+
+# --------------------------------------------------------------------- mAP
+def test_map_reference_doctest_oracle():
+    preds = [dict(
+        boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        scores=jnp.asarray([0.536]),
+        labels=jnp.asarray([0]),
+    )]
+    target = [dict(
+        boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        labels=jnp.asarray([0]),
+    )]
+    m = MeanAveragePrecision(iou_type="bbox")
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.6, abs=1e-4)
+    assert float(res["map_50"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_75"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_large"]) == pytest.approx(0.6, abs=1e-4)
+    assert float(res["map_medium"]) == -1.0
+    assert float(res["map_small"]) == -1.0
+    assert float(res["mar_1"]) == pytest.approx(0.6, abs=1e-4)
+    assert float(res["mar_10"]) == pytest.approx(0.6, abs=1e-4)
+    assert float(res["mar_100"]) == pytest.approx(0.6, abs=1e-4)
+    assert float(res["map_per_class"]) == -1.0
+    assert int(res["classes"]) == 0
+
+
+def test_map_hand_derived_interpolation():
+    # dets (score order): TP, FP, TP over 2 gts -> pr=[1,1/2,2/3] -> monotone
+    # [1,2/3,2/3]; 101-pt AP = (51*1 + 50*2/3)/101
+    preds = [dict(
+        boxes=jnp.asarray([
+            [0.0, 0.0, 10.0, 10.0],
+            [50.0, 50.0, 60.0, 60.0],
+            [20.0, 20.0, 30.0, 30.0],
+        ]),
+        scores=jnp.asarray([0.9, 0.8, 0.7]),
+        labels=jnp.asarray([0, 0, 0]),
+    )]
+    target = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0], [20.0, 20.0, 30.0, 30.0]]),
+        labels=jnp.asarray([0, 0]),
+    )]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    want_ap = (51 * 1.0 + 50 * (2.0 / 3.0)) / 101
+    assert float(res["map"]) == pytest.approx(want_ap, abs=1e-5)
+    assert float(res["map_50"]) == pytest.approx(want_ap, abs=1e-5)
+    assert float(res["mar_100"]) == pytest.approx(1.0)
+    assert float(res["mar_1"]) == pytest.approx(0.5)
+    # gt areas are 100 (< 32^2) -> small
+    assert float(res["map_small"]) == pytest.approx(want_ap, abs=1e-5)
+    assert float(res["map_large"]) == -1.0
+
+
+def test_map_multiclass_and_accumulation():
+    # class 0 perfect, class 1 missed -> macro map = (1 + 0)/2
+    preds1 = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]),
+        scores=jnp.asarray([0.9]),
+        labels=jnp.asarray([0]),
+    )]
+    target1 = [dict(boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]), labels=jnp.asarray([0]))]
+    preds2 = [dict(
+        boxes=jnp.zeros((0, 4)),
+        scores=jnp.zeros(0),
+        labels=jnp.zeros(0, jnp.int32),
+    )]
+    target2 = [dict(boxes=jnp.asarray([[10.0, 10.0, 50.0, 50.0]]), labels=jnp.asarray([1]))]
+    m = MeanAveragePrecision(class_metrics=True)
+    m.update(preds1, target1)
+    m.update(preds2, target2)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.5, abs=1e-5)
+    np.testing.assert_allclose(np.asarray(res["map_per_class"]), [1.0, 0.0], atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res["classes"]), [0, 1])
+
+
+def test_map_crowd_ignored():
+    # crowd gt: matched det is ignored, crowd gt not counted as FN
+    preds = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]),
+        scores=jnp.asarray([0.9]),
+        labels=jnp.asarray([0]),
+    )]
+    target = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 10.0, 10.0]]),
+        labels=jnp.asarray([0]),
+        iscrowd=jnp.asarray([1]),
+    )]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == -1.0  # no valid (non-crowd) gt at all
+
+
+def test_map_segm():
+    mask_gt = np.zeros((1, 20, 20), bool)
+    mask_gt[0, :10, :10] = True
+    mask_pred = np.zeros((1, 20, 20), bool)
+    mask_pred[0, :10, :8] = True  # IoU = 80/100 = 0.8
+    preds = [dict(masks=jnp.asarray(mask_pred), scores=jnp.asarray([0.8]), labels=jnp.asarray([3]))]
+    target = [dict(masks=jnp.asarray(mask_gt), labels=jnp.asarray([3]))]
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(preds, target)
+    res = m.compute()
+    # IoU 0.8 passes thresholds 0.5..0.8 (7 of 10) -> map = 0.7
+    assert float(res["map"]) == pytest.approx(0.7, abs=1e-5)
+    assert float(res["map_50"]) == pytest.approx(1.0)
+
+
+def test_map_input_validation():
+    m = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="same length"):
+        m.update([], [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))])
+    with pytest.raises(ValueError, match="scores"):
+        m.update(
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))],
+            [dict(boxes=jnp.zeros((0, 4)), labels=jnp.zeros(0))],
+        )
+    with pytest.raises(ValueError, match="box_format"):
+        MeanAveragePrecision(box_format="bogus")
+
+
+def test_map_micro_average():
+    # class 0 perfect, class 1 missed: macro map = 0.5; micro pools detections
+    preds = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]),
+        scores=jnp.asarray([0.9]),
+        labels=jnp.asarray([0]),
+    )]
+    target = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0], [100.0, 100.0, 140.0, 140.0]]),
+        labels=jnp.asarray([0, 1]),
+    )]
+    macro = MeanAveragePrecision(average="macro")
+    macro.update(preds, target)
+    micro = MeanAveragePrecision(average="micro")
+    micro.update(preds, target)
+    m_macro = float(macro.compute()["map"])
+    m_micro = float(micro.compute()["map"])
+    assert m_macro == pytest.approx(0.5, abs=1e-5)
+    # micro: one pooled class with 2 gts, 1 TP det => recall caps at 0.5
+    want_micro = 51 / 101  # precision 1 up to recall 0.5, 0 beyond
+    assert m_micro == pytest.approx(want_micro, abs=1e-5)
+    np.testing.assert_array_equal(np.asarray(micro.compute()["classes"]), [0, 1])
+
+
+def test_map_extended_summary_ious():
+    preds = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]),
+        scores=jnp.asarray([0.9]),
+        labels=jnp.asarray([0]),
+    )]
+    target = [dict(boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]), labels=jnp.asarray([0]))]
+    m = MeanAveragePrecision(extended_summary=True)
+    m.update(preds, target)
+    res = m.compute()
+    assert res["precision"].shape[0] == 10
+    assert (0, 0) in res["ious"]
+    assert float(res["ious"][(0, 0)][0, 0]) == pytest.approx(1.0)
+
+
+def test_panoptic_large_instance_ids():
+    # COCO-panoptic RGB-encoded instance ids must not overflow the pairing
+    big = 16_000_000
+    preds = jnp.asarray([[[[1, big], [200, big + 1]], [[1, big], [200, big + 1]]]])
+    m = float(panoptic_quality(preds, preds, things={1, 200}, stuffs=set()))
+    assert m == pytest.approx(1.0)
+
+
+def test_map_box_format():
+    # same box in xywh
+    preds = [dict(
+        boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]),  # xywh
+        scores=jnp.asarray([0.9]),
+        labels=jnp.asarray([0]),
+    )]
+    target = [dict(boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]), labels=jnp.asarray([0]))]
+    m = MeanAveragePrecision(box_format="xywh")
+    m.update(preds, target)
+    assert float(m.compute()["map"]) == pytest.approx(1.0)
+
+
+# ------------------------------------------------------------ panoptic quality
+PQ_PREDS = jnp.asarray([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+                         [[0, 0], [0, 0], [6, 0], [0, 1]],
+                         [[0, 0], [0, 0], [6, 0], [0, 1]],
+                         [[0, 0], [7, 0], [6, 0], [1, 0]],
+                         [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+PQ_TARGET = jnp.asarray([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+                          [[0, 1], [0, 1], [6, 0], [0, 1]],
+                          [[0, 1], [0, 1], [6, 0], [1, 0]],
+                          [[0, 1], [7, 0], [1, 0], [1, 0]],
+                          [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+
+
+def test_panoptic_quality_oracle():
+    got = float(panoptic_quality(PQ_PREDS, PQ_TARGET, things={0, 1}, stuffs={6, 7}))
+    assert got == pytest.approx(0.5463, abs=1e-4)
+
+
+def test_panoptic_quality_sq_rq_oracle():
+    got = np.asarray(panoptic_quality(PQ_PREDS, PQ_TARGET, things={0, 1}, stuffs={6, 7}, return_sq_and_rq=True))
+    np.testing.assert_allclose(got, [0.5463, 0.6111, 0.6667], atol=1e-4)
+
+
+def test_panoptic_quality_per_class_oracle():
+    got = np.asarray(panoptic_quality(PQ_PREDS, PQ_TARGET, things={0, 1}, stuffs={6, 7}, return_per_class=True))
+    np.testing.assert_allclose(got, [[0.5185, 0.0, 0.6667, 1.0]], atol=1e-4)
+
+
+MPQ_PREDS = jnp.asarray([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+MPQ_TARGET = jnp.asarray([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+
+
+def test_modified_panoptic_quality_oracle():
+    got = float(modified_panoptic_quality(MPQ_PREDS, MPQ_TARGET, things={0, 1}, stuffs={6, 7}))
+    assert got == pytest.approx(0.7667, abs=1e-4)
+
+
+def test_panoptic_quality_class_accumulation():
+    m = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    m.update(PQ_PREDS, PQ_TARGET)
+    m.update(PQ_PREDS, PQ_TARGET)  # same twice: averages unchanged
+    assert float(m.compute()) == pytest.approx(0.5463, abs=1e-4)
+
+    m2 = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+    m2.update(MPQ_PREDS, MPQ_TARGET)
+    assert float(m2.compute()) == pytest.approx(0.7667, abs=1e-4)
+
+
+def test_panoptic_quality_validation():
+    with pytest.raises(ValueError, match="distinct"):
+        PanopticQuality(things={0, 1}, stuffs={1, 2})
+    m = PanopticQuality(things={0}, stuffs={6})
+    with pytest.raises(ValueError, match="Unknown categories"):
+        m.update(jnp.asarray([[[[9, 0]]]]), jnp.asarray([[[[0, 0]]]]))
+    # unknown categories in target always map to void, no error
+    m2 = PanopticQuality(things={0}, stuffs={6}, allow_unknown_preds_category=True)
+    m2.update(jnp.asarray([[[[0, 0]]]]), jnp.asarray([[[[9, 0]]]]))

@@ -1,0 +1,137 @@
+"""Detection IoU-family modular metrics (reference: detection/{iou.py:32,
+giou.py:29, diou.py:29, ciou.py:29})."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.detection.box_ops import box_convert
+from torchmetrics_tpu.functional.detection.iou import (
+    _ciou_update,
+    _diou_update,
+    _giou_update,
+    _iou_update,
+)
+
+
+def _input_validator(preds: Sequence, target: Sequence, ignore_score: bool = False) -> None:
+    if not isinstance(preds, Sequence) or not isinstance(target, Sequence):
+        raise ValueError("Expected argument `preds` and `target` to be a sequence of dicts")
+    if len(preds) != len(target):
+        raise ValueError("Expected argument `preds` and `target` to have the same length")
+    for p in preds:
+        keys = ("boxes", "labels") if ignore_score else ("boxes", "scores", "labels")
+        for k in keys:
+            if k not in p:
+                raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for t in target:
+        for k in ("boxes", "labels"):
+            if k not in t:
+                raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+
+class IntersectionOverUnion(Metric):
+    """Mean IoU of matched det/gt boxes (reference detection/iou.py:32)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+    _iou_update_fn: Callable = staticmethod(_iou_update)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in ("xyxy", "xywh", "cxcywh"):
+            raise ValueError(f"Expected argument `box_format` to be one of ('xyxy', 'xywh', 'cxcywh') but got {box_format}")
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        self.class_metrics = class_metrics
+        self.respect_labels = respect_labels
+
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self.add_state("iou_matrix", [], dist_reduce_fx=None)
+
+    def _update(self, state: State, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> State:
+        _input_validator(preds, target, ignore_score=True)
+        new = dict(state)
+        for p, t in zip(preds, target):
+            det_boxes = self._convert(p["boxes"])
+            gt_boxes = self._convert(t["boxes"])
+            iou_matrix = type(self)._iou_update_fn(det_boxes, gt_boxes, self.iou_threshold, self._invalid_val)
+            if self.respect_labels:
+                p_labels = jnp.asarray(p["labels"]).reshape(-1)
+                t_labels = jnp.asarray(t["labels"]).reshape(-1)
+                label_eq = p_labels[:, None] == t_labels[None, :]
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            new["groundtruth_labels"] = new["groundtruth_labels"] + (jnp.asarray(t["labels"]).reshape(-1),)
+            new["iou_matrix"] = new["iou_matrix"] + (iou_matrix,)
+        return new
+
+    def _convert(self, boxes: Array) -> Array:
+        boxes = jnp.asarray(boxes, jnp.float32)
+        boxes = boxes.reshape(-1, 4) if boxes.size else jnp.zeros((0, 4))
+        return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+
+    def _compute(self, state: State) -> Dict[str, Array]:
+        valid = [m[m != self._invalid_val] for m in state["iou_matrix"]]
+        flat = jnp.concatenate([v.ravel() for v in valid]) if valid else jnp.zeros(0)
+        score = flat.mean() if flat.size else jnp.zeros(())
+        results: Dict[str, Array] = {self._iou_type: score}
+        if self.class_metrics:
+            gt_labels = (
+                jnp.concatenate(state["groundtruth_labels"]) if state["groundtruth_labels"] else jnp.zeros(0)
+            )
+            classes = np.unique(np.asarray(gt_labels)).tolist() if gt_labels.size else []
+            for cl in classes:
+                total = cnt = 0.0
+                for mat, gl in zip(state["iou_matrix"], state["groundtruth_labels"]):
+                    scores = mat[:, np.asarray(gl) == cl]
+                    sel = scores[scores != self._invalid_val]
+                    total += float(sel.sum())
+                    cnt += int(sel.size)
+                results[f"{self._iou_type}/cl_{int(cl)}"] = jnp.asarray(total / cnt if cnt else 0.0)
+        return results
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    """GIoU (reference detection/giou.py:29)."""
+
+    _iou_type = "giou"
+    _invalid_val = -2.0
+    _iou_update_fn = staticmethod(_giou_update)
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """DIoU (reference detection/diou.py:29)."""
+
+    _iou_type = "diou"
+    _invalid_val = -2.0
+    _iou_update_fn = staticmethod(_diou_update)
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU (reference detection/ciou.py:29)."""
+
+    _iou_type = "ciou"
+    _invalid_val = -2.0
+    _iou_update_fn = staticmethod(_ciou_update)

@@ -1,0 +1,421 @@
+"""Mean Average Precision — native COCO evaluator.
+
+Reference: /root/reference/src/torchmetrics/detection/mean_ap.py:76 (1063 LoC)
+shells out to pycocotools/faster-coco-eval C extensions (``_load_backend_tools``
+:50).  Here the full COCOeval protocol — greedy per-class matching at 10 IoU
+thresholds, crowd handling, area ranges, maxDets caps, 101-point interpolated
+precision — is implemented natively (numpy host path; the per-image IoU
+matrices are plain tensor ops).  The in-tree pure-torch `detection/_mean_ap.py`
+proves this is semantically reachable without the C backend.
+
+States are per-image variable-length arrays kept as list ("cat") states, as in
+the reference (mean_ap.py:470-512).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.detection.box_ops import box_convert
+
+_AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+
+
+def _box_iou_crowd(det: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise IoU with COCO crowd semantics: for crowd gt the union is the
+    detection area (pycocotools maskUtils.iou)."""
+    if det.size == 0 or gt.size == 0:
+        return np.zeros((det.shape[0], gt.shape[0]))
+    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    det_area = (det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1])
+    gt_area = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    union = det_area[:, None] + gt_area[None, :] - inter
+    union = np.where(iscrowd[None, :].astype(bool), det_area[:, None], union)
+    return inter / np.maximum(union, 1e-12)
+
+
+def _mask_iou_crowd(det: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise mask IoU, crowd semantics as above; masks are (N, H, W) bool."""
+    if det.size == 0 or gt.size == 0:
+        return np.zeros((det.shape[0], gt.shape[0]))
+    d = det.reshape(det.shape[0], -1).astype(np.float64)
+    g = gt.reshape(gt.shape[0], -1).astype(np.float64)
+    inter = d @ g.T
+    d_area = d.sum(axis=1)
+    g_area = g.sum(axis=1)
+    union = d_area[:, None] + g_area[None, :] - inter
+    union = np.where(iscrowd[None, :].astype(bool), d_area[:, None], union)
+    return inter / np.maximum(union, 1e-12)
+
+
+def _evaluate_image(
+    ious: np.ndarray,          # (D, G) for this class/image
+    det_scores: np.ndarray,    # (D,)
+    gt_crowd: np.ndarray,      # (G,) bool
+    gt_area: np.ndarray,       # (G,)
+    det_area: np.ndarray,      # (D,)
+    iou_thrs: np.ndarray,
+    area_rng: Tuple[float, float],
+    max_det: int,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """COCOeval.evaluateImg: greedy match per IoU threshold.
+
+    Returns (dt_matches (T, D'), dt_ignore (T, D'), scores (D',), n_valid_gt).
+    """
+    gt_ignore = gt_crowd | (gt_area < area_rng[0]) | (gt_area > area_rng[1])
+    # gts sorted: non-ignored first (stable)
+    g_order = np.argsort(gt_ignore, kind="stable")
+    gt_ignore_sorted = gt_ignore[g_order]
+
+    d_order = np.argsort(-det_scores, kind="stable")[:max_det]
+    n_d = len(d_order)
+    n_g = len(g_order)
+    T = len(iou_thrs)
+
+    dtm = np.zeros((T, n_d), dtype=np.int64) - 1
+    dt_ig = np.zeros((T, n_d), dtype=bool)
+    gtm = np.zeros((T, n_g), dtype=np.int64) - 1
+
+    ious_s = ious[np.ix_(d_order, g_order)] if n_d and n_g else np.zeros((n_d, n_g))
+    crowd_sorted = gt_crowd[g_order]
+
+    for ti, t in enumerate(iou_thrs):
+        for di in range(n_d):
+            best_iou = min(t, 1 - 1e-10)
+            m = -1
+            for gi in range(n_g):
+                if gtm[ti, gi] >= 0 and not crowd_sorted[gi]:
+                    continue
+                if m > -1 and not gt_ignore_sorted[m] and gt_ignore_sorted[gi]:
+                    break  # only ignored gts remain; keep current non-ignored match
+                if ious_s[di, gi] < best_iou:
+                    continue
+                best_iou = ious_s[di, gi]
+                m = gi
+            if m != -1:
+                dtm[ti, di] = m
+                dt_ig[ti, di] = gt_ignore_sorted[m]
+                gtm[ti, m] = di
+
+    # unmatched dets outside the area range are ignored
+    d_area_sorted = det_area[d_order]
+    out_of_range = (d_area_sorted < area_rng[0]) | (d_area_sorted > area_rng[1])
+    dt_ig = dt_ig | ((dtm == -1) & out_of_range[None, :])
+
+    n_valid_gt = int((~gt_ignore).sum())
+    return (dtm >= 0), dt_ig, det_scores[d_order], n_valid_gt
+
+
+class _ImageRecord:
+    __slots__ = ("det_boxes", "det_scores", "det_labels", "gt_boxes", "gt_labels", "gt_crowd",
+                 "gt_area", "det_area", "det_masks", "gt_masks")
+
+    def __init__(self, **kw: Any) -> None:
+        for k in self.__slots__:
+            setattr(self, k, kw.get(k))
+
+
+class MeanAveragePrecision(Metric):
+    """COCO mAP/mAR (reference detection/mean_ap.py:76)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "native",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if box_format not in ("xyxy", "xywh", "cxcywh"):
+            raise ValueError(f"Expected argument `box_format` to be one of ('xyxy', 'xywh', 'cxcywh') but got {box_format}")
+        iou_types = (iou_type,) if isinstance(iou_type, str) else tuple(iou_type)
+        for it in iou_types:
+            if it not in ("bbox", "segm"):
+                raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {it}")
+        if len(iou_types) > 1:
+            raise NotImplementedError("Multiple simultaneous iou_types are not yet supported; pick 'bbox' or 'segm'.")
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+
+        self.box_format = box_format
+        self.iou_type = iou_types[0]
+        self.iou_thresholds = np.asarray(iou_thresholds if iou_thresholds is not None
+                                         else np.round(np.arange(0.5, 1.0, 0.05), 2))
+        self.rec_thresholds = np.asarray(rec_thresholds if rec_thresholds is not None
+                                         else np.round(np.arange(0.0, 1.01, 0.01), 2))
+        mdt = max_detection_thresholds if max_detection_thresholds is not None else [1, 10, 100]
+        if len(mdt) != 3:
+            raise ValueError("Argument `max_detection_thresholds` must be a list of length 3")
+        self.max_detection_thresholds = sorted(mdt)
+        self.class_metrics = class_metrics
+        self.extended_summary = extended_summary
+        self.average = average
+
+        # per-image variable-length states (reference mean_ap.py:470-512)
+        for name in ("detection_boxes", "detection_scores", "detection_labels",
+                     "groundtruth_boxes", "groundtruth_labels", "groundtruth_crowds",
+                     "groundtruth_area"):
+            self.add_state(name, [], dist_reduce_fx=None)
+
+    # -------------------------------------------------------------- update
+    def _update(self, state: State, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> State:
+        if not isinstance(preds, Sequence) or not isinstance(target, Sequence):
+            raise ValueError("Expected argument `preds` and `target` to be a sequence of dicts")
+        if len(preds) != len(target):
+            raise ValueError("Expected argument `preds` and `target` to have the same length")
+        key = "masks" if self.iou_type == "segm" else "boxes"
+        for p in preds:
+            for k in (key, "scores", "labels"):
+                if k not in p:
+                    raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+        for t in target:
+            for k in (key, "labels"):
+                if k not in t:
+                    raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+        new = {k: state[k] for k in state}
+        for p, t in zip(preds, target):
+            if self.iou_type == "segm":
+                det_item = jnp.asarray(p["masks"], bool)
+                gt_item = jnp.asarray(t["masks"], bool)
+            else:
+                det_item = self._convert_boxes(p["boxes"])
+                gt_item = self._convert_boxes(t["boxes"])
+            n_gt = gt_item.shape[0]
+            crowds = jnp.asarray(t.get("iscrowd", jnp.zeros(n_gt, jnp.int32))).reshape(-1)
+            if "area" in t and t["area"] is not None and jnp.asarray(t["area"]).size == n_gt:
+                area = jnp.asarray(t["area"], jnp.float32).reshape(-1)
+            else:
+                area = self._item_area(gt_item)
+            new["detection_boxes"] = new["detection_boxes"] + (det_item,)
+            new["detection_scores"] = new["detection_scores"] + (jnp.asarray(p["scores"], jnp.float32).reshape(-1),)
+            new["detection_labels"] = new["detection_labels"] + (jnp.asarray(p["labels"]).reshape(-1),)
+            new["groundtruth_boxes"] = new["groundtruth_boxes"] + (gt_item,)
+            new["groundtruth_labels"] = new["groundtruth_labels"] + (jnp.asarray(t["labels"]).reshape(-1),)
+            new["groundtruth_crowds"] = new["groundtruth_crowds"] + (crowds,)
+            new["groundtruth_area"] = new["groundtruth_area"] + (area,)
+        return new
+
+    def _convert_boxes(self, boxes: Array) -> Array:
+        boxes = jnp.asarray(boxes, jnp.float32).reshape(-1, 4) if jnp.asarray(boxes).size else jnp.zeros((0, 4))
+        return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+
+    def _item_area(self, item: Array) -> Array:
+        if self.iou_type == "segm":
+            return item.reshape(item.shape[0], -1).sum(axis=-1).astype(jnp.float32) if item.size else jnp.zeros(0)
+        if item.size == 0:
+            return jnp.zeros(0)
+        return ((item[:, 2] - item[:, 0]) * (item[:, 3] - item[:, 1])).astype(jnp.float32)
+
+    # -------------------------------------------------------------- compute
+    def _compute(self, state: State) -> Dict[str, Array]:
+        images: List[_ImageRecord] = []
+        for i in range(len(state["detection_boxes"])):
+            det_item = np.asarray(state["detection_boxes"][i])
+            gt_item = np.asarray(state["groundtruth_boxes"][i])
+            rec = _ImageRecord(
+                det_boxes=det_item,
+                det_scores=np.asarray(state["detection_scores"][i]),
+                det_labels=np.asarray(state["detection_labels"][i]),
+                gt_boxes=gt_item,
+                gt_labels=np.asarray(state["groundtruth_labels"][i]),
+                gt_crowd=np.asarray(state["groundtruth_crowds"][i]).astype(bool),
+                gt_area=np.asarray(state["groundtruth_area"][i]),
+                det_area=np.asarray(self._item_area(jnp.asarray(det_item))),
+            )
+            images.append(rec)
+
+        observed_classes = sorted(
+            set(np.concatenate([r.det_labels for r in images]).tolist() if images else [])
+            | set(np.concatenate([r.gt_labels for r in images]).tolist() if images else [])
+        )
+        if self.average == "micro":
+            # micro: collapse all labels to one class before evaluation
+            # (reference mean_ap.py maps labels to 0 for the coco datasets)
+            for r in images:
+                r.det_labels = np.zeros_like(r.det_labels)
+                r.gt_labels = np.zeros_like(r.gt_labels)
+            classes = [0] if observed_classes else []
+        else:
+            classes = observed_classes
+        iou_thrs = self.iou_thresholds
+        rec_thrs = self.rec_thresholds
+        max_dets = self.max_detection_thresholds
+        area_names = list(_AREA_RANGES)
+
+        T, R, K, A, M = len(iou_thrs), len(rec_thrs), len(classes), len(area_names), len(max_dets)
+        precision = -np.ones((T, R, K, A, M))
+        recall = -np.ones((T, K, A, M))
+        scores_out = -np.ones((T, R, K, A, M))
+
+        # per (class, image): iou matrices computed once
+        iou_cache: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = {}
+        for ki, cls in enumerate(classes):
+            for ii, r in enumerate(images):
+                d_sel = r.det_labels == cls
+                g_sel = r.gt_labels == cls
+                det = r.det_boxes[d_sel]
+                gt = r.gt_boxes[g_sel]
+                crowd = r.gt_crowd[g_sel]
+                if self.iou_type == "segm":
+                    ious = _mask_iou_crowd(det, gt, crowd)
+                else:
+                    ious = _box_iou_crowd(det, gt, crowd)
+                iou_cache[(ki, ii)] = (
+                    ious, r.det_scores[d_sel], crowd, r.gt_area[g_sel], r.det_area[d_sel]
+                )
+
+        for ki in range(K):
+            for ai, aname in enumerate(area_names):
+                arng = _AREA_RANGES[aname]
+                for mi, mdet in enumerate(max_dets):
+                    all_scores, all_tp, all_ig = [], [], []
+                    npig = 0
+                    for ii in range(len(images)):
+                        ious, d_scores, crowd, g_area, d_area = iou_cache[(ki, ii)]
+                        if ious.shape[0] == 0 and ious.shape[1] == 0:
+                            continue
+                        tp, ig, sc, nv = _evaluate_image(
+                            ious, d_scores, crowd, g_area, d_area, iou_thrs, arng, mdet
+                        )
+                        all_tp.append(tp)
+                        all_ig.append(ig)
+                        all_scores.append(sc)
+                        npig += nv
+                    if npig == 0:
+                        continue
+                    if all_scores:
+                        scores = np.concatenate(all_scores)
+                        order = np.argsort(-scores, kind="mergesort")
+                        scores = scores[order]
+                        tp = np.concatenate(all_tp, axis=1)[:, order]
+                        ig = np.concatenate(all_ig, axis=1)[:, order]
+                    else:
+                        scores = np.zeros(0)
+                        tp = np.zeros((T, 0), bool)
+                        ig = np.zeros((T, 0), bool)
+
+                    tps = tp & ~ig
+                    fps = ~tp & ~ig
+                    tp_cum = np.cumsum(tps, axis=1).astype(np.float64)
+                    fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
+                    for ti in range(T):
+                        tp_c, fp_c = tp_cum[ti], fp_cum[ti]
+                        nd = len(tp_c)
+                        rc = tp_c / npig
+                        pr = tp_c / np.maximum(fp_c + tp_c, np.spacing(1))
+                        recall[ti, ki, ai, mi] = rc[-1] if nd else 0.0
+                        # monotone precision from the right (pycocotools accumulate)
+                        pr = pr.tolist()
+                        for i in range(nd - 1, 0, -1):
+                            if pr[i] > pr[i - 1]:
+                                pr[i - 1] = pr[i]
+                        inds = np.searchsorted(rc, rec_thrs, side="left")
+                        q = np.zeros(R)
+                        ss = np.zeros(R)
+                        for ri, pi in enumerate(inds):
+                            if pi < nd:
+                                q[ri] = pr[pi]
+                                ss[ri] = scores[pi]
+                        precision[ti, :, ki, ai, mi] = q
+                        scores_out[ti, :, ki, ai, mi] = ss
+
+        def _summarize(ap: bool, iou_thr: Optional[float] = None, area: str = "all", mdet: int = 100) -> float:
+            ai = area_names.index(area)
+            mi = max_dets.index(mdet)
+            if ap:
+                s = precision[:, :, :, ai, mi]
+                if iou_thr is not None:
+                    sel = np.where(np.isclose(iou_thrs, iou_thr))[0]
+                    if len(sel) == 0:
+                        return -1.0
+                    s = s[sel]
+            else:
+                s = recall[:, :, ai, mi]
+                if iou_thr is not None:
+                    sel = np.where(np.isclose(iou_thrs, iou_thr))[0]
+                    if len(sel) == 0:
+                        return -1.0
+                    s = s[sel]
+            valid = s[s > -1]
+            return float(valid.mean()) if valid.size else -1.0
+
+        mdt = max_dets
+        res: Dict[str, Any] = {
+            "map": _summarize(True, None, "all", mdt[-1]),
+            "map_50": _summarize(True, 0.5, "all", mdt[-1]),
+            "map_75": _summarize(True, 0.75, "all", mdt[-1]),
+            "map_small": _summarize(True, None, "small", mdt[-1]),
+            "map_medium": _summarize(True, None, "medium", mdt[-1]),
+            "map_large": _summarize(True, None, "large", mdt[-1]),
+            f"mar_{mdt[0]}": _summarize(False, None, "all", mdt[0]),
+            f"mar_{mdt[1]}": _summarize(False, None, "all", mdt[1]),
+            f"mar_{mdt[2]}": _summarize(False, None, "all", mdt[2]),
+            "mar_small": _summarize(False, None, "small", mdt[-1]),
+            "mar_medium": _summarize(False, None, "medium", mdt[-1]),
+            "mar_large": _summarize(False, None, "large", mdt[-1]),
+        }
+
+        map_per_class: Union[float, np.ndarray] = -1.0
+        mar_per_class: Union[float, np.ndarray] = -1.0
+        if self.class_metrics and K:
+            ai = area_names.index("all")
+            mi = max_dets.index(mdt[-1])
+            per_cls_ap = []
+            per_cls_ar = []
+            for ki in range(K):
+                p = precision[:, :, ki, ai, mi]
+                valid = p[p > -1]
+                per_cls_ap.append(float(valid.mean()) if valid.size else -1.0)
+                rr = recall[:, ki, ai, mi]
+                valid_r = rr[rr > -1]
+                per_cls_ar.append(float(valid_r.mean()) if valid_r.size else -1.0)
+            map_per_class = np.asarray(per_cls_ap, np.float32)
+            mar_per_class = np.asarray(per_cls_ar, np.float32)
+
+        out = {k: jnp.asarray(v, jnp.float32) for k, v in res.items()}
+        out["map_per_class"] = jnp.asarray(map_per_class, jnp.float32)
+        out[f"mar_{mdt[-1]}_per_class"] = jnp.asarray(mar_per_class, jnp.float32)
+        out["classes"] = (
+            jnp.asarray(np.asarray(observed_classes, np.int32).squeeze())
+            if observed_classes
+            else jnp.asarray([], jnp.int32)
+        )
+        if self.extended_summary:
+            out["precision"] = jnp.asarray(precision, jnp.float32)
+            out["recall"] = jnp.asarray(recall, jnp.float32)
+            out["scores"] = jnp.asarray(scores_out, jnp.float32)
+            # per (image_idx, class_id) iou matrices, mirroring COCOeval.ious
+            out["ious"] = {  # type: ignore[assignment]
+                (ii, classes[ki]): jnp.asarray(iou_cache[(ki, ii)][0], jnp.float32)
+                for ki in range(K)
+                for ii in range(len(images))
+            }
+        return out

@@ -1,0 +1,114 @@
+"""PanopticQuality / ModifiedPanopticQuality modular metrics
+(reference: detection/panoptic_qualities.py:40,299)."""
+
+from __future__ import annotations
+
+from typing import Any, Collection
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.detection.panoptic_quality import (
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+)
+
+
+class PanopticQuality(Metric):
+    """PQ with sum-reduced per-category (iou_sum, tp, fp, fn) states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _modified = False
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things_s, stuffs_s = _parse_categories(things, stuffs)
+        self.things = things_s
+        self.stuffs = stuffs_s
+        self.void_color = _get_void_color(things_s, stuffs_s)
+        cats = [*sorted(things_s), *sorted(stuffs_s)]
+        self.cat_id_to_continuous_id = {c: i for i, c in enumerate(cats)}
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+
+        n = len(cats)
+        self.add_state("iou_sum", jnp.zeros(n), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(n), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(n), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(n), dist_reduce_fx="sum")
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        preds_np = np.asarray(preds)
+        target_np = np.asarray(target)
+        if preds_np.ndim < 3 or preds_np.shape[-1] != 2:
+            raise ValueError(f"Expected argument `preds` to have shape (B, *spatial, 2) but got {preds_np.shape}")
+        if target_np.shape != preds_np.shape:
+            raise ValueError(
+                f"Expected argument `preds` and `target` to have the same shape, but got {preds_np.shape} and {target_np.shape}"
+            )
+        flat_preds = _preprocess_inputs(
+            self.things, self.stuffs, preds_np, self.void_color, self.allow_unknown_preds_category
+        )
+        flat_target = _preprocess_inputs(self.things, self.stuffs, target_np, self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            flat_preds, flat_target, self.cat_id_to_continuous_id, self.void_color,
+            modified_metric_stuffs=self.stuffs if self._modified else None,
+        )
+        return {
+            "iou_sum": state["iou_sum"] + jnp.asarray(iou_sum),
+            "true_positives": state["true_positives"] + jnp.asarray(tp, jnp.float32),
+            "false_positives": state["false_positives"] + jnp.asarray(fp, jnp.float32),
+            "false_negatives": state["false_negatives"] + jnp.asarray(fn, jnp.float32),
+        }
+
+    def _compute(self, state: State) -> Array:
+        pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+            np.asarray(state["iou_sum"]),
+            np.asarray(state["true_positives"]),
+            np.asarray(state["false_positives"]),
+            np.asarray(state["false_negatives"]),
+        )
+        if self.return_per_class:
+            if self.return_sq_and_rq:
+                return jnp.asarray(np.stack([pq, sq, rq], axis=-1))[None]
+            return jnp.asarray(pq)[None]
+        if self.return_sq_and_rq:
+            return jnp.asarray([pq_avg, sq_avg, rq_avg])
+        return jnp.asarray(pq_avg)
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """PQ† (reference detection/panoptic_qualities.py:299)."""
+
+    _modified = True
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            things=things, stuffs=stuffs,
+            allow_unknown_preds_category=allow_unknown_preds_category, **kwargs
+        )

@@ -1,0 +1,97 @@
+"""Box primitives — JAX equivalents of the torchvision.ops the reference
+imports (box_convert/box_iou/generalized_box_iou/distance_box_iou/
+complete_box_iou; torchvision is an external dep of the reference,
+functional/detection/iou.py:33).  All pairwise kernels are (N, M) batched
+tensor expressions — no loops.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert between xyxy / xywh / cxcywh box layouts."""
+    if in_fmt == out_fmt:
+        return boxes
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    elif in_fmt != "xyxy":
+        raise ValueError(f"Unsupported box format {in_fmt}")
+    if out_fmt == "xyxy":
+        return boxes
+    x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+    if out_fmt == "xywh":
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    if out_fmt == "cxcywh":
+        return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+    raise ValueError(f"Unsupported box format {out_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _pairwise_intersection_union(preds: Array, target: Array) -> Tuple[Array, Array]:
+    lt = jnp.maximum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.minimum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = box_area(preds)[:, None] + box_area(target)[None, :] - inter
+    return inter, union
+
+
+def box_iou(preds: Array, target: Array) -> Array:
+    inter, union = _pairwise_intersection_union(preds, target)
+    return inter / jnp.maximum(union, 1e-12)
+
+
+def generalized_box_iou(preds: Array, target: Array) -> Array:
+    inter, union = _pairwise_intersection_union(preds, target)
+    iou = inter / jnp.maximum(union, 1e-12)
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    hull = wh[..., 0] * wh[..., 1]
+    return iou - (hull - union) / jnp.maximum(hull, 1e-12)
+
+
+def distance_box_iou(preds: Array, target: Array) -> Array:
+    inter, union = _pairwise_intersection_union(preds, target)
+    iou = inter / jnp.maximum(union, 1e-12)
+    return iou - _center_distance_term(preds, target)
+
+
+def _center_distance_term(preds: Array, target: Array) -> Array:
+    lt = jnp.minimum(preds[:, None, :2], target[None, :, :2])
+    rb = jnp.maximum(preds[:, None, 2:], target[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0)
+    diag_sq = wh[..., 0] ** 2 + wh[..., 1] ** 2
+    cp = (preds[:, :2] + preds[:, 2:]) / 2
+    ct = (target[:, :2] + target[:, 2:]) / 2
+    d_sq = ((cp[:, None, :] - ct[None, :, :]) ** 2).sum(-1)
+    return d_sq / jnp.maximum(diag_sq, 1e-12)
+
+
+def complete_box_iou(preds: Array, target: Array) -> Array:
+    inter, union = _pairwise_intersection_union(preds, target)
+    iou = inter / jnp.maximum(union, 1e-12)
+    diou = iou - _center_distance_term(preds, target)
+    wp = preds[:, 2] - preds[:, 0]
+    hp = preds[:, 3] - preds[:, 1]
+    wt = target[:, 2] - target[:, 0]
+    ht = target[:, 3] - target[:, 1]
+    v = (4 / math.pi**2) * (
+        jnp.arctan(wt[None, :] / jnp.maximum(ht[None, :], 1e-12))
+        - jnp.arctan(wp[:, None] / jnp.maximum(hp[:, None], 1e-12))
+    ) ** 2
+    alpha = v / jnp.maximum(1 - iou + v, 1e-12)
+    return diou - alpha * v

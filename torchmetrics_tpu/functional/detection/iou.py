@@ -1,0 +1,82 @@
+"""IoU-family functionals (reference: functional/detection/{iou,giou,diou,ciou}.py)."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.detection.box_ops import (
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+
+
+def _make_update(pairwise_fn: Callable) -> Callable:
+    def _update(
+        preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0
+    ) -> Array:
+        preds = jnp.asarray(preds, jnp.float32).reshape(-1, 4) if preds.size else jnp.zeros((0, 4))
+        target = jnp.asarray(target, jnp.float32).reshape(-1, 4) if target.size else jnp.zeros((0, 4))
+        iou = pairwise_fn(preds, target)
+        if iou_threshold is not None:
+            iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+        return iou
+
+    return _update
+
+
+def _compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return iou.diagonal().mean() if iou.size else jnp.zeros(())
+
+
+_iou_update = _make_update(box_iou)
+_giou_update = _make_update(generalized_box_iou)
+_diou_update = _make_update(distance_box_iou)
+_ciou_update = _make_update(complete_box_iou)
+
+
+def intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Pairwise (or aggregated elementwise-mean) IoU (reference functional/detection/iou.py:47)."""
+    return _compute(_iou_update(preds, target, iou_threshold, replacement_val), aggregate)
+
+
+def generalized_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _compute(_giou_update(preds, target, iou_threshold, replacement_val), aggregate)
+
+
+def distance_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _compute(_diou_update(preds, target, iou_threshold, replacement_val), aggregate)
+
+
+def complete_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    return _compute(_ciou_update(preds, target, iou_threshold, replacement_val), aggregate)

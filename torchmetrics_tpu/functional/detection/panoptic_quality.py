@@ -1,0 +1,254 @@
+"""Panoptic Quality (reference: functional/detection/_panoptic_quality_common
+.py:24-500 and panoptic_qualities.py:34,182).
+
+Inputs are (B, *spatial, 2) arrays of (category_id, instance_id) pairs.
+Segment areas/intersections are computed with one vectorized unique pass over
+paired color codes instead of the reference's Python dict loops.
+"""
+
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    things_parsed = set(int(t) for t in things)
+    stuffs_parsed = set(int(s) for s in stuffs)
+    if not things_parsed and not stuffs_parsed:
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}."
+        )
+    return things_parsed, stuffs_parsed
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    unused_category_id = 1 + max([0, *list(things), *list(stuffs)])
+    return unused_category_id, 0
+
+
+def _preprocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs: np.ndarray,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims; zero stuff instance ids; map unknowns to void
+    (reference _panoptic_quality_common.py:175-210)."""
+    out = np.array(inputs, copy=True)
+    out = out.reshape(out.shape[0], -1, 2)
+    cat = out[:, :, 0]
+    mask_stuffs = np.isin(cat, list(stuffs))
+    mask_things = np.isin(cat, list(things))
+    out[:, :, 1] = np.where(mask_stuffs, 0, out[:, :, 1])
+    known = mask_things | mask_stuffs
+    if not allow_unknown_category and not known.all():
+        raise ValueError(f"Unknown categories found: {out[~known]}")
+    out[:, :, 0] = np.where(known, out[:, :, 0], void_color[0])
+    out[:, :, 1] = np.where(known, out[:, :, 1], void_color[1])
+    return out
+
+
+def _encode(colors: np.ndarray, base: int) -> np.ndarray:
+    return colors[..., 0].astype(np.int64) * base + colors[..., 1].astype(np.int64)
+
+
+def _panoptic_quality_update_sample(
+    flat_preds: np.ndarray,   # (P, 2)
+    flat_target: np.ndarray,  # (P, 2)
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Per-sample (iou_sum, tp, fp, fn) per continuous category
+    (reference _panoptic_quality_common.py:312-395)."""
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+
+    base = int(max(flat_preds[..., 1].max(initial=0), flat_target[..., 1].max(initial=0),
+                   void_color[1])) + 2
+    p_codes = _encode(flat_preds, base)
+    t_codes = _encode(flat_target, base)
+    void_code = void_color[0] * base + void_color[1]
+
+    p_unique, p_areas_arr = np.unique(p_codes, return_counts=True)
+    t_unique, t_areas_arr = np.unique(t_codes, return_counts=True)
+    pred_areas = dict(zip(p_unique.tolist(), p_areas_arr.tolist()))
+    target_areas = dict(zip(t_unique.tolist(), t_areas_arr.tolist()))
+
+    # 2-column unique instead of integer pairing: p_code*base+t_code would
+    # overflow int64 for COCO-panoptic RGB-encoded instance ids (~1.6e7)
+    pairs = np.stack([p_codes, t_codes], axis=1)
+    pair_unique, pair_areas_arr = np.unique(pairs, axis=0, return_counts=True)
+    intersection_areas = {
+        (int(pc), int(tc)): int(a) for (pc, tc), a in zip(pair_unique, pair_areas_arr)
+    }
+
+    def cat_of(code: int) -> int:
+        return code // base
+
+    pred_matched: Set[int] = set()
+    target_matched: Set[int] = set()
+    for (p_code, t_code), inter in intersection_areas.items():
+        if t_code == void_code:
+            continue
+        if cat_of(p_code) != cat_of(t_code):
+            continue
+        pred_void = intersection_areas.get((p_code, void_code), 0)
+        void_target = intersection_areas.get((void_code, t_code), 0)
+        union = pred_areas[p_code] - pred_void + target_areas[t_code] - void_target - inter
+        iou = inter / union if union else 0.0
+        cat_id = cat_of(t_code)
+        continuous_id = cat_id_to_continuous_id[cat_id]
+        if cat_id not in stuffs_modified_metric and iou > 0.5:
+            pred_matched.add(p_code)
+            target_matched.add(t_code)
+            iou_sum[continuous_id] += iou
+            tp[continuous_id] += 1
+        elif cat_id in stuffs_modified_metric and iou > 0:
+            iou_sum[continuous_id] += iou
+
+    # false negatives: unmatched target segments not mostly void in pred
+    for t_code in set(target_areas) - target_matched:
+        if t_code == void_code:
+            continue
+        void_target = intersection_areas.get((void_code, t_code), 0)
+        if void_target / target_areas[t_code] <= 0.5:
+            cat_id = cat_of(t_code)
+            if cat_id not in stuffs_modified_metric:
+                fn[cat_id_to_continuous_id[cat_id]] += 1
+
+    # false positives: unmatched pred segments not mostly void in target
+    for p_code in set(pred_areas) - pred_matched:
+        if p_code == void_code:
+            continue
+        pred_void = intersection_areas.get((p_code, void_code), 0)
+        if pred_void / pred_areas[p_code] <= 0.5:
+            cat_id = cat_of(p_code)
+            if cat_id not in stuffs_modified_metric:
+                fp[cat_id_to_continuous_id[cat_id]] += 1
+
+    # modified metric: every observed target category counts as one TP
+    for t_code in target_areas:
+        cat_id = cat_of(t_code)
+        if cat_id in stuffs_modified_metric:
+            tp[cat_id_to_continuous_id[cat_id]] += 1
+
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+    for b in range(flatten_preds.shape[0]):
+        r = _panoptic_quality_update_sample(
+            flatten_preds[b], flatten_target[b], cat_id_to_continuous_id, void_color, modified_metric_stuffs
+        )
+        iou_sum += r[0]
+        tp += r[1]
+        fp += r[2]
+        fn += r[3]
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_compute(
+    iou_sum: np.ndarray, tp: np.ndarray, fp: np.ndarray, fn: np.ndarray
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, float, float, float]:
+    sq = np.where(tp > 0, iou_sum / np.maximum(tp, 1), 0.0)
+    denominator = tp + 0.5 * fp + 0.5 * fn
+    rq = np.where(denominator > 0, tp / np.maximum(denominator, 1e-12), 0.0)
+    pq = sq * rq
+    sel = denominator > 0
+    pq_avg = float(pq[sel].mean()) if sel.any() else 0.0
+    sq_avg = float(sq[sel].mean()) if sel.any() else 0.0
+    rq_avg = float(rq[sel].mean()) if sel.any() else 0.0
+    return pq, sq, rq, pq_avg, sq_avg, rq_avg
+
+
+def _pq_pipeline(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool,
+    modified: bool,
+    return_sq_and_rq: bool,
+    return_per_class: bool,
+) -> Array:
+    things_s, stuffs_s = _parse_categories(things, stuffs)
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if preds_np.ndim < 3 or preds_np.shape[-1] != 2:
+        raise ValueError(f"Expected argument `preds` to have shape (B, *spatial, 2) but got {preds_np.shape}")
+    if target_np.shape != preds_np.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds_np.shape} and {target_np.shape}"
+        )
+    void_color = _get_void_color(things_s, stuffs_s)
+    cats = [*sorted(things_s), *sorted(stuffs_s)]
+    cat_id_to_continuous_id = {c: i for i, c in enumerate(cats)}
+    flat_preds = _preprocess_inputs(things_s, stuffs_s, preds_np, void_color, allow_unknown_preds_category)
+    # unknown target categories always map to void (reference panoptic_qualities.py:163)
+    flat_target = _preprocess_inputs(things_s, stuffs_s, target_np, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flat_preds, flat_target, cat_id_to_continuous_id, void_color,
+        modified_metric_stuffs=stuffs_s if modified else None,
+    )
+    pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(iou_sum, tp, fp, fn)
+    if return_per_class:
+        if return_sq_and_rq:
+            return jnp.asarray(np.stack([pq, sq, rq], axis=-1))[None]
+        return jnp.asarray(pq)[None]
+    if return_sq_and_rq:
+        return jnp.asarray([pq_avg, sq_avg, rq_avg])
+    return jnp.asarray(pq_avg)
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> Array:
+    """PQ (reference panoptic_qualities.py:34-180)."""
+    return _pq_pipeline(
+        preds, target, things, stuffs, allow_unknown_preds_category,
+        modified=False, return_sq_and_rq=return_sq_and_rq, return_per_class=return_per_class,
+    )
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    """Modified PQ: stuff classes use continuous IoU without 0.5 matching
+    (reference panoptic_qualities.py:182-260)."""
+    return _pq_pipeline(
+        preds, target, things, stuffs, allow_unknown_preds_category,
+        modified=True, return_sq_and_rq=False, return_per_class=False,
+    )
