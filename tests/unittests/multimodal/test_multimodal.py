@@ -1,0 +1,127 @@
+"""Multimodal metric tests with deterministic encoders."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment, clip_score
+from torchmetrics_tpu.functional.multimodal.clip_iqa import _clip_iqa_format_prompts
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore
+
+
+class AlignedImageEncoder:
+    """Encodes an image by its mean channel intensities into a 3-dim embedding."""
+
+    def __call__(self, images):
+        return jnp.asarray(images).mean(axis=(2, 3))
+
+
+class AlignedTextEncoder:
+    """'red'/'green'/'blue' captions map to matching one-hot embeddings."""
+
+    def __call__(self, text):
+        table = {"red": [1.0, 0.0, 0.0], "green": [0.0, 1.0, 0.0], "blue": [0.0, 0.0, 1.0]}
+        return jnp.asarray([table.get(t.split()[0].lower(), [0.5, 0.5, 0.5]) for t in text])
+
+
+def _color_image(channel: int) -> jnp.ndarray:
+    img = np.zeros((3, 8, 8), np.float32)
+    img[channel] = 1.0
+    return jnp.asarray(img)
+
+
+def test_clip_score_alignment():
+    imgs = [_color_image(0), _color_image(2)]
+    good = float(clip_score(imgs, ["red", "blue"], image_encoder=AlignedImageEncoder(), text_encoder=AlignedTextEncoder()))
+    bad = float(clip_score(imgs, ["blue", "red"], image_encoder=AlignedImageEncoder(), text_encoder=AlignedTextEncoder()))
+    assert good == pytest.approx(100.0, abs=1e-3)
+    assert bad == pytest.approx(0.0, abs=1e-3)
+
+
+def test_clip_score_validation():
+    with pytest.raises(ValueError, match="same"):
+        clip_score([_color_image(0)], ["a", "b"])
+    with pytest.raises(ValueError, match="3d"):
+        clip_score([jnp.zeros((1, 3, 8, 8))], ["a"])
+
+
+def test_clip_score_class_accumulation():
+    m = CLIPScore(image_encoder=AlignedImageEncoder(), text_encoder=AlignedTextEncoder())
+    m.update([_color_image(0)], ["red"])
+    m.update([_color_image(1)], ["blue"])  # mismatch -> 0
+    # mean of (100, 0) = 50
+    assert float(m.compute()) == pytest.approx(50.0, abs=1e-3)
+
+
+def test_clip_iqa_prompt_formatting():
+    lst, names = _clip_iqa_format_prompts(("quality",))
+    assert lst == ["Good photo.", "Bad photo."] and names == ["quality"]
+    lst, names = _clip_iqa_format_prompts(("quality", ("Great pic.", "Awful pic.")))
+    assert names == ["quality", "user_defined_0"]
+    assert lst[2:] == ["Great pic.", "Awful pic."]
+    with pytest.raises(ValueError, match="must be one of"):
+        _clip_iqa_format_prompts(("bogus_keyword",))
+    with pytest.raises(ValueError, match="length 2"):
+        _clip_iqa_format_prompts((("a", "b", "c"),))
+
+
+def test_clip_iqa_scores_in_unit_interval():
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.random((4, 3, 16, 16)), jnp.float32)
+    out = clip_image_quality_assessment(imgs, prompts=("quality",))
+    assert out.shape == (4,)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) <= 1)).all()
+    out2 = clip_image_quality_assessment(imgs, prompts=("quality", "brightness"))
+    assert set(out2) == {"quality", "brightness"}
+
+
+def test_clip_iqa_anchor_preference():
+    # anchor-aligned image must score near 1 for the positive prompt
+    imgs = jnp.stack([_color_image(0), _color_image(2)])
+    out = clip_image_quality_assessment(
+        imgs,
+        prompts=(("red", "blue"),),
+        image_encoder=AlignedImageEncoder(),
+        text_encoder=AlignedTextEncoder(),
+    )
+    assert float(out[0]) > 0.99  # red image prefers 'red' anchor
+    assert float(out[1]) < 0.01  # blue image prefers 'blue' anchor
+
+
+def test_clip_iqa_class():
+    rng = np.random.default_rng(1)
+    imgs = jnp.asarray(rng.random((4, 3, 16, 16)), jnp.float32)
+    m = CLIPImageQualityAssessment(prompts=("quality",))
+    m.update(imgs[:2])
+    m.update(imgs[2:])
+    out = m.compute()
+    want = clip_image_quality_assessment(imgs, prompts=("quality",))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-6)
+
+
+def test_clip_score_update_order_invariant():
+    rng = np.random.default_rng(5)
+    img_a = jnp.asarray(rng.random((3, 8, 8)), jnp.float32)
+    img_b = jnp.asarray(rng.random((3, 8, 8)), jnp.float32)
+    m1 = CLIPScore()
+    m1.update([img_a], ["dog playing fetch"])
+    m1.update([img_b], ["cat sleeping"])
+    m2 = CLIPScore()
+    m2.update([img_b], ["cat sleeping"])
+    m2.update([img_a], ["dog playing fetch"])
+    assert float(m1.compute()) == pytest.approx(float(m2.compute()), abs=1e-5)
+
+
+def test_check_forward_full_state_property():
+    from torchmetrics_tpu.utilities.checks import check_forward_full_state_property
+    from torchmetrics_tpu import MeanSquaredError
+
+    check_forward_full_state_property(
+        MeanSquaredError,
+        init_args={},
+        input_args={"preds": jnp.asarray([1.0, 2.0]), "target": jnp.asarray([1.5, 2.5])},
+        num_update_to_compare=3,
+        reps=1,
+    )

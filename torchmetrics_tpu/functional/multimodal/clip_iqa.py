@@ -1,0 +1,107 @@
+"""CLIP-IQA (reference: functional/multimodal/clip_iqa.py:43-330).
+
+Per prompt pair (positive, negative): softmax over the two anchor cosine
+logits gives P(positive).  Prompt table and scoring identical to the
+reference; CLIP encoders pluggable as in clip_score.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    DeterministicImageEncoder,
+    DeterministicTextEncoder,
+)
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _clip_iqa_format_prompts(
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+) -> Tuple[List[str], List[str]]:
+    """Expand prompt keywords/custom pairs (reference clip_iqa.py:92-150)."""
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if not isinstance(p, (str, tuple)):
+            raise ValueError("Argument `prompts` must be a tuple containing strings or tuples of strings")
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS.keys())} if not custom tuples of strings, got {p}"
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        else:
+            if len(p) != 2:
+                raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+    return prompts_list, prompts_names
+
+
+def _clip_iqa_compute(
+    img_features: Array,
+    anchors: Array,
+    prompts_names: List[str],
+    format_as_dict: bool = True,
+) -> Union[Array, Dict[str, Array]]:
+    """Softmax over (positive, negative) anchor logits (reference clip_iqa.py:300)."""
+    logits_per_image = 100 * img_features @ anchors.T
+    probs = jax.nn.softmax(logits_per_image.reshape(logits_per_image.shape[0], -1, 2), axis=-1)[:, :, 0]
+    if len(prompts_names) == 1:
+        return probs.squeeze()
+    if format_as_dict:
+        return {p: probs[:, i] for i, p in enumerate(prompts_names)}
+    return probs
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: str = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+    image_encoder: Optional[Callable] = None,
+    text_encoder: Optional[Callable] = None,
+) -> Union[Array, Dict[str, Array]]:
+    """CLIP-IQA per image (reference clip_iqa.py:220-330)."""
+    if not (isinstance(data_range, (int, float)) and data_range > 0):
+        raise ValueError("Argument `data_range` should be a positive number.")
+    prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
+    image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
+    text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+
+    images = jnp.asarray(images, jnp.float32) / float(data_range)
+    if images.ndim != 4 or images.shape[1] != 3:
+        raise ValueError(f"Expected 4D (N, 3, H, W) input, got {images.shape}")
+    img_features = jnp.asarray(image_encoder(images))
+    img_features = img_features / jnp.maximum(jnp.linalg.norm(img_features, axis=-1, keepdims=True), 1e-12)
+    anchors = jnp.asarray(text_encoder(prompts_list))
+    anchors = anchors / jnp.maximum(jnp.linalg.norm(anchors, axis=-1, keepdims=True), 1e-12)
+    return _clip_iqa_compute(img_features, anchors, prompts_names)

@@ -1,0 +1,119 @@
+"""CLIPScore (reference: functional/multimodal/clip_score.py:30-180).
+
+score = 100 · max(cos(image_emb, text_emb), 0) averaged over pairs.  The CLIP
+model is pluggable — ``image_encoder`` maps (B, 3, H, W) images to (B, D)
+embeddings, ``text_encoder`` maps a list of strings to (B, D) — since the
+reference's HF checkpoint download (clip_score.py:_get_clip_model_and_processor)
+is not possible hermetically.  Deterministic seeded encoders are the default
+so the metric runs end-to-end out of the box.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.text.bert import _hash_embedding_model
+
+
+class DeterministicImageEncoder:
+    """Seeded conv encoder: (B, 3, H, W) → (B, dim) embeddings."""
+
+    def __init__(self, dim: int = 64, seed: int = 7) -> None:
+        self.dim = dim
+        key = jax.random.PRNGKey(seed)
+        k1, k2 = jax.random.split(key)
+        self.w1 = jax.random.normal(k1, (16, 3, 3, 3)) / jnp.sqrt(27.0)
+        self.proj = jax.random.normal(k2, (16, dim)) / 4.0
+
+    def __call__(self, images: Array) -> Array:
+        x = jnp.asarray(images, jnp.float32)
+        x = jnp.where(x.max() > 1.5, x / 255.0, x)
+        x = jax.lax.conv_general_dilated(
+            x, self.w1, (2, 2), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")
+        )
+        x = jax.nn.relu(x)
+        return x.mean(axis=(2, 3)) @ self.proj
+
+
+class DeterministicTextEncoder:
+    """Hash-embedding text encoder: list[str] → (B, dim) embeddings.
+
+    Token ids come from a stateless string hash — not an insertion-order
+    vocab — so the same caption always embeds identically regardless of what
+    was encoded before (update-order invariance of accumulated state).
+    """
+
+    def __init__(self, dim: int = 64, max_length: int = 64) -> None:
+        self.dim = dim
+        self.max_length = max_length
+
+    @staticmethod
+    def _token_id(token: str) -> int:
+        import zlib
+
+        return (zlib.crc32(token.encode("utf-8")) % 1_000_003) + 2
+
+    def __call__(self, text: Sequence[str]) -> Array:
+        rows = [
+            [self._token_id(t) for t in caption.lower().split()[: self.max_length]]
+            for caption in text
+        ]
+        max_len = max((len(r) for r in rows), default=1) or 1
+        ids = np.zeros((len(rows), max_len), np.int32)
+        mask = np.zeros((len(rows), max_len), np.int32)
+        for i, r in enumerate(rows):
+            ids[i, : len(r)] = r
+            mask[i, : len(r)] = 1
+        emb = _hash_embedding_model(jnp.asarray(ids), jnp.asarray(mask), dim=self.dim)
+        denom = jnp.maximum(mask.sum(axis=1, keepdims=True), 1)
+        return emb.sum(axis=1) / denom
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    image_encoder: Callable,
+    text_encoder: Callable,
+) -> Tuple[Array, int]:
+    """Per-pair cosine scores ×100 (reference clip_score.py:46-100)."""
+    if not isinstance(images, (list, tuple)):
+        if images.ndim == 3:
+            images = [images]
+        else:
+            images = list(images)
+    else:
+        images = list(images)
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+    img_batch = jnp.stack([jnp.asarray(i, jnp.float32) for i in images])
+    img_features = jnp.asarray(image_encoder(img_batch))
+    img_features = img_features / jnp.maximum(jnp.linalg.norm(img_features, axis=-1, keepdims=True), 1e-12)
+    txt_features = jnp.asarray(text_encoder(text))
+    txt_features = txt_features / jnp.maximum(jnp.linalg.norm(txt_features, axis=-1, keepdims=True), 1e-12)
+    score = 100 * (img_features * txt_features).sum(axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: str = "openai/clip-vit-large-patch14",
+    image_encoder: Optional[Callable] = None,
+    text_encoder: Optional[Callable] = None,
+) -> Array:
+    """CLIPScore = max(100·cos, 0) averaged (reference clip_score.py:103-180)."""
+    image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
+    text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+    score, _ = _clip_score_update(images, text, image_encoder, text_encoder)
+    return jnp.maximum(score.mean(), 0.0)

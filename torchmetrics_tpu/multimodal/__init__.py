@@ -1,0 +1,6 @@
+"""Multimodal metrics (reference: src/torchmetrics/multimodal/__init__.py)."""
+
+from torchmetrics_tpu.multimodal.clip_iqa import CLIPImageQualityAssessment
+from torchmetrics_tpu.multimodal.clip_score import CLIPScore
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

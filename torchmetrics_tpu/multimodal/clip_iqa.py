@@ -1,0 +1,63 @@
+"""CLIP-IQA modular metric (reference: multimodal/clip_iqa.py:56-280)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.multimodal.clip_iqa import (
+    _clip_iqa_compute,
+    _clip_iqa_format_prompts,
+)
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    DeterministicImageEncoder,
+    DeterministicTextEncoder,
+)
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA; anchors embedded once at init, image features accumulate as
+    cat states (reference multimodal/clip_iqa.py:56)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False  # cat states merge distributively; avoids double encoding in forward
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        image_encoder: Optional[Callable] = None,
+        text_encoder: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(data_range, (int, float)) and data_range > 0):
+            raise ValueError("Argument `data_range` should be a positive number.")
+        self.data_range = data_range
+        prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
+        self.prompts_names = prompts_names
+        self.image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
+        text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+        anchors = jnp.asarray(text_encoder(prompts_list))
+        self.anchors = anchors / jnp.maximum(jnp.linalg.norm(anchors, axis=-1, keepdims=True), 1e-12)
+        self.add_state("img_features", [], dist_reduce_fx="cat")
+
+    def _update(self, state: State, images: Array) -> State:
+        images = jnp.asarray(images, jnp.float32) / float(self.data_range)
+        if images.ndim != 4 or images.shape[1] != 3:
+            raise ValueError(f"Expected 4D (N, 3, H, W) input, got {images.shape}")
+        feats = jnp.asarray(self.image_encoder(images))
+        feats = feats / jnp.maximum(jnp.linalg.norm(feats, axis=-1, keepdims=True), 1e-12)
+        return {"img_features": state["img_features"] + (feats,)}
+
+    def _compute(self, state: State) -> Union[Array, Dict[str, Array]]:
+        feats = dim_zero_cat(state["img_features"])
+        return _clip_iqa_compute(feats, self.anchors, self.prompts_names)

@@ -1,0 +1,49 @@
+"""CLIPScore modular metric (reference: multimodal/clip_score.py:43-180)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    DeterministicImageEncoder,
+    DeterministicTextEncoder,
+    _clip_score_update,
+)
+
+
+class CLIPScore(Metric):
+    """CLIPScore; states = (Σ per-pair score, n) (reference multimodal/clip_score.py:43)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False  # sum states merge distributively; avoids double encoding in forward
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "openai/clip-vit-large-patch14",
+        image_encoder: Optional[Callable] = None,
+        text_encoder: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
+        self.text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state: State, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> State:
+        score, n_samples = _clip_score_update(images, text, self.image_encoder, self.text_encoder)
+        return {
+            "score": state["score"] + score.sum(),
+            "n_samples": state["n_samples"] + n_samples,
+        }
+
+    def _compute(self, state: State) -> Array:
+        return jnp.maximum(state["score"] / state["n_samples"], 0.0)

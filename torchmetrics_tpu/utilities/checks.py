@@ -1,0 +1,70 @@
+"""Input/behavior check helpers.
+
+Reference: utilities/checks.py:636-740 (`check_forward_full_state_property`) —
+the empirical tool that tests whether ``full_state_update=False`` is safe for
+a metric class and times both paths.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from torchmetrics_tpu.utilities.prints import rank_zero_info
+
+
+def check_forward_full_state_property(
+    metric_class: type,
+    init_args: Optional[Dict[str, Any]] = None,
+    input_args: Optional[Dict[str, Any]] = None,
+    num_update_to_compare: int = 10,
+    reps: int = 3,
+) -> None:
+    """Empirically check that full_state_update=False matches True and time both.
+
+    Instantiates the metric twice with ``full_state_update`` overridden to
+    True/False, runs ``forward`` ``num_update_to_compare`` times with
+    ``input_args`` on each, and asserts every batch value matches; then prints
+    simple wall-clock timings (reference utilities/checks.py:636-740).
+    """
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):  # type: ignore[misc, valid-type]
+        full_state_update = True
+
+    class PartialState(metric_class):  # type: ignore[misc, valid-type]
+        full_state_update = False
+
+    full = FullState(**init_args)
+    partial_state = PartialState(**init_args)
+
+    for i in range(num_update_to_compare):
+        out1 = full(**input_args)
+        out2 = partial_state(**input_args)
+        if not np.allclose(np.asarray(out1), np.asarray(out2), atol=1e-6, equal_nan=True):
+            raise RuntimeError(
+                f"The metric {metric_class.__name__} cannot safely set `full_state_update=False`: "
+                f"forward outputs diverge on update {i}: {out1} vs {out2}."
+            )
+
+    def _time(m_cls: type) -> float:
+        best = float("inf")
+        for _ in range(reps):
+            m = m_cls(**init_args)
+            start = time.perf_counter()
+            for _ in range(num_update_to_compare):
+                m(**input_args)
+            best = min(best, time.perf_counter() - start)
+        return best
+
+    t_full = _time(FullState)
+    t_partial = _time(PartialState)
+    rank_zero_info(
+        f"Full state for {metric_class.__name__} metric took: {t_full:.4f}s per {num_update_to_compare} steps\n"
+        f"Partial state for {metric_class.__name__} metric took: {t_partial:.4f}s per {num_update_to_compare} steps"
+    )
+    faster = t_partial < t_full
+    rank_zero_info(f"Recommended setting `full_state_update={not faster}`")
