@@ -1,0 +1,160 @@
+"""Fixed-operating-point family tests vs brute-force numpy references."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification import (
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    PrecisionAtFixedRecall,
+    RecallAtFixedPrecision,
+    SensitivityAtSpecificity,
+    SpecificityAtSensitivity,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    multiclass_recall_at_fixed_precision,
+)
+
+SEED = 0
+
+
+def _brute_force(preds, target, min_constraint, objective, constraint):
+    """Scan all prediction thresholds; return best objective value."""
+    best = 0.0
+    for thr in np.unique(preds):
+        hard = (preds >= thr).astype(int)
+        tp = ((hard == 1) & (target == 1)).sum()
+        fp = ((hard == 1) & (target == 0)).sum()
+        fn = ((hard == 0) & (target == 1)).sum()
+        tn = ((hard == 0) & (target == 0)).sum()
+        stats = {
+            "precision": tp / (tp + fp) if tp + fp else 1.0,
+            "recall": tp / (tp + fn) if tp + fn else 0.0,
+            "specificity": tn / (tn + fp) if tn + fp else 0.0,
+        }
+        if stats[constraint] >= min_constraint:
+            best = max(best, stats[objective])
+    return best
+
+
+@pytest.mark.parametrize("min_val", [0.2, 0.5, 0.8])
+@pytest.mark.parametrize(
+    "fn,objective,constraint",
+    [
+        (binary_precision_at_fixed_recall, "precision", "recall"),
+        (binary_recall_at_fixed_precision, "recall", "precision"),
+        (binary_sensitivity_at_specificity, "recall", "specificity"),
+        (binary_specificity_at_sensitivity, "specificity", "recall"),
+    ],
+)
+def test_binary_functional_vs_brute_force(fn, objective, constraint, min_val):
+    rng = np.random.default_rng(SEED)
+    preds = rng.random(200)
+    target = rng.integers(0, 2, 200)
+    got, thr = fn(jnp.asarray(preds), jnp.asarray(target), min_val)
+    want = _brute_force(preds, target, min_val, objective, constraint)
+    assert float(got) == pytest.approx(want, abs=1e-6)
+
+
+def test_binned_close_to_exact():
+    rng = np.random.default_rng(SEED)
+    preds = rng.random(500)
+    target = rng.integers(0, 2, 500)
+    exact, _ = binary_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), 0.52)
+    binned, _ = binary_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), 0.52, thresholds=200)
+    assert float(binned) == pytest.approx(float(exact), abs=0.05)
+
+
+def test_multiclass_per_class_shapes():
+    rng = np.random.default_rng(SEED)
+    preds = rng.random((100, 4))
+    preds = preds / preds.sum(1, keepdims=True)
+    target = rng.integers(0, 4, 100)
+    vals, thrs = multiclass_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), 4, 0.3)
+    assert vals.shape == (4,) and thrs.shape == (4,)
+    assert ((np.asarray(vals) >= 0) & (np.asarray(vals) <= 1)).all()
+
+
+def test_class_api_matches_functional():
+    rng = np.random.default_rng(SEED)
+    preds = rng.random(150)
+    target = rng.integers(0, 2, 150)
+    m = BinaryRecallAtFixedPrecision(min_value=0.6)
+    m.update(jnp.asarray(preds[:75]), jnp.asarray(target[:75]))
+    m.update(jnp.asarray(preds[75:]), jnp.asarray(target[75:]))
+    got_v, got_t = m.compute()
+    want_v, want_t = binary_recall_at_fixed_precision(jnp.asarray(preds), jnp.asarray(target), 0.6)
+    assert float(got_v) == pytest.approx(float(want_v), abs=1e-6)
+    assert float(got_t) == pytest.approx(float(want_t), abs=1e-6)
+
+
+def test_task_wrappers_dispatch():
+    assert type(PrecisionAtFixedRecall(task="binary", min_recall=0.5)).__name__ == "BinaryPrecisionAtFixedRecall"
+    assert type(RecallAtFixedPrecision(task="multiclass", min_precision=0.5, num_classes=3)).__name__ == "MulticlassRecallAtFixedPrecision"
+    assert type(SensitivityAtSpecificity(task="multilabel", min_specificity=0.5, num_labels=3)).__name__ == "MultilabelSensitivityAtSpecificity"
+    assert type(SpecificityAtSensitivity(task="binary", min_sensitivity=0.5)).__name__ == "BinarySpecificityAtSensitivity"
+    with pytest.raises(ValueError, match="not supported"):
+        PrecisionAtFixedRecall(task="bogus", min_recall=0.5)
+
+
+def test_no_valid_point_fallback():
+    # impossible precision constraint => (0, 1e6)
+    preds = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+    target = jnp.asarray([0, 0, 0, 0])
+    v, t = binary_recall_at_fixed_precision(preds, target, 0.9)
+    assert float(v) == 0.0
+    assert float(t) == pytest.approx(1e6)
+
+
+def test_roc_family_keeps_real_threshold():
+    # the ROC origin (spec=1, tpr=0, thr=1.0) satisfies the constraint ->
+    # real threshold returned, not the 1e6 sentinel (reference
+    # sensitivity_specificity.py only sentinels when nothing satisfies)
+    v, t = binary_sensitivity_at_specificity(
+        jnp.asarray([0.2, 0.8]), jnp.asarray([1, 0]), 0.5
+    )
+    assert float(v) == 0.0
+    assert float(t) <= 1.0
+
+
+def test_int_min_values_accepted():
+    preds = jnp.asarray([0.1, 0.9])
+    target = jnp.asarray([0, 1])
+    v, _ = binary_precision_at_fixed_recall(preds, target, 1)
+    assert float(v) == pytest.approx(1.0)
+    v2, _ = binary_recall_at_fixed_precision(preds, target, 0)
+    assert float(v2) == pytest.approx(1.0)
+
+
+def test_min_value_validation():
+    with pytest.raises(ValueError, match="min_precision"):
+        binary_recall_at_fixed_precision(jnp.zeros(4), jnp.zeros(4, jnp.int32), 1.5)
+    with pytest.raises(ValueError, match="min_recall"):
+        BinaryPrecisionAtFixedRecall(min_value=-0.1)
+
+
+def test_multilabel_class():
+    rng = np.random.default_rng(SEED)
+    preds = rng.random((60, 3))
+    target = rng.integers(0, 2, (60, 3))
+    m = MultilabelRecallAtFixedPrecision(num_labels=3, min_value=0.4)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    vals, thrs = m.compute()
+    assert vals.shape == (3,)
+    m2 = MulticlassRecallAtFixedPrecision(num_classes=3, min_value=0.4, thresholds=50)
+    probs = rng.random((60, 3))
+    probs = probs / probs.sum(1, keepdims=True)
+    m2.update(jnp.asarray(probs), jnp.asarray(rng.integers(0, 3, 60)))
+    vals2, _ = m2.compute()
+    assert vals2.shape == (3,)

@@ -83,6 +83,13 @@ from torchmetrics_tpu.classification.ranking import (
     MultilabelRankingLoss,
 )
 from torchmetrics_tpu.classification.roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
+from torchmetrics_tpu.classification.fixed_operating_point import (
+    BinaryPrecisionAtFixedRecall, MulticlassPrecisionAtFixedRecall, MultilabelPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision, MulticlassRecallAtFixedPrecision, MultilabelRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity, MulticlassSensitivityAtSpecificity, MultilabelSensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity, MulticlassSpecificityAtSensitivity, MultilabelSpecificityAtSensitivity,
+    PrecisionAtFixedRecall, RecallAtFixedPrecision, SensitivityAtSpecificity, SpecificityAtSensitivity,
+)
 from torchmetrics_tpu.classification.specificity import (
     BinarySpecificity,
     MulticlassSpecificity,
@@ -122,4 +129,9 @@ __all__ = [
     "ROC", "BinaryROC", "MulticlassROC", "MultilabelROC",
     "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity",
     "BinaryStatScores", "MulticlassStatScores", "MultilabelStatScores", "StatScores",
+    "BinaryPrecisionAtFixedRecall", "MulticlassPrecisionAtFixedRecall", "MultilabelPrecisionAtFixedRecall",
+    "BinaryRecallAtFixedPrecision", "MulticlassRecallAtFixedPrecision", "MultilabelRecallAtFixedPrecision",
+    "BinarySensitivityAtSpecificity", "MulticlassSensitivityAtSpecificity", "MultilabelSensitivityAtSpecificity",
+    "BinarySpecificityAtSensitivity", "MulticlassSpecificityAtSensitivity", "MultilabelSpecificityAtSensitivity",
+    "PrecisionAtFixedRecall", "RecallAtFixedPrecision", "SensitivityAtSpecificity", "SpecificityAtSensitivity",
 ]

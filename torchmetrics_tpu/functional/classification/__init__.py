@@ -120,3 +120,18 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     multilabel_stat_scores,
     stat_scores,
 )
+
+from torchmetrics_tpu.functional.classification.fixed_operating_point import (  # noqa: F401,E402
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    multiclass_precision_at_fixed_recall,
+    multiclass_recall_at_fixed_precision,
+    multiclass_sensitivity_at_specificity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_precision_at_fixed_recall,
+    multilabel_recall_at_fixed_precision,
+    multilabel_sensitivity_at_specificity,
+    multilabel_specificity_at_sensitivity,
+)

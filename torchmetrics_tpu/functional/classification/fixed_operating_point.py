@@ -1,0 +1,217 @@
+"""Fixed-operating-point family: {Precision,Recall,Sensitivity,Specificity}At
+{Recall,Precision,Specificity,Sensitivity}.
+
+Reference: functional/classification/{precision_fixed_recall.py,
+recall_fixed_precision.py:40-76, sensitivity_specificity.py,
+specificity_sensitivity.py}.  All four share one core: mask the curve points
+satisfying the constraint, lexicographic-argmax on (objective, constraint,
+threshold), return (best objective, its threshold) with the reference's
+(0, 1e6) fallback.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    binary_roc,
+    multiclass_roc,
+    multilabel_roc,
+)
+
+
+def _lexargmax(x: np.ndarray) -> int:
+    """Index of the lexicographic maximum row (reference recall_fixed_precision.py:40-56)."""
+    idx = np.arange(x.shape[0])
+    for col in range(x.shape[1]):
+        mx = x[idx, col].max()
+        idx = idx[x[idx, col] == mx]
+        if len(idx) == 1:
+            break
+    return int(idx[0])
+
+
+def _best_at_constraint(
+    objective: Array,
+    constraint: Array,
+    thresholds: Array,
+    min_constraint: float,
+    zero_sentinel: bool = True,
+) -> Tuple[Array, Array]:
+    """(max objective s.t. constraint ≥ min, matching threshold).
+
+    ``zero_sentinel``: the PRC family returns the 1e6 sentinel threshold
+    whenever the best objective is 0 (reference recall_fixed_precision.py:73);
+    the ROC family keeps the real threshold and reserves 1e6 for the
+    no-point-satisfies-constraint case only.
+    """
+    obj = np.asarray(objective, np.float64).ravel()
+    con = np.asarray(constraint, np.float64).ravel()
+    thr = np.asarray(thresholds, np.float64).ravel()
+    n = min(len(obj), len(con), len(thr))
+    zipped = np.stack([obj[:n], con[:n], thr[:n]], axis=1)
+    masked = zipped[zipped[:, 1] >= min_constraint]
+    if masked.shape[0] > 0:
+        best = masked[_lexargmax(masked)]
+        best_obj, best_thr = float(best[0]), float(best[2])
+        if zero_sentinel and best_obj == 0.0:
+            best_thr = 1e6
+    else:
+        best_obj, best_thr = 0.0, 1e6
+    return jnp.asarray(best_obj, jnp.float32), jnp.asarray(best_thr, jnp.float32)
+
+
+def _per_class(values, constraint_values, thresholds, min_constraint, n: int, zero_sentinel: bool = True):
+    outs, thrs = [], []
+    for c in range(n):
+        th_c = thresholds[c] if isinstance(thresholds, list) else thresholds
+        v, t = _best_at_constraint(values[c], constraint_values[c], th_c, min_constraint, zero_sentinel)
+        outs.append(v)
+        thrs.append(t)
+    return jnp.stack(outs), jnp.stack(thrs)
+
+
+def _validate_min(name: str, value: float) -> None:
+    if not isinstance(value, (int, float)) or isinstance(value, bool) or not 0 <= value <= 1:
+        raise ValueError(f"Expected argument `{name}` to be a float in the [0,1] range, but got {value}")
+
+
+# -------------------------------------------------------- precision @ recall
+def binary_precision_at_fixed_recall(
+    preds, target, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_recall", min_recall)
+    precision, recall, thr = binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    return _best_at_constraint(precision, recall, thr, min_recall)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds, target, num_classes: int, min_recall: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_recall", min_recall)
+    precision, recall, thr = multiclass_precision_recall_curve(
+        preds, target, num_classes, thresholds, ignore_index, validate_args
+    )
+    return _per_class(precision, recall, thr, min_recall, num_classes)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds, target, num_labels: int, min_recall: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_recall", min_recall)
+    precision, recall, thr = multilabel_precision_recall_curve(
+        preds, target, num_labels, thresholds, ignore_index, validate_args
+    )
+    return _per_class(precision, recall, thr, min_recall, num_labels)
+
+
+# -------------------------------------------------------- recall @ precision
+def binary_recall_at_fixed_precision(
+    preds, target, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_precision", min_precision)
+    precision, recall, thr = binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    return _best_at_constraint(recall, precision, thr, min_precision)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds, target, num_classes: int, min_precision: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_precision", min_precision)
+    precision, recall, thr = multiclass_precision_recall_curve(
+        preds, target, num_classes, thresholds, ignore_index, validate_args
+    )
+    return _per_class(recall, precision, thr, min_precision, num_classes)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds, target, num_labels: int, min_precision: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_precision", min_precision)
+    precision, recall, thr = multilabel_precision_recall_curve(
+        preds, target, num_labels, thresholds, ignore_index, validate_args
+    )
+    return _per_class(recall, precision, thr, min_precision, num_labels)
+
+
+# ------------------------------------------------- sensitivity @ specificity
+def binary_sensitivity_at_specificity(
+    preds, target, min_specificity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+    fpr, tpr, thr = binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    return _best_at_constraint(tpr, 1 - fpr, thr, min_specificity, zero_sentinel=False)
+
+
+def multiclass_sensitivity_at_specificity(
+    preds, target, num_classes: int, min_specificity: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+    fpr, tpr, thr = multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    spec = [1 - f for f in fpr] if isinstance(fpr, list) else 1 - fpr
+    return _per_class(tpr, spec, thr, min_specificity, num_classes, zero_sentinel=False)
+
+
+def multilabel_sensitivity_at_specificity(
+    preds, target, num_labels: int, min_specificity: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+    fpr, tpr, thr = multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    spec = [1 - f for f in fpr] if isinstance(fpr, list) else 1 - fpr
+    return _per_class(tpr, spec, thr, min_specificity, num_labels, zero_sentinel=False)
+
+
+# ------------------------------------------------- specificity @ sensitivity
+def binary_specificity_at_sensitivity(
+    preds, target, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+    fpr, tpr, thr = binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    return _best_at_constraint(1 - fpr, tpr, thr, min_sensitivity, zero_sentinel=False)
+
+
+def multiclass_specificity_at_sensitivity(
+    preds, target, num_classes: int, min_sensitivity: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+    fpr, tpr, thr = multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    spec = [1 - f for f in fpr] if isinstance(fpr, list) else 1 - fpr
+    return _per_class(spec, tpr, thr, min_sensitivity, num_classes, zero_sentinel=False)
+
+
+def multilabel_specificity_at_sensitivity(
+    preds, target, num_labels: int, min_sensitivity: float, thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+    fpr, tpr, thr = multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    spec = [1 - f for f in fpr] if isinstance(fpr, list) else 1 - fpr
+    return _per_class(spec, tpr, thr, min_sensitivity, num_labels, zero_sentinel=False)

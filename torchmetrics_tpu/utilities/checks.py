@@ -49,6 +49,14 @@ def check_forward_full_state_property(
                 f"The metric {metric_class.__name__} cannot safely set `full_state_update=False`: "
                 f"forward outputs diverge on update {i}: {out1} vs {out2}."
             )
+    # the accumulated states are where the two paths can actually diverge
+    # (update-twice vs compute-batch-then-merge) — compare final compute()
+    res1, res2 = full.compute(), partial_state.compute()
+    if not np.allclose(np.asarray(res1), np.asarray(res2), atol=1e-6, equal_nan=True):
+        raise RuntimeError(
+            f"The metric {metric_class.__name__} cannot safely set `full_state_update=False`: "
+            f"accumulated compute() diverges: {res1} vs {res2}."
+        )
 
     def _time(m_cls: type) -> float:
         best = float("inf")
