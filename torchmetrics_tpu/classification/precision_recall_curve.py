@@ -21,6 +21,8 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _binary_precision_recall_curve_compute_binned,
     _binary_precision_recall_curve_compute_exact,
     _binary_prc_format,
+    _binned_confmat_multiclass,
+    _binned_confmat_multilabel,
     _binned_curve_update,
     _multiclass_prc_format,
     _multilabel_prc_format,
@@ -118,11 +120,7 @@ class MulticlassPrecisionRecallCurve(_CurveBase):
         if self.thresholds is None:
             binned = None
         else:
-            onehot = jax.nn.one_hot(t, self.num_classes, dtype=jnp.int32)
-            binned = jnp.moveaxis(
-                jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, self.thresholds), in_axes=(1, 1))(p, onehot),
-                0, 1,
-            )
+            binned = _binned_confmat_multiclass(p, t, w, self.thresholds, self.num_classes)
         return self._accumulate(state, p, t, w, binned)
 
     def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
@@ -177,10 +175,7 @@ class MultilabelPrecisionRecallCurve(_CurveBase):
         if self.thresholds is None:
             binned = None
         else:
-            binned = jnp.moveaxis(
-                jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, self.thresholds), in_axes=(1, 1, 1))(p, t, w),
-                0, 1,
-            )
+            binned = _binned_confmat_multilabel(p, t, w, self.thresholds)
         return self._accumulate(state, p, t, w, binned)
 
     def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:

@@ -185,8 +185,16 @@ class MetricCollection(dict):
         res = {}
         for k, m in self.items(keep_base=True, copy_state=False):
             res[k] = m(*args, **m._filter_kwargs(**kwargs))
-        # forward bypasses group sharing; re-sync group state next update
-        self._groups_checked = False
+        # Group members receive identical inputs, so equal states stay equal:
+        # formed groups remain valid across forward/update (reference keeps
+        # groups stable once formed, collections.py:205-236).  A first forward
+        # counts as the group-forming update.
+        if not self._groups:
+            self._init_groups()
+        if not self._groups_checked:
+            if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
+                self._merge_compute_groups()
+            self._groups_checked = True
         return self._to_renamed_dict(res)
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:

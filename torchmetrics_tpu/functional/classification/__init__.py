@@ -134,4 +134,8 @@ from torchmetrics_tpu.functional.classification.fixed_operating_point import (  
     multilabel_recall_at_fixed_precision,
     multilabel_sensitivity_at_specificity,
     multilabel_specificity_at_sensitivity,
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
 )

@@ -215,3 +215,71 @@ def multilabel_specificity_at_sensitivity(
     fpr, tpr, thr = multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
     spec = [1 - f for f in fpr] if isinstance(fpr, list) else 1 - fpr
     return _per_class(spec, tpr, thr, min_sensitivity, num_labels, zero_sentinel=False)
+
+
+# --------------------------------------------------------- task dispatchers
+# (reference: functional/classification/precision_fixed_recall.py:309,
+#  recall_fixed_precision.py:401, sensitivity_specificity.py:406,
+#  specificity_sensitivity.py:443)
+def _dispatch_fixed(task, binary_fn, multiclass_fn, multilabel_fn, preds, target, min_value,
+                    thresholds, num_classes, num_labels, ignore_index, validate_args):
+    task = str(task)
+    if task == "binary":
+        return binary_fn(preds, target, min_value, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)}` was passed.`")
+        return multiclass_fn(preds, target, num_classes, min_value, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)}` was passed.`")
+        return multilabel_fn(preds, target, num_labels, min_value, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Task {task} not supported.")
+
+
+def precision_at_fixed_recall(
+    preds, target, task, min_recall: float, thresholds=None, num_classes=None, num_labels=None,
+    ignore_index=None, validate_args: bool = True,
+):
+    """Highest precision subject to recall >= min_recall (task dispatcher)."""
+    return _dispatch_fixed(
+        task, binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall,
+        multilabel_precision_at_fixed_recall, preds, target, min_recall,
+        thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def recall_at_fixed_precision(
+    preds, target, task, min_precision: float, thresholds=None, num_classes=None, num_labels=None,
+    ignore_index=None, validate_args: bool = True,
+):
+    """Highest recall subject to precision >= min_precision (task dispatcher)."""
+    return _dispatch_fixed(
+        task, binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision,
+        multilabel_recall_at_fixed_precision, preds, target, min_precision,
+        thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def sensitivity_at_specificity(
+    preds, target, task, min_specificity: float, thresholds=None, num_classes=None, num_labels=None,
+    ignore_index=None, validate_args: bool = True,
+):
+    """Highest sensitivity subject to specificity >= min_specificity (task dispatcher)."""
+    return _dispatch_fixed(
+        task, binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity,
+        multilabel_sensitivity_at_specificity, preds, target, min_specificity,
+        thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def specificity_at_sensitivity(
+    preds, target, task, min_sensitivity: float, thresholds=None, num_classes=None, num_labels=None,
+    ignore_index=None, validate_args: bool = True,
+):
+    """Highest specificity subject to sensitivity >= min_sensitivity (task dispatcher)."""
+    return _dispatch_fixed(
+        task, binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity,
+        multilabel_specificity_at_sensitivity, preds, target, min_sensitivity,
+        thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )

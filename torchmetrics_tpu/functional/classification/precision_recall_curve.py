@@ -96,15 +96,60 @@ def _binary_precision_recall_curve_compute_exact(
 def _binned_curve_update(
     preds: Array, target: Array, weights: Array, thresholds: Array
 ) -> Array:
-    """(T, 2, 2) threshold-confusion state: state[t] = [[tn, fp], [fn, tp]]."""
+    """(T, 2, 2) threshold-confusion state: state[t] = [[tn, fp], [fn, tp]].
+
+    MXU formulation: two (T, N) @ (N,) contractions (tp, pospred) instead of
+    four masked (N, T) reductions; fn/tn by complement counts.
+    """
     pred_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # (N, T)
-    t = target.astype(jnp.float32)[:, None]
-    w = weights[:, None]
-    tp = jnp.sum(pred_t * t * w, axis=0)
-    fp = jnp.sum(pred_t * (1 - t) * w, axis=0)
-    fn = jnp.sum((1 - pred_t) * t * w, axis=0)
-    tn = jnp.sum((1 - pred_t) * (1 - t) * w, axis=0)
+    tw = target.astype(jnp.float32) * weights  # (N,)
+    tp = pred_t.T @ tw  # (T,)
+    pospred = pred_t.T @ weights  # (T,)
+    fp = pospred - tp
+    actpos = jnp.sum(tw)
+    total = jnp.sum(weights)
+    fn = actpos - tp
+    tn = total - pospred - fn
     return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T, 2, 2)
+
+
+def _binned_confmat_multiclass(
+    p: Array, target: Array, w: Array, thresholds: Array, num_classes: int
+) -> Array:
+    """(T, C, 2, 2) one-vs-rest threshold-confusion tensor, MXU-formulated.
+
+    tp only depends on the *true-class* score, so it is one clean
+    (T, N) @ (N, C) matmul against the weighted one-hot; pospred is a single
+    einsum over one fused comparison tensor (vs the previous vmap of 8
+    reductions per class); fn/tn are complement counts.
+    """
+    ohw = jax.nn.one_hot(target, num_classes, dtype=p.dtype) * w[:, None]  # (N, C)
+    s = jnp.take_along_axis(p, target[:, None], axis=1)[:, 0]  # (N,) true-class score
+    pred_true = (s[:, None] >= thresholds[None, :]).astype(p.dtype)  # (N, T)
+    tp = pred_true.T @ ohw  # (T, C)
+    cmp = (p[:, :, None] >= thresholds[None, None, :]).astype(p.dtype)  # (N, C, T)
+    pospred = jnp.einsum("nct,n->tc", cmp, w)  # (T, C)
+    fp = pospred - tp
+    actpos = jnp.sum(ohw, axis=0)  # (C,)
+    total = jnp.sum(w)
+    fn = actpos[None, :] - tp
+    tn = total - pospred - fn
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T, C, 2, 2)
+
+
+def _binned_confmat_multilabel(p: Array, target: Array, w: Array, thresholds: Array) -> Array:
+    """(T, L, 2, 2) per-label threshold-confusion tensor via two einsums."""
+    t = target.astype(p.dtype)
+    tw = t * w  # (N, L)
+    cmp = (p[:, :, None] >= thresholds[None, None, :]).astype(p.dtype)  # (N, L, T)
+    tp = jnp.einsum("nlt,nl->tl", cmp, tw)
+    pospred = jnp.einsum("nlt,nl->tl", cmp, w)
+    fp = pospred - tp
+    actpos = jnp.sum(tw, axis=0)  # (L,)
+    total = jnp.sum(w, axis=0)  # (L,)
+    fn = actpos[None, :] - tp
+    tn = total[None, :] - pospred - fn
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T, L, 2, 2)
 
 
 def _binary_precision_recall_curve_compute_binned(confmat: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
@@ -164,8 +209,8 @@ def multiclass_precision_recall_curve(
         _validate_thresholds(thresholds)
     p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
     thr = _adjust_threshold_arg(thresholds)
-    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
     if thr is None:
+        onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
         precisions, recalls, thrs = [], [], []
         for c in range(num_classes):
             pr, rc, th = _binary_precision_recall_curve_compute_exact(p[:, c], onehot[:, c], w)
@@ -173,9 +218,7 @@ def multiclass_precision_recall_curve(
             recalls.append(rc)
             thrs.append(th)
         return precisions, recalls, thrs
-    confmat = jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, thr), in_axes=(1, 1))(p, onehot)
-    # confmat: (C, T, 2, 2) -> reference layout (T, C, 2, 2)
-    confmat = jnp.moveaxis(confmat, 0, 1)
+    confmat = _binned_confmat_multiclass(p, t, w, thr, num_classes)  # (T, C, 2, 2)
     tp = confmat[:, :, 1, 1]
     fp = confmat[:, :, 0, 1]
     fn = confmat[:, :, 1, 0]
@@ -217,8 +260,7 @@ def multilabel_precision_recall_curve(
             recalls.append(rc)
             thrs.append(th)
         return precisions, recalls, thrs
-    confmat = jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, thr), in_axes=(1, 1, 1))(p, t, w)
-    confmat = jnp.moveaxis(confmat, 0, 1)  # (T, L, 2, 2)
+    confmat = _binned_confmat_multilabel(p, t, w, thr)  # (T, L, 2, 2)
     tp = confmat[:, :, 1, 1]
     fp = confmat[:, :, 0, 1]
     fn = confmat[:, :, 1, 0]

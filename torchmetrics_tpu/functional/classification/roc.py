@@ -12,6 +12,8 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _adjust_threshold_arg,
     _binary_clf_curve,
     _binary_prc_format,
+    _binned_confmat_multiclass,
+    _binned_confmat_multilabel,
     _binned_curve_update,
     _multiclass_prc_format,
     _multilabel_prc_format,
@@ -71,8 +73,8 @@ def multiclass_roc(
         _validate_thresholds(thresholds)
     p, t, w = _multiclass_prc_format(preds, target, num_classes, ignore_index)
     thr = _adjust_threshold_arg(thresholds)
-    onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
     if thr is None:
+        onehot = jax.nn.one_hot(t, num_classes, dtype=jnp.int32)
         fprs, tprs, thrs = [], [], []
         for c in range(num_classes):
             fp_, tp_, th_ = _binary_roc_compute_exact(p[:, c], onehot[:, c], w)
@@ -80,9 +82,7 @@ def multiclass_roc(
             tprs.append(tp_)
             thrs.append(th_)
         return fprs, tprs, thrs
-    confmat = jnp.moveaxis(
-        jax.vmap(lambda pc, tc: _binned_curve_update(pc, tc, w, thr), in_axes=(1, 1))(p, onehot), 0, 1
-    )  # (T, C, 2, 2)
+    confmat = _binned_confmat_multiclass(p, t, w, thr, num_classes)  # (T, C, 2, 2)
     tp = confmat[:, :, 1, 1]
     fp = confmat[:, :, 0, 1]
     fn = confmat[:, :, 1, 0]
@@ -112,9 +112,7 @@ def multilabel_roc(
             tprs.append(tp_)
             thrs.append(th_)
         return fprs, tprs, thrs
-    confmat = jnp.moveaxis(
-        jax.vmap(lambda pc, tc, wc: _binned_curve_update(pc, tc, wc, thr), in_axes=(1, 1, 1))(p, t, w), 0, 1
-    )
+    confmat = _binned_confmat_multilabel(p, t, w, thr)  # (T, L, 2, 2)
     tp = confmat[:, :, 1, 1]
     fp = confmat[:, :, 0, 1]
     fn = confmat[:, :, 1, 0]
