@@ -1,0 +1,7 @@
+"""Minimal lightning_utilities stub so the reference torchmetrics imports
+from /root/reference/src for cross-implementation parity tests.
+
+Only the four names the reference imports are provided (see
+`grep "lightning_utilities" -r /root/reference/src/torchmetrics`).
+"""
+from lightning_utilities.core.apply_func import apply_to_collection  # noqa: F401

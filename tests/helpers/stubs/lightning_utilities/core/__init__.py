@@ -1,0 +1,1 @@
+from lightning_utilities.core.apply_func import apply_to_collection  # noqa: F401

@@ -1,0 +1,30 @@
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple, Union
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, Tuple[type, ...]],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, Tuple[type, ...]]] = None,
+    include_none: bool = True,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all entries of type ``dtype``."""
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)) and not hasattr(data, "_fields"):
+        out = [apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype,
+                                   include_none=include_none, **kwargs) for d in data]
+        return type(data)(out)
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype,
+                                                include_none=include_none, **kwargs) for d in data))
+    if isinstance(data, dict):
+        return type(data)(
+            (k, apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype,
+                                    include_none=include_none, **kwargs))
+            for k, v in data.items()
+        )
+    return data

@@ -1,0 +1,33 @@
+from enum import Enum
+from typing import Optional
+
+
+class StrEnum(str, Enum):
+    """String enum with case-insensitive lookup (mirror of the public API)."""
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        out = cls.try_from_str(value, source=source)
+        if out is None:
+            raise ValueError(f"Invalid match: expected one of {[e.name for e in cls]}, but got {value}.")
+        return out
+
+    @classmethod
+    def try_from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        if source in ("key", "any"):
+            for e in cls:
+                if e.name.lower() == value.lower():
+                    return e
+        if source in ("value", "any"):
+            for e in cls:
+                if e.value.lower() == value.lower():
+                    return e
+        return None
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())

@@ -1,0 +1,62 @@
+import importlib
+import importlib.metadata
+import importlib.util
+import re
+
+
+def package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+
+def module_available(path: str) -> bool:
+    if not package_available(path.split(".")[0]):
+        return False
+    try:
+        importlib.import_module(path)
+    except Exception:
+        return False
+    return True
+
+
+class RequirementCache:
+    """Bool-evaluable availability probe for ``pkg`` / ``pkg>=x.y`` requirement strings."""
+
+    def __init__(self, requirement: str, module: str = None) -> None:
+        self.requirement = requirement
+        self.module = module
+
+    def _check(self) -> bool:
+        name = re.split(r"[<>=!~ \[]", self.requirement.strip())[0]
+        mod = self.module or name
+        if not package_available(mod.replace("-", "_")):
+            return False
+        cons = self.requirement.strip()[len(name):].strip()
+        if not cons:
+            return True
+        try:
+            version = importlib.metadata.version(name)
+        except importlib.metadata.PackageNotFoundError:
+            return False
+        return all(self._cmp(version, c.strip()) for c in cons.split(",") if c.strip())
+
+    @staticmethod
+    def _vt(v: str):
+        return tuple(int(x) for x in re.findall(r"\d+", v)[:3])
+
+    def _cmp(self, version: str, con: str) -> bool:
+        m = re.match(r"(>=|<=|==|<|>|!=)\s*(.+)", con)
+        if not m:
+            return True
+        op, want = m.groups()
+        a, b = self._vt(version), self._vt(want)
+        return {"<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b, "==": a[:len(b)] == b, "!=": a[:len(b)] != b}[op]
+
+    def __bool__(self) -> bool:
+        if not hasattr(self, "_cached"):
+            self._cached = self._check()
+        return self._cached
+
+    def __str__(self) -> str:
+        return f"Requirement '{self.requirement}' {'met' if bool(self) else 'not met'}"
+
+    __repr__ = __str__

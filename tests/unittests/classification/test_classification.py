@@ -238,12 +238,14 @@ def test_ranking_classes():
 def test_binary_calibration_error():
     p, t = _flat(BIN_PROBS), _flat(BIN_TARGET)
     res = binary_calibration_error(jnp.asarray(p), jnp.asarray(t), n_bins=10, norm="l1")
-    # manual ECE on predicted-class confidence
-    conf = np.where(p > 0.5, p, 1 - p)
-    acc = np.where(p > 0.5, t, 1 - t)
-    bins = np.clip((conf * 10).astype(int), 0, 9)
+    # manual ECE with the reference's binary convention: confidence is the
+    # positive-class probability, accuracy is the target
+    # (reference calibration_error.py:136-138); bin 10 holds conf == 1.0
+    conf = p
+    acc = t.astype(np.float64)
+    bins = np.clip((conf * 10).astype(int), 0, 10)
     ece = 0.0
-    for b in range(10):
+    for b in range(11):
         mask = bins == b
         if mask.sum():
             ece += np.abs(acc[mask].mean() - conf[mask].mean()) * mask.mean()

@@ -118,7 +118,9 @@ def test_kl_divergence():
     q /= q.sum(1, keepdims=True)
     m = KLDivergence()
     m.update(jnp.asarray(p), jnp.asarray(q))
-    expected = np.mean([stats.entropy(q[i], p[i]) for i in range(16)])
+    # KL(p || q): first update argument is the data distribution (reference
+    # functional/regression/kl_divergence.py:26-48)
+    expected = np.mean([stats.entropy(p[i], q[i]) for i in range(16)])
     np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-4)
 
 

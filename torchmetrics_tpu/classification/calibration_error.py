@@ -36,9 +36,11 @@ class _CalibrationErrorBase(Metric):
             raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
         self.n_bins = n_bins
         self.norm = norm
-        self.add_state("conf_sum", jnp.zeros(n_bins), dist_reduce_fx="sum")
-        self.add_state("acc_sum", jnp.zeros(n_bins), dist_reduce_fx="sum")
-        self.add_state("count", jnp.zeros(n_bins), dist_reduce_fx="sum")
+        # n_bins + 1: the last bin holds conf == 1.0 exactly (reference
+        # bucketize semantics, functional/classification/calibration_error.py:44-50)
+        self.add_state("conf_sum", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
+        self.add_state("acc_sum", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
 
     def _accumulate(self, state: State, conf: Array, acc: Array, w: Array) -> State:
         cs, as_, ct = _bin_update(conf, acc, w, self.n_bins)

@@ -21,11 +21,19 @@ from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed, _safe
 def _bin_update(
     confidences: Array, accuracies: Array, weights: Array, n_bins: int
 ) -> Tuple[Array, Array, Array]:
-    """Scatter confidences/accuracies into uniform bins over [0, 1]."""
-    bin_idx = jnp.clip((confidences * n_bins).astype(jnp.int32), 0, n_bins - 1)
-    conf_sum = jnp.zeros(n_bins).at[bin_idx].add(confidences * weights)
-    acc_sum = jnp.zeros(n_bins).at[bin_idx].add(accuracies * weights)
-    count = jnp.zeros(n_bins).at[bin_idx].add(weights)
+    """Scatter confidences/accuracies into uniform bins over [0, 1].
+
+    Left-closed bins (conf in [i/n, (i+1)/n) -> bin i) with an overflow bin
+    that holds conf == 1.0 exactly — the semantics of the reference's
+    ``bucketize(conf, linspace(0, 1, n+1), right=True) - 1`` over an
+    (n_bins+1)-sized count array
+    (functional/classification/calibration_error.py:44-50).  Returned arrays
+    have n_bins + 1 entries.
+    """
+    bin_idx = jnp.clip(jnp.floor(confidences * n_bins).astype(jnp.int32), 0, n_bins)
+    conf_sum = jnp.zeros(n_bins + 1).at[bin_idx].add(confidences * weights)
+    acc_sum = jnp.zeros(n_bins + 1).at[bin_idx].add(accuracies * weights)
+    count = jnp.zeros(n_bins + 1).at[bin_idx].add(weights)
     return conf_sum, acc_sum, count
 
 
@@ -54,9 +62,11 @@ def _binary_ce_confidences(
         weights = jnp.where(target == ignore_index, 0.0, weights)
         target = jnp.where(target == ignore_index, 0, target)
     preds = normalize_logits_if_needed(preds, "sigmoid")
-    # confidence in the *predicted* class, accuracy of that prediction
-    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
-    accuracies = jnp.where(preds > 0.5, target, 1 - target).astype(jnp.float32)
+    # reference convention: confidence IS the positive-class probability and
+    # accuracy IS the binary target (calibration_error.py:136-138), not the
+    # top-label max(p, 1-p) convention
+    confidences = preds
+    accuracies = target.astype(jnp.float32)
     return confidences, accuracies, weights
 
 

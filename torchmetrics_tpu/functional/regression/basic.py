@@ -197,22 +197,27 @@ def _kl_divergence_update(preds: Array, target: Array, log_prob: bool = False) -
     preds, target = jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
     if preds.ndim != 2 or target.ndim != 2:
         raise ValueError(f"Expected both predictions and target to be 2D but got {preds.ndim} and {target.ndim} respectively")
+    # KL(p || q): first argument is the data distribution (reference:
+    # functional/regression/kl_divergence.py:26-48).  Returns the per-sample
+    # measures so callers can sum (mean/sum reduction) or keep them (none).
     if log_prob:
-        measures = jnp.sum(jnp.exp(target) * (target - preds), axis=-1)
+        measures = jnp.sum(jnp.exp(preds) * (preds - target), axis=-1)
     else:
         p = preds / jnp.sum(preds, axis=-1, keepdims=True)
         t = target / jnp.sum(target, axis=-1, keepdims=True)
-        measures = jnp.sum(_safe_xlogy(t, t / jnp.maximum(p, 1e-24)), axis=-1)
-    return jnp.sum(measures), jnp.asarray(preds.shape[0], jnp.float32)
+        measures = jnp.sum(_safe_xlogy(p, p / jnp.maximum(t, 1e-24)), axis=-1)
+    return measures, jnp.asarray(preds.shape[0], jnp.float32)
 
 
 def kl_divergence(preds: Array, target: Array, log_prob: bool = False, reduction: str = "mean") -> Array:
-    s, n = _kl_divergence_update(preds, target, log_prob)
+    measures, n = _kl_divergence_update(preds, target, log_prob)
     if reduction == "mean":
-        return s / n
+        return jnp.sum(measures) / n
     if reduction == "sum":
-        return s
-    raise ValueError(f"Expected argument `reduction` to be one of ('mean', 'sum'), got {reduction}")
+        return jnp.sum(measures)
+    if reduction in ("none", None):
+        return measures
+    raise ValueError(f"Expected argument `reduction` to be one of ('mean', 'sum', 'none', None), got {reduction}")
 
 
 # ------------------------------------------------------------------ cosine similarity

@@ -164,8 +164,12 @@ def concordance_corrcoef(preds: Array, target: Array) -> Array:
         preds, target = preds[:, None], target[:, None]
     n = preds.shape[0]
     mx, my = jnp.mean(preds, axis=0), jnp.mean(target, axis=0)
-    vx = jnp.var(preds, axis=0)
-    vy = jnp.var(target, axis=0)
-    cxy = jnp.mean((preds - mx) * (target - my), axis=0)
+    # n-1 normalization matches the reference (functional/regression/pearson.py:95-97).
+    # Deliberate deviation: for n == 1 the reference divides by zero and
+    # returns nan; we clamp the denominator and return a finite value.
+    denom = max(n - 1, 1)
+    vx = jnp.sum((preds - mx) ** 2, axis=0) / denom
+    vy = jnp.sum((target - my) ** 2, axis=0) / denom
+    cxy = jnp.sum((preds - mx) * (target - my), axis=0) / denom
     ccc = 2 * cxy / (vx + vy + (mx - my) ** 2)
     return ccc.squeeze()

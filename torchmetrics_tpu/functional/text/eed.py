@@ -1,9 +1,10 @@
 """Extended Edit Distance (reference: functional/text/eed.py:100-430).
 
 EED = CDER-style character DP with an α-penalized jump at blank positions and
-a ρ coverage penalty.  The inner DP row is vectorized: the deletion chain
-collapses to a prefix-min scan (see helper._edit_distance), so each reference
-character costs one numpy pass over the hypothesis instead of a Python loop.
+a ρ coverage penalty.  The substitution/insertion candidates of each DP row
+are vectorized in numpy; the deletion chain is deliberately sequential so
+float rounding and tie-breaks (which feed min_index and the jump) match the
+reference's operation order exactly — do not re-vectorize it as a prefix-min.
 """
 
 from __future__ import annotations
@@ -26,7 +27,7 @@ def _eed_function(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> float:
-    """Sentence-level EED (reference eed.py:116-172, vectorized rows)."""
+    """Sentence-level EED (reference eed.py:116-172; order-exact DP)."""
     nh = len(hyp)
     hyp_arr = np.frombuffer(hyp.encode("utf-32-le"), dtype=np.uint32) if nh else np.zeros(0, np.uint32)
     number_of_visits = np.full(nh + 1, -1, dtype=np.int64)
@@ -40,8 +41,17 @@ def _eed_function(
         cand = np.empty(nh + 1, dtype=np.float64)
         cand[0] = row[0] + 1.0
         cand[1:] = np.minimum(row[:-1] + sub_cost, row[1:] + insertion)
-        # deletion chain: next[i] = min(next[i-1]+deletion, cand[i]) — prefix-min
-        next_row = np.minimum.accumulate(cand - idx * deletion) + idx * deletion
+        # deletion chain: next[i] = min(next[i-1]+deletion, cand[i]).  Run it
+        # sequentially so float rounding (and hence tie-breaks feeding
+        # min_index / the jump) matches the reference operation order — a
+        # prefix-min reformulation changes ULPs and can flip the alignment.
+        next_row = cand
+        prev = next_row[0]
+        for i in range(1, nh + 1):
+            d = prev + deletion
+            if d < next_row[i]:
+                next_row[i] = d
+            prev = next_row[i]
         min_index = int(np.argmin(next_row))
         number_of_visits[min_index] += 1
         if ref[w - 1] == " ":

@@ -86,7 +86,9 @@ class ConcordanceCorrCoef(PearsonCorrCoef):
     """Lin's CCC from the same moment states (reference: regression/concordance.py)."""
 
     def _compute(self, state: State) -> Array:
-        n = jnp.maximum(state["n_total"], 1.0)
+        # n-1 normalization matches the reference exactly
+        # (functional/regression/pearson.py:95-97 feeding concordance.py:30)
+        n = jnp.maximum(state["n_total"] - 1.0, 1.0)
         vx = state["var_x"] / n
         vy = state["var_y"] / n
         cxy = state["corr_xy"] / n

@@ -37,21 +37,10 @@ class KLDivergence(Metric):
         self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
 
     def _update(self, state: State, p: Array, q: Array) -> State:
-        s, n = _kl_divergence_update(p, q, self.log_prob)
+        measures, n = _kl_divergence_update(p, q, self.log_prob)
         if self.reduction in ("mean", "sum"):
-            return {"measures": state["measures"] + s, "total": state["total"] + n}
-        # for none: recompute per-sample measures
-        p = jnp.asarray(p, jnp.float32)
-        q = jnp.asarray(q, jnp.float32)
-        from torchmetrics_tpu.utilities.compute import _safe_xlogy
-
-        if self.log_prob:
-            m = jnp.sum(jnp.exp(q) * (q - p), axis=-1)
-        else:
-            pn = p / jnp.sum(p, axis=-1, keepdims=True)
-            qn = q / jnp.sum(q, axis=-1, keepdims=True)
-            m = jnp.sum(_safe_xlogy(qn, qn / jnp.maximum(pn, 1e-24)), axis=-1)
-        return {"measures": tuple(state["measures"]) + (m,), "total": state["total"] + n}
+            return {"measures": state["measures"] + jnp.sum(measures), "total": state["total"] + n}
+        return {"measures": tuple(state["measures"]) + (measures,), "total": state["total"] + n}
 
     def _compute(self, state: State) -> Array:
         if self.reduction == "mean":
