@@ -1,0 +1,87 @@
+"""BERTScore parity vs the reference with identical HF weights.
+
+A tiny random-initialized torch BertModel + WordPiece tokenizer are saved to
+a temp dir; the reference BERTScore loads them with torch, ours loads the
+same checkpoint through FlaxAutoModel(from_pt=True).  Same weights, same
+tokenizer, same texts → P/R/F1 must agree (VERDICT r1 "next" #3: real model
+wiring proven without downloadable weights).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_STUBS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
+for _p in (_STUBS, "/root/reference/src"):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+transformers = pytest.importorskip("transformers")
+
+# Pairs ordered so ascending-length sort is the identity on BOTH sides: the
+# reference sorts preds and target independently by length inside bert_score
+# (helper_embedding_metric.py:79-84,130-133) and only un-sorts the preds axis
+# (bert.py:426-433), so differently-ordered corpora get their pairs
+# misaligned upstream.  Our implementation keeps pair alignment; identity
+# ordering makes the two comparable.
+PREDS = ["hello world this is a test", "the cat is on the mat"]
+TARGET = ["hello world it is a test", "there is a cat on the mat"]
+
+VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + sorted({w for s in PREDS + TARGET for w in s.split()})
+    + ["extra", "tokens", "for", "padding"]
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_bert_dir(tmp_path_factory):
+    from transformers import BertConfig, BertModel, BertTokenizer
+
+    d = tmp_path_factory.mktemp("tiny_bert")
+    vocab_file = d / "vocab.txt"
+    vocab_file.write_text("\n".join(VOCAB))
+    tok = BertTokenizer(str(vocab_file))
+    tok.save_pretrained(str(d))
+
+    cfg = BertConfig(
+        vocab_size=len(VOCAB), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=64,
+    )
+    import torch
+
+    torch.manual_seed(0)
+    model = BertModel(cfg).eval()
+    model.save_pretrained(str(d))
+    return str(d)
+
+
+def test_bertscore_reference_parity(tiny_bert_dir):
+    import torchmetrics as R
+
+    import torchmetrics_tpu as T
+
+    ref = R.text.BERTScore(model_name_or_path=tiny_bert_dir, num_layers=2, max_length=32)
+    ours = T.text.BERTScore(model_name_or_path=tiny_bert_dir, num_layers=2, max_length=32)
+
+    ref.update(PREDS, TARGET)
+    ours.update(PREDS, TARGET)
+    res_r = ref.compute()
+    res_o = ours.compute()
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(
+            np.asarray(res_o[key]), np.asarray(res_r[key]), atol=1e-4,
+            err_msg=f"BERTScore {key} mismatch",
+        )
+
+
+def test_bertscore_functional_hf(tiny_bert_dir):
+    from torchmetrics_tpu.functional.text import bert_score
+
+    out = bert_score(PREDS, TARGET, model_name_or_path=tiny_bert_dir, num_layers=2, max_length=32)
+    assert out["f1"].shape == (2,)
+    # identical sentences must score ~1
+    out_same = bert_score(PREDS, PREDS, model_name_or_path=tiny_bert_dir, num_layers=2, max_length=32)
+    np.testing.assert_allclose(np.asarray(out_same["f1"]), 1.0, atol=1e-4)

@@ -59,14 +59,37 @@ class DeterministicLPIPSNet:
         return feats
 
 
-_DEFAULT_NET: Optional[DeterministicLPIPSNet] = None
+_DEFAULT_NETS: dict = {}
 
 
-def _default_net() -> DeterministicLPIPSNet:
-    global _DEFAULT_NET
-    if _DEFAULT_NET is None:
-        _DEFAULT_NET = DeterministicLPIPSNet()
-    return _DEFAULT_NET
+def _default_net(net_type: str = "squeeze") -> Callable:
+    """Backbone for ``net_type``: real VGG16/AlexNet pyramids (JAX ports,
+    image/backbones/lpips_nets.py) for 'vgg'/'alex'; the deterministic conv
+    pyramid for 'squeeze' (no SqueezeNet port yet).
+
+    Torch weights load from ``TORCHMETRICS_TPU_LPIPS_WEIGHTS_VGG`` /
+    ``..._ALEX`` (torchvision ``state_dict`` path) when set — nothing is
+    downloaded in this zero-egress image; random-init otherwise (the
+    architecture and conversion path are still the real, parity-tested ones).
+    """
+    if net_type not in _DEFAULT_NETS:
+        if net_type in ("vgg", "alex"):
+            import os
+
+            from torchmetrics_tpu.image.backbones.lpips_nets import LPIPSBackbone
+
+            path = os.environ.get(f"TORCHMETRICS_TPU_LPIPS_WEIGHTS_{net_type.upper()}")
+            if path:
+                import torch as _torch
+
+                _DEFAULT_NETS[net_type] = LPIPSBackbone.from_torch_state_dict(
+                    net_type, _torch.load(path, map_location="cpu")
+                )
+            else:
+                _DEFAULT_NETS[net_type] = LPIPSBackbone(net=net_type)
+        else:
+            _DEFAULT_NETS[net_type] = DeterministicLPIPSNet()
+    return _DEFAULT_NETS[net_type]
 
 
 def _lpips_from_features(
@@ -120,6 +143,6 @@ def learned_perceptual_image_patch_similarity(
         img1 = 2 * img1 - 1
         img2 = 2 * img2 - 1
 
-    backbone = net if net is not None else _default_net()
+    backbone = net if net is not None else _default_net(net_type)
     per_sample = _lpips_from_features(backbone(img1), backbone(img2), linear_weights)
     return per_sample.mean() if reduction == "mean" else per_sample.sum()

@@ -72,6 +72,61 @@ def _idf_weights(input_ids: np.ndarray, attention_mask: np.ndarray, idf: Dict[in
     return w
 
 
+def _process_special_tokens_mask(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero the [CLS] (first) and [SEP] (last attended) positions.
+
+    Numpy mirror of the reference's
+    ``_process_attention_mask_for_special_tokens``
+    (functional/text/helper_embedding_metric.py:33-48).
+    """
+    am = np.asarray(attention_mask).astype(np.float32).copy()
+    am[:, 0] = 0
+    sep_pos = np.cumsum(am - 0.1, axis=-1).argmax(-1)
+    am[np.arange(am.shape[0]), sep_pos] = 0
+    return am.astype(attention_mask.dtype)
+
+
+def load_hf_embedder(
+    model_name_or_path: str,
+    num_layers: Optional[int] = None,
+    max_length: int = 512,
+    truncation: bool = True,
+) -> Tuple[Callable, Callable]:
+    """(embed_fn, tokenizer_fn) from a HuggingFace model path.
+
+    Uses the Flax variant of the model when available, converting from torch
+    weights otherwise — so a user's local torch checkpoint runs natively on
+    TPU.  Mirrors the reference's embedding extraction
+    (functional/text/bert.py:100-101): hidden_states[num_layers or -1].
+    Zero-egress note: ``model_name_or_path`` must be a local directory here;
+    nothing is downloaded.
+    """
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    tok = AutoTokenizer.from_pretrained(model_name_or_path)
+    try:
+        hf_model = FlaxAutoModel.from_pretrained(model_name_or_path)
+    except (OSError, EnvironmentError, ValueError):
+        hf_model = FlaxAutoModel.from_pretrained(model_name_or_path, from_pt=True)
+
+    def embed_fn(input_ids, attention_mask):
+        out = hf_model(
+            input_ids=np.asarray(input_ids),
+            attention_mask=np.asarray(attention_mask),
+            output_hidden_states=True,
+        )
+        return jnp.asarray(out.hidden_states[num_layers if num_layers is not None else -1])
+
+    def tokenizer_fn(texts):
+        enc = tok(
+            list(texts), padding=True, truncation=truncation, max_length=max_length,
+            return_tensors="np",
+        )
+        return {"input_ids": enc["input_ids"], "attention_mask": enc["attention_mask"]}
+
+    return embed_fn, tokenizer_fn
+
+
 def _bert_score_from_embeddings(
     pred_emb: Array,
     pred_mask: Array,
@@ -88,7 +143,10 @@ def _bert_score_from_embeddings(
     tgt_n = target_emb / jnp.maximum(jnp.linalg.norm(target_emb, axis=-1, keepdims=True), 1e-12)
     sim = jnp.einsum("bph,bth->bpt", pred_n, tgt_n)
     valid = pred_mask[:, :, None] * target_mask[:, None, :]
-    sim = jnp.where(valid > 0, sim, -1e9)
+    # masked entries contribute similarity 0 — the reference multiplies
+    # normalized embeddings by the mask, so its max over a masked axis
+    # floors at 0 rather than -inf (functional/text/bert.py:117-118,138)
+    sim = jnp.where(valid > 0, sim, 0.0)
 
     pm = pred_mask.astype(jnp.float32)
     tm = target_mask.astype(jnp.float32)
@@ -138,8 +196,15 @@ def bert_score(
     if len(preds_l) != len(target_l):
         raise ValueError("Number of predicted and reference sententes must be the same!")
 
-    tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
-    embed_fn = user_forward_fn or model or _hash_embedding_model
+    zero_special = False
+    if model_name_or_path and model is None and user_forward_fn is None and user_tokenizer is None:
+        embed_fn, tokenizer = load_hf_embedder(
+            model_name_or_path, num_layers, max_length, truncation=True
+        )
+        zero_special = True
+    else:
+        tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
+        embed_fn = user_forward_fn or model or _hash_embedding_model
 
     pred_tok = tokenizer(preds_l)
     tgt_tok = tokenizer(target_l)
@@ -156,14 +221,18 @@ def bert_score(
     pred_emb = jnp.asarray(embed_fn(jnp.asarray(pred_ids), jnp.asarray(pred_mask)))
     tgt_emb = jnp.asarray(embed_fn(jnp.asarray(tgt_ids), jnp.asarray(tgt_mask)))
 
+    # model forward sees the raw mask; scoring excludes [CLS]/[SEP]
+    score_pred_mask = _process_special_tokens_mask(pred_mask) if zero_special else pred_mask
+    score_tgt_mask = _process_special_tokens_mask(tgt_mask) if zero_special else tgt_mask
+
     pw = tw = None
     if idf:
-        idf_map = _compute_idf(tgt_ids, tgt_mask)
-        pw = jnp.asarray(_idf_weights(pred_ids, pred_mask, idf_map))
-        tw = jnp.asarray(_idf_weights(tgt_ids, tgt_mask, idf_map))
+        idf_map = _compute_idf(tgt_ids, score_tgt_mask)
+        pw = jnp.asarray(_idf_weights(pred_ids, score_pred_mask, idf_map))
+        tw = jnp.asarray(_idf_weights(tgt_ids, score_tgt_mask, idf_map))
 
     precision, recall, f1 = _bert_score_from_embeddings(
-        pred_emb, jnp.asarray(pred_mask), tgt_emb, jnp.asarray(tgt_mask), pw, tw
+        pred_emb, jnp.asarray(score_pred_mask), tgt_emb, jnp.asarray(score_tgt_mask), pw, tw
     )
     out = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:

@@ -1,0 +1,167 @@
+"""VGG16 / AlexNet feature pyramids for LPIPS, in pure JAX.
+
+Reference: the reference LPIPS embeds pretrained torchvision AlexNet/VGG16/
+SqueezeNet plus learned linear calibration weights
+(/root/reference/src/torchmetrics/functional/image/lpips.py:130-180).  This
+module implements the two main backbones as op-list programs over a params
+pytree with a ``load_torch_state_dict`` conversion from the torchvision
+``features.N.weight`` layout, plus the LPIPS scaling layer.  Weights are not
+downloadable here (zero egress); parity of the converted execution is proven
+against an independently written torch mirror in
+tests/unittests/image/test_backbones.py.
+
+Each backbone yields the canonical LPIPS tap points:
+
+* VGG16:   relu1_2, relu2_2, relu3_3, relu4_3, relu5_3  (64/128/256/512/512 ch)
+* AlexNet: relu1..relu5                                  (64/192/384/256/256 ch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+Params = Dict[str, Any]
+
+# op-list encodings: ("conv", torch_features_index, stride, pad), ("relu",),
+# ("maxpool", window, stride), ("tap",) marks an LPIPS feature output
+_VGG16_OPS: Tuple[Tuple, ...] = tuple(
+    [("conv", 0, 1, 1), ("relu",), ("conv", 2, 1, 1), ("relu",), ("tap",), ("maxpool", 2, 2)]
+    + [("conv", 5, 1, 1), ("relu",), ("conv", 7, 1, 1), ("relu",), ("tap",), ("maxpool", 2, 2)]
+    + [("conv", 10, 1, 1), ("relu",), ("conv", 12, 1, 1), ("relu",), ("conv", 14, 1, 1), ("relu",), ("tap",), ("maxpool", 2, 2)]
+    + [("conv", 17, 1, 1), ("relu",), ("conv", 19, 1, 1), ("relu",), ("conv", 21, 1, 1), ("relu",), ("tap",), ("maxpool", 2, 2)]
+    + [("conv", 24, 1, 1), ("relu",), ("conv", 26, 1, 1), ("relu",), ("conv", 28, 1, 1), ("relu",), ("tap",)]
+)
+# (torch_features_index, cin, cout, kernel, stride, pad)
+_VGG16_CONVS = (
+    (0, 3, 64, 3, 1, 1), (2, 64, 64, 3, 1, 1),
+    (5, 64, 128, 3, 1, 1), (7, 128, 128, 3, 1, 1),
+    (10, 128, 256, 3, 1, 1), (12, 256, 256, 3, 1, 1), (14, 256, 256, 3, 1, 1),
+    (17, 256, 512, 3, 1, 1), (19, 512, 512, 3, 1, 1), (21, 512, 512, 3, 1, 1),
+    (24, 512, 512, 3, 1, 1), (26, 512, 512, 3, 1, 1), (28, 512, 512, 3, 1, 1),
+)
+VGG16_CHANNELS = (64, 128, 256, 512, 512)
+
+_ALEXNET_OPS: Tuple[Tuple, ...] = (
+    ("conv", 0, 4, 2), ("relu",), ("tap",), ("maxpool", 3, 2),
+    ("conv", 3, 1, 2), ("relu",), ("tap",), ("maxpool", 3, 2),
+    ("conv", 6, 1, 1), ("relu",), ("tap",),
+    ("conv", 8, 1, 1), ("relu",), ("tap",),
+    ("conv", 10, 1, 1), ("relu",), ("tap",),
+)
+_ALEXNET_CONVS = (
+    (0, 3, 64, 11, 4, 2),
+    (3, 64, 192, 5, 1, 2),
+    (6, 192, 384, 3, 1, 1),
+    (8, 384, 256, 3, 1, 1),
+    (10, 256, 256, 3, 1, 1),
+)
+ALEXNET_CHANNELS = (64, 192, 384, 256, 256)
+
+_NETS = {
+    "vgg": (_VGG16_OPS, _VGG16_CONVS, VGG16_CHANNELS),
+    "vgg16": (_VGG16_OPS, _VGG16_CONVS, VGG16_CHANNELS),
+    "alex": (_ALEXNET_OPS, _ALEXNET_CONVS, ALEXNET_CHANNELS),
+}
+
+# LPIPS ScalingLayer constants (lpips.py ScalingLayer)
+_SHIFT = np.array([-0.030, -0.088, -0.188], np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], np.float32)
+
+
+def net_init(net: str, key: Array) -> Params:
+    """He-init random params in the torch ``features.N`` naming (tests/smoke)."""
+    _, convs, _ = _NETS[net]
+    params: Params = {}
+    keys = iter(jax.random.split(key, len(convs)))
+    for idx, cin, cout, k, _, _ in convs:
+        fan_in = cin * k * k
+        params[f"features.{idx}"] = {
+            "w": jax.random.normal(next(keys), (k, k, cin, cout)) * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((cout,)),
+        }
+    return params
+
+
+def load_torch_state_dict(net: str, sd: Dict[str, Any]) -> Params:
+    """Convert a torchvision vgg16/alexnet ``state_dict`` (``features.N.weight``)."""
+
+    def arr(v):
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        return jnp.asarray(np.asarray(v), jnp.float32)
+
+    _, convs, _ = _NETS[net]
+    params: Params = {}
+    for idx, *_ in convs:
+        w = arr(sd[f"features.{idx}.weight"])  # (O, I, KH, KW)
+        params[f"features.{idx}"] = {
+            "w": jnp.transpose(w, (2, 3, 1, 0)),
+            "b": arr(sd[f"features.{idx}.bias"]),
+        }
+    return params
+
+
+def net_apply(net: str, params: Params, x: Array) -> List[Array]:
+    """Run the op list on (B, 3, H, W); returns the LPIPS tap feature maps."""
+    ops, _, _ = _NETS[net]
+    taps: List[Array] = []
+    for op in ops:
+        if op[0] == "conv":
+            _, idx, stride, pad = op
+            p = params[f"features.{idx}"]
+            x = jax.lax.conv_general_dilated(
+                x, p["w"], (stride, stride), [(pad, pad), (pad, pad)],
+                dimension_numbers=("NCHW", "HWIO", "NCHW"),
+            ) + p["b"][None, :, None, None]
+        elif op[0] == "relu":
+            x = jax.nn.relu(x)
+        elif op[0] == "maxpool":
+            _, window, stride = op
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 1, window, window), (1, 1, stride, stride),
+                [(0, 0), (0, 0), (0, 0), (0, 0)],
+            )
+        elif op[0] == "tap":
+            taps.append(x)
+    return taps
+
+
+def scaling_layer(x: Array) -> Array:
+    """LPIPS input normalization: (x - shift) / scale on [-1, 1] images."""
+    return (x - jnp.asarray(_SHIFT)[None, :, None, None]) / jnp.asarray(_SCALE)[None, :, None, None]
+
+
+class LPIPSBackbone:
+    """Callable (B,3,H,W) in [-1,1] → list of feature maps, LPIPS interface.
+
+    ``lin_weights``: per-layer (C,) calibration vectors (the reference's
+    learned 1x1 ``lin`` convs).  None → unweighted (all-ones), which is the
+    reference's ``lpips=False`` ("baseline") mode.
+    """
+
+    def __init__(
+        self,
+        net: str = "vgg",
+        params: Optional[Params] = None,
+        lin_weights: Optional[Sequence[Array]] = None,
+        seed: int = 0,
+    ) -> None:
+        if net not in _NETS:
+            raise ValueError(f"Unknown LPIPS backbone {net!r}; expected one of {sorted(_NETS)}")
+        self.net = net
+        self.channels = _NETS[net][2]
+        self.params = params if params is not None else net_init(net, jax.random.PRNGKey(seed))
+        self.lin_weights = None if lin_weights is None else [jnp.asarray(w) for w in lin_weights]
+        self._apply = jax.jit(lambda p, x: net_apply(net, p, scaling_layer(x)))
+
+    @classmethod
+    def from_torch_state_dict(cls, net: str, sd: Dict[str, Any], **kwargs: Any) -> "LPIPSBackbone":
+        return cls(net=net, params=load_torch_state_dict(net, sd), **kwargs)
+
+    def __call__(self, x: Array) -> List[Array]:
+        return self._apply(self.params, jnp.asarray(x, jnp.float32))

@@ -90,12 +90,54 @@ class _RealFeaturesResetMixin:
             super().reset()
 
 
+def _load_inception(return_logits: bool = False, weights_path: Optional[str] = None):
+    """Real JAX InceptionV3 (pytorch-fid graph, image/backbones/inception.py).
+
+    Weights: a torch/numpy state_dict at ``weights_path`` or the
+    ``TORCHMETRICS_TPU_INCEPTION_WEIGHTS`` env var (zero-egress image, so
+    nothing is downloaded); random-init otherwise — the architecture is still
+    the real one and the conversion path is parity-tested.
+    """
+    import os
+
+    from torchmetrics_tpu.image.backbones.inception import InceptionFeatureExtractor
+
+    weights_path = weights_path or os.environ.get("TORCHMETRICS_TPU_INCEPTION_WEIGHTS")
+    if weights_path:
+        if weights_path.endswith(".npz"):
+            import numpy as _np
+
+            sd = dict(_np.load(weights_path))
+        else:
+            import torch as _torch
+
+            sd = _torch.load(weights_path, map_location="cpu")
+        return InceptionFeatureExtractor.from_torch_state_dict(sd, return_logits=return_logits)
+    return InceptionFeatureExtractor(return_logits=return_logits)
+
+
 def _resolve_feature_extractor(
-    feature: Union[int, Callable, None], default_dim: int = 64
+    feature: Union[int, str, Callable, None], default_dim: int = 64
 ) -> Tuple[Callable, int]:
     if feature is None:
         feature = default_dim
+    if isinstance(feature, str):
+        # reference InceptionScore accepts "logits_unbiased" (inception.py:34);
+        # "inception" selects the pooled 2048-d features explicitly
+        if feature == "inception":
+            net = _load_inception(return_logits=False)
+            return net, net.num_features
+        if feature in ("logits", "logits_unbiased"):
+            from torchmetrics_tpu.image.backbones.inception import NUM_LOGITS
+
+            return _load_inception(return_logits=True), NUM_LOGITS
+        raise ValueError(f"Got unknown input to argument `feature`: {feature!r}")
     if isinstance(feature, int):
+        # 2048 is the canonical InceptionV3 pool dim (reference fid.py feature
+        # choices {64, 192, 768, 2048}): use the real backbone for it; the
+        # lower block dims keep the deterministic stand-in encoder.
+        if feature == 2048:
+            return _load_inception(return_logits=False), 2048
         return DeterministicFeatureExtractor(dim=feature), feature
     if callable(feature):
         dim = getattr(feature, "num_features", None)

@@ -55,8 +55,17 @@ class BERTScore(Metric):
         self.model_name_or_path = model_name_or_path
         self.idf = idf
         self.return_hash = return_hash
-        self.tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
-        self.embed_fn = user_forward_fn or model or _hash_embedding_model
+        self._zero_special = False
+        if model_name_or_path and model is None and user_forward_fn is None and user_tokenizer is None:
+            from torchmetrics_tpu.functional.text.bert import load_hf_embedder
+
+            self.embed_fn, self.tokenizer = load_hf_embedder(
+                model_name_or_path, num_layers, max_length, truncation=truncation
+            )
+            self._zero_special = True
+        else:
+            self.tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
+            self.embed_fn = user_forward_fn or model or _hash_embedding_model
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -101,6 +110,12 @@ class BERTScore(Metric):
 
         pred_emb = jnp.asarray(self.embed_fn(jnp.asarray(p_ids), jnp.asarray(p_mask)))
         tgt_emb = jnp.asarray(self.embed_fn(jnp.asarray(t_ids), jnp.asarray(t_mask)))
+
+        if self._zero_special:
+            from torchmetrics_tpu.functional.text.bert import _process_special_tokens_mask
+
+            p_mask = _process_special_tokens_mask(p_mask)
+            t_mask = _process_special_tokens_mask(t_mask)
 
         pw = tw = None
         if self.idf:
