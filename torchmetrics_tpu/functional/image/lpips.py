@@ -72,24 +72,29 @@ def _default_net(net_type: str = "squeeze") -> Callable:
     downloaded in this zero-egress image; random-init otherwise (the
     architecture and conversion path are still the real, parity-tested ones).
     """
-    if net_type not in _DEFAULT_NETS:
-        if net_type in ("vgg", "alex"):
-            import os
+    if net_type in ("vgg", "alex"):
+        import os
 
+        # cache key includes the weights path so a later env-var change is
+        # picked up instead of serving a stale random-init backbone
+        path = os.environ.get(f"TORCHMETRICS_TPU_LPIPS_WEIGHTS_{net_type.upper()}")
+        key = (net_type, path)
+        if key not in _DEFAULT_NETS:
             from torchmetrics_tpu.image.backbones.lpips_nets import LPIPSBackbone
 
-            path = os.environ.get(f"TORCHMETRICS_TPU_LPIPS_WEIGHTS_{net_type.upper()}")
             if path:
                 import torch as _torch
 
-                _DEFAULT_NETS[net_type] = LPIPSBackbone.from_torch_state_dict(
+                _DEFAULT_NETS[key] = LPIPSBackbone.from_torch_state_dict(
                     net_type, _torch.load(path, map_location="cpu")
                 )
             else:
-                _DEFAULT_NETS[net_type] = LPIPSBackbone(net=net_type)
-        else:
-            _DEFAULT_NETS[net_type] = DeterministicLPIPSNet()
-    return _DEFAULT_NETS[net_type]
+                _DEFAULT_NETS[key] = LPIPSBackbone(net=net_type)
+        return _DEFAULT_NETS[key]
+    key = (net_type, None)
+    if key not in _DEFAULT_NETS:
+        _DEFAULT_NETS[key] = DeterministicLPIPSNet()
+    return _DEFAULT_NETS[key]
 
 
 def _lpips_from_features(
@@ -144,5 +149,9 @@ def learned_perceptual_image_patch_similarity(
         img2 = 2 * img2 - 1
 
     backbone = net if net is not None else _default_net(net_type)
+    if linear_weights is None:
+        # a backbone carrying learned calibration vectors (reference's
+        # lpips=True 1x1 `lin` convs) supplies them implicitly
+        linear_weights = getattr(backbone, "lin_weights", None)
     per_sample = _lpips_from_features(backbone(img1), backbone(img2), linear_weights)
     return per_sample.mean() if reduction == "mean" else per_sample.sum()

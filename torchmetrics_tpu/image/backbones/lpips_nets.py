@@ -157,11 +157,20 @@ class LPIPSBackbone:
         self.channels = _NETS[net][2]
         self.params = params if params is not None else net_init(net, jax.random.PRNGKey(seed))
         self.lin_weights = None if lin_weights is None else [jnp.asarray(w) for w in lin_weights]
-        self._apply = jax.jit(lambda p, x: net_apply(net, p, scaling_layer(x)))
 
     @classmethod
     def from_torch_state_dict(cls, net: str, sd: Dict[str, Any], **kwargs: Any) -> "LPIPSBackbone":
         return cls(net=net, params=load_torch_state_dict(net, sd), **kwargs)
 
     def __call__(self, x: Array) -> List[Array]:
-        return self._apply(self.params, jnp.asarray(x, jnp.float32))
+        return _scaled_net_apply(self.net, self.params, jnp.asarray(x, jnp.float32))
+
+
+def _scaled_net_apply_impl(net: str, params: Params, x: Array) -> List[Array]:
+    return net_apply(net, params, scaling_layer(x))
+
+
+# one shared jitted apply: compilations are cached across backbone instances,
+# clones, and unpickles (metrics embedding a backbone must pickle/clone,
+# reference metric.py:713-732)
+_scaled_net_apply = jax.jit(_scaled_net_apply_impl, static_argnums=0)

@@ -27,7 +27,7 @@ from torchmetrics_tpu.functional.image.generative import (
     kid_from_features,
 )
 from torchmetrics_tpu.functional.image.lpips import (
-    DeterministicLPIPSNet,
+    _default_net,
     learned_perceptual_image_patch_similarity,
 )
 from torchmetrics_tpu.utilities.data import dim_zero_cat
@@ -395,7 +395,10 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         self.net_type = net_type
         self.reduction = reduction
         self.normalize = normalize
-        self.net = net if net is not None else DeterministicLPIPSNet()
+        # Resolve the same default backbone as the functional path so the
+        # modular class and `learned_perceptual_image_patch_similarity` agree
+        # (reference image/lpip.py:40 delegates to the identical _lpips_* path).
+        self.net = net if net is not None else _default_net(net_type)
 
         self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
@@ -460,7 +463,10 @@ class PerceptualPathLength(Metric):
         self.resize = resize
         self.lower_discard = lower_discard
         self.upper_discard = upper_discard
-        self.sim_net = sim_net if sim_net is not None else DeterministicLPIPSNet()
+        # Reference PPL measures distances with a vgg-backboned LPIPS
+        # (reference image/perceptual_path_length.py:150); resolve the same
+        # default backbone as the LPIPS paths instead of a stand-in.
+        self.sim_net = sim_net if sim_net is not None else _default_net("vgg")
         self.add_state("distances", [], dist_reduce_fx="cat")
 
     @staticmethod
