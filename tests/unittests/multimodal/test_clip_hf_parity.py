@@ -1,0 +1,166 @@
+"""CLIPScore / CLIP-IQA parity vs the reference with identical HF weights.
+
+A tiny random-initialized torch CLIPModel + character-level CLIP BPE
+tokenizer + processor are saved to a temp dir; the reference loads them with
+torch (multimodal/clip_score.py:115-117), ours loads the same checkpoint
+through FlaxCLIPModel(from_pt=True).  Same weights, same processor, same
+inputs → scores must agree (VERDICT r2 "next" #2: the BERTScore hermetic
+pattern applied to the last external-model family).
+
+The text config must pin ``eos_token_id=1`` to match the tiny tokenizer —
+CLIP text pooling selects the EOS position, and the default id (49407)
+would silently pool BOS, collapsing every prompt to one embedding.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_STUBS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
+for _p in (_STUBS, "/root/reference/src"):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+transformers = pytest.importorskip("transformers")
+
+PREDS_TEXT = ["a photo of a cat", "a red car", "a good dog"]
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_dir(tmp_path_factory):
+    import torch
+    from transformers import (
+        CLIPConfig,
+        CLIPImageProcessor,
+        CLIPModel,
+        CLIPProcessor,
+        CLIPTokenizer,
+    )
+
+    d = tmp_path_factory.mktemp("tiny_clip")
+    # character-level CLIP BPE: every lowercase letter and its </w> form, no merges
+    chars = sorted("abcdefghijklmnopqrstuvwxyz")
+    vocab = {"<|startoftext|>": 0, "<|endoftext|>": 1}
+    for c in chars:
+        vocab[c] = len(vocab)
+        vocab[c + "</w>"] = len(vocab)
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "merges.txt").write_text("#version: 0.2\n")
+    tok = CLIPTokenizer(str(d / "vocab.json"), str(d / "merges.txt"), model_max_length=77)
+    ip = CLIPImageProcessor(size={"shortest_edge": 32}, crop_size={"height": 32, "width": 32})
+    CLIPProcessor(image_processor=ip, tokenizer=tok).save_pretrained(str(d))
+
+    cfg = CLIPConfig(
+        text_config=dict(
+            vocab_size=len(vocab), hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=77,
+            bos_token_id=0, eos_token_id=1,
+        ),
+        vision_config=dict(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=2, image_size=32, patch_size=8,
+        ),
+        projection_dim=16,
+    )
+    torch.manual_seed(0)
+    CLIPModel(cfg).eval().save_pretrained(str(d))
+    return str(d)
+
+
+@pytest.fixture()
+def images():
+    rng = np.random.default_rng(42)
+    return rng.integers(0, 255, (3, 3, 32, 32), dtype=np.uint8)
+
+
+def test_tiny_clip_anchors_discriminate(tiny_clip_dir):
+    """Guard against the degenerate-pooling failure mode: distinct prompts
+    must embed distinctly, otherwise every comparison below is vacuous."""
+    from torchmetrics_tpu.multimodal.backbones.clip import load_clip_encoders
+
+    _, enc_t = load_clip_encoders(tiny_clip_dir)
+    feats = np.asarray(enc_t(["Good photo.", "Bad photo."]))
+    assert np.linalg.norm(feats[0] - feats[1]) > 0.1
+
+
+def test_clip_score_reference_parity(tiny_clip_dir, images):
+    import torch
+    from torchmetrics.multimodal import CLIPScore as RefCLIPScore
+
+    import jax.numpy as jnp
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    ref = RefCLIPScore(model_name_or_path=tiny_clip_dir)
+    ours = CLIPScore(model_name_or_path=tiny_clip_dir)
+    # batch-by-batch accumulation on both sides
+    ref.update([torch.tensor(i) for i in images[:2]], PREDS_TEXT[:2])
+    ref.update([torch.tensor(images[2])], PREDS_TEXT[2:])
+    ours.update([jnp.asarray(i) for i in images[:2]], PREDS_TEXT[:2])
+    ours.update([jnp.asarray(images[2])], PREDS_TEXT[2:])
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-3)
+
+
+def test_clip_score_functional_parity(tiny_clip_dir, images):
+    import torch
+    from torchmetrics.functional.multimodal import clip_score as ref_clip_score
+
+    import jax.numpy as jnp
+    from torchmetrics_tpu.functional.multimodal import clip_score
+
+    ref_val = ref_clip_score(
+        [torch.tensor(i) for i in images], PREDS_TEXT, model_name_or_path=tiny_clip_dir
+    )
+    our_val = clip_score([jnp.asarray(i) for i in images], PREDS_TEXT, model_name_or_path=tiny_clip_dir)
+    np.testing.assert_allclose(float(our_val), float(ref_val), atol=1e-3)
+
+
+@pytest.mark.parametrize("prompts", [("quality",), ("quality", "brightness"), (("Super photo.", "Terrible photo."),)])
+def test_clip_iqa_reference_parity(tiny_clip_dir, prompts):
+    import torch
+    from torchmetrics.functional.multimodal import (
+        clip_image_quality_assessment as ref_iqa,
+    )
+
+    import jax.numpy as jnp
+    from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment
+
+    rng = np.random.default_rng(7)
+    imgs = rng.integers(0, 255, (4, 3, 32, 32)).astype(np.float32)
+    ref_val = ref_iqa(torch.tensor(imgs), model_name_or_path=tiny_clip_dir, data_range=255.0, prompts=prompts)
+    our_val = clip_image_quality_assessment(
+        jnp.asarray(imgs), model_name_or_path=tiny_clip_dir, data_range=255.0, prompts=prompts
+    )
+    if isinstance(ref_val, dict):
+        assert set(our_val) == set(ref_val)
+        for k in ref_val:
+            np.testing.assert_allclose(np.asarray(our_val[k]), ref_val[k].numpy(), atol=1e-3)
+    else:
+        np.testing.assert_allclose(np.asarray(our_val), ref_val.numpy(), atol=1e-3)
+
+
+def test_clip_iqa_modular_accumulation_parity(tiny_clip_dir):
+    import torch
+    from torchmetrics.functional.multimodal import (
+        clip_image_quality_assessment as ref_iqa,
+    )
+
+    import jax.numpy as jnp
+    from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 255, (4, 3, 32, 32)).astype(np.float32)
+    ref_val = ref_iqa(
+        torch.tensor(imgs), model_name_or_path=tiny_clip_dir, data_range=255.0,
+        prompts=("quality", "natural"),
+    )
+    m = CLIPImageQualityAssessment(
+        model_name_or_path=tiny_clip_dir, data_range=255.0, prompts=("quality", "natural")
+    )
+    m.update(jnp.asarray(imgs[:2]))
+    m.update(jnp.asarray(imgs[2:]))
+    res = m.compute()
+    for k in ref_val:
+        np.testing.assert_allclose(np.asarray(res[k]), ref_val[k].numpy(), atol=1e-3)

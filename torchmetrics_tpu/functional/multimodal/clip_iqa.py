@@ -13,10 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
-from torchmetrics_tpu.functional.multimodal.clip_score import (
-    DeterministicImageEncoder,
-    DeterministicTextEncoder,
-)
+from torchmetrics_tpu.functional.multimodal.clip_score import _resolve_clip_encoders
 
 _PROMPTS: Dict[str, Tuple[str, str]] = {
     "quality": ("Good photo.", "Bad photo."),
@@ -94,8 +91,7 @@ def clip_image_quality_assessment(
     if not (isinstance(data_range, (int, float)) and data_range > 0):
         raise ValueError("Argument `data_range` should be a positive number.")
     prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
-    image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
-    text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+    image_encoder, text_encoder = _resolve_clip_encoders(model_name_or_path, image_encoder, text_encoder)
 
     images = jnp.asarray(images, jnp.float32) / float(data_range)
     if images.ndim != 4 or images.shape[1] != 3:

@@ -74,6 +74,63 @@ class DeterministicTextEncoder:
         return emb.sum(axis=1) / denom
 
 
+def _resolve_clip_encoders(
+    model_name_or_path: str,
+    image_encoder: Optional[Callable] = None,
+    text_encoder: Optional[Callable] = None,
+) -> Tuple[Callable, Callable]:
+    """Resolve the CLIP encoder pair like the reference resolves its model.
+
+    Explicit encoders win.  A local checkpoint directory (or warm HF cache)
+    loads the real FlaxCLIPModel + processor — the reference's
+    ``_get_clip_model_and_processor`` (functional/multimodal/clip_score.py:94).
+    Only when no checkpoint is reachable (zero-egress image, hub id given)
+    do the deterministic stand-ins engage, with a loud warning that the
+    numbers are not CLIP.
+    """
+    if image_encoder is not None and text_encoder is not None:
+        return image_encoder, text_encoder
+    default_img, default_txt = _default_clip_pair(model_name_or_path)
+    return (
+        image_encoder if image_encoder is not None else default_img,
+        text_encoder if text_encoder is not None else default_txt,
+    )
+
+
+_RESOLVED_PAIRS: dict = {}
+
+
+def _default_clip_pair(model_name_or_path: str) -> Tuple[Callable, Callable]:
+    if model_name_or_path in _RESOLVED_PAIRS:
+        return _RESOLVED_PAIRS[model_name_or_path]
+    import os
+
+    from torchmetrics_tpu.multimodal.backbones.clip import load_clip_encoders
+
+    if os.path.isdir(model_name_or_path):
+        # user pointed at a real checkpoint: load it or fail loudly
+        pair = load_clip_encoders(model_name_or_path)
+    else:
+        try:
+            pair = load_clip_encoders(model_name_or_path)
+        except (OSError, EnvironmentError, ValueError):
+            # checkpoint genuinely not reachable; any other exception (version
+            # incompatibility, corrupt cache) propagates instead of silently
+            # degrading to stand-ins
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"CLIP checkpoint {model_name_or_path!r} is not available locally (no download is "
+                "possible in this environment). Falling back to deterministic stand-in encoders — "
+                "scores will NOT match real CLIP. Pass a local checkpoint directory as "
+                "`model_name_or_path`, or explicit `image_encoder`/`text_encoder`, for real scores.",
+                UserWarning,
+            )
+            pair = (DeterministicImageEncoder(), DeterministicTextEncoder())
+    _RESOLVED_PAIRS[model_name_or_path] = pair
+    return pair
+
+
 def _clip_score_update(
     images: Union[Array, List[Array]],
     text: Union[str, List[str]],
@@ -113,7 +170,6 @@ def clip_score(
     text_encoder: Optional[Callable] = None,
 ) -> Array:
     """CLIPScore = max(100·cos, 0) averaged (reference clip_score.py:103-180)."""
-    image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
-    text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+    image_encoder, text_encoder = _resolve_clip_encoders(model_name_or_path, image_encoder, text_encoder)
     score, _ = _clip_score_update(images, text, image_encoder, text_encoder)
     return jnp.maximum(score.mean(), 0.0)

@@ -516,7 +516,9 @@ class PerceptualPathLength(Metric):
             if self.resize is not None:
                 img_a = jax.image.resize(img_a, (*img_a.shape[:2], self.resize, self.resize), "bilinear")
                 img_b = jax.image.resize(img_b, (*img_b.shape[:2], self.resize, self.resize), "bilinear")
-            d = _lpips_from_features(self.sim_net(img_a), self.sim_net(img_b)) / self.epsilon**2
+            d = _lpips_from_features(
+                self.sim_net(img_a), self.sim_net(img_b), getattr(self.sim_net, "lin_weights", None)
+            ) / self.epsilon**2
             distances.append(d)
             done += n
         return {"distances": state["distances"] + (jnp.concatenate(distances),)}

@@ -1,0 +1,7 @@
+from torchmetrics_tpu.multimodal.backbones.clip import (
+    CLIPImageEncoder,
+    CLIPTextEncoder,
+    load_clip_encoders,
+)
+
+__all__ = ["CLIPImageEncoder", "CLIPTextEncoder", "load_clip_encoders"]

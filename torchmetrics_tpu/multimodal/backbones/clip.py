@@ -1,0 +1,121 @@
+"""Real CLIP encoders for CLIPScore / CLIP-IQA via HF Flax.
+
+The reference embeds an actual ``transformers.CLIPModel`` + ``CLIPProcessor``
+in both metrics (reference multimodal/clip_score.py:115-117,
+functional/multimodal/clip_score.py:44-91, clip_iqa.py:145-200).  Here the
+same checkpoint loads through ``FlaxCLIPModel`` (``from_pt=True`` converts a
+torch checkpoint), the processor runs host-side exactly as the reference
+feeds it (lists of CHW arrays / caption strings), and the projection
+features run as jitted JAX.  Nothing downloads in this zero-egress image —
+a local checkpoint directory (or a warm HF cache) is required, which is the
+same hermetic pattern proven for BERTScore in
+tests/unittests/text/test_bert_hf_parity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+_CLIP_CACHE: dict = {}
+
+
+def _load_flax_clip(model_name_or_path: str) -> Tuple[Any, Any]:
+    """(FlaxCLIPModel, CLIPProcessor) from a local dir or warm HF cache.
+
+    Local-only by default so an unreachable hub id fails fast instead of
+    spending ~50s in huggingface-hub's retry loop; set
+    ``TORCHMETRICS_TPU_ALLOW_DOWNLOAD=1`` to permit network fetches in
+    environments that have egress.
+    """
+    import os
+
+    from transformers import CLIPProcessor, FlaxCLIPModel
+
+    kwargs: dict = {}
+    if not os.environ.get("TORCHMETRICS_TPU_ALLOW_DOWNLOAD"):
+        kwargs["local_files_only"] = True
+    try:
+        model = FlaxCLIPModel.from_pretrained(model_name_or_path, **kwargs)
+    except (OSError, EnvironmentError, ValueError):
+        # torch-format checkpoint: convert on load (same path as BERTScore's
+        # load_hf_embedder, functional/text/bert.py:104-110)
+        model = FlaxCLIPModel.from_pretrained(model_name_or_path, from_pt=True, **kwargs)
+    processor = CLIPProcessor.from_pretrained(model_name_or_path, **kwargs)
+    return model, processor
+
+
+class CLIPImageEncoder:
+    """(B, 3, H, W) array → (B, D) CLIP image-projection features.
+
+    Mirrors the reference update: each image goes through the CLIPProcessor
+    host-side (resize / rescale / normalize — reference
+    functional/multimodal/clip_score.py:68), then a jitted
+    ``get_image_features`` (the visual transformer + projection) runs on
+    device.
+    """
+
+    def __init__(self, model: Any, processor: Any) -> None:
+        self.model = model
+        self.processor = processor
+
+    def _features(self, pixel_values: Array) -> Array:
+        return self.model.get_image_features(pixel_values)
+
+    def __call__(self, images: Array) -> Array:
+        imgs = [np.asarray(i) for i in np.asarray(jax.device_get(images))]
+        processed = self.processor(images=imgs, return_tensors="np", padding=True)
+        return jnp.asarray(self._features(jnp.asarray(processed["pixel_values"])))
+
+
+class CLIPTextEncoder:
+    """list[str] → (B, D) CLIP text-projection features.
+
+    Tokenizes host-side with the checkpoint's tokenizer, truncates to the
+    text tower's ``max_position_embeddings`` with the reference's warning
+    (reference functional/multimodal/clip_score.py:73-84), and runs
+    ``get_text_features`` on device.
+    """
+
+    def __init__(self, model: Any, processor: Any) -> None:
+        self.model = model
+        self.processor = processor
+
+    def __call__(self, text: Sequence[str]) -> Array:
+        processed = self.processor(text=list(text), return_tensors="np", padding=True)
+        input_ids = processed["input_ids"]
+        attention_mask = processed["attention_mask"]
+        max_pos = self.model.config.text_config.max_position_embeddings
+        if attention_mask.shape[-1] > max_pos:
+            rank_zero_warn(
+                f"Encountered caption longer than max_position_embeddings={max_pos}. "
+                "Will truncate captions to this length. If longer captions are needed, "
+                "initialize argument `model_name_or_path` with a model that supports longer sequences.",
+                UserWarning,
+            )
+            input_ids = input_ids[..., :max_pos]
+            attention_mask = attention_mask[..., :max_pos]
+        feats = self.model.get_text_features(jnp.asarray(input_ids), jnp.asarray(attention_mask))
+        return jnp.asarray(feats)
+
+
+def load_clip_encoders(model_name_or_path: str) -> Tuple[Callable, Callable]:
+    """(image_encoder, text_encoder) callables backed by a real CLIP checkpoint.
+
+    Cached per path so CLIPScore + CLIP-IQA constructed from the same
+    checkpoint share one model (the reference gets this via FeatureShare /
+    NetworkCache, wrappers/feature_share.py:26-42).
+    """
+    if model_name_or_path not in _CLIP_CACHE:
+        model, processor = _load_flax_clip(model_name_or_path)
+        _CLIP_CACHE[model_name_or_path] = (
+            CLIPImageEncoder(model, processor),
+            CLIPTextEncoder(model, processor),
+        )
+    return _CLIP_CACHE[model_name_or_path]

@@ -12,10 +12,7 @@ from torchmetrics_tpu.functional.multimodal.clip_iqa import (
     _clip_iqa_compute,
     _clip_iqa_format_prompts,
 )
-from torchmetrics_tpu.functional.multimodal.clip_score import (
-    DeterministicImageEncoder,
-    DeterministicTextEncoder,
-)
+from torchmetrics_tpu.functional.multimodal.clip_score import _resolve_clip_encoders
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 
@@ -44,8 +41,9 @@ class CLIPImageQualityAssessment(Metric):
         self.data_range = data_range
         prompts_list, prompts_names = _clip_iqa_format_prompts(prompts)
         self.prompts_names = prompts_names
-        self.image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
-        text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+        self.image_encoder, text_encoder = _resolve_clip_encoders(
+            model_name_or_path, image_encoder, text_encoder
+        )
         anchors = jnp.asarray(text_encoder(prompts_list))
         self.anchors = anchors / jnp.maximum(jnp.linalg.norm(anchors, axis=-1, keepdims=True), 1e-12)
         self.add_state("img_features", [], dist_reduce_fx="cat")

@@ -9,9 +9,8 @@ from jax import Array
 
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.multimodal.clip_score import (
-    DeterministicImageEncoder,
-    DeterministicTextEncoder,
     _clip_score_update,
+    _resolve_clip_encoders,
 )
 
 
@@ -33,8 +32,9 @@ class CLIPScore(Metric):
     ) -> None:
         super().__init__(**kwargs)
         self.model_name_or_path = model_name_or_path
-        self.image_encoder = image_encoder if image_encoder is not None else DeterministicImageEncoder()
-        self.text_encoder = text_encoder if text_encoder is not None else DeterministicTextEncoder()
+        self.image_encoder, self.text_encoder = _resolve_clip_encoders(
+            model_name_or_path, image_encoder, text_encoder
+        )
         self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("n_samples", jnp.zeros(()), dist_reduce_fx="sum")
 
