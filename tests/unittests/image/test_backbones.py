@@ -264,14 +264,61 @@ class TorchAlexNetFeatures(nn.Module):
         return {f"features.{i}.{k}": v for i, m in self.features.items() for k, v in m.state_dict().items()}
 
 
-@pytest.mark.parametrize("net,mirror_cls", [("vgg", TorchVGG16Features), ("alex", TorchAlexNetFeatures)])
+class _TorchFire(nn.Module):
+    """torchvision squeezenet Fire mirror: squeeze-1x1 → (expand-1x1 ‖ expand-3x3)."""
+
+    def __init__(self, cin, sq, ex):
+        super().__init__()
+        self.squeeze = nn.Conv2d(cin, sq, 1)
+        self.expand1x1 = nn.Conv2d(sq, ex, 1)
+        self.expand3x3 = nn.Conv2d(sq, ex, 3, padding=1)
+
+    def forward(self, x):
+        x = F.relu(self.squeeze(x))
+        return torch.cat([F.relu(self.expand1x1(x)), F.relu(self.expand3x3(x))], 1)
+
+
+class TorchSqueezeNetFeatures(nn.Module):
+    """torchvision squeezenet1_1 `.features` mirror with the 7 LPIPS taps."""
+
+    def __init__(self):
+        super().__init__()
+        fires = {3: (64, 16, 64), 4: (128, 16, 64), 6: (128, 32, 128), 7: (256, 32, 128),
+                 9: (256, 48, 192), 10: (384, 48, 192), 11: (384, 64, 256), 12: (512, 64, 256)}
+        self.features = nn.ModuleDict({"0": nn.Conv2d(3, 64, 3, stride=2)})
+        for i, (c, s, e) in fires.items():
+            self.features[str(i)] = _TorchFire(c, s, e)
+
+    def forward(self, x):
+        taps = []
+        x = F.relu(self.features["0"](x)); taps.append(x)
+        x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+        x = self.features["3"](x); x = self.features["4"](x); taps.append(x)
+        x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+        x = self.features["6"](x); x = self.features["7"](x); taps.append(x)
+        x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+        x = self.features["9"](x); taps.append(x)
+        x = self.features["10"](x); taps.append(x)
+        x = self.features["11"](x); taps.append(x)
+        x = self.features["12"](x); taps.append(x)
+        return taps
+
+    def state_dict_torchvision(self):
+        return {f"features.{i}.{k}": v for i, m in self.features.items() for k, v in m.state_dict().items()}
+
+
+@pytest.mark.parametrize(
+    "net,mirror_cls",
+    [("vgg", TorchVGG16Features), ("alex", TorchAlexNetFeatures), ("squeeze", TorchSqueezeNetFeatures)],
+)
 def test_lpips_backbone_torch_parity(net, mirror_cls):
     from torchmetrics_tpu.image.backbones.lpips_nets import load_torch_state_dict, net_apply
 
     torch.manual_seed(0)
     with torch.no_grad():
         mirror = mirror_cls().eval()
-        x = torch.rand((2, 3, 64, 64)) * 2 - 1
+        # odd spatial size exercises ceil_mode max-pooling in the squeeze net
+        x = torch.rand((2, 3, 65, 65)) * 2 - 1
         taps_t = mirror(x)
 
     params = load_torch_state_dict(net, mirror.state_dict_torchvision())
@@ -287,7 +334,7 @@ def test_lpips_metric_with_real_backbone():
     rng = np.random.default_rng(0)
     a = jnp.asarray(rng.random((2, 3, 64, 64)).astype(np.float32) * 2 - 1)
     b = jnp.asarray(rng.random((2, 3, 64, 64)).astype(np.float32) * 2 - 1)
-    for net_type in ("vgg", "alex"):
+    for net_type in ("vgg", "alex", "squeeze"):
         m = LearnedPerceptualImagePatchSimilarity(net_type=net_type)
         m.update(a, b)
         same = LearnedPerceptualImagePatchSimilarity(net_type=net_type)

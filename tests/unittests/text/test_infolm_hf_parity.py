@@ -1,0 +1,99 @@
+"""InfoLM parity vs the reference with identical HF masked-LM weights.
+
+A tiny random-initialized torch BertForMaskedLM + WordPiece tokenizer are
+saved to a temp dir; the reference loads them with AutoModelForMaskedLM,
+ours through FlaxAutoModelForMaskedLM(from_pt=True).  Same weights, same
+tokenizer, same per-position masking pipeline → scores must agree
+(VERDICT r2 missing #4: InfoLM silently ignored `model_name_or_path`).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+_STUBS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
+for _p in (_STUBS, "/root/reference/src"):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+transformers = pytest.importorskip("transformers")
+
+PREDS = ["hello world this is a test", "the cat is on the mat"]
+TARGET = ["hello world it is a test", "there is a cat on the mat"]
+
+VOCAB = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + sorted({w for s in PREDS + TARGET for w in s.split()})
+    + ["extra", "tokens"]
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_mlm_dir(tmp_path_factory):
+    import torch
+    from transformers import BertConfig, BertForMaskedLM, BertTokenizer
+
+    d = tmp_path_factory.mktemp("tiny_mlm")
+    (d / "vocab.txt").write_text("\n".join(VOCAB))
+    BertTokenizer(str(d / "vocab.txt")).save_pretrained(str(d))
+    cfg = BertConfig(
+        vocab_size=len(VOCAB), hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64, max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    BertForMaskedLM(cfg).eval().save_pretrained(str(d))
+    return str(d)
+
+
+@pytest.mark.parametrize("measure", ["kl_divergence", "l2_distance", "fisher_rao_distance"])
+@pytest.mark.parametrize("idf", [False, True])
+def test_infolm_functional_reference_parity(tiny_mlm_dir, measure, idf):
+    from torchmetrics.functional.text.infolm import infolm as ref_infolm
+
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    ref_val = ref_infolm(
+        PREDS, TARGET, model_name_or_path=tiny_mlm_dir, idf=idf,
+        information_measure=measure, max_length=16, verbose=False,
+    )
+    our_val = infolm(
+        PREDS, TARGET, model_name_or_path=tiny_mlm_dir, idf=idf,
+        information_measure=measure, max_length=16,
+    )
+    np.testing.assert_allclose(float(our_val), float(ref_val), atol=1e-3)
+
+
+def test_infolm_sentence_level_parity(tiny_mlm_dir):
+    from torchmetrics.functional.text.infolm import infolm as ref_infolm
+
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    ref_score, ref_per = ref_infolm(
+        PREDS, TARGET, model_name_or_path=tiny_mlm_dir, idf=False,
+        information_measure="kl_divergence", max_length=16,
+        return_sentence_level_score=True, verbose=False,
+    )
+    our_score, our_per = infolm(
+        PREDS, TARGET, model_name_or_path=tiny_mlm_dir, idf=False,
+        information_measure="kl_divergence", max_length=16,
+        return_sentence_level_score=True,
+    )
+    np.testing.assert_allclose(np.asarray(our_per), ref_per.numpy(), atol=1e-3)
+    np.testing.assert_allclose(float(our_score), float(ref_score), atol=1e-3)
+
+
+def test_infolm_modular_uses_real_model(tiny_mlm_dir):
+    from torchmetrics_tpu.text import InfoLM
+
+    m = InfoLM(model_name_or_path=tiny_mlm_dir, idf=False, max_length=16)
+    m.update(PREDS[:1], TARGET[:1])
+    m.update(PREDS[1:], TARGET[1:])
+    acc = float(m.compute())
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    # per-sentence scores are corpus-independent with idf=False → accumulated
+    # mean equals the one-shot corpus score
+    one_shot = float(infolm(PREDS, TARGET, model_name_or_path=tiny_mlm_dir, idf=False, max_length=16))
+    np.testing.assert_allclose(acc, one_shot, atol=1e-4)

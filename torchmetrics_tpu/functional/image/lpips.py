@@ -2,11 +2,13 @@
 
 Learned Perceptual Image Patch Similarity: unit-normalize each layer's
 features, per-channel weighted squared difference, spatial average, sum over
-layers.  The backbone+calibration weights are pluggable (the reference loads
-pretrained AlexNet/VGG/SqueezeNet plus .pth linear weights,
-lpips.py:lpips_models — not fetchable hermetically); the default here is a
-deterministic seeded conv pyramid so the metric is runnable and testable
-out of the box.
+layers.  Every ``net_type`` ('alex'/'vgg'/'squeeze') resolves a real JAX
+backbone port (image/backbones/lpips_nets.py); torchvision weights load from
+``TORCHMETRICS_TPU_LPIPS_WEIGHTS_*`` env vars when available (zero-egress
+image), random-init otherwise — same graph, conversion parity-tested against
+a torch mirror.  A custom backbone callable and explicit calibration
+``linear_weights`` can be passed; ``DeterministicLPIPSNet`` remains only as
+an explicit opt-in stand-in.
 """
 
 from __future__ import annotations
@@ -63,37 +65,32 @@ _DEFAULT_NETS: dict = {}
 
 
 def _default_net(net_type: str = "squeeze") -> Callable:
-    """Backbone for ``net_type``: real VGG16/AlexNet pyramids (JAX ports,
-    image/backbones/lpips_nets.py) for 'vgg'/'alex'; the deterministic conv
-    pyramid for 'squeeze' (no SqueezeNet port yet).
+    """Backbone for ``net_type``: real VGG16/AlexNet/SqueezeNet1.1 pyramids
+    (JAX ports, image/backbones/lpips_nets.py).
 
     Torch weights load from ``TORCHMETRICS_TPU_LPIPS_WEIGHTS_VGG`` /
-    ``..._ALEX`` (torchvision ``state_dict`` path) when set — nothing is
-    downloaded in this zero-egress image; random-init otherwise (the
-    architecture and conversion path are still the real, parity-tested ones).
+    ``..._ALEX`` / ``..._SQUEEZE`` (torchvision ``state_dict`` path) when
+    set — nothing is downloaded in this zero-egress image; random-init
+    otherwise (the architecture and conversion path are still the real,
+    parity-tested ones).
     """
-    if net_type in ("vgg", "alex"):
-        import os
+    import os
 
-        # cache key includes the weights path so a later env-var change is
-        # picked up instead of serving a stale random-init backbone
-        path = os.environ.get(f"TORCHMETRICS_TPU_LPIPS_WEIGHTS_{net_type.upper()}")
-        key = (net_type, path)
-        if key not in _DEFAULT_NETS:
-            from torchmetrics_tpu.image.backbones.lpips_nets import LPIPSBackbone
-
-            if path:
-                import torch as _torch
-
-                _DEFAULT_NETS[key] = LPIPSBackbone.from_torch_state_dict(
-                    net_type, _torch.load(path, map_location="cpu")
-                )
-            else:
-                _DEFAULT_NETS[key] = LPIPSBackbone(net=net_type)
-        return _DEFAULT_NETS[key]
-    key = (net_type, None)
+    # cache key includes the weights path so a later env-var change is
+    # picked up instead of serving a stale random-init backbone
+    path = os.environ.get(f"TORCHMETRICS_TPU_LPIPS_WEIGHTS_{net_type.upper()}")
+    key = (net_type, path)
     if key not in _DEFAULT_NETS:
-        _DEFAULT_NETS[key] = DeterministicLPIPSNet()
+        from torchmetrics_tpu.image.backbones.lpips_nets import LPIPSBackbone
+
+        if path:
+            import torch as _torch
+
+            _DEFAULT_NETS[key] = LPIPSBackbone.from_torch_state_dict(
+                net_type, _torch.load(path, map_location="cpu")
+            )
+        else:
+            _DEFAULT_NETS[key] = LPIPSBackbone(net=net_type)
     return _DEFAULT_NETS[key]
 
 

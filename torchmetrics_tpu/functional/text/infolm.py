@@ -121,6 +121,109 @@ def _hash_lm(input_ids: Array, attention_mask: Array, vocab_size: int = 512) -> 
     return jax.nn.softmax(emb * 8.0, axis=-1)
 
 
+_HF_MLMS: dict = {}
+_HF_FAILED: set = set()
+
+
+def _load_hf_mlm(model_name_or_path: str):
+    """Memoized (tokenizer, FlaxAutoModelForMaskedLM, jitted masked-position fn).
+
+    Local-only by default (set ``TORCHMETRICS_TPU_ALLOW_DOWNLOAD=1`` for
+    network fetches) — the same hermetic policy as the CLIP loader
+    (multimodal/backbones/clip.py).
+    """
+    if model_name_or_path not in _HF_MLMS:
+        import os
+
+        from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
+
+        kwargs: dict = {}
+        if not os.environ.get("TORCHMETRICS_TPU_ALLOW_DOWNLOAD"):
+            kwargs["local_files_only"] = True
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, **kwargs)
+        try:
+            model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path, **kwargs)
+        except (OSError, EnvironmentError, ValueError):
+            model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path, from_pt=True, **kwargs)
+
+        @jax.jit
+        def masked_position_probs(input_ids: Array, attention_mask: Array, pos: Array, mask_id: Array,
+                                  temperature: Array) -> Array:
+            masked = input_ids.at[:, pos].set(mask_id)
+            logits = model(masked, attention_mask).logits
+            return jax.nn.softmax(logits[:, pos, :] / temperature, axis=-1)
+
+        _HF_MLMS[model_name_or_path] = (tokenizer, model, masked_position_probs)
+    return _HF_MLMS[model_name_or_path]
+
+
+def _corpus_tokens_idf(input_ids: np.ndarray) -> Tuple[Dict[int, float], float]:
+    """Sentence-level document frequencies → idf map, reference formula
+    ``log((N+1)/(occurrences+1))`` with default ``log(N+1)``
+    (reference helper_embedding_metric.py:240-259)."""
+    import math
+    from collections import Counter
+
+    n = len(input_ids)
+    counter: Counter = Counter()
+    for row in input_ids:
+        counter.update(set(row.tolist()))
+    idf = {tok: math.log((n + 1) / (occ + 1)) for tok, occ in counter.items()}
+    return idf, math.log(n + 1)
+
+
+def _hf_data_distribution(
+    model_name_or_path: str,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    temperature: float,
+    idf: bool,
+    batch_size: int = 64,
+) -> Array:
+    """Per-sentence discrete distributions via per-position masking.
+
+    Mirrors the reference `_get_batch_distribution`
+    (functional/text/infolm.py:367-423): every position is masked in turn,
+    the MLM distribution at that position is temperature-softmaxed, weighted
+    by the (own-corpus) idf of the original token, special-token positions
+    (pad/sep/cls) are zeroed, and positions are averaged.  The corpus is
+    processed in ``batch_size`` chunks (reference default 64) and each chunk
+    reduces over positions immediately, so peak memory is
+    (batch, vocab) — never (corpus, seq, vocab).
+    """
+    tokenizer, _, masked_position_probs = _load_hf_mlm(model_name_or_path)
+    special = [tokenizer.pad_token_id, tokenizer.sep_token_id, tokenizer.cls_token_id]
+    token_mask = ~np.isin(input_ids, [t for t in special if t is not None])
+
+    weights = token_mask.astype(np.float32)
+    idf_w = None
+    if idf:
+        # idf is computed over THIS corpus (reference computes it per
+        # dataloader, helper_embedding_metric.py:299-300)
+        idf_map, default = _corpus_tokens_idf(input_ids)
+        idf_w = np.vectorize(lambda t: idf_map.get(int(t), default))(input_ids).astype(np.float32)
+        weights = weights * idf_w
+
+    seq_len = input_ids.shape[1]
+    mask_id = jnp.asarray(tokenizer.mask_token_id)
+    temp = jnp.asarray(temperature, jnp.float32)
+    chunks = []
+    for lo in range(0, len(input_ids), batch_size):
+        hi = lo + batch_size
+        ids_c = jnp.asarray(input_ids[lo:hi])
+        mask_c = jnp.asarray(attention_mask[lo:hi])
+        tm_c = jnp.asarray(token_mask[lo:hi].astype(np.float32))
+        acc = None
+        for s in range(seq_len):
+            probs = masked_position_probs(ids_c, mask_c, jnp.asarray(s), mask_id, temp)
+            if idf_w is not None:
+                probs = probs * jnp.asarray(idf_w[lo:hi, s])[:, None]
+            probs = probs * tm_c[:, s][:, None]
+            acc = probs if acc is None else acc + probs
+        chunks.append(acc / jnp.asarray(weights[lo:hi].sum(axis=1))[:, None])
+    return jnp.concatenate(chunks, axis=0)
+
+
 def _sentence_distribution(
     logits_or_probs: Array, attention_mask: Array, idf_weights: Optional[Array] = None
 ) -> Array:
@@ -161,6 +264,51 @@ def infolm(
         raise ValueError("Number of predicted and reference sententes must be the same!")
 
     measure = _InformationMeasure(information_measure, alpha, beta)
+
+    if model is None and user_tokenizer is None:
+        # resolve the real HF masked LM like the reference
+        # (_load_tokenizer_and_model, infolm.py:660); fall back to the hash
+        # LM only when no checkpoint is reachable, loudly
+        import os
+
+        resolved = None
+        if os.path.isdir(model_name_or_path):
+            resolved = _load_hf_mlm(model_name_or_path)  # fail loudly on a bad explicit path
+        elif model_name_or_path not in _HF_FAILED:
+            try:
+                resolved = _load_hf_mlm(model_name_or_path)
+            except (OSError, EnvironmentError, ValueError, ImportError):
+                _HF_FAILED.add(model_name_or_path)
+                from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"InfoLM checkpoint {model_name_or_path!r} is not available locally (no download "
+                    "is possible in this environment). Falling back to the deterministic hash LM — "
+                    "scores will NOT match the reference. Pass a local checkpoint directory, or an "
+                    "explicit `model` callable, for real scores.",
+                    UserWarning,
+                )
+        if resolved is not None:
+            hf_tokenizer, hf_model, _ = resolved
+            eff_max_length = max_length or hf_model.config.max_length
+            enc_p = hf_tokenizer(
+                preds_l, padding="max_length", max_length=eff_max_length, truncation=True, return_tensors="np"
+            )
+            enc_t = hf_tokenizer(
+                target_l, padding="max_length", max_length=eff_max_length, truncation=True, return_tensors="np"
+            )
+            p_dist = _hf_data_distribution(
+                model_name_or_path, enc_p["input_ids"], enc_p["attention_mask"], temperature, idf, batch_size
+            )
+            t_dist = _hf_data_distribution(
+                model_name_or_path, enc_t["input_ids"], enc_t["attention_mask"], temperature, idf, batch_size
+            )
+            p_dist = jnp.maximum(p_dist, 1e-12)
+            t_dist = jnp.maximum(t_dist, 1e-12)
+            per_sentence = measure(p_dist, t_dist)
+            score = per_sentence.mean()
+            return (score, per_sentence) if return_sentence_level_score else score
+
     tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length or 128)
     lm = model or _hash_lm
 

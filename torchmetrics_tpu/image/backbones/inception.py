@@ -293,15 +293,39 @@ def _mixed_e(x: Array, params: Params, name: str, pool: str) -> Array:
     return jnp.concatenate([b1, b3, bd, bp], axis=1)
 
 
-def inception_apply(params: Params, x: Array) -> Dict[str, Array]:
-    """Forward (B, 3, 299, 299) in [-1, 1] → {"pool": (B, 2048), "logits": (B, 1000)}."""
+def inception_apply(
+    params: Params, x: Array, features: Tuple[str, ...] = ("pool", "logits")
+) -> Dict[str, Array]:
+    """Forward (B, 3, 299, 299) in [-1, 1] → feature dict with keys ``features``.
+
+    Available taps: ``"64"``/``"192"``/``"768"`` — spatially avg-pooled block
+    taps at the first max-pool (64 ch), second max-pool (192 ch), and
+    Mixed_6e (768 ch), matching the torch-fidelity tap points the reference's
+    ``feature`` int selects (reference image/fid.py:320) — plus ``"pool"``
+    (B, 2048), ``"logits"`` (B, 1000), and ``"logits_unbiased"`` (fc without
+    bias, the reference's IS default, fid.py:137-141).  The forward stops as
+    soon as every requested tap is collected, so FID(feature=64) does not pay
+    for the Mixed blocks.
+    """
+    want = set(features)
+    out: Dict[str, Array] = {}
+
+    def done() -> bool:
+        return want.issubset(out)
+
     x = _conv_bn_relu(x, params["Conv2d_1a_3x3"], 2, (0, 0))
     x = _conv_bn_relu(x, params["Conv2d_2a_3x3"], 1, (0, 0))
     x = _conv_bn_relu(x, params["Conv2d_2b_3x3"], 1, (1, 1))
     x = _max_pool(x)
+    out["64"] = jnp.mean(x, axis=(2, 3))
+    if done():
+        return {k: out[k] for k in features}
     x = _conv_bn_relu(x, params["Conv2d_3b_1x1"], 1, (0, 0))
     x = _conv_bn_relu(x, params["Conv2d_4a_3x3"], 1, (0, 0))
     x = _max_pool(x)
+    out["192"] = jnp.mean(x, axis=(2, 3))
+    if done():
+        return {k: out[k] for k in features}
     x = _mixed_a(x, params, "Mixed_5b")
     x = _mixed_a(x, params, "Mixed_5c")
     x = _mixed_a(x, params, "Mixed_5d")
@@ -310,12 +334,17 @@ def inception_apply(params: Params, x: Array) -> Dict[str, Array]:
     x = _mixed_c(x, params, "Mixed_6c")
     x = _mixed_c(x, params, "Mixed_6d")
     x = _mixed_c(x, params, "Mixed_6e")
+    out["768"] = jnp.mean(x, axis=(2, 3))
+    if done():
+        return {k: out[k] for k in features}
     x = _mixed_d(x, params, "Mixed_7a")
     x = _mixed_e(x, params, "Mixed_7b", pool="avg")
     x = _mixed_e(x, params, "Mixed_7c", pool="max")
     pool = jnp.mean(x, axis=(2, 3))  # adaptive avg pool to 1x1
-    logits = pool @ params["fc"]["w"] + params["fc"]["b"]
-    return {"pool": pool, "logits": logits}
+    out["pool"] = pool
+    out["logits_unbiased"] = pool @ params["fc"]["w"]
+    out["logits"] = out["logits_unbiased"] + params["fc"]["b"]
+    return {k: out[k] for k in features}
 
 
 def preprocess(imgs: Array, size: int = 299) -> Array:
@@ -335,11 +364,25 @@ class InceptionFeatureExtractor:
     """
 
     num_features = NUM_FEATURES
+    _TAP_DIMS = {
+        "64": 64, "192": 192, "768": 768, "pool": NUM_FEATURES,
+        "logits": NUM_LOGITS, "logits_unbiased": NUM_LOGITS,
+    }
 
-    def __init__(self, params: Optional[Params] = None, seed: int = 0, return_logits: bool = False) -> None:
+    def __init__(
+        self,
+        params: Optional[Params] = None,
+        seed: int = 0,
+        return_logits: bool = False,
+        feature: str = "pool",
+    ) -> None:
+        if return_logits:
+            feature = "logits"
+        if feature not in self._TAP_DIMS:
+            raise ValueError(f"Unknown feature tap {feature!r}; expected one of {sorted(self._TAP_DIMS)}")
         self.params = params if params is not None else inception_init(jax.random.PRNGKey(seed))
-        self.return_logits = return_logits
-        self._apply = jax.jit(inception_apply)
+        self.feature = feature
+        self.num_features = self._TAP_DIMS[feature]
 
     @classmethod
     def from_torch_state_dict(cls, sd: Dict[str, Any], **kwargs: Any) -> "InceptionFeatureExtractor":
@@ -349,5 +392,11 @@ class InceptionFeatureExtractor:
         x = jnp.asarray(imgs, jnp.float32)
         # accept [0,1] floats or pixel-scale input
         x = jnp.where(x.max() <= 1.5, x * 255.0, x)
-        out = self._apply(self.params, preprocess(x))
-        return out["logits"] if self.return_logits else out["pool"]
+        out = _jit_inception_apply(self.params, preprocess(x), (self.feature,))
+        return out[self.feature]
+
+
+# one shared jitted apply: compile cache survives pickling/cloning of the
+# extractor and is shared across FID/KID/IS/MiFID instances; the static
+# features tuple prunes the graph to the requested tap depth
+_jit_inception_apply = jax.jit(inception_apply, static_argnums=2)

@@ -62,10 +62,34 @@ _ALEXNET_CONVS = (
 )
 ALEXNET_CHANNELS = (64, 192, 384, 256, 256)
 
+# SqueezeNet 1.1 (torchvision ``squeezenet1_1().features``): first conv is
+# stride-2 unpadded, max pools are 3x2 with ceil_mode=True, and Fire modules
+# are squeeze-1x1 → (expand-1x1 ‖ expand-3x3) concat.  LPIPS 'squeeze' taps
+# the 7 slice boundaries of the upstream lpips package.
+# (torch_features_index, cin, squeeze_ch, expand_ch) — out = 2*expand_ch
+_SQUEEZE_FIRES = {
+    3: (64, 16, 64), 4: (128, 16, 64),
+    6: (128, 32, 128), 7: (256, 32, 128),
+    9: (256, 48, 192), 10: (384, 48, 192),
+    11: (384, 64, 256), 12: (512, 64, 256),
+}
+_SQUEEZE_OPS: Tuple[Tuple, ...] = (
+    ("conv", 0, 2, 0), ("relu",), ("tap",),
+    ("maxpool_ceil", 3, 2), ("fire", 3), ("fire", 4), ("tap",),
+    ("maxpool_ceil", 3, 2), ("fire", 6), ("fire", 7), ("tap",),
+    ("maxpool_ceil", 3, 2), ("fire", 9), ("tap",),
+    ("fire", 10), ("tap",),
+    ("fire", 11), ("tap",),
+    ("fire", 12), ("tap",),
+)
+_SQUEEZE_CONVS = ((0, 3, 64, 3, 2, 0),)
+SQUEEZE_CHANNELS = (64, 128, 256, 384, 384, 512, 512)
+
 _NETS = {
     "vgg": (_VGG16_OPS, _VGG16_CONVS, VGG16_CHANNELS),
     "vgg16": (_VGG16_OPS, _VGG16_CONVS, VGG16_CHANNELS),
     "alex": (_ALEXNET_OPS, _ALEXNET_CONVS, ALEXNET_CHANNELS),
+    "squeeze": (_SQUEEZE_OPS, _SQUEEZE_CONVS, SQUEEZE_CHANNELS),
 }
 
 # LPIPS ScalingLayer constants (lpips.py ScalingLayer)
@@ -76,14 +100,24 @@ _SCALE = np.array([0.458, 0.448, 0.450], np.float32)
 def net_init(net: str, key: Array) -> Params:
     """He-init random params in the torch ``features.N`` naming (tests/smoke)."""
     _, convs, _ = _NETS[net]
-    params: Params = {}
-    keys = iter(jax.random.split(key, len(convs)))
-    for idx, cin, cout, k, _, _ in convs:
+    n_fire = len(_SQUEEZE_FIRES) if net == "squeeze" else 0
+    keys = iter(jax.random.split(key, len(convs) + 3 * n_fire))
+
+    def conv_p(cin, cout, k):
         fan_in = cin * k * k
-        params[f"features.{idx}"] = {
+        return {
             "w": jax.random.normal(next(keys), (k, k, cin, cout)) * np.sqrt(2.0 / fan_in),
             "b": jnp.zeros((cout,)),
         }
+
+    params: Params = {}
+    for idx, cin, cout, k, _, _ in convs:
+        params[f"features.{idx}"] = conv_p(cin, cout, k)
+    if net == "squeeze":
+        for idx, (cin, sq, ex) in _SQUEEZE_FIRES.items():
+            params[f"features.{idx}.squeeze"] = conv_p(cin, sq, 1)
+            params[f"features.{idx}.expand1x1"] = conv_p(sq, ex, 1)
+            params[f"features.{idx}.expand3x3"] = conv_p(sq, ex, 3)
     return params
 
 
@@ -95,14 +129,18 @@ def load_torch_state_dict(net: str, sd: Dict[str, Any]) -> Params:
             v = v.detach().cpu().numpy()
         return jnp.asarray(np.asarray(v), jnp.float32)
 
+    def conv_p(prefix):
+        w = arr(sd[f"{prefix}.weight"])  # (O, I, KH, KW)
+        return {"w": jnp.transpose(w, (2, 3, 1, 0)), "b": arr(sd[f"{prefix}.bias"])}
+
     _, convs, _ = _NETS[net]
     params: Params = {}
     for idx, *_ in convs:
-        w = arr(sd[f"features.{idx}.weight"])  # (O, I, KH, KW)
-        params[f"features.{idx}"] = {
-            "w": jnp.transpose(w, (2, 3, 1, 0)),
-            "b": arr(sd[f"features.{idx}.bias"]),
-        }
+        params[f"features.{idx}"] = conv_p(f"features.{idx}")
+    if net == "squeeze":
+        for idx in _SQUEEZE_FIRES:
+            for part in ("squeeze", "expand1x1", "expand3x3"):
+                params[f"features.{idx}.{part}"] = conv_p(f"features.{idx}.{part}")
     return params
 
 
@@ -126,6 +164,37 @@ def net_apply(net: str, params: Params, x: Array) -> List[Array]:
                 x, -jnp.inf, jax.lax.max, (1, 1, window, window), (1, 1, stride, stride),
                 [(0, 0), (0, 0), (0, 0), (0, 0)],
             )
+        elif op[0] == "maxpool_ceil":
+            # torch MaxPool2d(ceil_mode=True): pad the end with -inf so the
+            # last (partial) window still produces an output element
+            _, window, stride = op
+            pads = []
+            for n in x.shape[2:]:
+                out = -(-(n - window) // stride) + 1  # ceil
+                pads.append(max(0, (out - 1) * stride + window - n))
+            x = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 1, window, window), (1, 1, stride, stride),
+                [(0, 0), (0, 0), (0, pads[0]), (0, pads[1])],
+            )
+        elif op[0] == "fire":
+            _, idx = op
+
+            def conv1x1(inp, p):
+                return jax.lax.conv_general_dilated(
+                    inp, p["w"], (1, 1), [(0, 0), (0, 0)],
+                    dimension_numbers=("NCHW", "HWIO", "NCHW"),
+                ) + p["b"][None, :, None, None]
+
+            sq = jax.nn.relu(conv1x1(x, params[f"features.{idx}.squeeze"]))
+            e1 = jax.nn.relu(conv1x1(sq, params[f"features.{idx}.expand1x1"]))
+            p3 = params[f"features.{idx}.expand3x3"]
+            e3 = jax.nn.relu(
+                jax.lax.conv_general_dilated(
+                    sq, p3["w"], (1, 1), [(1, 1), (1, 1)],
+                    dimension_numbers=("NCHW", "HWIO", "NCHW"),
+                ) + p3["b"][None, :, None, None]
+            )
+            x = jnp.concatenate([e1, e3], axis=1)
         elif op[0] == "tap":
             taps.append(x)
     return taps

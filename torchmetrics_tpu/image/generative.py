@@ -2,12 +2,16 @@
 
 Reference: image/{fid.py:182, kid.py:70, inception.py:34, mifid.py:66,
 lpip.py:40, perceptual_path_length.py:32}.  The reference embeds a downloaded
-``NoTrainInceptionV3`` inside each metric (fid.py:44); weights cannot be
-fetched hermetically here, so every metric accepts a pluggable ``feature``
-extractor callable ((B,C,H,W) images → (B,D) features / (B,K) logits) and
-falls back to a deterministic seeded conv encoder.  Statistics, states, and
-sync semantics mirror the reference exactly (sum-reduced feature sums +
-covariance sums for FID/MiFID, cat feature lists for KID/IS).
+``NoTrainInceptionV3`` inside each metric (fid.py:44); here every default
+``feature`` choice (64/192/768/2048/logits) resolves the real JAX
+InceptionV3 port (image/backbones/inception.py) — weights load from
+``TORCHMETRICS_TPU_INCEPTION_WEIGHTS`` when available, random-init otherwise
+(same graph, conversion parity-tested).  A custom extractor callable
+((B,C,H,W) images → (B,D) features) can be passed explicitly;
+``DeterministicFeatureExtractor`` remains available as an explicit opt-in
+stand-in for hermetic smoke tests.  Statistics, states, and sync semantics
+mirror the reference exactly (sum-reduced feature sums + covariance sums for
+FID/MiFID, cat feature lists for KID/IS).
 """
 
 from __future__ import annotations
@@ -90,7 +94,7 @@ class _RealFeaturesResetMixin:
             super().reset()
 
 
-def _load_inception(return_logits: bool = False, weights_path: Optional[str] = None):
+def _load_inception(feature: str = "pool", weights_path: Optional[str] = None):
     """Real JAX InceptionV3 (pytorch-fid graph, image/backbones/inception.py).
 
     Weights: a torch/numpy state_dict at ``weights_path`` or the
@@ -112,8 +116,8 @@ def _load_inception(return_logits: bool = False, weights_path: Optional[str] = N
             import torch as _torch
 
             sd = _torch.load(weights_path, map_location="cpu")
-        return InceptionFeatureExtractor.from_torch_state_dict(sd, return_logits=return_logits)
-    return InceptionFeatureExtractor(return_logits=return_logits)
+        return InceptionFeatureExtractor.from_torch_state_dict(sd, feature=feature)
+    return InceptionFeatureExtractor(feature=feature)
 
 
 def _resolve_feature_extractor(
@@ -125,20 +129,25 @@ def _resolve_feature_extractor(
         # reference InceptionScore accepts "logits_unbiased" (inception.py:34);
         # "inception" selects the pooled 2048-d features explicitly
         if feature == "inception":
-            net = _load_inception(return_logits=False)
+            net = _load_inception("pool")
             return net, net.num_features
         if feature in ("logits", "logits_unbiased"):
             from torchmetrics_tpu.image.backbones.inception import NUM_LOGITS
 
-            return _load_inception(return_logits=True), NUM_LOGITS
+            # "logits_unbiased" omits the fc bias (reference fid.py:137-141)
+            return _load_inception(feature), NUM_LOGITS
         raise ValueError(f"Got unknown input to argument `feature`: {feature!r}")
     if isinstance(feature, int):
-        # 2048 is the canonical InceptionV3 pool dim (reference fid.py feature
-        # choices {64, 192, 768, 2048}): use the real backbone for it; the
-        # lower block dims keep the deterministic stand-in encoder.
-        if feature == 2048:
-            return _load_inception(return_logits=False), 2048
-        return DeterministicFeatureExtractor(dim=feature), feature
+        # every valid int selects a real InceptionV3 tap (64/192: max-pool
+        # blocks, 768: Mixed_6e, 2048: final pool) — same choices and error
+        # as the reference (fid.py:320-323); no stand-in is reachable here
+        valid_int_input = (64, 192, 768, 2048)
+        if feature not in valid_int_input:
+            raise ValueError(
+                f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+            )
+        tap = "pool" if feature == 2048 else str(feature)
+        return _load_inception(tap), feature
     if callable(feature):
         dim = getattr(feature, "num_features", None)
         if dim is None:
@@ -158,7 +167,7 @@ class FrechetInceptionDistance(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable, None] = 64,
+        feature: Union[int, Callable, None] = 2048,
         reset_real_features: bool = True,
         normalize: bool = False,
         **kwargs: Any,
@@ -234,7 +243,7 @@ class MemorizationInformedFrechetInceptionDistance(_RealFeaturesResetMixin, Metr
 
     def __init__(
         self,
-        feature: Union[int, Callable, None] = 64,
+        feature: Union[int, Callable, None] = 2048,
         reset_real_features: bool = True,
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
@@ -284,7 +293,7 @@ class KernelInceptionDistance(_RealFeaturesResetMixin, Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable, None] = 64,
+        feature: Union[int, Callable, None] = 2048,
         subsets: int = 100,
         subset_size: int = 1000,
         degree: int = 3,
@@ -344,7 +353,7 @@ class InceptionScore(Metric):
 
     def __init__(
         self,
-        feature: Union[int, Callable, None] = 64,
+        feature: Union[int, str, Callable, None] = "logits_unbiased",
         splits: int = 10,
         normalize: bool = False,
         **kwargs: Any,
