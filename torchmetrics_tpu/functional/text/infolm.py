@@ -272,13 +272,17 @@ def infolm(
         import os
 
         resolved = None
+        # failure key includes the download-permission env var so flipping it
+        # mid-process retries the load instead of silently staying on the
+        # hash LM (same staleness rule as the LPIPS/CLIP loaders)
+        fail_key = (model_name_or_path, os.environ.get("TORCHMETRICS_TPU_ALLOW_DOWNLOAD"))
         if os.path.isdir(model_name_or_path):
             resolved = _load_hf_mlm(model_name_or_path)  # fail loudly on a bad explicit path
-        elif model_name_or_path not in _HF_FAILED:
+        elif fail_key not in _HF_FAILED:
             try:
                 resolved = _load_hf_mlm(model_name_or_path)
             except (OSError, EnvironmentError, ValueError, ImportError):
-                _HF_FAILED.add(model_name_or_path)
+                _HF_FAILED.add(fail_key)
                 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
                 rank_zero_warn(

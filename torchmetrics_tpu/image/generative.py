@@ -121,7 +121,7 @@ def _load_inception(feature: str = "pool", weights_path: Optional[str] = None):
 
 
 def _resolve_feature_extractor(
-    feature: Union[int, str, Callable, None], default_dim: int = 64
+    feature: Union[int, str, Callable, None], default_dim: int = 2048
 ) -> Tuple[Callable, int]:
     if feature is None:
         feature = default_dim
