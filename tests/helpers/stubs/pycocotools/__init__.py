@@ -1,0 +1,3 @@
+"""Import-only pycocotools stub: satisfies the reference legacy mAP's
+availability probe and module imports for the bbox path (which never calls
+RLE mask utilities)."""

@@ -1,0 +1,18 @@
+"""RLE mask utilities are intentionally unimplemented — the bbox oracle path
+never calls them; calling means a test wandered into segm territory."""
+
+
+def area(*args, **kwargs):
+    raise NotImplementedError("pycocotools stub: RLE area not available (bbox-only oracle)")
+
+
+def iou(*args, **kwargs):
+    raise NotImplementedError("pycocotools stub: RLE iou not available (bbox-only oracle)")
+
+
+def decode(*args, **kwargs):
+    raise NotImplementedError("pycocotools stub: RLE decode not available (bbox-only oracle)")
+
+
+def encode(*args, **kwargs):
+    raise NotImplementedError("pycocotools stub: RLE encode not available (bbox-only oracle)")

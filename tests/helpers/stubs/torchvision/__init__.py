@@ -1,0 +1,7 @@
+"""Minimal torchvision stub: only the box ops the reference's pure-torch
+legacy mAP (`torchmetrics/detection/_mean_ap.py`) needs, so it can run as an
+in-image oracle without the real torchvision wheel."""
+
+from torchvision import ops  # noqa: F401
+
+__version__ = "0.15.2"

@@ -1,0 +1,45 @@
+"""torchvision.ops box utilities (exact torch re-implementations)."""
+
+import torch
+from torch import Tensor
+
+
+def box_area(boxes: Tensor) -> Tensor:
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def box_iou(boxes1: Tensor, boxes2: Tensor) -> Tensor:
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter / union
+
+
+def box_convert(boxes: Tensor, in_fmt: str, out_fmt: str) -> Tensor:
+    if in_fmt == out_fmt:
+        return boxes.clone()
+
+    # normalize to xyxy first
+    if in_fmt == "xyxy":
+        xyxy = boxes
+    elif in_fmt == "xywh":
+        x, y, w, h = boxes.unbind(-1)
+        xyxy = torch.stack([x, y, x + w, y + h], dim=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = boxes.unbind(-1)
+        xyxy = torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+    else:
+        raise ValueError(f"Unsupported in_fmt {in_fmt}")
+
+    if out_fmt == "xyxy":
+        return xyxy
+    x1, y1, x2, y2 = xyxy.unbind(-1)
+    if out_fmt == "xywh":
+        return torch.stack([x1, y1, x2 - x1, y2 - y1], dim=-1)
+    if out_fmt == "cxcywh":
+        return torch.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], dim=-1)
+    raise ValueError(f"Unsupported out_fmt {out_fmt}")

@@ -1,0 +1,103 @@
+"""Device matcher ≡ numpy oracle, including crowd and area-ignore cases.
+
+The batched jitted matcher (functional/detection/matcher.py) must reproduce
+`_evaluate_image`'s greedy semantics bit-for-bit; the full-metric test runs
+both backends end-to-end on data with crowds (which the torch-oracle suite
+cannot cover, see test_map_oracle.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.detection.mean_ap import _AREA_RANGES, _evaluate_image
+from torchmetrics_tpu.functional.detection.matcher import match_batch_padded
+
+IOU_THRS = np.round(np.arange(0.5, 1.0, 0.05), 2)
+
+
+AREA_NAMES = tuple(_AREA_RANGES)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_matcher_matches_numpy_oracle(seed):
+    """Per (item, area): the (A, T, D) device output equals the numpy greedy
+    matcher.  Quantized ious manufacture exact ties; gts are passed UNSORTED
+    to the device path (priority + original-index tie-break must reproduce
+    the oracle's ignored-last stable sort)."""
+    rng = np.random.default_rng(seed)
+    items, oracle = [], []
+    for _ in range(12):
+        nd, ng = int(rng.integers(0, 14)), int(rng.integers(0, 9))
+        ious = np.round(rng.uniform(0, 1, (nd, ng)), 1)
+        scores = rng.uniform(0, 1, nd)
+        crowd = rng.uniform(0, 1, ng) < 0.3
+        g_area = rng.uniform(10, 10_000, ng)
+        d_area = rng.uniform(10, 10_000, nd)
+        mdet = 10
+        if nd == 0 and ng == 0:
+            continue
+        per_area = [
+            _evaluate_image(ious, scores, crowd, g_area, d_area, IOU_THRS, _AREA_RANGES[a], mdet)
+            for a in AREA_NAMES
+        ]
+        oracle.append((per_area, d_area, scores, mdet))
+        d_order = np.argsort(-scores, kind="stable")[:mdet]
+        gt_ignore = np.stack([
+            crowd | (g_area < _AREA_RANGES[a][0]) | (g_area > _AREA_RANGES[a][1]) for a in AREA_NAMES
+        ])
+        items.append((ious[d_order], crowd, gt_ignore))
+
+    results = match_batch_padded(items, IOU_THRS)
+    for (per_area, d_area, scores, mdet), (matched, ig_m) in zip(oracle, results):
+        d_order = np.argsort(-scores, kind="stable")[:mdet]
+        d_area_s = d_area[d_order]
+        for ai, aname in enumerate(AREA_NAMES):
+            tp_o, ig_o, sc_o, nv_o = per_area[ai]
+            arng = _AREA_RANGES[aname]
+            out_rng = (d_area_s < arng[0]) | (d_area_s > arng[1])
+            ig_full = ig_m[ai] | (~matched[ai] & out_rng[None, :])
+            np.testing.assert_array_equal(matched[ai], tp_o, err_msg=aname)
+            np.testing.assert_array_equal(ig_full, ig_o, err_msg=aname)
+
+
+def _crowd_dataset(seed, n_images=6):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(n_images):
+        ng = int(rng.integers(1, 8))
+        xy = rng.uniform(0, 120, (ng, 2))
+        wh = rng.uniform(4, 100, (ng, 2))
+        gb = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        gl = rng.integers(0, 3, ng)
+        crowd = (rng.uniform(0, 1, ng) < 0.3).astype(np.int64)
+        keep = rng.uniform(0, 1, ng) < 0.85
+        pb = gb[keep] + rng.normal(0, 3, (int(keep.sum()), 4)).astype(np.float32)
+        ps = rng.uniform(0.1, 1, len(pb)).astype(np.float32)
+        batches.append((pb, ps, gl[keep], gb, gl, crowd))
+    return batches
+
+
+@pytest.mark.parametrize("seed", [0, 9])
+def test_full_metric_backends_agree_with_crowds(seed):
+    m_dev = MeanAveragePrecision(class_metrics=True, backend="native")
+    m_np = MeanAveragePrecision(class_metrics=True, backend="native_numpy")
+    for pb, ps, pl, gb, gl, crowd in _crowd_dataset(seed):
+        p = [{"boxes": jnp.asarray(pb), "scores": jnp.asarray(ps), "labels": jnp.asarray(pl)}]
+        t = [{"boxes": jnp.asarray(gb), "labels": jnp.asarray(gl), "iscrowd": jnp.asarray(crowd)}]
+        m_dev.update(p, t)
+        m_np.update(p, t)
+    r_dev, r_np = m_dev.compute(), m_np.compute()
+    for k in r_np:
+        np.testing.assert_allclose(
+            np.asarray(r_dev[k]), np.asarray(r_np[k]), atol=1e-6, err_msg=k
+        )
+
+
+def test_backend_validation():
+    with pytest.raises(ValueError, match="backend"):
+        MeanAveragePrecision(backend="pycocotools")

@@ -157,14 +157,15 @@ class MeanAveragePrecision(Metric):
         for it in iou_types:
             if it not in ("bbox", "segm"):
                 raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {it}")
-        if len(iou_types) > 1:
-            raise NotImplementedError("Multiple simultaneous iou_types are not yet supported; pick 'bbox' or 'segm'.")
         if not isinstance(class_metrics, bool):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         if average not in ("macro", "micro"):
             raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
 
         self.box_format = box_format
+        # reference accepts a tuple of iou types and prefixes result keys
+        # when more than one is evaluated (mean_ap.py:375,:862)
+        self.iou_types = iou_types
         self.iou_type = iou_types[0]
         self.iou_thresholds = np.asarray(iou_thresholds if iou_thresholds is not None
                                          else np.round(np.arange(0.5, 1.0, 0.05), 2))
@@ -177,11 +178,21 @@ class MeanAveragePrecision(Metric):
         self.class_metrics = class_metrics
         self.extended_summary = extended_summary
         self.average = average
+        # "native": batched jitted device matcher (functional/detection/matcher.py);
+        # "native_numpy": the per-image host loop, kept as the oracle
+        if backend not in ("native", "native_numpy"):
+            raise ValueError(f"Expected argument `backend` to be one of ('native', 'native_numpy') but got {backend}")
+        self.backend = backend
 
-        # per-image variable-length states (reference mean_ap.py:470-512)
-        for name in ("detection_boxes", "detection_scores", "detection_labels",
-                     "groundtruth_boxes", "groundtruth_labels", "groundtruth_crowds",
-                     "groundtruth_area"):
+        # per-image variable-length states (reference mean_ap.py:470-512);
+        # box and mask item states coexist when iou_types has both
+        names = ["detection_scores", "detection_labels", "groundtruth_labels",
+                 "groundtruth_crowds", "groundtruth_area"]
+        if "bbox" in iou_types:
+            names += ["detection_boxes", "groundtruth_boxes"]
+        if "segm" in iou_types:
+            names += ["detection_masks", "groundtruth_masks"]
+        for name in names:
             self.add_state(name, [], dist_reduce_fx=None)
 
     # -------------------------------------------------------------- update
@@ -190,34 +201,33 @@ class MeanAveragePrecision(Metric):
             raise ValueError("Expected argument `preds` and `target` to be a sequence of dicts")
         if len(preds) != len(target):
             raise ValueError("Expected argument `preds` and `target` to have the same length")
-        key = "masks" if self.iou_type == "segm" else "boxes"
+        item_keys = [("masks" if it == "segm" else "boxes") for it in self.iou_types]
         for p in preds:
-            for k in (key, "scores", "labels"):
+            for k in item_keys + ["scores", "labels"]:
                 if k not in p:
                     raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
         for t in target:
-            for k in (key, "labels"):
+            for k in item_keys + ["labels"]:
                 if k not in t:
                     raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
         new = {k: state[k] for k in state}
         for p, t in zip(preds, target):
-            if self.iou_type == "segm":
-                det_item = jnp.asarray(p["masks"], bool)
-                gt_item = jnp.asarray(t["masks"], bool)
-            else:
-                det_item = self._convert_boxes(p["boxes"])
-                gt_item = self._convert_boxes(t["boxes"])
-            n_gt = gt_item.shape[0]
+            if "bbox" in self.iou_types:
+                new["detection_boxes"] = new["detection_boxes"] + (self._convert_boxes(p["boxes"]),)
+                new["groundtruth_boxes"] = new["groundtruth_boxes"] + (self._convert_boxes(t["boxes"]),)
+            if "segm" in self.iou_types:
+                new["detection_masks"] = new["detection_masks"] + (jnp.asarray(p["masks"], bool),)
+                new["groundtruth_masks"] = new["groundtruth_masks"] + (jnp.asarray(t["masks"], bool),)
+            n_gt = jnp.asarray(t["labels"]).reshape(-1).shape[0]
             crowds = jnp.asarray(t.get("iscrowd", jnp.zeros(n_gt, jnp.int32))).reshape(-1)
             if "area" in t and t["area"] is not None and jnp.asarray(t["area"]).size == n_gt:
                 area = jnp.asarray(t["area"], jnp.float32).reshape(-1)
             else:
-                area = self._item_area(gt_item)
-            new["detection_boxes"] = new["detection_boxes"] + (det_item,)
+                # sentinel: per-type area is derived at compute time
+                area = jnp.full((n_gt,), -1.0, jnp.float32)
             new["detection_scores"] = new["detection_scores"] + (jnp.asarray(p["scores"], jnp.float32).reshape(-1),)
             new["detection_labels"] = new["detection_labels"] + (jnp.asarray(p["labels"]).reshape(-1),)
-            new["groundtruth_boxes"] = new["groundtruth_boxes"] + (gt_item,)
             new["groundtruth_labels"] = new["groundtruth_labels"] + (jnp.asarray(t["labels"]).reshape(-1),)
             new["groundtruth_crowds"] = new["groundtruth_crowds"] + (crowds,)
             new["groundtruth_area"] = new["groundtruth_area"] + (area,)
@@ -227,8 +237,9 @@ class MeanAveragePrecision(Metric):
         boxes = jnp.asarray(boxes, jnp.float32).reshape(-1, 4) if jnp.asarray(boxes).size else jnp.zeros((0, 4))
         return box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
 
-    def _item_area(self, item: Array) -> Array:
-        if self.iou_type == "segm":
+    @staticmethod
+    def _item_area(item: Array, iou_type: str) -> Array:
+        if iou_type == "segm":
             return item.reshape(item.shape[0], -1).sum(axis=-1).astype(jnp.float32) if item.size else jnp.zeros(0)
         if item.size == 0:
             return jnp.zeros(0)
@@ -236,10 +247,37 @@ class MeanAveragePrecision(Metric):
 
     # -------------------------------------------------------------- compute
     def _compute(self, state: State) -> Dict[str, Array]:
+        out: Dict[str, Array] = {}
+        for i_type in self.iou_types:
+            prefix = "" if len(self.iou_types) == 1 else f"{i_type}_"
+            res = self._compute_one_type(state, i_type)
+            for k, v in res.items():
+                if k == "classes":
+                    out[k] = v  # unprefixed, identical across types (reference mean_ap.py:585)
+                else:
+                    out[f"{prefix}{k}"] = v
+        return out
+
+    def _compute_one_type(self, state: State, iou_type: str) -> Dict[str, Array]:
+        det_key = "detection_masks" if iou_type == "segm" else "detection_boxes"
+        gt_key = "groundtruth_masks" if iou_type == "segm" else "groundtruth_boxes"
+        # derived gt area source: mask area whenever segm is among the
+        # evaluated types, box area otherwise — the reference derives ONE gt
+        # area this way and keeps it for every type pass, rewriting only the
+        # prediction areas per type (mean_ap.py:522-525,:910-917)
+        gt_area_src_key = "groundtruth_masks" if "segm" in self.iou_types else "groundtruth_boxes"
+        gt_area_src_type = "segm" if "segm" in self.iou_types else "bbox"
         images: List[_ImageRecord] = []
-        for i in range(len(state["detection_boxes"])):
-            det_item = np.asarray(state["detection_boxes"][i])
-            gt_item = np.asarray(state["groundtruth_boxes"][i])
+        for i in range(len(state[det_key])):
+            det_item = np.asarray(state[det_key][i])
+            gt_item = np.asarray(state[gt_key][i])
+            user_area = np.asarray(state["groundtruth_area"][i]).reshape(-1)
+            derived = np.asarray(
+                self._item_area(jnp.asarray(state[gt_area_src_key][i]), gt_area_src_type)
+            ).reshape(-1)
+            # per-annotation: a positive user area wins, anything else is
+            # derived (reference checks `area[image_id][k] > 0`, mean_ap.py:910)
+            gt_area = np.where(user_area > 0, user_area, derived) if user_area.size else derived
             rec = _ImageRecord(
                 det_boxes=det_item,
                 det_scores=np.asarray(state["detection_scores"][i]),
@@ -247,8 +285,8 @@ class MeanAveragePrecision(Metric):
                 gt_boxes=gt_item,
                 gt_labels=np.asarray(state["groundtruth_labels"][i]),
                 gt_crowd=np.asarray(state["groundtruth_crowds"][i]).astype(bool),
-                gt_area=np.asarray(state["groundtruth_area"][i]),
-                det_area=np.asarray(self._item_area(jnp.asarray(det_item))),
+                gt_area=gt_area,
+                det_area=np.asarray(self._item_area(jnp.asarray(det_item), iou_type)),
             )
             images.append(rec)
 
@@ -284,13 +322,41 @@ class MeanAveragePrecision(Metric):
                 det = r.det_boxes[d_sel]
                 gt = r.gt_boxes[g_sel]
                 crowd = r.gt_crowd[g_sel]
-                if self.iou_type == "segm":
+                if iou_type == "segm":
                     ious = _mask_iou_crowd(det, gt, crowd)
                 else:
                     ious = _box_iou_crowd(det, gt, crowd)
                 iou_cache[(ki, ii)] = (
                     ious, r.det_scores[d_sel], crowd, r.gt_area[g_sel], r.det_area[d_sel]
                 )
+
+        # det views sorted by score (stable), capped at maxDets[-1] — greedy
+        # matching of the first k dets is independent of later dets, so one
+        # match at the largest cap serves every mdet by column slicing
+        # (pycocotools matches once with maxDets[-1] and slices in accumulate)
+        det_sorted: Dict[Tuple[int, int], Tuple] = {}
+        for (ki, ii), (ious, d_scores, crowd, g_area, d_area) in iou_cache.items():
+            d_order = np.argsort(-d_scores, kind="stable")[: max_dets[-1]]
+            det_sorted[(ki, ii)] = (ious[d_order], d_scores[d_order], d_area[d_order], crowd, g_area)
+
+        match_results: Dict[Tuple[int, int], Tuple[np.ndarray, np.ndarray]] = {}
+        if self.backend == "native":
+            from torchmetrics_tpu.functional.detection.matcher import match_batch_padded
+
+            area_bounds = np.asarray([_AREA_RANGES[a] for a in area_names])  # (A, 2)
+            keys, items = [], []
+            for ki in range(K):
+                for ii in range(len(images)):
+                    ious_s, _, _, crowd, g_area = det_sorted[(ki, ii)]
+                    if ious_s.shape[0] == 0 and ious_s.shape[1] == 0:
+                        continue
+                    # (A, G) per-area gt ignore; one shared IoU matrix per item
+                    gt_ignore = crowd[None, :] | (g_area[None, :] < area_bounds[:, :1]) | (
+                        g_area[None, :] > area_bounds[:, 1:]
+                    )
+                    keys.append((ki, ii))
+                    items.append((ious_s, crowd, gt_ignore))
+            match_results = dict(zip(keys, match_batch_padded(items, iou_thrs)))
 
         for ki in range(K):
             for ai, aname in enumerate(area_names):
@@ -302,9 +368,21 @@ class MeanAveragePrecision(Metric):
                         ious, d_scores, crowd, g_area, d_area = iou_cache[(ki, ii)]
                         if ious.shape[0] == 0 and ious.shape[1] == 0:
                             continue
-                        tp, ig, sc, nv = _evaluate_image(
-                            ious, d_scores, crowd, g_area, d_area, iou_thrs, arng, mdet
-                        )
+                        if self.backend == "native":
+                            ious_s, sc_sorted, d_area_s, _, _ = det_sorted[(ki, ii)]
+                            matched, ig_m = match_results[(ki, ii)]
+                            tp = matched[ai, :, :mdet]
+                            ig = ig_m[ai, :, :mdet]
+                            d_area_m = d_area_s[:mdet]
+                            out_rng = (d_area_m < arng[0]) | (d_area_m > arng[1])
+                            ig = ig | (~tp & out_rng[None, :])
+                            sc = sc_sorted[:mdet]
+                            gt_ignore = crowd | (g_area < arng[0]) | (g_area > arng[1])
+                            nv = int((~gt_ignore).sum())
+                        else:
+                            tp, ig, sc, nv = _evaluate_image(
+                                ious, d_scores, crowd, g_area, d_area, iou_thrs, arng, mdet
+                            )
                         all_tp.append(tp)
                         all_ig.append(ig)
                         all_scores.append(sc)

@@ -1,0 +1,136 @@
+"""Greedy COCO detection↔gt matching as a batched, jitted device kernel.
+
+Reference precedent: the in-tree pure-torch evaluator's per-image matching
+loop (/root/reference/src/torchmetrics/detection/_mean_ap.py:148) and
+pycocotools ``COCOeval.evaluateImg``.  The greedy scan is sequential in
+detection-score order, so it maps to ``lax.fori_loop`` over the (padded)
+detection axis with the per-gt "already matched" mask as carry; IoU
+thresholds and batch items are independent and ``vmap`` over them.  One
+compile serves every (class, image, area) item of a padded bucket — the
+SURVEY §7-8 device-side matcher.
+
+Semantics replicated exactly from the numpy oracle (`_evaluate_image`):
+* eligibility: iou ≥ min(t, 1-1e-10) and gt unmatched-or-crowd
+* non-ignored gts take priority over ignored ones (gts are pre-sorted
+  ignored-last; priority, not order, is what matters here)
+* among equal IoUs the LAST gt index wins (pycocotools scan direction)
+* a det matching an ignored gt is itself ignored
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _match_one_threshold(
+    ious: Array,       # (D, G) padded
+    crowd: Array,      # (G,) bool
+    ignored: Array,    # (G,) bool — gt ignore flags (crowd | out-of-area)
+    valid_d: Array,    # (D,) bool
+    valid_g: Array,    # (G,) bool
+    thr: Array,        # scalar
+) -> Tuple[Array, Array]:
+    D, G = ious.shape
+    thr_eff = jnp.minimum(thr, 1.0 - 1e-10)
+    gidx = jnp.arange(G)
+
+    # lax.scan over the det axis with pure mask updates (no scatters): TPU
+    # compiles scatter-in-loop-under-vmap pathologically slowly, and scan
+    # stacks the per-det outputs so no output buffer indexing is needed
+    def step(gt_matched, xs):
+        row, vd = xs
+        elig = (row >= thr_eff) & (~gt_matched | crowd) & valid_g
+        non_ig = elig & ~ignored
+        pool = jnp.where(non_ig.any(), non_ig, elig & ignored)
+        vals = jnp.where(pool, row, -jnp.inf)
+        m = (G - 1) - jnp.argmax(vals[::-1])  # last max wins
+        has = pool.any() & vd
+        gt_matched = gt_matched | ((gidx == m) & has)
+        return gt_matched, (has, has & ignored[m])
+
+    _, (m_flags, i_flags) = jax.lax.scan(step, jnp.zeros(G, bool), (ious, valid_d))
+    return m_flags, i_flags
+
+
+# (T,) thresholds over one item
+_match_all_thresholds = jax.vmap(_match_one_threshold, in_axes=(None, None, None, None, None, 0))
+# (A, G) per-area ignore masks over one item → (A, T, D); the IoU matrix is
+# shared across areas instead of being duplicated 4x host-side
+_match_areas_thresholds = jax.vmap(_match_all_thresholds, in_axes=(None, None, 0, None, None, None))
+
+
+@jax.jit
+def match_batch(
+    ious: Array,       # (B, D, G) padded, dets sorted by -score per item
+    crowd: Array,      # (B, G) bool
+    ignored: Array,    # (B, A, G) bool — per-area gt ignore masks
+    valid_d: Array,    # (B, D) bool
+    valid_g: Array,    # (B, G) bool
+    iou_thrs: Array,   # (T,)
+) -> Tuple[Array, Array]:
+    """→ (matched (B, A, T, D), det_ignored (B, A, T, D))."""
+    return jax.vmap(_match_areas_thresholds, in_axes=(0, 0, 0, 0, 0, None))(
+        ious, crowd, ignored, valid_d, valid_g, iou_thrs
+    )
+
+
+def _bucket(n: int, minimum: int = 8) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+_CHUNK = 1024  # items per device dispatch; bounds the padded buffer size
+
+
+def match_batch_padded(items, iou_thrs) -> list:
+    """Host convenience: pad (ious (D,G), crowd (G,), ignored (A,G)) numpy
+    items to shared buckets (D, G, and item count — so compiles are reused
+    across datasets of different sizes), dispatch in chunks, return per-item
+    (matched (A, T, D_i), det_ig (A, T, D_i)) unpadded.
+
+    Tie-break note: gts need NOT be pre-sorted ignored-last here — the kernel
+    selects by non-ignored-first *priority*, and within a priority pool the
+    numpy oracle's ignored-last stable sort preserves original order, so
+    "last max by original index" is identical in both.
+    """
+    import numpy as np
+
+    if not items:
+        return []
+    D = _bucket(max(i[0].shape[0] for i in items))
+    G = _bucket(max(i[0].shape[1] for i in items))
+    A = items[0][2].shape[0]
+    thrs = jnp.asarray(iou_thrs, jnp.float32)
+    out = []
+    for lo in range(0, len(items), _CHUNK):
+        chunk = items[lo:lo + _CHUNK]
+        B = _bucket(len(chunk))
+        ious = np.zeros((B, D, G), np.float32)
+        crowd = np.zeros((B, G), bool)
+        ignored = np.zeros((B, A, G), bool)
+        valid_d = np.zeros((B, D), bool)
+        valid_g = np.zeros((B, G), bool)
+        for b, (iou, cr, ig) in enumerate(chunk):
+            d, g = iou.shape
+            ious[b, :d, :g] = iou
+            crowd[b, :g] = cr
+            ignored[b, :, :g] = ig
+            valid_d[b, :d] = True
+            valid_g[b, :g] = True
+        m, di = match_batch(
+            jnp.asarray(ious), jnp.asarray(crowd), jnp.asarray(ignored),
+            jnp.asarray(valid_d), jnp.asarray(valid_g), thrs,
+        )
+        m = np.asarray(m)
+        di = np.asarray(di)
+        out.extend(
+            (m[b, :, :, : chunk[b][0].shape[0]], di[b, :, :, : chunk[b][0].shape[0]])
+            for b in range(len(chunk))
+        )
+    return out
