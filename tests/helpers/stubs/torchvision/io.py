@@ -1,0 +1,12 @@
+"""`torchvision.io` stub: transformers' `video_utils.py` imports the module
+at import time when torchvision looks installed, but only calls into it when
+actually decoding video — which no test here does."""
+
+
+def _unavailable(*_args, **_kwargs):
+    raise RuntimeError("torchvision stub: video/image IO is not available")
+
+
+read_video = _unavailable
+read_image = _unavailable
+VideoReader = _unavailable

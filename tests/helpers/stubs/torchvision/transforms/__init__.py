@@ -1,0 +1,21 @@
+"""`torchvision.transforms` stub — just enough for libraries that probe
+torchvision availability via package metadata (the fake dist-info next to
+this stub) and then import interpolation enums at module scope.
+
+transformers' `image_utils.py` does `from torchvision.transforms import
+InterpolationMode` whenever torchvision looks installed; without this
+module the incomplete stub poisoned every transformers model import in the
+same process (round-3 regression: 18 parity tests ERROR'd).
+"""
+
+import enum
+
+
+class InterpolationMode(enum.Enum):
+    NEAREST = "nearest"
+    NEAREST_EXACT = "nearest-exact"
+    BILINEAR = "bilinear"
+    BICUBIC = "bicubic"
+    BOX = "box"
+    HAMMING = "hamming"
+    LANCZOS = "lanczos"

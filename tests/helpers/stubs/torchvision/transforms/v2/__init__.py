@@ -1,0 +1,4 @@
+"""`torchvision.transforms.v2` stub: re-exports the v1 interpolation enum
+(the only symbol availability-probing libraries import at module scope)."""
+
+from torchvision.transforms import InterpolationMode  # noqa: F401

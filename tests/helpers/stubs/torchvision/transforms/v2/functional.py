@@ -1,0 +1,10 @@
+"""`torchvision.transforms.v2.functional` stub: transformers' fast image
+processors import the module at import time; every attribute raises if a
+test ever actually invokes a torchvision kernel."""
+
+
+def __getattr__(name):
+    raise RuntimeError(
+        f"torchvision stub: transforms.v2.functional.{name} is not available "
+        "(install real torchvision to use fast image processors)"
+    )

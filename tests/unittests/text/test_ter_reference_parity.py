@@ -1,0 +1,70 @@
+"""Randomized TER parity vs the reference implementation.
+
+The tercom shift search here is structured differently from the reference's
+(original block-matching/insertion-point walk), so behavioral equivalence is
+asserted the strong way: random corpora across every tokenizer flag combo
+must score identically (VERDICT r3 next #8: rewrite must keep parity green).
+"""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+_STUBS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
+for _p in (_STUBS, "/root/reference/src"):
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+pytest.importorskip("torchmetrics")
+
+VOCAB = [
+    "the", "cat", "dog", "sat", "on", "mat", "a", "ran", "fast", "slow",
+    "big", "house", "tree,", "bird.", "&amp;", "3-4", "it's", "end",
+]
+
+
+def _sentence(rng, n):
+    return " ".join(rng.choice(VOCAB) for _ in range(n))
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [{}, {"normalize": True}, {"no_punctuation": True}, {"lowercase": False},
+     {"normalize": True, "no_punctuation": True}],
+    ids=["default", "normalize", "no_punct", "cased", "normalize+no_punct"],
+)
+def test_ter_random_corpora_reference_parity(flags):
+    from torchmetrics.functional.text.ter import translation_edit_rate as ref_ter
+
+    from torchmetrics_tpu.functional.text.ter import translation_edit_rate as our_ter
+
+    rng = random.Random(7)
+    for _ in range(20):
+        n = rng.randint(1, 4)
+        preds = [_sentence(rng, rng.randint(1, 15)) for _ in range(n)]
+        target = [
+            [_sentence(rng, rng.randint(1, 15)) for _ in range(rng.randint(1, 3))]
+            for _ in range(n)
+        ]
+        ref_score = float(ref_ter(preds, target, **flags))
+        our_score = float(our_ter(preds, target, **flags))
+        assert abs(ref_score - our_score) < 1e-6, (preds, target, flags)
+
+
+def test_ter_sentence_level_reference_parity():
+    from torchmetrics.functional.text.ter import translation_edit_rate as ref_ter
+
+    from torchmetrics_tpu.functional.text.ter import translation_edit_rate as our_ter
+
+    rng = random.Random(3)
+    preds = [_sentence(rng, rng.randint(2, 12)) for _ in range(5)]
+    target = [[_sentence(rng, rng.randint(2, 12))] for _ in range(5)]
+    ref_c, ref_s = ref_ter(preds, target, return_sentence_level_score=True)
+    our_c, our_s = our_ter(preds, target, return_sentence_level_score=True)
+    assert abs(float(ref_c) - float(our_c)) < 1e-6
+    np.testing.assert_allclose(
+        np.asarray(our_s).ravel(), np.asarray(ref_s).ravel(), atol=1e-6
+    )

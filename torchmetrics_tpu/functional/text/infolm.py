@@ -37,6 +37,22 @@ _ALLOWED_INFORMATION_MEASURE = (
 )
 
 
+# which hyper-parameters each parameterized measure needs ...
+_REQUIRED_PARAMS: Dict[str, Tuple[str, ...]] = {
+    "alpha_divergence": ("alpha",),
+    "beta_divergence": ("beta",),
+    "ab_divergence": ("alpha", "beta"),
+    "renyi_divergence": ("alpha",),
+}
+# ... and the parameter values where its closed form divides by zero
+_SINGULAR_PARAMS: Dict[str, Callable[[Optional[float], Optional[float]], bool]] = {
+    "alpha_divergence": lambda a, b: a in (0.0, 1.0),
+    "beta_divergence": lambda a, b: b in (0.0, -1.0),
+    "ab_divergence": lambda a, b: 0.0 in (a, b, a + b),
+    "renyi_divergence": lambda a, b: a == 1.0,
+}
+
+
 class _InformationMeasure:
     """Measure dispatch + parameter validation (reference infolm.py:72-296)."""
 
@@ -48,26 +64,23 @@ class _InformationMeasure:
     ) -> None:
         if information_measure not in _ALLOWED_INFORMATION_MEASURE:
             raise ValueError(
-                f"Argument `information_measure` is expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+                f"Unknown `information_measure` {information_measure!r}; choose one of "
+                f"{', '.join(_ALLOWED_INFORMATION_MEASURE)}."
             )
-        needs_alpha = information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence")
-        needs_beta = information_measure in ("beta_divergence", "ab_divergence")
-        if needs_alpha and not isinstance(alpha, float):
-            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
-        if needs_beta and not isinstance(beta, float):
-            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
-        if information_measure == "alpha_divergence" and alpha in (0.0, 1.0):
-            raise ValueError("Parameter `alpha` is expected to be differened from 0 and 1 for alpha divergence.")
-        if information_measure == "beta_divergence" and beta in (0.0, -1.0):
-            raise ValueError("Parameter `beta` is expected to be differened from 0 and -1 for beta divergence.")
-        if information_measure == "ab_divergence" and (
-            0.0 in (alpha, beta) or alpha + beta == 0.0  # type: ignore[operator]
-        ):
+        params = {"alpha": alpha, "beta": beta}
+        for name in _REQUIRED_PARAMS.get(information_measure, ()):
+            if not isinstance(params[name], float):
+                raise ValueError(
+                    f"`information_measure={information_measure!r}` requires a float `{name}` parameter."
+                )
+        singular_check = _SINGULAR_PARAMS.get(information_measure)
+        if singular_check is not None and singular_check(alpha, beta):
             raise ValueError(
-                "Parameters `alpha`, `beta` and their sum are expected to differ from 0 for AB divergence."
+                f"The given parameters make {information_measure!r} degenerate (zero denominator "
+                "in its closed form): `alpha` must avoid {0, 1} for the alpha divergence and 1 for "
+                "Rényi; `beta` must avoid {0, -1} for the beta divergence; and alpha, beta, "
+                "alpha+beta must all be nonzero for the AB divergence."
             )
-        if information_measure == "renyi_divergence" and alpha == 1.0:
-            raise ValueError("Parameter `alpha` is expected to be differened from 1 for Rényi divergence.")
         self.information_measure = information_measure
         self.alpha = alpha
         self.beta = beta
@@ -133,13 +146,11 @@ def _load_hf_mlm(model_name_or_path: str):
     (multimodal/backbones/clip.py).
     """
     if model_name_or_path not in _HF_MLMS:
-        import os
-
         from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
 
-        kwargs: dict = {}
-        if not os.environ.get("TORCHMETRICS_TPU_ALLOW_DOWNLOAD"):
-            kwargs["local_files_only"] = True
+        from torchmetrics_tpu.utilities.imports import hf_local_kwargs
+
+        kwargs = hf_local_kwargs()
         tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, **kwargs)
         try:
             model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path, **kwargs)

@@ -15,10 +15,8 @@ from jax import Array
 
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.text.bert import (
-    WhitespaceTokenizer,
     _bert_score_from_embeddings,
     _compute_idf,
-    _hash_embedding_model,
     _idf_weights,
 )
 
@@ -52,20 +50,14 @@ class BERTScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.model_name_or_path = model_name_or_path
+        from torchmetrics_tpu.functional.text.bert import resolve_embedder
+
         self.idf = idf
         self.return_hash = return_hash
-        self._zero_special = False
-        if model_name_or_path and model is None and user_forward_fn is None and user_tokenizer is None:
-            from torchmetrics_tpu.functional.text.bert import load_hf_embedder
-
-            self.embed_fn, self.tokenizer = load_hf_embedder(
-                model_name_or_path, num_layers, max_length, truncation=truncation
-            )
-            self._zero_special = True
-        else:
-            self.tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
-            self.embed_fn = user_forward_fn or model or _hash_embedding_model
+        self.embed_fn, self.tokenizer, self._zero_special, self.model_name_or_path = resolve_embedder(
+            model_name_or_path, num_layers, max_length, truncation=truncation,
+            model=model, user_tokenizer=user_tokenizer, user_forward_fn=user_forward_fn,
+        )
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -128,5 +120,5 @@ class BERTScore(Metric):
         )
         out: Dict[str, Any] = {"precision": precision, "recall": recall, "f1": f1}
         if self.return_hash:
-            out["hash"] = f"tpu_bert_score(model={self.model_name_or_path or 'hash-embedding'})"
+            out["hash"] = f"tpu_bert_score(model={self.model_name_or_path or 'user-model'})"
         return out

@@ -10,8 +10,8 @@ from jax import Array
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.text.ter import (
     _compute_ter_score_from_statistics,
+    _corpus_statistics,
     _TercomTokenizer,
-    _ter_update,
 )
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
@@ -40,7 +40,7 @@ class TranslationEditRate(Metric):
             ("lowercase", lowercase), ("asian_support", asian_support),
         ):
             if not isinstance(val, bool):
-                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+                raise ValueError(f"`{name}` must be a bool, got {val!r}.")
         self._tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
         self.return_sentence_level_score = return_sentence_level_score
 
@@ -52,14 +52,13 @@ class TranslationEditRate(Metric):
     def _update(
         self, state: State, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]
     ) -> State:
-        sentence_ter: Optional[List[float]] = [] if self.return_sentence_level_score else None
-        num_edits, tgt_length = _ter_update(preds, target, self._tokenizer, 0.0, 0.0, sentence_ter)
+        num_edits, tgt_length, per_sentence = _corpus_statistics(preds, target, self._tokenizer)
         new = {
             "total_num_edits": state["total_num_edits"] + num_edits,
             "total_tgt_length": state["total_tgt_length"] + tgt_length,
         }
         if self.return_sentence_level_score:
-            new["sentence_ter"] = state["sentence_ter"] + (jnp.asarray(sentence_ter, jnp.float32),)
+            new["sentence_ter"] = state["sentence_ter"] + (jnp.asarray(per_sentence, jnp.float32),)
         return new
 
     def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:

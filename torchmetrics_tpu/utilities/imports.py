@@ -33,3 +33,17 @@ _PANDAS_AVAILABLE: bool = _package_available("pandas")
 _PYCOCOTOOLS_AVAILABLE: bool = _package_available("pycocotools")
 _REGEX_AVAILABLE: bool = _package_available("regex")
 _NLTK_AVAILABLE: bool = _package_available("nltk")
+
+
+def hf_local_kwargs() -> dict:
+    """from_pretrained kwargs enforcing local-only checkpoint resolution.
+
+    Zero-egress default: an unreachable hub id fails fast instead of
+    spending ~50s in huggingface-hub's retry loop.  Set
+    ``TORCHMETRICS_TPU_ALLOW_DOWNLOAD=1`` to permit network fetches.
+    Shared by every HF loader (BERT, CLIP, InfoLM) so the knob cannot
+    drift between them.
+    """
+    import os
+
+    return {} if os.environ.get("TORCHMETRICS_TPU_ALLOW_DOWNLOAD") else {"local_files_only": True}
