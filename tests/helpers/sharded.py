@@ -1,0 +1,93 @@
+"""Universal sharded-metric tester.
+
+The reference routes *every* metric through a ddp=True ``MetricTester``
+(/root/reference/tests/unittests/_helpers/testers.py:352,453): rank-split
+updates, state sync, compute, oracle compare.  This is the mesh-native
+equivalent: batch-split updates across the 8-virtual-device mesh via
+``sharded_update`` (shard_map + in-graph collectives), merge across steps,
+compute — asserted identical to single-device accumulation and, when given,
+to an external oracle.  One harness, enrolled per domain (VERDICT r3 #4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from torchmetrics_tpu.parallel import sharded_update
+
+
+def _flatten_result(value: Any) -> dict:
+    """Normalize a metric result (array / tuple / dict / nested) to flat
+    {path: np.ndarray} for comparison."""
+    flat = {}
+
+    def walk(v, path):
+        if isinstance(v, dict):
+            for k in sorted(v):
+                walk(v[k], f"{path}.{k}")
+        elif isinstance(v, (tuple, list)):
+            for i, e in enumerate(v):
+                walk(e, f"{path}[{i}]")
+        else:
+            flat[path] = np.asarray(v)
+
+    walk(value, "result")
+    return flat
+
+
+def assert_results_close(got: Any, expected: Any, atol: float, rtol: float, label: str) -> None:
+    got_flat, exp_flat = _flatten_result(got), _flatten_result(expected)
+    assert got_flat.keys() == exp_flat.keys(), (
+        f"{label}: result structure differs: {sorted(got_flat)} vs {sorted(exp_flat)}"
+    )
+    for key in got_flat:
+        np.testing.assert_allclose(
+            got_flat[key], exp_flat[key], atol=atol, rtol=rtol,
+            err_msg=f"{label}: mismatch at {key}",
+        )
+
+
+def assert_sharded_parity(
+    mesh,
+    metric_ctor: Callable[[], Any],
+    batches: Sequence[Tuple[Any, ...]],
+    oracle: Optional[Any] = None,
+    atol: float = 1e-5,
+    rtol: float = 1e-5,
+) -> Any:
+    """Assert mesh-sharded accumulation ≡ single-device accumulation (≡ oracle).
+
+    ``batches``: per-step input tuples; every array's leading (batch) dim
+    must be divisible by the mesh size so ``shard_map`` can split it evenly.
+    Returns the single-device result so callers can chain extra checks.
+    """
+    n_dev = mesh.devices.size
+    for step, batch in enumerate(batches):
+        for arr in batch:
+            assert np.asarray(arr).shape[0] % n_dev == 0, (
+                f"batch {step}: leading dim {np.asarray(arr).shape[0]} not divisible by {n_dev}"
+            )
+
+    # single-device accumulation (eager facade)
+    single = metric_ctor()
+    for batch in batches:
+        single.update(*batch)
+    expected = single.compute()
+
+    # mesh path: shard each step's batch over the devices, sync in-graph,
+    # merge the replicated per-step states across steps
+    sharded = metric_ctor()
+    total = None
+    for batch in batches:
+        state = sharded_update(sharded, *batch, mesh=mesh)
+        total = state if total is None else sharded.merge_states(total, state)
+    got = sharded.compute_state(total)
+    jax.block_until_ready(jax.tree.leaves(got))
+
+    assert_results_close(got, expected, atol, rtol, label=f"sharded({n_dev})-vs-single")
+    if oracle is not None:
+        assert_results_close(expected, oracle, atol, rtol, label="single-vs-oracle")
+    return expected

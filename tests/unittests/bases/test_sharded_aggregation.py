@@ -1,0 +1,61 @@
+"""Aggregation + clustering metrics through the 8-device sharded-sync path."""
+
+import numpy as np
+import pytest
+
+from tests.helpers.sharded import assert_sharded_parity
+
+N = 64
+
+
+@pytest.fixture()
+def values():
+    rng = np.random.default_rng(41)
+    return rng.normal(size=(2, N)).astype(np.float32)
+
+
+def test_sharded_mean_metric(mesh, values):
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    assert_sharded_parity(
+        mesh, MeanMetric, [(values[0],), (values[1],)], oracle=values.mean(), atol=1e-5
+    )
+
+
+def test_sharded_sum_metric(mesh, values):
+    from torchmetrics_tpu.aggregation import SumMetric
+
+    assert_sharded_parity(
+        mesh, SumMetric, [(values[0],), (values[1],)], oracle=values.sum(), atol=1e-3, rtol=1e-5
+    )
+
+
+def test_sharded_minmax(mesh, values):
+    from torchmetrics_tpu.aggregation import MaxMetric, MinMetric
+
+    assert_sharded_parity(mesh, MaxMetric, [(values[0],), (values[1],)], oracle=values.max())
+    assert_sharded_parity(mesh, MinMetric, [(values[0],), (values[1],)], oracle=values.min())
+
+
+def test_sharded_cat_metric(mesh, values):
+    from torchmetrics_tpu.aggregation import CatMetric
+
+    assert_sharded_parity(mesh, CatMetric, [(values[0],), (values[1],)], oracle=values.ravel())
+
+
+def test_sharded_clustering_rand_score(mesh):
+    from sklearn.metrics import adjusted_rand_score
+
+    from torchmetrics_tpu.clustering import AdjustedRandScore
+
+    rng = np.random.default_rng(43)
+    preds = rng.integers(0, 4, size=(2, N))
+    target = rng.integers(0, 4, size=(2, N))
+    oracle = adjusted_rand_score(target.ravel(), preds.ravel())
+    assert_sharded_parity(
+        mesh,
+        AdjustedRandScore,
+        [(preds[0], target[0]), (preds[1], target[1])],
+        oracle=oracle,
+        atol=1e-5,
+    )

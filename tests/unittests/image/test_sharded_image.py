@@ -1,0 +1,57 @@
+"""Image-signal metrics through the 8-device sharded-sync path."""
+
+import numpy as np
+import pytest
+
+from tests.helpers.sharded import assert_sharded_parity
+
+B = 16  # images per step; 8 devices x 2
+
+
+@pytest.fixture()
+def image_pairs():
+    rng = np.random.default_rng(21)
+    preds = rng.uniform(size=(2, B, 3, 16, 16)).astype(np.float32)
+    target = np.clip(preds + 0.05 * rng.normal(size=preds.shape), 0, 1).astype(np.float32)
+    return preds, target
+
+
+def _batches(preds, target):
+    return [(preds[0], target[0]), (preds[1], target[1])]
+
+
+def test_sharded_psnr(mesh, image_pairs):
+    from torchmetrics_tpu.image import PeakSignalNoiseRatio
+
+    preds, target = image_pairs
+    assert_sharded_parity(
+        mesh, lambda: PeakSignalNoiseRatio(data_range=1.0), _batches(preds, target), atol=1e-4
+    )
+
+
+def test_sharded_ssim(mesh, image_pairs):
+    from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+
+    preds, target = image_pairs
+    assert_sharded_parity(
+        mesh,
+        lambda: StructuralSimilarityIndexMeasure(data_range=1.0),
+        _batches(preds, target),
+        atol=1e-4,
+    )
+
+
+def test_sharded_uqi(mesh, image_pairs):
+    from torchmetrics_tpu.image import UniversalImageQualityIndex
+
+    preds, target = image_pairs
+    assert_sharded_parity(
+        mesh, UniversalImageQualityIndex, _batches(preds, target), atol=1e-4
+    )
+
+
+def test_sharded_total_variation(mesh, image_pairs):
+    from torchmetrics_tpu.image import TotalVariation
+
+    preds, _ = image_pairs
+    assert_sharded_parity(mesh, TotalVariation, [(preds[0],), (preds[1],)], atol=1e-3, rtol=1e-4)

@@ -110,6 +110,17 @@ class Metric:
             "distributed_available_fn", distributed_available
         )
         self.process_group: Optional[Any] = kwargs.pop("process_group", None)
+        if self.process_group is not None:
+            # No silent API-parity theater: jax's host-level collectives have
+            # no torch-style subgroup object.  Sub-world sync here is done
+            # in-graph by syncing over a named mesh axis (``axis_name``,
+            # consumed by sync_states/sharded_update), or by supplying a
+            # custom ``dist_sync_fn`` for the host path.
+            raise ValueError(
+                "`process_group` is not supported on the TPU backend: scope the sync by mesh "
+                "axis instead (pass `axis_name=...` and sync inside shard_map), or supply a "
+                "custom `dist_sync_fn` for host-level sync over a process subset."
+            )
         kwargs.pop("compute_on_cpu", None)  # accepted for API parity; host state is the default here
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
@@ -194,6 +205,14 @@ class Metric:
         out[_N] = jax.lax.psum(state[_N], axis_name)
         return out
 
+    def host_sync_states(self, state: State) -> State:
+        """Cross-process (DCN, eager) sync — the host mirror of ``sync_states``.
+
+        Metrics whose states don't combine leaf-wise under the reduction
+        table (e.g. streaming-moment states) must override BOTH sync hooks.
+        """
+        return host_sync_state(state, self._reductions)
+
     # ------------------------------------------------------- subclass contract
     def _update(self, state: State, *args: Any, **kwargs: Any) -> State:
         raise NotImplementedError
@@ -241,7 +260,7 @@ class Metric:
             if self.dist_sync_fn is not None:
                 state = self.dist_sync_fn(state, self._reductions)
             else:
-                state = host_sync_state(state, self._reductions)
+                state = self.host_sync_states(state)
         value = self.compute_state(state)
         if self.compute_with_cache:
             self._computed = value

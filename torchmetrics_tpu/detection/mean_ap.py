@@ -90,11 +90,15 @@ def _evaluate_image(
     gtm = np.zeros((T, n_g), dtype=np.int64) - 1
 
     ious_s = ious[np.ix_(d_order, g_order)] if n_d and n_g else np.zeros((n_d, n_g))
+    # compare in float32 — the device backend's dtype — so the two backends
+    # tie-break identically when an IoU lands exactly on a threshold (e.g.
+    # exact 0.5 from integer boxes); float64 here could flip such matches
+    ious_s = ious_s.astype(np.float32)
     crowd_sorted = gt_crowd[g_order]
 
     for ti, t in enumerate(iou_thrs):
         for di in range(n_d):
-            best_iou = min(t, 1 - 1e-10)
+            best_iou = np.float32(min(t, 1 - 1e-10))
             m = -1
             for gi in range(n_g):
                 if gtm[ti, gi] >= 0 and not crowd_sorted[gi]:

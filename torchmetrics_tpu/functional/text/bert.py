@@ -148,12 +148,11 @@ def resolve_embedder(
     Mirrors the reference's model resolution (text/bert.py:156-190): explicit
     user hooks win; an unspecified ``model_name_or_path`` warns and defaults
     to the recommended model; a named checkpoint loads through
-    :func:`load_hf_embedder`.  Only when a *hub id* is genuinely unreachable
-    (zero-egress image, cold cache) does the deterministic hash embedder
-    engage — with a loud warning, never silently (VERDICT r3 weak #6).  A
-    local directory that fails to load raises.
+    :func:`load_hf_embedder`.  Only the *implicit default* may degrade to
+    the deterministic hash embedder — and only when it is genuinely absent
+    (zero-egress image, cold cache), with a loud warning, never silently
+    (VERDICT r3 weak #6).  Any checkpoint the user named must load or raise.
     """
-    import os
 
     from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -161,7 +160,8 @@ def resolve_embedder(
         tokenizer = user_tokenizer if user_tokenizer is not None else WhitespaceTokenizer(max_length)
         return user_forward_fn or model or _hash_embedding_model, tokenizer, False, model_name_or_path
 
-    if model_name_or_path is None:
+    explicit = model_name_or_path is not None
+    if not explicit:
         rank_zero_warn(
             "The argument `model_name_or_path` was not specified while it is required when"
             " the default `transformers` model is used."
@@ -178,21 +178,20 @@ def resolve_embedder(
             )
         embed_fn, tokenizer = _HF_EMBEDDERS[cache_key]
         return embed_fn, tokenizer, True, model_name_or_path
-    except (OSError, EnvironmentError, ValueError):
-        path_like = (
-            os.path.isdir(model_name_or_path)
-            or os.path.isabs(model_name_or_path)
-            or model_name_or_path.startswith(".")
-            or model_name_or_path.count("/") > 1  # hub ids are "name" or "org/name"
-        )
-        if path_like:
-            # user pointed at a checkpoint path: never degrade silently
+    except (OSError, EnvironmentError):
+        # Not-found class of failure only.  ValueError (e.g. an architecture
+        # with no Flax port) propagates — it would misreport as
+        # "unavailable" and silently score with the wrong model.
+        if explicit:
+            # a checkpoint the USER named must load or fail loudly,
+            # whether it's a local path or a hub id
             raise
         rank_zero_warn(
-            f"BERT checkpoint {model_name_or_path!r} is not available locally (no download is"
-            " possible in this environment). Falling back to a deterministic hash-embedding"
-            " model — scores will NOT match real BERTScore. Pass a local checkpoint directory"
-            " as `model_name_or_path`, or explicit `model`/`user_forward_fn`, for real scores.",
+            f"The default BERT checkpoint {_DEFAULT_MODEL!r} is not available locally (no"
+            " download is possible in this environment). Falling back to a deterministic"
+            " hash-embedding model — scores will NOT match real BERTScore. Pass a local"
+            " checkpoint directory as `model_name_or_path`, or explicit"
+            " `model`/`user_forward_fn`, for real scores.",
             UserWarning,
         )
         return _hash_embedding_model, WhitespaceTokenizer(max_length), False, model_name_or_path

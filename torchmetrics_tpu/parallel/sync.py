@@ -154,11 +154,12 @@ def sharded_update(
     if in_specs is None:
         in_specs = P(axis_name)
 
-    reductions = metric._reductions
-
     def step(*shards):
         st = metric.update_state(metric.init_state(), *shards, **kwargs)
-        return sync_state(st, reductions, axis_name)
+        # metric.sync_states, not the bare reduction table: metrics with
+        # non-distributive states (e.g. Pearson's streaming moments)
+        # override sync_states with their own cross-shard aggregation
+        return metric.sync_states(st, axis_name)
 
     specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
     # check_vma=False: all_gather-produced leaves are replicated in value but the

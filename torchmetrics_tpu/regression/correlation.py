@@ -78,6 +78,26 @@ class PearsonCorrCoef(Metric):
             "corr_xy": cxy, "n_total": n, _N: jax.lax.psum(state[_N], axis_name),
         }
 
+    def host_sync_states(self, state: State) -> State:
+        """DCN mirror of the in-graph override: gather each process's moment
+        state, then run the same pairwise aggregation."""
+        from jax.experimental import multihost_utils
+
+        gathered = {
+            k: jnp.asarray(multihost_utils.process_allgather(v))
+            for k, v in state.items()
+            if k != _N
+        }
+        mx, my, vx, vy, cxy, n = _final_aggregation(
+            gathered["mean_x"], gathered["mean_y"], gathered["var_x"],
+            gathered["var_y"], gathered["corr_xy"], gathered["n_total"],
+        )
+        n_updates = jnp.sum(jnp.asarray(multihost_utils.process_allgather(state[_N])))
+        return {
+            "mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy,
+            "corr_xy": cxy, "n_total": n, _N: n_updates,
+        }
+
     def _compute(self, state: State) -> Array:
         return _pearson_compute(state["var_x"], state["var_y"], state["corr_xy"], state["n_total"])
 
