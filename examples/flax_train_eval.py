@@ -1,0 +1,134 @@
+"""Train/eval loop integration: a Flax model + optax + MetricCollection.
+
+The L6 slice the reference proves through Lightning
+(/root/reference/tests/integrations/test_lightning.py:48,83,184): metrics
+accumulate across an epoch inside the (jitted) eval step, compute + reset at
+the epoch boundary, and metric state checkpoints/restores mid-epoch together
+with the train state.
+
+TPU-native shape: the metric update runs INSIDE the jitted eval step via the
+collection's functional state API, so per-batch accumulation fuses into the
+eval graph instead of syncing to host every batch (the reference's forward()
+is host-side Python around torch ops — SURVEY.md §2.7).
+
+Run on anything: ``python examples/flax_train_eval.py`` (CPU ok).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+)
+
+NUM_CLASSES = 4
+FEATURES = 16
+BATCH = 32
+EPOCHS = 3
+STEPS_PER_EPOCH = 10
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(64)(x))
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+_W_TRUE = jax.random.normal(jax.random.PRNGKey(99), (FEATURES, NUM_CLASSES))
+
+
+def make_data(key, n):
+    """Linearly-separable-ish synthetic classification data (one shared
+    ground-truth mapping, so train and val measure the same task)."""
+    x = jax.random.normal(key, (n, FEATURES))
+    y = jnp.argmax(x @ _W_TRUE + 0.5 * jax.random.normal(key, (n, NUM_CLASSES)), axis=-1)
+    return x, y
+
+
+def main():
+    model = MLP()
+    metrics = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=20, validate_args=False),
+        },
+        prefix="val_",
+    )
+
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, FEATURES)))
+    tx = optax.sgd(0.1, momentum=0.9)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def eval_step(params, metric_states, x, y):
+        """Model forward + metric accumulation, one fused graph."""
+        probs = jax.nn.softmax(model.apply(params, x))
+        return metrics.update_states(metric_states, probs, y)
+
+    x_train, y_train = make_data(jax.random.PRNGKey(1), STEPS_PER_EPOCH * BATCH)
+    x_val, y_val = make_data(jax.random.PRNGKey(2), STEPS_PER_EPOCH * BATCH)
+
+    for epoch in range(EPOCHS):
+        for i in range(STEPS_PER_EPOCH):
+            sl = slice(i * BATCH, (i + 1) * BATCH)
+            params, opt_state, loss = train_step(params, opt_state, x_train[sl], y_train[sl])
+
+        states = metrics.init_states()
+        for i in range(STEPS_PER_EPOCH):
+            sl = slice(i * BATCH, (i + 1) * BATCH)
+            states = eval_step(params, states, x_val[sl], y_val[sl])
+
+            if epoch == 0 and i == STEPS_PER_EPOCH // 2:
+                # mid-epoch checkpoint: metric state is an ordinary pytree,
+                # so it rides the same checkpoint as params/opt_state
+                ckpt = flax.serialization.to_bytes(
+                    {"params": params, "opt": opt_state, "metrics": states}
+                )
+                restored = flax.serialization.from_bytes(
+                    {"params": params, "opt": opt_state, "metrics": states}, ckpt
+                )
+                states = restored["metrics"]
+                print(f"  (mid-epoch checkpoint round-trip at step {i}: "
+                      f"{len(ckpt)} bytes, state restored)")
+
+        # epoch boundary: compute over the accumulated state, then the next
+        # epoch starts from fresh init_states (the reference's auto-reset)
+        results = metrics.compute_states(states)
+        print(
+            f"epoch {epoch}: loss={float(loss):.4f} "
+            + " ".join(f"{k}={float(v):.4f}" for k, v in results.items())
+        )
+
+    # the eager facade interops: install the last epoch's states and use
+    # compute()/reset() exactly like the reference's modular metrics
+    metrics.load_states(states)
+    assert np.allclose(
+        float(metrics.compute()["val_acc"]), float(results["val_acc"]), atol=1e-6
+    )
+    metrics.reset()
+    print("final epoch results installed into the eager facade; reset OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""One place that puts the reference implementation + its import stubs on
+``sys.path`` for oracle/parity tests (seven test files were each deriving
+the relative stubs path by hand)."""
+
+import os
+import sys
+
+STUBS_DIR = os.path.abspath(os.path.join(os.path.dirname(__file__), "stubs"))
+REFERENCE_SRC = "/root/reference/src"
+
+
+def add_reference_paths() -> None:
+    """Make ``import torchmetrics`` resolve to the reference tree, with the
+    lightning_utilities/torchvision/pycocotools stubs it needs."""
+    for path in (STUBS_DIR, REFERENCE_SRC):
+        if path not in sys.path:
+            sys.path.insert(0, path)

@@ -1,0 +1,185 @@
+"""L6 integration: MetricCollection inside a real Flax/optax train-eval loop.
+
+Mirrors the behaviors the reference proves through Lightning
+(/root/reference/tests/integrations/test_lightning.py):
+  :48  — metric states accumulate across an epoch of eval steps
+  :83  — compute at the epoch boundary + reset leaves no state leakage
+  :184 — metric values logged per epoch track the accumulated state
+plus the checkpoint story: mid-epoch metric state rides the same pytree
+checkpoint as params/opt_state and restores into a fresh process/instance.
+"""
+
+import flax.linen as nn
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+NUM_CLASSES = 4
+FEATURES = 8
+BATCH = 16
+STEPS = 6
+
+
+class TinyNet(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(NUM_CLASSES)(nn.relu(nn.Dense(32)(x)))
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", validate_args=False),
+        },
+        prefix="val_",
+    )
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """A briefly-trained model + eval data."""
+    model = TinyNet()
+    w_true = jax.random.normal(jax.random.PRNGKey(99), (FEATURES, NUM_CLASSES))
+
+    def data(key, n):
+        x = jax.random.normal(key, (n, FEATURES))
+        return x, jnp.argmax(x @ w_true, axis=-1)
+
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, FEATURES)))
+    tx = optax.sgd(0.1)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        grads = jax.grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state
+
+    x_tr, y_tr = data(jax.random.PRNGKey(1), 256)
+    for i in range(16):
+        sl = slice((i % 8) * 32, (i % 8 + 1) * 32)
+        params, opt_state = train_step(params, opt_state, x_tr[sl], y_tr[sl])
+
+    x_val, y_val = data(jax.random.PRNGKey(2), STEPS * BATCH)
+    return model, params, np.asarray(x_val), np.asarray(y_val)
+
+
+def _run_epoch(model, params, metrics, states, x_val, y_val):
+    @jax.jit
+    def eval_step(params, states, x, y):
+        probs = jax.nn.softmax(model.apply(params, x))
+        return metrics.update_states(states, probs, y)
+
+    for i in range(len(x_val) // BATCH):
+        sl = slice(i * BATCH, (i + 1) * BATCH)
+        states = eval_step(params, states, jnp.asarray(x_val[sl]), jnp.asarray(y_val[sl]))
+    return states
+
+
+def test_epoch_accumulation_matches_full_pass(trained):
+    """Per-batch accumulation inside the jitted eval step ≡ one computation
+    over the whole epoch's data (reference test_lightning.py:48)."""
+    from sklearn.metrics import accuracy_score, f1_score
+
+    model, params, x_val, y_val = trained
+    metrics = _collection()
+    states = _run_epoch(model, params, metrics, metrics.init_states(), x_val, y_val)
+    results = metrics.compute_states(states)
+
+    probs = jax.nn.softmax(model.apply(params, jnp.asarray(x_val)))
+    pred_labels = np.asarray(probs).argmax(-1)
+    np.testing.assert_allclose(
+        float(results["val_acc"]), accuracy_score(y_val, pred_labels), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        float(results["val_f1"]), f1_score(y_val, pred_labels, average="macro"), atol=1e-6
+    )
+
+
+def test_epoch_boundary_reset_no_leakage(trained):
+    """Epoch 2 starting from fresh states is oblivious to epoch 1
+    (reference's auto-reset, test_lightning.py:83)."""
+    model, params, x_val, y_val = trained
+    metrics = _collection()
+
+    # epoch 1 on the first half, epoch 2 on the second half
+    half = STEPS * BATCH // 2
+    s1 = _run_epoch(model, params, metrics, metrics.init_states(), x_val[:half], y_val[:half])
+    epoch1 = metrics.compute_states(s1)
+    s2 = _run_epoch(model, params, metrics, metrics.init_states(), x_val[half:], y_val[half:])
+    epoch2 = metrics.compute_states(s2)
+
+    # fresh-instance oracle for epoch 2 alone
+    oracle = _collection()
+    s_oracle = _run_epoch(model, params, oracle, oracle.init_states(), x_val[half:], y_val[half:])
+    expected2 = oracle.compute_states(s_oracle)
+
+    np.testing.assert_allclose(float(epoch2["val_acc"]), float(expected2["val_acc"]), atol=1e-6)
+    # and the eager facade resets the same way
+    metrics.load_states(s1)
+    assert float(metrics.compute()["val_acc"]) == pytest.approx(float(epoch1["val_acc"]), abs=1e-6)
+    metrics.reset()
+    assert not any(m.update_called for m in metrics.values())
+
+
+def test_mid_epoch_checkpoint_restore(trained):
+    """Metric state serializes mid-epoch with params/opt_state and restores
+    into a FRESH collection; the resumed epoch matches the uninterrupted one."""
+    model, params, x_val, y_val = trained
+    metrics = _collection()
+
+    # uninterrupted epoch
+    full_states = _run_epoch(model, params, metrics, metrics.init_states(), x_val, y_val)
+    expected = metrics.compute_states(full_states)
+
+    # interrupted epoch: run half, checkpoint, restore into a new instance
+    half_steps = STEPS // 2
+    half_states = _run_epoch(
+        metrics=metrics, model=model, params=params, states=metrics.init_states(),
+        x_val=x_val[: half_steps * BATCH], y_val=y_val[: half_steps * BATCH],
+    )
+    blob = flax.serialization.to_bytes({"params": params, "metrics": half_states})
+
+    fresh = _collection()
+    template = {"params": params, "metrics": fresh.init_states()}
+    restored = flax.serialization.from_bytes(template, blob)
+    resumed = _run_epoch(
+        model, params, fresh, restored["metrics"],
+        x_val[half_steps * BATCH :], y_val[half_steps * BATCH :],
+    )
+    got = fresh.compute_states(resumed)
+    np.testing.assert_allclose(float(got["val_acc"]), float(expected["val_acc"]), atol=1e-6)
+    np.testing.assert_allclose(float(got["val_f1"]), float(expected["val_f1"]), atol=1e-6)
+
+
+def test_eager_facade_matches_jitted_path(trained):
+    """The reference-style eager loop (collection.update per batch, compute
+    at epoch end) gives the same numbers as the jitted functional path."""
+    model, params, x_val, y_val = trained
+
+    eager = _collection()
+    for i in range(STEPS):
+        sl = slice(i * BATCH, (i + 1) * BATCH)
+        probs = jax.nn.softmax(model.apply(params, jnp.asarray(x_val[sl])))
+        eager.update(probs, jnp.asarray(y_val[sl]))
+    eager_results = eager.compute()
+
+    functional = _collection()
+    states = _run_epoch(model, params, functional, functional.init_states(), x_val, y_val)
+    jit_results = functional.compute_states(states)
+
+    for key in eager_results:
+        np.testing.assert_allclose(
+            float(eager_results[key]), float(jit_results[key]), atol=1e-6, err_msg=key
+        )

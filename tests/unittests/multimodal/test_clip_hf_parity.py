@@ -19,10 +19,9 @@ import sys
 import numpy as np
 import pytest
 
-_STUBS = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
-for _p in (_STUBS, "/root/reference/src"):
-    if _p not in sys.path:
-        sys.path.insert(0, _p)
+from tests.helpers.refpath import add_reference_paths
+
+add_reference_paths()
 
 transformers = pytest.importorskip("transformers")
 

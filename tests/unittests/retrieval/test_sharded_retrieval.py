@@ -44,13 +44,9 @@ def test_sharded_retrieval(mesh, retrieval_inputs, name):
 
 def test_sharded_retrieval_map_reference_oracle(mesh, retrieval_inputs):
     """Single-device ≡ sharded ≡ the reference implementation (torch CPU)."""
-    import os
-    import sys
+    from tests.helpers.refpath import add_reference_paths
 
-    stubs = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", "helpers", "stubs"))
-    for p in (stubs, "/root/reference/src"):
-        if p not in sys.path:
-            sys.path.insert(0, p)
+    add_reference_paths()
     torch = pytest.importorskip("torch")
     from torchmetrics.retrieval import RetrievalMAP as RefMAP
 

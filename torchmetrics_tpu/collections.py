@@ -220,6 +220,69 @@ class MetricCollection(dict):
             out[name] = v
         return out
 
+    # ---------------------------------------------------- functional state API
+    # The pure mirror of update/compute/reset: states live in a
+    # {leader_name: state_pytree} dict that threads through jitted step
+    # functions (the eager facade above cannot be jitted — it mutates).
+    # Compute groups here are the ones configured at construction (an
+    # explicit ``compute_groups=[[...]]`` list shares one state per group);
+    # automatic state-equality group formation needs an eager first update
+    # and does not apply on this path, because merging groups mid-stream
+    # would change the state pytree's structure under jit.
+
+    def _functional_groups(self) -> Dict[int, List[str]]:
+        if not self._groups:
+            self._init_groups()
+        return self._groups
+
+    def init_states(self) -> Dict[str, Any]:
+        """Fresh per-group states, keyed by group-leader metric name."""
+        return {
+            members[0]: self[members[0]].init_state()
+            for members in self._functional_groups().values()
+        }
+
+    def update_states(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure batched update of every group leader's state (jit-friendly)."""
+        out = {}
+        for leader_name, st in states.items():
+            leader = self[leader_name]
+            out[leader_name] = leader.update_state(st, *args, **leader._filter_kwargs(**kwargs))
+        return out
+
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: self[k].merge_states(a[k], b[k]) for k in a}
+
+    def sync_states(self, states: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
+        """In-graph cross-device sync of every leader state (call under shard_map)."""
+        return {k: self[k].sync_states(st, axis_name) for k, st in states.items()}
+
+    def compute_states(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        """Results for every metric; group members compute from their leader's state."""
+        res = {}
+        for members in self._functional_groups().values():
+            leader_state = states[members[0]]
+            for name in members:
+                res[name] = self[name].compute_state(leader_state)
+        return self._to_renamed_dict(res)
+
+    def load_states(self, states: Dict[str, Any]) -> None:
+        """Install functional states into the eager facade (e.g. after a
+        jitted eval loop or a checkpoint restore)."""
+        for members in self._functional_groups().values():
+            st = states[members[0]]
+            for name in members:
+                self[name].load_state_pytree(st)
+
+    def state_pytree(self) -> Dict[str, Any]:
+        """Checkpointable state pytree for the whole collection (orbax-ready)."""
+        return {k: m.state_pytree() for k, m in self.items(keep_base=True)}
+
+    def load_state_pytree(self, states: Dict[str, Any]) -> None:
+        for k, m in self.items(keep_base=True):
+            if k in states:
+                m.load_state_pytree(states[k])
+
     # -------------------------------------------------------------- dict api
     def keys(self, keep_base: bool = False):  # type: ignore[override]
         if keep_base:

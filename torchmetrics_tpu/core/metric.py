@@ -282,7 +282,7 @@ class Metric:
             self._state = self.merge_states(self._state, batch_state)
         self._computed = None
         if self.dist_sync_on_step and self.distributed_available_fn():
-            batch_state = host_sync_state(batch_state, self._reductions)
+            batch_state = self.host_sync_states(batch_state)
         self._forward_cache = self.compute_state(batch_state)
         return self._forward_cache
 

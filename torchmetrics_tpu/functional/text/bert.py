@@ -178,7 +178,7 @@ def resolve_embedder(
             )
         embed_fn, tokenizer = _HF_EMBEDDERS[cache_key]
         return embed_fn, tokenizer, True, model_name_or_path
-    except (OSError, EnvironmentError):
+    except OSError:
         # Not-found class of failure only.  ValueError (e.g. an architecture
         # with no Flax port) propagates — it would misreport as
         # "unavailable" and silently score with the wrong model.
