@@ -50,8 +50,9 @@ _W_TRUE = jax.random.normal(jax.random.PRNGKey(99), (FEATURES, NUM_CLASSES))
 def make_data(key, n):
     """Linearly-separable-ish synthetic classification data (one shared
     ground-truth mapping, so train and val measure the same task)."""
-    x = jax.random.normal(key, (n, FEATURES))
-    y = jnp.argmax(x @ _W_TRUE + 0.5 * jax.random.normal(key, (n, NUM_CLASSES)), axis=-1)
+    kx, kn = jax.random.split(key)
+    x = jax.random.normal(kx, (n, FEATURES))
+    y = jnp.argmax(x @ _W_TRUE + 0.5 * jax.random.normal(kn, (n, NUM_CLASSES)), axis=-1)
     return x, y
 
 

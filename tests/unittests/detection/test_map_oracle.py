@@ -23,8 +23,6 @@ Scope notes (two *verified* legacy-oracle defects, excluded from scope):
 
 from __future__ import annotations
 
-import os
-import sys
 
 import numpy as np
 import pytest

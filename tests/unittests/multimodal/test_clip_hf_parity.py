@@ -13,8 +13,6 @@ would silently pool BOS, collapsing every prompt to one embedding.
 """
 
 import json
-import os
-import sys
 
 import numpy as np
 import pytest

@@ -8,7 +8,6 @@ wiring proven without downloadable weights).
 """
 
 import os
-import sys
 
 import numpy as np
 import pytest

@@ -7,8 +7,6 @@ tokenizer, same per-position masking pipeline → scores must agree
 (VERDICT r2 missing #4: InfoLM silently ignored `model_name_or_path`).
 """
 
-import os
-import sys
 
 import numpy as np
 import pytest

@@ -6,9 +6,7 @@ asserted the strong way: random corpora across every tokenizer flag combo
 must score identically (VERDICT r3 next #8: rewrite must keep parity green).
 """
 
-import os
 import random
-import sys
 
 import numpy as np
 import pytest

@@ -6,9 +6,11 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_sum,
 )
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from torchmetrics_tpu.utilities.formatting import classify_inputs
 from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
 
 __all__ = [
+    "classify_inputs",
     "dim_zero_cat",
     "dim_zero_max",
     "dim_zero_mean",

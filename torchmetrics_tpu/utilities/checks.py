@@ -76,3 +76,14 @@ def check_forward_full_state_property(
     )
     faster = t_partial < t_full
     rank_zero_info(f"Recommended setting `full_state_update={not faster}`")
+
+
+def _input_format_classification(preds, target, threshold=0.5, top_k=None, num_classes=None, multiclass=None, ignore_index=None):
+    """Reference-named alias of :func:`~torchmetrics_tpu.utilities.formatting.classify_inputs`
+    (reference utilities/checks.py:315)."""
+    from torchmetrics_tpu.utilities.formatting import classify_inputs
+
+    return classify_inputs(
+        preds, target, threshold=threshold, top_k=top_k, num_classes=num_classes,
+        multiclass=multiclass, ignore_index=ignore_index,
+    )

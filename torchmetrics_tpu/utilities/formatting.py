@@ -1,0 +1,256 @@
+"""Flexible classification input canonicalization.
+
+The shared input-format layer the reference builds in
+``utilities/checks.py`` (``_check_classification_inputs`` :207,
+``_input_format_classification`` :315): heterogeneous classification inputs
+— float probabilities/logits or integer labels, with or without a class
+dimension, with extra spatial dims — are auto-classified into one of four
+cases and canonicalized to binary ``(N, C)`` / ``(N, C, X)`` tensors that
+every downstream kernel can consume uniformly.
+
+The decision table is behaviorally identical to the reference's (property-
+tested against it case-by-case in
+tests/unittests/utilities/test_formatting.py); the structure here is a
+detect → validate → canonicalize pipeline over one rules table rather than
+the reference's chain of per-aspect check functions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.utilities.data import select_topk, to_onehot
+from torchmetrics_tpu.utilities.enums import DataType
+
+__all__ = ["classify_inputs", "DataType"]
+
+
+def _is_float(x: np.ndarray) -> bool:
+    return np.issubdtype(x.dtype, np.floating)
+
+
+def _squeeze_excess(preds: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Drop every size-1 dimension except the leading batch dim."""
+    if preds.shape[:1] == (1,):
+        return preds.squeeze()[None], target.squeeze()[None]
+    return preds.squeeze(), target.squeeze()
+
+
+def _detect_case(preds: np.ndarray, target: np.ndarray) -> Tuple[DataType, int]:
+    """Classify the (preds, target) shape/dtype combination.
+
+    Returns the case and the implied class count (``C`` dim for multi-class
+    probabilities, flattened extra dims for multi-label).
+    """
+    floating = _is_float(preds)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"`preds` and `target` with equal rank must have equal shape; got "
+                f"{preds.shape} vs {target.shape}."
+            )
+        if floating and target.size and target.max() > 1:
+            raise ValueError(
+                "With same-shaped float `preds`, `target` must be binary (0/1)."
+            )
+        if preds.ndim == 1:
+            case = DataType.BINARY if floating else DataType.MULTICLASS
+        else:
+            case = DataType.MULTILABEL if floating else DataType.MULTIDIM_MULTICLASS
+        implied = int(preds[0].size) if preds.size else 0
+        return case, implied
+
+    if preds.ndim == target.ndim + 1:
+        if not floating:
+            raise ValueError(
+                "`preds` with one extra dimension must be float probabilities/logits."
+            )
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "With an extra class dimension, `preds` must be (N, C, ...) and "
+                "`target` (N, ...) over the same trailing dims."
+            )
+        implied = int(preds.shape[1]) if preds.size else 0
+        return (DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS), implied
+
+    raise ValueError(
+        "Shapes must be either identical (N, ...) for both, or (N, C, ...) `preds` "
+        f"with (N, ...) `target`; got {preds.shape} and {target.shape}."
+    )
+
+
+def _validate(
+    preds: np.ndarray,
+    target: np.ndarray,
+    case: DataType,
+    implied: int,
+    top_k: Optional[int],
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    ignore_index: Optional[int],
+) -> None:
+    """The reference's consistency rules, one place (checks.py:96-205,271-302)."""
+    floating = _is_float(preds)
+
+    if target.size and target.min() < 0 and (ignore_index is None or ignore_index >= 0):
+        raise ValueError("`target` must be non-negative.")
+    if not floating and preds.size and preds.min() < 0:
+        raise ValueError("Integer `preds` must be non-negative.")
+    if multiclass is False:
+        if target.size and target.max() > 1:
+            raise ValueError("`multiclass=False` requires `target` values <= 1.")
+        if not floating and preds.size and preds.max() > 1:
+            raise ValueError("`multiclass=False` requires integer `preds` values <= 1.")
+
+    if preds.shape != target.shape:  # C-dim cases
+        if multiclass is False and implied != 2:
+            raise ValueError(
+                "`multiclass=False` needs exactly 2 classes along the C dimension of `preds`."
+            )
+        if target.size and target.max() >= implied:
+            raise ValueError(
+                "The highest `target` label must be below the C dimension of `preds`."
+            )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            if num_classes > 2:
+                raise ValueError("Binary data cannot have `num_classes` > 2.")
+            if num_classes == 2 and not multiclass:
+                raise ValueError(
+                    "Binary data with `num_classes=2` needs `multiclass=True` to be "
+                    "promoted to multi-class format."
+                )
+            if num_classes == 1 and multiclass:
+                raise ValueError(
+                    "Binary data with `multiclass=True` needs `num_classes=2` (or unset)."
+                )
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            if num_classes == 1 and multiclass is not False:
+                raise ValueError(
+                    "`num_classes=1` on multi-class data requires `multiclass=False` "
+                    "(demote two-class data to binary/multi-label)."
+                )
+            if num_classes > 1:
+                if multiclass is False and implied != num_classes:
+                    raise ValueError(
+                        "`multiclass=False` demotion requires `num_classes` to match the "
+                        "implied class count."
+                    )
+                if target.size and num_classes <= target.max():
+                    raise ValueError("The highest `target` label must be below `num_classes`.")
+                if preds.shape != target.shape and num_classes != implied:
+                    raise ValueError("`num_classes` must match the C dimension of `preds`.")
+        else:  # multi-label
+            if multiclass and num_classes != 2:
+                raise ValueError(
+                    "Promoting multi-label data with `multiclass=True` requires "
+                    "`num_classes` of 2 or None."
+                )
+            if not multiclass and num_classes != implied:
+                raise ValueError("`num_classes` must match the implied label count.")
+
+    if top_k is not None:
+        if case == DataType.BINARY:
+            raise ValueError("`top_k` does not apply to binary data.")
+        if not isinstance(top_k, int) or top_k <= 0:
+            raise ValueError("`top_k` must be a positive integer.")
+        if not floating:
+            raise ValueError("`top_k` needs probability `preds`, not labels.")
+        if multiclass is False:
+            raise ValueError("`top_k` cannot combine with `multiclass=False`.")
+        if case == DataType.MULTILABEL and multiclass:
+            raise ValueError("`top_k` cannot combine with multi-label promotion.")
+        if top_k >= implied:
+            raise ValueError("`top_k` must be strictly below the C dimension of `preds`.")
+
+
+def classify_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Auto-classify and canonicalize flexible classification inputs.
+
+    Accepted shapes (mirroring the reference's table, checks.py:315-380):
+
+    ========================  =================  =======================
+    preds                     target             case
+    ========================  =================  =======================
+    float (N,)                binary int (N,)    binary
+    int (N,)                  int (N,)           multi-class
+    float (N, C)              int (N,)           multi-class
+    float (N, ...)            binary int (N,...) multi-label
+    float (N, C, ...)         int (N, ...)       multi-dim multi-class
+    int (N, ...)              int (N, ...)       multi-dim multi-class
+    ========================  =================  =======================
+
+    Returns int binary tensors of shape ``(N, C)`` or ``(N, C, X)`` plus the
+    detected :class:`DataType`.  ``multiclass`` promotes/demotes between the
+    binary and two-class representations exactly as the reference does.
+    """
+    p = np.asarray(preds)
+    t = np.asarray(target)
+
+    if not (p.size == 0 and t.size == 0):
+        if np.issubdtype(t.dtype, np.floating):
+            raise ValueError("`target` must be an integer tensor.")
+        if p.shape[:1] != t.shape[:1]:
+            raise ValueError("`preds` and `target` must agree on the batch dimension.")
+
+    p, t = _squeeze_excess(p, t)
+    if p.dtype == np.float16:
+        p = p.astype(np.float32)
+
+    case, implied = _detect_case(p, t)
+    if not (p.size == 0 and t.size == 0):
+        _validate(p, t, case, implied, top_k, num_classes, multiclass, ignore_index)
+
+    pj = jnp.asarray(p)
+    tj = jnp.asarray(t)
+    preds_are_probs = _is_float(p)
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        pj = (pj >= threshold).astype(jnp.int32)
+        preds_are_probs = False
+        num_classes = 2 if multiclass else num_classes
+    if case == DataType.MULTILABEL and top_k:
+        pj = select_topk(pj, top_k)
+        preds_are_probs = False
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if preds_are_probs:
+            num_classes = p.shape[1]
+            pj = select_topk(pj, top_k or 1)
+        else:
+            if not num_classes:
+                num_classes = int(max(p.max(initial=0), t.max(initial=0)) + 1) if p.size else 1
+            pj = to_onehot(pj, max(2, num_classes))
+        tj = to_onehot(tj, max(2, num_classes))
+        if multiclass is False:
+            pj, tj = pj[:, 1, ...], tj[:, 1, ...]
+
+    if pj.size or tj.size:
+        promote = (
+            case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False
+        ) or multiclass
+        if promote:
+            pj = pj.reshape(pj.shape[0], pj.shape[1], -1)
+            tj = tj.reshape(tj.shape[0], tj.shape[1], -1)
+        else:
+            pj = pj.reshape(pj.shape[0], -1)
+            tj = tj.reshape(tj.shape[0], -1)
+
+    if pj.ndim > 2 and pj.shape[-1] == 1:
+        pj, tj = pj.squeeze(-1), tj.squeeze(-1)
+
+    return pj.astype(jnp.int32), tj.astype(jnp.int32), case
