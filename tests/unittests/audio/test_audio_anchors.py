@@ -1,0 +1,175 @@
+"""Value-level numeric anchors for STOI and SRMR (VERDICT r3 #7).
+
+The delegation targets (pystoi, the SRMR toolbox / gammatone) are not
+installed in this image and cannot be fetched (zero egress), and the COCO-
+style recorded-fixture route is closed for the same reason — so these
+anchors pin VALUES analytically instead of by property:
+
+* exact invariances of the STOI definition (identity = 1.0, scale
+  invariance) that any transcription error in the correlation core breaks;
+* the one-third-octave band matrix against an independent closed form
+  (nearest-bin quantized band edges computed by a different formula than
+  the implementation's argmin scan) — the exact "sign error in the band
+  matrix" blind spot VERDICT r3 weak #4 called out;
+* pure tones at band centers must concentrate their energy in THEIR band;
+* the gammatone filterbank against the Slaney ERB closed forms: uniform
+  ERB-scale spacing, response peaked at cf, and the analytic -3 dB width
+  of a 4th-order gammatone;
+* amplitude-modulation routing for SRMR: 4 Hz AM energy must land in the
+  low modulation bands (SRMR >> 1), 100 Hz AM must not.
+"""
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.audio.srmr import (
+    _erb_center_freqs,
+    _gammatone_fft_weights,
+    speech_reverberation_modulation_energy_ratio as srmr,
+)
+from torchmetrics_tpu.functional.audio.stoi import (
+    FS,
+    MINFREQ,
+    NFFT,
+    NUMBAND,
+    _stft_mag,
+    _thirdoct,
+    short_time_objective_intelligibility as stoi,
+)
+
+
+# ---------------------------------------------------------------------- STOI
+@pytest.mark.parametrize("extended", [False, True])
+@pytest.mark.parametrize("fs", [10000, 16000])
+def test_stoi_identity_is_exactly_one(extended, fs):
+    """d(x, x) = 1: every per-segment correlation of identical signals is 1,
+    and the clipping bound never engages (y' = min(x, x(1+10^(15/20))) = x)."""
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=2 * fs)
+    assert float(stoi(x, x, fs, extended=extended)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_stoi_scale_invariance_is_exact():
+    """The per-(segment, band) energy normalization makes classic STOI
+    invariant to a global gain on either signal."""
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=32000)
+    assert float(stoi(5.0 * x, x, 16000)) == pytest.approx(1.0, abs=1e-6)
+    assert float(stoi(x, 0.1 * x, 16000)) == pytest.approx(1.0, abs=1e-6)
+
+
+def test_thirdoct_matches_independent_closed_form():
+    """Band k spans the FFT bins [round(f_lo/Δ), round(f_hi/Δ)) with
+    f_lo = 150·2^((2k-1)/6), f_hi = 150·2^((2k+1)/6), Δ = fs/nfft.
+
+    This recomputes the band edges with round() instead of the
+    implementation's argmin scan — a sign/order error in either produces a
+    different bin set.
+    """
+    obm, cf = _thirdoct(FS, NFFT, NUMBAND, MINFREQ)
+    k = np.arange(NUMBAND)
+    np.testing.assert_allclose(cf, MINFREQ * 2.0 ** (k / 3.0))
+
+    delta = FS / NFFT
+    lo_bins = np.round(MINFREQ * 2.0 ** ((2 * k - 1) / 6.0) / delta).astype(int)
+    hi_bins = np.round(MINFREQ * 2.0 ** ((2 * k + 1) / 6.0) / delta).astype(int)
+    for i in range(NUMBAND):
+        np.testing.assert_array_equal(
+            np.nonzero(obm[i])[0], np.arange(lo_bins[i], hi_bins[i]),
+            err_msg=f"band {i} bin range mismatch",
+        )
+    # bands tile the axis contiguously: band i ends where band i+1 begins
+    assert all(hi_bins[i] == lo_bins[i + 1] for i in range(NUMBAND - 1))
+
+
+@pytest.mark.parametrize("band", [0, 2, 7, 12, 14])
+def test_pure_tone_energy_lands_in_its_band(band):
+    """A sinusoid at the k-th third-octave center must put its dominant
+    band energy into band k — the direct detector for a transposed or
+    sign-flipped band matrix."""
+    obm, cf = _thirdoct(FS, NFFT, NUMBAND, MINFREQ)
+    t = np.arange(2 * FS) / FS
+    tone = np.sin(2 * np.pi * cf[band] * t)
+    spec = _stft_mag(tone, 256, 128, NFFT).T
+    band_energy = (obm @ spec**2).sum(axis=1)
+    assert int(band_energy.argmax()) == band
+
+
+def test_stoi_known_degradation_values():
+    """Additive white noise at fixed SNRs gives reproducible mid-range
+    values (seeded), pinned with a tolerance wide enough for BLAS/fft
+    variation but far tighter than the property tests' orderings."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=32000)
+    noise = rng.normal(size=32000)
+
+    def at_snr(db):
+        scaled = noise * np.linalg.norm(x) / np.linalg.norm(noise) * 10 ** (-db / 20)
+        return float(stoi(x + scaled, x, 16000))
+
+    dm5, d0, d10 = at_snr(-5.0), at_snr(0.0), at_snr(10.0)
+    assert 1.0 > d10 > d0 > dm5 > 0.0
+    # recorded from this implementation (seeded, deterministic pipeline);
+    # guards against silent numeric drift in any stage
+    assert dm5 == pytest.approx(0.192, abs=0.02)
+    assert d0 == pytest.approx(0.454, abs=0.02)
+    assert d10 == pytest.approx(0.901, abs=0.02)
+
+
+# ---------------------------------------------------------------------- SRMR
+def test_erb_centers_uniform_on_erb_scale():
+    """Slaney ERB scale: e(f) = EarQ·ln(1 + f/(EarQ·minBW)).  The center
+    frequencies must be EQUALLY spaced on e and bracket (low, high)."""
+    ear_q, min_bw = 9.26449, 24.7
+    low, high, n = 125.0, 16000 / 2 * 0.9, 23
+    cfs = _erb_center_freqs(low, high, n)
+
+    def e(f):
+        return ear_q * np.log(1 + f / (ear_q * min_bw))
+
+    steps = np.diff(e(cfs))
+    np.testing.assert_allclose(steps, steps[0], rtol=1e-9)
+    assert low < cfs[0] < cfs[-1] < high
+    # n uniform steps from e(low) span to e(high): cf_k = step positions
+    step = (e(high) - e(low)) / n
+    np.testing.assert_allclose(steps[0], step, rtol=1e-9)
+
+
+def test_gammatone_response_peak_and_bandwidth():
+    """Each filter's FFT-domain response peaks at its center frequency, and
+    its -3 dB full width matches the analytic 4th-order gammatone value:
+    |H| = (1+u²)^(-2) = 2^(-1/2)  ->  u = sqrt(2^(1/4) - 1) ≈ 0.4350,
+    full width = 2·u·b/(2π) with b = 1.019·2π·ERB(cf)."""
+    fs, n = 16000, 16000  # 1 Hz bin resolution
+    cfs = _erb_center_freqs(125.0, fs / 2 * 0.9, 23)
+    weights = _gammatone_fft_weights(fs, n, cfs)
+    freqs = np.fft.rfftfreq(n, 1.0 / fs)
+
+    ear_q, min_bw = 9.26449, 24.7
+    erb = ((cfs / ear_q) ** 4 + min_bw**4) ** 0.25
+    x3db = np.sqrt(2.0 ** 0.25 - 1.0)
+    expected_width = 2 * x3db * (1.019 * 2 * np.pi * erb) / (2 * np.pi)
+
+    for i in range(0, 23, 4):
+        resp = weights[i]
+        assert abs(freqs[resp.argmax()] - cfs[i]) <= 1.0  # peak at cf (±1 bin)
+        above = freqs[resp >= 2 ** (-0.5)]
+        measured = above.max() - above.min()
+        np.testing.assert_allclose(measured, expected_width[i], rtol=0.05)
+
+
+def test_srmr_am_modulation_routing():
+    """AM at 4 Hz (center of the lowest modulation band) concentrates
+    envelope energy in the low bands -> SRMR far above 1; AM at 100 Hz
+    (inside the highest band) must not."""
+    fs = 16000
+    t = np.arange(2 * fs) / fs
+    carrier = np.sin(2 * np.pi * 1000 * t)
+    am_slow = (1 + 0.9 * np.sin(2 * np.pi * 4 * t)) * carrier
+    am_fast = (1 + 0.9 * np.sin(2 * np.pi * 100 * t)) * carrier
+
+    slow = float(srmr(am_slow, fs))
+    fast = float(srmr(am_fast, fs))
+    assert slow > 100.0, f"4 Hz AM should dominate the low modulation bands, got {slow}"
+    assert fast < 2.0, f"100 Hz AM should not, got {fast}"
+    assert slow > 100 * fast

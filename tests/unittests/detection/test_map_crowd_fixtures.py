@@ -1,0 +1,177 @@
+"""Hand-derived COCO crowd-semantics fixtures for MeanAveragePrecision.
+
+pycocotools cannot run in this image (not installed, and the COCO sample
+jsons the reference's test_map.py uses are not mounted), so these fixtures
+are derived BY HAND from the COCOeval algorithm (cocoeval.py evaluateImg/
+accumulate), with every step written out.  Each case is constructed so that
+an implementation missing the specific crowd rule produces a DIFFERENT
+number — they discriminate, not just exercise:
+
+  1. crowd multi-match: a crowd gt absorbs several high-scoring dets that
+     a crowd-blind evaluator would count as score-leading FPs;
+  2. non-ignored priority: a lower-IoU non-crowd gt must win over a
+     higher-IoU overlapping crowd gt;
+  3. area-range interplay: crowd ignore + out-of-range unmatched-det
+     ignore inside the small/medium/large splits;
+  4. threshold-dependent crowd eligibility: a det is crowd-ignored at
+     IoU .5 but becomes a real FP at .55+.
+
+Reference gold standard these rules mirror: pycocotools semantics as
+embedded in the reference (detection/mean_ap.py:528 delegates to COCOeval).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+
+def _image(preds_rows, gt_rows):
+    """rows: (box, score|None, label, iscrowd-for-gt)."""
+    preds = dict(
+        boxes=jnp.asarray([r[0] for r in preds_rows], jnp.float32).reshape(-1, 4),
+        scores=jnp.asarray([r[1] for r in preds_rows], jnp.float32),
+        labels=jnp.asarray([r[2] for r in preds_rows], jnp.int32),
+    )
+    target = dict(
+        boxes=jnp.asarray([r[0] for r in gt_rows], jnp.float32).reshape(-1, 4),
+        labels=jnp.asarray([r[1] for r in gt_rows], jnp.int32),
+        iscrowd=jnp.asarray([r[2] for r in gt_rows], jnp.int32),
+    )
+    return [preds], [target]
+
+
+@pytest.mark.parametrize("backend", ["native", "native_numpy"])
+def test_crowd_absorbs_score_leading_dets(backend):
+    """Case 1: crowd gt absorbs TWO dets that outscore / follow the TP.
+
+    gts:  A=[0,0,10,10] (real), B=[20,20,40,40] (crowd)
+    dets: d2=[20,20,30,30] s=.95 — crowd IoU vs B = 100/100 = 1.0 (union is
+            the DET area for crowd) -> matched to B -> ignored
+          d1=[0,0,10,10]  s=.90 — IoU vs A = 1.0 -> TP
+          d3=[25,25,35,35] s=.70 — crowd IoU vs B = 1.0; B is already
+            matched but crowd gts accept multiple matches -> ignored
+          d4=[60,60,70,70] s=.60 — no overlap -> FP
+    All these IoUs are exact 1.0/0.0, so every IoU threshold behaves alike.
+    nGT (non-ignored) = 1.
+
+    Score-ordered NON-IGNORED dets: d1 TP (p=1, r=1), d4 FP.  The 101-point
+    envelope has precision 1.0 at recall 1.0 -> AP = 1.0 at all thresholds.
+
+    A crowd-blind evaluator counts d2 as the top-scoring FP: the envelope at
+    recall 1 drops to 1/2 -> AP = 0.5.  This case separates the two.
+
+    mar_1: with maxDets=1 only d2 survives the cap; it is crowd-ignored, so
+    no non-ignored det exists -> recall 0.
+    """
+    preds, target = _image(
+        [([20, 20, 30, 30], 0.95, 0), ([0, 0, 10, 10], 0.90, 0),
+         ([25, 25, 35, 35], 0.70, 0), ([60, 60, 70, 70], 0.60, 0)],
+        [([0, 0, 10, 10], 0, 0), ([20, 20, 40, 40], 0, 1)],
+    )
+    m = MeanAveragePrecision(backend=backend)
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_50"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_75"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["mar_100"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["mar_1"]) == pytest.approx(0.0, abs=1e-6)
+
+
+@pytest.mark.parametrize("backend", ["native", "native_numpy"])
+def test_non_crowd_priority_beats_higher_iou_crowd(backend):
+    """Case 2: the matcher must prefer a lower-IoU REAL gt over a
+    higher-IoU crowd gt.
+
+    gts:  A=[0,0,10,10] (real), C=[0,0,12,12] (crowd), overlapping.
+    det:  d1=[0,0,11,11] s=.9
+          IoU vs A      = 100 / (121 + 100 - 100) = 100/121 ~= 0.8264
+          crowd IoU vs C = 121 / 121 = 1.0  (union = det area)
+
+    COCOeval scans non-ignored gts first and KEEPS a non-ignored match even
+    when an ignored gt has higher IoU.  So for t in {.50...80} (7 of the 10
+    thresholds, 0.8264 >= t): d1 -> A, TP, AP(t) = 1.  For t in {.85,.90,.95}
+    A is ineligible and d1 matches the crowd -> ignored; no non-ignored det
+    and recall 0 -> AP(t) = 0.
+
+    map = 7/10 = 0.7; a highest-IoU-first matcher would send d1 to the
+    crowd at EVERY threshold -> map = 0.
+    """
+    preds, target = _image(
+        [([0, 0, 11, 11], 0.9, 0)],
+        [([0, 0, 10, 10], 0, 0), ([0, 0, 12, 12], 0, 1)],
+    )
+    m = MeanAveragePrecision(backend=backend)
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.7, abs=1e-6)
+    assert float(res["map_50"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_75"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["mar_100"]) == pytest.approx(0.7, abs=1e-6)
+
+
+@pytest.mark.parametrize("backend", ["native", "native_numpy"])
+def test_crowd_and_area_ranges(backend):
+    """Case 3: crowd ignore composes with the small/medium/large splits and
+    with the unmatched-out-of-range det rule.
+
+    gts:  A=[0,0,10,10] real, area 100 (small: < 32^2)
+          B=[20,20,40,40] crowd, area 400 (small)
+    dets: d3=[50,50,90,90] s=.95, area 1600 (medium), no overlap
+          d1=[0,0,10,10]  s=.90 -> TP on A (IoU 1.0)
+          d2=[20,20,30,30] s=.80 -> crowd-ignored on B
+
+    "all" range: d3 is in range -> real top-scoring FP; sequence d3 FP,
+    d1 TP => precision at recall 1 is 1/2 -> AP = 0.5 at all thresholds.
+
+    "small" range: d3 is OUT of range and unmatched -> ignored (not FP);
+    d1 TP, d2 crowd-ignored -> AP_small = 1.0.  A rule-blind evaluator
+    counts d3 -> 0.5.
+
+    "medium"/"large": no non-ignored gt at all -> -1 sentinel.
+    """
+    preds, target = _image(
+        [([50, 50, 90, 90], 0.95, 0), ([0, 0, 10, 10], 0.90, 0), ([20, 20, 30, 30], 0.80, 0)],
+        [([0, 0, 10, 10], 0, 0), ([20, 20, 40, 40], 0, 1)],
+    )
+    m = MeanAveragePrecision(backend=backend)
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.5, abs=1e-6)
+    assert float(res["map_small"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_medium"]) == pytest.approx(-1.0, abs=1e-6)
+    assert float(res["map_large"]) == pytest.approx(-1.0, abs=1e-6)
+    assert float(res["mar_100"]) == pytest.approx(1.0, abs=1e-6)
+
+
+@pytest.mark.parametrize("backend", ["native", "native_numpy"])
+def test_crowd_eligibility_is_threshold_dependent(backend):
+    """Case 4: a det crowd-matches at IoU .5 exactly, and becomes a true FP
+    at every higher threshold.
+
+    gts:  A=[0,0,10,10] real; B=[20,20,40,40] crowd
+    dets: d5=[15,25,25,35] s=.95
+            inter with B: x [20,25]=5, y [25,35]=10 -> 50; det area 100
+            crowd IoU = 50/100 = 0.5 exactly
+          d1=[0,0,10,10] s=.90 -> IoU 1.0 vs A
+
+    t=.50: d5 -> crowd-ignored; d1 TP -> AP = 1.0
+    t>=.55: d5 unmatched, in range -> FP ahead of the TP; envelope at
+            recall 1 = 1/2 -> AP = 0.5
+    map = (1.0 + 9*0.5)/10 = 0.55; map_50 = 1.0; map_75 = 0.5.
+
+    (The exact-0.5 IoU also pins the >= comparison and the float32
+    tie-break shared by both backends.)
+    """
+    preds, target = _image(
+        [([15, 25, 25, 35], 0.95, 0), ([0, 0, 10, 10], 0.90, 0)],
+        [([0, 0, 10, 10], 0, 0), ([20, 20, 40, 40], 0, 1)],
+    )
+    m = MeanAveragePrecision(backend=backend)
+    m.update(preds, target)
+    res = m.compute()
+    assert float(res["map"]) == pytest.approx(0.55, abs=1e-6)
+    assert float(res["map_50"]) == pytest.approx(1.0, abs=1e-6)
+    assert float(res["map_75"]) == pytest.approx(0.5, abs=1e-6)

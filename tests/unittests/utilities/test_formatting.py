@@ -64,7 +64,9 @@ PARAM_GRID = [
 @pytest.mark.parametrize("case", CASES)
 @pytest.mark.parametrize("params", PARAM_GRID, ids=[str(p) for p in PARAM_GRID])
 def test_classify_inputs_reference_parity(case, params):
-    rng = np.random.default_rng(hash(case) % 2**31)
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(case.encode()))
     for _ in range(3):
         preds, target = _gen(case, rng)
 
@@ -111,3 +113,33 @@ def test_classify_inputs_rejects_mismatched_shapes():
         classify_inputs(np.zeros((4, 3, 2), np.int64), np.zeros((4,), np.int64))
     with pytest.raises(ValueError):
         classify_inputs(np.zeros((4,), np.float32), np.zeros((4,), np.float32))  # float target
+
+
+def test_classify_inputs_bfloat16_probs():
+    """bfloat16 probabilities — the native TPU dtype — must classify as
+    float probabilities, not integer labels."""
+    import jax.numpy as jnp
+
+    probs = jnp.asarray([0.2, 0.7, 0.9, 0.1], jnp.bfloat16)
+    target = np.asarray([0, 1, 1, 0])
+    p, t, case = classify_inputs(probs, target)
+    assert case.value == "binary"
+    np.testing.assert_array_equal(np.asarray(p).ravel(), [0, 1, 1, 0])
+
+
+def test_classify_inputs_out_of_range_int_preds_raise():
+    """Integer preds >= num_classes must raise (the reference rejects via
+    its scatter; a silent zero one-hot row would corrupt downstream stats)."""
+    with pytest.raises(ValueError, match="preds"):
+        classify_inputs(np.asarray([5, 0]), np.asarray([0, 1]), num_classes=4)
+
+
+def test_classify_inputs_ignore_index_zero_quirk():
+    """ignore_index=0 disables the target-negativity check exactly like the
+    reference's falsy-zero condition (checks.py:62); ignore_index=1 keeps it."""
+    preds = np.asarray([0.5, 0.6], np.float32)
+    ref = _ref_format(preds, np.asarray([-1, 1]), ignore_index=0)
+    ours = classify_inputs(preds, np.asarray([-1, 1]), ignore_index=0)
+    assert ours[2].value == ref[2].value
+    with pytest.raises(ValueError):
+        classify_inputs(preds, np.asarray([-1, 1]), ignore_index=1)

@@ -30,7 +30,9 @@ __all__ = ["classify_inputs", "DataType"]
 
 
 def _is_float(x: np.ndarray) -> bool:
-    return np.issubdtype(x.dtype, np.floating)
+    # np.issubdtype is False for ml_dtypes.bfloat16 — the dtype TPU
+    # probabilities most commonly arrive in — so check it by name
+    return np.issubdtype(x.dtype, np.floating) or x.dtype.name == "bfloat16"
 
 
 def _squeeze_excess(preds: np.ndarray, target: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -97,7 +99,11 @@ def _validate(
     """The reference's consistency rules, one place (checks.py:96-205,271-302)."""
     floating = _is_float(preds)
 
-    if target.size and target.min() < 0 and (ignore_index is None or ignore_index >= 0):
+    # mirrors the reference's exact condition (checks.py:62), including its
+    # falsy-zero quirk: ignore_index=0 disables the negativity check
+    if target.size and target.min() < 0 and (
+        ignore_index is None or (ignore_index and ignore_index >= 0)
+    ):
         raise ValueError("`target` must be non-negative.")
     if not floating and preds.size and preds.min() < 0:
         raise ValueError("Integer `preds` must be non-negative.")
@@ -144,6 +150,10 @@ def _validate(
                     )
                 if target.size and num_classes <= target.max():
                     raise ValueError("The highest `target` label must be below `num_classes`.")
+                if not floating and preds.size and num_classes <= preds.max():
+                    # the reference rejects this via its scatter one-hot;
+                    # jax.nn.one_hot would silently emit a zero row instead
+                    raise ValueError("The highest `preds` label must be below `num_classes`.")
                 if preds.shape != target.shape and num_classes != implied:
                     raise ValueError("`num_classes` must match the C dimension of `preds`.")
         else:  # multi-label
@@ -208,7 +218,7 @@ def classify_inputs(
             raise ValueError("`preds` and `target` must agree on the batch dimension.")
 
     p, t = _squeeze_excess(p, t)
-    if p.dtype == np.float16:
+    if p.dtype == np.float16 or p.dtype.name == "bfloat16":
         p = p.astype(np.float32)
 
     case, implied = _detect_case(p, t)
