@@ -1,0 +1,51 @@
+"""Executable docstring examples (VERDICT r3 #10).
+
+The reference runs every metric docstring as a test (``--doctest-modules``,
+reference Makefile:28, pyproject.toml:116-121).  Here each module carrying
+``Example::`` blocks is doctested explicitly, and the runner asserts the
+examples were actually FOUND — a renamed class or dedented block cannot
+silently drop coverage.
+"""
+
+import doctest
+import importlib
+
+import pytest
+
+# module -> minimum number of doctest examples expected in it
+DOCTEST_MODULES = {
+    "torchmetrics_tpu.classification.accuracy": 2,
+    "torchmetrics_tpu.classification.f_beta": 2,
+    "torchmetrics_tpu.classification.auroc": 2,
+    "torchmetrics_tpu.classification.average_precision": 1,
+    "torchmetrics_tpu.classification.confusion_matrix": 1,
+    "torchmetrics_tpu.classification.cohen_kappa": 1,
+    "torchmetrics_tpu.classification.matthews_corrcoef": 1,
+    "torchmetrics_tpu.regression.errors": 2,
+    "torchmetrics_tpu.regression.variance": 2,
+    "torchmetrics_tpu.regression.correlation": 2,
+    "torchmetrics_tpu.image.psnr": 1,
+    "torchmetrics_tpu.text.bleu": 1,
+    "torchmetrics_tpu.text.asr": 2,
+    "torchmetrics_tpu.retrieval.metrics": 1,
+    "torchmetrics_tpu.aggregation": 1,
+}
+
+
+@pytest.mark.parametrize("module_name", sorted(DOCTEST_MODULES))
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE)
+    n_classes_with_examples = 0
+    for test in finder.find(module, module_name):
+        if not test.examples:
+            continue
+        n_classes_with_examples += 1
+        runner.run(test)
+    assert n_classes_with_examples >= DOCTEST_MODULES[module_name], (
+        f"{module_name}: expected >= {DOCTEST_MODULES[module_name]} docstring examples, "
+        f"found {n_classes_with_examples} — example blocks lost?"
+    )
+    results = runner.summarize(verbose=False)
+    assert results.failed == 0, f"{module_name}: {results.failed} doctest failure(s)"

@@ -138,7 +138,16 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean (reference: aggregation.py:493-615)."""
+    """Weighted running mean (reference: aggregation.py:493-615).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> round(float(metric.compute()), 4)
+        2.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("mean_value", jnp.zeros(()), "sum", nan_strategy, **kwargs)

@@ -14,6 +14,17 @@ from torchmetrics_tpu.core.metric import Metric, State
 
 
 class BinaryAccuracy(BinaryStatScores):
+    """Binary accuracy: fraction of thresholded predictions matching targets (reference classification/accuracy.py:461).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = BinaryAccuracy()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
     _stat_kind = "accuracy"
     is_differentiable = False
     higher_is_better = True
@@ -26,6 +37,17 @@ class BinaryAccuracy(BinaryStatScores):
 
 
 class MulticlassAccuracy(MulticlassStatScores):
+    """Multiclass accuracy over int labels or (N, C) probabilities (reference classification/accuracy.py:151).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = MulticlassAccuracy(num_classes=3, average='micro')
+        >>> metric.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
     _stat_kind = "accuracy"
     is_differentiable = False
     higher_is_better = True

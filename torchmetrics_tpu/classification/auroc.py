@@ -21,6 +21,17 @@ from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide
 
 
 class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Area under the binary ROC curve (reference classification/auroc.py:40).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAUROC
+        >>> metric = BinaryAUROC()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -44,6 +55,18 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """Macro-averaged one-vs-rest multiclass AUROC (reference classification/auroc.py:151).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAUROC
+        >>> metric = MulticlassAUROC(num_classes=3)
+        >>> probs = jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> metric.update(probs, jnp.asarray([0, 1, 1, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.8056
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

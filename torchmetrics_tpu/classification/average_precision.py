@@ -37,6 +37,18 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
+    """Macro-averaged area under the precision-recall curve (reference classification/average_precision.py:157).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAveragePrecision
+        >>> metric = MulticlassAveragePrecision(num_classes=3)
+        >>> probs = jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> metric.update(probs, jnp.asarray([0, 1, 1, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.7778
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

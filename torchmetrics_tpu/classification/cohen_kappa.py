@@ -14,6 +14,17 @@ from torchmetrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_
 
 
 class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Cohen's kappa: chance-corrected agreement (reference classification/cohen_kappa.py:26).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryCohenKappa
+        >>> metric = BinaryCohenKappa()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.0
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

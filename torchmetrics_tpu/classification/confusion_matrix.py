@@ -50,6 +50,17 @@ class BinaryConfusionMatrix(_ConfusionMatrixBase):
 
 
 class MulticlassConfusionMatrix(_ConfusionMatrixBase):
+    """(C, C) confusion matrix, rows = true class (reference classification/confusion_matrix.py:157).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> [row for row in metric.compute().tolist()]
+        [[1, 0, 0], [0, 1, 0], [0, 1, 1]]
+    """
     def __init__(self, num_classes: int, normalize: Optional[str] = None,
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -81,12 +81,34 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
+    """Binary F1 (harmonic precision/recall mean; reference classification/f_beta.py:185).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryF1Score
+        >>> metric = BinaryF1Score()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
     def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(1.0, threshold, multidim_average, ignore_index, validate_args, **kwargs)
 
 
 class MulticlassF1Score(MulticlassFBetaScore):
+    """Multiclass F1 (reference classification/f_beta.py:322).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassF1Score
+        >>> metric = MulticlassF1Score(num_classes=3, average='macro')
+        >>> metric.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.7778
+    """
     def __init__(self, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:

@@ -31,6 +31,17 @@ class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
 
 
 class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
+    """Matthews correlation from the confusion matrix (reference classification/matthews_corrcoef.py:95).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassMatthewsCorrCoef
+        >>> metric = MulticlassMatthewsCorrCoef(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.7
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

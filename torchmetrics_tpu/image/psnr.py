@@ -20,7 +20,18 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 class PeakSignalNoiseRatio(Metric):
     """PSNR; scalar sum states when ``dim`` is None, cat states otherwise;
     data range inferred via min/max states when not given (reference
-    image/psnr.py:31-150)."""
+    image/psnr.py:31-150).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+        >>> preds = jnp.full((1, 3, 8, 8), 0.4)
+        >>> target = jnp.full((1, 3, 8, 8), 0.5)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        20.0
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -29,6 +29,17 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 
 class PearsonCorrCoef(Metric):
+    """Streaming Pearson correlation from mergeable moment states.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.9849
+    """
     is_differentiable = True
     higher_is_better = None
     full_state_update = True
@@ -136,6 +147,17 @@ class _CatCorrBase(Metric):
 
 
 class SpearmanCorrCoef(_CatCorrBase):
+    """Spearman rank correlation over the full accumulated sample (reference regression/spearman.py:28).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SpearmanCorrCoef
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     higher_is_better = None
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0

@@ -48,6 +48,17 @@ class _SumCountMetric(Metric):
 
 
 class MeanSquaredError(_SumCountMetric):
+    """Mean squared error (reference regression/mse.py:27).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MeanSquaredError()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.375
+    """
     def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(num_outputs=num_outputs, **kwargs)
         if not isinstance(squared, bool):
@@ -64,6 +75,17 @@ class MeanSquaredError(_SumCountMetric):
 
 
 class MeanAbsoluteError(_SumCountMetric):
+    """Mean absolute error (reference regression/mae.py:26).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(num_outputs=num_outputs, **kwargs)
 

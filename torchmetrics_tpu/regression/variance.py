@@ -17,6 +17,17 @@ from torchmetrics_tpu.functional.regression.variance import (
 
 
 class R2Score(Metric):
+    """Coefficient of determination (reference regression/r2.py:32).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> metric = R2Score()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.9486
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -56,6 +67,17 @@ class R2Score(Metric):
 
 
 class ExplainedVariance(Metric):
+    """Explained variance ratio (reference regression/explained_variance.py:30).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ExplainedVariance
+        >>> metric = ExplainedVariance()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.9572
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

@@ -35,7 +35,16 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean Average Precision (reference retrieval/average_precision.py:28)."""
+    """Mean Average Precision (reference retrieval/average_precision.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> metric = RetrievalMAP()
+        >>> metric.update(jnp.asarray([0.2, 0.3, 0.5, 0.1]), jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 0, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -47,13 +47,31 @@ class _ErrorRateMetric(Metric):
 
 
 class WordErrorRate(_ErrorRateMetric):
-    """WER (reference text/wer.py:28)."""
+    """WER (reference text/wer.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     _update_fn = staticmethod(_wer_update)
 
 
 class CharErrorRate(_ErrorRateMetric):
-    """CER (reference text/cer.py:28)."""
+    """CER (reference text/cer.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> round(float(metric.compute()), 4)
+        0.381
+    """
 
     _update_fn = staticmethod(_cer_update)
 

@@ -15,7 +15,16 @@ from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _S
 
 class BLEUScore(Metric):
     """Corpus BLEU; states = per-order numerator/denominator + length sums
-    (reference text/bleu.py:33-130)."""
+    (reference text/bleu.py:33-130).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> metric = BLEUScore(n_gram=2)
+        >>> metric.update(["the cat is on the mat"], [["a cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        0.8165
+    """
 
     is_differentiable = False
     higher_is_better = True
