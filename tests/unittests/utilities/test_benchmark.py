@@ -1,0 +1,27 @@
+"""utilities.benchmark: jitted metric micro-benchmark helper."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.utilities import benchmark
+
+
+def test_benchmark_reports_timings_and_state():
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    probs = jnp.asarray(np.random.default_rng(0).uniform(size=(16, 5)), jnp.float32)
+    target = jnp.asarray(np.random.default_rng(1).integers(0, 5, 16))
+    rep = benchmark(m, probs, target, steps=10, n_devices=8)
+    assert rep["metric"] == "MulticlassAccuracy"
+    assert rep["update_us"] > 0 and rep["compute_us"] > 0
+    assert rep["state_bytes"] > 0 and rep["state_leaves"] >= 1
+    assert rep["sync_bytes_per_chip"] > 0
+
+
+def test_benchmark_rejects_list_state_metrics():
+    from torchmetrics_tpu.regression import SpearmanCorrCoef
+
+    with pytest.raises(ValueError, match="cat"):
+        benchmark(SpearmanCorrCoef(), jnp.zeros(4), jnp.zeros(4))

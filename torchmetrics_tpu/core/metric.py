@@ -174,14 +174,22 @@ class Metric:
         return st
 
     def update_state(self, state: State, *args: Any, **kwargs: Any) -> State:
-        """Pure update: returns a new state with this batch folded in."""
-        new = dict(self._update(state, *args, **kwargs))
-        new[_N] = state[_N] + 1
-        return new
+        """Pure update: returns a new state with this batch folded in.
+
+        Wrapped in a ``jax.named_scope`` so a metric's update subgraph shows
+        up as ``<ClassName>.update`` in XLA/Perfetto profiles (the SURVEY §5
+        tracing plan; the reference has no device-side equivalent to name).
+        """
+        with jax.named_scope(f"{type(self).__name__}.update"):
+            new = dict(self._update(state, *args, **kwargs))
+            new[_N] = state[_N] + 1
+            return new
 
     def compute_state(self, state: State) -> Any:
-        """Pure compute on a state pytree."""
-        return self._compute(state)
+        """Pure compute on a state pytree (named ``<ClassName>.compute`` in
+        profiles)."""
+        with jax.named_scope(f"{type(self).__name__}.compute"):
+            return self._compute(state)
 
     def merge_states(self, a: State, b: State) -> State:
         """Combine two states under the per-leaf reduction table (pure).

@@ -408,26 +408,27 @@ class MeanAveragePrecision(Metric):
                     fps = ~tp & ~ig
                     tp_cum = np.cumsum(tps, axis=1).astype(np.float64)
                     fp_cum = np.cumsum(fps, axis=1).astype(np.float64)
-                    for ti in range(T):
-                        tp_c, fp_c = tp_cum[ti], fp_cum[ti]
-                        nd = len(tp_c)
-                        rc = tp_c / npig
-                        pr = tp_c / np.maximum(fp_c + tp_c, np.spacing(1))
-                        recall[ti, ki, ai, mi] = rc[-1] if nd else 0.0
-                        # monotone precision from the right (pycocotools accumulate)
-                        pr = pr.tolist()
-                        for i in range(nd - 1, 0, -1):
-                            if pr[i] > pr[i - 1]:
-                                pr[i - 1] = pr[i]
-                        inds = np.searchsorted(rc, rec_thrs, side="left")
-                        q = np.zeros(R)
-                        ss = np.zeros(R)
-                        for ri, pi in enumerate(inds):
-                            if pi < nd:
-                                q[ri] = pr[pi]
-                                ss[ri] = scores[pi]
-                        precision[ti, :, ki, ai, mi] = q
-                        scores_out[ti, :, ki, ai, mi] = ss
+                    nd = tp_cum.shape[1]
+                    rc = tp_cum / npig  # (T, nd), nondecreasing per row
+                    pr = tp_cum / np.maximum(fp_cum + tp_cum, np.spacing(1))
+                    recall[:, ki, ai, mi] = rc[:, -1] if nd else 0.0
+                    # monotone precision envelope from the right (pycocotools
+                    # accumulate) = reversed running max, all thresholds at once
+                    pr_env = np.flip(np.maximum.accumulate(np.flip(pr, axis=1), axis=1), axis=1)
+                    # first index with rc >= r per (threshold, recall point);
+                    # a T-length searchsorted loop (T ~ 10), NOT a broadcast —
+                    # (T, R, nd) booleans would be ~0.5 GB at COCO scale
+                    inds = (
+                        np.stack([np.searchsorted(rc[ti], rec_thrs, side="left") for ti in range(T)])
+                        if nd
+                        else np.zeros((T, R), dtype=np.int64)
+                    )
+                    hit = inds < nd
+                    safe = np.minimum(inds, max(nd - 1, 0))
+                    q = np.where(hit, np.take_along_axis(pr_env, safe, axis=1), 0.0) if nd else np.zeros((T, R))
+                    ss = np.where(hit, scores[safe], 0.0) if nd else np.zeros((T, R))
+                    precision[:, :, ki, ai, mi] = q
+                    scores_out[:, :, ki, ai, mi] = ss
 
         def _summarize(ap: bool, iou_thr: Optional[float] = None, area: str = "all", mdet: int = 100) -> float:
             ai = area_names.index(area)

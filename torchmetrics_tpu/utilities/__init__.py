@@ -5,11 +5,13 @@ from torchmetrics_tpu.utilities.data import (
     dim_zero_min,
     dim_zero_sum,
 )
+from torchmetrics_tpu.utilities.benchmark import benchmark
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
 from torchmetrics_tpu.utilities.formatting import classify_inputs
 from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
 
 __all__ = [
+    "benchmark",
     "classify_inputs",
     "dim_zero_cat",
     "dim_zero_max",

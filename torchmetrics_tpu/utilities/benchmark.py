@@ -1,0 +1,102 @@
+"""Metric micro-benchmark helper (SURVEY §5 row 1: ``metrics.benchmark()``).
+
+The reference's only perf tool is ``check_forward_full_state_property``
+(reference utilities/checks.py:636), which wall-clock-times the two eager
+forward paths.  On TPU the interesting questions differ: how much device
+time does the *jitted* update subgraph cost, how big is the sync'd state,
+and how much collective traffic does a mesh sync move.  ``benchmark``
+answers all three for any metric instance.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+from torchmetrics_tpu.core.reductions import Reduce
+
+__all__ = ["benchmark"]
+
+
+def _state_bytes(state: Dict[str, Any]) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(state):
+        total += int(leaf.size) * leaf.dtype.itemsize
+    return total
+
+
+def benchmark(
+    metric: Any,
+    *example_inputs: Any,
+    steps: int = 100,
+    warmup: int = 2,
+    n_devices: Optional[int] = None,
+    **example_kwargs: Any,
+) -> Dict[str, Any]:
+    """Measure a metric's jitted update/compute cost and sync footprint.
+
+    Args:
+        metric: a metric instance (its state must be jit-compatible —
+            tensor states, not list states).
+        example_inputs: one representative batch for ``update``.
+        steps: timed iterations (chained, so the device queue stays full).
+        warmup: untimed compile+warmup calls.
+        n_devices: when given, also reports the analytic per-chip reduce
+            traffic of one state sync over that many devices.
+
+    Returns a dict with ``update_us``, ``compute_us``, ``state_bytes``,
+    ``state_leaves`` and (optionally) ``sync_bytes_per_chip``.
+    """
+    if getattr(metric, "_has_list_states", False):
+        raise ValueError(
+            f"{type(metric).__name__} holds list (cat) states, which grow per step and "
+            "cannot be timed as a fixed jitted subgraph; benchmark its functional kernel "
+            "directly instead."
+        )
+
+    update = jax.jit(metric.update_state)
+    compute = jax.jit(metric.compute_state)
+
+    state = metric.init_state()
+    for _ in range(max(warmup, 1)):
+        state = update(state, *example_inputs, **example_kwargs)
+    jax.block_until_ready(state)
+    result = compute(state)
+    jax.block_until_ready(result)
+
+    start = time.perf_counter()
+    out = metric.init_state()
+    for _ in range(steps):
+        out = update(out, *example_inputs, **example_kwargs)
+    jax.block_until_ready(out)
+    update_us = (time.perf_counter() - start) / steps * 1e6
+
+    start = time.perf_counter()
+    for _ in range(steps):
+        result = compute(out)
+    jax.block_until_ready(result)
+    compute_us = (time.perf_counter() - start) / steps * 1e6
+
+    report: Dict[str, Any] = {
+        "metric": type(metric).__name__,
+        "update_us": round(update_us, 2),
+        "compute_us": round(compute_us, 2),
+        "state_bytes": _state_bytes(out),
+        "state_leaves": len(jax.tree.leaves(out)),
+        "device": jax.devices()[0].platform,
+    }
+    if n_devices is not None and n_devices > 1:
+        psum_b = cat_b = 0
+        for name, reduce in metric._reductions.items():
+            leaf = out[name]
+            nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+            if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN):
+                psum_b += nbytes  # ring all-reduce: 2(n-1)/n of the buffer per chip
+            else:
+                cat_b += nbytes  # all_gather: (n-1) x local bytes received per chip
+        report["sync_bytes_per_chip"] = int(
+            round(2 * (n_devices - 1) / n_devices * psum_b + (n_devices - 1) * cat_b)
+        )
+    return report
