@@ -152,8 +152,8 @@ def make_steps():
     return plain_step, metric_step, init_states, metrics
 
 
-PAIRS = int(os.environ.get("BENCH_PAIRS", 50))  # interleaved A/B pairs
-INNER = int(os.environ.get("BENCH_INNER", 4))  # steps per timing burst
+PAIRS = int(os.environ.get("BENCH_PAIRS", 80))  # interleaved A/B pairs
+INNER = int(os.environ.get("BENCH_INNER", 8))  # steps per timing burst
 
 
 def interleaved_ab(plain_step, metric_step, params, init_states, x, y, pairs=PAIRS):
@@ -210,18 +210,14 @@ def metric_subgraph_us(init_states, metrics, y, steps=200):
     return (time.perf_counter() - start) / steps * 1e6
 
 
-def _leaf_bytes(v):
-    if isinstance(v, tuple):
-        return sum(int(a.size) * a.dtype.itemsize for a in v)
-    return int(v.size) * v.dtype.itemsize
-
-
 def state_reduce_bytes_table():
     """Analytic per-chip reduce traffic for the BASELINE.json configs, 1→64
-    chips.  psum states ride a ring all-reduce (2·(n−1)/n · bytes per chip);
-    cat/None list states all_gather ((n−1) · local bytes received per chip).
-    State sizes are static — no hardware needed (VERDICT r2 next #4).
+    chips, using the library's shared cost model
+    (torchmetrics_tpu.utilities.benchmark.split_state_bytes /
+    sync_bytes_per_chip).  State sizes are static — no hardware needed
+    (VERDICT r2 next #4).
     """
+    from torchmetrics_tpu.utilities.benchmark import split_state_bytes, sync_bytes_per_chip
     from torchmetrics_tpu import MetricCollection
     from torchmetrics_tpu.classification import MulticlassAUROC as AUROC5
     from torchmetrics_tpu.classification import MulticlassF1Score as F15
@@ -283,17 +279,15 @@ def state_reduce_bytes_table():
     for name, ms in configs.items():
         psum_b = cat_b = 0
         for m in ms:
-            for sname, reduce in m._reductions.items():
-                b = _leaf_bytes(m._state[sname])
-                if reduce in ("sum", "mean", "max", "min"):
-                    psum_b += b
-                else:  # cat / None list states
-                    cat_b += b
+            p, c = split_state_bytes(m._reductions, m._state)
+            psum_b += p
+            cat_b += c
         table[name] = {
             "psum_state_bytes": psum_b,
             "cat_state_bytes_per_step": cat_b,
             "per_chip_reduce_bytes": {
-                str(n): int(round(2 * (n - 1) / n * psum_b + (n - 1) * cat_b)) for n in chips
+                str(n): sum(sync_bytes_per_chip(m._reductions, m._state, n) for m in ms)
+                for n in chips
             },
         }
     return table

@@ -17,14 +17,41 @@ import jax
 
 from torchmetrics_tpu.core.reductions import Reduce
 
-__all__ = ["benchmark"]
+__all__ = ["benchmark", "state_bytes", "sync_bytes_per_chip"]
 
 
-def _state_bytes(state: Dict[str, Any]) -> int:
+def state_bytes(state: Dict[str, Any]) -> int:
+    """Total bytes held by a state pytree."""
     total = 0
     for leaf in jax.tree.leaves(state):
         total += int(leaf.size) * leaf.dtype.itemsize
     return total
+
+
+def split_state_bytes(reductions: Dict[str, Any], state: Dict[str, Any]) -> tuple:
+    """``(psum_bytes, gather_bytes)`` of a state under its reduction table:
+    sum/mean/max/min leaves all-reduce; cat/None/callable leaves all_gather
+    (matching what ``core.reductions.sync_leaf`` lowers each to)."""
+    psum_b = gather_b = 0
+    for name, reduce in reductions.items():
+        leaf = state[name]
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+        if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN):
+            psum_b += nbytes
+        else:
+            gather_b += nbytes
+    return psum_b, gather_b
+
+
+def sync_bytes_per_chip(reductions: Dict[str, Any], state: Dict[str, Any], n_devices: int) -> int:
+    """Analytic per-chip traffic of one state sync over ``n_devices``.
+
+    psum-family states ride a ring all-reduce (``2(n-1)/n`` of the buffer per
+    chip); gathered states receive ``(n-1) x`` local bytes per chip.  One
+    cost model shared by :func:`benchmark` and ``bench.py``.
+    """
+    psum_b, gather_b = split_state_bytes(reductions, state)
+    return int(round(2 * (n_devices - 1) / n_devices * psum_b + (n_devices - 1) * gather_b))
 
 
 def benchmark(
@@ -83,20 +110,10 @@ def benchmark(
         "metric": type(metric).__name__,
         "update_us": round(update_us, 2),
         "compute_us": round(compute_us, 2),
-        "state_bytes": _state_bytes(out),
+        "state_bytes": state_bytes(out),
         "state_leaves": len(jax.tree.leaves(out)),
         "device": jax.devices()[0].platform,
     }
     if n_devices is not None and n_devices > 1:
-        psum_b = cat_b = 0
-        for name, reduce in metric._reductions.items():
-            leaf = out[name]
-            nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
-            if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN):
-                psum_b += nbytes  # ring all-reduce: 2(n-1)/n of the buffer per chip
-            else:
-                cat_b += nbytes  # all_gather: (n-1) x local bytes received per chip
-        report["sync_bytes_per_chip"] = int(
-            round(2 * (n_devices - 1) / n_devices * psum_b + (n_devices - 1) * cat_b)
-        )
+        report["sync_bytes_per_chip"] = sync_bytes_per_chip(metric._reductions, out, n_devices)
     return report
