@@ -21,14 +21,24 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.classification.confusion_matrix": 1,
     "torchmetrics_tpu.classification.cohen_kappa": 1,
     "torchmetrics_tpu.classification.matthews_corrcoef": 1,
-    "torchmetrics_tpu.regression.errors": 2,
+    "torchmetrics_tpu.regression.errors": 3,
     "torchmetrics_tpu.regression.variance": 2,
-    "torchmetrics_tpu.regression.correlation": 2,
+    "torchmetrics_tpu.regression.correlation": 3,
     "torchmetrics_tpu.image.psnr": 1,
-    "torchmetrics_tpu.text.bleu": 1,
-    "torchmetrics_tpu.text.asr": 2,
-    "torchmetrics_tpu.retrieval.metrics": 1,
-    "torchmetrics_tpu.aggregation": 1,
+    "torchmetrics_tpu.text.bleu": 2,
+    "torchmetrics_tpu.text.asr": 3,
+    "torchmetrics_tpu.retrieval.metrics": 3,
+    "torchmetrics_tpu.aggregation": 3,
+    "torchmetrics_tpu.nominal.nominal": 1,
+    "torchmetrics_tpu.clustering.extrinsic": 2,
+    "torchmetrics_tpu.segmentation.mean_iou": 1,
+    "torchmetrics_tpu.segmentation.generalized_dice": 1,
+    "torchmetrics_tpu.audio.metrics": 2,
+    "torchmetrics_tpu.image.spectral": 1,
+    "torchmetrics_tpu.text.rouge": 1,
+    "torchmetrics_tpu.text.ter": 1,
+    "torchmetrics_tpu.regression.distribution": 1,
+    "torchmetrics_tpu.wrappers.minmax": 1,
 }
 
 

@@ -88,7 +88,16 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max (reference: aggregation.py:114-218)."""
+    """Running max (reference: aggregation.py:114-218).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(jnp.asarray([1.0, 5.0, 3.0]))
+        >>> round(float(metric.compute()), 4)
+        5.0
+    """
 
     full_state_update = True
 
@@ -114,7 +123,16 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum (reference: aggregation.py:324-428)."""
+    """Running sum (reference: aggregation.py:324-428).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+        >>> round(float(metric.compute()), 4)
+        6.0
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum_value", jnp.zeros(()), "sum", nan_strategy, **kwargs)

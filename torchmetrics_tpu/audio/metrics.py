@@ -57,7 +57,16 @@ class _AveragedAudioMetric(Metric):
 
 
 class SignalNoiseRatio(_AveragedAudioMetric):
-    """SNR (reference audio/snr.py:35)."""
+    """SNR (reference audio/snr.py:35).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(jnp.asarray([3.0, -0.5, 2.0, 7.0]), jnp.asarray([3.0, -0.5, 2.0, 8.0]))
+        >>> round(float(metric.compute()), 4)
+        18.879
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -123,7 +132,16 @@ class SignalDistortionRatio(_AveragedAudioMetric):
 
 
 class ScaleInvariantSignalDistortionRatio(_AveragedAudioMetric):
-    """SI-SDR (reference audio/sdr.py:173)."""
+    """SI-SDR (reference audio/sdr.py:173).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> metric = ScaleInvariantSignalDistortionRatio()
+        >>> metric.update(jnp.asarray([3.0, -0.5, 2.0, 7.0]), jnp.asarray([3.0, -0.5, 2.0, 8.0]))
+        >>> round(float(metric.compute()), 4)
+        25.5862
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -83,7 +83,16 @@ class AdjustedMutualInfoScore(_LabelPairMetric):
 
 
 class NormalizedMutualInfoScore(_LabelPairMetric):
-    """Entropy-normalized MI (clustering/normalized_mutual_info_score.py:31)."""
+    """Entropy-normalized MI (clustering/normalized_mutual_info_score.py:31).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+        >>> metric = NormalizedMutualInfoScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([0, 0, 1, 2, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.7397
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -114,7 +123,16 @@ class RandScore(_LabelPairMetric):
 
 
 class AdjustedRandScore(_LabelPairMetric):
-    """Chance-adjusted Rand index (clustering/adjusted_rand_score.py:28)."""
+    """Chance-adjusted Rand index (clustering/adjusted_rand_score.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import AdjustedRandScore
+        >>> metric = AdjustedRandScore()
+        >>> metric.update(jnp.asarray([0, 0, 1, 1, 2, 2]), jnp.asarray([0, 0, 1, 2, 2, 2]))
+        >>> round(float(metric.compute()), 4)
+        0.4444
+    """
 
     higher_is_better = True
     plot_lower_bound = -0.5

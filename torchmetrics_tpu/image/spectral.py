@@ -291,7 +291,17 @@ class VisualInformationFidelity(Metric):
 
 
 class TotalVariation(Metric):
-    """TV (reference image/tv.py:24)."""
+    """TV (reference image/tv.py:24).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import TotalVariation
+        >>> metric = TotalVariation()
+        >>> img = jnp.arange(48.0).reshape(1, 3, 4, 4) / 48.0
+        >>> metric.update(img)
+        >>> round(float(metric.compute()), 4)
+        3.75
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -66,7 +66,16 @@ class _ContingencyMetric(Metric):
 
 
 class CramersV(_ContingencyMetric):
-    """Cramér's V association (nominal/cramers.py:30)."""
+    """Cramér's V association (nominal/cramers.py:30).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> metric = CramersV(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 1, 0, 2, 0, 1]), jnp.asarray([0, 1, 2, 2, 0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.5652
+    """
 
     def __init__(
         self,

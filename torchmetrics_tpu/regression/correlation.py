@@ -167,6 +167,17 @@ class SpearmanCorrCoef(_CatCorrBase):
 
 
 class KendallRankCorrCoef(_CatCorrBase):
+    """KendallRankCorrCoef (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        1.0
+    """
     higher_is_better = None
     plot_lower_bound = -1.0
     plot_upper_bound = 1.0

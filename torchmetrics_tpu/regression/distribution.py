@@ -51,6 +51,17 @@ class KLDivergence(Metric):
 
 
 class CosineSimilarity(Metric):
+    """CosineSimilarity (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CosineSimilarity
+        >>> metric = CosineSimilarity(reduction='mean')
+        >>> metric.update(jnp.asarray([[1.0, 2.0, 3.0]]), jnp.asarray([[1.0, 2.0, 4.0]]))
+        >>> round(float(metric.compute()), 4)
+        0.9915
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

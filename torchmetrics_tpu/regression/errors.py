@@ -131,6 +131,17 @@ class LogCoshError(_SumCountMetric):
 
 
 class MinkowskiDistance(Metric):
+    """MinkowskiDistance (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3.0)
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        1.0772
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -56,7 +56,16 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:28)."""
+    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> metric = RetrievalMRR()
+        >>> metric.update(jnp.asarray([0.2, 0.3, 0.5, 0.1]), jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 0, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
@@ -134,7 +143,16 @@ class RetrievalRPrecision(RetrievalMetric):
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """NDCG@k; allows graded (non-binary) relevance (reference retrieval/ndcg.py:28)."""
+    """NDCG@k; allows graded (non-binary) relevance (reference retrieval/ndcg.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+        >>> metric = RetrievalNormalizedDCG()
+        >>> metric.update(jnp.asarray([0.2, 0.3, 0.5, 0.1]), jnp.asarray([0, 1, 0, 1]), jnp.asarray([0, 0, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.8155
+    """
 
     allow_non_binary_target = True
 

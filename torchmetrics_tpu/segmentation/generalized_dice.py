@@ -20,7 +20,16 @@ from torchmetrics_tpu.functional.segmentation.generalized_dice import (
 
 
 class GeneralizedDiceScore(Metric):
-    """Generalized Dice score for semantic segmentation."""
+    """Generalized Dice score for semantic segmentation.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import GeneralizedDiceScore
+        >>> metric = GeneralizedDiceScore(num_classes=3, input_format='index')
+        >>> metric.update(jnp.asarray([[[0, 1], [2, 1]]]), jnp.asarray([[[0, 1], [2, 2]]]))
+        >>> round(float(metric.compute()), 4)
+        0.7826
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -21,7 +21,16 @@ from torchmetrics_tpu.functional.segmentation.mean_iou import (
 
 
 class MeanIoU(Metric):
-    """Mean Intersection over Union for semantic segmentation."""
+    """Mean Intersection over Union for semantic segmentation.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import MeanIoU
+        >>> metric = MeanIoU(num_classes=3)
+        >>> metric.update(jnp.asarray([[0, 1, 2, 1]]), jnp.asarray([[0, 1, 2, 2]]))
+        >>> round(float(metric.compute()), 4)
+        0.75
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -77,7 +77,16 @@ class CharErrorRate(_ErrorRateMetric):
 
 
 class MatchErrorRate(_ErrorRateMetric):
-    """MER (reference text/mer.py:28)."""
+    """MER (reference text/mer.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> round(float(metric.compute()), 4)
+        0.25
+    """
 
     _update_fn = staticmethod(_mer_update)
 

@@ -80,7 +80,16 @@ class BLEUScore(Metric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with canonical tokenization (reference text/sacre_bleu.py:34-140)."""
+    """BLEU with canonical tokenization (reference text/sacre_bleu.py:34-140).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["a cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        0.7598
+    """
 
     def __init__(
         self,

@@ -18,7 +18,16 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 class ROUGEScore(Metric):
     """ROUGE-N/L/Lsum; per-sample P/R/F stored as cat states so the sync path
-    moves only tensors (reference text/rouge.py:143 stores the same)."""
+    moves only tensors (reference text/rouge.py:143 stores the same).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> metric = ROUGEScore(rouge_keys='rouge1')
+        >>> metric.update("the cat is on the mat", "a cat is on the mat")
+        >>> round(float(metric.compute()['rouge1_fmeasure']), 4)
+        0.8333
+    """
 
     is_differentiable = False
     higher_is_better = True

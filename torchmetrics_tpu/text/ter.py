@@ -18,7 +18,16 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 class TranslationEditRate(Metric):
     """Corpus TER; state = total edits + total reference length, sum-reduced
-    (reference text/ter.py:29)."""
+    (reference text/ter.py:29).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> metric.update(["the cat is on the mat"], [["a cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        0.1667
+    """
 
     is_differentiable = False
     higher_is_better = False

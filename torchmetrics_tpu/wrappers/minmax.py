@@ -12,7 +12,17 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MinMaxMetric(WrapperMetric):
-    """Track the running min and max of the wrapped metric's compute value."""
+    """Track the running min and max of the wrapped metric's compute value.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> metric.update(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+        >>> round(float(metric.compute()['raw']), 4)
+        1.0
+    """
 
     full_state_update = True
 
