@@ -39,6 +39,11 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.text.ter": 1,
     "torchmetrics_tpu.regression.distribution": 1,
     "torchmetrics_tpu.wrappers.minmax": 1,
+    "torchmetrics_tpu.wrappers.classwise": 1,
+    "torchmetrics_tpu.wrappers.multioutput": 1,
+    "torchmetrics_tpu.wrappers.multitask": 1,
+    "torchmetrics_tpu.wrappers.running": 1,
+    "torchmetrics_tpu.wrappers.bootstrapping": 1,
 }
 
 

@@ -33,6 +33,18 @@ def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optio
 
 
 class BootStrapper(WrapperMetric):
+    """BootStrapper (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> metric = BootStrapper(MeanSquaredError(), num_bootstraps=5, seed=42)
+        >>> metric.update(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 2.5, 3.0, 4.5]))
+        >>> sorted(metric.compute().keys())
+        ['mean', 'std']
+    """
     full_state_update = True
 
     def __init__(

@@ -11,7 +11,17 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class ClasswiseWrapper(WrapperMetric):
-    """Explode a per-class vector output into a labeled dict."""
+    """Explode a per-class vector output into a labeled dict.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> from torchmetrics_tpu.wrappers import ClasswiseWrapper
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> metric.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> round(float(metric.compute()['multiclassaccuracy_2']), 4)
+        0.5
+    """
 
     def __init__(
         self,

@@ -13,7 +13,17 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MultioutputWrapper(WrapperMetric):
-    """Clone the base metric per output dim and slice inputs along ``output_dim``."""
+    """Clone the base metric per output dim and slice inputs along ``output_dim``.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import MultioutputWrapper
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(jnp.asarray([[1.0, 2.0], [2.0, 4.0]]), jnp.asarray([[1.0, 3.0], [2.0, 4.0]]))
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [0.0, 0.5]
+    """
 
     is_differentiable = False
 

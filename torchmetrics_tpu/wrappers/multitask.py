@@ -10,7 +10,19 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class MultitaskWrapper(WrapperMetric):
-    """Route a dict of task inputs to a dict of task metrics."""
+    """Route a dict of task inputs to a dict of task metrics.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> metric = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        >>> metric.update({"cls": jnp.asarray([0.2, 0.8]), "reg": jnp.asarray([1.0, 2.0])},
+        ...               {"cls": jnp.asarray([0, 1]), "reg": jnp.asarray([1.0, 3.0])})
+        >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+        {'cls': 1.0, 'reg': 0.5}
+    """
 
     is_differentiable = False
 

@@ -15,7 +15,18 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 
 class Running(WrapperMetric):
-    """Metric over a sliding window of the last ``window`` updates."""
+    """Metric over a sliding window of the last ``window`` updates.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.wrappers import Running
+        >>> metric = Running(MeanSquaredError(), window=2)
+        >>> for p, t in [(1.0, 1.5), (2.0, 2.0), (3.0, 3.5)]:
+        ...     metric.update(jnp.asarray([p]), jnp.asarray([t]))
+        >>> round(float(metric.compute()), 4)
+        0.125
+    """
 
     def __init__(self, base_metric: Metric, window: int = 5, **kwargs: Any) -> None:
         super().__init__(**kwargs)
