@@ -59,3 +59,43 @@ def test_sharded_clustering_rand_score(mesh):
         oracle=oracle,
         atol=1e-5,
     )
+
+
+def test_sharded_multioutput_wrapper(mesh):
+    """Wrapped metrics ride the same sharded path: MultioutputWrapper's
+    per-output child states sync leaf-wise."""
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu.wrappers import MultioutputWrapper
+
+    rng = np.random.default_rng(51)
+    preds = rng.normal(size=(2, N, 3)).astype(np.float32)
+    target = (preds + 0.1 * rng.normal(size=(2, N, 3))).astype(np.float32)
+    oracle = ((preds - target) ** 2).reshape(-1, 3).mean(axis=0)
+    assert_sharded_parity(
+        mesh,
+        # remove_nans=False: NaN-row masking is data-dependent and eager-only
+        lambda: MultioutputWrapper(MeanSquaredError(), num_outputs=3, remove_nans=False),
+        [(preds[0], target[0]), (preds[1], target[1])],
+        oracle=oracle,
+        atol=1e-5,
+    )
+
+
+def test_multioutput_wrapper_functional_guards():
+    """remove_nans=True must refuse the (untraceable) functional path with a
+    clear error; the state pytree must round-trip child states."""
+    import jax.numpy as jnp
+    import pytest
+
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu.wrappers import MultioutputWrapper
+
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    with pytest.raises(ValueError, match="remove_nans=False"):
+        m.update_state(m.init_state(), jnp.zeros((4, 2)), jnp.zeros((4, 2)))
+
+    m.update(jnp.asarray([[1.0, 2.0], [2.0, 4.0]]), jnp.asarray([[1.0, 3.0], [2.0, 4.0]]))
+    tree = m.state_pytree()
+    fresh = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    fresh.load_state_pytree(tree)
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()), atol=1e-7)

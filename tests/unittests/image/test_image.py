@@ -281,3 +281,40 @@ def test_psnrb_class():
     m = PeakSignalNoiseRatioWithBlockedEffect()
     m.update(J(a), J(b))
     assert np.isfinite(float(m.compute()))
+
+
+def test_ssim_uqi_reject_images_smaller_than_kernel():
+    """Images smaller than the analysis window must raise, not silently NaN
+    (reference raises from its padding op)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from torchmetrics_tpu.functional.image import (
+        structural_similarity_index_measure,
+        universal_image_quality_index,
+    )
+
+    tiny = jnp.arange(48.0).reshape(1, 3, 4, 4) / 48.0
+    with pytest.raises(ValueError, match="window"):
+        structural_similarity_index_measure(tiny, tiny * 0.9, data_range=1.0)
+    with pytest.raises(ValueError, match="kernel"):
+        universal_image_quality_index(tiny, tiny * 0.9)
+    # still fine at exactly the kernel size
+    ok = jnp.arange(363.0).reshape(1, 3, 11, 11) / 363.0
+    assert float(structural_similarity_index_measure(ok, ok, data_range=1.0)) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_ssim_window_guard_tracks_sigma():
+    """The gaussian window is derived from sigma, not kernel_size: big sigma
+    on a small image must raise; small sigma on a small image must work."""
+    import jax.numpy as jnp
+    import pytest
+
+    from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+
+    img12 = jnp.arange(144.0).reshape(1, 1, 12, 12) / 144.0
+    with pytest.raises(ValueError, match="window"):
+        structural_similarity_index_measure(img12, img12 * 0.9, sigma=3.0, data_range=1.0)
+    img8 = jnp.arange(64.0).reshape(1, 1, 8, 8) / 64.0
+    val = structural_similarity_index_measure(img8, img8, sigma=0.5, data_range=1.0)
+    assert float(val) == pytest.approx(1.0, abs=1e-5)

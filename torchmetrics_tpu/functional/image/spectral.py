@@ -55,6 +55,13 @@ def universal_image_quality_index(
         raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+    if preds.shape[-2] < kernel_size[0] or preds.shape[-1] < kernel_size[1]:
+        # reflect padding with pad >= dim would silently produce NaNs; the
+        # reference raises from its pad op here
+        raise ValueError(
+            f"Image spatial dimensions {tuple(preds.shape[-2:])} must each be at least "
+            f"the kernel size {tuple(kernel_size)}."
+        )
 
     channel = preds.shape[1]
     kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, preds.dtype)

@@ -71,6 +71,21 @@ def _ssim_update(
         raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
     if any(x % 2 == 0 or x <= 0 for x in kernel_size):
         raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    # the ACTUAL analysis window: derived from sigma for gaussian kernels
+    # (kernel_size only applies to uniform windows) — mirrors the win_size
+    # computation below
+    actual_win = (
+        [int(3.5 * s + 0.5) * 2 + 1 for s in sigma] if gaussian_kernel else list(kernel_size)
+    )
+    spatial = preds.shape[2:]
+    if any(s < w for s, w in zip(spatial, actual_win)):
+        # reflect padding with pad >= dim would silently produce NaNs; the
+        # reference raises from its pad op here
+        raise ValueError(
+            f"Image spatial dimensions {tuple(spatial)} must each be at least the "
+            f"analysis window size {tuple(actual_win)} "
+            f"({'derived from sigma' if gaussian_kernel else 'the kernel size'})."
+        )
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
 
