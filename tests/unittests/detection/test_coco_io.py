@@ -1,0 +1,120 @@
+"""Native COCO json/RLE io: codec invariants + full metric round-trips."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.detection.coco_io import (
+    _counts_from_string,
+    _counts_to_string,
+    ann_to_mask,
+    rle_decode,
+    rle_encode,
+)
+
+
+def test_rle_counts_string_roundtrip():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        counts = rng.integers(0, 5000, size=rng.integers(1, 40)).tolist()
+        assert _counts_from_string(_counts_to_string(counts)) == counts
+
+
+def test_rle_counts_known_values():
+    # single run of 6 zeros: 6 fits in one 5-bit chunk -> chr(6+48) == '6'
+    assert _counts_to_string([6]) == "6"
+    assert _counts_from_string("6") == [6]
+    # deltas from two back can be negative -> sign-extended chunks
+    assert _counts_from_string(_counts_to_string([100, 5, 3, 90])) == [100, 5, 3, 90]
+
+
+def test_rle_mask_roundtrip():
+    rng = np.random.default_rng(1)
+    for shape in [(4, 6), (11, 7), (1, 1), (16, 16)]:
+        mask = rng.uniform(size=shape) > 0.6
+        decoded = rle_decode(rle_encode(mask))
+        np.testing.assert_array_equal(decoded, mask.astype(np.uint8))
+        # uncompressed counts path too
+        decoded_u = rle_decode(rle_encode(mask, compress=False))
+        np.testing.assert_array_equal(decoded_u, mask.astype(np.uint8))
+    # empty + full masks
+    np.testing.assert_array_equal(rle_decode(rle_encode(np.zeros((3, 3), bool))), np.zeros((3, 3)))
+    np.testing.assert_array_equal(rle_decode(rle_encode(np.ones((3, 3), bool))), np.ones((3, 3)))
+
+
+def test_rle_decode_is_column_major():
+    """COCO runs scan columns: a 1-run of length H fills the FIRST column."""
+    rle = {"size": [3, 2], "counts": [0, 3, 3]}  # 3 ones then 3 zeros
+    expected = np.asarray([[1, 0], [1, 0], [1, 0]], np.uint8)
+    np.testing.assert_array_equal(rle_decode(rle), expected)
+
+
+def test_ann_to_mask_polygon():
+    pytest.importorskip("matplotlib")
+    ann = {"segmentation": [[1.0, 1.0, 5.0, 1.0, 5.0, 5.0, 1.0, 5.0]]}  # 4x4 square
+    mask = ann_to_mask(ann, 8, 8)
+    assert mask[2, 2] == 1 and mask[0, 0] == 0 and mask[6, 6] == 0
+    assert 9 <= mask.sum() <= 25  # ~16 modulo boundary rounding
+
+
+def test_bbox_roundtrip_through_coco_files(tmp_path):
+    """update -> tm_to_coco -> coco_to_tm -> update a fresh metric ->
+    identical mAP results."""
+    preds = [
+        dict(boxes=jnp.asarray([[10.0, 20.0, 60.0, 80.0], [5.0, 5.0, 25.0, 30.0]]),
+             scores=jnp.asarray([0.9, 0.4]), labels=jnp.asarray([0, 1])),
+        dict(boxes=jnp.asarray([[0.0, 0.0, 40.0, 40.0]]),
+             scores=jnp.asarray([0.7]), labels=jnp.asarray([1])),
+    ]
+    target = [
+        dict(boxes=jnp.asarray([[12.0, 22.0, 58.0, 78.0]]), labels=jnp.asarray([0]),
+             iscrowd=jnp.asarray([0])),
+        dict(boxes=jnp.asarray([[2.0, 2.0, 38.0, 42.0], [50.0, 50.0, 90.0, 90.0]]),
+             labels=jnp.asarray([1, 1]), iscrowd=jnp.asarray([0, 1])),
+    ]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    expected = metric.compute()
+
+    stem = str(tmp_path / "roundtrip")
+    metric.tm_to_coco(stem)
+    with open(f"{stem}_target.json") as handle:
+        assert {"images", "annotations", "categories"} <= set(json.load(handle))
+
+    loaded_preds, loaded_target = MeanAveragePrecision.coco_to_tm(
+        f"{stem}_preds.json", f"{stem}_target.json", iou_type="bbox"
+    )
+    fresh = MeanAveragePrecision(box_format="xywh")  # coco files carry xywh
+    fresh.update(loaded_preds, loaded_target)
+    resumed = fresh.compute()
+    for key in ("map", "map_50", "map_75", "mar_100", "map_small"):
+        np.testing.assert_allclose(
+            np.asarray(resumed[key]), np.asarray(expected[key]), atol=1e-6, err_msg=key
+        )
+
+
+def test_segm_roundtrip_through_coco_files(tmp_path):
+    rng = np.random.default_rng(3)
+    mask_gt = np.zeros((1, 20, 20), bool)
+    mask_gt[0, 2:12, 3:13] = True
+    mask_pred = np.zeros((1, 20, 20), bool)
+    mask_pred[0, 2:12, 2:12] = True
+    preds = [dict(masks=jnp.asarray(mask_pred), scores=jnp.asarray([0.8]), labels=jnp.asarray([3]))]
+    target = [dict(masks=jnp.asarray(mask_gt), labels=jnp.asarray([3]))]
+
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(preds, target)
+    expected = metric.compute()
+
+    stem = str(tmp_path / "segm")
+    metric.tm_to_coco(stem)
+    loaded_preds, loaded_target = MeanAveragePrecision.coco_to_tm(
+        f"{stem}_preds.json", f"{stem}_target.json", iou_type="segm"
+    )
+    fresh = MeanAveragePrecision(iou_type="segm")
+    fresh.update(loaded_preds, loaded_target)
+    resumed = fresh.compute()
+    np.testing.assert_allclose(np.asarray(resumed["map"]), np.asarray(expected["map"]), atol=1e-6)

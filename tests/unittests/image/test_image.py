@@ -305,16 +305,52 @@ def test_ssim_uqi_reject_images_smaller_than_kernel():
 
 
 def test_ssim_window_guard_tracks_sigma():
-    """The gaussian window is derived from sigma, not kernel_size: big sigma
-    on a small image must raise; small sigma on a small image must work."""
+    """The guard follows the ACTUAL analysis window (derived from sigma for
+    gaussian kernels): below the window size the reference yields no finite
+    value either (pad error or silent NaN from an empty crop — verified),
+    so we raise across that whole range."""
     import jax.numpy as jnp
     import pytest
 
     from torchmetrics_tpu.functional.image import structural_similarity_index_measure
 
+    # sigma=3.0 -> win 23: a 12x12 image has no un-padded SSIM position
     img12 = jnp.arange(144.0).reshape(1, 1, 12, 12) / 144.0
     with pytest.raises(ValueError, match="window"):
         structural_similarity_index_measure(img12, img12 * 0.9, sigma=3.0, data_range=1.0)
+    # small sigma shrinks the window: 8x8 with sigma=0.5 (win 5) is fine
     img8 = jnp.arange(64.0).reshape(1, 1, 8, 8) / 64.0
     val = structural_similarity_index_measure(img8, img8, sigma=0.5, data_range=1.0)
     assert float(val) == pytest.approx(1.0, abs=1e-5)
+
+
+def test_ssim_uqi_boundary_reference_parity():
+    """At exactly the window size (the smallest finite case) values must
+    match the reference."""
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+
+    from tests.helpers.refpath import add_reference_paths
+
+    add_reference_paths()
+    torch = pytest.importorskip("torch")
+    from torchmetrics.functional.image import (
+        structural_similarity_index_measure as ref_ssim,
+        universal_image_quality_index as ref_uqi,
+    )
+
+    from torchmetrics_tpu.functional.image import (
+        structural_similarity_index_measure,
+        universal_image_quality_index,
+    )
+
+    rng = np.random.default_rng(5)
+    img = rng.uniform(size=(1, 3, 11, 11)).astype(np.float32)
+    other = np.clip(img + 0.1 * rng.normal(size=img.shape), 0, 1).astype(np.float32)
+    ref_s = float(ref_ssim(torch.tensor(img), torch.tensor(other), data_range=1.0))
+    ours_s = float(structural_similarity_index_measure(jnp.asarray(img), jnp.asarray(other), data_range=1.0))
+    np.testing.assert_allclose(ours_s, ref_s, atol=1e-4)
+    ref_u = float(ref_uqi(torch.tensor(img), torch.tensor(other)))
+    ours_u = float(universal_image_quality_index(jnp.asarray(img), jnp.asarray(other)))
+    np.testing.assert_allclose(ours_u, ref_u, atol=1e-4)

@@ -249,6 +249,60 @@ class MeanAveragePrecision(Metric):
             return jnp.zeros(0)
         return ((item[:, 2] - item[:, 0]) * (item[:, 3] - item[:, 1])).astype(jnp.float32)
 
+    # ---------------------------------------------------------- coco file io
+    @staticmethod
+    def coco_to_tm(
+        coco_preds: str,
+        coco_target: str,
+        iou_type: Union[str, List[str]] = "bbox",
+        backend: str = "native",
+    ) -> Tuple[List[Dict[str, Array]], List[Dict[str, Array]]]:
+        """Convert COCO-format json files into this metric's input lists.
+
+        Native json/RLE parsing — no pycocotools (the reference's version,
+        mean_ap.py:641-755, shells out to ``COCO``/``loadRes``).  Boxes come
+        back in COCO xywh, so construct the metric with
+        ``box_format="xywh"`` when feeding them, exactly as with the
+        reference.  ``backend`` is accepted for API parity; only the native
+        parser exists here.
+        """
+        from torchmetrics_tpu.detection.coco_io import parse_coco_files
+
+        preds, target = parse_coco_files(coco_preds, coco_target, iou_type)
+        to_jnp = lambda d: {k: jnp.asarray(v) for k, v in d.items()}  # noqa: E731
+        return [to_jnp(p) for p in preds], [to_jnp(t) for t in target]
+
+    def tm_to_coco(self, name: str = "tm_map_input") -> None:
+        """Write the accumulated inputs to ``{name}_preds.json`` /
+        ``{name}_target.json`` in COCO format (reference mean_ap.py:752-830).
+
+        Boxes are written in COCO xywh; masks as compressed RLE.
+        """
+        import json as _json
+
+        from torchmetrics_tpu.detection.coco_io import build_coco_dicts
+
+        state = self._state
+        has_boxes = "bbox" in self.iou_types
+        has_masks = "segm" in self.iou_types
+        target_dict = build_coco_dicts(
+            labels=state["groundtruth_labels"],
+            boxes_xyxy=state["groundtruth_boxes"] if has_boxes else None,
+            masks=state["groundtruth_masks"] if has_masks else None,
+            crowds=state["groundtruth_crowds"],
+            area=state["groundtruth_area"],
+        )
+        preds_dict = build_coco_dicts(
+            labels=state["detection_labels"],
+            boxes_xyxy=state["detection_boxes"] if has_boxes else None,
+            masks=state["detection_masks"] if has_masks else None,
+            scores=state["detection_scores"],
+        )
+        with open(f"{name}_target.json", "w") as handle:
+            _json.dump(target_dict, handle)
+        with open(f"{name}_preds.json", "w") as handle:
+            _json.dump(preds_dict, handle)
+
     # -------------------------------------------------------------- compute
     def _compute(self, state: State) -> Dict[str, Array]:
         out: Dict[str, Array] = {}

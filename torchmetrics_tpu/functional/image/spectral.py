@@ -55,12 +55,15 @@ def universal_image_quality_index(
         raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
-    if preds.shape[-2] < kernel_size[0] or preds.shape[-1] < kernel_size[1]:
-        # reflect padding with pad >= dim would silently produce NaNs; the
-        # reference raises from its pad op here
+    if any(s < k for s, k in zip(preds.shape[-2:], kernel_size)):
+        # below the kernel size the reference produces no finite result
+        # either: its pad raises when pad >= dim, and for pad < dim < kernel
+        # the post-conv crop is empty and it silently returns NaN (verified
+        # empirically).  Raise across the whole range.
         raise ValueError(
             f"Image spatial dimensions {tuple(preds.shape[-2:])} must each be at least "
-            f"the kernel size {tuple(kernel_size)}."
+            f"the kernel size {tuple(kernel_size)}; smaller inputs have no valid "
+            "(un-padded) UQI positions."
         )
 
     channel = preds.shape[1]

@@ -71,20 +71,23 @@ def _ssim_update(
         raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
     if any(x % 2 == 0 or x <= 0 for x in kernel_size):
         raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
-    # the ACTUAL analysis window: derived from sigma for gaussian kernels
-    # (kernel_size only applies to uniform windows) — mirrors the win_size
-    # computation below
-    actual_win = (
-        [int(3.5 * s + 0.5) * 2 + 1 for s in sigma] if gaussian_kernel else list(kernel_size)
-    )
+    # the ACTUAL analysis window is derived from sigma for gaussian kernels
+    # (kernel_size only applies to uniform windows); computed once here and
+    # reused for padding below
+    if gaussian_kernel:
+        win_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+    else:
+        win_size = list(kernel_size)
     spatial = preds.shape[2:]
-    if any(s < w for s, w in zip(spatial, actual_win)):
-        # reflect padding with pad >= dim would silently produce NaNs; the
-        # reference raises from its pad op here
+    if any(s < w for s, w in zip(spatial, win_size)):
+        # below the window size the reference produces no finite result
+        # either: its reflect pad raises when pad >= dim, and for
+        # pad < dim < win the post-conv crop is empty and it silently
+        # returns NaN (verified empirically).  Raise across the whole range.
         raise ValueError(
-            f"Image spatial dimensions {tuple(spatial)} must each be at least the "
-            f"analysis window size {tuple(actual_win)} "
-            f"({'derived from sigma' if gaussian_kernel else 'the kernel size'})."
+            f"Image spatial dimensions {tuple(spatial)} must each be at least the analysis "
+            f"window {tuple(win_size)} ({'derived from sigma' if gaussian_kernel else 'the kernel size'}); "
+            "smaller inputs have no valid (un-padded) SSIM positions."
         )
     if any(y <= 0 for y in sigma):
         raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
@@ -95,10 +98,6 @@ def _ssim_update(
     channel = preds.shape[1]
     dtype = preds.dtype
 
-    if gaussian_kernel:
-        win_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
-    else:
-        win_size = list(kernel_size)
     pad_h = (win_size[0] - 1) // 2
     pad_w = (win_size[1] - 1) // 2
 
