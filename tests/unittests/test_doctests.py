@@ -44,6 +44,8 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.wrappers.multitask": 1,
     "torchmetrics_tpu.wrappers.running": 1,
     "torchmetrics_tpu.wrappers.bootstrapping": 1,
+    "torchmetrics_tpu.detection.mean_ap": 1,
+    "torchmetrics_tpu.detection.iou": 1,
 }
 
 

@@ -36,7 +36,20 @@ def _input_validator(preds: Sequence, target: Sequence, ignore_score: bool = Fal
 
 
 class IntersectionOverUnion(Metric):
-    """Mean IoU of matched det/gt boxes (reference detection/iou.py:32)."""
+    """Mean IoU of matched det/gt boxes (reference detection/iou.py:32).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        ...               scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))]
+        >>> target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        ...                labels=jnp.asarray([0]))]
+        >>> metric = IntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()['iou']), 4)
+        0.7755
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -133,7 +133,20 @@ class _ImageRecord:
 
 
 class MeanAveragePrecision(Metric):
-    """COCO mAP/mAR (reference detection/mean_ap.py:76)."""
+    """COCO mAP/mAR (reference detection/mean_ap.py:76).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [dict(boxes=jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        ...               scores=jnp.asarray([0.536]), labels=jnp.asarray([0]))]
+        >>> target = [dict(boxes=jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        ...                labels=jnp.asarray([0]))]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()['map']), 4)
+        0.6
+    """
 
     is_differentiable = False
     higher_is_better = True
