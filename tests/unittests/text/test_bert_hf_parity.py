@@ -124,3 +124,13 @@ def test_bertscore_default_model_warns_never_silent():
     # explicit local dir that doesn't exist must raise, not degrade
     with pytest.raises(Exception):
         T.text.BERTScore(model_name_or_path=os.path.join(os.sep, "definitely", "missing", "dir2"))
+
+
+def test_bertscore_rejects_silently_score_changing_args():
+    """Options whose silent omission would change scores must refuse loudly."""
+    import torchmetrics_tpu as T
+
+    with pytest.raises(NotImplementedError, match="all_layers"):
+        T.text.BERTScore(model_name_or_path=None, all_layers=True)
+    with pytest.raises(NotImplementedError, match="rescale_with_baseline"):
+        T.text.BERTScore(model_name_or_path=None, rescale_with_baseline=True)

@@ -134,6 +134,23 @@ _DEFAULT_MODEL = "roberta-large"  # reference text/bert.py:33
 _HF_EMBEDDERS: dict = {}  # (path, layers, max_len, trunc) -> (embed_fn, tokenizer)
 
 
+def _reject_unsupported_bert_args(all_layers: bool, rescale_with_baseline: bool) -> None:
+    """Options that would silently change scores if ignored must refuse
+    loudly instead (same discipline as `process_group`, core/metric.py)."""
+    if all_layers:
+        raise NotImplementedError(
+            "`all_layers=True` is not supported: the reference aggregates every hidden "
+            "layer's embeddings, so ignoring the flag would silently produce different "
+            "scores. Select a layer with `num_layers` instead."
+        )
+    if rescale_with_baseline:
+        raise NotImplementedError(
+            "`rescale_with_baseline=True` is not supported: baseline files cannot be "
+            "fetched in this environment, and ignoring the flag would silently return "
+            "un-rescaled scores."
+        )
+
+
 def resolve_embedder(
     model_name_or_path: Optional[str] = None,
     num_layers: Optional[int] = None,
@@ -261,13 +278,14 @@ def bert_score(
     cannot be downloaded in this environment; reference downloads
     roberta-large at import time, bert.py:40-52).
     """
+    _reject_unsupported_bert_args(all_layers, rescale_with_baseline)
     preds_l = [preds] if isinstance(preds, str) else list(preds)
     target_l = [target] if isinstance(target, str) else list(target)
     if len(preds_l) != len(target_l):
         raise ValueError("Number of predicted and reference sententes must be the same!")
 
     embed_fn, tokenizer, zero_special, model_name_or_path = resolve_embedder(
-        model_name_or_path, num_layers, max_length, truncation=True,
+        model_name_or_path, num_layers, max_length, truncation=truncation,
         model=model, user_tokenizer=user_tokenizer, user_forward_fn=user_forward_fn,
     )
 

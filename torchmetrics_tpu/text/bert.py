@@ -50,8 +50,12 @@ class BERTScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.functional.text.bert import resolve_embedder
+        from torchmetrics_tpu.functional.text.bert import (
+            _reject_unsupported_bert_args,
+            resolve_embedder,
+        )
 
+        _reject_unsupported_bert_args(all_layers, rescale_with_baseline)
         self.idf = idf
         self.return_hash = return_hash
         self.embed_fn, self.tokenizer, self._zero_special, self.model_name_or_path = resolve_embedder(
