@@ -358,7 +358,7 @@ def _ensure_backend_or_reexec():
         return
     os.environ["BENCH_BACKEND_CHECKED"] = "1"
     probe = "import jax; jax.devices(); print('ok')"
-    retries = int(os.environ.get("BENCH_BACKEND_RETRIES", 3))
+    retries = int(os.environ.get("BENCH_BACKEND_RETRIES", 2))
     last_err = ""
     for attempt in range(retries):
         try:
@@ -367,7 +367,7 @@ def _ensure_backend_or_reexec():
                 env=dict(os.environ),
                 capture_output=True,
                 text=True,
-                timeout=float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", 120)),
+                timeout=float(os.environ.get("BENCH_BACKEND_PROBE_TIMEOUT", 75)),
             )
             if res.returncode == 0 and "ok" in res.stdout:
                 return
@@ -385,7 +385,8 @@ def _ensure_backend_or_reexec():
     env.setdefault("BENCH_BATCH", "8")
     env.setdefault("BENCH_IMG", "64")
     env.setdefault("BENCH_CLASSES", "100")
-    env.setdefault("BENCH_PAIRS", "10")
+    env.setdefault("BENCH_PAIRS", "6")
+    env.setdefault("BENCH_INNER", "2")  # CPU steps run seconds, not ms — keep bursts short
     env["BENCH_BACKEND_FALLBACK"] = (
         f"configured backend unavailable after {retries} probe attempts; "
         f"ran on scrubbed CPU with reduced shapes. last error: {last_err}"

@@ -134,3 +134,25 @@ def test_bertscore_rejects_silently_score_changing_args():
         T.text.BERTScore(model_name_or_path=None, all_layers=True)
     with pytest.raises(NotImplementedError, match="rescale_with_baseline"):
         T.text.BERTScore(model_name_or_path=None, rescale_with_baseline=True)
+
+
+def test_functional_bert_score_rejects_unsupported_args():
+    from torchmetrics_tpu.functional.text import bert_score
+
+    with pytest.raises(NotImplementedError, match="all_layers"):
+        bert_score(["a"], ["a"], all_layers=True)
+    with pytest.raises(NotImplementedError, match="rescale_with_baseline"):
+        bert_score(["a"], ["a"], rescale_with_baseline=True)
+
+
+def test_bert_score_overlength_without_truncation_raises(tiny_bert_dir):
+    from torchmetrics_tpu.functional.text import bert_score
+
+    long_text = " ".join(["hello"] * 40)
+    with pytest.raises(ValueError, match="truncation"):
+        bert_score([long_text], [long_text], model_name_or_path=tiny_bert_dir,
+                   num_layers=2, max_length=16)
+    # same input with truncation enabled scores fine
+    out = bert_score([long_text], [long_text], model_name_or_path=tiny_bert_dir,
+                     num_layers=2, max_length=16, truncation=True)
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-4)

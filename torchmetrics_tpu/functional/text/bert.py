@@ -125,6 +125,15 @@ def load_hf_embedder(
             list(texts), padding=True, truncation=truncation, max_length=max_length,
             return_tensors="np",
         )
+        if not truncation and enc["input_ids"].shape[-1] > max_length:
+            # Flax embeddings silently CLAMP out-of-range position ids (the
+            # torch reference raises an index error) — fail loudly instead
+            # of scoring clamped positions
+            raise ValueError(
+                f"Tokenized input length {enc['input_ids'].shape[-1]} exceeds "
+                f"max_length={max_length} and `truncation=False`. Enable `truncation` "
+                "or raise `max_length`."
+            )
         return {"input_ids": enc["input_ids"], "attention_mask": enc["attention_mask"]}
 
     return embed_fn, tokenizer_fn

@@ -18,6 +18,9 @@ from torchmetrics_tpu.functional.text.bert import (
     _bert_score_from_embeddings,
     _compute_idf,
     _idf_weights,
+    _process_special_tokens_mask,
+    _reject_unsupported_bert_args,
+    resolve_embedder,
 )
 
 
@@ -50,11 +53,6 @@ class BERTScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        from torchmetrics_tpu.functional.text.bert import (
-            _reject_unsupported_bert_args,
-            resolve_embedder,
-        )
-
         _reject_unsupported_bert_args(all_layers, rescale_with_baseline)
         self.idf = idf
         self.return_hash = return_hash
@@ -108,8 +106,6 @@ class BERTScore(Metric):
         tgt_emb = jnp.asarray(self.embed_fn(jnp.asarray(t_ids), jnp.asarray(t_mask)))
 
         if self._zero_special:
-            from torchmetrics_tpu.functional.text.bert import _process_special_tokens_mask
-
             p_mask = _process_special_tokens_mask(p_mask)
             t_mask = _process_special_tokens_mask(t_mask)
 
