@@ -29,11 +29,11 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.text.asr": 3,
     "torchmetrics_tpu.retrieval.metrics": 3,
     "torchmetrics_tpu.aggregation": 3,
-    "torchmetrics_tpu.nominal.nominal": 1,
+    "torchmetrics_tpu.nominal.nominal": 2,
     "torchmetrics_tpu.clustering.extrinsic": 2,
     "torchmetrics_tpu.segmentation.mean_iou": 1,
     "torchmetrics_tpu.segmentation.generalized_dice": 1,
-    "torchmetrics_tpu.audio.metrics": 2,
+    "torchmetrics_tpu.audio.metrics": 3,
     "torchmetrics_tpu.image.spectral": 1,
     "torchmetrics_tpu.text.rouge": 1,
     "torchmetrics_tpu.text.ter": 1,
@@ -46,6 +46,14 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.wrappers.bootstrapping": 1,
     "torchmetrics_tpu.detection.mean_ap": 1,
     "torchmetrics_tpu.detection.iou": 1,
+    "torchmetrics_tpu.classification.specificity": 1,
+    "torchmetrics_tpu.classification.precision_recall": 2,
+    "torchmetrics_tpu.classification.hamming": 1,
+    "torchmetrics_tpu.classification.jaccard": 1,
+    "torchmetrics_tpu.classification.calibration_error": 1,
+    "torchmetrics_tpu.classification.exact_match": 1,
+    "torchmetrics_tpu.image.ssim": 1,
+    "torchmetrics_tpu.clustering.intrinsic": 2,
 }
 
 

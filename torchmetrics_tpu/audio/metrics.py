@@ -176,7 +176,19 @@ class SourceAggregatedSignalDistortionRatio(_AveragedAudioMetric):
 
 
 class PermutationInvariantTraining(_AveragedAudioMetric):
-    """PIT (reference audio/pit.py:30)."""
+    """PIT (reference audio/pit.py:30).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import signal_noise_ratio
+        >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+        >>> metric = PermutationInvariantTraining(signal_noise_ratio)
+        >>> preds = jnp.asarray([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]])
+        >>> target = jnp.asarray([[[4.1, 5.0, 6.0], [1.0, 2.1, 3.0]]])  # permuted
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        35.2485
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -55,6 +55,17 @@ class _CalibrationErrorBase(Metric):
 
 
 class BinaryCalibrationError(_CalibrationErrorBase):
+    """BinaryCalibrationError (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+        >>> metric = BinaryCalibrationError(n_bins=2)
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.225
+    """
     def __init__(self, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -44,6 +44,17 @@ class _ExactMatchBase(Metric):
 
 
 class MulticlassExactMatch(_ExactMatchBase):
+    """MulticlassExactMatch (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassExactMatch
+        >>> metric = MulticlassExactMatch(num_classes=3)
+        >>> metric.update(jnp.asarray([[0, 1], [2, 1]]), jnp.asarray([[0, 1], [2, 2]]))
+        >>> round(float(metric.compute()), 4)
+        0.5
+    """
     def __init__(self, num_classes: int, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(**kwargs)

@@ -8,3 +8,15 @@ BinaryHammingDistance, MulticlassHammingDistance, MultilabelHammingDistance, Ham
         "HammingDistance", __name__, higher_is_better=False,
     )
 )
+
+BinaryHammingDistance.__doc__ = """Binary Hamming distance: fraction of disagreeing labels (reference classification/hamming.py:24).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinaryHammingDistance
+    >>> metric = BinaryHammingDistance()
+    >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+"""

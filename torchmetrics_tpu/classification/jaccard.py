@@ -15,6 +15,17 @@ from torchmetrics_tpu.functional.classification.jaccard import _jaccard_reduce
 
 
 class BinaryJaccardIndex(BinaryConfusionMatrix):
+    """BinaryJaccardIndex (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryJaccardIndex
+        >>> metric = BinaryJaccardIndex()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.3333
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

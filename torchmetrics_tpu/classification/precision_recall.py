@@ -9,3 +9,27 @@ BinaryPrecision, MulticlassPrecision, MultilabelPrecision, Precision = make_stat
 BinaryRecall, MulticlassRecall, MultilabelRecall, Recall = make_stat_metric_classes(
     "recall", "BinaryRecall", "MulticlassRecall", "MultilabelRecall", "Recall", __name__
 )
+
+BinaryPrecision.__doc__ = """Binary precision: TP / (TP + FP) (reference classification/precision_recall.py:28).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinaryPrecision
+    >>> metric = BinaryPrecision()
+    >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+"""
+
+BinaryRecall.__doc__ = """Binary recall: TP / (TP + FN) (reference classification/precision_recall.py:450).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinaryRecall
+    >>> metric = BinaryRecall()
+    >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+"""

@@ -5,3 +5,15 @@ from torchmetrics_tpu.classification._factory import make_stat_metric_classes
 BinarySpecificity, MulticlassSpecificity, MultilabelSpecificity, Specificity = make_stat_metric_classes(
     "specificity", "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity", __name__
 )
+
+BinarySpecificity.__doc__ = """Binary specificity: TN / (TN + FP) (reference classification/specificity.py:25).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinarySpecificity
+    >>> metric = BinarySpecificity()
+    >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+"""

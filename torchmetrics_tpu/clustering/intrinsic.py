@@ -40,7 +40,17 @@ class _DataLabelMetric(Metric):
 
 
 class CalinskiHarabaszScore(_DataLabelMetric):
-    """Variance-ratio criterion (clustering/calinski_harabasz_score.py:28)."""
+    """Variance-ratio criterion (clustering/calinski_harabasz_score.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+        >>> metric = CalinskiHarabaszScore()
+        >>> x = jnp.asarray([[0.0, 0.0], [0.0, 1.0], [5.0, 5.0], [5.0, 6.0]])
+        >>> metric.update(x, jnp.asarray([0, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        100.0
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -50,7 +60,17 @@ class CalinskiHarabaszScore(_DataLabelMetric):
 
 
 class DaviesBouldinScore(_DataLabelMetric):
-    """Average worst-case cluster similarity (clustering/davies_bouldin_score.py:28)."""
+    """Average worst-case cluster similarity (clustering/davies_bouldin_score.py:28).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+        >>> metric = DaviesBouldinScore()
+        >>> x = jnp.asarray([[0.0, 0.0], [0.0, 1.0], [5.0, 5.0], [5.0, 6.0]])
+        >>> metric.update(x, jnp.asarray([0, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.1414
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0

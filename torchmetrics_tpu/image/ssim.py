@@ -18,7 +18,17 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 class StructuralSimilarityIndexMeasure(Metric):
     """SSIM; per-image similarity kept as scalar sum (mean reduction) or cat
-    state (reference image/ssim.py:30-210)."""
+    state (reference image/ssim.py:30-210).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> img = jnp.arange(256.0).reshape(1, 1, 16, 16) / 256.0
+        >>> metric.update(img, img * 0.9)
+        >>> round(float(metric.compute()), 4)
+        0.9893
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -118,7 +118,16 @@ class PearsonsContingencyCoefficient(_ContingencyMetric):
 
 
 class TheilsU(_ContingencyMetric):
-    """Theil's U uncertainty coefficient (nominal/theils_u.py:30); asymmetric."""
+    """Theil's U uncertainty coefficient (nominal/theils_u.py:30); asymmetric.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import TheilsU
+        >>> metric = TheilsU(num_classes=3)
+        >>> metric.update(jnp.asarray([0, 1, 2, 1, 0, 2, 0, 1]), jnp.asarray([0, 1, 2, 2, 0, 1, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.6193
+    """
 
     def _compute(self, state: State) -> Array:
         return _theils_u_compute(state["confmat"])
