@@ -1,0 +1,54 @@
+"""Segmentation + nominal metrics through the 8-device sharded-sync path."""
+
+import numpy as np
+import pytest
+
+from tests.helpers.sharded import assert_sharded_parity
+
+N = 16
+
+
+@pytest.fixture()
+def index_maps():
+    rng = np.random.default_rng(61)
+    preds = rng.integers(0, 3, size=(2, N, 8, 8))
+    target = rng.integers(0, 3, size=(2, N, 8, 8))
+    return preds, target
+
+
+def test_sharded_mean_iou(mesh, index_maps):
+    from torchmetrics_tpu.segmentation import MeanIoU
+
+    preds, target = index_maps
+    assert_sharded_parity(
+        mesh,
+        lambda: MeanIoU(num_classes=3, input_format="index"),
+        [(preds[0], target[0]), (preds[1], target[1])],
+        atol=1e-5,
+    )
+
+
+def test_sharded_generalized_dice(mesh, index_maps):
+    from torchmetrics_tpu.segmentation import GeneralizedDiceScore
+
+    preds, target = index_maps
+    assert_sharded_parity(
+        mesh,
+        lambda: GeneralizedDiceScore(num_classes=3, input_format="index"),
+        [(preds[0], target[0]), (preds[1], target[1])],
+        atol=1e-5,
+    )
+
+
+def test_sharded_cramers_v(mesh):
+    from torchmetrics_tpu.nominal import CramersV
+
+    rng = np.random.default_rng(62)
+    preds = rng.integers(0, 3, size=(2, 64))
+    target = rng.integers(0, 3, size=(2, 64))
+    assert_sharded_parity(
+        mesh,
+        lambda: CramersV(num_classes=3),
+        [(preds[0], target[0]), (preds[1], target[1])],
+        atol=1e-5,
+    )
