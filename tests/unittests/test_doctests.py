@@ -54,6 +54,7 @@ DOCTEST_MODULES = {
     "torchmetrics_tpu.classification.exact_match": 1,
     "torchmetrics_tpu.image.ssim": 1,
     "torchmetrics_tpu.clustering.intrinsic": 2,
+    "torchmetrics_tpu.functional.pairwise.pairwise": 2,
 }
 
 

@@ -56,7 +56,15 @@ def pairwise_cosine_similarity(
     reduction: Optional[Literal["mean", "sum", "none"]] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Cosine similarity matrix: xᵢ·yⱼ / (‖xᵢ‖‖yⱼ‖)."""
+    """Cosine similarity matrix: xᵢ·yⱼ / (‖xᵢ‖‖yⱼ‖).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+        >>> round(float(pairwise_cosine_similarity(x)[0, 2]), 4)  # diag zeroed by default
+        0.7071
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_norm = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-12)
     y_norm = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-12)
@@ -70,7 +78,15 @@ def pairwise_euclidean_distance(
     reduction: Optional[Literal["mean", "sum", "none"]] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> Array:
-    """Euclidean distance matrix via the ‖x‖² + ‖y‖² - 2x·y expansion (one matmul)."""
+    """Euclidean distance matrix via the ‖x‖² + ‖y‖² - 2x·y expansion (one matmul).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]])
+        >>> round(float(pairwise_euclidean_distance(x)[0, 1]), 4)
+        1.4142
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_sq = jnp.sum(x * x, axis=1, keepdims=True)  # (N, 1)
     y_sq = jnp.sum(y * y, axis=1, keepdims=True).T  # (1, M)
