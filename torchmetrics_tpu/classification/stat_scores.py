@@ -84,7 +84,16 @@ class _AbstractStatScores(Metric):
 
 
 class BinaryStatScores(_AbstractStatScores):
-    """Binary tp/fp/tn/fn (reference: classification/stat_scores.py:91)."""
+    """Binary tp/fp/tn/fn (reference: classification/stat_scores.py:91).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryStatScores
+        >>> metric = BinaryStatScores()
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.6, 0.3]), jnp.asarray([0, 1, 0, 1]))
+        >>> metric.compute().tolist()  # [tp, fp, tn, fn, support]
+        [1, 1, 1, 1, 2]
+    """
 
     is_differentiable = False
     higher_is_better = None

@@ -22,7 +22,18 @@ from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
 
 
 class MetricCollection(dict):
-    """Dict-like container of metrics sharing one ``update``/``compute`` call."""
+    """Dict-like container of metrics sharing one ``update``/``compute`` call.
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> metrics = MetricCollection({"acc": MulticlassAccuracy(num_classes=3, average="micro"),
+        ...                         "f1": MulticlassF1Score(num_classes=3, average="macro")})
+        >>> metrics.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        >>> {k: round(float(v), 4) for k, v in sorted(metrics.compute().items())}
+        {'acc': 0.75, 'f1': 0.7778}
+    """
 
     _groups: Dict[int, List[str]]
 

@@ -95,12 +95,34 @@ class MeanAbsoluteError(_SumCountMetric):
 
 
 class MeanAbsolutePercentageError(_SumCountMetric):
+    """MeanAbsolutePercentageError (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsolutePercentageError
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.3274
+    """
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _mean_absolute_percentage_error_update(preds, target)
         return {"measure": state["measure"] + s, "total": state["total"] + n}
 
 
 class SymmetricMeanAbsolutePercentageError(_SumCountMetric):
+    """SymmetricMeanAbsolutePercentageError (see module docstring for the reference mapping).
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SymmetricMeanAbsolutePercentageError
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> metric.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> round(float(metric.compute()), 4)
+        0.5788
+    """
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _symmetric_mape_update(preds, target)
         return {"measure": state["measure"] + s, "total": state["total"] + n}

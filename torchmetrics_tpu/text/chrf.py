@@ -15,7 +15,16 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 class CHRFScore(Metric):
     """chrF/chrF++; state = six per-order count arrays, sum-reduced
-    (reference text/chrf.py:52 keeps the same counts as dict states)."""
+    (reference text/chrf.py:52 keeps the same counts as dict states).
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> metric = CHRFScore()
+        >>> metric.update(["the cat is on the mat"], [["a cat is on the mat"]])
+        >>> round(float(metric.compute()), 4)
+        0.864
+    """
 
     is_differentiable = False
     higher_is_better = True
