@@ -1,0 +1,92 @@
+"""Audio metrics through the 8-device sharded-sync path.
+
+Enrollment of the universal sharded tester for the audio domain (VERDICT r4
+next #2): the SNR/SDR family's (Σ value, n) sum states batch-split over the
+mesh, psum in-graph, and must compute identically to single-device
+accumulation (reference ddp coverage: the `average_metric` ddp cases of
+/root/reference/tests/unittests/audio/test_snr.py et al.).
+"""
+
+import numpy as np
+import pytest
+
+from tests.helpers.sharded import assert_sharded_parity
+
+N = 16  # waveforms per step; 8 devices x 2
+T = 128  # samples per waveform
+
+
+@pytest.fixture()
+def waveforms():
+    rng = np.random.default_rng(21)
+    target = rng.normal(size=(2, N, T)).astype(np.float32)
+    noise = rng.normal(size=(2, N, T)).astype(np.float32)
+    preds = target + 0.3 * noise
+    return preds, target
+
+
+def _batches(preds, target):
+    return [(preds[0], target[0]), (preds[1], target[1])]
+
+
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("SignalNoiseRatio", {}),
+        ("SignalNoiseRatio", {"zero_mean": True}),
+        ("ScaleInvariantSignalNoiseRatio", {}),
+        ("ScaleInvariantSignalDistortionRatio", {}),
+    ],
+)
+def test_sharded_audio(mesh, waveforms, name, kwargs):
+    import torchmetrics_tpu.audio as A
+
+    ctor = getattr(A, name)
+    assert_sharded_parity(mesh, lambda: ctor(**kwargs), _batches(*waveforms), atol=1e-4, rtol=1e-4)
+
+
+def test_sharded_sa_sdr(mesh):
+    """SA-SDR aggregates over a per-sample sources axis — the batch dim that
+    shards must be a genuine (batch, spk, time) leading dim."""
+    from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+
+    rng = np.random.default_rng(22)
+    target = rng.normal(size=(2, N, 2, T)).astype(np.float32)
+    preds = target + 0.3 * rng.normal(size=(2, N, 2, T)).astype(np.float32)
+    assert_sharded_parity(
+        mesh,
+        SourceAggregatedSignalDistortionRatio,
+        _batches(preds, target),
+        atol=1e-4,
+        rtol=1e-4,
+    )
+
+
+def test_sharded_snr_matches_analytic_oracle(mesh, waveforms):
+    """Sharded ≡ single ≡ the closed-form SNR mean over all waveforms."""
+    from torchmetrics_tpu.audio import SignalNoiseRatio
+
+    preds, target = waveforms
+    p = preds.reshape(-1, T)
+    t = target.reshape(-1, T)
+    noise = p - t
+    snr = 10 * np.log10((t**2).sum(-1) / (noise**2).sum(-1))
+    assert_sharded_parity(
+        mesh, SignalNoiseRatio, _batches(preds, target), oracle=float(snr.mean()), atol=1e-4,
+        rtol=1e-4,
+    )
+
+
+def test_sharded_sdr(mesh, waveforms):
+    """SDR's per-sample value solves a Toeplitz system — heavier graph, same
+    sum-state sync contract."""
+    from torchmetrics_tpu.audio import SignalDistortionRatio
+
+    preds, target = waveforms
+    assert_sharded_parity(
+        mesh,
+        lambda: SignalDistortionRatio(filter_length=32),
+        _batches(preds, target),
+        atol=1e-3,
+        rtol=1e-3,
+    )

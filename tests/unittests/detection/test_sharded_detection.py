@@ -1,0 +1,223 @@
+"""Detection mAP through the 8-device ragged sharded-sync path.
+
+The reference treats mAP's sync as special enough to need a custom
+``_sync_dist`` (pad every per-image tensor to the world max, all_gather,
+trim — /root/reference/src/torchmetrics/detection/mean_ap.py:1022-1046 +
+utilities/distributed.py:136-147).  These tests push the repo's equivalent
+(:func:`torchmetrics_tpu.parallel.sync_ragged_states`) across a real
+8-device mesh with *uneven* per-device image counts and det/gt counts —
+including a device that saw no images at all — and assert the merged state
+computes identically to single-device accumulation and the torch oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.helpers.refpath import add_reference_paths
+from tests.helpers.sharded import assert_results_close
+
+add_reference_paths()
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.detection import MeanAveragePrecision  # noqa: E402
+from torchmetrics_tpu.parallel import sharded_list_update, sync_ragged_states  # noqa: E402
+
+UNBANDED_KEYS = ("map", "map_50", "map_75", "mar_1", "mar_10", "mar_100")
+
+
+def _ragged_images(seed: int, n_images: int, n_classes: int = 3, allow_empty: bool = True):
+    """Per-image (pred_dict, target_dict) with varying det/gt counts, incl.
+    zero-det and zero-gt images."""
+    rng = np.random.default_rng(seed)
+    images = []
+    for i in range(n_images):
+        ng = int(rng.integers(0 if allow_empty else 1, 7))
+        xy = rng.uniform(0, 150, (ng, 2))
+        wh = rng.uniform(8, 100, (ng, 2))
+        gb = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        gl = rng.integers(0, n_classes, ng)
+        keep = rng.uniform(0, 1, ng) < 0.8
+        pb = gb[keep] + rng.normal(0, 4, (int(keep.sum()), 4)).astype(np.float32)
+        pl = gl[keep].copy()
+        nfp = int(rng.integers(0, 4))
+        fp_xy = rng.uniform(0, 150, (nfp, 2))
+        fp_wh = rng.uniform(8, 60, (nfp, 2))
+        pb = np.concatenate([pb, np.concatenate([fp_xy, fp_xy + fp_wh], 1).astype(np.float32)])
+        pl = np.concatenate([pl, rng.integers(0, n_classes, nfp)])
+        ps = rng.uniform(0.1, 1, len(pl)).astype(np.float32)
+        pred = {"boxes": jnp.asarray(pb.reshape(-1, 4)), "scores": jnp.asarray(ps),
+                "labels": jnp.asarray(pl.astype(np.int32))}
+        target = {"boxes": jnp.asarray(gb.reshape(-1, 4)), "labels": jnp.asarray(gl.astype(np.int32))}
+        images.append((pred, target))
+    return images
+
+
+def _uneven_split(images, n_dev: int, seed: int):
+    """Assign images to devices with deliberately unequal counts; device 1
+    (when present) gets nothing — the all-empty-shard edge the reference's
+    pad-gather path must survive."""
+    rng = np.random.default_rng(seed + 1000)
+    assignment = rng.integers(0, n_dev, len(images))
+    if n_dev > 1:
+        assignment[assignment == 1] = 0  # starve device 1
+    per_dev = []
+    for d in range(n_dev):
+        mine = [images[i] for i in np.nonzero(assignment == d)[0]]
+        per_dev.append(([p for p, _ in mine], [t for _, t in mine]))
+    counts = [len(b[0]) for b in per_dev]
+    assert min(counts) == 0 and max(counts) >= 3, f"split not uneven enough: {counts}"
+    return per_dev
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_sharded_map_ragged_uneven_devices(mesh, seed):
+    images = _ragged_images(seed, n_images=16)
+    n_dev = mesh.devices.size
+    per_dev = _uneven_split(images, n_dev, seed)
+
+    single = MeanAveragePrecision(class_metrics=True)
+    for preds, targets in per_dev:  # same order the mesh path merges in
+        if preds:
+            single.update(preds, targets)
+    expected = single.compute()
+
+    sharded = MeanAveragePrecision(class_metrics=True)
+    state = sharded_list_update(sharded, per_dev, mesh=mesh)
+    # every image crossed the mesh exactly once
+    assert len(state["detection_scores"]) == sum(len(b[0]) for b in per_dev)
+    got = sharded.compute_state(state)
+    assert_results_close(got, expected, atol=1e-6, rtol=1e-6, label="sharded-map-vs-single")
+
+
+def test_sharded_map_matches_torch_oracle(mesh):
+    """Mesh-synced mAP ≡ the reference's pure-torch evaluator on the same
+    ragged dataset (crowd-free: the legacy oracle has no crowd handling —
+    see test_map_oracle.py scope notes)."""
+    torch = pytest.importorskip("torch")
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as LegacyMAP
+
+    images = _ragged_images(23, n_images=12, allow_empty=False)
+    per_dev = _uneven_split(images, mesh.devices.size, 23)
+
+    legacy = LegacyMAP()
+    for preds, targets in per_dev:
+        if not preds:
+            continue
+        legacy.update(
+            [{k: torch.tensor(np.asarray(v)) for k, v in p.items()} for p in preds],
+            [{k: torch.tensor(np.asarray(v)) for k, v in t.items()} for t in targets],
+        )
+    oracle = legacy.compute()
+
+    ours = MeanAveragePrecision()
+    state = sharded_list_update(ours, per_dev, mesh=mesh)
+    got = ours.compute_state(state)
+    for k in UNBANDED_KEYS:
+        np.testing.assert_allclose(float(got[k]), float(oracle[k]), atol=1e-5, err_msg=k)
+
+
+def test_sharded_map_crowd_state_survives_mesh(mesh):
+    """Crowd flags and user-provided areas are list states too — they must
+    cross the mesh bit-exactly (sharded ≡ single includes the crowd keys)."""
+    rng = np.random.default_rng(3)
+    images = []
+    for _ in range(8):
+        ng = int(rng.integers(1, 5))
+        xy = rng.uniform(0, 100, (ng, 2))
+        wh = rng.uniform(10, 80, (ng, 2))
+        gb = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        gl = rng.integers(0, 2, ng).astype(np.int32)
+        crowd = (rng.uniform(0, 1, ng) < 0.3).astype(np.int32)
+        pb = gb + rng.normal(0, 3, gb.shape).astype(np.float32)
+        ps = rng.uniform(0.1, 1, ng).astype(np.float32)
+        images.append((
+            {"boxes": jnp.asarray(pb), "scores": jnp.asarray(ps), "labels": jnp.asarray(gl)},
+            {"boxes": jnp.asarray(gb), "labels": jnp.asarray(gl), "iscrowd": jnp.asarray(crowd)},
+        ))
+    per_dev = _uneven_split(images, mesh.devices.size, 3)
+
+    single = MeanAveragePrecision()
+    for preds, targets in per_dev:
+        if preds:
+            single.update(preds, targets)
+    expected = single.compute()
+
+    sharded = MeanAveragePrecision()
+    state = sharded_list_update(sharded, per_dev, mesh=mesh)
+    got = sharded.compute_state(state)
+    assert_results_close(got, expected, atol=1e-6, rtol=1e-6, label="sharded-map-crowd")
+
+
+def test_sharded_map_segm_masks_cross_mesh(mesh):
+    """Mask (segm) states are (k, H, W) tensors ragged in EVERY dim — images
+    of different sizes give different H, W per item, so the pad must cover
+    trailing dims too (the reference pads all dims to the world max,
+    utilities/distributed.py:136-147)."""
+    rng = np.random.default_rng(9)
+    images = []
+    for _ in range(8):
+        n = int(rng.integers(1, 4))
+        hw = int(rng.integers(24, 48))  # per-image mask size varies
+        masks = np.zeros((n, hw, hw), bool)
+        for j in range(n):
+            x0, y0 = rng.integers(0, hw // 2, 2)
+            w, h = rng.integers(6, 14, 2)
+            masks[j, y0 : y0 + h, x0 : x0 + w] = True
+        noisy = masks.copy()
+        noisy[:, ::7, :] = False
+        lab = rng.integers(0, 2, n).astype(np.int32)
+        images.append((
+            {"masks": jnp.asarray(noisy), "scores": jnp.asarray(rng.uniform(0.2, 1, n).astype(np.float32)),
+             "labels": jnp.asarray(lab)},
+            {"masks": jnp.asarray(masks), "labels": jnp.asarray(lab)},
+        ))
+    per_dev = _uneven_split(images, mesh.devices.size, 9)
+
+    single = MeanAveragePrecision(iou_type="segm")
+    for preds, targets in per_dev:
+        if preds:
+            single.update(preds, targets)
+    expected = single.compute()
+
+    sharded = MeanAveragePrecision(iou_type="segm")
+    state = sharded_list_update(sharded, per_dev, mesh=mesh)
+    got = sharded.compute_state(state)
+    assert_results_close(got, expected, atol=1e-6, rtol=1e-6, label="sharded-map-segm")
+
+
+def test_sharded_list_update_rejects_overridden_sync(mesh):
+    """A metric whose sync_states is overridden does not combine leaf-wise —
+    the ragged path must refuse loudly instead of applying the table."""
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+
+    metric = PearsonCorrCoef()
+    with pytest.raises(ValueError, match="overrides sync_states"):
+        sharded_list_update(metric, [((), ())] * mesh.devices.size, mesh=mesh)
+
+
+def test_sync_ragged_states_device_order_and_lengths(mesh):
+    """Unit-level check of the pad-gather-trim primitive itself: items come
+    back in device order with exact lengths and values."""
+    n_dev = mesh.devices.size
+    reductions = {"items": None}
+    per_dev = []
+    for d in range(n_dev):
+        k = d % 3  # 0, 1 or 2 items per device
+        items = tuple(
+            jnp.asarray(np.full((d + j + 1, 2), 10 * d + j, np.float32)) for j in range(k)
+        )
+        per_dev.append({"items": items, "_n": jnp.asarray(1 if k else 0, jnp.int32)})
+
+    from torchmetrics_tpu.core.reductions import canonical_reduce
+
+    merged = sync_ragged_states(
+        {k: canonical_reduce(v) for k, v in reductions.items()}, per_dev, mesh
+    )
+    expected_items = [it for st in per_dev for it in st["items"]]
+    assert len(merged["items"]) == len(expected_items)
+    for got, exp in zip(merged["items"], expected_items):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(exp))
+    assert int(merged["_n"]) == sum(int(st["_n"]) for st in per_dev)

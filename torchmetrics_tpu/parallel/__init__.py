@@ -9,9 +9,14 @@ The reference's entire comm backend is ``gather_all_tensors``
 * :func:`gather_all_arrays` — host-level all-gather across processes (DCN);
 * :func:`metric_mesh`, :func:`sharded_update` — mesh construction and a
   one-call helper that runs a metric ``update`` on batch-sharded inputs and
-  psum-merges the partial states.
+  psum-merges the partial states;
+* :func:`sync_ragged_states` / :func:`sharded_list_update` — the
+  pad-gather-trim path for ragged list states (detection mAP's per-image
+  variable-length tensors; reference ``_sync_dist`` at
+  detection/mean_ap.py:1022-1046 + utilities/distributed.py:136-147).
 """
 
+from torchmetrics_tpu.parallel.ragged import sharded_list_update, sync_ragged_states
 from torchmetrics_tpu.parallel.sync import (
     distributed_available,
     gather_all_arrays,
@@ -26,6 +31,8 @@ __all__ = [
     "gather_all_arrays",
     "metric_mesh",
     "reduce_op",
+    "sharded_list_update",
     "sharded_update",
+    "sync_ragged_states",
     "sync_state",
 ]

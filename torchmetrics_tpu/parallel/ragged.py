@@ -1,0 +1,238 @@
+"""Ragged (variable-length) list-state sync across a device mesh.
+
+The hardest sync path in the reference is detection mAP's per-image
+variable-length cat states: each rank holds a *different number* of
+per-image tensors with *different shapes*, and its custom ``_sync_dist``
+pads every tensor to the world max, all_gathers, and trims
+(/root/reference/src/torchmetrics/detection/mean_ap.py:1022-1046 via
+``gather_all_tensors``'s pad-gather-trim slow path,
+/root/reference/src/torchmetrics/utilities/distributed.py:136-147).
+
+The TPU-native equivalent here: per-device list states are packed into ONE
+padded buffer + one per-item shape table per state name (items are padded in
+*every* dimension to the mesh max, like the reference's all-dims pad), a
+single tiled ``all_gather`` per state crosses the mesh inside ``shard_map``
+(ICI — not one collective per tensor like the reference's per-tensor
+gather), and the items are re-split on host.  Scalar (psum/pmax/...) states
+ride the same shard_map call, so a metric mixing tensor and list states
+syncs in one graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
+
+State = Dict[str, Any]
+_N = "_n"
+
+
+def _pack_items(
+    items: Sequence[Any], max_trailing: Tuple[int, ...], dtype
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad a device's items to the global trailing dims and concatenate along
+    the leading axis.  Returns (buffer, shapes) with shapes (k, ndim)."""
+    ndim = 1 + len(max_trailing)
+    shapes = np.zeros((len(items), ndim), np.int32)
+    padded = []
+    for j, it in enumerate(items):
+        arr = np.asarray(it)
+        if arr.ndim != ndim:
+            raise ValueError(
+                f"ragged list-state items must share rank: got {arr.ndim}d item among {ndim}d items"
+            )
+        shapes[j] = arr.shape
+        pad = [(0, 0)] + [(0, m - s) for m, s in zip(max_trailing, arr.shape[1:])]
+        padded.append(np.pad(arr, pad) if any(p != (0, 0) for p in pad) else arr)
+    if padded:
+        buf = np.concatenate(padded, axis=0)
+    else:
+        buf = np.zeros((0, *max_trailing), dtype)
+    return buf.astype(dtype, copy=False), shapes
+
+
+def _ragged_meta(per_device_items: Sequence[Sequence[Any]]) -> Optional[Tuple[Tuple[int, ...], Any]]:
+    """(elementwise-max trailing shape, dtype) over every item on every
+    device, or None if no device holds any item."""
+    max_trailing: Optional[np.ndarray] = None
+    dtype = None
+    for items in per_device_items:
+        for it in items:
+            arr = np.asarray(it)
+            t = np.asarray(arr.shape[1:], np.int64)
+            if max_trailing is None:
+                max_trailing, dtype = t, arr.dtype
+            else:
+                if len(t) != len(max_trailing):
+                    raise ValueError(
+                        f"ragged list-state items must share rank: {arr.ndim}d vs {1 + len(max_trailing)}d"
+                    )
+                if arr.dtype != dtype:
+                    raise ValueError(
+                        f"ragged list-state items must share dtype: {arr.dtype} vs {dtype} "
+                        "(a silent cast would diverge from single-device accumulation)"
+                    )
+                max_trailing = np.maximum(max_trailing, t)
+    if max_trailing is None:
+        return None
+    return tuple(int(x) for x in max_trailing), dtype
+
+
+def sync_ragged_states(
+    reductions: Mapping[str, Union[Reduce, Callable]],
+    per_device_states: Sequence[State],
+    mesh: Mesh,
+    axis_name: str = "data",
+) -> State:
+    """Combine per-device states whose list leaves are ragged, via one
+    in-graph pad-gather-trim per state name.
+
+    ``per_device_states``: one state pytree per mesh device (eager update
+    results on that device's input shard).  Tensor leaves are synced with the
+    normal reduction table; list ("cat"/None) leaves — tuples holding a
+    *device-dependent number* of arrays with *device-dependent shapes* (any
+    dimension may differ, e.g. segm masks from different-sized images) — are
+    padded in every dim to the mesh max, crossed with a tiled ``all_gather``,
+    and re-split, preserving device order (rank order in the reference).
+    Returns the replicated global state; re-split list items come back as
+    host numpy views (list states are host-side by construction — pushing
+    thousands of small per-image arrays back to the device would serialize
+    into tiny transfers the downstream compute immediately undoes).
+    """
+    n_dev = int(mesh.devices.size)
+    if len(per_device_states) != n_dev:
+        raise ValueError(
+            f"need one state per mesh device: got {len(per_device_states)} states for {n_dev} devices"
+        )
+    names = list(per_device_states[0].keys())
+
+    scalar_names: List[str] = []
+    ragged_names: List[str] = []
+    for name in names:
+        if name == _N:
+            continue
+        if isinstance(per_device_states[0][name], tuple):
+            ragged_names.append(name)
+        else:
+            scalar_names.append(name)
+
+    # ---- pack ragged leaves: one (buffer, shape-table) pair per name
+    packed: Dict[str, Tuple[np.ndarray, np.ndarray, int, int]] = {}  # name -> (bufs, shapes, L, K)
+    for name in ragged_names:
+        per_dev = [st[name] for st in per_device_states]
+        meta = _ragged_meta(per_dev)
+        if meta is None:  # no device holds items for this leaf
+            continue
+        max_trailing, dtype = meta
+        bufs, shapes = zip(*[_pack_items(items, max_trailing, dtype) for items in per_dev])
+        L = max(b.shape[0] for b in bufs) or 1
+        K = max(s.shape[0] for s in shapes) or 1
+        ndim = 1 + len(max_trailing)
+        buf_stack = np.zeros((n_dev * L, *max_trailing), dtype)
+        shape_stack = np.full((n_dev * K, ndim), -1, np.int32)
+        for d in range(n_dev):
+            buf_stack[d * L : d * L + bufs[d].shape[0]] = bufs[d]
+            shape_stack[d * K : d * K + shapes[d].shape[0]] = shapes[d]
+        packed[name] = (buf_stack, shape_stack, L, K)
+
+    scalar_stacks = {
+        name: jnp.stack([jnp.asarray(st[name]) for st in per_device_states])
+        for name in scalar_names
+    }
+    # "_n" is reserved-but-optional, matching sync_state's contract
+    has_n = _N in per_device_states[0]
+    n_stack = jnp.stack(
+        [jnp.asarray(st.get(_N, 0), jnp.int32) for st in per_device_states]
+    )
+
+    ragged_in = {name: (jnp.asarray(packed[name][0]), jnp.asarray(packed[name][1])) for name in packed}
+
+    def gather(scalars, n, ragged):
+        out_scalars = {
+            name: sync_leaf(reductions[name], scalars[name][0], axis_name) for name in scalars
+        }
+        out_n = jax.lax.psum(n[0], axis_name)
+        out_ragged = {
+            name: (
+                jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
+                jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
+            )
+            for name, (buf, shapes) in ragged.items()
+        }
+        return out_scalars, out_n, out_ragged
+
+    specs_in = (
+        {name: P(axis_name) for name in scalar_stacks},
+        P(axis_name),
+        {name: (P(axis_name), P(axis_name)) for name in ragged_in},
+    )
+    specs_out = (
+        {name: P() for name in scalar_stacks},
+        P(),
+        {name: (P(), P()) for name in ragged_in},
+    )
+    fn = jax.shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
+    g_scalars, g_n, g_ragged = fn(scalar_stacks, n_stack, ragged_in)
+
+    # ---- trim + re-split on host, preserving device order
+    out: State = {name: g_scalars[name] for name in scalar_names}
+    if has_n:
+        out[_N] = g_n
+    for name in ragged_names:
+        if name not in packed:  # every device empty
+            out[name] = ()
+            continue
+        _, _, L, K = packed[name]
+        buf = np.asarray(g_ragged[name][0])
+        shape_tab = np.asarray(g_ragged[name][1])
+        items: List[np.ndarray] = []
+        for d in range(n_dev):
+            dev_shapes = shape_tab[d * K : (d + 1) * K]
+            dev_shapes = dev_shapes[dev_shapes[:, 0] >= 0]
+            offset = d * L
+            for shp in dev_shapes:
+                lead = int(shp[0])
+                window = (slice(offset, offset + lead),) + tuple(slice(0, int(s)) for s in shp[1:])
+                items.append(buf[window])
+                offset += lead
+        out[name] = tuple(items)
+    return out
+
+
+def sharded_list_update(
+    metric: "Metric",  # noqa: F821 — forward ref
+    per_device_batches: Sequence[Tuple[Any, ...]],
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "data",
+) -> State:
+    """One metric step where each device sees its own (possibly ragged) batch.
+
+    The list-state counterpart of :func:`~torchmetrics_tpu.parallel.sync.sharded_update`:
+    ``update_state`` runs eagerly per device shard (list-state updates are
+    host-side by construction — the reference's are too), then every partial
+    state crosses the mesh through :func:`sync_ragged_states`'s single
+    padded all_gather per state.  Returns the replicated global state, ready
+    for ``compute_state``.
+    """
+    from torchmetrics_tpu.core.metric import Metric
+    from torchmetrics_tpu.parallel.sync import metric_mesh
+
+    if type(metric).sync_states is not Metric.sync_states:
+        # the pad-gather-trim combine below applies the per-leaf reduction
+        # table; a metric that overrides sync_states (streaming moments,
+        # wrapper fan-out) needs its own cross-shard aggregation, and
+        # applying the table instead would be silently wrong
+        raise ValueError(
+            f"{type(metric).__name__} overrides sync_states, so its states do not combine "
+            "leaf-wise under the reduction table. Use sharded_update (tensor states) or sync "
+            "its states with the metric's own sync_states inside shard_map."
+        )
+    mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
+    states = [metric.update_state(metric.init_state(), *batch) for batch in per_device_batches]
+    return sync_ragged_states(metric._reductions, states, mesh, axis_name)
