@@ -1,0 +1,88 @@
+"""Differentiability harness (VERDICT r4 next #5).
+
+The reference's ``MetricTester.run_differentiability_test`` takes
+``torch.autograd.gradcheck`` through ``metric(preds, target)`` for every
+metric declaring ``is_differentiable``
+(/root/reference/tests/unittests/_helpers/testers.py:531-561).  The JAX
+equivalent: ``jax.grad`` of a scalarized ``compute(update(init, *inputs))``
+w.r.t. ``preds`` must be finite AND match a central finite difference along
+random directions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+State = dict
+
+
+def _scalarize(out: Any) -> jnp.ndarray:
+    leaves = [
+        leaf
+        for leaf in jax.tree.leaves(out)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)
+    ]
+    assert leaves, "metric produced no float output to differentiate"
+    return sum(jnp.sum(jnp.asarray(leaf)) for leaf in leaves)
+
+
+def assert_differentiable(
+    metric_ctor: Callable[[], Any],
+    *inputs: Any,
+    wrt: int = 0,
+    eps: float = 1e-2,
+    rtol: float = 5e-2,
+    atol: float = 1e-3,
+    n_directions: int = 2,
+    seed: int = 0,
+) -> None:
+    """``jax.grad`` through update→compute is finite and matches finite
+    differences along ``n_directions`` random unit directions."""
+    metric = metric_ctor()
+    assert metric.is_differentiable is True, (
+        f"{type(metric).__name__} enrolled in the differentiability harness but declares "
+        f"is_differentiable={metric.is_differentiable}"
+    )
+    inputs = tuple(jnp.asarray(x, jnp.float32) if i == wrt else x for i, x in enumerate(inputs))
+
+    def scalar_fn(x):
+        args = list(inputs)
+        args[wrt] = x
+        state = metric.update_state(metric.init_state(), *args)
+        return _scalarize(metric.compute_state(state))
+
+    x0 = inputs[wrt]
+    grad = jax.grad(scalar_fn)(x0)
+    assert np.isfinite(np.asarray(grad)).all(), (
+        f"{type(metric).__name__}: non-finite gradient entries"
+    )
+
+    f = jax.jit(scalar_fn)
+    rng = np.random.default_rng(seed)
+    for d in range(n_directions):
+        v = rng.normal(size=x0.shape).astype(np.float32)
+        v /= np.linalg.norm(v) + 1e-12
+        v = jnp.asarray(v)
+        fd = (float(f(x0 + eps * v)) - float(f(x0 - eps * v))) / (2 * eps)
+        analytic = float(jnp.vdot(grad, v))
+        np.testing.assert_allclose(
+            analytic,
+            fd,
+            rtol=rtol,
+            atol=atol,
+            err_msg=f"{type(metric).__name__}: grad/finite-difference mismatch (direction {d})",
+        )
+
+
+def assert_declared_not_differentiable(metric_ctor: Callable[[], Any]) -> None:
+    """Metrics outside the harness must say so explicitly — a None/True claim
+    without enrollment is a contract violation (reference testers.py:546)."""
+    metric = metric_ctor()
+    assert metric.is_differentiable is False, (
+        f"{type(metric).__name__}.is_differentiable={metric.is_differentiable}; "
+        "non-enrolled metrics must declare False"
+    )

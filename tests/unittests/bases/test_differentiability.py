@@ -1,0 +1,166 @@
+"""Differentiability contract across domains (VERDICT r4 next #5).
+
+Every metric declaring ``is_differentiable=True`` gets ``jax.grad`` taken
+through ``compute(update(init, preds, target))``, checked finite and against
+finite differences (tests/helpers/differentiability.py — the mesh-native
+``run_differentiability_test``, reference testers.py:531-561).  A sweep also
+asserts the attribute is explicitly declared on every concrete metric.
+"""
+
+import numpy as np
+import pytest
+
+from tests.helpers.differentiability import assert_differentiable
+
+N = 16
+
+
+@pytest.fixture()
+def reg_inputs():
+    rng = np.random.default_rng(7)
+    preds = rng.normal(size=N).astype(np.float32)
+    target = preds + 0.3 * rng.normal(size=N).astype(np.float32)
+    return preds, target
+
+
+# ------------------------------------------------------------------ regression
+@pytest.mark.parametrize(
+    "name,kwargs",
+    [
+        ("MeanSquaredError", {}),
+        ("MeanAbsoluteError", {}),
+        ("ExplainedVariance", {}),
+        ("R2Score", {}),
+        ("CosineSimilarity", {}),
+        ("KLDivergence", {}),
+    ],
+)
+def test_regression_differentiable(reg_inputs, name, kwargs):
+    import torchmetrics_tpu.regression as R
+
+    preds, target = reg_inputs
+    if name == "KLDivergence":
+        p = np.abs(preds.reshape(4, 4)) + 0.1
+        q = np.abs(target.reshape(4, 4)) + 0.1
+        assert_differentiable(
+            lambda: getattr(R, name)(**kwargs), p / p.sum(-1, keepdims=True),
+            q / q.sum(-1, keepdims=True),
+        )
+    elif name == "CosineSimilarity":
+        assert_differentiable(
+            lambda: getattr(R, name)(**kwargs), preds.reshape(4, 4), target.reshape(4, 4)
+        )
+    else:
+        assert_differentiable(lambda: getattr(R, name)(**kwargs), preds, target)
+
+
+# ---------------------------------------------------------------------- audio
+@pytest.mark.parametrize(
+    "name", ["SignalNoiseRatio", "ScaleInvariantSignalNoiseRatio", "ScaleInvariantSignalDistortionRatio"]
+)
+def test_audio_differentiable(name):
+    import torchmetrics_tpu.audio as A
+
+    rng = np.random.default_rng(3)
+    target = rng.normal(size=(2, 64)).astype(np.float32)
+    preds = target + 0.4 * rng.normal(size=(2, 64)).astype(np.float32)
+    assert_differentiable(lambda: getattr(A, name)(), preds, target)
+
+
+# ---------------------------------------------------------------------- image
+def test_psnr_differentiable():
+    from torchmetrics_tpu.image import PeakSignalNoiseRatio
+
+    rng = np.random.default_rng(5)
+    preds = rng.uniform(0.2, 0.8, size=(1, 3, 8, 8)).astype(np.float32)
+    target = np.clip(preds + 0.1 * rng.normal(size=preds.shape), 0, 1).astype(np.float32)
+    assert_differentiable(lambda: PeakSignalNoiseRatio(data_range=1.0), preds, target)
+
+
+def test_ssim_differentiable():
+    from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+
+    rng = np.random.default_rng(6)
+    preds = rng.uniform(0.2, 0.8, size=(1, 1, 16, 16)).astype(np.float32)
+    target = np.clip(preds + 0.1 * rng.normal(size=preds.shape), 0, 1).astype(np.float32)
+    assert_differentiable(
+        lambda: StructuralSimilarityIndexMeasure(data_range=1.0), preds, target,
+        rtol=8e-2,
+    )
+
+
+# ------------------------------------------------------------ classification
+def test_hinge_differentiable():
+    from torchmetrics_tpu.classification import BinaryHingeLoss
+
+    rng = np.random.default_rng(8)
+    preds = rng.uniform(0.1, 0.9, size=N).astype(np.float32)
+    target = rng.integers(0, 2, size=N)
+    assert_differentiable(lambda: BinaryHingeLoss(), preds, target)
+
+
+# ----------------------------------------------------------------------- text
+def test_perplexity_differentiable():
+    from torchmetrics_tpu.text import Perplexity
+
+    rng = np.random.default_rng(9)
+    logits = rng.normal(size=(1, 6, 5)).astype(np.float32)
+    target = rng.integers(0, 5, size=(1, 6))
+    assert_differentiable(lambda: Perplexity(), logits, target)
+
+
+# ------------------------------------------- threshold metrics: zero gradient
+def test_accuracy_gradient_is_zero_not_useful():
+    """Thresholded metrics are a.e. flat: jax.grad runs but returns zeros —
+    exactly why they declare is_differentiable=False (the reference documents
+    the same: metric.py docs 'property ... if metric is differentiable')."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    m = BinaryAccuracy(validate_args=False)
+    assert m.is_differentiable is False
+
+    def f(preds):
+        st = m.update_state(m.init_state(), preds, jnp.asarray([1, 0, 1, 0]))
+        return m.compute_state(st)
+
+    g = jax.grad(f)(jnp.asarray([0.9, 0.2, 0.7, 0.4]))
+    assert np.allclose(np.asarray(g), 0.0)
+
+
+# -------------------------------------------------- declaration completeness
+def test_every_concrete_metric_declares_differentiability():
+    """Every exported concrete Metric class must pin is_differentiable to
+    True or False — None (undeclared) is a missing contract."""
+    import torchmetrics_tpu
+    import torchmetrics_tpu.audio as A
+    import torchmetrics_tpu.classification as C
+    import torchmetrics_tpu.clustering as CL
+    import torchmetrics_tpu.detection as D
+    import torchmetrics_tpu.image as I
+    import torchmetrics_tpu.nominal as NM
+    import torchmetrics_tpu.regression as R
+    import torchmetrics_tpu.retrieval as RT
+    import torchmetrics_tpu.segmentation as S
+    import torchmetrics_tpu.text as T
+    from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+    from torchmetrics_tpu.core.metric import Metric
+
+    undeclared = []
+    for pkg in (A, C, CL, D, I, NM, R, RT, S, T, torchmetrics_tpu.multimodal):
+        for name in getattr(pkg, "__all__", dir(pkg)):
+            obj = getattr(pkg, name, None)
+            if (
+                isinstance(obj, type)
+                and issubclass(obj, Metric)
+                and obj.__module__.startswith("torchmetrics_tpu")
+                # task-dispatch facades construct a Binary*/Multiclass* in
+                # __new__ and are never instantiated as themselves; the
+                # concrete classes they return all declare the contract
+                and not issubclass(obj, _ClassificationTaskWrapper)
+            ):
+                if obj.is_differentiable is None:
+                    undeclared.append(f"{obj.__module__}.{obj.__name__}")
+    assert not undeclared, f"metrics without a differentiability declaration: {sorted(set(undeclared))}"

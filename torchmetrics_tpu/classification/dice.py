@@ -1,4 +1,14 @@
-"""Dice metric class (reference: classification/dice.py:31)."""
+"""Dice metric class (reference: classification/dice.py:31).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import Dice
+    >>> metric = Dice(average='micro', num_classes=3)
+    >>> metric.update(jnp.asarray([2, 0, 2, 1]), jnp.asarray([1, 0, 2, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.75
+"""
 
 from __future__ import annotations
 

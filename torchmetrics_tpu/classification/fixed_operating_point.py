@@ -3,6 +3,17 @@
 Reference: classification/{precision_fixed_recall.py, recall_fixed_precision
 .py, sensitivity_specificity.py, specificity_sensitivity.py} — each subclasses
 the corresponding curve metric and post-processes the curve at compute.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import PrecisionAtFixedRecall
+    >>> metric = PrecisionAtFixedRecall(task='binary', min_recall=0.5)
+    >>> metric.update(jnp.asarray([0.1, 0.4, 0.6, 0.85]), jnp.asarray([0, 1, 0, 1]))
+    >>> prec, thresh = metric.compute()
+    >>> (round(float(prec), 4), round(float(thresh), 4))
+    (1.0, 0.85)
 """
 
 from __future__ import annotations

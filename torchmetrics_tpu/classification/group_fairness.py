@@ -1,4 +1,14 @@
-"""Group fairness metric classes (reference: classification/group_fairness.py:59,157)."""
+"""Group fairness metric classes (reference: classification/group_fairness.py:59,157).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryFairness
+    >>> metric = BinaryFairness(num_groups=2)
+    >>> metric.update(jnp.asarray([0.9, 0.2, 0.8, 0.4]), jnp.asarray([1, 0, 1, 0]), jnp.asarray([0, 0, 1, 1]))
+    >>> {k: round(float(v), 4) for k, v in sorted(metric.compute().items())}
+    {'DP_0_0': 1.0, 'EO_0_0': 1.0}
+"""
 
 from __future__ import annotations
 

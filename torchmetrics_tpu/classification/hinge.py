@@ -1,4 +1,14 @@
-"""Hinge loss metric classes (reference: classification/hinge.py)."""
+"""Hinge loss metric classes (reference: classification/hinge.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+    >>> metric = BinaryHingeLoss()
+    >>> metric.update(jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75]), jnp.asarray([0, 0, 1, 1, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.69
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,14 @@
-"""Negative predictive value metric classes (reference: classification/negative_predictive_value.py)."""
+"""Negative predictive value metric classes (reference: classification/negative_predictive_value.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryNegativePredictiveValue
+    >>> metric = BinaryNegativePredictiveValue()
+    >>> metric.update(jnp.asarray([0.1, 0.9, 0.8, 0.3]), jnp.asarray([0, 1, 0, 1]))
+    >>> round(float(metric.compute()), 4)
+    0.5
+"""
 
 from torchmetrics_tpu.classification._factory import make_stat_metric_classes
 

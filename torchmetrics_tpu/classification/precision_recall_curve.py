@@ -4,6 +4,17 @@ Two state layouts, as in the reference:
 * ``thresholds=None`` — exact: cat-list states of (preds, target, weights);
 * ``thresholds`` given — binned (T, ..., 2, 2) confusion state, sum-reduced
   (the TPU-friendly layout: static shape, psum-able in-graph).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+    >>> metric = BinaryPrecisionRecallCurve(thresholds=None)
+    >>> metric.update(jnp.asarray([0.1, 0.6, 0.35, 0.8]), jnp.asarray([0, 1, 0, 1]))
+    >>> precision, recall, thresholds = metric.compute()
+    >>> precision
+    Array([0.5      , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32)
 """
 
 from __future__ import annotations

@@ -1,4 +1,14 @@
-"""Multilabel ranking metric classes (reference: classification/ranking.py:40,160,280)."""
+"""Multilabel ranking metric classes (reference: classification/ranking.py:40,160,280).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import MultilabelRankingAveragePrecision
+    >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+    >>> metric.update(jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.6, 0.1]]), jnp.asarray([[1, 0, 1], [0, 0, 1]]))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+"""
 
 from __future__ import annotations
 

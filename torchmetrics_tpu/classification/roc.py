@@ -1,4 +1,15 @@
-"""ROC metric classes (reference: classification/roc.py:42,175,346)."""
+"""ROC metric classes (reference: classification/roc.py:42,175,346).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.classification import BinaryROC
+    >>> metric = BinaryROC(thresholds=None)
+    >>> metric.update(jnp.asarray([0.1, 0.6, 0.35, 0.8]), jnp.asarray([0, 1, 0, 1]))
+    >>> fpr, tpr, thresholds = metric.compute()
+    >>> tpr
+    Array([0. , 0.5, 1. , 1. , 1. ], dtype=float32)
+"""
 
 from __future__ import annotations
 

@@ -22,6 +22,27 @@ State is a dict pytree ``{name: Array | tuple[Array, ...]}`` plus a reserved
 — still a pytree, so every state is shardable, donat-able and checkpointable
 with orbax as-is.  ``sync`` is pure and returns a *new* state, which deletes
 the reference's cache/restore sync-unsync dance (metric.py:507-608) wholesale.
+
+Example::
+
+    >>> import jax, jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> metric = BinaryAccuracy()
+    >>> # eager facade (reference-API parity)
+    >>> metric.update(jnp.asarray([0.9, 0.2, 0.8]), jnp.asarray([1, 0, 0]))
+    >>> round(float(metric.compute()), 4)
+    0.6667
+    >>> # functional core: pure + jittable, usable inside a pjit'd step
+    >>> @jax.jit
+    ... def eval_step(state, preds, target):
+    ...     return metric.update_state(state, preds, target)
+    >>> state = eval_step(metric.init_state(), jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    >>> round(float(metric.compute_state(state)), 4)
+    1.0
+    >>> # states merge under the per-leaf reduction table (checkpoint joining)
+    >>> merged = metric.merge_states(state, state)
+    >>> int(merged["_n"])
+    2
 """
 
 from __future__ import annotations
@@ -351,6 +372,7 @@ class Metric:
         d = self.__dict__.copy()
         d.pop("_jitted_update", None)
         d.pop("_update_signature", None)
+        d.pop("_sharded_fn_cache", None)  # compiled shard_map steps (parallel/sync.py)
         d["_state"] = jax.tree.map(np.asarray, self._state)
         d["_defaults"] = jax.tree.map(np.asarray, self._defaults)
         d["_computed"] = None

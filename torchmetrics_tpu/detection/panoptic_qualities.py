@@ -1,5 +1,17 @@
 """PanopticQuality / ModifiedPanopticQuality modular metrics
-(reference: detection/panoptic_qualities.py:40,299)."""
+(reference: detection/panoptic_qualities.py:40,299).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.detection import PanopticQuality
+    >>> metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    >>> preds = jnp.asarray([[[[6, 0], [0, 0]], [[6, 0], [7, 0]]]])
+    >>> target = jnp.asarray([[[[6, 0], [0, 1]], [[6, 0], [7, 0]]]])
+    >>> metric.update(preds, target)
+    >>> round(float(metric.compute()), 4)
+    1.0
+"""
 
 from __future__ import annotations
 

@@ -4,6 +4,15 @@ of ITU-T P.862 is out of scope and the C package is not in this image).
 
 A custom backend callable ``(fs, target, preds, mode) -> float`` may be
 supplied for hermetic use.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
+    >>> toy_backend = lambda fs, target, preds, mode: 4.5  # hermetic stand-in for the C package
+    >>> sig = jnp.zeros(16000)
+    >>> float(perceptual_evaluation_speech_quality(sig, sig, fs=16000, mode='wb', backend=toy_backend))
+    4.5
 """
 
 from __future__ import annotations

@@ -6,6 +6,19 @@ permutation-wise mode, pit.py:150-165; its speaker-wise mode loops a Python
 double-for over the spk×spk matrix — here that matrix is built with one
 vmapped call too).  For large speaker counts the Hungarian solver
 (scipy.linalg_sum_assignment) replaces the exhaustive O(spk!) scan.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate
+    >>> from torchmetrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio
+    >>> target = jnp.asarray([[[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]])
+    >>> preds = target[:, ::-1, :]  # speakers swapped
+    >>> best_metric, best_perm = permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio)
+    >>> best_perm
+    Array([[0, 1]], dtype=int32)
+    >>> bool(jnp.allclose(pit_permutate(preds, best_perm), target))
+    False
 """
 
 from __future__ import annotations

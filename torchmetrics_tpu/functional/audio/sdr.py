@@ -5,6 +5,15 @@ BSS-eval SDR projects ``preds`` onto the span of ``filter_length`` shifts of
 system solved in one batched ``jnp.linalg.solve`` — the FFT and the solve both
 map well onto XLA (the reference uses torch.fft + torch.linalg.solve the same
 way; the optional fast-bss-eval conjugate-gradient path is not needed here).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+    >>> preds = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    >>> target = jnp.asarray([3.0, -0.5, 2.0, 8.0])
+    >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
+    25.5862
 """
 
 from __future__ import annotations

@@ -1,4 +1,15 @@
-"""SNR family (reference: functional/audio/snr.py:22-150)."""
+"""SNR family (reference: functional/audio/snr.py:22-150).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.audio.snr import signal_noise_ratio, scale_invariant_signal_noise_ratio
+    >>> preds = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    >>> target = jnp.asarray([3.0, -0.5, 2.0, 8.0])
+    >>> round(float(signal_noise_ratio(preds, target)), 4)
+    18.879
+    >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+    23.5724
+"""
 
 from __future__ import annotations
 

@@ -6,6 +6,18 @@ filterbank (4th-order IIR approximated with FFT-domain magnitude response),
 modulation filterbank over the temporal envelope, and the ratio of low (first
 4) to high modulation-band energy.  Follows the SRMR toolbox structure
 [Falk et al., 2010] with norm=False defaults.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+    >>> rng = np.random.default_rng(0)
+    >>> t = np.linspace(0, 1, 8000, dtype=np.float32)
+    >>> speech_like = np.sin(2 * np.pi * 220 * t) * (1 + 0.5 * np.sin(2 * np.pi * 4 * t))
+    >>> v = speech_reverberation_modulation_energy_ratio(jnp.asarray(speech_like), fs=8000)
+    >>> bool(v > 0)
+    True
 """
 
 from __future__ import annotations

@@ -6,6 +6,16 @@ Pipeline: resample to 10 kHz → remove silent frames (40 dB below max energy)
 → 256/128 STFT → 15 one-third-octave bands from 150 Hz → 30-frame segments →
 (extended: row/col-normalized correlation; classic: clipped normalized
 correlation with −15 dB SDR bound) → average.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+    >>> rng = np.random.default_rng(0)
+    >>> target = jnp.asarray(rng.normal(size=16000).astype(np.float32))
+    >>> round(float(short_time_objective_intelligibility(target, target, fs=16000)), 4)  # identity -> 1
+    1.0
 """
 
 from __future__ import annotations

@@ -1,4 +1,16 @@
-"""Accuracy kernels (reference: functional/classification/accuracy.py:30-406)."""
+"""Accuracy kernels (reference: functional/classification/accuracy.py:30-406).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.accuracy import binary_accuracy, multiclass_accuracy
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 1, 1])
+    >>> round(float(binary_accuracy(preds, target)), 4)
+    0.75
+    >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.2, 2.5, 0.3], [0.1, 0.2, 0.4]])
+    >>> round(float(multiclass_accuracy(logits, jnp.asarray([0, 1, 0]), num_classes=3, average='micro')), 4)
+    0.6667
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,13 @@
-"""AUROC kernels (reference: functional/classification/auroc.py)."""
+"""AUROC kernels (reference: functional/classification/auroc.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.auroc import binary_auroc
+    >>> preds = jnp.asarray([0.1, 0.6, 0.35, 0.8])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> round(float(binary_auroc(preds, target)), 4)
+    1.0
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,13 @@
-"""Average precision kernels (reference: functional/classification/average_precision.py)."""
+"""Average precision kernels (reference: functional/classification/average_precision.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.average_precision import binary_average_precision
+    >>> preds = jnp.asarray([0.1, 0.6, 0.35, 0.8])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> round(float(binary_average_precision(preds, target, thresholds=None)), 4)
+    1.0
+"""
 
 from __future__ import annotations
 

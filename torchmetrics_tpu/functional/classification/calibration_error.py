@@ -5,6 +5,15 @@ TPU-native design difference: the reference stores raw confidence/accuracy
 confidence, so here the state is the **binned sufficient statistics**
 (conf_sum, acc_sum, count per bin) — fixed shape (n_bins,), ``sum``-reduced,
 accumulated with one XLA scatter-add.  Identical ECE, jittable, psum-able.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.calibration_error import binary_calibration_error
+    >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+    >>> target = jnp.asarray([0, 0, 1, 1, 1])
+    >>> round(float(binary_calibration_error(preds, target, n_bins=2, norm='l1')), 4)
+    0.29
 """
 
 from __future__ import annotations

@@ -1,4 +1,13 @@
-"""Cohen's kappa kernels (reference: functional/classification/cohen_kappa.py)."""
+"""Cohen's kappa kernels (reference: functional/classification/cohen_kappa.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.cohen_kappa import multiclass_cohen_kappa
+    >>> preds = jnp.asarray([2, 1, 0, 1])
+    >>> target = jnp.asarray([2, 1, 0, 0])
+    >>> round(float(multiclass_cohen_kappa(preds, target, num_classes=3)), 4)
+    0.6364
+"""
 
 from __future__ import annotations
 

@@ -5,6 +5,17 @@ formulation is a single static-length scatter-add (``_bincount`` over
 ``C * target + pred``) — one XLA scatter, no dynamic shapes.
 ``ignore_index`` contributes weight 0 via the scatter's update operand
 instead of boolean indexing.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.confusion_matrix import multiclass_confusion_matrix
+    >>> preds = jnp.asarray([2, 1, 0, 1])
+    >>> target = jnp.asarray([2, 1, 0, 0])
+    >>> multiclass_confusion_matrix(preds, target, num_classes=3)
+    Array([[1, 1, 0],
+           [0, 1, 0],
+           [0, 0, 1]], dtype=int32)
 """
 
 from __future__ import annotations

@@ -1,6 +1,15 @@
 """Dice score kernel (reference: functional/classification/dice.py / classification/dice.py:31).
 
 Dice == F1 on the stat-scores decomposition: 2*tp / (2*tp + fp + fn).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.dice import dice
+    >>> preds = jnp.asarray([2, 0, 2, 1])
+    >>> target = jnp.asarray([1, 0, 2, 1])
+    >>> round(float(dice(preds, target, average='micro', num_classes=3)), 4)
+    0.75
 """
 
 from __future__ import annotations

@@ -1,4 +1,13 @@
-"""Exact match kernels (reference: functional/classification/exact_match.py)."""
+"""Exact match kernels (reference: functional/classification/exact_match.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.exact_match import multilabel_exact_match
+    >>> preds = jnp.asarray([[0.9, 0.1, 0.8], [0.2, 0.7, 0.1]])
+    >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0]])
+    >>> round(float(multilabel_exact_match(preds, target, num_labels=3)), 4)
+    0.5
+"""
 
 from __future__ import annotations
 

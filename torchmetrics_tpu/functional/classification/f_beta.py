@@ -1,4 +1,15 @@
-"""F-beta / F1 kernels (reference: functional/classification/f_beta.py:26-915)."""
+"""F-beta / F1 kernels (reference: functional/classification/f_beta.py:26-915).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.f_beta import binary_f1_score, multiclass_fbeta_score
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 1, 1])
+    >>> round(float(binary_f1_score(preds, target)), 4)
+    0.8
+    >>> round(float(multiclass_fbeta_score(jnp.asarray([2, 1, 0, 0]), jnp.asarray([2, 1, 0, 1]), beta=0.5, num_classes=3)), 4)
+    0.7963
+"""
 
 from __future__ import annotations
 

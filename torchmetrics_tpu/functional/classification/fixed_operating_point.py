@@ -7,6 +7,16 @@ specificity_sensitivity.py}.  All four share one core: mask the curve points
 satisfying the constraint, lexicographic-argmax on (objective, constraint,
 threshold), return (best objective, its threshold) with the reference's
 (0, 1e6) fallback.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.fixed_operating_point import binary_precision_at_fixed_recall
+    >>> preds = jnp.asarray([0.1, 0.4, 0.6, 0.85])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> prec, thresh = binary_precision_at_fixed_recall(preds, target, min_recall=0.5)
+    >>> (round(float(prec), 4), round(float(thresh), 4))
+    (1.0, 0.85)
 """
 
 from __future__ import annotations

@@ -1,4 +1,14 @@
-"""Group fairness kernels (reference: functional/classification/group_fairness.py:59-157)."""
+"""Group fairness kernels (reference: functional/classification/group_fairness.py:59-157).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.group_fairness import binary_fairness
+    >>> preds = jnp.asarray([0.9, 0.2, 0.8, 0.4])
+    >>> target = jnp.asarray([1, 0, 1, 0])
+    >>> groups = jnp.asarray([0, 0, 1, 1])
+    >>> {k: round(float(v), 4) for k, v in binary_fairness(preds, target, groups, num_groups=2).items()}
+    {'DP_0_0': 1.0, 'EO_0_0': 1.0}
+"""
 
 from __future__ import annotations
 

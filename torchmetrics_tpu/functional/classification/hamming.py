@@ -1,4 +1,13 @@
-"""Hamming distance kernels (reference: functional/classification/hamming.py)."""
+"""Hamming distance kernels (reference: functional/classification/hamming.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.hamming import binary_hamming_distance
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> round(float(binary_hamming_distance(preds, target)), 4)
+    0.5
+"""
 
 from torchmetrics_tpu.functional.classification._family import (
     _binary_stat_metric,

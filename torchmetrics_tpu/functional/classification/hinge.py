@@ -1,4 +1,16 @@
-"""Hinge loss kernels (reference: functional/classification/hinge.py)."""
+"""Hinge loss kernels (reference: functional/classification/hinge.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.hinge import binary_hinge_loss, multiclass_hinge_loss
+    >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+    >>> target = jnp.asarray([0, 0, 1, 1, 1])
+    >>> round(float(binary_hinge_loss(preds, target)), 4)
+    0.69
+    >>> logits = jnp.asarray([[2.0, 0.5, 0.1], [0.2, 2.5, 0.3]])
+    >>> round(float(multiclass_hinge_loss(logits, jnp.asarray([0, 1]), num_classes=3)), 4)
+    0.3499
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,13 @@
-"""Jaccard index (IoU) kernels (reference: functional/classification/jaccard.py)."""
+"""Jaccard index (IoU) kernels (reference: functional/classification/jaccard.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.jaccard import multiclass_jaccard_index
+    >>> preds = jnp.asarray([2, 1, 0, 0])
+    >>> target = jnp.asarray([2, 1, 0, 1])
+    >>> round(float(multiclass_jaccard_index(preds, target, num_classes=3)), 4)
+    0.6667
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,13 @@
-"""Matthews correlation coefficient kernels (reference: functional/classification/matthews_corrcoef.py)."""
+"""Matthews correlation coefficient kernels (reference: functional/classification/matthews_corrcoef.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.matthews_corrcoef import binary_matthews_corrcoef
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 1, 1])
+    >>> round(float(binary_matthews_corrcoef(preds, target)), 4)
+    0.5774
+"""
 
 from __future__ import annotations
 

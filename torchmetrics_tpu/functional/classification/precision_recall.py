@@ -1,4 +1,15 @@
-"""Precision / Recall kernels (reference: functional/classification/precision_recall.py:40-928)."""
+"""Precision / Recall kernels (reference: functional/classification/precision_recall.py:40-928).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.precision_recall import binary_precision, multiclass_recall
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> round(float(binary_precision(preds, target)), 4)
+    0.5
+    >>> round(float(multiclass_recall(jnp.asarray([2, 1, 0, 0]), jnp.asarray([2, 1, 0, 1]), num_classes=3, average='macro')), 4)
+    0.8333
+"""
 
 from __future__ import annotations
 

@@ -11,6 +11,18 @@ state layouts, as in the reference:
   zero-length segments that change neither the curve nor any area under it.
 * ``thresholds=int/array`` — binned (T, 2, 2) confusion-matrix state,
   ``sum``-reduced: the TPU-friendly path (static shape, psum-able).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.precision_recall_curve import binary_precision_recall_curve
+    >>> preds = jnp.asarray([0.1, 0.6, 0.35, 0.8])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> precision, recall, thresholds = binary_precision_recall_curve(preds, target, thresholds=None)
+    >>> precision
+    Array([0.5      , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32)
+    >>> recall
+    Array([1. , 1. , 1. , 0.5, 0. ], dtype=float32)
 """
 
 from __future__ import annotations

@@ -1,4 +1,13 @@
-"""Multilabel ranking kernels (reference: functional/classification/ranking.py:40-280)."""
+"""Multilabel ranking kernels (reference: functional/classification/ranking.py:40-280).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.ranking import multilabel_ranking_average_precision
+    >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.6, 0.1]])
+    >>> target = jnp.asarray([[1, 0, 1], [0, 0, 1]])
+    >>> round(float(multilabel_ranking_average_precision(preds, target, num_labels=3)), 4)
+    0.6667
+"""
 
 from __future__ import annotations
 

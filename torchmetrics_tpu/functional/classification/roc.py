@@ -1,4 +1,16 @@
-"""ROC curve kernels (reference: functional/classification/roc.py)."""
+"""ROC curve kernels (reference: functional/classification/roc.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.roc import binary_roc
+    >>> preds = jnp.asarray([0.1, 0.6, 0.35, 0.8])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> fpr, tpr, thresholds = binary_roc(preds, target, thresholds=None)
+    >>> fpr
+    Array([0. , 0. , 0. , 0.5, 1. ], dtype=float32)
+    >>> tpr
+    Array([0. , 0.5, 1. , 1. , 1. ], dtype=float32)
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,13 @@
-"""Specificity kernels (reference: functional/classification/specificity.py)."""
+"""Specificity kernels (reference: functional/classification/specificity.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.specificity import binary_specificity
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> round(float(binary_specificity(preds, target)), 4)
+    0.5
+"""
 
 from torchmetrics_tpu.functional.classification._family import (
     _binary_stat_metric,

@@ -17,6 +17,15 @@ reductions/scatters XLA fuses well:
 
 ``ignore_index`` becomes a weight of zero instead of dynamic-shape boolean
 indexing (which XLA cannot compile).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.classification.stat_scores import binary_stat_scores
+    >>> preds = jnp.asarray([0.1, 0.9, 0.8, 0.3])
+    >>> target = jnp.asarray([0, 1, 0, 1])
+    >>> binary_stat_scores(preds, target)  # tp, fp, tn, fn, support
+    Array([1, 1, 1, 1, 2], dtype=int32)
 """
 
 from __future__ import annotations

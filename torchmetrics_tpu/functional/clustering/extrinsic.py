@@ -4,6 +4,17 @@ Reference: functional/clustering/{mutual_info_score,normalized_mutual_info_score
 adjusted_mutual_info_score,rand_score,adjusted_rand_score,fowlkes_mallows_index,
 homogeneity_completeness_v_measure}.py.  All are contingency-matrix based; the
 matrix is produced by an MXU matmul (see utils.calculate_contingency_matrix).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.clustering.extrinsic import mutual_info_score, adjusted_rand_score
+    >>> preds = jnp.asarray([0, 0, 1, 1])
+    >>> target = jnp.asarray([1, 1, 0, 0])
+    >>> round(float(mutual_info_score(preds, target)), 4)
+    0.6931
+    >>> round(float(adjusted_rand_score(preds, target)), 4)
+    1.0
 """
 
 from __future__ import annotations

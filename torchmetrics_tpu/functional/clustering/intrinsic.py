@@ -3,6 +3,15 @@
 Reference: functional/clustering/{calinski_harabasz_score,davies_bouldin_score,
 dunn_index}.py.  All three reduce to per-cluster means/dispersions computed by
 one-hot matmuls (MXU) rather than per-cluster python loops.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.clustering.intrinsic import calinski_harabasz_score
+    >>> data = jnp.asarray([[0.0, 0.0], [0.1, 0.1], [5.0, 5.0], [5.1, 4.9]])
+    >>> labels = jnp.asarray([0, 0, 1, 1])
+    >>> round(float(calinski_harabasz_score(data, labels)), 2)
+    4901.0
 """
 
 from __future__ import annotations

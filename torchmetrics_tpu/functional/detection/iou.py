@@ -1,4 +1,15 @@
-"""IoU-family functionals (reference: functional/detection/{iou,giou,diou,ciou}.py)."""
+"""IoU-family functionals (reference: functional/detection/{iou,giou,diou,ciou}.py).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.detection.iou import intersection_over_union, generalized_intersection_over_union
+    >>> preds = jnp.asarray([[100.0, 100.0, 200.0, 200.0]])
+    >>> target = jnp.asarray([[110.0, 110.0, 210.0, 210.0]])
+    >>> round(float(intersection_over_union(preds, target, aggregate=True)), 4)
+    0.6807
+    >>> round(float(generalized_intersection_over_union(preds, target, aggregate=True)), 4)
+    0.6641
+"""
 
 from __future__ import annotations
 

@@ -4,6 +4,15 @@
 Inputs are (B, *spatial, 2) arrays of (category_id, instance_id) pairs.
 Segment areas/intersections are computed with one vectorized unique pass over
 paired color codes instead of the reference's Python dict loops.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.detection.panoptic_quality import panoptic_quality
+    >>> preds = jnp.asarray([[[[6, 0], [0, 0]], [[6, 0], [7, 0]]]])
+    >>> target = jnp.asarray([[[[6, 0], [0, 1]], [[6, 0], [7, 0]]]])
+    >>> round(float(panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})), 4)
+    1.0
 """
 
 from __future__ import annotations

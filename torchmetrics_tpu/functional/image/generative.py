@@ -6,6 +6,22 @@ the pretrained InceptionV3 the reference downloads (fid.py:44
 ``NoTrainInceptionV3``) is replaced by a pluggable extractor interface, since
 weights cannot be fetched hermetically.  The math (eigenvalue Fréchet
 distance, polynomial-kernel MMD, marginal/conditional KL) is identical.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.image.generative import inception_score_from_logits, kid_from_features
+    >>> rng = np.random.default_rng(0)
+    >>> logits = jnp.asarray(rng.normal(size=(8, 10)).astype(np.float32))
+    >>> mean, std = inception_score_from_logits(logits, splits=2)
+    >>> bool(mean >= 1.0)  # IS is bounded below by 1
+    True
+    >>> real = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    >>> fake = jnp.asarray(rng.normal(size=(8, 4)).astype(np.float32))
+    >>> k_mean, k_std = kid_from_features(real, fake, subsets=2, subset_size=4)
+    >>> bool(abs(float(k_mean)) < 10)
+    True
 """
 
 from __future__ import annotations

@@ -9,6 +9,17 @@ image), random-init otherwise — same graph, conversion parity-tested against
 a torch mirror.  A custom backbone callable and explicit calibration
 ``linear_weights`` can be passed; ``DeterministicLPIPSNet`` remains only as
 an explicit opt-in stand-in.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.default_rng(42)
+    >>> preds = jnp.asarray(rng.uniform(size=(1, 3, 32, 32)).astype(np.float32))
+    >>> from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
+    >>> d_same = learned_perceptual_image_patch_similarity(preds, preds, normalize=True)
+    >>> round(float(d_same), 4)  # identical images -> 0 distance
+    0.0
 """
 
 from __future__ import annotations
@@ -140,6 +151,12 @@ def learned_perceptual_image_patch_similarity(
     if img1.shape != img2.shape or img1.ndim != 4 or img1.shape[1] != 3:
         raise ValueError(
             f"Expected both inputs to be 4D with 3 channels, but got {img1.shape} and {img2.shape}"
+        )
+    if img1.shape[2] < 32 or img1.shape[3] < 32:
+        # the backbone's stride pyramid reduces deep feature maps to zero
+        # spatial size below this, which would NaN the spatial average
+        raise ValueError(
+            f"LPIPS requires spatial dims of at least 32x32, but got {img1.shape[2]}x{img1.shape[3]}"
         )
     if normalize:
         img1 = 2 * img1 - 1

@@ -1,4 +1,14 @@
-"""PSNR and PSNR-B (reference: functional/image/psnr.py:23-150, psnrb.py:20-120)."""
+"""PSNR and PSNR-B (reference: functional/image/psnr.py:23-150, psnrb.py:20-120).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+    >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+    >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+    >>> round(float(peak_signal_noise_ratio(preds, target, data_range=4.0)), 4)
+    5.0515
+"""
 
 from __future__ import annotations
 

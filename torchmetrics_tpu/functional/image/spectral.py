@@ -3,6 +3,19 @@ SCC, D-lambda, D-s, QNR, VIF-p.
 
 Reference: functional/image/{uqi.py:22, sam.py:20, ergas.py:21, rase.py:20,
 rmse_sw.py:20, scc.py:20, d_lambda.py:22, d_s.py:24, qnr.py:22, vif.py:20}.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.default_rng(42)
+    >>> preds = jnp.asarray(rng.uniform(size=(1, 3, 16, 16)).astype(np.float32))
+    >>> target = jnp.asarray((0.7 * np.asarray(preds) + 0.3 * rng.uniform(size=(1, 3, 16, 16))).astype(np.float32))
+    >>> from torchmetrics_tpu.functional.image.spectral import universal_image_quality_index, spectral_angle_mapper
+    >>> round(float(universal_image_quality_index(preds, target)), 4)
+    0.865
+    >>> round(float(spectral_angle_mapper(preds, target)), 4)
+    0.1884
 """
 
 from __future__ import annotations

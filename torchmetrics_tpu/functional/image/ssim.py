@@ -5,6 +5,17 @@ One depthwise conv over the 5-way stacked inputs (μp, μt, E[p²], E[t²], E[pt
 tiles onto the MXU.  Supports 4D (B,C,H,W) and 5D volumetric inputs, gaussian
 or uniform windows, data-range clamping, full-image and contrast-sensitivity
 outputs, and the 5-scale MS-SSIM with relu/simple normalization.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> rng = np.random.default_rng(42)
+    >>> preds = jnp.asarray(rng.uniform(size=(1, 3, 16, 16)).astype(np.float32))
+    >>> target = jnp.asarray((0.7 * np.asarray(preds) + 0.3 * rng.uniform(size=(1, 3, 16, 16))).astype(np.float32))
+    >>> from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+    >>> round(float(structural_similarity_index_measure(preds, target, data_range=1.0)), 4)
+    0.866
 """
 
 from __future__ import annotations

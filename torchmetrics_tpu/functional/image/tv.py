@@ -1,5 +1,14 @@
 """Total variation (reference: functional/image/tv.py:20-100) and image
-gradients (functional/image/gradients.py:20-80)."""
+gradients (functional/image/gradients.py:20-80).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.image.tv import total_variation
+    >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+    >>> round(float(total_variation(img)), 4)
+    60.0
+"""
 
 from __future__ import annotations
 

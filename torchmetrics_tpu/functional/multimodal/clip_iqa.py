@@ -3,6 +3,17 @@
 Per prompt pair (positive, negative): softmax over the two anchor cosine
 logits gives P(positive).  Prompt table and scoring identical to the
 reference; CLIP encoders pluggable as in clip_score.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+    >>> rng = np.random.default_rng(123)
+    >>> images = jnp.asarray(rng.uniform(size=(1, 3, 64, 64)).astype(np.float32))
+    >>> score = clip_image_quality_assessment(images, prompts=('quality',))
+    >>> bool(0 <= float(score) <= 1)
+    True
 """
 
 from __future__ import annotations

@@ -6,6 +6,17 @@ embeddings, ``text_encoder`` maps a list of strings to (B, D) — since the
 reference's HF checkpoint download (clip_score.py:_get_clip_model_and_processor)
 is not possible hermetically.  Deterministic seeded encoders are the default
 so the metric runs end-to-end out of the box.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+    >>> rng = np.random.default_rng(123)
+    >>> image = jnp.asarray(rng.integers(0, 255, (3, 224, 224)).astype(np.float32))
+    >>> score = clip_score(image, 'a photo of a cat')
+    >>> bool(0 <= float(score) <= 100)
+    True
 """
 
 from __future__ import annotations

@@ -4,6 +4,17 @@ Pearson's contingency coefficient, Theil's U.
 Reference: functional/nominal/{cramers,tschuprows,pearson,theils_u}.py.  Each
 metric accumulates a static (C, C) confusion matrix (sum-reduced — just a
 psum across devices) and evaluates the statistic once at compute.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.nominal.contingency import cramers_v, theils_u
+    >>> preds = jnp.asarray([0, 1, 1, 2, 2, 2])
+    >>> target = jnp.asarray([0, 1, 1, 2, 2, 1])
+    >>> round(float(cramers_v(preds, target)), 4)
+    0.7328
+    >>> round(float(theils_u(preds, target)), 4)
+    0.6853
 """
 
 from __future__ import annotations

@@ -1,6 +1,14 @@
 """Fleiss' kappa inter-rater agreement.
 
 Reference: functional/nominal/fleiss_kappa.py:61 (+ update/compute helpers).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.nominal.fleiss_kappa import fleiss_kappa
+    >>> ratings = jnp.asarray([[3, 0], [2, 1], [0, 3], [1, 2]])  # (subjects, categories) rater counts
+    >>> round(float(fleiss_kappa(ratings, mode='counts')), 4)
+    0.3333
 """
 
 from __future__ import annotations

@@ -5,6 +5,17 @@ msle,log_cosh,minkowski,tweedie_deviance,csi,kl_divergence,cosine_similarity}.py
 All are (sum-of-errors, count) sufficient-statistic metrics — every update
 function returns the pair so the stateful classes just add, and the one-shot
 functional wrappers divide.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.regression.basic import mean_squared_error, mean_absolute_error
+    >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    >>> round(float(mean_squared_error(preds, target)), 4)
+    0.375
+    >>> round(float(mean_absolute_error(preds, target)), 4)
+    0.5
 """
 
 from __future__ import annotations

@@ -6,6 +6,17 @@ Pearson keeps Welford-style parallel-mergeable moments
 `_final_aggregation` below is the parallel combine used by both local merge
 and cross-device sync.  Kendall is O(n²) pairwise — fine on the MXU for the
 sizes the reference supports (it cat-gathers full data anyway).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.regression.correlation import pearson_corrcoef, spearman_corrcoef
+    >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    >>> round(float(pearson_corrcoef(preds, target)), 4)
+    0.9849
+    >>> round(float(spearman_corrcoef(preds, target)), 4)
+    1.0
 """
 
 from __future__ import annotations

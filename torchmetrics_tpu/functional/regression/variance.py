@@ -3,6 +3,17 @@
 Reference: functional/regression/{r2,explained_variance,rse}.py.  All keep
 sum-reducible sufficient statistics (Σt, Σt², Σ(p−t)², n) so state merge and
 cross-device psum are exact.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.regression.variance import explained_variance, relative_squared_error
+    >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    >>> round(float(explained_variance(preds, target)), 4)
+    0.9572
+    >>> round(float(relative_squared_error(preds, target)), 4)
+    0.0514
 """
 
 from __future__ import annotations

@@ -15,6 +15,19 @@ reciprocal_rank.py:21, retrieval_normalized_dcg ndcg.py:66, retrieval_fall_out
 fall_out.py:22, retrieval_r_precision r_precision.py:21, retrieval_hit_rate
 hit_rate.py:21, retrieval_auroc auroc.py:23, retrieval_precision_recall_curve
 precision_recall_curve.py:26).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.retrieval.kernels import rank_groups, grouped_precision, grouped_reciprocal_rank
+    >>> preds = jnp.asarray([0.9, 0.2, 0.7, 0.6])
+    >>> target = jnp.asarray([1, 0, 0, 1])
+    >>> indexes = jnp.asarray([0, 0, 1, 1])
+    >>> rg = rank_groups(preds, target, indexes, num_groups=2)
+    >>> [round(float(v), 4) for v in grouped_precision(rg, top_k=1)]
+    [1.0, 0.0]
+    >>> [round(float(v), 4) for v in grouped_reciprocal_rank(rg)]
+    [1.0, 0.5]
 """
 
 from __future__ import annotations

@@ -3,6 +3,15 @@
 Reference: functional/segmentation/generalized_dice.py:23-120.  Class weights
 (1, 1/|t|, or 1/|t|²) with inf-replacement by the per-sample max weight,
 exactly matching the reference's flattened inf-handling.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.segmentation.generalized_dice import generalized_dice_score
+    >>> preds = jnp.asarray([[[0, 0], [1, 1]]])
+    >>> target = jnp.asarray([[[0, 1], [1, 1]]])
+    >>> [round(float(v), 4) for v in generalized_dice_score(preds, target, num_classes=2, input_format='index')]
+    [0.6875]
 """
 
 from __future__ import annotations

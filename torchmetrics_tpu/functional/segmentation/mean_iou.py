@@ -3,6 +3,15 @@
 Reference: functional/segmentation/mean_iou.py:25-110.  Per-sample, per-class
 intersection/union reduced over spatial axes — pure elementwise + reduction
 ops that XLA fuses into one kernel.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.segmentation.mean_iou import mean_iou
+    >>> preds = jnp.asarray([[0, 0, 1, 1]])
+    >>> target = jnp.asarray([[0, 1, 1, 1]])
+    >>> [round(float(v), 4) for v in mean_iou(preds, target, num_classes=2, input_format='index')]
+    [0.5833]
 """
 
 from __future__ import annotations

@@ -5,6 +5,17 @@ cer.py:24, mer.py:24, wil.py:24, wip.py:24, edit.py:24}.  All are host-side
 token DP feeding scalar count states; the reference stores (errors, total)
 the same way.  WIL/WIP store hits = Σmax(len) − Σedits directly instead of the
 reference's negated-errors trick (wil.py/wip.py `errors - total`).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.asr import word_error_rate, char_error_rate
+    >>> preds = ['this is the prediction', 'there is an other sample']
+    >>> target = ['this is the reference', 'there is another one']
+    >>> round(float(word_error_rate(preds, target)), 4)
+    0.5
+    >>> round(float(char_error_rate(preds, target)), 4)
+    0.3415
 """
 
 from __future__ import annotations

@@ -10,6 +10,14 @@ reference's design (text/bert.py:194-197 stores input_ids/attention_mask as
 The similarity/matching core (`_bert_score_from_embeddings`) is pure JAX and
 jittable — one (B, Tp, Tt) batched matmul on the MXU instead of the
 reference's per-pair loop.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.bert import bert_score
+    >>> score = bert_score(['the cat sat'], ['the cat sat'])
+    >>> round(float(score['f1'][0]), 4)  # identical pair -> 1 under any embedder
+    1.0
 """
 
 from __future__ import annotations

@@ -4,6 +4,15 @@ N-gram counting is host-side (strings never reach the device); the metric
 state is four arrays — clipped-match numerator/denominator per n-gram order
 plus candidate/reference length sums — exactly the reference's state layout
 (text/bleu.py:33 class states), which makes cross-device sync a plain psum.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.bleu import bleu_score
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> round(float(bleu_score(preds, target)), 4)
+    0.7598
 """
 
 from __future__ import annotations

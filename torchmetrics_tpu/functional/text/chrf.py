@@ -4,6 +4,15 @@ State = six fixed-size count arrays (matching/hyp/ref × char/word n-gram
 orders), sum-reduced — the reference keeps the same statistics as per-order
 dict entries (chrf.py:49-80); packing them into arrays makes distributed sync
 a single psum per array.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.chrf import chrf_score
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat']]
+    >>> round(float(chrf_score(preds, target)), 4)
+    0.4942
 """
 
 from __future__ import annotations

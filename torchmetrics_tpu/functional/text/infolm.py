@@ -6,6 +6,14 @@ masked language model.  The LM is pluggable — any
 because pretrained weights cannot be fetched hermetically (the reference
 downloads ``google/bert_uncased_L-2_H-128_A-2`` at runtime, infolm.py:~100).
 All nine information measures are pure JAX and jittable.
+
+Example::
+
+    >>> from torchmetrics_tpu.functional.text.infolm import infolm
+    >>> preds = ['the cat sat on the mat']
+    >>> target = ['the cat sat on the mat']
+    >>> round(float(infolm(preds, target, information_measure='l2_distance', idf=False, verbose=False)), 4)
+    0.0
 """
 
 from __future__ import annotations

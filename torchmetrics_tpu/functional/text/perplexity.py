@@ -5,6 +5,15 @@ The only text metric whose inputs are already tensors (B, T, V logits), so
 unlike the host-side string metrics this one runs on-device and fuses into the
 eval step under ``jit``; ``ignore_index`` is a static argument so the mask
 compiles to a select.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.perplexity import perplexity
+    >>> logits = jnp.log(jnp.asarray([[[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]]))
+    >>> target = jnp.asarray([[0, 1]])
+    >>> round(float(perplexity(logits, target)), 4)
+    1.3363
 """
 
 from __future__ import annotations

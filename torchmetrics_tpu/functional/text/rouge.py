@@ -6,6 +6,15 @@ metric state (list/"cat"-reduced), mirroring the reference which stores
 per-sample score tensors (text/rouge.py:143).  Sentence splitting for Lsum
 uses a regex splitter instead of the reference's nltk-punkt dependency
 (rouge.py:42-59 downloads punkt at runtime; no egress here).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.rouge import rouge_score
+    >>> preds = 'My name is John'
+    >>> target = 'Is your name John'
+    >>> {k: round(float(v), 4) for k, v in sorted(rouge_score(preds, target, rouge_keys='rouge1').items())}
+    {'rouge1_fmeasure': 0.75, 'rouge1_precision': 0.75, 'rouge1_recall': 0.75}
 """
 
 from __future__ import annotations

@@ -5,6 +5,15 @@ Tokenizers: ``13a`` (mteval-v13a), ``intl`` (unicode-punctuation aware),
 ``char``, ``none``.  ``ja-mecab``/``ko-mecab`` require the mecab native
 tokenizers which are unavailable here and raise, mirroring the reference's
 RequirementCache gating (sacre_bleu.py:40-52).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+    >>> preds = ['the cat is on the mat']
+    >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+    >>> round(float(sacre_bleu_score(preds, target)), 4)
+    0.7598
 """
 
 from __future__ import annotations

@@ -2,6 +2,15 @@
 
 Official normalization (lowercase, strip punctuation/articles) and
 max-over-ground-truths, accumulated as three scalar sum states.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.functional.text.squad import squad
+    >>> preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]
+    >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]
+    >>> {k: float(v) for k, v in sorted(squad(preds, target).items())}
+    {'exact_match': 100.0, 'f1': 100.0}
 """
 
 from __future__ import annotations

@@ -12,6 +12,19 @@ InceptionV3 port (image/backbones/inception.py) — weights load from
 stand-in for hermetic smoke tests.  Statistics, states, and sync semantics
 mirror the reference exactly (sum-reduced feature sums + covariance sums for
 FID/MiFID, cat feature lists for KID/IS).
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.image import FrechetInceptionDistance
+    >>> fid = FrechetInceptionDistance(feature=64)
+    >>> rng = np.random.default_rng(0)
+    >>> imgs = jnp.asarray(rng.integers(0, 255, (4, 3, 32, 32)), jnp.uint8)
+    >>> fid.update(imgs, real=True)
+    >>> fid.update(imgs, real=False)
+    >>> round(float(fid.compute()), 4)  # identical distributions -> 0
+    -0.0
 """
 
 from __future__ import annotations

@@ -1,4 +1,15 @@
-"""CLIP-IQA modular metric (reference: multimodal/clip_iqa.py:56-280)."""
+"""CLIP-IQA modular metric (reference: multimodal/clip_iqa.py:56-280).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+    >>> metric = CLIPImageQualityAssessment(prompts=('quality',))
+    >>> images = jnp.asarray(np.random.default_rng(123).uniform(size=(1, 3, 64, 64)).astype(np.float32))
+    >>> metric.update(images)
+    >>> bool(0 <= float(metric.compute()) <= 1)
+    True
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,17 @@
-"""CLIPScore modular metric (reference: multimodal/clip_score.py:43-180)."""
+"""CLIPScore modular metric (reference: multimodal/clip_score.py:43-180).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.multimodal import CLIPScore
+    >>> image_encoder = lambda imgs: imgs.mean(axis=(2, 3)) @ jnp.ones((3, 8))
+    >>> text_encoder = lambda rows: jnp.stack([jnp.asarray(r, jnp.float32) for r in rows])
+    >>> metric = CLIPScore(image_encoder=image_encoder, text_encoder=text_encoder)
+    >>> images = jnp.ones((2, 3, 16, 16))
+    >>> metric.update(images, [jnp.ones(8), jnp.ones(8)])
+    >>> round(float(metric.compute()), 4)  # aligned embeddings -> max score
+    100.0
+"""
 
 from __future__ import annotations
 

@@ -32,6 +32,54 @@ from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
 State = Dict[str, Any]
 _N = "_n"
 
+# compiled gather graphs keyed by (mesh, axis, scalar reduce table, ragged
+# names): building a fresh shard_map per call would re-trace per step —
+# jit re-compiles only when the padded buffer shapes actually change
+_GATHER_CACHE: Dict[Any, Callable] = {}
+
+
+def _gather_fn(
+    mesh: Mesh,
+    axis_name: str,
+    scalar_reduces: Tuple[Tuple[str, Union[Reduce, Callable]], ...],
+    ragged_names: Tuple[str, ...],
+) -> Callable:
+    key = (mesh, axis_name, scalar_reduces, ragged_names)
+    fn = _GATHER_CACHE.get(key)
+    if fn is not None:
+        return fn
+    reduce_table = dict(scalar_reduces)
+
+    def gather(scalars, n, ragged):
+        out_scalars = {
+            name: sync_leaf(reduce_table[name], scalars[name][0], axis_name) for name in scalars
+        }
+        out_n = jax.lax.psum(n[0], axis_name)
+        out_ragged = {
+            name: (
+                jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
+                jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
+            )
+            for name, (buf, shapes) in ragged.items()
+        }
+        return out_scalars, out_n, out_ragged
+
+    specs_in = (
+        {name: P(axis_name) for name, _ in scalar_reduces},
+        P(axis_name),
+        {name: (P(axis_name), P(axis_name)) for name in ragged_names},
+    )
+    specs_out = (
+        {name: P() for name, _ in scalar_reduces},
+        P(),
+        {name: (P(), P()) for name in ragged_names},
+    )
+    fn = jax.jit(
+        jax.shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
+    )
+    _GATHER_CACHE[key] = fn
+    return fn
+
 
 def _pack_items(
     items: Sequence[Any], max_trailing: Tuple[int, ...], dtype
@@ -106,6 +154,16 @@ def sync_ragged_states(
     into tiny transfers the downstream compute immediately undoes).
     """
     n_dev = int(mesh.devices.size)
+    if int(mesh.shape[axis_name]) != n_dev:
+        # the gather shards stacked buffers over axis_name only; on a
+        # multi-axis mesh (e.g. data x model) the per-shard blocks would hold
+        # several devices' states and the trim offsets would misalign —
+        # build a 1-D eval mesh over the devices instead
+        raise ValueError(
+            f"sync_ragged_states needs a mesh whose '{axis_name}' axis spans all its devices: "
+            f"axis size {int(mesh.shape[axis_name])} != {n_dev} devices. Use a 1-D mesh "
+            f"(e.g. parallel.metric_mesh()) for ragged metric-state sync."
+        )
     if len(per_device_states) != n_dev:
         raise ValueError(
             f"need one state per mesh device: got {len(per_device_states)} states for {n_dev} devices"
@@ -153,31 +211,8 @@ def sync_ragged_states(
 
     ragged_in = {name: (jnp.asarray(packed[name][0]), jnp.asarray(packed[name][1])) for name in packed}
 
-    def gather(scalars, n, ragged):
-        out_scalars = {
-            name: sync_leaf(reductions[name], scalars[name][0], axis_name) for name in scalars
-        }
-        out_n = jax.lax.psum(n[0], axis_name)
-        out_ragged = {
-            name: (
-                jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
-                jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
-            )
-            for name, (buf, shapes) in ragged.items()
-        }
-        return out_scalars, out_n, out_ragged
-
-    specs_in = (
-        {name: P(axis_name) for name in scalar_stacks},
-        P(axis_name),
-        {name: (P(axis_name), P(axis_name)) for name in ragged_in},
-    )
-    specs_out = (
-        {name: P() for name in scalar_stacks},
-        P(),
-        {name: (P(), P()) for name in ragged_in},
-    )
-    fn = jax.shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
+    scalar_reduces = tuple(sorted(((n, reductions[n]) for n in scalar_names), key=lambda kv: kv[0]))
+    fn = _gather_fn(mesh, axis_name, scalar_reduces, tuple(sorted(ragged_in)))
     g_scalars, g_n, g_ragged = fn(scalar_stacks, n_stack, ragged_in)
 
     # ---- trim + re-split on host, preserving device order

@@ -154,6 +154,8 @@ def sharded_update(
     if in_specs is None:
         in_specs = P(axis_name)
 
+    specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
+
     def step(*shards):
         st = metric.update_state(metric.init_state(), *shards, **kwargs)
         # metric.sync_states, not the bare reduction table: metrics with
@@ -161,8 +163,22 @@ def sharded_update(
         # override sync_states with their own cross-shard aggregation
         return metric.sync_states(st, axis_name)
 
-    specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
     # check_vma=False: all_gather-produced leaves are replicated in value but the
     # static VMA checker cannot infer that, so replication is asserted, not checked.
-    fn = jax.shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+    if kwargs:
+        # kwargs are closed over as trace constants — a cached compile would
+        # freeze their first values, so this path stays uncached
+        fn = jax.shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+        return fn(*inputs)
+    # cache the compiled step per (mesh, axis, specs): a fresh shard_map
+    # closure per call re-traces every step, turning a ~100 µs collective
+    # into a ~1 s compile — per-step eval use would never warm up
+    cache = metric.__dict__.setdefault("_sharded_fn_cache", {})
+    key = (mesh, axis_name, specs)
+    fn = cache.get(key)
+    if fn is None:
+        fn = jax.jit(
+            jax.shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+        )
+        cache[key] = fn
     return fn(*inputs)

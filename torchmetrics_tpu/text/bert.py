@@ -3,6 +3,14 @@
 Stores tokenized input_ids/attention_mask as cat states — strings never enter
 the sync path (reference text/bert.py:194-197, the precedent SURVEY.md
 §2.4-text calls out).  The embedding model is pluggable.
+
+Example::
+
+    >>> from torchmetrics_tpu.text import BERTScore
+    >>> metric = BERTScore(verbose=False)
+    >>> metric.update(['the cat sat'], ['the cat sat'])
+    >>> {k: round(float(v[0]), 4) for k, v in sorted(metric.compute().items())}
+    {'f1': 1.0, 'precision': 1.0, 'recall': 1.0}
 """
 
 from __future__ import annotations

@@ -1,4 +1,14 @@
-"""EED modular metric (reference: text/eed.py:28-140)."""
+"""EED modular metric (reference: text/eed.py:28-140).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import ExtendedEditDistance
+    >>> metric = ExtendedEditDistance()
+    >>> metric.update(['this is the prediction'], ['this is the reference'])
+    >>> round(float(metric.compute()), 4)
+    0.3835
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,12 @@
-"""InfoLM modular metric (reference: text/infolm.py:41-220)."""
+"""InfoLM modular metric (reference: text/infolm.py:41-220).
+Example::
+
+    >>> from torchmetrics_tpu.text import InfoLM
+    >>> metric = InfoLM(information_measure='l2_distance', idf=False, verbose=False)
+    >>> metric.update(['the cat sat on the mat'], ['the cat sat on the mat'])
+    >>> round(float(metric.compute()), 4)  # identical pair -> zero distance
+    0.0
+"""
 
 from __future__ import annotations
 

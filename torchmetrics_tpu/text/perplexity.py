@@ -3,6 +3,17 @@
 The one text metric whose ``update`` is fully jittable — construct with
 ``jit=True`` (or call ``update_state`` inside a pjit'd eval step) and the
 accumulation fuses into the step graph.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import Perplexity
+    >>> metric = Perplexity()
+    >>> logits = jnp.log(jnp.asarray([[[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]]))
+    >>> metric.update(logits, jnp.asarray([[0, 1]]))
+    >>> round(float(metric.compute()), 4)
+    1.3363
 """
 
 from __future__ import annotations

@@ -1,4 +1,16 @@
-"""SQuAD modular metric (reference: text/squad.py:34-120)."""
+"""SQuAD modular metric (reference: text/squad.py:34-120).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.text import SQuAD
+    >>> metric = SQuAD()
+    >>> preds = [{'prediction_text': '1976', 'id': '1'}]
+    >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '1'}]
+    >>> metric.update(preds, target)
+    >>> {k: float(v) for k, v in sorted(metric.compute().items())}
+    {'exact_match': 100.0, 'f1': 100.0}
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,18 @@
-"""MetricTracker (reference: wrappers/tracker.py:31)."""
+"""MetricTracker (reference: wrappers/tracker.py:31).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu import MetricTracker
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> tracker = MetricTracker(BinaryAccuracy())
+    >>> for epoch in range(2):
+    ...     _ = tracker.increment()
+    ...     tracker.update(jnp.asarray([0.8, 0.2, 0.9, 0.4]), jnp.asarray([1, epoch, 1, 0]))
+    >>> best, which = tracker.best_metric(return_step=True)
+    >>> (round(float(best), 4), int(which))
+    (1.0, 0)
+"""
 
 from __future__ import annotations
 

@@ -1,4 +1,15 @@
-"""Input-transforming wrappers (reference: wrappers/transformations.py:23,79,132)."""
+"""Input-transforming wrappers (reference: wrappers/transformations.py:23,79,132).
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> import numpy as np
+    >>> from torchmetrics_tpu.wrappers import BinaryTargetTransformer
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=0.5)
+    >>> metric.update(jnp.asarray([0.8, 0.2, 0.9, 0.4]), jnp.asarray([0.9, 0.1, 0.3, 0.2]))
+    >>> round(float(metric.compute()), 4)
+    0.75
+"""
 
 from __future__ import annotations
 
