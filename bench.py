@@ -204,7 +204,12 @@ def interleaved_ab(plain_step, metric_step, params, init_states, x, y, pairs=PAI
         if n >= MAX_PAIRS or (time.perf_counter() - start) > TIME_BUDGET_S:
             break
         deltas = np.asarray(metrics_t) - np.asarray(plains)
-        sem = float(deltas.std(ddof=1) / np.sqrt(n))
+        # stop on the SAME statistic the headline reports: the SEM of the
+        # 20%-trimmed deltas (raw SEM stays outlier-inflated on the tunneled
+        # chip and would run the loop to the time cap for nothing)
+        trim = n // 10
+        trimmed = np.sort(deltas)[trim:-trim] if trim else deltas
+        sem = float(trimmed.std(ddof=1) / np.sqrt(len(trimmed)))
         # target: SEM below 1/3 of the 1%-of-step budget
         if sem < 0.01 * float(np.median(plains)) / 3.0:
             break

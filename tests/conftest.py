@@ -19,6 +19,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Opt-in persistent compile cache for the hourly dev loop: the suite is
+# JIT-dominated (~full-run compiles dwarf the math), and a warm cache cuts
+# wall time substantially.  Off by default — XLA:CPU AOT reload warns about
+# machine-feature mismatches that could SIGILL on a different host, so only
+# same-machine rerun loops should enable it.
+if os.environ.get("TM_TPU_JIT_CACHE"):
+    cache_dir = os.environ.get("TM_TPU_JIT_CACHE_DIR", "/tmp/tm_tpu_jit_cache")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

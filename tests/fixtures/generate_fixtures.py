@@ -1,0 +1,138 @@
+"""Regenerate recorded external-oracle fixtures.
+
+Runs wherever the external tools exist (``pip install pycocotools pystoi``)
+and rewrites the committed JSON vectors from seeded, deterministic inputs.
+In an image without the tools it reports which fixtures stay ``pending``.
+
+Usage::
+
+    python tests/fixtures/generate_fixtures.py          # dry run: report
+    python tests/fixtures/generate_fixtures.py --write  # rewrite fixtures
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+# ------------------------------------------------------------ deterministic inputs
+def stoi_signals():
+    """Three seeded 1-second 10 kHz signals with distinct degradation levels."""
+    rng = np.random.default_rng(1234)
+    fs = 10000
+    t = np.arange(fs) / fs
+    clean = (
+        np.sin(2 * np.pi * 180 * t) * (1 + 0.6 * np.sin(2 * np.pi * 3.5 * t))
+        + 0.4 * np.sin(2 * np.pi * 370 * t) * (1 + 0.5 * np.sin(2 * np.pi * 6 * t))
+    ).astype(np.float64)
+    cases = {}
+    for name, snr_db in (("light_noise_10db", 10.0), ("heavy_noise_0db", 0.0), ("severe_noise_m5db", -5.0)):
+        noise = rng.normal(size=fs)
+        noise *= np.sqrt((clean**2).sum() / (noise**2).sum()) * 10 ** (-snr_db / 20)
+        cases[name] = {"fs": fs, "seed": 1234, "snr_db": snr_db, "degraded": clean + noise, "clean": clean}
+    return cases
+
+
+def map_crowd_dataset():
+    """Seeded crowd-heavy COCO-style dataset (6 images, crowd ratio ~0.4)."""
+    rng = np.random.default_rng(77)
+    images = []
+    for img_id in range(6):
+        ng = int(rng.integers(2, 6))
+        xy = rng.uniform(0, 120, (ng, 2))
+        wh = rng.uniform(10, 80, (ng, 2))
+        gb = np.concatenate([xy, xy + wh], axis=1)
+        gl = rng.integers(0, 2, ng)
+        crowd = (rng.uniform(0, 1, ng) < 0.4).astype(int)
+        keep = rng.uniform(0, 1, ng) < 0.9
+        pb = gb[keep] + rng.normal(0, 4, (int(keep.sum()), 4))
+        pl = gl[keep]
+        nfp = int(rng.integers(1, 4))
+        fp_xy = rng.uniform(0, 120, (nfp, 2))
+        fp_wh = rng.uniform(10, 50, (nfp, 2))
+        pb = np.concatenate([pb, np.concatenate([fp_xy, fp_xy + fp_wh], 1)])
+        pl = np.concatenate([pl, rng.integers(0, 2, nfp)])
+        ps = np.round(rng.uniform(0.1, 1, len(pl)), 6)
+        images.append(
+            dict(
+                image_id=img_id,
+                gt_boxes=np.round(gb, 4).tolist(),
+                gt_labels=gl.tolist(),
+                gt_crowd=crowd.tolist(),
+                det_boxes=np.round(pb, 4).tolist(),
+                det_labels=pl.tolist(),
+                det_scores=ps.tolist(),
+            )
+        )
+    return images
+
+
+# ------------------------------------------------------------------ generators
+def gen_stoi(write: bool) -> str:
+    path = os.path.join(HERE, "stoi_recorded.json")
+    try:
+        from pystoi import stoi as pystoi_fn
+    except ImportError:
+        return "stoi_recorded.json: pystoi not installed — values stay pending"
+    cases = stoi_signals()
+    out = {"provenance": "pystoi", "tool_version": __import__("pystoi").__version__, "cases": {}}
+    for name, c in cases.items():
+        val = float(pystoi_fn(c["clean"], c["degraded"], c["fs"], extended=False))
+        out["cases"][name] = {"fs": c["fs"], "snr_db": c["snr_db"], "stoi": round(val, 8)}
+    if write:
+        json.dump(out, open(path, "w"), indent=1)
+    return f"stoi_recorded.json: generated {len(out['cases'])} values from pystoi"
+
+
+def gen_map_crowd(write: bool) -> str:
+    path = os.path.join(HERE, "map_crowd_recorded.json")
+    try:
+        from pycocotools.coco import COCO
+        from pycocotools.cocoeval import COCOeval
+    except ImportError:
+        return "map_crowd_recorded.json: pycocotools not installed — values stay pending"
+    images = map_crowd_dataset()
+    # build COCO gt/dt dicts
+    gt = {"images": [{"id": im["image_id"], "height": 300, "width": 300} for im in images],
+          "categories": [{"id": 0}, {"id": 1}], "annotations": []}
+    dt = []
+    ann_id = 1
+    for im in images:
+        for b, l, c in zip(im["gt_boxes"], im["gt_labels"], im["gt_crowd"]):
+            x0, y0, x1, y1 = b
+            gt["annotations"].append(
+                {"id": ann_id, "image_id": im["image_id"], "category_id": int(l), "iscrowd": int(c),
+                 "bbox": [x0, y0, x1 - x0, y1 - y0], "area": (x1 - x0) * (y1 - y0)}
+            )
+            ann_id += 1
+        for b, l, s in zip(im["det_boxes"], im["det_labels"], im["det_scores"]):
+            x0, y0, x1, y1 = b
+            dt.append({"image_id": im["image_id"], "category_id": int(l),
+                       "bbox": [x0, y0, x1 - x0, y1 - y0], "score": float(s)})
+    coco_gt = COCO()
+    coco_gt.dataset = gt
+    coco_gt.createIndex()
+    coco_dt = coco_gt.loadRes(dt)
+    ev = COCOeval(coco_gt, coco_dt, iouType="bbox")
+    ev.evaluate()
+    ev.accumulate()
+    ev.summarize()
+    keys = ["map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+            "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large"]
+    out = {"provenance": "pycocotools", "dataset_seed": 77,
+           "expected": {k: round(float(v), 8) for k, v in zip(keys, ev.stats)}}
+    if write:
+        json.dump(out, open(path, "w"), indent=1)
+    return "map_crowd_recorded.json: generated from pycocotools COCOeval"
+
+
+if __name__ == "__main__":
+    write = "--write" in sys.argv
+    for msg in (gen_stoi(write), gen_map_crowd(write)):
+        print(msg)

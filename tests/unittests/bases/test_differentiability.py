@@ -23,6 +23,36 @@ def reg_inputs():
     return preds, target
 
 
+# Registry: every is_differentiable=True metric class must appear either
+# here (enrolled in a gradient test below) or in EXCLUDED with a reason.
+ENROLLED = {
+    "MeanSquaredError", "MeanAbsoluteError", "ExplainedVariance", "R2Score",
+    "CosineSimilarity", "KLDivergence", "LogCoshError", "MeanSquaredLogError",
+    "MeanAbsolutePercentageError", "SymmetricMeanAbsolutePercentageError",
+    "WeightedMeanAbsolutePercentageError", "MinkowskiDistance", "TweedieDevianceScore",
+    "RelativeSquaredError", "PearsonCorrCoef", "ConcordanceCorrCoef",
+    "SignalNoiseRatio", "ScaleInvariantSignalNoiseRatio",
+    "ScaleInvariantSignalDistortionRatio", "SignalDistortionRatio",
+    "SourceAggregatedSignalDistortionRatio", "ComplexScaleInvariantSignalNoiseRatio",
+    "PermutationInvariantTraining",
+    "PeakSignalNoiseRatio", "StructuralSimilarityIndexMeasure",
+    "UniversalImageQualityIndex", "SpectralAngleMapper", "TotalVariation",
+    "RelativeAverageSpectralError", "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpatialCorrelationCoefficient", "ErrorRelativeGlobalDimensionlessSynthesis",
+    "BinaryHingeLoss", "MulticlassHingeLoss", "Perplexity",
+}
+EXCLUDED = {
+    # grad flows but the generic (preds, target) harness doesn't fit the input contract:
+    "SpatialDistortionIndex": "target is a dict of ms/pan images",
+    "QualityWithNoReference": "target is a dict of ms/pan images",
+    "SpectralDistortionIndex": "cat-state pair metric exercised via UQI/SAM family",
+    "VisualInformationFidelity": "needs >=41px inputs; wavelet pyramid makes FD unstable at f32",
+    "MultiScaleStructuralSimilarityIndexMeasure": "needs >=161px inputs; covered by SSIM",
+    "PeakSignalNoiseRatioWithBlockedEffect": "block-boundary masks make FD checks flaky; covered by PSNR",
+    "LearnedPerceptualImagePatchSimilarity": "backbone-weight dependent; identity/order tests cover it",
+}
+
+
 # ------------------------------------------------------------------ regression
 @pytest.mark.parametrize(
     "name,kwargs",
@@ -33,6 +63,11 @@ def reg_inputs():
         ("R2Score", {}),
         ("CosineSimilarity", {}),
         ("KLDivergence", {}),
+        ("LogCoshError", {}),
+        ("MinkowskiDistance", {"p": 3}),
+        ("PearsonCorrCoef", {}),
+        ("ConcordanceCorrCoef", {}),
+        ("RelativeSquaredError", {}),
     ],
 )
 def test_regression_differentiable(reg_inputs, name, kwargs):
@@ -54,17 +89,87 @@ def test_regression_differentiable(reg_inputs, name, kwargs):
         assert_differentiable(lambda: getattr(R, name)(**kwargs), preds, target)
 
 
+@pytest.mark.parametrize(
+    "name", ["MeanSquaredLogError", "MeanAbsolutePercentageError",
+             "SymmetricMeanAbsolutePercentageError", "WeightedMeanAbsolutePercentageError",
+             "TweedieDevianceScore"]
+)
+def test_regression_positive_domain_differentiable(name):
+    """Metrics whose domain is positive targets (logs / ratios)."""
+    import torchmetrics_tpu.regression as R
+
+    rng = np.random.default_rng(11)
+    target = rng.uniform(0.5, 3.0, size=N).astype(np.float32)
+    preds = target * rng.uniform(0.7, 1.3, size=N).astype(np.float32)
+    assert_differentiable(lambda: getattr(R, name)(), preds, target)
+
+
 # ---------------------------------------------------------------------- audio
 @pytest.mark.parametrize(
-    "name", ["SignalNoiseRatio", "ScaleInvariantSignalNoiseRatio", "ScaleInvariantSignalDistortionRatio"]
+    "name,kwargs",
+    [
+        ("SignalNoiseRatio", {}),
+        ("ScaleInvariantSignalNoiseRatio", {}),
+        ("ScaleInvariantSignalDistortionRatio", {}),
+        ("SignalDistortionRatio", {"filter_length": 16}),
+    ],
 )
-def test_audio_differentiable(name):
+def test_audio_differentiable(name, kwargs):
     import torchmetrics_tpu.audio as A
 
     rng = np.random.default_rng(3)
     target = rng.normal(size=(2, 64)).astype(np.float32)
     preds = target + 0.4 * rng.normal(size=(2, 64)).astype(np.float32)
-    assert_differentiable(lambda: getattr(A, name)(), preds, target)
+    tol = dict(rtol=2e-1, atol=5e-2) if name == "SignalDistortionRatio" else {}
+    assert_differentiable(lambda: getattr(A, name)(**kwargs), preds, target, **tol)
+
+
+def test_audio_multisource_differentiable():
+    """SA-SDR / C-SI-SNR / PIT take (batch, spk, time) inputs."""
+    import torchmetrics_tpu.audio as A
+    from torchmetrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio
+
+    rng = np.random.default_rng(4)
+    target = rng.normal(size=(2, 2, 48)).astype(np.float32)
+    preds = target + 0.4 * rng.normal(size=(2, 2, 48)).astype(np.float32)
+    assert_differentiable(lambda: A.SourceAggregatedSignalDistortionRatio(), preds, target)
+    assert_differentiable(
+        lambda: A.PermutationInvariantTraining(scale_invariant_signal_noise_ratio),
+        preds, target,
+    )
+    # complex SI-SNR: (..., frequency, frame, 2) real/imag layout
+    ct = rng.normal(size=(2, 8, 6, 2)).astype(np.float32)
+    cp = ct + 0.3 * rng.normal(size=(2, 8, 6, 2)).astype(np.float32)
+    assert_differentiable(lambda: A.ComplexScaleInvariantSignalNoiseRatio(), cp, ct)
+
+
+# --------------------------------------------------------------- image spectral
+@pytest.mark.parametrize(
+    "name,kwargs,tol",
+    [
+        ("UniversalImageQualityIndex", {}, {}),
+        ("SpectralAngleMapper", {}, {}),
+        ("RelativeAverageSpectralError", {}, dict(rtol=2e-1, atol=5e-2)),
+        ("RootMeanSquaredErrorUsingSlidingWindow", {}, {}),
+        ("SpatialCorrelationCoefficient", {}, dict(rtol=2e-1, atol=5e-2)),
+        ("ErrorRelativeGlobalDimensionlessSynthesis", {}, dict(rtol=2e-1, atol=5e-2)),
+    ],
+)
+def test_image_spectral_differentiable(name, kwargs, tol):
+    import torchmetrics_tpu.image as I
+
+    rng = np.random.default_rng(13)
+    preds = rng.uniform(0.2, 0.8, size=(1, 3, 16, 16)).astype(np.float32)
+    target = np.clip(preds + 0.1 * rng.normal(size=preds.shape), 0.05, 1).astype(np.float32)
+    assert_differentiable(lambda: getattr(I, name)(**kwargs), preds, target, **tol)
+
+
+def test_total_variation_differentiable():
+    from torchmetrics_tpu.image import TotalVariation
+
+    rng = np.random.default_rng(14)
+    img = rng.uniform(size=(1, 3, 12, 12)).astype(np.float32)
+    assert_differentiable(lambda: TotalVariation(), img)
 
 
 # ---------------------------------------------------------------------- image
@@ -91,12 +196,17 @@ def test_ssim_differentiable():
 
 # ------------------------------------------------------------ classification
 def test_hinge_differentiable():
-    from torchmetrics_tpu.classification import BinaryHingeLoss
+    from torchmetrics_tpu.classification import BinaryHingeLoss, MulticlassHingeLoss
 
     rng = np.random.default_rng(8)
     preds = rng.uniform(0.1, 0.9, size=N).astype(np.float32)
     target = rng.integers(0, 2, size=N)
     assert_differentiable(lambda: BinaryHingeLoss(), preds, target)
+    logits = rng.normal(size=(N, 3)).astype(np.float32)
+    mc_target = rng.integers(0, 3, size=N)
+    assert_differentiable(
+        lambda: MulticlassHingeLoss(num_classes=3, validate_args=False), logits, mc_target
+    )
 
 
 # ----------------------------------------------------------------------- text
@@ -131,6 +241,49 @@ def test_accuracy_gradient_is_zero_not_useful():
 
 
 # -------------------------------------------------- declaration completeness
+def test_every_true_claimer_is_enrolled_or_excluded():
+    """Every is_differentiable=True metric must be gradient-tested above or
+    carry a documented exclusion — a bare True claim is unverified."""
+    import torchmetrics_tpu.audio as A
+    import torchmetrics_tpu.classification as C
+    import torchmetrics_tpu.image as I
+    import torchmetrics_tpu.regression as R
+    import torchmetrics_tpu.text as T
+    from torchmetrics_tpu.core.metric import Metric
+
+    unverified = []
+    for pkg in (A, C, I, R, T):
+        for name in dir(pkg):
+            obj = getattr(pkg, name, None)
+            if (
+                isinstance(obj, type)
+                and issubclass(obj, Metric)
+                and obj.__module__.startswith("torchmetrics_tpu")
+                and obj.is_differentiable is True
+                and obj.__name__ not in ENROLLED
+                and obj.__name__ not in EXCLUDED
+            ):
+                unverified.append(obj.__name__)
+    assert not unverified, f"True-claimers neither enrolled nor excluded: {sorted(set(unverified))}"
+
+
+def test_threshold_metrics_declare_not_differentiable():
+    """Representative thresholded metrics must pin is_differentiable=False
+    (tests/helpers/differentiability.assert_declared_not_differentiable)."""
+    from tests.helpers.differentiability import assert_declared_not_differentiable
+    from torchmetrics_tpu.classification import (
+        BinaryAccuracy,
+        BinaryF1Score,
+        MulticlassConfusionMatrix,
+    )
+
+    assert_declared_not_differentiable(lambda: BinaryAccuracy(validate_args=False))
+    assert_declared_not_differentiable(lambda: BinaryF1Score(validate_args=False))
+    assert_declared_not_differentiable(
+        lambda: MulticlassConfusionMatrix(num_classes=3, validate_args=False)
+    )
+
+
 def test_every_concrete_metric_declares_differentiability():
     """Every exported concrete Metric class must pin is_differentiable to
     True or False — None (undeclared) is a missing contract."""

@@ -1,0 +1,122 @@
+"""Dice flexible-input parity vs the ACTUAL reference Dice.
+
+VERDICT r4 next #8: ``classify_inputs`` (the port of the reference's
+796-line ``_input_format_classification`` machinery) must have a real
+consumer.  Dice is the reference's legacy-style entry point that accepts
+every classification input layout; these tests feed the same heterogeneous
+inputs to the reference Dice (which canonicalizes via
+``_input_format_classification``) and ours (via ``classify_inputs``) and
+require identical scores.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.helpers.refpath import add_reference_paths
+
+add_reference_paths()
+
+torch = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.classification import Dice  # noqa: E402
+
+N = 24
+
+
+def _both(ours_kwargs, ref_kwargs, preds, target):
+    from torchmetrics.classification import Dice as RefDice
+
+    ref = RefDice(**ref_kwargs)
+    ref.update(torch.tensor(np.asarray(preds)), torch.tensor(np.asarray(target)))
+    ours = Dice(**ours_kwargs)
+    ours.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(
+        np.asarray(ours.compute(), np.float64), float(ref.compute()), atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice_int_labels(average):
+    rng = np.random.default_rng(0)
+    preds = rng.integers(0, 3, N)
+    target = rng.integers(0, 3, N)
+    _both(
+        dict(num_classes=3, average=average),
+        dict(num_classes=3, average=average),
+        preds,
+        target,
+    )
+
+
+def test_dice_probs_matrix():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(N, 3)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.integers(0, 3, N)
+    _both(dict(num_classes=3, average="micro"), dict(num_classes=3, average="micro"), probs, target)
+
+
+def test_dice_binary_float_promoted():
+    rng = np.random.default_rng(2)
+    probs = rng.uniform(size=N).astype(np.float32)
+    target = rng.integers(0, 2, N)
+    _both(
+        dict(num_classes=2, average="micro", multiclass=True),
+        dict(num_classes=2, average="micro", multiclass=True),
+        probs,
+        target,
+    )
+
+
+def test_dice_multidim_labels():
+    rng = np.random.default_rng(3)
+    preds = rng.integers(0, 3, (N, 5))
+    target = rng.integers(0, 3, (N, 5))
+    _both(dict(num_classes=3, average="micro"), dict(num_classes=3, average="micro"), preds, target)
+
+
+def test_dice_ignore_index():
+    rng = np.random.default_rng(4)
+    preds = rng.integers(0, 3, N)
+    target = rng.integers(0, 3, N)
+    target[:4] = 1
+    _both(
+        dict(num_classes=3, average="micro", ignore_index=1),
+        dict(num_classes=3, average="micro", ignore_index=1),
+        preds,
+        target,
+    )
+
+
+def test_dice_binary_without_multiclass_raises_like_reference():
+    """Both implementations demand an explicit multiclass=True for binary
+    data viewed as two classes."""
+    from torchmetrics.classification import Dice as RefDice
+
+    with pytest.raises(ValueError, match="multiclass"):
+        RefDice(num_classes=2).update(torch.tensor([0.9, 0.2]), torch.tensor([1, 0]))
+    with pytest.raises(ValueError, match="multiclass"):
+        Dice(num_classes=2).update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+
+
+def test_dice_rejects_class_count_mismatch():
+    with pytest.raises(ValueError):  # classify_inputs rejects binary num_classes>2 loudly
+        m = Dice(num_classes=4)
+        m.update(jnp.asarray([0.1, 0.8, 0.4]), jnp.asarray([0, 1, 1]))
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice_ignore_index_macro(average):
+    rng = np.random.default_rng(5)
+    preds = rng.integers(0, 4, N)
+    target = rng.integers(0, 4, N)
+    _both(
+        dict(num_classes=4, average=average, ignore_index=2),
+        dict(num_classes=4, average=average, ignore_index=2),
+        preds,
+        target,
+    )

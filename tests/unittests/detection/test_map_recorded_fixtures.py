@@ -1,0 +1,78 @@
+"""mAP against recorded external-oracle fixtures (VERDICT r4 next #9).
+
+`tests/fixtures/map_crowd_recorded.json` holds pycocotools COCOeval numbers
+for a seeded crowd-heavy dataset; the generation script
+(tests/fixtures/generate_fixtures.py) fills them wherever pycocotools exists.
+When the fixture is still ``pending`` (this zero-egress image) the strict
+assertion skips cleanly — the hand-derived crowd vectors always assert.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "..", "..", "fixtures")
+
+
+def _load(name):
+    with open(os.path.join(FIXTURES, name)) as handle:
+        return json.load(handle)
+
+
+def test_map_crowd_recorded_pycocotools():
+    fix = _load("map_crowd_recorded.json")
+    if fix["provenance"] == "pending" or fix["expected"] is None:
+        pytest.skip("fixture awaiting pycocotools regeneration (generate_fixtures.py --write)")
+
+    import sys
+
+    sys.path.insert(0, FIXTURES)
+    from generate_fixtures import map_crowd_dataset
+
+    m = MeanAveragePrecision()
+    for im in map_crowd_dataset():
+        m.update(
+            [dict(boxes=jnp.asarray(im["det_boxes"], jnp.float32).reshape(-1, 4),
+                  scores=jnp.asarray(im["det_scores"], jnp.float32),
+                  labels=jnp.asarray(im["det_labels"], jnp.int32))],
+            [dict(boxes=jnp.asarray(im["gt_boxes"], jnp.float32).reshape(-1, 4),
+                  labels=jnp.asarray(im["gt_labels"], jnp.int32),
+                  iscrowd=jnp.asarray(im["gt_crowd"], jnp.int32))],
+        )
+    res = m.compute()
+    for key, expected in fix["expected"].items():
+        np.testing.assert_allclose(float(res[key]), expected, atol=1e-6, err_msg=key)
+
+
+def test_map_crowd_handderived_vectors():
+    """The committed hand-derived COCOeval vectors always assert — they are
+    the recorded values the pending pycocotools replay will cross-check."""
+    fix = _load("map_crowd_handderived.json")
+    assert fix["provenance"] == "hand-derived-cocoeval"
+    expected = {name: case["map"] for name, case in fix["cases"].items()}
+    assert expected == {
+        "crowd_absorbs_score_leading_dets": 1.0,
+        "crowd_and_area_ranges": 0.5,
+        "crowd_eligibility_threshold_dependent": 0.55,
+    }
+    # the vectors are enforced against the evaluator in
+    # test_map_crowd_fixtures.py (both backends); here we pin the fixture
+    # file itself so a drive-by edit of the recorded numbers fails loudly
+
+
+def test_generation_script_reports_cleanly():
+    """The generator must degrade to a report (not a crash) without the tools."""
+    import subprocess
+    import sys
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(FIXTURES, "generate_fixtures.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-500:]
+    assert "stoi_recorded.json" in res.stdout and "map_crowd_recorded.json" in res.stdout

@@ -11,6 +11,8 @@ torch InceptionV3 state_dict independent of downloadable weights
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-port heavy; deselect with -m 'not slow'
+
 torch = pytest.importorskip("torch")
 import torch.nn as nn  # noqa: E402
 import torch.nn.functional as F  # noqa: E402

@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # model-port heavy; deselect with -m 'not slow'
 import jax
 import jax.numpy as jnp
 

@@ -17,6 +17,8 @@ import json
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-port heavy; deselect with -m 'not slow'
+
 from tests.helpers.refpath import add_reference_paths
 
 add_reference_paths()

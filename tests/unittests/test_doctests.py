@@ -44,6 +44,30 @@ EXEMPT = {
 }
 
 
+# Per-module floors carried over from the r4 enumerated runner: modules known
+# to hold MANY examples keep their counts, so a dedent/rename that silently
+# drops examples (but leaves >= 1) still fails.  The walk covers everything
+# else at a floor of 1.
+MIN_EXAMPLES = {
+    "torchmetrics_tpu.classification.accuracy": 2,
+    "torchmetrics_tpu.classification.f_beta": 2,
+    "torchmetrics_tpu.classification.auroc": 2,
+    "torchmetrics_tpu.regression.errors": 5,
+    "torchmetrics_tpu.regression.variance": 2,
+    "torchmetrics_tpu.regression.correlation": 3,
+    "torchmetrics_tpu.text.bleu": 2,
+    "torchmetrics_tpu.text.asr": 3,
+    "torchmetrics_tpu.retrieval.metrics": 3,
+    "torchmetrics_tpu.aggregation": 3,
+    "torchmetrics_tpu.nominal.nominal": 2,
+    "torchmetrics_tpu.clustering.extrinsic": 2,
+    "torchmetrics_tpu.clustering.intrinsic": 2,
+    "torchmetrics_tpu.audio.metrics": 3,
+    "torchmetrics_tpu.classification.precision_recall": 2,
+    "torchmetrics_tpu.functional.pairwise.pairwise": 2,
+}
+
+
 def _all_modules():
     names = ["torchmetrics_tpu"]
     for info in pkgutil.walk_packages(torchmetrics_tpu.__path__, "torchmetrics_tpu."):
@@ -100,10 +124,11 @@ def test_module_doctests(module_name):
         n_with_examples += 1
         runner.run(test)
     if _requires_example(module):
-        assert n_with_examples >= 1, (
-            f"{module_name} defines public metrics/functionals but has no executable "
-            "docstring example — add an Example:: block (the reference doctests every "
-            "metric file via --doctest-modules)"
+        floor = MIN_EXAMPLES.get(module_name, 1)
+        assert n_with_examples >= floor, (
+            f"{module_name} defines public metrics/functionals but has {n_with_examples} "
+            f"executable docstring example(s), expected >= {floor} — example blocks lost? "
+            "(the reference doctests every metric file via --doctest-modules)"
         )
     results = runner.summarize(verbose=False)
     assert results.failed == 0, f"{module_name}: {results.failed} doctest failure(s)"

@@ -12,6 +12,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-port heavy; deselect with -m 'not slow'
+
 from tests.helpers.refpath import add_reference_paths
 
 add_reference_paths()

@@ -11,6 +11,8 @@ tokenizer, same per-position masking pipeline → scores must agree
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # model-port heavy; deselect with -m 'not slow'
+
 from tests.helpers.refpath import add_reference_paths
 
 add_reference_paths()

@@ -51,6 +51,42 @@ def test_ter_random_corpora_reference_parity(flags):
         assert abs(ref_score - our_score) < 1e-6, (preds, target, flags)
 
 
+def test_ter_asian_support_reference_parity():
+    """asian_support=True routes CJK chars through the \\u-escape tokenizer
+    tables (functional/text/ter.py:49) — a transcription slip in those ranges
+    would silently change segmentation, so CJK corpora are compared to the
+    reference directly (advisor r4)."""
+    from torchmetrics.functional.text.ter import translation_edit_rate as ref_ter
+
+    from torchmetrics_tpu.functional.text.ter import translation_edit_rate as our_ter
+
+    cjk_preds = [
+        "猫はマットの上に座った",
+        "犬が速く走る。家は大きい",
+        "这只 猫 坐在 垫子 上。",
+        "鳥は木にいます、そして猫は見ています",
+    ]
+    cjk_targets = [
+        ["猫がマットの上に座っていた"],
+        ["犬は速く走った。家は大きかった", "犬が走る。家が大きい"],
+        ["这只 猫 坐在 垫子 上", "那只 猫 在 垫子 上"],
+        ["鳥は木にいます。猫は見ています"],
+    ]
+    for flags in ({"asian_support": True}, {"asian_support": True, "normalize": True},
+                  {"asian_support": True, "no_punctuation": True}):
+        ref_score = float(ref_ter(cjk_preds, cjk_targets, **flags))
+        our_score = float(our_ter(cjk_preds, cjk_targets, **flags))
+        assert abs(ref_score - our_score) < 1e-6, flags
+    # mixed CJK + latin, sentence-level
+    preds = ["the cat sat 猫はマット", "big 家 dog"]
+    targets = [["the cat sat 猫はマットの上"], ["big 家 dog ran"]]
+    ref_s = ref_ter(preds, targets, asian_support=True, return_sentence_level_score=True)[1]
+    our_s = our_ter(preds, targets, asian_support=True, return_sentence_level_score=True)[1]
+    import numpy as np
+
+    np.testing.assert_allclose(np.asarray(our_s), np.asarray([float(x) for x in ref_s]), atol=1e-6)
+
+
 def test_ter_sentence_level_reference_parity():
     from torchmetrics.functional.text.ter import translation_edit_rate as ref_ter
 

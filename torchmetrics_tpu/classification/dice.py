@@ -15,15 +15,27 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from torchmetrics_tpu.classification.stat_scores import MulticlassStatScores
 from torchmetrics_tpu.core.metric import State
 from torchmetrics_tpu.utilities.compute import _adjust_weights_safe_divide, _safe_divide
+from torchmetrics_tpu.utilities.formatting import classify_inputs
 
 
 class Dice(MulticlassStatScores):
-    """Dice score: 2*tp / (2*tp + fp + fn) over multiclass stat scores."""
+    """Dice score: 2*tp / (2*tp + fp + fn) over flexible-format inputs.
+
+    This is the legacy-style entry point: like the reference
+    (classification/dice.py:31 via ``_input_format_classification``,
+    utilities/checks.py:315), it accepts binary probabilities ``(N,)`` (with
+    ``multiclass=True``, as the reference requires), ``(N, C)``
+    probabilities/logits, integer labels, multilabel masks, and
+    multi-dim variants — all canonicalized through
+    :func:`~torchmetrics_tpu.utilities.formatting.classify_inputs` before the
+    per-class stat-score accumulation.
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -32,12 +44,47 @@ class Dice(MulticlassStatScores):
     plot_upper_bound = 1.0
 
     def __init__(self, num_classes: int, average: Optional[str] = "micro",
-                 ignore_index: Optional[int] = None, top_k: int = 1, **kwargs: Any) -> None:
+                 ignore_index: Optional[int] = None, top_k: int = 1,
+                 threshold: float = 0.5, multiclass: Optional[bool] = None,
+                 **kwargs: Any) -> None:
         super().__init__(num_classes=num_classes, top_k=top_k, average=average,
                          ignore_index=ignore_index, **kwargs)
+        self.threshold = threshold
+        self.multiclass = multiclass
+
+    def _update(self, state: State, preds: Array, target: Array) -> State:
+        # binary inputs with num_classes=2 require an explicit
+        # multiclass=True, exactly like the reference (checks.py raises the
+        # same "Set it to True if you want to transform binary data" error)
+        p, t, case = classify_inputs(
+            preds, target, threshold=self.threshold,
+            top_k=None if self.top_k == 1 else self.top_k,
+            num_classes=self.num_classes, multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+        if p.shape[1] != self.num_classes:
+            raise ValueError(
+                f"Inputs canonicalized to {p.shape[1]} classes but `num_classes={self.num_classes}` "
+                f"(detected case: {case.value})"
+            )
+        # fold multi-dim positions into the sample axis: (N, C[, X]) -> (N*X, C)
+        if p.ndim == 3:
+            p = jnp.moveaxis(p, 1, 2).reshape(-1, p.shape[1])
+            t = jnp.moveaxis(t, 1, 2).reshape(-1, t.shape[1])
+        tp = ((p == 1) & (t == 1)).sum(axis=0)
+        fp = ((p == 1) & (t == 0)).sum(axis=0)
+        tn = ((p == 0) & (t == 0)).sum(axis=0)
+        fn = ((p == 0) & (t == 1)).sum(axis=0)
+        return self._update_stats(state, tp, fp, tn, fn)
 
     def _compute(self, state: State) -> Array:
         tp, fp, tn, fn = self._final_state(state)
+        if self.ignore_index is not None:
+            # ignore_index removes the CLASS from every reduction — samples
+            # keep contributing to the other classes (reference
+            # _reduce_stat_scores drops the index, dice.py via stat_scores)
+            keep = np.arange(self.num_classes) != self.ignore_index
+            tp, fp, tn, fn = tp[..., keep], fp[..., keep], tn[..., keep], fn[..., keep]
         if self.average == "micro":
             tp, fp, fn = tp.sum(), fp.sum(), fn.sum()
             return _safe_divide(2 * tp, 2 * tp + fp + fn)

@@ -181,11 +181,21 @@ def parse_coco_files(
         entry["iscrowd"].append(ann.get("iscrowd", 0))
         if "bbox" in iou_types:
             entry["boxes"].append(ann["bbox"])
+        mask = None
         if "segm" in iou_types:
-            entry["masks"].append(ann_to_mask(ann, *image_hw(ann["image_id"], ann)))
-        entry["area"].append(
-            ann.get("area", float(ann["bbox"][2] * ann["bbox"][3]) if "bbox" in ann else 0.0)
-        )
+            mask = ann_to_mask(ann, *image_hw(ann["image_id"], ann))
+            entry["masks"].append(mask)
+        if "area" in ann:
+            area = float(ann["area"])
+        elif mask is not None:
+            # pycocotools derives area from the decoded mask when the
+            # annotation carries none (maskUtils.area precedence)
+            area = float(np.asarray(mask).sum())
+        elif "bbox" in ann:
+            area = float(ann["bbox"][2] * ann["bbox"][3])
+        else:
+            area = 0.0
+        entry["area"].append(area)
 
     preds: Dict[int, Dict[str, list]] = {}
     for ann in dt_anns:

@@ -16,6 +16,21 @@ single tiled ``all_gather`` per state crosses the mesh inside ``shard_map``
 gather), and the items are re-split on host.  Scalar (psum/pmax/...) states
 ride the same shard_map call, so a metric mixing tensor and list states
 syncs in one graph.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.core.reductions import Reduce
+    >>> from torchmetrics_tpu.parallel import metric_mesh, sync_ragged_states
+    >>> mesh = metric_mesh()
+    >>> n_dev = mesh.devices.size
+    >>> # each device holds a DIFFERENT number of variable-length items
+    >>> per_dev = [{"items": (jnp.full((d % 3 + 1,), float(d)),)} for d in range(n_dev)]
+    >>> merged = sync_ragged_states({"items": Reduce.CAT}, per_dev, mesh)
+    >>> len(merged["items"]) == n_dev  # every device's item arrived, in order
+    True
+    >>> [int(v.shape[0]) for v in merged["items"]][:3]
+    [1, 2, 3]
 """
 
 from __future__ import annotations

@@ -11,6 +11,19 @@ TPU-native replacement for the reference's distributed layer
   axis (ICI);
 * across hosts (eager facade), ``multihost_utils.process_allgather`` rides
   DCN.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.classification import BinaryAccuracy
+    >>> from torchmetrics_tpu.parallel import metric_mesh, sharded_update
+    >>> mesh = metric_mesh()  # 1-D mesh over all local devices
+    >>> metric = BinaryAccuracy(validate_args=False)
+    >>> probs = jnp.asarray([0.9, 0.2, 0.8, 0.4, 0.7, 0.1, 0.6, 0.3])
+    >>> target = jnp.asarray([1, 0, 1, 0, 0, 0, 1, 1])
+    >>> state = sharded_update(metric, probs, target, mesh=mesh)  # batch-split + in-graph psum
+    >>> round(float(metric.compute_state(state)), 4)
+    0.75
 """
 
 from __future__ import annotations

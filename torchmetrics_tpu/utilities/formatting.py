@@ -207,6 +207,23 @@ def classify_inputs(
     Returns int binary tensors of shape ``(N, C)`` or ``(N, C, X)`` plus the
     detected :class:`DataType`.  ``multiclass`` promotes/demotes between the
     binary and two-class representations exactly as the reference does.
+    Consumed by the legacy-style entry points (e.g.
+    :class:`~torchmetrics_tpu.classification.Dice`) and public for building
+    layout-agnostic metrics.
+
+    Example::
+
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utilities import classify_inputs
+        >>> # binary probabilities -> thresholded (N, 1) masks
+        >>> p, t, case = classify_inputs(jnp.asarray([0.2, 0.8]), jnp.asarray([0, 1]))
+        >>> (case.value, p.ravel().tolist())
+        ('binary', [0, 1])
+        >>> # (N, C) probabilities + labels -> one-hot top-1
+        >>> p, t, case = classify_inputs(
+        ...     jnp.asarray([[0.1, 0.9], [0.7, 0.3]]), jnp.asarray([1, 0]))
+        >>> (case.value, p.tolist(), t.tolist())
+        ('multi-class', [[0, 1], [1, 0]], [[0, 1], [1, 0]])
     """
     p = np.asarray(preds)
     t = np.asarray(target)
