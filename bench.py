@@ -418,6 +418,92 @@ def ragged_sync_bench_child():
             1,
         ),
     }
+
+    # --- retrace counters: varying batch geometry through the bucketed
+    # ragged gather.  The seed re-traced once per distinct padded geometry;
+    # with power-of-two bucketing (core/compile.py) many geometries land in
+    # one bucket, so cache_stats()['traces'] stays well under the distinct
+    # raw shape count.
+    from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+
+    def retrace_leg(states_for):
+        clear_compile_cache()
+        raw_shapes = set()
+        for g in (3, 5, 6, 7, 9, 11, 13, 17, 21, 27):
+            reductions, states = states_for(g)
+            raw_shapes.add(
+                tuple(
+                    tuple(np.asarray(v).shape for v in st[name])
+                    for st in states
+                    for name in st
+                    if isinstance(st[name], tuple)
+                )
+            )
+            sync_ragged_states(reductions, states, mesh)
+        stats = cache_stats()
+        return {
+            "distinct_raw_geometries": len(raw_shapes),
+            "seed_equivalent_retraces": len(raw_shapes),  # seed: one trace per geometry
+            "retraces": stats["traces"],
+            "gather_dispatches": stats["hits"] + stats["misses"],
+        }
+
+    def map_states_for(g):
+        states = []
+        for d in range(n_dev):
+            p = {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (g, 4)), jnp.float32),
+                "scores": jnp.asarray(rng.uniform(0, 1, (g,)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (g,))),
+            }
+            t = {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (max(g // 2, 1), 4)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (max(g // 2, 1),))),
+            }
+            states.append(m.update_state(m.init_state(), [p], [t]))
+        return m._reductions, states
+
+    def rouge_states_for(g):
+        s = ["the quick brown fox jumps over the lazy dog"] * g  # g sents/device
+        return r._reductions, [r.update_state(r.init_state(), s, s) for _ in range(n_dev)]
+
+    out["map_retrace"] = retrace_leg(map_states_for)
+    out["rouge_retrace"] = retrace_leg(rouge_states_for)
+
+    # --- fused MetricCollection: one shard_map graph for all members vs one
+    # sharded_update dispatch per member, same mesh, same inputs
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import MulticlassAUROC, MulticlassF1Score
+    from torchmetrics_tpu.parallel import sharded_collection_update
+
+    coll = MetricCollection(
+        {
+            "acc": Acc5(num_classes=5, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=5, validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=5, thresholds=50, validate_args=False),
+        },
+        compute_groups=False,
+    )
+
+    def dispatch_per_metric():
+        for name in coll.keys(keep_base=True):
+            _jax.block_until_ready(
+                _jax.tree.leaves(sharded_update(coll[name], probs, tgt, mesh=mesh))
+            )
+
+    def dispatch_fused():
+        _jax.block_until_ready(
+            _jax.tree.leaves(sharded_collection_update(coll, probs, tgt, mesh=mesh))
+        )
+
+    per_metric_us = timed(dispatch_per_metric, reps=20)
+    fused_us = timed(dispatch_fused, reps=20)
+    out["collection_fused_8dev"] = {
+        "members": list(coll.keys(keep_base=True)),
+        "metric_subgraph_us_per_step_dispatch": round(per_metric_us, 1),
+        "metric_subgraph_us_per_step_fused": round(fused_us, 1),
+        "fused_speedup": round(per_metric_us / fused_us, 2) if fused_us else None,
+    }
     print(json.dumps(out))
 
 
@@ -455,6 +541,165 @@ def measured_ragged_sync_us():
         return {"error": f"ragged child failed: {err}"}
 
 
+def donation_leg():
+    """In-place accumulator update via the compile cache's donated state vs a
+    plain (copying) jit: same step, same big psum state — the donated path's
+    saving is the per-step state copy (FID-class states move tens of MB).
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.core.compile import compiled_update
+    from torchmetrics_tpu.utilities.benchmark import state_bytes
+
+    n_cls = int(os.environ.get("BENCH_DONATION_CLASSES", 2048))
+    m = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, n_cls, 256))
+    tgt = jnp.asarray(rng.integers(0, n_cls, 256))
+    reps = 30
+
+    donated = compiled_update(m, (preds, tgt), {})
+    undonated = jax.jit(m.update_state)
+
+    def burst(fn, inner=5):
+        st = m.init_state()
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            st = fn(st, preds, tgt)
+        jax.block_until_ready(st)
+        return (time.perf_counter() - t0) / inner * 1e6
+
+    burst(donated), burst(undonated)  # compile both arms
+    d_t, u_t = [], []
+    for _ in range(reps):  # interleaved so drift hits both arms equally
+        d_t.append(burst(donated))
+        u_t.append(burst(undonated))
+    state_b = state_bytes(m.init_state())
+    return {
+        "metric": f"MulticlassConfusionMatrix({n_cls})",
+        "state_bytes": state_b,
+        "copied_bytes_per_step_without_donation": state_b,
+        "donated_update_us_per_step": round(float(np.median(d_t)), 1),
+        "undonated_update_us_per_step": round(float(np.median(u_t)), 1),
+        "note": "donation eliminates the per-step state copy in device memory; "
+        "the CPU backend does not always alias donated buffers, so the wall-clock "
+        "win shows on HBM-backed devices",
+    }
+
+
+def kernel_vs_reference():
+    """Opt-in head-to-head of our jitted kernels vs the installed torch
+    reference (stat_scores / confusion_matrix / PSNR).  Skips cleanly —
+    with an explicit record — when ``torchmetrics`` isn't importable.
+    """
+    try:
+        import torch  # noqa: F401
+        import torchmetrics.functional as R
+    except Exception as err:  # noqa: BLE001 — any import failure means skip
+        return {"skipped": f"torchmetrics not importable: {type(err).__name__}: {err}"}
+
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    reps = 50
+    out = {}
+
+    def timed_jax(fn, *xs):
+        jax.block_until_ready(fn(*xs))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            res = fn(*xs)
+        jax.block_until_ready(res)
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    def timed_torch(fn, *xs):
+        fn(*xs)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn(*xs)
+        return (time.perf_counter() - t0) / reps * 1e6
+
+    import torch
+
+    from torchmetrics_tpu.functional.classification import (
+        binary_stat_scores,
+        multiclass_confusion_matrix,
+    )
+    from torchmetrics_tpu.functional.image import peak_signal_noise_ratio
+
+    # binary stat_scores
+    p = rng.uniform(size=4096).astype(np.float32)
+    t = rng.integers(0, 2, 4096)
+    ours = jax.jit(lambda a, b: binary_stat_scores(a, b))
+    out["binary_stat_scores"] = {
+        "kernel_us": round(timed_jax(ours, jnp.asarray(p), jnp.asarray(t)), 1),
+        "reference_us": round(
+            timed_torch(
+                lambda a, b: R.classification.binary_stat_scores(a, b),
+                torch.from_numpy(p),
+                torch.from_numpy(t),
+            ),
+            1,
+        ),
+        "max_abs_diff": float(
+            np.abs(
+                np.asarray(ours(jnp.asarray(p), jnp.asarray(t)))
+                - R.classification.binary_stat_scores(torch.from_numpy(p), torch.from_numpy(t)).numpy()
+            ).max()
+        ),
+    }
+
+    # multiclass confusion_matrix
+    mp = rng.integers(0, 10, 4096)
+    mt = rng.integers(0, 10, 4096)
+    ours_cm = jax.jit(lambda a, b: multiclass_confusion_matrix(a, b, num_classes=10))
+    out["multiclass_confusion_matrix"] = {
+        "kernel_us": round(timed_jax(ours_cm, jnp.asarray(mp), jnp.asarray(mt)), 1),
+        "reference_us": round(
+            timed_torch(
+                lambda a, b: R.classification.multiclass_confusion_matrix(a, b, num_classes=10),
+                torch.from_numpy(mp),
+                torch.from_numpy(mt),
+            ),
+            1,
+        ),
+        "max_abs_diff": float(
+            np.abs(
+                np.asarray(ours_cm(jnp.asarray(mp), jnp.asarray(mt)))
+                - R.classification.multiclass_confusion_matrix(
+                    torch.from_numpy(mp), torch.from_numpy(mt), num_classes=10
+                ).numpy()
+            ).max()
+        ),
+    }
+
+    # PSNR
+    a = rng.uniform(size=(16, 3, 32, 32)).astype(np.float32)
+    b = rng.uniform(size=(16, 3, 32, 32)).astype(np.float32)
+    ours_psnr = jax.jit(lambda x, y: peak_signal_noise_ratio(x, y, data_range=1.0))
+    out["peak_signal_noise_ratio"] = {
+        "kernel_us": round(timed_jax(ours_psnr, jnp.asarray(a), jnp.asarray(b)), 1),
+        "reference_us": round(
+            timed_torch(
+                lambda x, y: R.peak_signal_noise_ratio(x, y, data_range=1.0),
+                torch.from_numpy(a),
+                torch.from_numpy(b),
+            ),
+            1,
+        ),
+        "max_abs_diff": float(
+            np.abs(
+                np.asarray(ours_psnr(jnp.asarray(a), jnp.asarray(b)))
+                - R.peak_signal_noise_ratio(
+                    torch.from_numpy(a), torch.from_numpy(b), data_range=1.0
+                ).numpy()
+            ).max()
+        ),
+    }
+    return out
+
+
 def main():
     params = init_params(jax.random.PRNGKey(0))
     n_params = sum(int(p.size) for p in jax.tree.leaves(params))
@@ -481,6 +726,14 @@ def main():
     ci95 = [overhead_pct - 1.96 * noise_pct, overhead_pct + 1.96 * noise_pct]
     sub_us = metric_subgraph_us(init_states, metrics, y)
     ragged_measured = measured_ragged_sync_us()
+    try:
+        donation = donation_leg()
+    except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
+        donation = {"error": f"donation leg failed: {err}"}
+    try:
+        kernel_ref = kernel_vs_reference()
+    except Exception as err:  # noqa: BLE001
+        kernel_ref = {"error": f"kernel_vs_reference leg failed: {err}"}
 
     print(json.dumps({
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
@@ -504,6 +757,8 @@ def main():
             "train_step_with_metrics_ms_median": round(float(np.median(metrics_t)) * 1e3, 3),
             "metric_subgraph_us_per_step": round(sub_us, 1),
             "measured_sync_us_8dev_mesh": ragged_measured,
+            "donation": donation,
+            "kernel_vs_reference": kernel_ref,
             "state_reduce_bytes_1_to_64_chips": state_reduce_bytes_table(),
             "model": f"ResNet-50 ({n_params / 1e6:.1f}M params, bf16)",
             "batch": BATCH, "image": IMG, "num_classes": NUM_CLASSES,

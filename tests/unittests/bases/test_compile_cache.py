@@ -1,0 +1,383 @@
+"""Unified compile cache (core/compile.py): keying, invalidation-on-mutation,
+state donation, shape bucketing, and the fused MetricCollection paths.
+
+The load-bearing regression here is the ADVICE round-5 stale-trace bug: the
+old per-instance ``sharded_update`` cache was keyed only on
+``(mesh, axis_name, specs)``, so mutating a metric attribute after the first
+call silently reused the stale compiled step.  Now the key folds in a config
+fingerprint that ``Metric.__setattr__`` invalidates.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric, MetricCollection
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.core.compile import (
+    abstract_signature,
+    bucket_dim,
+    bucket_shape,
+    cache_size,
+    cache_stats,
+    clear_compile_cache,
+    config_fingerprint,
+    is_jit_compatible,
+)
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.parallel import (
+    DeferredRaggedSync,
+    sharded_collection_update,
+    sharded_update,
+    sync_ragged_states,
+)
+
+PROBS = jnp.asarray([0.9, 0.2, 0.8, 0.4, 0.7, 0.1, 0.6, 0.3])
+TARGET = jnp.asarray([1, 0, 1, 0, 0, 0, 1, 1])
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_compile_cache()
+    yield
+    clear_compile_cache()
+
+
+# --------------------------------------------------------------- fingerprints
+def test_fingerprint_stable_across_instances():
+    a = BinaryAccuracy(threshold=0.5, validate_args=False)
+    b = BinaryAccuracy(threshold=0.5, validate_args=False)
+    assert config_fingerprint(a) == config_fingerprint(b)
+
+
+def test_fingerprint_changes_on_config():
+    a = BinaryAccuracy(threshold=0.5, validate_args=False)
+    b = BinaryAccuracy(threshold=0.7, validate_args=False)
+    assert config_fingerprint(a) != config_fingerprint(b)
+
+
+def test_fingerprint_invalidated_by_setattr():
+    m = BinaryAccuracy(threshold=0.5, validate_args=False)
+    before = m._config_fingerprint()
+    assert m._config_fingerprint() == before  # cached
+    m.threshold = 0.8
+    assert m._config_fingerprint() != before
+
+
+def test_fingerprint_ignores_private_and_excluded():
+    m = BinaryAccuracy(validate_args=False)
+    before = m._config_fingerprint()
+    m._some_private = 123
+    m.sync_on_compute = False  # base-class bookkeeping knob, excluded
+    assert m._config_fingerprint() == before
+
+
+# ------------------------------------------------------------------ cache hits
+def test_compiled_update_cache_hits_and_shares_across_instances():
+    a = BinaryAccuracy(validate_args=False, jit=True)
+    a.update(PROBS, TARGET)
+    first = cache_stats()
+    assert first["misses"] == 1 and first["traces"] == 1
+    a.update(PROBS, TARGET)
+    assert cache_stats()["hits"] == 1
+    # a same-config instance reuses the same compiled step
+    b = BinaryAccuracy(validate_args=False, jit=True)
+    b.update(PROBS, TARGET)
+    stats = cache_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 2
+    assert cache_size() == 1
+
+
+def test_new_input_shape_is_new_entry():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    m.update(PROBS[:4], TARGET[:4])
+    assert cache_stats()["misses"] == 2
+
+
+# -------------------------------------------------- invalidation on mutation
+def test_eager_jit_update_sees_mutated_threshold():
+    m = BinaryAccuracy(threshold=0.5, validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    assert float(m.compute()) == pytest.approx(0.75)
+    m.reset()
+    m.threshold = 0.85  # only 0.9 counts as positive now
+    m.update(PROBS, TARGET)
+    expected = float(np.mean((np.asarray(PROBS) > 0.85) == np.asarray(TARGET).astype(bool)))
+    assert float(m.compute()) == pytest.approx(expected)
+    assert cache_stats()["misses"] == 2  # mutation forced a new entry
+
+
+def test_sharded_update_sees_mutated_threshold(mesh):
+    """THE round-5 regression: attribute mutation after a first compiled
+    sharded_update must produce the new result, not the stale trace."""
+    m = BinaryAccuracy(threshold=0.5, validate_args=False)
+    state = sharded_update(m, PROBS, TARGET, mesh=mesh)
+    assert float(m.compute_state(state)) == pytest.approx(0.75)
+
+    m.threshold = 0.85
+    state = sharded_update(m, PROBS, TARGET, mesh=mesh)
+    expected = float(np.mean((np.asarray(PROBS) > 0.85) == np.asarray(TARGET).astype(bool)))
+    assert float(m.compute_state(state)) == pytest.approx(expected)
+    stats = cache_stats()
+    assert stats["misses"] == 2 and stats["traces"] == 2
+
+
+def test_sharded_update_repeat_hits_cache(mesh):
+    m = BinaryAccuracy(validate_args=False)
+    sharded_update(m, PROBS, TARGET, mesh=mesh)
+    sharded_update(m, PROBS, TARGET, mesh=mesh)
+    stats = cache_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 1 and stats["traces"] == 1
+
+
+def test_compiled_forward_matches_eager_and_invalidates():
+    eager = BinaryAccuracy(validate_args=False)
+    fused = BinaryAccuracy(validate_args=False, jit=True)
+    for _ in range(2):
+        assert float(fused(PROBS, TARGET)) == pytest.approx(float(eager(PROBS, TARGET)))
+    assert float(fused.compute()) == pytest.approx(float(eager.compute()))
+    stats = cache_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 1
+    fused.reset()
+    fused.threshold = 0.85
+    expected = float(np.mean((np.asarray(PROBS) > 0.85) == np.asarray(TARGET).astype(bool)))
+    assert float(fused(PROBS, TARGET)) == pytest.approx(expected)
+
+
+# ------------------------------------------------------------------- donation
+def test_donation_consumes_previous_state():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    old = m._state
+    m.update(PROBS, TARGET)
+    # the donated pytree's buffers are dead after the call
+    assert any(getattr(leaf, "is_deleted", lambda: False)() for leaf in jax.tree.leaves(old))
+
+
+def test_donation_never_corrupts_defaults():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    for _ in range(3):
+        m.update(PROBS, TARGET)
+    m.reset()  # must not observe deleted buffers
+    assert int(m._state["_n"]) == 0
+    m.update(PROBS, TARGET)
+    assert float(m.compute()) == pytest.approx(0.75)
+
+
+def test_init_state_never_aliases_defaults():
+    m = BinaryAccuracy(validate_args=False)
+    st = m.init_state()
+    for name, leaf in m._defaults.items():
+        if not isinstance(leaf, tuple):
+            assert st[name] is not leaf
+
+
+# ------------------------------------------------------------------ bucketing
+def test_bucket_dim():
+    assert [bucket_dim(n) for n in (0, 1, 2, 3, 5, 8, 9, 1000)] == [0, 1, 2, 4, 8, 8, 16, 1024]
+    assert bucket_shape((3, 5)) == (4, 8)
+
+
+def test_ragged_gather_buckets_geometries(mesh):
+    """Many distinct raw geometries collapse into few traces (pow2 buckets)."""
+
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return sum(float(np.asarray(v).sum()) for v in state["items"])
+
+    m = CatItems()
+    n_dev = int(mesh.devices.size)
+    geometries = (3, 5, 6, 7, 9, 11, 13, 15)
+    for g in geometries:
+        states = [
+            m.update_state(m.init_state(), jnp.full((g + d % 2,), 1.0)) for d in range(n_dev)
+        ]
+        merged = sync_ragged_states(m._reductions, states, mesh)
+        # exactness survives bucketing: trims recover true shapes
+        assert sum(int(v.shape[0]) for v in merged["items"]) == sum(
+            g + d % 2 for d in range(n_dev)
+        )
+    stats = cache_stats()
+    assert stats["traces"] < len(geometries)
+
+
+# ----------------------------------------------- ragged leaf classification
+def test_ragged_classification_uses_reduction_table(mesh):
+    """A CAT-reduce *tensor* leaf (fixed-shape concat state) must ride the
+    collective path, not be misclassified from its runtime type."""
+    reductions = {"cat_tensor": Reduce.CAT, "total": Reduce.SUM}
+    n_dev = int(mesh.devices.size)
+    states = [
+        {"cat_tensor": jnp.full((2,), float(d)), "total": jnp.asarray(float(d)), "_n": jnp.asarray(1)}
+        for d in range(n_dev)
+    ]
+    out = sync_ragged_states(reductions, states, mesh)
+    assert out["cat_tensor"].shape == (2 * n_dev,)
+    assert float(out["total"]) == sum(range(n_dev))
+
+
+def test_ragged_cross_device_disagreement_errors(mesh):
+    n_dev = int(mesh.devices.size)
+    states = [
+        {"x": (jnp.ones((2,)),) if d == 0 else jnp.ones((2,)), "_n": jnp.asarray(1)}
+        for d in range(n_dev)
+    ]
+    with pytest.raises(ValueError, match="disagrees across devices"):
+        sync_ragged_states({"x": Reduce.CAT}, states, mesh)
+
+
+def test_ragged_missing_reduction_entry_errors(mesh):
+    n_dev = int(mesh.devices.size)
+    states = [{"x": (jnp.ones((2,)),), "_n": jnp.asarray(1)} for _ in range(n_dev)]
+    with pytest.raises(ValueError, match="no entry in the reduction table"):
+        sync_ragged_states({}, states, mesh)
+
+
+def test_ragged_tuple_leaf_with_scalar_reduce_errors(mesh):
+    n_dev = int(mesh.devices.size)
+    states = [{"x": (jnp.ones((2,)),), "_n": jnp.asarray(1)} for _ in range(n_dev)]
+    with pytest.raises(ValueError, match="item tuples"):
+        sync_ragged_states({"x": Reduce.SUM}, states, mesh)
+
+
+# -------------------------------------------------------- fused collections
+def _collection(jit=False, groups=True):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes=3, average="macro", validate_args=False),
+        },
+        compute_groups=groups,
+        jit=jit,
+    )
+
+
+MC_PREDS = jnp.asarray([0, 1, 2, 1, 0, 2, 1, 0])
+MC_TARGET = jnp.asarray([0, 1, 2, 2, 0, 2, 0, 1])
+
+
+def test_fused_collection_matches_eager():
+    eager, fused = _collection(jit=False), _collection(jit=True)
+    for _ in range(3):
+        eager.update(MC_PREDS, MC_TARGET)
+        fused.update(MC_PREDS, MC_TARGET)
+    e, f = eager.compute(), fused.compute()
+    assert set(e) == set(f)
+    for k in e:
+        assert float(e[k]) == pytest.approx(float(f[k])), k
+    # steps 2..3 ran through ONE fused graph: 1 miss, then hits
+    stats = cache_stats()
+    assert stats["misses"] == 1 and stats["hits"] == 1
+
+
+def test_fused_collection_survives_reset():
+    mc = _collection(jit=True)
+    mc.update(MC_PREDS, MC_TARGET)
+    mc.update(MC_PREDS, MC_TARGET)
+    before = {k: float(v) for k, v in mc.compute().items()}
+    mc.reset()
+    mc.update(MC_PREDS, MC_TARGET)
+    mc.update(MC_PREDS, MC_TARGET)
+    after = {k: float(v) for k, v in mc.compute().items()}
+    assert before == after
+
+
+def test_fused_collection_falls_back_on_strings():
+    """Un-jittable inputs (e.g. text) silently take the eager path."""
+
+    class StrLen(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, texts):
+            return {"total": state["total"] + sum(len(t) for t in texts)}
+
+        def _compute(self, state):
+            return state["total"]
+
+    mc = MetricCollection({"len": StrLen()}, jit=True, compute_groups=False)
+    mc.update(["ab", "cde"])
+    mc.update(["f"])
+    assert float(mc.compute()["len"]) == 6.0
+
+
+def test_sharded_collection_update_matches_sharded_update(mesh):
+    mc = _collection(groups=False)
+    states = sharded_collection_update(mc, MC_PREDS, MC_TARGET, mesh=mesh)
+    res = mc.compute_states(states)
+    for name in ("acc", "f1"):
+        solo = sharded_update(mc[name], MC_PREDS, MC_TARGET, mesh=mesh)
+        assert float(res[name]) == pytest.approx(float(mc[name].compute_state(solo))), name
+
+
+def test_sharded_collection_update_rejects_list_states(mesh):
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return len(state["items"])
+
+    mc = MetricCollection({"cat": CatItems()}, compute_groups=False)
+    with pytest.raises(ValueError, match="DeferredRaggedSync"):
+        sharded_collection_update(mc, jnp.ones((8,)), mesh=mesh)
+
+
+# ------------------------------------------------------ deferred ragged sync
+def test_deferred_ragged_sync_matches_per_step(mesh):
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return sum(float(np.asarray(v).sum()) for v in state["items"])
+
+    m = CatItems()
+    n_dev = int(mesh.devices.size)
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    per_step_total = 0.0
+    for step in range(3):
+        batches = [(jnp.full((d % 3 + 1,), float(step + 1)),) for d in range(n_dev)]
+        acc.update(batches)
+        states = [m.update_state(m.init_state(), *b) for b in batches]
+        per_step_total += m.compute_state(sync_ragged_states(m._reductions, states, mesh))
+    assert acc.steps == 3
+    assert float(acc.compute()) == pytest.approx(per_step_total)
+    acc.reset()
+    assert acc.steps == 0
+
+
+# ------------------------------------------------------------------- helpers
+def test_abstract_signature_distinguishes_shape_dtype():
+    a = abstract_signature((jnp.ones((2, 3)),))
+    assert a == abstract_signature((jnp.zeros((2, 3)),))
+    assert a != abstract_signature((jnp.ones((3, 2)),))
+    assert a != abstract_signature((jnp.ones((2, 3), jnp.int32),))
+
+
+def test_is_jit_compatible():
+    assert is_jit_compatible((jnp.ones(3), np.ones(3), 1, 2.0, True))
+    assert not is_jit_compatible(("text",))
+    assert not is_jit_compatible(({"k": object()},))

@@ -12,6 +12,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from torchmetrics_tpu import Metric
+from torchmetrics_tpu.core.compile import shard_map
 from torchmetrics_tpu.core.reductions import Reduce
 from torchmetrics_tpu.parallel import sharded_update, sync_state
 
@@ -51,7 +52,7 @@ def test_sync_reductions(mesh, reduce, expected_fn):
         st = m.update_state(m.init_state(), shard)
         return m.sync_states(st, "data")["x"]
 
-    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
+    out = shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
     np.testing.assert_allclose(np.asarray(out), float(expected_fn(np.arange(16.0))), rtol=1e-6)
 
 
@@ -74,7 +75,7 @@ def test_sync_cat_tensor_state(mesh):
         st = m.update_state(m.init_state(), shard)
         return m.sync_states(st, "data")["x"]
 
-    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)(data)
+    out = shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)(data)
     assert out.shape == (16,)
     np.testing.assert_allclose(np.sort(np.asarray(out)), np.arange(16.0))
 
@@ -95,7 +96,7 @@ def test_sync_update_counter(mesh):
         st = m.update_state(st, shard)
         return m.sync_states(st, "data")["_n"]
 
-    out = jax.shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(jnp.arange(16.0))
+    out = shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P())(jnp.arange(16.0))
     assert int(out) == 16  # 2 updates x 8 devices
 
 
@@ -109,7 +110,7 @@ def test_sync_inside_jit_fuses(mesh):
             st = m.update_state(m.init_state(), shard)
             return m.sync_states(st, "data")["x"]
 
-        return jax.shard_map(inner, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
+        return shard_map(inner, mesh=mesh, in_specs=P("data"), out_specs=P())(data)
 
     out = full_step(jnp.arange(16.0))
     assert float(out) == 120.0

@@ -161,7 +161,9 @@ def test_pearson_merge_and_sync(mesh):
         st = m.update_state(m.init_state(), ps, ts)
         return m.sync_states(st, "data")
 
-    st = jax.shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)(
+    from torchmetrics_tpu.core.compile import shard_map
+
+    st = shard_map(step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P(), check_vma=False)(
         jnp.asarray(p), jnp.asarray(t)
     )
     np.testing.assert_allclose(float(m.compute_state(st)), stats.pearsonr(t, p)[0], rtol=1e-4)

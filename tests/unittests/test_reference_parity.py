@@ -15,9 +15,9 @@ from __future__ import annotations
 import numpy as np
 import pytest
 
-from tests.helpers.refpath import add_reference_paths
+from tests.helpers.refpath import require_reference
 
-add_reference_paths()
+require_reference()
 
 import jax.numpy as jnp  # noqa: E402
 import torch  # noqa: E402

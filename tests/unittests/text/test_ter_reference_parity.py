@@ -11,11 +11,9 @@ import random
 import numpy as np
 import pytest
 
-from tests.helpers.refpath import add_reference_paths
+from tests.helpers.refpath import require_reference
 
-add_reference_paths()
-
-pytest.importorskip("torchmetrics")
+require_reference()
 
 VOCAB = [
     "the", "cat", "dog", "sat", "on", "mat", "a", "ran", "fast", "slow",

@@ -10,11 +10,11 @@ for against /root/reference/src/torchmetrics/utilities/checks.py:207,315.
 import numpy as np
 import pytest
 
-from tests.helpers.refpath import add_reference_paths
+from tests.helpers.refpath import require_reference
 
-add_reference_paths()
+require_reference()
 
-torch = pytest.importorskip("torch")
+import torch  # noqa: E402
 
 from torchmetrics_tpu.utilities.formatting import classify_inputs  # noqa: E402
 

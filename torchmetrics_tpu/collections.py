@@ -44,11 +44,13 @@ class MetricCollection(dict):
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        jit: bool = False,
     ) -> None:
         super().__init__()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
+        self._enable_jit = bool(jit)
         self._groups_checked = False
         self._state_is_copy = False
         self._groups = {}
@@ -177,6 +179,8 @@ class MetricCollection(dict):
         if not self._groups:
             self._init_groups()
         if self._groups_checked:
+            if self._enable_jit and self._fused_update(args, kwargs):
+                return
             # steady state: update leaders, share state with members
             for members in self._groups.values():
                 leader = self[members[0]]
@@ -191,6 +195,37 @@ class MetricCollection(dict):
             if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
                 self._merge_compute_groups()
             self._groups_checked = True
+
+    def _fused_update(self, args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> bool:
+        """Single-trace update: ONE jitted graph folds the batch into every
+        group leader's state (``jit=True`` construction flag).
+
+        All leaders update inside one XLA graph with the previous state
+        pytrees donated, so shared preprocessing is CSE'd across the
+        collection and the accumulators update in place — one dispatch
+        instead of one per member metric.  Returns ``False`` (and the caller
+        falls back to per-metric dispatch) when a leader holds list states
+        (their per-step growth cannot be traced) or an input can't cross the
+        jit boundary.
+        """
+        from torchmetrics_tpu.core.compile import compiled_collection_update, is_jit_compatible
+
+        leaders = tuple(members[0] for members in self._groups.values())
+        if any(self[name]._has_list_states for name in leaders):
+            return False
+        if not is_jit_compatible((args, dict(kwargs))):
+            return False
+        fn = compiled_collection_update(self, leaders, args, kwargs)
+        # the previous states are donated — dead after this call; every
+        # member (leaders included) is re-pointed at the returned states
+        new_states = fn({name: self[name]._state for name in leaders}, *args, **kwargs)
+        for members in self._groups.values():
+            leader_state = new_states[members[0]]
+            for name in members:
+                member = self[name]
+                member._state = leader_state
+                member._computed = None
+        return True
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {}

@@ -1,0 +1,500 @@
+"""Unified compile cache — the single compilation layer for every jitted
+metric entry point.
+
+Before this module existed each entry point owned its own ad-hoc cache:
+``Metric.update`` kept a per-instance ``_jitted_update``, ``sharded_update``
+kept a per-instance dict keyed only on ``(mesh, axis_name, specs)`` (so
+mutating a metric attribute silently reused the stale trace — ADVICE.md
+round-5), and ``parallel/ragged.py`` kept its own module-global gather cache.
+Every other caller re-traced from scratch.
+
+Here every compiled step routes through one registry.  Cache keys are::
+
+    (entry point, metric class + config fingerprint of update-participating
+     attrs, abstract input shapes/dtypes, mesh/axis_name)
+
+with three properties the ad-hoc caches lacked:
+
+* **Invalidation on attribute mutation.**  ``Metric.__setattr__`` bumps a
+  config version whenever a public attribute changes; the fingerprint is
+  recomputed and the next lookup misses, so ``metric.threshold = 0.9`` after
+  a first compiled call produces the new result, not the stale trace.
+  Compiled closures capture a *frozen clone* of the metric, never the live
+  instance — a retrace for a new input shape under an old key can therefore
+  never observe mutated attributes.
+
+* **State donation.**  Entry points that thread a state pytree through the
+  graph pass ``donate_argnums`` on it, so accumulators update in place
+  (XLA reuses the old state's buffers for the new state — no per-step copy
+  of e.g. FID's 33.5 MB covariance state).  The contract: after a donated
+  call the previous state reference is dead; callers must use the returned
+  state.  ``Metric.init_state``/``add_state`` hand out fresh buffers (never
+  the ``_defaults`` arrays) precisely so donation can't corrupt defaults.
+
+* **Power-of-two shape bucketing** (:func:`bucket_dim`) for ragged/cat-state
+  buffers, so mAP/ROUGE-style per-batch geometry changes collapse into a
+  handful of bucketed shapes instead of one retrace per geometry.
+
+The registry also counts hits/misses/traces (:func:`cache_stats`) — the
+``bench.py`` retrace legs read these counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from copy import deepcopy
+from typing import Any, Callable, Dict, Hashable, Mapping, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = [
+    "shard_map",
+    "abstract_signature",
+    "bucket_dim",
+    "bucket_shape",
+    "cache_size",
+    "cache_stats",
+    "clear_compile_cache",
+    "compiled_collection_update",
+    "compiled_forward",
+    "compiled_ragged_gather",
+    "compiled_sharded_collection_update",
+    "compiled_sharded_update",
+    "compiled_update",
+    "config_fingerprint",
+    "is_jit_compatible",
+    "mark_trace",
+]
+
+# ------------------------------------------------------------ compat shim
+def _make_shard_map() -> Callable:
+    """``jax.shard_map`` across jax versions.
+
+    jax ≥ 0.6 exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)`` (same
+    semantics, older name).  One shim here serves every compiled entry point.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def _compat(f, mesh, in_specs, out_specs, check_vma=True):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_vma
+        )
+
+    return _compat
+
+
+shard_map = _make_shard_map()
+
+
+# ---------------------------------------------------------------- registry
+_LOCK = threading.RLock()
+_CACHE: Dict[Hashable, Callable] = {}
+_STATS = {"hits": 0, "misses": 0, "traces": 0}
+
+# attrs of the Metric base that never participate in update math — excluded
+# from the fingerprint so toggling them doesn't force a retrace.  Subclasses
+# extend via ``__fingerprint_exclude__``.
+_BASE_FINGERPRINT_EXCLUDE = frozenset(
+    {
+        "sync_on_compute",
+        "dist_sync_on_step",
+        "compute_with_cache",
+        "dist_sync_fn",
+        "distributed_available_fn",
+        "process_group",
+    }
+)
+
+
+def cache_stats() -> Dict[str, int]:
+    """Snapshot of the registry counters: hits, misses, traces.
+
+    ``traces`` counts actual XLA traces (including shape-driven retraces
+    inside one cached callable) — the number ``bench.py``'s retrace legs
+    watch.
+    """
+    with _LOCK:
+        return dict(_STATS)
+
+
+def cache_size() -> int:
+    with _LOCK:
+        return len(_CACHE)
+
+
+def clear_compile_cache(reset_stats: bool = True) -> None:
+    """Drop every cached compiled step (and, by default, zero the counters)."""
+    with _LOCK:
+        _CACHE.clear()
+        if reset_stats:
+            for k in _STATS:
+                _STATS[k] = 0
+
+
+def mark_trace() -> None:
+    """Called from inside traced step bodies; Python runs only while XLA is
+    tracing, so each call is exactly one (re)trace."""
+    with _LOCK:
+        _STATS["traces"] += 1
+
+
+def _lookup(key: Hashable, build: Callable[[], Callable]) -> Callable:
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is not None:
+            _STATS["hits"] += 1
+            return fn
+        _STATS["misses"] += 1
+    fn = build()  # build outside the lock: tracing can be slow
+    with _LOCK:
+        return _CACHE.setdefault(key, fn)
+
+
+# ------------------------------------------------------------- fingerprints
+def _freeze_value(v: Any) -> Hashable:
+    """Hashable snapshot of one config attribute value."""
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return v
+    if isinstance(v, (list, tuple)):
+        return ("seq", tuple(_freeze_value(x) for x in v))
+    if isinstance(v, (set, frozenset)):
+        return ("set", tuple(sorted(_freeze_value(x) for x in v)))
+    if isinstance(v, dict):
+        return ("map", tuple(sorted((str(k), _freeze_value(x)) for k, x in v.items())))
+    if hasattr(v, "_config_fingerprint"):  # nested Metric (composition DAGs)
+        return ("metric", v._config_fingerprint())
+    if isinstance(v, (np.ndarray, jax.Array)) or hasattr(v, "__array__"):
+        arr = np.asarray(v)
+        if arr.size * arr.itemsize <= 1 << 16:
+            return ("arr", arr.shape, str(arr.dtype), arr.tobytes())
+        import hashlib
+
+        return ("arr", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
+    if callable(v):
+        # functions/partials: identity-keyed — a different callable object is
+        # conservatively a different config (costs at most an extra trace)
+        return ("fn", getattr(v, "__module__", ""), getattr(v, "__qualname__", repr(v)), id(v))
+    return ("obj", type(v).__module__, type(v).__qualname__, id(v))
+
+
+def config_fingerprint(metric: Any) -> Hashable:
+    """Hashable snapshot of ``(metric class, update-participating attrs)``.
+
+    Every public instance attribute participates except the base class's
+    sync/bookkeeping knobs and anything a subclass lists in
+    ``__fingerprint_exclude__``.  Private (``_``-prefixed) attrs — state,
+    caches, registries — never participate.
+    """
+    exclude = _BASE_FINGERPRINT_EXCLUDE | set(getattr(type(metric), "__fingerprint_exclude__", ()))
+    items = []
+    for name in sorted(metric.__dict__):
+        if name.startswith("_") or name in exclude:
+            continue
+        items.append((name, _freeze_value(metric.__dict__[name])))
+    return (type(metric).__module__, type(metric).__qualname__, tuple(items))
+
+
+# ------------------------------------------------------- abstract signatures
+def _leaf_signature(leaf: Any) -> Hashable:
+    if isinstance(leaf, (jax.Array, np.ndarray)):
+        return ("arr", tuple(leaf.shape), str(leaf.dtype))
+    if isinstance(leaf, (bool, int, float, complex)):
+        # weak-typed python scalars: jit traces them value-insensitively
+        return ("py", type(leaf).__name__)
+    return ("obj", type(leaf).__name__)
+
+
+def abstract_signature(tree: Any) -> Hashable:
+    """Shapes/dtypes/treedef of an input pytree — the cache key's input leg."""
+    leaves, treedef = jax.tree.flatten(tree)
+    return (treedef, tuple(_leaf_signature(leaf) for leaf in leaves))
+
+
+def is_jit_compatible(tree: Any) -> bool:
+    """True when every leaf of ``tree`` can be passed to a jitted function
+    (arrays and numeric python scalars; strings/objects cannot)."""
+    return all(
+        isinstance(leaf, (jax.Array, np.ndarray, bool, int, float, complex))
+        for leaf in jax.tree.leaves(tree)
+    )
+
+
+# ----------------------------------------------------------------- bucketing
+def bucket_dim(n: int) -> int:
+    """Round a dimension up to the next power of two (0 stays 0).
+
+    Ragged/cat-state buffers padded to bucketed dims collapse per-batch
+    geometry jitter into a handful of trace shapes.
+    """
+    n = int(n)
+    if n <= 0:
+        return 0
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Per-dimension power-of-two bucketing of a shape tuple."""
+    return tuple(bucket_dim(s) for s in shape)
+
+
+# ------------------------------------------------------------- frozen clones
+def _frozen_clone(metric: Any) -> Any:
+    """Config snapshot of a metric for capture in a compiled closure.
+
+    A deepcopy (reset to default state, so no accumulated arrays are kept
+    alive) guarantees that a later retrace under the same cache key — e.g.
+    for a new input shape — replays the configuration the key fingerprints,
+    even if the live metric was mutated meanwhile.
+    """
+    clone = deepcopy(metric)
+    clone.reset()
+    return clone
+
+
+def _backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "unknown"
+
+
+# ------------------------------------------------------------- entry points
+def compiled_update(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> Callable:
+    """Compiled ``update_state`` with the state pytree donated (arg 0).
+
+    Returns ``fn(state, *args, **kwargs) -> new_state``.  The caller MUST
+    treat the passed-in state as consumed.
+    """
+    key = (
+        "update",
+        metric._config_fingerprint(),
+        abstract_signature((args, dict(kwargs))),
+        _backend(),
+    )
+
+    def build() -> Callable:
+        frozen = _frozen_clone(metric)
+
+        def step(state, *a, **kw):
+            mark_trace()
+            return frozen.update_state(state, *a, **kw)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    return _lookup(key, build)
+
+
+def compiled_forward(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> Callable:
+    """Compiled ``forward``: one donated-state graph computing the batch
+    value AND folding the batch into the global state.
+
+    Returns ``fn(state, *args, **kwargs) -> (new_state, batch_value)``.
+    Replays ``Metric.forward``'s two strategies (merge-distributive fast
+    path vs ``full_state_update`` double-update) inside a single graph.
+    """
+    key = (
+        "forward",
+        metric._config_fingerprint(),
+        abstract_signature((args, dict(kwargs))),
+        _backend(),
+    )
+
+    def build() -> Callable:
+        frozen = _frozen_clone(metric)
+
+        def step(state, *a, **kw):
+            mark_trace()
+            if frozen.full_state_update:
+                new = frozen.update_state(state, *a, **kw)
+                batch = frozen.update_state(frozen.init_state(), *a, **kw)
+            else:
+                batch = frozen.update_state(frozen.init_state(), *a, **kw)
+                new = frozen.merge_states(state, batch)
+            return new, frozen.compute_state(batch)
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    return _lookup(key, build)
+
+
+def compiled_sharded_update(
+    metric: Any,
+    mesh: Mesh,
+    axis_name: str,
+    specs: Tuple[Any, ...],
+    args: Tuple[Any, ...],
+) -> Callable:
+    """Compiled shard_map step for ``parallel.sync.sharded_update``.
+
+    The key folds in the metric's config fingerprint, so attribute mutation
+    after the first call misses the cache and re-traces with the new config
+    (the round-5 stale-trace fix).
+    """
+    key = (
+        "sharded_update",
+        metric._config_fingerprint(),
+        mesh,
+        axis_name,
+        specs,
+        abstract_signature(args),
+    )
+
+    def build() -> Callable:
+        frozen = _frozen_clone(metric)
+
+        def step(*shards):
+            mark_trace()
+            st = frozen.update_state(frozen.init_state(), *shards)
+            # frozen.sync_states, not the bare reduction table: metrics with
+            # non-distributive states (e.g. Pearson's streaming moments)
+            # override sync_states with their own cross-shard aggregation
+            return frozen.sync_states(st, axis_name)
+
+        return jax.jit(
+            shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+        )
+
+    return _lookup(key, build)
+
+
+def compiled_ragged_gather(
+    mesh: Mesh,
+    axis_name: str,
+    scalar_reduces: Tuple[Tuple[str, Any], ...],
+    ragged_names: Tuple[str, ...],
+) -> Callable:
+    """Compiled gather graph for ``parallel.ragged.sync_ragged_states``.
+
+    Buffer shapes vary per call; the caller buckets them (power-of-two) so
+    the jit dispatch inside one cached callable re-traces only when a bucket
+    boundary is crossed — ``cache_stats()['traces']`` counts those.
+    """
+    from torchmetrics_tpu.core.reductions import sync_leaf
+
+    key = ("ragged_gather", mesh, axis_name, scalar_reduces, ragged_names)
+
+    def build() -> Callable:
+        reduce_table = dict(scalar_reduces)
+
+        def gather(scalars, n, ragged):
+            mark_trace()
+            out_scalars = {
+                name: sync_leaf(reduce_table[name], scalars[name][0], axis_name)
+                for name in scalars
+            }
+            out_n = jax.lax.psum(n[0], axis_name)
+            out_ragged = {
+                name: (
+                    jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
+                    jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
+                )
+                for name, (buf, shapes) in ragged.items()
+            }
+            return out_scalars, out_n, out_ragged
+
+        specs_in = (
+            {name: P(axis_name) for name, _ in scalar_reduces},
+            P(axis_name),
+            {name: (P(axis_name), P(axis_name)) for name in ragged_names},
+        )
+        specs_out = (
+            {name: P() for name, _ in scalar_reduces},
+            P(),
+            {name: (P(), P()) for name in ragged_names},
+        )
+        return jax.jit(
+            shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
+        )
+
+    return _lookup(key, build)
+
+
+def _collection_leaders(collection: Any) -> Tuple[str, ...]:
+    return tuple(members[0] for members in collection._functional_groups().values())
+
+
+def compiled_collection_update(
+    collection: Any,
+    leader_names: Tuple[str, ...],
+    args: Tuple[Any, ...],
+    kwargs: Mapping[str, Any],
+) -> Callable:
+    """One fused jitted graph updating every named leader's state.
+
+    Returns ``fn(states, *args, **kwargs) -> new_states`` where ``states`` is
+    ``{leader_name: state_pytree}`` (donated).  All leaders update inside ONE
+    XLA graph, so preprocessing shared between members (softmax, argmax,
+    format canonicalization) is computed once and CSE'd across the group —
+    instead of N separate dispatches each redoing it.
+    """
+    key = (
+        "collection_update",
+        tuple((name, collection[name]._config_fingerprint()) for name in leader_names),
+        abstract_signature((args, dict(kwargs))),
+        _backend(),
+    )
+
+    def build() -> Callable:
+        frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
+
+        def fused(states, *a, **kw):
+            mark_trace()
+            return {
+                name: m.update_state(states[name], *a, **m._filter_kwargs(**kw))
+                for name, m in frozen.items()
+            }
+
+        return jax.jit(fused, donate_argnums=(0,))
+
+    return _lookup(key, build)
+
+
+def compiled_sharded_collection_update(
+    collection: Any,
+    leader_names: Tuple[str, ...],
+    mesh: Mesh,
+    axis_name: str,
+    specs: Tuple[Any, ...],
+    args: Tuple[Any, ...],
+) -> Callable:
+    """One fused shard_map graph: every leader updates from its input shard
+    AND syncs across the mesh in a single compiled step.
+
+    Returns ``fn(*inputs) -> {leader_name: replicated_state}``.  The mesh
+    collective for all leaders' states rides one graph (one dispatch, fused
+    collectives) instead of one ``sharded_update`` dispatch per metric.
+    """
+    key = (
+        "sharded_collection_update",
+        tuple((name, collection[name]._config_fingerprint()) for name in leader_names),
+        mesh,
+        axis_name,
+        specs,
+        abstract_signature(args),
+    )
+
+    def build() -> Callable:
+        frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
+
+        def step(*shards):
+            mark_trace()
+            out = {}
+            for name, m in frozen.items():
+                st = m.update_state(m.init_state(), *shards)
+                out[name] = m.sync_states(st, axis_name)
+            return out
+
+        # every leader state comes back fully replicated
+        out_specs = {name: P() for name in frozen}
+        return jax.jit(
+            shard_map(step, mesh=mesh, in_specs=specs, out_specs=out_specs, check_vma=False)
+        )
+
+    return _lookup(key, build)

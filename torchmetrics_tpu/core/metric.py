@@ -103,6 +103,9 @@ class Metric:
     """
 
     __jit_state_exclude__: Tuple[str, ...] = ()
+    # extra attrs a subclass wants excluded from the compile-cache config
+    # fingerprint (core/compile.py) on top of the base bookkeeping set
+    __fingerprint_exclude__: Tuple[str, ...] = ()
 
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
@@ -149,6 +152,31 @@ class Metric:
         self._jitted_update: Optional[Callable] = None
         self._update_signature = inspect.signature(self._update)
 
+    # ------------------------------------------------- compile-cache plumbing
+    def __setattr__(self, name: str, value: Any) -> None:
+        # Public attribute mutation invalidates the compile cache's config
+        # fingerprint: the next compiled call misses and re-traces with the
+        # new config instead of silently reusing a stale trace.
+        object.__setattr__(self, name, value)
+        if not name.startswith("_"):
+            d = self.__dict__
+            d["_config_version"] = d.get("_config_version", 0) + 1
+            d.pop("_fingerprint_cache", None)
+
+    def _config_fingerprint(self) -> Any:
+        """Hashable snapshot of (class, update-participating attrs) — the
+        compile-cache key component; cached until an attribute mutates."""
+        from torchmetrics_tpu.core.compile import config_fingerprint
+
+        d = self.__dict__
+        version = d.get("_config_version", 0)
+        cached = d.get("_fingerprint_cache")
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        fp = config_fingerprint(self)
+        d["_fingerprint_cache"] = (version, fp)
+        return fp
+
     # ------------------------------------------------------------------ state
     def add_state(
         self,
@@ -179,7 +207,9 @@ class Metric:
         else:
             arr = jnp.asarray(default)
             self._defaults[name] = arr
-            self._state[name] = arr
+            # never alias _defaults: a donated compiled update consumes the
+            # live state's buffers, and the defaults must survive it
+            self._state[name] = arr.copy()
         self._reductions[name] = reduce
         self._persistent[name] = persistent
 
@@ -189,8 +219,15 @@ class Metric:
 
     # -------------------------------------------------------- functional core
     def init_state(self) -> State:
-        """Fresh state pytree (pure)."""
-        st = {k: v for k, v in self._defaults.items()}
+        """Fresh state pytree (pure).
+
+        Leaves are copies of the defaults, never the default arrays
+        themselves: compiled entry points donate the state pytree to XLA
+        (core/compile.py), and a donated buffer is dead after the call —
+        handing out ``_defaults`` references would let one donated step
+        destroy the defaults for every later ``reset``.
+        """
+        st = {k: (v if isinstance(v, tuple) else v.copy()) for k, v in self._defaults.items()}
         st[_N] = jnp.zeros((), dtype=jnp.int32)
         return st
 
@@ -264,12 +301,20 @@ class Metric:
         return self._state
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Accumulate a batch into the global state."""
+        """Accumulate a batch into the global state.
+
+        With ``jit=True`` the step routes through the unified compile cache
+        (core/compile.py): the trace is keyed on the metric's config
+        fingerprint (attribute mutation re-traces instead of reusing a stale
+        step) and the previous state pytree is donated to XLA, so the
+        accumulators update in place with no per-step state copy.
+        """
         self._computed = None
         if self._enable_jit and not self._has_list_states:
-            if self._jitted_update is None:
-                self._jitted_update = jax.jit(self.update_state)
-            self._state = self._jitted_update(self._state, *args, **kwargs)
+            from torchmetrics_tpu.core.compile import compiled_update
+
+            fn = compiled_update(self, args, kwargs)
+            self._state = fn(self._state, *args, **kwargs)
         else:
             self._state = self.update_state(self._state, *args, **kwargs)
 
@@ -303,6 +348,18 @@ class Metric:
         state.  Metrics whose ``update`` is not merge-distributive set
         ``full_state_update=True`` and take the two-update path.
         """
+        if (
+            self._enable_jit
+            and not self._has_list_states
+            and not (self.dist_sync_on_step and self.distributed_available_fn())
+        ):
+            from torchmetrics_tpu.core.compile import compiled_forward, is_jit_compatible
+
+            if is_jit_compatible((args, dict(kwargs))):
+                fn = compiled_forward(self, args, kwargs)
+                self._state, self._forward_cache = fn(self._state, *args, **kwargs)
+                self._computed = None
+                return self._forward_cache
         if self.full_state_update:
             self._state = self.update_state(self._state, *args, **kwargs)
             batch_state = self.update_state(self.init_state(), *args, **kwargs)
@@ -372,7 +429,10 @@ class Metric:
         d = self.__dict__.copy()
         d.pop("_jitted_update", None)
         d.pop("_update_signature", None)
-        d.pop("_sharded_fn_cache", None)  # compiled shard_map steps (parallel/sync.py)
+        d.pop("_sharded_fn_cache", None)  # legacy per-instance compiled-step cache
+        # fingerprints can embed object ids (callable attrs) — never let them
+        # cross a pickle boundary where ids could collide
+        d.pop("_fingerprint_cache", None)
         d["_state"] = jax.tree.map(np.asarray, self._state)
         d["_defaults"] = jax.tree.map(np.asarray, self._defaults)
         d["_computed"] = None

@@ -16,21 +16,28 @@ The reference's entire comm backend is ``gather_all_tensors``
   detection/mean_ap.py:1022-1046 + utilities/distributed.py:136-147).
 """
 
-from torchmetrics_tpu.parallel.ragged import sharded_list_update, sync_ragged_states
+from torchmetrics_tpu.parallel.ragged import (
+    DeferredRaggedSync,
+    sharded_list_update,
+    sync_ragged_states,
+)
 from torchmetrics_tpu.parallel.sync import (
     distributed_available,
     gather_all_arrays,
     metric_mesh,
     reduce as reduce_op,
+    sharded_collection_update,
     sharded_update,
     sync_state,
 )
 
 __all__ = [
+    "DeferredRaggedSync",
     "distributed_available",
     "gather_all_arrays",
     "metric_mesh",
     "reduce_op",
+    "sharded_collection_update",
     "sharded_list_update",
     "sharded_update",
     "sync_ragged_states",

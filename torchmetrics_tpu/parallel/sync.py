@@ -169,29 +169,70 @@ def sharded_update(
 
     specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
 
-    def step(*shards):
-        st = metric.update_state(metric.init_state(), *shards, **kwargs)
-        # metric.sync_states, not the bare reduction table: metrics with
-        # non-distributive states (e.g. Pearson's streaming moments)
-        # override sync_states with their own cross-shard aggregation
-        return metric.sync_states(st, axis_name)
-
-    # check_vma=False: all_gather-produced leaves are replicated in value but the
-    # static VMA checker cannot infer that, so replication is asserted, not checked.
+    # check_vma=False (inside compiled_sharded_update): all_gather-produced
+    # leaves are replicated in value but the static VMA checker cannot infer
+    # that, so replication is asserted, not checked.
     if kwargs:
         # kwargs are closed over as trace constants — a cached compile would
         # freeze their first values, so this path stays uncached
-        fn = jax.shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+
+        def step(*shards):
+            st = metric.update_state(metric.init_state(), *shards, **kwargs)
+            # metric.sync_states, not the bare reduction table: metrics with
+            # non-distributive states (e.g. Pearson's streaming moments)
+            # override sync_states with their own cross-shard aggregation
+            return metric.sync_states(st, axis_name)
+
+        from torchmetrics_tpu.core.compile import shard_map
+
+        fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
         return fn(*inputs)
-    # cache the compiled step per (mesh, axis, specs): a fresh shard_map
-    # closure per call re-traces every step, turning a ~100 µs collective
-    # into a ~1 s compile — per-step eval use would never warm up
-    cache = metric.__dict__.setdefault("_sharded_fn_cache", {})
-    key = (mesh, axis_name, specs)
-    fn = cache.get(key)
-    if fn is None:
-        fn = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+    # unified compile cache: the compiled step is keyed on (metric class,
+    # config fingerprint, mesh, axis, specs, abstract input shapes), so
+    # mutating a metric attribute after the first call re-traces with the
+    # new config instead of silently reusing the stale step (ADVICE r5),
+    # while repeat steps still hit the cache (a fresh shard_map closure per
+    # call would re-trace every step, turning a ~100 µs collective into a
+    # ~1 s compile)
+    from torchmetrics_tpu.core.compile import compiled_sharded_update
+
+    fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs)
+    return fn(*inputs)
+
+
+def sharded_collection_update(
+    collection: "MetricCollection",  # noqa: F821 - forward ref, avoids circular import
+    *inputs: Array,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "data",
+    in_specs: Optional[Any] = None,
+) -> Dict[str, State]:
+    """One fused compiled step for a whole :class:`MetricCollection`.
+
+    Every compute-group leader updates from its input shard AND syncs across
+    the mesh inside ONE shard_map graph — one dispatch and fused collectives
+    for the whole collection, instead of one :func:`sharded_update` dispatch
+    per member metric.  Shared preprocessing between members is CSE'd by XLA
+    inside the single graph.  Returns ``{leader_name: replicated_state}``,
+    ready for ``collection.compute_states`` / ``collection.load_states``.
+
+    Leaders with list (cat) states cannot ride the in-graph step path — use
+    :class:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync` for those.
+    """
+    from torchmetrics_tpu.core.compile import compiled_sharded_collection_update
+
+    mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
+    if in_specs is None:
+        in_specs = P(axis_name)
+    specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
+
+    leaders = tuple(members[0] for members in collection._functional_groups().values())
+    listy = [name for name in leaders if collection[name]._has_list_states]
+    if listy:
+        raise ValueError(
+            f"sharded_collection_update fuses fixed-size (psum-family) states into one graph; "
+            f"leaders {listy} hold list (cat) states, which grow per step and cannot be traced. "
+            "Update those eagerly and defer their gather to compute with DeferredRaggedSync."
         )
-        cache[key] = fn
+    fn = compiled_sharded_collection_update(collection, leaders, mesh, axis_name, specs, inputs)
     return fn(*inputs)
