@@ -23,11 +23,13 @@ from torchmetrics_tpu.core.compile import (
     abstract_signature,
     bucket_dim,
     bucket_shape,
+    cache_capacity,
     cache_size,
     cache_stats,
     clear_compile_cache,
     config_fingerprint,
     is_jit_compatible,
+    set_cache_capacity,
 )
 from torchmetrics_tpu.core.reductions import Reduce
 from torchmetrics_tpu.parallel import (
@@ -75,6 +77,46 @@ def test_fingerprint_ignores_private_and_excluded():
     m._some_private = 123
     m.sync_on_compute = False  # base-class bookkeeping knob, excluded
     assert m._config_fingerprint() == before
+
+
+def test_fingerprint_partials_are_structural():
+    """partials deepcopy into new instances, so id-keying them would make
+    every clone a new config AND risk id reuse — they fingerprint by value."""
+    import functools
+
+    a = BinaryAccuracy(validate_args=False)
+    b = BinaryAccuracy(validate_args=False)
+    a.agg_fn = functools.partial(jnp.clip, min=0.0, max=1.0)
+    b.agg_fn = functools.partial(jnp.clip, min=0.0, max=1.0)
+    assert config_fingerprint(a) == config_fingerprint(b)
+    b.agg_fn = functools.partial(jnp.clip, min=0.0, max=0.5)
+    assert config_fingerprint(a) != config_fingerprint(b)
+
+
+def test_fingerprint_pins_id_keyed_objects():
+    """id-keyed fingerprint components (opaque callables/objects) must keep
+    the object alive: a collected object's id could be recycled by a
+    different object with the same qualname, falsely hitting a stale trace."""
+    import gc
+    import weakref
+
+    from torchmetrics_tpu.core import compile as compile_mod
+
+    class Opaque:
+        pass
+
+    m = BinaryAccuracy(validate_args=False)
+    m.knob = Opaque()
+    ref = weakref.ref(m.knob)
+    config_fingerprint(m)
+    m.knob = None  # the metric no longer holds it...
+    del m
+    gc.collect()
+    assert ref() is not None  # ...but the pin does, so its id can't be reused
+    assert id(ref()) in compile_mod._ID_PINS
+    clear_compile_cache()
+    gc.collect()
+    assert ref() is None  # pins die with the cache
 
 
 # ------------------------------------------------------------------ cache hits
@@ -150,6 +192,32 @@ def test_compiled_forward_matches_eager_and_invalidates():
     assert float(fused(PROBS, TARGET)) == pytest.approx(expected)
 
 
+# ------------------------------------------------------------------ eviction
+def test_cache_is_lru_bounded():
+    cap = cache_capacity()
+    try:
+        set_cache_capacity(2)
+        m = BinaryAccuracy(validate_args=False, jit=True)
+        m.update(PROBS, TARGET)
+        m.update(PROBS[:4], TARGET[:4])  # 2nd entry (new shape)
+        m.update(PROBS, TARGET)  # hit: refreshes entry 1's recency
+        m.update(PROBS[:2], TARGET[:2])  # 3rd entry evicts the LRU one (shape :4)
+        stats = cache_stats()
+        assert cache_size() == 2
+        assert stats["evictions"] == 1
+        m.update(PROBS, TARGET)  # survived the eviction
+        assert cache_stats()["hits"] == 2
+        m.update(PROBS[:4], TARGET[:4])  # evicted: re-misses
+        assert cache_stats()["misses"] == 4
+    finally:
+        set_cache_capacity(cap)
+
+
+def test_set_cache_capacity_rejects_nonpositive():
+    with pytest.raises(ValueError, match="capacity"):
+        set_cache_capacity(0)
+
+
 # ------------------------------------------------------------------- donation
 def test_donation_consumes_previous_state():
     m = BinaryAccuracy(validate_args=False, jit=True)
@@ -176,6 +244,92 @@ def test_init_state_never_aliases_defaults():
     for name, leaf in m._defaults.items():
         if not isinstance(leaf, tuple):
             assert st[name] is not leaf
+
+
+def _jit_group_collection():
+    """Two jit=True metrics that compute-group together (identical states)."""
+    return MetricCollection(
+        {
+            "acc_micro": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False, jit=True),
+            "acc_macro": MulticlassAccuracy(num_classes=3, average="macro", validate_args=False, jit=True),
+        },
+        compute_groups=True,
+        jit=False,  # per-member dispatch: each member's own jit path runs
+    )
+
+
+def test_no_donation_on_shared_group_state():
+    """Use-after-donate regression: once a compute group shares one state
+    pytree across members, a member's compiled update/forward must NOT donate
+    it — on TPU/GPU donation deletes the buffers the other members still
+    read (CPU ignores donation, so we assert the flag and the compiled-step
+    keying rather than the device-side RuntimeError)."""
+    mc = _jit_group_collection()
+    mc.update(MC_PREDS, MC_TARGET)  # group-forming update
+    mc.update(MC_PREDS, MC_TARGET)  # steady state: members now alias leader state
+    group = next(iter(mc.compute_groups.values()))
+    assert len(group) == 2
+    assert mc["acc_micro"]._state is mc["acc_macro"]._state
+    assert all(mc[name]._state_shared for name in group)
+
+    from torchmetrics_tpu.core.compile import compiled_update
+
+    m = mc["acc_micro"]
+    donating = compiled_update(m, (MC_PREDS, MC_TARGET), {}, donate=True)
+    sharing = compiled_update(m, (MC_PREDS, MC_TARGET), {}, donate=False)
+    assert donating is not sharing  # donate flag is part of the cache key
+
+    # direct member calls after sharing stay usable for EVERY group member
+    m.update(MC_PREDS, MC_TARGET)
+    assert not any(
+        getattr(leaf, "is_deleted", lambda: False)()
+        for leaf in jax.tree.leaves(mc["acc_macro"]._state)
+    )
+    eager = MulticlassAccuracy(num_classes=3, average="macro", validate_args=False)
+    for _ in range(2):
+        eager.update(MC_PREDS, MC_TARGET)
+    assert float(mc["acc_macro"].compute()) == pytest.approx(float(eager.compute()))
+
+
+def test_member_forward_after_sharing_is_safe():
+    mc = _jit_group_collection()
+    mc.update(MC_PREDS, MC_TARGET)
+    mc.update(MC_PREDS, MC_TARGET)
+    # MetricCollection.forward dispatches each member's compiled forward in
+    # sequence over the SAME aliased state — none of them may donate it
+    res = mc.forward(MC_PREDS, MC_TARGET)
+    assert set(res) == {"acc_micro", "acc_macro"}
+    for name in res:
+        assert not any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for leaf in jax.tree.leaves(mc[name]._state)
+        )
+
+
+def test_fused_update_marks_members_shared():
+    mc = MetricCollection(
+        {
+            "acc_micro": MulticlassAccuracy(num_classes=3, average="micro", validate_args=False, jit=True),
+            "acc_macro": MulticlassAccuracy(num_classes=3, average="macro", validate_args=False, jit=True),
+        },
+        compute_groups=True,
+        jit=True,
+    )
+    mc.update(MC_PREDS, MC_TARGET)  # group-forming
+    mc.update(MC_PREDS, MC_TARGET)  # fused path shares the returned state
+    group = next(iter(mc.compute_groups.values()))
+    assert len(group) == 2
+    assert all(mc[name]._state_shared for name in group)
+
+
+def test_reset_clears_shared_flag_and_restores_donation():
+    mc = _jit_group_collection()
+    mc.update(MC_PREDS, MC_TARGET)
+    mc.update(MC_PREDS, MC_TARGET)
+    m = mc["acc_micro"]
+    assert m._state_shared
+    m.reset()  # fresh buffers: nothing aliases them anymore
+    assert not m._state_shared
 
 
 # ------------------------------------------------------------------ bucketing
@@ -367,6 +521,34 @@ def test_deferred_ragged_sync_matches_per_step(mesh):
     assert float(acc.compute()) == pytest.approx(per_step_total)
     acc.reset()
     assert acc.steps == 0
+
+
+def test_deferred_ragged_sync_validates_length_every_step(mesh):
+    """A wrong per-device batch count must raise on EVERY update, not just
+    the first — later steps zip against the running states and would
+    silently drop data otherwise."""
+
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return len(state["items"])
+
+    n_dev = int(mesh.devices.size)
+    acc = DeferredRaggedSync(CatItems(), mesh=mesh)
+    good = [(jnp.ones((2,)),) for _ in range(n_dev)]
+    acc.update(good)
+    with pytest.raises(ValueError, match="one batch per mesh device"):
+        acc.update(good + [(jnp.ones((2,)),)])  # too many on step 2
+    with pytest.raises(ValueError, match="one batch per mesh device"):
+        acc.update(good[:-1])  # too few on step 2
+    acc.update(good)  # the failed calls must not have corrupted the states
+    assert acc.steps == 2
 
 
 # ------------------------------------------------------------------- helpers

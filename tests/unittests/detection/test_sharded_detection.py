@@ -96,6 +96,9 @@ def test_sharded_map_matches_torch_oracle(mesh):
     """Mesh-synced mAP ≡ the reference's pure-torch evaluator on the same
     ragged dataset (crowd-free: the legacy oracle has no crowd handling —
     see test_map_oracle.py scope notes)."""
+    from tests.helpers.refpath import require_reference
+
+    require_reference()  # skips when the reference mount / torchmetrics is absent
     torch = pytest.importorskip("torch")
     from torchmetrics.detection._mean_ap import MeanAveragePrecision as LegacyMAP
 

@@ -189,6 +189,7 @@ class MetricCollection(dict):
                     member = self[name]
                     member._state = leader._state
                     member._computed = None
+                self._mark_shared(members)
         else:
             for m in self.values(copy_state=False):
                 m.update(*args, **m._filter_kwargs(**kwargs))
@@ -225,7 +226,21 @@ class MetricCollection(dict):
                 member = self[name]
                 member._state = leader_state
                 member._computed = None
+            self._mark_shared(members)
         return True
+
+    def _mark_shared(self, members: List[str]) -> None:
+        """Flag every member of a multi-metric group as holding aliased state.
+
+        One state pytree is referenced by all of them, so a compiled
+        ``update``/``forward`` on any single member must not donate it to XLA
+        — donation deletes the buffers for the rest of the group
+        (``Metric._state_shared``, checked by the jit paths in
+        ``core/metric.py``).
+        """
+        if len(members) > 1:
+            for name in members:
+                self[name]._state_shared = True
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         res = {}
@@ -319,6 +334,9 @@ class MetricCollection(dict):
             st = states[members[0]]
             for name in members:
                 self[name].load_state_pytree(st)
+            # load_state_pytree's jnp.asarray is a no-op on jax arrays, so
+            # every member of the group now aliases one pytree
+            self._mark_shared(members)
 
     def state_pytree(self) -> Dict[str, Any]:
         """Checkpointable state pytree for the whole collection (orbax-ready)."""

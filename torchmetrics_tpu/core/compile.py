@@ -30,6 +30,15 @@ with three properties the ad-hoc caches lacked:
   call the previous state reference is dead; callers must use the returned
   state.  ``Metric.init_state``/``add_state`` hand out fresh buffers (never
   the ``_defaults`` arrays) precisely so donation can't corrupt defaults.
+  Donation is skipped for states that may be *aliased*: compute-group
+  members share one state pytree (``Metric._state_shared``), and donating it
+  from one member's call would delete buffers the others still read.
+
+The registry is a bounded LRU (default 512 entries, tunable via
+``set_cache_capacity`` / ``TM_TPU_COMPILE_CACHE_SIZE``): each entry pins a
+frozen metric clone and compiled executables, so eviction keeps
+config-churning or shape-churning long jobs at a bounded footprint.
+``clear_compile_cache()`` releases everything at once.
 
 * **Power-of-two shape bucketing** (:func:`bucket_dim`) for ragged/cat-state
   buffers, so mAP/ROUGE-style per-batch geometry changes collapse into a
@@ -41,7 +50,10 @@ The registry also counts hits/misses/traces (:func:`cache_stats`) — the
 
 from __future__ import annotations
 
+import functools
+import os
 import threading
+from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Callable, Dict, Hashable, Mapping, Optional, Tuple
 
@@ -55,8 +67,10 @@ __all__ = [
     "abstract_signature",
     "bucket_dim",
     "bucket_shape",
+    "cache_capacity",
     "cache_size",
     "cache_stats",
+    "set_cache_capacity",
     "clear_compile_cache",
     "compiled_collection_update",
     "compiled_forward",
@@ -95,8 +109,22 @@ shard_map = _make_shard_map()
 
 # ---------------------------------------------------------------- registry
 _LOCK = threading.RLock()
-_CACHE: Dict[Hashable, Callable] = {}
-_STATS = {"hits": 0, "misses": 0, "traces": 0}
+# LRU: lookups move entries to the back; inserts evict from the front once
+# the capacity is hit.  Each entry's closure pins a frozen metric clone plus
+# its compiled executables, so an unbounded registry would leak in jobs that
+# keep mutating config attrs or crossing shape buckets — the cap keeps the
+# steady-state footprint of such jobs bounded (and clear_compile_cache()
+# releases everything at once for long-running processes).
+_CACHE: "OrderedDict[Hashable, Callable]" = OrderedDict()
+_CACHE_CAPACITY = max(1, int(os.environ.get("TM_TPU_COMPILE_CACHE_SIZE", "512")))
+_STATS = {"hits": 0, "misses": 0, "traces": 0, "evictions": 0}
+# Strong refs to objects whose fingerprint embeds id(): while a cache entry
+# keyed on id(obj) may exist, the object must stay alive so its id cannot be
+# recycled for a different object with the same module/qualname (which would
+# silently replay a trace built from the old attribute value).  Cleared with
+# the cache; entries evicted by the LRU may leave a pin behind (a small,
+# safe-direction leak — a live pin can only prevent false hits).
+_ID_PINS: Dict[int, Any] = {}
 
 # attrs of the Metric base that never participate in update math — excluded
 # from the fingerprint so toggling them doesn't force a retrace.  Subclasses
@@ -114,7 +142,7 @@ _BASE_FINGERPRINT_EXCLUDE = frozenset(
 
 
 def cache_stats() -> Dict[str, int]:
-    """Snapshot of the registry counters: hits, misses, traces.
+    """Snapshot of the registry counters: hits, misses, traces, evictions.
 
     ``traces`` counts actual XLA traces (including shape-driven retraces
     inside one cached callable) — the number ``bench.py``'s retrace legs
@@ -129,10 +157,34 @@ def cache_size() -> int:
         return len(_CACHE)
 
 
+def cache_capacity() -> int:
+    with _LOCK:
+        return _CACHE_CAPACITY
+
+
+def set_cache_capacity(capacity: int) -> None:
+    """Resize the LRU registry (entries beyond the new cap are evicted
+    oldest-first).  Default 512, or ``TM_TPU_COMPILE_CACHE_SIZE``."""
+    global _CACHE_CAPACITY
+    if capacity < 1:
+        raise ValueError(f"compile cache capacity must be >= 1, got {capacity}")
+    with _LOCK:
+        _CACHE_CAPACITY = capacity
+        while len(_CACHE) > _CACHE_CAPACITY:
+            _CACHE.popitem(last=False)
+            _STATS["evictions"] += 1
+
+
 def clear_compile_cache(reset_stats: bool = True) -> None:
-    """Drop every cached compiled step (and, by default, zero the counters)."""
+    """Drop every cached compiled step (and, by default, zero the counters).
+
+    Also releases the fingerprint id-pins.  Long-running jobs that churn
+    through many configs or shape buckets should call this between
+    evaluation phases to release compiled executables and pinned clones.
+    """
     with _LOCK:
         _CACHE.clear()
+        _ID_PINS.clear()
         if reset_stats:
             for k in _STATS:
                 _STATS[k] = 0
@@ -150,14 +202,34 @@ def _lookup(key: Hashable, build: Callable[[], Callable]) -> Callable:
         fn = _CACHE.get(key)
         if fn is not None:
             _STATS["hits"] += 1
+            _CACHE.move_to_end(key)
             return fn
         _STATS["misses"] += 1
     fn = build()  # build outside the lock: tracing can be slow
     with _LOCK:
-        return _CACHE.setdefault(key, fn)
+        fn = _CACHE.setdefault(key, fn)
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > _CACHE_CAPACITY:
+            _CACHE.popitem(last=False)
+            _STATS["evictions"] += 1
+        return fn
 
 
 # ------------------------------------------------------------- fingerprints
+def _pin_id(v: Any) -> int:
+    """Return ``id(v)`` after pinning ``v`` alive for the cache's lifetime.
+
+    Identity-keyed fingerprint components are only sound while the object
+    exists: if it were collected, CPython could hand its id to a *different*
+    object with the same module/qualname, and a later lookup would falsely
+    hit a trace built from the old attribute value.  The pin makes id reuse
+    impossible for as long as any cache entry might embed it.
+    """
+    with _LOCK:
+        _ID_PINS[id(v)] = v
+    return id(v)
+
+
 def _freeze_value(v: Any) -> Hashable:
     """Hashable snapshot of one config attribute value."""
     if isinstance(v, (str, int, float, bool, bytes, type(None))):
@@ -177,11 +249,22 @@ def _freeze_value(v: Any) -> Hashable:
         import hashlib
 
         return ("arr", arr.shape, str(arr.dtype), hashlib.sha1(arr.tobytes()).hexdigest())
+    if isinstance(v, functools.partial):
+        # structural, not identity: partials deepcopy into new instances, so
+        # id-keying them would both over-trace (every clone a new config) and
+        # risk id reuse after the original dies
+        return (
+            "partial",
+            _freeze_value(v.func),
+            _freeze_value(v.args),
+            _freeze_value(v.keywords or {}),
+        )
     if callable(v):
-        # functions/partials: identity-keyed — a different callable object is
-        # conservatively a different config (costs at most an extra trace)
-        return ("fn", getattr(v, "__module__", ""), getattr(v, "__qualname__", repr(v)), id(v))
-    return ("obj", type(v).__module__, type(v).__qualname__, id(v))
+        # other callables: identity-keyed — a different callable object is
+        # conservatively a different config (costs at most an extra trace).
+        # The id is pinned so it can't be recycled into a false cache hit.
+        return ("fn", getattr(v, "__module__", ""), getattr(v, "__qualname__", repr(v)), _pin_id(v))
+    return ("obj", type(v).__module__, type(v).__qualname__, _pin_id(v))
 
 
 def config_fingerprint(metric: Any) -> Hashable:
@@ -266,17 +349,26 @@ def _backend() -> str:
 
 
 # ------------------------------------------------------------- entry points
-def compiled_update(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> Callable:
-    """Compiled ``update_state`` with the state pytree donated (arg 0).
+def compiled_update(
+    metric: Any,
+    args: Tuple[Any, ...],
+    kwargs: Mapping[str, Any],
+    donate: bool = True,
+) -> Callable:
+    """Compiled ``update_state``, donating the state pytree (arg 0) by default.
 
-    Returns ``fn(state, *args, **kwargs) -> new_state``.  The caller MUST
-    treat the passed-in state as consumed.
+    Returns ``fn(state, *args, **kwargs) -> new_state``.  With ``donate=True``
+    the caller MUST treat the passed-in state as consumed.  Callers whose
+    state pytree may be aliased elsewhere (compute-group members sharing one
+    state — ``Metric._state_shared``) pass ``donate=False``: donating an
+    aliased state would delete buffers other metrics still read.
     """
     key = (
         "update",
         metric._config_fingerprint(),
         abstract_signature((args, dict(kwargs))),
         _backend(),
+        donate,
     )
 
     def build() -> Callable:
@@ -286,24 +378,32 @@ def compiled_update(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any
             mark_trace()
             return frozen.update_state(state, *a, **kw)
 
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     return _lookup(key, build)
 
 
-def compiled_forward(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> Callable:
-    """Compiled ``forward``: one donated-state graph computing the batch
-    value AND folding the batch into the global state.
+def compiled_forward(
+    metric: Any,
+    args: Tuple[Any, ...],
+    kwargs: Mapping[str, Any],
+    donate: bool = True,
+) -> Callable:
+    """Compiled ``forward``: one graph computing the batch value AND folding
+    the batch into the global state (donated by default).
 
     Returns ``fn(state, *args, **kwargs) -> (new_state, batch_value)``.
     Replays ``Metric.forward``'s two strategies (merge-distributive fast
     path vs ``full_state_update`` double-update) inside a single graph.
+    ``donate=False`` for states that may be aliased (see
+    :func:`compiled_update`).
     """
     key = (
         "forward",
         metric._config_fingerprint(),
         abstract_signature((args, dict(kwargs))),
         _backend(),
+        donate,
     )
 
     def build() -> Callable:
@@ -319,7 +419,7 @@ def compiled_forward(metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, An
                 new = frozen.merge_states(state, batch)
             return new, frozen.compute_state(batch)
 
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
 
     return _lookup(key, build)
 

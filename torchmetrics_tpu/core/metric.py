@@ -120,6 +120,13 @@ class Metric:
         self._reductions: Dict[str, Union[Reduce, Callable]] = {}
         self._persistent: Dict[str, bool] = {}
         self._state: State = {_N: jnp.zeros((), dtype=jnp.int32)}
+        # True once self._state may be aliased by another metric (compute
+        # groups share one pytree across members): compiled paths must not
+        # donate an aliased state — donation would delete buffers the other
+        # metrics still read.  Sticky until ``reset`` hands out fresh
+        # buffers, because eager updates/merges can thread old leaves into
+        # the new state (e.g. cat-state tuples pass arrays through).
+        self._state_shared: bool = False
         self._computed: Any = None
         self._forward_cache: Any = None
         self._dtype: Optional[jnp.dtype] = None
@@ -313,7 +320,7 @@ class Metric:
         if self._enable_jit and not self._has_list_states:
             from torchmetrics_tpu.core.compile import compiled_update
 
-            fn = compiled_update(self, args, kwargs)
+            fn = compiled_update(self, args, kwargs, donate=not self._state_shared)
             self._state = fn(self._state, *args, **kwargs)
         else:
             self._state = self.update_state(self._state, *args, **kwargs)
@@ -356,7 +363,7 @@ class Metric:
             from torchmetrics_tpu.core.compile import compiled_forward, is_jit_compatible
 
             if is_jit_compatible((args, dict(kwargs))):
-                fn = compiled_forward(self, args, kwargs)
+                fn = compiled_forward(self, args, kwargs, donate=not self._state_shared)
                 self._state, self._forward_cache = fn(self._state, *args, **kwargs)
                 self._computed = None
                 return self._forward_cache
@@ -378,6 +385,7 @@ class Metric:
     def reset(self) -> None:
         """Restore default state (reference: metric.py:692-707)."""
         self._state = self.init_state()
+        self._state_shared = False  # fresh buffers: nothing aliases them
         self._computed = None
         self._forward_cache = None
 
@@ -447,6 +455,7 @@ class Metric:
         self._defaults = {
             k: v if isinstance(v, tuple) else jnp.asarray(v) for k, v in self._defaults.items()
         }
+        self._state_shared = False  # state arrays were just rebuilt from numpy
         self._jitted_update = None
         self._update_signature = inspect.signature(self._update)
 

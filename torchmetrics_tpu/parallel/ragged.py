@@ -320,14 +320,16 @@ class DeferredRaggedSync:
     def update(self, per_device_batches: Sequence[Tuple[Any, ...]]) -> None:
         """Fold one step's per-device batches into the running per-device
         states.  Purely local: no cross-device collective runs here."""
+        # validated on EVERY step: the merge below zips against the running
+        # per-device states, and a silent zip-truncation would drop data
+        if len(per_device_batches) != int(self.mesh.devices.size):
+            raise ValueError(
+                f"need one batch per mesh device: got {len(per_device_batches)} for "
+                f"{int(self.mesh.devices.size)} devices"
+            )
         m = self.metric
         partial = [m.update_state(m.init_state(), *batch) for batch in per_device_batches]
         if self._per_device is None:
-            if len(partial) != int(self.mesh.devices.size):
-                raise ValueError(
-                    f"need one batch per mesh device: got {len(partial)} for "
-                    f"{int(self.mesh.devices.size)} devices"
-                )
             self._per_device = partial
         else:
             self._per_device = [
