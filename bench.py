@@ -588,6 +588,70 @@ def donation_leg():
     }
 
 
+def resilience_leg():
+    """Checkpoint and guard cost: snapshot→host-numpy and validate→restore
+    latency for a large confusion-matrix state, plus the per-step price of
+    ``nan_strategy="ignore"`` on the compiled update path versus the default
+    ``"propagate"`` — with the retrace counter proving the fused guard adds
+    zero extra compilations for a fixed geometry.
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+    from torchmetrics_tpu.resilience import restore, snapshot
+    from torchmetrics_tpu.utilities.benchmark import state_bytes
+
+    n_cls = int(os.environ.get("BENCH_RESILIENCE_CLASSES", 1024))
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, n_cls, 256))
+    tgt = jnp.asarray(rng.integers(0, n_cls, 256))
+    reps = 20
+
+    m = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False)
+    m.update(preds, tgt)
+    snap = snapshot(m)  # warm the path once
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        snap = snapshot(m)
+    snap_us = (time.perf_counter() - t0) / reps * 1e6
+    fresh = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False)
+    restore(fresh, snap)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        restore(fresh, snap)
+    jax.block_until_ready(fresh._state["confmat"])
+    restore_us = (time.perf_counter() - t0) / reps * 1e6
+
+    def guarded_step_us(strategy):
+        clear_compile_cache()
+        gm = MulticlassConfusionMatrix(
+            num_classes=n_cls, validate_args=False, nan_strategy=strategy, jit=True
+        )
+        gm.update(preds, tgt)  # compile
+        inner = 30
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            gm.update(preds, tgt)
+        jax.block_until_ready(gm._state["confmat"])
+        return (time.perf_counter() - t0) / inner * 1e6, cache_stats()["traces"]
+
+    base_us, base_traces = guarded_step_us("propagate")
+    guard_us, guard_traces = guarded_step_us("ignore")
+    return {
+        "metric": f"MulticlassConfusionMatrix({n_cls})",
+        "state_bytes": state_bytes(m.init_state()),
+        "snapshot_us": round(snap_us, 1),
+        "restore_us": round(restore_us, 1),
+        "update_us_propagate": round(base_us, 1),
+        "update_us_ignore": round(guard_us, 1),
+        "ignore_extra_retraces": guard_traces - base_traces,  # must be 0
+        "note": "snapshot is a device->host copy plus spec build; restore is "
+        "validate-then-install; the ignore guard fuses into the step and "
+        "adds no retrace",
+    }
+
+
 def kernel_vs_reference():
     """Opt-in head-to-head of our jitted kernels vs the installed torch
     reference (stat_scores / confusion_matrix / PSNR).  Skips cleanly —
@@ -734,6 +798,10 @@ def main():
         kernel_ref = kernel_vs_reference()
     except Exception as err:  # noqa: BLE001
         kernel_ref = {"error": f"kernel_vs_reference leg failed: {err}"}
+    try:
+        resilience = resilience_leg()
+    except Exception as err:  # noqa: BLE001
+        resilience = {"error": f"resilience leg failed: {err}"}
 
     print(json.dumps({
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
@@ -759,6 +827,7 @@ def main():
             "measured_sync_us_8dev_mesh": ragged_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
+            "resilience": resilience,
             "state_reduce_bytes_1_to_64_chips": state_reduce_bytes_table(),
             "model": f"ResNet-50 ({n_params / 1e6:.1f}M params, bf16)",
             "batch": BATCH, "image": IMG, "num_classes": NUM_CLASSES,

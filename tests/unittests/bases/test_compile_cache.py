@@ -563,3 +563,52 @@ def test_is_jit_compatible():
     assert is_jit_compatible((jnp.ones(3), np.ones(3), 1, 2.0, True))
     assert not is_jit_compatible(("text",))
     assert not is_jit_compatible(({"k": object()},))
+
+
+# ------------------------------------------------- nan_strategy guard fusion
+def test_fused_guard_strategies_add_zero_cache_entries():
+    """The ignore/zero masks fuse into the compiled update: for a fixed input
+    geometry, N repeat steps stay at one cache entry and one trace — the
+    guard costs no extra compilation whatsoever."""
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    preds = jnp.asarray([1.0, 2.0, 3.0])
+    target = jnp.asarray([1.0, 2.5, 3.0])
+    for strategy in ("ignore", "zero"):
+        clear_compile_cache()
+        m = MeanSquaredError(nan_strategy=strategy, jit=True)
+        for _ in range(6):
+            m.update(preds, target)
+        stats = cache_stats()
+        assert cache_size() == 1, strategy
+        assert stats["misses"] == 1 and stats["traces"] == 1, strategy
+        assert stats["hits"] == 5, strategy
+
+
+def test_guard_strategy_is_part_of_cache_key():
+    """Different strategies compile different graphs — they must not collide
+    on one cache entry."""
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    a = MeanSquaredError(nan_strategy="propagate", jit=True)
+    b = MeanSquaredError(nan_strategy="zero", jit=True)
+    assert config_fingerprint(a) != config_fingerprint(b)
+    preds = jnp.asarray([1.0, 2.0])
+    a.update(preds, preds)
+    b.update(preds, preds)
+    stats = cache_stats()
+    assert stats["misses"] == 2 and cache_size() == 2
+
+
+def test_deferred_error_strategy_traces_once():
+    """warn/error add a reserved counter leaf but the host-side check is
+    deferred — the compiled step itself still traces exactly once."""
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    m = MeanSquaredError(nan_strategy="error", jit=True)
+    preds = jnp.asarray([1.0, 2.0, 3.0])
+    for _ in range(4):
+        m.update(preds, preds)
+    stats = cache_stats()
+    assert stats["traces"] == 1 and cache_size() == 1
+    assert m.nonfinite_count == 0  # clean data: the guard never fired

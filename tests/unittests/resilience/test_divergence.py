@@ -1,0 +1,128 @@
+"""Cross-replica divergence detection on the 8-device CPU mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.parallel import sharded_update, sync_ragged_states
+from torchmetrics_tpu.resilience import (
+    ReplicaDivergenceError,
+    perturb_replica,
+    replica_digest_table,
+    verify_replica_consistency,
+)
+
+PROBS = jnp.asarray([0.9, 0.2, 0.8, 0.4, 0.7, 0.1, 0.6, 0.3])
+TARGET = jnp.asarray([1, 0, 1, 0, 0, 0, 1, 1])
+
+
+def _replica_states(n=NUM_DEVICES):
+    m = MeanMetric()
+    st = m.update_state(m.init_state(), jnp.asarray([1.0, 2.0, 3.0]))
+    return m, [dict(st) for _ in range(n)]
+
+
+def test_digest_table_shape_and_agreement():
+    _, states = _replica_states()
+    table = replica_digest_table(states)
+    assert table.shape == (NUM_DEVICES, len(states[0]))
+    assert (table == table[0]).all()
+
+
+def test_consistent_replicas_pass(mesh):
+    m, states = _replica_states()
+    verify_replica_consistency(m, mesh=mesh, states=states)  # no raise
+
+
+@pytest.mark.faultinject
+def test_perturbed_replica_caught_on_mesh(mesh):
+    m, states = _replica_states()
+    bad = perturb_replica(states, replica=5)
+    with pytest.raises(ReplicaDivergenceError) as ei:
+        verify_replica_consistency(m, mesh=mesh, states=bad)
+    assert ei.value.replicas == (5,)
+    assert "mean_value" in ei.value.leaves
+
+
+@pytest.mark.faultinject
+def test_perturbed_named_leaf_and_host_fallback():
+    # replica count != mesh size -> host-side compare path
+    m, states = _replica_states(n=3)
+    bad = perturb_replica(states, replica=1, leaf="weight", delta=0.5)
+    with pytest.raises(ReplicaDivergenceError) as ei:
+        verify_replica_consistency(m, states=bad)
+    assert ei.value.leaves == ("weight",)
+    assert ei.value.replicas == (1,)
+
+
+def test_structure_mismatch_is_divergence():
+    m, states = _replica_states()
+    del states[2]["weight"]
+    with pytest.raises(ReplicaDivergenceError) as ei:
+        verify_replica_consistency(m, states=states)
+    assert "weight" in ei.value.leaves
+
+
+def test_single_replica_trivially_consistent():
+    m, states = _replica_states(n=1)
+    verify_replica_consistency(m, states=states)  # nothing to compare
+
+
+def test_requires_mesh_or_states():
+    m = MeanMetric()
+    with pytest.raises(ValueError, match="mesh"):
+        verify_replica_consistency(m)
+
+
+def test_sharded_update_verify_hook_passes(mesh):
+    metric = BinaryAccuracy(validate_args=False)
+    state = sharded_update(metric, PROBS, TARGET, mesh=mesh, verify_consistency=True)
+    assert round(float(metric.compute_state(state)), 4) == 0.75
+
+
+def test_replicated_metric_state_verifies_on_mesh(mesh):
+    # the replicated post-sync state lands on every device; the default
+    # (states=None) mode digests each device's copy
+    metric = BinaryAccuracy(validate_args=False)
+    state = sharded_update(metric, PROBS, TARGET, mesh=mesh)
+    verify_replica_consistency(metric, mesh=mesh, state=state)
+
+
+@pytest.mark.faultinject
+def test_ragged_sync_catches_update_count_drift(mesh):
+    # per-device partial states legitimately differ in *content*, but every
+    # device must have seen the same number of steps — a device that lost a
+    # step to preemption is caught before the gather
+    n_dev = int(mesh.devices.size)
+    states = [
+        {"items": (jnp.full((2,), float(d)),), "_n": jnp.asarray(1, jnp.int32)}
+        for d in range(n_dev)
+    ]
+    merged = sync_ragged_states({"items": Reduce.CAT}, states, mesh, verify_consistency=True)
+    assert len(merged["items"]) == n_dev
+
+    states[3] = dict(states[3], _n=jnp.asarray(2, jnp.int32))  # a duplicated step
+    with pytest.raises(ReplicaDivergenceError) as ei:
+        sync_ragged_states({"items": Reduce.CAT}, states, mesh, verify_consistency=True)
+    assert ei.value.leaves == ("_n",)
+    assert ei.value.replicas == (3,)
+
+
+def test_nonfinite_counter_rides_ragged_scalar_path(mesh):
+    # the reserved _nonfinite counter has no reduction-table entry; it must
+    # ride the scalar SUM path instead of raising "no entry"
+    n_dev = int(mesh.devices.size)
+    states = [
+        {
+            "items": (jnp.full((1,), float(d)),),
+            "_n": jnp.asarray(1, jnp.int32),
+            "_nonfinite": jnp.asarray(1, jnp.int32),
+        }
+        for d in range(n_dev)
+    ]
+    merged = sync_ragged_states({"items": Reduce.CAT}, states, mesh)
+    assert int(merged["_nonfinite"]) == n_dev

@@ -1,0 +1,202 @@
+"""Deterministic fault injection: kill-and-restore must be bitwise-identical
+to the uninterrupted run across three metric families (classification,
+aggregation, ragged/detection); corrupted snapshots must fail loudly by
+leaf name; a perturbed replica must be caught on the 8-device mesh."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.resilience import (
+    CORRUPTION_MODES,
+    ReplicaDivergenceError,
+    StateRestoreError,
+    corrupt_snapshot,
+    perturb_replica,
+    restore,
+    run_with_preemption,
+    snapshot,
+    verify_replica_consistency,
+)
+
+pytestmark = pytest.mark.faultinject
+
+
+def _bitwise_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert a.tobytes() == b.tobytes()
+
+
+def _assert_same_compute(revived, reference):
+    got, ref = revived.compute(), reference.compute()
+    if isinstance(ref, dict):
+        assert set(got) == set(ref)
+        for key in ref:
+            _bitwise_equal(got[key], ref[key])
+    else:
+        _bitwise_equal(got, ref)
+
+
+def _uninterrupted(make_metric, batches):
+    m = make_metric()
+    for batch in batches:
+        m.update(*batch)
+    return m
+
+
+# ------------------------------------------------- family: classification
+CLS_BATCHES = [
+    (jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2])),
+    (jnp.asarray([2, 2, 0, 1]), jnp.asarray([2, 1, 0, 1])),
+    (jnp.asarray([1, 0, 1, 2]), jnp.asarray([1, 0, 2, 2])),
+    (jnp.asarray([0, 0, 2, 1]), jnp.asarray([0, 1, 2, 1])),
+]
+
+
+@pytest.mark.parametrize("kill_at", [0, 1, 2, 4])
+def test_classification_kill_and_restore_bitwise(kill_at):
+    make = lambda: MulticlassConfusionMatrix(num_classes=3)
+    revived = run_with_preemption(make, CLS_BATCHES, kill_at=kill_at)
+    _assert_same_compute(revived, _uninterrupted(make, CLS_BATCHES))
+
+
+def test_classification_kill_and_restore_compiled():
+    # the revived instance resumes on the *compiled, donated* update path
+    make = lambda: BinaryAccuracy(jit=True)
+    batches = [
+        (jnp.asarray([0.9, 0.2, 0.7]), jnp.asarray([1, 0, 0])),
+        (jnp.asarray([0.4, 0.8, 0.1]), jnp.asarray([0, 1, 0])),
+        (jnp.asarray([0.6, 0.3, 0.9]), jnp.asarray([1, 1, 1])),
+    ]
+    revived = run_with_preemption(make, batches, kill_at=2)
+    _assert_same_compute(revived, _uninterrupted(make, batches))
+
+
+# ---------------------------------------------------- family: aggregation
+AGG_BATCHES = [
+    (jnp.asarray([1.5, 2.5]),),
+    (jnp.asarray([-0.25]),),
+    (jnp.asarray([4.0, 0.125, 3.0]),),
+]
+
+
+@pytest.mark.parametrize("kill_at", [0, 1, 3])
+def test_aggregation_kill_and_restore_bitwise(kill_at):
+    make = lambda: MeanMetric()
+    revived = run_with_preemption(make, AGG_BATCHES, kill_at=kill_at)
+    _assert_same_compute(revived, _uninterrupted(make, AGG_BATCHES))
+
+
+@pytest.mark.parametrize("kill_at", [1, 2])
+def test_aggregation_list_state_kill_and_restore_bitwise(kill_at):
+    # CatMetric accumulates a growable list state — the snapshot must carry
+    # every appended chunk, in order
+    make = lambda: CatMetric()
+    revived = run_with_preemption(make, AGG_BATCHES, kill_at=kill_at)
+    _assert_same_compute(revived, _uninterrupted(make, AGG_BATCHES))
+
+
+# ----------------------------------------------- family: ragged/detection
+def _det_batch(shift):
+    box = jnp.asarray([[10.0 + shift, 10.0, 60.0, 60.0], [5.0, 5.0 + shift, 25.0, 30.0]])
+    preds = [{"boxes": box, "scores": jnp.asarray([0.9, 0.4]), "labels": jnp.asarray([0, 1])}]
+    target = [{"boxes": box + 1.0, "labels": jnp.asarray([0, 1])}]
+    return (preds, target)
+
+
+DET_BATCHES = [_det_batch(0.0), _det_batch(3.0), _det_batch(7.0)]
+
+
+@pytest.mark.parametrize("kill_at", [1, 2])
+def test_detection_kill_and_restore_bitwise(kill_at):
+    make = lambda: MeanAveragePrecision(iou_thresholds=[0.5, 0.75])
+    revived = run_with_preemption(make, DET_BATCHES, kill_at=kill_at)
+    _assert_same_compute(revived, _uninterrupted(make, DET_BATCHES))
+
+
+# -------------------------------------------------- corrupted checkpoints
+_EXPECTED_REASON = {
+    "truncate": "corrupt",
+    "shape": "shape",
+    "dtype": "dtype",
+    "missing_leaf": "missing-leaf",
+    "extra_leaf": "unknown-leaf",
+    "class": "class",
+    "version": "schema-version",
+}
+
+
+@pytest.mark.parametrize("mode", CORRUPTION_MODES)
+def test_every_corruption_mode_raises_named_error(mode):
+    m = MulticlassConfusionMatrix(num_classes=3)
+    m.update(*CLS_BATCHES[0])
+    bad = corrupt_snapshot(snapshot(m), mode)
+    fresh = MulticlassConfusionMatrix(num_classes=3)
+    with pytest.raises(StateRestoreError) as ei:
+        restore(fresh, bad)
+    assert ei.value.reason == _EXPECTED_REASON[mode]
+    if mode in ("truncate", "shape", "dtype", "missing_leaf"):
+        assert ei.value.leaf == "confmat"
+    elif mode == "extra_leaf":
+        assert ei.value.leaf == "bogus_leaf"
+    # the failed restore never touched the target
+    assert fresh.update_count == 0
+
+
+@pytest.mark.parametrize("mode", ["shape", "missing_leaf", "class"])
+def test_collection_member_corruption_raises(mode):
+    col = MetricCollection(
+        {
+            "confmat": MulticlassConfusionMatrix(num_classes=3),
+            "f1": MulticlassF1Score(num_classes=3, average="macro"),
+        }
+    )
+    col.update(*CLS_BATCHES[0])
+    bad = corrupt_snapshot(snapshot(col), mode, member="confmat")
+    col2 = MetricCollection(
+        {
+            "confmat": MulticlassConfusionMatrix(num_classes=3),
+            "f1": MulticlassF1Score(num_classes=3, average="macro"),
+        }
+    )
+    with pytest.raises(StateRestoreError) as ei:
+        restore(col2, bad)
+    assert ei.value.reason == _EXPECTED_REASON[mode]
+    # validation is two-phase: no member state was installed
+    for member in col2.values():
+        assert member.update_count == 0
+
+
+def test_detection_list_leaf_truncation_detected():
+    m = MeanAveragePrecision(iou_thresholds=[0.5])
+    for batch in DET_BATCHES:
+        m.update(*batch)
+    snap = snapshot(m)
+    snap["state"]["detection_scores"] = snap["state"]["detection_scores"][:-1]
+    with pytest.raises(StateRestoreError) as ei:
+        restore(MeanAveragePrecision(iou_thresholds=[0.5]), snap)
+    assert ei.value.leaf == "detection_scores"
+    assert ei.value.reason == "corrupt"
+
+
+# --------------------------------------------------- replica perturbation
+def test_perturbed_replica_caught_on_8_device_mesh(mesh):
+    m = BinaryAccuracy(validate_args=False)
+    st = m.update_state(m.init_state(), jnp.asarray([0.9, 0.2, 0.7]), jnp.asarray([1, 0, 1]))
+    states = [dict(st) for _ in range(int(mesh.devices.size))]
+    verify_replica_consistency(m, mesh=mesh, states=states)  # sanity: clean passes
+
+    bad = perturb_replica(states, replica=6)
+    with pytest.raises(ReplicaDivergenceError) as ei:
+        verify_replica_consistency(m, mesh=mesh, states=bad)
+    assert ei.value.replicas == (6,)
+    assert len(ei.value.leaves) >= 1

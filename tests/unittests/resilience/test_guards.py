@@ -1,0 +1,178 @@
+"""Jit-fused non-finite guards: ``Metric(nan_strategy=...)`` semantics on
+the eager and compiled paths, the deferred warn/error counter, and the
+digest helpers (core/guards.py)."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.core.guards import (
+    GUARD_STRATEGIES,
+    count_nonfinite,
+    guard_state,
+    leaf_digest,
+    state_digest,
+)
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.resilience import NonFiniteStateError
+
+NAN_PREDS = jnp.asarray([1.0, float("nan"), 3.0])
+TARGET = jnp.asarray([1.0, 2.0, 3.0])
+
+
+# ------------------------------------------------------------ pure helpers
+def test_count_nonfinite_counts_float_leaves_only():
+    state = {
+        "a": jnp.asarray([1.0, jnp.nan, jnp.inf]),
+        "b": jnp.asarray([1, 2, 3]),  # int leaf: never counted
+        "_n": jnp.asarray(5, jnp.int32),
+        "items": (jnp.asarray([jnp.nan]), jnp.asarray([1.0])),
+    }
+    assert int(count_nonfinite(state)) == 3
+
+
+def test_guard_state_zero_masks_everything():
+    old = {"a": jnp.asarray([1.0, 2.0]), "_n": jnp.asarray(1, jnp.int32)}
+    new = {"a": jnp.asarray([jnp.nan, 5.0]), "_n": jnp.asarray(2, jnp.int32)}
+    out = guard_state("zero", old, new)
+    np.testing.assert_array_equal(np.asarray(out["a"]), [0.0, 5.0])
+    assert int(out["_n"]) == 2  # reserved leaves untouched
+
+
+def test_guard_state_ignore_falls_back_to_old_value():
+    old = {"a": jnp.asarray([1.0, 2.0]), "_n": jnp.asarray(1, jnp.int32)}
+    new = {"a": jnp.asarray([jnp.nan, 5.0]), "_n": jnp.asarray(2, jnp.int32)}
+    out = guard_state("ignore", old, new)
+    np.testing.assert_array_equal(np.asarray(out["a"]), [1.0, 5.0])
+
+
+def test_guard_state_is_jittable():
+    def step(old, new):
+        return guard_state("ignore", old, new)
+
+    old = {"a": jnp.asarray([1.0, 2.0])}
+    new = {"a": jnp.asarray([jnp.nan, 5.0])}
+    out = jax.jit(step)(old, new)
+    np.testing.assert_array_equal(np.asarray(out["a"]), [1.0, 5.0])
+
+
+def test_leaf_digest_is_order_sensitive():
+    a = jnp.asarray([1.0, 2.0, 3.0])
+    b = jnp.asarray([3.0, 2.0, 1.0])
+    assert int(leaf_digest(a)) != int(leaf_digest(b))
+    assert int(leaf_digest(a)) == int(leaf_digest(jnp.asarray([1.0, 2.0, 3.0])))
+
+
+def test_state_digest_distinguishes_leaves():
+    d = state_digest({"x": jnp.asarray([1.0]), "y": jnp.asarray([2.0]), "_n": jnp.asarray(1)})
+    assert set(d) == {"_n", "x", "y"}
+    assert int(d["x"]) != int(d["y"])
+
+
+# ------------------------------------------------------------- strategies
+def test_invalid_strategy_rejected():
+    with pytest.raises(ValueError, match="nan_strategy"):
+        MeanSquaredError(nan_strategy="explode")
+    assert set(GUARD_STRATEGIES) == {"propagate", "ignore", "zero", "warn", "error"}
+
+
+def test_propagate_lets_nan_through():
+    m = MeanSquaredError()
+    m.update(NAN_PREDS, TARGET)
+    assert not np.isfinite(float(m.compute()))
+
+
+@pytest.mark.parametrize("use_jit", [False, True])
+def test_ignore_skips_poisoned_update_elementwise(use_jit):
+    m = MeanSquaredError(nan_strategy="ignore", jit=use_jit)
+    m.update(NAN_PREDS, TARGET)  # sum of squares poisoned -> falls back to 0
+    m.update(jnp.asarray([2.0]), jnp.asarray([0.0]))
+    assert np.isfinite(float(m.compute()))
+
+
+@pytest.mark.parametrize("use_jit", [False, True])
+def test_zero_masks_nonfinite(use_jit):
+    m = MeanSquaredError(nan_strategy="zero", jit=use_jit)
+    m.update(NAN_PREDS, TARGET)
+    assert float(m.compute()) == 0.0
+
+
+def test_error_raises_eagerly():
+    m = MeanSquaredError(nan_strategy="error")
+    with pytest.raises(NonFiniteStateError) as ei:
+        m.update(NAN_PREDS, TARGET)
+    assert ei.value.count >= 1
+
+
+def test_error_defers_to_compute_under_jit():
+    m = MeanSquaredError(nan_strategy="error", jit=True)
+    m.update(NAN_PREDS, TARGET)  # jit path: no host readback per step
+    with pytest.raises(NonFiniteStateError):
+        m.compute()
+    assert m.nonfinite_count >= 1
+
+
+def test_warn_once_per_count():
+    m = MeanSquaredError(nan_strategy="warn")
+    with pytest.warns(UserWarning, match="non-finite"):
+        m.update(NAN_PREDS, TARGET)
+    # unchanged count: no duplicate warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        m.update(jnp.asarray([1.0]), jnp.asarray([1.0]))
+
+
+def test_reset_clears_counter_and_unpoisons():
+    m = MeanSquaredError(nan_strategy="error", jit=True)
+    m.update(NAN_PREDS, TARGET)
+    m.reset()
+    m.update(jnp.asarray([1.0]), jnp.asarray([2.0]))
+    assert float(m.compute()) == 1.0
+    assert m.nonfinite_count == 0
+
+
+def test_counter_survives_merge_and_forward():
+    m = MeanSquaredError(nan_strategy="warn")
+    m.forward(NAN_PREDS, TARGET)  # forward merges batch state into global
+    assert m.nonfinite_count >= 1  # merge_states refreshed the counter
+    with pytest.warns(UserWarning, match="non-finite"):
+        m.compute()  # the deferred host-side check fires here
+
+
+def test_guard_traces_into_compiled_forward():
+    m = MeanSquaredError(nan_strategy="zero", jit=True)
+    batch_val = m.forward(NAN_PREDS, TARGET)
+    assert float(batch_val) == 0.0
+    assert float(m.compute()) == 0.0
+
+
+# ------------------------------------------------------- aggregator opt-out
+def test_aggregators_keep_their_own_nan_vocabulary():
+    m = MeanMetric(nan_strategy="ignore")  # aggregator vocabulary, not the base one
+    assert m._guard_strategy == "propagate"
+    m.update(jnp.asarray([1.0, jnp.nan, 3.0]))
+    assert float(m.compute()) == 2.0
+    with pytest.raises(ValueError):
+        SumMetric(nan_strategy="not-a-strategy")
+
+
+def test_nonreserved_metrics_validate_against_base_vocabulary():
+    m = BinaryAccuracy(nan_strategy="ignore")
+    assert m._guard_strategy == "ignore"
+
+
+def test_snapshot_roundtrip_preserves_counter():
+    from torchmetrics_tpu.resilience import restore, snapshot
+
+    m = MeanSquaredError(nan_strategy="warn")
+    with pytest.warns(UserWarning):
+        m.update(NAN_PREDS, TARGET)
+    count = m.nonfinite_count
+    m2 = MeanSquaredError(nan_strategy="warn")
+    restore(m2, snapshot(m))
+    assert m2.nonfinite_count == count

@@ -1,0 +1,302 @@
+"""Snapshot/restore: the versioned checkpoint format and validate-before-
+install restore (resilience/snapshot.py), plus the rewired
+``load_state_dict`` / ``load_state_pytree`` core paths."""
+
+import pickle
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.resilience import (
+    SCHEMA_VERSION,
+    StateRestoreError,
+    class_fingerprint,
+    restore,
+    snapshot,
+)
+
+PREDS = jnp.asarray([0, 1, 2, 1, 0, 2])
+TARGET = jnp.asarray([0, 1, 2, 2, 0, 1])
+
+
+def _fresh_pair():
+    a = MulticlassConfusionMatrix(num_classes=3)
+    b = MulticlassConfusionMatrix(num_classes=3)
+    a.update(PREDS, TARGET)
+    return a, b
+
+
+# ----------------------------------------------------------------- format
+def test_snapshot_is_versioned_and_self_describing():
+    m, _ = _fresh_pair()
+    snap = snapshot(m)
+    assert snap["schema_version"] == SCHEMA_VERSION
+    assert snap["kind"] == "metric"
+    assert snap["class"] == class_fingerprint(m)
+    assert set(snap["spec"]) == set(snap["state"])
+    entry = snap["spec"]["confmat"]
+    assert entry["kind"] == "array"
+    assert entry["shape"] == [3, 3]
+
+
+def test_snapshot_payload_is_host_numpy_and_picklable():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    snap = snapshot(m)
+    for leaf in snap["state"].values():
+        items = leaf if isinstance(leaf, list) else [leaf]
+        assert all(isinstance(x, np.ndarray) for x in items)
+    blob = pickle.dumps(snap)
+    restored = pickle.loads(blob)
+    m2 = CatMetric()
+    restore(m2, restored)
+    np.testing.assert_array_equal(np.asarray(m2.compute()), np.asarray(m.compute()))
+
+
+def test_roundtrip_bitwise_identical():
+    m, m2 = _fresh_pair()
+    restore(m2, snapshot(m))
+    assert np.asarray(m.compute()).tobytes() == np.asarray(m2.compute()).tobytes()
+    assert m2.update_count == m.update_count
+
+
+def test_restore_marks_buffers_fresh_for_donation():
+    m, m2 = _fresh_pair()
+    m2._state_shared = True  # pretend it was a compute-group member
+    restore(m2, snapshot(m))
+    assert m2._state_shared is False
+    assert m2._computed is None
+
+
+def test_restored_metric_survives_compiled_update():
+    m = BinaryAccuracy(jit=True)
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    m2 = BinaryAccuracy(jit=True)
+    restore(m2, snapshot(m))
+    # the restored (donatable) buffers go straight through a donated jit step
+    m2.update(jnp.asarray([0.7, 0.3]), jnp.asarray([1, 1]))
+    m.update(jnp.asarray([0.7, 0.3]), jnp.asarray([1, 1]))
+    assert float(m2.compute()) == float(m.compute())
+
+
+# ----------------------------------------------------- validation failures
+def test_schema_version_mismatch():
+    m, m2 = _fresh_pair()
+    snap = snapshot(m)
+    snap["schema_version"] = SCHEMA_VERSION + 1
+    with pytest.raises(StateRestoreError) as ei:
+        restore(m2, snap)
+    assert ei.value.reason == "schema-version"
+
+
+def test_class_fingerprint_mismatch_and_override():
+    m = MulticlassAccuracy(num_classes=3, average="micro")
+    m.update(PREDS, TARGET)
+    snap = snapshot(m)
+    other = MulticlassF1Score(num_classes=3, average="micro")
+    with pytest.raises(StateRestoreError) as ei:
+        restore(other, snap)
+    assert ei.value.reason == "class"
+    # same state layout: explicit opt-out installs it
+    restore(other, snap, strict_class=False)
+    assert other.update_count == m.update_count
+
+
+def test_shape_mismatch_names_leaf():
+    m = MulticlassConfusionMatrix(num_classes=3)
+    m.update(PREDS, TARGET)
+    wrong = MulticlassConfusionMatrix(num_classes=4)
+    with pytest.raises(StateRestoreError) as ei:
+        restore(wrong, snapshot(m), strict_class=False)
+    assert ei.value.reason == "shape"
+    assert ei.value.leaf == "confmat"
+
+
+def test_failed_restore_leaves_target_untouched():
+    m, m2 = _fresh_pair()
+    m2.update(TARGET, TARGET)
+    before = np.asarray(m2._state["confmat"]).copy()
+    snap = snapshot(m)
+    snap["state"]["confmat"] = snap["state"]["confmat"].astype(np.float64)
+    snap["spec"]["confmat"]["dtype"] = "float64"
+    with pytest.raises(StateRestoreError) as ei:
+        restore(m2, snap)
+    assert ei.value.reason == "dtype"
+    np.testing.assert_array_equal(np.asarray(m2._state["confmat"]), before)
+
+
+def test_restore_rejects_non_metric():
+    with pytest.raises(TypeError):
+        snapshot(object())
+    with pytest.raises(TypeError):
+        restore(object(), {"schema_version": SCHEMA_VERSION})
+
+
+# ------------------------------------------------------- load_state_pytree
+def test_load_state_pytree_validates_before_install():
+    m, m2 = _fresh_pair()
+    good = m.state_pytree()
+    bad = dict(good)
+    bad["confmat"] = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(StateRestoreError) as ei:
+        m2.load_state_pytree(bad)
+    assert ei.value.leaf == "confmat"
+    assert ei.value.reason == "shape"
+    m2.load_state_pytree(good)
+    assert np.asarray(m2.compute()).tobytes() == np.asarray(m.compute()).tobytes()
+
+
+def test_load_state_pytree_unknown_and_missing_leaves():
+    m, m2 = _fresh_pair()
+    state = dict(m.state_pytree())
+    state["extra"] = jnp.zeros(())
+    with pytest.raises(StateRestoreError) as ei:
+        m2.load_state_pytree(state)
+    assert ei.value.reason == "unknown-leaf"
+    assert ei.value.leaf == "extra"
+    with pytest.raises(StateRestoreError) as ei:
+        m2.load_state_pytree({"_n": jnp.zeros((), jnp.int32)})
+    assert ei.value.reason == "missing-leaf"
+
+
+# --------------------------------------------------------- load_state_dict
+def test_load_state_dict_roundtrip_after_reset_on_donated_state():
+    # donated compiled updates consumed the original buffers; reset hands out
+    # fresh ones and the persisted leaves must still land cleanly
+    m = MeanSquaredError(jit=True)
+    m.persistent(True)
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 3.0]))
+    m.update(jnp.asarray([2.0, 2.0]), jnp.asarray([0.0, 2.0]))
+    saved = m.state_dict()
+    expected = float(m.compute())
+    m.reset()
+    m.load_state_dict(saved)
+    assert float(m.compute_state(m._state)) == expected
+
+
+def test_load_state_dict_warns_on_unknown_keys():
+    m = MeanMetric()
+    m.persistent(True)
+    sd = m.state_dict()
+    sd["not_a_state"] = np.zeros(())
+    with pytest.warns(UserWarning, match="unknown key"):
+        m.load_state_dict(sd)
+
+
+def test_load_state_dict_warns_on_missing_expected_keys():
+    m = MeanMetric()
+    m.persistent(True)
+    with pytest.warns(UserWarning, match="missing"):
+        m.load_state_dict({})
+
+
+def test_load_state_dict_validates_shape():
+    m = MulticlassConfusionMatrix(num_classes=3)
+    with pytest.raises(StateRestoreError) as ei:
+        m.load_state_dict({"confmat": np.zeros((2, 2), np.int32)})
+    assert ei.value.leaf == "confmat"
+
+
+# ------------------------------------------------------------- collections
+def _collection():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=3, average="micro"),
+            "f1": MulticlassF1Score(num_classes=3, average="macro"),
+            "confmat": MulticlassConfusionMatrix(num_classes=3),
+        }
+    )
+
+
+def test_collection_snapshot_restores_groups_and_aliasing():
+    col = _collection()
+    col.update(PREDS, TARGET)  # forms compute groups (acc/f1 share state)
+    snap = snapshot(col)
+    assert snap["kind"] == "collection"
+    assert snap["groups"] is not None
+
+    col2 = _collection()
+    restore(col2, snap)
+    assert col2["acc"]._state is col2["f1"]._state  # one pytree per group
+    assert col2["acc"]._state_shared and col2["f1"]._state_shared
+    ref, got = col.compute(), col2.compute()
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(got[k]))
+
+
+def test_collection_restore_validates_members():
+    col = _collection()
+    col.update(PREDS, TARGET)
+    snap = snapshot(col)
+    del snap["metrics"]["f1"]
+    with pytest.raises(StateRestoreError) as ei:
+        restore(_collection(), snap)
+    assert ei.value.reason == "missing-leaf"
+    assert ei.value.leaf == "f1"
+
+
+def test_collection_load_state_dict_preserves_group_aliasing():
+    col = _collection()
+    col.persistent(True)
+    col.update(PREDS, TARGET)
+    saved = col.state_dict()
+    expected = col.compute()
+
+    col2 = _collection()
+    col2.persistent(True)
+    col2.update(PREDS, TARGET)  # form groups, then restore over them
+    col2.load_state_dict(saved)
+    assert col2["acc"]._state is col2["f1"]._state
+    assert col2["acc"]._state_shared
+    got = col2.compute()
+    for k in expected:
+        np.testing.assert_array_equal(np.asarray(expected[k]), np.asarray(got[k]))
+
+
+def test_collection_load_state_pytree_preserves_group_aliasing():
+    col = _collection()
+    col.update(PREDS, TARGET)
+    tree = col.state_pytree()
+    col2 = _collection()
+    col2.update(PREDS, TARGET)
+    col2.load_state_pytree(tree)
+    assert col2["acc"]._state is col2["f1"]._state
+    got = col2.compute()
+    ref = col.compute()
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(ref[k]), np.asarray(got[k]))
+
+
+# ------------------------------------------------------------------ pickle
+def test_pickle_unpickle_then_compiled_update():
+    m = MulticlassAccuracy(num_classes=3, average="micro", jit=True)
+    m.update(PREDS, TARGET)
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone._state_shared is False
+    clone.update(PREDS, TARGET)  # donated compiled step on rebuilt buffers
+    m.update(PREDS, TARGET)
+    assert float(clone.compute()) == float(m.compute())
+
+
+def test_unpickled_old_metric_defaults_nan_strategy():
+    m = BinaryAccuracy()
+    state = m.__getstate__()
+    state.pop("nan_strategy", None)  # a pickle from before the guard existed
+    state.pop("_nf_reported", None)
+    revived = BinaryAccuracy.__new__(BinaryAccuracy)
+    revived.__setstate__(state)
+    assert revived.nan_strategy == "propagate"
+    revived.update(jnp.asarray([0.9]), jnp.asarray([1]))
+    assert float(revived.compute()) == 1.0

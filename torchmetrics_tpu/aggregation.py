@@ -32,6 +32,10 @@ class BaseAggregator(Metric):
     is_differentiable = None
     higher_is_better = None
     full_state_update = False
+    # aggregators implement their own input-level NaN vocabulary
+    # (error/warn/ignore/disable/float-impute) — opt out of the base
+    # Metric's state-level guard so the two never double-apply
+    __handles_nan_strategy__ = True
 
     def __init__(
         self,

@@ -125,6 +125,11 @@ class MetricCollection(dict):
             return False
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False
+        if metric1._guard_strategy != metric2._guard_strategy:
+            # equal states today can diverge on the first non-finite batch if
+            # the guards differ (and warn/error states carry an extra
+            # reserved counter leaf) — never merge across strategies
+            return False
         for key in metric1._defaults:
             s1, s2 = metric1._state[key], metric2._state[key]
             if isinstance(s1, tuple) and isinstance(s2, tuple):
@@ -343,9 +348,36 @@ class MetricCollection(dict):
         return {k: m.state_pytree() for k, m in self.items(keep_base=True)}
 
     def load_state_pytree(self, states: Dict[str, Any]) -> None:
+        """Install per-metric state pytrees (each validated by
+        ``Metric.load_state_pytree``) and re-establish compute-group state
+        aliasing afterwards."""
         for k, m in self.items(keep_base=True):
             if k in states:
                 m.load_state_pytree(states[k])
+        self._realias_groups()
+
+    def _realias_groups(self) -> None:
+        """Re-point every compute-group member at its leader's state pytree.
+
+        A per-metric restore (``load_state_dict`` / ``load_state_pytree``)
+        installs fresh, unshared buffers per member, silently dissolving the
+        one-pytree-per-group invariant the update fast path relies on.  Once
+        groups are formed, members must hold identical state anyway — so
+        after a restore the leader's pytree is authoritative and members
+        re-alias it (and are re-marked shared, keeping the compiled paths'
+        no-donate-aliased-state contract).
+        """
+        if not self._groups_checked:
+            return
+        for members in self._groups.values():
+            if len(members) <= 1:
+                continue
+            leader_state = self[members[0]]._state
+            for name in members[1:]:
+                member = self[name]
+                member._state = leader_state
+                member._computed = None
+            self._mark_shared(members)
 
     # -------------------------------------------------------------- dict api
     def keys(self, keep_base: bool = False):  # type: ignore[override]
@@ -398,6 +430,7 @@ class MetricCollection(dict):
         for k, m in self.items(keep_base=True):
             if k in state_dict:
                 m.load_state_dict(state_dict[k])
+        self._realias_groups()
 
     def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False):
         from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val

@@ -73,6 +73,7 @@ __all__ = [
     "set_cache_capacity",
     "clear_compile_cache",
     "compiled_collection_update",
+    "compiled_divergence_check",
     "compiled_forward",
     "compiled_ragged_gather",
     "compiled_sharded_collection_update",
@@ -511,6 +512,34 @@ def compiled_ragged_gather(
         )
         return jax.jit(
             shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
+        )
+
+    return _lookup(key, build)
+
+
+def compiled_divergence_check(mesh: Mesh, axis_name: str, n_leaves: int) -> Callable:
+    """Compiled replica-digest compare for
+    ``resilience.verify_replica_consistency``.
+
+    Returns ``fn(digests) -> agree`` where ``digests`` is a ``(n_devices,
+    n_leaves)`` uint32 matrix of per-replica state checksums
+    (``core/guards.py``) sharded over ``axis_name``, and ``agree`` is a
+    replicated ``(n_leaves,)`` bool vector: ``pmin == pmax`` over the mesh
+    axis, true iff every replica holds the same digest for that leaf.  The
+    digests are bitcast to int32 for the collective — for *any* total order
+    min equals max iff all values are equal, so the signed compare detects
+    exactly the same divergences.
+    """
+    key = ("divergence_check", mesh, axis_name, int(n_leaves))
+
+    def build() -> Callable:
+        def check(digests):
+            mark_trace()
+            row = jax.lax.bitcast_convert_type(digests[0], jnp.int32)
+            return jax.lax.pmin(row, axis_name) == jax.lax.pmax(row, axis_name)
+
+        return jax.jit(
+            shard_map(check, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
         )
 
     return _lookup(key, build)

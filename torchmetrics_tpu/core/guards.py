@@ -1,0 +1,155 @@
+"""Jit-fused non-finite guards and cheap state checksums.
+
+Pure ``jnp`` math with no dependency on the rest of the package, so both
+``core/metric.py`` (guard application inside ``update_state``) and
+``core/compile.py`` / ``resilience/divergence.py`` (checksum graphs) can
+import it without cycles.
+
+Two tool families live here:
+
+* **Non-finite guards** (:func:`guard_state`, :func:`count_nonfinite`) — the
+  per-metric ``nan_strategy`` lowering.  ``"ignore"``/``"zero"`` are
+  expressed with ``jnp.where`` masks, so inside a compiled update they fuse
+  into the step graph with no extra trace (the strategy is part of the
+  compile-cache config fingerprint, not a runtime branch).  ``"warn"`` and
+  ``"error"`` stay jit-safe by only *counting* non-finite values into the
+  reserved ``"_nonfinite"`` state leaf; the raise/warn happens in a deferred
+  host-side check (``Metric._check_nonfinite``).
+
+* **State checksums** (:func:`leaf_digest`, :func:`state_digest`) — cheap
+  order-sensitive uint32 digests of state leaves, used by the cross-replica
+  divergence detector: replicas that must hold identical state compare
+  digests with ``pmin``/``pmax`` over the mesh axis instead of shipping the
+  full state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+State = Dict[str, Any]
+
+_N = "_n"
+_NONFINITE = "_nonfinite"
+RESERVED_STATE_KEYS: Tuple[str, ...] = (_N, _NONFINITE)
+
+#: strategies accepted by ``Metric(nan_strategy=...)``
+GUARD_STRATEGIES: Tuple[str, ...] = ("propagate", "ignore", "zero", "warn", "error")
+
+
+def _is_float_leaf(x: Any) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and (
+        jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+    )
+
+
+def _guard_array(strategy: str, old: Optional[Any], new: Any) -> Any:
+    """Mask non-finite entries of one float array leaf (pure, jittable)."""
+    if not _is_float_leaf(new):
+        return new
+    finite = jnp.isfinite(new)
+    if strategy == "ignore" and old is not None and getattr(old, "shape", None) == new.shape:
+        # elementwise fallback to the pre-update value: the poisoned batch's
+        # contribution to that element is dropped, previous accumulation kept
+        return jnp.where(finite, new, old)
+    return jnp.where(finite, new, jnp.zeros_like(new))
+
+
+def count_nonfinite(state: State) -> Any:
+    """Total count of non-finite values across the float leaves of a state.
+
+    Pure and jittable; integer/bool leaves and reserved bookkeeping leaves
+    contribute nothing.  Returns an int32 scalar.
+    """
+    total: Any = jnp.zeros((), jnp.int32)
+    for name, leaf in state.items():
+        if name in RESERVED_STATE_KEYS:
+            continue
+        for item in leaf if isinstance(leaf, tuple) else (leaf,):
+            if _is_float_leaf(item):
+                total = total + jnp.sum(~jnp.isfinite(item), dtype=jnp.int32)
+    return total
+
+
+def guard_state(strategy: str, old_state: State, new_state: State) -> State:
+    """Apply one ``nan_strategy`` to a freshly updated state (pure, jittable).
+
+    ``"ignore"``: non-finite elements of fixed-shape float leaves fall back
+    to their pre-update value (the bad batch is skipped elementwise); items
+    of list (cat) leaves have no pre-update counterpart, so their non-finite
+    entries are zeroed.  ``"zero"``: non-finite entries become 0.  Both are
+    single fused ``jnp.where`` masks — no host round-trip, no extra trace.
+
+    ``"warn"`` / ``"error"``: values pass through untouched, and the
+    reserved ``"_nonfinite"`` leaf is set to the current non-finite count so
+    a deferred host-side check can warn/raise outside the graph.
+
+    ``"propagate"`` (and unknown strategies) return ``new_state`` unchanged.
+    """
+    if strategy in ("ignore", "zero"):
+        out: State = {}
+        for name, leaf in new_state.items():
+            if name in RESERVED_STATE_KEYS:
+                out[name] = leaf
+            elif isinstance(leaf, tuple):
+                out[name] = tuple(_guard_array("zero", None, item) for item in leaf)
+            else:
+                old = old_state.get(name) if strategy == "ignore" else None
+                out[name] = _guard_array(strategy, None if isinstance(old, tuple) else old, leaf)
+        return out
+    if strategy in ("warn", "error"):
+        out = dict(new_state)
+        out[_NONFINITE] = count_nonfinite(new_state)
+        return out
+    return new_state
+
+
+# ------------------------------------------------------------- state digests
+_HASH_MULT = np.uint32(2654435761)  # Knuth's multiplicative constant
+_HASH_SEED = np.uint32(0x9E3779B9)
+
+
+def _as_words(x: Any) -> Any:
+    """Flatten one array leaf into uint32 words, value-deterministically."""
+    arr = jnp.ravel(jnp.asarray(x))
+    if arr.dtype == jnp.bool_:
+        return arr.astype(jnp.uint32)
+    if jnp.issubdtype(arr.dtype, jnp.complexfloating):
+        re, im = jnp.real(arr), jnp.imag(arr)
+        return jnp.concatenate([_as_words(re), _as_words(im)])
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        # upcast to float32 is exact for narrower floats, then bitcast: two
+        # states digest equal iff their float32 images are bitwise equal
+        return jax.lax.bitcast_convert_type(arr.astype(jnp.float32), jnp.uint32)
+    return arr.astype(jnp.uint32)  # integer leaves: wraparound cast
+
+
+def leaf_digest(leaf: Any) -> Any:
+    """Order-sensitive uint32 checksum of one state leaf (pure, jittable).
+
+    Words are weighted by a position-dependent odd multiplier, so permuted
+    or shifted contents digest differently; the element count is folded in
+    so zero-padded states don't collide with shorter ones.  Tuple (list)
+    leaves chain their items' digests with item-index weights.
+    """
+    if isinstance(leaf, tuple):
+        total = jnp.asarray(np.uint32(len(leaf)) * _HASH_SEED)
+        for i, item in enumerate(leaf):
+            total = total + leaf_digest(item) * (np.uint32(2 * i + 1))
+        return total
+    words = _as_words(leaf)
+    if words.size == 0:
+        return jnp.asarray(_HASH_SEED)
+    idx = jnp.arange(words.size, dtype=jnp.uint32)
+    weights = idx * _HASH_MULT | jnp.uint32(1)  # odd => injective mod 2^32
+    return jnp.sum(words * weights, dtype=jnp.uint32) + jnp.uint32(words.size)
+
+
+def state_digest(state: State) -> Dict[str, Any]:
+    """Per-leaf uint32 checksums of a state pytree, sorted by leaf name."""
+    return {name: leaf_digest(state[name]) for name in sorted(state)}

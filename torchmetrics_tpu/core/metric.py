@@ -58,6 +58,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from torchmetrics_tpu.core.guards import (
+    GUARD_STRATEGIES,
+    count_nonfinite,
+    guard_state,
+)
 from torchmetrics_tpu.core.reductions import (
     Reduce,
     canonical_reduce,
@@ -66,12 +71,13 @@ from torchmetrics_tpu.core.reductions import (
     sync_leaf,
 )
 from torchmetrics_tpu.parallel.sync import distributed_available, host_sync_state
-from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.exceptions import NonFiniteStateError, TorchMetricsUserError
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 State = Dict[str, Any]
 
 _N = "_n"  # reserved state key: int32 update counter, always psum/sum-merged
+_NONFINITE = "_nonfinite"  # reserved state key: int32 non-finite counter (nan_strategy warn/error)
 
 # ctor kwargs consumed by Metric.__init__ — wrappers that forward leftover
 # kwargs elsewhere (e.g. PermutationInvariantTraining) split on this set
@@ -82,6 +88,7 @@ METRIC_BASE_KWARGS = frozenset(
         "compute_with_cache",
         "axis_name",
         "jit",
+        "nan_strategy",
         "dist_sync_fn",
         "distributed_available_fn",
         "process_group",
@@ -100,12 +107,26 @@ class Metric:
         compute_with_cache: cache the ``compute`` result until next update/reset.
         axis_name: mesh axis used by the in-graph ``sync_states``.
         jit: jit-compile the facade ``update`` path (tensor-state metrics only).
+        nan_strategy: non-finite guard on the updated state —
+            ``"propagate"`` (default, no guard) | ``"ignore"`` (non-finite
+            elements fall back to their pre-update value) | ``"zero"``
+            (non-finite elements become 0) | ``"warn"`` / ``"error"``
+            (values pass through; a reserved in-graph counter tracks
+            non-finite values and a deferred host-side check warns/raises).
+            ``"ignore"``/``"zero"`` lower to fused ``jnp.where`` masks and
+            add no extra trace; the strategy is part of the compile-cache
+            config fingerprint.
     """
 
     __jit_state_exclude__: Tuple[str, ...] = ()
     # extra attrs a subclass wants excluded from the compile-cache config
     # fingerprint (core/compile.py) on top of the base bookkeeping set
     __fingerprint_exclude__: Tuple[str, ...] = ()
+    # subclasses that implement their own input-level NaN handling (the
+    # aggregation family's error/warn/ignore/disable/impute vocabulary) set
+    # this True: the base state-level guard then never double-applies, and
+    # their ``nan_strategy`` attribute keeps its subclass semantics
+    __handles_nan_strategy__: bool = False
 
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
@@ -136,6 +157,15 @@ class Metric:
         self.compute_with_cache: bool = kwargs.pop("compute_with_cache", True)
         self.axis_name: str = kwargs.pop("axis_name", "data")
         self._enable_jit: bool = kwargs.pop("jit", False)
+        nan_strategy = kwargs.pop("nan_strategy", "propagate")
+        if not type(self).__handles_nan_strategy__ and nan_strategy not in GUARD_STRATEGIES:
+            raise ValueError(
+                f"Arg `nan_strategy` must be one of {GUARD_STRATEGIES}, got {nan_strategy!r}"
+            )
+        self.nan_strategy = nan_strategy
+        self._nf_reported: int = 0
+        if self._guard_strategy in ("warn", "error"):
+            self._state[_NONFINITE] = jnp.zeros((), dtype=jnp.int32)
         self.dist_sync_fn: Optional[Callable] = kwargs.pop("dist_sync_fn", None)
         self.distributed_available_fn: Callable = kwargs.pop(
             "distributed_available_fn", distributed_available
@@ -224,6 +254,51 @@ class Metric:
     def _has_list_states(self) -> bool:
         return any(is_list_state(v) for v in self._defaults.values())
 
+    # ------------------------------------------------------ non-finite guards
+    @property
+    def _guard_strategy(self) -> str:
+        """The effective base-level ``nan_strategy`` (``"propagate"`` when a
+        subclass handles NaNs itself, e.g. the aggregation family)."""
+        if type(self).__handles_nan_strategy__:
+            return "propagate"
+        return getattr(self, "nan_strategy", "propagate")
+
+    @property
+    def nonfinite_count(self) -> int:
+        """Non-finite values currently tracked in the state (``nan_strategy``
+        ``"warn"``/``"error"`` only; always 0 otherwise).  Reads the counter
+        back to host — a device sync on the jit path."""
+        return int(self._state.get(_NONFINITE, 0))
+
+    def _check_nonfinite(self) -> None:
+        """Deferred host-side leg of the ``"warn"``/``"error"`` strategies.
+
+        The compiled update only *counts* non-finite values into the
+        reserved ``_nonfinite`` leaf (jit-safe); this check reads the counter
+        on host and raises/warns.  Called from eager ``update`` and from
+        ``compute`` — the jit ``update`` path defers to ``compute`` so
+        per-step async dispatch is preserved.
+        """
+        if self._guard_strategy not in ("warn", "error"):
+            return
+        count = int(self._state.get(_NONFINITE, 0))
+        if count == 0:
+            return
+        if self._guard_strategy == "error":
+            raise NonFiniteStateError(
+                f"Metric {type(self).__name__} accumulated {count} non-finite value(s) in its "
+                "state (nan_strategy='error'). Reset the metric, or use nan_strategy "
+                "'ignore'/'zero' to mask non-finite updates in-graph.",
+                count=count,
+            )
+        if count > self._nf_reported:
+            rank_zero_warn(
+                f"Metric {type(self).__name__} state contains {count} non-finite value(s) "
+                "(nan_strategy='warn'). Results may be poisoned.",
+                UserWarning,
+            )
+            self.__dict__["_nf_reported"] = count
+
     # -------------------------------------------------------- functional core
     def init_state(self) -> State:
         """Fresh state pytree (pure).
@@ -236,6 +311,8 @@ class Metric:
         """
         st = {k: (v if isinstance(v, tuple) else v.copy()) for k, v in self._defaults.items()}
         st[_N] = jnp.zeros((), dtype=jnp.int32)
+        if self._guard_strategy in ("warn", "error"):
+            st[_NONFINITE] = jnp.zeros((), dtype=jnp.int32)
         return st
 
     def update_state(self, state: State, *args: Any, **kwargs: Any) -> State:
@@ -248,6 +325,12 @@ class Metric:
         with jax.named_scope(f"{type(self).__name__}.update"):
             new = dict(self._update(state, *args, **kwargs))
             new[_N] = state[_N] + 1
+            strategy = self._guard_strategy
+            if strategy != "propagate":
+                # fused non-finite guard (core/guards.py): ignore/zero are
+                # jnp.where masks inside this same graph; warn/error only
+                # refresh the reserved counter leaf (checked on host later)
+                new = guard_state(strategy, state, new)
             return new
 
     def compute_state(self, state: State) -> Any:
@@ -267,6 +350,8 @@ class Metric:
         for name, reduce in self._reductions.items():
             out[name] = merge_leaf(reduce, a[name], b[name], n_a=a[_N], n_b=b[_N])
         out[_N] = a[_N] + b[_N]
+        if self._guard_strategy in ("warn", "error"):
+            out[_NONFINITE] = count_nonfinite(out)
         return out
 
     def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
@@ -276,6 +361,8 @@ class Metric:
         for name, reduce in self._reductions.items():
             out[name] = sync_leaf(reduce, state[name], axis_name)
         out[_N] = jax.lax.psum(state[_N], axis_name)
+        if self._guard_strategy in ("warn", "error"):
+            out[_NONFINITE] = count_nonfinite(out)
         return out
 
     def host_sync_states(self, state: State) -> State:
@@ -324,6 +411,9 @@ class Metric:
             self._state = fn(self._state, *args, **kwargs)
         else:
             self._state = self.update_state(self._state, *args, **kwargs)
+            # eager path: surface warn/error immediately (the state is host-
+            # adjacent anyway); the jit path defers the readback to compute()
+            self._check_nonfinite()
 
     def compute(self) -> Any:
         """Compute over accumulated (and, if multi-host, synced) state."""
@@ -335,6 +425,7 @@ class Metric:
             )
         if self.compute_with_cache and self._computed is not None:
             return self._computed
+        self._check_nonfinite()
 
         state = self._state
         if self.sync_on_compute and self.distributed_available_fn():
@@ -388,6 +479,7 @@ class Metric:
         self._state_shared = False  # fresh buffers: nothing aliases them
         self._computed = None
         self._forward_cache = None
+        self._nf_reported = 0
 
     # ------------------------------------------------------------- lifecycle
     def clone(self) -> "Metric":
@@ -414,14 +506,42 @@ class Metric:
         return destination
 
     def load_state_dict(self, state_dict: Mapping[str, Any], prefix: str = "") -> None:
+        """Install persisted leaves, validating each against the state spec.
+
+        Unknown keys (present under ``prefix`` but not a state of this
+        metric) and expected-but-missing keys are surfaced with
+        ``rank_zero_warn`` instead of being silently skipped; leaves that
+        fail shape/dtype validation raise
+        :class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError`
+        before any state is touched.
+        """
+        from torchmetrics_tpu.resilience.snapshot import validate_state_leaf
+
+        known = {prefix + name for name in self._defaults}
+        unknown = sorted(k for k in state_dict if k.startswith(prefix) and k not in known)
+        if unknown:
+            rank_zero_warn(
+                f"Ignoring {len(unknown)} unknown key(s) in state_dict for metric "
+                f"{type(self).__name__}: {unknown} (not registered states of this metric).",
+                UserWarning,
+            )
+        expected = {prefix + name for name, persistent in self._persistent.items() if persistent}
+        missing = sorted(expected - set(state_dict))
+        if missing:
+            rank_zero_warn(
+                f"Metric {type(self).__name__} expected persistent state key(s) {missing} "
+                "in state_dict but they are missing; those states keep their current values.",
+                UserWarning,
+            )
+        staged: Dict[str, Any] = {}
         for name in self._defaults:
             key = prefix + name
-            if key in state_dict:
-                value = state_dict[key]
-                if isinstance(value, (list, tuple)):
-                    self._state[name] = tuple(jnp.asarray(v) for v in value)
-                else:
-                    self._state[name] = jnp.asarray(value)
+            if key not in state_dict:
+                continue
+            value = state_dict[key]
+            staged[name] = validate_state_leaf(self, name, value)
+        # all-or-nothing: leaves land only after every one validated
+        self._state.update(staged)
         self._computed = None
 
     def state_pytree(self) -> State:
@@ -429,7 +549,21 @@ class Metric:
         return self._state
 
     def load_state_pytree(self, state: State) -> None:
-        self._state = jax.tree.map(jnp.asarray, state)
+        """Install a full state pytree, validated against this metric's spec.
+
+        Structure, shapes and dtypes are checked *before* ``_state`` is
+        touched (:func:`torchmetrics_tpu.resilience.snapshot.validate_state_pytree`);
+        a mismatch raises :class:`StateRestoreError` naming the offending
+        leaf instead of failing deep inside the next compiled update.  The
+        installed buffers are treated as fresh: ``_state_shared`` is cleared,
+        so compiled updates may donate them again (a caller that re-aliases
+        one pytree across metrics — ``MetricCollection.load_states`` — marks
+        the group shared afterwards).
+        """
+        from torchmetrics_tpu.resilience.snapshot import validate_state_pytree
+
+        self._state = validate_state_pytree(self, state)
+        self._state_shared = False
         self._computed = None
 
     # pickling: state arrays -> numpy for portability (reference metric.py:713-732)
@@ -448,6 +582,8 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self.__dict__.setdefault("nan_strategy", "propagate")
+        self.__dict__.setdefault("_nf_reported", 0)
         self._state = {
             k: tuple(jnp.asarray(x) for x in v) if isinstance(v, (list, tuple)) else jnp.asarray(v)
             for k, v in self._state.items()

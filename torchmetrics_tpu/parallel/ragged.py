@@ -47,6 +47,7 @@ from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
 
 State = Dict[str, Any]
 _N = "_n"
+_NONFINITE = "_nonfinite"
 
 
 def _pack_items(
@@ -105,6 +106,7 @@ def sync_ragged_states(
     per_device_states: Sequence[State],
     mesh: Mesh,
     axis_name: str = "data",
+    verify_consistency: bool = False,
 ) -> State:
     """Combine per-device states whose list leaves are ragged, via one
     in-graph pad-gather-trim per state name.
@@ -136,6 +138,27 @@ def sync_ragged_states(
         raise ValueError(
             f"need one state per mesh device: got {len(per_device_states)} states for {n_dev} devices"
         )
+    if verify_consistency:
+        # a device whose update count drifted (lost or duplicated a step —
+        # the uneven-restore failure mode) would silently skew the gathered
+        # aggregate; catch it before the collective runs
+        counts = [int(np.asarray(st.get(_N, 0))) for st in per_device_states]
+        if len(set(counts)) > 1:
+            from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+            majority = max(set(counts), key=counts.count)
+            bad = [d for d, c in enumerate(counts) if c != majority]
+            raise ReplicaDivergenceError(
+                f"per-device update counts diverged before ragged sync: {counts} "
+                f"(devices {bad} disagree with the majority count {majority}). Each device "
+                "must see the same number of update steps; a preempted/restored device "
+                "likely resumed from the wrong step.",
+                leaves=(_N,),
+                replicas=bad,
+            )
+    # reserved counters ride the scalar SUM path without a reduction-table entry
+    reductions = dict(reductions)
+    reductions.setdefault(_NONFINITE, Reduce.SUM)
     names = list(per_device_states[0].keys())
 
     # ragged-vs-scalar classification comes from the metric's reduction
@@ -299,6 +322,7 @@ class DeferredRaggedSync:
         metric: "Metric",  # noqa: F821 — forward ref
         mesh: Optional[Mesh] = None,
         axis_name: str = "data",
+        verify_consistency: bool = False,
     ) -> None:
         from torchmetrics_tpu.core.metric import Metric
         from torchmetrics_tpu.parallel.sync import metric_mesh
@@ -311,6 +335,7 @@ class DeferredRaggedSync:
         self.metric = metric
         self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
         self.axis_name = axis_name
+        self.verify_consistency = verify_consistency
         self._per_device: Optional[List[State]] = None
 
     @property
@@ -341,7 +366,13 @@ class DeferredRaggedSync:
         per-device state across the mesh and return the global state."""
         if self._per_device is None:
             raise RuntimeError("DeferredRaggedSync.sync called before any update")
-        return sync_ragged_states(self.metric._reductions, self._per_device, self.mesh, self.axis_name)
+        return sync_ragged_states(
+            self.metric._reductions,
+            self._per_device,
+            self.mesh,
+            self.axis_name,
+            verify_consistency=self.verify_consistency,
+        )
 
     def compute(self) -> Any:
         return self.metric.compute_state(self.sync())

@@ -37,19 +37,35 @@ from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchmetrics_tpu.core.reductions import Reduce, host_sync_leaf, sync_leaf
+from torchmetrics_tpu.utilities.prints import rank_zero_debug
 
 State = Dict[str, Any]
+
+_N = "_n"
+_NONFINITE = "_nonfinite"
+
+# one-time latch for the distributed_available probe failure, so a broken
+# backend logs once instead of on every compute()
+_DIST_PROBE_FAILED_LOGGED = False
 
 
 def distributed_available() -> bool:
     """True when more than one process participates (multi-host program).
 
     The reference's probe is ``torch.distributed.is_initialized``
-    (metric.py:46-48); the JAX equivalent is the process count.
+    (metric.py:46-48); the JAX equivalent is the process count.  Only a
+    ``RuntimeError`` (the backend is not initialized / no devices) means
+    "not distributed" — anything else is a real failure and propagates.
     """
+    global _DIST_PROBE_FAILED_LOGGED
     try:
         return jax.process_count() > 1
-    except Exception:  # pragma: no cover
+    except RuntimeError as err:  # pragma: no cover - needs an uninitialized backend
+        if not _DIST_PROBE_FAILED_LOGGED:
+            _DIST_PROBE_FAILED_LOGGED = True
+            rank_zero_debug(
+                "jax.process_count() raised %r; treating the program as single-process.", err
+            )
         return False
 
 
@@ -75,7 +91,7 @@ def sync_state(
     """
     out = {}
     for name, value in state.items():
-        if name == "_n":
+        if name in (_N, _NONFINITE):  # reserved counters: always summed
             out[name] = jax.lax.psum(value, axis_name)
             continue
         out[name] = sync_leaf(reductions[name], value, axis_name)
@@ -89,7 +105,7 @@ def host_sync_state(
     """Cross-process sync of an eager state pytree (DCN path, no jit)."""
     out = {}
     for name, value in state.items():
-        if name == "_n":
+        if name in (_N, _NONFINITE):  # reserved counters: always summed
             out[name] = host_sync_leaf(Reduce.SUM, value)
             continue
         out[name] = host_sync_leaf(reductions[name], value)
@@ -152,6 +168,7 @@ def sharded_update(
     mesh: Optional[Mesh] = None,
     axis_name: str = "data",
     in_specs: Optional[Any] = None,
+    verify_consistency: bool = False,
     **kwargs: Array,
 ) -> State:
     """Run one metric ``update`` with inputs sharded over the mesh batch axis.
@@ -162,6 +179,13 @@ def sharded_update(
     replacement for the reference's "each rank holds a replica and all_gathers
     at compute" model (§2.8 of SURVEY.md): the collective runs over ICI inside
     the step graph, so metric accumulation fuses into the eval step.
+
+    With ``verify_consistency=True`` the returned replicated state's
+    per-device copies are checksum-compared over the mesh axis
+    (:func:`torchmetrics_tpu.resilience.verify_replica_consistency`); a
+    device copy that diverged raises
+    :class:`~torchmetrics_tpu.utilities.exceptions.ReplicaDivergenceError`
+    at sync time instead of producing a silently wrong aggregate.
     """
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     if in_specs is None:
@@ -186,7 +210,12 @@ def sharded_update(
         from torchmetrics_tpu.core.compile import shard_map
 
         fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
-        return fn(*inputs)
+        out = fn(*inputs)
+        if verify_consistency:
+            from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+
+            verify_replica_consistency(metric, mesh=mesh, state=out, axis_name=axis_name)
+        return out
     # unified compile cache: the compiled step is keyed on (metric class,
     # config fingerprint, mesh, axis, specs, abstract input shapes), so
     # mutating a metric attribute after the first call re-traces with the
@@ -197,7 +226,12 @@ def sharded_update(
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
     fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs)
-    return fn(*inputs)
+    out = fn(*inputs)
+    if verify_consistency:
+        from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+
+        verify_replica_consistency(metric, mesh=mesh, state=out, axis_name=axis_name)
+    return out
 
 
 def sharded_collection_update(

@@ -1,0 +1,61 @@
+"""Resilience layer: preemption-safe snapshots, validated restore, and
+cross-replica divergence detection.
+
+The three failure modes that kill long metric runs on preemptible pods —
+preemption mid-epoch, silently corrupted restores, and replica state drift —
+each get a first-class tool here:
+
+* :func:`snapshot` / :func:`restore` — versioned, self-describing host-numpy
+  checkpoints, validated leaf-by-leaf *before* any state is installed
+  (``StateRestoreError`` names the offending leaf).
+* :func:`verify_replica_consistency` — cheap per-leaf checksums compared
+  with one ``pmin``/``pmax`` collective over the mesh axis
+  (``ReplicaDivergenceError`` names the divergent leaves and replicas).
+* :mod:`torchmetrics_tpu.resilience.faults` — deterministic fault injection
+  (kill/restore, snapshot corruption, single-replica perturbation) for tests.
+
+The jit-fused non-finite guards (``Metric(nan_strategy=...)``) live in
+``core/guards.py`` so the core can apply them without importing this package.
+"""
+
+from torchmetrics_tpu.resilience.divergence import (
+    replica_digest_table,
+    verify_replica_consistency,
+)
+from torchmetrics_tpu.resilience.faults import (
+    CORRUPTION_MODES,
+    corrupt_snapshot,
+    perturb_replica,
+    run_with_preemption,
+)
+from torchmetrics_tpu.resilience.snapshot import (
+    SCHEMA_VERSION,
+    class_fingerprint,
+    restore,
+    snapshot,
+    validate_state_leaf,
+    validate_state_pytree,
+)
+from torchmetrics_tpu.utilities.exceptions import (
+    NonFiniteStateError,
+    ReplicaDivergenceError,
+    StateRestoreError,
+)
+
+__all__ = [
+    "CORRUPTION_MODES",
+    "NonFiniteStateError",
+    "ReplicaDivergenceError",
+    "SCHEMA_VERSION",
+    "StateRestoreError",
+    "class_fingerprint",
+    "corrupt_snapshot",
+    "perturb_replica",
+    "replica_digest_table",
+    "restore",
+    "run_with_preemption",
+    "snapshot",
+    "validate_state_leaf",
+    "validate_state_pytree",
+    "verify_replica_consistency",
+]

@@ -1,0 +1,173 @@
+"""Cross-replica divergence detection.
+
+Replicas that are supposed to hold *identical* metric state — every device's
+copy of a replicated post-sync state, every host's copy of the global
+accumulator — can silently drift apart: an uneven restore across hosts, a
+replica that lost a step to preemption, a flipped bit.  Every downstream
+aggregate then looks plausible and is wrong.
+
+Instead of shipping full states around to compare, each replica's state is
+reduced to one cheap order-sensitive uint32 checksum per leaf
+(``core/guards.py``) and the digests are compared with a single
+``pmin``/``pmax`` collective over the mesh axis
+(``core.compile.compiled_divergence_check`` — for any total order, min
+equals max iff every replica agrees).  A mismatch raises
+:class:`~torchmetrics_tpu.utilities.exceptions.ReplicaDivergenceError`
+naming the divergent leaves and the replicas that disagree with the
+majority.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchmetrics_tpu.core.guards import leaf_digest
+from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+__all__ = ["replica_digest_table", "verify_replica_consistency"]
+
+State = Dict[str, Any]
+
+
+def _check_same_structure(states: Sequence[State]) -> List[str]:
+    names = sorted(states[0])
+    for i, st in enumerate(states[1:], start=1):
+        if sorted(st) != names:
+            diff = sorted(set(names).symmetric_difference(st))
+            raise ReplicaDivergenceError(
+                f"replica state structures disagree: replica 0 holds leaves {names}, "
+                f"replica {i} holds {sorted(st)} (differing: {diff}).",
+                leaves=diff,
+                replicas=[i],
+            )
+    return names
+
+
+def replica_digest_table(states: Sequence[State]) -> "np.ndarray":
+    """``(n_replicas, n_leaves)`` uint32 checksum matrix of per-replica
+    states (leaves in sorted-name order).  Raises
+    :class:`ReplicaDivergenceError` if the replicas' leaf *names* already
+    disagree."""
+    names = _check_same_structure(states)
+    table = np.zeros((len(states), len(names)), np.uint32)
+    for i, st in enumerate(states):
+        for j, name in enumerate(names):
+            table[i, j] = np.asarray(leaf_digest(st[name]), np.uint32)
+    return table
+
+
+def _replica_views(state: State, mesh: Mesh) -> Optional[List[State]]:
+    """Per-device views of a replicated state pytree, or ``None`` when no
+    leaf actually lives replicated on the mesh devices (nothing to compare).
+
+    Only leaves whose every addressable shard carries the *full* value (true
+    replication) are expanded per device; host leaves and genuinely sharded
+    leaves are passed through as one shared object, which digests identically
+    on every replica and therefore can never raise a false alarm.
+    """
+    devices = list(mesh.devices.flat)
+    views: List[State] = [dict() for _ in devices]
+    comparable = False
+    for name, leaf in state.items():
+        per_dev = None
+        if isinstance(leaf, jax.Array) and not isinstance(leaf, jax.core.Tracer):
+            try:
+                shards = leaf.addressable_shards
+            except Exception:
+                shards = []
+            full = {
+                s.device: s.data for s in shards if tuple(s.data.shape) == tuple(leaf.shape)
+            }
+            if all(d in full for d in devices) and len(devices) > 1:
+                per_dev = full
+        if per_dev is not None:
+            comparable = True
+            for i, d in enumerate(devices):
+                views[i][name] = per_dev[d]
+        else:
+            for i in range(len(devices)):
+                views[i][name] = leaf
+    return views if comparable else None
+
+
+def verify_replica_consistency(
+    metric: Any,
+    mesh: Optional[Mesh] = None,
+    states: Optional[Sequence[State]] = None,
+    state: Optional[State] = None,
+    axis_name: str = "data",
+) -> None:
+    """Verify that replicas holding supposedly-identical metric state agree.
+
+    Two modes:
+
+    * ``states=[state_0, ..., state_{n-1}]`` — explicit per-replica pytrees
+      (e.g. each host's copy of the global accumulator after a restore).
+    * otherwise — ``state`` (default: ``metric.state_pytree()``) is treated
+      as a mesh-replicated pytree and each device's copy is checked.  Leaves
+      that are not replicated on the mesh are skipped; with nothing
+      replicated the check trivially passes.
+
+    Each replica's state reduces to one uint32 checksum per leaf; when
+    ``mesh`` is a 1-D mesh matching the replica count, the compare runs as a
+    single in-graph ``pmin``/``pmax`` collective over ``axis_name``
+    (cached in the unified compile registry), otherwise it runs on host.
+    Disagreement raises :class:`ReplicaDivergenceError` naming the divergent
+    leaves and the minority replicas.
+    """
+    if states is None:
+        if mesh is None:
+            raise ValueError("verify_replica_consistency needs `mesh` (or explicit `states`)")
+        src = state if state is not None else metric.state_pytree()
+        views = _replica_views(src, mesh)
+        if views is None:
+            return  # nothing replicated on this mesh — single source of truth
+        states = views
+    states = list(states)
+    if len(states) < 2:
+        return
+    table = replica_digest_table(states)
+    names = sorted(states[0])
+    if not names:
+        return
+
+    agree: np.ndarray
+    if (
+        mesh is not None
+        and int(mesh.devices.size) == len(states)
+        and axis_name in mesh.shape
+        and int(mesh.shape[axis_name]) == len(states)
+    ):
+        from torchmetrics_tpu.core.compile import compiled_divergence_check
+
+        fn = compiled_divergence_check(mesh, axis_name, len(names))
+        sharded = jax.device_put(table, NamedSharding(mesh, P(axis_name)))
+        agree = np.asarray(fn(sharded))
+    else:
+        agree = (table == table[0]).all(axis=0)
+
+    if bool(np.all(agree)):
+        return
+    bad_leaves = [names[j] for j in range(len(names)) if not agree[j]]
+    bad_replicas: List[int] = []
+    for j, name in enumerate(names):
+        if agree[j]:
+            continue
+        col = table[:, j]
+        vals, counts = np.unique(col, return_counts=True)
+        majority = vals[int(np.argmax(counts))]
+        bad_replicas.extend(int(i) for i in np.nonzero(col != majority)[0])
+    bad_replicas = sorted(set(bad_replicas))
+    raise ReplicaDivergenceError(
+        f"metric state diverged across {len(states)} replicas: leaves {bad_leaves} do not "
+        f"agree (replicas {bad_replicas} differ from the majority). The replicas were "
+        "expected to hold identical state — check for an uneven restore or a replica "
+        "that lost/duplicated an update step.",
+        leaves=bad_leaves,
+        replicas=bad_replicas,
+    )

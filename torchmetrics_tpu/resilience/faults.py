@@ -1,0 +1,203 @@
+"""Deterministic fault injection for the resilience test suite.
+
+Three failure modes a preemptible-pod metrics stack must survive, each
+reproduced deterministically (no wall clock, no RNG — the same call always
+injects the same fault):
+
+* **Preemption** — :func:`run_with_preemption` kills a run after an
+  arbitrary update step, round-trips the snapshot through pickle bytes (the
+  on-disk checkpoint boundary), restores into a *fresh* instance, and
+  finishes the remaining steps.  The contract under test: ``compute()`` is
+  bitwise-identical to the uninterrupted run.
+* **Checkpoint corruption** — :func:`corrupt_snapshot` returns a copy of a
+  snapshot damaged in one specific, named way (truncated payload, wrong
+  shape/dtype, missing/extra leaf, wrong class, wrong schema version).  The
+  contract: ``restore`` raises ``StateRestoreError`` naming the bad leaf,
+  before any state is touched.
+* **Replica perturbation** — :func:`perturb_replica` flips exactly one leaf
+  of exactly one replica's state.  The contract:
+  ``verify_replica_consistency`` names that leaf and that replica.
+"""
+
+from __future__ import annotations
+
+import pickle
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.guards import RESERVED_STATE_KEYS
+from torchmetrics_tpu.resilience.snapshot import restore, snapshot
+
+__all__ = ["CORRUPTION_MODES", "corrupt_snapshot", "perturb_replica", "run_with_preemption"]
+
+CORRUPTION_MODES = (
+    "truncate",
+    "shape",
+    "dtype",
+    "missing_leaf",
+    "extra_leaf",
+    "class",
+    "version",
+)
+
+
+def run_with_preemption(
+    make_metric: Callable[[], Any],
+    batches: Sequence[Tuple[Any, ...]],
+    kill_at: int,
+    through_pickle: bool = True,
+) -> Any:
+    """Simulate a preemption after ``kill_at`` update steps.
+
+    ``make_metric`` builds a fresh metric/collection (called once for the
+    doomed instance, once for the revived one — exactly what a restarted
+    training process does).  The first ``kill_at`` batches go into the first
+    instance, its snapshot crosses a ``pickle`` byte boundary (the on-disk
+    checkpoint), the revived instance restores from it and consumes the
+    remaining batches.  Returns the revived metric, ready for ``compute()``.
+    """
+    if not 0 <= kill_at <= len(batches):
+        raise ValueError(f"kill_at must be within [0, {len(batches)}], got {kill_at}")
+    doomed = make_metric()
+    for batch in batches[:kill_at]:
+        doomed.update(*batch)
+    snap = snapshot(doomed)
+    if through_pickle:
+        snap = pickle.loads(pickle.dumps(snap))
+    del doomed  # the preempted process is gone
+    revived = make_metric()
+    restore(revived, snap)
+    for batch in batches[kill_at:]:
+        revived.update(*batch)
+    return revived
+
+
+def _target_leaf(payload: Mapping[str, Any], leaf: Optional[str]) -> str:
+    if leaf is not None:
+        if leaf not in payload:
+            raise KeyError(f"leaf {leaf!r} not in snapshot payload ({sorted(payload)})")
+        return leaf
+    candidates = [
+        name
+        for name in sorted(payload)
+        if name not in RESERVED_STATE_KEYS and not isinstance(payload[name], (list, tuple))
+    ]
+    if not candidates:
+        raise ValueError("snapshot has no corruptible array leaf; pass `leaf=` explicitly")
+    return candidates[0]
+
+
+def corrupt_snapshot(
+    snap: Mapping[str, Any],
+    mode: str,
+    leaf: Optional[str] = None,
+    member: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Return a deep copy of ``snap`` with one deterministic corruption.
+
+    ``mode``:
+        * ``"truncate"`` — payload loses its last element while the recorded
+          spec still describes the full array (a torn write).
+        * ``"shape"`` — payload *and* spec gain a leading axis (a checkpoint
+          from a differently-configured metric).
+        * ``"dtype"`` — payload and spec cast to a different dtype.
+        * ``"missing_leaf"`` / ``"extra_leaf"`` — a leaf disappears from /
+          appears in both payload and spec.
+        * ``"class"`` / ``"version"`` — the class fingerprint / schema
+          version no longer matches.
+
+    ``member`` targets one metric inside a collection snapshot; ``leaf``
+    picks the state leaf (default: first non-reserved array leaf).
+    """
+    if mode not in CORRUPTION_MODES:
+        raise ValueError(f"mode must be one of {CORRUPTION_MODES}, got {mode!r}")
+    out = deepcopy(dict(snap))
+    target: Dict[str, Any] = out
+    if out.get("kind") == "collection":
+        if mode == "version":
+            out["schema_version"] = out["schema_version"] + 1
+            return out
+        if mode == "class":
+            out["class"] = out["class"] + "Mismatched"
+            return out
+        members = out["metrics"]
+        name = member if member is not None else sorted(members)[0]
+        if name not in members:
+            raise KeyError(f"member {name!r} not in collection snapshot ({sorted(members)})")
+        target = members[name]
+
+    if mode == "version":
+        target["schema_version"] = target["schema_version"] + 1
+        return out
+    if mode == "class":
+        target["class"] = target["class"] + "Mismatched"
+        return out
+
+    payload, spec = target["state"], target["spec"]
+    if mode == "missing_leaf":
+        name = _target_leaf(payload, leaf)
+        del payload[name]
+        del spec[name]
+        return out
+    if mode == "extra_leaf":
+        payload["bogus_leaf"] = np.zeros((3,), np.float32)
+        spec["bogus_leaf"] = {"kind": "array", "shape": [3], "dtype": "float32"}
+        return out
+
+    name = _target_leaf(payload, leaf)
+    arr = np.asarray(payload[name])
+    if mode == "truncate":
+        flat = arr.reshape(-1)
+        payload[name] = flat[:-1] if flat.size else np.zeros((1,), arr.dtype)
+        return out  # spec untouched: payload no longer matches it
+    if mode == "shape":
+        payload[name] = arr[np.newaxis]
+        spec[name] = {"kind": "array", "shape": [1, *arr.shape], "dtype": str(arr.dtype)}
+        return out
+    # dtype
+    new_dtype = np.dtype(np.float64 if arr.dtype != np.float64 else np.float32)
+    payload[name] = arr.astype(new_dtype)
+    spec[name] = {"kind": "array", "shape": list(arr.shape), "dtype": str(new_dtype)}
+    return out
+
+
+def perturb_replica(
+    per_replica_states: Sequence[Mapping[str, Any]],
+    replica: int,
+    leaf: Optional[str] = None,
+    delta: float = 1.0,
+) -> List[Dict[str, Any]]:
+    """Copy a list of per-replica states with ONE leaf of ONE replica nudged.
+
+    The perturbation is the smallest realistic divergence: one accumulator on
+    one replica off by ``delta`` (or, for bool leaves, one flipped flag) —
+    exactly what an uneven restore or a dropped batch produces.  Everything
+    else is shared by reference, so only the targeted (replica, leaf) pair
+    can trip :func:`~torchmetrics_tpu.resilience.verify_replica_consistency`.
+    """
+    if not 0 <= replica < len(per_replica_states):
+        raise ValueError(f"replica must be within [0, {len(per_replica_states)}), got {replica}")
+    states = [dict(st) for st in per_replica_states]
+    st = states[replica]
+    name = leaf
+    if name is None:
+        candidates = [k for k in sorted(st) if k not in RESERVED_STATE_KEYS]
+        if not candidates:
+            raise ValueError("state has no perturbable leaf; pass `leaf=` explicitly")
+        name = candidates[0]
+    value = st[name]
+    if isinstance(value, tuple):
+        if not value:
+            raise ValueError(f"leaf {name!r} is an empty list state; nothing to perturb")
+        first = jnp.asarray(value[0])
+        st[name] = (first + jnp.asarray(delta, first.dtype),) + tuple(value[1:])
+    else:
+        arr = jnp.asarray(value)
+        if arr.dtype == jnp.bool_:
+            st[name] = ~arr
+        else:
+            st[name] = arr + jnp.asarray(delta, arr.dtype)
+    return states

@@ -1,0 +1,425 @@
+"""Preemption-safe metric snapshots: a versioned checkpoint format with
+validate-before-install restore.
+
+Training jobs on preemptible pods die mid-epoch; what kills the *run* is not
+the preemption but a silently corrupted resume — a checkpoint written by a
+different metric config, a truncated leaf, a shape that only explodes three
+steps later inside a compiled update.  The snapshot format here is
+self-describing so every restore is validated **before any state leaf is
+touched**:
+
+``snapshot(metric) ->``::
+
+    {
+        "schema_version": 1,
+        "kind": "metric",
+        "class": "torchmetrics_tpu.classification...BinaryAccuracy",
+        "spec": {leaf: {"kind": "array", "shape": [...], "dtype": "..."} | {"kind": "list", ...}},
+        "state": {leaf: np.ndarray | [np.ndarray, ...]},   # host numpy pytree
+    }
+
+``snapshot(collection)`` wraps one metric snapshot per member plus the
+compute-group partition, so restore re-establishes state aliasing exactly
+(group members share ONE pytree again, ``_state_shared`` marked — the PR 1
+donation contract survives the round-trip).
+
+``restore`` (and the rewired ``Metric.load_state_dict`` /
+``load_state_pytree`` paths, which share :func:`validate_state_leaf` /
+:func:`validate_state_pytree`) raises a structured
+:class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError` naming the
+offending leaf on any mismatch.  Payloads are plain ``dict``/``list``/numpy
+— picklable, ``np.savez``-able, orbax-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.guards import RESERVED_STATE_KEYS
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "class_fingerprint",
+    "restore",
+    "snapshot",
+    "validate_state_leaf",
+    "validate_state_pytree",
+]
+
+SCHEMA_VERSION = 1
+
+_N = "_n"
+_NONFINITE = "_nonfinite"
+
+
+def class_fingerprint(obj: Any) -> str:
+    """Stable identity of the snapshotted class: ``module.qualname``."""
+    return f"{type(obj).__module__}.{type(obj).__qualname__}"
+
+
+def _is_growable(metric: Metric, name: str) -> bool:
+    """True for leaves whose leading dim may legitimately differ from the
+    default (cat/None-reduce concat states grow with the data)."""
+    reduce = metric._reductions.get(name)
+    return reduce in (Reduce.CAT, Reduce.NONE) or (callable(reduce) and not isinstance(reduce, Reduce))
+
+
+# ------------------------------------------------------------------ validate
+def validate_state_leaf(metric: Metric, name: str, value: Any) -> Any:
+    """Validate ONE state leaf against the metric's spec; return the
+    installable (jnp) leaf.  Raises :class:`StateRestoreError` naming the
+    leaf on any kind/shape/dtype mismatch — never touches metric state."""
+    if name in RESERVED_STATE_KEYS:
+        arr = np.asarray(value)
+        if arr.size != 1 or not np.issubdtype(arr.dtype, np.integer):
+            raise StateRestoreError(
+                f"Reserved counter leaf {name!r} must be an integer scalar; got "
+                f"shape {tuple(arr.shape)} dtype {arr.dtype}.",
+                leaf=name,
+                reason="counter",
+            )
+        return jnp.asarray(arr.reshape(()), jnp.int32)
+
+    if name not in metric._defaults:
+        raise StateRestoreError(
+            f"Leaf {name!r} is not a registered state of {type(metric).__name__} "
+            f"(known: {sorted(metric._defaults)}).",
+            leaf=name,
+            reason="unknown-leaf",
+        )
+    default = metric._defaults[name]
+
+    if isinstance(default, tuple):  # list ("cat") state
+        if not isinstance(value, (list, tuple)):
+            raise StateRestoreError(
+                f"List-state leaf {name!r} of {type(metric).__name__} expects a sequence of "
+                f"arrays; got {type(value).__name__}.",
+                leaf=name,
+                reason="kind",
+            )
+        items = []
+        dtype = None
+        for j, item in enumerate(value):
+            arr = np.asarray(item)
+            if dtype is None:
+                dtype = arr.dtype
+            elif arr.dtype != dtype:
+                raise StateRestoreError(
+                    f"List-state leaf {name!r} item {j} has dtype {arr.dtype}, but item 0 "
+                    f"has {dtype}: a snapshot's list items must share one dtype.",
+                    leaf=name,
+                    reason="dtype",
+                )
+            items.append(jnp.asarray(arr))
+        return tuple(items)
+
+    if isinstance(value, (list, tuple)):
+        raise StateRestoreError(
+            f"Tensor-state leaf {name!r} of {type(metric).__name__} expects an array; got a "
+            f"sequence of {len(value)} item(s).",
+            leaf=name,
+            reason="kind",
+        )
+    arr = np.asarray(value)
+    if arr.dtype != np.asarray(default).dtype:
+        raise StateRestoreError(
+            f"State leaf {name!r} of {type(metric).__name__} has dtype {arr.dtype}, "
+            f"expected {np.asarray(default).dtype}.",
+            leaf=name,
+            reason="dtype",
+        )
+    if _is_growable(metric, name):
+        if arr.ndim != np.asarray(default).ndim:
+            raise StateRestoreError(
+                f"Growable state leaf {name!r} of {type(metric).__name__} has rank {arr.ndim}, "
+                f"expected {np.asarray(default).ndim}.",
+                leaf=name,
+                reason="shape",
+            )
+    elif tuple(arr.shape) != tuple(np.asarray(default).shape):
+        raise StateRestoreError(
+            f"State leaf {name!r} of {type(metric).__name__} has shape {tuple(arr.shape)}, "
+            f"expected {tuple(np.asarray(default).shape)}.",
+            leaf=name,
+            reason="shape",
+        )
+    return jnp.asarray(arr)
+
+
+def validate_state_pytree(metric: Metric, state: Mapping[str, Any]) -> State:
+    """Validate a FULL state pytree against the metric's spec; return the
+    installable state dict (fresh jnp leaves).
+
+    Checks structure first (missing / unknown leaves), then every leaf's
+    kind/shape/dtype via :func:`validate_state_leaf`.  The reserved ``_n``
+    counter is preserved from the current state when absent; the
+    ``_nonfinite`` counter is synthesized/dropped to match the metric's
+    ``nan_strategy``.  Raises :class:`StateRestoreError` before anything is
+    installed.
+    """
+    if not isinstance(state, Mapping):
+        raise StateRestoreError(
+            f"Expected a state mapping for {type(metric).__name__}, got {type(state).__name__}.",
+            reason="structure",
+        )
+    provided = {k for k in state if k not in RESERVED_STATE_KEYS}
+    expected = set(metric._defaults)
+    missing = sorted(expected - provided)
+    if missing:
+        raise StateRestoreError(
+            f"State for {type(metric).__name__} is missing leaf {missing[0]!r} "
+            f"(all missing: {missing}).",
+            leaf=missing[0],
+            reason="missing-leaf",
+        )
+    unknown = sorted(provided - expected)
+    if unknown:
+        raise StateRestoreError(
+            f"State for {type(metric).__name__} contains unknown leaf {unknown[0]!r} "
+            f"(all unknown: {unknown}; known: {sorted(expected)}).",
+            leaf=unknown[0],
+            reason="unknown-leaf",
+        )
+    out: State = {}
+    for name in metric._defaults:
+        out[name] = validate_state_leaf(metric, name, state[name])
+    if _N in state:
+        out[_N] = validate_state_leaf(metric, _N, state[_N])
+    else:  # functional states without the counter keep the current count
+        out[_N] = metric._state.get(_N, jnp.zeros((), jnp.int32))
+    if metric._guard_strategy in ("warn", "error"):
+        if _NONFINITE in state:
+            out[_NONFINITE] = validate_state_leaf(metric, _NONFINITE, state[_NONFINITE])
+        else:
+            from torchmetrics_tpu.core.guards import count_nonfinite
+
+            out[_NONFINITE] = count_nonfinite(out)
+    return out
+
+
+# ------------------------------------------------------------------ snapshot
+def _leaf_spec(leaf: Any) -> Dict[str, Any]:
+    if isinstance(leaf, (tuple, list)):
+        arrs = [np.asarray(x) for x in leaf]
+        return {
+            "kind": "list",
+            "length": len(arrs),
+            "shapes": [list(a.shape) for a in arrs],
+            "dtype": str(arrs[0].dtype) if arrs else None,
+        }
+    arr = np.asarray(leaf)
+    return {"kind": "array", "shape": list(arr.shape), "dtype": str(arr.dtype)}
+
+
+def _metric_snapshot(metric: Metric) -> Dict[str, Any]:
+    state = metric.state_pytree()
+    payload: Dict[str, Any] = {}
+    spec: Dict[str, Any] = {}
+    for name, leaf in state.items():
+        spec[name] = _leaf_spec(leaf)
+        if isinstance(leaf, (tuple, list)):
+            payload[name] = [np.asarray(x) for x in leaf]
+        else:
+            payload[name] = np.asarray(leaf)
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "kind": "metric",
+        "class": class_fingerprint(metric),
+        "spec": spec,
+        "state": payload,
+    }
+
+
+def snapshot(obj: Any) -> Dict[str, Any]:
+    """Versioned host-numpy snapshot of a metric or collection.
+
+    The result is self-describing (schema version, class fingerprint,
+    per-leaf shape/dtype spec) so :func:`restore` can reject corruption or a
+    config mismatch with a structured error instead of poisoning state.
+    Plain dict/list/numpy payload: picklable and ``np.savez``/orbax-friendly.
+    """
+    from torchmetrics_tpu.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        groups: Optional[List[List[str]]] = None
+        if obj._groups and obj._groups_checked:
+            groups = [list(members) for members in obj._groups.values()]
+        return {
+            "schema_version": SCHEMA_VERSION,
+            "kind": "collection",
+            "class": class_fingerprint(obj),
+            "groups": groups,
+            "metrics": {key: _metric_snapshot(m) for key, m in obj.items(keep_base=True)},
+        }
+    if isinstance(obj, Metric):
+        return _metric_snapshot(obj)
+    raise TypeError(f"snapshot() takes a Metric or MetricCollection, got {type(obj).__name__}")
+
+
+# ------------------------------------------------------------------- restore
+def _check_header(snap: Any, expect_kind: str, target: Any, strict_class: bool) -> None:
+    if not isinstance(snap, Mapping):
+        raise StateRestoreError(
+            f"Snapshot must be a mapping, got {type(snap).__name__}.", reason="structure"
+        )
+    version = snap.get("schema_version")
+    if version != SCHEMA_VERSION:
+        raise StateRestoreError(
+            f"Snapshot schema_version {version!r} is not supported (this build reads "
+            f"version {SCHEMA_VERSION}).",
+            reason="schema-version",
+        )
+    kind = snap.get("kind")
+    if kind != expect_kind:
+        raise StateRestoreError(
+            f"Snapshot kind {kind!r} cannot restore into a {type(target).__name__} "
+            f"(expected kind {expect_kind!r}).",
+            reason="kind",
+        )
+    if strict_class and snap.get("class") != class_fingerprint(target):
+        raise StateRestoreError(
+            f"Snapshot was taken from class {snap.get('class')!r} but is being restored "
+            f"into {class_fingerprint(target)!r}; pass strict_class=False to override.",
+            reason="class",
+        )
+
+
+def _check_payload_matches_spec(snap: Mapping[str, Any]) -> None:
+    """Detect corruption: the recorded per-leaf spec must match the payload."""
+    spec, payload = snap.get("spec"), snap.get("state")
+    if not isinstance(spec, Mapping) or not isinstance(payload, Mapping):
+        raise StateRestoreError(
+            "Snapshot is missing its 'spec'/'state' sections.", reason="structure"
+        )
+    for name in spec:
+        if name not in payload:
+            raise StateRestoreError(
+                f"Snapshot spec lists leaf {name!r} but the payload does not contain it "
+                "(truncated or corrupted snapshot).",
+                leaf=name,
+                reason="corrupt",
+            )
+    for name, leaf in payload.items():
+        entry = spec.get(name)
+        if entry is None:
+            raise StateRestoreError(
+                f"Snapshot payload contains leaf {name!r} with no spec entry "
+                "(corrupted snapshot).",
+                leaf=name,
+                reason="corrupt",
+            )
+        actual = _leaf_spec(leaf)
+        if entry.get("kind") != actual["kind"]:
+            raise StateRestoreError(
+                f"Snapshot leaf {name!r} payload kind {actual['kind']!r} does not match its "
+                f"recorded spec kind {entry.get('kind')!r} (corrupted snapshot).",
+                leaf=name,
+                reason="corrupt",
+            )
+        if actual["kind"] == "array":
+            if list(entry.get("shape", [])) != actual["shape"] or entry.get("dtype") != actual["dtype"]:
+                raise StateRestoreError(
+                    f"Snapshot leaf {name!r} payload (shape {actual['shape']}, dtype "
+                    f"{actual['dtype']}) does not match its recorded spec (shape "
+                    f"{entry.get('shape')}, dtype {entry.get('dtype')}) — corrupted snapshot.",
+                    leaf=name,
+                    reason="corrupt",
+                )
+        elif entry.get("length") != actual["length"] or entry.get("shapes") != actual["shapes"]:
+            raise StateRestoreError(
+                f"Snapshot list leaf {name!r} payload does not match its recorded item "
+                "shapes (corrupted snapshot).",
+                leaf=name,
+                reason="corrupt",
+            )
+
+
+def _restore_metric(metric: Metric, snap: Mapping[str, Any], strict_class: bool) -> State:
+    """Validate a metric snapshot fully; return the installable state."""
+    _check_header(snap, "metric", metric, strict_class)
+    _check_payload_matches_spec(snap)
+    return validate_state_pytree(metric, snap["state"])
+
+
+def _install(metric: Metric, state: State) -> None:
+    metric._state = state
+    metric._state_shared = False  # restored buffers are fresh — donation is safe again
+    metric._computed = None
+    metric._forward_cache = None
+    metric._nf_reported = 0
+
+
+def restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True) -> None:
+    """Validate-then-install a snapshot into a metric or collection.
+
+    Validation is all-or-nothing: every leaf of every member is checked
+    (structure, shapes, dtypes, class fingerprint, spec/payload agreement)
+    before ANY state is installed, so a failed restore leaves the target
+    untouched.  For collections the snapshot's compute-group partition is
+    re-established: members of a group share their leader's restored pytree
+    and are re-marked as aliased (``_state_shared``) so compiled updates
+    keep honoring the no-donate-aliased-state contract.
+    """
+    from torchmetrics_tpu.collections import MetricCollection
+
+    if isinstance(obj, MetricCollection):
+        _check_header(snap, "collection", obj, strict_class)
+        members_snap = snap.get("metrics")
+        if not isinstance(members_snap, Mapping):
+            raise StateRestoreError(
+                "Collection snapshot is missing its 'metrics' section.", reason="structure"
+            )
+        keys = set(obj.keys(keep_base=True))
+        missing = sorted(keys - set(members_snap))
+        if missing:
+            raise StateRestoreError(
+                f"Collection snapshot is missing member {missing[0]!r} (all missing: {missing}).",
+                leaf=missing[0],
+                reason="missing-leaf",
+            )
+        unknown = sorted(set(members_snap) - keys)
+        if unknown:
+            raise StateRestoreError(
+                f"Collection snapshot contains unknown member {unknown[0]!r} "
+                f"(all unknown: {unknown}).",
+                leaf=unknown[0],
+                reason="unknown-leaf",
+            )
+        groups = snap.get("groups")
+        if groups is not None:
+            flat = [name for members in groups for name in members]
+            bad = sorted(set(flat) - keys)
+            if bad:
+                raise StateRestoreError(
+                    f"Snapshot compute group names {bad} are not members of this collection.",
+                    leaf=bad[0],
+                    reason="groups",
+                )
+            if len(flat) != len(set(flat)):
+                raise StateRestoreError(
+                    "Snapshot compute groups assign a metric to more than one group.",
+                    reason="groups",
+                )
+        # two-phase: validate everything, then install everything
+        staged = {key: _restore_metric(obj[key], members_snap[key], strict_class) for key in keys}
+        for key in keys:
+            _install(obj[key], staged[key])
+        if groups is not None:
+            obj._groups = {i: list(members) for i, members in enumerate(groups)}
+            obj._groups_checked = True
+            for members in groups:
+                leader_state = obj[members[0]]._state
+                for name in members[1:]:
+                    obj[name]._state = leader_state
+                obj._mark_shared(list(members))
+        return
+    if isinstance(obj, Metric):
+        _install(obj, _restore_metric(obj, snap, strict_class))
+        return
+    raise TypeError(f"restore() takes a Metric or MetricCollection, got {type(obj).__name__}")

@@ -1,8 +1,12 @@
 """Exception types.
 
 TPU-native re-design of the reference's ``utilities/exceptions.py``
-(see /root/reference/src/torchmetrics/utilities/exceptions.py:16,20).
+(see /root/reference/src/torchmetrics/utilities/exceptions.py:16,20), plus
+the structured resilience errors raised by the checkpoint/restore and
+cross-replica verification paths (``torchmetrics_tpu/resilience``).
 """
+
+from typing import Optional, Sequence
 
 
 class TorchMetricsUserError(Exception):
@@ -11,3 +15,72 @@ class TorchMetricsUserError(Exception):
 
 class TorchMetricsUserWarning(UserWarning):
     """Warning raised on questionable usage of the metric API."""
+
+
+class StateRestoreError(TorchMetricsUserError):
+    """A snapshot/state-dict failed validation before being installed.
+
+    Raised by ``resilience.restore`` / ``Metric.load_state_pytree`` /
+    ``Metric.load_state_dict`` when a checkpoint's structure, shapes, dtypes,
+    or class fingerprint do not match the metric it is being restored into —
+    *before* any ``_state`` leaf is touched, so a failed restore never leaves
+    a metric half-loaded (and never surfaces as a shape error deep inside a
+    compiled update steps later).
+
+    Attributes:
+        leaf: name of the offending state leaf (``None`` for structural /
+            class-level mismatches).
+        reason: machine-readable mismatch category, e.g. ``"shape"``,
+            ``"dtype"``, ``"missing-leaf"``, ``"unknown-leaf"``, ``"class"``,
+            ``"schema-version"``.
+    """
+
+    def __init__(self, message: str, *, leaf: Optional[str] = None, reason: Optional[str] = None) -> None:
+        super().__init__(message)
+        self.leaf = leaf
+        self.reason = reason
+
+
+class ReplicaDivergenceError(TorchMetricsUserError):
+    """Metric state disagrees across replicas that must hold identical state.
+
+    Raised by ``resilience.verify_replica_consistency`` (and the opt-in
+    ``verify_consistency`` hooks in ``parallel.sync.sharded_update`` /
+    ``parallel.ragged``) when per-replica state checksums do not agree —
+    e.g. after an uneven restore across hosts, or a replica-local
+    perturbation.  Catching this at sync time turns a silently wrong
+    aggregate into a hard error.
+
+    Attributes:
+        leaves: names of the state leaves whose checksums diverged.
+        replicas: indices of the replicas that disagree with the majority
+            (``None`` when the divergent replica cannot be identified, e.g.
+            on the in-graph flag-only path).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        leaves: Sequence[str] = (),
+        replicas: Optional[Sequence[int]] = None,
+    ) -> None:
+        super().__init__(message)
+        self.leaves = tuple(leaves)
+        self.replicas = tuple(replicas) if replicas is not None else None
+
+
+class NonFiniteStateError(TorchMetricsUserError):
+    """A metric running with ``nan_strategy="error"`` accumulated NaN/Inf.
+
+    The non-finite check is jit-safe: compiled updates only *count*
+    non-finite values into a reserved state leaf, and this error is raised by
+    the deferred host-side check (``Metric.compute`` / eager ``update``).
+
+    Attributes:
+        count: number of non-finite values found in the state.
+    """
+
+    def __init__(self, message: str, *, count: int = 0) -> None:
+        super().__init__(message)
+        self.count = count
