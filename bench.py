@@ -652,6 +652,67 @@ def resilience_leg():
     }
 
 
+def observability_leg():
+    """Telemetry cost: per-step price of the observability layer on the
+    compiled update path, enabled vs disabled, with the retrace counter
+    proving telemetry adds zero compilations (the flag never enters a cache
+    key) and a smoke round-trip of all three exporters.
+    """
+    import io
+
+    import numpy as np
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+
+    n_cls = int(os.environ.get("BENCH_OBS_CLASSES", 256))
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, n_cls, 4096))
+    tgt = jnp.asarray(rng.integers(0, n_cls, 4096))
+
+    def step_us(enabled):
+        clear_compile_cache()
+        obs.reset_telemetry()
+        (obs.enable if enabled else obs.disable)()
+        m = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False, jit=True)
+        m.update(preds, tgt)  # compile
+        inner = 50
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            m.update(preds, tgt)
+        jax.block_until_ready(m._state["confmat"])
+        return (time.perf_counter() - t0) / inner * 1e6, cache_stats()["traces"]
+
+    try:
+        off_us, off_traces = step_us(False)
+        on_us, on_traces = step_us(True)
+
+        # exporter round trip over the enabled run's report
+        obs.enable()
+        report = obs.report()
+        line = obs.export(report, fmt="jsonl", stream=io.StringIO())
+        jsonl_roundtrip = json.loads(line)["enabled"] is True
+        prom_text = obs.export(report, fmt="prometheus")
+        prom_lines = len(prom_text.splitlines())
+        obs.export(report, fmt="log")
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+        clear_compile_cache()
+
+    return {
+        "metric": f"MulticlassConfusionMatrix({n_cls}) jitted update",
+        "update_us_telemetry_off": round(off_us, 1),
+        "update_us_telemetry_on": round(on_us, 1),
+        "enabled_overhead_pct": round((on_us - off_us) / off_us * 100.0, 2),
+        "telemetry_extra_retraces": on_traces - off_traces,  # must be 0
+        "exporters": {"jsonl_roundtrip": jsonl_roundtrip, "prometheus_lines": prom_lines},
+        "note": "telemetry never enters compile-cache keys (0 extra retraces by "
+        "construction); the disabled path is one flag check per entry point",
+    }
+
+
 def kernel_vs_reference():
     """Opt-in head-to-head of our jitted kernels vs the installed torch
     reference (stat_scores / confusion_matrix / PSNR).  Skips cleanly —
@@ -802,6 +863,10 @@ def main():
         resilience = resilience_leg()
     except Exception as err:  # noqa: BLE001
         resilience = {"error": f"resilience leg failed: {err}"}
+    try:
+        observability = observability_leg()
+    except Exception as err:  # noqa: BLE001
+        observability = {"error": f"observability leg failed: {err}"}
 
     print(json.dumps({
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
@@ -828,6 +893,7 @@ def main():
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
+            "observability": observability,
             "state_reduce_bytes_1_to_64_chips": state_reduce_bytes_table(),
             "model": f"ResNet-50 ({n_params / 1e6:.1f}M params, bf16)",
             "batch": BATCH, "image": IMG, "num_classes": NUM_CLASSES,

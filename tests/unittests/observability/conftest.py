@@ -1,0 +1,19 @@
+"""Telemetry tests sandbox every case: fresh compile cache and registry in,
+globally-disabled layer out — the enable flag must never leak into the rest
+of the suite (other tier-1 tests assume the default-off contract)."""
+
+import pytest
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.core.compile import clear_compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_sandbox():
+    clear_compile_cache()
+    obs.disable()
+    obs.reset_telemetry()
+    yield
+    obs.disable()
+    obs.reset_telemetry()
+    clear_compile_cache()

@@ -1,0 +1,97 @@
+"""The off-by-default contract: with telemetry disabled the library behaves
+bit-for-bit as if the observability layer did not exist — same compiled
+results, same number of traces, no registry rows, shared no-op span."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+from torchmetrics_tpu.observability.registry import span as _span
+from torchmetrics_tpu.parallel import sharded_update
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+def _jit_flow():
+    clear_compile_cache()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    for _ in range(3):
+        m.update(PREDS, TARGET)
+    out = m.compute()
+    stats = cache_stats()
+    return np.asarray(out), stats["traces"], stats["by_entrypoint"]
+
+
+def test_zero_extra_traces_and_identical_results():
+    obs.disable()
+    result_off, traces_off, by_off = _jit_flow()
+
+    obs.enable()
+    result_on, traces_on, by_on = _jit_flow()
+
+    assert traces_on == traces_off  # telemetry never enters a cache key
+    assert by_on == by_off
+    np.testing.assert_array_equal(result_on, result_off)
+
+
+def test_sharded_flow_zero_extra_traces(mesh):
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 5, 8 * NUM_DEVICES))
+    target = jnp.asarray(rng.integers(0, 5, 8 * NUM_DEVICES))
+    spec = NamedSharding(mesh, P("data"))
+
+    def flow():
+        clear_compile_cache()
+        m = MulticlassAccuracy(num_classes=5, average="micro")
+        synced = sharded_update(
+            m,
+            jax.device_put(preds, spec),
+            jax.device_put(target, spec),
+            mesh=mesh,
+            axis_name="data",
+        )
+        return np.asarray(m.compute_state(synced)), cache_stats()["traces"]
+
+    obs.disable()
+    result_off, traces_off = flow()
+    obs.enable()
+    result_on, traces_on = flow()
+    assert traces_on == traces_off
+    np.testing.assert_array_equal(result_on, result_off)
+
+
+def test_disabled_records_nothing():
+    assert not obs.enabled()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    m.compute()
+    m.reset()
+    rep = obs.report()
+    assert rep["enabled"] is False
+    assert rep["metrics"] == {}
+    assert rep["global"]["counters"]["updates"] == 0
+
+
+def test_disabled_span_is_shared_noop():
+    assert not obs.enabled()
+    m = MulticlassAccuracy(num_classes=5)
+    # one preallocated null context, not a fresh object per boundary
+    assert _span(m, "update") is _span(m, "compute")
+
+
+def test_enable_disable_idempotent():
+    obs.enable()
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    # double-subscribe must not double-count cache events
+    assert m.telemetry.as_dict()["cache"]["update"]["misses"] == 1
+    obs.disable()
+    obs.disable()
+    assert not obs.enabled()

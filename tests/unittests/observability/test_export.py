@@ -1,0 +1,186 @@
+"""Exporter round-trips: JSONL parse-back, Prometheus text-exposition lint,
+and the structured-logging backend."""
+
+import io
+import json
+import logging
+import re
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.observability import (
+    JSONLinesExporter,
+    LoggingExporter,
+    PrometheusExporter,
+    export,
+)
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+def _activity():
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    m.update(PREDS, TARGET)
+    m.compute()
+    b = BinaryAccuracy()
+    b.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    return obs.report()
+
+
+# --------------------------------------------------------------------- jsonl
+def test_jsonl_stream_roundtrip():
+    report = _activity()
+    buf = io.StringIO()
+    line = export(report, fmt="jsonl", stream=buf)
+    assert buf.getvalue() == line + "\n"
+    back = json.loads(line)
+    assert back["schema"] == 1 and back["enabled"] is True
+    assert set(back["metrics"]) == set(report["metrics"])
+    label, row = next(iter(sorted(report["metrics"].items())))
+    assert back["metrics"][label]["counters"] == row["counters"]
+    assert back["compile_cache"]["by_entrypoint"] == report["compile_cache"]["by_entrypoint"]
+
+
+def test_jsonl_path_appends_one_line_per_export(tmp_path):
+    report = _activity()
+    path = tmp_path / "telemetry.jsonl"
+    export(report, fmt="jsonl", path=str(path))
+    export(report, fmt="jsonl", path=str(path))
+    lines = path.read_text().splitlines()
+    assert len(lines) == 2
+    assert all(json.loads(ln)["schema"] == 1 for ln in lines)
+
+
+def test_jsonl_needs_exactly_one_sink():
+    with pytest.raises(ValueError, match="exactly one"):
+        JSONLinesExporter()
+    with pytest.raises(ValueError, match="exactly one"):
+        JSONLinesExporter(path="x", stream=io.StringIO())
+
+
+# ---------------------------------------------------------------- prometheus
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"  # metric name
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?[0-9]+(\.[0-9]+(e[+-]?[0-9]+)?)?$"
+)
+
+
+def test_prometheus_exposition_lints():
+    report = _activity()
+    text = export(report, fmt="prometheus")
+    lines = text.splitlines()
+    assert text.endswith("\n")
+
+    helped, typed = set(), set()
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+        elif ln.startswith("# TYPE "):
+            parts = ln.split()
+            assert parts[3] in ("counter", "histogram")
+            typed.add(parts[2])
+        else:
+            assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"
+    # every family documented, every family typed
+    assert helped == typed and helped
+
+    # counters end in _total and every declared counter family has samples
+    assert any(ln.startswith("tm_tpu_updates_total{") for ln in lines)
+
+    # histogram contract: cumulative buckets ending at +Inf == _count
+    def _label_dict(ln):
+        return dict(re.findall(r'([a-zA-Z_]+)="([^"]*)"', ln))
+
+    bucket_series = {}
+    counts = {}
+    for ln in lines:
+        if ln.startswith("tm_tpu_span_seconds_bucket{"):
+            lbl = _label_dict(ln)
+            bucket_series.setdefault((lbl["metric"], lbl["span"]), []).append(
+                (lbl["le"], int(ln.rsplit(" ", 1)[1]))
+            )
+        elif ln.startswith("tm_tpu_span_seconds_count{"):
+            lbl = _label_dict(ln)
+            counts[(lbl["metric"], lbl["span"])] = int(ln.rsplit(" ", 1)[1])
+    assert bucket_series
+    for key, series in bucket_series.items():
+        values = [v for _, v in series]
+        assert values == sorted(values), f"non-cumulative buckets in {key}"
+        assert series[-1][0] == "+Inf"
+        assert counts[key] == series[-1][1]
+
+
+def test_prometheus_label_escaping():
+    report = {
+        "metrics": {
+            'we"ird\nlabel\\x': {
+                "class": "X",
+                "counters": {"updates": 1},
+                "cache": {},
+                "spans": {},
+            }
+        },
+        "global": {},
+        "compile_cache": {},
+    }
+    text = PrometheusExporter().export(report)
+    line = next(ln for ln in text.splitlines() if ln.startswith("tm_tpu_updates_total{"))
+    assert '\\"' in line and "\\n" in line and "\\\\" in line
+    assert "\n" not in line
+
+
+def test_prometheus_path_writes_file(tmp_path):
+    report = _activity()
+    path = tmp_path / "metrics.prom"
+    text = export(report, fmt="prometheus", path=str(path))
+    assert path.read_text() == text
+
+
+# -------------------------------------------------------------------- logging
+def test_logging_exporter_routes_through_library_logger(caplog):
+    report = _activity()
+    with caplog.at_level(logging.INFO, logger="torchmetrics_tpu.observability"):
+        out = export(report, fmt="log")
+    assert out is None
+    messages = [r.getMessage() for r in caplog.records]
+    assert any(msg.startswith("telemetry:") for msg in messages)
+    # label seq numbers are process-global, so match on the class prefix
+    assert any("telemetry[MulticlassAccuracy#" in msg for msg in messages)
+    # structured payload rides on the record for structured handlers
+    head = next(r for r in caplog.records if r.getMessage().startswith("telemetry:"))
+    assert head.telemetry["schema"] == 1
+
+
+def test_logging_exporter_custom_logger_and_level(caplog):
+    report = _activity()
+    logger = logging.getLogger("test.telemetry.custom")
+    with caplog.at_level(logging.DEBUG, logger="test.telemetry.custom"):
+        LoggingExporter(logger=logger, level=logging.DEBUG).export(report)
+    assert caplog.records and all(r.levelno == logging.DEBUG for r in caplog.records)
+
+
+# ------------------------------------------------------------------ front door
+def test_export_defaults_to_fresh_report():
+    _activity()
+    line = export(fmt="jsonl", stream=io.StringIO())
+    assert json.loads(line)["enabled"] is True
+
+
+def test_export_unknown_fmt():
+    with pytest.raises(ValueError, match="unknown telemetry export format"):
+        export({}, fmt="csv")
+
+
+def test_export_custom_exporter_instance():
+    class Capture:
+        def export(self, report):
+            return report.get("schema")
+
+    assert export({"schema": 1}, exporter=Capture()) == 1

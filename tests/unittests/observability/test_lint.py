@@ -1,0 +1,42 @@
+"""Library hygiene lint: no bare ``print(`` inside ``torchmetrics_tpu/``.
+
+User-facing output must go through the ``torchmetrics_tpu`` logger (which
+carries a ``NullHandler`` — see ``utilities/prints.py``) or the rank-zero
+helpers, never stdout.  Allowed exceptions: ``utilities/prints.py`` itself
+and ``utilities/plot.py`` (interactive plotting helper).
+"""
+
+import ast
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parents[3] / "torchmetrics_tpu"
+ALLOWED = {"utilities/prints.py", "utilities/plot.py", "plot.py"}
+
+
+def _bare_prints(path: Path):
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            yield node.lineno
+
+
+def test_package_importable_from_expected_location():
+    assert PACKAGE.is_dir(), f"package not found at {PACKAGE}"
+    assert (PACKAGE / "__init__.py").is_file()
+
+
+def test_no_bare_print_in_library():
+    offenders = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel in ALLOWED:
+            continue
+        offenders.extend(f"{rel}:{lineno}" for lineno in _bare_prints(path))
+    assert not offenders, (
+        "bare print() calls found (route output through the torchmetrics_tpu "
+        f"logger or utilities.prints helpers instead): {offenders}"
+    )

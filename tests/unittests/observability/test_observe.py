@@ -1,0 +1,94 @@
+"""``observe()`` window semantics: scoped enable, diff correctness, and
+flag restoration."""
+
+import io
+import json
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import BinaryAccuracy
+
+PROBS = jnp.asarray([0.9, 0.2, 0.8, 0.4])
+TARGET = jnp.asarray([1, 0, 1, 0])
+
+
+def test_window_diff_counts_only_inside():
+    obs.enable()
+    m = BinaryAccuracy()
+    m.update(PROBS, TARGET)  # before the window: must not appear in the diff
+    label = m.telemetry.label
+
+    with obs.observe("epoch-0") as window:
+        m.update(PROBS, TARGET)
+        m.update(PROBS, TARGET)
+        m.compute()
+
+    row = window.diff["metrics"][label]
+    assert row["counters"]["updates"] == 2
+    assert row["counters"]["computes"] == 1
+    assert window.diff["global"]["counters"]["updates"] == 2
+    # absolute snapshots stay available alongside the diff
+    assert window.before["metrics"][label]["counters"]["updates"] == 1
+    assert window.after["metrics"][label]["counters"]["updates"] == 3
+
+
+def test_window_span_diff_keeps_point_in_time_stats():
+    obs.enable()
+    m = BinaryAccuracy()
+    m.update(PROBS, TARGET)
+    label = m.telemetry.label
+    with obs.observe() as window:
+        m.update(PROBS, TARGET)
+    span = window.diff["metrics"][label]["spans"]["update"]
+    assert span["count"] == 1  # only the in-window sample
+    assert span["ema_us"] > 0  # EMA/max are end-of-window values, not deltas
+    assert sum(n for _, n in span["buckets"]) == 1
+
+
+def test_observe_enables_for_window_then_restores():
+    assert not obs.enabled()
+    m = BinaryAccuracy()
+    with obs.observe("scoped"):
+        assert obs.enabled()
+        m.update(PROBS, TARGET)
+    assert not obs.enabled()
+    # activity after the window is invisible again
+    m.update(PROBS, TARGET)
+    assert m.telemetry.as_dict()["counters"]["updates"] == 1
+
+
+def test_observe_preserves_already_enabled_flag():
+    obs.enable()
+    with obs.observe():
+        assert obs.enabled()
+    assert obs.enabled()
+
+
+def test_observe_without_enable_just_snapshots():
+    assert not obs.enabled()
+    m = BinaryAccuracy()
+    with obs.observe(enable=False) as window:
+        assert not obs.enabled()
+        m.update(PROBS, TARGET)
+    assert window.diff["global"]["counters"].get("updates", 0) == 0
+
+
+def test_window_export_carries_label():
+    with obs.observe("eval-epoch-3") as window:
+        m = BinaryAccuracy()
+        m.update(PROBS, TARGET)
+    line = window.export(fmt="jsonl", stream=io.StringIO())
+    payload = json.loads(line)
+    assert payload["window"] == "eval-epoch-3"
+    assert payload["global"]["counters"]["updates"] == 1
+
+
+def test_nested_metric_created_inside_window():
+    with obs.observe() as window:
+        m = BinaryAccuracy()
+        m.update(PROBS, TARGET)
+        label = m.telemetry.label
+    # no `before` row for a metric born inside the window: diff is absolute
+    assert label not in window.before["metrics"]
+    assert window.diff["metrics"][label]["counters"]["updates"] == 1

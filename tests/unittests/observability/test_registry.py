@@ -1,0 +1,222 @@
+"""Instrumentation registry: counter correctness on single metrics, the
+8-device mesh sync paths, and per-instance compile-cache attribution."""
+
+import gc
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import MetricCollection, observability as obs
+from torchmetrics_tpu import resilience
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.core.compile import cache_stats
+from torchmetrics_tpu.observability import COUNTER_NAMES, telemetry_for
+from torchmetrics_tpu.parallel import sharded_update
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+def _zeros_except(**nonzero):
+    out = {name: 0 for name in COUNTER_NAMES}
+    out.update(nonzero)
+    return out
+
+
+def test_counters_jit_lifecycle():
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    m.update(PREDS, TARGET)
+    m.compute()
+    m.forward(PREDS, TARGET)
+    m.reset()
+
+    row = m.telemetry.as_dict()
+    c = row["counters"]
+    # forward() on a fresh batch also advances the accumulator once
+    assert c["updates"] == 2
+    assert c["computes"] >= 1
+    assert c["forwards"] == 1
+    assert c["resets"] == 1
+    # jit path with un-aliased state donates every install
+    assert c["donated_installs"] == c["updates"] + c["forwards"]
+    assert c["copied_installs"] == 0
+    assert c["syncs"] == 0 and c["sync_bytes"] == 0
+
+    # one trace for the update geometry, the repeat calls hit
+    upd = row["cache"]["update"]
+    assert upd["misses"] == 1
+    assert upd["traces"] == 1
+    assert upd["hits"] >= 1
+
+    # host boundaries were timed
+    assert row["spans"]["update"]["count"] == 2
+    assert row["spans"]["compute"]["count"] >= 1
+
+
+def test_counters_eager_path():
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, jit=False)
+    m.update(PREDS, TARGET)
+    m.compute()
+    c = m.telemetry.as_dict()["counters"]
+    assert c == _zeros_except(updates=1, computes=1)
+
+
+def test_telemetry_property_is_registry_row_not_attribute():
+    obs.enable()
+    m = BinaryAccuracy()
+    row = m.telemetry
+    assert row is telemetry_for(m)
+    # identity-keyed registry storage: nothing lands on the instance itself,
+    # so deepcopy/pickle/config fingerprints never see telemetry state
+    assert "telemetry" not in vars(m)
+
+
+def test_cache_attribution_matches_global_breakdown():
+    obs.enable()
+    before = cache_stats()["by_entrypoint"]["update"]
+
+    a = MulticlassAccuracy(num_classes=5, jit=True)
+    b = MulticlassAccuracy(num_classes=5, jit=True)  # same config: shares a's entry
+    a.update(PREDS, TARGET)
+    a.update(PREDS, TARGET)
+    b.update(PREDS, TARGET)
+
+    after = cache_stats()["by_entrypoint"]["update"]
+    delta = {f: after[f] - before.get(f, 0) for f in ("hits", "misses", "traces")}
+
+    ra = a.telemetry.as_dict()["cache"]["update"]
+    rb = b.telemetry.as_dict()["cache"].get("update", {})
+    summed = {f: ra.get(f, 0) + rb.get(f, 0) for f in ("hits", "misses", "traces")}
+    assert summed == delta
+    # the trace belongs to the instance whose call created the entry
+    assert ra["traces"] == 1 and rb.get("traces", 0) == 0
+    assert rb.get("hits", 0) == 1
+
+
+def test_sharded_sync_bytes_match_cost_model(mesh):
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 5, 64))
+    target = jnp.asarray(rng.integers(0, 5, 64))
+    spec = NamedSharding(mesh, P("data"))
+    synced = sharded_update(
+        m,
+        jax.device_put(preds, spec),
+        jax.device_put(target, spec),
+        mesh=mesh,
+        axis_name="data",
+    )
+
+    row = m.telemetry.as_dict()
+    assert row["counters"]["syncs"] == 1
+    expected = sync_bytes_per_chip(m._reductions, dict(synced), NUM_DEVICES)
+    assert row["counters"]["sync_bytes"] == expected > 0
+    # the sharded entry point is attributed to this instance
+    assert row["cache"]["sharded"]["traces"] == 1
+    assert row["spans"]["sync"]["count"] == 1
+
+
+def test_nonfinite_events_counted():
+    obs.enable()
+    m = MeanSquaredError(nan_strategy="warn")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m.update(jnp.asarray([1.0, float("nan")]), jnp.asarray([1.0, 2.0]))
+        m.compute()
+    assert m.telemetry.as_dict()["counters"]["nonfinite_events"] >= 1
+
+
+def test_snapshot_restore_counters():
+    obs.enable()
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    snap = resilience.snapshot(m)
+    resilience.restore(m, snap)
+    m.load_state_dict(m.state_dict())
+    c = m.telemetry.as_dict()["counters"]
+    assert c["snapshots"] == 1
+    assert c["restores"] == 2  # resilience.restore + load_state_dict
+
+
+def test_dead_instances_fold_into_retired():
+    obs.enable()
+
+    def scoped():
+        m = BinaryAccuracy()
+        m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+
+    scoped()
+    gc.collect()
+    rows = obs.report()["metrics"]
+    assert "_retired" in rows
+    assert rows["_retired"]["counters"]["updates"] == 1
+
+
+def test_collection_telemetry_aggregates_members():
+    obs.enable()
+    coll = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=5),
+            "bacc": MulticlassAccuracy(num_classes=5, average="macro"),
+        }
+    )
+    coll.update(PREDS, TARGET)
+    coll.compute()
+    tel = coll.telemetry
+    assert set(tel) == {"collection", "members", "aggregate"}
+    assert set(tel["members"]) == {"acc", "bacc"}
+    agg = tel["aggregate"]["counters"]
+    member_updates = sum(m["counters"]["updates"] for m in tel["members"].values())
+    assert agg["updates"] >= member_updates
+    assert agg["computes"] >= 1
+
+
+def test_report_global_sums_rows():
+    obs.enable()
+    a = BinaryAccuracy()
+    b = BinaryAccuracy()
+    a.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    b.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    b.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    rep = obs.report()
+    assert rep["schema"] == 1 and rep["enabled"] is True
+    assert rep["global"]["counters"]["updates"] == sum(
+        row["counters"]["updates"] for row in rep["metrics"].values()
+    ) == 3
+    assert "by_entrypoint" in rep["compile_cache"]
+
+
+def test_disabled_creates_no_rows():
+    assert not obs.enabled()
+    m = BinaryAccuracy(jit=True)
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    m.compute()
+    assert obs.report()["metrics"] == {}
+    assert telemetry_for(m, create=False) is None
+
+
+def test_reset_telemetry_zeroes_but_keeps_rows():
+    obs.enable()
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    assert m.telemetry.as_dict()["counters"]["updates"] == 1
+    obs.reset_telemetry()
+    row = m.telemetry.as_dict()
+    assert row["counters"] == _zeros_except()
+    assert row["spans"] == {} and row["cache"] == {}
+
+
+@pytest.mark.parametrize("name", ["updates", "sync_bytes", "restores"])
+def test_counter_names_cover_issue_surface(name):
+    assert name in COUNTER_NAMES

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.observability import registry as _telemetry
 from torchmetrics_tpu.utilities.data import _flatten_dict, allclose
 
 
@@ -224,7 +225,15 @@ class MetricCollection(dict):
         fn = compiled_collection_update(self, leaders, args, kwargs)
         # the previous states are donated — dead after this call; every
         # member (leaders included) is re-pointed at the returned states
-        new_states = fn({name: self[name]._state for name in leaders}, *args, **kwargs)
+        with _telemetry.span(self, "update"):
+            new_states = fn({name: self[name]._state for name in leaders}, *args, **kwargs)
+        if _telemetry.enabled():
+            _telemetry.count(self, "updates")
+            # leaders advanced inside the fused graph without their own
+            # update() running — keep their per-instance counters truthful
+            for name in leaders:
+                _telemetry.count(self[name], "updates")
+                _telemetry.count(self[name], "donated_installs")
         for members in self._groups.values():
             leader_state = new_states[members[0]]
             for name in members:
@@ -273,6 +282,27 @@ class MetricCollection(dict):
     def reset(self) -> None:
         for m in self.values(copy_state=False):
             m.reset()
+
+    @property
+    def telemetry(self) -> Dict[str, Any]:
+        """Collection-level telemetry view (observability layer).
+
+        Returns ``{"collection": <own row>, "members": {name: row, ...},
+        "aggregate": <sum>}``: the collection's own counters (fused updates
+        land here), every member's per-instance telemetry, and their
+        aggregate.  Accumulates only while
+        ``torchmetrics_tpu.observability.enable()`` is on.
+        """
+        own = _telemetry.telemetry_for(self).as_dict()
+        members = {
+            name: _telemetry.telemetry_for(m).as_dict()
+            for name, m in self.items(keep_base=True, copy_state=False)
+        }
+        return {
+            "collection": own,
+            "members": members,
+            "aggregate": _telemetry.aggregate_telemetry([own, *members.values()]),
+        }
 
     def _to_renamed_dict(self, res: Dict[str, Any]) -> Dict[str, Any]:
         res, _ = _flatten_dict(res)

@@ -44,18 +44,27 @@ config-churning or shape-churning long jobs at a bounded footprint.
   buffers, so mAP/ROUGE-style per-batch geometry changes collapse into a
   handful of bucketed shapes instead of one retrace per geometry.
 
-The registry also counts hits/misses/traces (:func:`cache_stats`) — the
-``bench.py`` retrace legs read these counters.
+The registry also counts hits/misses/traces (:func:`cache_stats`) — flat
+totals plus a per-entrypoint ``by_entrypoint`` breakdown — and publishes
+every cache event to registered observers (:func:`add_cache_observer`; the
+observability layer subscribes while telemetry is enabled, attributing
+events to owning metric instances via weakrefs that never enter cache
+keys).  Every compiled step body also runs under a
+``tm_tpu/<MetricClass>/<entrypoint>`` ``jax.named_scope`` so metric work is
+attributable in xplane/Perfetto profiler traces; scopes are trace-time
+metadata only and cannot cause retraces.
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 import os
 import threading
+import weakref
 from collections import OrderedDict
 from copy import deepcopy
-from typing import Any, Callable, Dict, Hashable, Mapping, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,6 +72,9 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
+    "CACHE_KINDS",
+    "add_cache_observer",
+    "remove_cache_observer",
     "shard_map",
     "abstract_signature",
     "bucket_dim",
@@ -119,6 +131,62 @@ _LOCK = threading.RLock()
 _CACHE: "OrderedDict[Hashable, Callable]" = OrderedDict()
 _CACHE_CAPACITY = max(1, int(os.environ.get("TM_TPU_COMPILE_CACHE_SIZE", "512")))
 _STATS = {"hits": 0, "misses": 0, "traces": 0, "evictions": 0}
+
+#: entry-point kinds the per-entrypoint breakdown tracks (``cache_stats()
+#: ["by_entrypoint"]``); flat totals above stay the back-compat surface
+CACHE_KINDS = (
+    "update",
+    "forward",
+    "sharded",
+    "ragged",
+    "collection",
+    "sharded_collection",
+    "divergence",
+)
+
+
+def _fresh_kind_stats() -> Dict[str, Dict[str, int]]:
+    return {kind: {"hits": 0, "misses": 0, "traces": 0} for kind in CACHE_KINDS}
+
+
+_KIND_STATS = _fresh_kind_stats()
+
+# Cache-event observers (the observability registry subscribes here while
+# telemetry is enabled).  Called OUTSIDE _LOCK — an observer that takes its
+# own lock can never deadlock against the cache, and a slow observer can't
+# stall concurrent lookups.  Exceptions are logged and swallowed: telemetry
+# must never break a compile.
+_OBSERVERS: List[Callable[[str, Optional[str], Any], None]] = []
+_OBS_LOG = logging.getLogger("torchmetrics_tpu.compile")
+
+
+def add_cache_observer(fn: Callable[[str, Optional[str], Any], None]) -> None:
+    """Subscribe ``fn(event, kind, owner)`` to cache events.
+
+    ``event`` is ``"hit" | "miss" | "trace"``, ``kind`` one of
+    :data:`CACHE_KINDS`, ``owner`` the live metric/collection the entry point
+    was invoked for (``None`` when unattributable, e.g. a dead weakref).
+    Idempotent per callable.
+    """
+    with _LOCK:
+        if fn not in _OBSERVERS:
+            _OBSERVERS.append(fn)
+
+
+def remove_cache_observer(fn: Callable[[str, Optional[str], Any], None]) -> None:
+    with _LOCK:
+        if fn in _OBSERVERS:
+            _OBSERVERS.remove(fn)
+
+
+def _notify(event: str, kind: Optional[str], owner: Any) -> None:
+    if not _OBSERVERS:
+        return
+    for fn in tuple(_OBSERVERS):
+        try:
+            fn(event, kind, owner)
+        except Exception:
+            _OBS_LOG.debug("compile-cache observer %r failed", fn, exc_info=True)
 # Strong refs to objects whose fingerprint embeds id(): while a cache entry
 # keyed on id(obj) may exist, the object must stay alive so its id cannot be
 # recycled for a different object with the same module/qualname (which would
@@ -142,15 +210,19 @@ _BASE_FINGERPRINT_EXCLUDE = frozenset(
 )
 
 
-def cache_stats() -> Dict[str, int]:
+def cache_stats() -> Dict[str, Any]:
     """Snapshot of the registry counters: hits, misses, traces, evictions.
 
     ``traces`` counts actual XLA traces (including shape-driven retraces
     inside one cached callable) — the number ``bench.py``'s retrace legs
-    watch.
+    watch.  ``by_entrypoint`` breaks hits/misses/traces down per entry-point
+    kind (:data:`CACHE_KINDS`); the flat totals remain authoritative and
+    back-compatible.
     """
     with _LOCK:
-        return dict(_STATS)
+        out: Dict[str, Any] = dict(_STATS)
+        out["by_entrypoint"] = {kind: dict(slot) for kind, slot in _KIND_STATS.items()}
+        return out
 
 
 def cache_size() -> int:
@@ -183,29 +255,56 @@ def clear_compile_cache(reset_stats: bool = True) -> None:
     through many configs or shape buckets should call this between
     evaluation phases to release compiled executables and pinned clones.
     """
+    global _KIND_STATS
     with _LOCK:
         _CACHE.clear()
         _ID_PINS.clear()
         if reset_stats:
             for k in _STATS:
                 _STATS[k] = 0
+            _KIND_STATS = _fresh_kind_stats()
 
 
-def mark_trace() -> None:
+def mark_trace(
+    kind: Optional[str] = None,
+    owner_ref: Optional["weakref.ref"] = None,
+) -> None:
     """Called from inside traced step bodies; Python runs only while XLA is
-    tracing, so each call is exactly one (re)trace."""
+    tracing, so each call is exactly one (re)trace.
+
+    ``kind`` feeds the per-entrypoint breakdown; ``owner_ref`` (a weakref to
+    the metric the cache entry was built for) lets observers attribute the
+    retrace to a live instance.  Shape-driven retraces of a shared cache
+    entry attribute to the instance that created the entry.
+    """
     with _LOCK:
         _STATS["traces"] += 1
+        if kind is not None:
+            _KIND_STATS[kind]["traces"] += 1
+    _notify("trace", kind, owner_ref() if owner_ref is not None else None)
 
 
-def _lookup(key: Hashable, build: Callable[[], Callable]) -> Callable:
+def _lookup(
+    key: Hashable,
+    build: Callable[[], Callable],
+    kind: Optional[str] = None,
+    owner: Any = None,
+) -> Callable:
     with _LOCK:
         fn = _CACHE.get(key)
-        if fn is not None:
+        hit = fn is not None
+        if hit:
             _STATS["hits"] += 1
+            if kind is not None:
+                _KIND_STATS[kind]["hits"] += 1
             _CACHE.move_to_end(key)
-            return fn
-        _STATS["misses"] += 1
+        else:
+            _STATS["misses"] += 1
+            if kind is not None:
+                _KIND_STATS[kind]["misses"] += 1
+    _notify("hit" if hit else "miss", kind, owner)
+    if hit:
+        return fn
     fn = build()  # build outside the lock: tracing can be slow
     with _LOCK:
         fn = _CACHE.setdefault(key, fn)
@@ -342,6 +441,13 @@ def _frozen_clone(metric: Any) -> Any:
     return clone
 
 
+def _scoped_member_update(member: Any, state: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any]) -> Any:
+    """One collection member's update inside its own profiler scope, so fused
+    collection graphs still attribute per-member work in traces."""
+    with jax.named_scope(f"tm_tpu/{type(member).__name__}/update"):
+        return member.update_state(state, *args, **kwargs)
+
+
 def _backend() -> str:
     try:
         return jax.default_backend()
@@ -372,16 +478,20 @@ def compiled_update(
         donate,
     )
 
+    owner_ref = weakref.ref(metric)
+    scope = f"tm_tpu/{type(metric).__name__}/update"
+
     def build() -> Callable:
         frozen = _frozen_clone(metric)
 
         def step(state, *a, **kw):
-            mark_trace()
-            return frozen.update_state(state, *a, **kw)
+            mark_trace("update", owner_ref)
+            with jax.named_scope(scope):
+                return frozen.update_state(state, *a, **kw)
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="update", owner=metric)
 
 
 def compiled_forward(
@@ -407,22 +517,26 @@ def compiled_forward(
         donate,
     )
 
+    owner_ref = weakref.ref(metric)
+    scope = f"tm_tpu/{type(metric).__name__}/forward"
+
     def build() -> Callable:
         frozen = _frozen_clone(metric)
 
         def step(state, *a, **kw):
-            mark_trace()
-            if frozen.full_state_update:
-                new = frozen.update_state(state, *a, **kw)
-                batch = frozen.update_state(frozen.init_state(), *a, **kw)
-            else:
-                batch = frozen.update_state(frozen.init_state(), *a, **kw)
-                new = frozen.merge_states(state, batch)
-            return new, frozen.compute_state(batch)
+            mark_trace("forward", owner_ref)
+            with jax.named_scope(scope):
+                if frozen.full_state_update:
+                    new = frozen.update_state(state, *a, **kw)
+                    batch = frozen.update_state(frozen.init_state(), *a, **kw)
+                else:
+                    batch = frozen.update_state(frozen.init_state(), *a, **kw)
+                    new = frozen.merge_states(state, batch)
+                return new, frozen.compute_state(batch)
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="forward", owner=metric)
 
 
 def compiled_sharded_update(
@@ -447,22 +561,26 @@ def compiled_sharded_update(
         abstract_signature(args),
     )
 
+    owner_ref = weakref.ref(metric)
+    scope = f"tm_tpu/{type(metric).__name__}/sharded_update"
+
     def build() -> Callable:
         frozen = _frozen_clone(metric)
 
         def step(*shards):
-            mark_trace()
-            st = frozen.update_state(frozen.init_state(), *shards)
-            # frozen.sync_states, not the bare reduction table: metrics with
-            # non-distributive states (e.g. Pearson's streaming moments)
-            # override sync_states with their own cross-shard aggregation
-            return frozen.sync_states(st, axis_name)
+            mark_trace("sharded", owner_ref)
+            with jax.named_scope(scope):
+                st = frozen.update_state(frozen.init_state(), *shards)
+                # frozen.sync_states, not the bare reduction table: metrics with
+                # non-distributive states (e.g. Pearson's streaming moments)
+                # override sync_states with their own cross-shard aggregation
+                return frozen.sync_states(st, axis_name)
 
         return jax.jit(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
         )
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="sharded", owner=metric)
 
 
 def compiled_ragged_gather(
@@ -470,6 +588,7 @@ def compiled_ragged_gather(
     axis_name: str,
     scalar_reduces: Tuple[Tuple[str, Any], ...],
     ragged_names: Tuple[str, ...],
+    owner: Any = None,
 ) -> Callable:
     """Compiled gather graph for ``parallel.ragged.sync_ragged_states``.
 
@@ -479,26 +598,32 @@ def compiled_ragged_gather(
     """
     from torchmetrics_tpu.core.reductions import sync_leaf
 
+    # `owner` attributes cache events to the metric driving the sync; it is
+    # deliberately NOT part of the key — the gather graph depends only on the
+    # mesh + reduction structure and is shared across owning instances.
     key = ("ragged_gather", mesh, axis_name, scalar_reduces, ragged_names)
+    owner_ref = weakref.ref(owner) if owner is not None else None
+    scope = f"tm_tpu/{type(owner).__name__ if owner is not None else 'ragged'}/ragged_gather"
 
     def build() -> Callable:
         reduce_table = dict(scalar_reduces)
 
         def gather(scalars, n, ragged):
-            mark_trace()
-            out_scalars = {
-                name: sync_leaf(reduce_table[name], scalars[name][0], axis_name)
-                for name in scalars
-            }
-            out_n = jax.lax.psum(n[0], axis_name)
-            out_ragged = {
-                name: (
-                    jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
-                    jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
-                )
-                for name, (buf, shapes) in ragged.items()
-            }
-            return out_scalars, out_n, out_ragged
+            mark_trace("ragged", owner_ref)
+            with jax.named_scope(scope):
+                out_scalars = {
+                    name: sync_leaf(reduce_table[name], scalars[name][0], axis_name)
+                    for name in scalars
+                }
+                out_n = jax.lax.psum(n[0], axis_name)
+                out_ragged = {
+                    name: (
+                        jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
+                        jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
+                    )
+                    for name, (buf, shapes) in ragged.items()
+                }
+                return out_scalars, out_n, out_ragged
 
         specs_in = (
             {name: P(axis_name) for name, _ in scalar_reduces},
@@ -514,10 +639,12 @@ def compiled_ragged_gather(
             shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
         )
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="ragged", owner=owner)
 
 
-def compiled_divergence_check(mesh: Mesh, axis_name: str, n_leaves: int) -> Callable:
+def compiled_divergence_check(
+    mesh: Mesh, axis_name: str, n_leaves: int, owner: Any = None
+) -> Callable:
     """Compiled replica-digest compare for
     ``resilience.verify_replica_consistency``.
 
@@ -531,18 +658,20 @@ def compiled_divergence_check(mesh: Mesh, axis_name: str, n_leaves: int) -> Call
     exactly the same divergences.
     """
     key = ("divergence_check", mesh, axis_name, int(n_leaves))
+    owner_ref = weakref.ref(owner) if owner is not None else None
 
     def build() -> Callable:
         def check(digests):
-            mark_trace()
-            row = jax.lax.bitcast_convert_type(digests[0], jnp.int32)
-            return jax.lax.pmin(row, axis_name) == jax.lax.pmax(row, axis_name)
+            mark_trace("divergence", owner_ref)
+            with jax.named_scope("tm_tpu/divergence/check"):
+                row = jax.lax.bitcast_convert_type(digests[0], jnp.int32)
+                return jax.lax.pmin(row, axis_name) == jax.lax.pmax(row, axis_name)
 
         return jax.jit(
             shard_map(check, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
         )
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="divergence", owner=owner)
 
 
 def _collection_leaders(collection: Any) -> Tuple[str, ...]:
@@ -570,19 +699,24 @@ def compiled_collection_update(
         _backend(),
     )
 
+    owner_ref = weakref.ref(collection)
+
     def build() -> Callable:
         frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
 
         def fused(states, *a, **kw):
-            mark_trace()
-            return {
-                name: m.update_state(states[name], *a, **m._filter_kwargs(**kw))
-                for name, m in frozen.items()
-            }
+            mark_trace("collection", owner_ref)
+            with jax.named_scope("tm_tpu/MetricCollection/collection_update"):
+                return {
+                    name: _scoped_member_update(
+                        m, states[name], a, m._filter_kwargs(**kw)
+                    )
+                    for name, m in frozen.items()
+                }
 
         return jax.jit(fused, donate_argnums=(0,))
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="collection", owner=collection)
 
 
 def compiled_sharded_collection_update(
@@ -609,16 +743,20 @@ def compiled_sharded_collection_update(
         abstract_signature(args),
     )
 
+    owner_ref = weakref.ref(collection)
+
     def build() -> Callable:
         frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
 
         def step(*shards):
-            mark_trace()
-            out = {}
-            for name, m in frozen.items():
-                st = m.update_state(m.init_state(), *shards)
-                out[name] = m.sync_states(st, axis_name)
-            return out
+            mark_trace("sharded_collection", owner_ref)
+            with jax.named_scope("tm_tpu/MetricCollection/sharded_collection_update"):
+                out = {}
+                for name, m in frozen.items():
+                    with jax.named_scope(f"tm_tpu/{type(m).__name__}/sharded_update"):
+                        st = m.update_state(m.init_state(), *shards)
+                        out[name] = m.sync_states(st, axis_name)
+                return out
 
         # every leader state comes back fully replicated
         out_specs = {name: P() for name in frozen}
@@ -626,4 +764,4 @@ def compiled_sharded_collection_update(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=out_specs, check_vma=False)
         )
 
-    return _lookup(key, build)
+    return _lookup(key, build, kind="sharded_collection", owner=collection)

@@ -70,6 +70,7 @@ from torchmetrics_tpu.core.reductions import (
     merge_leaf,
     sync_leaf,
 )
+from torchmetrics_tpu.observability import registry as _telemetry
 from torchmetrics_tpu.parallel.sync import distributed_available, host_sync_state
 from torchmetrics_tpu.utilities.exceptions import NonFiniteStateError, TorchMetricsUserError
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
@@ -285,6 +286,7 @@ class Metric:
         if count == 0:
             return
         if self._guard_strategy == "error":
+            _telemetry.count(self, "nonfinite_events", count - self._nf_reported)
             raise NonFiniteStateError(
                 f"Metric {type(self).__name__} accumulated {count} non-finite value(s) in its "
                 "state (nan_strategy='error'). Reset the metric, or use nan_strategy "
@@ -292,6 +294,7 @@ class Metric:
                 count=count,
             )
         if count > self._nf_reported:
+            _telemetry.count(self, "nonfinite_events", count - self._nf_reported)
             rank_zero_warn(
                 f"Metric {type(self).__name__} state contains {count} non-finite value(s) "
                 "(nan_strategy='warn'). Results may be poisoned.",
@@ -394,6 +397,18 @@ class Metric:
         """The current raw state pytree (including the ``_n`` counter)."""
         return self._state
 
+    @property
+    def telemetry(self) -> "_telemetry.MetricTelemetry":
+        """This instance's telemetry (observability layer).
+
+        Counters/spans/cache attribution accumulate only while
+        ``torchmetrics_tpu.observability.enable()`` is on; the object itself
+        is always available.  It lives in the observability registry keyed on
+        instance identity — never on the metric — so it survives neither
+        ``clone()`` nor pickling, and cannot perturb config fingerprints.
+        """
+        return _telemetry.telemetry_for(self)
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate a batch into the global state.
 
@@ -404,13 +419,18 @@ class Metric:
         accumulators update in place with no per-step state copy.
         """
         self._computed = None
+        _telemetry.count(self, "updates")
         if self._enable_jit and not self._has_list_states:
             from torchmetrics_tpu.core.compile import compiled_update
 
-            fn = compiled_update(self, args, kwargs, donate=not self._state_shared)
-            self._state = fn(self._state, *args, **kwargs)
+            donate = not self._state_shared
+            with _telemetry.span(self, "update"):
+                fn = compiled_update(self, args, kwargs, donate=donate)
+                self._state = fn(self._state, *args, **kwargs)
+            _telemetry.count(self, "donated_installs" if donate else "copied_installs")
         else:
-            self._state = self.update_state(self._state, *args, **kwargs)
+            with _telemetry.span(self, "update"):
+                self._state = self.update_state(self._state, *args, **kwargs)
             # eager path: surface warn/error immediately (the state is host-
             # adjacent anyway); the jit path defers the readback to compute()
             self._check_nonfinite()
@@ -423,17 +443,21 @@ class Metric:
                 "the ``update`` method which may lead to errors, as metric states have not yet been updated.",
                 UserWarning,
             )
+        _telemetry.count(self, "computes")
         if self.compute_with_cache and self._computed is not None:
             return self._computed
         self._check_nonfinite()
 
         state = self._state
         if self.sync_on_compute and self.distributed_available_fn():
-            if self.dist_sync_fn is not None:
-                state = self.dist_sync_fn(state, self._reductions)
-            else:
-                state = self.host_sync_states(state)
-        value = self.compute_state(state)
+            with _telemetry.span(self, "sync"):
+                if self.dist_sync_fn is not None:
+                    state = self.dist_sync_fn(state, self._reductions)
+                else:
+                    state = self.host_sync_states(state)
+            _telemetry.record_sync(self, self._reductions, state, jax.process_count())
+        with _telemetry.span(self, "compute"):
+            value = self.compute_state(state)
         if self.compute_with_cache:
             self._computed = value
         return value
@@ -446,6 +470,7 @@ class Metric:
         state.  Metrics whose ``update`` is not merge-distributive set
         ``full_state_update=True`` and take the two-update path.
         """
+        _telemetry.count(self, "forwards")
         if (
             self._enable_jit
             and not self._has_list_states
@@ -454,20 +479,25 @@ class Metric:
             from torchmetrics_tpu.core.compile import compiled_forward, is_jit_compatible
 
             if is_jit_compatible((args, dict(kwargs))):
-                fn = compiled_forward(self, args, kwargs, donate=not self._state_shared)
-                self._state, self._forward_cache = fn(self._state, *args, **kwargs)
+                donate = not self._state_shared
+                with _telemetry.span(self, "forward"):
+                    fn = compiled_forward(self, args, kwargs, donate=donate)
+                    self._state, self._forward_cache = fn(self._state, *args, **kwargs)
                 self._computed = None
+                _telemetry.count(self, "donated_installs" if donate else "copied_installs")
                 return self._forward_cache
-        if self.full_state_update:
-            self._state = self.update_state(self._state, *args, **kwargs)
-            batch_state = self.update_state(self.init_state(), *args, **kwargs)
-        else:
-            batch_state = self.update_state(self.init_state(), *args, **kwargs)
-            self._state = self.merge_states(self._state, batch_state)
-        self._computed = None
-        if self.dist_sync_on_step and self.distributed_available_fn():
-            batch_state = self.host_sync_states(batch_state)
-        self._forward_cache = self.compute_state(batch_state)
+        with _telemetry.span(self, "forward"):
+            if self.full_state_update:
+                self._state = self.update_state(self._state, *args, **kwargs)
+                batch_state = self.update_state(self.init_state(), *args, **kwargs)
+            else:
+                batch_state = self.update_state(self.init_state(), *args, **kwargs)
+                self._state = self.merge_states(self._state, batch_state)
+            self._computed = None
+            if self.dist_sync_on_step and self.distributed_available_fn():
+                batch_state = self.host_sync_states(batch_state)
+                _telemetry.record_sync(self, self._reductions, batch_state, jax.process_count())
+            self._forward_cache = self.compute_state(batch_state)
         return self._forward_cache
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -475,6 +505,10 @@ class Metric:
 
     def reset(self) -> None:
         """Restore default state (reference: metric.py:692-707)."""
+        # count_existing, not count: reset() also runs on internal frozen
+        # clones during compile-cache builds, which must not pollute the
+        # telemetry registry with throwaway instances
+        _telemetry.count_existing(self, "resets")
         self._state = self.init_state()
         self._state_shared = False  # fresh buffers: nothing aliases them
         self._computed = None
@@ -543,6 +577,7 @@ class Metric:
         # all-or-nothing: leaves land only after every one validated
         self._state.update(staged)
         self._computed = None
+        _telemetry.count(self, "restores")
 
     def state_pytree(self) -> State:
         """Full state as a pytree for orbax checkpointing."""
@@ -565,6 +600,7 @@ class Metric:
         self._state = validate_state_pytree(self, state)
         self._state_shared = False
         self._computed = None
+        _telemetry.count(self, "restores")
 
     # pickling: state arrays -> numpy for portability (reference metric.py:713-732)
     def __getstate__(self) -> Dict[str, Any]:

@@ -1,0 +1,78 @@
+"""Observability layer: per-metric telemetry, profiler attribution, exporters.
+
+Off by default.  Turn it on with :func:`enable` (or ``TM_TPU_TELEMETRY=1``)
+and every metric starts counting its updates/computes/forwards/resets,
+cross-device syncs (with modelled per-chip byte traffic), donated-vs-copied
+state installs, non-finite events, snapshot/restore events, and
+per-entrypoint compile-cache hits/misses/retraces — plus fixed-bucket timing
+histograms of the host-side ``update``/``compute``/sync boundaries.  While
+enabled, compiled metric work is also visible in TPU profiler traces under
+``tm_tpu/<MetricClass>/<entrypoint>`` scopes.
+
+Quick tour::
+
+    from torchmetrics_tpu import observability as obs
+
+    obs.enable()
+    ...  # train
+    acc.telemetry.as_dict()              # one metric's counters/spans
+    obs.report()                          # everything, as one dict
+    obs.export(fmt="prometheus")          # or "jsonl" / "log"
+
+    with obs.observe("eval") as window:   # scoped diff around a phase
+        ...
+    window.diff["global"]["counters"]["updates"]
+
+The disabled fast path is a no-op: no compile-cache observer is registered,
+recording helpers return after one flag check, and nothing here touches
+cache keys — so telemetry can never cause a retrace.
+"""
+
+from torchmetrics_tpu.observability.export import (
+    Exporter,
+    JSONLinesExporter,
+    LoggingExporter,
+    PrometheusExporter,
+    export,
+)
+from torchmetrics_tpu.observability.registry import (
+    COUNTER_NAMES,
+    MetricTelemetry,
+    ObservationWindow,
+    SPAN_BUCKETS_US,
+    aggregate_telemetry,
+    diff_report,
+    disable,
+    enable,
+    enabled,
+    observe,
+    report,
+    reset_telemetry,
+    telemetry_for,
+)
+
+__all__ = [
+    "COUNTER_NAMES",
+    "Exporter",
+    "JSONLinesExporter",
+    "LoggingExporter",
+    "MetricTelemetry",
+    "ObservationWindow",
+    "PrometheusExporter",
+    "SPAN_BUCKETS_US",
+    "aggregate_telemetry",
+    "diff_report",
+    "disable",
+    "enable",
+    "enabled",
+    "export",
+    "observe",
+    "report",
+    "reset_telemetry",
+    "telemetry_for",
+]
+
+# honour TM_TPU_TELEMETRY=1: registry seeds the flag at import; finish the
+# job by subscribing to compile-cache events
+if enabled():  # pragma: no cover - env-driven path
+    enable()

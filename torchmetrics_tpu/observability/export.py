@@ -1,0 +1,243 @@
+"""Pluggable exporters for :func:`observability.registry.report` payloads.
+
+Three backends behind one front door (:func:`export`):
+
+* ``"log"`` — structured lines through the ``torchmetrics_tpu.observability``
+  logger (a child of the library logger, which carries a ``NullHandler`` —
+  silent until the application configures logging).
+* ``"jsonl"`` — one compact JSON object per export appended to a file or
+  stream; parse each line back with ``json.loads``.
+* ``"prometheus"`` — text exposition format (``# HELP``/``# TYPE``, counter
+  ``_total`` samples, cumulative histogram ``_bucket{le=...}`` series) ready
+  for a node-exporter textfile collector or an HTTP scrape handler.
+
+Exporters are plain classes with an ``export(report) -> Any`` method; anything
+with that shape can be passed to :func:`export` via ``exporter=``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, IO, List, Mapping, Optional
+
+from torchmetrics_tpu.observability.registry import COUNTER_NAMES
+
+__all__ = [
+    "Exporter",
+    "JSONLinesExporter",
+    "LoggingExporter",
+    "PrometheusExporter",
+    "export",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+#: one-line docs for the Prometheus ``# HELP`` strings
+_COUNTER_HELP = {
+    "updates": "Metric.update() calls.",
+    "computes": "Metric.compute() calls.",
+    "forwards": "Metric.forward() calls.",
+    "resets": "Metric.reset() calls.",
+    "syncs": "Cross-device/host state synchronisations.",
+    "sync_bytes": "Modelled per-chip sync traffic in bytes.",
+    "donated_installs": "Compiled state installs with buffer donation.",
+    "copied_installs": "Compiled state installs without donation (aliased state).",
+    "nonfinite_events": "Non-finite update batches observed by nan_strategy guards.",
+    "snapshots": "Resilience snapshots taken.",
+    "restores": "State restores (resilience restore / load_state_*).",
+}
+
+
+class Exporter:
+    """Interface: subclasses implement :meth:`export`."""
+
+    def export(self, report: Mapping[str, Any]) -> Any:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class LoggingExporter(Exporter):
+    """Emit a report as structured log records.
+
+    One summary record for the global aggregate plus one record per metric
+    row, each carrying the payload both formatted and as ``extra={"telemetry":
+    ...}`` for structured handlers.
+    """
+
+    def __init__(self, logger: Optional[logging.Logger] = None, level: int = logging.INFO):
+        self.logger = logger if logger is not None else _log
+        self.level = level
+
+    def export(self, report: Mapping[str, Any]) -> None:
+        glob = report.get("global", {})
+        counters = glob.get("counters", {})
+        head = ", ".join(f"{k}={v}" for k, v in sorted(counters.items()) if v)
+        self.logger.log(
+            self.level,
+            "telemetry: %s",
+            head or "(no activity)",
+            extra={"telemetry": dict(report)},
+        )
+        for label, row in sorted(report.get("metrics", {}).items()):
+            row_counters = {k: v for k, v in row.get("counters", {}).items() if v}
+            self.logger.log(
+                self.level,
+                "telemetry[%s]: %s",
+                label,
+                ", ".join(f"{k}={v}" for k, v in sorted(row_counters.items())) or "(idle)",
+                extra={"telemetry_metric": dict(row)},
+            )
+
+
+class JSONLinesExporter(Exporter):
+    """Append each report as one JSON line to ``path`` (or a writable
+    ``stream``).  ``json.loads`` on any line round-trips the report."""
+
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO[str]] = None):
+        if (path is None) == (stream is None):
+            raise ValueError("JSONLinesExporter needs exactly one of `path` or `stream`")
+        self.path = path
+        self.stream = stream
+
+    def export(self, report: Mapping[str, Any]) -> str:
+        line = json.dumps(report, sort_keys=True, separators=(",", ":"), default=str)
+        if self.stream is not None:
+            self.stream.write(line + "\n")
+            try:
+                self.stream.flush()
+            except Exception:  # pragma: no cover
+                pass
+        else:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(line + "\n")
+        return line
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(**kv: str) -> str:
+    inner = ",".join(f'{k}="{_prom_escape(str(v))}"' for k, v in kv.items() if v is not None)
+    return "{" + inner + "}" if inner else ""
+
+
+class PrometheusExporter(Exporter):
+    """Render a report in the Prometheus text exposition format (0.0.4).
+
+    ``export`` returns the exposition text; pass ``path=`` to also write it
+    atomically enough for a textfile collector (write then rename is the
+    collector's job — we just overwrite).
+    """
+
+    def __init__(self, namespace: str = "tm_tpu", path: Optional[str] = None):
+        self.namespace = namespace
+        self.path = path
+
+    def export(self, report: Mapping[str, Any]) -> str:
+        ns = self.namespace
+        out: List[str] = []
+        rows = dict(report.get("metrics", {}))
+
+        for name in COUNTER_NAMES:
+            metric_name = f"{ns}_{name}_total"
+            out.append(f"# HELP {metric_name} {_COUNTER_HELP.get(name, name)}")
+            out.append(f"# TYPE {metric_name} counter")
+            for label, row in sorted(rows.items()):
+                val = int(row.get("counters", {}).get(name, 0))
+                out.append(
+                    f"{metric_name}{_labels(metric=label, **{'class': row.get('class', '')})} {val}"
+                )
+
+        cache_name = f"{ns}_compile_cache_events_total"
+        out.append(f"# HELP {cache_name} Per-metric compile-cache events by entrypoint.")
+        out.append(f"# TYPE {cache_name} counter")
+        for label, row in sorted(rows.items()):
+            for kind, slot in sorted(row.get("cache", {}).items()):
+                for event in ("hits", "misses", "traces"):
+                    out.append(
+                        f"{cache_name}{_labels(metric=label, entrypoint=kind, event=event)} "
+                        f"{int(slot.get(event, 0))}"
+                    )
+
+        span_name = f"{ns}_span_seconds"
+        out.append(f"# HELP {span_name} Host-side boundary latency per metric and span.")
+        out.append(f"# TYPE {span_name} histogram")
+        for label, row in sorted(rows.items()):
+            for sname, s in sorted(row.get("spans", {}).items()):
+                cum = 0
+                for edge_us, n in s.get("buckets", []):
+                    cum += int(n)
+                    le = "+Inf" if edge_us is None else repr(edge_us / 1e6)
+                    out.append(
+                        f"{span_name}_bucket{_labels(metric=label, span=sname, le=le)} {cum}"
+                    )
+                out.append(
+                    f"{span_name}_sum{_labels(metric=label, span=sname)} "
+                    f"{repr(float(s.get('total_us', 0.0)) / 1e6)}"
+                )
+                out.append(
+                    f"{span_name}_count{_labels(metric=label, span=sname)} {int(s.get('count', 0))}"
+                )
+
+        cc = report.get("compile_cache", {})
+        flat_name = f"{ns}_compile_cache_total"
+        out.append(f"# HELP {flat_name} Global compile-cache counters.")
+        out.append(f"# TYPE {flat_name} counter")
+        for event in ("hits", "misses", "traces", "evictions"):
+            if event in cc:
+                out.append(f"{flat_name}{_labels(event=event)} {int(cc[event])}")
+        by = cc.get("by_entrypoint", {})
+        if by:
+            ep_name = f"{ns}_compile_cache_entrypoint_total"
+            out.append(f"# HELP {ep_name} Global compile-cache counters by entrypoint.")
+            out.append(f"# TYPE {ep_name} counter")
+            for kind, slot in sorted(by.items()):
+                for event, val in sorted(slot.items()):
+                    out.append(f"{ep_name}{_labels(entrypoint=kind, event=event)} {int(val)}")
+
+        text = "\n".join(out) + "\n"
+        if self.path is not None:
+            with open(self.path, "w", encoding="utf-8") as fh:
+                fh.write(text)
+        return text
+
+
+_FMT_ALIASES = {
+    "log": LoggingExporter,
+    "logging": LoggingExporter,
+    "jsonl": JSONLinesExporter,
+    "json": JSONLinesExporter,
+    "prometheus": PrometheusExporter,
+    "prom": PrometheusExporter,
+}
+
+
+def export(
+    report: Optional[Mapping[str, Any]] = None,
+    fmt: str = "log",
+    exporter: Optional[Exporter] = None,
+    **kwargs: Any,
+) -> Any:
+    """Export a telemetry report through one of the built-in backends.
+
+    ``report`` defaults to a fresh :func:`registry.report` snapshot.  Either
+    name a backend (``fmt`` in ``log | jsonl | prometheus``, with ``kwargs``
+    forwarded to its constructor) or pass a ready ``exporter`` instance.
+    Returns whatever the backend's ``export`` returns (the JSON line, the
+    exposition text, or ``None`` for logging).
+    """
+    if report is None:
+        from torchmetrics_tpu.observability.registry import report as _report
+
+        report = _report()
+    if exporter is None:
+        try:
+            cls = _FMT_ALIASES[fmt]
+        except KeyError:
+            raise ValueError(
+                f"unknown telemetry export format {fmt!r}; expected one of "
+                f"{sorted(set(_FMT_ALIASES))} (or pass `exporter=`)"
+            ) from None
+        exporter = cls(**kwargs)
+    return exporter.export(report)

@@ -1,0 +1,622 @@
+"""Per-metric instrumentation registry.
+
+Every instrumented site in the library funnels through this module:
+``Metric.update/compute/forward/reset`` count themselves and time their
+host-side boundary, ``parallel/sync.py`` and ``parallel/ragged.py`` record
+cross-device syncs and the modelled per-chip byte traffic
+(``utilities.benchmark.sync_bytes_per_chip``), ``resilience/snapshot.py``
+records snapshot/restore events, ``core/guards.py``-driven non-finite
+detections land as ``nonfinite_events``, and ``core/compile.py`` pushes
+per-entrypoint cache hits/misses/traces through the observer hook
+(:func:`enable` subscribes, :func:`disable` unsubscribes).
+
+Design constraints, in order:
+
+* **Disabled is free.**  The module-level flag gates every recording helper
+  with one boolean check; no compile-cache observer is registered while
+  disabled, spans return a shared null context manager, and nothing here
+  ever appears in a traced graph — so toggling telemetry can never change a
+  cache key or add a retrace.
+* **No unbounded growth.**  Timing spans accumulate into fixed log-spaced
+  histogram buckets plus an EMA — O(1) memory per (instance, span) pair no
+  matter how many steps run.  Telemetry of garbage-collected metrics folds
+  into one ``_retired`` aggregate.
+* **No footprint on the metric.**  Telemetry is keyed on ``id(metric)`` in a
+  module dict with a ``weakref.finalize`` reaper — storing it as an instance
+  attribute would leak into ``deepcopy``/pickle and the config fingerprint.
+  (A ``WeakKeyDictionary`` is out: ``Metric.__eq__`` builds a compositional
+  metric, so hash-bucket collisions would compare-by-composition.)
+"""
+
+from __future__ import annotations
+
+import bisect
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "COUNTER_NAMES",
+    "MetricTelemetry",
+    "ObservationWindow",
+    "SPAN_BUCKETS_US",
+    "aggregate_telemetry",
+    "annotate",
+    "count",
+    "count_existing",
+    "diff_report",
+    "disable",
+    "enable",
+    "enabled",
+    "observe",
+    "record_sync",
+    "report",
+    "reset_telemetry",
+    "span",
+    "telemetry_for",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+_LOCK = threading.RLock()
+
+#: Counter slots every :class:`MetricTelemetry` starts from.  ``sync_bytes``
+#: is the modelled per-chip traffic (bytes), everything else is an event count.
+COUNTER_NAMES = (
+    "updates",
+    "computes",
+    "forwards",
+    "resets",
+    "syncs",
+    "sync_bytes",
+    "donated_installs",
+    "copied_installs",
+    "nonfinite_events",
+    "snapshots",
+    "restores",
+)
+
+#: Upper edges (microseconds) of the fixed span histogram; one overflow
+#: bucket (+Inf) rides on the end.  Log-spaced from sub-dispatch latencies to
+#: full host syncs.
+SPAN_BUCKETS_US = (
+    50.0,
+    100.0,
+    250.0,
+    500.0,
+    1_000.0,
+    2_500.0,
+    5_000.0,
+    10_000.0,
+    50_000.0,
+    100_000.0,
+    1_000_000.0,
+)
+_BUCKET_EDGES_S = tuple(us / 1e6 for us in SPAN_BUCKETS_US)
+
+#: Smoothing factor for the per-span exponential moving average.
+EMA_ALPHA = 0.1
+
+_ENABLED = os.environ.get("TM_TPU_TELEMETRY", "").strip().lower() in ("1", "true", "on", "yes")
+
+
+class SpanStats:
+    """Fixed-size latency accumulator: count/total/max, EMA, and a
+    log-bucketed histogram.  O(1) memory regardless of sample count."""
+
+    __slots__ = ("count", "total_s", "max_s", "ema_s", "buckets")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.ema_s = 0.0
+        self.buckets = [0] * (len(_BUCKET_EDGES_S) + 1)
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        if seconds > self.max_s:
+            self.max_s = seconds
+        self.ema_s = seconds if self.count == 1 else (
+            EMA_ALPHA * seconds + (1.0 - EMA_ALPHA) * self.ema_s
+        )
+        self.buckets[bisect.bisect_left(_BUCKET_EDGES_S, seconds)] += 1
+
+    def absorb(self, other: "SpanStats") -> None:
+        if other.count == 0:
+            return
+        self.total_s += other.total_s
+        self.max_s = max(self.max_s, other.max_s)
+        # EMA has no exact merge; weight by sample count.
+        total = self.count + other.count
+        self.ema_s = (self.count * self.ema_s + other.count * other.ema_s) / total
+        self.count = total
+        for i, n in enumerate(other.buckets):
+            self.buckets[i] += n
+
+    def as_dict(self) -> Dict[str, Any]:
+        mean = self.total_s / self.count if self.count else 0.0
+        return {
+            "count": self.count,
+            "total_us": self.total_s * 1e6,
+            "mean_us": mean * 1e6,
+            "ema_us": self.ema_s * 1e6,
+            "max_us": self.max_s * 1e6,
+            "buckets": [
+                [edge if i < len(SPAN_BUCKETS_US) else None, self.buckets[i]]
+                for i, edge in enumerate(SPAN_BUCKETS_US + (None,))
+            ],
+        }
+
+
+class MetricTelemetry:
+    """Counters, per-entrypoint cache stats, and timing spans for one metric
+    instance (or one synthetic aggregate like ``_retired``)."""
+
+    __slots__ = ("label", "cls", "counters", "cache", "spans")
+
+    def __init__(self, label: str, cls: str) -> None:
+        self.label = label
+        self.cls = cls
+        self.counters: Dict[str, int] = {name: 0 for name in COUNTER_NAMES}
+        self.cache: Dict[str, Dict[str, int]] = {}
+        self.spans: Dict[str, SpanStats] = {}
+
+    # -- mutation (callers hold _LOCK) -------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def record_cache(self, kind: str, field: str) -> None:
+        slot = self.cache.get(kind)
+        if slot is None:
+            slot = self.cache[kind] = {"hits": 0, "misses": 0, "traces": 0}
+        slot[field] = slot.get(field, 0) + 1
+
+    def record_span(self, name: str, seconds: float) -> None:
+        stats = self.spans.get(name)
+        if stats is None:
+            stats = self.spans[name] = SpanStats()
+        stats.record(seconds)
+
+    def absorb(self, other: "MetricTelemetry") -> None:
+        for name, n in other.counters.items():
+            self.counters[name] = self.counters.get(name, 0) + n
+        for kind, slot in other.cache.items():
+            for field, n in slot.items():
+                mine = self.cache.setdefault(kind, {"hits": 0, "misses": 0, "traces": 0})
+                mine[field] = mine.get(field, 0) + n
+        for name, stats in other.spans.items():
+            self.spans.setdefault(name, SpanStats()).absorb(stats)
+
+    def clear(self) -> None:
+        self.counters = {name: 0 for name in COUNTER_NAMES}
+        self.cache = {}
+        self.spans = {}
+
+    @property
+    def active(self) -> bool:
+        return (
+            any(self.counters.values())
+            or any(any(slot.values()) for slot in self.cache.values())
+            or any(s.count for s in self.spans.values())
+        )
+
+    # -- export -------------------------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        with _LOCK:
+            return {
+                "label": self.label,
+                "class": self.cls,
+                "counters": dict(self.counters),
+                "cache": {kind: dict(slot) for kind, slot in sorted(self.cache.items())},
+                "spans": {name: s.as_dict() for name, s in sorted(self.spans.items())},
+            }
+
+    # ``m.telemetry.snapshot()`` reads nicer than ``as_dict`` at call sites
+    snapshot = as_dict
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"MetricTelemetry({self.label!r}, counters={self.counters!r})"
+
+
+# ------------------------------------------------------------------ storage
+_BY_ID: Dict[int, MetricTelemetry] = {}
+_CLASS_SEQ: Dict[str, int] = {}
+_RETIRED = MetricTelemetry("_retired", "_retired")
+_UNATTRIBUTED = MetricTelemetry("_unattributed", "_unattributed")
+
+
+def _retire(oid: int) -> None:
+    with _LOCK:
+        t = _BY_ID.pop(oid, None)
+        if t is not None and t.active:
+            _RETIRED.absorb(t)
+
+
+def telemetry_for(obj: Any, create: bool = True) -> Optional[MetricTelemetry]:
+    """The :class:`MetricTelemetry` for ``obj`` (created on first touch).
+
+    Labels are ``<ClassName>#<seq>`` in first-seen order per class.  Entries
+    follow the instance's lifetime: a ``weakref.finalize`` reaper folds the
+    telemetry of collected instances into the ``_retired`` aggregate.
+    """
+    if obj is None:
+        return _UNATTRIBUTED
+    with _LOCK:
+        t = _BY_ID.get(id(obj))
+        if t is None and create:
+            cls = type(obj).__name__
+            seq = _CLASS_SEQ.get(cls, 0)
+            _CLASS_SEQ[cls] = seq + 1
+            t = MetricTelemetry(f"{cls}#{seq}", cls)
+            _BY_ID[id(obj)] = t
+            try:
+                weakref.finalize(obj, _retire, id(obj))
+            except TypeError:  # non-weakrefable owner: entry lives until reset
+                pass
+        return t
+
+
+# ------------------------------------------------------------ enable/disable
+def enabled() -> bool:
+    return _ENABLED
+
+
+def enable() -> None:
+    """Turn telemetry on and subscribe to compile-cache events.
+
+    Also reachable at import time via ``TM_TPU_TELEMETRY=1``.
+    """
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = True
+    from torchmetrics_tpu.core import compile as _compile
+
+    _compile.add_cache_observer(_on_cache_event)
+
+
+def disable() -> None:
+    """Turn telemetry off; the recording helpers revert to no-ops."""
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = False
+    from torchmetrics_tpu.core import compile as _compile
+
+    _compile.remove_cache_observer(_on_cache_event)
+
+
+def _on_cache_event(event: str, kind: Optional[str], owner: Any) -> None:
+    """Compile-cache observer: attribute hits/misses/traces to the owning
+    metric instance (or ``_unattributed`` for ownerless entry points)."""
+    if not _ENABLED or event not in ("hit", "miss", "trace"):
+        return
+    field = {"hit": "hits", "miss": "misses", "trace": "traces"}[event]
+    with _LOCK:
+        telemetry_for(owner).record_cache(kind or "unknown", field)
+
+
+# ------------------------------------------------------------------ recording
+def count(obj: Any, name: str, n: int = 1) -> None:
+    """Increment counter ``name`` for ``obj`` (no-op while disabled)."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        telemetry_for(obj).inc(name, n)
+
+
+def count_existing(obj: Any, name: str, n: int = 1) -> None:
+    """Like :func:`count` but never *creates* a telemetry entry — used by
+    sites that also run on internal throwaway clones (e.g. ``reset`` during
+    frozen-clone construction), so transient objects don't pollute the
+    registry."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        t = _BY_ID.get(id(obj))
+        if t is not None:
+            t.inc(name, n)
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    """Times a host-side boundary into the owner's :class:`SpanStats` and
+    marks it in the profiler timeline (``jax.profiler.TraceAnnotation``)."""
+
+    __slots__ = ("_obj", "_name", "_t0", "_ann")
+
+    def __init__(self, obj: Any, name: str) -> None:
+        self._obj = obj
+        self._name = name
+        self._ann = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        cls = type(self._obj).__name__ if self._obj is not None else "unattributed"
+        try:
+            import jax
+
+            self._ann = jax.profiler.TraceAnnotation(f"tm_tpu/{cls}/{self._name}")
+            self._ann.__enter__()
+        except Exception:  # pragma: no cover - profiler unavailable
+            self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:  # pragma: no cover
+                pass
+        with _LOCK:
+            t = telemetry_for(self._obj)
+            if t is not None:
+                t.record_span(self._name, dt)
+        return False
+
+
+def span(obj: Any, name: str):
+    """Context manager timing a host boundary for ``obj`` (null when
+    disabled)."""
+    if not _ENABLED:
+        return _NULL
+    return _Span(obj, name)
+
+
+def annotate(name: str):
+    """Bare profiler ``TraceAnnotation`` (no timing) — null when disabled."""
+    if not _ENABLED:
+        return _NULL
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover
+        return _NULL
+
+
+def record_sync(
+    obj: Any,
+    reductions: Mapping[str, Any],
+    state: Mapping[str, Any],
+    n_devices: int,
+) -> None:
+    """Record one cross-device sync for ``obj``: bumps ``syncs`` and adds the
+    modelled per-chip traffic (``utilities.benchmark.sync_bytes_per_chip``)
+    to ``sync_bytes``.  Never raises — telemetry must not break a sync."""
+    if not _ENABLED:
+        return
+    nbytes = 0
+    try:
+        from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+
+        state = dict(state)
+        table = {name: r for name, r in reductions.items() if name in state}
+        nbytes = int(sync_bytes_per_chip(table, state, int(n_devices)))
+    except Exception:
+        _log.debug("sync byte accounting failed for %r", obj, exc_info=True)
+    with _LOCK:
+        t = telemetry_for(obj)
+        t.inc("syncs")
+        t.inc("sync_bytes", nbytes)
+
+
+# ------------------------------------------------------------------ reporting
+def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
+    """Sum a list of ``MetricTelemetry.as_dict()`` payloads into one."""
+    agg = MetricTelemetry("_aggregate", "_aggregate")
+    for part in parts:
+        for name, n in part.get("counters", {}).items():
+            agg.counters[name] = agg.counters.get(name, 0) + int(n)
+        for kind, slot in part.get("cache", {}).items():
+            mine = agg.cache.setdefault(kind, {"hits": 0, "misses": 0, "traces": 0})
+            for field, n in slot.items():
+                mine[field] = mine.get(field, 0) + int(n)
+        for name, s in part.get("spans", {}).items():
+            stats = agg.spans.setdefault(name, SpanStats())
+            merged = SpanStats()
+            merged.count = int(s["count"])
+            merged.total_s = float(s["total_us"]) / 1e6
+            merged.max_s = float(s["max_us"]) / 1e6
+            merged.ema_s = float(s["ema_us"]) / 1e6
+            merged.buckets = [int(n) for _, n in s["buckets"]]
+            stats.absorb(merged)
+    return agg.as_dict()
+
+
+def report() -> Dict[str, Any]:
+    """One structured snapshot of everything the registry knows.
+
+    Layout::
+
+        {"schema": 1, "enabled": bool,
+         "metrics": {label: telemetry-dict, ...},   # live + synthetic rows
+         "global": telemetry-dict,                   # sum over all rows
+         "compile_cache": cache_stats()}             # incl. by_entrypoint
+    """
+    with _LOCK:
+        rows = {t.label: t.as_dict() for t in _BY_ID.values()}
+        for synth in (_RETIRED, _UNATTRIBUTED):
+            if synth.active:
+                rows[synth.label] = synth.as_dict()
+    out: Dict[str, Any] = {
+        "schema": 1,
+        "enabled": _ENABLED,
+        "metrics": dict(sorted(rows.items())),
+        "global": aggregate_telemetry(rows.values()),
+    }
+    try:
+        from torchmetrics_tpu.core.compile import cache_stats
+
+        out["compile_cache"] = cache_stats()
+    except Exception:  # pragma: no cover
+        out["compile_cache"] = {}
+    return out
+
+
+def _diff_num(a: Any, b: Any) -> Any:
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a - b
+    return a
+
+
+def _diff_span(after: Mapping[str, Any], before: Optional[Mapping[str, Any]]) -> Dict[str, Any]:
+    if before is None:
+        return dict(after)
+    count_d = int(after["count"]) - int(before["count"])
+    total_d = float(after["total_us"]) - float(before["total_us"])
+    prev = [int(n) for _, n in before["buckets"]]
+    return {
+        "count": count_d,
+        "total_us": total_d,
+        "mean_us": total_d / count_d if count_d else 0.0,
+        # point-in-time stats: the window's EMA/max are the final values
+        "ema_us": after["ema_us"],
+        "max_us": after["max_us"],
+        "buckets": [
+            [edge, int(n) - (prev[i] if i < len(prev) else 0)]
+            for i, (edge, n) in enumerate(after["buckets"])
+        ],
+    }
+
+
+def _diff_tdict(after: Mapping[str, Any], before: Optional[Mapping[str, Any]]) -> Dict[str, Any]:
+    if before is None:
+        return dict(after)
+    out: Dict[str, Any] = {
+        "label": after.get("label"),
+        "class": after.get("class"),
+        "counters": {
+            name: int(n) - int(before.get("counters", {}).get(name, 0))
+            for name, n in after.get("counters", {}).items()
+        },
+        "cache": {},
+        "spans": {},
+    }
+    for kind, slot in after.get("cache", {}).items():
+        prev = before.get("cache", {}).get(kind, {})
+        out["cache"][kind] = {f: int(n) - int(prev.get(f, 0)) for f, n in slot.items()}
+    for name, s in after.get("spans", {}).items():
+        out["spans"][name] = _diff_span(s, before.get("spans", {}).get(name))
+    return out
+
+
+def _diff_cache_stats(after: Mapping[str, Any], before: Mapping[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in after.items():
+        if k == "by_entrypoint":
+            out[k] = {
+                kind: {
+                    f: int(n) - int(before.get(k, {}).get(kind, {}).get(f, 0))
+                    for f, n in slot.items()
+                }
+                for kind, slot in v.items()
+            }
+        else:
+            out[k] = _diff_num(v, before.get(k, 0))
+    return out
+
+
+def diff_report(before: Mapping[str, Any], after: Mapping[str, Any]) -> Dict[str, Any]:
+    """``after - before`` over two :func:`report` snapshots (counter deltas;
+    EMA/max spans keep their end-of-window values)."""
+    metrics = {
+        label: _diff_tdict(td, before.get("metrics", {}).get(label))
+        for label, td in after.get("metrics", {}).items()
+    }
+    return {
+        "schema": after.get("schema", 1),
+        "enabled": after.get("enabled", False),
+        "metrics": metrics,
+        "global": _diff_tdict(after.get("global", {}), before.get("global")),
+        "compile_cache": _diff_cache_stats(
+            after.get("compile_cache", {}), before.get("compile_cache", {})
+        ),
+    }
+
+
+def reset_telemetry() -> None:
+    """Zero every live entry and the retired/unattributed aggregates (labels
+    and instance identity are kept)."""
+    with _LOCK:
+        for t in _BY_ID.values():
+            t.clear()
+        _RETIRED.clear()
+        _UNATTRIBUTED.clear()
+
+
+# ------------------------------------------------------------------- observe
+class ObservationWindow:
+    """Handle yielded by :func:`observe`: ``before``/``after`` snapshots and,
+    once the block exits, their ``diff``."""
+
+    __slots__ = ("label", "before", "after", "diff")
+
+    def __init__(self, label: Optional[str]) -> None:
+        self.label = label
+        self.before: Dict[str, Any] = {}
+        self.after: Dict[str, Any] = {}
+        self.diff: Dict[str, Any] = {}
+
+    def export(self, fmt: str = "log", **kwargs: Any) -> Any:
+        """Export the window's diff through :func:`observability.export.export`."""
+        from torchmetrics_tpu.observability.export import export as _export
+
+        payload = dict(self.diff)
+        if self.label is not None:
+            payload["window"] = self.label
+        return _export(payload, fmt=fmt, **kwargs)
+
+
+class _Observe:
+    def __init__(self, label: Optional[str], turn_on: bool) -> None:
+        self._label = label
+        self._turn_on = turn_on
+        self._prev: Optional[bool] = None
+        self.window = ObservationWindow(label)
+
+    def __enter__(self) -> ObservationWindow:
+        self._prev = enabled()
+        if self._turn_on and not self._prev:
+            enable()
+        self.window.before = report()
+        return self.window
+
+    def __exit__(self, *exc: Any) -> bool:
+        self.window.after = report()
+        self.window.diff = diff_report(self.window.before, self.window.after)
+        if self._turn_on and self._prev is False:
+            disable()
+        return False
+
+
+def observe(label: Optional[str] = None, enable: bool = True) -> _Observe:
+    """Context manager scoping a telemetry window around a training phase::
+
+        with observe("eval-epoch-3") as window:
+            ...  # train/eval steps
+        window.diff  # what happened inside the block, as a report delta
+
+    ``enable=True`` (default) turns telemetry on for the window and restores
+    the previous flag on exit, so a normally-dark job can observe one phase.
+    """
+    return _Observe(label, enable)

@@ -44,6 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from torchmetrics_tpu.core.compile import bucket_dim, compiled_ragged_gather
 from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
+from torchmetrics_tpu.observability import registry as _telemetry
 
 State = Dict[str, Any]
 _N = "_n"
@@ -107,6 +108,7 @@ def sync_ragged_states(
     mesh: Mesh,
     axis_name: str = "data",
     verify_consistency: bool = False,
+    owner: Any = None,
 ) -> State:
     """Combine per-device states whose list leaves are ragged, via one
     in-graph pad-gather-trim per state name.
@@ -234,8 +236,12 @@ def sync_ragged_states(
     ragged_in = {name: (jnp.asarray(packed[name][0]), jnp.asarray(packed[name][1])) for name in packed}
 
     scalar_reduces = tuple(sorted(((n, reductions[n]) for n in scalar_names), key=lambda kv: kv[0]))
-    fn = compiled_ragged_gather(mesh, axis_name, scalar_reduces, tuple(sorted(ragged_in)))
-    g_scalars, g_n, g_ragged = fn(scalar_stacks, n_stack, ragged_in)
+    fn = compiled_ragged_gather(mesh, axis_name, scalar_reduces, tuple(sorted(ragged_in)), owner=owner)
+    with _telemetry.span(owner, "sync"):
+        g_scalars, g_n, g_ragged = fn(scalar_stacks, n_stack, ragged_in)
+    # `owner=None` lands the sync in the `_unattributed` telemetry row rather
+    # than double-counting against a metric some outer caller already credits
+    _telemetry.record_sync(owner, reductions, dict(per_device_states[0]), n_dev)
 
     # ---- trim + re-split on host, preserving device order
     out: State = {name: g_scalars[name] for name in scalar_names}
@@ -292,7 +298,7 @@ def sharded_list_update(
         )
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     states = [metric.update_state(metric.init_state(), *batch) for batch in per_device_batches]
-    return sync_ragged_states(metric._reductions, states, mesh, axis_name)
+    return sync_ragged_states(metric._reductions, states, mesh, axis_name, owner=metric)
 
 
 class DeferredRaggedSync:
@@ -372,6 +378,7 @@ class DeferredRaggedSync:
             self.mesh,
             self.axis_name,
             verify_consistency=self.verify_consistency,
+            owner=self.metric,
         )
 
     def compute(self) -> Any:

@@ -37,6 +37,7 @@ from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchmetrics_tpu.core.reductions import Reduce, host_sync_leaf, sync_leaf
+from torchmetrics_tpu.observability import registry as _telemetry
 from torchmetrics_tpu.utilities.prints import rank_zero_debug
 
 State = Dict[str, Any]
@@ -210,7 +211,9 @@ def sharded_update(
         from torchmetrics_tpu.core.compile import shard_map
 
         fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
-        out = fn(*inputs)
+        with _telemetry.span(metric, "sync"):
+            out = fn(*inputs)
+        _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
         if verify_consistency:
             from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -226,7 +229,9 @@ def sharded_update(
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
     fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs)
-    out = fn(*inputs)
+    with _telemetry.span(metric, "sync"):
+        out = fn(*inputs)
+    _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
     if verify_consistency:
         from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -269,4 +274,10 @@ def sharded_collection_update(
             "Update those eagerly and defer their gather to compute with DeferredRaggedSync."
         )
     fn = compiled_sharded_collection_update(collection, leaders, mesh, axis_name, specs, inputs)
-    return fn(*inputs)
+    with _telemetry.span(collection, "sync"):
+        out = fn(*inputs)
+    if _telemetry.enabled():
+        n_dev = int(mesh.devices.size)
+        for name in leaders:
+            _telemetry.record_sync(collection[name], collection[name]._reductions, out[name], n_dev)
+    return out

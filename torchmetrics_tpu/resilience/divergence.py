@@ -145,7 +145,7 @@ def verify_replica_consistency(
     ):
         from torchmetrics_tpu.core.compile import compiled_divergence_check
 
-        fn = compiled_divergence_check(mesh, axis_name, len(names))
+        fn = compiled_divergence_check(mesh, axis_name, len(names), owner=metric)
         sharded = jax.device_put(table, NamedSharding(mesh, P(axis_name)))
         agree = np.asarray(fn(sharded))
     else:

@@ -218,6 +218,9 @@ def _leaf_spec(leaf: Any) -> Dict[str, Any]:
 
 
 def _metric_snapshot(metric: Metric) -> Dict[str, Any]:
+    from torchmetrics_tpu.observability import registry as _telemetry
+
+    _telemetry.count(metric, "snapshots")
     state = metric.state_pytree()
     payload: Dict[str, Any] = {}
     spec: Dict[str, Any] = {}
@@ -348,6 +351,9 @@ def _restore_metric(metric: Metric, snap: Mapping[str, Any], strict_class: bool)
 
 
 def _install(metric: Metric, state: State) -> None:
+    from torchmetrics_tpu.observability import registry as _telemetry
+
+    _telemetry.count(metric, "restores")
     metric._state = state
     metric._state_shared = False  # restored buffers are fresh — donation is safe again
     metric._computed = None

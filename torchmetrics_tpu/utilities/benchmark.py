@@ -17,7 +17,27 @@ import jax
 
 from torchmetrics_tpu.core.reductions import Reduce
 
-__all__ = ["benchmark", "state_bytes", "sync_bytes_per_chip"]
+__all__ = ["benchmark", "cache_stats_delta", "state_bytes", "sync_bytes_per_chip"]
+
+
+def cache_stats_delta(after: Dict[str, Any], before: Dict[str, Any]) -> Dict[str, Any]:
+    """``after - before`` over two :func:`core.compile.cache_stats` snapshots
+    (flat counters and the per-entrypoint breakdown)."""
+    out: Dict[str, Any] = {
+        k: int(after[k]) - int(before.get(k, 0))
+        for k in after
+        if isinstance(after[k], int)
+    }
+    by_after = after.get("by_entrypoint", {})
+    by_before = before.get("by_entrypoint", {})
+    out["by_entrypoint"] = {
+        kind: {
+            field: int(n) - int(by_before.get(kind, {}).get(field, 0))
+            for field, n in slot.items()
+        }
+        for kind, slot in by_after.items()
+    }
+    return out
 
 
 def state_bytes(state: Dict[str, Any]) -> int:
@@ -74,7 +94,10 @@ def benchmark(
             traffic of one state sync over that many devices.
 
     Returns a dict with ``update_us``, ``compute_us``, ``state_bytes``,
-    ``state_leaves`` and (optionally) ``sync_bytes_per_chip``.
+    ``state_leaves``, per-leg compile-cache deltas
+    (``cache_stats_delta``: compile/warmup vs update loop vs compute loop —
+    a leg's retrace count can no longer be blamed on earlier legs in the
+    same process) and (optionally) ``sync_bytes_per_chip``.
     """
     if getattr(metric, "_has_list_states", False):
         raise ValueError(
@@ -99,6 +122,7 @@ def benchmark(
     jax.block_until_ready(state)
     result = compute(state)
     jax.block_until_ready(result)
+    stats_warm = cache_stats()
 
     start = time.perf_counter()
     out = metric.init_state()
@@ -106,12 +130,14 @@ def benchmark(
         out = update(out, *example_inputs, **example_kwargs)
     jax.block_until_ready(out)
     update_us = (time.perf_counter() - start) / steps * 1e6
+    stats_update = cache_stats()
 
     start = time.perf_counter()
     for _ in range(steps):
         result = compute(out)
     jax.block_until_ready(result)
     compute_us = (time.perf_counter() - start) / steps * 1e6
+    stats_compute = cache_stats()
 
     report: Dict[str, Any] = {
         "metric": type(metric).__name__,
@@ -121,7 +147,14 @@ def benchmark(
         "state_leaves": len(jax.tree.leaves(out)),
         "device": jax.devices()[0].platform,
         "donated_state": True,
-        "retraces": cache_stats()["traces"] - stats_before["traces"],
+        "retraces": stats_compute["traces"] - stats_before["traces"],
+        # per-leg deltas: retraces (or hits/misses) inside THIS benchmark's
+        # sections, uncontaminated by whatever compiled earlier in-process
+        "cache_stats_delta": {
+            "compile_and_warmup": cache_stats_delta(stats_warm, stats_before),
+            "update_loop": cache_stats_delta(stats_update, stats_warm),
+            "compute_loop": cache_stats_delta(stats_compute, stats_update),
+        },
     }
     if n_devices is not None and n_devices > 1:
         report["sync_bytes_per_chip"] = sync_bytes_per_chip(metric._reductions, out, n_devices)

@@ -14,6 +14,14 @@ from functools import partial, wraps
 from typing import Any, Callable
 
 log = logging.getLogger("torchmetrics_tpu")
+# Library logging etiquette: a NullHandler on the package root means an
+# application that never configures logging sees neither "No handlers could
+# be found" noise nor unformatted last-resort output, while an application
+# that does configure the root (or this) logger gets every record exactly
+# once through its own handlers.  Child loggers — e.g. the observability
+# exporters' "torchmetrics_tpu.observability" — propagate up through here.
+if not any(isinstance(h, logging.NullHandler) for h in log.handlers):
+    log.addHandler(logging.NullHandler())
 
 
 def _rank() -> int:
