@@ -507,9 +507,218 @@ def ragged_sync_bench_child():
     print(json.dumps(out))
 
 
-def measured_ragged_sync_us():
-    """Spawn the 8-virtual-device child and return its measurements (or an
-    error record — the bench must not die red because the child did)."""
+def coalescing_bench_child():
+    """Collective-coalescing acceptance leg on the 8-virtual-device mesh:
+
+    * planner counts — the Acc+F1+AUROC collection's per-leaf collective
+      count vs the dtype-bucketed plan (headline: fuses to <= 2 launches);
+    * byte model — FID(2048)+PSNR per-chip sync traffic at 8 chips, per-leaf
+      vs coalesced, plus the two-stage ICI/DCN cut at 4 hosts x 8 local;
+    * measured cadence — SyncStepper on accuracy_5cls with every_n_steps in
+      {1, 4} against a sync-free (at_compute) baseline: per-step sync time
+      must drop >= 2x at every_n_steps=4;
+    * telemetry — the ``collectives``/``sync_bytes`` counters recorded by
+      the registry must equal syncs x the planner model;
+    * retraces — steady-state cadence windows add zero compile-cache
+      traces/misses.
+    """
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import MetricCollection, observability as obs
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy as Acc5,
+        MulticlassAUROC,
+        MulticlassF1Score,
+    )
+    from torchmetrics_tpu.core.compile import cache_stats
+    from torchmetrics_tpu.core.reductions import Reduce
+    from torchmetrics_tpu.image import FrechetInceptionDistance, PeakSignalNoiseRatio
+    from torchmetrics_tpu.parallel import (
+        SyncPolicy,
+        SyncStepper,
+        build_sync_plan,
+        bucketed_collective_count,
+        per_leaf_collective_count,
+        sharded_collection_update,
+    )
+    from torchmetrics_tpu.utilities.benchmark import (
+        per_leaf_sync_bytes_per_chip,
+        ring_reduce_bytes,
+        sync_bytes_per_chip,
+        two_stage_dcn_bytes,
+    )
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+
+    # --- planner: Acc+F1+AUROC compute-group leaders share dtype buckets
+    coll = MetricCollection(
+        {
+            "acc": Acc5(num_classes=5, validate_args=False),
+            "f1": MulticlassF1Score(num_classes=5, validate_args=False),
+            "auroc": MulticlassAUROC(num_classes=5, thresholds=50, validate_args=False),
+        },
+        compute_groups=True,
+    )
+    probs = jnp.asarray(rng.uniform(size=(64, 5)), jnp.float32)
+    tgt = jnp.asarray(rng.integers(0, 5, 64))
+    states = sharded_collection_update(coll, probs, tgt, mesh=mesh)
+    entries = []
+    for name in states:
+        m = coll[name]
+        sub = {leaf: states[name][leaf] for leaf in m._reductions}
+        sub["_n"] = states[name]["_n"]
+        entries.append((m._reductions, sub))
+    plan = build_sync_plan(entries)
+    per_leaf_n = sum(per_leaf_collective_count(r, s) for r, s in entries)
+    out["planner_acc_f1_auroc"] = {
+        "leaders": sorted(states),
+        "per_leaf_collectives": int(per_leaf_n),
+        "bucketed_collectives": int(plan.n_collectives),
+        "bucket_sizes": plan.bucket_sizes(),
+        "fused_to_two_or_fewer": bool(plan.n_collectives <= 2),
+    }
+
+    # --- byte model: FID(2048)+PSNR cross-metric fused sync at 8 chips.
+    # States are static — the numbers are analytic, from the same planner the
+    # runtime sync lowers through.
+    fid = FrechetInceptionDistance(feature=2048)
+    psnr = PeakSignalNoiseRatio()
+    pair = (fid, psnr)
+    pair_states = [m._state for m in pair]
+
+    def _aug_table(m):
+        # per-leaf model iterates the reduction table; fold the auto
+        # bookkeeping leaves in so both sides count the same state
+        table = dict(m._reductions)
+        for extra in ("_n", "_nonfinite"):
+            if extra in m._state:
+                table[extra] = Reduce.SUM
+        return table
+
+    per_leaf_b = sum(
+        per_leaf_sync_bytes_per_chip(_aug_table(m), m._state, n_dev) for m in pair
+    )
+    plan_ip = build_sync_plan([(m._reductions, m._state) for m in pair])
+    fused_b = sum(
+        ring_reduce_bytes(b.size * np.dtype(b.dtype).itemsize, n_dev) for b in plan_ip.buckets
+    )
+    for slot in plan_ip.passthrough:
+        leaf = pair_states[slot[0]][slot[1]]
+        fused_b += (n_dev - 1) * sum(
+            int(v.size) * v.dtype.itemsize for v in _jax.tree.leaves(leaf)
+        )
+    dcn_flat = dcn_two = 0
+    for m in pair:
+        dcn = two_stage_dcn_bytes(_aug_table(m), m._state, n_hosts=4, n_local_devices=8)
+        dcn_flat += dcn["flat"]
+        dcn_two += dcn["two_stage"]
+    out["bytes_fid2048_psnr_8chips"] = {
+        "per_leaf_collectives": int(
+            sum(per_leaf_collective_count(_aug_table(m), m._state) for m in pair)
+        ),
+        "bucketed_collectives": int(plan_ip.n_collectives),
+        "per_leaf_bytes_per_chip": int(per_leaf_b),
+        "coalesced_bytes_per_chip": int(fused_b),
+        "byte_drop_pct": round((1 - fused_b / per_leaf_b) * 100.0, 2) if per_leaf_b else None,
+        "fused_buckets": plan_ip.bucket_sizes(),
+        "dcn_4hosts_x8local": {
+            "flat_bytes": int(dcn_flat),
+            "two_stage_bytes": int(dcn_two),
+            "cut": round(dcn_flat / dcn_two, 1) if dcn_two else None,
+        },
+    }
+
+    # --- measured cadence: accuracy_5cls under SyncStepper.  at_compute never
+    # launches a collective inside the loop, so its pass time is the local
+    # floor; sync time per step is the excess over that floor.
+    steps = int(os.environ.get("BENCH_CADENCE_STEPS", 32))
+    reps = 3
+
+    def cadence_pass_us(policy):
+        stepper = SyncStepper(
+            Acc5(num_classes=5, validate_args=False), mesh=mesh, policy=policy
+        )
+        times = []
+        for rep in range(reps + 1):  # rep 0 warms the step + sync traces
+            stepper.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                stepper.update(probs, tgt)
+            _jax.block_until_ready(
+                _jax.tree.leaves(stepper._local) + _jax.tree.leaves(stepper._synced)
+            )
+            if rep:
+                times.append(time.perf_counter() - t0)
+        return float(np.median(times)) / steps * 1e6
+
+    local_us = cadence_pass_us(SyncPolicy(at_compute=True))
+    every1_us = cadence_pass_us(SyncPolicy(every_n_steps=1))
+    every4_us = cadence_pass_us(SyncPolicy(every_n_steps=4))
+    sync1 = every1_us - local_us
+    sync4 = every4_us - local_us
+    out["cadence_accuracy_5cls"] = {
+        "steps_per_pass": steps,
+        "pass_us_per_step": {
+            "at_compute_local": round(local_us, 1),
+            "every_1": round(every1_us, 1),
+            "every_4": round(every4_us, 1),
+        },
+        "sync_us_per_step_every_1": round(sync1, 1),
+        "sync_us_per_step_every_4": round(sync4, 1),
+        "sync_time_cut_every_4": round(sync1 / sync4, 2) if sync4 > 0 else None,
+        "meets_2x_target": bool(sync4 > 0 and sync1 / sync4 >= 2.0),
+    }
+
+    # --- telemetry counters + steady-state retrace proof
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        m = Acc5(num_classes=5, validate_args=False)
+        stepper = SyncStepper(m, mesh=mesh, policy=SyncPolicy(every_n_steps=4))
+        for _ in range(8):  # two full windows -> 2 syncs
+            stepper.update(probs, tgt)
+        warm = cache_stats()
+        for _ in range(8):  # two more windows: must be all cache hits
+            stepper.update(probs, tgt)
+        stats = cache_stats()
+        synced = stepper._synced[""]
+        table = {n: r for n, r in m._reductions.items() if n in synced}
+        per_sync_collectives = int(bucketed_collective_count(table, synced))
+        per_sync_bytes = int(sync_bytes_per_chip(table, dict(synced), n_dev))
+        counters = obs.report()["global"]["counters"]
+        out["telemetry_vs_model"] = {
+            "syncs": int(counters["syncs"]),
+            "collectives_counter": int(counters["collectives"]),
+            "collectives_model": 4 * per_sync_collectives,
+            "sync_bytes_counter": int(counters["sync_bytes"]),
+            "sync_bytes_model": 4 * per_sync_bytes,
+            "counters_match_model": bool(
+                counters["collectives"] == 4 * per_sync_collectives
+                and counters["sync_bytes"] == 4 * per_sync_bytes
+            ),
+        }
+        out["cadence_steady_state_retraces"] = {
+            "extra_traces": stats["traces"] - warm["traces"],
+            "extra_misses": stats["misses"] - warm["misses"],
+        }
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    print(json.dumps(out))
+
+
+def _run_cpu_mesh_child(mode, timeout_s):
+    """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
+    return its last-stdout-line JSON (or an error record — the bench must not
+    die red because a child did)."""
     import subprocess
     import sys
 
@@ -522,7 +731,7 @@ def measured_ragged_sync_us():
         if not f.startswith("--xla_force_host_platform_device_count")
     )
     env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
-    env["BENCH_CHILD_MODE"] = "ragged"
+    env["BENCH_CHILD_MODE"] = mode
     env.pop("BENCH_BACKEND_CHECKED", None)
     try:
         res = subprocess.run(
@@ -530,15 +739,27 @@ def measured_ragged_sync_us():
             env=env,
             capture_output=True,
             text=True,
-            timeout=float(os.environ.get("BENCH_RAGGED_TIMEOUT", 300)),
+            timeout=timeout_s,
         )
         if res.returncode == 0:
             return json.loads(res.stdout.strip().splitlines()[-1])
-        return {"error": f"ragged child rc={res.returncode}: {(res.stderr or '')[-400:]}"}
+        return {"error": f"{mode} child rc={res.returncode}: {(res.stderr or '')[-400:]}"}
     except subprocess.TimeoutExpired:
-        return {"error": "ragged child timed out"}
+        return {"error": f"{mode} child timed out"}
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
-        return {"error": f"ragged child failed: {err}"}
+        return {"error": f"{mode} child failed: {err}"}
+
+
+def measured_ragged_sync_us():
+    return _run_cpu_mesh_child(
+        "ragged", float(os.environ.get("BENCH_RAGGED_TIMEOUT", 300))
+    )
+
+
+def measured_coalescing():
+    return _run_cpu_mesh_child(
+        "coalescing", float(os.environ.get("BENCH_COALESCE_TIMEOUT", 300))
+    )
 
 
 def donation_leg():
@@ -851,6 +1072,7 @@ def main():
     ci95 = [overhead_pct - 1.96 * noise_pct, overhead_pct + 1.96 * noise_pct]
     sub_us = metric_subgraph_us(init_states, metrics, y)
     ragged_measured = measured_ragged_sync_us()
+    coalescing_measured = measured_coalescing()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -890,6 +1112,7 @@ def main():
             "train_step_with_metrics_ms_median": round(float(np.median(metrics_t)) * 1e3, 3),
             "metric_subgraph_us_per_step": round(sub_us, 1),
             "measured_sync_us_8dev_mesh": ragged_measured,
+            "coalescing": coalescing_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -971,6 +1194,8 @@ def _ensure_backend_or_reexec():
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD_MODE") == "ragged":
         ragged_sync_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "coalescing":
+        coalescing_bench_child()
     else:
         _ensure_backend_or_reexec()
         main()

@@ -1,0 +1,397 @@
+"""Coalescing planner tests: dtype-bucketed fused syncs must be bit-for-bit
+identical to the per-leaf collectives they replace, add zero compile-cache
+entries, and count collectives the way the telemetry/byte models claim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu import Metric, MetricCollection
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache, shard_map
+from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
+from torchmetrics_tpu.parallel import metric_mesh, sharded_collection_update, sharded_update
+from torchmetrics_tpu.parallel.coalesce import (
+    _reduce_for,
+    build_sync_plan,
+    bucketed_collective_count,
+    coalesced_host_sync,
+    coalesced_metric_sync,
+    coalesced_sync_state,
+    per_leaf_collective_count,
+)
+
+
+def _sub_mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("data",))
+
+
+def _random_state(rng, n_dev, table, dtypes):
+    """Stacked per-device leaves (leading device axis) for every table entry
+    plus the reserved ``_n`` counter."""
+    stacked = {}
+    for (name, reduce), dtype in zip(table.items(), dtypes):
+        shape = (n_dev, 3, 2) if name.endswith("v") else (n_dev,)
+        vals = rng.uniform(-8, 8, size=shape)
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.integer):
+            vals = rng.integers(0, 50, size=shape)
+        stacked[name] = jnp.asarray(vals).astype(dtype)
+    stacked["_n"] = jnp.ones((n_dev,), jnp.int32)
+    return stacked
+
+
+def _sync_both_ways(stacked, table, mesh):
+    """Run the coalesced sync and the per-leaf reference sync inside one
+    shard_map each; return (coalesced, per_leaf) replicated states."""
+
+    def coalesced(st):
+        local = {k: v[0] for k, v in st.items()}
+        return coalesced_sync_state(local, table, "data")
+
+    def per_leaf(st):
+        local = {k: v[0] for k, v in st.items()}
+        return {k: sync_leaf(_reduce_for(k, table), v, "data") for k, v in local.items()}
+
+    run = lambda f: shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    return jax.jit(run(coalesced))(stacked), jax.jit(run(per_leaf))(stacked)
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 8])
+@pytest.mark.parametrize(
+    "dtype", [jnp.float32, jnp.bfloat16, jnp.int32, jnp.uint32], ids=["f32", "bf16", "i32", "u32"]
+)
+def test_bucketed_sum_bitwise_identical_per_leaf(mesh, n_dev, dtype):
+    rng = np.random.default_rng(7)
+    table = {"a": Reduce.SUM, "b_v": Reduce.SUM, "c": Reduce.SUM}
+    stacked = _random_state(rng, n_dev, table, [dtype] * 3)
+    got, want = _sync_both_ways(stacked, table, _sub_mesh(n_dev))
+    assert sorted(got) == sorted(want)
+    for k in want:
+        assert np.asarray(got[k]).tobytes() == np.asarray(want[k]).tobytes(), k
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 8])
+def test_mixed_ops_bitwise_identical_per_leaf(mesh, n_dev):
+    """sum/mean/min/max leaves of several dtypes in one table: every leaf of
+    the bucketed sync matches the per-leaf collective bit-for-bit — including
+    MEAN riding the sum bucket (pmean lowers to psum/psum(1))."""
+    rng = np.random.default_rng(11)
+    table = {
+        "s1": Reduce.SUM,
+        "s2_v": Reduce.SUM,
+        "m1": Reduce.MEAN,
+        "lo": Reduce.MIN,
+        "hi": Reduce.MAX,
+        "cnt": Reduce.SUM,
+        "hist_v": Reduce.SUM,
+    }
+    dtypes = [jnp.float32, jnp.float32, jnp.float32, jnp.float32, jnp.bfloat16, jnp.int32, jnp.uint32]
+    stacked = _random_state(rng, n_dev, table, dtypes)
+    got, want = _sync_both_ways(stacked, table, _sub_mesh(n_dev))
+    for k in want:
+        assert np.asarray(got[k]).dtype == np.asarray(want[k]).dtype, k
+        assert np.asarray(got[k]).tobytes() == np.asarray(want[k]).tobytes(), k
+
+
+# ---------------------------------------------------------------- plan shape
+def test_plan_buckets_by_dtype_and_op():
+    state = {
+        "tp": jnp.zeros((5,)),
+        "fp": jnp.zeros((5,)),
+        "lo": jnp.zeros(()),
+        "mean": jnp.zeros((2,)),
+        "n_obs": jnp.zeros((), jnp.int32),
+        "_n": jnp.zeros((), jnp.int32),
+    }
+    table = {
+        "tp": Reduce.SUM,
+        "fp": Reduce.SUM,
+        "lo": Reduce.MIN,
+        "mean": Reduce.MEAN,
+        "n_obs": Reduce.SUM,
+    }
+    plan = build_sync_plan([(table, state)])
+    assert plan.bucket_sizes() == {"float32/min": 1, "float32/sum": 12, "int32/sum": 2}
+    assert plan.n_collectives == 3  # vs 6 per-leaf
+    assert per_leaf_collective_count(table, state) == 6
+    assert bucketed_collective_count(table, state) == 3
+
+
+def test_plan_passthrough_classification():
+    """Tuple (list) leaves, callable reduces, CAT/NONE, and integer MEAN must
+    NOT be bucketed — each keeps its per-leaf lowering."""
+    fold = lambda x, axis_name: x
+    state = {
+        "items": (jnp.zeros((2,)), jnp.zeros((3,))),
+        "custom": jnp.zeros((2,)),
+        "cat_t": jnp.zeros((4,)),
+        "stack": jnp.zeros((4,)),
+        "int_mean": jnp.zeros((2,), jnp.int32),
+        "ok": jnp.zeros((2,)),
+    }
+    table = {
+        "items": Reduce.CAT,
+        "custom": fold,
+        "cat_t": Reduce.CAT,
+        "stack": Reduce.NONE,
+        "int_mean": Reduce.MEAN,
+        "ok": Reduce.SUM,
+    }
+    plan = build_sync_plan([(table, state)])
+    assert sorted(name for _, name, _ in plan.passthrough) == [
+        "cat_t", "custom", "int_mean", "items", "stack",
+    ]
+    assert [b.op for b in plan.buckets] == ["sum"]
+    assert {s.name for b in plan.buckets for s in b.slots} == {"ok"}
+    # the items tuple holds 2 arrays -> 2 gathers; 4 other passthrough leaves
+    assert plan.n_passthrough_collectives == 6
+
+
+def test_plan_rejects_unknown_leaf():
+    with pytest.raises(KeyError, match="no entry in the reduction table"):
+        build_sync_plan([({"a": Reduce.SUM}, {"a": jnp.zeros(()), "mystery": jnp.zeros(())})])
+
+
+# ------------------------------------------------------------ retrace identity
+def test_coalescing_adds_zero_cache_entries(mesh):
+    """5 repeat sharded_update steps after the first: no new compile-cache
+    entries, no new traces — the plan folds into the existing fingerprint."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    clear_compile_cache()
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    preds = jnp.zeros((16,), jnp.int32)
+    target = jnp.ones((16,), jnp.int32)
+    sharded_update(m, preds, target, mesh=mesh)
+    warm = cache_stats()
+    assert warm["traces"] == 1
+    for _ in range(5):
+        sharded_update(m, preds, target, mesh=mesh)
+    stats = cache_stats()
+    assert stats["traces"] == warm["traces"]
+    assert stats["misses"] == warm["misses"]
+    assert stats["hits"] == warm["hits"] + 5
+
+
+# ------------------------------------------------------- cross-metric fusion
+def test_collection_leaders_share_two_buckets(mesh):
+    """The ISSUE headline: Acc+F1+AUROC — 13 per-leaf collectives — fuse to
+    at most 2 bucketed ones (one f32 sum, one i32 sum)."""
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassF1Score,
+    )
+
+    mc = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=5, average="micro"),
+            "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=5, thresholds=16),
+        },
+        compute_groups=True,
+    )
+    probs = jax.nn.softmax(jnp.asarray(np.random.default_rng(0).normal(size=(16, 5))), -1)
+    target = jnp.asarray(np.random.default_rng(1).integers(0, 5, size=(16,)))
+    states = sharded_collection_update(mc, probs, target, mesh=mesh)
+    entries = []
+    for name in states:
+        m = mc[name]
+        sub = {leaf: states[name][leaf] for leaf in m._reductions}
+        sub["_n"] = states[name]["_n"]
+        entries.append((m._reductions, sub))
+    plan = build_sync_plan(entries)
+    assert per_leaf_collective_count(entries[0][0], entries[0][1]) >= 3  # per metric
+    assert plan.n_collectives <= 2, plan.bucket_sizes()
+
+
+def test_coalesced_metric_sync_matches_individual(mesh):
+    """Cross-metric fused sync == each metric's own sync_states, including a
+    sync_states-overriding metric (Pearson) that must stay un-coalesced."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.regression import MeanSquaredError, PearsonCorrCoef
+
+    rng = np.random.default_rng(3)
+    acc = MulticlassAccuracy(num_classes=4, average="micro")
+    mse = MeanSquaredError()
+    pear = PearsonCorrCoef()
+    acc_in = (jnp.asarray(rng.integers(0, 4, (16,))), jnp.asarray(rng.integers(0, 4, (16,))))
+    reg_in = (jnp.asarray(rng.normal(size=(16,))), jnp.asarray(rng.normal(size=(16,))))
+
+    def fused(a_p, a_t, r_p, r_t):
+        sts = [
+            acc.update_state(acc.init_state(), a_p, a_t),
+            mse.update_state(mse.init_state(), r_p, r_t),
+            pear.update_state(pear.init_state(), r_p, r_t),
+        ]
+        return tuple(coalesced_metric_sync([acc, mse, pear], sts, "data"))
+
+    def individual(a_p, a_t, r_p, r_t):
+        sts = [
+            acc.update_state(acc.init_state(), a_p, a_t),
+            mse.update_state(mse.init_state(), r_p, r_t),
+            pear.update_state(pear.init_state(), r_p, r_t),
+        ]
+        return tuple(m.sync_states(st, "data") for m, st in zip([acc, mse, pear], sts))
+
+    run = lambda f: shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    got = jax.jit(run(fused))(*acc_in, *reg_in)
+    want = jax.jit(run(individual))(*acc_in, *reg_in)
+    for g, w in zip(got, want):
+        assert sorted(g) == sorted(w)
+        for k in w:
+            np.testing.assert_allclose(np.asarray(g[k]), np.asarray(w[k]), rtol=1e-6, atol=1e-7)
+
+
+# --------------------------------------------------------- hierarchical (DCN)
+def test_coalesced_host_sync_single_process_is_identity():
+    state = {"a": jnp.ones((3,)), "_n": jnp.ones((), jnp.int32)}
+    out = coalesced_host_sync(state, {"a": Reduce.SUM}, n_processes=1)
+    assert out is not state
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(out[k]), np.asarray(state[k]))
+
+
+def test_coalesced_host_sync_reduces_buckets_across_hosts():
+    """Injected 2-host allgather: one gather per bucket, reductions applied
+    per slot (sum adds, mean averages over hosts, min/max elementwise)."""
+    table = {"s": Reduce.SUM, "m": Reduce.MEAN, "lo": Reduce.MIN, "hi": Reduce.MAX}
+    host_a = {
+        "s": jnp.asarray([1.0, 2.0]),
+        "m": jnp.asarray([4.0]),
+        "lo": jnp.asarray([5.0]),
+        "hi": jnp.asarray([7.0]),
+        "_n": jnp.asarray(3, jnp.int32),
+    }
+    host_b = {
+        "s": jnp.asarray([10.0, 20.0]),
+        "m": jnp.asarray([8.0]),
+        "lo": jnp.asarray([2.0]),
+        "hi": jnp.asarray([6.0]),
+        "_n": jnp.asarray(3, jnp.int32),
+    }
+    # emulate process_allgather: host B's matching bucket flats, in the
+    # deterministic plan bucket order
+    plan = build_sync_plan([(table, host_a)])
+    b_flats = []
+    for bucket in plan.buckets:
+        parts = [jnp.asarray(host_b[s.name]).reshape((s.size,)) for s in bucket.slots]
+        b_flats.append(parts[0] if len(parts) == 1 else jnp.concatenate(parts))
+    calls = []
+    it = iter(b_flats)
+
+    def fake_allgather(flat):
+        calls.append(np.asarray(flat).copy())
+        return np.stack([np.asarray(flat), np.asarray(next(it))])
+
+    out = coalesced_host_sync(host_a, table, n_processes=2, allgather=fake_allgather)
+    assert len(calls) == len(plan.buckets) == plan.n_collectives
+    np.testing.assert_allclose(np.asarray(out["s"]), [11.0, 22.0])
+    np.testing.assert_allclose(np.asarray(out["m"]), [6.0])
+    np.testing.assert_allclose(np.asarray(out["lo"]), [2.0])
+    np.testing.assert_allclose(np.asarray(out["hi"]), [7.0])
+    np.testing.assert_allclose(np.asarray(out["_n"]), 6)
+
+
+# ------------------------------------------------- shared deferred ragged sync
+def test_deferred_ragged_multi_metric_single_gather(mesh):
+    """Two cat-state metrics registered on one DeferredRaggedSync: one
+    combined gather, per-metric results identical to separate accumulators."""
+    from torchmetrics_tpu.parallel import DeferredRaggedSync
+
+    class CatSum(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return sum(float(np.asarray(v).sum()) for v in state["items"])
+
+    class CatLen(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (jnp.asarray(x, jnp.int32),)}
+
+        def _compute(self, state):
+            return sum(int(np.asarray(v).size) for v in state["items"])
+
+    n_dev = int(mesh.devices.size)
+    rng = np.random.default_rng(5)
+    shared = DeferredRaggedSync(mesh=mesh)
+    assert shared.register(CatSum(), "s") == "s"
+    assert shared.register(CatLen(), "l") == "l"
+    solo_s = DeferredRaggedSync(CatSum(), mesh=mesh)
+    solo_l = DeferredRaggedSync(CatLen(), mesh=mesh)
+    for step in range(3):
+        f_batches = [(jnp.asarray(rng.normal(size=(d % 3 + 1,))),) for d in range(n_dev)]
+        i_batches = [(jnp.asarray(rng.integers(0, 9, (d % 2 + 1, 2))),) for d in range(n_dev)]
+        shared.update_for("s", f_batches)
+        shared.update_for("l", i_batches)
+        solo_s.update(f_batches)
+        solo_l.update(i_batches)
+    out = shared.compute()
+    assert sorted(out) == ["l", "s"]
+    assert out["s"] == pytest.approx(solo_s.compute())
+    assert out["l"] == solo_l.compute()
+    # the combined synced states carry per-metric counters
+    synced = shared.sync()
+    assert int(np.asarray(synced["s"]["_n"])) == 3 * n_dev
+    assert int(np.asarray(synced["l"]["_n"])) == 3 * n_dev
+
+
+def test_deferred_ragged_register_rejects_duplicates_and_overriders(mesh):
+    from torchmetrics_tpu.parallel import DeferredRaggedSync
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return len(state["items"])
+
+    acc = DeferredRaggedSync(mesh=mesh)
+    acc.register(CatItems(), "a")
+    with pytest.raises(ValueError, match="already registered"):
+        acc.register(CatItems(), "a")
+    with pytest.raises(ValueError, match="'::'"):
+        acc.register(CatItems(), "a::b")
+    with pytest.raises(ValueError, match="overrides sync_states"):
+        acc.register(PearsonCorrCoef())
+    with pytest.raises(RuntimeError, match="before any update"):
+        acc.sync()
+
+
+# ------------------------------------------------------------------ byte model
+def test_byte_models_favor_coalescing():
+    from torchmetrics_tpu.utilities.benchmark import (
+        coalesced_sync_bytes_per_chip,
+        collectives_per_sync,
+        per_leaf_sync_bytes_per_chip,
+        ring_reduce_bytes,
+        two_stage_dcn_bytes,
+    )
+
+    table = {f"c{i}": Reduce.SUM for i in range(12)}
+    state = {name: jnp.zeros(()) for name in table}
+    state["_n"] = jnp.zeros((), jnp.int32)
+    counts = collectives_per_sync(table, state)
+    assert counts == {"per_leaf": 13, "bucketed": 2}
+    per_leaf = per_leaf_sync_bytes_per_chip(table, state, 8)
+    fused = coalesced_sync_bytes_per_chip(table, state, 8)
+    assert fused < per_leaf  # granule floor amortized across the bucket
+    assert ring_reduce_bytes(0, 8) == 0 and ring_reduce_bytes(4, 1) == 0
+    dcn = two_stage_dcn_bytes(table, state, n_hosts=4, n_local_devices=8)
+    assert dcn["flat"] == 8 * dcn["two_stage"]

@@ -1,0 +1,277 @@
+"""Sync cadence tests: ``SyncPolicy(every_n_steps=k)`` must match the
+per-step sync exactly, interoperate with snapshot/restore mid-window, and run
+the divergence verifier on exactly the sync steps.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.parallel import (
+    SyncPolicy,
+    SyncStepper,
+    flush_sync,
+    sharded_collection_update,
+    sharded_update,
+)
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=5, average="micro"),
+            "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=5, thresholds=16),
+        },
+        compute_groups=True,
+    )
+
+
+def _cls_batches(rng, n=10, batch=16):
+    return [
+        (
+            jax.nn.softmax(jnp.asarray(rng.normal(size=(batch, 5)), jnp.float32), -1),
+            jnp.asarray(rng.integers(0, 5, size=(batch,))),
+        )
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------------------ validation
+def test_sync_policy_validation():
+    assert SyncPolicy().every_n_steps == 1 and not SyncPolicy().defers
+    assert SyncPolicy(every_n_steps=3).defers
+    assert SyncPolicy(at_compute=True).defers
+    assert SyncPolicy(every_n_steps=3).should_sync(3)
+    assert not SyncPolicy(every_n_steps=3).should_sync(2)
+    assert not SyncPolicy(at_compute=True).should_sync(10**6)
+    with pytest.raises(ValueError, match="not both"):
+        SyncPolicy(every_n_steps=2, at_compute=True)
+    for bad in (0, -1, 2.5, True, "3"):
+        with pytest.raises(ValueError, match="int >= 1"):
+            SyncPolicy(every_n_steps=bad)
+
+
+# ------------------------------------------------------------------- exactness
+def test_every_n_matches_per_step_collection(mesh):
+    """10 steps of Acc+F1+AUROC under every_n_steps=3: cumulative states and
+    computed values match the per-step sync exactly (integer-valued f32
+    counts sum exactly, so this is bit-for-bit)."""
+    rng = np.random.default_rng(0)
+    batches = _cls_batches(rng, n=10)
+    cadenced, per_step = _collection(), _collection()
+    ref = {}
+    returned = []
+    for probs, target in batches:
+        out = sharded_collection_update(
+            cadenced, probs, target, mesh=mesh, sync_policy=SyncPolicy(every_n_steps=3)
+        )
+        returned.append(out is not None)
+        states = sharded_collection_update(per_step, probs, target, mesh=mesh)
+        for name, st in states.items():
+            ref[name] = st if name not in ref else per_step[name].merge_states(ref[name], st)
+    # collective ran on steps 3, 6, 9 only
+    assert returned == [False, False, True] * 3 + [False]
+    final = flush_sync(cadenced)
+    for name in ref:
+        assert sorted(final[name]) == sorted(ref[name])
+        for leaf in ref[name]:
+            a, b = np.asarray(final[name][leaf]), np.asarray(ref[name][leaf])
+            assert a.dtype == b.dtype and np.array_equal(a, b), (name, leaf)
+    got = {k: float(v) for k, v in per_step.compute_states(final).items()}
+    want = {k: float(v) for k, v in per_step.compute_states(ref).items()}
+    assert got == want
+
+
+def test_every_n_single_metric_facade(mesh):
+    """sharded_update(sync_policy=...) returns None on deferred steps and the
+    cumulative replicated state on sync steps."""
+    rng = np.random.default_rng(1)
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    ref = MulticlassAccuracy(num_classes=5, average="micro")
+    ref_state = None
+    for step in range(1, 7):
+        preds = jnp.asarray(rng.integers(0, 5, (16,)))
+        target = jnp.asarray(rng.integers(0, 5, (16,)))
+        out = sharded_update(m, preds, target, mesh=mesh, sync_policy=SyncPolicy(every_n_steps=2))
+        st = sharded_update(ref, preds, target, mesh=mesh)
+        ref_state = st if ref_state is None else ref.merge_states(ref_state, st)
+        if step % 2 == 0:
+            assert out is not None
+            for leaf in ref_state:
+                np.testing.assert_array_equal(np.asarray(out[leaf]), np.asarray(ref_state[leaf]))
+        else:
+            assert out is None
+    assert int(np.asarray(flush_sync(m)["_n"])) == int(np.asarray(ref_state["_n"]))
+
+
+def test_at_compute_defers_everything(mesh):
+    rng = np.random.default_rng(2)
+    batches = _cls_batches(rng, n=5)
+    stepper = SyncStepper(_collection(), mesh=mesh, policy=SyncPolicy(at_compute=True))
+    per_step = _collection()
+    ref = {}
+    for probs, target in batches:
+        assert stepper.update(probs, target) is None
+        states = sharded_collection_update(per_step, probs, target, mesh=mesh)
+        for name, st in states.items():
+            ref[name] = st if name not in ref else per_step[name].merge_states(ref[name], st)
+    got = {k: float(v) for k, v in stepper.compute().items()}
+    want = {k: float(v) for k, v in per_step.compute_states(ref).items()}
+    assert got == want
+    assert stepper.steps == 5 and stepper.pending == 0
+
+
+# --------------------------------------------------------- snapshot / restore
+def test_snapshot_restore_mid_window(mesh):
+    """A snapshot taken mid-window (pending deferred steps) restores into a
+    fresh stepper and the continued run matches the uninterrupted one."""
+    rng = np.random.default_rng(3)
+    batches = _cls_batches(rng, n=10)
+    policy = SyncPolicy(every_n_steps=3)
+    stepper = SyncStepper(_collection(), mesh=mesh, policy=policy)
+    for probs, target in batches[:5]:
+        stepper.update(probs, target)
+    assert stepper.pending == 2  # mid-window: 2 deferred steps not yet synced
+    snap = stepper.snapshot()
+    for probs, target in batches[5:]:
+        stepper.update(probs, target)
+    want = {k: float(v) for k, v in stepper.compute().items()}
+
+    restored = SyncStepper(_collection(), mesh=mesh, policy=policy)
+    restored.restore(snap)
+    assert restored.steps == 5 and restored.pending == 2
+    for probs, target in batches[5:]:
+        restored.update(probs, target)
+    got = {k: float(v) for k, v in restored.compute().items()}
+    assert got == want
+
+
+def test_restore_rejects_mismatched_snapshots(mesh):
+    stepper = SyncStepper(_collection(), mesh=mesh, policy=SyncPolicy(every_n_steps=3))
+    with pytest.raises(StateRestoreError, match="not a SyncStepper snapshot"):
+        stepper.restore({"version": 99})
+    probs, target = _cls_batches(np.random.default_rng(4), n=1)[0]
+    stepper.update(probs, target)
+    snap = stepper.snapshot()
+    other = SyncStepper(
+        MetricCollection({"acc": MulticlassAccuracy(num_classes=5, average="micro")}),
+        mesh=mesh,
+        policy=SyncPolicy(every_n_steps=3),
+    )
+    with pytest.raises(StateRestoreError, match="stepper expects"):
+        other.restore(snap)
+    bad = dict(snap)
+    bad["local"] = {
+        name: {leaf: np.zeros((2, 2)) for leaf in tree} for name, tree in snap["local"].items()
+    }
+    with pytest.raises(StateRestoreError, match="shape"):
+        stepper.restore(bad)
+
+
+# -------------------------------------------------------- divergence verifier
+def test_verify_consistency_runs_on_sync_steps(mesh, monkeypatch):
+    """verify_consistency=True checks every synced window — once per member
+    per collective (steps 3, 6, and the compute flush), never on deferred
+    steps."""
+    import torchmetrics_tpu.resilience.divergence as divergence
+
+    calls = []
+    real = divergence.verify_replica_consistency
+    monkeypatch.setattr(
+        divergence,
+        "verify_replica_consistency",
+        lambda m, **kw: calls.append(type(m).__name__) or real(m, **kw),
+    )
+    rng = np.random.default_rng(5)
+    stepper = SyncStepper(
+        _collection(), mesh=mesh, policy=SyncPolicy(every_n_steps=3), verify_consistency=True
+    )
+    n_members = len(stepper._members)
+    for i, (probs, target) in enumerate(_cls_batches(rng, n=7), start=1):
+        stepper.update(probs, target)
+        assert len(calls) == (i // 3) * n_members
+    stepper.compute()  # flushes the open 1-step window
+    assert len(calls) == 3 * n_members
+
+
+# ----------------------------------------------------------------- guard rails
+def test_cadence_args_must_stay_stable(mesh):
+    rng = np.random.default_rng(6)
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    preds = jnp.asarray(rng.integers(0, 5, (16,)))
+    target = jnp.asarray(rng.integers(0, 5, (16,)))
+    sharded_update(m, preds, target, mesh=mesh, sync_policy=SyncPolicy(every_n_steps=4))
+    with pytest.raises(ValueError, match="cadence arguments changed"):
+        sharded_update(m, preds, target, mesh=mesh, sync_policy=SyncPolicy(every_n_steps=2))
+
+
+def test_cadence_rejects_kwargs(mesh):
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    with pytest.raises(ValueError, match="positional"):
+        sharded_update(
+            m,
+            mesh=mesh,
+            sync_policy=SyncPolicy(every_n_steps=2),
+            preds=jnp.zeros((16,), jnp.int32),
+            target=jnp.zeros((16,), jnp.int32),
+        )
+
+
+def test_flush_sync_without_policy_errors():
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    with pytest.raises(RuntimeError, match="no pending cadence state"):
+        flush_sync(m)
+
+
+def test_stepper_rejects_list_state_members(mesh):
+    from torchmetrics_tpu import Metric
+
+    class CatItems(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("items", [], dist_reduce_fx="cat")
+
+        def _update(self, state, x):
+            return {"items": state["items"] + (x,)}
+
+        def _compute(self, state):
+            return len(state["items"])
+
+    with pytest.raises(ValueError, match="DeferredRaggedSync"):
+        SyncStepper(CatItems(), mesh=mesh, policy=SyncPolicy(every_n_steps=2))
+
+
+def test_stepper_steady_state_adds_no_cache_entries(mesh):
+    """After the first sync window, further windows hit the cache: zero new
+    traces however many steps run."""
+    from torchmetrics_tpu.core.compile import cache_stats
+
+    rng = np.random.default_rng(7)
+    stepper = SyncStepper(
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        mesh=mesh,
+        policy=SyncPolicy(every_n_steps=4),
+    )
+    batches = [
+        (jnp.asarray(rng.integers(0, 5, (16,))), jnp.asarray(rng.integers(0, 5, (16,))))
+        for _ in range(12)
+    ]
+    stepper.update(*batches[0])
+    for b in batches[1:4]:
+        stepper.update(*b)  # completes window 1 -> one cadence_sync trace
+    warm = cache_stats()
+    for b in batches[4:]:
+        stepper.update(*b)
+    stepper.compute()
+    stats = cache_stats()
+    assert stats["traces"] == warm["traces"]
+    assert stats["misses"] == warm["misses"]

@@ -1,9 +1,15 @@
-"""Library hygiene lint: no bare ``print(`` inside ``torchmetrics_tpu/``.
+"""Library hygiene lints over the ``torchmetrics_tpu/`` AST.
 
-User-facing output must go through the ``torchmetrics_tpu`` logger (which
-carries a ``NullHandler`` — see ``utilities/prints.py``) or the rank-zero
-helpers, never stdout.  Allowed exceptions: ``utilities/prints.py`` itself
-and ``utilities/plot.py`` (interactive plotting helper).
+* No bare ``print(``: user-facing output must go through the
+  ``torchmetrics_tpu`` logger (which carries a ``NullHandler`` — see
+  ``utilities/prints.py``) or the rank-zero helpers, never stdout.  Allowed
+  exceptions: ``utilities/prints.py`` itself and ``utilities/plot.py``
+  (interactive plotting helper).
+* No direct ``jax.lax.psum``/``all_gather`` outside ``core/reductions.py``
+  and ``parallel/coalesce.py``: every cross-device collective must go
+  through ``sync_leaf`` or the coalescing planner so it is bucketed,
+  telemetry-counted, and covered by the byte-cost model.  A stray direct
+  collective silently escapes all three.
 """
 
 import ast
@@ -11,6 +17,11 @@ from pathlib import Path
 
 PACKAGE = Path(__file__).resolve().parents[3] / "torchmetrics_tpu"
 ALLOWED = {"utilities/prints.py", "utilities/plot.py", "plot.py"}
+
+#: attribute names whose direct call is a collective launch
+BANNED_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather"}
+#: the only modules allowed to lower collectives themselves
+COLLECTIVE_ALLOWED = {"core/reductions.py", "parallel/coalesce.py"}
 
 
 def _bare_prints(path: Path):
@@ -39,4 +50,33 @@ def test_no_bare_print_in_library():
     assert not offenders, (
         "bare print() calls found (route output through the torchmetrics_tpu "
         f"logger or utilities.prints helpers instead): {offenders}"
+    )
+
+
+def _direct_collectives(path: Path):
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        # jax.lax.psum(...) style            from jax.lax import psum; psum(...)
+        name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", None)
+        if name in BANNED_COLLECTIVES:
+            yield node.lineno, name
+
+
+def test_no_direct_collectives_outside_reduction_layer():
+    """Every cross-device collective must lower through core/reductions.py's
+    ``sync_leaf`` or the parallel/coalesce.py planner — anywhere else it
+    escapes bucketing, the telemetry ``collectives`` counter, and the
+    sync-byte cost model."""
+    offenders = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(PACKAGE).as_posix()
+        if rel in COLLECTIVE_ALLOWED:
+            continue
+        offenders.extend(f"{rel}:{lineno} ({name})" for lineno, name in _direct_collectives(path))
+    assert not offenders, (
+        "direct collective calls found outside core/reductions.py and "
+        f"parallel/coalesce.py (use sync_leaf or the coalescing planner): {offenders}"
     )

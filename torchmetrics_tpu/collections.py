@@ -46,12 +46,22 @@ class MetricCollection(dict):
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
         jit: bool = False,
+        sync_policy: Optional["SyncPolicy"] = None,  # noqa: F821 — forward ref
     ) -> None:
         super().__init__()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
         self._enable_jit = bool(jit)
+        if sync_policy is not None:
+            from torchmetrics_tpu.parallel.coalesce import SyncPolicy
+
+            if not isinstance(sync_policy, SyncPolicy):
+                raise ValueError(
+                    f"Expected `sync_policy` to be a parallel.SyncPolicy, got {type(sync_policy)}"
+                )
+        # default cadence for sharded_collection_update(...) on this collection
+        self._sync_policy = sync_policy
         self._groups_checked = False
         self._state_is_copy = False
         self._groups = {}

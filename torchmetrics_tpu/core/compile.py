@@ -84,6 +84,8 @@ __all__ = [
     "cache_stats",
     "set_cache_capacity",
     "clear_compile_cache",
+    "compiled_cadence_step",
+    "compiled_cadence_sync",
     "compiled_collection_update",
     "compiled_divergence_check",
     "compiled_forward",
@@ -142,6 +144,7 @@ CACHE_KINDS = (
     "collection",
     "sharded_collection",
     "divergence",
+    "cadence",
 )
 
 
@@ -587,53 +590,56 @@ def compiled_ragged_gather(
     mesh: Mesh,
     axis_name: str,
     scalar_reduces: Tuple[Tuple[str, Any], ...],
-    ragged_names: Tuple[str, ...],
+    flat_keys: Tuple[str, ...],
     owner: Any = None,
 ) -> Callable:
     """Compiled gather graph for ``parallel.ragged.sync_ragged_states``.
 
-    Buffer shapes vary per call; the caller buckets them (power-of-two) so
-    the jit dispatch inside one cached callable re-traces only when a bucket
-    boundary is crossed — ``cache_stats()['traces']`` counts those.
+    ``flat_keys`` name the caller's coalesced per-dtype ragged buffers (all
+    cat leaves of one dtype raveled into ONE flat buffer, plus one shared
+    shape-table buffer) — one tiled gather each, however many list states
+    ride the sync.  Scalar leaves cross in dtype buckets via the coalescing
+    planner.  Buffer shapes vary per call; the caller buckets them
+    (power-of-two) so the jit dispatch inside one cached callable re-traces
+    only when a bucket boundary is crossed — ``cache_stats()['traces']``
+    counts those.
     """
-    from torchmetrics_tpu.core.reductions import sync_leaf
+    from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
 
     # `owner` attributes cache events to the metric driving the sync; it is
     # deliberately NOT part of the key — the gather graph depends only on the
     # mesh + reduction structure and is shared across owning instances.
-    key = ("ragged_gather", mesh, axis_name, scalar_reduces, ragged_names)
+    key = ("ragged_gather", mesh, axis_name, scalar_reduces, flat_keys)
     owner_ref = weakref.ref(owner) if owner is not None else None
     scope = f"tm_tpu/{type(owner).__name__ if owner is not None else 'ragged'}/ragged_gather"
 
     def build() -> Callable:
+        from torchmetrics_tpu.parallel.coalesce import coalesced_sync_state
+
         reduce_table = dict(scalar_reduces)
 
-        def gather(scalars, n, ragged):
+        def gather(scalars, n, flats):
             mark_trace("ragged", owner_ref)
             with jax.named_scope(scope):
-                out_scalars = {
-                    name: sync_leaf(reduce_table[name], scalars[name][0], axis_name)
-                    for name in scalars
+                local = {name: scalars[name][0] for name in scalars}
+                local["_n"] = n[0]
+                synced = coalesced_sync_state(local, reduce_table, axis_name)
+                out_n = synced.pop("_n")
+                out_scalars = {name: synced[name] for name in scalars}
+                out_flats = {
+                    key: sync_leaf(Reduce.CAT, buf, axis_name) for key, buf in flats.items()
                 }
-                out_n = jax.lax.psum(n[0], axis_name)
-                out_ragged = {
-                    name: (
-                        jax.lax.all_gather(buf, axis_name, axis=0, tiled=True),
-                        jax.lax.all_gather(shapes, axis_name, axis=0, tiled=True),
-                    )
-                    for name, (buf, shapes) in ragged.items()
-                }
-                return out_scalars, out_n, out_ragged
+                return out_scalars, out_n, out_flats
 
         specs_in = (
             {name: P(axis_name) for name, _ in scalar_reduces},
             P(axis_name),
-            {name: (P(axis_name), P(axis_name)) for name in ragged_names},
+            {key: P(axis_name) for key in flat_keys},
         )
         specs_out = (
             {name: P() for name, _ in scalar_reduces},
             P(),
-            {name: (P(), P()) for name in ragged_names},
+            {key: P() for key in flat_keys},
         )
         return jax.jit(
             shard_map(gather, mesh=mesh, in_specs=specs_in, out_specs=specs_out, check_vma=False)
@@ -661,11 +667,15 @@ def compiled_divergence_check(
     owner_ref = weakref.ref(owner) if owner is not None else None
 
     def build() -> Callable:
+        from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
+
         def check(digests):
             mark_trace("divergence", owner_ref)
             with jax.named_scope("tm_tpu/divergence/check"):
                 row = jax.lax.bitcast_convert_type(digests[0], jnp.int32)
-                return jax.lax.pmin(row, axis_name) == jax.lax.pmax(row, axis_name)
+                lo = sync_leaf(Reduce.MIN, row, axis_name)
+                hi = sync_leaf(Reduce.MAX, row, axis_name)
+                return lo == hi
 
         return jax.jit(
             shard_map(check, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
@@ -731,8 +741,11 @@ def compiled_sharded_collection_update(
     AND syncs across the mesh in a single compiled step.
 
     Returns ``fn(*inputs) -> {leader_name: replicated_state}``.  The mesh
-    collective for all leaders' states rides one graph (one dispatch, fused
-    collectives) instead of one ``sharded_update`` dispatch per metric.
+    collective for all leaders' states rides one graph — and, through
+    ``parallel.coalesce.coalesced_metric_sync``, one *cross-leader* bucket
+    plan: every leader's psum-family leaves share dtype buckets, so the
+    whole collection syncs in as few collectives as it has distinct
+    (dtype, reduction-class) pairs instead of one per leaf per metric.
     """
     key = (
         "sharded_collection_update",
@@ -746,17 +759,22 @@ def compiled_sharded_collection_update(
     owner_ref = weakref.ref(collection)
 
     def build() -> Callable:
+        from torchmetrics_tpu.parallel.coalesce import coalesced_metric_sync
+
         frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
 
         def step(*shards):
             mark_trace("sharded_collection", owner_ref)
             with jax.named_scope("tm_tpu/MetricCollection/sharded_collection_update"):
-                out = {}
+                locals_ = {}
                 for name, m in frozen.items():
                     with jax.named_scope(f"tm_tpu/{type(m).__name__}/sharded_update"):
-                        st = m.update_state(m.init_state(), *shards)
-                        out[name] = m.sync_states(st, axis_name)
-                return out
+                        locals_[name] = m.update_state(m.init_state(), *shards)
+                names = tuple(frozen)
+                synced = coalesced_metric_sync(
+                    [frozen[n] for n in names], [locals_[n] for n in names], axis_name
+                )
+                return dict(zip(names, synced))
 
         # every leader state comes back fully replicated
         out_specs = {name: P() for name in frozen}
@@ -765,3 +783,107 @@ def compiled_sharded_collection_update(
         )
 
     return _lookup(key, build, kind="sharded_collection", owner=collection)
+
+
+def compiled_cadence_step(
+    owner: Any,
+    named_metrics: Tuple[Tuple[str, Any], ...],
+    mesh: Mesh,
+    axis_name: str,
+    in_specs: Optional[Any],
+    args: Tuple[Any, ...],
+) -> Callable:
+    """Collective-free local accumulation step for ``parallel.coalesce.SyncStepper``.
+
+    Returns ``fn(carry, *inputs) -> carry`` where ``carry`` is
+    ``{name: stacked_state}`` — every state leaf with a leading device axis,
+    sharded over ``axis_name`` — and each device folds its input shard into
+    its own running state with ``update_state``.  No collective runs; the
+    carry is donated (the stepper owns it exclusively).
+    """
+    if in_specs is None:
+        in_specs = P(axis_name)
+    # NB PartitionSpec is itself a tuple subclass — a bare P broadcasts to
+    # every input, only a non-P tuple is already per-input
+    if isinstance(in_specs, tuple) and not isinstance(in_specs, P):
+        specs = in_specs
+    else:
+        specs = tuple(in_specs for _ in args)
+    key = (
+        "cadence_step",
+        tuple((name, m._config_fingerprint()) for name, m in named_metrics),
+        mesh,
+        axis_name,
+        specs,
+        abstract_signature(args),
+    )
+
+    owner_ref = weakref.ref(owner)
+
+    def build() -> Callable:
+        frozen = tuple((name, _frozen_clone(m)) for name, m in named_metrics)
+
+        def step(carry, *shards):
+            mark_trace("cadence", owner_ref)
+            with jax.named_scope("tm_tpu/SyncStepper/cadence_step"):
+                out = {}
+                for name, m in frozen:
+                    local = jax.tree.map(lambda x: x[0], carry[name])
+                    new = _scoped_member_update(m, local, shards, {})
+                    out[name] = jax.tree.map(lambda x: x[None], new)
+                return out
+
+        return jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(P(axis_name),) + specs,
+                out_specs=P(axis_name),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+
+    return _lookup(key, build, kind="cadence", owner=owner)
+
+
+def compiled_cadence_sync(
+    owner: Any,
+    named_metrics: Tuple[Tuple[str, Any], ...],
+    mesh: Mesh,
+    axis_name: str,
+) -> Callable:
+    """The deferred collective for ``parallel.coalesce.SyncStepper``.
+
+    Returns ``fn(carry) -> {name: replicated_state}``: each device's
+    accumulated local state crosses the mesh through ONE cross-metric
+    coalesced bucket plan (``coalesced_metric_sync``), exactly the sync the
+    per-step path would have run — just ``k`` steps later.
+    """
+    key = (
+        "cadence_sync",
+        tuple((name, m._config_fingerprint()) for name, m in named_metrics),
+        mesh,
+        axis_name,
+    )
+
+    owner_ref = weakref.ref(owner)
+
+    def build() -> Callable:
+        from torchmetrics_tpu.parallel.coalesce import coalesced_metric_sync
+
+        frozen = tuple((name, _frozen_clone(m)) for name, m in named_metrics)
+
+        def syncf(carry):
+            mark_trace("cadence", owner_ref)
+            with jax.named_scope("tm_tpu/SyncStepper/cadence_sync"):
+                names = tuple(name for name, _ in frozen)
+                locals_ = [jax.tree.map(lambda x: x[0], carry[name]) for name in names]
+                synced = coalesced_metric_sync([m for _, m in frozen], locals_, axis_name)
+                return dict(zip(names, synced))
+
+        return jax.jit(
+            shard_map(syncf, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
+        )
+
+    return _lookup(key, build, kind="cadence", owner=owner)

@@ -68,7 +68,6 @@ from torchmetrics_tpu.core.reductions import (
     canonical_reduce,
     is_list_state,
     merge_leaf,
-    sync_leaf,
 )
 from torchmetrics_tpu.observability import registry as _telemetry
 from torchmetrics_tpu.parallel.sync import distributed_available, host_sync_state
@@ -358,12 +357,21 @@ class Metric:
         return out
 
     def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
-        """In-graph cross-device sync (pure; call under shard_map/pmap)."""
+        """In-graph cross-device sync (pure; call under shard_map/pmap).
+
+        Lowers through the coalescing planner
+        (:func:`torchmetrics_tpu.parallel.coalesce.coalesced_sync_state`):
+        one collective per (dtype, reduction-class) bucket instead of one
+        per leaf.  The plan is a static function of the reduction table and
+        leaf specs — exactly what the compile-cache key already fingerprints
+        — so bucketing adds zero cache entries and zero retraces.
+        """
+        from torchmetrics_tpu.parallel.coalesce import coalesced_sync_state
+
         axis_name = axis_name or self.axis_name
-        out: State = {}
-        for name, reduce in self._reductions.items():
-            out[name] = sync_leaf(reduce, state[name], axis_name)
-        out[_N] = jax.lax.psum(state[_N], axis_name)
+        sub: State = {name: state[name] for name in self._reductions}
+        sub[_N] = state[_N]
+        out = coalesced_sync_state(sub, self._reductions, axis_name)
         if self._guard_strategy in ("warn", "error"):
             out[_NONFINITE] = count_nonfinite(out)
         return out
@@ -608,6 +616,7 @@ class Metric:
         d.pop("_jitted_update", None)
         d.pop("_update_signature", None)
         d.pop("_sharded_fn_cache", None)  # legacy per-instance compiled-step cache
+        d.pop("_cadence_stepper", None)  # holds device arrays + a mesh; rebuilt on demand
         # fingerprints can embed object ids (callable attrs) — never let them
         # cross a pickle boundary where ids could collide
         d.pop("_fingerprint_cache", None)

@@ -72,6 +72,7 @@ COUNTER_NAMES = (
     "resets",
     "syncs",
     "sync_bytes",
+    "collectives",
     "donated_installs",
     "copied_installs",
     "nonfinite_events",
@@ -400,24 +401,30 @@ def record_sync(
     state: Mapping[str, Any],
     n_devices: int,
 ) -> None:
-    """Record one cross-device sync for ``obj``: bumps ``syncs`` and adds the
+    """Record one cross-device sync for ``obj``: bumps ``syncs``, adds the
     modelled per-chip traffic (``utilities.benchmark.sync_bytes_per_chip``)
-    to ``sync_bytes``.  Never raises — telemetry must not break a sync."""
+    to ``sync_bytes``, and adds the planner's fused collective count
+    (``parallel.coalesce.bucketed_collective_count``) to ``collectives``.
+    Never raises — telemetry must not break a sync."""
     if not _ENABLED:
         return
     nbytes = 0
+    n_collectives = 0
     try:
+        from torchmetrics_tpu.parallel.coalesce import bucketed_collective_count
         from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
 
         state = dict(state)
         table = {name: r for name, r in reductions.items() if name in state}
         nbytes = int(sync_bytes_per_chip(table, state, int(n_devices)))
+        n_collectives = int(bucketed_collective_count(table, state))
     except Exception:
         _log.debug("sync byte accounting failed for %r", obj, exc_info=True)
     with _LOCK:
         t = telemetry_for(obj)
         t.inc("syncs")
         t.inc("sync_bytes", nbytes)
+        t.inc("collectives", n_collectives)
 
 
 # ------------------------------------------------------------------ reporting

@@ -13,9 +13,27 @@ The reference's entire comm backend is ``gather_all_tensors``
 * :func:`sync_ragged_states` / :func:`sharded_list_update` — the
   pad-gather-trim path for ragged list states (detection mAP's per-image
   variable-length tensors; reference ``_sync_dist`` at
-  detection/mean_ap.py:1022-1046 + utilities/distributed.py:136-147).
+  detection/mean_ap.py:1022-1046 + utilities/distributed.py:136-147);
+* :mod:`~torchmetrics_tpu.parallel.coalesce` — the sync planner behind all
+  of the above: dtype-bucketed fused collectives (:func:`build_sync_plan` /
+  :func:`apply_sync_plan`), sync cadence control (:class:`SyncPolicy`,
+  :class:`SyncStepper`, :func:`flush_sync`), and the hierarchical
+  ICI-then-DCN host sync (:func:`coalesced_host_sync`).
 """
 
+from torchmetrics_tpu.parallel.coalesce import (
+    SyncPolicy,
+    SyncStepper,
+    apply_sync_plan,
+    bucketed_collective_count,
+    build_sync_plan,
+    cadence_stepper,
+    coalesced_host_sync,
+    coalesced_metric_sync,
+    coalesced_sync_state,
+    flush_sync,
+    per_leaf_collective_count,
+)
 from torchmetrics_tpu.parallel.ragged import (
     DeferredRaggedSync,
     sharded_list_update,
@@ -33,9 +51,20 @@ from torchmetrics_tpu.parallel.sync import (
 
 __all__ = [
     "DeferredRaggedSync",
+    "SyncPolicy",
+    "SyncStepper",
+    "apply_sync_plan",
+    "bucketed_collective_count",
+    "build_sync_plan",
+    "cadence_stepper",
+    "coalesced_host_sync",
+    "coalesced_metric_sync",
+    "coalesced_sync_state",
     "distributed_available",
+    "flush_sync",
     "gather_all_arrays",
     "metric_mesh",
+    "per_leaf_collective_count",
     "reduce_op",
     "sharded_collection_update",
     "sharded_list_update",

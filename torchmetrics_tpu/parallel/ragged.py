@@ -10,12 +10,14 @@ pads every tensor to the world max, all_gathers, and trims
 
 The TPU-native equivalent here: per-device list states are packed into ONE
 padded buffer + one per-item shape table per state name (items are padded in
-*every* dimension to the mesh max, like the reference's all-dims pad), a
-single tiled ``all_gather`` per state crosses the mesh inside ``shard_map``
+*every* dimension to the mesh max, like the reference's all-dims pad), then
+every state's buffer of a given dtype is raveled into a single flat buffer —
+one tiled ``all_gather`` per *dtype* crosses the mesh inside ``shard_map``
 (ICI — not one collective per tensor like the reference's per-tensor
-gather), and the items are re-split on host.  Scalar (psum/pmax/...) states
-ride the same shard_map call, so a metric mixing tensor and list states
-syncs in one graph.
+gather), plus one for the shared shape tables.  Scalar (psum/pmax/...)
+states ride the same shard_map call through the coalescing planner's dtype
+buckets, so a metric mixing tensor and list states syncs in one graph with
+a handful of collectives regardless of its leaf count.
 
 Example::
 
@@ -43,7 +45,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchmetrics_tpu.core.compile import bucket_dim, compiled_ragged_gather
-from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
+from torchmetrics_tpu.core.reductions import Reduce
 from torchmetrics_tpu.observability import registry as _telemetry
 
 State = Dict[str, Any]
@@ -102,6 +104,25 @@ def _ragged_meta(per_device_items: Sequence[Sequence[Any]]) -> Optional[Tuple[Tu
     return tuple(int(x) for x in max_trailing), dtype
 
 
+def _check_update_counts(counts: Sequence[int], leaf: str = _N) -> None:
+    """Raise :class:`ReplicaDivergenceError` if the per-device update counts
+    disagree (the uneven-restore failure mode — a lost or duplicated step
+    would silently skew the gathered aggregate)."""
+    if len(set(counts)) > 1:
+        from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+        majority = max(set(counts), key=counts.count)
+        bad = [d for d, c in enumerate(counts) if c != majority]
+        raise ReplicaDivergenceError(
+            f"per-device update counts diverged before ragged sync: {counts} "
+            f"(devices {bad} disagree with the majority count {majority}). Each device "
+            "must see the same number of update steps; a preempted/restored device "
+            "likely resumed from the wrong step.",
+            leaves=(leaf,),
+            replicas=bad,
+        )
+
+
 def sync_ragged_states(
     reductions: Mapping[str, Union[Reduce, Callable]],
     per_device_states: Sequence[State],
@@ -144,20 +165,7 @@ def sync_ragged_states(
         # a device whose update count drifted (lost or duplicated a step —
         # the uneven-restore failure mode) would silently skew the gathered
         # aggregate; catch it before the collective runs
-        counts = [int(np.asarray(st.get(_N, 0))) for st in per_device_states]
-        if len(set(counts)) > 1:
-            from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
-
-            majority = max(set(counts), key=counts.count)
-            bad = [d for d, c in enumerate(counts) if c != majority]
-            raise ReplicaDivergenceError(
-                f"per-device update counts diverged before ragged sync: {counts} "
-                f"(devices {bad} disagree with the majority count {majority}). Each device "
-                "must see the same number of update steps; a preempted/restored device "
-                "likely resumed from the wrong step.",
-                leaves=(_N,),
-                replicas=bad,
-            )
+        _check_update_counts([int(np.asarray(st.get(_N, 0))) for st in per_device_states])
     # reserved counters ride the scalar SUM path without a reduction-table entry
     reductions = dict(reductions)
     reductions.setdefault(_NONFINITE, Reduce.SUM)
@@ -233,15 +241,88 @@ def sync_ragged_states(
         [jnp.asarray(st.get(_N, 0), jnp.int32) for st in per_device_states]
     )
 
-    ragged_in = {name: (jnp.asarray(packed[name][0]), jnp.asarray(packed[name][1])) for name in packed}
+    # ---- coalesce the packed per-name buffers into per-dtype flat gathers:
+    # every cat leaf of one dtype ravels into ONE stacked 1-D buffer (each
+    # device's segment concatenates its per-name blocks in sorted-name
+    # order), and all shape tables share one i32 buffer — however many list
+    # states the metric carries, the graph runs one tiled all_gather per
+    # dtype plus one for the tables.  Block sizes are functions of the
+    # pow2-bucketed L/K/trailing dims, so the flat lengths are as
+    # trace-stable as the per-name buffers were.
+    sorted_ragged = sorted(packed)
+    by_dtype: Dict[str, List[str]] = {}
+    for name in sorted_ragged:
+        by_dtype.setdefault(str(packed[name][0].dtype), []).append(name)
+    # one device's ravel length for this leaf: L * prod(trailing dims)
+    block_size = {
+        name: packed[name][2] * int(np.prod(packed[name][0].shape[1:], dtype=np.int64))
+        for name in sorted_ragged
+    }
+    shape_block = {name: packed[name][3] * packed[name][1].shape[1] for name in sorted_ragged}
+
+    flats: Dict[str, np.ndarray] = {}
+    for dtype_str, group in sorted(by_dtype.items()):
+        seg_len = sum(block_size[nm] for nm in group)
+        flat = np.zeros((n_dev * seg_len,), np.dtype(dtype_str))
+        for d in range(n_dev):
+            off = d * seg_len
+            for nm in group:
+                buf_stack, _, L, _ = packed[nm]
+                block = buf_stack[d * L : (d + 1) * L].ravel()
+                flat[off : off + block.size] = block
+                off += block.size
+        flats[f"items_{dtype_str}"] = flat
+    if sorted_ragged:
+        tab_len = sum(shape_block[nm] for nm in sorted_ragged)
+        shp_flat = np.empty((n_dev * tab_len,), np.int32)
+        for d in range(n_dev):
+            off = d * tab_len
+            for nm in sorted_ragged:
+                _, shape_stack, _, K = packed[nm]
+                block = shape_stack[d * K : (d + 1) * K].ravel()
+                shp_flat[off : off + block.size] = block
+                off += block.size
+        flats["shapes"] = shp_flat
+    flats_jnp = {key: jnp.asarray(v) for key, v in flats.items()}
 
     scalar_reduces = tuple(sorted(((n, reductions[n]) for n in scalar_names), key=lambda kv: kv[0]))
-    fn = compiled_ragged_gather(mesh, axis_name, scalar_reduces, tuple(sorted(ragged_in)), owner=owner)
+    fn = compiled_ragged_gather(mesh, axis_name, scalar_reduces, tuple(sorted(flats_jnp)), owner=owner)
     with _telemetry.span(owner, "sync"):
-        g_scalars, g_n, g_ragged = fn(scalar_stacks, n_stack, ragged_in)
+        g_scalars, g_n, g_flats = fn(scalar_stacks, n_stack, flats_jnp)
     # `owner=None` lands the sync in the `_unattributed` telemetry row rather
     # than double-counting against a metric some outer caller already credits
     _telemetry.record_sync(owner, reductions, dict(per_device_states[0]), n_dev)
+
+    # ---- carve each name's per-device blocks back out of the gathered flats
+    g_host = {key: np.asarray(v) for key, v in g_flats.items()}
+    rebuilt: Dict[str, np.ndarray] = {}
+    for dtype_str, group in sorted(by_dtype.items()):
+        seg_len = sum(block_size[nm] for nm in group)
+        flat = g_host[f"items_{dtype_str}"]
+        for nm in group:
+            trail = packed[nm][0].shape[1:]
+            rebuilt[nm] = np.empty((n_dev * packed[nm][2], *trail), np.dtype(dtype_str))
+        for d in range(n_dev):
+            off = d * seg_len
+            for nm in group:
+                L = packed[nm][2]
+                trail = packed[nm][0].shape[1:]
+                size = block_size[nm]
+                rebuilt[nm][d * L : (d + 1) * L] = flat[off : off + size].reshape(L, *trail)
+                off += size
+    shape_tabs: Dict[str, np.ndarray] = {}
+    if sorted_ragged:
+        tab_len = sum(shape_block[nm] for nm in sorted_ragged)
+        shp = g_host["shapes"]
+        for nm in sorted_ragged:
+            shape_tabs[nm] = np.empty((n_dev * packed[nm][3], packed[nm][1].shape[1]), np.int32)
+        for d in range(n_dev):
+            off = d * tab_len
+            for nm in sorted_ragged:
+                K, ndim = packed[nm][3], packed[nm][1].shape[1]
+                size = shape_block[nm]
+                shape_tabs[nm][d * K : (d + 1) * K] = shp[off : off + size].reshape(K, ndim)
+                off += size
 
     # ---- trim + re-split on host, preserving device order
     out: State = {name: g_scalars[name] for name in scalar_names}
@@ -252,8 +333,8 @@ def sync_ragged_states(
             out[name] = ()
             continue
         _, _, L, K = packed[name]
-        buf = np.asarray(g_ragged[name][0])
-        shape_tab = np.asarray(g_ragged[name][1])
+        buf = rebuilt[name]
+        shape_tab = shape_tabs[name]
         items: List[np.ndarray] = []
         for d in range(n_dev):
             dev_shapes = shape_tab[d * K : (d + 1) * K]
@@ -315,42 +396,100 @@ class DeferredRaggedSync:
     merges each step's partial state locally (cheap, collective-free), and
     crosses the mesh exactly once when the result is needed.
 
+    Several cat-state metrics sharing one evaluation loop can
+    :meth:`register` on the SAME accumulator: their leaves are namespaced
+    (``"name::leaf"``) into one combined state, so ``sync`` runs a single
+    coalesced gather — one all_gather per dtype — for ALL of them instead of
+    one gather per metric.
+
     Example::
 
         acc = DeferredRaggedSync(map_metric, mesh=mesh)
         for per_device_batches in loader:
             acc.update(per_device_batches)       # no collective here
         results = acc.compute()                  # ONE padded gather
+
+        shared = DeferredRaggedSync(mesh=mesh)
+        shared.register(map_metric, "map")
+        shared.register(rouge_metric, "rouge")
+        ...
+        shared.update_for("map", map_batches)    # still no collective
+        shared.update_for("rouge", rouge_batches)
+        results = shared.compute()               # ONE gather for both
     """
 
     def __init__(
         self,
-        metric: "Metric",  # noqa: F821 — forward ref
+        metric: Optional["Metric"] = None,  # noqa: F821 — forward ref
         mesh: Optional[Mesh] = None,
         axis_name: str = "data",
         verify_consistency: bool = False,
     ) -> None:
-        from torchmetrics_tpu.core.metric import Metric
         from torchmetrics_tpu.parallel.sync import metric_mesh
+
+        self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
+        self.axis_name = axis_name
+        self.verify_consistency = verify_consistency
+        self._members: Dict[str, Any] = {}  # insertion-ordered
+        self._per_device: Dict[str, Optional[List[State]]] = {}
+        if metric is not None:
+            self.register(metric)
+
+    def register(self, metric: "Metric", name: Optional[str] = None) -> str:  # noqa: F821
+        """Add a metric to the shared deferred gather; returns its key."""
+        from torchmetrics_tpu.core.metric import Metric
 
         if type(metric).sync_states is not Metric.sync_states:
             raise ValueError(
                 f"{type(metric).__name__} overrides sync_states; its states do not combine "
                 "leaf-wise under the reduction table, so the deferred gather cannot apply it."
             )
-        self.metric = metric
-        self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
-        self.axis_name = axis_name
-        self.verify_consistency = verify_consistency
-        self._per_device: Optional[List[State]] = None
+        if name is None:
+            name = type(metric).__name__
+            if name in self._members:
+                name = f"{name}_{len(self._members)}"
+        if name in self._members:
+            raise ValueError(
+                f"a metric is already registered under {name!r}; pass an explicit unique name"
+            )
+        if "::" in name:
+            raise ValueError(f"metric name {name!r} may not contain '::' (the namespace separator)")
+        self._members[name] = metric
+        self._per_device[name] = None
+        return name
+
+    @property
+    def metric(self) -> "Metric":  # noqa: F821
+        """The sole registered metric (single-metric back-compat accessor)."""
+        if len(self._members) != 1:
+            raise AttributeError(
+                f".metric needs exactly one registered metric, have {len(self._members)}"
+            )
+        return next(iter(self._members.values()))
+
+    def _sole_key(self, what: str) -> str:
+        if len(self._members) != 1:
+            raise RuntimeError(
+                f"{what} requires exactly one registered metric "
+                f"(have {sorted(self._members)}); use the *_for/keyed variants"
+            )
+        return next(iter(self._members))
 
     @property
     def steps(self) -> int:
-        return 0 if self._per_device is None else int(self._per_device[0].get(_N, 0))
+        key = self._sole_key("steps")
+        states = self._per_device[key]
+        return 0 if states is None else int(states[0].get(_N, 0))
 
     def update(self, per_device_batches: Sequence[Tuple[Any, ...]]) -> None:
         """Fold one step's per-device batches into the running per-device
         states.  Purely local: no cross-device collective runs here."""
+        self.update_for(self._sole_key("update"), per_device_batches)
+
+    def update_for(self, name: str, per_device_batches: Sequence[Tuple[Any, ...]]) -> None:
+        """:meth:`update` for one registered metric of a shared accumulator."""
+        if name not in self._members:
+            raise KeyError(f"no metric registered under {name!r} (have {sorted(self._members)})")
         # validated on EVERY step: the merge below zips against the running
         # per-device states, and a silent zip-truncation would drop data
         if len(per_device_batches) != int(self.mesh.devices.size):
@@ -358,31 +497,74 @@ class DeferredRaggedSync:
                 f"need one batch per mesh device: got {len(per_device_batches)} for "
                 f"{int(self.mesh.devices.size)} devices"
             )
-        m = self.metric
+        m = self._members[name]
         partial = [m.update_state(m.init_state(), *batch) for batch in per_device_batches]
-        if self._per_device is None:
-            self._per_device = partial
+        if self._per_device[name] is None:
+            self._per_device[name] = partial
         else:
-            self._per_device = [
-                m.merge_states(acc, new) for acc, new in zip(self._per_device, partial)
+            self._per_device[name] = [
+                m.merge_states(acc, new) for acc, new in zip(self._per_device[name], partial)
             ]
 
-    def sync(self) -> State:
+    def sync(self) -> Union[State, Dict[str, State]]:
         """The one deferred collective: pad-gather-trim every accumulated
-        per-device state across the mesh and return the global state."""
-        if self._per_device is None:
-            raise RuntimeError("DeferredRaggedSync.sync called before any update")
-        return sync_ragged_states(
-            self.metric._reductions,
-            self._per_device,
-            self.mesh,
-            self.axis_name,
-            verify_consistency=self.verify_consistency,
-            owner=self.metric,
-        )
+        per-device state across the mesh.  With one registered metric,
+        returns its global state (back-compat); with several, returns
+        ``{name: state}`` — all of them crossed in a single coalesced
+        gather."""
+        if not self._members:
+            raise RuntimeError("DeferredRaggedSync.sync called with no registered metric")
+        never = [k for k, v in self._per_device.items() if v is None]
+        if never:
+            raise RuntimeError(
+                f"DeferredRaggedSync.sync called before any update for {never}"
+            )
+        if len(self._members) == 1:
+            key = next(iter(self._members))
+            m = self._members[key]
+            # raw (un-namespaced) leaf names keep the single-metric compile
+            # cache keys identical to the pre-registration API
+            return sync_ragged_states(
+                m._reductions,
+                self._per_device[key],
+                self.mesh,
+                self.axis_name,
+                verify_consistency=self.verify_consistency,
+                owner=m,
+            )
+        n_dev = int(self.mesh.devices.size)
+        if self.verify_consistency:
+            for key, states in self._per_device.items():
+                _check_update_counts(
+                    [int(np.asarray(st.get(_N, 0))) for st in states], leaf=f"{key}::{_N}"
+                )
+        table: Dict[str, Union[Reduce, Callable]] = {}
+        combined: List[State] = [{} for _ in range(n_dev)]
+        for key, m in self._members.items():
+            table.update({f"{key}::{leaf}": r for leaf, r in m._reductions.items()})
+            # reserved counters become ordinary namespaced SUM leaves — the
+            # combined state has no top-level "_n" of its own
+            table[f"{key}::{_N}"] = Reduce.SUM
+            table[f"{key}::{_NONFINITE}"] = Reduce.SUM
+            for d, st in enumerate(self._per_device[key]):
+                combined[d].update({f"{key}::{leaf}": v for leaf, v in st.items()})
+        # owner=None: the sync spans several metrics, so it lands in the
+        # `_unattributed` telemetry row instead of crediting one of them
+        synced = sync_ragged_states(table, combined, self.mesh, self.axis_name, owner=None)
+        out: Dict[str, State] = {}
+        for key in self._members:
+            prefix = f"{key}::"
+            out[key] = {
+                leaf[len(prefix):]: v for leaf, v in synced.items() if leaf.startswith(prefix)
+            }
+        return out
 
     def compute(self) -> Any:
-        return self.metric.compute_state(self.sync())
+        """Single metric: its computed value.  Several: ``{name: value}``."""
+        if len(self._members) == 1:
+            return self.metric.compute_state(self.sync())
+        synced = self.sync()
+        return {key: self._members[key].compute_state(synced[key]) for key in self._members}
 
     def reset(self) -> None:
-        self._per_device = None
+        self._per_device = {key: None for key in self._members}

@@ -36,9 +36,17 @@ import numpy as np
 from jax import Array
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from torchmetrics_tpu.core.reductions import Reduce, host_sync_leaf, sync_leaf
+from jax.experimental import multihost_utils
+
+from torchmetrics_tpu.core.reductions import Reduce
 from torchmetrics_tpu.observability import registry as _telemetry
-from torchmetrics_tpu.utilities.prints import rank_zero_debug
+from torchmetrics_tpu.parallel.coalesce import (
+    SyncPolicy,
+    cadence_stepper,
+    coalesced_host_sync,
+    coalesced_sync_state,
+)
+from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_warn
 
 State = Dict[str, Any]
 
@@ -48,6 +56,9 @@ _NONFINITE = "_nonfinite"
 # one-time latch for the distributed_available probe failure, so a broken
 # backend logs once instead of on every compute()
 _DIST_PROBE_FAILED_LOGGED = False
+
+# one-time-per-class latch for the uncached kwargs path warning below
+_KWARGS_RETRACE_WARNED: set = set()
 
 
 def distributed_available() -> bool:
@@ -88,29 +99,25 @@ def sync_state(
     Pure; call inside ``shard_map``/``pmap``.  The per-leaf reduction table is
     the same one ``merge`` uses, so in-graph sync and local merge are
     guaranteed consistent (the reference re-implements both paths separately
-    at metric.py:401 and :459).
+    at metric.py:401 and :459).  Lowers through the coalescing planner
+    (``parallel.coalesce``): one collective per (dtype, reduction-class)
+    bucket instead of one per leaf; reserved counters (``_n``/``_nonfinite``)
+    ride the int32 sum bucket.
     """
-    out = {}
-    for name, value in state.items():
-        if name in (_N, _NONFINITE):  # reserved counters: always summed
-            out[name] = jax.lax.psum(value, axis_name)
-            continue
-        out[name] = sync_leaf(reductions[name], value, axis_name)
-    return out
+    return coalesced_sync_state(state, reductions, axis_name)
 
 
 def host_sync_state(
     state: State,
     reductions: Mapping[str, Union[Reduce, Callable]],
 ) -> State:
-    """Cross-process sync of an eager state pytree (DCN path, no jit)."""
-    out = {}
-    for name, value in state.items():
-        if name in (_N, _NONFINITE):  # reserved counters: always summed
-            out[name] = host_sync_leaf(Reduce.SUM, value)
-            continue
-        out[name] = host_sync_leaf(reductions[name], value)
-    return out
+    """Cross-process sync of an eager state pytree (DCN path, no jit).
+
+    Bucketed like the in-graph path: one ``process_allgather`` per
+    (dtype, reduction-class) bucket — the DCN stage of the hierarchical
+    two-stage reduce, crossing hosts on already ICI-reduced state.
+    """
+    return coalesced_host_sync(state, reductions)
 
 
 def gather_all_arrays(value: Array, group: Any = None) -> list:
@@ -121,11 +128,19 @@ def gather_all_arrays(value: Array, group: Any = None) -> list:
     reference pads+trims for uneven shapes; ``process_allgather`` handles
     shape negotiation itself, so the fast/slow split disappears.
     Returns a list of per-process arrays.
+
+    ``group`` (the reference's ``torch.distributed`` process group) has no
+    JAX equivalent — ``process_allgather`` always spans every process — so a
+    non-``None`` group is rejected instead of silently ignored.
     """
+    if group is not None:
+        raise ValueError(
+            "gather_all_arrays(group=...) is not supported: JAX's process_allgather "
+            "always spans all processes; there is no process-subgroup equivalent. "
+            "Pass group=None and filter the returned per-process list instead."
+        )
     if not distributed_available():
         return [value]
-    from jax.experimental import multihost_utils
-
     gathered = multihost_utils.process_allgather(value)
     return list(gathered)
 
@@ -170,6 +185,7 @@ def sharded_update(
     axis_name: str = "data",
     in_specs: Optional[Any] = None,
     verify_consistency: bool = False,
+    sync_policy: Optional[SyncPolicy] = None,
     **kwargs: Array,
 ) -> State:
     """Run one metric ``update`` with inputs sharded over the mesh batch axis.
@@ -187,6 +203,13 @@ def sharded_update(
     device copy that diverged raises
     :class:`~torchmetrics_tpu.utilities.exceptions.ReplicaDivergenceError`
     at sync time instead of producing a silently wrong aggregate.
+
+    With a deferring ``sync_policy`` (``SyncPolicy(every_n_steps=k)`` or
+    ``at_compute=True``), repeated calls accumulate *locally* on each device
+    and the coalesced collective runs only on sync steps: the call returns
+    the **cumulative** replicated state on sync steps and ``None`` on
+    deferred ones; finish with
+    :func:`~torchmetrics_tpu.parallel.coalesce.flush_sync`.
     """
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     if in_specs is None:
@@ -194,12 +217,40 @@ def sharded_update(
 
     specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
 
+    if sync_policy is not None and sync_policy.defers:
+        if kwargs:
+            raise ValueError(
+                "sharded_update(sync_policy=...) needs positional inputs: the cadence "
+                "stepper's compiled local step is cached, and kwargs would be frozen as "
+                "trace constants"
+            )
+        stepper = cadence_stepper(
+            metric,
+            mesh=mesh,
+            axis_name=axis_name,
+            policy=sync_policy,
+            verify_consistency=verify_consistency,
+            in_specs=specs,
+        )
+        return stepper.update(*inputs)
+
     # check_vma=False (inside compiled_sharded_update): all_gather-produced
     # leaves are replicated in value but the static VMA checker cannot infer
     # that, so replication is asserted, not checked.
     if kwargs:
         # kwargs are closed over as trace constants — a cached compile would
         # freeze their first values, so this path stays uncached
+        cls_name = type(metric).__name__
+        if cls_name not in _KWARGS_RETRACE_WARNED:
+            _KWARGS_RETRACE_WARNED.add(cls_name)
+            rank_zero_warn(
+                f"sharded_update({cls_name}, ...) was called with keyword inputs "
+                f"({sorted(kwargs)}). This path cannot use the compile cache — kwargs are "
+                "closed over as trace constants — so EVERY step re-traces (~seconds each). "
+                "Pass the batch arrays positionally to hit the cached compiled path "
+                "(core.compile.compiled_sharded_update). This warning is shown once per "
+                "metric class."
+            )
 
         def step(*shards):
             st = metric.update_state(metric.init_state(), *shards, **kwargs)
@@ -245,15 +296,24 @@ def sharded_collection_update(
     mesh: Optional[Mesh] = None,
     axis_name: str = "data",
     in_specs: Optional[Any] = None,
+    sync_policy: Optional[SyncPolicy] = None,
 ) -> Dict[str, State]:
     """One fused compiled step for a whole :class:`MetricCollection`.
 
     Every compute-group leader updates from its input shard AND syncs across
-    the mesh inside ONE shard_map graph — one dispatch and fused collectives
-    for the whole collection, instead of one :func:`sharded_update` dispatch
-    per member metric.  Shared preprocessing between members is CSE'd by XLA
-    inside the single graph.  Returns ``{leader_name: replicated_state}``,
-    ready for ``collection.compute_states`` / ``collection.load_states``.
+    the mesh inside ONE shard_map graph — one dispatch, and through the
+    coalescing planner ONE collective per (dtype, reduction-class) bucket
+    *across all leaders* (2 buckets for Acc+F1+AUROC), instead of one
+    :func:`sharded_update` dispatch with per-leaf collectives per member
+    metric.  Shared preprocessing between members is CSE'd by XLA inside the
+    single graph.  Returns ``{leader_name: replicated_state}``, ready for
+    ``collection.compute_states`` / ``collection.load_states``.
+
+    ``sync_policy`` (defaulting to the collection's ``sync_policy=``
+    construction flag) defers the collective like
+    :func:`sharded_update`'s: deferred steps return ``None``, sync steps
+    return the cumulative states; finish with
+    :func:`~torchmetrics_tpu.parallel.coalesce.flush_sync`.
 
     Leaders with list (cat) states cannot ride the in-graph step path — use
     :class:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync` for those.
@@ -273,6 +333,17 @@ def sharded_collection_update(
             f"leaders {listy} hold list (cat) states, which grow per step and cannot be traced. "
             "Update those eagerly and defer their gather to compute with DeferredRaggedSync."
         )
+    if sync_policy is None:
+        sync_policy = getattr(collection, "_sync_policy", None)
+    if sync_policy is not None and sync_policy.defers:
+        stepper = cadence_stepper(
+            collection,
+            mesh=mesh,
+            axis_name=axis_name,
+            policy=sync_policy,
+            in_specs=specs,
+        )
+        return stepper.update(*inputs)
     fn = compiled_sharded_collection_update(collection, leaders, mesh, axis_name, specs, inputs)
     with _telemetry.span(collection, "sync"):
         out = fn(*inputs)

@@ -11,11 +11,11 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
-import jax
 import jax.numpy as jnp
 from jax import Array
 
 from torchmetrics_tpu.core.metric import Metric, State, _N
+from torchmetrics_tpu.core.reductions import Reduce, sync_leaf
 from torchmetrics_tpu.functional.regression.correlation import (
     _final_aggregation,
     _pearson_compute,
@@ -78,15 +78,21 @@ class PearsonCorrCoef(Metric):
         }
 
     def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
+        # moment states are not leaf-wise combinable, so this bypasses the
+        # coalescing planner: stack every device's moments (Reduce.NONE
+        # lowers to the same all_gather the planner's passthrough uses) and
+        # run the pairwise aggregation on the stacked copies
         axis_name = axis_name or self.axis_name
-        gathered = {k: jax.lax.all_gather(v, axis_name) for k, v in state.items() if k != _N}
+        gathered = {
+            k: sync_leaf(Reduce.NONE, v, axis_name) for k, v in state.items() if k != _N
+        }
         mx, my, vx, vy, cxy, n = _final_aggregation(
             gathered["mean_x"], gathered["mean_y"], gathered["var_x"],
             gathered["var_y"], gathered["corr_xy"], gathered["n_total"],
         )
         return {
             "mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy,
-            "corr_xy": cxy, "n_total": n, _N: jax.lax.psum(state[_N], axis_name),
+            "corr_xy": cxy, "n_total": n, _N: sync_leaf(Reduce.SUM, state[_N], axis_name),
         }
 
     def host_sync_states(self, state: State) -> State:

@@ -10,14 +10,27 @@ answers all three for any metric instance.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 
 from torchmetrics_tpu.core.reductions import Reduce
 
-__all__ = ["benchmark", "cache_stats_delta", "state_bytes", "sync_bytes_per_chip"]
+__all__ = [
+    "RING_GRANULE_BYTES",
+    "benchmark",
+    "cache_stats_delta",
+    "coalesced_sync_bytes_per_chip",
+    "collectives_per_sync",
+    "per_leaf_sync_bytes_per_chip",
+    "ring_reduce_bytes",
+    "state_bytes",
+    "sync_bytes_per_chip",
+    "two_stage_dcn_bytes",
+]
 
 
 def cache_stats_delta(after: Dict[str, Any], before: Dict[str, Any]) -> Dict[str, Any]:
@@ -72,6 +85,113 @@ def sync_bytes_per_chip(reductions: Dict[str, Any], state: Dict[str, Any], n_dev
     """
     psum_b, gather_b = split_state_bytes(reductions, state)
     return int(round(2 * (n_devices - 1) / n_devices * psum_b + (n_devices - 1) * gather_b))
+
+
+#: Minimum per-step transfer a ring all-reduce moves on real interconnects:
+#: each of the ``2(n-1)`` ring steps sends ``ceil(B/(n*granule))*granule``
+#: bytes, so a collective over a tiny buffer still pays one full granule per
+#: step.  This is what makes per-leaf syncs of scalar counters so much more
+#: expensive than their raw byte count suggests — and what coalescing wins
+#: back by amortizing the granule over every fused leaf.
+RING_GRANULE_BYTES = 256
+
+
+def ring_reduce_bytes(
+    buffer_bytes: int, n_devices: int, granule: int = RING_GRANULE_BYTES
+) -> int:
+    """Granule-aware per-chip traffic of ONE ring all-reduce of
+    ``buffer_bytes``: ``2(n-1) * ceil(B / (n*granule)) * granule``.
+
+    Reduces to the classic ``2(n-1)/n * B`` as ``B >> n*granule``, but keeps
+    the floor a small collective actually pays.
+    """
+    if n_devices <= 1 or buffer_bytes <= 0:
+        return 0
+    chunk = math.ceil(buffer_bytes / (n_devices * granule)) * granule
+    return int(2 * (n_devices - 1) * chunk)
+
+
+def collectives_per_sync(reductions: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, int]:
+    """``{"per_leaf": n, "bucketed": m}`` collective launches for one sync of
+    ``state`` — the pre-coalescing one-per-leaf loop vs the planner's fused
+    dtype buckets (``parallel.coalesce.build_sync_plan``)."""
+    from torchmetrics_tpu.parallel.coalesce import (
+        bucketed_collective_count,
+        per_leaf_collective_count,
+    )
+
+    return {
+        "per_leaf": per_leaf_collective_count(reductions, state),
+        "bucketed": bucketed_collective_count(reductions, state),
+    }
+
+
+def per_leaf_sync_bytes_per_chip(
+    reductions: Dict[str, Any],
+    state: Dict[str, Any],
+    n_devices: int,
+    granule: int = RING_GRANULE_BYTES,
+) -> int:
+    """Granule-aware per-chip traffic of the pre-coalescing per-leaf sync:
+    one ring all-reduce per psum-family leaf (each paying its own granule
+    floor) plus ``(n-1)x`` local bytes per gathered leaf."""
+    total = 0
+    for name, reduce in reductions.items():
+        leaf = state[name]
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+        if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN) and not isinstance(
+            leaf, tuple
+        ):
+            total += ring_reduce_bytes(nbytes, n_devices, granule)
+        else:
+            total += (n_devices - 1) * nbytes
+    return int(total)
+
+
+def coalesced_sync_bytes_per_chip(
+    reductions: Dict[str, Any],
+    state: Dict[str, Any],
+    n_devices: int,
+    granule: int = RING_GRANULE_BYTES,
+) -> int:
+    """Granule-aware per-chip traffic of the coalesced sync: one ring
+    all-reduce per planner bucket (the granule floor amortized over every
+    fused leaf) plus the per-leaf gather path for passthrough leaves."""
+    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+
+    plan = build_sync_plan([(reductions, state)])
+    total = 0
+    for bucket in plan.buckets:
+        total += ring_reduce_bytes(bucket.size * np.dtype(bucket.dtype).itemsize, n_devices, granule)
+    for _, name, _ in plan.passthrough:
+        leaf = state[name]
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+        total += (n_devices - 1) * nbytes
+    return int(total)
+
+
+def two_stage_dcn_bytes(
+    reductions: Dict[str, Any],
+    state: Dict[str, Any],
+    n_hosts: int,
+    n_local_devices: int,
+    granule: int = RING_GRANULE_BYTES,
+) -> Dict[str, int]:
+    """Cross-host (DCN) traffic model of one psum-family sync: ``flat``
+    reduces over all ``n_hosts * n_local_devices`` participants in one ring
+    whose inter-host hops carry every local device's segment, vs
+    ``two_stage`` which reduces over ICI inside each host first so ONE
+    reduced copy per host crosses DCN — an ``~n_local_devices x`` cut.
+    """
+    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+
+    plan = build_sync_plan([(reductions, state)])
+    psum_b = sum(b.size * np.dtype(b.dtype).itemsize for b in plan.buckets)
+    per_host_ring = ring_reduce_bytes(psum_b, n_hosts, granule)
+    return {
+        "flat": int(n_local_devices * per_host_ring),
+        "two_stage": int(per_host_ring),
+    }
 
 
 def benchmark(
