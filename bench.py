@@ -1046,6 +1046,47 @@ def kernel_vs_reference():
     return out
 
 
+def analysis_leg():
+    """Static-analysis cost: wall-time of the full trace-safety lint
+    (``python -m torchmetrics_tpu.analysis``) over the package, with a 5 s
+    budget so the CI gate stays cheap, plus one jaxpr contract audit proving
+    the planner's collective count matches the lowered sync graph.
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.analysis import all_rules, audit_metric, lint_package, package_root
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    n_files = len(list(package_root().rglob("*.py")))
+    t0 = time.perf_counter()
+    findings = lint_package()
+    lint_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.standard_normal((64, 5)).astype("float32"))
+    tgt = jnp.asarray(rng.integers(0, 5, 64))
+    t0 = time.perf_counter()
+    report = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), preds, tgt)
+    audit_s = time.perf_counter() - t0
+
+    return {
+        "metric": f"full-package lint ({n_files} files, {len(all_rules())} rules)",
+        "lint_wall_s": round(lint_s, 3),
+        "lint_budget_s": 5.0,
+        "within_budget": bool(lint_s < 5.0),
+        "findings": len(findings),
+        "audit_accuracy_wall_s": round(audit_s, 3),
+        "audit_ok": bool(report.ok),
+        "audit_sync_collectives_traced_vs_planned": [
+            report.traced_sync_collectives,
+            report.planned_sync_collectives,
+        ],
+        "note": "the lint gate runs in tier-1 CI (exit code 1 on any finding); "
+        "the audit closes the loop between the coalescing planner's cost model "
+        "and the collectives XLA actually lowers",
+    }
+
+
 def main():
     params = init_params(jax.random.PRNGKey(0))
     n_params = sum(int(p.size) for p in jax.tree.leaves(params))
@@ -1089,6 +1130,10 @@ def main():
         observability = observability_leg()
     except Exception as err:  # noqa: BLE001
         observability = {"error": f"observability leg failed: {err}"}
+    try:
+        analysis = analysis_leg()
+    except Exception as err:  # noqa: BLE001
+        analysis = {"error": f"analysis leg failed: {err}"}
 
     print(json.dumps({
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
@@ -1117,6 +1162,7 @@ def main():
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
             "observability": observability,
+            "analysis": analysis,
             "state_reduce_bytes_1_to_64_chips": state_reduce_bytes_table(),
             "model": f"ResNet-50 ({n_params / 1e6:.1f}M params, bf16)",
             "batch": BATCH, "image": IMG, "num_classes": NUM_CLASSES,

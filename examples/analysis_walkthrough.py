@@ -1,0 +1,157 @@
+"""Tour of the trace-safety analysis subsystem, both tiers:
+
+1. the AST linter — run the registry over a deliberately broken snippet,
+   then show a justified suppression silencing a genuine host boundary
+   (and TMT009 catching a stale one);
+2. the jaxpr contract auditor — ``audit_metric`` on a clean metric (the
+   planner's collective count matches the lowered sync graph), then on a
+   metric that smuggles a host callback into ``update``;
+3. the Accuracy+F1+AUROC collection: 12+ per-leaf collectives fuse to 2
+   buckets, and the audit proves the traced graph agrees.
+
+Run with:  python examples/analysis_walkthrough.py
+"""
+
+import os
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+def tier1_linter() -> None:
+    from torchmetrics_tpu.analysis import all_rules, lint_file
+
+    banner("Tier 1: AST linter — the rule registry")
+    for rule in all_rules():
+        print(f"  {rule.id}  {rule.name}")
+
+    snippet = textwrap.dedent(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        def _update(self, state, x):
+            print("debugging!")                    # TMT001
+            n = float(x.sum())                     # TMT003: host sync in trace
+            if x > 0:                              # TMT004: traced branch
+                n += 1
+            ones = jnp.array([1.0])                # TMT005: materialize in update
+            return {"total": state["total"] + jax.lax.psum(n * ones, "data")}  # TMT002
+
+        def helper(self):
+            count = int(self._state["_n"])  # tmt: ignore[TMT003] -- eager host readback for the user
+            stale = 1  # tmt: ignore[TMT005] -- nothing here triggers TMT005 (goes stale)
+            return count + stale
+        """
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "broken.py"
+        path.write_text(snippet)
+        findings = lint_file(path, root=Path(tmp))
+
+    banner("Findings on a deliberately broken snippet")
+    for f in sorted(findings, key=lambda f: f.line):
+        print(f"  {f.location()}: {f.rule} {f.message.split(chr(10))[0][:70]}")
+    print(
+        "\n  note: the justified TMT003 suppression silenced its line;"
+        "\n        the stale TMT005 suppression was itself reported (TMT009)."
+    )
+
+
+def tier2_auditor() -> None:
+    from torchmetrics_tpu.analysis import TraceContractError, audit_metric
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.core.metric import Metric
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.standard_normal((32, 5)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 5, 32))
+
+    banner("Tier 2: jaxpr audit — clean metric")
+    report = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), preds, target)
+    print(f"  subject: {report.subject}   ok: {report.ok}")
+    print(f"  checks run: {', '.join(report.checks)}")
+    print(
+        f"  sync collectives — lowered: {report.traced_sync_collectives}, "
+        f"planned by coalesce: {report.planned_sync_collectives}"
+    )
+
+    class CallbackInUpdate(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, x):
+            peek = jax.pure_callback(
+                lambda v: np.asarray(v), jax.ShapeDtypeStruct((), jnp.float32), x.sum()
+            )
+            return {"total": state["total"] + peek}
+
+        def _compute(self, state):
+            return state["total"]
+
+    banner("Tier 2: jaxpr audit — host callback smuggled into update")
+    try:
+        audit_metric(CallbackInUpdate(), jnp.ones(4, jnp.float32), strict=True)
+    except TraceContractError as err:
+        print("  rejected, as it must be:")
+        for line in str(err).splitlines():
+            print(f"    {line}")
+
+
+def collection_case() -> None:
+    from torchmetrics_tpu.analysis import audit_collection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassF1Score,
+    )
+    from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.parallel.coalesce import per_leaf_collective_count
+
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.standard_normal((64, 5)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 5, 64))
+
+    col = MetricCollection(
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        MulticlassF1Score(num_classes=5, average="macro"),
+        MulticlassAUROC(num_classes=5, thresholds=16),
+        compute_groups=True,
+    )
+    report = audit_collection(col, preds, target)
+
+    leaders = [col[m[0]] for m in col._functional_groups().values()]
+    states = [m.update_state(m.init_state(), preds, target) for m in leaders]
+    per_leaf = sum(per_leaf_collective_count(m._reductions, s) for m, s in zip(leaders, states))
+
+    banner("The 12 -> 2 case: Accuracy + F1 + AUROC under one bucket plan")
+    print(f"  per-leaf collectives (un-coalesced): {per_leaf}")
+    print(f"  bucketed plan:                       {report.planned_sync_collectives}")
+    print(f"  collectives in the lowered jaxpr:    {report.traced_sync_collectives}")
+    print(f"  audit ok: {report.ok}")
+
+
+def main() -> None:
+    tier1_linter()
+    tier2_auditor()
+    collection_case()
+    banner("Done")
+    print("  CI gate:  python -m torchmetrics_tpu.analysis --format json   (exit 0 = clean)")
+
+
+if __name__ == "__main__":
+    main()

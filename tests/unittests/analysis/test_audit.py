@@ -1,0 +1,141 @@
+"""Tier-2 contract auditor tests: clean metrics pass every check, the
+planner's collective count matches the lowered sync jaxpr (the Acc+F1+AUROC
+12-leaf -> 2-bucket case), and metrics that smuggle host callbacks or
+unregistered state leaves into the trace are rejected.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
+from torchmetrics_tpu.analysis import TraceContractError, audit_collection, audit_metric
+from torchmetrics_tpu.analysis.audit import COLLECTIVE_PRIMITIVES, count_primitives, iter_eqns
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.parallel.coalesce import per_leaf_collective_count, plan_for_metrics
+
+
+@pytest.fixture
+def clf_batch():
+    rng = np.random.default_rng(7)
+    preds = jnp.asarray(rng.standard_normal((32, 5)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 5, 32))
+    return preds, target
+
+
+# ------------------------------------------------------------- clean metrics
+def test_accuracy_passes_all_checks(clf_batch):
+    rep = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), *clf_batch)
+    assert rep.ok, rep.violations
+    assert set(rep.checks) == {"state-registration", "update", "compute", "sync-collective-count"}
+    assert rep.skipped == ()
+    assert rep.traced_sync_collectives == rep.planned_sync_collectives
+
+
+def test_mean_metric_passes(clf_batch):
+    rep = audit_metric(MeanMetric(), jnp.abs(clf_batch[0][:, 0]))
+    assert rep.ok, rep.violations
+    assert rep.traced_sync_collectives == rep.planned_sync_collectives
+
+
+def test_cat_state_metric_passes():
+    rep = audit_metric(CatMetric(), jnp.arange(8, dtype=jnp.float32))
+    assert rep.ok, rep.violations
+    # cat leaves pass through the plan as individual all_gathers; the traced
+    # graph must still match the planner's model exactly
+    assert rep.traced_sync_collectives == rep.planned_sync_collectives
+
+
+def test_string_input_text_metric_skips_update_trace():
+    from torchmetrics_tpu.text.asr import WordErrorRate
+
+    rep = audit_metric(WordErrorRate(), ["hello world"], ["hello there world"])
+    assert rep.ok, rep.violations
+    assert "state-registration" in rep.checks  # eager update still audited
+    assert any(check == "update" for check, _ in rep.skipped)
+
+
+# ------------------------------------------------- planner vs lowered graph
+def test_collection_sync_matches_plan_12_to_2(clf_batch):
+    col = MetricCollection(
+        MulticlassAccuracy(num_classes=5, average="micro"),
+        MulticlassF1Score(num_classes=5, average="macro"),
+        MulticlassAUROC(num_classes=5, thresholds=16),
+        compute_groups=True,
+    )
+    rep = audit_collection(col, *clf_batch)
+    assert rep.ok, rep.violations
+    assert rep.traced_sync_collectives == rep.planned_sync_collectives
+    assert rep.traced_sync_collectives <= 2
+
+    # and the fusion is real: per-leaf the same leaders would need >= 12
+    leaders = [col[m[0]] for m in col._functional_groups().values()]
+    states = [m.update_state(m.init_state(), *clf_batch) for m in leaders]
+    per_leaf = sum(per_leaf_collective_count(m._reductions, s) for m, s in zip(leaders, states))
+    assert per_leaf >= 12
+    plan, _ = plan_for_metrics(leaders, states)
+    assert plan.n_collectives == rep.planned_sync_collectives
+
+
+def test_jaxpr_walker_counts_nested_collectives():
+    jx = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones(3))
+    assert count_primitives(jx, COLLECTIVE_PRIMITIVES) == 0
+    assert any(e.primitive.name == "mul" for e in iter_eqns(jx))
+
+
+# ------------------------------------------------------------ broken metrics
+class _CallbackInUpdate(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        peek = jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct((), jnp.float32), x.sum()
+        )
+        return {"total": state["total"] + peek}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+class _UnregisteredLeaf(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"total": state["total"] + x.sum(), "rogue": x.mean()}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+def test_host_callback_in_update_is_rejected():
+    rep = audit_metric(_CallbackInUpdate(), jnp.ones(4, jnp.float32))
+    assert not rep.ok
+    assert any(v.check == "update" and "pure_callback" in v.message for v in rep.violations)
+
+
+def test_strict_mode_raises_with_report_attached():
+    with pytest.raises(TraceContractError) as err:
+        audit_metric(_CallbackInUpdate(), jnp.ones(4, jnp.float32), strict=True)
+    assert not err.value.report.ok
+    assert "pure_callback" in str(err.value)
+
+
+def test_unregistered_state_leaf_is_rejected():
+    rep = audit_metric(_UnregisteredLeaf(), jnp.ones(4, jnp.float32))
+    assert not rep.ok
+    assert any(v.check == "state-registration" and "rogue" in v.message for v in rep.violations)
+
+
+def test_report_round_trips_to_dict(clf_batch):
+    rep = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), *clf_batch)
+    d = rep.as_dict()
+    assert d["ok"] is True
+    assert d["traced_sync_collectives"] == d["planned_sync_collectives"]
+    assert "sync-collective-count" in d["checks"]

@@ -1,0 +1,57 @@
+"""CLI contract: ``python -m torchmetrics_tpu.analysis`` is the CI gate.
+Exit 0 + parseable JSON over the installed package is a tier-1 invariant —
+a regression here is a lint failure in disguise.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "torchmetrics_tpu.analysis", *args],
+        capture_output=True,
+        text=True,
+        env=_ENV,
+        timeout=120,
+    )
+
+
+def test_package_is_clean_json():
+    proc = _run("--format", "json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["n_findings"] == 0
+    assert report["findings"] == []
+    assert len(report["rules"]) >= 8
+
+
+def test_findings_exit_code_is_one(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text('print("hi")\n')
+    proc = _run(str(bad), "--format", "json")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["n_findings"] == 1
+    assert report["findings"][0]["rule"] == "TMT001"
+
+
+def test_unknown_select_is_usage_error():
+    proc = _run("--select", "TMT999")
+    assert proc.returncode == 2
+    assert "unknown rule id" in proc.stderr
+
+
+def test_list_rules_prints_registry():
+    proc = _run("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("TMT001", "TMT002", "TMT003", "TMT009"):
+        assert rid in proc.stdout

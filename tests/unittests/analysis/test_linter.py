@@ -1,0 +1,254 @@
+"""Framework tests for the tier-1 AST linter: every registered rule fires on a
+minimal synthetic offender, suppressions with justifications silence exactly
+their rule, and the TMT009 hygiene rule polices the suppressions themselves.
+"""
+
+import textwrap
+
+import pytest
+
+from torchmetrics_tpu.analysis import all_rules, get_rule, lint_file, lint_paths
+from torchmetrics_tpu.analysis.linter import Rule, parse_suppressions, register
+
+pytestmark = pytest.mark.lint
+
+
+def _lint(tmp_path, source, name="mod.py", select=None):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_file(path, root=tmp_path, select=select)
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------ registry
+def test_registry_is_complete_and_ordered():
+    ids = [r.id for r in all_rules()]
+    assert ids == sorted(ids)
+    assert len(ids) >= 8
+    assert get_rule("TMT001").name == "bare-print"
+
+
+def test_register_rejects_bad_and_duplicate_ids():
+    with pytest.raises(ValueError):
+
+        @register
+        class BadId(Rule):
+            id = "TMT01X"
+            name = "bad"
+            description = "bad id format"
+
+    with pytest.raises(ValueError):
+
+        @register
+        class Duplicate(Rule):
+            id = "TMT001"
+            name = "dupe"
+            description = "already taken"
+
+
+# ------------------------------------------------------------- rule triggers
+def test_tmt001_bare_print(tmp_path):
+    assert _ids(_lint(tmp_path, 'print("hi")\n')) == ["TMT001"]
+
+
+def test_tmt002_direct_collective(tmp_path):
+    src = """
+    import jax
+
+    def helper(x):
+        return jax.lax.psum(x, "data")
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT002"]
+
+
+def test_tmt002_allow_paths(tmp_path):
+    src = 'import jax\n\ndef helper(x):\n    return jax.lax.psum(x, "data")\n'
+    assert _lint(tmp_path, src, name="core/reductions.py") == []
+
+
+def test_tmt003_host_sync_in_traced_fn(tmp_path):
+    src = """
+    def _update(self, state, x):
+        bad = float(x)
+        also_bad = x.item()
+        fine = float(x.shape[0])
+        return {"total": state["total"] + bad + also_bad + fine}
+    """
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT003", "TMT003"]
+    assert {f.line for f in findings} == {3, 4}
+
+
+def test_tmt003_jit_decorated_function(tmp_path):
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return int(x)
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT003"]
+
+
+def test_tmt004_traced_branch(tmp_path):
+    src = """
+    def _compute(self, state):
+        if state["total"] > 0:
+            return state["total"]
+        return 0
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT004"]
+
+
+def test_tmt004_structural_checks_allowed(tmp_path):
+    src = """
+    def _compute(self, state):
+        if not state["preds"]:          # cat-state emptiness: tuple truthiness
+            return 0
+        if "extra" in state:            # dict membership
+            return 1
+        if state.get("x") is None:      # identity
+            return 2
+        return 3
+
+    def _helper(iou, aggregate: bool = True):
+        if not aggregate:               # constant-default config flag
+            return iou
+        return iou
+    """
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt005_materialize_in_update(tmp_path):
+    src = """
+    import jax.numpy as jnp
+
+    def _update(self, state, x):
+        ones = jnp.array([1.0, 2.0])
+        return {"total": state["total"] + x * ones}
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT005"]
+
+
+def test_tmt006_wallclock_and_seedless_rng(tmp_path):
+    src = """
+    import time
+    import numpy as np
+
+    def helper():
+        t0 = time.perf_counter()
+        rng = np.random.default_rng()
+        return t0, rng
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT006", "TMT006"]
+
+
+def test_tmt006_seeded_rng_allowed(tmp_path):
+    src = """
+    import numpy as np
+
+    def helper(seed):
+        return np.random.default_rng(seed)
+    """
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt007_state_mutation_outside_lifecycle(tmp_path):
+    src = """
+    class M:
+        def reset(self):
+            self._state = {}       # sanctioned
+
+        def sneaky(self):
+            self._state = {"x": 1}
+            self._state["y"] = 2
+    """
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT007", "TMT007"]
+    assert {f.line for f in findings} == {7, 8}
+
+
+def test_tmt008_float64_literal(tmp_path):
+    src = """
+    import jax.numpy as jnp
+
+    def helper(x):
+        return x.astype(jnp.float64)
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT008"]
+
+
+# ------------------------------------------------------------- suppressions
+def test_suppression_with_justification_silences_rule(tmp_path):
+    src = 'print("hi")  # tmt: ignore[TMT001] -- CLI banner at the host boundary\n'
+    assert _lint(tmp_path, src) == []
+
+
+def test_suppression_only_covers_named_rule(tmp_path):
+    src = """
+    def _update(self, state, x):
+        return float(x)  # tmt: ignore[TMT005] -- wrong rule named on purpose
+    """
+    ids = _ids(_lint(tmp_path, src))
+    assert "TMT003" in ids  # finding survives
+    assert "TMT009" in ids  # and the suppression is reported stale
+
+
+def test_suppression_without_justification_is_tmt009(tmp_path):
+    src = 'print("hi")  # tmt: ignore[TMT001]\n'
+    ids = _ids(_lint(tmp_path, src))
+    assert ids == ["TMT009"]  # print suppressed, but hygiene flags the bare marker
+
+
+def test_unknown_rule_id_is_tmt009(tmp_path):
+    src = "x = 1  # tmt: ignore[TMT999] -- no such rule\n"
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT009"]
+    assert "unknown" in findings[0].message
+
+
+def test_stale_suppression_is_tmt009(tmp_path):
+    src = "x = 1  # tmt: ignore[TMT001] -- nothing to suppress here\n"
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT009"]
+    assert "stale" in findings[0].message
+
+
+def test_marker_in_docstring_or_string_is_not_a_suppression():
+    lines = [
+        '"""Example: # tmt: ignore[TMT001] -- doc text."""',
+        "MSG = 'write # tmt: ignore[TMT003] -- why'",
+        "x = 1  # tmt: ignore[TMT001] -- a real comment",
+    ]
+    sups = parse_suppressions(lines)
+    assert [s.line for s in sups] == [3]
+
+
+# ------------------------------------------------------- select / multi-file
+def test_select_runs_only_named_rules(tmp_path):
+    src = """
+    import jax.numpy as jnp
+
+    def _update(self, state, x):
+        y = jnp.array([1.0])
+        return {"t": state["t"] + float(x) + y}
+    """
+    assert _ids(_lint(tmp_path, src, select=["TMT005"])) == ["TMT005"]
+
+
+def test_select_disables_stale_detection(tmp_path):
+    # under --select a suppression for a deselected rule must not look stale
+    src = 'print("hi")  # tmt: ignore[TMT001] -- justified elsewhere\n'
+    assert _lint(tmp_path, src, select=["TMT003"]) == []
+
+
+def test_lint_paths_sorted_and_recursive(tmp_path):
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "pkg" / "b.py").write_text('print("b")\n')
+    (tmp_path / "pkg" / "a.py").write_text('print("a")\n')
+    findings = lint_paths([tmp_path / "pkg"], root=tmp_path)
+    assert [f.path for f in findings] == ["pkg/a.py", "pkg/b.py"]

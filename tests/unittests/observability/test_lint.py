@@ -1,82 +1,28 @@
-"""Library hygiene lints over the ``torchmetrics_tpu/`` AST.
+"""Package-wide lint gate — thin shim over ``torchmetrics_tpu.analysis``.
 
-* No bare ``print(``: user-facing output must go through the
-  ``torchmetrics_tpu`` logger (which carries a ``NullHandler`` — see
-  ``utilities/prints.py``) or the rank-zero helpers, never stdout.  Allowed
-  exceptions: ``utilities/prints.py`` itself and ``utilities/plot.py``
-  (interactive plotting helper).
-* No direct ``jax.lax.psum``/``all_gather`` outside ``core/reductions.py``
-  and ``parallel/coalesce.py``: every cross-device collective must go
-  through ``sync_leaf`` or the coalescing planner so it is bucketed,
-  telemetry-counted, and covered by the byte-cost model.  A stray direct
-  collective silently escapes all three.
+The ad-hoc AST walks that used to live here (bare ``print``, direct
+``jax.lax`` collectives) are now registered rules TMT001/TMT002 of the
+analysis framework, alongside the trace-safety rules TMT003+.  This file
+just asserts the package is clean under the full registry — the CLI
+(``python -m torchmetrics_tpu.analysis``) is exercised separately in
+``tests/unittests/analysis/test_cli.py``.
 """
 
-import ast
-from pathlib import Path
+import pytest
 
-PACKAGE = Path(__file__).resolve().parents[3] / "torchmetrics_tpu"
-ALLOWED = {"utilities/prints.py", "utilities/plot.py", "plot.py"}
+from torchmetrics_tpu.analysis import all_rules, lint_package
 
-#: attribute names whose direct call is a collective launch
-BANNED_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather"}
-#: the only modules allowed to lower collectives themselves
-COLLECTIVE_ALLOWED = {"core/reductions.py", "parallel/coalesce.py"}
+pytestmark = pytest.mark.lint
 
 
-def _bare_prints(path: Path):
-    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            yield node.lineno
+def test_rule_registry_has_full_surface():
+    ids = [r.id for r in all_rules()]
+    assert len(ids) >= 8, f"expected >=8 registered rules, got {ids}"
+    # the two legacy checks must have survived the migration
+    assert "TMT001" in ids  # bare print
+    assert "TMT002" in ids  # direct collectives outside reductions/coalesce
 
 
-def test_package_importable_from_expected_location():
-    assert PACKAGE.is_dir(), f"package not found at {PACKAGE}"
-    assert (PACKAGE / "__init__.py").is_file()
-
-
-def test_no_bare_print_in_library():
-    offenders = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        if rel in ALLOWED:
-            continue
-        offenders.extend(f"{rel}:{lineno}" for lineno in _bare_prints(path))
-    assert not offenders, (
-        "bare print() calls found (route output through the torchmetrics_tpu "
-        f"logger or utilities.prints helpers instead): {offenders}"
-    )
-
-
-def _direct_collectives(path: Path):
-    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        func = node.func
-        # jax.lax.psum(...) style            from jax.lax import psum; psum(...)
-        name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", None)
-        if name in BANNED_COLLECTIVES:
-            yield node.lineno, name
-
-
-def test_no_direct_collectives_outside_reduction_layer():
-    """Every cross-device collective must lower through core/reductions.py's
-    ``sync_leaf`` or the parallel/coalesce.py planner — anywhere else it
-    escapes bucketing, the telemetry ``collectives`` counter, and the
-    sync-byte cost model."""
-    offenders = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        rel = path.relative_to(PACKAGE).as_posix()
-        if rel in COLLECTIVE_ALLOWED:
-            continue
-        offenders.extend(f"{rel}:{lineno} ({name})" for lineno, name in _direct_collectives(path))
-    assert not offenders, (
-        "direct collective calls found outside core/reductions.py and "
-        f"parallel/coalesce.py (use sync_leaf or the coalescing planner): {offenders}"
-    )
+def test_package_lints_clean():
+    findings = lint_package()
+    assert findings == [], "\n".join(f.location() for f in findings)

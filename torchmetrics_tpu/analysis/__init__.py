@@ -1,0 +1,56 @@
+"""Trace-safety analysis: AST lint framework + jaxpr contract auditor.
+
+Two tiers guard the trace contract the library's performance depends on:
+
+* **Tier 1 — static lint** (:mod:`analysis.linter` + :mod:`analysis.rules`):
+  registered rules with stable IDs (``TMT001``…) over the package AST —
+  host-sync hazards, stray collectives, traced branching, wall-clock/RNG in
+  traced code, state-mutation discipline.  ``python -m
+  torchmetrics_tpu.analysis`` is the CI entry point; ``# tmt:
+  ignore[TMTxxx] -- why`` suppresses one line with a required justification.
+* **Tier 2 — jaxpr audit** (:mod:`analysis.audit`): :func:`audit_metric` /
+  :func:`audit_collection` abstract-trace a metric's ``update``/``compute``/
+  ``sync`` and verify what XLA will actually lower — no host callbacks, every
+  state leaf registered for reduction, no float64 leaks, and the number of
+  collective primitives in the sharded sync jaxpr equal to the coalescing
+  planner's bucket count.
+"""
+
+from torchmetrics_tpu.analysis.audit import (
+    AuditReport,
+    AuditViolation,
+    TraceContractError,
+    audit_collection,
+    audit_metric,
+)
+from torchmetrics_tpu.analysis.linter import (
+    Finding,
+    Rule,
+    all_rules,
+    format_json,
+    format_text,
+    get_rule,
+    lint_file,
+    lint_package,
+    lint_paths,
+    package_root,
+)
+from torchmetrics_tpu.analysis import rules  # noqa: F401  (registers TMT001...)
+
+__all__ = [
+    "AuditReport",
+    "AuditViolation",
+    "Finding",
+    "Rule",
+    "TraceContractError",
+    "all_rules",
+    "audit_collection",
+    "audit_metric",
+    "format_json",
+    "format_text",
+    "get_rule",
+    "lint_file",
+    "lint_package",
+    "lint_paths",
+    "package_root",
+]

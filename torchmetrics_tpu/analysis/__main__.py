@@ -1,0 +1,87 @@
+"""CLI: ``python -m torchmetrics_tpu.analysis [paths...]``.
+
+Exit codes (CI contract):
+  0  clean — no findings
+  1  findings reported
+  2  usage / internal error
+
+``--format json`` emits a machine-readable report; ``--list-rules`` prints
+the registry with IDs and descriptions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from torchmetrics_tpu.analysis.linter import (
+    all_rules,
+    format_json,
+    format_text,
+    lint_paths,
+    package_root,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.analysis",
+        description="Trace-safety lint over torchmetrics_tpu sources (rules TMT001...).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to lint (default: the installed torchmetrics_tpu package)",
+    )
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule IDs to run (default: all); e.g. --select TMT003,TMT004",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            allow = f"  [allow: {', '.join(rule.allow_paths)}]" if rule.allow_paths else ""
+            sys.stdout.write(f"{rule.id}  {rule.name}{allow}\n    {rule.description}\n")
+        return 0
+
+    select = None
+    if args.select:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        known = {r.id for r in all_rules()}
+        unknown = sorted(set(select) - known)
+        if unknown:
+            sys.stderr.write(f"unknown rule id(s): {unknown} (known: {sorted(known)})\n")
+            return 2
+
+    if args.paths:
+        paths = [Path(p) for p in args.paths]
+        missing = [p for p in paths if not p.exists()]
+        if missing:
+            sys.stderr.write(f"no such path(s): {[str(p) for p in missing]}\n")
+            return 2
+        root = paths[0] if len(paths) == 1 and paths[0].is_dir() else Path.cwd()
+    else:
+        root = package_root()
+        paths = [root]
+
+    try:
+        findings = lint_paths(paths, root=root, select=select)
+    except SyntaxError as err:
+        sys.stderr.write(f"parse error: {err}\n")
+        return 2
+
+    if args.format == "json":
+        n_files = sum(len(list(p.rglob("*.py"))) if p.is_dir() else 1 for p in paths)
+        sys.stdout.write(format_json(findings, n_files=n_files) + "\n")
+    else:
+        sys.stdout.write(format_text(findings) + "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,441 @@
+"""Jaxpr contract auditor — tier 2 of the trace-safety analysis subsystem.
+
+The linter (tier 1) reasons about *source*; this module reasons about what
+XLA will actually lower.  :func:`audit_metric` abstract-traces a metric's
+``update``/``compute``/``sync`` legs via ``jax.make_jaxpr`` — through the
+same frozen-clone step bodies the compile cache builds
+(``core.compile.audit_step_fn``) — and verifies four contracts:
+
+1. **No host callbacks.**  ``pure_callback`` / ``io_callback`` /
+   ``debug_callback`` primitives in an update/compute/sync jaxpr mean a
+   host round-trip inside the fused step — the exact stall the whole
+   design exists to avoid.
+2. **Every state leaf is registered.**  A leaf produced by ``update_state``
+   that is absent from the reduction table would silently never sync or
+   merge; the audit cross-checks output keys against ``_reductions`` plus
+   the reserved counters.
+3. **No float64 leaks.**  Any ``float64``/``complex128`` aval anywhere in a
+   traced graph doubles collective bytes and flips the graph under
+   ``jax_enable_x64`` — flagged wherever it appears.
+4. **Planner model == lowered graph.**  The number of collective primitives
+   in the sharded sync jaxpr must equal ``n_collectives`` of the plan from
+   ``parallel.coalesce.plan_for_metric`` / ``plan_for_metrics`` — closing
+   the loop between the coalescing planner's cost model (which telemetry
+   and the byte model trust) and what XLA actually lowers.  Updates must
+   contain *zero* collectives: one there would escape the planner entirely.
+
+``audit_collection`` runs the same contract over a ``MetricCollection``'s
+compute-group leaders with the shared cross-metric bucket plan (the
+Acc+F1+AUROC 12→2 case).  Checks that cannot run (string-input text
+metrics, host-side computes, overridden ``sync_states``) are recorded as
+*skipped with a reason*, never silently dropped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "AuditReport",
+    "AuditViolation",
+    "CALLBACK_PRIMITIVES",
+    "COLLECTIVE_PRIMITIVES",
+    "TraceContractError",
+    "audit_collection",
+    "audit_metric",
+    "count_primitives",
+    "iter_eqns",
+]
+
+#: primitives that round-trip through the host mid-graph
+CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "outside_call", "host_callback_call"}
+)
+#: primitives that launch a cross-device collective
+COLLECTIVE_PRIMITIVES = frozenset(
+    {
+        "psum",
+        "pmax",
+        "pmin",
+        "pmean",
+        "all_gather",
+        "all_to_all",
+        "psum_scatter",
+        "reduce_scatter",
+        "ppermute",
+        "pgather",
+    }
+)
+#: avals that must never appear in a lowered metric graph
+_BANNED_DTYPES = frozenset({"float64", "complex128"})
+
+_RESERVED_LEAVES = ("_n", "_nonfinite")
+
+
+class TraceContractError(RuntimeError):
+    """A metric violates the trace contract; carries the full report."""
+
+    def __init__(self, report: "AuditReport") -> None:
+        lines = [f"{report.subject}: {len(report.violations)} trace-contract violation(s)"]
+        lines += [f"  [{v.check}] {v.message}" for v in report.violations]
+        super().__init__("\n".join(lines))
+        self.report = report
+
+
+@dataclass(frozen=True)
+class AuditViolation:
+    check: str
+    message: str
+
+
+@dataclass
+class AuditReport:
+    """Outcome of one :func:`audit_metric` / :func:`audit_collection` run."""
+
+    subject: str
+    violations: Tuple[AuditViolation, ...] = ()
+    #: checks that ran to completion
+    checks: Tuple[str, ...] = ()
+    #: (check, reason) pairs for checks that could not run on this metric
+    skipped: Tuple[Tuple[str, str], ...] = ()
+    #: collective primitives found in the traced sharded-sync jaxpr
+    traced_sync_collectives: Optional[int] = None
+    #: ``n_collectives`` of the coalescing planner's bucket plan
+    planned_sync_collectives: Optional[int] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def raise_if_violations(self) -> "AuditReport":
+        if self.violations:
+            raise TraceContractError(self)
+        return self
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "subject": self.subject,
+            "ok": self.ok,
+            "violations": [{"check": v.check, "message": v.message} for v in self.violations],
+            "checks": list(self.checks),
+            "skipped": [list(s) for s in self.skipped],
+            "traced_sync_collectives": self.traced_sync_collectives,
+            "planned_sync_collectives": self.planned_sync_collectives,
+        }
+
+
+# ------------------------------------------------------------- jaxpr walking
+def _sub_jaxprs(val: Any) -> Iterator[Any]:
+    from jax.core import ClosedJaxpr, Jaxpr
+
+    if isinstance(val, ClosedJaxpr):
+        yield val.jaxpr
+    elif isinstance(val, Jaxpr):
+        yield val
+    elif isinstance(val, (tuple, list)):
+        for v in val:
+            yield from _sub_jaxprs(v)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Every eqn of ``jaxpr`` including nested call/scan/shard_map bodies."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)  # accept ClosedJaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                yield from iter_eqns(sub)
+
+
+def count_primitives(jaxpr: Any, names: frozenset) -> int:
+    return sum(1 for eqn in iter_eqns(jaxpr) if eqn.primitive.name in names)
+
+
+def _banned_dtypes(jaxpr: Any) -> List[str]:
+    """``prim:dtype`` descriptions for every banned-dtype aval in the graph."""
+    out: List[str] = []
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for var in list(jaxpr.invars) + list(jaxpr.constvars) + list(jaxpr.outvars):
+        dt = getattr(getattr(var, "aval", None), "dtype", None)
+        if dt is not None and str(dt) in _BANNED_DTYPES:
+            out.append(f"jaxpr boundary: {dt}")
+    for eqn in iter_eqns(jaxpr):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            dt = getattr(getattr(var, "aval", None), "dtype", None)
+            if dt is not None and str(dt) in _BANNED_DTYPES:
+                out.append(f"{eqn.primitive.name}: {dt}")
+    return out
+
+
+# ------------------------------------------------------------ shared helpers
+def _callback_names(jaxpr: Any) -> List[str]:
+    return sorted({e.primitive.name for e in iter_eqns(jaxpr) if e.primitive.name in CALLBACK_PRIMITIVES})
+
+
+def _graph_violations(check: str, jaxpr: Any, *, allow_collectives: bool) -> List[AuditViolation]:
+    out: List[AuditViolation] = []
+    callbacks = _callback_names(jaxpr)
+    if callbacks:
+        out.append(
+            AuditViolation(
+                check,
+                f"host callback primitive(s) {callbacks} in the {check} jaxpr — a host "
+                "round-trip inside the fused step (pure_callback/io_callback/debug.print "
+                "must stay outside compiled metric code)",
+            )
+        )
+    if not allow_collectives:
+        n = count_primitives(jaxpr, COLLECTIVE_PRIMITIVES)
+        if n:
+            out.append(
+                AuditViolation(
+                    check,
+                    f"{n} collective primitive(s) in the {check} jaxpr — collectives belong "
+                    "to the sync path (sync_states / the coalescing planner), where they are "
+                    "bucketed and telemetry-counted",
+                )
+            )
+    f64 = _banned_dtypes(jaxpr)
+    if f64:
+        out.append(
+            AuditViolation(
+                "float64-leak",
+                f"64-bit aval(s) in the {check} jaxpr: {sorted(set(f64))[:4]} — doubles "
+                "collective bytes and flips the graph under jax_enable_x64",
+            )
+        )
+    return out
+
+
+def _stack_state(state: Any, n_dev: int) -> Any:
+    # works on any state pytree (one metric's dict or a tuple of dicts)
+    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n_dev, *x.shape)), state)
+
+
+def _default_mesh(mesh: Optional[Any], axis_name: str) -> Any:
+    if mesh is not None:
+        return mesh
+    from torchmetrics_tpu.parallel.sync import metric_mesh
+
+    return metric_mesh(axis_name=axis_name)
+
+
+def _trace_sync(sync_fn: Any, state: Mapping[str, Any], mesh: Any, axis_name: str) -> Any:
+    """make_jaxpr of one sharded sync over a stacked (leading device axis)
+    copy of ``state`` — the same shape the cadence/sharded entry points use."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu.core.compile import shard_map
+
+    n_dev = int(mesh.devices.size)
+
+    def run(stacked):
+        local = jax.tree.map(lambda x: x[0], stacked)
+        return sync_fn(local)
+
+    wrapped = shard_map(run, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
+    return jax.make_jaxpr(wrapped)(_stack_state(state, n_dev))
+
+
+# -------------------------------------------------------------------- audits
+def audit_metric(
+    metric: Any,
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: Optional[str] = None,
+    strict: bool = False,
+) -> AuditReport:
+    """Audit one metric's trace contract against example ``inputs``.
+
+    ``inputs`` are one representative ``update`` batch.  ``strict=True``
+    raises :class:`TraceContractError` on any violation; otherwise inspect
+    the returned :class:`AuditReport`.
+    """
+    from torchmetrics_tpu.core.compile import audit_step_fn, is_jit_compatible
+    from torchmetrics_tpu.core.metric import Metric
+    from torchmetrics_tpu.parallel.coalesce import plan_for_metric
+
+    subject = type(metric).__name__
+    axis = axis_name or getattr(metric, "axis_name", "data")
+    violations: List[AuditViolation] = []
+    checks: List[str] = []
+    skipped: List[Tuple[str, str]] = []
+
+    # -- state registration: run one eager update (works for any input kind)
+    try:
+        state = metric.update_state(metric.init_state(), *inputs)
+    except Exception as err:
+        report = AuditReport(
+            subject,
+            violations=(
+                AuditViolation(
+                    "update",
+                    f"update_state failed on the example inputs ({type(err).__name__}: {err})",
+                ),
+            ),
+        )
+        return report.raise_if_violations() if strict else report
+    checks.append("state-registration")
+    registered = set(metric._reductions) | set(_RESERVED_LEAVES)
+    unregistered = sorted(set(state) - registered)
+    if unregistered:
+        violations.append(
+            AuditViolation(
+                "state-registration",
+                f"state leaf(s) {unregistered} produced by update_state are not in the "
+                "reduction table — they would silently never sync or merge; register them "
+                "via add_state(..., dist_reduce_fx=...)",
+            )
+        )
+
+    # -- update jaxpr: through the exact step body the compile cache builds
+    if is_jit_compatible((inputs, {})):
+        try:
+            jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
+        except Exception as err:
+            violations.append(
+                AuditViolation(
+                    "update",
+                    f"update_state is not abstractly traceable with array inputs "
+                    f"({type(err).__name__}: {err}) — it cannot fuse into a jitted step",
+                )
+            )
+        else:
+            checks.append("update")
+            violations.extend(_graph_violations("update", jx_update, allow_collectives=False))
+    else:
+        skipped.append(("update", "example inputs are not jit-compatible (non-array leaves)"))
+
+    # -- compute jaxpr: best-effort (host-side computes are legal, but audited
+    #    metrics meant for the fused path should trace cleanly)
+    try:
+        jx_compute = jax.make_jaxpr(audit_step_fn(metric, "compute"))(state)
+    except Exception as err:
+        skipped.append(("compute", f"compute_state is host-side ({type(err).__name__}: {err})"))
+    else:
+        checks.append("compute")
+        violations.extend(_graph_violations("compute", jx_compute, allow_collectives=False))
+
+    # -- sharded sync jaxpr vs the coalescing planner's model
+    traced_n: Optional[int] = None
+    planned_n: Optional[int] = None
+    if type(metric).sync_states is not Metric.sync_states:
+        skipped.append(("sync-collective-count", "metric overrides sync_states (not coalesced)"))
+    else:
+        try:
+            the_mesh = _default_mesh(mesh, axis)
+            jx_sync = _trace_sync(lambda st: metric.sync_states(st, axis), state, the_mesh, axis)
+        except Exception as err:
+            skipped.append(("sync-collective-count", f"sync not traceable ({type(err).__name__}: {err})"))
+        else:
+            checks.append("sync-collective-count")
+            traced_n = count_primitives(jx_sync, COLLECTIVE_PRIMITIVES)
+            planned_n = plan_for_metric(metric, state).n_collectives
+            if traced_n != planned_n:
+                violations.append(
+                    AuditViolation(
+                        "sync-collective-count",
+                        f"sharded sync lowers {traced_n} collective primitive(s) but the "
+                        f"coalescing planner models {planned_n} — the telemetry/byte model "
+                        "no longer describes the real graph",
+                    )
+                )
+            violations.extend(
+                v for v in _graph_violations("sync", jx_sync, allow_collectives=True)
+            )
+
+    report = AuditReport(
+        subject,
+        violations=tuple(violations),
+        checks=tuple(checks),
+        skipped=tuple(skipped),
+        traced_sync_collectives=traced_n,
+        planned_sync_collectives=planned_n,
+    )
+    return report.raise_if_violations() if strict else report
+
+
+def audit_collection(
+    collection: Any,
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    strict: bool = False,
+) -> AuditReport:
+    """Audit a ``MetricCollection``'s fused sync: the cross-metric coalesced
+    sync jaxpr for the compute-group leaders must lower exactly
+    ``plan_for_metrics(...).n_collectives`` collectives (Acc+F1+AUROC: 2).
+
+    Per-member update/compute contracts are audited individually via
+    :func:`audit_metric`; violations aggregate with member-name prefixes.
+    """
+    from torchmetrics_tpu.parallel.coalesce import coalesced_metric_sync, plan_for_metrics
+
+    leader_names = tuple(members[0] for members in collection._functional_groups().values())
+    metrics = [collection[name] for name in leader_names]
+    subject = f"MetricCollection[{', '.join(leader_names)}]"
+    violations: List[AuditViolation] = []
+    checks: List[str] = []
+    skipped: List[Tuple[str, str]] = []
+
+    states = []
+    for name, m in zip(leader_names, metrics):
+        member_report = audit_metric(m, *inputs, mesh=mesh, axis_name=axis_name)
+        violations.extend(
+            AuditViolation(v.check, f"[{name}] {v.message}") for v in member_report.violations
+        )
+        skipped.extend((c, f"[{name}] {reason}") for c, reason in member_report.skipped)
+        states.append(m.update_state(m.init_state(), *inputs))
+    checks.append("members")
+
+    plan, standard = plan_for_metrics(metrics, states)
+    for i, m in enumerate(metrics):
+        if i not in standard:
+            skipped.append(
+                ("sync-collective-count", f"[{leader_names[i]}] overrides sync_states (not coalesced)")
+            )
+    std_metrics = [metrics[i] for i in standard]
+    std_states = [states[i] for i in standard]
+
+    traced_n: Optional[int] = None
+    planned_n: Optional[int] = None
+    if std_metrics:
+        the_mesh = _default_mesh(mesh, axis_name)
+
+        def sync_fn(flat_states):
+            return tuple(coalesced_metric_sync(std_metrics, list(flat_states), axis_name))
+
+        try:
+            jx_sync = _trace_sync(sync_fn, tuple(std_states), the_mesh, axis_name)
+        except Exception as err:
+            skipped.append(
+                ("sync-collective-count", f"fused sync not traceable ({type(err).__name__}: {err})")
+            )
+        else:
+            checks.append("sync-collective-count")
+            traced_n = count_primitives(jx_sync, COLLECTIVE_PRIMITIVES)
+            planned_n = plan.n_collectives
+            if traced_n != planned_n:
+                violations.append(
+                    AuditViolation(
+                        "sync-collective-count",
+                        f"fused collection sync lowers {traced_n} collective primitive(s) but "
+                        f"the cross-metric plan models {planned_n} "
+                        f"(buckets: {plan.bucket_sizes()})",
+                    )
+                )
+            violations.extend(_graph_violations("sync", jx_sync, allow_collectives=True))
+
+    report = AuditReport(
+        subject,
+        violations=tuple(violations),
+        checks=tuple(checks),
+        skipped=tuple(skipped),
+        traced_sync_collectives=traced_n,
+        planned_sync_collectives=planned_n,
+    )
+    return report.raise_if_violations() if strict else report

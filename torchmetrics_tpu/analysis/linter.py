@@ -1,0 +1,409 @@
+"""AST lint framework — tier 1 of the trace-safety analysis subsystem.
+
+The north star is metric accumulation that fuses cleanly into the XLA step
+graph.  The failure modes that break it — hidden host syncs, stray
+collectives that escape the coalescing planner, host control flow on traced
+values — are invisible at runtime until a TPU step stalls.  This framework
+turns each failure mode into a *registered rule* with a stable ID
+(``TMT001``…) so the contract is proven statically, in CI, on every change.
+
+Framework pieces (rules themselves live in :mod:`analysis.rules`):
+
+* **Rule registry** — :func:`register` binds a :class:`Rule` under its stable
+  ID; :func:`all_rules` / :func:`get_rule` enumerate it.  Every rule carries
+  a per-rule *path allowlist*: modules that implement the guarded mechanism
+  itself (e.g. ``core/reductions.py`` lowers collectives by design) are
+  exempt without per-line noise.
+* **Suppressions** — ``# tmt: ignore[TMT003] -- why this is a genuine host
+  boundary`` on the offending line.  The justification text after ``--`` is
+  REQUIRED; a bare ``# tmt: ignore[...]`` is itself a finding.  Suppressions
+  that match no finding (the code they excused was fixed or removed) are
+  reported as stale, so suppressions cannot rot.  Both hygiene checks are
+  the registered rule ``TMT009`` and can never be suppressed themselves.
+* **Traced-context detection** — shared by the trace-safety rules via
+  :class:`FileContext`: a function is *traced* when its name is one of the
+  functional-core entry points (``_update``/``_compute``/``update_state``/
+  ``compute_state``/``merge_states``/``sync_states``), when it is decorated
+  with ``jax.jit`` (directly or through ``functools.partial``), when it is
+  passed by name to ``jax.jit``/``shard_map`` in the enclosing scope (the
+  ``def step`` bodies of ``core/compile.py``), or when it is nested inside
+  any of the above.
+
+Run it as ``python -m torchmetrics_tpu.analysis`` (text or ``--format
+json``; exit code 0 clean / 1 findings / 2 usage error) or via
+:func:`lint_paths` / :func:`lint_package` from tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FileContext",
+    "Finding",
+    "Rule",
+    "Suppression",
+    "all_rules",
+    "format_json",
+    "format_text",
+    "get_rule",
+    "lint_file",
+    "lint_package",
+    "lint_paths",
+    "package_root",
+    "register",
+]
+
+#: functional-core entry points whose bodies are traced by the compile layer
+TRACED_ENTRYPOINTS = frozenset(
+    {"_update", "_compute", "update_state", "compute_state", "merge_states", "sync_states"}
+)
+#: the subset of traced contexts that is an *update hot path* (per-step cost)
+UPDATE_HOT_ENTRYPOINTS = frozenset({"_update", "update_state"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tmt:\s*ignore\[(?P<ids>[A-Za-z0-9_,\s]+)\]\s*(?:--\s*(?P<why>\S.*))?"
+)
+
+HYGIENE_RULE_ID = "TMT009"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    path: str  # package-relative posix path
+    line: int
+    message: str
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line, "message": self.message}
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# tmt: ignore[...]`` comment."""
+
+    line: int
+    ids: Tuple[str, ...]
+    justification: str
+    used: bool = False
+
+
+class Rule:
+    """One registered lint rule.
+
+    Subclasses set the class attributes and implement :meth:`check`, a
+    generator of ``(lineno, message)`` pairs over one :class:`FileContext`.
+    ``allow_paths`` names package-relative files exempt from the rule — the
+    modules that *implement* the mechanism the rule guards.
+    """
+
+    id: str = ""
+    name: str = ""
+    description: str = ""
+    allow_paths: Tuple[str, ...] = ()
+
+    def check(self, ctx: "FileContext") -> Iterator[Tuple[int, str]]:
+        raise NotImplementedError
+
+    def applies_to(self, rel_path: str) -> bool:
+        return rel_path not in self.allow_paths
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(cls: type) -> type:
+    """Class decorator: instantiate and bind a :class:`Rule` under its ID."""
+    rule = cls()
+    if not re.fullmatch(r"TMT\d{3}", rule.id):
+        raise ValueError(f"rule id must match TMTxxx, got {rule.id!r}")
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    _RULES[rule.id] = rule
+    return cls
+
+
+def all_rules() -> Tuple[Rule, ...]:
+    _ensure_rules_loaded()
+    return tuple(_RULES[rid] for rid in sorted(_RULES))
+
+
+def get_rule(rule_id: str) -> Rule:
+    _ensure_rules_loaded()
+    try:
+        return _RULES[rule_id]
+    except KeyError:
+        raise KeyError(f"unknown rule {rule_id!r} (known: {sorted(_RULES)})") from None
+
+
+def _ensure_rules_loaded() -> None:
+    # rules register on import; keep the framework importable standalone
+    from torchmetrics_tpu.analysis import rules  # noqa: F401
+
+
+# ------------------------------------------------------------- file context
+_JIT_NAMES = {"jit", "shard_map", "pmap"}
+
+
+def _decorator_is_jit(dec: ast.expr) -> bool:
+    """True for ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)`` and kin."""
+    if isinstance(dec, ast.Call):
+        fn = dec.func
+        # @functools.partial(jax.jit, ...) / @partial(jit, ...)
+        if (isinstance(fn, ast.Attribute) and fn.attr == "partial") or (
+            isinstance(fn, ast.Name) and fn.id == "partial"
+        ):
+            return bool(dec.args) and _decorator_is_jit(dec.args[0])
+        return _decorator_is_jit(fn)
+    if isinstance(dec, ast.Attribute):
+        return dec.attr in _JIT_NAMES
+    if isinstance(dec, ast.Name):
+        return dec.id in _JIT_NAMES
+    return False
+
+
+def _call_is_jit_entry(node: ast.Call) -> bool:
+    """True for ``jax.jit(f, ...)`` / ``shard_map(f, ...)`` call sites."""
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+    return name in _JIT_NAMES
+
+
+class FileContext:
+    """One parsed source file plus the traced-context analysis rules share."""
+
+    def __init__(self, path: Path, rel_path: str) -> None:
+        self.path = path
+        self.rel_path = rel_path
+        self.source = path.read_text(encoding="utf-8")
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.lines = self.source.splitlines()
+        self._traced: Optional[List[ast.AST]] = None
+        self._update_hot: Optional[List[ast.AST]] = None
+
+    # -------------------------------------------------- traced-context model
+    def _analyze(self) -> None:
+        traced: List[ast.AST] = []
+        update_hot: List[ast.AST] = []
+
+        def visit(node: ast.AST, in_traced: bool, in_hot: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_traced, child_hot = in_traced, in_hot
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    is_entry = child.name in TRACED_ENTRYPOINTS
+                    is_jit = any(_decorator_is_jit(d) for d in child.decorator_list)
+                    jit_passed = child.name in _names_passed_to_jit(node)
+                    child_traced = in_traced or is_entry or is_jit or jit_passed
+                    child_hot = in_hot or child.name in UPDATE_HOT_ENTRYPOINTS
+                    if child_traced:
+                        traced.append(child)
+                    if child_hot:
+                        update_hot.append(child)
+                elif isinstance(child, ast.ClassDef):
+                    # methods reset the traced flag: a class defined inside a
+                    # traced fn is host machinery, not traced math
+                    child_traced, child_hot = False, False
+                visit(child, child_traced, child_hot)
+
+        visit(self.tree, False, False)
+        self._traced = traced
+        self._update_hot = update_hot
+
+    def traced_functions(self) -> List[ast.AST]:
+        """FunctionDefs whose bodies run under a JAX trace (see module doc)."""
+        if self._traced is None:
+            self._analyze()
+        return list(self._traced)
+
+    def update_hot_functions(self) -> List[ast.AST]:
+        """The per-step subset: ``_update``/``update_state`` bodies."""
+        if self._update_hot is None:
+            self._analyze()
+        return list(self._update_hot)
+
+
+def _names_passed_to_jit(scope: ast.AST) -> set:
+    """Local function names passed to ``jax.jit``/``shard_map`` inside ``scope``
+    (not descending into nested function scopes)."""
+    names: set = set()
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # different scope
+        if isinstance(node, ast.Call) and _call_is_jit_entry(node):
+            if node.args and isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+        stack.extend(ast.iter_child_nodes(node))
+    return names
+
+
+# ------------------------------------------------------------- suppressions
+def parse_suppressions(lines: Sequence[str]) -> List[Suppression]:
+    # Tokenize so only real COMMENT tokens count: the marker syntax quoted in
+    # docstrings, messages, and docs must not register as live suppressions.
+    out: List[Suppression] = []
+    reader = io.StringIO("\n".join(lines) + "\n").readline
+    try:
+        tokens = list(tokenize.generate_tokens(reader))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        tokens = []
+    for tok in tokens:
+        if tok.type is not tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        ids = tuple(s.strip() for s in m.group("ids").split(",") if s.strip())
+        out.append(
+            Suppression(line=tok.start[0], ids=ids, justification=(m.group("why") or "").strip())
+        )
+    return out
+
+
+def _hygiene_findings(
+    rel_path: str, sups: Sequence[Suppression], check_stale: bool = True
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for sup in sups:
+        if not sup.justification:
+            findings.append(
+                Finding(
+                    HYGIENE_RULE_ID,
+                    rel_path,
+                    sup.line,
+                    f"suppression {list(sup.ids)} has no justification — write "
+                    "'# tmt: ignore[TMTxxx] -- <why this is a genuine host boundary>'",
+                )
+            )
+        unknown = [rid for rid in sup.ids if rid not in _RULES]
+        if unknown:
+            findings.append(
+                Finding(
+                    HYGIENE_RULE_ID,
+                    rel_path,
+                    sup.line,
+                    f"suppression names unknown rule id(s) {unknown} (known: {sorted(_RULES)})",
+                )
+            )
+        if check_stale and sup.ids and not unknown and not sup.used:
+            findings.append(
+                Finding(
+                    HYGIENE_RULE_ID,
+                    rel_path,
+                    sup.line,
+                    f"stale suppression {list(sup.ids)}: no finding on this line — the code "
+                    "it excused was fixed or moved; delete the comment",
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------ driving
+def lint_file(
+    path: Path,
+    root: Path,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint one file; returns surviving findings including hygiene findings."""
+    _ensure_rules_loaded()
+    try:
+        rel_path = path.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:  # outside root (explicit CLI path): no allowlist matches
+        rel_path = path.resolve().as_posix()
+    ctx = FileContext(path, rel_path)
+    sups = parse_suppressions(ctx.lines)
+    by_line: Dict[int, List[Suppression]] = {}
+    for sup in sups:
+        by_line.setdefault(sup.line, []).append(sup)
+
+    selected = set(select) if select is not None else None
+    findings: List[Finding] = []
+    for rule in all_rules():
+        if rule.id == HYGIENE_RULE_ID:
+            continue  # framework-driven, below
+        if selected is not None and rule.id not in selected:
+            continue
+        if not rule.applies_to(rel_path):
+            continue
+        for lineno, message in rule.check(ctx):
+            suppressed = False
+            for sup in by_line.get(lineno, ()):
+                if rule.id in sup.ids:
+                    sup.used = True
+                    suppressed = True
+            if not suppressed:
+                findings.append(Finding(rule.id, rel_path, lineno, message))
+    if selected is None or HYGIENE_RULE_ID in selected:
+        # stale detection is only sound when every rule ran: a suppression
+        # looks unused whenever its rule was deselected
+        findings.extend(_hygiene_findings(rel_path, sups, check_stale=selected is None))
+    return findings
+
+
+def package_root() -> Path:
+    """Directory of the installed ``torchmetrics_tpu`` package."""
+    import torchmetrics_tpu
+
+    return Path(torchmetrics_tpu.__file__).resolve().parent
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    root: Optional[Path] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint files/directories; findings sorted by (path, line, rule)."""
+    files: List[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        else:
+            files.append(p)
+    if root is None:
+        root = Path(paths[0]) if len(paths) == 1 and Path(paths[0]).is_dir() else Path.cwd()
+    findings: List[Finding] = []
+    for f in files:
+        findings.extend(lint_file(f, root, select=select))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_package(select: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint the installed ``torchmetrics_tpu`` package (the CI entry point)."""
+    root = package_root()
+    return lint_paths([root], root=root, select=select)
+
+
+# -------------------------------------------------------------------- output
+def format_text(findings: Sequence[Finding]) -> str:
+    if not findings:
+        return "torchmetrics_tpu.analysis: clean (0 findings)"
+    lines = [f"{f.location()}: {f.rule} {f.message}" for f in findings]
+    lines.append(f"torchmetrics_tpu.analysis: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def format_json(findings: Sequence[Finding], n_files: Optional[int] = None) -> str:
+    import json
+
+    payload = {
+        "findings": [f.as_dict() for f in findings],
+        "n_findings": len(findings),
+        "rules": {r.id: r.name for r in all_rules()},
+    }
+    if n_files is not None:
+        payload["n_files"] = n_files
+    return json.dumps(payload, indent=2, sort_keys=True)

@@ -1,0 +1,545 @@
+"""Registered trace-safety rules (TMT001…TMT009).
+
+Each rule encodes one way a metric implementation can silently break the
+trace contract this library's performance story depends on:
+
+====== ============================== =======================================
+ID     name                           guards against
+====== ============================== =======================================
+TMT001 bare-print                     stdout noise instead of the library
+                                      logger / rank-zero helpers
+TMT002 direct-collective              collectives that escape the coalescing
+                                      planner, telemetry, and the byte model
+TMT003 host-sync-in-trace             ``.item()``/``float()``-style host
+                                      readbacks stalling the device pipeline
+TMT004 traced-branch                  Python ``if``/``while`` on traced
+                                      arrays (TracerBoolConversionError on
+                                      TPU, silent retraces at best)
+TMT005 materialize-in-update          ``jnp.array``/``jax.device_put`` in
+                                      per-step hot paths (constant re-upload
+                                      per call; hosts the hot loop)
+TMT006 wallclock-rng                  ``time.time``/seedless randomness —
+                                      baked in at trace time, nondeterministic
+                                      across replicas (divergence hazard)
+TMT007 state-mutation                 mutating ``add_state`` buffers outside
+                                      the sanctioned lifecycle methods
+                                      (breaks donation + compute groups)
+TMT008 float64-literal                explicit float64 requests (x64 is off:
+                                      silent downcast locally, dtype-mismatch
+                                      retrace under ``jax_enable_x64``)
+TMT009 suppression-hygiene            suppressions without justification,
+                                      naming unknown rules, or gone stale
+====== ============================== =======================================
+
+TMT001/TMT002 are the two lints previously hard-coded in
+``tests/unittests/observability/test_lint.py``, migrated onto the registry;
+the rest are new.  TMT009 is implemented by the framework
+(:mod:`analysis.linter`) and registered here so it is listed, documented and
+counted like every other rule — it is the one rule that can never be
+suppressed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Tuple
+
+from torchmetrics_tpu.analysis.linter import FileContext, Rule, register
+
+__all__ = [
+    "BarePrintRule",
+    "DirectCollectiveRule",
+    "Float64LiteralRule",
+    "HostSyncInTraceRule",
+    "MaterializeInUpdateRule",
+    "StateMutationRule",
+    "SuppressionHygieneRule",
+    "TracedBranchRule",
+    "WallClockRngRule",
+]
+
+
+def _dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` as a string for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _walk_scope(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested function defs
+    (nested traced functions are visited as scopes of their own)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+#: attributes of a jax array that are static at trace time — converting or
+#: branching on them is host-safe
+_STATIC_ATTRS = frozenset({"shape", "ndim", "size", "itemsize", "dtype"})
+
+
+def _is_static_expr(node: ast.expr) -> bool:
+    """Conservatively true when ``node`` is a trace-time-static value, so
+    ``int(...)``/``float(...)`` over it is not a device readback."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS
+    if isinstance(node, ast.Subscript):  # x.shape[0]
+        return _is_static_expr(node.value)
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func)
+        return name in {"len", "ord", "round"} or (
+            name is not None and name.split(".")[-1] in {"prod", "bit_length"} and all(
+                _is_static_expr(a) for a in node.args
+            )
+        )
+    if isinstance(node, ast.BinOp):
+        return _is_static_expr(node.left) and _is_static_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_static_expr(node.operand)
+    return False
+
+
+# --------------------------------------------------------------------- TMT001
+@register
+class BarePrintRule(Rule):
+    id = "TMT001"
+    name = "bare-print"
+    description = (
+        "No bare print(): user-facing output must go through the torchmetrics_tpu "
+        "logger (NullHandler, utilities/prints.py) or the rank-zero helpers, never stdout."
+    )
+    allow_paths = ("utilities/prints.py", "utilities/plot.py")
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                yield node.lineno, (
+                    "bare print() — route output through the torchmetrics_tpu logger "
+                    "or utilities.prints helpers"
+                )
+
+
+# --------------------------------------------------------------------- TMT002
+@register
+class DirectCollectiveRule(Rule):
+    id = "TMT002"
+    name = "direct-collective"
+    description = (
+        "No direct jax.lax collectives outside the reduction layer: every cross-device "
+        "collective must lower through core/reductions.sync_leaf or the parallel/coalesce "
+        "planner so it is bucketed, telemetry-counted, and covered by the byte-cost model."
+    )
+    allow_paths = ("core/reductions.py", "parallel/coalesce.py")
+
+    BANNED = frozenset({"psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter", "all_to_all"})
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # jax.lax.psum(...) style           from jax.lax import psum; psum(...)
+            name = func.attr if isinstance(func, ast.Attribute) else getattr(func, "id", None)
+            if name in self.BANNED:
+                yield node.lineno, (
+                    f"direct collective {name}() — use core/reductions.sync_leaf or the "
+                    "parallel/coalesce planner (a stray collective escapes bucketing, the "
+                    "telemetry counter, and the sync-byte model)"
+                )
+
+
+# --------------------------------------------------------------------- TMT003
+@register
+class HostSyncInTraceRule(Rule):
+    id = "TMT003"
+    name = "host-sync-in-trace"
+    description = (
+        "No host readbacks in jit-reachable code: .item()/.tolist()/float()/int()/bool()/"
+        "np.asarray() on array values inside update/compute bodies force a device sync "
+        "(ConcretizationTypeError under jit; a pipeline stall at best).  Also flags "
+        "conversions of self._state leaves anywhere — reading accumulated state back to "
+        "host is a sync wherever it happens."
+    )
+
+    _ATTR_SYNCS = frozenset({"item", "tolist", "block_until_ready"})
+    _CONVERTERS = frozenset({"float", "int", "bool", "complex"})
+    _NP_SYNCS = frozenset({"np.asarray", "numpy.asarray", "np.array", "numpy.array", "jax.device_get"})
+
+    def _mentions_state(self, node: ast.expr) -> bool:
+        return any(
+            isinstance(n, ast.Attribute) and n.attr in ("_state", "metric_state")
+            for n in ast.walk(node)
+        )
+
+    def _hazards(self, scope: ast.AST, in_trace: bool) -> Iterator[Tuple[int, str]]:
+        for node in _walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in self._ATTR_SYNCS and in_trace:
+                yield node.lineno, f".{func.attr}() reads the device value back to host"
+                continue
+            dotted = _dotted(func)
+            if dotted in self._NP_SYNCS:
+                arg_ok = node.args and _is_static_expr(node.args[0])
+                if in_trace and not arg_ok:
+                    yield node.lineno, f"{dotted}() materializes a traced value on host"
+                elif not in_trace and node.args and self._mentions_state(node.args[0]):
+                    yield node.lineno, f"{dotted}() on metric state is a device sync"
+                continue
+            if isinstance(func, ast.Name) and func.id in self._CONVERTERS and node.args:
+                arg = node.args[0]
+                if _is_static_expr(arg):
+                    continue
+                if in_trace:
+                    yield node.lineno, (
+                        f"{func.id}() on an array value forces a host sync "
+                        "(ConcretizationTypeError under jit)"
+                    )
+                elif self._mentions_state(arg):
+                    yield node.lineno, (
+                        f"{func.id}() on metric state reads the accumulator back to host "
+                        "— a device sync on the jit path"
+                    )
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        traced = ctx.traced_functions()
+        traced_ids = {id(f) for f in traced}
+        for fn in traced:
+            yield from self._hazards(fn, in_trace=True)
+        # host-side scopes: only state-readback conversions are flagged
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and id(node) not in traced_ids:
+                yield from self._hazards(node, in_trace=False)
+
+
+# --------------------------------------------------------------------- TMT004
+@register
+class TracedBranchRule(Rule):
+    id = "TMT004"
+    name = "traced-branch"
+    description = (
+        "No Python if/while on traced arrays inside update/compute bodies: branching on a "
+        "tracer raises TracerBoolConversionError under jit, and on the eager path it "
+        "forces a host sync per step.  Use jnp.where / jax.lax.cond instead."
+    )
+
+    _SAFE_CALLS = frozenset({"isinstance", "callable", "hasattr", "len", "getattr"})
+
+    def _param_names(self, fn: ast.AST) -> frozenset:
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        names = [a.arg for a in pos] + [a.arg for a in args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        # A parameter with a Python-constant default (``aggregate: bool = True``)
+        # is a config flag bound at call sites with literals, not a traced value.
+        config = {a.arg for a, d in zip(pos[len(pos) - len(args.defaults) :], args.defaults)
+                  if isinstance(d, ast.Constant)}
+        config |= {a.arg for a, d in zip(args.kwonlyargs, args.kw_defaults)
+                   if isinstance(d, ast.Constant)}
+        return frozenset(n for n in names if n != "self" and n not in config)
+
+    @staticmethod
+    def _truthiness_atoms(node: ast.expr) -> Iterator[ast.expr]:
+        """Decompose ``a and not b or c`` into its truthiness atoms."""
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                yield from TracedBranchRule._truthiness_atoms(v)
+        elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            yield from TracedBranchRule._truthiness_atoms(node.operand)
+        else:
+            yield node
+
+    def _array_suspect(self, test: ast.expr, params: frozenset) -> Optional[str]:
+        """Name of a parameter used as a traced value inside ``test``, if any."""
+
+        class V(ast.NodeVisitor):
+            hit: Optional[str] = None
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if node.attr in _STATIC_ATTRS:
+                    return  # x.shape / x.ndim / x.dtype are static
+                self.generic_visit(node)
+
+            def visit_Call(self, node: ast.Call) -> None:
+                name = _dotted(node.func)
+                if name in TracedBranchRule._SAFE_CALLS:
+                    return
+                self.generic_visit(node)
+
+            def visit_Compare(self, node: ast.Compare) -> None:
+                # identity (`x is None`) and container membership (`"k" in target`)
+                # are host-side structure checks, not tracer math
+                if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+                    return
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                if node.id in params and self.hit is None:
+                    self.hit = node.id
+
+        v = V()
+        for atom in self._truthiness_atoms(test):
+            # Bare truthiness of a state leaf (``if not state["preds"]``) is the
+            # cat-state emptiness idiom: the leaf is a Python tuple, and its
+            # truthiness is container structure, not tracer math.
+            if isinstance(atom, ast.Subscript):
+                continue
+            v.visit(atom)
+        return v.hit
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for fn in ctx.traced_functions():
+            params = self._param_names(fn)
+            for node in _walk_scope(fn):
+                if isinstance(node, (ast.If, ast.While)):
+                    name = self._array_suspect(node.test, params)
+                    if name is not None:
+                        kw = "while" if isinstance(node, ast.While) else "if"
+                        yield node.lineno, (
+                            f"python `{kw}` branches on traced input {name!r} — "
+                            "TracerBoolConversionError under jit; use jnp.where or lax.cond"
+                        )
+
+
+# --------------------------------------------------------------------- TMT005
+@register
+class MaterializeInUpdateRule(Rule):
+    id = "TMT005"
+    name = "materialize-in-update"
+    description = (
+        "No jnp.array()/jax.device_put() in update hot paths (_update/update_state): "
+        "each call re-materializes a host constant into the per-step graph — a transfer "
+        "per step eagerly, a baked constant (and shape-keyed retrace risk) under jit.  "
+        "Build constants in __init__ and close over them."
+    )
+
+    _BANNED = frozenset({"jnp.array", "jax.numpy.array", "jax.device_put", "device_put"})
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for fn in ctx.update_hot_functions():
+            for node in _walk_scope(fn):
+                if isinstance(node, ast.Call):
+                    name = _dotted(node.func)
+                    if name in self._BANNED:
+                        yield node.lineno, (
+                            f"{name}() materializes a buffer inside the per-step update "
+                            "path — hoist it to __init__/add_state"
+                        )
+
+
+# --------------------------------------------------------------------- TMT006
+@register
+class WallClockRngRule(Rule):
+    id = "TMT006"
+    name = "wallclock-rng"
+    description = (
+        "No wall-clock or seedless randomness in library code: under a trace the value is "
+        "baked in at trace time (frozen forever in the compiled step), and across replicas "
+        "it diverges — the divergence detector will fire on state that was never synced.  "
+        "Thread explicit PRNG keys / timestamps in as inputs instead."
+    )
+    # host-side measurement utilities ARE the wall-clock boundary by design
+    allow_paths = ("utilities/benchmark.py", "utilities/checks.py")
+
+    _WALLCLOCK = frozenset(
+        {
+            "time.time",
+            "time.time_ns",
+            "time.perf_counter",
+            "time.perf_counter_ns",
+            "time.monotonic",
+            "time.process_time",
+            "datetime.now",
+            "datetime.utcnow",
+            "datetime.datetime.now",
+            "datetime.datetime.utcnow",
+        }
+    )
+    _SEEDLESS_RNG = frozenset(
+        {
+            "random.random",
+            "random.randint",
+            "random.randrange",
+            "random.choice",
+            "random.sample",
+            "random.shuffle",
+            "random.uniform",
+            "random.gauss",
+            "random.seed",
+        }
+        | {
+            f"{mod}.random.{fn}"
+            for mod in ("np", "numpy")
+            for fn in ("rand", "randn", "randint", "random", "choice", "permutation", "shuffle", "uniform", "normal", "seed")
+        }
+    )
+    _RNG_CTORS = frozenset({"np.random.default_rng", "numpy.random.default_rng", "np.random.RandomState", "numpy.random.RandomState"})
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            if name in self._WALLCLOCK:
+                yield node.lineno, (
+                    f"{name}() — wall-clock in library code: trace-frozen under jit and "
+                    "replica-divergent; pass timestamps in from the host boundary"
+                )
+            elif name in self._SEEDLESS_RNG:
+                yield node.lineno, (
+                    f"{name}() — global-state RNG: nondeterministic across replicas and "
+                    "trace-frozen under jit; thread an explicit seeded generator/key"
+                )
+            elif name in self._RNG_CTORS and not node.args and not node.keywords:
+                yield node.lineno, (
+                    f"{name}() without a seed — replica-divergent randomness; require or "
+                    "derive an explicit seed"
+                )
+
+
+# --------------------------------------------------------------------- TMT007
+@register
+class StateMutationRule(Rule):
+    id = "TMT007"
+    name = "state-mutation"
+    description = (
+        "add_state buffers mutate only inside the sanctioned lifecycle methods "
+        "(__init__/add_state/update/forward/reset/load_*/__setstate__/set_dtype/"
+        "to_device).  Anywhere else, rebinding or writing _state breaks the donation "
+        "contract (a donated buffer may already be dead) and compute-group aliasing."
+    )
+    # the Metric base/facade IS the sanctioned lifecycle implementation
+    allow_paths = ("core/metric.py",)
+
+    _ALLOWED_METHODS = frozenset(
+        {
+            "__init__",
+            "__setstate__",
+            "add_state",
+            "update",
+            "_update",
+            "forward",
+            "reset",
+            "load_state_dict",
+            "load_state_pytree",
+            "set_dtype",
+            "to_device",
+        }
+    )
+    _MUTATING_CALLS = frozenset({"update", "setdefault", "pop", "clear", "__setitem__"})
+
+    def _is_state_attr(self, node: ast.expr) -> bool:
+        return isinstance(node, ast.Attribute) and node.attr == "_state"
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        # walk (method, enclosing function name) pairs
+        def visit(node: ast.AST, fname: Optional[str]) -> Iterator[Tuple[int, str]]:
+            for child in ast.iter_child_nodes(node):
+                cname = fname
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    cname = child.name
+                yield from self._check_node(child, cname)
+                yield from visit(child, cname)
+
+        yield from visit(ctx.tree, None)
+
+    def _check_node(self, node: ast.AST, fname: Optional[str]) -> Iterator[Tuple[int, str]]:
+        if fname in self._ALLOWED_METHODS:
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in targets:
+                base = tgt.value if isinstance(tgt, ast.Subscript) else tgt
+                if self._is_state_attr(base):
+                    yield node.lineno, (
+                        f"assignment to {'_state[...]' if isinstance(tgt, ast.Subscript) else '_state'} "
+                        f"outside the sanctioned lifecycle methods (in {fname or '<module>'}) — "
+                        "route through update/reset/load_state_pytree"
+                    )
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in self._MUTATING_CALLS
+                and self._is_state_attr(func.value)
+            ):
+                yield node.lineno, (
+                    f"_state.{func.attr}(...) outside the sanctioned lifecycle methods "
+                    f"(in {fname or '<module>'}) — route through update/reset/load_state_pytree"
+                )
+
+
+# --------------------------------------------------------------------- TMT008
+@register
+class Float64LiteralRule(Rule):
+    id = "TMT008"
+    name = "float64-literal"
+    description = (
+        "No explicit float64 requests on the jnp namespace: x64 is disabled, so "
+        "jnp.float64/dtype='float64' silently produces float32 locally — and flips to a "
+        "different (retraced, 2x-byte) graph the moment someone enables jax_enable_x64.  "
+        "Host-side numpy float64 is fine; the auditor separately proves no f64 leaks "
+        "into jaxprs."
+    )
+
+    _BANNED_ATTRS = frozenset({"jnp.float64", "jax.numpy.float64", "jnp.complex128", "jax.numpy.complex128"})
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute):
+                name = _dotted(node)
+                if name in self._BANNED_ATTRS:
+                    yield node.lineno, (
+                        f"{name} — explicit 64-bit jnp dtype; use float32/complex64 (or gate "
+                        "on jax_enable_x64 with a justified suppression)"
+                    )
+            elif isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name is None or not (name.startswith("jnp.") or name.startswith("jax.numpy.")):
+                    continue
+                for kw in node.keywords:
+                    if (
+                        kw.arg == "dtype"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value in ("float64", "double", "complex128")
+                    ):
+                        yield node.lineno, (
+                            f"dtype={kw.value.value!r} passed to {name}() — explicit 64-bit "
+                            "request in jnp code"
+                        )
+
+
+# --------------------------------------------------------------------- TMT009
+@register
+class SuppressionHygieneRule(Rule):
+    id = "TMT009"
+    name = "suppression-hygiene"
+    description = (
+        "Every '# tmt: ignore[TMTxxx]' must carry a '-- justification', name a registered "
+        "rule, and still match a finding on its line; violations of any of the three are "
+        "findings under this ID.  Enforced by the framework; never suppressible."
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
+        # framework-driven (analysis/linter.py emits TMT009 after all rules ran,
+        # because staleness needs the full finding set); nothing to do per-rule
+        return iter(())

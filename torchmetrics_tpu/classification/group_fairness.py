@@ -80,10 +80,10 @@ class BinaryFairness(BinaryGroupStatRates):
         results: Dict[str, Array] = {}
         if self.task in ("demographic_parity", "all"):
             pos_rate = _safe_divide(state["tp"] + state["fp"], state["tp"] + state["fp"] + state["tn"] + state["fn"])
-            lo, hi = int(jnp.argmin(pos_rate)), int(jnp.argmax(pos_rate))
+            lo, hi = int(jnp.argmin(pos_rate)), int(jnp.argmax(pos_rate))  # tmt: ignore[TMT003] -- host-side compute: result keys embed argmin/argmax group ids as Python ints
             results[f"DP_{lo}_{hi}"] = _safe_divide(pos_rate[lo], pos_rate[hi])
         if self.task in ("equal_opportunity", "all"):
             tpr = _safe_divide(state["tp"], state["tp"] + state["fn"])
-            lo, hi = int(jnp.argmin(tpr)), int(jnp.argmax(tpr))
+            lo, hi = int(jnp.argmin(tpr)), int(jnp.argmax(tpr))  # tmt: ignore[TMT003] -- host-side compute: result keys embed argmin/argmax group ids as Python ints
             results[f"EO_{lo}_{hi}"] = _safe_divide(tpr[lo], tpr[hi])
         return results

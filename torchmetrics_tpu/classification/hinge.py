@@ -41,7 +41,7 @@ class BinaryHingeLoss(Metric):
         if self.ignore_index is not None:
             n_valid = jnp.sum(jnp.asarray(target).reshape(-1) != self.ignore_index)
         else:
-            n_valid = jnp.asarray(float(n))
+            n_valid = jnp.asarray(n, dtype=jnp.float32)
         loss = binary_hinge_loss(preds, target, self.squared, self.ignore_index, self.validate_args)
         return {"measures": state["measures"] + loss * n_valid, "total": state["total"] + n_valid}
 

@@ -248,7 +248,7 @@ class MetricCollection(dict):
             leader_state = new_states[members[0]]
             for name in members:
                 member = self[name]
-                member._state = leader_state
+                member._state = leader_state  # tmt: ignore[TMT007] -- fused-update install: aliasing member states to the group leader IS the lifecycle
                 member._computed = None
             self._mark_shared(members)
         return True
@@ -415,7 +415,7 @@ class MetricCollection(dict):
             leader_state = self[members[0]]._state
             for name in members[1:]:
                 member = self[name]
-                member._state = leader_state
+                member._state = leader_state  # tmt: ignore[TMT007] -- compute-group re-aliasing after load: collection state lifecycle
                 member._computed = None
             self._mark_shared(members)
 

@@ -77,6 +77,7 @@ __all__ = [
     "remove_cache_observer",
     "shard_map",
     "abstract_signature",
+    "audit_step_fn",
     "bucket_dim",
     "bucket_shape",
     "cache_capacity",
@@ -456,6 +457,51 @@ def _backend() -> str:
         return jax.default_backend()
     except Exception:  # pragma: no cover
         return "unknown"
+
+
+# ---------------------------------------------------------------- audit hook
+def audit_step_fn(metric: Any, entrypoint: str = "update") -> Callable:
+    """Un-jitted mirror of a compiled entry point's step body, for the
+    analysis auditor (``analysis/audit.py``).
+
+    Returns the same frozen-clone closure :func:`compiled_update` /
+    :func:`compiled_forward` / the compute leg would hand to ``jax.jit`` —
+    minus ``mark_trace`` (an audit trace must not perturb the cache
+    counters) and minus the jit wrapper (the auditor runs ``jax.make_jaxpr``
+    itself).  Auditing this closure therefore audits exactly the graph the
+    compile cache would build for the live metric's current config.
+    """
+    frozen = _frozen_clone(metric)
+    scope = f"tm_tpu/{type(metric).__name__}/{entrypoint}"
+    if entrypoint == "update":
+
+        def step(state, *a, **kw):
+            with jax.named_scope(scope):
+                return frozen.update_state(state, *a, **kw)
+
+    elif entrypoint == "forward":
+
+        def step(state, *a, **kw):
+            with jax.named_scope(scope):
+                if frozen.full_state_update:
+                    new = frozen.update_state(state, *a, **kw)
+                    batch = frozen.update_state(frozen.init_state(), *a, **kw)
+                else:
+                    batch = frozen.update_state(frozen.init_state(), *a, **kw)
+                    new = frozen.merge_states(state, batch)
+                return new, frozen.compute_state(batch)
+
+    elif entrypoint == "compute":
+
+        def step(state):
+            with jax.named_scope(scope):
+                return frozen.compute_state(state)
+
+    else:
+        raise ValueError(
+            f"audit_step_fn entrypoint must be 'update' | 'forward' | 'compute', got {entrypoint!r}"
+        )
+    return step
 
 
 # ------------------------------------------------------------- entry points

@@ -268,7 +268,7 @@ class Metric:
         """Non-finite values currently tracked in the state (``nan_strategy``
         ``"warn"``/``"error"`` only; always 0 otherwise).  Reads the counter
         back to host — a device sync on the jit path."""
-        return int(self._state.get(_NONFINITE, 0))
+        return int(self._state.get(_NONFINITE, 0))  # tmt: ignore[TMT003] -- deliberate eager host readback for a user-facing Python int
 
     def _check_nonfinite(self) -> None:
         """Deferred host-side leg of the ``"warn"``/``"error"`` strategies.
@@ -281,7 +281,7 @@ class Metric:
         """
         if self._guard_strategy not in ("warn", "error"):
             return
-        count = int(self._state.get(_NONFINITE, 0))
+        count = int(self._state.get(_NONFINITE, 0))  # tmt: ignore[TMT003] -- nan-strategy guard check is an eager host boundary by design
         if count == 0:
             return
         if self._guard_strategy == "error":
@@ -394,11 +394,11 @@ class Metric:
     # ----------------------------------------------------------------- facade
     @property
     def update_called(self) -> bool:
-        return int(self._state[_N]) > 0
+        return int(self._state[_N]) > 0  # tmt: ignore[TMT003] -- deliberate eager host readback for a user-facing Python bool
 
     @property
     def update_count(self) -> int:
-        return int(self._state[_N])
+        return int(self._state[_N])  # tmt: ignore[TMT003] -- deliberate eager host readback for a user-facing Python int
 
     @property
     def metric_state(self) -> State:

@@ -114,15 +114,15 @@ class IntersectionOverUnion(Metric):
             gt_labels = (
                 jnp.concatenate(state["groundtruth_labels"]) if state["groundtruth_labels"] else jnp.zeros(0)
             )
-            classes = np.unique(np.asarray(gt_labels)).tolist() if gt_labels.size else []
+            classes = np.unique(np.asarray(gt_labels)).tolist() if gt_labels.size else []  # tmt: ignore[TMT003] -- host-side compute: per-class bucketing over variable-length matches
             for cl in classes:
                 total = cnt = 0.0
                 for mat, gl in zip(state["iou_matrix"], state["groundtruth_labels"]):
-                    scores = mat[:, np.asarray(gl) == cl]
+                    scores = mat[:, np.asarray(gl) == cl]  # tmt: ignore[TMT003] -- host-side compute: ragged per-image IoU matrices
                     sel = scores[scores != self._invalid_val]
-                    total += float(sel.sum())
+                    total += float(sel.sum())  # tmt: ignore[TMT003] -- host-side compute: ragged per-image IoU matrices
                     cnt += int(sel.size)
-                results[f"{self._iou_type}/cl_{int(cl)}"] = jnp.asarray(total / cnt if cnt else 0.0)
+                results[f"{self._iou_type}/cl_{int(cl)}"] = jnp.asarray(total / cnt if cnt else 0.0)  # tmt: ignore[TMT003] -- host-side compute: ragged per-image IoU matrices
         return results
 
 

@@ -69,8 +69,8 @@ class PanopticQuality(Metric):
         self.add_state("false_negatives", jnp.zeros(n), dist_reduce_fx="sum")
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
-        preds_np = np.asarray(preds)
-        target_np = np.asarray(target)
+        preds_np = np.asarray(preds)  # tmt: ignore[TMT003] -- host-side update: segment matching runs on host arrays
+        target_np = np.asarray(target)  # tmt: ignore[TMT003] -- host-side update: segment matching runs on host arrays
         if preds_np.ndim < 3 or preds_np.shape[-1] != 2:
             raise ValueError(f"Expected argument `preds` to have shape (B, *spatial, 2) but got {preds_np.shape}")
         if target_np.shape != preds_np.shape:
@@ -94,10 +94,10 @@ class PanopticQuality(Metric):
 
     def _compute(self, state: State) -> Array:
         pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
-            np.asarray(state["iou_sum"]),
-            np.asarray(state["true_positives"]),
-            np.asarray(state["false_positives"]),
-            np.asarray(state["false_negatives"]),
+            np.asarray(state["iou_sum"]),  # tmt: ignore[TMT003] -- host-side compute: panoptic matching statistics live on host
+            np.asarray(state["true_positives"]),  # tmt: ignore[TMT003] -- host-side compute: panoptic matching statistics live on host
+            np.asarray(state["false_positives"]),  # tmt: ignore[TMT003] -- host-side compute: panoptic matching statistics live on host
+            np.asarray(state["false_negatives"]),  # tmt: ignore[TMT003] -- host-side compute: panoptic matching statistics live on host
         )
         if self.return_per_class:
             if self.return_sq_and_rq:

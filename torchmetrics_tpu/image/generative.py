@@ -219,17 +219,17 @@ class FrechetInceptionDistance(Metric):
     def _compute(self, state: State) -> Array:
         import numpy as np
 
-        if float(state["real_features_num_samples"]) < 2 or float(state["fake_features_num_samples"]) < 2:
+        if float(state["real_features_num_samples"]) < 2 or float(state["fake_features_num_samples"]) < 2:  # tmt: ignore[TMT003, TMT004] -- host-side FID compute: sample-count sanity check before np sqrtm path
             raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
         mu_real, cov_real = _mean_cov(
-            np.asarray(state["real_features_sum"], np.float64),
-            np.asarray(state["real_features_cov_sum"], np.float64),
-            float(state["real_features_num_samples"]),
+            np.asarray(state["real_features_sum"], np.float64),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
+            np.asarray(state["real_features_cov_sum"], np.float64),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
+            float(state["real_features_num_samples"]),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
         )
         mu_fake, cov_fake = _mean_cov(
-            np.asarray(state["fake_features_sum"], np.float64),
-            np.asarray(state["fake_features_cov_sum"], np.float64),
-            float(state["fake_features_num_samples"]),
+            np.asarray(state["fake_features_sum"], np.float64),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
+            np.asarray(state["fake_features_cov_sum"], np.float64),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
+            float(state["fake_features_num_samples"]),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
         )
         return jnp.asarray(_compute_fid_np(mu_real, cov_real, mu_fake, cov_fake), jnp.float32)
 
@@ -287,8 +287,8 @@ class MemorizationInformedFrechetInceptionDistance(_RealFeaturesResetMixin, Metr
         # (mifid.py:62) is meaningless at float32 noise levels
         import numpy as np
 
-        real = np.asarray(dim_zero_cat(state["real_features"]), np.float64)
-        fake = np.asarray(dim_zero_cat(state["fake_features"]), np.float64)
+        real = np.asarray(dim_zero_cat(state["real_features"]), np.float64)  # tmt: ignore[TMT003] -- host-side MiFID compute in np.float64 on host
+        fake = np.asarray(dim_zero_cat(state["fake_features"]), np.float64)  # tmt: ignore[TMT003] -- host-side MiFID compute in np.float64 on host
         return _mifid_compute(
             real.mean(axis=0), np.cov(real.T), real,
             fake.mean(axis=0), np.cov(fake.T), fake,
@@ -517,7 +517,7 @@ class PerceptualPathLength(Metric):
             )
         from torchmetrics_tpu.functional.image.lpips import _lpips_from_features
 
-        key = jax.random.PRNGKey(int(state.get("_n", 0)))
+        key = jax.random.PRNGKey(int(state.get("_n", 0)))  # tmt: ignore[TMT003] -- host-side sampling loop: PRNG seed derives from a host int
         distances = []
         done = 0
         while done < self.num_samples:
@@ -529,7 +529,7 @@ class PerceptualPathLength(Metric):
             za = self._interpolate(z1, z2, t, self.interpolation_method)
             zb = self._interpolate(z1, z2, t + self.epsilon, self.interpolation_method)
             if self.conditional:
-                labels = jax.random.randint(kl, (n,), 0, int(generator.num_classes))
+                labels = jax.random.randint(kl, (n,), 0, int(generator.num_classes))  # tmt: ignore[TMT003] -- host-side sampling loop: label count is host config
                 img_a = jnp.asarray(generator(za, labels))
                 img_b = jnp.asarray(generator(zb, labels))
             else:
@@ -548,7 +548,7 @@ class PerceptualPathLength(Metric):
     def _compute(self, state: State) -> Tuple[Array, Array, Array]:
         import numpy as np
 
-        distances = np.asarray(dim_zero_cat(state["distances"]))
+        distances = np.asarray(dim_zero_cat(state["distances"]))  # tmt: ignore[TMT003] -- host-side compute: np.quantile discard thresholds
         lower = np.quantile(distances, self.lower_discard) if self.lower_discard is not None else distances.min()
         upper = np.quantile(distances, self.upper_discard) if self.upper_discard is not None else distances.max()
         kept = distances[(distances >= lower) & (distances <= upper)]

@@ -60,7 +60,7 @@ class CLIPImageQualityAssessment(Metric):
         self.add_state("img_features", [], dist_reduce_fx="cat")
 
     def _update(self, state: State, images: Array) -> State:
-        images = jnp.asarray(images, jnp.float32) / float(self.data_range)
+        images = jnp.asarray(images, jnp.float32) / self.data_range
         if images.ndim != 4 or images.shape[1] != 3:
             raise ValueError(f"Expected 4D (N, 3, H, W) input, got {images.shape}")
         feats = jnp.asarray(self.image_encoder(images))

@@ -358,11 +358,11 @@ class _Span:
             self._ann.__enter__()
         except Exception:  # pragma: no cover - profiler unavailable
             self._ann = None
-        self._t0 = time.perf_counter()
+        self._t0 = time.perf_counter()  # tmt: ignore[TMT006] -- eager telemetry span timing at the host boundary; never traced
         return self
 
     def __exit__(self, *exc: Any) -> bool:
-        dt = time.perf_counter() - self._t0
+        dt = time.perf_counter() - self._t0  # tmt: ignore[TMT006] -- eager telemetry span timing at the host boundary; never traced
         if self._ann is not None:
             try:
                 self._ann.__exit__(*exc)

@@ -78,6 +78,8 @@ __all__ = [
     "coalesced_sync_state",
     "flush_sync",
     "per_leaf_collective_count",
+    "plan_for_metric",
+    "plan_for_metrics",
 ]
 
 State = Dict[str, Any]
@@ -257,6 +259,47 @@ def coalesced_sync_state(
     return apply_sync_plan(plan, [state], axis_name)[0]
 
 
+def _metric_entry(metric: Any, state: Mapping[str, Any]) -> Tuple[Mapping[str, Any], State]:
+    """The (reduction table, synced-leaf subset) entry ``sync_states`` plans
+    over: every registered leaf plus the reserved ``_n`` counter."""
+    sub: State = {name: state[name] for name in metric._reductions}
+    sub[_N] = state[_N]
+    return metric._reductions, sub
+
+
+def plan_for_metric(metric: Any, state: Optional[Mapping[str, Any]] = None) -> SyncPlan:
+    """Introspection hook: the exact :class:`SyncPlan` one ``sync_states``
+    call on ``metric`` builds (``state`` defaults to the live accumulator).
+
+    The analysis auditor (``analysis/audit.py``) compares this plan's
+    ``n_collectives`` against the collective primitives actually present in
+    the traced sync jaxpr — closing the loop between the planner's cost
+    model and what XLA lowers.
+    """
+    if state is None:
+        state = metric._state
+    return build_sync_plan([_metric_entry(metric, state)])
+
+
+def plan_for_metrics(
+    metrics: Sequence[Any], states: Sequence[Mapping[str, Any]]
+) -> Tuple[SyncPlan, Tuple[int, ...]]:
+    """Cross-metric introspection hook: the shared bucket plan for the
+    coalescible (standard-``sync_states``) subset of ``metrics``.
+
+    Returns ``(plan, standard_indices)``; metrics that override
+    ``sync_states`` keep their own aggregation and are excluded — exactly
+    the partition :func:`coalesced_metric_sync` executes.
+    """
+    from torchmetrics_tpu.core.metric import Metric
+
+    standard = tuple(
+        i for i, m in enumerate(metrics) if type(m).sync_states is Metric.sync_states
+    )
+    entries = [_metric_entry(metrics[i], states[i]) for i in standard]
+    return build_sync_plan(entries), standard
+
+
 def coalesced_metric_sync(
     metrics: Sequence[Any], states: Sequence[Mapping[str, Any]], axis_name: str
 ) -> List[State]:
@@ -269,18 +312,10 @@ def coalesced_metric_sync(
     leaf-wise would be silently wrong for them.
     """
     from torchmetrics_tpu.core.guards import count_nonfinite
-    from torchmetrics_tpu.core.metric import Metric
 
-    standard = [
-        i for i, m in enumerate(metrics) if type(m).sync_states is Metric.sync_states
-    ]
-    entries = []
-    for i in standard:
-        table, st = metrics[i]._reductions, states[i]
-        sub = {name: st[name] for name in table}
-        sub[_N] = st[_N]
-        entries.append((table, sub))
-    synced = apply_sync_plan(build_sync_plan(entries), [e[1] for e in entries], axis_name)
+    plan, standard = plan_for_metrics(metrics, states)
+    entries = [_metric_entry(metrics[i], states[i]) for i in standard]
+    synced = apply_sync_plan(plan, [e[1] for e in entries], axis_name)
     out: List[Optional[State]] = [None] * len(metrics)
     for i, st in zip(standard, synced):
         if metrics[i]._guard_strategy in ("warn", "error"):

@@ -354,7 +354,7 @@ def _install(metric: Metric, state: State) -> None:
     from torchmetrics_tpu.observability import registry as _telemetry
 
     _telemetry.count(metric, "restores")
-    metric._state = state
+    metric._state = state  # tmt: ignore[TMT007] -- checkpoint restore installs state buffers — a sanctioned lifecycle boundary
     metric._state_shared = False  # restored buffers are fresh — donation is safe again
     metric._computed = None
     metric._forward_cache = None
@@ -422,7 +422,7 @@ def restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True) -> Non
             for members in groups:
                 leader_state = obj[members[0]]._state
                 for name in members[1:]:
-                    obj[name]._state = leader_state
+                    obj[name]._state = leader_state  # tmt: ignore[TMT007] -- compute-group re-aliasing on restore: collection state lifecycle
                 obj._mark_shared(list(members))
         return
     if isinstance(obj, Metric):

@@ -228,13 +228,13 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
         target = dim_zero_cat(state["target"])
         indexes = dim_zero_cat(state["indexes"])
         rg = rank_groups(preds, target, indexes)
-        max_k = self.max_k if self.max_k is not None else int(rg.sizes.max())
+        max_k = self.max_k if self.max_k is not None else int(rg.sizes.max())  # tmt: ignore[TMT003] -- host-side compute: ragged per-query grouping is data-dependent
         prec, rec, topk = grouped_precision_recall_curve(rg, max_k, self.adaptive_k)
         empty = rg.n_rel == 0
-        if self.empty_target_action == "error" and bool(empty.any()):
+        if self.empty_target_action == "error" and bool(empty.any()):  # tmt: ignore[TMT003] -- host-side compute: empty_target_action='error' must raise eagerly
             raise ValueError("`compute` method was provided with a query with no positive target.")
         if self.empty_target_action == "skip":
-            keep = np.asarray(~empty)
+            keep = np.asarray(~empty)  # tmt: ignore[TMT003] -- host-side compute: boolean row filter over ragged groups
             prec, rec = prec[keep], rec[keep]
         else:
             fill = 1.0 if self.empty_target_action == "pos" else 0.0
@@ -261,10 +261,10 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
 
     def _compute(self, state: State) -> Tuple[Array, Array]:
         precision, recall, top_k = super()._compute(state)
-        p, r, k = np.asarray(precision), np.asarray(recall), np.asarray(top_k)
+        p, r, k = np.asarray(precision), np.asarray(recall), np.asarray(top_k)  # tmt: ignore[TMT003] -- host-side compute: curve search over ragged groups
         ok = p >= self.min_precision
         if not ok.any():
             return jnp.asarray(0.0), jnp.asarray(k[-1] if k.size else 0)
-        pairs = sorted(zip(r[ok].tolist(), k[ok].tolist()))
+        pairs = sorted(zip(r[ok].tolist(), k[ok].tolist()))  # tmt: ignore[TMT003] -- host-side compute: curve search over ragged groups
         best_r, best_k = pairs[-1]
-        return jnp.asarray(best_r, dtype=jnp.float32), jnp.asarray(int(best_k))
+        return jnp.asarray(best_r, dtype=jnp.float32), jnp.asarray(int(best_k))  # tmt: ignore[TMT003] -- host-side compute: curve search over ragged groups

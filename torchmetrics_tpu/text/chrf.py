@@ -89,10 +89,10 @@ class CHRFScore(Metric):
     def _compute(self, state: State) -> Union[Array, Tuple[Array, Array]]:
         corpus = jnp.asarray(
             _fscore(
-                np.asarray(state["matching_char"]), np.asarray(state["matching_word"]),
-                np.asarray(state["preds_char"]), np.asarray(state["preds_word"]),
-                np.asarray(state["target_char"]), np.asarray(state["target_word"]),
-                float(self.n_char_order + self.n_word_order), self.beta,
+                np.asarray(state["matching_char"]), np.asarray(state["matching_word"]),  # tmt: ignore[TMT003] -- host-side text metric: chrF statistics are host numbers
+                np.asarray(state["preds_char"]), np.asarray(state["preds_word"]),  # tmt: ignore[TMT003] -- host-side text metric: chrF statistics are host numbers
+                np.asarray(state["target_char"]), np.asarray(state["target_word"]),  # tmt: ignore[TMT003] -- host-side text metric: chrF statistics are host numbers
+                float(self.n_char_order + self.n_word_order), self.beta,  # tmt: ignore[TMT003] -- host-side text metric: chrF statistics are host numbers
             ),
             jnp.float32,
         )

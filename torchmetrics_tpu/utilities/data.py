@@ -122,6 +122,6 @@ def allclose(t1: Array, t2: Array, atol: float = 1e-8) -> bool:
     """dtype-robust allclose (reference: utilities/data.py:241-245)."""
     if t1.shape != t2.shape:
         return False
-    return bool(jnp.allclose(t1.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
-                             t2.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),
+    return bool(jnp.allclose(t1.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),  # tmt: ignore[TMT008] -- x64 branch explicitly gated on jax_enable_x64; float32 otherwise
+                             t2.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32),  # tmt: ignore[TMT008] -- x64 branch explicitly gated on jax_enable_x64; float32 otherwise
                              atol=atol))

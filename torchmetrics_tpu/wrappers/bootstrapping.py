@@ -23,7 +23,7 @@ from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson", rng: Optional[np.random.Generator] = None) -> np.ndarray:
     """Resampled indices for one bootstrap replicate (reference: bootstrapping.py:35-52)."""
-    rng = rng or np.random.default_rng()
+    rng = rng or np.random.default_rng()  # tmt: ignore[TMT006] -- documented host-side fallback; BootStrapper always passes a seeded Generator
     if sampling_strategy == "poisson":
         counts = rng.poisson(1.0, size)
         return np.repeat(np.arange(size), counts)
