@@ -892,10 +892,12 @@ def observability_leg():
     preds = jnp.asarray(rng.integers(0, n_cls, 4096))
     tgt = jnp.asarray(rng.integers(0, n_cls, 4096))
 
-    def step_us(enabled):
+    def step_us(enabled, recorder=False):
         clear_compile_cache()
         obs.reset_telemetry()
         (obs.enable if enabled else obs.disable)()
+        if recorder:
+            obs.tracing.start(capacity=4096)
         m = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False, jit=True)
         m.update(preds, tgt)  # compile
         inner = 50
@@ -908,6 +910,13 @@ def observability_leg():
     try:
         off_us, off_traces = step_us(False)
         on_us, on_traces = step_us(True)
+        rec_us, rec_traces = step_us(True, recorder=True)
+        rec_events = len(obs.tracing.events())
+        chrome = json.loads(obs.export(fmt="chrome"))
+        chrome_ok = (
+            bool(chrome["traceEvents"])
+            and "schema_version" in chrome["otherData"]
+        )
 
         # exporter round trip over the enabled run's report
         obs.enable()
@@ -918,6 +927,7 @@ def observability_leg():
         prom_lines = len(prom_text.splitlines())
         obs.export(report, fmt="log")
     finally:
+        obs.tracing.stop()
         obs.disable()
         obs.reset_telemetry()
         clear_compile_cache()
@@ -926,8 +936,12 @@ def observability_leg():
         "metric": f"MulticlassConfusionMatrix({n_cls}) jitted update",
         "update_us_telemetry_off": round(off_us, 1),
         "update_us_telemetry_on": round(on_us, 1),
+        "update_us_flight_recorder": round(rec_us, 1),
         "enabled_overhead_pct": round((on_us - off_us) / off_us * 100.0, 2),
+        "recorder_overhead_pct": round((rec_us - off_us) / off_us * 100.0, 2),
         "telemetry_extra_retraces": on_traces - off_traces,  # must be 0
+        "recorder_extra_retraces": rec_traces - off_traces,  # must be 0
+        "flight_recorder": {"events": rec_events, "chrome_export_ok": chrome_ok},
         "exporters": {"jsonl_roundtrip": jsonl_roundtrip, "prometheus_lines": prom_lines},
         "note": "telemetry never enters compile-cache keys (0 extra retraces by "
         "construction); the disabled path is one flag check per entry point",
@@ -1135,7 +1149,7 @@ def main():
     except Exception as err:  # noqa: BLE001
         analysis = {"error": f"analysis leg failed: {err}"}
 
-    print(json.dumps({
+    record = {
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
         "value": round(overhead_pct, 3),
         "unit": "% of train step",
@@ -1169,7 +1183,9 @@ def main():
             "device": str(jax.devices()[0].platform),
             "backend_fallback": os.environ.get("BENCH_BACKEND_FALLBACK") or None,
         },
-    }))
+    }
+    print(json.dumps(record))
+    return record
 
 
 def _ensure_backend_or_reexec():
@@ -1234,14 +1250,53 @@ def _ensure_backend_or_reexec():
         f"ran on scrubbed CPU with reduced shapes. last error: {last_err}"
     )
     sys.stderr.write(f"bench: {env['BENCH_BACKEND_FALLBACK']}\n")
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+    # preserve CLI flags (--check-regressions) across the re-exec
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
+
+
+def check_regressions_cli() -> None:
+    """``bench.py --check-regressions [--input FILE]``: gate a bench record
+    against the archived ``BENCH_r*.json`` history next to this script.
+
+    With ``--input FILE`` (or ``BENCH_REGRESSION_INPUT``) the record is read
+    from an existing bench-output JSON line instead of re-running the bench.
+    The markdown report goes to stderr; the last stdout line is the
+    machine-readable verdict JSON.  Exit code: 0 on pass/no-baseline, 3 on
+    regression — distinct from generic-crash 1 so CI can tell them apart.
+    """
+    import sys
+
+    from torchmetrics_tpu.utilities.regression import check_regressions
+
+    argv = sys.argv[1:]
+    input_path = os.environ.get("BENCH_REGRESSION_INPUT")
+    if "--input" in argv and argv.index("--input") + 1 < len(argv):
+        input_path = argv[argv.index("--input") + 1]
+    history_dir = os.environ.get(
+        "BENCH_HISTORY_DIR", os.path.dirname(os.path.abspath(__file__)) or "."
+    )
+    if input_path:
+        with open(input_path) as fh:
+            lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+        record = json.loads(lines[-1])
+    else:
+        _ensure_backend_or_reexec()
+        record = main()
+    report = check_regressions(record, history_dir=history_dir)
+    sys.stderr.write(report.to_markdown())
+    print(json.dumps(report.to_dict()))
+    raise SystemExit(3 if report.verdict == "fail" else 0)
 
 
 if __name__ == "__main__":
+    import sys as _sys
+
     if os.environ.get("BENCH_CHILD_MODE") == "ragged":
         ragged_sync_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "coalescing":
         coalescing_bench_child()
+    elif "--check-regressions" in _sys.argv[1:]:
+        check_regressions_cli()
     else:
         _ensure_backend_or_reexec()
         main()

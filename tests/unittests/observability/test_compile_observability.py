@@ -1,0 +1,148 @@
+"""Compile-time observability: per-entry cold-start timing, total miss-cause
+attribution (every miss names one of :data:`MISS_CAUSES`), and
+``explain_retrace`` pinning a retrace on the attribute that mutated."""
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.core.compile import (
+    MISS_CAUSES,
+    cache_capacity,
+    cache_stats,
+    compile_time_by_fingerprint,
+    compile_timeline,
+    explain_retrace,
+    fingerprint_diff,
+    measure_compile_phases,
+    set_cache_capacity,
+)
+
+PROBS = jnp.asarray([0.1, 0.8, 0.6, 0.4, 0.9, 0.2, 0.7, 0.3])
+TARGET = jnp.asarray([0, 1, 1, 0, 1, 0, 1, 1])
+
+
+# ----------------------------------------------------------- cause attribution
+def test_every_miss_carries_a_cause():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    m.update(PROBS[:4], TARGET[:4])  # new shape -> new key
+    m.threshold = 0.9  # mutation -> invalidation
+    m.update(PROBS, TARGET)
+    stats = cache_stats()
+    assert set(stats["miss_causes"]) == set(MISS_CAUSES)
+    assert sum(stats["miss_causes"].values()) == stats["misses"]
+
+
+def test_first_compile_is_new_key():
+    BinaryAccuracy(validate_args=False, jit=True).update(PROBS, TARGET)
+    assert cache_stats()["miss_causes"]["new-key"] >= 1
+    assert cache_stats()["miss_causes"]["invalidation"] == 0
+
+
+def test_mutation_is_an_invalidation_and_explain_retrace_names_it():
+    """PR 1's stale-trace scenario, now attributed: mutating ``threshold``
+    between dispatches must classify as an invalidation miss and
+    ``explain_retrace`` must name the attribute with old and new values."""
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    before = cache_stats()["miss_causes"]["invalidation"]
+    m.threshold = 0.9
+    m.update(PROBS, TARGET)
+    assert cache_stats()["miss_causes"]["invalidation"] == before + 1
+
+    why = explain_retrace(m)
+    assert why is not None and why["label"] == "BinaryAccuracy"
+    changed = {c["attr"]: c for c in why["changed"]}
+    assert "threshold" in changed
+    assert changed["threshold"]["old"] == "0.5"
+    assert changed["threshold"]["new"] == "0.9"
+    assert "threshold" in why["summary"] and "0.9" in why["summary"]
+
+
+def test_explain_retrace_none_without_invalidation():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    assert explain_retrace(m) is None
+    # and restricting to a class that never invalidated stays None
+    assert explain_retrace(MulticlassAccuracy(num_classes=5)) is None
+
+
+def test_evicted_key_remisses_as_eviction():
+    cap = cache_capacity()
+    try:
+        set_cache_capacity(1)
+        m = BinaryAccuracy(validate_args=False, jit=True)
+        m.update(PROBS, TARGET)
+        m.update(PROBS[:4], TARGET[:4])  # evicts the full-shape entry
+        m.update(PROBS, TARGET)  # the exact old key comes back
+        assert cache_stats()["miss_causes"]["eviction"] == 1
+    finally:
+        set_cache_capacity(cap)
+
+
+def test_donation_flip_is_a_donate_variant_miss():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)  # compiled with donation (exclusive state)
+    m._state_shared = True  # aliased state: same config+signature, donate off
+    m.update(PROBS, TARGET)
+    assert cache_stats()["miss_causes"]["donate-variant"] == 1
+
+
+# --------------------------------------------------------- cold-start timeline
+def test_compile_timeline_records_cold_starts():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    m.threshold = 0.25
+    m.update(PROBS, TARGET)
+    timeline = compile_timeline()
+    assert len(timeline) == 2
+    assert [r["cause"] for r in timeline] == ["new-key", "invalidation"]
+    for rec in timeline:
+        assert rec["kind"] == "update"
+        assert rec["label"] == "BinaryAccuracy"
+        assert rec["cold_start_s"] > 0.0
+        assert len(rec["fingerprint_hash"]) == 12
+    # the two dispatches compiled under different config fingerprints
+    assert timeline[0]["fingerprint_hash"] != timeline[1]["fingerprint_hash"]
+
+
+def test_compile_time_keyed_by_fingerprint():
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PROBS, TARGET)
+    m.update(PROBS[:4], TARGET[:4])  # same fingerprint, second entry
+    by_fp = compile_time_by_fingerprint()
+    (fp_hash,) = by_fp
+    slot = by_fp[fp_hash]
+    assert slot["label"] == "BinaryAccuracy"
+    assert slot["count"] == 2
+    assert slot["total_s"] > 0.0
+    assert slot["kinds"] == ["update"]
+
+
+def test_measure_compile_phases_does_not_touch_the_cache():
+    m = MulticlassAccuracy(num_classes=5)
+    before = cache_stats()
+    phases = measure_compile_phases(
+        m, jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]), entrypoint="update"
+    )
+    assert cache_stats() == before  # pure diagnostic: no entries, no counters
+    assert set(phases) >= {"trace_s", "lower_s", "compile_s", "total_s"}
+    assert all(v >= 0.0 for v in phases.values())
+    assert phases["total_s"] >= phases["compile_s"]
+
+
+# ------------------------------------------------------------ fingerprint diffs
+def test_fingerprint_diff_opaque_shapes():
+    diff = fingerprint_diff(("weird",), 42)
+    assert diff["opaque"] is True and diff["changed"] == []
+
+
+def test_fingerprint_diff_named_attrs():
+    a = BinaryAccuracy(validate_args=False)
+    old = a._config_fingerprint()
+    a.threshold = 0.75
+    new = a._config_fingerprint()
+    diff = fingerprint_diff(old, new)
+    assert not diff["opaque"]
+    assert [c["attr"] for c in diff["changed"]] == ["threshold"]

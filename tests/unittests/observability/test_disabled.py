@@ -66,6 +66,42 @@ def test_sharded_flow_zero_extra_traces(mesh):
     np.testing.assert_array_equal(result_on, result_off)
 
 
+def test_armed_recorder_keeps_jaxprs_bit_identical():
+    """Arming the flight recorder (even with telemetry on) must not perturb a
+    single traced graph: the jaxpr of the update step is bit-identical."""
+    from torchmetrics_tpu.core.compile import audit_step_fn
+    from torchmetrics_tpu.observability import tracing
+
+    m = MulticlassAccuracy(num_classes=5)
+    step = audit_step_fn(m, "update")
+    state = m.init_state()
+    obs.disable()
+    baseline = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    try:
+        tracing.start(capacity=64)
+        obs.enable()
+        armed = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    finally:
+        tracing.stop()
+    assert armed == baseline
+
+
+def test_armed_recorder_adds_zero_cache_entries():
+    from torchmetrics_tpu.observability import tracing
+
+    obs.disable()
+    result_off, traces_off, by_off = _jit_flow()
+    try:
+        tracing.start(capacity=64)
+        obs.enable()
+        result_on, traces_on, by_on = _jit_flow()
+    finally:
+        tracing.stop()
+    assert traces_on == traces_off
+    assert by_on == by_off
+    np.testing.assert_array_equal(result_on, result_off)
+
+
 def test_disabled_records_nothing():
     assert not obs.enabled()
     m = MulticlassAccuracy(num_classes=5, jit=True)

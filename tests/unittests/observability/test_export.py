@@ -17,6 +17,11 @@ from torchmetrics_tpu.observability import (
     PrometheusExporter,
     export,
 )
+from torchmetrics_tpu.observability.export import (
+    SCHEMA_MAJOR,
+    SCHEMA_VERSION,
+    parse_export_line,
+)
 
 PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
 TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
@@ -57,6 +62,43 @@ def test_jsonl_path_appends_one_line_per_export(tmp_path):
     assert all(json.loads(ln)["schema"] == 1 for ln in lines)
 
 
+def test_jsonl_carries_schema_version():
+    report = _activity()
+    line = export(report, fmt="jsonl", stream=io.StringIO())
+    assert json.loads(line)["schema_version"] == SCHEMA_VERSION
+
+
+# -------------------------------------------------- versioned parse-back contract
+def test_parse_export_line_roundtrip():
+    report = _activity()
+    line = export(report, fmt="jsonl", stream=io.StringIO())
+    back = parse_export_line(line)
+    assert back["schema_version"] == SCHEMA_VERSION
+    assert set(back["metrics"]) == set(report["metrics"])
+
+
+def test_parse_export_line_accepts_legacy_unversioned():
+    # pre-1.1 exports had no schema_version field: accepted as major 1
+    back = parse_export_line(json.dumps({"schema": 1, "metrics": {}}))
+    assert back["metrics"] == {}
+
+
+def test_parse_export_line_rejects_unknown_major():
+    future = json.dumps({"schema_version": f"{SCHEMA_MAJOR + 1}.0.0", "metrics": {}})
+    with pytest.raises(ValueError, match=f"major {SCHEMA_MAJOR} only"):
+        parse_export_line(future)
+
+
+def test_parse_export_line_rejects_garbage_version():
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_export_line(json.dumps({"schema_version": "new-and-shiny"}))
+
+
+def test_parse_export_line_same_major_newer_minor_ok():
+    line = json.dumps({"schema_version": f"{SCHEMA_MAJOR}.99.7", "metrics": {"x": {}}})
+    assert parse_export_line(line)["metrics"] == {"x": {}}
+
+
 def test_jsonl_needs_exactly_one_sink():
     with pytest.raises(ValueError, match="exactly one"):
         JSONLinesExporter()
@@ -84,7 +126,7 @@ def test_prometheus_exposition_lints():
             helped.add(ln.split()[2])
         elif ln.startswith("# TYPE "):
             parts = ln.split()
-            assert parts[3] in ("counter", "histogram")
+            assert parts[3] in ("counter", "histogram", "gauge")
             typed.add(parts[2])
         else:
             assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"

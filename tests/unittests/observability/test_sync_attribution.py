@@ -1,0 +1,195 @@
+"""Measured sync-cost attribution: block-until-ready bucket timing next to the
+ring-model prediction, cadence windows feeding the same accounting as per-step
+syncs, and the report-only :class:`SyncAdvisor` built on both."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.observability import registry
+from torchmetrics_tpu.parallel import (
+    SyncAdvisor,
+    SyncPolicy,
+    SyncStepper,
+    bucketed_collective_count,
+    flush_sync,
+    sharded_update,
+)
+from torchmetrics_tpu.utilities.benchmark import ring_reduce_bytes, sync_bytes_per_chip
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=5, average="micro")
+
+
+def _batch(rng, n=16):
+    return (
+        jnp.asarray(rng.integers(0, 5, (n,))),
+        jnp.asarray(rng.integers(0, 5, (n,))),
+    )
+
+
+# ------------------------------------------------------- measured bucket rows
+def test_sharded_update_records_measured_buckets(mesh):
+    obs.enable()
+    m = _metric()
+    rng = np.random.default_rng(0)
+    sharded_update(m, *_batch(rng), mesh=mesh)
+    row = m.telemetry.as_dict()
+    buckets = row["sync_buckets"]
+    assert buckets, "an enabled sync must produce measured bucket rows"
+    for key, b in buckets.items():
+        assert b["syncs"] == 1
+        assert b["measured_us"] > 0.0
+        assert b["residual_bytes"] == b["model_ring_bytes"] - b["model_naive_bytes"]
+    # the measured wall time also lands as a span, one per sync
+    assert row["spans"]["sync_measured"]["count"] == 1
+    # attribution shares sum back to the measured total
+    total_us = sum(b["measured_us"] for b in buckets.values())
+    assert total_us == pytest.approx(row["spans"]["sync_measured"]["total_us"], rel=1e-6)
+
+
+def test_measured_bucket_byte_models_exact():
+    """record_measured_sync against a hand-built state: bucket bytes must be
+    exactly the naive 2(n-1)/n model vs the granule-aware ring model."""
+    obs.enable()
+
+    class Owner:
+        pass
+
+    owner = Owner()
+    entries = [({"a": "sum"}, {"a": np.zeros((64,), np.float32)})]
+    registry.record_measured_sync(owner, entries, n_devices=8, seconds=0.25)
+    row = registry.telemetry_for(owner).as_dict()
+    (key,) = row["sync_buckets"]
+    b = row["sync_buckets"][key]
+    assert key == "float32/sum"
+    payload = 64 * 4
+    assert b["elements"] == 64
+    assert b["model_naive_bytes"] == int(round(2 * 7 / 8 * payload))
+    assert b["model_ring_bytes"] == int(ring_reduce_bytes(payload, 8))
+    assert b["residual_bytes"] == b["model_ring_bytes"] - b["model_naive_bytes"]
+    # single bucket: the whole measured window is attributed to it
+    assert b["measured_us"] == pytest.approx(0.25e6)
+
+
+def test_measured_sync_dark_when_disabled(mesh):
+    assert not obs.enabled()
+    m = _metric()
+    sharded_update(m, *_batch(np.random.default_rng(1)), mesh=mesh)
+    obs.enable()  # read back without recording anything new
+    assert registry.telemetry_for(m).as_dict()["sync_buckets"] == {}
+
+
+# ------------------------------------- cadence windows feed the same accounting
+def test_cadence_every_n4_matches_direct_sync_accounting(mesh):
+    """Satellite regression: 8 steps under every_n=4 must feed record_sync
+    exactly like 2 direct per-step syncs — same syncs count, same modelled
+    bytes per collective, same fused-collective count."""
+    obs.enable()
+    rng = np.random.default_rng(2)
+    batches = [_batch(rng) for _ in range(8)]
+
+    cadenced = _metric()
+    for preds, target in batches:
+        sharded_update(
+            cadenced, preds, target, mesh=mesh, sync_policy=SyncPolicy(every_n_steps=4)
+        )
+    direct = _metric()
+    for preds, target in batches[:2]:
+        sharded_update(direct, preds, target, mesh=mesh)
+
+    c_row = cadenced.telemetry.as_dict()["counters"]
+    d_row = direct.telemetry.as_dict()["counters"]
+    assert c_row["syncs"] == 2  # windows at steps 4 and 8
+    assert c_row["syncs"] == d_row["syncs"]
+    assert c_row["sync_bytes"] == d_row["sync_bytes"]
+    assert c_row["collectives"] == d_row["collectives"]
+    # and the modelled per-sync traffic is the planner's own number
+    state = cadenced.init_state()
+    per_sync = int(sync_bytes_per_chip(cadenced._reductions, state, NUM_DEVICES))
+    assert c_row["sync_bytes"] == 2 * per_sync
+    assert c_row["collectives"] == 2 * int(
+        bucketed_collective_count(cadenced._reductions, state)
+    )
+    # measured attribution rode along on both paths
+    assert cadenced.telemetry.as_dict()["spans"]["sync_measured"]["count"] == 2
+
+
+def test_flush_sync_records_like_a_sync_step(mesh):
+    obs.enable()
+    m = _metric()
+    rng = np.random.default_rng(3)
+    for _ in range(2):  # mid-window: no collective yet
+        sharded_update(m, *_batch(rng), mesh=mesh, sync_policy=SyncPolicy(every_n_steps=4))
+    assert m.telemetry.as_dict()["counters"]["syncs"] == 0
+    flush_sync(m)
+    row = m.telemetry.as_dict()
+    assert row["counters"]["syncs"] == 1
+    assert row["counters"]["sync_bytes"] > 0
+    assert row["spans"]["sync_measured"]["count"] == 1
+
+
+def test_at_compute_records_exactly_one_sync(mesh):
+    obs.enable()
+    m = _metric()
+    stepper = SyncStepper(m, mesh=mesh, policy=SyncPolicy(at_compute=True))
+    rng = np.random.default_rng(4)
+    for _ in range(3):
+        stepper.update(*_batch(rng))
+    assert m.telemetry.as_dict()["counters"]["syncs"] == 0
+    stepper.compute()
+    assert m.telemetry.as_dict()["counters"]["syncs"] == 1
+
+
+# ------------------------------------------------------------------ the advisor
+def test_sync_advisor_profile_and_recommend(mesh):
+    m = _metric()
+    rng = np.random.default_rng(5)
+    preds, target = _batch(rng)
+    advisor = SyncAdvisor(m, mesh=mesh, candidates=(1, 4))
+    prof = advisor.profile(preds, target, steps=8, rounds=1)
+    by_n = {r["every_n"]: r for r in prof["runs"]}
+    assert set(by_n) == {1, 4}
+    assert by_n[1]["syncs"] == 8 and by_n[4]["syncs"] == 2
+    assert prof["n_devices"] == NUM_DEVICES
+    assert prof["buckets"], "profile must carry per-bucket measured-vs-model rows"
+
+    rec = advisor.recommend(target_cut=0.0)  # every candidate eligible
+    assert rec["every_n"] == 1  # smallest eligible cadence wins
+    rec = advisor.recommend(target_cut=1e9)  # none eligible -> best cut
+    assert rec["every_n"] in (1, 4)
+    assert rec["policy"] == "every_n"
+    assert rec["baseline_sync_s"] > 0
+    assert "report-only" in rec["note"]
+    for key, row in rec["buckets"].items():
+        assert row["residual_bytes"] == row["model_ring_bytes"] - row["model_naive_bytes"]
+    # profiling is a dryrun: telemetry gate restored, not left enabled
+    assert not obs.enabled()
+
+
+def test_sync_advisor_requires_baseline_candidate(mesh):
+    with pytest.raises(ValueError, match="must include 1"):
+        SyncAdvisor(_metric(), mesh=mesh, candidates=(2, 4))
+
+
+# ------------------------------------------------------------ exporter surface
+def test_prometheus_exports_sync_bucket_families(mesh):
+    obs.enable()
+    m = _metric()
+    sharded_update(m, *_batch(np.random.default_rng(6)), mesh=mesh)
+    text = obs.export(fmt="prometheus")
+    for family in (
+        "tm_tpu_sync_bucket_measured_seconds_total",
+        "tm_tpu_sync_bucket_model_bytes_total",
+        "tm_tpu_sync_bucket_residual_bytes",
+    ):
+        assert f"# HELP {family} " in text
+        assert any(
+            ln.startswith(family + "{") for ln in text.splitlines()
+        ), f"{family} declared but has no samples"
+    # both models labelled per bucket
+    assert 'model="naive"' in text and 'model="ring"' in text

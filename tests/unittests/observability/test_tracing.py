@@ -1,0 +1,245 @@
+"""Flight recorder: double off-by-default gate, bounded ring semantics, and a
+Perfetto-loadable Chrome trace-event export (schema validated field by field)."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.observability import tracing
+from torchmetrics_tpu.observability.export import SCHEMA_VERSION
+from torchmetrics_tpu.observability.tracing import (
+    CATEGORIES,
+    FlightRecorder,
+    TraceEvent,
+)
+from torchmetrics_tpu.parallel import sharded_update
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    tracing.stop()
+    yield
+    tracing.stop()
+
+
+# ------------------------------------------------------------------ the gates
+def test_disarmed_by_default():
+    assert tracing.recorder() is None
+    assert not tracing.active()
+    assert tracing.events() == []
+
+
+def test_armed_without_telemetry_stays_dark():
+    """The double gate: an armed recorder with telemetry disabled records
+    nothing — a normally-dark job stays dark."""
+    assert not obs.enabled()
+    rec = tracing.start(capacity=64)
+    m = MulticlassAccuracy(num_classes=5)
+    m.update(PREDS, TARGET)
+    m.compute()
+    assert not tracing.active()
+    assert len(rec) == 0
+
+
+def test_telemetry_without_arming_records_no_events():
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5)
+    m.update(PREDS, TARGET)
+    assert tracing.events() == []
+    # ...but the registry still counted (the recorder is additive, not a tap
+    # the registry depends on)
+    assert m.telemetry.counters["updates"] == 1
+
+
+def test_armed_and_enabled_captures_eager_spans():
+    obs.enable()
+    rec = tracing.start(capacity=256)
+    m = MulticlassAccuracy(num_classes=5)
+    m.update(PREDS, TARGET)
+    m.compute()
+    names = [e.name for e in rec.events()]
+    label = m.telemetry.label
+    assert f"{label}/update" in names
+    assert f"{label}/compute" in names
+    for e in rec.events():
+        assert e.cat == "eager" and e.ph == "X" and e.dur_us >= 0.0
+        assert e.tid == label
+
+
+def test_stop_disarms_but_keeps_ring_readable():
+    obs.enable()
+    rec = tracing.start(capacity=64)
+    MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+    n = len(rec)
+    assert n > 0
+    back = tracing.stop()
+    assert back is rec and tracing.recorder() is None
+    # disarmed: no new events flow, old ones stay exportable
+    MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+    assert len(rec.events()) == n
+
+
+def test_recording_context_manager():
+    obs.enable()
+    with tracing.recording(capacity=32) as rec:
+        MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+        assert len(rec) > 0
+    assert tracing.recorder() is None  # scope exit disarmed
+
+
+# ------------------------------------------------------------------- the ring
+def test_ring_bounded_and_counts_drops():
+    rec = FlightRecorder(capacity=4)
+    for i in range(7):
+        rec.span(f"e{i}", "eager", float(i), 1.0)
+    assert len(rec) == 4
+    assert rec.dropped == 3
+    assert [e.name for e in rec.events()] == ["e3", "e4", "e5", "e6"]
+    rec.clear()
+    assert len(rec) == 0 and rec.dropped == 0
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        FlightRecorder(capacity=0)
+
+
+# ---------------------------------------------------------------- sync events
+def test_sharded_sync_events_carry_sync_category(mesh):
+    obs.enable()
+    rec = tracing.start(capacity=256)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 5, 4 * NUM_DEVICES))
+    target = jnp.asarray(rng.integers(0, 5, 4 * NUM_DEVICES))
+    spec = NamedSharding(mesh, P("data"))
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    import jax
+
+    sharded_update(
+        m, jax.device_put(preds, spec), jax.device_put(target, spec),
+        mesh=mesh, axis_name="data",
+    )
+    cats = {e.name: e.cat for e in rec.events()}
+    label = m.telemetry.label
+    assert cats[f"{label}/sync"] == "sync"
+    assert cats[f"{label}/sync_measured"] == "sync"
+
+
+def test_compile_cold_start_events_carry_cause(mesh):
+    obs.enable()
+    rec = tracing.start(capacity=256)
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    compiles = [e for e in rec.events() if e.cat == "compile"]
+    assert compiles, "cold start must land in the flight recorder"
+    for e in compiles:
+        assert e.ph == "X" and e.tid == "compile"
+        assert e.args["cause"] == "new-key"
+        assert e.args["kind"] == "update"
+
+
+# --------------------------------------------------------- chrome trace schema
+def _validate_chrome(payload):
+    assert set(payload) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert payload["displayTimeUnit"] == "ms"
+    meta = payload["otherData"]
+    assert meta["schema_version"] == SCHEMA_VERSION
+    assert meta["producer"] == "torchmetrics_tpu.observability.tracing"
+    assert isinstance(meta["capacity"], int) and isinstance(meta["dropped"], int)
+    for ev in payload["traceEvents"]:
+        assert ev["ph"] in ("X", "i")
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["cat"] in CATEGORIES
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["tid"], str)
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        else:
+            assert ev["s"] == "t"
+    return payload["traceEvents"]
+
+
+def test_chrome_trace_schema_roundtrip():
+    obs.enable()
+    tracing.start(capacity=128)
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    m.compute()
+    # through json so the test sees exactly what Perfetto would load
+    payload = json.loads(json.dumps(tracing.chrome_trace()))
+    events = _validate_chrome(payload)
+    assert events, "instrumented run must produce events"
+    assert {e["cat"] for e in events} >= {"eager", "compile"}
+
+
+def test_chrome_trace_empty_when_disarmed():
+    payload = tracing.chrome_trace()
+    assert _validate_chrome(payload) == []
+
+
+def test_export_front_door_chrome(tmp_path):
+    obs.enable()
+    tracing.start(capacity=64)
+    BinaryAccuracy().update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
+    path = tmp_path / "flight.trace.json"
+    text = obs.export(fmt="chrome", path=str(path))
+    assert path.read_text() == text
+    events = _validate_chrome(json.loads(text))
+    assert events
+    # report counters ride in otherData so the file is self-describing
+    meta = json.loads(text)["otherData"]
+    assert meta["report_counters"]["updates"] >= 1
+
+
+def test_trace_jsonl_export_lines_parse_back():
+    import io
+
+    from torchmetrics_tpu.observability.export import parse_export_line
+
+    obs.enable()
+    tracing.start(capacity=64)
+    MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+    buf = io.StringIO()
+    text = obs.export(fmt="trace-jsonl", stream=buf)
+    assert buf.getvalue() == text
+    lines = text.splitlines()
+    assert lines
+    for ln in lines:
+        ev = parse_export_line(ln)  # every line independently versioned
+        assert ev["schema_version"] == SCHEMA_VERSION
+        assert ev["cat"] in CATEGORIES and ev["ph"] in ("X", "i")
+
+
+def test_to_json_writes_perfetto_file(tmp_path):
+    obs.enable()
+    tracing.start(capacity=64)
+    MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+    path = tracing.to_json(str(tmp_path / "t.json"))
+    _validate_chrome(json.loads(open(path).read()))
+
+
+def test_instant_events_scope_thread():
+    rec = FlightRecorder(capacity=8)
+    rec.instant("snap", "resilience", tid="ckpt", count=1)
+    (ev,) = rec.events()
+    chrome = ev.as_chrome(pid=1)
+    assert chrome["ph"] == "i" and chrome["s"] == "t" and chrome["args"]["count"] == 1
+
+
+def test_event_dict_forms_agree():
+    ev = TraceEvent("x/update", "eager", "X", 10.0, 5.0, tid="x", args={"a": 1})
+    d = ev.as_dict()
+    c = ev.as_chrome(pid=7)
+    assert d["ts_us"] == c["ts"] == 10.0
+    assert d["dur_us"] == c["dur"] == 5.0
+    assert d["args"] == c["args"] == {"a": 1}

@@ -1,0 +1,216 @@
+"""Bench regression tracker: archive parsing (clean, crashed, and truncated
+records), direction-aware noise bands, device gating, and the
+``bench.py --check-regressions`` front door."""
+
+import json
+
+import pytest
+
+from torchmetrics_tpu.utilities.regression import (
+    BenchRun,
+    RegressionTracker,
+    band_for,
+    check_regressions,
+    direction_for,
+    flatten_numeric,
+    load_bench_history,
+    recover_numeric_pairs,
+)
+
+
+def _archive(tmp_path, n, parsed=None, rc=0, tail=""):
+    path = tmp_path / f"BENCH_r{n:02d}.json"
+    path.write_text(json.dumps({"n": n, "cmd": "python bench.py", "rc": rc,
+                                "tail": tail, "parsed": parsed}))
+    return path
+
+
+def _record(value, device="cpu", **detail):
+    detail.setdefault("device", device)
+    return {"metric": "overhead", "value": value, "unit": "%", "detail": detail}
+
+
+# ------------------------------------------------------------------- parsing
+def test_flatten_numeric_dotted_keys_and_bool_exclusion():
+    flat = flatten_numeric({"a": 1, "b": {"c": 2.5, "ok": True}, "d": [3, "x"]})
+    assert flat == {"a": 1.0, "b.c": 2.5, "d.0": 3.0}
+
+
+def test_recover_numeric_pairs_drops_ambiguous_keys():
+    tail = '"x": 1.5, "dup": 2, "y": -3e-2, "dup": 7'
+    pairs = recover_numeric_pairs(tail)
+    assert pairs == {"x": 1.5, "y": -0.03}
+
+
+def test_load_history_handles_all_archive_shapes(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.5, device="tpu"))
+    _archive(tmp_path, 2, rc=1, tail="Traceback (most recent call last): boom")
+    # truncated tail: starts mid-object, parsed is null (the BENCH_r05 shape)
+    _archive(tmp_path, 3, tail='0.3, "train_step_ms_median": 42.0, "device": "cpu"')
+    _archive(tmp_path, 4, tail="no numbers here at all")
+    runs = load_bench_history(str(tmp_path))
+    assert [r.n for r in runs] == [1, 3]
+    assert runs[0].device == "tpu" and not runs[0].partial
+    assert runs[1].device == "cpu" and runs[1].partial
+    assert runs[1].values["train_step_ms_median"] == 42.0
+
+
+def test_partial_run_lookup_matches_dotted_suffix():
+    run = BenchRun(n=1, rc=0, source="r", values={"train_step_ms_median": 42.0})
+    assert run.lookup("detail.train_step_ms_median") == 42.0
+    assert run.lookup("detail.absent") is None
+
+
+# ---------------------------------------------------------- directions & bands
+def test_direction_heuristics():
+    assert direction_for("detail.metric_subgraph_us_per_step") == "lower"
+    assert direction_for("detail.sync_bytes") == "lower"
+    assert direction_for("detail.overhead_pct_trimmed_mean") == "lower"
+    assert direction_for("detail.sync_time_cut_every_4") == "higher"
+    assert direction_for("detail.fused_speedup") == "higher"
+    assert direction_for("detail.num_classes") is None  # descriptive
+
+
+def test_band_classes():
+    assert band_for("detail.train_step_ms_median") >= 0.60  # wall clock: wide
+    assert band_for("detail.psum_state_bytes") == 0.01  # analytic: tight
+    assert band_for("detail.overhead_pct_trimmed_mean") == 0.30
+
+
+# ------------------------------------------------------------------ the gate
+def test_unchanged_run_passes(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.8, step_ms=50.0, psum_state_bytes=1024))
+    rep = check_regressions(
+        _record(0.8, step_ms=50.0, psum_state_bytes=1024), history_dir=str(tmp_path)
+    )
+    assert rep.verdict == "pass" and not rep.failures
+
+
+def test_analytic_regression_fails_tight(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.8, psum_state_bytes=1024))
+    rep = check_regressions(
+        _record(0.8, psum_state_bytes=1100), history_dir=str(tmp_path)
+    )
+    assert rep.verdict == "fail"
+    assert [c.key for c in rep.failures] == ["detail.psum_state_bytes"]
+
+
+def test_timing_noise_within_band_passes(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.8, step_ms=50.0))
+    rep = check_regressions(_record(0.8, step_ms=70.0), history_dir=str(tmp_path))
+    assert rep.verdict == "pass"  # +40% < the 60% wall-clock band
+
+
+def test_higher_better_gates_decreases(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.8, sync_time_cut_every_4=5.0))
+    bad = check_regressions(
+        _record(0.8, sync_time_cut_every_4=1.1), history_dir=str(tmp_path)
+    )
+    assert [c.key for c in bad.failures] == ["detail.sync_time_cut_every_4"]
+    ok = check_regressions(
+        _record(0.8, sync_time_cut_every_4=9.0), history_dir=str(tmp_path)
+    )
+    assert ok.verdict == "pass"
+
+
+def test_band_widens_to_historical_spread(tmp_path):
+    # history itself disagrees 4x on a wall-clock leg: a current value inside
+    # that measured spread must not fail
+    _archive(tmp_path, 1, parsed=_record(0.8, step_ms=200.0))
+    _archive(tmp_path, 2, parsed=_record(0.8, step_ms=50.0))
+    rep = check_regressions(_record(0.8, step_ms=190.0), history_dir=str(tmp_path))
+    assert rep.verdict == "pass"
+
+
+def test_negative_baseline_uses_additive_band(tmp_path):
+    # sign-flipping noise stats: baseline -0.05, current +0.2 is within any
+    # sane band and must not fail on a multiplicative-threshold inversion
+    _archive(tmp_path, 1, parsed=_record(0.8, overhead_pct_raw_mean=-0.05))
+    rep = check_regressions(
+        _record(0.8, overhead_pct_raw_mean=0.2), history_dir=str(tmp_path)
+    )
+    assert rep.verdict == "pass"
+
+
+def test_device_mismatch_never_cross_gates(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.1, device="tpu", step_ms=2.0))
+    rep = check_regressions(
+        _record(5.0, device="cpu", step_ms=900.0), history_dir=str(tmp_path)
+    )
+    assert rep.verdict == "no-baseline"
+    assert rep.skipped_device_mismatch > 0
+
+
+def test_no_history_is_no_baseline(tmp_path):
+    rep = check_regressions(_record(0.8), history_dir=str(tmp_path))
+    assert rep.verdict == "no-baseline" and rep.comparisons == []
+
+
+# ------------------------------------------------------------------- reporting
+def test_markdown_and_dict_shapes(tmp_path):
+    _archive(tmp_path, 1, parsed=_record(0.8, psum_state_bytes=1024, num_classes=5))
+    rep = check_regressions(
+        _record(0.8, psum_state_bytes=4096, num_classes=5), history_dir=str(tmp_path)
+    )
+    md = rep.to_markdown()
+    assert "## Bench regression check" in md
+    assert "**Verdict: FAIL**" in md
+    assert "`detail.psum_state_bytes`" in md and "fail" in md
+    d = rep.to_dict()
+    assert d["metric"] == "bench-regression-check"
+    assert d["verdict"] == "fail" and d["n_failures"] == 1
+    assert d["failures"][0]["key"] == "detail.psum_state_bytes"
+    json.dumps(d)  # machine-readable: must serialize
+
+
+# ------------------------------------------------------- bench.py front door
+def _load_bench_module():
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[3]
+    spec = importlib.util.spec_from_file_location("_bench_cli", root / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_check_regressions_cli(tmp_path, monkeypatch):
+    import sys
+
+    bench = _load_bench_module()
+    _archive(tmp_path, 1, parsed=_record(0.8, psum_state_bytes=1024))
+    current = tmp_path / "current.json"
+    current.write_text(json.dumps(_record(0.8, psum_state_bytes=1024)))
+    monkeypatch.setenv("BENCH_HISTORY_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--check-regressions", "--input", str(current)]
+    )
+    with pytest.raises(SystemExit) as exc:
+        bench.check_regressions_cli()
+    assert exc.value.code == 0
+
+    current.write_text(json.dumps(_record(0.8, psum_state_bytes=9999)))
+    with pytest.raises(SystemExit) as exc:
+        bench.check_regressions_cli()
+    assert exc.value.code == 3  # regression exit code, distinct from crash
+
+
+def test_bench_cli_emits_machine_readable_verdict(tmp_path, monkeypatch, capsys):
+    import sys
+
+    bench = _load_bench_module()
+    _archive(tmp_path, 1, parsed=_record(0.8, psum_state_bytes=1024))
+    current = tmp_path / "current.json"
+    current.write_text(json.dumps(_record(0.8, psum_state_bytes=1024)))
+    monkeypatch.setenv("BENCH_HISTORY_DIR", str(tmp_path))
+    monkeypatch.setattr(
+        sys, "argv", ["bench.py", "--check-regressions", "--input", str(current)]
+    )
+    with pytest.raises(SystemExit):
+        bench.check_regressions_cli()
+    out = capsys.readouterr()
+    verdict = json.loads(out.out.strip().splitlines()[-1])
+    assert verdict["metric"] == "bench-regression-check"
+    assert verdict["verdict"] == "pass"
+    assert "## Bench regression check" in out.err

@@ -61,8 +61,9 @@ import functools
 import logging
 import os
 import threading
+import time
 import weakref
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from copy import deepcopy
 from typing import Any, Callable, Dict, Hashable, List, Mapping, Optional, Tuple
 
@@ -73,8 +74,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 __all__ = [
     "CACHE_KINDS",
+    "CompileRecord",
+    "MISS_CAUSES",
     "add_cache_observer",
+    "add_compile_timing_observer",
     "remove_cache_observer",
+    "remove_compile_timing_observer",
     "shard_map",
     "abstract_signature",
     "audit_step_fn",
@@ -83,6 +88,11 @@ __all__ = [
     "cache_capacity",
     "cache_size",
     "cache_stats",
+    "compile_time_by_fingerprint",
+    "compile_timeline",
+    "explain_retrace",
+    "fingerprint_diff",
+    "measure_compile_phases",
     "set_cache_capacity",
     "clear_compile_cache",
     "compiled_cadence_step",
@@ -134,6 +144,105 @@ _LOCK = threading.RLock()
 _CACHE: "OrderedDict[Hashable, Callable]" = OrderedDict()
 _CACHE_CAPACITY = max(1, int(os.environ.get("TM_TPU_COMPILE_CACHE_SIZE", "512")))
 _STATS = {"hits": 0, "misses": 0, "traces": 0, "evictions": 0}
+
+#: every cache miss is attributed to exactly one cause:
+#: ``new-key`` — never-seen configuration/signature;
+#: ``eviction`` — the exact key lived here before and was LRU-evicted;
+#: ``invalidation`` — same entry point + input signature, different config
+#: fingerprint (an attribute mutation forced the retrace — see
+#: :func:`explain_retrace` for *which* attribute);
+#: ``donate-variant`` — same entry point + signature + fingerprint compiled
+#: under a different donation flag (aliased vs exclusive state).
+MISS_CAUSES = ("new-key", "eviction", "invalidation", "donate-variant")
+_MISS_CAUSE_COUNTS = {cause: 0 for cause in MISS_CAUSES}
+
+# Bounded lookup history backing the cause attribution.  ``_EVICTED`` is an
+# LRU *set* of keys that once lived in the cache; ``_FP_SEEN`` maps each
+# residual (key minus fingerprint/variant — "this entry point with these
+# inputs") to the fingerprints/variants it has compiled under, plus the most
+# recent fingerprint for invalidation diffs.
+_HISTORY_CAP = 4096
+_EVICTED: "OrderedDict[Hashable, None]" = OrderedDict()
+_FP_SEEN: "OrderedDict[Hashable, Dict[str, Any]]" = OrderedDict()
+_SEQ = 0
+
+# Recent fingerprint invalidations (old vs new), feeding explain_retrace().
+_INVALIDATIONS: "deque[Dict[str, Any]]" = deque(maxlen=256)
+
+
+class CompileRecord:
+    """One cold start: the first dispatch of a freshly built cache entry,
+    which pays trace + lower + XLA compile synchronously under ``jax.jit``."""
+
+    __slots__ = ("seq", "kind", "cause", "label", "fingerprint_hash", "cold_start_s", "owner_ref")
+
+    def __init__(
+        self,
+        seq: int,
+        kind: Optional[str],
+        cause: str,
+        label: str,
+        fingerprint_hash: Optional[str],
+        owner_ref: Optional["weakref.ref"],
+    ) -> None:
+        self.seq = seq
+        self.kind = kind
+        self.cause = cause
+        self.label = label
+        self.fingerprint_hash = fingerprint_hash
+        self.cold_start_s = 0.0
+        self.owner_ref = owner_ref
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "seq": self.seq,
+            "kind": self.kind,
+            "cause": self.cause,
+            "label": self.label,
+            "fingerprint_hash": self.fingerprint_hash,
+            "cold_start_s": self.cold_start_s,
+        }
+
+
+#: completed cold starts, oldest first (bounded); running totals live in
+#: ``_COLD_START_TOTALS`` so long jobs don't lose count to the ring
+_COMPILE_LOG: "deque[CompileRecord]" = deque(maxlen=512)
+_COLD_START_TOTALS = {"count": 0, "total_s": 0.0}
+
+# Compile-timing observers: ``fn(record)`` fires once per completed cold
+# start, outside _LOCK (flight recorder + telemetry registry subscribe).
+_COMPILE_OBSERVERS: List[Callable[[CompileRecord], None]] = []
+
+
+def add_compile_timing_observer(fn: Callable[[CompileRecord], None]) -> None:
+    """Subscribe ``fn(record)`` to completed cold starts (idempotent)."""
+    with _LOCK:
+        if fn not in _COMPILE_OBSERVERS:
+            _COMPILE_OBSERVERS.append(fn)
+
+
+def remove_compile_timing_observer(fn: Callable[[CompileRecord], None]) -> None:
+    with _LOCK:
+        if fn in _COMPILE_OBSERVERS:
+            _COMPILE_OBSERVERS.remove(fn)
+
+
+def _notify_compile(record: CompileRecord) -> None:
+    if not _COMPILE_OBSERVERS:
+        return
+    for fn in tuple(_COMPILE_OBSERVERS):
+        try:
+            fn(record)
+        except Exception:
+            _OBS_LOG.debug("compile-timing observer %r failed", fn, exc_info=True)
+
+
+def _fingerprint_hash(fingerprint: Any) -> Optional[str]:
+    if fingerprint is None:
+        return None
+    import hashlib
+
+    return hashlib.sha1(repr(fingerprint).encode()).hexdigest()[:12]
 
 #: entry-point kinds the per-entrypoint breakdown tracks (``cache_stats()
 #: ["by_entrypoint"]``); flat totals above stay the back-compat surface
@@ -221,11 +330,16 @@ def cache_stats() -> Dict[str, Any]:
     inside one cached callable) — the number ``bench.py``'s retrace legs
     watch.  ``by_entrypoint`` breaks hits/misses/traces down per entry-point
     kind (:data:`CACHE_KINDS`); the flat totals remain authoritative and
-    back-compatible.
+    back-compatible.  ``miss_causes`` attributes every miss to one of
+    :data:`MISS_CAUSES`, and ``cold_start`` sums the measured wall time of
+    first dispatches (trace + lower + XLA compile) — see
+    :func:`compile_timeline` for the per-entry records.
     """
     with _LOCK:
         out: Dict[str, Any] = dict(_STATS)
         out["by_entrypoint"] = {kind: dict(slot) for kind, slot in _KIND_STATS.items()}
+        out["miss_causes"] = dict(_MISS_CAUSE_COUNTS)
+        out["cold_start"] = dict(_COLD_START_TOTALS)
         return out
 
 
@@ -248,8 +362,8 @@ def set_cache_capacity(capacity: int) -> None:
     with _LOCK:
         _CACHE_CAPACITY = capacity
         while len(_CACHE) > _CACHE_CAPACITY:
-            _CACHE.popitem(last=False)
-            _STATS["evictions"] += 1
+            evicted_key, _ = _CACHE.popitem(last=False)
+            _note_eviction(evicted_key)
 
 
 def clear_compile_cache(reset_stats: bool = True) -> None:
@@ -263,10 +377,20 @@ def clear_compile_cache(reset_stats: bool = True) -> None:
     with _LOCK:
         _CACHE.clear()
         _ID_PINS.clear()
+        # an explicit clear is not an LRU eviction: wipe the cause history so
+        # re-misses after a clear attribute as new-key, not eviction
+        _EVICTED.clear()
+        _FP_SEEN.clear()
         if reset_stats:
             for k in _STATS:
                 _STATS[k] = 0
             _KIND_STATS = _fresh_kind_stats()
+            for cause in _MISS_CAUSE_COUNTS:
+                _MISS_CAUSE_COUNTS[cause] = 0
+            _INVALIDATIONS.clear()
+            _COMPILE_LOG.clear()
+            _COLD_START_TOTALS["count"] = 0
+            _COLD_START_TOTALS["total_s"] = 0.0
 
 
 def mark_trace(
@@ -288,12 +412,110 @@ def mark_trace(
     _notify("trace", kind, owner_ref() if owner_ref is not None else None)
 
 
+def _note_eviction(key: Hashable) -> None:
+    """Caller holds ``_LOCK``: remember an LRU-evicted key (bounded)."""
+    _STATS["evictions"] += 1
+    _EVICTED[key] = None
+    _EVICTED.move_to_end(key)
+    while len(_EVICTED) > _HISTORY_CAP:
+        _EVICTED.popitem(last=False)
+
+
+def _classify_miss(
+    key: Hashable,
+    residual: Optional[Hashable],
+    fingerprint: Optional[Hashable],
+    variant: Optional[Hashable],
+) -> Tuple[str, Optional[Hashable]]:
+    """Caller holds ``_LOCK``: name this miss's cause and, for an
+    invalidation, return the fingerprint it displaced."""
+    if key in _EVICTED:
+        return "eviction", None
+    if residual is None or fingerprint is None:
+        return "new-key", None
+    hist = _FP_SEEN.get(residual)
+    if hist is None:
+        return "new-key", None
+    variants = hist["fps"].get(fingerprint)
+    if variants is not None:
+        if variant not in variants:
+            return "donate-variant", None
+        # exact (residual, fingerprint, variant) combo compiled before but the
+        # key is gone and past the evicted-set horizon: still an eviction
+        return "eviction", None
+    return "invalidation", hist["last"]
+
+
+def _remember_key(
+    key: Hashable,
+    residual: Optional[Hashable],
+    fingerprint: Optional[Hashable],
+    variant: Optional[Hashable],
+) -> None:
+    """Caller holds ``_LOCK``: record this lookup in the cause history."""
+    _EVICTED.pop(key, None)  # key is live again
+    if residual is None or fingerprint is None:
+        return
+    hist = _FP_SEEN.get(residual)
+    if hist is None:
+        hist = _FP_SEEN[residual] = {"last": fingerprint, "fps": {}}
+        while len(_FP_SEEN) > _HISTORY_CAP:
+            _FP_SEEN.popitem(last=False)
+    else:
+        _FP_SEEN.move_to_end(residual)
+        hist["last"] = fingerprint
+    fps = hist["fps"]
+    fps.setdefault(fingerprint, set()).add(variant)
+    while len(fps) > 64:  # bound per-residual fingerprint churn
+        fps.pop(next(iter(fps)))
+
+
+def _owner_label(owner: Any, kind: Optional[str]) -> str:
+    if owner is not None:
+        return type(owner).__name__
+    return kind or "unattributed"
+
+
+def _timed_cold_start(key: Hashable, fn: Callable, record: CompileRecord) -> Callable:
+    """Wrap a freshly built entry so its FIRST dispatch — the call that pays
+    trace + lower + XLA compile synchronously — is wall-timed.
+
+    After the measurement the wrapper swaps the raw callable back into the
+    cache slot, so steady-state lookups pay zero wrapper overhead; only a
+    caller that held on to the wrapper itself keeps one list-check per call.
+    """
+    done: List[bool] = []
+
+    def first_call(*args: Any, **kwargs: Any) -> Any:
+        if done:
+            return fn(*args, **kwargs)
+        done.append(True)
+        t0 = time.perf_counter()  # tmt: ignore[TMT006] -- cold-start wall time at the dispatch host boundary; never traced
+        out = fn(*args, **kwargs)
+        record.cold_start_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- cold-start wall time at the dispatch host boundary; never traced
+        with _LOCK:
+            _COMPILE_LOG.append(record)
+            _COLD_START_TOTALS["count"] += 1
+            _COLD_START_TOTALS["total_s"] += record.cold_start_s
+            if _CACHE.get(key) is first_call:
+                _CACHE[key] = fn
+        _notify_compile(record)
+        return out
+
+    return first_call
+
+
 def _lookup(
     key: Hashable,
     build: Callable[[], Callable],
     kind: Optional[str] = None,
     owner: Any = None,
+    fingerprint: Optional[Hashable] = None,
+    residual: Optional[Hashable] = None,
+    variant: Optional[Hashable] = None,
 ) -> Callable:
+    global _SEQ
+    record: Optional[CompileRecord] = None
     with _LOCK:
         fn = _CACHE.get(key)
         hit = fn is not None
@@ -306,17 +528,206 @@ def _lookup(
             _STATS["misses"] += 1
             if kind is not None:
                 _KIND_STATS[kind]["misses"] += 1
+            cause, old_fp = _classify_miss(key, residual, fingerprint, variant)
+            _MISS_CAUSE_COUNTS[cause] += 1
+            _SEQ += 1
+            label = _owner_label(owner, kind)
+            if cause == "invalidation":
+                _INVALIDATIONS.append(
+                    {
+                        "seq": _SEQ,
+                        "kind": kind,
+                        "label": label,
+                        "old_fp": old_fp,
+                        "new_fp": fingerprint,
+                    }
+                )
+            _remember_key(key, residual, fingerprint, variant)
+            try:
+                owner_ref = weakref.ref(owner) if owner is not None else None
+            except TypeError:  # non-weakrefable owner
+                owner_ref = None
+            record = CompileRecord(
+                _SEQ, kind, cause, label, _fingerprint_hash(fingerprint), owner_ref
+            )
     _notify("hit" if hit else "miss", kind, owner)
     if hit:
         return fn
     fn = build()  # build outside the lock: tracing can be slow
+    fn = _timed_cold_start(key, fn, record)
     with _LOCK:
         fn = _CACHE.setdefault(key, fn)
         _CACHE.move_to_end(key)
         while len(_CACHE) > _CACHE_CAPACITY:
-            _CACHE.popitem(last=False)
-            _STATS["evictions"] += 1
+            evicted_key, _ = _CACHE.popitem(last=False)
+            _note_eviction(evicted_key)
         return fn
+
+
+# ------------------------------------------------- compile-time observability
+def compile_timeline() -> List[Dict[str, Any]]:
+    """The recent cold starts, oldest first: one dict per first dispatch with
+    ``kind``, ``cause`` (:data:`MISS_CAUSES`), owner ``label``,
+    ``fingerprint_hash`` and measured ``cold_start_s`` (trace + lower + XLA
+    compile paid synchronously by that dispatch).  Bounded to the last 512."""
+    with _LOCK:
+        return [r.as_dict() for r in _COMPILE_LOG]
+
+
+def compile_time_by_fingerprint() -> Dict[str, Dict[str, Any]]:
+    """Cold-start wall time aggregated per config fingerprint (hash)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rec in compile_timeline():
+        key = rec["fingerprint_hash"] or f"({rec['kind'] or 'unkeyed'})"
+        slot = out.setdefault(
+            key, {"label": rec["label"], "kinds": [], "count": 0, "total_s": 0.0}
+        )
+        if rec["kind"] and rec["kind"] not in slot["kinds"]:
+            slot["kinds"].append(rec["kind"])
+        slot["count"] += 1
+        slot["total_s"] += float(rec["cold_start_s"])
+    return out
+
+
+def _flatten_fp(fp: Any, prefix: str = "") -> Optional[Dict[str, Any]]:
+    """Config fingerprint -> flat ``{attr: frozen_value}`` map (dotted names
+    for collection-style fingerprints), or ``None`` if unrecognised."""
+    if (
+        isinstance(fp, tuple)
+        and len(fp) == 3
+        and isinstance(fp[0], str)
+        and isinstance(fp[1], str)
+        and isinstance(fp[2], tuple)
+    ):
+        out = {f"{prefix}__class__": f"{fp[0]}.{fp[1]}"}
+        for item in fp[2]:
+            if isinstance(item, tuple) and len(item) == 2 and isinstance(item[0], str):
+                out[f"{prefix}{item[0]}"] = item[1]
+            else:
+                return None
+        return out
+    if isinstance(fp, tuple) and fp and all(
+        isinstance(p, tuple) and len(p) == 2 and isinstance(p[0], str) for p in fp
+    ):
+        out = {}
+        for name, member_fp in fp:
+            sub = _flatten_fp(member_fp, prefix=f"{prefix}{name}.")
+            if sub is None:
+                return None
+            out.update(sub)
+        return out
+    return None
+
+
+def fingerprint_diff(old_fp: Any, new_fp: Any) -> Dict[str, Any]:
+    """Name the attributes that differ between two config fingerprints.
+
+    Returns ``{"changed": [{"attr", "old", "new"}], "added": [...],
+    "removed": [...], "opaque": bool}`` — ``opaque`` is True when either
+    fingerprint has a shape this differ doesn't understand."""
+    old_map = _flatten_fp(old_fp)
+    new_map = _flatten_fp(new_fp)
+    if old_map is None or new_map is None:
+        return {"changed": [], "added": [], "removed": [], "opaque": True}
+    changed = [
+        {"attr": k, "old": repr(old_map[k]), "new": repr(new_map[k])}
+        for k in sorted(set(old_map) & set(new_map))
+        if old_map[k] != new_map[k]
+    ]
+    return {
+        "changed": changed,
+        "added": sorted(set(new_map) - set(old_map)),
+        "removed": sorted(set(old_map) - set(new_map)),
+        "opaque": False,
+    }
+
+
+def explain_retrace(metric: Any = None) -> Optional[Dict[str, Any]]:
+    """Why did the last fingerprint invalidation retrace?
+
+    Finds the most recent ``invalidation`` miss (optionally restricted to
+    ``metric``'s class) and diffs the displaced fingerprint against the one
+    that replaced it, naming the mutated attribute(s)::
+
+        acc(preds, target)          # compiles
+        acc.threshold = 0.9         # mutation
+        acc(preds, target)          # invalidation miss + retrace
+        explain_retrace(acc)
+        # {'label': 'BinaryAccuracy', 'changed': [{'attr': 'threshold',
+        #   'old': '0.5', 'new': '0.9'}], ..., 'summary': '...'}
+
+    Returns ``None`` when no matching invalidation has been observed."""
+    with _LOCK:
+        records = list(_INVALIDATIONS)
+    if metric is not None:
+        label = type(metric).__name__
+        records = [r for r in records if r["label"] == label]
+    if not records:
+        return None
+    rec = records[-1]
+    diff = fingerprint_diff(rec["old_fp"], rec["new_fp"])
+    if diff["opaque"]:
+        summary = "config fingerprint changed (opaque fingerprint shapes)"
+    elif diff["changed"]:
+        summary = "; ".join(
+            f"{c['attr']}: {c['old']} -> {c['new']}" for c in diff["changed"]
+        )
+    elif diff["added"] or diff["removed"]:
+        parts = []
+        if diff["added"]:
+            parts.append("added " + ", ".join(diff["added"]))
+        if diff["removed"]:
+            parts.append("removed " + ", ".join(diff["removed"]))
+        summary = "; ".join(parts)
+    else:
+        summary = "fingerprints differ only in unhashed detail"
+    return {
+        "seq": rec["seq"],
+        "kind": rec["kind"],
+        "label": rec["label"],
+        "changed": diff["changed"],
+        "added": diff["added"],
+        "removed": diff["removed"],
+        "opaque": diff["opaque"],
+        "summary": f"{rec['label']} retraced ({rec['kind']}): {summary}",
+    }
+
+
+def measure_compile_phases(
+    metric: Any,
+    *args: Any,
+    entrypoint: str = "update",
+    **kwargs: Any,
+) -> Dict[str, float]:
+    """Explicit trace / lower / compile wall-time split for one entry point.
+
+    A diagnostic, NOT a hot-path helper: it builds the same frozen-clone step
+    body the cache would (via :func:`audit_step_fn`, so no ``mark_trace`` and
+    no cache entry) and walks jax's AOT pipeline on it, timing each phase.
+    Use it to answer "where does my cold start go?" without perturbing the
+    cache, its counters, or any zero-retrace proof.
+    """
+    step = audit_step_fn(metric, entrypoint)
+    state = metric.init_state()
+    call_args = (state,) + args if entrypoint != "compute" else (state,)
+    jitted = jax.jit(step)
+    t0 = time.perf_counter()  # tmt: ignore[TMT006] -- AOT phase diagnostic; explicit off-path measurement
+    try:
+        traced = jitted.trace(*call_args, **kwargs)
+        t1 = time.perf_counter()  # tmt: ignore[TMT006] -- AOT phase diagnostic; explicit off-path measurement
+        lowered = traced.lower()
+    except AttributeError:  # older jax: no .trace(); lower() folds both phases
+        t1 = t0
+        lowered = jitted.lower(*call_args, **kwargs)
+    t2 = time.perf_counter()  # tmt: ignore[TMT006] -- AOT phase diagnostic; explicit off-path measurement
+    lowered.compile()
+    t3 = time.perf_counter()  # tmt: ignore[TMT006] -- AOT phase diagnostic; explicit off-path measurement
+    return {
+        "trace_s": t1 - t0,
+        "lower_s": t2 - t1,
+        "compile_s": t3 - t2,
+        "total_s": t3 - t0,
+    }
 
 
 # ------------------------------------------------------------- fingerprints
@@ -519,13 +930,10 @@ def compiled_update(
     state — ``Metric._state_shared``) pass ``donate=False``: donating an
     aliased state would delete buffers other metrics still read.
     """
-    key = (
-        "update",
-        metric._config_fingerprint(),
-        abstract_signature((args, dict(kwargs))),
-        _backend(),
-        donate,
-    )
+    fp = metric._config_fingerprint()
+    sig = abstract_signature((args, dict(kwargs)))
+    backend = _backend()
+    key = ("update", fp, sig, backend, donate)
 
     owner_ref = weakref.ref(metric)
     scope = f"tm_tpu/{type(metric).__name__}/update"
@@ -540,7 +948,15 @@ def compiled_update(
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    return _lookup(key, build, kind="update", owner=metric)
+    return _lookup(
+        key,
+        build,
+        kind="update",
+        owner=metric,
+        fingerprint=fp,
+        residual=("update", sig, backend),
+        variant=donate,
+    )
 
 
 def compiled_forward(
@@ -558,13 +974,10 @@ def compiled_forward(
     ``donate=False`` for states that may be aliased (see
     :func:`compiled_update`).
     """
-    key = (
-        "forward",
-        metric._config_fingerprint(),
-        abstract_signature((args, dict(kwargs))),
-        _backend(),
-        donate,
-    )
+    fp = metric._config_fingerprint()
+    sig = abstract_signature((args, dict(kwargs)))
+    backend = _backend()
+    key = ("forward", fp, sig, backend, donate)
 
     owner_ref = weakref.ref(metric)
     scope = f"tm_tpu/{type(metric).__name__}/forward"
@@ -585,7 +998,15 @@ def compiled_forward(
 
         return jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    return _lookup(key, build, kind="forward", owner=metric)
+    return _lookup(
+        key,
+        build,
+        kind="forward",
+        owner=metric,
+        fingerprint=fp,
+        residual=("forward", sig, backend),
+        variant=donate,
+    )
 
 
 def compiled_sharded_update(
@@ -601,14 +1022,9 @@ def compiled_sharded_update(
     after the first call misses the cache and re-traces with the new config
     (the round-5 stale-trace fix).
     """
-    key = (
-        "sharded_update",
-        metric._config_fingerprint(),
-        mesh,
-        axis_name,
-        specs,
-        abstract_signature(args),
-    )
+    fp = metric._config_fingerprint()
+    sig = abstract_signature(args)
+    key = ("sharded_update", fp, mesh, axis_name, specs, sig)
 
     owner_ref = weakref.ref(metric)
     scope = f"tm_tpu/{type(metric).__name__}/sharded_update"
@@ -629,7 +1045,14 @@ def compiled_sharded_update(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
         )
 
-    return _lookup(key, build, kind="sharded", owner=metric)
+    return _lookup(
+        key,
+        build,
+        kind="sharded",
+        owner=metric,
+        fingerprint=fp,
+        residual=("sharded_update", mesh, axis_name, specs, sig),
+    )
 
 
 def compiled_ragged_gather(
@@ -748,12 +1171,10 @@ def compiled_collection_update(
     format canonicalization) is computed once and CSE'd across the group —
     instead of N separate dispatches each redoing it.
     """
-    key = (
-        "collection_update",
-        tuple((name, collection[name]._config_fingerprint()) for name in leader_names),
-        abstract_signature((args, dict(kwargs))),
-        _backend(),
-    )
+    fp = tuple((name, collection[name]._config_fingerprint()) for name in leader_names)
+    sig = abstract_signature((args, dict(kwargs)))
+    backend = _backend()
+    key = ("collection_update", fp, sig, backend)
 
     owner_ref = weakref.ref(collection)
 
@@ -772,7 +1193,14 @@ def compiled_collection_update(
 
         return jax.jit(fused, donate_argnums=(0,))
 
-    return _lookup(key, build, kind="collection", owner=collection)
+    return _lookup(
+        key,
+        build,
+        kind="collection",
+        owner=collection,
+        fingerprint=fp,
+        residual=("collection_update", sig, backend),
+    )
 
 
 def compiled_sharded_collection_update(
@@ -793,14 +1221,9 @@ def compiled_sharded_collection_update(
     whole collection syncs in as few collectives as it has distinct
     (dtype, reduction-class) pairs instead of one per leaf per metric.
     """
-    key = (
-        "sharded_collection_update",
-        tuple((name, collection[name]._config_fingerprint()) for name in leader_names),
-        mesh,
-        axis_name,
-        specs,
-        abstract_signature(args),
-    )
+    fp = tuple((name, collection[name]._config_fingerprint()) for name in leader_names)
+    sig = abstract_signature(args)
+    key = ("sharded_collection_update", fp, mesh, axis_name, specs, sig)
 
     owner_ref = weakref.ref(collection)
 
@@ -828,7 +1251,14 @@ def compiled_sharded_collection_update(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=out_specs, check_vma=False)
         )
 
-    return _lookup(key, build, kind="sharded_collection", owner=collection)
+    return _lookup(
+        key,
+        build,
+        kind="sharded_collection",
+        owner=collection,
+        fingerprint=fp,
+        residual=("sharded_collection_update", mesh, axis_name, specs, sig),
+    )
 
 
 def compiled_cadence_step(
@@ -855,14 +1285,9 @@ def compiled_cadence_step(
         specs = in_specs
     else:
         specs = tuple(in_specs for _ in args)
-    key = (
-        "cadence_step",
-        tuple((name, m._config_fingerprint()) for name, m in named_metrics),
-        mesh,
-        axis_name,
-        specs,
-        abstract_signature(args),
-    )
+    fp = tuple((name, m._config_fingerprint()) for name, m in named_metrics)
+    sig = abstract_signature(args)
+    key = ("cadence_step", fp, mesh, axis_name, specs, sig)
 
     owner_ref = weakref.ref(owner)
 
@@ -890,7 +1315,14 @@ def compiled_cadence_step(
             donate_argnums=(0,),
         )
 
-    return _lookup(key, build, kind="cadence", owner=owner)
+    return _lookup(
+        key,
+        build,
+        kind="cadence",
+        owner=owner,
+        fingerprint=fp,
+        residual=("cadence_step", mesh, axis_name, specs, sig),
+    )
 
 
 def compiled_cadence_sync(
@@ -906,12 +1338,8 @@ def compiled_cadence_sync(
     coalesced bucket plan (``coalesced_metric_sync``), exactly the sync the
     per-step path would have run — just ``k`` steps later.
     """
-    key = (
-        "cadence_sync",
-        tuple((name, m._config_fingerprint()) for name, m in named_metrics),
-        mesh,
-        axis_name,
-    )
+    fp = tuple((name, m._config_fingerprint()) for name, m in named_metrics)
+    key = ("cadence_sync", fp, mesh, axis_name)
 
     owner_ref = weakref.ref(owner)
 
@@ -932,4 +1360,11 @@ def compiled_cadence_sync(
             shard_map(syncf, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
         )
 
-    return _lookup(key, build, kind="cadence", owner=owner)
+    return _lookup(
+        key,
+        build,
+        kind="cadence",
+        owner=owner,
+        fingerprint=fp,
+        residual=("cadence_sync", mesh, axis_name),
+    )

@@ -28,13 +28,19 @@ recording helpers return after one flag check, and nothing here touches
 cache keys — so telemetry can never cause a retrace.
 """
 
+from torchmetrics_tpu.observability import tracing
 from torchmetrics_tpu.observability.export import (
+    ChromeTraceExporter,
     Exporter,
     JSONLinesExporter,
     LoggingExporter,
     PrometheusExporter,
+    SCHEMA_VERSION,
+    TraceJSONLinesExporter,
     export,
+    parse_export_line,
 )
+from torchmetrics_tpu.observability.tracing import FlightRecorder, TraceEvent
 from torchmetrics_tpu.observability.registry import (
     COUNTER_NAMES,
     MetricTelemetry,
@@ -53,13 +59,18 @@ from torchmetrics_tpu.observability.registry import (
 
 __all__ = [
     "COUNTER_NAMES",
+    "ChromeTraceExporter",
     "Exporter",
+    "FlightRecorder",
     "JSONLinesExporter",
     "LoggingExporter",
     "MetricTelemetry",
     "ObservationWindow",
     "PrometheusExporter",
+    "SCHEMA_VERSION",
     "SPAN_BUCKETS_US",
+    "TraceEvent",
+    "TraceJSONLinesExporter",
     "aggregate_telemetry",
     "diff_report",
     "disable",
@@ -67,9 +78,11 @@ __all__ = [
     "enabled",
     "export",
     "observe",
+    "parse_export_line",
     "report",
     "reset_telemetry",
     "telemetry_for",
+    "tracing",
 ]
 
 # honour TM_TPU_TELEMETRY=1: registry seeds the flag at import; finish the

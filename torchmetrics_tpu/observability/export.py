@@ -10,26 +10,71 @@ Three backends behind one front door (:func:`export`):
 * ``"prometheus"`` — text exposition format (``# HELP``/``# TYPE``, counter
   ``_total`` samples, cumulative histogram ``_bucket{le=...}`` series) ready
   for a node-exporter textfile collector or an HTTP scrape handler.
+* ``"chrome"`` — the flight recorder's ring (``observability/tracing.py``) as
+  Chrome trace-event JSON, loadable in Perfetto / ``chrome://tracing``.
 
 Exporters are plain classes with an ``export(report) -> Any`` method; anything
 with that shape can be passed to :func:`export` via ``exporter=``.
+
+Machine-readable outputs (JSONL lines and the Chrome trace's ``otherData``)
+carry a ``schema_version`` (semver).  Consumers should parse JSONL through
+:func:`parse_export_line`, which rejects lines whose *major* version it does
+not understand — the forward-compat contract is: minor/patch bumps are
+additive, a major bump may break you.
 """
 
 from __future__ import annotations
 
 import json
 import logging
-from typing import Any, IO, List, Mapping, Optional
+from typing import Any, Dict, IO, List, Mapping, Optional
 
 from torchmetrics_tpu.observability.registry import COUNTER_NAMES
 
 __all__ = [
+    "ChromeTraceExporter",
     "Exporter",
     "JSONLinesExporter",
     "LoggingExporter",
     "PrometheusExporter",
+    "SCHEMA_VERSION",
+    "TraceJSONLinesExporter",
     "export",
+    "parse_export_line",
 ]
+
+#: Semver of the machine-readable export payloads (JSONL lines, Chrome-trace
+#: ``otherData``).  Major 1 = the PR 3 report layout; 1.1 added the
+#: ``schema_version`` field itself and the flight-recorder trace export.
+SCHEMA_VERSION = "1.1.0"
+SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
+
+
+def parse_export_line(line: str) -> Dict[str, Any]:
+    """Parse one :class:`JSONLinesExporter` line back into a dict, enforcing
+    the schema-version contract.
+
+    Lines without a ``schema_version`` (pre-1.1 exports) are accepted as
+    legacy major 1.  A present-but-unparseable version, or a major version
+    other than ``SCHEMA_MAJOR``, raises ``ValueError`` — a consumer must not
+    silently misread a payload whose layout it cannot know.
+    """
+    payload = json.loads(line)
+    if not isinstance(payload, dict):
+        raise ValueError(f"telemetry export line is not a JSON object: {type(payload).__name__}")
+    version = payload.get("schema_version")
+    if version is None:
+        return payload  # legacy pre-1.1 line: implied major 1
+    try:
+        major = int(str(version).split(".", 1)[0])
+    except ValueError:
+        raise ValueError(f"unparseable telemetry schema_version {version!r}") from None
+    if major != SCHEMA_MAJOR:
+        raise ValueError(
+            f"unsupported telemetry schema_version {version!r}: this reader understands "
+            f"major {SCHEMA_MAJOR} only"
+        )
+    return payload
 
 _log = logging.getLogger("torchmetrics_tpu.observability")
 
@@ -41,6 +86,7 @@ _COUNTER_HELP = {
     "resets": "Metric.reset() calls.",
     "syncs": "Cross-device/host state synchronisations.",
     "sync_bytes": "Modelled per-chip sync traffic in bytes.",
+    "collectives": "Fused (bucketed) collective launches.",
     "donated_installs": "Compiled state installs with buffer donation.",
     "copied_installs": "Compiled state installs without donation (aliased state).",
     "nonfinite_events": "Non-finite update batches observed by nan_strategy guards.",
@@ -100,7 +146,9 @@ class JSONLinesExporter(Exporter):
         self.stream = stream
 
     def export(self, report: Mapping[str, Any]) -> str:
-        line = json.dumps(report, sort_keys=True, separators=(",", ":"), default=str)
+        payload = dict(report)
+        payload.setdefault("schema_version", SCHEMA_VERSION)
+        line = json.dumps(payload, sort_keys=True, separators=(",", ":"), default=str)
         if self.stream is not None:
             self.stream.write(line + "\n")
             try:
@@ -111,6 +159,41 @@ class JSONLinesExporter(Exporter):
             with open(self.path, "a", encoding="utf-8") as fh:
                 fh.write(line + "\n")
         return line
+
+
+class TraceJSONLinesExporter(Exporter):
+    """Append the flight recorder's ring as JSON lines — one event per line,
+    oldest first, each line independently parseable through
+    :func:`parse_export_line` (every line carries the ``schema_version``).
+
+    Like :class:`ChromeTraceExporter` this reads from
+    ``observability/tracing.py`` rather than the ``report`` argument; with
+    neither ``path`` nor ``stream`` the lines are returned as one string.
+    """
+
+    def __init__(self, path: Optional[str] = None, stream: Optional[IO[str]] = None):
+        self.path = path
+        self.stream = stream
+
+    def export(self, report: Mapping[str, Any]) -> str:
+        from torchmetrics_tpu.observability import tracing
+
+        lines = []
+        for ev in tracing.events():
+            payload = ev.as_dict()
+            payload["schema_version"] = SCHEMA_VERSION
+            lines.append(json.dumps(payload, sort_keys=True, separators=(",", ":")))
+        text = "\n".join(lines) + ("\n" if lines else "")
+        if self.stream is not None:
+            self.stream.write(text)
+            try:
+                self.stream.flush()
+            except Exception:  # pragma: no cover
+                pass
+        elif self.path is not None:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(text)
+        return text
 
 
 def _prom_escape(value: str) -> str:
@@ -180,6 +263,44 @@ class PrometheusExporter(Exporter):
                     f"{span_name}_count{_labels(metric=label, span=sname)} {int(s.get('count', 0))}"
                 )
 
+        bsync_name = f"{ns}_sync_bucket_measured_seconds_total"
+        out.append(
+            f"# HELP {bsync_name} Measured (block-until-ready) sync wall time attributed per "
+            "collective bucket."
+        )
+        out.append(f"# TYPE {bsync_name} counter")
+        for label, row in sorted(rows.items()):
+            for key, b in sorted(row.get("sync_buckets", {}).items()):
+                out.append(
+                    f"{bsync_name}{_labels(metric=label, bucket=key)} "
+                    f"{repr(float(b.get('measured_us', 0.0)) / 1e6)}"
+                )
+        bbytes_name = f"{ns}_sync_bucket_model_bytes_total"
+        out.append(
+            f"# HELP {bbytes_name} Modelled per-chip bucket traffic: naive 2(n-1)/n vs "
+            "granule-aware ring model."
+        )
+        out.append(f"# TYPE {bbytes_name} counter")
+        for label, row in sorted(rows.items()):
+            for key, b in sorted(row.get("sync_buckets", {}).items()):
+                for model, field in (("naive", "model_naive_bytes"), ("ring", "model_ring_bytes")):
+                    out.append(
+                        f"{bbytes_name}{_labels(metric=label, bucket=key, model=model)} "
+                        f"{int(b.get(field, 0))}"
+                    )
+        bres_name = f"{ns}_sync_bucket_residual_bytes"
+        out.append(
+            f"# HELP {bres_name} Ring-model minus naive-model bucket bytes (the granule floor "
+            "the naive model misses)."
+        )
+        out.append(f"# TYPE {bres_name} gauge")
+        for label, row in sorted(rows.items()):
+            for key, b in sorted(row.get("sync_buckets", {}).items()):
+                out.append(
+                    f"{bres_name}{_labels(metric=label, bucket=key)} "
+                    f"{int(b.get('residual_bytes', 0))}"
+                )
+
         cc = report.get("compile_cache", {})
         flat_name = f"{ns}_compile_cache_total"
         out.append(f"# HELP {flat_name} Global compile-cache counters.")
@@ -203,6 +324,35 @@ class PrometheusExporter(Exporter):
         return text
 
 
+class ChromeTraceExporter(Exporter):
+    """Export the flight recorder's ring as Chrome trace-event JSON.
+
+    Unlike the other backends this reads from ``observability/tracing.py``
+    (the recorder must be armed to have captured anything); the ``report``
+    argument only contributes its global counters to the trace's
+    ``otherData`` so a trace file is self-describing.  ``export`` returns the
+    JSON text and, with ``path=``, also writes it to disk — the file loads
+    directly in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+
+    def export(self, report: Mapping[str, Any]) -> str:
+        from torchmetrics_tpu.observability import tracing
+
+        meta: Dict[str, Any] = {}
+        glob = report.get("global", {}) if isinstance(report, Mapping) else {}
+        counters = {k: v for k, v in glob.get("counters", {}).items() if v}
+        if counters:
+            meta["report_counters"] = counters
+        text = json.dumps(tracing.chrome_trace(meta or None), separators=(",", ":"))
+        if self.path is not None:
+            with open(self.path, "w", encoding="utf-8") as fh:
+                fh.write(text)
+        return text
+
+
 _FMT_ALIASES = {
     "log": LoggingExporter,
     "logging": LoggingExporter,
@@ -210,6 +360,11 @@ _FMT_ALIASES = {
     "json": JSONLinesExporter,
     "prometheus": PrometheusExporter,
     "prom": PrometheusExporter,
+    "chrome": ChromeTraceExporter,
+    "chrome-trace": ChromeTraceExporter,
+    "perfetto": ChromeTraceExporter,
+    "trace": ChromeTraceExporter,
+    "trace-jsonl": TraceJSONLinesExporter,
 }
 
 
@@ -222,7 +377,7 @@ def export(
     """Export a telemetry report through one of the built-in backends.
 
     ``report`` defaults to a fresh :func:`registry.report` snapshot.  Either
-    name a backend (``fmt`` in ``log | jsonl | prometheus``, with ``kwargs``
+    name a backend (``fmt`` in ``log | jsonl | prometheus | chrome``, with ``kwargs``
     forwarded to its constructor) or pass a ready ``exporter`` instance.
     Returns whatever the backend's ``export`` returns (the JSON line, the
     exposition text, or ``None`` for logging).

@@ -52,9 +52,11 @@ __all__ = [
     "enable",
     "enabled",
     "observe",
+    "record_measured_sync",
     "record_sync",
     "report",
     "reset_telemetry",
+    "set_trace_sinks",
     "span",
     "telemetry_for",
 ]
@@ -102,6 +104,27 @@ _BUCKET_EDGES_S = tuple(us / 1e6 for us in SPAN_BUCKETS_US)
 EMA_ALPHA = 0.1
 
 _ENABLED = os.environ.get("TM_TPU_TELEMETRY", "").strip().lower() in ("1", "true", "on", "yes")
+
+# Flight-recorder sinks (observability/tracing.py).  ``None`` while the
+# recorder is disarmed, so the per-event cost of an idle recorder is one
+# ``is None`` check *after* the ``_ENABLED`` gate already passed.
+_SPAN_SINK: Optional[Callable[[str, str, float], None]] = None
+_COUNT_SINK: Optional[Callable[[str, str, int], None]] = None
+
+
+def set_trace_sinks(
+    span_sink: Optional[Callable[[str, str, float], None]],
+    count_sink: Optional[Callable[[str, str, int], None]],
+) -> None:
+    """Install (or clear, with ``None``) the flight-recorder event sinks.
+
+    ``span_sink(label, span_name, seconds)`` fires at every span exit;
+    ``count_sink(label, counter_name, n)`` at every counter bump.  Both run
+    outside ``_LOCK`` and only while telemetry is enabled."""
+    global _SPAN_SINK, _COUNT_SINK
+    with _LOCK:
+        _SPAN_SINK = span_sink
+        _COUNT_SINK = count_sink
 
 
 class SpanStats:
@@ -158,7 +181,7 @@ class MetricTelemetry:
     """Counters, per-entrypoint cache stats, and timing spans for one metric
     instance (or one synthetic aggregate like ``_retired``)."""
 
-    __slots__ = ("label", "cls", "counters", "cache", "spans")
+    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets")
 
     def __init__(self, label: str, cls: str) -> None:
         self.label = label
@@ -166,6 +189,10 @@ class MetricTelemetry:
         self.counters: Dict[str, int] = {name: 0 for name in COUNTER_NAMES}
         self.cache: Dict[str, Dict[str, int]] = {}
         self.spans: Dict[str, SpanStats] = {}
+        #: per-bucket measured-vs-model sync cost, keyed ``"dtype/op"`` (ring
+        #: buckets) or ``"gather/dtype"`` (passthrough leaves); filled by
+        #: :func:`record_measured_sync`
+        self.sync_buckets: Dict[str, Dict[str, float]] = {}
 
     # -- mutation (callers hold _LOCK) -------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -183,6 +210,29 @@ class MetricTelemetry:
             stats = self.spans[name] = SpanStats()
         stats.record(seconds)
 
+    def record_bucket(
+        self,
+        key: str,
+        elements: int,
+        measured_s: float,
+        naive_bytes: int,
+        ring_bytes: int,
+    ) -> None:
+        row = self.sync_buckets.get(key)
+        if row is None:
+            row = self.sync_buckets[key] = {
+                "syncs": 0,
+                "elements": 0,
+                "measured_us": 0.0,
+                "model_naive_bytes": 0,
+                "model_ring_bytes": 0,
+            }
+        row["syncs"] += 1
+        row["elements"] += int(elements)
+        row["measured_us"] += measured_s * 1e6
+        row["model_naive_bytes"] += int(naive_bytes)
+        row["model_ring_bytes"] += int(ring_bytes)
+
     def absorb(self, other: "MetricTelemetry") -> None:
         for name, n in other.counters.items():
             self.counters[name] = self.counters.get(name, 0) + n
@@ -192,11 +242,16 @@ class MetricTelemetry:
                 mine[field] = mine.get(field, 0) + n
         for name, stats in other.spans.items():
             self.spans.setdefault(name, SpanStats()).absorb(stats)
+        for key, row in other.sync_buckets.items():
+            mine = self.sync_buckets.setdefault(key, {k: 0 for k in row})
+            for field, n in row.items():
+                mine[field] = mine.get(field, 0) + n
 
     def clear(self) -> None:
         self.counters = {name: 0 for name in COUNTER_NAMES}
         self.cache = {}
         self.spans = {}
+        self.sync_buckets = {}
 
     @property
     def active(self) -> bool:
@@ -204,7 +259,19 @@ class MetricTelemetry:
             any(self.counters.values())
             or any(any(slot.values()) for slot in self.cache.values())
             or any(s.count for s in self.spans.values())
+            or bool(self.sync_buckets)
         )
+
+    @staticmethod
+    def _bucket_row(row: Mapping[str, Any]) -> Dict[str, Any]:
+        out = dict(row)
+        # measured-vs-model: the granule floor the ring model keeps and the
+        # naive 2(n-1)/n model misses — positive when tiny buffers pay a
+        # full granule per ring step
+        out["residual_bytes"] = int(row.get("model_ring_bytes", 0)) - int(
+            row.get("model_naive_bytes", 0)
+        )
+        return out
 
     # -- export -------------------------------------------------------------
     def as_dict(self) -> Dict[str, Any]:
@@ -215,6 +282,10 @@ class MetricTelemetry:
                 "counters": dict(self.counters),
                 "cache": {kind: dict(slot) for kind, slot in sorted(self.cache.items())},
                 "spans": {name: s.as_dict() for name, s in sorted(self.spans.items())},
+                "sync_buckets": {
+                    key: self._bucket_row(row)
+                    for key, row in sorted(self.sync_buckets.items())
+                },
             }
 
     # ``m.telemetry.snapshot()`` reads nicer than ``as_dict`` at call sites
@@ -278,6 +349,7 @@ def enable() -> None:
     from torchmetrics_tpu.core import compile as _compile
 
     _compile.add_cache_observer(_on_cache_event)
+    _compile.add_compile_timing_observer(_on_compile_timing)
 
 
 def disable() -> None:
@@ -288,6 +360,7 @@ def disable() -> None:
     from torchmetrics_tpu.core import compile as _compile
 
     _compile.remove_cache_observer(_on_cache_event)
+    _compile.remove_compile_timing_observer(_on_compile_timing)
 
 
 def _on_cache_event(event: str, kind: Optional[str], owner: Any) -> None:
@@ -300,13 +373,27 @@ def _on_cache_event(event: str, kind: Optional[str], owner: Any) -> None:
         telemetry_for(owner).record_cache(kind or "unknown", field)
 
 
+def _on_compile_timing(record: Any) -> None:
+    """Compile-timing observer: fold each measured cold start (trace + lower
+    + XLA compile wall time of a cache entry's first dispatch) into the
+    owning metric's span stats as ``compile/<kind>``."""
+    if not _ENABLED:
+        return
+    owner = record.owner_ref() if record.owner_ref is not None else None
+    with _LOCK:
+        telemetry_for(owner).record_span(f"compile/{record.kind or 'unknown'}", record.cold_start_s)
+
+
 # ------------------------------------------------------------------ recording
 def count(obj: Any, name: str, n: int = 1) -> None:
     """Increment counter ``name`` for ``obj`` (no-op while disabled)."""
     if not _ENABLED:
         return
     with _LOCK:
-        telemetry_for(obj).inc(name, n)
+        t = telemetry_for(obj)
+        t.inc(name, n)
+    if _COUNT_SINK is not None:
+        _COUNT_SINK(t.label, name, n)
 
 
 def count_existing(obj: Any, name: str, n: int = 1) -> None:
@@ -320,6 +407,8 @@ def count_existing(obj: Any, name: str, n: int = 1) -> None:
         t = _BY_ID.get(id(obj))
         if t is not None:
             t.inc(name, n)
+    if t is not None and _COUNT_SINK is not None:
+        _COUNT_SINK(t.label, name, n)
 
 
 class _NullSpan:
@@ -372,6 +461,8 @@ class _Span:
             t = telemetry_for(self._obj)
             if t is not None:
                 t.record_span(self._name, dt)
+        if t is not None and _SPAN_SINK is not None:
+            _SPAN_SINK(t.label, self._name, dt)
         return False
 
 
@@ -427,6 +518,64 @@ def record_sync(
         t.inc("collectives", n_collectives)
 
 
+def record_measured_sync(
+    obj: Any,
+    entries: Iterable[Tuple[Mapping[str, Any], Mapping[str, Any]]],
+    n_devices: int,
+    seconds: float,
+) -> None:
+    """Attribute one *measured* coalesced sync (block-until-ready wall time
+    at the host boundary) to ``obj``'s per-bucket table.
+
+    ``entries`` is the ``[(reduction table, state), ...]`` list the sync's
+    :func:`parallel.coalesce.build_sync_plan` call fused, so the bucket keys
+    here match the collectives that actually launched.  Each bucket row gets
+    its byte-share of ``seconds`` plus both byte models — the naive
+    ``2(n-1)/n`` prediction and the granule-aware ring model — so exporters
+    can show the measured-vs-model residual per bucket.  The whole window
+    also lands in the owner's span stats as ``sync_measured``.  Never raises.
+    """
+    if not _ENABLED:
+        return
+    rows: List[Tuple[str, int, int, int]] = []  # (key, elements, naive_b, ring_b)
+    try:
+        import numpy as _np
+
+        from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+        from torchmetrics_tpu.utilities.benchmark import ring_reduce_bytes
+
+        entries = [(dict(r), dict(s)) for r, s in entries]
+        plan = build_sync_plan(entries)
+        n = max(int(n_devices), 1)
+        for bucket in plan.buckets:
+            payload = bucket.size * _np.dtype(bucket.dtype).itemsize
+            naive_b = int(round(2 * (n - 1) / n * payload))
+            ring_b = int(ring_reduce_bytes(payload, n))
+            rows.append((f"{bucket.dtype}/{bucket.op}", int(bucket.size), naive_b, ring_b))
+        for e, name, _reduce in plan.passthrough:
+            leaf = entries[e][1][name]
+            import jax as _jax
+
+            nbytes = sum(int(v.size) * v.dtype.itemsize for v in _jax.tree.leaves(leaf))
+            elems = sum(int(v.size) for v in _jax.tree.leaves(leaf))
+            gather_b = (n - 1) * nbytes  # no granule model for gathers
+            rows.append((f"gather/{name}", elems, gather_b, gather_b))
+    except Exception:
+        _log.debug("measured sync attribution failed for %r", obj, exc_info=True)
+    total_ring = sum(r[3] for r in rows)
+    with _LOCK:
+        t = telemetry_for(obj)
+        t.record_span("sync_measured", seconds)
+        for i, (key, elements, naive_b, ring_b) in enumerate(rows):
+            if total_ring > 0:
+                share = seconds * ring_b / total_ring
+            else:  # degenerate (1 device / empty buckets): split evenly
+                share = seconds / len(rows)
+            t.record_bucket(key, elements, share, naive_b, ring_b)
+    if _SPAN_SINK is not None:
+        _SPAN_SINK(t.label, "sync_measured", seconds)
+
+
 # ------------------------------------------------------------------ reporting
 def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
     """Sum a list of ``MetricTelemetry.as_dict()`` payloads into one."""
@@ -447,6 +596,19 @@ def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
             merged.ema_s = float(s["ema_us"]) / 1e6
             merged.buckets = [int(n) for _, n in s["buckets"]]
             stats.absorb(merged)
+        for key, row in part.get("sync_buckets", {}).items():
+            mine = agg.sync_buckets.setdefault(
+                key,
+                {
+                    "syncs": 0,
+                    "elements": 0,
+                    "measured_us": 0.0,
+                    "model_naive_bytes": 0,
+                    "model_ring_bytes": 0,
+                },
+            )
+            for field in mine:
+                mine[field] = mine[field] + row.get(field, 0)
     return agg.as_dict()
 
 
@@ -518,12 +680,16 @@ def _diff_tdict(after: Mapping[str, Any], before: Optional[Mapping[str, Any]]) -
         },
         "cache": {},
         "spans": {},
+        "sync_buckets": {},
     }
     for kind, slot in after.get("cache", {}).items():
         prev = before.get("cache", {}).get(kind, {})
         out["cache"][kind] = {f: int(n) - int(prev.get(f, 0)) for f, n in slot.items()}
     for name, s in after.get("spans", {}).items():
         out["spans"][name] = _diff_span(s, before.get("spans", {}).get(name))
+    for key, row in after.get("sync_buckets", {}).items():
+        prev = before.get("sync_buckets", {}).get(key, {})
+        out["sync_buckets"][key] = {f: _diff_num(n, prev.get(f, 0)) for f, n in row.items()}
     return out
 
 
@@ -538,6 +704,9 @@ def _diff_cache_stats(after: Mapping[str, Any], before: Mapping[str, Any]) -> Di
                 }
                 for kind, slot in v.items()
             }
+        elif isinstance(v, Mapping):  # flat numeric sub-dicts: miss_causes, cold_start
+            prev = before.get(k, {})
+            out[k] = {f: _diff_num(n, prev.get(f, 0)) for f, n in v.items()}
         else:
             out[k] = _diff_num(v, before.get(k, 0))
     return out

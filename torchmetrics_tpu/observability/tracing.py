@@ -1,0 +1,387 @@
+"""Flight recorder: a bounded ring-buffer timeline of structured trace events.
+
+PR 3's registry answers *how many* and *how long on average*; this module
+answers *when* and *in what order*.  While armed, every instrumented host
+boundary appends one :class:`TraceEvent` to a fixed-capacity ring buffer:
+
+* eager ``update`` / ``compute`` / ``forward`` spans (per metric instance),
+* sync windows — every coalesced collective boundary, with the planner's
+  bucket layout and modelled bytes riding in ``args``,
+* compile-cache activity — per-entry cold starts (trace+lower+compile) and
+  shape-driven retraces, attributed to their miss cause,
+* snapshot / restore / non-finite instants from the resilience layer.
+
+The recorder is **off by default twice over**: events only flow while
+telemetry is enabled (``observability.enable()`` / ``TM_TPU_TELEMETRY=1``)
+AND the recorder is armed (:func:`start` / ``TM_TPU_FLIGHT_RECORDER=1``).
+Disarmed, the only cost at an instrumented site is one ``is None`` check on a
+module-level sink — and with telemetry off not even that runs (the registry's
+shared null span short-circuits first).  Nothing here ever appears in a
+traced graph, so arming the recorder can never change a cache key, add a
+compile, or perturb a jaxpr.
+
+The buffer is a ring: memory is O(capacity) regardless of run length, and a
+multi-hour job keeps the *most recent* window — exactly what a post-mortem
+wants.  Export with :func:`chrome_trace` (Chrome trace-event JSON, loads
+directly in Perfetto / ``chrome://tracing``) or per-event JSON lines through
+the PR 3 exporter front door (``observability.export(fmt="chrome")`` /
+``fmt="trace-jsonl"``).
+
+Example::
+
+    from torchmetrics_tpu import observability as obs
+
+    obs.enable()
+    obs.tracing.start(capacity=8192)
+    ...  # train / eval
+    obs.export(fmt="chrome", path="flight.trace.json")  # open in Perfetto
+    obs.tracing.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "CATEGORIES",
+    "DEFAULT_CAPACITY",
+    "FlightRecorder",
+    "TraceEvent",
+    "active",
+    "chrome_trace",
+    "clear",
+    "events",
+    "recorder",
+    "recording",
+    "start",
+    "stop",
+]
+
+#: event categories the recorder emits (the ``cat`` field); Perfetto's track
+#: filter groups on these
+CATEGORIES = ("eager", "sync", "compile", "resilience", "guard")
+
+DEFAULT_CAPACITY = 4096
+
+_LOCK = threading.RLock()
+
+
+class TraceEvent:
+    """One Chrome-trace-event-model record.
+
+    ``ph`` is the trace-event phase: ``"X"`` (complete event: ``ts`` +
+    ``dur_us``) for spans, ``"i"`` (instant) for point events.  Timestamps
+    are microseconds since the recorder's epoch (monotonic clock), so events
+    from one process order totally and Perfetto renders them on one timeline.
+    """
+
+    __slots__ = ("name", "cat", "ph", "ts_us", "dur_us", "tid", "args")
+
+    def __init__(
+        self,
+        name: str,
+        cat: str,
+        ph: str,
+        ts_us: float,
+        dur_us: float = 0.0,
+        tid: str = "host",
+        args: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self.name = name
+        self.cat = cat
+        self.ph = ph
+        self.ts_us = ts_us
+        self.dur_us = dur_us
+        self.tid = tid
+        self.args = dict(args) if args else {}
+
+    def as_chrome(self, pid: int) -> Dict[str, Any]:
+        """This event in Chrome trace-event JSON form."""
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "cat": self.cat,
+            "ph": self.ph,
+            "ts": round(self.ts_us, 3),
+            "pid": pid,
+            "tid": self.tid,
+        }
+        if self.ph == "X":
+            out["dur"] = round(self.dur_us, 3)
+        if self.ph == "i":
+            out["s"] = "t"  # instant scope: thread
+        if self.args:
+            out["args"] = dict(self.args)
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "cat": self.cat,
+            "ph": self.ph,
+            "ts_us": round(self.ts_us, 3),
+            "dur_us": round(self.dur_us, 3),
+            "tid": self.tid,
+            "args": dict(self.args),
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"TraceEvent({self.cat}/{self.name} ph={self.ph} ts={self.ts_us:.1f}us dur={self.dur_us:.1f}us)"
+
+
+class FlightRecorder:
+    """Fixed-capacity ring buffer of :class:`TraceEvent` rows.
+
+    Appends are O(1) and evict the oldest event once ``capacity`` is hit —
+    the recorder keeps the most recent window of a long run.  ``dropped``
+    counts evictions so an export can say how much history scrolled away.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity < 1:
+            raise ValueError(f"flight recorder capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._ring: "deque[TraceEvent]" = deque(maxlen=capacity)
+        self._dropped = 0
+        self._epoch = time.perf_counter()  # tmt: ignore[TMT006] -- recorder epoch; host-side only, never traced
+
+    # ------------------------------------------------------------- recording
+    def now_us(self) -> float:
+        """Microseconds since this recorder's epoch (monotonic)."""
+        return (time.perf_counter() - self._epoch) * 1e6  # tmt: ignore[TMT006] -- span timestamping at the host boundary; never traced
+
+    def add(self, event: TraceEvent) -> None:
+        with _LOCK:
+            if len(self._ring) == self.capacity:
+                self._dropped += 1
+            self._ring.append(event)
+
+    def span(
+        self,
+        name: str,
+        cat: str,
+        t0_us: float,
+        dur_us: float,
+        tid: str = "host",
+        **args: Any,
+    ) -> None:
+        """Append a complete ("X") event covering ``[t0_us, t0_us+dur_us]``."""
+        self.add(TraceEvent(name, cat, "X", t0_us, dur_us, tid=tid, args=args))
+
+    def instant(self, name: str, cat: str, tid: str = "host", **args: Any) -> None:
+        """Append an instant ("i") event stamped now."""
+        self.add(TraceEvent(name, cat, "i", self.now_us(), tid=tid, args=args))
+
+    # --------------------------------------------------------------- reading
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def events(self) -> List[TraceEvent]:
+        """Snapshot of the ring, oldest first."""
+        with _LOCK:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with _LOCK:
+            self._ring.clear()
+            self._dropped = 0
+
+    # ---------------------------------------------------------------- export
+    def chrome_trace(self, extra_metadata: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+        """The ring as a Chrome trace-event JSON object (Perfetto-loadable).
+
+        Uses the object form (``{"traceEvents": [...], ...}``) so metadata —
+        including the export ``schema_version`` — rides along; Perfetto and
+        ``chrome://tracing`` both accept it.
+        """
+        from torchmetrics_tpu.observability.export import SCHEMA_VERSION
+
+        pid = os.getpid()
+        meta: Dict[str, Any] = {
+            "schema_version": SCHEMA_VERSION,
+            "producer": "torchmetrics_tpu.observability.tracing",
+            "capacity": self.capacity,
+            "dropped": self._dropped,
+        }
+        if extra_metadata:
+            meta.update(extra_metadata)
+        return {
+            "traceEvents": [e.as_chrome(pid) for e in self.events()],
+            "displayTimeUnit": "ms",
+            "otherData": meta,
+        }
+
+
+# ------------------------------------------------------------- module facade
+_RECORDER: Optional[FlightRecorder] = None
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The armed recorder, or ``None`` while disarmed."""
+    return _RECORDER
+
+
+def active() -> bool:
+    """True when events are actually flowing: armed AND telemetry enabled."""
+    if _RECORDER is None:
+        return False
+    from torchmetrics_tpu.observability import registry as _registry
+
+    return _registry.enabled()
+
+
+def start(capacity: int = DEFAULT_CAPACITY) -> FlightRecorder:
+    """Arm the flight recorder (idempotent; re-arming with a new capacity
+    replaces the ring).
+
+    Events only flow while telemetry is *also* enabled
+    (``observability.enable()`` / ``TM_TPU_TELEMETRY=1``) — the recorder
+    rides the same gate as every other recording helper, so a normally-dark
+    job stays dark even with the recorder armed.
+    """
+    global _RECORDER
+    with _LOCK:
+        if _RECORDER is None or _RECORDER.capacity != capacity:
+            _RECORDER = FlightRecorder(capacity)
+    _wire_sinks(True)
+    return _RECORDER
+
+
+def stop() -> Optional[FlightRecorder]:
+    """Disarm the recorder and return it (its ring stays readable/exportable)."""
+    global _RECORDER
+    _wire_sinks(False)
+    with _LOCK:
+        rec, _RECORDER = _RECORDER, None
+    return rec
+
+
+def clear() -> None:
+    with _LOCK:
+        if _RECORDER is not None:
+            _RECORDER.clear()
+
+
+def events() -> List[TraceEvent]:
+    """Snapshot of the armed recorder's ring (empty when disarmed)."""
+    with _LOCK:
+        rec = _RECORDER
+    return rec.events() if rec is not None else []
+
+
+def chrome_trace(extra_metadata: Optional[Mapping[str, Any]] = None) -> Dict[str, Any]:
+    """Chrome trace-event JSON of the current ring (empty trace if disarmed)."""
+    with _LOCK:
+        rec = _RECORDER
+    if rec is None:
+        rec = FlightRecorder(1)  # empty, but schema-complete
+    return rec.chrome_trace(extra_metadata)
+
+
+class _Recording:
+    def __init__(self, capacity: int) -> None:
+        self._capacity = capacity
+        self._was_armed = False
+
+    def __enter__(self) -> FlightRecorder:
+        self._was_armed = _RECORDER is not None
+        return start(self._capacity)
+
+    def __exit__(self, *exc: Any) -> bool:
+        if not self._was_armed:
+            stop()
+        return False
+
+
+def recording(capacity: int = DEFAULT_CAPACITY) -> _Recording:
+    """Context manager arming the recorder for a scope::
+
+        with obs.tracing.recording() as rec:
+            ...  # train
+        open("t.json", "w").write(json.dumps(rec.chrome_trace()))
+    """
+    return _Recording(capacity)
+
+
+# ----------------------------------------------------------------- the sinks
+# The registry (spans/instants) and the compile cache (cold starts/retraces)
+# publish into these callbacks only while the recorder is armed; disarmed,
+# the hooks are unregistered and the hot paths are back to one None check.
+_INSTANT_COUNTERS = {
+    "snapshots": ("snapshot", "resilience"),
+    "restores": ("restore", "resilience"),
+    "nonfinite_events": ("nonfinite", "guard"),
+}
+
+
+def _span_sink(label: str, name: str, dur_s: float) -> None:
+    """Registry span hook: called at span exit with the just-measured duration."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    cat = "sync" if name.startswith("sync") else "eager"
+    end_us = rec.now_us()
+    rec.span(f"{label}/{name}", cat, end_us - dur_s * 1e6, dur_s * 1e6, tid=label)
+
+
+def _count_sink(label: str, counter: str, n: int) -> None:
+    """Registry counter hook: resilience/guard counters become instants."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    mapped = _INSTANT_COUNTERS.get(counter)
+    if mapped is not None:
+        name, cat = mapped
+        rec.instant(f"{label}/{name}", cat, tid=label, count=n)
+
+
+def _compile_sink(record: Any) -> None:
+    """Compile-cache timing hook (``core.compile.CompileRecord``)."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    dur_us = float(record.cold_start_s) * 1e6
+    rec.span(
+        f"compile/{record.kind}/{record.label}",
+        "compile",
+        rec.now_us() - dur_us,
+        dur_us,
+        tid="compile",
+        cause=record.cause,
+        kind=record.kind,
+        fingerprint=record.fingerprint_hash,
+    )
+
+
+def _wire_sinks(arm: bool) -> None:
+    from torchmetrics_tpu.core import compile as _compile
+    from torchmetrics_tpu.observability import registry as _registry
+
+    if arm:
+        _registry.set_trace_sinks(_span_sink, _count_sink)
+        _compile.add_compile_timing_observer(_compile_sink)
+    else:
+        _registry.set_trace_sinks(None, None)
+        _compile.remove_compile_timing_observer(_compile_sink)
+
+
+def to_json(path: str, extra_metadata: Optional[Mapping[str, Any]] = None) -> str:
+    """Write the current ring as a Chrome trace file and return the path."""
+    payload = chrome_trace(extra_metadata)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, separators=(",", ":"))
+    return path
+
+
+# honour TM_TPU_FLIGHT_RECORDER=1 at import (telemetry must still be enabled
+# for events to flow — the double gate is deliberate)
+if os.environ.get("TM_TPU_FLIGHT_RECORDER", "").strip().lower() in ("1", "true", "on", "yes"):
+    start(int(os.environ.get("TM_TPU_FLIGHT_RECORDER_CAPACITY", str(DEFAULT_CAPACITY))))

@@ -22,6 +22,7 @@ The reference's entire comm backend is ``gather_all_tensors``
 """
 
 from torchmetrics_tpu.parallel.coalesce import (
+    SyncAdvisor,
     SyncPolicy,
     SyncStepper,
     apply_sync_plan,
@@ -51,6 +52,7 @@ from torchmetrics_tpu.parallel.sync import (
 
 __all__ = [
     "DeferredRaggedSync",
+    "SyncAdvisor",
     "SyncPolicy",
     "SyncStepper",
     "apply_sync_plan",

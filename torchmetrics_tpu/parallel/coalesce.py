@@ -55,6 +55,7 @@ Example::
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
@@ -66,6 +67,7 @@ from torchmetrics_tpu.core.reductions import Reduce, host_sync_leaf, sync_leaf
 
 __all__ = [
     "Bucket",
+    "SyncAdvisor",
     "SyncPlan",
     "SyncPolicy",
     "SyncStepper",
@@ -440,6 +442,12 @@ class SyncPolicy:
     def should_sync(self, pending: int) -> bool:
         return (not self.at_compute) and pending >= self.every_n_steps
 
+    @classmethod
+    def every_n(cls, k: int) -> "SyncPolicy":
+        """``SyncPolicy(every_n_steps=k)`` — the spelling :class:`SyncAdvisor`
+        recommendations use."""
+        return cls(every_n_steps=k)
+
 
 class SyncStepper:
     """Cadence-controlled sharded accumulation for a metric or collection.
@@ -558,11 +566,25 @@ class SyncStepper:
 
         if self._local is not None:
             fn = compiled_cadence_sync(self.target, self._members, self.mesh, self.axis_name)
+            measuring = _telemetry.enabled()
+            t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
             with _telemetry.span(self.target, "sync"):
                 window = fn(self._local)
+                if measuring:
+                    # block so the span/measurement covers the collective
+                    # itself, not just its async dispatch
+                    jax.block_until_ready(window)
             n_dev = self._n_devices()
             for name, m in self._members:
                 _telemetry.record_sync(m, m._reductions, window[name], n_dev)
+            if measuring:
+                measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
+                _telemetry.record_measured_sync(
+                    self.target,
+                    [(m._reductions, window[name]) for name, m in self._members],
+                    n_dev,
+                    measured_s,
+                )
             if self.verify_consistency:
                 from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -713,3 +735,184 @@ def flush_sync(target: Any) -> Any:
             "sharded_update/sharded_collection_update first (or drive a SyncStepper directly)"
         )
     return stepper.sync()
+
+
+# -------------------------------------------------------------------- advisor
+class SyncAdvisor:
+    """Report-only sync-cadence advisor driven by *measured* sync cost.
+
+    The byte models above predict what a cadence change should save; this
+    class measures it.  :meth:`profile` dry-runs the target under each
+    candidate ``every_n`` cadence on the given mesh with telemetry on, so
+    every flushed window is block-until-ready timed at the host boundary
+    (``SyncStepper.sync``), then :meth:`recommend` names the smallest cadence
+    whose measured sync-time cut clears ``target_cut`` — smallest because a
+    longer window buys diminishing sync savings at growing staleness.
+
+    Nothing here mutates the target's policy: the recommendation is a dict
+    the caller applies (or ignores) via
+    ``sharded_update(..., sync_policy=SyncPolicy.every_n(k))``.
+
+    Example (8-device dryrun — the BENCH_r05 scenario)::
+
+        advisor = SyncAdvisor(metric, mesh=mesh, axis_name="data")
+        advisor.profile(preds, target, steps=16)
+        rec = advisor.recommend()
+        rec["every_n"]            # 4 on the 8-device CPU mesh
+        rec["measured_cut"]       # ~4-5x less sync wall time than every-step
+        rec["buckets"]            # per-bucket measured vs model bytes + residual
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        mesh: Optional[Any] = None,
+        axis_name: str = "data",
+        in_specs: Optional[Any] = None,
+        candidates: Sequence[int] = (1, 2, 4, 8),
+        max_staleness: int = 8,
+    ) -> None:
+        from torchmetrics_tpu.parallel.sync import metric_mesh
+
+        if 1 not in candidates:
+            raise ValueError("SyncAdvisor candidates must include 1 (the measured baseline)")
+        self.target = target
+        self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
+        self.axis_name = axis_name
+        self.in_specs = in_specs
+        self.candidates = tuple(sorted(set(int(n) for n in candidates)))
+        self.max_staleness = int(max_staleness)
+        self._profile: Optional[Dict[str, Any]] = None
+
+    def profile(self, *inputs: Any, steps: int = 16, rounds: int = 3) -> Dict[str, Any]:
+        """Measure total sync wall time over ``steps`` updates of ``inputs``
+        under each candidate cadence (telemetry is enabled for the dryrun and
+        restored after).
+
+        An untimed warmup window runs first so no candidate's measurement
+        pays the cadence step/sync compile; candidates are then measured
+        ``rounds`` times round-robin and each keeps its *minimum* total —
+        the standard noise-robust wall-clock estimator, so one scheduler
+        hiccup cannot flip the recommendation.
+        """
+        from torchmetrics_tpu.observability import registry as _telemetry
+
+        was_enabled = _telemetry.enabled()
+        if not was_enabled:
+            _telemetry.enable()
+        cands = [n for n in self.candidates if n <= steps and n <= self.max_staleness]
+        totals: Dict[int, List[Dict[str, float]]] = {n: [] for n in cands}
+        before_all = _telemetry.telemetry_for(self.target).as_dict()
+        try:
+            warm = SyncStepper(
+                self.target,
+                mesh=self.mesh,
+                axis_name=self.axis_name,
+                policy=SyncPolicy(every_n_steps=1),
+                in_specs=self.in_specs,
+            )
+            warm.update(*inputs)  # compiles the cadence step + sync untimed
+            for _ in range(max(int(rounds), 1)):
+                for n in cands:
+                    stepper = SyncStepper(
+                        self.target,
+                        mesh=self.mesh,
+                        axis_name=self.axis_name,
+                        policy=SyncPolicy(every_n_steps=n),
+                        in_specs=self.in_specs,
+                    )
+                    before = _telemetry.telemetry_for(self.target).as_dict()
+                    for _ in range(steps):
+                        stepper.update(*inputs)
+                    if stepper.pending:
+                        stepper.sync()
+                    after = _telemetry.telemetry_for(self.target).as_dict()
+                    totals[n].append(_span_delta(after, before, "sync"))
+            after_all = _telemetry.telemetry_for(self.target).as_dict()
+        finally:
+            if not was_enabled:
+                _telemetry.disable()
+        runs: List[Dict[str, Any]] = []
+        for n in cands:
+            best = min(totals[n], key=lambda d: d["total_s"])
+            runs.append(
+                {
+                    "every_n": n,
+                    "steps": steps,
+                    "rounds": len(totals[n]),
+                    "syncs": best["count"],
+                    "sync_s": best["total_s"],
+                    "mean_sync_s": best["total_s"] / max(best["count"], 1),
+                }
+            )
+        self._profile = {
+            "steps": steps,
+            "n_devices": int(self.mesh.devices.size),
+            "runs": runs,
+            "buckets": _bucket_delta(after_all, before_all),
+        }
+        return self._profile
+
+    def recommend(self, target_cut: float = 3.5) -> Dict[str, Any]:
+        """The smallest profiled cadence whose measured sync-time cut (vs the
+        every-step baseline) reaches ``target_cut`` — or the best-measured
+        cadence when none does.  Report-only."""
+        if self._profile is None:
+            raise RuntimeError("SyncAdvisor.recommend called before profile()")
+        runs = self._profile["runs"]
+        base = next(r for r in runs if r["every_n"] == 1)
+        base_s = max(base["sync_s"], 1e-9)
+        for r in runs:
+            r["measured_cut"] = base_s / max(r["sync_s"], 1e-9)
+        eligible = [r for r in runs if r["measured_cut"] >= target_cut]
+        best = min(eligible, key=lambda r: r["every_n"]) if eligible else max(
+            runs, key=lambda r: r["measured_cut"]
+        )
+        buckets = self._profile["buckets"]
+        granule_bound = sorted(
+            key
+            for key, row in buckets.items()
+            if row.get("model_naive_bytes", 0)
+            and row.get("model_ring_bytes", 0) >= 2 * row["model_naive_bytes"]
+        )
+        return {
+            "policy": "every_n",
+            "every_n": best["every_n"],
+            "measured_cut": best["measured_cut"],
+            "target_cut": target_cut,
+            "baseline_sync_s": base["sync_s"],
+            "sync_s": best["sync_s"],
+            "runs": runs,
+            "buckets": buckets,
+            # buckets whose ring-model bytes dwarf the naive prediction are
+            # granule-floor-bound: deferral (fewer windows) is what pays there
+            "granule_bound_buckets": granule_bound,
+            "note": (
+                "report-only: apply with sharded_update(..., "
+                f"sync_policy=SyncPolicy.every_n({best['every_n']}))"
+            ),
+        }
+
+
+def _span_delta(
+    after: Mapping[str, Any], before: Mapping[str, Any], name: str
+) -> Dict[str, float]:
+    a = after.get("spans", {}).get(name, {})
+    b = before.get("spans", {}).get(name, {})
+    return {
+        "count": int(a.get("count", 0)) - int(b.get("count", 0)),
+        "total_s": (float(a.get("total_us", 0.0)) - float(b.get("total_us", 0.0))) / 1e6,
+    }
+
+
+def _bucket_delta(
+    after: Mapping[str, Any], before: Mapping[str, Any]
+) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for key, row in after.get("sync_buckets", {}).items():
+        prev = before.get("sync_buckets", {}).get(key, {})
+        out[key] = {
+            f: (v - prev.get(f, 0)) if isinstance(v, (int, float)) else v
+            for f, v in row.items()
+        }
+    return out

@@ -28,6 +28,7 @@ Example::
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
@@ -178,6 +179,34 @@ def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str 
     raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid}")
 
 
+def _measured_sync_dispatch(
+    owner: Any,
+    fn: Callable[..., Any],
+    inputs: Sequence[Any],
+    mesh: Mesh,
+    entries_of: Optional[Callable[[Any], Any]] = None,
+) -> Any:
+    """Dispatch one compiled sharded sync under the owner's ``"sync"`` span.
+
+    While telemetry is on, the dispatch is block-until-ready'd *inside* the
+    span so the measured wall time covers the collective itself rather than
+    just its async enqueue, and the window is attributed per-bucket through
+    :func:`observability.registry.record_measured_sync`.  Dark (telemetry
+    off), dispatch stays fully async — cadence/pipelining is unchanged.
+    """
+    measuring = _telemetry.enabled()
+    t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
+    with _telemetry.span(owner, "sync"):
+        out = fn(*inputs)
+        if measuring:
+            jax.block_until_ready(out)
+    if measuring:
+        measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
+        entries = entries_of(out) if entries_of is not None else [(owner._reductions, out)]
+        _telemetry.record_measured_sync(owner, entries, int(mesh.devices.size), measured_s)
+    return out
+
+
 def sharded_update(
     metric: "Metric",  # noqa: F821 - forward ref, avoids circular import
     *inputs: Array,
@@ -262,8 +291,7 @@ def sharded_update(
         from torchmetrics_tpu.core.compile import shard_map
 
         fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
-        with _telemetry.span(metric, "sync"):
-            out = fn(*inputs)
+        out = _measured_sync_dispatch(metric, fn, inputs, mesh)
         _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
         if verify_consistency:
             from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
@@ -280,8 +308,7 @@ def sharded_update(
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
     fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs)
-    with _telemetry.span(metric, "sync"):
-        out = fn(*inputs)
+    out = _measured_sync_dispatch(metric, fn, inputs, mesh)
     _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
     if verify_consistency:
         from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
@@ -345,8 +372,13 @@ def sharded_collection_update(
         )
         return stepper.update(*inputs)
     fn = compiled_sharded_collection_update(collection, leaders, mesh, axis_name, specs, inputs)
-    with _telemetry.span(collection, "sync"):
-        out = fn(*inputs)
+    out = _measured_sync_dispatch(
+        collection,
+        fn,
+        inputs,
+        mesh,
+        entries_of=lambda o: [(collection[name]._reductions, o[name]) for name in leaders],
+    )
     if _telemetry.enabled():
         n_dev = int(mesh.devices.size)
         for name in leaders:

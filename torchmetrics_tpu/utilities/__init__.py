@@ -9,9 +9,17 @@ from torchmetrics_tpu.utilities.benchmark import benchmark
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
 from torchmetrics_tpu.utilities.formatting import classify_inputs
 from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+from torchmetrics_tpu.utilities.regression import (
+    RegressionTracker,
+    check_regressions,
+    load_bench_history,
+)
 
 __all__ = [
     "benchmark",
+    "check_regressions",
+    "load_bench_history",
+    "RegressionTracker",
     "classify_inputs",
     "dim_zero_cat",
     "dim_zero_max",

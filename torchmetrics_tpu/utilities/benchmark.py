@@ -50,6 +50,12 @@ def cache_stats_delta(after: Dict[str, Any], before: Dict[str, Any]) -> Dict[str
         }
         for kind, slot in by_after.items()
     }
+    if "miss_causes" in after:
+        mc_before = before.get("miss_causes", {})
+        out["miss_causes"] = {
+            cause: int(n) - int(mc_before.get(cause, 0))
+            for cause, n in after["miss_causes"].items()
+        }
     return out
 
 

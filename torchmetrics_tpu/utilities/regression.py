@@ -1,0 +1,452 @@
+"""Bench regression tracker: gate the current bench run against history.
+
+``bench.py`` emits one JSON record per run and the driver archives them as
+``BENCH_r<NN>.json`` (``{"n": run-number, "cmd": ..., "rc": exit-code,
+"tail": last-stdout-bytes, "parsed": last-JSON-line-or-null}``).  Until now
+those were write-only: a perf regression landed silently and was only
+noticed by a human reading the next archive.  This module closes the loop:
+
+* :func:`load_bench_history` parses every archived run — including the
+  degraded shapes real archives have (``rc != 0`` crash records, ``parsed:
+  null`` with a *truncated* ``tail`` whose JSON can only be partially
+  recovered) — into flat ``{dotted.key: value}`` series;
+* :class:`RegressionTracker` compares the current run per leg against the
+  most recent comparable baseline (same device class — a CPU-fallback run
+  must never be judged against TPU numbers) inside direction-aware noise
+  bands: wall-clock legs get a wide band, analytic/deterministic legs
+  (byte models, collective counts, retrace counters) a tight one;
+* :class:`RegressionReport` renders a pass/fail markdown table and a
+  machine-readable verdict dict — wired into ``bench.py
+  --check-regressions``.
+
+The tracker is import-light (stdlib only) so it can run in CI without JAX.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+__all__ = [
+    "BenchRun",
+    "LegComparison",
+    "RegressionReport",
+    "RegressionTracker",
+    "check_regressions",
+    "flatten_numeric",
+    "load_bench_history",
+    "recover_numeric_pairs",
+]
+
+DEFAULT_PATTERN = "BENCH_r[0-9]*.json"
+
+#: Relative noise bands by key class.  Wall-clock legs vary wildly across
+#: container generations; analytic legs (byte models, planner counts,
+#: retrace counters) are deterministic and get a tight band.
+TIMING_BAND = 0.60
+ANALYTIC_BAND = 0.01
+DEFAULT_BAND = 0.30
+
+_ANALYTIC_MARKERS = (
+    "_bytes",
+    "_collectives",
+    "retraces",
+    "_traces",
+    "_misses",
+    "state_leaves",
+    "n_pairs",
+)
+#: keys where a LOWER value is better (gate on increases)
+_LOWER_BETTER = (
+    "_us",
+    "_ms",
+    "wall_s",
+    "_bytes",
+    "overhead",
+    "retraces",
+    "_misses",
+    "_collectives",
+    "findings",
+)
+#: keys where a HIGHER value is better (gate on decreases)
+_HIGHER_BETTER = ("cut", "speedup", "drop_pct", "fused_to", "prometheus_lines")
+
+
+def flatten_numeric(
+    obj: Any, prefix: str = "", max_depth: int = 8
+) -> Dict[str, float]:
+    """Flatten the numeric leaves of a nested bench record into
+    ``{"dotted.key": value}`` (bools excluded — they are verdicts, not
+    series)."""
+    out: Dict[str, float] = {}
+    if max_depth < 0:
+        return out
+    if isinstance(obj, Mapping):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten_numeric(v, key, max_depth - 1))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            key = f"{prefix}.{i}" if prefix else str(i)
+            out.update(flatten_numeric(v, key, max_depth - 1))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)) and math.isfinite(obj):
+        out[prefix] = float(obj)
+    return out
+
+
+_NUM_PAIR = re.compile(r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
+_DEVICE = re.compile(r'"device":\s*"([A-Za-z0-9_-]+)"')
+
+
+def recover_numeric_pairs(text: str) -> Dict[str, float]:
+    """Best-effort scalar recovery from a *truncated* JSON tail (the archive
+    keeps only the last N bytes of stdout, so the record can start
+    mid-object).  Returns every unambiguous ``"key": number`` pair; keys that
+    appear more than once with different values are dropped — with the
+    nesting gone there is no way to tell whose value is whose."""
+    seen: Dict[str, float] = {}
+    ambiguous = set()
+    for key, num in _NUM_PAIR.findall(text):
+        val = float(num)
+        if key in seen and seen[key] != val:
+            ambiguous.add(key)
+        seen[key] = val
+    return {k: v for k, v in seen.items() if k not in ambiguous}
+
+
+@dataclass
+class BenchRun:
+    """One archived bench run, reduced to flat numeric series."""
+
+    n: int
+    rc: int
+    source: str
+    device: Optional[str] = None
+    values: Dict[str, float] = field(default_factory=dict)
+    partial: bool = False  # recovered from a truncated tail
+
+    def lookup(self, dotted_key: str) -> Optional[float]:
+        """Value for ``dotted_key``: exact match, else a unique dotted-suffix
+        match (partial recoveries lose the nesting, keeping only leaf
+        names)."""
+        if dotted_key in self.values:
+            return self.values[dotted_key]
+        leaf = dotted_key.rsplit(".", 1)[-1]
+        if leaf in self.values:
+            return self.values[leaf]
+        hits = [v for k, v in self.values.items() if k.endswith("." + leaf)]
+        return hits[0] if len(hits) == 1 else None
+
+
+def _parse_archive(path: Path) -> Optional[BenchRun]:
+    try:
+        raw = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(raw, Mapping):
+        return None
+    n = int(raw.get("n", 0))
+    rc = int(raw.get("rc", 1))
+    parsed = raw.get("parsed")
+    tail = str(raw.get("tail") or "")
+    if isinstance(parsed, Mapping):
+        values = flatten_numeric(parsed)
+        device = _DEVICE.search(json.dumps(parsed))
+        return BenchRun(
+            n=n, rc=rc, source=path.name,
+            device=device.group(1) if device else None, values=values,
+        )
+    # degraded archive: try whole JSON lines in the tail first, then the
+    # truncated-object scalar recovery
+    for line in reversed(tail.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, Mapping) and "metric" in obj:
+                device = _DEVICE.search(line)
+                return BenchRun(
+                    n=n, rc=rc, source=path.name,
+                    device=device.group(1) if device else None,
+                    values=flatten_numeric(obj),
+                )
+    values = recover_numeric_pairs(tail)
+    if not values:
+        return None
+    device = _DEVICE.search(tail)
+    return BenchRun(
+        n=n, rc=rc, source=path.name,
+        device=device.group(1) if device else None,
+        values=values, partial=True,
+    )
+
+
+def load_bench_history(
+    directory: str = ".", pattern: str = DEFAULT_PATTERN
+) -> List[BenchRun]:
+    """Every parseable ``BENCH_r*.json`` in ``directory``, oldest first.
+    Crash records (``rc != 0``) and unrecoverable tails are skipped — a run
+    that produced no numbers can neither be a baseline nor regress."""
+    runs: List[BenchRun] = []
+    for path in sorted(Path(directory).glob(pattern)):
+        run = _parse_archive(path)
+        if run is not None and run.rc == 0 and run.values:
+            runs.append(run)
+    runs.sort(key=lambda r: r.n)
+    return runs
+
+
+def direction_for(key: str) -> Optional[str]:
+    """``"lower"`` / ``"higher"`` = which way is better; ``None`` = the key
+    is descriptive (shapes, configs) and is reported but never gated."""
+    leaf = key.rsplit(".", 1)[-1]
+    for marker in _HIGHER_BETTER:
+        if marker in leaf:
+            return "higher"
+    for marker in _LOWER_BETTER:
+        if marker in leaf or leaf.endswith(("_s", "_us", "_ms")):
+            return "lower"
+    return None
+
+
+_TIMING_TOKENS = frozenset({"us", "ms", "s", "wall", "time"})
+
+
+def band_for(key: str, noise_band: float = DEFAULT_BAND) -> float:
+    leaf = key.rsplit(".", 1)[-1]
+    if _TIMING_TOKENS & set(leaf.split("_")):
+        return max(TIMING_BAND, noise_band)
+    if any(m in leaf for m in _ANALYTIC_MARKERS):
+        return ANALYTIC_BAND
+    return noise_band
+
+
+def _denom_for(key: str, baseline: float) -> float:
+    """Scale for relative deltas/bands.  Percentage legs get a one-point
+    floor: their baselines hover near (or below) zero, where a raw relative
+    band degenerates — a sub-point move on an overhead-% leg is noise."""
+    denom = abs(baseline)
+    if "pct" in key.rsplit(".", 1)[-1]:
+        denom = max(denom, 1.0)
+    return denom or 1.0
+
+
+@dataclass
+class LegComparison:
+    key: str
+    current: float
+    baseline: float
+    baseline_run: str
+    delta_pct: float  # signed, relative to baseline (0 baseline -> inf-safe)
+    band_pct: float
+    direction: Optional[str]
+    verdict: str  # "pass" | "fail" | "info"
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+@dataclass
+class RegressionReport:
+    verdict: str  # "pass" | "fail" | "no-baseline"
+    comparisons: List[LegComparison]
+    baseline_runs: List[str]
+    device: Optional[str]
+    skipped_device_mismatch: int = 0
+
+    @property
+    def failures(self) -> List[LegComparison]:
+        return [c for c in self.comparisons if c.verdict == "fail"]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "metric": "bench-regression-check",
+            "verdict": self.verdict,
+            "device": self.device,
+            "baseline_runs": self.baseline_runs,
+            "n_compared": len(self.comparisons),
+            "n_gated": sum(1 for c in self.comparisons if c.direction is not None),
+            "n_failures": len(self.failures),
+            "skipped_device_mismatch": self.skipped_device_mismatch,
+            "failures": [c.as_dict() for c in self.failures],
+        }
+
+    def to_markdown(self) -> str:
+        lines = [
+            "## Bench regression check",
+            "",
+            f"**Verdict: {self.verdict.upper()}** — "
+            f"{len(self.comparisons)} legs compared against "
+            f"{', '.join(self.baseline_runs) or '(no baseline)'}"
+            + (f" on `{self.device}`" if self.device else "")
+            + f"; {len(self.failures)} failure(s), "
+            f"{self.skipped_device_mismatch} leg(s) skipped (device mismatch).",
+            "",
+        ]
+        gated = [c for c in self.comparisons if c.direction is not None]
+        if gated:
+            lines += [
+                "| leg | current | baseline | Δ% | band | better | verdict |",
+                "|---|---:|---:|---:|---:|:-:|:-:|",
+            ]
+            order = {"fail": 0, "pass": 1}
+            for c in sorted(gated, key=lambda c: (order.get(c.verdict, 2), c.key)):
+                mark = "❌" if c.verdict == "fail" else "✅"
+                lines.append(
+                    f"| `{c.key}` | {c.current:g} | {c.baseline:g} "
+                    f"({c.baseline_run}) | {c.delta_pct:+.1f}% | "
+                    f"±{c.band_pct * 100:.0f}% | {c.direction} | {mark} {c.verdict} |"
+                )
+        info = [c for c in self.comparisons if c.direction is None]
+        if info:
+            lines += ["", f"_{len(info)} ungated (descriptive) legs tracked but not gated._"]
+        return "\n".join(lines) + "\n"
+
+
+class RegressionTracker:
+    """Compare a current bench record against archived ``BENCH_r*.json``
+    history with per-leg noise bands.
+
+    ``noise_band`` is the default relative band; wall-clock legs widen to
+    ``TIMING_BAND`` and analytic legs tighten to ``ANALYTIC_BAND`` (see
+    :func:`band_for`).  Baselines come from the most recent clean run whose
+    device matches the current run's — when none matches, the check reports
+    ``no-baseline`` rather than failing on apples-vs-oranges numbers.
+    """
+
+    def __init__(
+        self,
+        history_dir: str = ".",
+        pattern: str = DEFAULT_PATTERN,
+        noise_band: float = DEFAULT_BAND,
+        history: Optional[Sequence[BenchRun]] = None,
+    ) -> None:
+        self.noise_band = float(noise_band)
+        self.history: List[BenchRun] = (
+            list(history) if history is not None else load_bench_history(history_dir, pattern)
+        )
+
+    #: historical spread is inflated by this factor when deriving the
+    #: empirical band — one prior excursion should not sit exactly on the line
+    HISTORY_SPREAD_FACTOR = 1.5
+
+    def _baseline_for(
+        self, key: str, device: Optional[str]
+    ) -> Tuple[Optional[float], Optional[BenchRun], int, List[float]]:
+        """Most recent comparable value for ``key`` plus every older
+        comparable value (used to widen the band to the observed run-to-run
+        dispersion)."""
+        skipped = 0
+        baseline: Optional[float] = None
+        run: Optional[BenchRun] = None
+        older: List[float] = []
+        for cand in reversed(self.history):  # newest first
+            val = cand.lookup(key)
+            if val is None:
+                continue
+            if device and cand.device and cand.device != device:
+                skipped += 1
+                continue
+            if baseline is None:
+                baseline, run = val, cand
+            else:
+                older.append(val)
+        return baseline, run, skipped, older
+
+    def _effective_band(self, key: str, baseline: float, older: List[float]) -> float:
+        """Class band widened to the measured history spread: a leg whose
+        archived runs already disagree by 8x (CPU wall-clock across container
+        generations) must not be gated at ±60%, while analytic legs whose
+        history is bit-identical stay at ±1%."""
+        band = band_for(key, self.noise_band)
+        denom = _denom_for(key, baseline)
+        for val in older:
+            spread = abs(val - baseline) / denom
+            band = max(band, spread * self.HISTORY_SPREAD_FACTOR)
+        return band
+
+    def compare(
+        self, current: Mapping[str, Any], device: Optional[str] = None
+    ) -> RegressionReport:
+        """Gate ``current`` (a bench record dict, nested or already flat)
+        against history.  ``device`` defaults to the record's own
+        ``device`` field."""
+        flat = (
+            {k: float(v) for k, v in current.items()}
+            if current and all(isinstance(v, (int, float)) for v in current.values())
+            else flatten_numeric(current)
+        )
+        if device is None:
+            m = _DEVICE.search(json.dumps(current, default=str))
+            device = m.group(1) if m else None
+        comparisons: List[LegComparison] = []
+        used_runs: List[str] = []
+        skipped_mismatch = 0
+        for key in sorted(flat):
+            baseline, run, skipped, older = self._baseline_for(key, device)
+            skipped_mismatch += skipped
+            if baseline is None or run is None:
+                continue
+            cur = flat[key]
+            denom = _denom_for(key, baseline)
+            delta_pct = (cur - baseline) / denom * 100.0
+            direction = direction_for(key)
+            band = self._effective_band(key, baseline, older)
+            # additive band in |baseline| units — multiplicative thresholds
+            # invert for negative baselines (noise stats can dip below zero)
+            if direction is None:
+                verdict = "info"
+            elif direction == "lower":
+                verdict = "fail" if cur > baseline + band * denom + 1e-12 else "pass"
+            else:
+                verdict = "fail" if cur < baseline - band * denom - 1e-12 else "pass"
+            if run.source not in used_runs:
+                used_runs.append(run.source)
+            comparisons.append(
+                LegComparison(
+                    key=key,
+                    current=cur,
+                    baseline=baseline,
+                    baseline_run=run.source,
+                    delta_pct=delta_pct,
+                    band_pct=band,
+                    direction=direction,
+                    verdict=verdict,
+                )
+            )
+        if not comparisons:
+            return RegressionReport(
+                verdict="no-baseline",
+                comparisons=[],
+                baseline_runs=[],
+                device=device,
+                skipped_device_mismatch=skipped_mismatch,
+            )
+        verdict = "fail" if any(c.verdict == "fail" for c in comparisons) else "pass"
+        return RegressionReport(
+            verdict=verdict,
+            comparisons=comparisons,
+            baseline_runs=used_runs,
+            device=device,
+            skipped_device_mismatch=skipped_mismatch,
+        )
+
+
+def check_regressions(
+    current: Mapping[str, Any],
+    history_dir: str = ".",
+    pattern: str = DEFAULT_PATTERN,
+    noise_band: float = DEFAULT_BAND,
+) -> RegressionReport:
+    """One-call front door: load history from ``history_dir`` and gate the
+    ``current`` bench record (what ``bench.py --check-regressions`` runs)."""
+    tracker = RegressionTracker(history_dir, pattern=pattern, noise_band=noise_band)
+    return tracker.compare(current)
