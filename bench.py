@@ -715,6 +715,125 @@ def coalescing_bench_child():
     print(json.dumps(out))
 
 
+def sketch_bench_child():
+    """Sketch-state acceptance leg on the 8-virtual-device mesh: the curve
+    family's ``approx="sketch"`` histogram pair vs the exact ``cat`` state at
+    1M accumulated samples.
+
+    * bytes — per-chip sync traffic from the shared cost model
+      (``sync_bytes_per_chip``): the exact path all_gathers 12 B/sample of
+      ragged state per peer, the sketch path ring-reduces one fixed
+      histogram; headline target is a >= 5x cut (it is orders of magnitude);
+    * timing — measured wall time of ``sync_ragged_states`` over the exact
+      cat states vs the jitted in-graph sharded sync of the sketch state;
+    * correctness — sketch AUROC must sit within its own data-dependent
+      ``auc_error_bound`` of the exact AUROC on the same 1M samples.
+    """
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torchmetrics_tpu.classification import BinaryAUROC, BinaryPrecisionRecallCurve
+    from torchmetrics_tpu.core.compile import shard_map
+    from torchmetrics_tpu.parallel import sync_ragged_states
+    from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+
+    total = int(os.environ.get("BENCH_SKETCH_SAMPLES", 1_000_000))
+    per_dev = total // n_dev
+    p = rng.random(total, dtype=np.float32)
+    t = (rng.random(total) < (0.25 + 0.5 * p)).astype(np.int32)
+
+    def timed_ms(fn, reps):
+        fn()  # warm (jit/pad-shape cache)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return (time.perf_counter() - t0) / reps * 1e3
+
+    # --- exact arm: one cat-state shard per device, ragged pad-gather sync
+    exact = BinaryAUROC()
+    exact_states = [
+        exact.update_state(
+            exact.init_state(),
+            jnp.asarray(p[d * per_dev : (d + 1) * per_dev]),
+            jnp.asarray(t[d * per_dev : (d + 1) * per_dev]),
+        )
+        for d in range(n_dev)
+    ]
+    exact_bytes = sync_bytes_per_chip(exact._reductions, exact_states[0], n_dev)
+    exact_sync_ms = timed_ms(
+        lambda: _jax.block_until_ready(
+            _jax.tree.leaves(sync_ragged_states(exact._reductions, exact_states, mesh))
+        ),
+        reps=3,
+    )
+
+    # --- sketch arm: fixed histogram state, in-graph coalesced sync
+    sk = BinaryAUROC(approx="sketch")
+    sk_state = sk.update_state(
+        sk.init_state(), jnp.asarray(p[:per_dev]), jnp.asarray(t[:per_dev])
+    )
+    sketch_bytes = sync_bytes_per_chip(sk._reductions, sk_state, n_dev)
+    stacked = _jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n_dev, *x.shape)), sk_state)
+
+    def run(st):
+        local = _jax.tree.map(lambda x: x[0], st)
+        return sk.sync_states(local, "data")
+
+    synced = _jax.jit(shard_map(run, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False))
+    sketch_sync_ms = timed_ms(
+        lambda: _jax.block_until_ready(_jax.tree.leaves(synced(stacked))), reps=10
+    )
+
+    # --- correctness on the full stream
+    exact_full = BinaryAUROC()
+    exact_full.update(jnp.asarray(p), jnp.asarray(t))
+    auc_exact = float(exact_full.compute())
+    sk_full = BinaryAUROC(approx="sketch")
+    sk_full.update(jnp.asarray(p), jnp.asarray(t))
+    auc_sketch = float(sk_full.compute())
+    bound = float(sk_full._sketch.auc_error_bound(sk_full._state["score_hist"]))
+    cut = exact_bytes / sketch_bytes if sketch_bytes else None
+    out["sketch_auroc_1m"] = {
+        "n_samples": total,
+        "approx_error": sk._sketch.eps,
+        "exact_sync_bytes_per_chip": int(exact_bytes),
+        "sketch_sync_bytes_per_chip": int(sketch_bytes),
+        "sync_byte_cut": round(cut, 1) if cut else None,
+        "meets_5x_target": bool(cut and cut >= 5.0),
+        "exact_ragged_sync_ms": round(exact_sync_ms, 2),
+        "sketch_sync_ms": round(sketch_sync_ms, 2),
+        "auc_exact": round(auc_exact, 6),
+        "auc_sketch": round(auc_sketch, 6),
+        "auc_abs_err": round(abs(auc_sketch - auc_exact), 6),
+        "auc_error_bound": round(bound, 6),
+        "within_bound": bool(abs(auc_sketch - auc_exact) <= bound + 1e-9),
+    }
+
+    # --- PRC: same cat-vs-histogram state shape, reported for the record
+    prc = BinaryPrecisionRecallCurve(approx="sketch")
+    prc_state = prc.update_state(
+        prc.init_state(), jnp.asarray(p[:per_dev]), jnp.asarray(t[:per_dev])
+    )
+    prc_bytes = sync_bytes_per_chip(prc._reductions, prc_state, n_dev)
+    prc_cut = exact_bytes / prc_bytes if prc_bytes else None
+    out["sketch_prc_1m"] = {
+        "exact_sync_bytes_per_chip": int(exact_bytes),
+        "sketch_sync_bytes_per_chip": int(prc_bytes),
+        "sync_byte_cut": round(prc_cut, 1) if prc_cut else None,
+        "meets_5x_target": bool(prc_cut and prc_cut >= 5.0),
+    }
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -759,6 +878,12 @@ def measured_ragged_sync_us():
 def measured_coalescing():
     return _run_cpu_mesh_child(
         "coalescing", float(os.environ.get("BENCH_COALESCE_TIMEOUT", 300))
+    )
+
+
+def measured_sketch():
+    return _run_cpu_mesh_child(
+        "sketch", float(os.environ.get("BENCH_SKETCH_TIMEOUT", 300))
     )
 
 
@@ -1128,6 +1253,7 @@ def main():
     sub_us = metric_subgraph_us(init_states, metrics, y)
     ragged_measured = measured_ragged_sync_us()
     coalescing_measured = measured_coalescing()
+    sketch_measured = measured_sketch()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -1172,6 +1298,7 @@ def main():
             "metric_subgraph_us_per_step": round(sub_us, 1),
             "measured_sync_us_8dev_mesh": ragged_measured,
             "coalescing": coalescing_measured,
+            "sketch_states": sketch_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -1295,6 +1422,8 @@ if __name__ == "__main__":
         ragged_sync_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "coalescing":
         coalescing_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "sketch":
+        sketch_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

@@ -30,9 +30,16 @@ def clf_batch():
 def test_accuracy_passes_all_checks(clf_batch):
     rep = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), *clf_batch)
     assert rep.ok, rep.violations
-    assert set(rep.checks) == {"state-registration", "update", "compute", "sync-collective-count"}
+    assert set(rep.checks) == {
+        "state-registration",
+        "update",
+        "compute",
+        "sync-collective-count",
+        "ragged-gather",
+    }
     assert rep.skipped == ()
     assert rep.traced_sync_collectives == rep.planned_sync_collectives
+    assert rep.traced_sync_gathers == 0  # all-sum state: nothing to gather
 
 
 def test_mean_metric_passes(clf_batch):

@@ -1,0 +1,56 @@
+"""MulticlassExactMatch ignore_index parity: the modular metric's global
+mean must match the functional path when ``ignore_index`` leaves some
+samples fully ignored — those samples must not dilute the denominator."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import MulticlassExactMatch
+from torchmetrics_tpu.functional.classification import multiclass_exact_match
+
+# sample 0 matches everywhere, sample 1 mismatches at a non-ignored slot,
+# sample 2 is ENTIRELY ignore_index — only 2 samples should count
+PREDS = jnp.asarray([[0, 1, 2], [2, 1, 0], [1, 1, 1]])
+TARGET = jnp.asarray([[0, 1, 2], [2, 0, 0], [-1, -1, -1]])
+
+
+def test_global_mean_ignores_fully_masked_samples():
+    fn = multiclass_exact_match(PREDS, TARGET, num_classes=3, ignore_index=-1)
+    m = MulticlassExactMatch(num_classes=3, ignore_index=-1)
+    m.update(PREDS, TARGET)
+    assert float(fn) == pytest.approx(0.5)
+    assert float(m.compute()) == pytest.approx(float(fn))
+
+
+def test_partially_ignored_positions_still_match():
+    # sample 1's mismatch sits at an IGNORED slot: the sample counts as a match
+    target = jnp.asarray([[0, 1, 2], [2, -1, 0], [-1, -1, -1]])
+    fn = multiclass_exact_match(PREDS, target, num_classes=3, ignore_index=-1)
+    m = MulticlassExactMatch(num_classes=3, ignore_index=-1)
+    m.update(PREDS, target)
+    assert float(fn) == pytest.approx(1.0)
+    assert float(m.compute()) == pytest.approx(1.0)
+
+
+def test_modular_functional_parity_across_batches():
+    rng = np.random.default_rng(3)
+    preds = rng.integers(0, 4, size=(3, 16, 5))
+    target = rng.integers(0, 4, size=(3, 16, 5))
+    target[rng.random(target.shape) < 0.3] = -1
+    target[0, 0] = -1  # force one fully-ignored sample
+    m = MulticlassExactMatch(num_classes=4, ignore_index=-1)
+    for step in range(3):
+        m.update(jnp.asarray(preds[step]), jnp.asarray(target[step]))
+    fn = multiclass_exact_match(
+        jnp.asarray(preds.reshape(-1, 5)), jnp.asarray(target.reshape(-1, 5)),
+        num_classes=4, ignore_index=-1,
+    )
+    np.testing.assert_allclose(float(m.compute()), float(fn), rtol=1e-6)
+
+
+def test_samplewise_unchanged():
+    m = MulticlassExactMatch(num_classes=3, ignore_index=-1, multidim_average="samplewise")
+    m.update(PREDS, TARGET)
+    out = np.asarray(m.compute())
+    np.testing.assert_allclose(out, [1.0, 0.0, 0.0])

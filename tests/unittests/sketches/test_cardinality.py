@@ -1,0 +1,116 @@
+"""HyperLogLog / CountMinSketch properties: estimates within the documented
+error bounds, masked inserts are no-ops, merge == single-stream, and
+``for_error`` sizes the structures to the requested bound."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.sketches import CountMinSketch, HyperLogLog, mix32
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(23)
+
+
+# ---------------------------------------------------------------------- mix32
+def test_mix32_deterministic_and_salt_sensitive():
+    keys = jnp.arange(100, dtype=jnp.int32)
+    a = mix32(keys, 7)
+    b = mix32(keys, 7)
+    c = mix32(keys, 8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.any(np.asarray(a) != np.asarray(c))
+    assert a.dtype == jnp.uint32
+
+
+# ----------------------------------------------------------------------- HLL
+def test_hll_estimate_within_rse(rng):
+    hll = HyperLogLog(precision=11)
+    true_n = 20_000
+    keys = jnp.asarray(rng.choice(1 << 30, size=true_n, replace=False).astype(np.int32))
+    regs = hll.insert_batch(hll.init(), keys)
+    est = float(hll.estimate(regs))
+    assert abs(est - true_n) / true_n <= 3 * hll.relative_error
+
+
+def test_hll_duplicates_do_not_inflate(rng):
+    hll = HyperLogLog(precision=11)
+    keys = jnp.asarray(rng.integers(0, 500, size=50_000).astype(np.int32))
+    est = float(hll.estimate(hll.insert_batch(hll.init(), keys)))
+    # small range hits the linear-counting branch: near-exact
+    assert abs(est - 500) / 500 <= 0.05
+
+
+def test_hll_small_range_linear_counting():
+    hll = HyperLogLog(precision=11)
+    regs = hll.insert_batch(hll.init(), jnp.arange(10, dtype=jnp.int32))
+    assert abs(float(hll.estimate(regs)) - 10) <= 1.0
+
+
+def test_hll_mask_is_noop():
+    hll = HyperLogLog(precision=8)
+    keys = jnp.arange(64, dtype=jnp.int32)
+    mask = jnp.asarray([True] * 32 + [False] * 32)
+    masked = hll.insert_batch(hll.init(), keys, mask=mask)
+    half = hll.insert_batch(hll.init(), keys[:32])
+    np.testing.assert_array_equal(np.asarray(masked), np.asarray(half))
+
+
+def test_hll_merge_equals_single_stream(rng):
+    hll = HyperLogLog(precision=10)
+    a = jnp.asarray(rng.integers(0, 1 << 20, 3000).astype(np.int32))
+    b = jnp.asarray(rng.integers(0, 1 << 20, 3000).astype(np.int32))
+    merged = hll.merge(hll.insert_batch(hll.init(), a), hll.insert_batch(hll.init(), b))
+    single = hll.insert_batch(hll.init(), jnp.concatenate([a, b]))
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(single))
+
+
+def test_hll_for_error_sizing():
+    assert HyperLogLog.for_error(0.01).relative_error <= 0.01
+    assert HyperLogLog.for_error(None).precision == 11
+    assert HyperLogLog.for_error(0.5).precision == 4  # clamped floor
+    with pytest.raises(ValueError):
+        HyperLogLog(precision=3)
+
+
+def test_hll_insert_is_jittable():
+    hll = HyperLogLog(precision=8)
+    regs = jax.jit(hll.insert_batch)(hll.init(), jnp.arange(100, dtype=jnp.int32))
+    assert regs.shape == (256,)
+
+
+# ----------------------------------------------------------------- count-min
+def test_cms_never_undercounts_and_bounded_overcount(rng):
+    cms = CountMinSketch.for_error(0.01)
+    n = 20_000
+    keys = rng.zipf(1.3, size=n).astype(np.int32) % 5000
+    table = cms.insert_batch(cms.init(), jnp.asarray(keys))
+    probe = np.unique(keys[:200])
+    est = np.asarray(cms.query(table, jnp.asarray(probe)))
+    true = np.asarray([np.sum(keys == k) for k in probe], np.float32)
+    assert np.all(est >= true - 1e-3)  # never undercounts
+    assert np.all(est - true <= cms.overcount_fraction * n + 1e-3)
+
+
+def test_cms_weighted_merge_equals_single_stream(rng):
+    cms = CountMinSketch(width=64, depth=3)
+    ka = jnp.asarray(rng.integers(0, 100, 500).astype(np.int32))
+    kb = jnp.asarray(rng.integers(0, 100, 500).astype(np.int32))
+    wa = jnp.asarray(rng.random(500).astype(np.float32))
+    wb = jnp.asarray(rng.random(500).astype(np.float32))
+    merged = cms.merge(
+        cms.insert_batch(cms.init(), ka, wa), cms.insert_batch(cms.init(), kb, wb)
+    )
+    single = cms.insert_batch(cms.init(), jnp.concatenate([ka, kb]), jnp.concatenate([wa, wb]))
+    np.testing.assert_allclose(np.asarray(merged), np.asarray(single), rtol=1e-6)
+
+
+def test_cms_for_error_sizing():
+    cms = CountMinSketch.for_error(0.001, delta=0.01)
+    assert cms.overcount_fraction <= 0.001
+    assert cms.depth >= 5  # ceil(ln 100)
+    with pytest.raises(ValueError):
+        CountMinSketch(width=0)

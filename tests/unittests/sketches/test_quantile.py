@@ -1,0 +1,115 @@
+"""QuantileSketch primitive properties: grid/rank guarantees, exact boundary
+tail counts, merge associativity, and merge == single-stream equality."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.sketches import DEFAULT_APPROX_ERROR, QuantileSketch, bins_for_error
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def test_bins_for_error_resolution():
+    assert bins_for_error(0.01) == 100
+    assert bins_for_error(1.0) == 2  # floor
+    with pytest.raises(ValueError):
+        bins_for_error(0.0)
+    with pytest.raises(ValueError):
+        bins_for_error(1.5)
+
+
+def test_for_error_defaults():
+    sk = QuantileSketch.for_error(None)
+    assert sk.eps == pytest.approx(DEFAULT_APPROX_ERROR)
+    assert QuantileSketch.for_error(1 / 64).bins == 64
+
+
+def test_quantile_query_within_grid_resolution(rng):
+    sk = QuantileSketch.for_error(1 / 512)
+    vals = rng.random(50_000).astype(np.float32)
+    hist = sk.insert_batch(sk.init(), jnp.asarray(vals))
+    for q in (0.1, 0.25, 0.5, 0.9, 0.99):
+        got = float(sk.query(hist, q))
+        true = float(np.quantile(vals, q))
+        assert abs(got - true) <= 2 * sk.eps, (q, got, true)
+
+
+def test_tail_counts_exact_at_boundaries(rng):
+    sk = QuantileSketch(bins=10)
+    vals = rng.random(5000).astype(np.float32)
+    hist = sk.insert_batch(sk.init(), jnp.asarray(vals))
+    tails = np.asarray(sk.tail_counts(hist))
+    edges = np.asarray(sk.edges)
+    for i, edge in enumerate(edges):
+        assert tails[i] == pytest.approx(np.sum(vals >= edge)), i
+
+
+def test_merge_equals_single_stream(rng):
+    sk = QuantileSketch.for_error(0.01)
+    a, b = rng.random(1000).astype(np.float32), rng.random(700).astype(np.float32)
+    merged = sk.merge(
+        sk.insert_batch(sk.init(), jnp.asarray(a)), sk.insert_batch(sk.init(), jnp.asarray(b))
+    )
+    single = sk.insert_batch(sk.init(), jnp.asarray(np.concatenate([a, b])))
+    np.testing.assert_array_equal(np.asarray(merged), np.asarray(single))
+
+
+def test_merge_associativity(rng):
+    sk = QuantileSketch(bins=32)
+    hists = [sk.insert_batch(sk.init(), jnp.asarray(rng.random(200).astype(np.float32))) for _ in range(3)]
+    left = sk.merge(sk.merge(hists[0], hists[1]), hists[2])
+    right = sk.merge(hists[0], sk.merge(hists[1], hists[2]))
+    np.testing.assert_array_equal(np.asarray(left), np.asarray(right))
+
+
+def test_prefix_shaped_and_weighted_insert(rng):
+    sk = QuantileSketch(bins=8)
+    vals = rng.random((64, 3, 2)).astype(np.float32)  # batch of 64 per (3, 2) row
+    w = rng.random((64, 3, 2)).astype(np.float32)
+    hist = sk.insert_batch(sk.init((3, 2)), jnp.asarray(vals), jnp.asarray(w))
+    assert hist.shape == (3, 2, 9)
+    np.testing.assert_allclose(np.asarray(sk.total(hist)), w.sum(0), rtol=1e-5)
+
+
+def test_insert_is_jit_and_grid_clipping():
+    sk = QuantileSketch(bins=4)
+    ins = jax.jit(sk.insert_batch)
+    hist = ins(sk.init(), jnp.asarray([-1.0, 0.0, 0.5, 1.0, 2.0]))
+    total = float(sk.total(hist))
+    assert total == 5.0  # out-of-range values clip into the end cells
+    assert float(hist[-1]) == 2.0  # 1.0 and 2.0 pin to the last cell
+
+
+def test_curve_confmat_matches_binned_update(rng):
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _binned_curve_update,
+    )
+
+    sk = QuantileSketch(bins=16)
+    p = rng.random(500).astype(np.float32)
+    t = (rng.random(500) < 0.4).astype(np.int32)
+    w = np.ones(500, np.float32)
+    pos = sk.insert_batch(sk.init(), jnp.asarray(p[t == 1]))
+    neg = sk.insert_batch(sk.init(), jnp.asarray(p[t == 0]))
+    hist = jnp.stack([neg, pos])  # (2, bins + 1)
+    confmat = np.asarray(sk.curve_confmat(hist))
+    ref = np.asarray(_binned_curve_update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(w), sk.edges))
+    np.testing.assert_allclose(confmat, ref, atol=1e-4)
+
+
+def test_auc_error_bound_shrinks_with_bins(rng):
+    p = rng.random(2000).astype(np.float32)
+    t = (rng.random(2000) < 0.5).astype(np.int32)
+    bounds = []
+    for bins in (8, 64, 512):
+        sk = QuantileSketch(bins=bins)
+        pos = sk.insert_batch(sk.init(), jnp.asarray(p[t == 1]))
+        neg = sk.insert_batch(sk.init(), jnp.asarray(p[t == 0]))
+        bounds.append(float(sk.auc_error_bound(jnp.stack([neg, pos]))))
+    assert bounds[0] > bounds[1] > bounds[2]
+    assert bounds[2] < 0.01

@@ -1,0 +1,282 @@
+"""Sketch-backed metric states end to end: ``Metric(approx="sketch")`` error
+bounds vs the exact path across class counts, bit-exact calibration grid
+parity, merge semantics, 8-device sharded sync, auditor/resilience/telemetry
+integration, and the default ``approx=None`` path staying untouched."""
+
+import copy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.sharded import assert_sharded_parity
+from torchmetrics_tpu.analysis import audit_metric
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryCalibrationError,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassCalibrationError,
+    MulticlassPrecisionRecallCurve,
+)
+from torchmetrics_tpu.resilience import StateRestoreError, restore, snapshot
+from torchmetrics_tpu.text import DistinctNGrams
+from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(31)
+
+
+def _binary_batch(rng, n):
+    # mildly separable scores so AUROC is away from the 0.5 degenerate point
+    t = (rng.random(n) < 0.4).astype(np.int32)
+    p = np.clip(rng.normal(0.35 + 0.3 * t, 0.25), 0.0, 1.0).astype(np.float32)
+    return jnp.asarray(p), jnp.asarray(t)
+
+
+def _multiclass_batch(rng, n, c):
+    logits = rng.normal(size=(n, c)).astype(np.float32)
+    target = rng.integers(0, c, n)
+    logits[np.arange(n), target] += 1.0  # signal
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(target)
+
+
+# ------------------------------------------------------------ ctor validation
+def test_ctor_validation():
+    with pytest.raises(ValueError, match="approx"):
+        BinaryAUROC(approx="montecarlo")
+    with pytest.raises(ValueError, match="approx_error"):
+        BinaryAUROC(approx_error=0.01)  # approx_error without approx
+    with pytest.raises(ValueError, match="approx_error"):
+        BinaryAUROC(approx="sketch", approx_error=0.7)
+    with pytest.raises(ValueError, match="thresholds"):
+        BinaryAUROC(thresholds=50, approx="sketch")
+
+
+# ------------------------------------------------- error bounds, {2,10,1000}
+def test_binary_auroc_within_documented_bound(rng):
+    p, t = _binary_batch(rng, 4096)
+    exact = BinaryAUROC()
+    sk = BinaryAUROC(approx="sketch")
+    exact_v = float(exact.compute_state(exact.update_state(exact.init_state(), p, t)))
+    state = sk.update_state(sk.init_state(), p, t)
+    sk_v = float(sk.compute_state(state))
+    bound = float(sk._sketch.auc_error_bound(state["score_hist"]))
+    assert abs(sk_v - exact_v) <= bound + 1e-6
+    assert bound < 0.05  # the bound itself is tight enough to be useful
+
+
+@pytest.mark.parametrize("num_classes,n", [(10, 2048), (1000, 2048)])
+def test_multiclass_auroc_within_documented_bound(rng, num_classes, n):
+    p, t = _multiclass_batch(rng, n, num_classes)
+    exact = MulticlassAUROC(num_classes=num_classes, validate_args=False)
+    sk = MulticlassAUROC(num_classes=num_classes, approx="sketch", validate_args=False)
+    exact_v = float(exact.compute_state(exact.update_state(exact.init_state(), p, t)))
+    state = sk.update_state(sk.init_state(), p, t)
+    sk_v = float(sk.compute_state(state))
+    # macro average: error bounded by the mean of the per-class bounds
+    bound = float(jnp.mean(sk._sketch.auc_error_bound(state["score_hist"])))
+    assert abs(sk_v - exact_v) <= bound + 1e-5
+
+
+def test_tighter_approx_error_tightens_result(rng):
+    p, t = _binary_batch(rng, 4096)
+    exact = BinaryAUROC()
+    exact_v = float(exact.compute_state(exact.update_state(exact.init_state(), p, t)))
+    errs = []
+    for eps in (1 / 16, 1 / 256):
+        m = BinaryAUROC(approx="sketch", approx_error=eps)
+        errs.append(abs(float(m.compute_state(m.update_state(m.init_state(), p, t))) - exact_v))
+    assert errs[1] <= errs[0] + 1e-7
+
+
+# --------------------------------- curve points lie exactly on the exact grid
+@pytest.mark.parametrize("ctor", [BinaryPrecisionRecallCurve, BinaryROC, BinaryAveragePrecision])
+def test_sketch_curve_equals_binned_at_grid_thresholds(rng, ctor):
+    """Boundary tail counts are exact, so a sketch curve must reproduce the
+    binned path evaluated at exactly the sketch's grid thresholds."""
+    p, t = _binary_batch(rng, 1024)
+    sk = ctor(approx="sketch", approx_error=1 / 64)
+    n_thresholds = sk._sketch.n_cells
+    binned = ctor(thresholds=n_thresholds)
+    np.testing.assert_allclose(
+        np.asarray(binned.thresholds), np.asarray(sk._sketch.edges), atol=1e-7
+    )
+    got = sk.compute_state(sk.update_state(sk.init_state(), p, t))
+    ref = binned.compute_state(binned.update_state(binned.init_state(), p, t))
+    for g, r in zip(jax.tree.leaves(got), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+
+# ------------------------------------------------- calibration grid parity
+def test_calibration_error_grid_match_is_bit_exact(rng):
+    p, t = _binary_batch(rng, 2000)
+    base = BinaryCalibrationError(n_bins=15)
+    sk = BinaryCalibrationError(approx="sketch", approx_error=1 / 15)
+    a = base.compute_state(base.update_state(base.init_state(), p, t))
+    b = sk.compute_state(sk.update_state(sk.init_state(), p, t))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_multiclass_calibration_grid_match(rng):
+    p, t = _multiclass_batch(rng, 512, 5)
+    base = MulticlassCalibrationError(num_classes=5, n_bins=20)
+    sk = MulticlassCalibrationError(num_classes=5, approx="sketch", approx_error=1 / 20)
+    a = base.compute_state(base.update_state(base.init_state(), p, t))
+    b = sk.compute_state(sk.update_state(sk.init_state(), p, t))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------- merge semantics
+def test_merge_vs_single_stream_and_associativity(rng):
+    m = BinaryAUROC(approx="sketch")
+    chunks = [_binary_batch(rng, 256) for _ in range(3)]
+    parts = [m.update_state(m.init_state(), p, t) for p, t in chunks]
+    left = m.merge_states(m.merge_states(parts[0], parts[1]), parts[2])
+    right = m.merge_states(parts[0], m.merge_states(parts[1], parts[2]))
+    np.testing.assert_array_equal(
+        np.asarray(left["score_hist"]), np.asarray(right["score_hist"])
+    )
+    single = m.update_state(
+        m.init_state(),
+        jnp.concatenate([c[0] for c in chunks]),
+        jnp.concatenate([c[1] for c in chunks]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(left["score_hist"]), np.asarray(single["score_hist"])
+    )
+
+
+# ------------------------------------------------------ 8-device sharded sync
+def test_sharded_binary_auroc_sketch(mesh, rng):
+    batches = [tuple(np.asarray(a) for a in _binary_batch(rng, 64)) for _ in range(2)]
+    assert_sharded_parity(mesh, lambda: BinaryAUROC(approx="sketch", validate_args=False), batches)
+
+
+def test_sharded_multiclass_prc_sketch(mesh, rng):
+    p, t = _multiclass_batch(rng, 64, 5)
+    assert_sharded_parity(
+        mesh,
+        lambda: MulticlassPrecisionRecallCurve(
+            num_classes=5, approx="sketch", approx_error=1 / 32, validate_args=False
+        ),
+        [(np.asarray(p), np.asarray(t))],
+    )
+
+
+# -------------------------------------------------------------- audit dogfood
+def test_audit_sketch_curve_has_zero_gathers(rng):
+    p, t = _binary_batch(rng, 64)
+    rep = audit_metric(BinaryAUROC(approx="sketch"), p, t)
+    assert rep.ok, rep.violations
+    assert "ragged-gather" in rep.checks
+    assert rep.traced_sync_gathers == 0
+
+
+def test_audit_exact_curve_skips_gather_check(rng):
+    p, t = _binary_batch(rng, 64)
+    rep = audit_metric(BinaryAUROC(), p, t)
+    assert rep.ok, rep.violations
+    assert any(check == "ragged-gather" for check, _ in rep.skipped)
+
+
+# ------------------------------------------------------ resilience snapshots
+def test_sketch_state_snapshot_roundtrip(rng):
+    p, t = _binary_batch(rng, 512)
+    m = BinaryAUROC(approx="sketch")
+    m.update(p, t)
+    fresh = BinaryAUROC(approx="sketch")
+    restore(fresh, snapshot(m))
+    np.testing.assert_array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_sketch_state_restore_rejects_wrong_shape(rng):
+    """SketchReduce leaves are fixed-shape (not growable cat states): a
+    snapshot with a resized histogram must be rejected, not installed."""
+    p, t = _binary_batch(rng, 128)
+    m = BinaryAUROC(approx="sketch")
+    m.update(p, t)
+    snap = copy.deepcopy(snapshot(m))
+    good = snap["state"]["score_hist"]
+    snap["state"]["score_hist"] = np.zeros((*good.shape[:-1], good.shape[-1] + 7), good.dtype)
+    with pytest.raises(StateRestoreError):
+        restore(BinaryAUROC(approx="sketch"), snap)
+
+
+# --------------------------------------------------------- byte-cut telemetry
+def test_modelled_sync_byte_cut_at_least_5x(rng):
+    n = 8192
+    p, t = _binary_batch(rng, n)
+    exact = BinaryAUROC()
+    exact_state = exact.update_state(exact.init_state(), p, t)
+    sk = BinaryAUROC(approx="sketch")
+    sk_state = sk.update_state(sk.init_state(), p, t)
+    exact_b = sync_bytes_per_chip(exact._reductions, dict(exact_state), 8)
+    sk_b = sync_bytes_per_chip(sk._reductions, dict(sk_state), 8)
+    assert sk_b > 0
+    assert exact_b / sk_b >= 5.0, (exact_b, sk_b)
+
+
+# ----------------------------------------------------- default path untouched
+def test_default_path_is_isolated_from_sketch_instances(rng):
+    sk = BinaryAUROC(approx="sketch")  # noqa: F841 - must not leak into defaults
+    m = BinaryAUROC()
+    assert m._sketch is None
+    assert m.approx is None
+    state = m.init_state()
+    assert "score_hist" not in state
+    assert set(state) >= {"preds", "target", "weight"}
+    # and both results still agree on shared data within the sketch bound
+    p, t = _binary_batch(rng, 256)
+    assert m.update_state(state, p, t)["preds"][0].shape == (256,)
+
+
+def test_approx_is_part_of_config_fingerprint():
+    a, b = BinaryAUROC(), BinaryAUROC()
+    assert a._config_fingerprint() == b._config_fingerprint()
+    assert BinaryAUROC(approx="sketch")._config_fingerprint() != a._config_fingerprint()
+    assert (
+        BinaryAUROC(approx="sketch", approx_error=1 / 64)._config_fingerprint()
+        != BinaryAUROC(approx="sketch")._config_fingerprint()
+    )
+
+
+# ------------------------------------------------------------- DistinctNGrams
+def test_distinct_ngrams_exact_matches_numpy(rng):
+    tokens = rng.integers(0, 50, size=(8, 32)).astype(np.int32)
+    m = DistinctNGrams(ngram=2)
+    got = float(m.compute_state(m.update_state(m.init_state(), jnp.asarray(tokens))))
+    wins = np.stack([tokens[:, :-1], tokens[:, 1:]], -1).reshape(-1, 2)
+    truth = len(np.unique(wins, axis=0)) / len(wins)
+    assert got == pytest.approx(truth, abs=1e-6)
+
+
+def test_distinct_ngrams_sketch_within_rse(rng):
+    tokens = rng.integers(0, 5000, size=(64, 64)).astype(np.int32)
+    exact = DistinctNGrams(ngram=1)
+    sk = DistinctNGrams(ngram=1, approx="sketch")
+    e = float(exact.compute_state(exact.update_state(exact.init_state(), jnp.asarray(tokens))))
+    s = float(sk.compute_state(sk.update_state(sk.init_state(), jnp.asarray(tokens))))
+    assert abs(s - e) / e <= 3 * sk._hll.relative_error
+
+
+def test_distinct_ngrams_sketch_merge_equals_single_stream(rng):
+    a = rng.integers(0, 1000, size=(8, 16)).astype(np.int32)
+    b = rng.integers(0, 1000, size=(8, 16)).astype(np.int32)
+    m = DistinctNGrams(ngram=2, approx="sketch")
+    merged = m.merge_states(
+        m.update_state(m.init_state(), jnp.asarray(a)),
+        m.update_state(m.init_state(), jnp.asarray(b)),
+    )
+    single = m.update_state(
+        m.update_state(m.init_state(), jnp.asarray(a)), jnp.asarray(b)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(merged["registers"]), np.asarray(single["registers"])
+    )

@@ -44,6 +44,7 @@ __all__ = [
     "AuditViolation",
     "CALLBACK_PRIMITIVES",
     "COLLECTIVE_PRIMITIVES",
+    "GATHER_PRIMITIVES",
     "TraceContractError",
     "audit_collection",
     "audit_metric",
@@ -70,6 +71,9 @@ COLLECTIVE_PRIMITIVES = frozenset(
         "pgather",
     }
 )
+#: the collectives whose payload scales with *gathered* (concatenated) state —
+#: the ragged syncs that bounded/sketch states exist to eliminate
+GATHER_PRIMITIVES = frozenset({"all_gather", "pgather", "all_to_all"})
 #: avals that must never appear in a lowered metric graph
 _BANNED_DTYPES = frozenset({"float64", "complex128"})
 
@@ -106,6 +110,8 @@ class AuditReport:
     traced_sync_collectives: Optional[int] = None
     #: ``n_collectives`` of the coalescing planner's bucket plan
     planned_sync_collectives: Optional[int] = None
+    #: gather-family collectives (:data:`GATHER_PRIMITIVES`) in the sync jaxpr
+    traced_sync_gathers: Optional[int] = None
 
     @property
     def ok(self) -> bool:
@@ -125,6 +131,7 @@ class AuditReport:
             "skipped": [list(s) for s in self.skipped],
             "traced_sync_collectives": self.traced_sync_collectives,
             "planned_sync_collectives": self.planned_sync_collectives,
+            "traced_sync_gathers": self.traced_sync_gathers,
         }
 
 
@@ -172,6 +179,27 @@ def _banned_dtypes(jaxpr: Any) -> List[str]:
 
 
 # ------------------------------------------------------------ shared helpers
+def _gather_budget(reductions: Mapping[str, Any]) -> Optional[int]:
+    """Max gather-family collectives a *bounded* state's sync may lower.
+
+    ``None`` when the reduction table holds cat/None/callable leaves (their
+    sync legitimately gathers, nothing to enforce).  For fully bounded
+    states — psum-family and sketch reductions only — the budget is the sum
+    of each structural sketch's declared ``n_sync_gathers`` (0 for bucketed
+    sketches), so a sketch-mode metric that sneaks in a ragged ``all_gather``
+    fails its audit.
+    """
+    from torchmetrics_tpu.core.reductions import Reduce, SketchReduce
+
+    budget = 0
+    for reduce in reductions.values():
+        if isinstance(reduce, SketchReduce):
+            budget += reduce.n_sync_gathers
+        elif reduce not in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN):
+            return None
+    return budget
+
+
 def _callback_names(jaxpr: Any) -> List[str]:
     return sorted({e.primitive.name for e in iter_eqns(jaxpr) if e.primitive.name in CALLBACK_PRIMITIVES})
 
@@ -323,6 +351,7 @@ def audit_metric(
     # -- sharded sync jaxpr vs the coalescing planner's model
     traced_n: Optional[int] = None
     planned_n: Optional[int] = None
+    traced_g: Optional[int] = None
     if type(metric).sync_states is not Metric.sync_states:
         skipped.append(("sync-collective-count", "metric overrides sync_states (not coalesced)"))
     else:
@@ -334,6 +363,7 @@ def audit_metric(
         else:
             checks.append("sync-collective-count")
             traced_n = count_primitives(jx_sync, COLLECTIVE_PRIMITIVES)
+            traced_g = count_primitives(jx_sync, GATHER_PRIMITIVES)
             planned_n = plan_for_metric(metric, state).n_collectives
             if traced_n != planned_n:
                 violations.append(
@@ -344,6 +374,20 @@ def audit_metric(
                         "no longer describes the real graph",
                     )
                 )
+            gather_budget = _gather_budget(metric._reductions)
+            if gather_budget is None:
+                skipped.append(("ragged-gather", "state holds cat/None/callable leaves (gathers expected)"))
+            else:
+                checks.append("ragged-gather")
+                if traced_g > gather_budget:
+                    violations.append(
+                        AuditViolation(
+                            "ragged-gather",
+                            f"sharded sync of a bounded state lowers {traced_g} gather-family "
+                            f"collective(s) (budget {gather_budget}) — bounded/sketch states "
+                            "must sync via elementwise reduce, not concatenation",
+                        )
+                    )
             violations.extend(
                 v for v in _graph_violations("sync", jx_sync, allow_collectives=True)
             )
@@ -355,6 +399,7 @@ def audit_metric(
         skipped=tuple(skipped),
         traced_sync_collectives=traced_n,
         planned_sync_collectives=planned_n,
+        traced_sync_gathers=traced_g,
     )
     return report.raise_if_violations() if strict else report
 
@@ -403,6 +448,7 @@ def audit_collection(
 
     traced_n: Optional[int] = None
     planned_n: Optional[int] = None
+    traced_g: Optional[int] = None
     if std_metrics:
         the_mesh = _default_mesh(mesh, axis_name)
 
@@ -418,6 +464,7 @@ def audit_collection(
         else:
             checks.append("sync-collective-count")
             traced_n = count_primitives(jx_sync, COLLECTIVE_PRIMITIVES)
+            traced_g = count_primitives(jx_sync, GATHER_PRIMITIVES)
             planned_n = plan.n_collectives
             if traced_n != planned_n:
                 violations.append(
@@ -428,6 +475,21 @@ def audit_collection(
                         f"(buckets: {plan.bucket_sizes()})",
                     )
                 )
+            budgets = [_gather_budget(m._reductions) for m in std_metrics]
+            if any(b is None for b in budgets):
+                skipped.append(("ragged-gather", "a member holds cat/None/callable leaves (gathers expected)"))
+            else:
+                checks.append("ragged-gather")
+                budget = sum(budgets)
+                if traced_g > budget:
+                    violations.append(
+                        AuditViolation(
+                            "ragged-gather",
+                            f"fused sync of bounded states lowers {traced_g} gather-family "
+                            f"collective(s) (budget {budget}) — bounded/sketch states must "
+                            "sync via elementwise reduce, not concatenation",
+                        )
+                    )
             violations.extend(_graph_violations("sync", jx_sync, allow_collectives=True))
 
     report = AuditReport(
@@ -437,5 +499,6 @@ def audit_collection(
         skipped=tuple(skipped),
         traced_sync_collectives=traced_n,
         planned_sync_collectives=planned_n,
+        traced_sync_gathers=traced_g,
     )
     return report.raise_if_violations() if strict else report

@@ -29,18 +29,36 @@ class _CalibrationErrorBase(Metric):
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
 
+    #: QuantileSketch when ``approx="sketch"`` sized the confidence grid
+    _sketch = None
+
     def _init_bins(self, n_bins: int, norm: str) -> None:
         if norm not in ("l1", "l2", "max"):
             raise ValueError(f"Argument `norm` is expected to be one of ('l1', 'l2', 'max') but got {norm}")
         if not (isinstance(n_bins, int) and n_bins > 0):
             raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
-        self.n_bins = n_bins
         self.norm = norm
+        if self.approx == "sketch":
+            # the binned state already IS a fixed-grid sketch of the
+            # reference's raw confidence lists — sketch mode just sizes the
+            # grid from the requested bound (each confidence rounds by at
+            # most ``approx_error`` inside its bin) and tags the leaves with
+            # the sketch reduce spec so audit/bench account them as such.
+            # ``approx_error = 1/n_bins`` reproduces the default grid
+            # bit-for-bit.
+            from torchmetrics_tpu.sketches import QuantileSketch
+
+            self._sketch = QuantileSketch.for_error(self.approx_error)
+            n_bins = self._sketch.bins
+            spec = self._sketch.reduce_spec
+        else:
+            spec = "sum"
+        self.n_bins = n_bins
         # n_bins + 1: the last bin holds conf == 1.0 exactly (reference
         # bucketize semantics, functional/classification/calibration_error.py:44-50)
-        self.add_state("conf_sum", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
-        self.add_state("acc_sum", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
-        self.add_state("count", jnp.zeros(n_bins + 1), dist_reduce_fx="sum")
+        self.add_state("conf_sum", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
+        self.add_state("acc_sum", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
+        self.add_state("count", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
 
     def _accumulate(self, state: State, conf: Array, acc: Array, w: Array) -> State:
         cs, as_, ct = _bin_update(conf, acc, w, self.n_bins)

@@ -10,9 +10,10 @@ from jax import Array
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.classification.exact_match import (
-    multiclass_exact_match,
+    _multiclass_exact_match_stats,
     multilabel_exact_match,
 )
+from torchmetrics_tpu.functional.classification.stat_scores import _multiclass_validate_args
 from torchmetrics_tpu.utilities.compute import _safe_divide
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
@@ -32,10 +33,15 @@ class _ExactMatchBase(Metric):
             self.add_state("correct", jnp.zeros(()), dist_reduce_fx="sum")
             self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
 
-    def _accumulate(self, state: State, samplewise: Array) -> State:
+    def _accumulate(self, state: State, samplewise: Array, valid_count=None) -> State:
         if self.multidim_average == "samplewise":
+            # deliberately an unbounded cat state: the samplewise API returns
+            # the per-sample vector itself, so every sample must be kept —
+            # there is no sufficient statistic (or sketch) to bound it
             return {"correct": tuple(state["correct"]) + (samplewise,)}
-        return {"correct": state["correct"] + jnp.sum(samplewise), "total": state["total"] + samplewise.shape[0]}
+        if valid_count is None:
+            valid_count = jnp.asarray(samplewise.shape[0], jnp.float32)
+        return {"correct": state["correct"] + jnp.sum(samplewise), "total": state["total"] + valid_count}
 
     def _compute(self, state: State) -> Array:
         if self.multidim_average == "samplewise":
@@ -64,10 +70,15 @@ class MulticlassExactMatch(_ExactMatchBase):
         self._init_em_state(multidim_average)
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
-        samplewise = multiclass_exact_match(
-            preds, target, self.num_classes, "samplewise", self.ignore_index, self.validate_args
+        if self.validate_args:
+            _multiclass_validate_args(self.num_classes, 1, None, self.multidim_average, self.ignore_index)
+        samplewise, sample_valid = _multiclass_exact_match_stats(
+            preds, target, self.num_classes, self.ignore_index
         )
-        return self._accumulate(state, samplewise)
+        # global total counts samples with >= 1 valid position: under
+        # ignore_index a fully-ignored sample must not dilute the mean
+        # (matches the functional path's denominator)
+        return self._accumulate(state, samplewise, jnp.sum(sample_valid))
 
 
 class MultilabelExactMatch(_ExactMatchBase):

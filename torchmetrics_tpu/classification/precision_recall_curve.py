@@ -44,22 +44,74 @@ from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 
 class _CurveBase(Metric):
-    """Shared state handling for all curve metrics."""
+    """Shared state handling for all curve metrics.
+
+    Three state layouts: the two reference layouts (exact ``cat`` lists for
+    ``thresholds=None``, binned ``(T, ..., 2, 2)`` confusion state for given
+    thresholds) plus the bounded ``approx="sketch"`` layout — a fixed-grid
+    quantile-histogram pair (``torchmetrics_tpu.sketches.QuantileSketch``)
+    of shape ``(..., 2, bins + 1)`` that replaces the unbounded cat states.
+    In sketch mode the curve is evaluated at the sketch's grid edges, so
+    every point lies exactly on the exact curve (the grid only subsamples
+    thresholds with spacing ``<= approx_error``) and the cross-device sync
+    is one fused ``psum`` instead of a ragged ``all_gather``.
+    """
 
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
 
+    #: QuantileSketch when ``approx="sketch"`` replaced the cat states
+    _sketch = None
+
     def _init_curve_state(self, thresholds, confmat_shape: Tuple[int, ...]) -> None:
         self.thresholds = _adjust_threshold_arg(thresholds)
-        if self.thresholds is None:
+        if self.approx == "sketch":
+            if self.thresholds is not None:
+                raise ValueError(
+                    "approx='sketch' replaces the unbounded thresholds=None state; explicit "
+                    "`thresholds` are already a bounded binned state — drop one of the two"
+                )
+            from torchmetrics_tpu.sketches import QuantileSketch
+
+            self._sketch = QuantileSketch.for_error(self.approx_error)
+            # curves are computed at the sketch's grid edges, so every
+            # binned `_compute` branch below applies to sketch mode unchanged
+            self.thresholds = self._sketch.edges
+            self.add_state(
+                "score_hist",
+                self._sketch.init((*confmat_shape, 2)),
+                dist_reduce_fx=self._sketch.reduce_spec,
+            )
+        elif self.thresholds is None:
             self.add_state("preds", [], dist_reduce_fx="cat")
             self.add_state("target", [], dist_reduce_fx="cat")
             self.add_state("weight", [], dist_reduce_fx="cat")
         else:
             self.add_state("confmat", jnp.zeros((self.thresholds.shape[0], *confmat_shape, 2, 2)), dist_reduce_fx="sum")
 
+    @property
+    def _binned_update_thresholds(self):
+        """Thresholds the per-batch binned confmat update needs — ``None``
+        for both unbounded-exact and sketch modes (the sketch accumulates a
+        histogram instead; materializing a (T, ..., 2, 2) batch confmat
+        would defeat its memory bound)."""
+        return None if self._sketch is not None else self.thresholds
+
+    def _sketch_insert(self, hist: Array, p: Array, t: Array, w: Array) -> Array:
+        """Fold formatted scores into the (negative, positive) histogram pair."""
+        if p.ndim == 2 and t.ndim == 1:  # multiclass scores + integer target
+            t = jax.nn.one_hot(t, p.shape[1], dtype=p.dtype)
+            w = w[:, None]
+        pos = t.astype(p.dtype) * w
+        neg = w - pos
+        values = jnp.broadcast_to(p[..., None], (*p.shape, 2))
+        weights = jnp.stack([neg, pos], axis=-1)
+        return self._sketch.insert_batch(hist, values, weights)
+
     def _accumulate(self, state: State, p: Array, t: Array, w: Array, binned: Array) -> State:
+        if self._sketch is not None:
+            return {"score_hist": self._sketch_insert(state["score_hist"], p, t, w)}
         if self.thresholds is None:
             return {
                 "preds": tuple(state["preds"]) + (p,),
@@ -67,6 +119,13 @@ class _CurveBase(Metric):
                 "weight": tuple(state["weight"]) + (w,),
             }
         return {"confmat": state["confmat"] + binned}
+
+    def compute_state(self, state: State):
+        if self._sketch is not None:
+            # project the histogram pair onto the binned confusion layout —
+            # pure and cheap (one reversed cumsum), traced into compute
+            state = {**state, "confmat": self._sketch.curve_confmat(state["score_hist"])}
+        return super().compute_state(state)
 
 
 class BinaryPrecisionRecallCurve(_CurveBase):
@@ -86,7 +145,8 @@ class BinaryPrecisionRecallCurve(_CurveBase):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         p, t, w = _binary_prc_format(preds, target, self.ignore_index)
-        binned = None if self.thresholds is None else _binned_curve_update(p, t, w, self.thresholds)
+        thresholds = self._binned_update_thresholds
+        binned = None if thresholds is None else _binned_curve_update(p, t, w, thresholds)
         return self._accumulate(state, p, t, w, binned)
 
     def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
@@ -128,10 +188,11 @@ class MulticlassPrecisionRecallCurve(_CurveBase):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         p, t, w = _multiclass_prc_format(preds, target, self.num_classes, self.ignore_index)
-        if self.thresholds is None:
+        thresholds = self._binned_update_thresholds
+        if thresholds is None:
             binned = None
         else:
-            binned = _binned_confmat_multiclass(p, t, w, self.thresholds, self.num_classes)
+            binned = _binned_confmat_multiclass(p, t, w, thresholds, self.num_classes)
         return self._accumulate(state, p, t, w, binned)
 
     def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:
@@ -183,10 +244,11 @@ class MultilabelPrecisionRecallCurve(_CurveBase):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         p, t, w = _multilabel_prc_format(preds, target, self.num_labels, self.ignore_index)
-        if self.thresholds is None:
+        thresholds = self._binned_update_thresholds
+        if thresholds is None:
             binned = None
         else:
-            binned = _binned_confmat_multilabel(p, t, w, self.thresholds)
+            binned = _binned_confmat_multilabel(p, t, w, thresholds)
         return self._accumulate(state, p, t, w, binned)
 
     def _exact_state(self, state: State) -> Tuple[Array, Array, Array]:

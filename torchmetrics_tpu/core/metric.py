@@ -65,6 +65,7 @@ from torchmetrics_tpu.core.guards import (
 )
 from torchmetrics_tpu.core.reductions import (
     Reduce,
+    SketchReduce,
     canonical_reduce,
     is_list_state,
     merge_leaf,
@@ -93,6 +94,8 @@ METRIC_BASE_KWARGS = frozenset(
         "distributed_available_fn",
         "process_group",
         "compute_on_cpu",
+        "approx",
+        "approx_error",
     }
 )
 
@@ -116,6 +119,15 @@ class Metric:
             ``"ignore"``/``"zero"`` lower to fused ``jnp.where`` masks and
             add no extra trace; the strategy is part of the compile-cache
             config fingerprint.
+        approx: ``None`` (default — bit-exact states) or ``"sketch"`` —
+            metric families with a sketch implementation (the curve family,
+            calibration error, cardinality-flavored text metrics) replace
+            unbounded ``cat`` states with fixed-size mergeable sketches
+            (``torchmetrics_tpu.sketches``) whose sync is psum-shaped.
+            Families without one ignore the flag and stay exact.
+        approx_error: target error bound for ``approx="sketch"`` (each
+            sketch documents its own semantics — grid resolution for curves,
+            RSE for cardinalities).  ``None`` picks the per-sketch default.
     """
 
     __jit_state_exclude__: Tuple[str, ...] = ()
@@ -183,6 +195,20 @@ class Metric:
                 "custom `dist_sync_fn` for host-level sync over a process subset."
             )
         kwargs.pop("compute_on_cpu", None)  # accepted for API parity; host state is the default here
+        approx = kwargs.pop("approx", None)
+        if approx not in (None, "sketch"):
+            raise ValueError(f"Arg `approx` must be None or 'sketch', got {approx!r}")
+        approx_error = kwargs.pop("approx_error", None)
+        if approx_error is not None:
+            if approx is None:
+                raise ValueError("`approx_error` requires `approx='sketch'`")
+            approx_error = float(approx_error)
+            if not (0.0 < approx_error <= 0.5):
+                raise ValueError(f"`approx_error` must be in (0, 0.5], got {approx_error}")
+        # public attrs: part of the compile-cache config fingerprint, so an
+        # exact and a sketch instance of one metric class never share traces
+        self.approx: Optional[str] = approx
+        self.approx_error: Optional[float] = approx_error
         if kwargs:
             raise ValueError(f"Unexpected keyword arguments: {list(kwargs)}")
 
@@ -219,14 +245,17 @@ class Metric:
         self,
         name: str,
         default: Union[Array, list, Sequence],
-        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        dist_reduce_fx: Optional[Union[str, Callable, SketchReduce]] = None,
         persistent: bool = False,
     ) -> None:
         """Register a state leaf (reference: metric.py:197-280).
 
         ``default`` is an array (tensor state) or an empty list (list state,
         stored as a tuple of arrays).  ``dist_reduce_fx`` ∈
-        sum|mean|max|min|cat|callable|None.
+        sum|mean|max|min|cat|callable|None, or a
+        :class:`~torchmetrics_tpu.core.reductions.SketchReduce` spec for
+        fixed-shape sketch leaves (``torchmetrics_tpu.sketches``) — those
+        merge elementwise and sync without ragged gathers.
         """
         if name.startswith("_"):
             raise ValueError(f"State name {name!r} must not start with '_'")

@@ -20,6 +20,7 @@ stays a valid JAX pytree.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from enum import Enum
 from typing import Any, Callable, Optional, Tuple, Union
 
@@ -35,25 +36,86 @@ class Reduce(str, Enum):
     MIN = "min"
     CAT = "cat"
     NONE = "none"
+    #: marker value only — registering a sketch leaf requires a concrete
+    #: :class:`SketchReduce` spec (see ``torchmetrics_tpu.sketches``), never
+    #: the bare string, because the merge semantics live on the spec
+    SKETCH = "sketch"
 
 
-ReduceFx = Union[Reduce, str, Callable, None]
+@dataclass(frozen=True)
+class SketchReduce:
+    """Reduction spec for a fixed-shape mergeable *sketch* leaf.
+
+    A sketch state (quantile histogram, count-min row block, HyperLogLog
+    registers, bottom-k reservoir — ``torchmetrics_tpu.sketches``) has one
+    defining property: merging two sketches is a fixed-shape elementwise (or
+    fixed-top-k) operation, never a concatenation.  That lets the
+    cross-device sync lower to an ordinary ``psum``/``pmax`` — or at worst a
+    *fixed-shape* gather — instead of the ragged ``all_gather`` a ``cat``
+    state pays.
+
+    ``bucket_op`` ∈ ``"sum" | "max" | "min"`` declares the merge as that
+    elementwise op; such leaves ride the coalescing planner's fused dtype
+    buckets exactly like SUM/MAX/MIN leaves.  ``bucket_op=None`` declares a
+    structural merge (e.g. a reservoir's sort-and-keep-k): supply
+    ``combine_stacked``, which folds a stacked ``(m, *leaf_shape)`` array of
+    sketches into one — the same contract callable reductions already use —
+    and the sync lowers to ONE fixed-shape gather + the combine.
+    """
+
+    kind: str
+    bucket_op: Optional[str] = None
+    combine_stacked: Optional[Callable] = None
+
+    def __post_init__(self) -> None:
+        if self.bucket_op not in (None, "sum", "max", "min"):
+            raise ValueError(
+                f"SketchReduce.bucket_op must be one of 'sum'/'max'/'min'/None, got {self.bucket_op!r}"
+            )
+        if self.bucket_op is None and self.combine_stacked is None:
+            raise ValueError(
+                "SketchReduce with bucket_op=None needs a `combine_stacked` callable "
+                "(stacked (m, ...) sketches -> one merged sketch)"
+            )
+
+    @property
+    def n_sync_gathers(self) -> int:
+        """Fixed-shape gather collectives one sync of this leaf launches
+        (0 when the merge rides a psum-family bucket)."""
+        return 0 if self.bucket_op is not None else 1
 
 
-def canonical_reduce(fx: ReduceFx) -> Union[Reduce, Callable]:
-    """Normalize a user-provided ``dist_reduce_fx`` into a :class:`Reduce` or callable."""
+def is_sketch_reduce(fx: Any) -> bool:
+    return isinstance(fx, SketchReduce)
+
+
+ReduceFx = Union[Reduce, str, Callable, "SketchReduce", None]
+
+
+def canonical_reduce(fx: ReduceFx) -> Union[Reduce, Callable, SketchReduce]:
+    """Normalize a user-provided ``dist_reduce_fx`` into a :class:`Reduce`,
+    :class:`SketchReduce`, or callable."""
     if fx is None:
         return Reduce.NONE
+    if isinstance(fx, SketchReduce):
+        return fx
     if callable(fx):
         return fx
-    if isinstance(fx, Reduce):
+    if isinstance(fx, Reduce) and fx is not Reduce.SKETCH:
         return fx
     try:
-        return Reduce(str(fx))
+        canon = Reduce(str(fx))
     except ValueError:
         raise ValueError(
-            f"`dist_reduce_fx` must be one of {[r.value for r in Reduce]}, a callable, or None; got {fx!r}"
+            f"`dist_reduce_fx` must be one of {[r.value for r in Reduce]}, a callable, "
+            f"a SketchReduce spec, or None; got {fx!r}"
         )
+    if canon is Reduce.SKETCH:
+        raise ValueError(
+            "dist_reduce_fx='sketch' is a marker, not a spec — pass a concrete "
+            "SketchReduce instance (e.g. torchmetrics_tpu.sketches.QuantileSketch(...).reduce_spec)"
+        )
+    return canon
 
 
 ListState = Tuple[Array, ...]
@@ -75,6 +137,14 @@ def merge_leaf(
     For ``MEAN`` the merge is the running-mean correction weighted by update
     counts (the reference's metric.py:415-420).
     """
+    if isinstance(reduce, SketchReduce):
+        if reduce.bucket_op == "sum":
+            return a + b
+        if reduce.bucket_op == "max":
+            return jnp.maximum(a, b)
+        if reduce.bucket_op == "min":
+            return jnp.minimum(a, b)
+        return reduce.combine_stacked(jnp.stack([a, b]))
     if callable(reduce) and not isinstance(reduce, Reduce):
         return reduce(jnp.stack([a, b]))
     if reduce == Reduce.SUM:
@@ -103,8 +173,19 @@ def sync_leaf(
     Must be called inside ``shard_map``/``pmap``/``pjit``-with-axis context.
     sum/mean/max/min lower to single ICI collectives; cat/none lower to
     ``all_gather`` (tiled concat along dim 0 for cat — matching the
-    reference's dim_zero_cat-after-gather at metric.py:467-470).
+    reference's dim_zero_cat-after-gather at metric.py:467-470).  Sketch
+    leaves with a ``bucket_op`` lower to the matching single collective;
+    structural sketches (reservoirs) lower to ONE fixed-shape gather plus
+    their in-graph ``combine_stacked`` — bounded traffic either way.
     """
+    if isinstance(reduce, SketchReduce):
+        if reduce.bucket_op == "sum":
+            return jax.lax.psum(value, axis_name)
+        if reduce.bucket_op == "max":
+            return jax.lax.pmax(value, axis_name)
+        if reduce.bucket_op == "min":
+            return jax.lax.pmin(value, axis_name)
+        return reduce.combine_stacked(jax.lax.all_gather(value, axis_name))
     if callable(reduce) and not isinstance(reduce, Reduce):
         gathered = jax.lax.all_gather(value, axis_name)
         return reduce(gathered)
@@ -145,6 +226,14 @@ def host_sync_leaf(
         gathered = multihost_utils.process_allgather(local, tiled=True)
         return (gathered,)
     gathered = multihost_utils.process_allgather(value)  # (n_proc, ...)
+    if isinstance(reduce, SketchReduce):
+        if reduce.bucket_op == "sum":
+            return gathered.sum(0)
+        if reduce.bucket_op == "max":
+            return gathered.max(0)
+        if reduce.bucket_op == "min":
+            return gathered.min(0)
+        return reduce.combine_stacked(gathered)
     if callable(reduce) and not isinstance(reduce, Reduce):
         return reduce(gathered)
     if reduce == Reduce.SUM:

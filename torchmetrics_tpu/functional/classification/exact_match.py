@@ -25,6 +25,24 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
 from torchmetrics_tpu.utilities.compute import _safe_divide
 
 
+def _multiclass_exact_match_stats(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> tuple:
+    """``(samplewise_match, sample_valid)`` — the sufficient statistics both
+    averaging modes (and the modular class's accumulator) are built from."""
+    pred_ind, targ_ind, valid = _multiclass_indicators(preds, target, num_classes, 1, ignore_index)
+    # position correct if the predicted one-hot matches the target one-hot
+    correct = jnp.sum(pred_ind * targ_ind, axis=1)  # (N, S)
+    v = valid[:, 0, :]
+    sample_match = jnp.all(jnp.logical_or(correct > 0, v == 0), axis=1).astype(jnp.float32)
+    # samples that are entirely ignored don't count
+    sample_valid = jnp.any(v > 0, axis=1).astype(jnp.float32)
+    return sample_match * sample_valid, sample_valid
+
+
 def multiclass_exact_match(
     preds: Array,
     target: Array,
@@ -36,16 +54,10 @@ def multiclass_exact_match(
     """Fraction of samples where EVERY (multidim) position is predicted correctly."""
     if validate_args:
         _multiclass_validate_args(num_classes, 1, None, multidim_average, ignore_index)
-    pred_ind, targ_ind, valid = _multiclass_indicators(preds, target, num_classes, 1, ignore_index)
-    # position correct if the predicted one-hot matches the target one-hot
-    correct = jnp.sum(pred_ind * targ_ind, axis=1)  # (N, S)
-    v = valid[:, 0, :]
-    sample_match = jnp.all(jnp.logical_or(correct > 0, v == 0), axis=1).astype(jnp.float32)
-    # samples that are entirely ignored don't count
-    sample_valid = jnp.any(v > 0, axis=1).astype(jnp.float32)
+    samplewise, sample_valid = _multiclass_exact_match_stats(preds, target, num_classes, ignore_index)
     if multidim_average == "global":
-        return _safe_divide(jnp.sum(sample_match * sample_valid), jnp.sum(sample_valid))
-    return sample_match * sample_valid
+        return _safe_divide(jnp.sum(samplewise), jnp.sum(sample_valid))
+    return samplewise
 
 
 def multilabel_exact_match(

@@ -63,7 +63,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchmetrics_tpu.core.reductions import Reduce, host_sync_leaf, sync_leaf
+from torchmetrics_tpu.core.reductions import Reduce, SketchReduce, host_sync_leaf, sync_leaf
 
 __all__ = [
     "Bucket",
@@ -181,6 +181,25 @@ def build_sync_plan(entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]
             if isinstance(value, tuple):
                 passthrough.append((e, name, reduce))
                 n_pass += len(value)
+                continue
+            if isinstance(reduce, SketchReduce):
+                # sketch leaves with an elementwise merge ride the matching
+                # fused dtype bucket exactly like SUM/MAX/MIN leaves; the
+                # structural ones (reservoirs) sync individually as one
+                # fixed-shape gather + in-graph combine
+                if reduce.bucket_op in _COLLECTIVE:
+                    shape = tuple(int(d) for d in value.shape)
+                    slot = _Slot(
+                        entry=e,
+                        name=name,
+                        shape=shape,
+                        size=int(np.prod(shape, dtype=np.int64)),
+                        mean=False,
+                    )
+                    groups.setdefault((str(jnp.dtype(value.dtype)), reduce.bucket_op), []).append(slot)
+                else:
+                    passthrough.append((e, name, reduce))
+                    n_pass += reduce.n_sync_gathers
                 continue
             if callable(reduce) and not isinstance(reduce, Reduce):
                 passthrough.append((e, name, reduce))

@@ -1,0 +1,47 @@
+"""Fixed-size, mergeable, trace-safe sketch states (ROADMAP Open item 1).
+
+Unbounded ``cat`` states make a metric's sync cost grow with sample count
+and mesh size (ragged ``all_gather``s — the dominant multi-device cost in
+BENCH_r05).  The sketches here are the bounded replacements: every one is a
+fixed-shape array pytree with pure ``init / insert_batch / merge / query``
+ops whose merge is elementwise (or fixed top-k), so cross-device sync
+lowers to ordinary ``psum``/``pmax`` leaves the coalescing planner buckets
+and fuses.
+
+Metrics opt in via ``Metric(approx="sketch", approx_error=...)`` — the
+default ``approx=None`` path stays bit-exact.  Each sketch documents its
+error bound; each exposes a ``reduce_spec`` (a
+:class:`~torchmetrics_tpu.core.reductions.SketchReduce`) to pass as
+``add_state(..., dist_reduce_fx=...)``.
+
+================  =====================================  ====================
+sketch            state / merge                          documented error
+================  =====================================  ====================
+QuantileSketch    ``(…, bins+1)`` histogram, ``+``       value/threshold
+                                                         resolution ``eps``
+HyperLogLog       ``(2^p,)`` registers, ``max``          ``1.04/sqrt(2^p)``
+                                                         RSE on distinct count
+CountMinSketch    ``(d, w)`` counters, ``+``             over ``<= e/w`` of
+                                                         total weight
+ReservoirSketch   ``(k, 1+F)`` bottom-k rows, sort+k     uniform k-sample
+                                                         (reweight by N/k)
+================  =====================================  ====================
+"""
+
+from torchmetrics_tpu.core.reductions import SketchReduce, is_sketch_reduce
+from torchmetrics_tpu.sketches.cardinality import CountMinSketch, HyperLogLog, mix32
+from torchmetrics_tpu.sketches.quantile import DEFAULT_APPROX_ERROR, QuantileSketch, bins_for_error
+from torchmetrics_tpu.sketches.reservoir import EMPTY_PRIORITY, ReservoirSketch
+
+__all__ = [
+    "CountMinSketch",
+    "DEFAULT_APPROX_ERROR",
+    "EMPTY_PRIORITY",
+    "HyperLogLog",
+    "QuantileSketch",
+    "ReservoirSketch",
+    "SketchReduce",
+    "bins_for_error",
+    "is_sketch_reduce",
+    "mix32",
+]

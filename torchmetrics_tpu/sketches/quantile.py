@@ -1,0 +1,197 @@
+"""Fixed-grid quantile sketch — bounded-memory score summaries for curve metrics.
+
+ROADMAP Open item 1: the exact ``thresholds=None`` path of the curve family
+(AUROC / ROC / PrecisionRecallCurve / AveragePrecision) accumulates every
+score in ragged ``cat`` states whose sync is an ``all_gather`` growing with
+sample count (BENCH_r04: 85 KB → 2.4 MB/chip from 2 → 32 chips).  This
+module replaces that with a *fixed-grid* quantile sketch: a weighted
+histogram over ``bins + 1`` cells of a known value range, held as one
+fixed-shape ``float32`` array.
+
+Why fixed-grid rather than KLL/GK compaction: curve-metric scores are
+probabilities with a known range ``[0, 1]``, so a uniform grid gives a hard,
+*deterministic* rank/value guarantee with a merge that is plain elementwise
+``+`` — trivially jit/vmap-traceable, associative, and lowered cross-device
+as one ``psum`` (the shape the coalescing planner buckets and fuses).
+KLL-style compaction needs data-dependent shapes or in-trace randomness,
+both of which the trace contract (TMT004/TMT006) bans.
+
+Guarantees (``eps = (hi - lo) / bins``, the grid spacing):
+
+* every cell boundary count is **exact**: ``tail_counts(hist)[i]`` is the
+  exact total weight of inserted values ``>= edges[i]`` (binning only loses
+  *within*-cell placement, never which side of a boundary a value lies on);
+* ``query(hist, q)`` returns a value within ``eps`` of some true
+  ``q'``-quantile with ``|q' - q| <=`` (mass of one cell);
+* for ROC/PR curves built from a ``(pos, neg)`` histogram pair, every
+  reported curve point lies **exactly on the exact curve** — the grid only
+  subsamples which thresholds are reported (spacing ``<= eps``);
+* trapezoidal AUROC deviates from exact by at most
+  ``auc_error_bound(hist)`` = ``0.5 * sum_b pos_frac_b * neg_frac_b``
+  (pairs falling in the same cell are scored as ties), which is ``<= eps``
+  for score distributions with bounded density.
+
+State layout: ``(*prefix, bins + 1)`` — cell ``i < bins`` covers
+``[edges[i], edges[i+1])`` and the last cell pins ``value == hi`` exactly
+(the same convention as the calibration-error binning).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from torchmetrics_tpu.core.reductions import SketchReduce
+
+__all__ = ["DEFAULT_APPROX_ERROR", "QuantileSketch", "bins_for_error"]
+
+#: default grid resolution for ``Metric(approx="sketch")`` when no
+#: ``approx_error`` is given: 1/200 → 201 curve thresholds, 804 bytes per
+#: histogram row — vs 12 bytes *per accumulated sample* for the exact path
+DEFAULT_APPROX_ERROR = 1.0 / 200.0
+
+
+def bins_for_error(eps: float, lo: float = 0.0, hi: float = 1.0) -> int:
+    """Cell count whose grid spacing over ``[lo, hi]`` is at most ``eps``."""
+    if not (0.0 < eps <= (hi - lo)):
+        raise ValueError(f"approx_error must be in (0, {hi - lo}], got {eps}")
+    return max(2, int(math.ceil((hi - lo) / eps)))
+
+
+@dataclass(frozen=True)
+class QuantileSketch:
+    """Static config of a fixed-grid quantile sketch (the state itself is a
+    plain array pytree — this object never holds data)."""
+
+    bins: int
+    lo: float = 0.0
+    hi: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.bins < 2:
+            raise ValueError(f"QuantileSketch needs bins >= 2, got {self.bins}")
+        if not self.hi > self.lo:
+            raise ValueError(f"QuantileSketch needs hi > lo, got [{self.lo}, {self.hi}]")
+
+    @classmethod
+    def for_error(cls, eps: Optional[float], lo: float = 0.0, hi: float = 1.0) -> "QuantileSketch":
+        """Sketch whose documented value/threshold resolution is ``<= eps``."""
+        return cls(bins=bins_for_error(DEFAULT_APPROX_ERROR if eps is None else eps, lo, hi), lo=lo, hi=hi)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def n_cells(self) -> int:
+        return self.bins + 1
+
+    @property
+    def eps(self) -> float:
+        """Grid spacing — the documented value resolution."""
+        return (self.hi - self.lo) / self.bins
+
+    @property
+    def edges(self) -> Array:
+        """``(bins + 1,)`` cell lower edges == the curve thresholds."""
+        return jnp.linspace(self.lo, self.hi, self.bins + 1, dtype=jnp.float32)
+
+    @property
+    def reduce_spec(self) -> SketchReduce:
+        """The ``dist_reduce_fx`` for a histogram leaf: merge == elementwise
+        sum, so cross-device sync rides the planner's fused sum bucket."""
+        return SketchReduce(kind="quantile", bucket_op="sum")
+
+    # -------------------------------------------------------------------- ops
+    def init(self, prefix: Tuple[int, ...] = (), dtype: jnp.dtype = jnp.float32) -> Array:
+        """Fresh empty histogram of shape ``(*prefix, bins + 1)``."""
+        return jnp.zeros((*prefix, self.n_cells), dtype=dtype)
+
+    def cell_index(self, values: Array) -> Array:
+        """int32 cell of each value (clipped into range; ``hi`` → last cell)."""
+        scaled = (values - self.lo) * (self.bins / (self.hi - self.lo))
+        return jnp.clip(jnp.floor(scaled), 0, self.bins).astype(jnp.int32)
+
+    def insert_batch(self, hist: Array, values: Array, weights: Optional[Array] = None) -> Array:
+        """Fold a batch into the histogram (pure; jit/vmap-traceable).
+
+        ``hist`` has shape ``(*prefix, bins + 1)``; ``values`` (and
+        ``weights``) have shape ``(batch, *prefix)`` — one scatter-add, no
+        data-dependent shapes.
+        """
+        if weights is None:
+            weights = jnp.ones(values.shape, hist.dtype)
+        prefix = hist.shape[:-1]
+        idx = self.cell_index(values)  # (batch, *prefix)
+        n_rows = int(np.prod(prefix, dtype=np.int64)) if prefix else 1
+        offsets = (jnp.arange(n_rows, dtype=jnp.int32) * self.n_cells).reshape(prefix)
+        flat_idx = (idx + offsets).reshape(-1)
+        flat = hist.reshape(-1).at[flat_idx].add(weights.astype(hist.dtype).reshape(-1))
+        return flat.reshape(hist.shape)
+
+    def merge(self, a: Array, b: Array) -> Array:
+        """Pairwise merge — exactly what ``SketchReduce(bucket_op='sum')``
+        lowers to in-graph (``psum`` across devices)."""
+        return a + b
+
+    def total(self, hist: Array) -> Array:
+        """Total inserted weight per prefix row: ``(*prefix,)``."""
+        return hist.sum(-1)
+
+    def cdf(self, hist: Array, x: Array) -> Array:
+        """Fraction of inserted weight with value ``< edges[cell(x)+1]``
+        (exact at cell boundaries, within one cell mass elsewhere)."""
+        cum = jnp.cumsum(hist, -1)
+        i = self.cell_index(x)
+        return jnp.take_along_axis(cum, i[..., None], axis=-1)[..., 0] / jnp.maximum(cum[..., -1], 1e-12)
+
+    def query(self, hist: Array, q) -> Array:
+        """Approximate ``q``-quantile value(s) per prefix row.
+
+        Returns the smallest grid edge whose cumulative mass reaches
+        ``q * total`` — within ``eps`` of a true quantile whose rank differs
+        from ``q`` by at most one cell's mass fraction.
+        """
+        q = jnp.asarray(q, hist.dtype)
+        cum = jnp.cumsum(hist, -1)  # (*prefix, C)
+        target = q[..., None] * cum[..., -1:] if q.ndim else q * cum[..., -1:]
+        i = jnp.sum(cum < target, axis=-1)  # first cell where cum >= target
+        return self.edges[jnp.clip(i, 0, self.bins)]
+
+    # ----------------------------------------------------- curve-metric hooks
+    def tail_counts(self, hist: Array) -> Array:
+        """``out[..., i]`` = exact total weight of values ``>= edges[i]``."""
+        return jnp.flip(jnp.cumsum(jnp.flip(hist, -1), -1), -1)
+
+    def curve_confmat(self, hist: Array) -> Array:
+        """Per-threshold confusion counts from a (neg, pos) histogram pair.
+
+        ``hist`` has shape ``(*prefix, 2, bins + 1)`` with axis ``-2``
+        indexing target ∈ {0: negative, 1: positive}; returns the binned-path
+        confusion layout ``(bins + 1, *prefix, 2, 2)`` indexed
+        ``[threshold, ..., target, pred]`` where ``pred = score >= edge`` —
+        numerically the state ``_binned_curve_update`` would have produced
+        at ``thresholds=edges``.
+        """
+        tail = self.tail_counts(hist)  # (*prefix, 2, C): weight >= edge per target
+        total = hist.sum(-1, keepdims=True)  # (*prefix, 2, 1)
+        pred1 = jnp.moveaxis(tail, -1, 0)  # (C, *prefix, 2)
+        pred0 = jnp.moveaxis(total - tail, -1, 0)
+        return jnp.stack([pred0, pred1], axis=-1)
+
+    def auc_error_bound(self, hist: Array) -> Array:
+        """Data-dependent bound on ``|AUROC_sketch - AUROC_exact|``.
+
+        Positive/negative pairs landing in *different* cells are ordered
+        identically by both paths; a pair in the *same* cell is scored as a
+        tie (½) by the sketch but may be ordered either way exactly — so the
+        trapezoidal AUC deviates by at most ``0.5 * sum_b p_b * n_b`` where
+        ``p_b``/``n_b`` are the cell's positive/negative mass fractions.
+        ``hist``: ``(*prefix, 2, bins + 1)`` → bound per prefix row.
+        """
+        neg, pos = hist[..., 0, :], hist[..., 1, :]
+        p = pos / jnp.maximum(pos.sum(-1, keepdims=True), 1e-12)
+        n = neg / jnp.maximum(neg.sum(-1, keepdims=True), 1e-12)
+        return 0.5 * jnp.sum(p * n, axis=-1)

@@ -1,0 +1,122 @@
+"""Deterministic bottom-k reservoir — the bounded surrogate for per-example states.
+
+Detection-style metrics (mAP) keep one variable-length record per example
+(score, label, match flags, …) in ``cat`` states, which is the single most
+expensive sync in BENCH_r05 (12.1 ms/step on 8 devices).  This reservoir
+bounds that state at ``capacity`` records while staying *deterministic* and
+*mergeable*:
+
+* each record's priority is a seeded hash of its integer key (TMT006: no
+  wall-clock RNG — the same record always draws the same priority, on every
+  replica, in every trace);
+* the reservoir keeps the ``capacity`` smallest priorities ("bottom-k by
+  hash", i.e. KMV sampling) — a fixed-shape sort-and-slice, so insert and
+  merge are jit-traceable with static shapes;
+* merge of any number of reservoirs = sort the union, keep k.  With distinct
+  keys this is exactly associative and order-independent: merging per-device
+  reservoirs equals the reservoir of the single concatenated stream —
+  property-tested in ``tests/unittests/sketches``.
+
+Cross-device sync is declared via ``reduce_spec`` as a structural
+:class:`~torchmetrics_tpu.core.reductions.SketchReduce`: ONE *fixed-shape*
+``all_gather`` of ``(capacity, 1 + fields)`` floats plus the in-graph
+``combine_stacked`` — bounded traffic regardless of how many examples were
+accumulated, vs. a ragged gather growing with sample count.
+
+The sample is uniform over distinct keys, so downstream estimators reweight
+by ``total_seen / capacity`` (track ``total_seen`` as an ordinary SUM leaf);
+:meth:`scale_factor` packages that correction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.reductions import SketchReduce
+from torchmetrics_tpu.sketches.cardinality import mix32
+
+__all__ = ["EMPTY_PRIORITY", "ReservoirSketch"]
+
+#: priority of an unfilled slot — sorts after every real priority in [0, 1)
+EMPTY_PRIORITY = 2.0
+
+
+@dataclass(frozen=True)
+class ReservoirSketch:
+    """Static config of a bottom-k reservoir of ``(priority, *fields)`` rows.
+
+    State layout: ``(capacity, 1 + fields)`` float32 — column 0 is the
+    hash-derived priority, columns ``1:`` the user payload.  Unfilled slots
+    carry :data:`EMPTY_PRIORITY` and zero payload.
+    """
+
+    capacity: int
+    fields: int
+    seed: int = 0x01000193
+
+    def __post_init__(self) -> None:
+        if self.capacity < 1:
+            raise ValueError(f"ReservoirSketch needs capacity >= 1, got {self.capacity}")
+        if self.fields < 1:
+            raise ValueError(f"ReservoirSketch needs fields >= 1, got {self.fields}")
+
+    @property
+    def row_width(self) -> int:
+        return 1 + self.fields
+
+    @property
+    def reduce_spec(self) -> SketchReduce:
+        return SketchReduce(kind="reservoir", bucket_op=None, combine_stacked=self.combine_stacked)
+
+    def init(self) -> Array:
+        empty = jnp.full((self.capacity, 1), EMPTY_PRIORITY, dtype=jnp.float32)
+        return jnp.concatenate([empty, jnp.zeros((self.capacity, self.fields), jnp.float32)], axis=1)
+
+    def priority(self, keys: Array) -> Array:
+        """Deterministic uniform-[0, 1) priority of each integer key."""
+        return mix32(keys, self.seed).astype(jnp.float32) * jnp.float32(2.0**-32)
+
+    def insert_batch(self, reservoir: Array, records: Array, keys: Array) -> Array:
+        """Fold ``(n, fields)`` records (keyed by ``(n,)`` integer keys) in:
+        sort the ``capacity + n`` candidate rows by priority, keep bottom-k —
+        pure, static shapes."""
+        pri = self.priority(keys.reshape(-1))
+        cand = jnp.concatenate([pri[:, None], records.astype(jnp.float32)], axis=1)
+        merged = jnp.concatenate([reservoir, cand], axis=0)
+        order = jnp.argsort(merged[:, 0], stable=True)[: self.capacity]
+        return merged[order]
+
+    def combine_stacked(self, stacked: Array) -> Array:
+        """Merge ``(m, capacity, 1 + fields)`` stacked reservoirs into one —
+        the ``SketchReduce.combine_stacked`` hook (pairwise merge and
+        cross-device sync both lower to this)."""
+        merged = stacked.reshape(-1, self.row_width)
+        order = jnp.argsort(merged[:, 0], stable=True)[: self.capacity]
+        return merged[order]
+
+    def merge(self, a: Array, b: Array) -> Array:
+        return self.combine_stacked(jnp.stack([a, b]))
+
+    # ------------------------------------------------------------- inspection
+    def count(self, reservoir: Array) -> Array:
+        """Number of real (non-empty) rows currently held."""
+        return jnp.sum(reservoir[:, 0] < 1.5).astype(jnp.int32)
+
+    def payload(self, reservoir: Array) -> Array:
+        """``(capacity, fields)`` user columns (empty rows are zero)."""
+        return reservoir[:, 1:]
+
+    def valid_mask(self, reservoir: Array) -> Array:
+        """``(capacity,)`` bool — True where the row holds a real record."""
+        return reservoir[:, 0] < 1.5
+
+    def scale_factor(self, reservoir: Array, total_seen: Array) -> Array:
+        """Per-record estimator weight ``total_seen / kept`` — multiply any
+        sum over kept records by this to estimate the full-stream sum
+        (``total_seen`` comes from a companion SUM-reduced counter leaf)."""
+        kept = jnp.maximum(self.count(reservoir).astype(jnp.float32), 1.0)
+        return total_seen.astype(jnp.float32) / kept

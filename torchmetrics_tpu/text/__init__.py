@@ -11,6 +11,7 @@ from torchmetrics_tpu.text.asr import (
 from torchmetrics_tpu.text.bert import BERTScore
 from torchmetrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
 from torchmetrics_tpu.text.chrf import CHRFScore
+from torchmetrics_tpu.text.distinct import DistinctNGrams
 from torchmetrics_tpu.text.eed import ExtendedEditDistance
 from torchmetrics_tpu.text.infolm import InfoLM
 from torchmetrics_tpu.text.perplexity import Perplexity
@@ -23,6 +24,7 @@ __all__ = [
     "BLEUScore",
     "CharErrorRate",
     "CHRFScore",
+    "DistinctNGrams",
     "EditDistance",
     "ExtendedEditDistance",
     "InfoLM",

@@ -1,0 +1,129 @@
+"""Distinct n-gram ratio over token-id streams.
+
+No reference-torchmetrics counterpart — this is the repo's cardinality
+dogfood metric (ROADMAP Open item 1): "how many distinct n-grams did the
+model generate" is the canonical unbounded-``cat``-state problem, since the
+exact answer needs every n-gram kept until ``compute``.  Two modes:
+
+* exact (default): ``cat`` state of ``(windows, n)`` int32 n-gram rows;
+  ``compute`` sorts lexicographically and counts row changes — exact, but
+  state (and its cross-device ``all_gather``) grows with every token.
+* ``approx="sketch"``: a fixed :class:`~torchmetrics_tpu.sketches.HyperLogLog`
+  register array (merge/sync = elementwise ``pmax``) plus a scalar window
+  counter — bounded state, documented ``~1.04/sqrt(m)`` relative error on
+  the distinct count.
+
+Both modes share one windowing/masking path, and invalid windows (any token
+== ``ignore_index``) are dropped statically: exact mode rewrites them to a
+sentinel row sorted last, sketch mode zeroes their HLL rank.
+
+Example::
+
+    >>> import jax.numpy as jnp
+    >>> from torchmetrics_tpu.text import DistinctNGrams
+    >>> metric = DistinctNGrams(ngram=2)
+    >>> metric.update(jnp.asarray([[3, 5, 3, 5, 3]]))
+    >>> round(float(metric.compute()), 4)  # windows: (3,5) (5,3) (3,5) (5,3)
+    0.5
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from torchmetrics_tpu.core.metric import Metric, State
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+#: sentinel token for invalid windows in the exact cat state — larger than
+#: any real int32 token id once compared as int64 column keys
+_SENTINEL = jnp.int32(-1)
+
+
+class DistinctNGrams(Metric):
+    """Fraction of generated n-grams that are distinct (type/token ratio).
+
+    Args:
+        ngram: window length (1 = distinct tokens).
+        ignore_index: token id to treat as padding; windows containing it
+            are excluded from both the distinct and total counts.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    #: HyperLogLog when ``approx="sketch"`` replaced the cat state
+    _hll = None
+
+    def __init__(self, ngram: int = 1, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(ngram, int) and ngram >= 1):
+            raise ValueError(f"Argument `ngram` expected to be an integer >= 1, but got {ngram}")
+        self.ngram = ngram
+        self.ignore_index = ignore_index
+        if self.approx == "sketch":
+            from torchmetrics_tpu.sketches import HyperLogLog
+
+            self._hll = HyperLogLog.for_error(self.approx_error)
+            self.add_state("registers", self._hll.init(), dist_reduce_fx=self._hll.reduce_spec)
+        else:
+            self.add_state("ngrams", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    # ------------------------------------------------------------- windowing
+    def _windows(self, tokens: Array):
+        """``(rows, n)`` stacked n-gram windows + ``(rows,)`` validity mask."""
+        tokens = jnp.atleast_2d(jnp.asarray(tokens, jnp.int32))
+        if tokens.shape[-1] < self.ngram:
+            raise ValueError(
+                f"DistinctNGrams(ngram={self.ngram}) needs sequences of at least {self.ngram} "
+                f"tokens, got shape {tokens.shape}"
+            )
+        span = tokens.shape[-1] - self.ngram + 1
+        win = jnp.stack([tokens[..., k : k + span] for k in range(self.ngram)], axis=-1)
+        win = win.reshape(-1, self.ngram)  # (rows, n)
+        if self.ignore_index is None:
+            valid = jnp.ones((win.shape[0],), bool)
+        else:
+            valid = jnp.all(win != jnp.int32(self.ignore_index), axis=-1)
+        return win, valid
+
+    def _keys(self, windows: Array) -> Array:
+        """One uint32 key per window: chained avalanche mix over the tokens."""
+        from torchmetrics_tpu.sketches import mix32
+
+        h = jnp.full((windows.shape[0],), 0, jnp.uint32)
+        for k in range(self.ngram):
+            h = mix32(windows[:, k].astype(jnp.uint32) + h, jnp.uint32(0x9E3779B9) * jnp.uint32(k + 1))
+        return h
+
+    # ---------------------------------------------------------------- update
+    def _update(self, state: State, preds: Array) -> State:
+        win, valid = self._windows(preds)
+        total = state["total"] + valid.sum()
+        if self._hll is not None:
+            return {"registers": self._hll.insert_batch(state["registers"], self._keys(win), mask=valid), "total": total}
+        win = jnp.where(valid[:, None], win, _SENTINEL)
+        return {"ngrams": tuple(state["ngrams"]) + (win,), "total": total}
+
+    # --------------------------------------------------------------- compute
+    def _compute(self, state: State) -> Array:
+        total = jnp.maximum(state["total"], 1.0)
+        if self._hll is not None:
+            return jnp.clip(self._hll.estimate(state["registers"]) / total, 0.0, 1.0)
+        rows = dim_zero_cat(state["ngrams"])  # (rows, n)
+        # lexicographic sort via one int64 rank per column pass (static
+        # shapes; last key first, stable) — sentinel rows group together
+        order = jnp.arange(rows.shape[0])
+        for col in range(rows.shape[1] - 1, -1, -1):
+            order = order[jnp.argsort(rows[order, col], stable=True)]
+        srt = rows[order]
+        valid = srt[:, 0] != _SENTINEL
+        changed = jnp.concatenate([jnp.ones((1,), bool), jnp.any(srt[1:] != srt[:-1], axis=-1)])
+        distinct = jnp.sum(changed & valid)
+        return distinct / total

@@ -17,7 +17,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
-from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.core.reductions import Reduce, SketchReduce
 
 __all__ = [
     "RING_GRANULE_BYTES",
@@ -67,15 +67,26 @@ def state_bytes(state: Dict[str, Any]) -> int:
     return total
 
 
+def _is_psum_shaped(reduce: Any) -> bool:
+    """True when one sync of this leaf rides a ring all-reduce: the
+    psum-family reductions plus sketch leaves with an elementwise merge
+    (``SketchReduce.bucket_op``); structural sketches and cat/None/callable
+    leaves pay the gather model instead."""
+    if isinstance(reduce, SketchReduce):
+        return reduce.bucket_op is not None
+    return reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN)
+
+
 def split_state_bytes(reductions: Dict[str, Any], state: Dict[str, Any]) -> tuple:
     """``(psum_bytes, gather_bytes)`` of a state under its reduction table:
-    sum/mean/max/min leaves all-reduce; cat/None/callable leaves all_gather
-    (matching what ``core.reductions.sync_leaf`` lowers each to)."""
+    sum/mean/max/min and bucketed sketch leaves all-reduce; cat/None/
+    callable/reservoir leaves all_gather (matching what
+    ``core.reductions.sync_leaf`` lowers each to)."""
     psum_b = gather_b = 0
     for name, reduce in reductions.items():
         leaf = state[name]
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
-        if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN):
+        if _is_psum_shaped(reduce):
             psum_b += nbytes
         else:
             gather_b += nbytes
@@ -145,9 +156,7 @@ def per_leaf_sync_bytes_per_chip(
     for name, reduce in reductions.items():
         leaf = state[name]
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
-        if reduce in (Reduce.SUM, Reduce.MEAN, Reduce.MAX, Reduce.MIN) and not isinstance(
-            leaf, tuple
-        ):
+        if _is_psum_shaped(reduce) and not isinstance(leaf, tuple):
             total += ring_reduce_bytes(nbytes, n_devices, granule)
         else:
             total += (n_devices - 1) * nbytes

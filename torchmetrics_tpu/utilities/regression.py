@@ -71,6 +71,7 @@ _LOWER_BETTER = (
     "_misses",
     "_collectives",
     "findings",
+    "_err",  # sketch-vs-exact error legs (abs err, error bounds)
 )
 #: keys where a HIGHER value is better (gate on decreases)
 _HIGHER_BETTER = ("cut", "speedup", "drop_pct", "fused_to", "prometheus_lines")
