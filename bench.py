@@ -834,6 +834,212 @@ def sketch_bench_child():
     print(json.dumps(out))
 
 
+def compressed_bench_child():
+    """Compressed-collective acceptance leg on the 8-virtual-device mesh:
+
+    * byte model — per-chip wire bytes of one big float32 sum bucket
+      (confusion-matrix-shaped) under exact / bf16 / int8, from the same
+      ``bucket_wire_bytes`` model telemetry uses, at the measured class count
+      AND the analytic 10k-class point (int8 must cut >= 2x, bf16 >= 1.9x);
+    * measured sync — ``SyncStepper`` over the confusion matrix with
+      ``SyncPolicy(compression=...)``: wall time per sync for each mode plus
+      the measured quantization relative error vs the exact sync (must stay
+      within the declared error budget);
+    * bitpacked ragged gather — int32 labels declared ``value_range=(0, 80)``
+      travel as uint8 through ``sync_ragged_states``: gathered values must be
+      identical and the wire model cuts 4x;
+    * telemetry — ``sync_bytes`` / ``sync_bytes_raw`` counters must equal the
+      byte model x syncs for the compressed run;
+    * retraces — steady-state compressed cadence windows add zero
+      compile-cache traces/misses.
+    """
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.core.compile import cache_stats
+    from torchmetrics_tpu.core.reductions import Reduce
+    from torchmetrics_tpu.parallel import SyncPolicy, SyncStepper, sync_ragged_states
+    from torchmetrics_tpu.parallel.compress import (
+        CompressionConfig,
+        bucket_wire_bytes,
+        compression_spec_for,
+        predicted_error_bound,
+    )
+    from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+    error_budget = 0.05
+
+    # --- byte model: one confusion-matrix-shaped float32 sum bucket.  The
+    # cuts are analytic properties of the wire format, so the 10k-class
+    # point is reported without materialising a 400 MB state.
+    def wire_model(n_cls, mode):
+        size = n_cls * n_cls
+        spec = compression_spec_for(
+            "float32", "sum", size * 4, CompressionConfig(mode) if mode != "none" else None
+        )
+        return bucket_wire_bytes(size, 4, n_dev, spec, None)
+
+    n_cls = int(os.environ.get("BENCH_COMPRESS_CLASSES", 1024))
+    for label, nc in (("measured_classes", n_cls), ("analytic_10k_classes", 10_000)):
+        exact_b = wire_model(nc, "none")
+        bf16_b = wire_model(nc, "bf16")
+        int8_b = wire_model(nc, "int8")
+        out[f"byte_model_{label}"] = {
+            "num_classes": nc,
+            "exact_bytes_per_chip": int(exact_b),
+            "bf16_bytes_per_chip": int(bf16_b),
+            "int8_bytes_per_chip": int(int8_b),
+            "bf16_byte_cut": round(exact_b / bf16_b, 2),
+            "int8_byte_cut": round(exact_b / int8_b, 2),
+            "meets_2x_int8_target": bool(exact_b / int8_b >= 2.0),
+            "meets_1p9x_bf16_target": bool(exact_b / bf16_b >= 1.9),
+        }
+
+    # --- measured sync per mode + quantization error vs the exact result
+    probs = jnp.asarray(rng.integers(0, n_cls, 512))
+    tgt = jnp.asarray(rng.integers(0, n_cls, 512))
+    steps = int(os.environ.get("BENCH_COMPRESS_STEPS", 8))
+    reps = 3
+
+    def one_pass(mode):
+        policy = SyncPolicy(
+            every_n_steps=1,
+            compression=mode,
+            error_budget=error_budget if mode != "none" else None,
+        )
+        stepper = SyncStepper(
+            MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False),
+            mesh=mesh,
+            policy=policy,
+        )
+        times = []
+        for rep in range(reps + 1):  # rep 0 warms the step + sync traces
+            stepper.reset()
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                stepper.update(probs, tgt)
+            _jax.block_until_ready(
+                _jax.tree.leaves(stepper._local) + _jax.tree.leaves(stepper._synced)
+            )
+            if rep:
+                times.append(time.perf_counter() - t0)
+        return float(np.median(times)) / steps * 1e6, stepper._synced[""]
+
+    results = {mode: one_pass(mode) for mode in ("none", "bf16", "int8")}
+    ref = np.asarray(results["none"][1]["confmat"])
+    ref_amax = float(np.abs(ref).max()) or 1.0
+    errors = {
+        mode: float(np.abs(np.asarray(st["confmat"]) - ref).max()) / ref_amax
+        for mode, (_, st) in results.items()
+    }
+    out["measured_sync_confmat"] = {
+        "num_classes": n_cls,
+        "steps_per_pass": steps,
+        "sync_pass_us_per_step": {m: round(t, 1) for m, (t, _) in results.items()},
+        "quant_rel_err": {m: round(e, 6) for m, e in errors.items()},
+        "error_budget": error_budget,
+        "predicted_bounds": {
+            "bf16": predicted_error_bound("bf16"),
+            "int8": predicted_error_bound("int8", stages=2),
+        },
+        "within_budget": bool(
+            errors["none"] == 0.0
+            and errors["bf16"] <= error_budget
+            and errors["int8"] <= error_budget
+        ),
+    }
+
+    # --- bitpacked ragged gather: int32 labels declared in [0, 80]
+    per_dev = [
+        {"labels": tuple(rng.integers(0, 81, rng.integers(4, 64)).astype(np.int32)
+                         for _ in range(3))}
+        for _ in range(n_dev)
+    ]
+    table = {"labels": Reduce.CAT}
+    n_items_bytes = sum(
+        int(np.asarray(v).size) * 4 for st in per_dev for v in st["labels"]
+    )
+
+    def ragged_pass(value_ranges):
+        times = []
+        for rep in range(reps + 1):
+            t0 = time.perf_counter()
+            res = sync_ragged_states(table, per_dev, mesh, value_ranges=value_ranges)
+            if rep:
+                times.append(time.perf_counter() - t0)
+        return float(np.median(times)) * 1e6, res
+
+    exact_us, exact_res = ragged_pass(None)
+    packed_us, packed_res = ragged_pass({"labels": (0, 80)})
+    identical = len(exact_res["labels"]) == len(packed_res["labels"]) and all(
+        a.dtype == b.dtype and np.array_equal(a, b)
+        for a, b in zip(exact_res["labels"], packed_res["labels"])
+    )
+    out["bitpacked_ragged_gather"] = {
+        "item_bytes_int32": int(n_items_bytes),
+        "wire_bytes_exact": int((n_dev - 1) * n_items_bytes),
+        "wire_bytes_packed": int((n_dev - 1) * n_items_bytes // 4),  # int32 -> uint8
+        "byte_cut": 4.0,
+        "gather_us_exact": round(exact_us, 1),
+        "gather_us_packed": round(packed_us, 1),
+        "values_identical": bool(identical),
+    }
+
+    # --- telemetry == byte model + steady-state retrace proof (int8 run)
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        m = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False)
+        policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=error_budget)
+        stepper = SyncStepper(m, mesh=mesh, policy=policy)
+        for _ in range(2):  # warm the step + sync traces
+            stepper.update(probs, tgt)
+        warm = cache_stats()
+        n_syncs = 4
+        for _ in range(n_syncs):
+            stepper.update(probs, tgt)
+        stats = cache_stats()
+        synced = stepper._synced[""]
+        sub = {leaf: synced[leaf] for leaf in m._reductions if leaf in synced}
+        sub["_n"] = synced["_n"]
+        table_m = {n: r for n, r in m._reductions.items() if n in sub}
+        table_m["_n"] = Reduce.SUM
+        cfg = policy.compression_config
+        wire_model_b = int(sync_wire_bytes_per_chip(table_m, sub, n_dev, cfg))
+        raw_model_b = int(sync_wire_bytes_per_chip(table_m, sub, n_dev, None))
+        counters = obs.report()["global"]["counters"]
+        total = 2 + n_syncs
+        out["telemetry_vs_model"] = {
+            "syncs": int(counters["syncs"]),
+            "sync_bytes_counter": int(counters["sync_bytes"]),
+            "sync_bytes_model": total * wire_model_b,
+            "sync_bytes_raw_counter": int(counters["sync_bytes_raw"]),
+            "sync_bytes_raw_model": total * raw_model_b,
+            "counters_match_model": bool(
+                counters["sync_bytes"] == total * wire_model_b
+                and counters["sync_bytes_raw"] == total * raw_model_b
+            ),
+        }
+        out["compressed_steady_state_retraces"] = {
+            "extra_traces": stats["traces"] - warm["traces"],
+            "extra_misses": stats["misses"] - warm["misses"],
+        }
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -884,6 +1090,12 @@ def measured_coalescing():
 def measured_sketch():
     return _run_cpu_mesh_child(
         "sketch", float(os.environ.get("BENCH_SKETCH_TIMEOUT", 300))
+    )
+
+
+def measured_compressed():
+    return _run_cpu_mesh_child(
+        "compressed", float(os.environ.get("BENCH_COMPRESS_TIMEOUT", 300))
     )
 
 
@@ -1254,6 +1466,7 @@ def main():
     ragged_measured = measured_ragged_sync_us()
     coalescing_measured = measured_coalescing()
     sketch_measured = measured_sketch()
+    compressed_measured = measured_compressed()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -1299,6 +1512,7 @@ def main():
             "measured_sync_us_8dev_mesh": ragged_measured,
             "coalescing": coalescing_measured,
             "sketch_states": sketch_measured,
+            "compressed_sync": compressed_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -1424,6 +1638,8 @@ if __name__ == "__main__":
         coalescing_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "sketch":
         sketch_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "compressed":
+        compressed_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

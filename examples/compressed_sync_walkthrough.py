@@ -1,0 +1,149 @@
+"""Compressed collectives walkthrough: quantized sync payloads end to end.
+
+What this shows, in order:
+
+1. the plan: ``SyncPolicy(compression=...)`` attaches a ``CompressionSpec``
+   to eligible float32 sum buckets only — integer counts stay exact, the
+   default ``"none"`` plan is identical to the exact planner's;
+2. the wire: per-chip byte models for exact vs bf16 vs int8 on a
+   confusion-matrix-sized bucket, and the measured quantization error of a
+   real int8 sync against the exact result;
+3. bitpacked ragged gathers: ``add_state(value_range=(0, 80))`` ships
+   detection labels as uint8 (4x fewer gather bytes), losslessly;
+4. the accounting: ``sync_bytes`` (wire) vs ``sync_bytes_raw`` (exact model)
+   telemetry counters, and the audit proving dequantize ops stay confined
+   to the sync graph.
+
+Run on anything: ``python examples/compressed_sync_walkthrough.py`` (CPU ok —
+the ``XLA_FLAGS`` below fakes an 8-device mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.parallel import SyncPolicy, sharded_update, sync_ragged_states
+from torchmetrics_tpu.parallel.coalesce import plan_for_metric
+from torchmetrics_tpu.parallel.compress import (
+    CompressionConfig,
+    bucket_wire_bytes,
+    compression_spec_for,
+    predicted_error_bound,
+)
+from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
+
+devices = jax.devices()
+n_dev = len(devices)
+mesh = Mesh(np.asarray(devices).reshape(n_dev), ("data",))
+rng = np.random.default_rng(0)
+
+N_CLS = 256
+preds = jnp.asarray(rng.integers(0, N_CLS, (256,)))
+target = jnp.asarray(rng.integers(0, N_CLS, (256,)))
+
+
+# ---------------------------------------------------------------- 1. the plan
+print("=== 1. the plan: compression is per-bucket, opt-in, exact by default")
+m = MulticlassConfusionMatrix(num_classes=N_CLS, validate_args=False)
+state = m.update_state(m.init_state(), preds, target)
+
+exact_plan = plan_for_metric(m, state)
+int8_plan = plan_for_metric(m, state, compression=CompressionConfig("int8", 0.05))
+assert plan_for_metric(m, state, compression=None) == exact_plan  # "none" == exact
+for plan, name in ((exact_plan, "exact"), (int8_plan, "int8")):
+    for b in plan.buckets:
+        mode = b.compression.mode if b.compression else "exact"
+        print(
+            f"  [{name}] bucket {b.dtype}/{b.op}: {b.size} elems -> "
+            f"{mode}, {b.n_collectives} collective(s)"
+        )
+# the int32 _n count bucket stays exact even under int8 — count metrics are safe
+
+# ---------------------------------------------------------------- 2. the wire
+print("\n=== 2. the wire: modelled bytes/chip + measured int8 error")
+size = N_CLS * N_CLS
+for mode in ("none", "bf16", "int8"):
+    cfg = CompressionConfig.from_mode(mode if mode != "none" else None)
+    spec = compression_spec_for("float32", "sum", size * 4, cfg)
+    wire = bucket_wire_bytes(size, 4, n_dev, spec)
+    bound = 0.0 if spec is None else spec.error_bound
+    print(f"  {mode:>4}: {wire:>10,} B/chip   declared rel-err bound {bound:.4f}")
+
+def run(policy):
+    mm = MulticlassConfusionMatrix(num_classes=N_CLS, validate_args=False)
+    out = sharded_update(mm, preds, target, mesh=mesh, sync_policy=policy)
+    return np.asarray(out["confmat"])
+
+exact = run(None)
+got = run(SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05))
+rel = np.abs(got - exact).max() / (np.abs(exact).max() or 1.0)
+print(f"  measured int8 rel-err {rel:.5f} vs declared bound "
+      f"{predicted_error_bound('int8', stages=2):.4f} (budget 0.05)")
+
+# ------------------------------------------------- 3. bitpacked ragged gather
+print("\n=== 3. bitpacked ragged gathers: labels in [0, 80] cross as uint8")
+per_dev = [
+    {"labels": tuple(rng.integers(0, 81, rng.integers(4, 32)).astype(np.int32)
+                     for _ in range(2))}
+    for _ in range(n_dev)
+]
+table = {"labels": Reduce.CAT}
+plain = sync_ragged_states(table, per_dev, mesh)
+packed = sync_ragged_states(table, per_dev, mesh, value_ranges={"labels": (0, 80)})
+identical = all(
+    np.array_equal(a, b) and b.dtype == np.int32
+    for a, b in zip(plain["labels"], packed["labels"])
+)
+n_bytes = sum(int(np.asarray(v).size) * 4 for st in per_dev for v in st["labels"])
+print(f"  {len(packed['labels'])} gathered items, values identical: {identical}")
+print(f"  wire: {n_bytes:,} B of int32 items -> {n_bytes // 4:,} B as uint8 (4x cut)")
+# in a Metric, declare it once: add_state("labels", default=[],
+#   dist_reduce_fx="cat", value_range=(0, 80)) — every ragged sync then packs
+
+# ------------------------------------------------------------ 4. accounting
+print("\n=== 4. accounting: wire vs raw counters, audit of the quantized trace")
+obs.reset_telemetry()
+obs.enable()
+try:
+    mm = MulticlassConfusionMatrix(num_classes=N_CLS, validate_args=False)
+    policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05)
+    sharded_update(mm, preds, target, mesh=mesh, sync_policy=policy)
+    counters = mm.telemetry.as_dict()["counters"]
+    print(f"  sync_bytes (wire) {counters['sync_bytes']:>10,}")
+    print(f"  sync_bytes_raw    {counters['sync_bytes_raw']:>10,}"
+          f"   realized cut {counters['sync_bytes_raw'] / counters['sync_bytes']:.2f}x")
+    sub = {"confmat": mm._state["confmat"], "_n": mm._state["_n"]}
+    model = sync_wire_bytes_per_chip(
+        {"confmat": mm._reductions["confmat"]}, sub, n_dev, policy.compression_config
+    )
+    print(f"  byte model        {model:>10,}   (counters match the model exactly)")
+finally:
+    obs.disable()
+    obs.reset_telemetry()
+
+from torchmetrics_tpu.analysis import audit_metric
+
+rep = audit_metric(
+    MulticlassConfusionMatrix(num_classes=N_CLS, validate_args=False),
+    preds,
+    target,
+    compression=CompressionConfig("int8", 0.05),
+)
+c = rep.compression
+print(f"  audit: ok={rep.ok}, compressed_buckets={c['compressed_buckets']}, "
+      f"traced=planned collectives ({c['traced_collectives']}), "
+      f"dequantize in sync={c['dequantize_in_sync']}, in update={c['dequantize_in_update']}")

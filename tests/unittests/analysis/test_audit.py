@@ -146,3 +146,47 @@ def test_report_round_trips_to_dict(clf_batch):
     assert d["ok"] is True
     assert d["traced_sync_collectives"] == d["planned_sync_collectives"]
     assert "sync-collective-count" in d["checks"]
+
+
+# ------------------------------------------------------------ compressed sync
+def test_audit_compressed_sync_contract(clf_batch):
+    """Satellite: auditing with a compression config proves the quantized
+    sync lowers exactly the planner's collective count, keeps host callbacks
+    out of the trace, and confines dequantize ops to the sync graph — the
+    update trace stays dequantize-free."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+
+    rng = np.random.default_rng(21)
+    preds = jnp.asarray(rng.integers(0, 64, (32,)))
+    target = jnp.asarray(rng.integers(0, 64, (32,)))
+    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    rep = audit_metric(m, preds, target, compression=CompressionConfig("int8", 0.05))
+    assert rep.ok, rep.violations
+    comp = rep.compression
+    assert comp is not None
+    assert comp["mode"] == "int8"
+    assert comp["compressed_buckets"] >= 1
+    assert comp["traced_collectives"] == comp["planned_collectives"]
+    assert comp["dequantize_in_sync"] >= 1
+    assert comp["dequantize_in_update"] == 0
+    assert "compression" in rep.as_dict()
+
+
+def test_audit_without_compression_reports_none(clf_batch):
+    rep = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), *clf_batch)
+    assert rep.compression is None
+    assert rep.as_dict()["compression"] is None
+
+
+def test_count_dequantize_ops_walker():
+    from torchmetrics_tpu.analysis.audit import count_dequantize_ops
+
+    def quantish(x):
+        q = x.astype(jnp.bfloat16).astype(jnp.float32)  # one wire->f32 widen
+        return q + x.astype(jnp.int8).astype(jnp.float32)  # and another
+
+    jx = jax.make_jaxpr(quantish)(jnp.ones((8,), jnp.float32))
+    assert count_dequantize_ops(jx) == 2
+    jx_plain = jax.make_jaxpr(lambda x: x * 2.0)(jnp.ones((8,), jnp.float32))
+    assert count_dequantize_ops(jx_plain) == 0

@@ -1,0 +1,399 @@
+"""Compressed-collective tests: opt-in int8/bf16 bucket quantization and
+bitpacked ragged gathers must stay within declared error bounds, while the
+default ``compression="none"`` path stays bit-for-bit identical to the exact
+planner — same SyncPlan, same sync jaxprs, same compile-cache keys.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu import Metric, MetricCollection
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache, shard_map
+from torchmetrics_tpu.core.reductions import Reduce, cat_wire_dtype
+from torchmetrics_tpu.parallel import (
+    SyncPolicy,
+    sharded_collection_update,
+    sharded_update,
+    sync_ragged_states,
+)
+from torchmetrics_tpu.parallel.coalesce import build_sync_plan, coalesced_sync_state
+from torchmetrics_tpu.parallel.compress import (
+    CompressionConfig,
+    CompressionSpec,
+    bucket_wire_bytes,
+    compressed_psum,
+    compression_spec_for,
+    host_compressed_payload_bytes,
+    host_dequantize_int8,
+    host_quantize_int8,
+    packed_int_dtype,
+    predicted_error_bound,
+)
+
+NUM_DEVICES = 8
+
+
+# ------------------------------------------------------------- config surface
+def test_compression_config_from_mode():
+    assert CompressionConfig.from_mode("none") is None
+    assert CompressionConfig.from_mode(None) is None
+    with pytest.raises(ValueError, match="error_budget"):
+        CompressionConfig.from_mode("none", 0.1)
+    cfg = CompressionConfig.from_mode("int8", 0.05)
+    assert cfg.mode == "int8" and cfg.error_budget == 0.05
+    assert CompressionConfig.from_mode("bf16").error_budget is None
+    with pytest.raises(ValueError, match="compression"):
+        CompressionConfig.from_mode("fp8")
+    # frozen + hashable: usable inside compile-cache keys
+    assert hash(cfg) == hash(CompressionConfig("int8", 0.05))
+
+
+def test_sync_policy_compression_fields():
+    p = SyncPolicy(every_n_steps=2, compression="bf16", error_budget=0.01)
+    cfg = p.compression_config
+    assert cfg.mode == "bf16" and cfg.error_budget == 0.01
+    assert SyncPolicy().compression == "none"
+    assert SyncPolicy().compression_config is None
+    with pytest.raises(ValueError):
+        SyncPolicy(compression="int4")
+
+
+def test_spec_eligibility_rules():
+    cfg = CompressionConfig("int8")
+    # float32 sum at/above the byte floor -> compressed
+    spec = compression_spec_for("float32", "sum", cfg.min_bucket_bytes, cfg)
+    assert spec is not None and spec.mode == "int8" and spec.n_collectives == 2
+    # below the floor -> exact
+    assert compression_spec_for("float32", "sum", cfg.min_bucket_bytes - 1, cfg) is None
+    # never int/count leaves, never order ops, never non-sum
+    assert compression_spec_for("int32", "sum", 1 << 20, cfg) is None
+    assert compression_spec_for("float32", "min", 1 << 20, cfg) is None
+    assert compression_spec_for("float32", "max", 1 << 20, cfg) is None
+    # no config -> exact
+    assert compression_spec_for("float32", "sum", 1 << 20, None) is None
+    # error budget below the mode's bound -> falls back to exact
+    tight = CompressionConfig("int8", error_budget=1e-6)
+    assert compression_spec_for("float32", "sum", 1 << 20, tight) is None
+    loose = CompressionConfig("int8", error_budget=0.05)
+    assert compression_spec_for("float32", "sum", 1 << 20, loose).mode == "int8"
+
+
+def test_predicted_error_bounds_ordering():
+    assert 0 < predicted_error_bound("bf16") < predicted_error_bound("int8")
+    assert predicted_error_bound("int8", stages=2) == 2 * predicted_error_bound("int8")
+
+
+# --------------------------------------------------------------- wire models
+def test_bucket_wire_bytes_models():
+    n = NUM_DEVICES
+    size, itemsize = 4096, 4
+    exact = bucket_wire_bytes(size, itemsize, n, None)
+    assert exact == 2 * (n - 1) * size * itemsize // n  # ring all-reduce
+    bf16 = bucket_wire_bytes(size, itemsize, n, CompressionSpec("bf16"))
+    assert exact / bf16 == 2.0  # half-width payload, same schedule
+    int8 = bucket_wire_bytes(size, itemsize, n, CompressionSpec("int8"))
+    assert exact / int8 >= 2.0  # 1-byte payload + fp32 chunk scales
+    # host (DCN) payload: one direction, per-host bytes
+    assert host_compressed_payload_bytes(size, itemsize, None) == size * itemsize
+    assert host_compressed_payload_bytes(size, itemsize, CompressionSpec("bf16")) == size * 2
+
+
+def test_host_int8_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = rng.normal(scale=50.0, size=4096).astype(np.float32)
+    packed = host_quantize_int8(x)
+    assert packed.dtype == np.uint8
+    back = host_dequantize_int8(packed, x.size)
+    rel = np.abs(back - x).max() / np.abs(x).max()
+    assert rel <= predicted_error_bound("int8")
+
+
+# ------------------------------------------------- compressed psum on a mesh
+def _psum_both(mesh, spec, stacked):
+    def compressed(st):
+        return compressed_psum(st[0], "data", spec)
+
+    def exact(st):
+        return jax.lax.psum(st[0], "data")
+
+    run = lambda f: jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    )
+    return np.asarray(run(compressed)(stacked)), np.asarray(run(exact)(stacked))
+
+
+@pytest.mark.parametrize("mode", ["bf16", "int8"])
+def test_compressed_psum_within_bound(mesh, mode):
+    rng = np.random.default_rng(1)
+    stacked = jnp.asarray(rng.normal(scale=30.0, size=(NUM_DEVICES, 2048)).astype(np.float32))
+    spec = CompressionSpec(mode, error_bound=predicted_error_bound(mode, stages=2))
+    got, want = _psum_both(mesh, spec, stacked)
+    scale = np.abs(want).max() or 1.0
+    rel = np.abs(got - want).max() / scale
+    assert rel <= predicted_error_bound(mode, stages=2), (mode, rel)
+    assert got.dtype == want.dtype == np.float32
+
+
+def test_compressed_psum_exact_on_tiny_ints(mesh):
+    """Integer-valued floats small enough to survive bf16's 8-bit mantissa
+    round-trip unchanged — sanity that compression is lossless when the
+    payload fits the narrow format."""
+    stacked = jnp.asarray(
+        np.tile(np.arange(32, dtype=np.float32), (NUM_DEVICES, 1))
+    )
+    got, want = _psum_both(mesh, CompressionSpec("bf16"), stacked)
+    np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------- plan + jaxpr exactness (none)
+def _collection_entries(mesh):
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassF1Score,
+    )
+
+    mc = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=5, average="micro"),
+            "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            "auroc": MulticlassAUROC(num_classes=5, thresholds=16),
+        },
+        compute_groups=True,
+    )
+    probs = jax.nn.softmax(jnp.asarray(np.random.default_rng(0).normal(size=(16, 5))), -1)
+    target = jnp.asarray(np.random.default_rng(1).integers(0, 5, size=(16,)))
+    states = sharded_collection_update(mc, probs, target, mesh=mesh)
+    entries = []
+    for name in states:
+        m = mc[name]
+        sub = {leaf: states[name][leaf] for leaf in m._reductions}
+        sub["_n"] = states[name]["_n"]
+        entries.append((m._reductions, sub))
+    return entries
+
+
+def test_none_plan_identical_to_exact_planner(mesh):
+    """SyncPolicy(compression="none") must produce the PR-4 planner's plan
+    object, field for field — no CompressionSpec anywhere, same collective
+    count, same bucket layout."""
+    entries = _collection_entries(mesh)
+    base = build_sync_plan(entries)
+    none = build_sync_plan(entries, compression=CompressionConfig.from_mode("none"))
+    assert none == base
+    assert all(b.compression is None for b in none.buckets)
+    assert none.n_collectives == base.n_collectives
+    # the Acc+F1+AUROC f32 bucket sits under the default 4 KiB floor, so a
+    # default int8 config still yields the exact plan ...
+    assert build_sync_plan(entries, compression=CompressionConfig("int8", 0.05)) == base
+    # ... and dropping the floor genuinely compresses it
+    compressed = build_sync_plan(entries, compression=CompressionConfig("int8", min_bucket_bytes=0))
+    assert compressed != base
+    assert any(b.compression is not None for b in compressed.buckets)
+    assert compressed.n_collectives > base.n_collectives  # int8 = 2 per bucket
+
+
+def _sync_jaxpr(mesh, table, state, compression):
+    def inner(st):
+        return coalesced_sync_state(st, table, "data", compression=compression)
+
+    f = shard_map(inner, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    return str(jax.make_jaxpr(f)(state))
+
+
+def test_none_sync_jaxpr_bit_identical(mesh):
+    """The lowered sync graph under compression=None and under an explicit
+    "none" config is character-identical for Acc+F1+AUROC-shaped states and
+    for a mixed float/int table — the exact path has no compression residue."""
+    entries = _collection_entries(mesh)
+    for table, state in entries:
+        full = dict(state)
+        assert _sync_jaxpr(mesh, table, full, None) == _sync_jaxpr(
+            mesh, table, full, CompressionConfig.from_mode("none")
+        )
+    mixed = {
+        "s": jnp.zeros((2048,), jnp.float32),
+        "c": jnp.zeros((), jnp.int32),
+        "_n": jnp.ones((), jnp.int32),
+    }
+    table = {"s": Reduce.SUM, "c": Reduce.SUM}
+    assert _sync_jaxpr(mesh, table, mixed, None) == _sync_jaxpr(
+        mesh, table, mixed, CompressionConfig.from_mode("none")
+    )
+    # and the compressed graph genuinely differs
+    assert _sync_jaxpr(mesh, table, mixed, None) != _sync_jaxpr(
+        mesh, table, mixed, CompressionConfig("bf16")
+    )
+
+
+def test_metric_sync_states_compression_kwarg(mesh):
+    """Metric.sync_states(compression=...) stays within the predicted bound
+    of the exact sync for a large sum state."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    rng = np.random.default_rng(2)
+    preds = jnp.asarray(rng.integers(0, 64, (64,)))
+    target = jnp.asarray(rng.integers(0, 64, (64,)))
+
+    def sync_with(cfg):
+        def f(p, t):
+            st = m.update_state(m.init_state(), p, t)
+            return m.sync_states(st, "data", compression=cfg)
+
+        run = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+        return jax.jit(run)(preds, target)
+
+    want = np.asarray(sync_with(None)["confmat"])
+    got = np.asarray(sync_with(CompressionConfig("int8", 0.05))["confmat"])
+    scale = np.abs(want).max() or 1.0
+    assert np.abs(got - want).max() / scale <= predicted_error_bound("int8", stages=2)
+
+
+def test_compression_none_adds_zero_cache_entries(mesh):
+    """An explicit compression="none" policy reuses the exact path's cache
+    keys — repeat steps add no traces, and the armed-vs-default fingerprints
+    collide (the "none" suffix is never appended)."""
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    clear_compile_cache()
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    preds = jnp.zeros((16,), jnp.int32)
+    target = jnp.ones((16,), jnp.int32)
+    sharded_update(m, preds, target, mesh=mesh)
+    warm = cache_stats()
+    from torchmetrics_tpu.core.compile import cache_size
+
+    warm_size = cache_size()
+    sharded_update(m, preds, target, mesh=mesh, sync_policy=SyncPolicy(compression="none"))
+    stats = cache_stats()
+    assert stats["traces"] == warm["traces"]
+    assert cache_size() == warm_size
+
+
+def test_compressed_steady_state_adds_zero_cache_entries(mesh):
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    clear_compile_cache()
+    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.integers(0, 64, (64,)))
+    target = jnp.asarray(rng.integers(0, 64, (64,)))
+    policy = SyncPolicy(compression="int8", error_budget=0.05)
+    sharded_update(m, preds, target, mesh=mesh, sync_policy=policy)
+    warm = cache_stats()
+    for _ in range(4):
+        sharded_update(m, preds, target, mesh=mesh, sync_policy=policy)
+    stats = cache_stats()
+    assert stats["traces"] == warm["traces"]
+    assert stats["misses"] == warm["misses"]
+
+
+# -------------------------------------------------------- bitpacked ragged cat
+def test_cat_wire_dtype_narrowing():
+    assert cat_wire_dtype(np.dtype(np.int32), None) == np.dtype(np.int32)
+    assert cat_wire_dtype(np.dtype(np.int32), (0, 80)) == np.dtype(np.uint8)
+    assert cat_wire_dtype(np.dtype(np.int32), (-3, 80)) == np.dtype(np.int8)
+    assert cat_wire_dtype(np.dtype(np.int32), (0, 70000)) == np.dtype(np.int32)  # no win
+    # floats and non-integral ranges never narrow
+    assert cat_wire_dtype(np.dtype(np.float32), (0, 80)) == np.dtype(np.float32)
+    assert packed_int_dtype(np.dtype(np.int64), (0, 255)) == np.dtype(np.uint8)
+
+
+def test_ragged_bitpack_values_identical(mesh):
+    rng = np.random.default_rng(4)
+    per_dev = [
+        {"labels": tuple(rng.integers(0, 81, rng.integers(1, 9)).astype(np.int32) for _ in range(2))}
+        for _ in range(NUM_DEVICES)
+    ]
+    table = {"labels": Reduce.CAT}
+    exact = sync_ragged_states(table, per_dev, mesh)
+    packed = sync_ragged_states(table, per_dev, mesh, value_ranges={"labels": (0, 80)})
+    assert len(exact["labels"]) == len(packed["labels"])
+    for a, b in zip(exact["labels"], packed["labels"]):
+        assert b.dtype == np.int32  # unpacked back to the declared dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ragged_bitpack_range_violation_raises(mesh):
+    per_dev = [{"labels": (np.array([5], np.int32),)} for _ in range(NUM_DEVICES)]
+    per_dev[2] = {"labels": (np.array([500], np.int32),)}
+    with pytest.raises(ValueError, match="value_range"):
+        sync_ragged_states(
+            {"labels": Reduce.CAT},
+            per_dev,
+            mesh,
+            value_ranges={"labels": (0, 80)},
+            verify_consistency=True,
+        )
+
+
+def test_add_state_value_range_contract():
+    class Det(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("labels", default=[], dist_reduce_fx="cat", value_range=(0, 80))
+            self.add_state("scores", default=[], dist_reduce_fx="cat")
+
+        def update(self, labels, scores):  # pragma: no cover - structure only
+            pass
+
+        def compute(self):  # pragma: no cover - structure only
+            return jnp.zeros(())
+
+    m = Det()
+    assert m._value_ranges == {"labels": (0.0, 80.0)}
+    with pytest.raises(ValueError):
+        class Bad(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("s", default=jnp.zeros(()), dist_reduce_fx="sum", value_range=(80, 0))
+
+            def update(self):  # pragma: no cover
+                pass
+
+            def compute(self):  # pragma: no cover
+                return jnp.zeros(())
+
+        Bad()
+
+
+def test_none_identity_mixed_sketch_cat_collection(mesh):
+    """Exact-by-default for a mixed sketch+cat pair: sketch-backed AUROC (psum
+    sketch leaves) alongside a cat-state aggregator — plan objects and sync
+    jaxprs are identical with compression=None vs an explicit "none"."""
+    from torchmetrics_tpu.aggregation import CatMetric
+    from torchmetrics_tpu.classification import BinaryAUROC
+    from torchmetrics_tpu.parallel.coalesce import plan_for_metrics
+
+    rng = np.random.default_rng(5)
+    sk = BinaryAUROC(approx="sketch")
+    cat = CatMetric()
+    probs = jnp.asarray(rng.uniform(size=(16,)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 2, (16,)))
+    vals = jnp.asarray(rng.normal(size=(16,)), jnp.float32)
+    states = [
+        sk.update_state(sk.init_state(), probs, target),
+        cat.update_state(cat.init_state(), vals),
+    ]
+    base_plan, base_std = plan_for_metrics([sk, cat], states)
+    none_plan, none_std = plan_for_metrics(
+        [sk, cat], states, compression=CompressionConfig.from_mode("none")
+    )
+    assert none_plan == base_plan and len(none_std) == len(base_std)
+
+    def jaxpr_of(m, inputs, cfg):
+        def f(*args):
+            st = m.update_state(m.init_state(), *args)
+            return m.sync_states(st, "data", compression=cfg)
+
+        run = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+        return str(jax.make_jaxpr(run)(*inputs))
+
+    for m, inputs in ((sk, (probs, target)), (cat, (vals,))):
+        assert jaxpr_of(m, inputs, None) == jaxpr_of(
+            m, inputs, CompressionConfig.from_mode("none")
+        )

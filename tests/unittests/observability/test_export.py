@@ -126,7 +126,7 @@ def test_prometheus_exposition_lints():
             helped.add(ln.split()[2])
         elif ln.startswith("# TYPE "):
             parts = ln.split()
-            assert parts[3] in ("counter", "histogram", "gauge")
+            assert parts[3] in ("counter", "histogram", "gauge", "summary")
             typed.add(parts[2])
         else:
             assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"

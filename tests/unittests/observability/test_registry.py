@@ -220,3 +220,36 @@ def test_reset_telemetry_zeroes_but_keeps_rows():
 @pytest.mark.parametrize("name", ["updates", "sync_bytes", "restores"])
 def test_counter_names_cover_issue_surface(name):
     assert name in COUNTER_NAMES
+
+
+def test_bucket_rows_aggregate_compression_fields(mesh):
+    """absorb/aggregate must merge the compressed-bucket fields: numeric
+    fields add, the compression mode string survives the merge, and
+    sync_bytes_raw is a first-class counter."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.observability import aggregate_telemetry, registry
+    from torchmetrics_tpu.parallel import SyncPolicy
+
+    assert "sync_bytes_raw" in COUNTER_NAMES
+    obs.enable()
+    rng = np.random.default_rng(17)
+    preds = jnp.asarray(rng.integers(0, 64, (64,)))
+    target = jnp.asarray(rng.integers(0, 64, (64,)))
+    policy = SyncPolicy(every_n_steps=1, compression="bf16", error_budget=0.05)
+    m1 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    m2 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    sharded_update(m1, preds, target, mesh=mesh, sync_policy=policy)
+    sharded_update(m2, preds, target, mesh=mesh, sync_policy=policy)
+    key = next(
+        k for k, b in m1.telemetry.as_dict()["sync_buckets"].items() if b["compression"] == "bf16"
+    )
+    registry.record_quant_error(m1, key, 0.002)
+
+    agg = aggregate_telemetry([m1.telemetry.as_dict(), m2.telemetry.as_dict()])
+    row = agg["sync_buckets"][key]
+    assert row["compression"] == "bf16"
+    assert row["syncs"] == 2  # both instances folded in
+    assert row["quant_err_count"] == 1
+    assert row["quant_rel_err_sum"] == pytest.approx(0.002)
+    assert row["model_raw_bytes"] > 0
+    assert agg["counters"]["sync_bytes_raw"] > agg["counters"]["sync_bytes"]

@@ -193,3 +193,141 @@ def test_prometheus_exports_sync_bucket_families(mesh):
         ), f"{family} declared but has no samples"
     # both models labelled per bucket
     assert 'model="naive"' in text and 'model="ring"' in text
+
+
+# ------------------------------------------------- compressed-sync accounting
+def test_advisor_reports_measured_bytes_and_compression(mesh):
+    """Satellite: the advisor's per-cadence rows carry measured wire/raw bytes
+    next to measured time, and recommend() folds per-mode compression advice
+    (modelled byte cut + declared error bound) into the recommendation."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    m = MulticlassConfusionMatrix(num_classes=128, validate_args=False)
+    rng = np.random.default_rng(9)
+    preds = jnp.asarray(rng.integers(0, 128, (64,)))
+    target = jnp.asarray(rng.integers(0, 128, (64,)))
+    advisor = SyncAdvisor(m, mesh=mesh, candidates=(1, 4))
+    prof = advisor.profile(preds, target, steps=4, rounds=1)
+    for row in prof["runs"]:
+        assert row["sync_wire_bytes"] > 0
+        assert row["sync_raw_bytes"] == row["sync_wire_bytes"]  # exact profile
+        assert row["mean_sync_bytes"] == pytest.approx(
+            row["sync_wire_bytes"] / row["syncs"]
+        )
+
+    rec = advisor.recommend(target_cut=0.0)
+    assert rec["sync_wire_bytes"] == rec["sync_raw_bytes"]
+    comp = rec["compression"]
+    assert comp["mode"] == "none"
+    exact_b = comp["model_exact_bytes"]
+    assert exact_b > 0
+    for mode in ("bf16", "int8"):
+        row = comp["modes"][mode]
+        assert row["model_wire_bytes"] < exact_b
+        assert row["model_byte_cut"] == pytest.approx(exact_b / row["model_wire_bytes"])
+        assert row["error_bound"] > 0
+        # quantized syncs are an explicit opt-in: no declared budget -> exact
+        assert row["within_budget"] is False
+    assert comp["recommended_mode"] == "none"
+    assert comp["modes"]["int8"]["model_byte_cut"] >= 2.0
+    assert comp["modes"]["bf16"]["model_byte_cut"] >= 1.9
+
+
+def test_advisor_compression_respects_error_budget(mesh):
+    """With a workable budget the strongest fitting mode is recommended; a
+    budget tighter than every mode's bound keeps the advice exact."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    rng = np.random.default_rng(10)
+    preds = jnp.asarray(rng.integers(0, 64, (64,)))
+    target = jnp.asarray(rng.integers(0, 64, (64,)))
+
+    def advice(budget):
+        m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+        advisor = SyncAdvisor(
+            m, mesh=mesh, candidates=(1, 4), compression="bf16", error_budget=budget
+        )
+        advisor.profile(preds, target, steps=2, rounds=1)
+        return advisor.recommend(target_cut=0.0)["compression"]
+
+    comp = advice(0.05)
+    assert comp["mode"] == "bf16" and comp["error_budget"] == 0.05
+    assert all(row["within_budget"] for row in comp["modes"].values())
+    assert comp["recommended_mode"] == "int8"  # strongest cut within budget
+
+    comp = advice(1e-9)
+    assert all(not row["within_budget"] for row in comp["modes"].values())
+    assert comp["recommended_mode"] == "none"
+
+
+def test_compressed_sync_counts_wire_and_raw_bytes(mesh):
+    """sync_bytes counts the compressed wire payload, sync_bytes_raw the exact
+    plan's bytes — their ratio is the realized cut; exact syncs keep both
+    counters equal (byte-identical to the pre-compression accounting)."""
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
+
+    obs.enable()
+    rng = np.random.default_rng(11)
+    preds = jnp.asarray(rng.integers(0, 64, (64,)))
+    target = jnp.asarray(rng.integers(0, 64, (64,)))
+
+    m_exact = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    sharded_update(m_exact, preds, target, mesh=mesh)
+    row = m_exact.telemetry.as_dict()["counters"]
+    assert row["sync_bytes"] == row["sync_bytes_raw"]
+
+    m_int8 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05)
+    sharded_update(m_int8, preds, target, mesh=mesh, sync_policy=policy)
+    row = m_int8.telemetry.as_dict()["counters"]
+    assert row["sync_bytes"] < row["sync_bytes_raw"]
+    assert row["sync_bytes_raw"] / row["sync_bytes"] >= 2.0
+    # both counters match the plan-backed byte model exactly
+    sub = {"confmat": m_int8._state["confmat"], "_n": m_int8._state["_n"]}
+    table = {"confmat": m_int8._reductions["confmat"]}
+    assert row["sync_bytes"] == sync_wire_bytes_per_chip(
+        table, sub, NUM_DEVICES, policy.compression_config
+    )
+    assert row["sync_bytes_raw"] == sync_wire_bytes_per_chip(table, sub, NUM_DEVICES, None)
+    # the compressed bucket row is labelled with its mode + carries the raw model
+    buckets = m_int8.telemetry.as_dict()["sync_buckets"]
+    comp_rows = [b for b in buckets.values() if b["compression"] == "int8"]
+    assert comp_rows and all(b["model_raw_bytes"] > b["model_naive_bytes"] for b in comp_rows)
+
+
+def test_record_quant_error_lands_in_bucket_rows(mesh):
+    obs.enable()
+    m = _metric()
+    sharded_update(m, *_batch(np.random.default_rng(12)), mesh=mesh)
+    key = next(iter(m.telemetry.as_dict()["sync_buckets"]))
+    registry.record_quant_error(m, key, 0.01)
+    registry.record_quant_error(m, key, 0.03)
+    row = m.telemetry.as_dict()["sync_buckets"][key]
+    assert row["quant_err_count"] == 2
+    assert row["quant_rel_err_sum"] == pytest.approx(0.04)
+
+
+def test_prometheus_exports_compression_families(mesh):
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    obs.enable()
+    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    rng = np.random.default_rng(13)
+    policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05)
+    sharded_update(
+        m,
+        jnp.asarray(rng.integers(0, 64, (64,))),
+        jnp.asarray(rng.integers(0, 64, (64,))),
+        mesh=mesh,
+        sync_policy=policy,
+    )
+    key = next(iter(m.telemetry.as_dict()["sync_buckets"]))
+    registry.record_quant_error(m, key, 0.004)
+    text = obs.export(fmt="prometheus")
+    assert "tm_tpu_sync_bytes_raw_total" in text
+    assert 'model="raw"' in text
+    assert "tm_tpu_sync_bucket_compression_info" in text
+    assert 'mode="int8"' in text
+    assert "tm_tpu_sync_bucket_quant_rel_err_sum" in text
+    assert "tm_tpu_sync_bucket_quant_rel_err_count" in text

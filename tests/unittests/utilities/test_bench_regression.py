@@ -214,3 +214,21 @@ def test_bench_cli_emits_machine_readable_verdict(tmp_path, monkeypatch, capsys)
     assert verdict["metric"] == "bench-regression-check"
     assert verdict["verdict"] == "pass"
     assert "## Bench regression check" in out.err
+
+
+def test_bytes_keys_gate_lower_is_better():
+    """Satellite: every *_bytes bench key — including bare ``bytes`` /
+    ``bytes_per_chip`` leaves from the compressed-sync leg — is a
+    lower-is-better analytic quantity, while realized cut ratios stay
+    higher-is-better."""
+    for key in (
+        "detail.compressed_sync.byte_model.int8_bytes_per_chip",
+        "detail.compressed_sync.bitpacked_ragged_gather.wire_bytes_packed",
+        "detail.sync_bytes_raw",
+        "detail.telemetry_vs_model.sync_bytes_counter",
+        "detail.bucket.bytes",
+    ):
+        assert direction_for(key) == "lower", key
+        assert band_for(key) == 0.01, key  # analytic: tight band
+    assert direction_for("detail.compressed_sync.byte_model.int8_byte_cut") == "higher"
+    assert direction_for("detail.bf16_byte_cut") == "higher"

@@ -25,3 +25,59 @@ def test_benchmark_rejects_list_state_metrics():
 
     with pytest.raises(ValueError, match="cat"):
         benchmark(SpearmanCorrCoef(), jnp.zeros(4), jnp.zeros(4))
+
+
+# ----------------------------------------------------- compressed byte models
+def test_sync_wire_bytes_models_compression():
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+    from torchmetrics_tpu.utilities.benchmark import (
+        coalesced_sync_bytes_per_chip,
+        sync_bytes_per_chip,
+        sync_wire_bytes_per_chip,
+    )
+
+    table = {"s": "sum"}
+    state = {"s": np.zeros((4096,), np.float32), "_n": np.ones((), np.int32)}
+    exact = sync_wire_bytes_per_chip(table, state, 8, None)
+    bf16 = sync_wire_bytes_per_chip(table, state, 8, CompressionConfig("bf16"))
+    int8 = sync_wire_bytes_per_chip(table, state, 8, CompressionConfig("int8"))
+    assert bf16 < exact and int8 < bf16
+    assert exact / int8 >= 2.0
+    # the ring-granule model orders the same way
+    r_exact = coalesced_sync_bytes_per_chip(table, state, 8)
+    r_int8 = coalesced_sync_bytes_per_chip(table, state, 8, compression=CompressionConfig("int8"))
+    assert r_int8 < r_exact
+    # exact wire model stays consistent with the legacy per-chip model's scale
+    legacy = sync_bytes_per_chip(table, state, 8)
+    assert exact == pytest.approx(legacy, rel=0.05)
+
+
+def test_two_stage_dcn_bytes_compression():
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+    from torchmetrics_tpu.utilities.benchmark import two_stage_dcn_bytes
+
+    table = {"s": "sum"}
+    state = {"s": np.zeros((8192,), np.float32), "_n": np.ones((), np.int32)}
+    exact = two_stage_dcn_bytes(table, state, n_hosts=4, n_local_devices=8)
+    bf16 = two_stage_dcn_bytes(
+        table, state, n_hosts=4, n_local_devices=8, compression=CompressionConfig("bf16")
+    )
+    int8 = two_stage_dcn_bytes(
+        table, state, n_hosts=4, n_local_devices=8, compression=CompressionConfig("int8")
+    )
+    for key in exact:
+        assert bf16[key] <= exact[key], key
+        assert int8[key] <= exact[key], key
+    assert bf16 != exact and int8 != exact
+
+
+def test_small_buckets_never_compressed_in_models():
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+    from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
+
+    table = {"s": "sum"}
+    state = {"s": np.zeros((16,), np.float32), "_n": np.ones((), np.int32)}
+    cfg = CompressionConfig("int8")
+    assert sync_wire_bytes_per_chip(table, state, 8, cfg) == sync_wire_bytes_per_chip(
+        table, state, 8, None
+    )

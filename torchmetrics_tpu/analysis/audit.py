@@ -48,6 +48,7 @@ __all__ = [
     "TraceContractError",
     "audit_collection",
     "audit_metric",
+    "count_dequantize_ops",
     "count_primitives",
     "iter_eqns",
 ]
@@ -76,6 +77,9 @@ COLLECTIVE_PRIMITIVES = frozenset(
 GATHER_PRIMITIVES = frozenset({"all_gather", "pgather", "all_to_all"})
 #: avals that must never appear in a lowered metric graph
 _BANNED_DTYPES = frozenset({"float64", "complex128"})
+#: wire dtypes of the compressed-collective payloads; a
+#: ``convert_element_type`` from one of these to float32 is a dequantize op
+_WIRE_DTYPES = frozenset({"int8", "uint8", "bfloat16"})
 
 _RESERVED_LEAVES = ("_n", "_nonfinite")
 
@@ -112,6 +116,9 @@ class AuditReport:
     planned_sync_collectives: Optional[int] = None
     #: gather-family collectives (:data:`GATHER_PRIMITIVES`) in the sync jaxpr
     traced_sync_gathers: Optional[int] = None
+    #: compressed-sync audit facts (mode, dequantize placement, collective
+    #: counts) when :func:`audit_metric` ran with a compression config
+    compression: Optional[Dict[str, Any]] = None
 
     @property
     def ok(self) -> bool:
@@ -132,6 +139,7 @@ class AuditReport:
             "traced_sync_collectives": self.traced_sync_collectives,
             "planned_sync_collectives": self.planned_sync_collectives,
             "traced_sync_gathers": self.traced_sync_gathers,
+            "compression": dict(self.compression) if self.compression is not None else None,
         }
 
 
@@ -160,6 +168,23 @@ def iter_eqns(jaxpr: Any) -> Iterator[Any]:
 
 def count_primitives(jaxpr: Any, names: frozenset) -> int:
     return sum(1 for eqn in iter_eqns(jaxpr) if eqn.primitive.name in names)
+
+
+def count_dequantize_ops(jaxpr: Any) -> int:
+    """``convert_element_type`` eqns lifting a compression wire dtype
+    (int8/uint8/bfloat16) back to float32 — the dequantize steps of the
+    compressed sync path.  Counted on eqn primitives via :func:`iter_eqns`,
+    never by string-matching the printed jaxpr (which double-prints some
+    collective calls)."""
+    n = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        in_dt = str(getattr(getattr(eqn.invars[0], "aval", None), "dtype", ""))
+        out_dt = str(getattr(getattr(eqn.outvars[0], "aval", None), "dtype", ""))
+        if in_dt in _WIRE_DTYPES and out_dt == "float32":
+            n += 1
+    return n
 
 
 def _banned_dtypes(jaxpr: Any) -> List[str]:
@@ -276,12 +301,20 @@ def audit_metric(
     mesh: Optional[Any] = None,
     axis_name: Optional[str] = None,
     strict: bool = False,
+    compression: Any = None,
 ) -> AuditReport:
     """Audit one metric's trace contract against example ``inputs``.
 
     ``inputs`` are one representative ``update`` batch.  ``strict=True``
     raises :class:`TraceContractError` on any violation; otherwise inspect
     the returned :class:`AuditReport`.
+
+    With ``compression`` (a ``parallel.compress.CompressionConfig``), the
+    *compressed* sync graph is additionally traced and audited: it must stay
+    host-callback-free, lower exactly the compressed plan's collective count
+    (int8 buckets lower two), and keep every dequantize op out of the update
+    jaxpr — quantization belongs to the sync path only.  Findings land in
+    :attr:`AuditReport.compression`.
     """
     from torchmetrics_tpu.core.compile import audit_step_fn, is_jit_compatible
     from torchmetrics_tpu.core.metric import Metric
@@ -321,10 +354,12 @@ def audit_metric(
         )
 
     # -- update jaxpr: through the exact step body the compile cache builds
+    jx_update = None
     if is_jit_compatible((inputs, {})):
         try:
             jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
         except Exception as err:
+            jx_update = None
             violations.append(
                 AuditViolation(
                     "update",
@@ -392,6 +427,72 @@ def audit_metric(
                 v for v in _graph_violations("sync", jx_sync, allow_collectives=True)
             )
 
+    # -- compressed sync jaxpr: quantize→collective→dequantize stays one
+    #    fused in-graph trace, with every dequantize outside update
+    compression_info: Optional[Dict[str, Any]] = None
+    if compression is not None:
+        if type(metric).sync_states is not Metric.sync_states:
+            skipped.append(("compressed-sync", "metric overrides sync_states (not coalesced)"))
+        else:
+            try:
+                the_mesh = _default_mesh(mesh, axis)
+                jx_csync = _trace_sync(
+                    lambda st: metric.sync_states(st, axis, compression=compression),
+                    state,
+                    the_mesh,
+                    axis,
+                )
+            except Exception as err:
+                skipped.append(
+                    ("compressed-sync", f"compressed sync not traceable ({type(err).__name__}: {err})")
+                )
+            else:
+                checks.append("compressed-sync")
+                plan_c = plan_for_metric(metric, state, compression=compression)
+                c_traced = count_primitives(jx_csync, COLLECTIVE_PRIMITIVES)
+                c_planned = plan_c.n_collectives
+                n_compressed = sum(1 for b in plan_c.buckets if b.compression is not None)
+                dq_sync = count_dequantize_ops(jx_csync)
+                dq_update = count_dequantize_ops(jx_update) if jx_update is not None else None
+                compression_info = {
+                    "mode": compression.mode,
+                    "compressed_buckets": n_compressed,
+                    "traced_collectives": c_traced,
+                    "planned_collectives": c_planned,
+                    "dequantize_in_sync": dq_sync,
+                    "dequantize_in_update": dq_update,
+                }
+                violations.extend(
+                    _graph_violations("compressed-sync", jx_csync, allow_collectives=True)
+                )
+                if c_traced != c_planned:
+                    violations.append(
+                        AuditViolation(
+                            "compressed-sync",
+                            f"compressed sync lowers {c_traced} collective primitive(s) but the "
+                            f"compressed plan models {c_planned} — the byte/collective model no "
+                            "longer describes the real graph",
+                        )
+                    )
+                if n_compressed and not dq_sync:
+                    violations.append(
+                        AuditViolation(
+                            "compressed-sync",
+                            f"the plan compresses {n_compressed} bucket(s) but no dequantize op "
+                            "appears in the lowered sync — the compressed path did not actually "
+                            "trace (quantize/dequantize must be in-graph)",
+                        )
+                    )
+                if dq_update:
+                    violations.append(
+                        AuditViolation(
+                            "compressed-sync",
+                            f"{dq_update} dequantize op(s) in the update jaxpr — quantization "
+                            "belongs to the sync path only; an update that converts wire dtypes "
+                            "to float32 would pay the precision loss on every step",
+                        )
+                    )
+
     report = AuditReport(
         subject,
         violations=tuple(violations),
@@ -400,6 +501,7 @@ def audit_metric(
         traced_sync_collectives=traced_n,
         planned_sync_collectives=planned_n,
         traced_sync_gathers=traced_g,
+        compression=compression_info,
     )
     return report.raise_if_violations() if strict else report
 

@@ -144,7 +144,10 @@ class DirectCollectiveRule(Rule):
         "collective must lower through core/reductions.sync_leaf or the parallel/coalesce "
         "planner so it is bucketed, telemetry-counted, and covered by the byte-cost model."
     )
-    allow_paths = ("core/reductions.py", "parallel/coalesce.py")
+    # compress.py is the planner's compression stage: its quantized
+    # psum/all_to_all/all_gather are issued per-bucket by apply_sync_plan,
+    # so they stay bucketed, telemetry-counted, and byte-modelled.
+    allow_paths = ("core/reductions.py", "parallel/coalesce.py", "parallel/compress.py")
 
     BANNED = frozenset({"psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter", "all_to_all"})
 

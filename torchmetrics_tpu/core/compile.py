@@ -1009,22 +1009,37 @@ def compiled_forward(
     )
 
 
+def _frozen_sync_states(frozen: Any, st: Any, axis_name: str, compression: Any) -> Any:
+    """Forward the compression config only to the standard planner-backed
+    ``sync_states``; overriding metrics keep their own exact aggregation."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    if compression is not None and type(frozen).sync_states is Metric.sync_states:
+        return frozen.sync_states(st, axis_name, compression=compression)
+    return frozen.sync_states(st, axis_name)
+
+
 def compiled_sharded_update(
     metric: Any,
     mesh: Mesh,
     axis_name: str,
     specs: Tuple[Any, ...],
     args: Tuple[Any, ...],
+    compression: Any = None,
 ) -> Callable:
     """Compiled shard_map step for ``parallel.sync.sharded_update``.
 
     The key folds in the metric's config fingerprint, so attribute mutation
     after the first call misses the cache and re-traces with the new config
-    (the round-5 stale-trace fix).
+    (the round-5 stale-trace fix).  An active compression config joins the
+    key (it changes the traced sync graph); the default ``None`` leaves the
+    key — and thus every pre-compression cache entry — byte-identical.
     """
     fp = metric._config_fingerprint()
     sig = abstract_signature(args)
     key = ("sharded_update", fp, mesh, axis_name, specs, sig)
+    if compression is not None:
+        key = key + (compression,)
 
     owner_ref = weakref.ref(metric)
     scope = f"tm_tpu/{type(metric).__name__}/sharded_update"
@@ -1039,7 +1054,7 @@ def compiled_sharded_update(
                 # frozen.sync_states, not the bare reduction table: metrics with
                 # non-distributive states (e.g. Pearson's streaming moments)
                 # override sync_states with their own cross-shard aggregation
-                return frozen.sync_states(st, axis_name)
+                return _frozen_sync_states(frozen, st, axis_name, compression)
 
         return jax.jit(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
@@ -1210,6 +1225,7 @@ def compiled_sharded_collection_update(
     axis_name: str,
     specs: Tuple[Any, ...],
     args: Tuple[Any, ...],
+    compression: Any = None,
 ) -> Callable:
     """One fused shard_map graph: every leader updates from its input shard
     AND syncs across the mesh in a single compiled step.
@@ -1220,10 +1236,13 @@ def compiled_sharded_collection_update(
     plan: every leader's psum-family leaves share dtype buckets, so the
     whole collection syncs in as few collectives as it has distinct
     (dtype, reduction-class) pairs instead of one per leaf per metric.
+    An active compression config joins the key; ``None`` leaves it unchanged.
     """
     fp = tuple((name, collection[name]._config_fingerprint()) for name in leader_names)
     sig = abstract_signature(args)
     key = ("sharded_collection_update", fp, mesh, axis_name, specs, sig)
+    if compression is not None:
+        key = key + (compression,)
 
     owner_ref = weakref.ref(collection)
 
@@ -1241,7 +1260,10 @@ def compiled_sharded_collection_update(
                         locals_[name] = m.update_state(m.init_state(), *shards)
                 names = tuple(frozen)
                 synced = coalesced_metric_sync(
-                    [frozen[n] for n in names], [locals_[n] for n in names], axis_name
+                    [frozen[n] for n in names],
+                    [locals_[n] for n in names],
+                    axis_name,
+                    compression=compression,
                 )
                 return dict(zip(names, synced))
 
@@ -1330,16 +1352,20 @@ def compiled_cadence_sync(
     named_metrics: Tuple[Tuple[str, Any], ...],
     mesh: Mesh,
     axis_name: str,
+    compression: Any = None,
 ) -> Callable:
     """The deferred collective for ``parallel.coalesce.SyncStepper``.
 
     Returns ``fn(carry) -> {name: replicated_state}``: each device's
     accumulated local state crosses the mesh through ONE cross-metric
     coalesced bucket plan (``coalesced_metric_sync``), exactly the sync the
-    per-step path would have run — just ``k`` steps later.
+    per-step path would have run — just ``k`` steps later.  An active
+    compression config joins the key; ``None`` leaves it unchanged.
     """
     fp = tuple((name, m._config_fingerprint()) for name, m in named_metrics)
     key = ("cadence_sync", fp, mesh, axis_name)
+    if compression is not None:
+        key = key + (compression,)
 
     owner_ref = weakref.ref(owner)
 
@@ -1353,7 +1379,9 @@ def compiled_cadence_sync(
             with jax.named_scope("tm_tpu/SyncStepper/cadence_sync"):
                 names = tuple(name for name, _ in frozen)
                 locals_ = [jax.tree.map(lambda x: x[0], carry[name]) for name in names]
-                synced = coalesced_metric_sync([m for _, m in frozen], locals_, axis_name)
+                synced = coalesced_metric_sync(
+                    [m for _, m in frozen], locals_, axis_name, compression=compression
+                )
                 return dict(zip(names, synced))
 
         return jax.jit(

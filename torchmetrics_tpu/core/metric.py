@@ -152,6 +152,9 @@ class Metric:
         self._defaults: Dict[str, Any] = {}
         self._reductions: Dict[str, Union[Reduce, Callable]] = {}
         self._persistent: Dict[str, bool] = {}
+        # declared (lo, hi) per state leaf: lets the ragged gather bitpack
+        # integer cat leaves to the narrowest sufficient wire dtype
+        self._value_ranges: Dict[str, Tuple[float, float]] = {}
         self._state: State = {_N: jnp.zeros((), dtype=jnp.int32)}
         # True once self._state may be aliased by another metric (compute
         # groups share one pytree across members): compiled paths must not
@@ -247,6 +250,7 @@ class Metric:
         default: Union[Array, list, Sequence],
         dist_reduce_fx: Optional[Union[str, Callable, SketchReduce]] = None,
         persistent: bool = False,
+        value_range: Optional[Tuple[float, float]] = None,
     ) -> None:
         """Register a state leaf (reference: metric.py:197-280).
 
@@ -256,9 +260,27 @@ class Metric:
         :class:`~torchmetrics_tpu.core.reductions.SketchReduce` spec for
         fixed-shape sketch leaves (``torchmetrics_tpu.sketches``) — those
         merge elementwise and sync without ragged gathers.
+
+        ``value_range=(lo, hi)`` declares the values this leaf can hold.
+        For integer list (cat) states the ragged gather uses it to bitpack
+        the wire payload to the narrowest sufficient dtype (token ids in
+        ``[0, 50k)`` cross as uint16, detection labels in ``[0, 80]`` as
+        uint8) — lossless for in-range values; the declared range is a
+        contract, values outside it would be truncated.
         """
         if name.startswith("_"):
             raise ValueError(f"State name {name!r} must not start with '_'")
+        if value_range is not None:
+            try:
+                lo, hi = float(value_range[0]), float(value_range[1])
+                ok = len(value_range) == 2 and lo <= hi
+            except (TypeError, ValueError, IndexError):
+                ok = False
+            if not ok:
+                raise ValueError(
+                    f"value_range must be a (lo, hi) pair with lo <= hi, got {value_range!r}"
+                )
+            self._value_ranges[name] = (lo, hi)
         if not isinstance(default, (list, tuple)) and not isinstance(
             default, (jnp.ndarray, np.ndarray, jax.Array, int, float)
         ):
@@ -385,7 +407,9 @@ class Metric:
             out[_NONFINITE] = count_nonfinite(out)
         return out
 
-    def sync_states(self, state: State, axis_name: Optional[str] = None) -> State:
+    def sync_states(
+        self, state: State, axis_name: Optional[str] = None, compression: Optional[Any] = None
+    ) -> State:
         """In-graph cross-device sync (pure; call under shard_map/pmap).
 
         Lowers through the coalescing planner
@@ -394,13 +418,19 @@ class Metric:
         per leaf.  The plan is a static function of the reduction table and
         leaf specs — exactly what the compile-cache key already fingerprints
         — so bucketing adds zero cache entries and zero retraces.
+
+        ``compression`` (a
+        :class:`~torchmetrics_tpu.parallel.compress.CompressionConfig`, or
+        ``None`` for the default exact sync) opts eligible large float32 sum
+        buckets into quantized wire payloads; the compiled entry points pass
+        it through from ``SyncPolicy(compression=...)``.
         """
         from torchmetrics_tpu.parallel.coalesce import coalesced_sync_state
 
         axis_name = axis_name or self.axis_name
         sub: State = {name: state[name] for name in self._reductions}
         sub[_N] = state[_N]
-        out = coalesced_sync_state(sub, self._reductions, axis_name)
+        out = coalesced_sync_state(sub, self._reductions, axis_name, compression=compression)
         if self._guard_strategy in ("warn", "error"):
             out[_NONFINITE] = count_nonfinite(out)
         return out

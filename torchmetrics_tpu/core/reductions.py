@@ -125,6 +125,20 @@ def is_list_state(default: Any) -> bool:
     return isinstance(default, (list, tuple))
 
 
+def cat_wire_dtype(dtype: Any, value_range: Optional[Tuple[float, float]]) -> Any:
+    """Dtype a CAT leaf travels at across the mesh: the narrowest integer
+    dtype covering its declared ``add_state(value_range=...)``, or the leaf's
+    own dtype when no declaration (or no narrowing) applies.  This is the
+    reduction-layer view of the ragged bitpack —
+    ``parallel.ragged.sync_ragged_states`` casts to this dtype before the
+    gather and back after the trim."""
+    if value_range is None:
+        return dtype
+    from torchmetrics_tpu.parallel.compress import packed_int_dtype
+
+    return packed_int_dtype(dtype, value_range)
+
+
 def merge_leaf(
     reduce: Union[Reduce, Callable],
     a: Union[Array, ListState],

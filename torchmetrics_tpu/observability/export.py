@@ -45,8 +45,10 @@ __all__ = [
 
 #: Semver of the machine-readable export payloads (JSONL lines, Chrome-trace
 #: ``otherData``).  Major 1 = the PR 3 report layout; 1.1 added the
-#: ``schema_version`` field itself and the flight-recorder trace export.
-SCHEMA_VERSION = "1.1.0"
+#: ``schema_version`` field itself and the flight-recorder trace export; 1.2
+#: added compressed-collective accounting (``sync_bytes_raw``, per-bucket
+#: ``model_raw_bytes`` / quantization-error fields / ``compression`` mode).
+SCHEMA_VERSION = "1.2.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -85,7 +87,8 @@ _COUNTER_HELP = {
     "forwards": "Metric.forward() calls.",
     "resets": "Metric.reset() calls.",
     "syncs": "Cross-device/host state synchronisations.",
-    "sync_bytes": "Modelled per-chip sync traffic in bytes.",
+    "sync_bytes": "Modelled per-chip sync wire traffic in bytes (compressed when active).",
+    "sync_bytes_raw": "Modelled per-chip sync traffic in bytes before compression.",
     "collectives": "Fused (bucketed) collective launches.",
     "donated_installs": "Compiled state installs with buffer donation.",
     "copied_installs": "Compiled state installs without donation (aliased state).",
@@ -278,16 +281,49 @@ class PrometheusExporter(Exporter):
         bbytes_name = f"{ns}_sync_bucket_model_bytes_total"
         out.append(
             f"# HELP {bbytes_name} Modelled per-chip bucket traffic: naive 2(n-1)/n vs "
-            "granule-aware ring model."
+            "granule-aware ring model (compressed wire sizes when a compression mode is "
+            "active) vs the uncompressed raw ring model."
         )
         out.append(f"# TYPE {bbytes_name} counter")
         for label, row in sorted(rows.items()):
             for key, b in sorted(row.get("sync_buckets", {}).items()):
-                for model, field in (("naive", "model_naive_bytes"), ("ring", "model_ring_bytes")):
+                for model, field in (
+                    ("naive", "model_naive_bytes"),
+                    ("ring", "model_ring_bytes"),
+                    ("raw", "model_raw_bytes"),
+                ):
                     out.append(
                         f"{bbytes_name}{_labels(metric=label, bucket=key, model=model)} "
                         f"{int(b.get(field, 0))}"
                     )
+        bcomp_name = f"{ns}_sync_bucket_compression_info"
+        out.append(
+            f"# HELP {bcomp_name} Active compression mode per collective bucket "
+            "(info-style gauge: value is always 1, the mode rides the label)."
+        )
+        out.append(f"# TYPE {bcomp_name} gauge")
+        for label, row in sorted(rows.items()):
+            for key, b in sorted(row.get("sync_buckets", {}).items()):
+                mode = str(b.get("compression", "none"))
+                out.append(f"{bcomp_name}{_labels(metric=label, bucket=key, mode=mode)} 1")
+        qerr_name = f"{ns}_sync_bucket_quant_rel_err"
+        out.append(
+            f"# HELP {qerr_name} Measured quantization relative error per compressed bucket "
+            "(summary: _sum over measurements, _count measurements)."
+        )
+        out.append(f"# TYPE {qerr_name} summary")
+        for label, row in sorted(rows.items()):
+            for key, b in sorted(row.get("sync_buckets", {}).items()):
+                if not int(b.get("quant_err_count", 0)):
+                    continue
+                out.append(
+                    f"{qerr_name}_sum{_labels(metric=label, bucket=key)} "
+                    f"{repr(float(b.get('quant_rel_err_sum', 0.0)))}"
+                )
+                out.append(
+                    f"{qerr_name}_count{_labels(metric=label, bucket=key)} "
+                    f"{int(b.get('quant_err_count', 0))}"
+                )
         bres_name = f"{ns}_sync_bucket_residual_bytes"
         out.append(
             f"# HELP {bres_name} Ring-model minus naive-model bucket bytes (the granule floor "

@@ -53,6 +53,7 @@ __all__ = [
     "enabled",
     "observe",
     "record_measured_sync",
+    "record_quant_error",
     "record_sync",
     "report",
     "reset_telemetry",
@@ -66,7 +67,9 @@ _log = logging.getLogger("torchmetrics_tpu.observability")
 _LOCK = threading.RLock()
 
 #: Counter slots every :class:`MetricTelemetry` starts from.  ``sync_bytes``
-#: is the modelled per-chip traffic (bytes), everything else is an event count.
+#: is the modelled per-chip *wire* traffic (compressed when a compression
+#: config is active), ``sync_bytes_raw`` the same model before compression
+#: (the two are equal for exact syncs); everything else is an event count.
 COUNTER_NAMES = (
     "updates",
     "computes",
@@ -74,6 +77,7 @@ COUNTER_NAMES = (
     "resets",
     "syncs",
     "sync_bytes",
+    "sync_bytes_raw",
     "collectives",
     "donated_installs",
     "copied_installs",
@@ -210,6 +214,20 @@ class MetricTelemetry:
             stats = self.spans[name] = SpanStats()
         stats.record(seconds)
 
+    @staticmethod
+    def _new_bucket_row() -> Dict[str, Any]:
+        return {
+            "syncs": 0,
+            "elements": 0,
+            "measured_us": 0.0,
+            "model_naive_bytes": 0,
+            "model_ring_bytes": 0,
+            "model_raw_bytes": 0,
+            "quant_rel_err_sum": 0.0,
+            "quant_err_count": 0,
+            "compression": "none",
+        }
+
     def record_bucket(
         self,
         key: str,
@@ -217,21 +235,30 @@ class MetricTelemetry:
         measured_s: float,
         naive_bytes: int,
         ring_bytes: int,
+        raw_bytes: Optional[int] = None,
+        compression: str = "none",
     ) -> None:
         row = self.sync_buckets.get(key)
         if row is None:
-            row = self.sync_buckets[key] = {
-                "syncs": 0,
-                "elements": 0,
-                "measured_us": 0.0,
-                "model_naive_bytes": 0,
-                "model_ring_bytes": 0,
-            }
+            row = self.sync_buckets[key] = self._new_bucket_row()
         row["syncs"] += 1
         row["elements"] += int(elements)
         row["measured_us"] += measured_s * 1e6
         row["model_naive_bytes"] += int(naive_bytes)
         row["model_ring_bytes"] += int(ring_bytes)
+        # raw = the uncompressed ring model; equals ring for exact buckets
+        row["model_raw_bytes"] += int(ring_bytes if raw_bytes is None else raw_bytes)
+        row["compression"] = compression
+
+    def record_quant_error(self, key: str, rel_err: float) -> None:
+        row = self.sync_buckets.get(key)
+        if row is None:
+            # a measurement arriving before any recorded sync still lands
+            self.record_bucket(key, 0, 0.0, 0, 0)
+            row = self.sync_buckets[key]
+            row["syncs"] -= 1
+        row["quant_rel_err_sum"] = row.get("quant_rel_err_sum", 0.0) + float(rel_err)
+        row["quant_err_count"] = row.get("quant_err_count", 0) + 1
 
     def absorb(self, other: "MetricTelemetry") -> None:
         for name, n in other.counters.items():
@@ -243,9 +270,12 @@ class MetricTelemetry:
         for name, stats in other.spans.items():
             self.spans.setdefault(name, SpanStats()).absorb(stats)
         for key, row in other.sync_buckets.items():
-            mine = self.sync_buckets.setdefault(key, {k: 0 for k in row})
+            mine = self.sync_buckets.setdefault(key, self._new_bucket_row())
             for field, n in row.items():
-                mine[field] = mine.get(field, 0) + n
+                if isinstance(n, str):
+                    mine[field] = n
+                else:
+                    mine[field] = mine.get(field, 0) + n
 
     def clear(self) -> None:
         self.counters = {name: 0 for name in COUNTER_NAMES}
@@ -491,30 +521,43 @@ def record_sync(
     reductions: Mapping[str, Any],
     state: Mapping[str, Any],
     n_devices: int,
+    compression: Any = None,
 ) -> None:
     """Record one cross-device sync for ``obj``: bumps ``syncs``, adds the
-    modelled per-chip traffic (``utilities.benchmark.sync_bytes_per_chip``)
-    to ``sync_bytes``, and adds the planner's fused collective count
+    modelled per-chip traffic to ``sync_bytes`` (compressed wire bytes when a
+    :class:`~torchmetrics_tpu.parallel.compress.CompressionConfig` is active,
+    ``utilities.benchmark.sync_bytes_per_chip`` otherwise), the uncompressed
+    model to ``sync_bytes_raw``, and the planner's fused collective count
     (``parallel.coalesce.bucketed_collective_count``) to ``collectives``.
     Never raises — telemetry must not break a sync."""
     if not _ENABLED:
         return
-    nbytes = 0
+    wire = 0
+    raw = 0
     n_collectives = 0
     try:
         from torchmetrics_tpu.parallel.coalesce import bucketed_collective_count
-        from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+        from torchmetrics_tpu.utilities.benchmark import (
+            sync_bytes_per_chip,
+            sync_wire_bytes_per_chip,
+        )
 
         state = dict(state)
         table = {name: r for name, r in reductions.items() if name in state}
-        nbytes = int(sync_bytes_per_chip(table, state, int(n_devices)))
-        n_collectives = int(bucketed_collective_count(table, state))
+        if compression is None:
+            wire = raw = int(sync_bytes_per_chip(table, state, int(n_devices)))
+        else:
+            # same plan-based model for both, so wire/raw diff cleanly
+            wire = int(sync_wire_bytes_per_chip(table, state, int(n_devices), compression))
+            raw = int(sync_wire_bytes_per_chip(table, state, int(n_devices), None))
+        n_collectives = int(bucketed_collective_count(table, state, compression))
     except Exception:
         _log.debug("sync byte accounting failed for %r", obj, exc_info=True)
     with _LOCK:
         t = telemetry_for(obj)
         t.inc("syncs")
-        t.inc("sync_bytes", nbytes)
+        t.inc("sync_bytes", wire)
+        t.inc("sync_bytes_raw", raw)
         t.inc("collectives", n_collectives)
 
 
@@ -523,6 +566,7 @@ def record_measured_sync(
     entries: Iterable[Tuple[Mapping[str, Any], Mapping[str, Any]]],
     n_devices: int,
     seconds: float,
+    compression: Any = None,
 ) -> None:
     """Attribute one *measured* coalesced sync (block-until-ready wall time
     at the host boundary) to ``obj``'s per-bucket table.
@@ -537,21 +581,29 @@ def record_measured_sync(
     """
     if not _ENABLED:
         return
-    rows: List[Tuple[str, int, int, int]] = []  # (key, elements, naive_b, ring_b)
+    # (key, elements, naive_b, ring_b, raw_b, mode)
+    rows: List[Tuple[str, int, int, int, int, str]] = []
     try:
         import numpy as _np
 
         from torchmetrics_tpu.parallel.coalesce import build_sync_plan
-        from torchmetrics_tpu.utilities.benchmark import ring_reduce_bytes
+        from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
+        from torchmetrics_tpu.utilities.benchmark import RING_GRANULE_BYTES, ring_reduce_bytes
 
         entries = [(dict(r), dict(s)) for r, s in entries]
-        plan = build_sync_plan(entries)
+        plan = build_sync_plan(entries, compression=compression)
         n = max(int(n_devices), 1)
         for bucket in plan.buckets:
-            payload = bucket.size * _np.dtype(bucket.dtype).itemsize
-            naive_b = int(round(2 * (n - 1) / n * payload))
-            ring_b = int(ring_reduce_bytes(payload, n))
-            rows.append((f"{bucket.dtype}/{bucket.op}", int(bucket.size), naive_b, ring_b))
+            itemsize = _np.dtype(bucket.dtype).itemsize
+            payload = bucket.size * itemsize
+            spec = bucket.compression
+            naive_b = int(bucket_wire_bytes(bucket.size, itemsize, n, spec, None))
+            ring_b = int(bucket_wire_bytes(bucket.size, itemsize, n, spec, RING_GRANULE_BYTES))
+            raw_b = int(ring_reduce_bytes(payload, n))
+            mode = spec.mode if spec is not None else "none"
+            rows.append(
+                (f"{bucket.dtype}/{bucket.op}", int(bucket.size), naive_b, ring_b, raw_b, mode)
+            )
         for e, name, _reduce in plan.passthrough:
             leaf = entries[e][1][name]
             import jax as _jax
@@ -559,21 +611,35 @@ def record_measured_sync(
             nbytes = sum(int(v.size) * v.dtype.itemsize for v in _jax.tree.leaves(leaf))
             elems = sum(int(v.size) for v in _jax.tree.leaves(leaf))
             gather_b = (n - 1) * nbytes  # no granule model for gathers
-            rows.append((f"gather/{name}", elems, gather_b, gather_b))
+            rows.append((f"gather/{name}", elems, gather_b, gather_b, gather_b, "none"))
     except Exception:
         _log.debug("measured sync attribution failed for %r", obj, exc_info=True)
     total_ring = sum(r[3] for r in rows)
     with _LOCK:
         t = telemetry_for(obj)
         t.record_span("sync_measured", seconds)
-        for i, (key, elements, naive_b, ring_b) in enumerate(rows):
+        for key, elements, naive_b, ring_b, raw_b, mode in rows:
             if total_ring > 0:
                 share = seconds * ring_b / total_ring
             else:  # degenerate (1 device / empty buckets): split evenly
                 share = seconds / len(rows)
-            t.record_bucket(key, elements, share, naive_b, ring_b)
+            t.record_bucket(
+                key, elements, share, naive_b, ring_b, raw_bytes=raw_b, compression=mode
+            )
     if _SPAN_SINK is not None:
         _SPAN_SINK(t.label, "sync_measured", seconds)
+
+
+def record_quant_error(obj: Any, bucket_key: str, rel_err: float) -> None:
+    """Fold one *measured* quantization relative error into ``obj``'s bucket
+    row ``bucket_key`` (e.g. ``"float32/sum"``).  Callers measure against an
+    exact reference sync (see the bench's compressed leg); telemetry only
+    accumulates sum/count so exporters can report the mean.  Never raises."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        t = telemetry_for(obj)
+        t.record_quant_error(bucket_key, float(rel_err))
 
 
 # ------------------------------------------------------------------ reporting
@@ -597,18 +663,14 @@ def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
             merged.buckets = [int(n) for _, n in s["buckets"]]
             stats.absorb(merged)
         for key, row in part.get("sync_buckets", {}).items():
-            mine = agg.sync_buckets.setdefault(
-                key,
-                {
-                    "syncs": 0,
-                    "elements": 0,
-                    "measured_us": 0.0,
-                    "model_naive_bytes": 0,
-                    "model_ring_bytes": 0,
-                },
-            )
-            for field in mine:
-                mine[field] = mine[field] + row.get(field, 0)
+            mine = agg.sync_buckets.setdefault(key, MetricTelemetry._new_bucket_row())
+            for field, n in row.items():
+                if field == "residual_bytes":  # derived in _bucket_row; recomputed on export
+                    continue
+                if isinstance(n, str):
+                    mine[field] = n
+                else:
+                    mine[field] = mine.get(field, 0) + n
     return agg.as_dict()
 
 

@@ -18,9 +18,14 @@ The reference's entire comm backend is ``gather_all_tensors``
   of the above: dtype-bucketed fused collectives (:func:`build_sync_plan` /
   :func:`apply_sync_plan`), sync cadence control (:class:`SyncPolicy`,
   :class:`SyncStepper`, :func:`flush_sync`), and the hierarchical
-  ICI-then-DCN host sync (:func:`coalesced_host_sync`).
+  ICI-then-DCN host sync (:func:`coalesced_host_sync`);
+* :mod:`~torchmetrics_tpu.parallel.compress` — opt-in compressed collectives
+  (:class:`CompressionConfig` / per-bucket :class:`CompressionSpec`): bf16 or
+  two-phase int8 quantized bucket all-reduces and bitpacked ragged gathers,
+  surfaced through ``SyncPolicy(compression=..., error_budget=...)``.
 """
 
+from torchmetrics_tpu.parallel.compress import CompressionConfig, CompressionSpec
 from torchmetrics_tpu.parallel.coalesce import (
     SyncAdvisor,
     SyncPolicy,
@@ -51,6 +56,8 @@ from torchmetrics_tpu.parallel.sync import (
 )
 
 __all__ = [
+    "CompressionConfig",
+    "CompressionSpec",
     "DeferredRaggedSync",
     "SyncAdvisor",
     "SyncPolicy",

@@ -64,9 +64,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.core.reductions import Reduce, SketchReduce, host_sync_leaf, sync_leaf
+from torchmetrics_tpu.parallel.compress import (
+    CompressionConfig,
+    CompressionSpec,
+    compressed_psum,
+    compression_spec_for,
+    host_dequantize_int8,
+    host_quantize_int8,
+    predicted_error_bound,
+)
 
 __all__ = [
     "Bucket",
+    "CompressionConfig",
+    "CompressionSpec",
     "SyncAdvisor",
     "SyncPlan",
     "SyncPolicy",
@@ -112,15 +123,27 @@ class _Slot:
 
 @dataclass(frozen=True)
 class Bucket:
-    """All same-(dtype, op) psum-family leaves fused into one collective."""
+    """All same-(dtype, op) psum-family leaves fused into one collective.
+
+    ``compression`` is ``None`` for exact buckets (the default — plans built
+    without a :class:`CompressionConfig` are field-for-field identical to
+    pre-compression plans) and a :class:`CompressionSpec` when the planner
+    elected to quantize this bucket's wire payload.
+    """
 
     dtype: str
     op: str  # "sum" | "min" | "max"
     slots: Tuple[_Slot, ...]
+    compression: Optional[CompressionSpec] = None
 
     @property
     def size(self) -> int:
         return sum(s.size for s in self.slots)
+
+    @property
+    def n_collectives(self) -> int:
+        """Collectives this bucket issues (the int8 exchange is two-phase)."""
+        return 1 if self.compression is None else self.compression.n_collectives
 
 
 @dataclass(frozen=True)
@@ -144,7 +167,7 @@ class SyncPlan:
     @property
     def n_collectives(self) -> int:
         """Collectives one sync under this plan launches."""
-        return len(self.buckets) + self.n_passthrough_collectives
+        return sum(b.n_collectives for b in self.buckets) + self.n_passthrough_collectives
 
     def bucket_sizes(self) -> Dict[str, int]:
         """``{"dtype/op": element count}`` per bucket (accounting surface)."""
@@ -163,7 +186,10 @@ def _reduce_for(name: str, reductions: Mapping[str, Any]) -> Any:
         ) from None
 
 
-def build_sync_plan(entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]]]) -> SyncPlan:
+def build_sync_plan(
+    entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]]],
+    compression: Optional[CompressionConfig] = None,
+) -> SyncPlan:
     """Plan one coalesced sync over ``entries`` = [(reduction table, state), ...].
 
     Multiple entries (one per compute-group leader) share buckets — the
@@ -171,6 +197,13 @@ def build_sync_plan(entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]
     order is sorted by (dtype, op) and slot order follows entry/table order,
     both deterministic, so repeated traces of the same configuration emit an
     identical graph.
+
+    ``compression`` opts eligible buckets into quantized wire payloads: only
+    float32 *sum* buckets at or above ``compression.min_bucket_bytes`` whose
+    declared error bound fits ``compression.error_budget`` get a
+    :class:`CompressionSpec`; integer (count) buckets, min/max buckets, and
+    every passthrough leaf always stay exact.  ``None`` (the default) yields
+    a plan identical to the pre-compression planner.
     """
     groups: Dict[Tuple[str, str], List[_Slot]] = {}
     passthrough: List[Tuple[int, str, Any]] = []
@@ -223,10 +256,12 @@ def build_sync_plan(entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]
                 mean=reduce == Reduce.MEAN,
             )
             groups.setdefault((str(dtype), _OP_OF[reduce]), []).append(slot)
-    buckets = tuple(
-        Bucket(dtype=dt, op=op, slots=tuple(slots))
-        for (dt, op), slots in sorted(groups.items())
-    )
+    buckets = []
+    for (dt, op), slots in sorted(groups.items()):
+        nbytes = sum(s.size for s in slots) * jnp.dtype(dt).itemsize
+        spec = compression_spec_for(dt, op, nbytes, compression)
+        buckets.append(Bucket(dtype=dt, op=op, slots=tuple(slots), compression=spec))
+    buckets = tuple(buckets)
     return SyncPlan(
         buckets=buckets,
         passthrough=tuple(passthrough),
@@ -250,8 +285,14 @@ def apply_sync_plan(
     for bucket in plan.buckets:
         parts = [states[s.entry][s.name].reshape((s.size,)) for s in bucket.slots]
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        with jax.named_scope(f"tm_tpu/coalesce/{bucket.op}_{bucket.dtype}"):
-            red = _COLLECTIVE[bucket.op](flat, axis_name)
+        if bucket.compression is not None:
+            with jax.named_scope(
+                f"tm_tpu/compress/{bucket.compression.mode}_{bucket.op}_{bucket.dtype}"
+            ):
+                red = compressed_psum(flat, axis_name, bucket.compression)
+        else:
+            with jax.named_scope(f"tm_tpu/coalesce/{bucket.op}_{bucket.dtype}"):
+                red = _COLLECTIVE[bucket.op](flat, axis_name)
         offset = 0
         for s in bucket.slots:
             seg = red if len(bucket.slots) == 1 else jax.lax.slice_in_dim(red, offset, offset + s.size)
@@ -269,14 +310,16 @@ def coalesced_sync_state(
     state: Mapping[str, Any],
     reductions: Mapping[str, Union[Reduce, Callable]],
     axis_name: str = "data",
+    compression: Optional[CompressionConfig] = None,
 ) -> State:
     """Bucketed replacement for the per-leaf sync loop (pure, in-graph).
 
     Every key of ``state`` must be in the reduction table or be a reserved
     counter (``_n``/``_nonfinite``, always summed) — the same contract the
-    per-leaf ``sync_state`` enforced.
+    per-leaf ``sync_state`` enforced.  ``compression=None`` (the default)
+    traces the exact planner graph bit-for-bit.
     """
-    plan = build_sync_plan([(reductions, state)])
+    plan = build_sync_plan([(reductions, state)], compression=compression)
     return apply_sync_plan(plan, [state], axis_name)[0]
 
 
@@ -288,7 +331,11 @@ def _metric_entry(metric: Any, state: Mapping[str, Any]) -> Tuple[Mapping[str, A
     return metric._reductions, sub
 
 
-def plan_for_metric(metric: Any, state: Optional[Mapping[str, Any]] = None) -> SyncPlan:
+def plan_for_metric(
+    metric: Any,
+    state: Optional[Mapping[str, Any]] = None,
+    compression: Optional[CompressionConfig] = None,
+) -> SyncPlan:
     """Introspection hook: the exact :class:`SyncPlan` one ``sync_states``
     call on ``metric`` builds (``state`` defaults to the live accumulator).
 
@@ -299,11 +346,13 @@ def plan_for_metric(metric: Any, state: Optional[Mapping[str, Any]] = None) -> S
     """
     if state is None:
         state = metric._state
-    return build_sync_plan([_metric_entry(metric, state)])
+    return build_sync_plan([_metric_entry(metric, state)], compression=compression)
 
 
 def plan_for_metrics(
-    metrics: Sequence[Any], states: Sequence[Mapping[str, Any]]
+    metrics: Sequence[Any],
+    states: Sequence[Mapping[str, Any]],
+    compression: Optional[CompressionConfig] = None,
 ) -> Tuple[SyncPlan, Tuple[int, ...]]:
     """Cross-metric introspection hook: the shared bucket plan for the
     coalescible (standard-``sync_states``) subset of ``metrics``.
@@ -318,11 +367,14 @@ def plan_for_metrics(
         i for i, m in enumerate(metrics) if type(m).sync_states is Metric.sync_states
     )
     entries = [_metric_entry(metrics[i], states[i]) for i in standard]
-    return build_sync_plan(entries), standard
+    return build_sync_plan(entries, compression=compression), standard
 
 
 def coalesced_metric_sync(
-    metrics: Sequence[Any], states: Sequence[Mapping[str, Any]], axis_name: str
+    metrics: Sequence[Any],
+    states: Sequence[Mapping[str, Any]],
+    axis_name: str,
+    compression: Optional[CompressionConfig] = None,
 ) -> List[State]:
     """Sync several metrics' states with ONE cross-metric bucket plan.
 
@@ -334,7 +386,7 @@ def coalesced_metric_sync(
     """
     from torchmetrics_tpu.core.guards import count_nonfinite
 
-    plan, standard = plan_for_metrics(metrics, states)
+    plan, standard = plan_for_metrics(metrics, states, compression=compression)
     entries = [_metric_entry(metrics[i], states[i]) for i in standard]
     synced = apply_sync_plan(plan, [e[1] for e in entries], axis_name)
     out: List[Optional[State]] = [None] * len(metrics)
@@ -350,10 +402,12 @@ def coalesced_metric_sync(
 
 # ---------------------------------------------------------------- accounting
 def bucketed_collective_count(
-    reductions: Mapping[str, Any], state: Mapping[str, Any]
+    reductions: Mapping[str, Any],
+    state: Mapping[str, Any],
+    compression: Optional[CompressionConfig] = None,
 ) -> int:
     """Collectives one coalesced sync of ``state`` launches (telemetry model)."""
-    return build_sync_plan([(reductions, state)]).n_collectives
+    return build_sync_plan([(reductions, state)], compression=compression).n_collectives
 
 
 def per_leaf_collective_count(
@@ -381,6 +435,7 @@ def coalesced_host_sync(
     *,
     n_processes: Optional[int] = None,
     allgather: Optional[Callable[[Any], Any]] = None,
+    compression: Optional[CompressionConfig] = None,
 ) -> State:
     """Cross-process (DCN) sync with one ``process_allgather`` per bucket.
 
@@ -390,11 +445,16 @@ def coalesced_host_sync(
     Passthrough leaves (cat/none/callable/tuple/int-mean) keep the per-leaf
     :func:`core.reductions.host_sync_leaf` lowering.
 
+    ``compression`` shrinks the DCN payload of eligible buckets: bf16 ships a
+    half-width gather; int8 quantizes once per process with per-chunk scales
+    and dequantize-sums on the host (a single quantization stage — DCN hops
+    are where compression pays the most).  Exact by default.
+
     ``n_processes``/``allgather`` are injectable for single-process testing;
     by default they resolve to ``jax.process_count()`` and
     ``multihost_utils.process_allgather``.
     """
-    plan = build_sync_plan([(reductions, state)])  # validates leaf names
+    plan = build_sync_plan([(reductions, state)], compression=compression)  # validates leaf names
     n_proc = jax.process_count() if n_processes is None else int(n_processes)
     if n_proc == 1:
         return dict(state)
@@ -406,8 +466,22 @@ def coalesced_host_sync(
     for bucket in plan.buckets:
         parts = [jnp.asarray(state[s.name]).reshape((s.size,)) for s in bucket.slots]
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        gathered = jnp.asarray(allgather(flat))  # (n_proc, bucket_size)
-        red = _HOST_REDUCE[bucket.op](gathered)
+        spec = bucket.compression
+        if spec is not None and spec.mode == "bf16":
+            gathered = jnp.asarray(allgather(flat.astype(jnp.bfloat16)))
+            red = gathered.astype(flat.dtype).sum(0)
+        elif spec is not None and spec.mode == "int8":
+            packed = host_quantize_int8(np.asarray(flat), spec.chunk)
+            gathered = np.asarray(allgather(jnp.asarray(packed)))  # (n_proc, packed_bytes)
+            red = jnp.asarray(
+                sum(
+                    host_dequantize_int8(gathered[p], bucket.size, spec.chunk)
+                    for p in range(gathered.shape[0])
+                )
+            )
+        else:
+            gathered = jnp.asarray(allgather(flat))  # (n_proc, bucket_size)
+            red = _HOST_REDUCE[bucket.op](gathered)
         offset = 0
         for s in bucket.slots:
             seg = red if len(bucket.slots) == 1 else red[offset : offset + s.size]
@@ -434,12 +508,22 @@ class SyncPolicy:
     float summation *order*, so it is bit-exact for integer-valued sum
     states (classification counts) but may differ in final ulps for
     mean-style float accumulators.
+
+    ``compression`` additionally opts large float32 sum buckets into
+    quantized wire payloads (``"bf16"`` or ``"int8"``); ``error_budget``
+    caps the declared relative error a compressed bucket may introduce
+    (buckets whose bound exceeds it stay exact).  ``"none"`` — the default —
+    keeps every sync bit-identical to the uncompressed planner.
     """
 
     every_n_steps: Optional[int] = None
     at_compute: bool = False
+    compression: str = "none"
+    error_budget: Optional[float] = None
 
     def __post_init__(self) -> None:
+        # validates the mode/budget combination (raises ValueError on misuse)
+        CompressionConfig.from_mode(self.compression, self.error_budget)
         if self.at_compute:
             if self.every_n_steps is not None:
                 raise ValueError(
@@ -457,6 +541,11 @@ class SyncPolicy:
     def defers(self) -> bool:
         """True when some steps run collective-free."""
         return self.at_compute or self.every_n_steps > 1
+
+    @property
+    def compression_config(self) -> Optional[CompressionConfig]:
+        """``None`` for exact syncs, else the planner-facing config."""
+        return CompressionConfig.from_mode(self.compression, self.error_budget)
 
     def should_sync(self, pending: int) -> bool:
         return (not self.at_compute) and pending >= self.every_n_steps
@@ -583,8 +672,11 @@ class SyncStepper:
         from torchmetrics_tpu.core.compile import compiled_cadence_sync
         from torchmetrics_tpu.observability import registry as _telemetry
 
+        comp = self.policy.compression_config
         if self._local is not None:
-            fn = compiled_cadence_sync(self.target, self._members, self.mesh, self.axis_name)
+            fn = compiled_cadence_sync(
+                self.target, self._members, self.mesh, self.axis_name, compression=comp
+            )
             measuring = _telemetry.enabled()
             t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
             with _telemetry.span(self.target, "sync"):
@@ -595,7 +687,7 @@ class SyncStepper:
                     jax.block_until_ready(window)
             n_dev = self._n_devices()
             for name, m in self._members:
-                _telemetry.record_sync(m, m._reductions, window[name], n_dev)
+                _telemetry.record_sync(m, m._reductions, window[name], n_dev, compression=comp)
             if measuring:
                 measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
                 _telemetry.record_measured_sync(
@@ -603,6 +695,7 @@ class SyncStepper:
                     [(m._reductions, window[name]) for name, m in self._members],
                     n_dev,
                     measured_s,
+                    compression=comp,
                 )
             if self.verify_consistency:
                 from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
@@ -780,6 +873,7 @@ class SyncAdvisor:
         rec["every_n"]            # 4 on the 8-device CPU mesh
         rec["measured_cut"]       # ~4-5x less sync wall time than every-step
         rec["buckets"]            # per-bucket measured vs model bytes + residual
+        rec["compression"]        # modelled byte cut per mode + recommended mode
     """
 
     def __init__(
@@ -790,18 +884,42 @@ class SyncAdvisor:
         in_specs: Optional[Any] = None,
         candidates: Sequence[int] = (1, 2, 4, 8),
         max_staleness: int = 8,
+        compression: str = "none",
+        error_budget: Optional[float] = None,
     ) -> None:
         from torchmetrics_tpu.parallel.sync import metric_mesh
 
         if 1 not in candidates:
             raise ValueError("SyncAdvisor candidates must include 1 (the measured baseline)")
+        # validates the mode/budget combination (raises ValueError on misuse)
+        CompressionConfig.from_mode(compression, error_budget)
         self.target = target
         self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
         self.axis_name = axis_name
         self.in_specs = in_specs
         self.candidates = tuple(sorted(set(int(n) for n in candidates)))
         self.max_staleness = int(max_staleness)
+        self.compression = compression
+        self.error_budget = error_budget
         self._profile: Optional[Dict[str, Any]] = None
+
+    def _member_metrics(self) -> List[Any]:
+        if hasattr(self.target, "_functional_groups"):
+            names = tuple(ms[0] for ms in self.target._functional_groups().values())
+            return [self.target[n] for n in names]
+        return [self.target]
+
+    def _sync_byte_totals(self) -> Dict[str, int]:
+        """Summed ``sync_bytes``/``sync_bytes_raw`` counters across the
+        profiled metric(s) — the measured per-cadence byte surface."""
+        from torchmetrics_tpu.observability import registry as _telemetry
+
+        out = {"sync_bytes": 0, "sync_bytes_raw": 0}
+        for m in self._member_metrics():
+            counters = _telemetry.telemetry_for(m).as_dict()["counters"]
+            for key in out:
+                out[key] += int(counters.get(key, 0))
+        return out
 
     def profile(self, *inputs: Any, steps: int = 16, rounds: int = 3) -> Dict[str, Any]:
         """Measure total sync wall time over ``steps`` updates of ``inputs``
@@ -821,13 +939,17 @@ class SyncAdvisor:
             _telemetry.enable()
         cands = [n for n in self.candidates if n <= steps and n <= self.max_staleness]
         totals: Dict[int, List[Dict[str, float]]] = {n: [] for n in cands}
+        bytes_by_cand: Dict[int, Dict[str, int]] = {}
+        policy_of = lambda n: SyncPolicy(
+            every_n_steps=n, compression=self.compression, error_budget=self.error_budget
+        )
         before_all = _telemetry.telemetry_for(self.target).as_dict()
         try:
             warm = SyncStepper(
                 self.target,
                 mesh=self.mesh,
                 axis_name=self.axis_name,
-                policy=SyncPolicy(every_n_steps=1),
+                policy=policy_of(1),
                 in_specs=self.in_specs,
             )
             warm.update(*inputs)  # compiles the cadence step + sync untimed
@@ -837,16 +959,22 @@ class SyncAdvisor:
                         self.target,
                         mesh=self.mesh,
                         axis_name=self.axis_name,
-                        policy=SyncPolicy(every_n_steps=n),
+                        policy=policy_of(n),
                         in_specs=self.in_specs,
                     )
                     before = _telemetry.telemetry_for(self.target).as_dict()
+                    bytes_before = self._sync_byte_totals()
                     for _ in range(steps):
                         stepper.update(*inputs)
                     if stepper.pending:
                         stepper.sync()
                     after = _telemetry.telemetry_for(self.target).as_dict()
                     totals[n].append(_span_delta(after, before, "sync"))
+                    bytes_after = self._sync_byte_totals()
+                    # deterministic per cadence — identical every round
+                    bytes_by_cand[n] = {
+                        key: bytes_after[key] - bytes_before[key] for key in bytes_after
+                    }
             after_all = _telemetry.telemetry_for(self.target).as_dict()
         finally:
             if not was_enabled:
@@ -854,6 +982,7 @@ class SyncAdvisor:
         runs: List[Dict[str, Any]] = []
         for n in cands:
             best = min(totals[n], key=lambda d: d["total_s"])
+            nbytes = bytes_by_cand[n]
             runs.append(
                 {
                     "every_n": n,
@@ -862,6 +991,9 @@ class SyncAdvisor:
                     "syncs": best["count"],
                     "sync_s": best["total_s"],
                     "mean_sync_s": best["total_s"] / max(best["count"], 1),
+                    "sync_wire_bytes": nbytes["sync_bytes"],
+                    "sync_raw_bytes": nbytes["sync_bytes_raw"],
+                    "mean_sync_bytes": nbytes["sync_bytes"] / max(best["count"], 1),
                 }
             )
         self._profile = {
@@ -871,6 +1003,58 @@ class SyncAdvisor:
             "buckets": _bucket_delta(after_all, before_all),
         }
         return self._profile
+
+    def _compression_advice(self) -> Dict[str, Any]:
+        """Modelled per-chip byte cut for each compression mode on the
+        profiled metric(s)' sync plan, folded into the recommendation.
+
+        Report-only like the cadence advice: the strongest mode whose
+        declared error bound fits ``self.error_budget`` (and actually cuts
+        bytes) is named ``recommended_mode``; with no budget declared the
+        advice stays ``"none"`` — quantized syncs are an explicit opt-in.
+        """
+        from torchmetrics_tpu.utilities.benchmark import coalesced_sync_bytes_per_chip
+
+        n_dev = int(self.mesh.devices.size)
+        members = self._member_metrics()
+
+        def model_bytes(cfg: Optional[CompressionConfig]) -> int:
+            total = 0
+            for m in members:
+                _, sub = _metric_entry(m, m._state)
+                total += coalesced_sync_bytes_per_chip(
+                    m._reductions, sub, n_dev, compression=cfg
+                )
+            return total
+
+        exact = model_bytes(None)
+        modes: Dict[str, Dict[str, Any]] = {}
+        for mode in ("bf16", "int8"):
+            cfg = CompressionConfig(mode=mode, error_budget=self.error_budget)
+            wire = model_bytes(cfg)
+            bound = predicted_error_bound(mode, stages=2 if mode == "int8" else 1)
+            modes[mode] = {
+                "model_wire_bytes": wire,
+                "model_byte_cut": exact / max(wire, 1),
+                "error_bound": bound,
+                "within_budget": self.error_budget is not None and bound <= self.error_budget,
+            }
+        recommended = "none"
+        if self.error_budget is not None:
+            eligible = [
+                (row["model_byte_cut"], mode)
+                for mode, row in modes.items()
+                if row["within_budget"] and row["model_byte_cut"] > 1.0
+            ]
+            if eligible:
+                recommended = max(eligible)[1]
+        return {
+            "mode": self.compression,
+            "error_budget": self.error_budget,
+            "recommended_mode": recommended,
+            "model_exact_bytes": exact,
+            "modes": modes,
+        }
 
     def recommend(self, target_cut: float = 3.5) -> Dict[str, Any]:
         """The smallest profiled cadence whose measured sync-time cut (vs the
@@ -901,8 +1085,11 @@ class SyncAdvisor:
             "target_cut": target_cut,
             "baseline_sync_s": base["sync_s"],
             "sync_s": best["sync_s"],
+            "sync_wire_bytes": best["sync_wire_bytes"],
+            "sync_raw_bytes": best["sync_raw_bytes"],
             "runs": runs,
             "buckets": buckets,
+            "compression": self._compression_advice(),
             # buckets whose ring-model bytes dwarf the naive prediction are
             # granule-floor-bound: deferral (fewer windows) is what pays there
             "granule_bound_buckets": granule_bound,

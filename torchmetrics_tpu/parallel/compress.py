@@ -1,0 +1,371 @@
+"""Compressed collectives: opt-in quantization for bucketed state syncs.
+
+The coalescing planner (``parallel/coalesce.py``) flattens metric states into a
+handful of dtype/op buckets and issues one collective per bucket.  At pod scale
+the remaining cost is the *bytes* those collectives move — per EQuARX-style
+quantized all-reduce, shrinking the wire payload 2-4x is worth far more than
+shaving another launch.  This module supplies the compression stage the planner
+can attach to individual buckets:
+
+``bf16``
+    Cast the fp32 bucket to bfloat16, run a single ``psum``, cast back.  One
+    collective, exactly half the bytes, ~2**-8 relative error.  The compiler
+    fuses both casts into the surrounding trace, so the compiled artifact is
+    still one fused sync program.
+
+``int8``
+    Two-phase quantized all-reduce with per-chunk symmetric scales computed
+    in-graph.  Each device splits the bucket into ``n_devices`` equal blocks,
+    quantizes every block to int8 with one fp32 scale per ``chunk`` elements,
+    and exchanges blocks with ``all_to_all`` — so device *k* receives all
+    senders' copies of block *k*.  It dequantizes, sums its block exactly in
+    fp32, requantizes the partial, and an ``all_gather`` of the packed payloads
+    completes the allreduce.  Two collectives per bucket, ~4x fewer bytes than
+    the fp32 ring, with error bounded by two quantization stages of 1/127 of
+    the per-chunk max magnitude each.
+
+Both paths are pure ``jax.lax`` graphs: no host callbacks, no extra compile
+cache entries (the compression config rides the existing cache key only when
+active), and they trace fine under ``shard_map(check_vma=False)`` like every
+other sync in this library.
+
+Exactness contract: the planner only ever attaches compression to *float32
+sum* buckets at or above ``min_bucket_bytes``.  Integer buckets (Accuracy-style
+correct/total counts), min/max buckets, and passthrough leaves are never
+compressed, so count-based metrics remain bit-exact even with compression
+enabled.  Host/DCN process-group syncs (``coalesced_host_sync``) can compress
+with a single quantization stage; the two-stage DCN *model* in
+``utilities/benchmark.py`` prices both topologies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "COMPRESSION_MODES",
+    "CompressionConfig",
+    "CompressionSpec",
+    "DEFAULT_CHUNK",
+    "DEFAULT_MIN_BUCKET_BYTES",
+    "PREDICTED_REL_ERROR",
+    "SCALE_BYTES",
+    "bucket_wire_bytes",
+    "compressed_psum",
+    "compression_spec_for",
+    "host_compressed_payload_bytes",
+    "host_dequantize_int8",
+    "host_quantize_int8",
+    "int8_block_bytes",
+    "psum_bf16",
+    "psum_int8",
+]
+
+# Quantization granularity: one fp32 scale per CHUNK int8 payload elements.
+DEFAULT_CHUNK = 256
+# Buckets below this byte size are never compressed: the fixed per-chunk scale
+# overhead (and the all_to_all block padding) erases the win on small payloads.
+DEFAULT_MIN_BUCKET_BYTES = 4096
+SCALE_BYTES = 4  # one fp32 scale per chunk rides the packed payload
+
+COMPRESSION_MODES = ("none", "bf16", "int8")
+
+# Declared per-stage relative error bound (w.r.t. the per-chunk max magnitude).
+# bf16 keeps 8 mantissa bits; symmetric int8 rounds to 1/127 of the chunk amax.
+# The device int8 path quantizes twice (sender blocks, then the requantized
+# partial sum), so its end-to-end bound is 2x the per-stage figure.
+PREDICTED_REL_ERROR: Dict[str, float] = {
+    "bf16": 2.0 ** -8,
+    "int8": 2.0 / 127.0,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Policy-level compression request, hashable so it can ride cache keys.
+
+    ``mode`` is ``"bf16"`` or ``"int8"`` (``"none"`` never reaches a config —
+    callers pass ``None`` instead, keeping default cache keys byte-identical).
+    ``error_budget`` is an optional relative-error ceiling: buckets whose
+    declared bound exceeds it stay exact.  ``min_bucket_bytes`` is the size
+    floor below which buckets stay exact regardless of mode.
+    """
+
+    mode: str
+    error_budget: Optional[float] = None
+    min_bucket_bytes: int = DEFAULT_MIN_BUCKET_BYTES
+    chunk: int = DEFAULT_CHUNK
+
+    def __post_init__(self) -> None:
+        if self.mode not in ("bf16", "int8"):
+            raise ValueError(
+                f"compression mode must be 'bf16' or 'int8', got {self.mode!r}"
+                " (use compression=None / 'none' for exact syncs)"
+            )
+        if self.error_budget is not None and not self.error_budget > 0:
+            raise ValueError(f"error_budget must be positive, got {self.error_budget!r}")
+        if self.min_bucket_bytes < 0:
+            raise ValueError(f"min_bucket_bytes must be >= 0, got {self.min_bucket_bytes!r}")
+        if self.chunk < 8:
+            raise ValueError(f"chunk must be >= 8, got {self.chunk!r}")
+
+    @classmethod
+    def from_mode(
+        cls, mode: Optional[str], error_budget: Optional[float] = None
+    ) -> Optional["CompressionConfig"]:
+        """``"none"``/``None`` -> ``None``; otherwise a validated config."""
+        if mode is None or mode == "none":
+            if error_budget is not None:
+                raise ValueError("error_budget requires compression='bf16' or 'int8'")
+            return None
+        return cls(mode=mode, error_budget=error_budget)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionSpec:
+    """Per-bucket compression decision recorded in the ``SyncPlan``.
+
+    ``error_bound`` is the declared end-to-end relative error bound for this
+    bucket (w.r.t. per-chunk max magnitude); plan tests compare it against the
+    policy's ``error_budget``.
+    """
+
+    mode: str
+    chunk: int = DEFAULT_CHUNK
+    error_bound: float = 0.0
+
+    @property
+    def n_collectives(self) -> int:
+        """Collectives this compressed bucket issues (int8 is two-phase)."""
+        return 2 if self.mode == "int8" else 1
+
+
+def predicted_error_bound(mode: str, *, stages: int = 1) -> float:
+    """Declared relative error bound for ``mode`` across ``stages`` stages."""
+    return PREDICTED_REL_ERROR[mode] * stages
+
+
+def compression_spec_for(
+    dtype: str, op: str, nbytes: int, config: Optional[CompressionConfig]
+) -> Optional[CompressionSpec]:
+    """Decide whether a planner bucket may be compressed.
+
+    Only float32 *sum* buckets (MEAN leaves ride sum buckets and divide after
+    the reduce, so they qualify too) at or above the byte floor are eligible;
+    integer, min/max and small buckets always stay exact.  Returns ``None``
+    when the bucket must remain exact.
+    """
+    if config is None:
+        return None
+    if op != "sum" or dtype != "float32":
+        return None
+    if nbytes < config.min_bucket_bytes:
+        return None
+    # The device int8 path quantizes twice: sender blocks + requantized partial.
+    stages = 2 if config.mode == "int8" else 1
+    bound = predicted_error_bound(config.mode, stages=stages)
+    if config.error_budget is not None and bound > config.error_budget:
+        return None
+    return CompressionSpec(mode=config.mode, chunk=config.chunk, error_bound=bound)
+
+
+# ---------------------------------------------------------------------------
+# In-graph quantized collectives
+# ---------------------------------------------------------------------------
+
+
+def _quantize_chunks(x: jnp.ndarray, n_chunks: int, chunk: int) -> jnp.ndarray:
+    """Pack ``(n_chunks * chunk,)`` fp32 into uint8 ``[int8 payload | fp32 scales]``."""
+    xc = x.reshape(n_chunks, chunk)
+    amax = jnp.max(jnp.abs(xc), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xc / scale[:, None]), -127, 127).astype(jnp.int8)
+    q_bytes = jax.lax.bitcast_convert_type(q, jnp.uint8).reshape(-1)
+    scale_bytes = jax.lax.bitcast_convert_type(scale, jnp.uint8).reshape(-1)
+    return jnp.concatenate([q_bytes, scale_bytes])
+
+
+def _dequantize_chunks(packed: jnp.ndarray, n_chunks: int, chunk: int) -> jnp.ndarray:
+    """Inverse of :func:`_quantize_chunks`; returns ``(n_chunks * chunk,)`` fp32."""
+    q_bytes = packed[: n_chunks * chunk].reshape(n_chunks, chunk)
+    q = jax.lax.bitcast_convert_type(q_bytes, jnp.int8)
+    scale_bytes = packed[n_chunks * chunk :].reshape(n_chunks, SCALE_BYTES)
+    scale = jax.lax.bitcast_convert_type(scale_bytes, jnp.float32)
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+
+
+def psum_bf16(flat: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """All-reduce ``flat`` over ``axis_name`` with a bfloat16 wire payload."""
+    return jax.lax.psum(flat.astype(jnp.bfloat16), axis_name).astype(flat.dtype)
+
+
+def psum_int8(flat: jnp.ndarray, axis_name: str, chunk: int = DEFAULT_CHUNK) -> jnp.ndarray:
+    """Two-phase int8 all-reduce with per-chunk symmetric scales, in-graph.
+
+    Phase 1: quantize ``n`` destination blocks locally, ``all_to_all`` so each
+    device holds every sender's copy of one block.  Phase 2: dequantize, sum
+    the block exactly in fp32, requantize, ``all_gather`` the packed partials.
+    The whole exchange is two uint8 collectives inside the same fused trace —
+    no host round-trips and no extra compile-cache entries.
+    """
+    orig_dtype = flat.dtype
+    flat = flat.astype(jnp.float32)
+    # Under shard_map the axis size constant-folds to a concrete Python int.
+    n = jax.lax.psum(1, axis_name)
+    size = flat.shape[0]
+    n_chunks = -(-size // (n * chunk))  # chunks per destination block
+    padded = n * n_chunks * chunk
+    blocks = jnp.pad(flat, (0, padded - size)).reshape(n, n_chunks * chunk)
+    packed = jnp.stack([_quantize_chunks(blocks[j], n_chunks, chunk) for j in range(n)])
+    received = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    partial = jnp.stack(
+        [_dequantize_chunks(received[k], n_chunks, chunk) for k in range(n)]
+    ).sum(axis=0)
+    repacked = _quantize_chunks(partial, n_chunks, chunk)
+    gathered = jax.lax.all_gather(repacked, axis_name, axis=0, tiled=False)
+    out = jnp.concatenate([_dequantize_chunks(gathered[k], n_chunks, chunk) for k in range(n)])
+    return out[:size].astype(orig_dtype)
+
+
+def compressed_psum(flat: jnp.ndarray, axis_name: str, spec: CompressionSpec) -> jnp.ndarray:
+    """Dispatch a bucket all-reduce through the spec's compression mode."""
+    if spec.mode == "bf16":
+        return psum_bf16(flat, axis_name)
+    if spec.mode == "int8":
+        return psum_int8(flat, axis_name, spec.chunk)
+    raise ValueError(f"unknown compression mode {spec.mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Wire-byte models (consumed by utilities/benchmark.py and telemetry)
+# ---------------------------------------------------------------------------
+
+
+def int8_block_bytes(size: int, n_devices: int, chunk: int = DEFAULT_CHUNK) -> int:
+    """Packed bytes of one destination block in the int8 two-phase exchange."""
+    n_chunks = -(-size // (n_devices * chunk))
+    return n_chunks * chunk + SCALE_BYTES * n_chunks
+
+
+def _granule_ceil(nbytes: int, granule: Optional[int]) -> int:
+    if granule is None or granule <= 0:
+        return nbytes
+    return -(-nbytes // granule) * granule
+
+
+def bucket_wire_bytes(
+    size: int,
+    itemsize: int,
+    n_devices: int,
+    spec: Optional[CompressionSpec],
+    granule: Optional[int] = None,
+) -> int:
+    """Modelled per-chip wire bytes for one bucket all-reduce.
+
+    ``granule=None`` gives the naive (granule-free) model used by the
+    ``sync_bytes`` telemetry counter; an integer granule gives the ring model
+    matching ``utilities.benchmark.ring_reduce_bytes``.  Exact and bf16 buckets
+    follow the ring schedule (2(n-1) payload-chunk hops per chip); the int8
+    two-phase exchange moves 2(n-1) packed blocks per chip (n-1 in the
+    ``all_to_all`` scatter phase, n-1 in the ``all_gather`` phase).
+    """
+    n = int(n_devices)
+    if n <= 1:
+        return 0
+    if spec is None or spec.mode == "none":
+        payload = size * itemsize
+    elif spec.mode == "bf16":
+        payload = size * 2
+    elif spec.mode == "int8":
+        block = int8_block_bytes(size, n, spec.chunk)
+        return 2 * (n - 1) * _granule_ceil(block, granule)
+    else:
+        raise ValueError(f"unknown compression mode {spec.mode!r}")
+    if granule is None:
+        return int(round(2 * (n - 1) / n * payload))
+    return 2 * (n - 1) * _granule_ceil(-(-payload // n), granule)
+
+
+def host_compressed_payload_bytes(size: int, itemsize: int, spec: Optional[CompressionSpec]) -> int:
+    """Per-process payload bytes a host/DCN gather ships for one bucket."""
+    if spec is None or spec.mode == "none":
+        return size * itemsize
+    if spec.mode == "bf16":
+        return size * 2
+    if spec.mode == "int8":
+        n_chunks = -(-size // spec.chunk)
+        return size + SCALE_BYTES * n_chunks
+    raise ValueError(f"unknown compression mode {spec.mode!r}")
+
+
+# ---------------------------------------------------------------------------
+# Host-path (process-group / DCN) quantization — single stage, numpy
+# ---------------------------------------------------------------------------
+
+
+def host_quantize_int8(flat: np.ndarray, chunk: int = DEFAULT_CHUNK) -> np.ndarray:
+    """Pack an fp32 vector into the uint8 ``[int8 payload | fp32 scales]`` layout."""
+    flat = np.asarray(flat, dtype=np.float32)
+    size = flat.shape[0]
+    n_chunks = -(-size // chunk)
+    padded = np.pad(flat, (0, n_chunks * chunk - size)).reshape(n_chunks, chunk)
+    amax = np.max(np.abs(padded), axis=1)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(padded / scale[:, None]), -127, 127).astype(np.int8)
+    return np.concatenate([q.view(np.uint8).reshape(-1), scale.view(np.uint8).reshape(-1)])
+
+
+def host_dequantize_int8(
+    packed: np.ndarray, size: int, chunk: int = DEFAULT_CHUNK
+) -> np.ndarray:
+    """Inverse of :func:`host_quantize_int8`, trimmed back to ``size`` elements."""
+    packed = np.asarray(packed, dtype=np.uint8)
+    n_chunks = -(-size // chunk)
+    q = packed[: n_chunks * chunk].view(np.int8).reshape(n_chunks, chunk)
+    scale = packed[n_chunks * chunk :].view(np.float32)
+    return (q.astype(np.float32) * scale[:, None]).reshape(-1)[:size]
+
+
+# ---------------------------------------------------------------------------
+# Ragged bitpack width selection
+# ---------------------------------------------------------------------------
+
+_PACK_CANDIDATES: Tuple[np.dtype, ...] = (
+    np.dtype(np.uint8),
+    np.dtype(np.int8),
+    np.dtype(np.uint16),
+    np.dtype(np.int16),
+    np.dtype(np.uint32),
+    np.dtype(np.int32),
+)
+
+
+def packed_int_dtype(dtype: np.dtype, value_range: Tuple[float, float]) -> np.dtype:
+    """Narrowest integer dtype that covers a declared ``(lo, hi)`` value range.
+
+    Used to bitpack ragged CAT gathers: token ids declared in ``[0, 50k)``
+    travel as uint16 instead of int32, detection labels in ``[0, 80]`` as
+    uint8.  The width is static — it comes from ``add_state(value_range=...)``,
+    not from the data — so the gather trace stays cache-stable.  Returns the
+    original dtype when no narrowing applies (float dtypes, or ranges needing
+    the full width).
+    """
+    dtype = np.dtype(dtype)
+    if dtype.kind not in ("i", "u"):
+        return dtype
+    lo, hi = value_range
+    if lo > hi:
+        raise ValueError(f"value_range lo must be <= hi, got {value_range!r}")
+    for cand in _PACK_CANDIDATES:
+        if cand.itemsize >= dtype.itemsize:
+            break
+        info = np.iinfo(cand)
+        if info.min <= lo and hi <= info.max:
+            return cand
+    return dtype

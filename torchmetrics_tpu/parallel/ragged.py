@@ -123,6 +123,24 @@ def _check_update_counts(counts: Sequence[int], leaf: str = _N) -> None:
         )
 
 
+def _check_value_range(
+    per_dev: Sequence[Sequence[Any]], name: str, value_range: Tuple[float, float]
+) -> None:
+    """Raise if any item of a bitpacked leaf falls outside its declared
+    range — a narrowing cast would silently wrap the out-of-range values."""
+    lo, hi = value_range
+    for d, items in enumerate(per_dev):
+        for it in items:
+            arr = np.asarray(it)
+            if arr.size and (arr.min() < lo or arr.max() > hi):
+                raise ValueError(
+                    f"ragged leaf {name!r} on device {d} holds values in "
+                    f"[{arr.min()}, {arr.max()}] outside its declared value_range "
+                    f"({lo}, {hi}); the bitpacked gather would wrap them. Fix the "
+                    "add_state(value_range=...) declaration or the update inputs."
+                )
+
+
 def sync_ragged_states(
     reductions: Mapping[str, Union[Reduce, Callable]],
     per_device_states: Sequence[State],
@@ -130,6 +148,7 @@ def sync_ragged_states(
     axis_name: str = "data",
     verify_consistency: bool = False,
     owner: Any = None,
+    value_ranges: Optional[Mapping[str, Tuple[float, float]]] = None,
 ) -> State:
     """Combine per-device states whose list leaves are ragged, via one
     in-graph pad-gather-trim per state name.
@@ -145,6 +164,15 @@ def sync_ragged_states(
     host numpy views (list states are host-side by construction — pushing
     thousands of small per-image arrays back to the device would serialize
     into tiny transfers the downstream compute immediately undoes).
+
+    ``value_ranges`` (``{leaf: (lo, hi)}``, normally the metric's
+    ``add_state(value_range=...)`` declarations) bitpacks integer cat leaves
+    for the wire crossing: a leaf whose declared range fits a narrower int
+    dtype travels at that width (detection labels in ``[0, 80]`` gather as
+    uint8 — a 4x cut) and is cast back after the trim.  The width is static
+    — derived from the declaration, never the data — so the gather trace
+    stays cache-stable; declared ranges are a contract, validated against
+    the data only under ``verify_consistency=True``.
     """
     n_dev = int(mesh.devices.size)
     if int(mesh.shape[axis_name]) != n_dev:
@@ -209,12 +237,22 @@ def sync_ragged_states(
 
     # ---- pack ragged leaves: one (buffer, shape-table) pair per name
     packed: Dict[str, Tuple[np.ndarray, np.ndarray, int, int]] = {}  # name -> (bufs, shapes, L, K)
+    unpacked_dtype: Dict[str, Any] = {}  # name -> original dtype when bitpacked
     for name in ragged_names:
         per_dev = [st[name] for st in per_device_states]
         meta = _ragged_meta(per_dev)
         if meta is None:  # no device holds items for this leaf
             continue
         max_trailing, dtype = meta
+        if value_ranges and name in value_ranges:
+            from torchmetrics_tpu.core.reductions import cat_wire_dtype
+
+            narrow = cat_wire_dtype(dtype, value_ranges[name])
+            if narrow != dtype:
+                if verify_consistency:
+                    _check_value_range(per_dev, name, value_ranges[name])
+                unpacked_dtype[name] = dtype
+                dtype = narrow
         # power-of-two bucketing of every padded dim (core/compile.py): the
         # gather graph re-traces only when a bucket boundary is crossed, not
         # on every batch-geometry change — the shape table still records
@@ -334,6 +372,8 @@ def sync_ragged_states(
             continue
         _, _, L, K = packed[name]
         buf = rebuilt[name]
+        if name in unpacked_dtype:  # bitpacked wire crossing: restore the declared dtype
+            buf = buf.astype(unpacked_dtype[name])
         shape_tab = shape_tabs[name]
         items: List[np.ndarray] = []
         for d in range(n_dev):
@@ -379,7 +419,14 @@ def sharded_list_update(
         )
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     states = [metric.update_state(metric.init_state(), *batch) for batch in per_device_batches]
-    return sync_ragged_states(metric._reductions, states, mesh, axis_name, owner=metric)
+    return sync_ragged_states(
+        metric._reductions,
+        states,
+        mesh,
+        axis_name,
+        owner=metric,
+        value_ranges=getattr(metric, "_value_ranges", None),
+    )
 
 
 class DeferredRaggedSync:
@@ -531,6 +578,7 @@ class DeferredRaggedSync:
                 self.axis_name,
                 verify_consistency=self.verify_consistency,
                 owner=m,
+                value_ranges=getattr(m, "_value_ranges", None),
             )
         n_dev = int(self.mesh.devices.size)
         if self.verify_consistency:
@@ -539,9 +587,13 @@ class DeferredRaggedSync:
                     [int(np.asarray(st.get(_N, 0))) for st in states], leaf=f"{key}::{_N}"
                 )
         table: Dict[str, Union[Reduce, Callable]] = {}
+        ranges: Dict[str, Tuple[float, float]] = {}
         combined: List[State] = [{} for _ in range(n_dev)]
         for key, m in self._members.items():
             table.update({f"{key}::{leaf}": r for leaf, r in m._reductions.items()})
+            ranges.update(
+                {f"{key}::{leaf}": rng for leaf, rng in getattr(m, "_value_ranges", {}).items()}
+            )
             # reserved counters become ordinary namespaced SUM leaves — the
             # combined state has no top-level "_n" of its own
             table[f"{key}::{_N}"] = Reduce.SUM
@@ -550,7 +602,9 @@ class DeferredRaggedSync:
                 combined[d].update({f"{key}::{leaf}": v for leaf, v in st.items()})
         # owner=None: the sync spans several metrics, so it lands in the
         # `_unattributed` telemetry row instead of crediting one of them
-        synced = sync_ragged_states(table, combined, self.mesh, self.axis_name, owner=None)
+        synced = sync_ragged_states(
+            table, combined, self.mesh, self.axis_name, owner=None, value_ranges=ranges
+        )
         out: Dict[str, State] = {}
         for key in self._members:
             prefix = f"{key}::"

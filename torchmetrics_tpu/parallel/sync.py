@@ -42,6 +42,7 @@ from jax.experimental import multihost_utils
 from torchmetrics_tpu.core.reductions import Reduce
 from torchmetrics_tpu.observability import registry as _telemetry
 from torchmetrics_tpu.parallel.coalesce import (
+    CompressionConfig,
     SyncPolicy,
     cadence_stepper,
     coalesced_host_sync,
@@ -94,6 +95,7 @@ def sync_state(
     state: State,
     reductions: Mapping[str, Union[Reduce, Callable]],
     axis_name: str = "data",
+    compression: Optional[CompressionConfig] = None,
 ) -> State:
     """In-graph sync: combine every leaf of ``state`` across ``axis_name``.
 
@@ -105,20 +107,23 @@ def sync_state(
     bucket instead of one per leaf; reserved counters (``_n``/``_nonfinite``)
     ride the int32 sum bucket.
     """
-    return coalesced_sync_state(state, reductions, axis_name)
+    return coalesced_sync_state(state, reductions, axis_name, compression=compression)
 
 
 def host_sync_state(
     state: State,
     reductions: Mapping[str, Union[Reduce, Callable]],
+    compression: Optional[CompressionConfig] = None,
 ) -> State:
     """Cross-process sync of an eager state pytree (DCN path, no jit).
 
     Bucketed like the in-graph path: one ``process_allgather`` per
     (dtype, reduction-class) bucket — the DCN stage of the hierarchical
     two-stage reduce, crossing hosts on already ICI-reduced state.
+    ``compression`` shrinks eligible buckets' DCN payloads (see
+    :func:`~torchmetrics_tpu.parallel.coalesce.coalesced_host_sync`).
     """
-    return coalesced_host_sync(state, reductions)
+    return coalesced_host_sync(state, reductions, compression=compression)
 
 
 def gather_all_arrays(value: Array, group: Any = None) -> list:
@@ -185,6 +190,7 @@ def _measured_sync_dispatch(
     inputs: Sequence[Any],
     mesh: Mesh,
     entries_of: Optional[Callable[[Any], Any]] = None,
+    compression: Optional[CompressionConfig] = None,
 ) -> Any:
     """Dispatch one compiled sharded sync under the owner's ``"sync"`` span.
 
@@ -203,8 +209,21 @@ def _measured_sync_dispatch(
     if measuring:
         measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
         entries = entries_of(out) if entries_of is not None else [(owner._reductions, out)]
-        _telemetry.record_measured_sync(owner, entries, int(mesh.devices.size), measured_s)
+        _telemetry.record_measured_sync(
+            owner, entries, int(mesh.devices.size), measured_s, compression=compression
+        )
     return out
+
+
+def _sync_states_with(metric: Any, st: State, axis_name: str, compression: Optional[CompressionConfig]) -> State:
+    """Route a traced sync through ``metric.sync_states``, forwarding the
+    compression config only to the standard (planner-backed) implementation —
+    metrics that override ``sync_states`` keep their own exact aggregation."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    if compression is not None and type(metric).sync_states is Metric.sync_states:
+        return metric.sync_states(st, axis_name, compression=compression)
+    return metric.sync_states(st, axis_name)
 
 
 def sharded_update(
@@ -245,6 +264,7 @@ def sharded_update(
         in_specs = P(axis_name)
 
     specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
+    compression = sync_policy.compression_config if sync_policy is not None else None
 
     if sync_policy is not None and sync_policy.defers:
         if kwargs:
@@ -286,13 +306,15 @@ def sharded_update(
             # metric.sync_states, not the bare reduction table: metrics with
             # non-distributive states (e.g. Pearson's streaming moments)
             # override sync_states with their own cross-shard aggregation
-            return metric.sync_states(st, axis_name)
+            return _sync_states_with(metric, st, axis_name, compression)
 
         from torchmetrics_tpu.core.compile import shard_map
 
         fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
-        out = _measured_sync_dispatch(metric, fn, inputs, mesh)
-        _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
+        out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
+        _telemetry.record_sync(
+            metric, metric._reductions, out, int(mesh.devices.size), compression=compression
+        )
         if verify_consistency:
             from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -307,9 +329,11 @@ def sharded_update(
     # ~1 s compile)
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
-    fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs)
-    out = _measured_sync_dispatch(metric, fn, inputs, mesh)
-    _telemetry.record_sync(metric, metric._reductions, out, int(mesh.devices.size))
+    fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs, compression=compression)
+    out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
+    _telemetry.record_sync(
+        metric, metric._reductions, out, int(mesh.devices.size), compression=compression
+    )
     if verify_consistency:
         from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -362,6 +386,7 @@ def sharded_collection_update(
         )
     if sync_policy is None:
         sync_policy = getattr(collection, "_sync_policy", None)
+    compression = sync_policy.compression_config if sync_policy is not None else None
     if sync_policy is not None and sync_policy.defers:
         stepper = cadence_stepper(
             collection,
@@ -371,16 +396,25 @@ def sharded_collection_update(
             in_specs=specs,
         )
         return stepper.update(*inputs)
-    fn = compiled_sharded_collection_update(collection, leaders, mesh, axis_name, specs, inputs)
+    fn = compiled_sharded_collection_update(
+        collection, leaders, mesh, axis_name, specs, inputs, compression=compression
+    )
     out = _measured_sync_dispatch(
         collection,
         fn,
         inputs,
         mesh,
         entries_of=lambda o: [(collection[name]._reductions, o[name]) for name in leaders],
+        compression=compression,
     )
     if _telemetry.enabled():
         n_dev = int(mesh.devices.size)
         for name in leaders:
-            _telemetry.record_sync(collection[name], collection[name]._reductions, out[name], n_dev)
+            _telemetry.record_sync(
+                collection[name],
+                collection[name]._reductions,
+                out[name],
+                n_dev,
+                compression=compression,
+            )
     return out

@@ -29,6 +29,7 @@ __all__ = [
     "ring_reduce_bytes",
     "state_bytes",
     "sync_bytes_per_chip",
+    "sync_wire_bytes_per_chip",
     "two_stage_dcn_bytes",
 ]
 
@@ -168,16 +169,52 @@ def coalesced_sync_bytes_per_chip(
     state: Dict[str, Any],
     n_devices: int,
     granule: int = RING_GRANULE_BYTES,
+    compression: Any = None,
 ) -> int:
     """Granule-aware per-chip traffic of the coalesced sync: one ring
     all-reduce per planner bucket (the granule floor amortized over every
-    fused leaf) plus the per-leaf gather path for passthrough leaves."""
-    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    fused leaf) plus the per-leaf gather path for passthrough leaves.
 
-    plan = build_sync_plan([(reductions, state)])
+    ``compression`` (a ``parallel.compress.CompressionConfig``) prices each
+    bucket at its *wire* size — bf16 halves the ring payload, int8's
+    two-phase exchange moves the packed ``[int8 | scales]`` blocks — via the
+    same per-bucket :func:`parallel.compress.bucket_wire_bytes` model the
+    telemetry counters use.  ``None`` reproduces the exact byte model
+    bit-for-bit (``bucket_wire_bytes`` with no spec IS the ring formula).
+    """
+    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
+
+    plan = build_sync_plan([(reductions, state)], compression=compression)
     total = 0
     for bucket in plan.buckets:
-        total += ring_reduce_bytes(bucket.size * np.dtype(bucket.dtype).itemsize, n_devices, granule)
+        itemsize = np.dtype(bucket.dtype).itemsize
+        total += bucket_wire_bytes(bucket.size, itemsize, n_devices, bucket.compression, granule)
+    for _, name, _ in plan.passthrough:
+        leaf = state[name]
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+        total += (n_devices - 1) * nbytes
+    return int(total)
+
+
+def sync_wire_bytes_per_chip(
+    reductions: Dict[str, Any],
+    state: Dict[str, Any],
+    n_devices: int,
+    compression: Any = None,
+) -> int:
+    """Granule-free per-chip *wire* traffic of one coalesced sync under an
+    optional compression config — the compressed counterpart of
+    :func:`sync_bytes_per_chip`, used by telemetry's ``sync_bytes`` counter
+    so compressed and raw counters diff cleanly (both granule-free)."""
+    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
+
+    plan = build_sync_plan([(reductions, state)], compression=compression)
+    total = 0
+    for bucket in plan.buckets:
+        itemsize = np.dtype(bucket.dtype).itemsize
+        total += bucket_wire_bytes(bucket.size, itemsize, n_devices, bucket.compression, None)
     for _, name, _ in plan.passthrough:
         leaf = state[name]
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
@@ -191,17 +228,27 @@ def two_stage_dcn_bytes(
     n_hosts: int,
     n_local_devices: int,
     granule: int = RING_GRANULE_BYTES,
+    compression: Any = None,
 ) -> Dict[str, int]:
     """Cross-host (DCN) traffic model of one psum-family sync: ``flat``
     reduces over all ``n_hosts * n_local_devices`` participants in one ring
     whose inter-host hops carry every local device's segment, vs
     ``two_stage`` which reduces over ICI inside each host first so ONE
     reduced copy per host crosses DCN — an ``~n_local_devices x`` cut.
+
+    With ``compression``, the payload each host ships over DCN shrinks to
+    the host-side packed size (bf16 halves it; int8 ships bytes plus one
+    fp32 scale per chunk — ``host_compressed_payload_bytes``), compounding
+    with the two-stage cut.
     """
     from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    from torchmetrics_tpu.parallel.compress import host_compressed_payload_bytes
 
-    plan = build_sync_plan([(reductions, state)])
-    psum_b = sum(b.size * np.dtype(b.dtype).itemsize for b in plan.buckets)
+    plan = build_sync_plan([(reductions, state)], compression=compression)
+    psum_b = 0
+    for b in plan.buckets:
+        itemsize = np.dtype(b.dtype).itemsize
+        psum_b += host_compressed_payload_bytes(b.size, itemsize, b.compression)
     per_host_ring = ring_reduce_bytes(psum_b, n_hosts, granule)
     return {
         "flat": int(n_local_devices * per_host_ring),

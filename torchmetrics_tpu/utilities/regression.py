@@ -212,8 +212,10 @@ def direction_for(key: str) -> Optional[str]:
     for marker in _HIGHER_BETTER:
         if marker in leaf:
             return "higher"
+    # markers match against "_" + leaf so prefix leaves gate too: a marker
+    # "_bytes" catches "sync_bytes" AND bare "bytes" / "bytes_per_chip"
     for marker in _LOWER_BETTER:
-        if marker in leaf or leaf.endswith(("_s", "_us", "_ms")):
+        if marker in f"_{leaf}" or leaf.endswith(("_s", "_us", "_ms")):
             return "lower"
     return None
 
@@ -225,7 +227,7 @@ def band_for(key: str, noise_band: float = DEFAULT_BAND) -> float:
     leaf = key.rsplit(".", 1)[-1]
     if _TIMING_TOKENS & set(leaf.split("_")):
         return max(TIMING_BAND, noise_band)
-    if any(m in leaf for m in _ANALYTIC_MARKERS):
+    if any(m in f"_{leaf}" for m in _ANALYTIC_MARKERS):
         return ANALYTIC_BAND
     return noise_band
 
