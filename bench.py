@@ -1401,8 +1401,15 @@ def analysis_leg():
     """Static-analysis cost: wall-time of the full trace-safety lint
     (``python -m torchmetrics_tpu.analysis``) over the package, with a 5 s
     budget so the CI gate stays cheap, plus one jaxpr contract audit proving
-    the planner's collective count matches the lowered sync graph.
+    the planner's collective count matches the lowered sync graph, plus the
+    whole-program sanitizer (``--audit-all``: donation races, fingerprint
+    completeness, collective uniformity, golden trace contracts) timed as a
+    fresh subprocess — the honest CI cost, including interpreter start and
+    the 8-device host-platform bootstrap — against a 20 s budget.
     """
+    import subprocess
+    import sys as _sys
+
     import numpy as np
 
     from torchmetrics_tpu.analysis import all_rules, audit_metric, lint_package, package_root
@@ -1420,6 +1427,15 @@ def analysis_leg():
     report = audit_metric(MulticlassAccuracy(num_classes=5, average="micro"), preds, tgt)
     audit_s = time.perf_counter() - t0
 
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [_sys.executable, "-m", "torchmetrics_tpu.analysis", "--audit-all"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    audit_all_s = time.perf_counter() - t0
+
     return {
         "metric": f"full-package lint ({n_files} files, {len(all_rules())} rules)",
         "lint_wall_s": round(lint_s, 3),
@@ -1432,9 +1448,15 @@ def analysis_leg():
             report.traced_sync_collectives,
             report.planned_sync_collectives,
         ],
+        "audit_all_wall_s": round(audit_all_s, 3),
+        "audit_all_budget_s": 20.0,
+        "audit_all_within_budget": bool(audit_all_s < 20.0),
+        "audit_all_exit": proc.returncode,
+        "audit_all_clean": bool(proc.returncode == 0),
         "note": "the lint gate runs in tier-1 CI (exit code 1 on any finding); "
         "the audit closes the loop between the coalescing planner's cost model "
-        "and the collectives XLA actually lowers",
+        "and the collectives XLA actually lowers; audit_all times the full "
+        "whole-program sanitizer (TMT010-TMT013) as a cold subprocess",
     }
 
 

@@ -1,0 +1,197 @@
+"""Tour of the whole-program trace-contract sanitizer (TMT010-TMT013):
+
+1. donation/aliasing race detector — reproduce the PR 1 bug by stripping
+   the ``_state_shared`` guard from a fused compute group, then show the
+   AST use-after-donate scan on a synthetic offender;
+2. fingerprint-completeness checker — catch a metric whose private attr
+   influences the trace but never reaches the compile-cache fingerprint,
+   confirmed dynamically with ``fingerprint_insensitive``;
+3. collective-uniformity verifier — prove the real sync graphs (plain,
+   compressed, cadence, ragged) are replica-independent, then reject a
+   synthetic ``lax.cond``-guarded ``psum``;
+4. golden trace contracts — trace a slate metric, tamper with its golden
+   snapshot, and read the primitive-level diff the CI gate would print.
+
+Run with:  python examples/trace_contracts_walkthrough.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+def _binary_batch():
+    rng = np.random.default_rng(0)
+    return (
+        jnp.asarray(rng.random(32, dtype="float32")),
+        jnp.asarray(rng.integers(0, 2, 32).astype("int32")),
+    )
+
+
+def donation_race() -> None:
+    from torchmetrics_tpu.analysis.donation import audit_donation, scan_use_after_donate
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+    from torchmetrics_tpu.collections import MetricCollection
+
+    banner("TMT010: compute-group aliased donation (the PR 1 bug)")
+    col = MetricCollection({"acc": BinaryAccuracy(), "f1": BinaryF1Score()}, jit=True)
+    p, t = _binary_batch()
+    col.update(p, t)
+    col.update(p, t)  # the SECOND update aliases member states to the leader
+    report = audit_donation(col)
+    print(f"  healthy collection: ok={report.ok}, alias groups detected: {len(report.alias_groups)}")
+
+    for _name, m in dict.items(col):  # strip the guard, as the PR 1 bug effectively did
+        m._state_shared = False
+    report = audit_donation(col)
+    print(f"  guard stripped:     ok={report.ok}, findings: {len(report.issues)}")
+    print(f"    e.g. {report.issues[0].message.splitlines()[0][:100]}")
+
+    banner("TMT010: AST use-after-donate scan")
+    snippet = textwrap.dedent(
+        """
+        from torchmetrics_tpu.core.compile import compiled_update
+
+        def step(metric, state, x):
+            fn = compiled_update(metric, (x,), {})
+            new = fn(state, x)
+            total = state["total"]  # reads the buffer fn() just donated
+            return new, total
+        """
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "bad_donate.py"
+        path.write_text(snippet)
+        for issue in scan_use_after_donate(paths=[path], root=Path(tmp)):
+            print(f"  {issue.path}:{issue.line}: {issue.message.splitlines()[0][:90]}")
+
+
+def fingerprint_completeness() -> None:
+    from torchmetrics_tpu.analysis.fingerprint import (
+        check_class_fingerprint,
+        fingerprint_insensitive,
+    )
+
+    banner("TMT011: unfingerprinted attribute feeding the trace")
+    src = textwrap.dedent(
+        """
+        import jax.numpy as jnp
+        from torchmetrics_tpu.core.metric import Metric
+
+
+        class BadScale(Metric):
+            def __init__(self, scale=2.0, **kw):
+                super().__init__(**kw)
+                self._scale = scale  # private: never fingerprinted
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def _update(self, state, x):
+                return {"total": state["total"] + self._scale * x.sum()}
+
+            def _compute(self, state):
+                return state["total"]
+        """
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        path = Path(tmp) / "badscale.py"
+        path.write_text(src)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("badscale", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        try:
+            for issue in check_class_fingerprint(mod.BadScale):
+                print(f"  static:  {issue.cls}.{issue.attr} [{issue.kind}]")
+                print(f"           {issue.message.splitlines()[0][:90]}")
+            insensitive = fingerprint_insensitive(mod.BadScale(), "_scale")
+            print(f"  dynamic: mutating _scale moves the fingerprint? {not insensitive}")
+            print("           -> BadScale(scale=0.5) and BadScale(scale=2.0) share ONE cached trace")
+        finally:
+            sys.modules.pop(spec.name, None)
+
+    print(
+        "\n  dogfooding this pass caught real bugs: FBeta._beta, PSNR clamp bounds,"
+        "\n  SacreBLEU/TER tokenizer flags — all fingerprinted now (see README table)."
+    )
+
+
+def collective_uniformity() -> None:
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu.analysis.audit import _default_mesh
+    from torchmetrics_tpu.analysis.uniformity import verify_metric_sync, verify_uniform
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.core.compile import shard_map
+
+    banner("TMT012: real sync paths are uniform")
+    report = verify_metric_sync(BinaryAccuracy(), *_binary_batch())
+    for label, seq in report.sequences.items():
+        print(f"  {label:12s} {' '.join(seq) or '(no collectives)'}")
+    print(f"  ok: {report.ok}")
+
+    banner("TMT012: a cond-guarded psum is rejected")
+    mesh = _default_mesh(None, "data")
+    n_dev = int(mesh.devices.size)
+
+    def bad(x):
+        return jax.lax.cond(x[0, 0] > 0, lambda v: jax.lax.psum(v, "data"), lambda v: v, x)
+
+    wrapped = shard_map(bad, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    jx = jax.make_jaxpr(wrapped)(jnp.zeros((n_dev, 4)))
+    for problem in verify_uniform(jx, label="guarded-psum"):
+        print(f"  {problem[:110]}")
+
+
+def trace_contracts() -> None:
+    from torchmetrics_tpu.analysis.contracts import diff_contracts, golden_metrics, trace_contract
+
+    banner("TMT013: golden trace contracts")
+    metric, inputs = golden_metrics()["BinaryAccuracy"]()
+    golden = trace_contract(metric, *inputs)
+    print(f"  metric: {golden['metric']}   mesh: {golden['mesh']}")
+    sync = golden["entrypoints"]["sync"]
+    print(f"  sync collectives: {sync['collectives']}")
+    print(f"  update donates:   {golden['entrypoints']['update']['donation']['donates']}")
+
+    tampered = json.loads(json.dumps(golden))
+    tampered["entrypoints"]["sync"]["collectives"].append("all_gather[8:float32]")
+    tampered["entrypoints"]["update"]["primitives"]["convert_element_type"] = (
+        tampered["entrypoints"]["update"]["primitives"].get("convert_element_type", 0) + 2
+    )
+    print("\n  a refactor sneaks in an all_gather and two dtype conversions; the gate prints:")
+    for diff in diff_contracts(golden, tampered):
+        print(f"    {diff[:110]}")
+    print(
+        "\n  intentional change?  python -m torchmetrics_tpu.analysis --update-contracts"
+        "\n  then review:         git diff tests/unittests/analysis/contracts/"
+    )
+
+
+def main() -> None:
+    donation_race()
+    fingerprint_completeness()
+    collective_uniformity()
+    trace_contracts()
+    banner("Done")
+    print("  CI gate:  python -m torchmetrics_tpu.analysis --audit-all   (exit 0 = clean)")
+
+
+if __name__ == "__main__":
+    main()

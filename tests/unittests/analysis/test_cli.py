@@ -55,3 +55,50 @@ def test_list_rules_prints_registry():
     assert proc.returncode == 0
     for rid in ("TMT001", "TMT002", "TMT003", "TMT009"):
         assert rid in proc.stdout
+
+
+def test_list_rules_tags_whole_program_passes():
+    proc = _run("--list-rules")
+    assert proc.returncode == 0
+    for rid in ("TMT010", "TMT011", "TMT012", "TMT013"):
+        line = next(l for l in proc.stdout.splitlines() if l.startswith(rid))
+        assert "[whole-program]" in line
+
+
+def test_github_format_emits_error_annotations(tmp_path):
+    bad = tmp_path / "offender.py"
+    bad.write_text('print("hi")\n')
+    proc = _run(str(bad), "--format", "github")
+    assert proc.returncode == 1
+    lines = proc.stdout.splitlines()
+    assert lines[0].startswith("::error file=")
+    assert "line=1" in lines[0] and "title=TMT001" in lines[0]
+    assert lines[-1].endswith("1 finding(s)")
+
+
+def test_parse_error_exit_two_names_failing_file(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    proc = _run(str(broken))
+    assert proc.returncode == 2
+    assert "parse error in" in proc.stderr
+    assert "broken.py" in proc.stderr
+
+
+def test_missing_path_is_usage_error(tmp_path):
+    proc = _run(str(tmp_path / "nope.py"))
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+@pytest.mark.contracts
+def test_audit_all_is_clean_and_within_budget():
+    import time
+
+    t0 = time.monotonic()
+    proc = _run("--audit-all", "--format", "json")
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["n_findings"] == 0
+    assert wall < 60.0  # generous CI ceiling; bench.py enforces the 20s budget

@@ -1,0 +1,119 @@
+"""TMT013 golden trace-contract snapshots.
+
+The CI gate: the golden slate re-traces clean against the JSON snapshots in
+``tests/unittests/analysis/contracts/``, and a tampered golden fails with a
+diff that names the metric and the changed primitive — so a graph regression
+reads as "``add`` count 3 -> 4 in BinaryAccuracy update", not a bare assert.
+"""
+
+import copy
+import json
+import shutil
+
+import pytest
+
+from torchmetrics_tpu.analysis.contracts import (
+    CONTRACT_SCHEMA_VERSION,
+    check_contracts,
+    contract_dir,
+    diff_contracts,
+    golden_metrics,
+    trace_contract,
+    write_contracts,
+)
+
+pytestmark = pytest.mark.contracts
+
+
+def test_golden_slate_covers_at_least_12_metrics():
+    slate = golden_metrics()
+    assert len(slate) >= 12
+    # families: classification, aggregation, regression, image
+    assert {"BinaryAccuracy", "MeanMetric", "MeanSquaredError", "PeakSignalNoiseRatio"} <= set(slate)
+
+
+def test_snapshots_exist_for_every_slate_entry():
+    on_disk = {p.stem for p in contract_dir().glob("*.json")}
+    assert set(golden_metrics()) <= on_disk
+
+
+def test_snapshot_shape():
+    golden = json.loads((contract_dir() / "BinaryAccuracy.json").read_text())
+    assert golden["schema"] == CONTRACT_SCHEMA_VERSION
+    assert golden["mesh"] == "cpu:8/data"
+    update = golden["entrypoints"]["update"]
+    sync = golden["entrypoints"]["sync"]
+    assert update["primitives"] and sync["primitives"]
+    assert update["collectives"] == []  # update path must stay collective-free
+    assert sync["collectives"]  # sync must actually cross replicas
+    assert update["donation"]["donates"] is True
+
+
+def test_check_contracts_passes_on_disk_goldens():
+    assert check_contracts() == []
+
+
+def test_tampered_golden_names_metric_and_primitive(tmp_path):
+    for p in contract_dir().glob("*.json"):
+        shutil.copy(p, tmp_path / p.name)
+    target = tmp_path / "BinaryAccuracy.json"
+    golden = json.loads(target.read_text())
+    prims = golden["entrypoints"]["update"]["primitives"]
+    prim = sorted(prims)[0]
+    prims[prim] += 1
+    target.write_text(json.dumps(golden))
+    diffs = check_contracts(tmp_path)
+    assert any("BinaryAccuracy" in d and f"primitive '{prim}'" in d for d in diffs)
+
+
+def test_missing_and_stale_snapshots_are_reported(tmp_path):
+    for p in contract_dir().glob("*.json"):
+        shutil.copy(p, tmp_path / p.name)
+    (tmp_path / "BinaryAccuracy.json").unlink()
+    (tmp_path / "RetiredMetric.json").write_text("{}")
+    diffs = check_contracts(tmp_path)
+    assert any("BinaryAccuracy" in d and "--update-contracts" in d for d in diffs)
+    assert any("RetiredMetric" in d and "stale" in d for d in diffs)
+
+
+def test_update_contracts_roundtrip(tmp_path):
+    written = write_contracts(tmp_path, names=["MeanMetric"])
+    assert [p.name for p in written] == ["MeanMetric.json"]
+    assert json.loads(written[0].read_text()) == json.loads(
+        (contract_dir() / "MeanMetric.json").read_text()
+    )
+
+
+def test_trace_contract_is_deterministic():
+    metric, inputs = golden_metrics()["SumMetric"]()
+    a = trace_contract(metric, *inputs)
+    metric2, inputs2 = golden_metrics()["SumMetric"]()
+    b = trace_contract(metric2, *inputs2)
+    assert a == b
+
+
+# -------------------------------------------------------------- diff surface
+def _contract():
+    metric, inputs = golden_metrics()["BinaryAccuracy"]()
+    return trace_contract(metric, *inputs)
+
+
+def test_diff_reports_collective_sequence_change():
+    golden = _contract()
+    current = copy.deepcopy(golden)
+    current["entrypoints"]["sync"]["collectives"].append("all_gather[8:float32]")
+    diffs = diff_contracts(golden, current)
+    assert any("collective sequence changed" in d and "all_gather" in d for d in diffs)
+
+
+def test_diff_reports_dropped_donation():
+    golden = _contract()
+    current = copy.deepcopy(golden)
+    current["entrypoints"]["update"]["donation"]["donates"] = False
+    diffs = diff_contracts(golden, current)
+    assert any("donation mask changed" in d for d in diffs)
+
+
+def test_diff_identical_contracts_is_empty():
+    golden = _contract()
+    assert diff_contracts(golden, copy.deepcopy(golden)) == []

@@ -1,0 +1,144 @@
+"""TMT010 donation/aliasing race detector.
+
+The load-bearing regression: PR 1's aliased-donation bug — compute-group
+members sharing one state buffer while each donates it on update.  The
+healthy package guards this with ``_state_shared`` (``MetricCollection.
+_mark_shared``); stripping the guard must reproduce the finding, one per
+shared leaf.
+"""
+
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.analysis.donation import (
+    audit_donation,
+    donation_mask,
+    scan_use_after_donate,
+)
+from torchmetrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+from torchmetrics_tpu.collections import MetricCollection
+
+pytestmark = pytest.mark.lint
+
+
+def _binary_batch():
+    rng = np.random.default_rng(0)
+    return (
+        jnp.asarray(rng.random(32, dtype="float32")),
+        jnp.asarray(rng.integers(0, 2, 32).astype("int32")),
+    )
+
+
+def _fused_group():
+    """A jit compute-group collection after TWO updates — the second update
+    is what aliases member states onto the group leader."""
+    col = MetricCollection({"acc": BinaryAccuracy(), "f1": BinaryF1Score()}, jit=True)
+    p, t = _binary_batch()
+    col.update(p, t)
+    col.update(p, t)
+    return col
+
+
+# ------------------------------------------------------------- live aliasing
+def test_healthy_compute_group_is_clean():
+    report = audit_donation(_fused_group())
+    assert report.ok, report.issues
+    assert report.alias_groups  # the aliasing itself is real and detected
+
+
+def test_guard_removed_reproduces_aliased_donation():
+    col = _fused_group()
+    for _name, m in dict.items(col):  # raw access: bypass copy_state machinery
+        m._state_shared = False
+    report = audit_donation(col)
+    assert not report.ok
+    kinds = {i.kind for i in report.issues}
+    assert kinds == {"aliased-donation"}
+    # one finding per shared state leaf of the accuracy/f1 stat-scores group
+    assert len(report.issues) == 5
+    msg = report.issues[0].message
+    assert "_state_shared" in msg and "donat" in msg
+
+
+def test_single_metric_is_clean():
+    m = BinaryAccuracy()
+    m.update(*_binary_batch())
+    assert audit_donation(m).ok
+
+
+# ------------------------------------------------------------- donation mask
+def test_donation_mask_consumed_leaves():
+    mask = donation_mask(BinaryAccuracy(), "update", *_binary_batch())
+    assert mask["entrypoint"] == "update"
+    assert mask["donates"] is True
+    assert mask["leaves"] == ("_n", "fn", "fp", "tn", "tp")
+    assert mask["consumed"] == ("_n", "fn", "fp", "tn", "tp")
+
+
+def test_donation_mask_respects_state_shared():
+    m = BinaryAccuracy()
+    m._state_shared = True
+    mask = donation_mask(m, "update")
+    assert mask["donates"] is False
+
+
+# ------------------------------------------------------- AST use-after-donate
+def test_package_has_no_use_after_donate():
+    assert scan_use_after_donate() == []
+
+
+def test_synthetic_use_after_donate_is_flagged(tmp_path):
+    src = textwrap.dedent(
+        """
+        from torchmetrics_tpu.core.compile import compiled_update
+
+        def step(metric, state, x):
+            fn = compiled_update(metric, (x,), {})
+            new = fn(state, x)
+            total = state["total"]  # read of the donated buffer
+            return new, total
+        """
+    )
+    path = tmp_path / "bad_donate.py"
+    path.write_text(src)
+    issues = scan_use_after_donate(paths=[path], root=tmp_path)
+    assert len(issues) == 1
+    issue = issues[0]
+    assert issue.kind == "use-after-donate"
+    assert issue.line == 7  # the read, not the donating call
+    assert "state" in issue.message
+
+
+def test_same_unit_rebind_is_safe(tmp_path):
+    src = textwrap.dedent(
+        """
+        from torchmetrics_tpu.core.compile import compiled_update
+
+        def step(metric, state, x):
+            fn = compiled_update(metric, (x,), {})
+            state = fn(state, x)     # canonical donate-and-rebind
+            return state["total"]    # reads the NEW buffer: fine
+        """
+    )
+    path = tmp_path / "good_donate.py"
+    path.write_text(src)
+    assert scan_use_after_donate(paths=[path], root=tmp_path) == []
+
+
+def test_donate_false_call_is_not_tracked(tmp_path):
+    src = textwrap.dedent(
+        """
+        from torchmetrics_tpu.core.compile import compiled_update
+
+        def step(metric, state, x):
+            fn = compiled_update(metric, (x,), {}, donate=False)
+            new = fn(state, x)
+            return new, state["total"]  # buffer not donated: legal
+        """
+    )
+    path = tmp_path / "nodonate.py"
+    path.write_text(src)
+    assert scan_use_after_donate(paths=[path], root=tmp_path) == []

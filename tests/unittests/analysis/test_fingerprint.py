@@ -1,0 +1,177 @@
+"""TMT011 fingerprint-completeness checker.
+
+Stale-trace bug class: an attribute that influences traced code but is
+invisible to ``config_fingerprint`` lets two differently-configured
+instances share one compile-cache entry.  The checker's attribute dataflow
+must catch synthetic offenders, pass clean derived-attribute patterns, and
+— via the dynamic ``fingerprint_insensitive`` cross-check — agree with what
+``explain_retrace`` would observe.
+"""
+
+import importlib.util
+import sys
+import textwrap
+
+import pytest
+
+from torchmetrics_tpu.analysis.fingerprint import (
+    check_class_fingerprint,
+    check_fingerprint,
+    fingerprint_insensitive,
+    scan_package_fingerprints,
+)
+from torchmetrics_tpu.analysis.linter import apply_suppressions
+from torchmetrics_tpu.analysis.sanitizer import run_fingerprint_pass
+
+pytestmark = pytest.mark.lint
+
+_FIXTURE_SRC = textwrap.dedent(
+    """
+    import jax.numpy as jnp
+    from torchmetrics_tpu.core.metric import Metric
+
+
+    class BadScale(Metric):
+        '''Private attr fed by an unmirrored ctor param: classic offender.'''
+
+        def __init__(self, scale=2.0, **kw):
+            super().__init__(**kw)
+            self._scale = scale
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, x):
+            return {"total": state["total"] + self._scale * x.sum()}
+
+        def _compute(self, state):
+            return state["total"]
+
+
+    class ExcludedRead(Metric):
+        '''Public attr read in trace but opted out of the fingerprint.'''
+
+        __fingerprint_exclude__ = ("mode",)
+
+        def __init__(self, mode="a", **kw):
+            super().__init__(**kw)
+            self.mode = mode
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, x):
+            s = x.sum() if self.mode == "a" else x.max()
+            return {"total": state["total"] + s}
+
+        def _compute(self, state):
+            return state["total"]
+
+
+    class GoodScale(Metric):
+        '''Private attrs derived from mirrored/public config: safe.'''
+
+        def __init__(self, scale=2.0, **kw):
+            super().__init__(**kw)
+            self.scale = scale
+            self._scale2 = float(scale) * 2
+            self._table = {k: k * self._scale2 for k in range(3)}
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def _update(self, state, x):
+            return {"total": state["total"] + self._scale2 * x.sum() + self._table[1]}
+
+        def _compute(self, state):
+            return state["total"]
+
+
+    class MutatedInTrace(Metric):
+        '''Private attr reassigned outside the construction lifecycle.'''
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self._bias = 0.0
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def set_bias(self, b):
+            self._bias = b
+
+        def _update(self, state, x):
+            return {"total": state["total"] + x.sum() + self._bias}
+
+        def _compute(self, state):
+            return state["total"]
+    """
+)
+
+
+@pytest.fixture(scope="module")
+def fixture_mod(tmp_path_factory):
+    path = tmp_path_factory.mktemp("fp") / "fp_fixture_metrics.py"
+    path.write_text(_FIXTURE_SRC)
+    spec = importlib.util.spec_from_file_location("fp_fixture_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    yield mod
+    sys.modules.pop(spec.name, None)
+
+
+def test_unmirrored_private_param_is_flagged(fixture_mod):
+    issues = check_class_fingerprint(fixture_mod.BadScale)
+    assert [(i.attr, i.kind) for i in issues] == [("_scale", "unfingerprinted-private")]
+    assert "compile-cache key" in issues[0].message
+
+
+def test_excluded_public_read_is_flagged(fixture_mod):
+    issues = check_class_fingerprint(fixture_mod.ExcludedRead)
+    assert [(i.attr, i.kind) for i in issues] == [("mode", "excluded-read")]
+
+
+def test_derived_private_attrs_are_safe(fixture_mod):
+    assert check_class_fingerprint(fixture_mod.GoodScale) == []
+
+
+def test_mutation_outside_lifecycle_is_flagged(fixture_mod):
+    issues = check_class_fingerprint(fixture_mod.MutatedInTrace)
+    assert [(i.attr, i.kind) for i in issues] == [("_bias", "mutated-in-trace")]
+
+
+def test_instance_check_filters_to_carried_attrs(fixture_mod):
+    m = fixture_mod.BadScale()
+    assert [i.attr for i in check_fingerprint(m)] == ["_scale"]
+
+
+def test_dynamic_cross_check_confirms_findings(fixture_mod):
+    # mutating the flagged attr moves nothing in the fingerprint — i.e.
+    # explain_retrace would attribute NO retrace to it: the hazard is real
+    assert fingerprint_insensitive(fixture_mod.BadScale(), "_scale")
+    # while a fingerprinted public attr IS sensitive
+    assert not fingerprint_insensitive(fixture_mod.GoodScale(), "scale")
+
+
+# ----------------------------------------------------------- package dogfood
+def test_package_scan_only_suppressed_findings():
+    # the raw scan may surface statically-unprovable-but-justified sites;
+    # each must carry a # tmt: ignore[TMT011] at its read line
+    assert apply_suppressions(run_fingerprint_pass()) == []
+
+
+def test_fbeta_beta_is_fingerprinted():
+    # regression: beta was a private-only attr — two FBeta instances
+    # differing only in beta shared one compile-cache key
+    from torchmetrics_tpu.classification import BinaryFBetaScore
+
+    a, b = BinaryFBetaScore(beta=0.5), BinaryFBetaScore(beta=2.0)
+    assert a._config_fingerprint() != b._config_fingerprint()
+
+
+def test_psnr_clamp_bounds_are_fingerprinted():
+    # regression: data_range=(0, 1) vs (1, 2) share data_range == 1.0 but
+    # compile different clip constants — the bounds must key the cache
+    from torchmetrics_tpu.image import PeakSignalNoiseRatio
+
+    a = PeakSignalNoiseRatio(data_range=(0.0, 1.0))
+    b = PeakSignalNoiseRatio(data_range=(1.0, 2.0))
+    assert a._config_fingerprint() != b._config_fingerprint()
+
+
+def test_scan_package_returns_only_known_justified_sites():
+    issues = scan_package_fingerprints()
+    assert {(i.cls, i.attr) for i in issues} <= {("BERTScore", "_zero_special")}

@@ -252,3 +252,122 @@ def test_lint_paths_sorted_and_recursive(tmp_path):
     (tmp_path / "pkg" / "a.py").write_text('print("a")\n')
     findings = lint_paths([tmp_path / "pkg"], root=tmp_path)
     assert [f.path for f in findings] == ["pkg/a.py", "pkg/b.py"]
+
+
+# ----------------------------------------------- TMT004 match / walrus forms
+def test_tmt004_match_on_traced_subject(tmp_path):
+    src = """
+    def _update(self, state, preds):
+        match preds.sum():
+            case 0:
+                return state
+            case _:
+                return state
+    """
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT004"]
+    assert "match" in findings[0].message
+
+
+def test_tmt004_match_guard_on_traced_input(tmp_path):
+    src = """
+    def _update(self, state, preds, mode="sum"):
+        match mode:
+            case "sum" if preds.sum() > 0:
+                return state
+            case _:
+                return state
+    """
+    findings = _lint(tmp_path, src)
+    assert _ids(findings) == ["TMT004"]
+
+
+def test_tmt004_match_on_config_is_allowed(tmp_path):
+    src = """
+    def _update(self, state, preds, mode="macro"):
+        match mode:
+            case "macro":
+                return state
+            case _:
+                return state
+    """
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt004_walrus_in_condition(tmp_path):
+    src = """
+    def _update(self, state, preds):
+        if (total := preds.sum()) > 0:
+            return {"t": state["t"] + total}
+        return state
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT004"]
+
+
+def test_tmt004_walrus_taint_propagates_to_later_branch(tmp_path):
+    src = """
+    def _update(self, state, preds):
+        y = (s := preds.sum())
+        if s > 0:
+            return {"t": state["t"] + y}
+        return state
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT004"]
+
+
+# ------------------------------------------- TMT009 satellite: multi-rule &
+# decorated-nested-function suppressions
+def test_multi_rule_one_line_suppression(tmp_path):
+    src = """
+    import jax.numpy as jnp
+
+    def _update(self, state, x):
+        return {"t": state["t"] + float(x) + jnp.array([1.0])}  # tmt: ignore[TMT003,TMT005] -- host fallback path, constant folded once
+    """
+    assert _lint(tmp_path, src) == []
+
+
+def test_multi_rule_suppression_partially_stale_is_tmt009(tmp_path):
+    src = """
+    def _update(self, state, x):
+        return {"t": state["t"] + float(x)}  # tmt: ignore[TMT003,TMT005] -- only TMT003 actually fires here
+    """
+    ids = _ids(_lint(tmp_path, src))
+    assert ids == ["TMT009"]  # TMT003 suppressed; TMT005 half reported stale
+
+
+def test_suppression_in_decorated_nested_function(tmp_path):
+    src = """
+    import functools
+
+    def make_update(scale):
+        @functools.lru_cache(maxsize=1)
+        def _update(self, state, x):
+            return {"t": state["t"] + float(x) * scale}  # tmt: ignore[TMT003] -- eager-only helper, never jitted
+        return _update
+    """
+    assert _lint(tmp_path, src) == []
+
+
+def test_stale_suppression_in_decorated_nested_function_is_tmt009(tmp_path):
+    src = """
+    import functools
+
+    def make_update(scale):
+        @functools.lru_cache(maxsize=1)
+        def _update(self, state, x):
+            return {"t": state["t"] * scale}  # tmt: ignore[TMT003] -- nothing fires on this line
+        return _update
+    """
+    assert _ids(_lint(tmp_path, src)) == ["TMT009"]
+
+
+def test_whole_program_rules_registered_and_inert_per_file(tmp_path):
+    # TMT010-013 live in the registry (so --select and suppressions know
+    # them) but never produce per-file findings from lint_file
+    ids = [r.id for r in all_rules()]
+    for rid in ("TMT010", "TMT011", "TMT012", "TMT013"):
+        assert rid in ids
+        assert get_rule(rid).whole_program
+    src = 'x = 1  # tmt: ignore[TMT011] -- whole-program suppression, never stale per-file\n'
+    assert _lint(tmp_path, src) == []

@@ -1,0 +1,124 @@
+"""TMT012 collective-uniformity verifier.
+
+Every sync lowering — plain, coalesced, int8/bf16 compressed, cadence-
+windowed, ragged — must issue a replica-independent collective sequence; a
+collective under traced control flow deadlocks a real pod.  All paths run
+on the 8-device host-platform mesh the test session pins.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from torchmetrics_tpu.analysis.audit import _default_mesh
+from torchmetrics_tpu.analysis.uniformity import (
+    collective_sequence,
+    verify_cadence_step,
+    verify_collection_sync,
+    verify_metric_sync,
+    verify_ragged_gather,
+    verify_uniform,
+)
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.core.compile import shard_map
+from torchmetrics_tpu.regression import MeanSquaredError
+
+pytestmark = pytest.mark.lint
+
+
+def _binary_batch():
+    rng = np.random.default_rng(0)
+    return (
+        jnp.asarray(rng.random(32, dtype="float32")),
+        jnp.asarray(rng.integers(0, 2, 32).astype("int32")),
+    )
+
+
+def _regression_batch():
+    rng = np.random.default_rng(1)
+    return (
+        jnp.asarray(rng.random(32, dtype="float32")),
+        jnp.asarray(rng.random(32, dtype="float32")),
+    )
+
+
+def _slate():
+    acc, mse = BinaryAccuracy(), MeanSquaredError()
+    states = [
+        acc.update_state(acc.init_state(), *_binary_batch()),
+        mse.update_state(mse.init_state(), *_regression_batch()),
+    ]
+    return [acc, mse], states
+
+
+# --------------------------------------------------------------- plain paths
+def test_metric_sync_plain_and_compressed_are_uniform():
+    report = verify_metric_sync(BinaryAccuracy(), *_binary_batch())
+    assert report.ok, report.problems
+    assert report.sequences["sync"]  # plain path issues collectives
+    # compressed paths engage the wire dtypes and stay uniform
+    int8_seq = " ".join(report.sequences["sync[int8]"])
+    bf16_seq = " ".join(report.sequences["sync[bf16]"])
+    assert "uint8" in int8_seq or "int8" in int8_seq
+    assert "bfloat16" in bf16_seq
+
+
+def test_coalesced_and_cadence_flush_are_uniform():
+    metrics, states = _slate()
+    report = verify_collection_sync(metrics, states)
+    assert report.ok, report.problems
+    assert report.sequences["coalesced"]
+    # the every_n cadence flush lowers the same fused collective sequence
+    assert report.sequences["cadence-flush"] == report.sequences["coalesced"]
+
+
+def test_cadence_local_step_is_collective_free():
+    metrics, states = _slate()
+    report = verify_cadence_step(metrics, states, *_binary_batch())
+    assert report.ok, report.problems
+    assert all(seq == () for seq in report.sequences.values())
+
+
+def test_ragged_gather_is_uniform_and_gathers():
+    report = verify_ragged_gather()
+    assert report.ok, report.problems
+    joined = " ".join(seq for seqs in report.sequences.values() for seq in seqs)
+    assert "all_gather" in joined or "pgather" in joined
+
+
+# ------------------------------------------------------- synthetic violation
+def test_guarded_collective_is_rejected():
+    mesh = _default_mesh(None, "data")
+    n_dev = int(mesh.devices.size)
+
+    def bad(x):
+        # collective inside a cond dominated by a traced value: some
+        # replicas enter the branch, others don't — deadlock shape
+        return jax.lax.cond(
+            x[0, 0] > 0,
+            lambda v: jax.lax.psum(v, "data"),
+            lambda v: v,
+            x,
+        )
+
+    wrapped = shard_map(bad, mesh=mesh, in_specs=P("data"), out_specs=P("data"), check_vma=False)
+    jx = jax.make_jaxpr(wrapped)(jnp.zeros((n_dev, 4)))
+    problems = verify_uniform(jx, label="synthetic")
+    assert problems
+    assert any("psum" in p for p in problems)
+
+
+def test_unguarded_collective_passes():
+    mesh = _default_mesh(None, "data")
+    n_dev = int(mesh.devices.size)
+
+    def good(x):
+        return jax.lax.psum(x, "data")
+
+    wrapped = shard_map(good, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    jx = jax.make_jaxpr(wrapped)(jnp.zeros((n_dev, 4)))
+    assert verify_uniform(jx, label="synthetic") == []
+    seq = collective_sequence(jx)
+    assert [op.primitive for op in seq] == ["psum"]

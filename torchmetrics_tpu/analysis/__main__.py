@@ -3,25 +3,52 @@
 Exit codes (CI contract):
   0  clean — no findings
   1  findings reported
-  2  usage / internal error
+  2  usage / internal error (the failing file or pass is named on stderr)
 
-``--format json`` emits a machine-readable report; ``--list-rules`` prints
+``--format json`` emits a machine-readable report; ``--format github``
+emits ``::error file=...`` workflow annotations; ``--list-rules`` prints
 the registry with IDs and descriptions.
+
+``--audit-all`` additionally runs the whole-program sanitizer passes
+(TMT010-TMT013: donation races, fingerprint completeness, collective
+uniformity, golden trace contracts).  These trace real jaxprs on an
+8-device host-platform mesh, so the CLI pins ``JAX_PLATFORMS=cpu`` and
+``--xla_force_host_platform_device_count=8`` *before* JAX initializes —
+unless the caller already configured a platform.  ``--update-contracts``
+regenerates the golden snapshots after an intentional graph change.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from pathlib import Path
 
 from torchmetrics_tpu.analysis.linter import (
     all_rules,
+    format_github,
     format_json,
     format_text,
     lint_paths,
     package_root,
 )
+
+
+def _bootstrap_devices() -> None:
+    """Give the process an 8-device CPU mesh before JAX's backend spins up.
+
+    ``XLA_FLAGS``/``JAX_PLATFORMS`` are read lazily at backend
+    initialization (the first device query), not at ``import jax`` — so
+    setting them here, before the sanitizer traces anything, is early
+    enough.  A caller that already chose a platform keeps it
+    (``setdefault``), and a backend that somehow initialized earlier simply
+    ignores the flags — the passes then run on whatever devices exist.
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count=8".strip()
 
 
 def main(argv=None) -> int:
@@ -34,19 +61,30 @@ def main(argv=None) -> int:
         nargs="*",
         help="files or directories to lint (default: the installed torchmetrics_tpu package)",
     )
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "github"), default="text")
     parser.add_argument(
         "--select",
         default=None,
         help="comma-separated rule IDs to run (default: all); e.g. --select TMT003,TMT004",
     )
     parser.add_argument("--list-rules", action="store_true", help="print the rule registry and exit")
+    parser.add_argument(
+        "--audit-all",
+        action="store_true",
+        help="also run the whole-program sanitizer passes (TMT010-TMT013)",
+    )
+    parser.add_argument(
+        "--update-contracts",
+        action="store_true",
+        help="regenerate the golden trace-contract snapshots (TMT013) and exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for rule in all_rules():
             allow = f"  [allow: {', '.join(rule.allow_paths)}]" if rule.allow_paths else ""
-            sys.stdout.write(f"{rule.id}  {rule.name}{allow}\n    {rule.description}\n")
+            wp = "  [whole-program]" if rule.whole_program else ""
+            sys.stdout.write(f"{rule.id}  {rule.name}{allow}{wp}\n    {rule.description}\n")
         return 0
 
     select = None
@@ -57,6 +95,20 @@ def main(argv=None) -> int:
         if unknown:
             sys.stderr.write(f"unknown rule id(s): {unknown} (known: {sorted(known)})\n")
             return 2
+
+    if args.update_contracts:
+        _bootstrap_devices()
+        from torchmetrics_tpu.analysis.sanitizer import run_contract_pass
+
+        try:
+            run_contract_pass(update=True)
+        except Exception as err:
+            sys.stderr.write(f"--update-contracts failed in analysis/contracts.py: {type(err).__name__}: {err}\n")
+            return 2
+        from torchmetrics_tpu.analysis.contracts import contract_dir
+
+        sys.stdout.write(f"golden contracts regenerated under {contract_dir()}\n")
+        return 0
 
     if args.paths:
         paths = [Path(p) for p in args.paths]
@@ -72,12 +124,29 @@ def main(argv=None) -> int:
     try:
         findings = lint_paths(paths, root=root, select=select)
     except SyntaxError as err:
-        sys.stderr.write(f"parse error: {err}\n")
+        sys.stderr.write(f"parse error in {err.filename}:{err.lineno}: {err.msg}\n")
         return 2
+
+    if args.audit_all:
+        _bootstrap_devices()
+        from torchmetrics_tpu.analysis.sanitizer import audit_all
+
+        try:
+            findings = list(findings) + audit_all(select=select)
+        except Exception as err:
+            tb = err.__traceback__
+            site = "<unknown>"
+            while tb is not None:
+                site = f"{tb.tb_frame.f_code.co_filename}:{tb.tb_lineno}"
+                tb = tb.tb_next
+            sys.stderr.write(f"--audit-all internal error at {site}: {type(err).__name__}: {err}\n")
+            return 2
 
     if args.format == "json":
         n_files = sum(len(list(p.rglob("*.py"))) if p.is_dir() else 1 for p in paths)
         sys.stdout.write(format_json(findings, n_files=n_files) + "\n")
+    elif args.format == "github":
+        sys.stdout.write(format_github(findings) + "\n")
     else:
         sys.stdout.write(format_text(findings) + "\n")
     return 1 if findings else 0

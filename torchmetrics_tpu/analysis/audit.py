@@ -371,14 +371,14 @@ def audit_metric(
             checks.append("update")
             violations.extend(_graph_violations("update", jx_update, allow_collectives=False))
     else:
-        skipped.append(("update", "example inputs are not jit-compatible (non-array leaves)"))
+        skipped.append(("update", f"{subject}: example inputs are not jit-compatible (non-array leaves)"))
 
     # -- compute jaxpr: best-effort (host-side computes are legal, but audited
     #    metrics meant for the fused path should trace cleanly)
     try:
         jx_compute = jax.make_jaxpr(audit_step_fn(metric, "compute"))(state)
     except Exception as err:
-        skipped.append(("compute", f"compute_state is host-side ({type(err).__name__}: {err})"))
+        skipped.append(("compute", f"{subject}: compute_state is host-side ({type(err).__name__}: {err})"))
     else:
         checks.append("compute")
         violations.extend(_graph_violations("compute", jx_compute, allow_collectives=False))
@@ -388,13 +388,13 @@ def audit_metric(
     planned_n: Optional[int] = None
     traced_g: Optional[int] = None
     if type(metric).sync_states is not Metric.sync_states:
-        skipped.append(("sync-collective-count", "metric overrides sync_states (not coalesced)"))
+        skipped.append(("sync-collective-count", f"{subject}: overrides sync_states (not coalesced)"))
     else:
         try:
             the_mesh = _default_mesh(mesh, axis)
             jx_sync = _trace_sync(lambda st: metric.sync_states(st, axis), state, the_mesh, axis)
         except Exception as err:
-            skipped.append(("sync-collective-count", f"sync not traceable ({type(err).__name__}: {err})"))
+            skipped.append(("sync-collective-count", f"{subject}: sync not traceable ({type(err).__name__}: {err})"))
         else:
             checks.append("sync-collective-count")
             traced_n = count_primitives(jx_sync, COLLECTIVE_PRIMITIVES)
@@ -411,7 +411,7 @@ def audit_metric(
                 )
             gather_budget = _gather_budget(metric._reductions)
             if gather_budget is None:
-                skipped.append(("ragged-gather", "state holds cat/None/callable leaves (gathers expected)"))
+                skipped.append(("ragged-gather", f"{subject}: state holds cat/None/callable leaves (gathers expected)"))
             else:
                 checks.append("ragged-gather")
                 if traced_g > gather_budget:
@@ -432,7 +432,7 @@ def audit_metric(
     compression_info: Optional[Dict[str, Any]] = None
     if compression is not None:
         if type(metric).sync_states is not Metric.sync_states:
-            skipped.append(("compressed-sync", "metric overrides sync_states (not coalesced)"))
+            skipped.append(("compressed-sync", f"{subject}: overrides sync_states (not coalesced)"))
         else:
             try:
                 the_mesh = _default_mesh(mesh, axis)
@@ -444,7 +444,7 @@ def audit_metric(
                 )
             except Exception as err:
                 skipped.append(
-                    ("compressed-sync", f"compressed sync not traceable ({type(err).__name__}: {err})")
+                    ("compressed-sync", f"{subject}: compressed sync not traceable ({type(err).__name__}: {err})")
                 )
             else:
                 checks.append("compressed-sync")
@@ -561,7 +561,7 @@ def audit_collection(
             jx_sync = _trace_sync(sync_fn, tuple(std_states), the_mesh, axis_name)
         except Exception as err:
             skipped.append(
-                ("sync-collective-count", f"fused sync not traceable ({type(err).__name__}: {err})")
+                ("sync-collective-count", f"{subject}: fused sync not traceable ({type(err).__name__}: {err})")
             )
         else:
             checks.append("sync-collective-count")
@@ -579,7 +579,7 @@ def audit_collection(
                 )
             budgets = [_gather_budget(m._reductions) for m in std_metrics]
             if any(b is None for b in budgets):
-                skipped.append(("ragged-gather", "a member holds cat/None/callable leaves (gathers expected)"))
+                skipped.append(("ragged-gather", f"{subject}: a member holds cat/None/callable leaves (gathers expected)"))
             else:
                 checks.append("ragged-gather")
                 budget = sum(budgets)

@@ -1,0 +1,288 @@
+"""Golden trace-contract snapshots — the TMT013 whole-program pass.
+
+A *trace contract* is the observable shape of the graph the compile layer
+builds for one (metric, entrypoint, mesh): the primitive multiset, the
+ordered collective sequence (:mod:`~torchmetrics_tpu.analysis.uniformity`
+descriptors, ``psum[4:float32]`` style), and the donation mask
+(:mod:`~torchmetrics_tpu.analysis.donation`).  Snapshots for a
+representative metric slate live as JSON under
+``tests/unittests/analysis/contracts/`` and gate CI: an innocent-looking
+refactor that changes what actually lowers — an extra ``all_gather``, a
+dropped donation, a ``convert_element_type`` creeping into the update path —
+fails with a primitive-level diff instead of shipping a silent perf or
+memory regression.
+
+Regenerate after an *intentional* graph change with::
+
+    python -m torchmetrics_tpu.analysis --update-contracts
+
+and review the JSON diff like any other golden file.
+
+The contract deliberately snapshots *counts and sequences*, not the full
+jaxpr pretty-print: jaxpr variable naming is unstable across JAX versions,
+while the primitive multiset and collective order are exactly the
+properties the uniformity/donation passes prove things about.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu.analysis.linter import package_root
+
+__all__ = [
+    "CONTRACT_SCHEMA_VERSION",
+    "check_contracts",
+    "contract_dir",
+    "diff_contracts",
+    "golden_metrics",
+    "trace_contract",
+    "write_contracts",
+]
+
+CONTRACT_SCHEMA_VERSION = 1
+
+
+def contract_dir() -> Path:
+    """Default golden-snapshot directory (inside the repo's test tree)."""
+    return package_root().parent / "tests" / "unittests" / "analysis" / "contracts"
+
+
+# ------------------------------------------------------------ golden slate
+def _rng() -> Any:
+    import numpy as np
+
+    return np.random.default_rng(0)
+
+
+def _binary_inputs() -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    r = _rng()
+    return (
+        jnp.asarray(r.random(32, dtype="float32")),
+        jnp.asarray(r.integers(0, 2, 32).astype("int32")),
+    )
+
+
+def _multiclass_inputs(c: int = 5) -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    r = _rng()
+    return (
+        jnp.asarray(r.random((32, c), dtype="float32")),
+        jnp.asarray(r.integers(0, c, 32).astype("int32")),
+    )
+
+
+def _regression_inputs() -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    r = _rng()
+    return (
+        jnp.asarray(r.random(32, dtype="float32")),
+        jnp.asarray(r.random(32, dtype="float32")),
+    )
+
+
+def _image_inputs() -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    r = _rng()
+    return (
+        jnp.asarray(r.random((2, 3, 8, 8), dtype="float32")),
+        jnp.asarray(r.random((2, 3, 8, 8), dtype="float32")),
+    )
+
+
+def _value_inputs() -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    return (jnp.asarray(_rng().random(16, dtype="float32")),)
+
+
+def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
+    """name -> factory returning (metric, example update inputs) for every
+    metric in the golden slate.  Deterministic: seeded inputs, fixed configs.
+    """
+
+    def make(ctor: Callable[[], Any], inputs: Callable[[], Tuple[Any, ...]]):
+        return lambda: (ctor(), inputs())
+
+    from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+    from torchmetrics_tpu.classification import (
+        BinaryAccuracy,
+        BinaryAUROC,
+        BinaryCalibrationError,
+        BinaryConfusionMatrix,
+        BinaryF1Score,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassJaccardIndex,
+    )
+    from torchmetrics_tpu.image import PeakSignalNoiseRatio
+    from torchmetrics_tpu.regression import (
+        MeanSquaredError,
+        PearsonCorrCoef,
+        R2Score,
+    )
+
+    return {
+        "BinaryAccuracy": make(BinaryAccuracy, _binary_inputs),
+        "BinaryAUROC": make(lambda: BinaryAUROC(thresholds=16), _binary_inputs),
+        "BinaryCalibrationError": make(lambda: BinaryCalibrationError(n_bins=10), _binary_inputs),
+        "BinaryConfusionMatrix": make(BinaryConfusionMatrix, _binary_inputs),
+        "BinaryF1Score": make(BinaryF1Score, _binary_inputs),
+        "MulticlassAccuracy": make(lambda: MulticlassAccuracy(num_classes=5), _multiclass_inputs),
+        "MulticlassConfusionMatrix": make(
+            lambda: MulticlassConfusionMatrix(num_classes=5), _multiclass_inputs
+        ),
+        "MulticlassJaccardIndex": make(
+            lambda: MulticlassJaccardIndex(num_classes=5), _multiclass_inputs
+        ),
+        "MeanMetric": make(MeanMetric, _value_inputs),
+        "SumMetric": make(SumMetric, _value_inputs),
+        "MeanSquaredError": make(MeanSquaredError, _regression_inputs),
+        "PearsonCorrCoef": make(PearsonCorrCoef, _regression_inputs),
+        "R2Score": make(R2Score, _regression_inputs),
+        "PeakSignalNoiseRatio": make(
+            lambda: PeakSignalNoiseRatio(data_range=(0.0, 1.0)), _image_inputs
+        ),
+    }
+
+
+# ------------------------------------------------------------------ tracing
+def _primitive_multiset(jaxpr: Any) -> Dict[str, int]:
+    from torchmetrics_tpu.analysis.audit import iter_eqns
+
+    return dict(sorted(Counter(eqn.primitive.name for eqn in iter_eqns(jaxpr)).items()))
+
+
+def _mesh_descriptor(mesh: Any, axis_name: str) -> str:
+    dev = mesh.devices.flat[0]
+    return f"{dev.platform}:{int(mesh.devices.size)}/{axis_name}"
+
+
+def trace_contract(
+    metric: Any,
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+) -> Dict[str, Any]:
+    """The (update, sync) trace contract of one metric on one mesh."""
+    from torchmetrics_tpu.analysis.audit import _default_mesh, _trace_sync
+    from torchmetrics_tpu.analysis.donation import donation_mask
+    from torchmetrics_tpu.analysis.uniformity import collective_sequence
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    the_mesh = _default_mesh(mesh, axis_name)
+    state = metric.update_state(metric.init_state(), *inputs)
+
+    jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
+    jx_sync = _trace_sync(lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name)
+
+    mask = donation_mask(metric, "update", *inputs)
+    return {
+        "schema": CONTRACT_SCHEMA_VERSION,
+        "metric": type(metric).__name__,
+        "mesh": _mesh_descriptor(the_mesh, axis_name),
+        "entrypoints": {
+            "update": {
+                "primitives": _primitive_multiset(jx_update),
+                "collectives": [op.describe() for op in collective_sequence(jx_update)],
+                "donation": {
+                    "donates": mask["donates"],
+                    "leaves": list(mask["leaves"]),
+                    "consumed": list(mask.get("consumed", ())),
+                },
+            },
+            "sync": {
+                "primitives": _primitive_multiset(jx_sync),
+                "collectives": [op.describe() for op in collective_sequence(jx_sync)],
+            },
+        },
+    }
+
+
+# -------------------------------------------------------------- diff / gate
+def diff_contracts(golden: Dict[str, Any], current: Dict[str, Any]) -> List[str]:
+    """Primitive-level differences, golden vs freshly traced.  Empty = pass."""
+    name = golden.get("metric", "?")
+    diffs: List[str] = []
+    if golden.get("mesh") != current.get("mesh"):
+        diffs.append(f"{name}: mesh changed {golden.get('mesh')!r} -> {current.get('mesh')!r}")
+    for entry in ("update", "sync"):
+        g = golden.get("entrypoints", {}).get(entry, {})
+        c = current.get("entrypoints", {}).get(entry, {})
+        gp, cp = g.get("primitives", {}), c.get("primitives", {})
+        for prim in sorted(set(gp) | set(cp)):
+            if gp.get(prim, 0) != cp.get(prim, 0):
+                diffs.append(
+                    f"{name} {entry}: primitive '{prim}' count {gp.get(prim, 0)} -> "
+                    f"{cp.get(prim, 0)}"
+                )
+        gc, cc = tuple(g.get("collectives", ())), tuple(c.get("collectives", ()))
+        if gc != cc:
+            diffs.append(
+                f"{name} {entry}: collective sequence changed {list(gc)} -> {list(cc)}"
+            )
+        gd, cd = g.get("donation"), c.get("donation")
+        if gd != cd and (gd or cd):
+            diffs.append(f"{name} {entry}: donation mask changed {gd} -> {cd}")
+    return diffs
+
+
+def write_contracts(
+    directory: Optional[Path] = None,
+    *,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    names: Optional[List[str]] = None,
+) -> List[Path]:
+    """(Re)generate the golden snapshots.  Returns the files written."""
+    directory = Path(directory) if directory is not None else contract_dir()
+    directory.mkdir(parents=True, exist_ok=True)
+    written: List[Path] = []
+    slate = golden_metrics()
+    for name in sorted(names or slate):
+        metric, inputs = slate[name]()
+        contract = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+        path = directory / f"{name}.json"
+        path.write_text(json.dumps(contract, indent=2, sort_keys=True) + "\n")
+        written.append(path)
+    return written
+
+
+def check_contracts(
+    directory: Optional[Path] = None,
+    *,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+) -> List[str]:
+    """Trace the golden slate and diff against the snapshots on disk.
+
+    Returns human-readable differences; an empty list is a pass.  Missing
+    snapshot files are reported (run ``--update-contracts``), and snapshot
+    files with no matching slate entry are flagged as stale.
+    """
+    directory = Path(directory) if directory is not None else contract_dir()
+    slate = golden_metrics()
+    diffs: List[str] = []
+    on_disk = {p.stem: p for p in sorted(directory.glob("*.json"))} if directory.is_dir() else {}
+    for name in sorted(slate):
+        path = on_disk.pop(name, None)
+        if path is None:
+            diffs.append(f"{name}: no golden snapshot — run --update-contracts")
+            continue
+        golden = json.loads(path.read_text())
+        metric, inputs = slate[name]()
+        current = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+        diffs.extend(diff_contracts(golden, current))
+    for name in sorted(on_disk):
+        diffs.append(f"{name}: stale snapshot (metric no longer in the golden slate)")
+    return diffs

@@ -1,0 +1,373 @@
+"""Donation sanitizer — the TMT010 whole-program pass.
+
+The jit update/forward paths donate the previous state pytree to XLA
+(``donate_argnums=(0,)``), so the buffers are dead the moment the call
+dispatches.  Two ways a read can still reach one:
+
+* **Aliased compute groups** — ``MetricCollection`` points every member of a
+  compute group at the *same* state buffers.  If any member then donates on
+  its own ``update``/``forward`` (i.e. the ``_state_shared`` opt-out that
+  PR 1 added is missing), the other members keep reading a donated buffer.
+  :func:`audit_donation` rebuilds the alias graph from live leaf identity
+  and cross-references each holder's donating entrypoints.
+* **Host-side use-after-donate** — package code that passes a state
+  expression to a donating compiled entrypoint and reads the *same
+  expression* again before rebinding it.  :func:`scan_use_after_donate`
+  walks every function's statements in source order tracking donated
+  expressions to their next store.
+
+:func:`donation_mask` is the jaxpr-level half: for one metric entrypoint it
+reports the donate flag, the donated leaf names, and — when example inputs
+are given — which donated leaves the traced graph actually consumes
+(``make_jaxpr`` over the exact step body the compile cache builds).  The
+golden trace contracts (:mod:`analysis.contracts`) snapshot this mask so a
+donation-semantics change can never land silently.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+
+from torchmetrics_tpu.analysis.linter import package_root
+
+__all__ = [
+    "DonationIssue",
+    "DonationReport",
+    "audit_donation",
+    "donation_mask",
+    "scan_use_after_donate",
+]
+
+#: compile-layer builders whose returned callable donates its first argument
+DONATING_BUILDERS = frozenset(
+    {"compiled_update", "compiled_forward", "compiled_collection_update", "compiled_cadence_step"}
+)
+
+
+@dataclass(frozen=True)
+class DonationIssue:
+    """One use-after-donate hazard."""
+
+    kind: str  # "aliased-donation" | "self-alias" | "use-after-donate"
+    message: str
+    #: source anchor (package-relative path, line) when one exists
+    path: Optional[str] = None
+    line: Optional[int] = None
+
+
+@dataclass
+class DonationReport:
+    subject: str
+    issues: List[DonationIssue] = field(default_factory=list)
+    #: leaf-identity alias groups inspected: (holder, leaf_name) tuples
+    alias_groups: List[Tuple[Tuple[str, str], ...]] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.issues
+
+
+# ------------------------------------------------------------ jaxpr-level mask
+def donation_mask(
+    metric: Any, entrypoint: str = "update", *inputs: Any
+) -> Dict[str, Any]:
+    """Donation contract of one compiled entrypoint, as data.
+
+    ``donates`` mirrors the live decision the update/forward paths make
+    (``donate = jit path enabled and not _state_shared``); ``leaves`` are the
+    state leaf names the donation covers (``donate_argnums=(0,)`` donates the
+    whole pytree).  With example ``inputs``, ``consumed`` additionally lists
+    the donated leaves the traced graph reads — the evidence that an aliased
+    reader would observe freed memory, not just a stale value.
+    """
+    # the decision the jit path makes (metric.update: donate = not
+    # _state_shared), independent of whether jit is currently enabled on this
+    # instance — the contract describes the compiled path
+    donates = bool(
+        entrypoint in ("update", "forward")
+        and not metric._has_list_states
+        and not metric._state_shared
+    )
+    leaves = tuple(sorted(metric._state))
+    mask: Dict[str, Any] = {"entrypoint": entrypoint, "donates": donates, "leaves": leaves}
+    if inputs and entrypoint in ("update", "forward"):
+        from torchmetrics_tpu.core.compile import audit_step_fn, is_jit_compatible
+
+        if is_jit_compatible((inputs, {})):
+            state = metric.init_state()
+            jaxpr = jax.make_jaxpr(audit_step_fn(metric, "update"))(state, *inputs)
+            flat, _ = jax.tree_util.tree_flatten(state)
+            n_state = len(flat)
+            # state leaves flatten in sorted-key order (dict pytree)
+            names = sorted(state)
+            state_invars = list(jaxpr.jaxpr.invars[:n_state])
+            used = _used_vars(jaxpr.jaxpr)
+            mask["consumed"] = tuple(
+                name for name, var in zip(names, state_invars) if var in used
+            )
+    return mask
+
+
+def _used_vars(jaxpr: Any) -> set:
+    """Every var read by an eqn (recursively) or returned, in ``jaxpr``."""
+    from torchmetrics_tpu.analysis.audit import iter_eqns
+
+    used = set()
+    for eqn in iter_eqns(jaxpr):
+        for var in eqn.invars:
+            if not isinstance(var, jax.core.Literal):
+                used.add(var)
+    for var in jaxpr.outvars:
+        if not isinstance(var, jax.core.Literal):
+            used.add(var)
+    return used
+
+
+# --------------------------------------------------------- live alias auditing
+def _holders(obj: Any) -> List[Tuple[str, Any]]:
+    from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.core.metric import Metric
+
+    if isinstance(obj, MetricCollection):
+        return [(name, m) for name, m in dict.items(obj)]
+    if isinstance(obj, Metric):
+        return [(type(obj).__name__, obj)]
+    return [(f"{type(m).__name__}[{i}]", m) for i, m in enumerate(obj)]
+
+
+def _metric_donates(metric: Any) -> bool:
+    # the guard itself, not today's jit switch: `donate = not _state_shared`
+    # is what the compiled update/forward paths will do the moment jit is on,
+    # and the sanitizer's job is the static contract
+    return bool(not metric._has_list_states and not metric._state_shared)
+
+
+def audit_donation(obj: Any) -> DonationReport:
+    """Audit a live Metric / MetricCollection / sequence of metrics for
+    aliased-donation races.
+
+    Builds the alias graph from state-leaf *identity* (two holders pointing
+    at the same array object — exactly what compute-group aliasing creates)
+    and flags every shared buffer reachable from a donating entrypoint.  A
+    healthy compute group has every member ``_state_shared=True`` (donation
+    off); the report is clean.  Strip the flag — the pre-PR 1 world — and
+    every shared leaf becomes a finding.
+    """
+    holders = _holders(obj)
+    subject = (
+        type(obj).__name__
+        if not isinstance(obj, (list, tuple))
+        else "+".join(type(m).__name__ for m in obj)
+    )
+    report = DonationReport(subject)
+
+    by_buffer: Dict[int, List[Tuple[str, str, Any]]] = {}
+    for name, metric in holders:
+        for leaf_name, leaf in metric._state.items():
+            items = leaf if isinstance(leaf, tuple) else (leaf,)
+            for item in items:
+                if isinstance(item, jax.Array):
+                    by_buffer.setdefault(id(item), []).append((name, leaf_name, metric))
+
+    seen_groups = set()
+    for refs in by_buffer.values():
+        if len(refs) < 2:
+            continue
+        group_key = tuple(sorted((n, ln) for n, ln, _ in refs))
+        if group_key in seen_groups:
+            continue
+        seen_groups.add(group_key)
+        report.alias_groups.append(group_key)
+        donors = sorted({n for n, _, m in refs if _metric_donates(m)})
+        readers = sorted({n for n, _, _ in refs})
+        distinct_metrics = {id(m) for _, _, m in refs}
+        if len(distinct_metrics) >= 2 and donors:
+            where = ", ".join(f"{n}._state[{ln!r}]" for n, ln in group_key)
+            report.issues.append(
+                DonationIssue(
+                    "aliased-donation",
+                    f"state buffer shared by {where} while {donors} donate(s) it on "
+                    f"update/forward (donate = not _state_shared) — the first donating "
+                    f"update frees the buffer under {readers}; mark the compute group "
+                    "shared (MetricCollection._mark_shared) so donation is skipped",
+                )
+            )
+        elif len(distinct_metrics) == 1 and donors and len({ln for _, ln, _ in refs}) > 1:
+            who, metric = refs[0][0], refs[0][2]
+            names = sorted({ln for _, ln, _ in refs})
+            report.issues.append(
+                DonationIssue(
+                    "self-alias",
+                    f"{who} holds ONE buffer under state leaves {names} while donating — "
+                    "XLA frees it once per alias; give each leaf its own buffer",
+                )
+            )
+    return report
+
+
+# ------------------------------------------------- AST use-after-donate scan
+def _dotted_expr(node: ast.expr) -> Optional[str]:
+    """Stable string for Name / self.attr / a.b.c chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_donating_builder(call: ast.Call) -> bool:
+    fn = call.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else getattr(fn, "id", None)
+    if name not in DONATING_BUILDERS:
+        return False
+    for kw in call.keywords:
+        if kw.arg == "donate" and isinstance(kw.value, ast.Constant) and kw.value.value is False:
+            return False
+    return True
+
+
+_COMPOUND = (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With, ast.AsyncWith, ast.Try)
+
+
+def _units(body: Sequence[ast.stmt]) -> Iterator[List[ast.AST]]:
+    """Flatten a statement body into sequential *units* in source order.
+
+    A simple statement is one unit; a compound statement contributes its
+    header expressions (test / iter / context items) as one unit, then its
+    sub-bodies recursively.  Nested function/class defs are separate scopes
+    and are skipped.  Branch exclusivity is ignored (a donate in an ``if``
+    body followed by a read in its ``else`` over-reports) — linter
+    semantics, suppressible.
+    """
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        if isinstance(stmt, _COMPOUND):
+            header: List[ast.AST] = []
+            for attr in ("test", "iter", "target"):
+                val = getattr(stmt, attr, None)
+                if val is not None:
+                    header.append(val)
+            for item in getattr(stmt, "items", ()) or ():
+                header.append(item.context_expr)
+            if header:
+                yield header
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub:
+                    yield from _units(sub)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                yield from _units(handler.body)
+        else:
+            yield [stmt]
+
+
+def _scan_function(fn: ast.AST, rel_path: str) -> Iterator[DonationIssue]:
+    """Linear source-order walk of one function scope.
+
+    Tracks (a) local names bound to donating builders, (b) donating calls
+    whose donated first argument is a trackable Name/attr chain, and flags a
+    Load of the donated expression after the call and before its next Store.
+    Same-statement rebinds (``x = fn(x, ...)``) are the sanctioned idiom.
+    """
+    donating_names: set = set()
+    # donated expr -> line of the donating call (live until next store)
+    live_donated: Dict[str, int] = {}
+
+    for unit in _units(fn.body):
+        store_targets: set = set()
+        donate_calls: List[Tuple[str, int]] = []
+        rebind_ok: set = set()
+
+        for item in unit:
+            if isinstance(item, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = item.targets if isinstance(item, ast.Assign) else [item.target]
+                flat_targets: List[ast.expr] = []
+                for tgt in targets:
+                    if isinstance(tgt, (ast.Tuple, ast.List)):
+                        flat_targets.extend(tgt.elts)
+                    else:
+                        flat_targets.append(tgt)
+                for tgt in flat_targets:
+                    dotted = _dotted_expr(tgt)
+                    if dotted is not None:
+                        store_targets.add(dotted)
+                if isinstance(item, ast.Assign) and isinstance(item.value, ast.Call):
+                    if _is_donating_builder(item.value):
+                        for tgt in item.targets:
+                            if isinstance(tgt, ast.Name):
+                                donating_names.add(tgt.id)
+
+        for item in unit:
+            for node in ast.walk(item):
+                if isinstance(node, ast.Call):
+                    is_donating_call = (
+                        isinstance(node.func, ast.Name) and node.func.id in donating_names
+                    ) or (isinstance(node.func, ast.Call) and _is_donating_builder(node.func))
+                    if is_donating_call and node.args:
+                        donated = _dotted_expr(node.args[0])
+                        if donated is not None:
+                            donate_calls.append((donated, node.lineno))
+                            if donated in store_targets:
+                                rebind_ok.add(donated)
+
+        # reads of live donated exprs (excluding this unit's own donating
+        # call argument, which IS the donation site)
+        donated_this_unit = {d for d, _ in donate_calls}
+        for item in unit:
+            for node in ast.walk(item):
+                if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load
+                ):
+                    dotted = _dotted_expr(node)
+                    if dotted in live_donated and dotted not in donated_this_unit:
+                        yield DonationIssue(
+                            "use-after-donate",
+                            f"{dotted!r} was donated to a compiled entrypoint on line "
+                            f"{live_donated[dotted]} and is read again here before being "
+                            "rebound — the buffer is already freed; rebind it from the "
+                            "call's return value first",
+                            path=rel_path,
+                            line=node.lineno,
+                        )
+                        del live_donated[dotted]
+
+        for dotted in store_targets:
+            live_donated.pop(dotted, None)
+        for donated, lineno in donate_calls:
+            if donated not in rebind_ok:
+                live_donated[donated] = lineno
+
+
+def scan_use_after_donate(
+    paths: Optional[Sequence[Path]] = None, root: Optional[Path] = None
+) -> List[DonationIssue]:
+    """AST use-after-donate scan over the package's host-side call sites."""
+    if root is None:
+        root = package_root()
+    if paths is None:
+        files = sorted(root.rglob("*.py"))
+    else:
+        files = []
+        for p in paths:
+            p = Path(p)
+            files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    issues: List[DonationIssue] = []
+    for path in files:
+        try:
+            rel = path.resolve().relative_to(root.resolve()).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                issues.extend(_scan_function(node, rel))
+    return issues

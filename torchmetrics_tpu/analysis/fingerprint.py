@@ -1,0 +1,629 @@
+"""Fingerprint-completeness checker — the TMT011 whole-program pass.
+
+The compile cache keys every traced entrypoint on ``config_fingerprint`` —
+the metric's *public* instance attributes minus the declared excludes
+(``_BASE_FINGERPRINT_EXCLUDE`` + ``__fingerprint_exclude__``); private
+(``_``-prefixed) attributes never participate.  Any attribute that
+influences traced code while invisible to the fingerprint is the PR 1
+stale-trace bug class: two differently-configured instances share one cache
+key, and the second silently reuses the first's compiled graph.
+
+The pass is an AST attribute-dataflow over each ``Metric`` subclass:
+
+1. **Traced-read set** — every ``self.<attr>`` read reachable from the
+   functional-core entrypoints (``_update``/``_compute``/``update_state``/
+   ``compute_state``/``merge_states``/``sync_states``), chasing
+   ``self._helper(...)`` calls and property getters to a fixed point.
+2. **Classification** — methods and class-level constants are structural
+   (the fingerprint carries ``(module, qualname)``); public attrs are
+   fingerprinted unless excluded; *excluded-but-read* is a finding.
+3. **Derivation analysis** — a private attr read in traced code is safe
+   only if every assignment to it lives in ``__init__``/``reset`` and its
+   value is a deterministic function of fingerprinted inputs: constants,
+   ctor params *mirrored* to a public attr, public attr reads, and other
+   safe privates (fixed point).  A private fed by an unmirrored ctor param
+   — two instances that differ only in that param collide on one cache key
+   — is a finding, as is a private mutated outside the lifecycle.
+
+Base-``Metric`` machinery privates (``_state``, ``_reductions``, …) are
+exempt: they are keyed by other cache-key components (abstract signature,
+donate flag) or owned by the framework, and the set is derived from the
+base source itself rather than hand-listed.
+
+:func:`fingerprint_insensitive` is the dynamic cross-check used by the
+tests: perturb the flagged attribute on a deep copy and confirm
+``config_fingerprint`` does not move (i.e. ``explain_retrace`` would
+attribute *no* retrace to the mutation — the finding is real).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from dataclasses import dataclass
+from functools import lru_cache
+from pathlib import Path
+from typing import Any, Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu.analysis.linter import TRACED_ENTRYPOINTS, package_root
+
+__all__ = [
+    "FingerprintIssue",
+    "check_class_fingerprint",
+    "check_fingerprint",
+    "fingerprint_insensitive",
+    "iter_package_metric_classes",
+    "scan_package_fingerprints",
+]
+
+
+@dataclass(frozen=True)
+class FingerprintIssue:
+    """One unfingerprinted trace-influencing attribute."""
+
+    cls: str
+    attr: str
+    kind: str  # "excluded-read" | "unfingerprinted-private" | "mutated-in-trace"
+    message: str
+    path: Optional[str] = None  # package-relative read site
+    line: Optional[int] = None
+
+
+# ------------------------------------------------------------- source access
+@lru_cache(maxsize=None)
+def _fn_tree(func: Any) -> Optional[Tuple[ast.AST, str, int]]:
+    """(parsed FunctionDef, rel source path, first line) of a function object."""
+    try:
+        src = textwrap.dedent(inspect.getsource(func))
+        path = inspect.getsourcefile(func)
+        _, firstline = inspect.getsourcelines(func)
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    node = tree.body[0]
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    try:
+        rel = Path(path).resolve().relative_to(package_root().resolve()).as_posix()
+    except (ValueError, TypeError):
+        rel = str(path)
+    return node, rel, firstline
+
+
+def _raw_function(obj: Any) -> Optional[Any]:
+    """Unwrap classmethod/staticmethod/property to the underlying function."""
+    if isinstance(obj, property):
+        return obj.fget
+    if isinstance(obj, (classmethod, staticmethod)):
+        return obj.__func__
+    if inspect.isfunction(obj):
+        return obj
+    return None
+
+
+def _mro_classes(cls: type) -> List[type]:
+    """Subclass-owned MRO: everything except the base ``Metric`` machinery
+    and stdlib scaffolding — user-defined metrics outside the package are
+    checked exactly like package metrics."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    return [
+        c
+        for c in cls.__mro__
+        if c is not Metric
+        and c is not object
+        and c.__module__ not in ("builtins", "abc", "typing")
+    ]
+
+
+def _lookup_method(cls: type, name: str) -> Optional[Any]:
+    """The raw function implementing ``name``, skipping the base Metric's
+    definition only when a package subclass overrides it."""
+    for c in cls.__mro__:
+        if name in c.__dict__:
+            return _raw_function(c.__dict__[name])
+    return None
+
+
+@lru_cache(maxsize=1)
+def _base_machinery_attrs() -> FrozenSet[str]:
+    """Private attrs the base ``Metric`` assigns — framework machinery, keyed
+    by other cache-key components (abstract signature, donate flag, backend),
+    never metric config.  Derived from the base source so the exemption can
+    not drift from the implementation."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    attrs: Set[str] = set()
+    for name, obj in vars(Metric).items():
+        fn = _raw_function(obj)
+        if fn is None:
+            continue
+        parsed = _fn_tree(fn)
+        if parsed is None:
+            continue
+        node, _, _ = parsed
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.ctx, ast.Store)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+            ):
+                attrs.add(sub.attr)
+    return frozenset(a for a in attrs if a.startswith("_"))
+
+
+# ------------------------------------------------------- traced-read analysis
+def _self_reads_and_calls(fn_node: ast.AST) -> Tuple[List[ast.Attribute], Set[str]]:
+    """(self.<attr> Load nodes, names of self-methods called) in one body."""
+    reads: List[ast.Attribute] = []
+    calls: Set[str] = set()
+    for node in ast.walk(fn_node):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            if isinstance(node.ctx, ast.Load):
+                reads.append(node)
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self"
+            ):
+                calls.add(f.attr)
+    return reads, calls
+
+
+def _traced_reads(cls: type) -> Dict[str, Tuple[str, Optional[str], Optional[int]]]:
+    """attr -> (via, rel_path, line) for every self-attribute read reachable
+    from the traced entrypoints, chasing self-method calls to a fixed point.
+
+    Only methods *defined in package subclasses* are walked (the base Metric
+    machinery is exempt); the first read site found anchors the finding.
+    """
+    seen_methods: Set[str] = set()
+    pending = [name for name in TRACED_ENTRYPOINTS if _is_subclass_method(cls, name)]
+    reads: Dict[str, Tuple[str, Optional[str], Optional[int]]] = {}
+
+    while pending:
+        name = pending.pop()
+        if name in seen_methods:
+            continue
+        seen_methods.add(name)
+        fn = _lookup_method(cls, name)
+        if fn is None or not _defined_in_package_subclass(cls, name):
+            continue
+        parsed = _fn_tree(fn)
+        if parsed is None:
+            continue
+        node, rel, firstline = parsed
+        body_reads, body_calls = _self_reads_and_calls(node)
+        for attr_node in body_reads:
+            attr = attr_node.attr
+            if attr in reads:
+                continue
+            reads[attr] = (name, rel, firstline + attr_node.lineno - 1)
+        for called in body_calls:
+            if called not in seen_methods:
+                pending.append(called)
+        # property getters read attrs too
+        for attr_node in body_reads:
+            resolved = _class_attr(cls, attr_node.attr)
+            if isinstance(resolved, property) and attr_node.attr not in seen_methods:
+                pending.append(attr_node.attr)
+    return reads
+
+
+def _class_attr(cls: type, name: str) -> Any:
+    for c in cls.__mro__:
+        if name in c.__dict__:
+            return c.__dict__[name]
+    return None
+
+
+def _is_subclass_method(cls: type, name: str) -> bool:
+    return any(name in c.__dict__ for c in _mro_classes(cls))
+
+
+def _defined_in_package_subclass(cls: type, name: str) -> bool:
+    """True when the MRO resolves ``name`` to a subclass definition
+    (i.e. the implementation that runs is not the base Metric's)."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    for c in cls.__mro__:
+        if name in c.__dict__:
+            return c is not Metric and c.__module__ not in ("builtins", "abc", "typing")
+    return False
+
+
+# ---------------------------------------------------------- derivation model
+class _InitModel:
+    """Dataflow summary of every ``__init__``/``reset`` in the MRO.
+
+    ``assignments`` maps each private attr to the list of value expressions
+    assigned to it; ``mirrored_params`` are ctor params stored verbatim (or
+    through one call) into a public, non-excluded attr; ``mutated_elsewhere``
+    lists privates assigned outside the lifecycle methods.
+    """
+
+    LIFECYCLE_ROOTS = ("__init__", "reset", "add_state", "__post_init__")
+
+    def __init__(self, cls: type, excluded: FrozenSet[str]) -> None:
+        self.cls = cls
+        self.excluded = excluded
+        self.assignments: Dict[str, List[ast.expr]] = {}
+        self.mirrored_params: Set[str] = set()
+        self.safe_locals_by_fn: Dict[int, Set[str]] = {}
+        self.mutated_elsewhere: Set[str] = set()
+        self.lifecycle = self._lifecycle_closure()
+        self._collect()
+
+    def _parsed_methods(self) -> Iterator[Tuple[str, ast.AST]]:
+        for c in _mro_classes(self.cls):
+            for name, obj in vars(c).items():
+                fn = _raw_function(obj)
+                if fn is None:
+                    continue
+                parsed = _fn_tree(fn)
+                if parsed is not None:
+                    yield name, parsed[0]
+
+    def _lifecycle_closure(self) -> FrozenSet[str]:
+        """Construction-time methods: the roots plus every self-method they
+        transitively call — ``__init__`` helpers like ``_init_curve_state``
+        assign config-derived privates just as legitimately as ``__init__``
+        itself does."""
+        calls: Dict[str, Set[str]] = {}
+        for name, node in self._parsed_methods():
+            calls.setdefault(name, set()).update(_self_reads_and_calls(node)[1])
+        lifecycle = set(self.LIFECYCLE_ROOTS)
+        pending = [n for n in lifecycle if n in calls]
+        while pending:
+            for called in calls.get(pending.pop(), ()):  # pragma: no branch
+                if called not in lifecycle and called not in TRACED_ENTRYPOINTS:
+                    lifecycle.add(called)
+                    pending.append(called)
+        return frozenset(lifecycle)
+
+    def _collect(self) -> None:
+        for c in _mro_classes(self.cls):
+            for name, obj in vars(c).items():
+                fn = _raw_function(obj)
+                if fn is None:
+                    continue
+                parsed = _fn_tree(fn)
+                if parsed is None:
+                    continue
+                node, _, _ = parsed
+                in_lifecycle = name in self.lifecycle
+                params = {
+                    a.arg
+                    for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+                }
+                for sub in ast.walk(node):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                        continue
+                    targets = sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                    value = sub.value
+                    # flatten tuple/list unpacking: each element conservatively
+                    # derives from the whole right-hand side
+                    flat: List[ast.expr] = []
+                    for tgt in targets:
+                        if isinstance(tgt, (ast.Tuple, ast.List)):
+                            flat.extend(tgt.elts)
+                        else:
+                            flat.append(tgt)
+                    for tgt in flat:
+                        if not (
+                            isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"
+                        ):
+                            continue
+                        attr = tgt.attr
+                        if not attr.startswith("_"):
+                            # mirror detection: self.pub = param / self.pub = f(param)
+                            if in_lifecycle and value is not None and attr not in self.excluded:
+                                p = _param_of(value, params)
+                                if p is not None:
+                                    self.mirrored_params.add(f"{name}:{p}")
+                            continue
+                        if not in_lifecycle:
+                            self.mutated_elsewhere.add(attr)
+                        elif value is not None:
+                            self.assignments.setdefault(attr, []).append(value)
+                            # remember which fn the expr came from, for params
+                            self.safe_locals_by_fn[id(value)] = params | {
+                                f"{name}:{p}" for p in params
+                            }
+
+    def param_mirrored(self, fn_name: str, param: str) -> bool:
+        return f"{fn_name}:{param}" in self.mirrored_params
+
+
+def _param_of(value: ast.expr, params: Set[str]) -> Optional[str]:
+    """The ctor param mirrored by ``value``: a bare Name, or one call layer
+    over it (``float(p)``, ``tuple(p)`` — deterministic wrappers)."""
+    if isinstance(value, ast.Name) and value.id in params:
+        return value.id
+    if (
+        isinstance(value, ast.Call)
+        and len(value.args) == 1
+        and not value.keywords
+        and isinstance(value.args[0], ast.Name)
+        and value.args[0].id in params
+    ):
+        return value.args[0].id
+    return None
+
+
+class _DerivationChecker:
+    """Decides whether each private attr's __init__ value is a deterministic
+    function of fingerprinted inputs (fixed point over safe privates)."""
+
+    def __init__(self, cls: type, excluded: FrozenSet[str]) -> None:
+        self.cls = cls
+        self.excluded = excluded
+        self.model = _InitModel(cls, excluded)
+        self.base_attrs = _base_machinery_attrs()
+        self.safe_privates: Set[str] = set()
+        self._solve()
+
+    def _solve(self) -> None:
+        candidates = set(self.model.assignments)
+        changed = True
+        while changed:
+            changed = False
+            for attr in sorted(candidates - self.safe_privates):
+                if attr in self.model.mutated_elsewhere:
+                    continue
+                if all(self._safe(v) for v in self.model.assignments[attr]):
+                    self.safe_privates.add(attr)
+                    changed = True
+
+    def classify(self, attr: str) -> str:
+        """'safe' | 'mutated' | 'unsafe' for a private attr read in trace."""
+        if attr in self.base_attrs:
+            return "safe"
+        if attr in self.model.mutated_elsewhere:
+            return "mutated"
+        if attr in self.safe_privates:
+            return "safe"
+        if attr not in self.model.assignments and _class_attr(self.cls, attr) is not None:
+            # class-level constant (``_stat_kind = "accuracy"`` style): the
+            # fingerprint carries (module, qualname), so class identity keys it
+            return "safe"
+        return "unsafe"
+
+    # -- expression safety --------------------------------------------------
+    def _safe(self, expr: ast.expr, locals_: Optional[Set[str]] = None) -> bool:
+        if locals_ is None:
+            # the params of the defining lifecycle fn act as locals; a bare
+            # param is safe only if mirrored into a public attr
+            locals_ = set()
+        fn_params = self.model.safe_locals_by_fn.get(id(expr), set())
+
+        def ok(node: ast.expr, bound: Set[str]) -> bool:
+            if isinstance(node, ast.Constant):
+                return True
+            if isinstance(node, ast.Name):
+                if node.id in bound:
+                    return True
+                if node.id in fn_params:
+                    # ctor param: safe only when mirrored to a public attr
+                    return any(
+                        self.model.param_mirrored(fn, node.id)
+                        for fn in self.model.lifecycle
+                    )
+                # module-level name (function, class, constant): deterministic
+                return True
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.value, ast.Name) and node.value.id == "self":
+                    a = node.attr
+                    if not a.startswith("_"):
+                        return a not in self.excluded
+                    if a in self.base_attrs or a in self.safe_privates:
+                        return True
+                    resolved = _class_attr(self.cls, a)
+                    return resolved is not None and _raw_function(resolved) is not None
+                return ok(node.value, bound)
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "self"
+                ):
+                    # self-method call: deterministic given safe args (the
+                    # method's own reads surface separately via traced-read
+                    # analysis when trace-reachable)
+                    pass
+                elif not ok(f, bound):
+                    return False
+                return all(ok(a, bound) for a in node.args) and all(
+                    ok(kw.value, bound) for kw in node.keywords
+                )
+            if isinstance(node, ast.Lambda):
+                inner = bound | {
+                    a.arg
+                    for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+                }
+                return ok(node.body, inner)
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+                inner = set(bound)
+                for gen in node.generators:
+                    for n in ast.walk(gen.target):
+                        if isinstance(n, ast.Name):
+                            inner.add(n.id)
+                    if not ok(gen.iter, inner) or not all(ok(i, inner) for i in gen.ifs):
+                        return False
+                if isinstance(node, ast.DictComp):
+                    return ok(node.key, inner) and ok(node.value, inner)
+                return ok(node.elt, inner)
+            if isinstance(node, ast.NamedExpr):
+                return ok(node.value, bound)
+            # structural nodes: every child expression must be safe
+            return all(
+                ok(child, bound)
+                for child in ast.iter_child_nodes(node)
+                if isinstance(child, ast.expr)
+            )
+
+        return ok(expr, set(locals_))
+
+
+# ------------------------------------------------------------------ checking
+def _excluded_attrs(cls: type) -> FrozenSet[str]:
+    from torchmetrics_tpu.core.compile import _BASE_FINGERPRINT_EXCLUDE
+
+    excluded = set(_BASE_FINGERPRINT_EXCLUDE)
+    for c in cls.__mro__:
+        excluded |= set(getattr(c, "__fingerprint_exclude__", ()) or ())
+    return frozenset(excluded)
+
+
+def check_class_fingerprint(cls: type) -> List[FingerprintIssue]:
+    """Static fingerprint-completeness findings for one Metric subclass."""
+    excluded = _excluded_attrs(cls)
+    reads = _traced_reads(cls)
+    if not reads:
+        return []
+    checker: Optional[_DerivationChecker] = None
+    issues: List[FingerprintIssue] = []
+    for attr, (via, rel, line) in sorted(reads.items()):
+        resolved = _class_attr(cls, attr)
+        if resolved is not None and (
+            _raw_function(resolved) is not None or not attr.startswith("_")
+        ):
+            # methods and properties are code — their own attr reads were
+            # collected by _traced_reads; public class attrs are carried by
+            # the fingerprint's (module, qualname) class identity
+            continue
+        if not attr.startswith("_"):
+            if attr in excluded:
+                issues.append(
+                    FingerprintIssue(
+                        cls.__name__,
+                        attr,
+                        "excluded-read",
+                        f"{cls.__name__}.{via} reads self.{attr}, which is listed in "
+                        "__fingerprint_exclude__ — mutating it would NOT retrace, so the "
+                        "compiled graph silently keeps the old value; remove it from the "
+                        "exclude list or stop reading it in traced code",
+                        path=rel,
+                        line=line,
+                    )
+                )
+            continue
+        if checker is None:
+            checker = _DerivationChecker(cls, excluded)
+        verdict = checker.classify(attr)
+        if verdict == "safe":
+            continue
+        if verdict == "mutated":
+            issues.append(
+                FingerprintIssue(
+                    cls.__name__,
+                    attr,
+                    "mutated-in-trace",
+                    f"{cls.__name__}.{via} reads private self.{attr}, which is assigned "
+                    "outside __init__/reset — private attrs never fingerprint, so the "
+                    "mutation reuses the stale compiled graph; derive it in __init__ from "
+                    "public config or store it as a public attribute",
+                    path=rel,
+                    line=line,
+                )
+            )
+        else:
+            issues.append(
+                FingerprintIssue(
+                    cls.__name__,
+                    attr,
+                    "unfingerprinted-private",
+                    f"{cls.__name__}.{via} reads private self.{attr}, whose value is not "
+                    "a deterministic function of fingerprinted attributes — two instances "
+                    "differing only in it would share one compile-cache key; mirror its "
+                    "source config into a public attribute",
+                    path=rel,
+                    line=line,
+                )
+            )
+    return issues
+
+
+def check_fingerprint(metric: Any) -> List[FingerprintIssue]:
+    """Instance-level check: class findings filtered to attrs this instance
+    actually carries (excluded-read findings always apply)."""
+    issues = check_class_fingerprint(type(metric))
+    return [
+        i
+        for i in issues
+        if i.kind == "excluded-read" or i.attr in getattr(metric, "__dict__", {})
+    ]
+
+
+def fingerprint_insensitive(metric: Any, attr: str) -> bool:
+    """Dynamic cross-check: True when perturbing ``attr`` on a deep copy
+    leaves ``config_fingerprint`` unchanged — i.e. ``explain_retrace`` would
+    attribute no retrace to the mutation, confirming the stale-trace hazard."""
+    import copy
+
+    clone = copy.deepcopy(metric)
+    before = clone._config_fingerprint()
+    setattr(clone, attr, object())
+    after = clone._config_fingerprint()
+    return before == after
+
+
+# ------------------------------------------------------------- package sweep
+def iter_package_metric_classes() -> Iterator[type]:
+    """Every concrete Metric subclass importable from the package's public
+    modules, deterministically ordered."""
+    import importlib
+    import pkgutil
+
+    import torchmetrics_tpu
+    from torchmetrics_tpu.core.metric import Metric
+
+    for modinfo in sorted(
+        pkgutil.walk_packages(torchmetrics_tpu.__path__, prefix="torchmetrics_tpu."),
+        key=lambda m: m.name,
+    ):
+        if any(part.startswith("_") for part in modinfo.name.split(".")[1:]):
+            continue
+        try:
+            importlib.import_module(modinfo.name)
+        except Exception:
+            continue
+
+    seen: Set[type] = set()
+
+    def walk(cls: type) -> Iterator[type]:
+        for sub in cls.__subclasses__():
+            if sub in seen:
+                continue
+            seen.add(sub)
+            if sub.__module__.startswith("torchmetrics_tpu"):
+                yield sub
+            yield from walk(sub)
+
+    yield from sorted(walk(Metric), key=lambda c: (c.__module__, c.__qualname__))
+
+
+def scan_package_fingerprints() -> List[FingerprintIssue]:
+    """Run :func:`check_class_fingerprint` over every package Metric class."""
+    issues: List[FingerprintIssue] = []
+    for cls in iter_package_metric_classes():
+        if inspect.isabstract(cls) or cls.__name__.startswith("_"):
+            # private bases (``_CurveBase`` …) are audited through their
+            # concrete subclasses, whose __init__ defines the lifecycle
+            continue
+        issues.extend(check_class_fingerprint(cls))
+    return issues

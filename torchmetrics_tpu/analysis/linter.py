@@ -42,7 +42,7 @@ import re
 import tokenize
 from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
     "FileContext",
@@ -50,6 +50,8 @@ __all__ = [
     "Rule",
     "Suppression",
     "all_rules",
+    "apply_suppressions",
+    "format_github",
     "format_json",
     "format_text",
     "get_rule",
@@ -97,7 +99,14 @@ class Suppression:
     line: int
     ids: Tuple[str, ...]
     justification: str
-    used: bool = False
+    #: rule ids that actually matched a finding on this line — staleness is
+    #: judged per id, so `ignore[TMT003,TMT005]` with only TMT003 firing
+    #: still reports the dead TMT005 half
+    used_ids: Set[str] = field(default_factory=set)
+
+    @property
+    def used(self) -> bool:
+        return bool(self.used_ids)
 
 
 class Rule:
@@ -113,8 +122,16 @@ class Rule:
     name: str = ""
     description: str = ""
     allow_paths: Tuple[str, ...] = ()
+    #: whole-program rules are driven by the sanitizer passes (donation,
+    #: fingerprint, uniformity, contracts) over *live* metric objects and
+    #: jaxprs rather than one file's AST; ``check`` never fires during the
+    #: per-file walk, and their suppressions are exempt from per-file stale
+    #: detection (only ``--audit-all`` can tell whether they still match).
+    whole_program: bool = False
 
     def check(self, ctx: "FileContext") -> Iterator[Tuple[int, str]]:
+        if self.whole_program:
+            return iter(())
         raise NotImplementedError
 
     def applies_to(self, rel_path: str) -> bool:
@@ -298,14 +315,23 @@ def _hygiene_findings(
                     f"suppression names unknown rule id(s) {unknown} (known: {sorted(_RULES)})",
                 )
             )
-        if check_stale and sup.ids and not unknown and not sup.used:
+        # per-id staleness: every named id must have matched a finding on its
+        # line, except whole-program ids (their passes report through the
+        # sanitizer, not lint_file, so per-file runs can't see their matches)
+        stale_ids = [
+            rid
+            for rid in sup.ids
+            if rid in _RULES and not _RULES[rid].whole_program and rid not in sup.used_ids
+        ]
+        if check_stale and sup.ids and not unknown and stale_ids:
             findings.append(
                 Finding(
                     HYGIENE_RULE_ID,
                     rel_path,
                     sup.line,
-                    f"stale suppression {list(sup.ids)}: no finding on this line — the code "
-                    "it excused was fixed or moved; delete the comment",
+                    f"stale suppression {stale_ids}: no finding for these rule(s) on this "
+                    "line — the code it excused was fixed or moved; delete the comment "
+                    "(or the dead id)",
                 )
             )
     return findings
@@ -342,7 +368,7 @@ def lint_file(
             suppressed = False
             for sup in by_line.get(lineno, ()):
                 if rule.id in sup.ids:
-                    sup.used = True
+                    sup.used_ids.add(rule.id)
                     suppressed = True
             if not suppressed:
                 findings.append(Finding(rule.id, rel_path, lineno, message))
@@ -387,11 +413,55 @@ def lint_package(select: Optional[Iterable[str]] = None) -> List[Finding]:
     return lint_paths([root], root=root, select=select)
 
 
+def apply_suppressions(findings: Sequence[Finding], root: Optional[Path] = None) -> List[Finding]:
+    """Filter whole-program pass findings through per-line ``# tmt: ignore``.
+
+    The sanitizer passes anchor each finding at a real source line, so the
+    suppression contract is identical to the per-file linter's: a
+    ``# tmt: ignore[TMT01x] -- why`` comment on the flagged line silences it.
+    ``root`` defaults to the package root; findings whose path cannot be read
+    (synthetic locations) survive untouched.
+    """
+    if root is None:
+        root = package_root()
+    surviving: List[Finding] = []
+    cache: Dict[str, Dict[int, List[Suppression]]] = {}
+    for f in findings:
+        if f.path not in cache:
+            try:
+                lines = (root / f.path).read_text(encoding="utf-8").splitlines()
+                by_line: Dict[int, List[Suppression]] = {}
+                for sup in parse_suppressions(lines):
+                    by_line.setdefault(sup.line, []).append(sup)
+                cache[f.path] = by_line
+            except OSError:
+                cache[f.path] = {}
+        if any(f.rule in sup.ids for sup in cache[f.path].get(f.line, ())):
+            continue
+        surviving.append(f)
+    return surviving
+
+
 # -------------------------------------------------------------------- output
 def format_text(findings: Sequence[Finding]) -> str:
     if not findings:
         return "torchmetrics_tpu.analysis: clean (0 findings)"
     lines = [f"{f.location()}: {f.rule} {f.message}" for f in findings]
+    lines.append(f"torchmetrics_tpu.analysis: {len(findings)} finding(s)")
+    return "\n".join(lines)
+
+
+def format_github(findings: Sequence[Finding]) -> str:
+    """GitHub Actions workflow-command annotations, one ``::error`` per finding.
+
+    Newlines inside messages are URL-encoded per the workflow-command spec so
+    multi-line diffs (the contract gate) render as one annotation.
+    """
+    lines = []
+    for f in findings:
+        message = f"{f.rule} {f.message}".replace("%", "%25").replace("\r", "%0D")
+        message = message.replace("\n", "%0A")
+        lines.append(f"::error file={f.path},line={f.line},title={f.rule}::{message}")
     lines.append(f"torchmetrics_tpu.analysis: {len(findings)} finding(s)")
     return "\n".join(lines)
 

@@ -1,4 +1,4 @@
-"""Registered trace-safety rules (TMT001…TMT009).
+"""Registered trace-safety rules (TMT001…TMT013).
 
 Each rule encodes one way a metric implementation can silently break the
 trace contract this library's performance story depends on:
@@ -29,7 +29,30 @@ TMT008 float64-literal                explicit float64 requests (x64 is off:
                                       retrace under ``jax_enable_x64``)
 TMT009 suppression-hygiene            suppressions without justification,
                                       naming unknown rules, or gone stale
+TMT010 donation-race                  use-after-donate on donated state
+                                      buffers, incl. compute-group aliased
+                                      leaves reachable from two donating
+                                      entrypoints (the PR 1 bug class)
+TMT011 fingerprint-completeness       ``self.<attr>`` reads that influence
+                                      traced code but are absent from the
+                                      compile-cache config fingerprint (the
+                                      stale-trace bug class)
+TMT012 collective-uniformity          collectives dominated by traced-value
+                                      control flow (replica-divergent
+                                      sequences), and quantize/dequantize ops
+                                      leaking out of the sync segment
+TMT013 trace-contract                 compiled-entrypoint jaxprs drifting
+                                      from their committed golden contracts
+                                      (primitive multiset, collective
+                                      sequence, donation mask)
 ====== ============================== =======================================
+
+TMT010–TMT013 are *whole-program* rules: their findings come from the
+sanitizer passes (:mod:`analysis.donation`, :mod:`analysis.fingerprint`,
+:mod:`analysis.uniformity`, :mod:`analysis.contracts`) run over live metric
+objects and traced jaxprs via ``--audit-all``, not from the per-file AST
+walk.  They are registered here so suppressions can name them, ``--select``
+can filter them, and ``--list-rules`` documents them.
 
 TMT001/TMT002 are the two lints previously hard-coded in
 ``tests/unittests/observability/test_lint.py``, migrated onto the registry;
@@ -48,12 +71,16 @@ from torchmetrics_tpu.analysis.linter import FileContext, Rule, register
 
 __all__ = [
     "BarePrintRule",
+    "CollectiveUniformityRule",
     "DirectCollectiveRule",
+    "DonationRaceRule",
+    "FingerprintCompletenessRule",
     "Float64LiteralRule",
     "HostSyncInTraceRule",
     "MaterializeInUpdateRule",
     "StateMutationRule",
     "SuppressionHygieneRule",
+    "TraceContractRule",
     "TracedBranchRule",
     "WallClockRngRule",
 ]
@@ -307,9 +334,32 @@ class TracedBranchRule(Rule):
             v.visit(atom)
         return v.hit
 
+    def _walrus_taints(self, fn: ast.AST, params: frozenset) -> frozenset:
+        """Names bound by ``(x := <traced expr>)`` anywhere in the scope.
+
+        A walrus can smuggle a tracer past the branch-test check: ``if (x :=
+        preds) is not None`` escapes through the identity-compare exemption,
+        yet ``x`` now aliases the traced input and a later ``if x:`` branches
+        on it.  Taint is scope-wide (not statement-ordered) — an
+        over-approximation a justified suppression can override.
+        """
+        tainted = set(params)
+        # iterate to a fixed point so chained walruses (y := x) propagate
+        changed = True
+        while changed:
+            changed = False
+            for node in _walk_scope(fn):
+                if isinstance(node, ast.NamedExpr) and isinstance(node.target, ast.Name):
+                    if node.target.id not in tainted and self._array_suspect(
+                        node.value, frozenset(tainted)
+                    ):
+                        tainted.add(node.target.id)
+                        changed = True
+        return frozenset(tainted)
+
     def check(self, ctx: FileContext) -> Iterator[Tuple[int, str]]:
         for fn in ctx.traced_functions():
-            params = self._param_names(fn)
+            params = self._walrus_taints(fn, self._param_names(fn))
             for node in _walk_scope(fn):
                 if isinstance(node, (ast.If, ast.While)):
                     name = self._array_suspect(node.test, params)
@@ -319,6 +369,22 @@ class TracedBranchRule(Rule):
                             f"python `{kw}` branches on traced input {name!r} — "
                             "TracerBoolConversionError under jit; use jnp.where or lax.cond"
                         )
+                elif isinstance(node, ast.Match):
+                    name = self._array_suspect(node.subject, params)
+                    if name is not None:
+                        yield node.lineno, (
+                            f"python `match` dispatches on traced input {name!r} — "
+                            "pattern matching compares the tracer on host; use jnp.where "
+                            "or lax.switch"
+                        )
+                    for case in node.cases:
+                        if case.guard is not None:
+                            gname = self._array_suspect(case.guard, params)
+                            if gname is not None:
+                                yield case.pattern.lineno, (
+                                    f"`case ... if` guard branches on traced input {gname!r} — "
+                                    "TracerBoolConversionError under jit; use jnp.where or lax.cond"
+                                )
 
 
 # --------------------------------------------------------------------- TMT005
@@ -546,3 +612,64 @@ class SuppressionHygieneRule(Rule):
         # framework-driven (analysis/linter.py emits TMT009 after all rules ran,
         # because staleness needs the full finding set); nothing to do per-rule
         return iter(())
+
+
+# --------------------------------------------------------------------- TMT010
+@register
+class DonationRaceRule(Rule):
+    id = "TMT010"
+    name = "donation-race"
+    whole_program = True
+    description = (
+        "No use-after-donate: a state buffer handed to a donating compiled entrypoint is "
+        "dead the moment the call dispatches, so reading it afterwards — directly, or "
+        "through a compute-group alias whose members donate independently without the "
+        "_state_shared opt-out — returns garbage or raises on TPU.  Driven by "
+        "analysis/donation.py over live metrics and the package's host-side call sites."
+    )
+
+
+# --------------------------------------------------------------------- TMT011
+@register
+class FingerprintCompletenessRule(Rule):
+    id = "TMT011"
+    name = "fingerprint-completeness"
+    whole_program = True
+    description = (
+        "Every attribute that influences traced code must be visible to the compile-cache "
+        "config fingerprint: an attribute read inside _update/_compute (or anything they "
+        "call) that is private, excluded via __fingerprint_exclude__, or mutated outside "
+        "__init__ can change without forcing a retrace — the stale-trace bug class.  "
+        "Driven by analysis/fingerprint.py's attribute dataflow over Metric subclasses."
+    )
+
+
+# --------------------------------------------------------------------- TMT012
+@register
+class CollectiveUniformityRule(Rule):
+    id = "TMT012"
+    name = "collective-uniformity"
+    whole_program = True
+    description = (
+        "Every sync jaxpr must issue a replica-independent collective sequence: a "
+        "collective inside a lax.cond branch or while-loop body dominated by a traced "
+        "value can fire on some replicas and not others — a deadlock on TPU.  Also "
+        "confines quantize/dequantize ops to the sync segment for compressed plans.  "
+        "Driven by analysis/uniformity.py over plain/coalesced/compressed/cadence/ragged "
+        "sync traces."
+    )
+
+
+# --------------------------------------------------------------------- TMT013
+@register
+class TraceContractRule(Rule):
+    id = "TMT013"
+    name = "trace-contract"
+    whole_program = True
+    description = (
+        "Compiled-entrypoint jaxprs for the representative metric set must match their "
+        "committed golden contracts (primitive multiset + collective sequence + donation "
+        "mask per (metric, entrypoint, mesh)).  An unintended trace change fails with a "
+        "primitive-level diff; intended changes are re-blessed via --update-contracts.  "
+        "Driven by analysis/contracts.py."
+    )

@@ -1,0 +1,157 @@
+"""Whole-program sanitizer driver: the ``--audit-all`` entry point.
+
+Runs the four whole-program passes — donation/aliasing races (TMT010),
+fingerprint completeness (TMT011), collective uniformity (TMT012), golden
+trace contracts (TMT013) — and renders their results as linter
+:class:`~torchmetrics_tpu.analysis.linter.Finding` objects so CLI
+formatting, exit codes, and per-line ``# tmt: ignore[TMT01x] -- why``
+suppressions all behave exactly like the per-file rules.
+
+Unlike the per-file AST rules these passes *execute* package code: they
+trace real jaxprs on an 8-device host-platform mesh, so the CLI bootstraps
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` before JAX
+initializes (see ``__main__``).  Findings without a natural source line
+(uniformity proofs over traced graphs, contract diffs) are anchored at the
+subsystem's source file, line 1.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, List, Optional, Sequence, Tuple
+
+from torchmetrics_tpu.analysis.linter import Finding, apply_suppressions
+
+__all__ = [
+    "audit_all",
+    "run_contract_pass",
+    "run_donation_pass",
+    "run_fingerprint_pass",
+    "run_uniformity_pass",
+]
+
+#: anchor files for findings that describe traced graphs rather than lines
+_SYNC_ANCHOR = "parallel/sync.py"
+_CONTRACT_ANCHOR = "analysis/contracts.py"
+
+
+def run_donation_pass() -> List[Finding]:
+    """TMT010: jaxpr/AST use-after-donate scan plus a live aliasing audit of
+    a jit compute-group collection (the PR 1 regression shape)."""
+    from torchmetrics_tpu.analysis.donation import audit_donation, scan_use_after_donate
+
+    findings = [
+        Finding("TMT010", issue.path or _SYNC_ANCHOR, issue.line or 1, issue.message)
+        for issue in scan_use_after_donate()
+    ]
+
+    # live check: a fused compute-group collection must come out of two
+    # updates with every shared buffer protected by _state_shared
+    from torchmetrics_tpu.analysis.contracts import _binary_inputs
+    from torchmetrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+    from torchmetrics_tpu.collections import MetricCollection
+
+    col = MetricCollection({"acc": BinaryAccuracy(), "f1": BinaryF1Score()}, jit=True)
+    p, t = _binary_inputs()
+    col.update(p, t)
+    col.update(p, t)  # second update establishes compute-group aliasing
+    report = audit_donation(col)
+    findings.extend(
+        Finding("TMT010", issue.path or "collections.py", issue.line or 1, issue.message)
+        for issue in report.issues
+    )
+    return findings
+
+
+def run_fingerprint_pass() -> List[Finding]:
+    """TMT011: unfingerprinted trace-influencing attributes, package-wide."""
+    from torchmetrics_tpu.analysis.fingerprint import scan_package_fingerprints
+
+    return [
+        Finding("TMT011", issue.path or "core/compile.py", issue.line or 1, issue.message)
+        for issue in scan_package_fingerprints()
+    ]
+
+
+def _uniformity_slate() -> Tuple[List[Any], List[Any], Tuple[Any, ...]]:
+    from torchmetrics_tpu.analysis.contracts import _binary_inputs, _regression_inputs
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    acc, mse = BinaryAccuracy(), MeanSquaredError()
+    inputs = _binary_inputs()
+    states = [
+        acc.update_state(acc.init_state(), *inputs),
+        mse.update_state(mse.init_state(), *_regression_inputs()),
+    ]
+    return [acc, mse], states, inputs
+
+
+def run_uniformity_pass(mesh: Optional[Any] = None, axis_name: str = "data") -> List[Finding]:
+    """TMT012: every sync lowering — plain, int8/bf16 compressed, coalesced,
+    cadence-windowed, ragged — must issue a replica-independent collective
+    sequence (and confine quantization to the sync segment)."""
+    from torchmetrics_tpu.analysis.uniformity import (
+        verify_cadence_step,
+        verify_collection_sync,
+        verify_metric_sync,
+        verify_ragged_gather,
+    )
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+
+    metrics, states, inputs = _uniformity_slate()
+    report = verify_metric_sync(metrics[0], *inputs, mesh=mesh, axis_name=axis_name)
+    report.merge(verify_collection_sync(metrics, states, mesh=mesh, axis_name=axis_name))
+    report.merge(
+        verify_collection_sync(
+            metrics,
+            states,
+            mesh=mesh,
+            axis_name=axis_name,
+            # floor of 0: the point is verifying the quantized graph, not
+            # whether these tiny states clear the size cutoff
+            compression=CompressionConfig(mode="int8", min_bucket_bytes=0),
+            cadence=False,
+        )
+    )
+    report.merge(verify_cadence_step(metrics, states, *inputs, mesh=mesh, axis_name=axis_name))
+    report.merge(verify_ragged_gather(mesh=mesh, axis_name=axis_name))
+    return [Finding("TMT012", _SYNC_ANCHOR, 1, problem) for problem in report.problems]
+
+
+def run_contract_pass(
+    update: bool = False,
+    directory: Optional[Path] = None,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+) -> List[Finding]:
+    """TMT013: golden trace-contract gate (or regeneration with ``update``)."""
+    from torchmetrics_tpu.analysis.contracts import check_contracts, write_contracts
+
+    if update:
+        write_contracts(directory, mesh=mesh, axis_name=axis_name)
+        return []
+    return [
+        Finding("TMT013", _CONTRACT_ANCHOR, 1, diff)
+        for diff in check_contracts(directory, mesh=mesh, axis_name=axis_name)
+    ]
+
+
+def audit_all(
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    select: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Run every whole-program pass; suppressions already applied."""
+    passes = (
+        ("TMT010", run_donation_pass),
+        ("TMT011", run_fingerprint_pass),
+        ("TMT012", lambda: run_uniformity_pass(mesh=mesh, axis_name=axis_name)),
+        ("TMT013", lambda: run_contract_pass(mesh=mesh, axis_name=axis_name)),
+    )
+    findings: List[Finding] = []
+    for rule_id, run in passes:
+        if select is not None and rule_id not in select:
+            continue
+        findings.extend(run())
+    return apply_suppressions(findings)

@@ -1,0 +1,384 @@
+"""Collective-uniformity verifier — the TMT012 whole-program pass.
+
+On TPU every replica runs the same SPMD program, so a collective deadlocks
+the moment its *execution* depends on a traced value: a ``lax.cond`` branch
+(or data-dependent ``while`` body) containing a ``psum`` fires on the
+replicas whose predicate was true and leaves the rest blocked at a barrier
+that never forms.  PR 2's runtime divergence digests catch the *symptom*
+(state that silently never synced); this pass proves the *absence* of the
+cause, statically, on the traced jaxpr:
+
+* :func:`collective_sequence` — the ordered ``(primitive, shape, dtype)``
+  collective trace of a jaxpr, each op annotated with whether traced-value
+  control flow (``cond``/``while``) dominates it.  ``scan`` bodies and
+  ``pjit``/``shard_map``/custom-derivative call wrappers are transparent:
+  their trip counts and call structure are static, so their collectives run
+  unconditionally on every replica.
+* :func:`verify_uniform` — problems for every guarded collective.
+* Path drivers — :func:`verify_metric_sync` (plain + int8/bf16 compressed),
+  :func:`verify_collection_sync` (cross-metric coalesced + ``every_n``
+  cadence window, whose local step must stay collective-*free*), and
+  :func:`verify_ragged_gather` (the multi-metric deferred ragged crossing)
+  — together covering every sync graph the library can lower.
+
+Compression confinement rides along: a compressed sync must contain the
+quantize→collective→dequantize segment (else the compressed path silently
+fell back to exact), and the update jaxpr must contain *neither* direction
+of wire-dtype conversion — quantization belongs to the sync segment only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.analysis.audit import (
+    COLLECTIVE_PRIMITIVES,
+    _default_mesh,
+    _stack_state,
+    _sub_jaxprs,
+    _trace_sync,
+    count_dequantize_ops,
+    iter_eqns,
+)
+
+__all__ = [
+    "CollectiveOp",
+    "UniformityReport",
+    "collective_sequence",
+    "count_quantize_ops",
+    "verify_cadence_step",
+    "verify_collection_sync",
+    "verify_metric_sync",
+    "verify_ragged_gather",
+    "verify_uniform",
+]
+
+#: wire dtypes a compression plan may move bytes in
+_WIRE = frozenset({"int8", "uint8", "bfloat16"})
+
+#: control-flow primitives whose sub-jaxprs run conditionally on traced
+#: values: cond branches are selected by a traced predicate, while bodies run
+#: a traced-value-dependent number of times (possibly zero)
+_GUARDING_PRIMITIVES = frozenset({"cond", "while"})
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One collective eqn in program order."""
+
+    primitive: str
+    shape: Tuple[int, ...]
+    dtype: str
+    #: True when a cond branch / while body dominates the op — its execution
+    #: is replica-dependent, the TMT012 hazard
+    guarded: bool = False
+
+    def describe(self) -> str:
+        dims = "x".join(str(d) for d in self.shape) or "scalar"
+        return f"{self.primitive}[{dims}:{self.dtype}]"
+
+
+def _collect(jaxpr: Any, guarded: bool, out: List[CollectiveOp]) -> None:
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in COLLECTIVE_PRIMITIVES:
+            aval = getattr(eqn.invars[0], "aval", None) if eqn.invars else None
+            out.append(
+                CollectiveOp(
+                    primitive=name,
+                    shape=tuple(getattr(aval, "shape", ())),
+                    dtype=str(getattr(aval, "dtype", "?")),
+                    guarded=guarded,
+                )
+            )
+        child_guarded = guarded or name in _GUARDING_PRIMITIVES
+        for val in eqn.params.values():
+            for sub in _sub_jaxprs(val):
+                _collect(sub, child_guarded, out)
+
+
+def collective_sequence(jaxpr: Any) -> Tuple[CollectiveOp, ...]:
+    """Ordered collective trace of ``jaxpr`` including nested bodies.
+
+    Program order within each (sub-)jaxpr; ``cond`` branches are visited in
+    branch-index order, so the sequence is deterministic for a given trace.
+    """
+    out: List[CollectiveOp] = []
+    _collect(jaxpr, False, out)
+    return tuple(out)
+
+
+def count_quantize_ops(jaxpr: Any) -> int:
+    """``convert_element_type`` eqns dropping float32 to a compression wire
+    dtype — the quantize half of the compressed sync segment (the dequantize
+    half is :func:`analysis.audit.count_dequantize_ops`)."""
+    n = 0
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        in_dt = str(getattr(getattr(eqn.invars[0], "aval", None), "dtype", ""))
+        out_dt = str(getattr(getattr(eqn.outvars[0], "aval", None), "dtype", ""))
+        if in_dt == "float32" and out_dt in _WIRE:
+            n += 1
+    return n
+
+
+def verify_uniform(jaxpr: Any, label: str = "sync") -> List[str]:
+    """Problem strings for every collective dominated by traced control flow."""
+    problems: List[str] = []
+    for i, op in enumerate(collective_sequence(jaxpr)):
+        if op.guarded:
+            problems.append(
+                f"{label}: collective #{i} {op.describe()} executes under traced-value "
+                "control flow (cond/while) — replicas whose predicate differs would "
+                "issue different collective sequences and deadlock the mesh; hoist the "
+                "collective out of the branch (sync unconditionally, select the result)"
+            )
+    return problems
+
+
+@dataclass
+class UniformityReport:
+    """Outcome of one driver run over a set of sync paths."""
+
+    subject: str
+    #: path label -> human-readable collective sequence
+    sequences: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    problems: List[str] = field(default_factory=list)
+    skipped: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.problems
+
+    def merge(self, other: "UniformityReport") -> None:
+        self.sequences.update(other.sequences)
+        self.problems.extend(other.problems)
+        self.skipped.extend(other.skipped)
+
+
+def _record(report: UniformityReport, label: str, jaxpr: Any) -> None:
+    seq = collective_sequence(jaxpr)
+    report.sequences[label] = tuple(op.describe() for op in seq)
+    report.problems.extend(verify_uniform(jaxpr, label=f"{report.subject}/{label}"))
+
+
+def verify_metric_sync(
+    metric: Any,
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    compressions: Sequence[str] = ("int8", "bf16"),
+) -> UniformityReport:
+    """Verify one metric's plain and compressed sync jaxprs are uniform.
+
+    For each compression mode the quantize/dequantize confinement contract
+    is asserted as well: wire-dtype conversions appear in the sync segment
+    (when the plan actually compressed a bucket) and never in the update
+    jaxpr.
+    """
+    from torchmetrics_tpu.core.compile import audit_step_fn, is_jit_compatible
+    from torchmetrics_tpu.core.metric import Metric
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+    from torchmetrics_tpu.parallel.coalesce import plan_for_metric
+
+    subject = type(metric).__name__
+    report = UniformityReport(subject)
+    state = metric.update_state(metric.init_state(), *inputs)
+    the_mesh = _default_mesh(mesh, axis_name)
+
+    if type(metric).sync_states is not Metric.sync_states:
+        report.skipped.append(f"{subject}: overrides sync_states (custom sync, not coalesced)")
+        custom_sync = True
+    else:
+        custom_sync = False
+
+    jx_update = None
+    if is_jit_compatible((inputs, {})):
+        jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
+        if count_quantize_ops(jx_update) or count_dequantize_ops(jx_update):
+            report.problems.append(
+                f"{subject}/update: wire-dtype conversion in the update jaxpr — "
+                "quantization belongs to the sync segment only"
+            )
+    else:
+        report.skipped.append(f"{subject}: update not jit-compatible (uniformity of update skipped)")
+
+    try:
+        jx_sync = _trace_sync(lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name)
+    except Exception as err:
+        report.skipped.append(f"{subject}: plain sync not traceable ({type(err).__name__}: {err})")
+        return report
+    _record(report, "sync", jx_sync)
+
+    if custom_sync:
+        return report  # compression rides the coalescing planner only
+
+    for mode in compressions:
+        # zero size floor: the dogfood states are tiny, and the point is to
+        # verify the *quantized* graph, not the exact fallback
+        cfg = CompressionConfig(mode=mode, min_bucket_bytes=0)
+        try:
+            jx_csync = _trace_sync(
+                lambda st: metric.sync_states(st, axis_name, compression=cfg),
+                state,
+                the_mesh,
+                axis_name,
+            )
+        except Exception as err:
+            report.skipped.append(
+                f"{subject}: {mode} sync not traceable ({type(err).__name__}: {err})"
+            )
+            continue
+        _record(report, f"sync[{mode}]", jx_csync)
+        plan = plan_for_metric(metric, state, compression=cfg)
+        n_compressed = sum(1 for b in plan.buckets if b.compression is not None)
+        if n_compressed and not count_dequantize_ops(jx_csync):
+            report.problems.append(
+                f"{subject}/sync[{mode}]: plan compresses {n_compressed} bucket(s) but the "
+                "traced sync has no dequantize op — the compressed segment did not lower"
+            )
+    return report
+
+
+def verify_collection_sync(
+    metrics: Sequence[Any],
+    states: Sequence[Mapping[str, Any]],
+    *,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    compression: Any = None,
+    cadence: bool = True,
+) -> UniformityReport:
+    """Verify the cross-metric coalesced sync and the ``every_n`` cadence pair.
+
+    ``cadence=True`` additionally traces the two halves of the
+    ``SyncPolicy(every_n_steps=k)`` window over a stacked carry — the local
+    accumulation step must lower *zero* collectives (each device folds its
+    own shard; a collective there would run every step and defeat the
+    cadence), and the deferred flush must be a uniform coalesced crossing.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu.core.compile import shard_map
+    from torchmetrics_tpu.parallel.coalesce import coalesced_metric_sync
+
+    names = "+".join(type(m).__name__ for m in metrics)
+    report = UniformityReport(f"coalesced[{names}]")
+    the_mesh = _default_mesh(mesh, axis_name)
+    n_dev = int(the_mesh.devices.size)
+    metrics = list(metrics)
+    states = [dict(s) for s in states]
+
+    def fused(flat_states):
+        return tuple(coalesced_metric_sync(metrics, list(flat_states), axis_name, compression=compression))
+
+    jx_fused = _trace_sync(fused, tuple(states), the_mesh, axis_name)
+    label = "coalesced" if compression is None else f"coalesced[{compression.mode}]"
+    _record(report, label, jx_fused)
+
+    if cadence:
+        # the cadence pair over a {name: stacked_state} carry, mirroring
+        # compile.compiled_cadence_step / compiled_cadence_sync
+        carry = {str(i): _stack_state(st, n_dev) for i, st in enumerate(states)}
+
+        def cadence_flush(c):
+            locals_ = [jax.tree.map(lambda x: x[0], c[str(i)]) for i in range(len(metrics))]
+            synced = coalesced_metric_sync(metrics, locals_, axis_name, compression=compression)
+            return {str(i): s for i, s in enumerate(synced)}
+
+        jx_flush = jax.make_jaxpr(
+            shard_map(cadence_flush, mesh=the_mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
+        )(carry)
+        _record(report, "cadence-flush", jx_flush)
+    return report
+
+
+def verify_cadence_step(
+    metrics: Sequence[Any],
+    states: Sequence[Mapping[str, Any]],
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+) -> UniformityReport:
+    """Trace the real cadence local step (per-device ``update_state`` fold
+    over the stacked carry) and assert it lowers zero collectives."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu.core.compile import shard_map
+
+    names = "+".join(type(m).__name__ for m in metrics)
+    report = UniformityReport(f"cadence[{names}]")
+    the_mesh = _default_mesh(mesh, axis_name)
+    n_dev = int(the_mesh.devices.size)
+    carry = {str(i): _stack_state(st, n_dev) for i, st in enumerate(states)}
+    stacked_inputs = tuple(
+        jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n_dev, *x.shape)), x) for x in inputs
+    )
+
+    def step(c, *shards):
+        out = {}
+        for i, m in enumerate(metrics):
+            local = jax.tree.map(lambda x: x[0], c[str(i)])
+            locs = tuple(jax.tree.map(lambda x: x[0], s) for s in shards)
+            new = m.update_state(local, *locs)
+            out[str(i)] = jax.tree.map(lambda x: x[None], new)
+        return out
+
+    jx_step = jax.make_jaxpr(
+        shard_map(
+            step,
+            mesh=the_mesh,
+            in_specs=(P(axis_name),) + tuple(P(axis_name) for _ in inputs),
+            out_specs=P(axis_name),
+            check_vma=False,
+        )
+    )(carry, *stacked_inputs)
+    seq = collective_sequence(jx_step)
+    report.sequences["cadence-step"] = tuple(op.describe() for op in seq)
+    if seq:
+        report.problems.append(
+            f"{report.subject}/cadence-step: {len(seq)} collective(s) in the local "
+            "accumulation step — the cadence window must defer ALL collectives to the flush"
+        )
+    return report
+
+
+def verify_ragged_gather(
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    n_items: int = 3,
+) -> UniformityReport:
+    """Trace the real multi-metric deferred ragged gather graph
+    (``compile.compiled_ragged_gather``) and verify its collective sequence
+    is uniform — the pad-gather-trim crossing must gather unconditionally
+    whatever the per-device item counts were."""
+    from torchmetrics_tpu.core.compile import compiled_ragged_gather
+    from torchmetrics_tpu.core.reductions import Reduce
+
+    report = UniformityReport("ragged-gather")
+    the_mesh = _default_mesh(mesh, axis_name)
+    n_dev = int(the_mesh.devices.size)
+
+    scalar_reduces = (("total", Reduce.SUM),)
+    flat_keys = ("rag0_data_f32", "rag0_shapes_i32")
+    fn = compiled_ragged_gather(the_mesh, axis_name, scalar_reduces, flat_keys)
+    scalars = {"total": jnp.zeros((n_dev,), jnp.float32)}
+    n = jnp.zeros((n_dev,), jnp.int32)
+    flats = {
+        "rag0_data_f32": jnp.zeros((n_dev, 64), jnp.float32),
+        "rag0_shapes_i32": jnp.zeros((n_dev, 2 * n_items), jnp.int32).astype(jnp.float32),
+    }
+    jx = jax.make_jaxpr(fn)(scalars, n, flats)
+    _record(report, "ragged-gather", jx)
+    if not any("all_gather" in d or "pgather" in d for d in report.sequences["ragged-gather"]):
+        report.problems.append(
+            "ragged-gather: no gather-family collective in the traced graph — the "
+            "ragged crossing did not lower"
+        )
+    return report

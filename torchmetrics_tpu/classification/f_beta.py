@@ -28,7 +28,7 @@ class BinaryFBetaScore(BinaryStatScores):
                          ignore_index=ignore_index, validate_args=validate_args, **kwargs)
         if validate_args:
             _validate_beta(beta)
-        self._beta = beta
+        self.beta = self._beta = beta  # public mirror fingerprints beta (TMT011)
 
     def _compute(self, state: State):
         return self._reduce_kind(state, "binary")
@@ -51,7 +51,7 @@ class MulticlassFBetaScore(MulticlassStatScores):
                          validate_args=validate_args, **kwargs)
         if validate_args:
             _validate_beta(beta)
-        self._beta = beta
+        self.beta = self._beta = beta  # public mirror fingerprints beta (TMT011)
 
     def _compute(self, state: State):
         return self._reduce_kind(state, self.average)
@@ -74,7 +74,7 @@ class MultilabelFBetaScore(MultilabelStatScores):
                          validate_args=validate_args, **kwargs)
         if validate_args:
             _validate_beta(beta)
-        self._beta = beta
+        self.beta = self._beta = beta  # public mirror fingerprints beta (TMT011)
 
     def _compute(self, state: State):
         return self._reduce_kind(state, self.average)

@@ -54,7 +54,9 @@ class PeakSignalNoiseRatio(Metric):
         self.base = base
         self.reduction = reduction
         self.dim = (dim,) if isinstance(dim, int) else dim
-        self._clamp: Optional[Tuple[float, float]] = None
+        # public so the clamp bounds fingerprint: data_range=(0, 1) and (1, 2)
+        # share self.data_range == 1.0 but compile different clip constants
+        self.clamp_range: Optional[Tuple[float, float]] = None
 
         if dim is None:
             self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
@@ -71,14 +73,14 @@ class PeakSignalNoiseRatio(Metric):
             self.add_state("max_target", jnp.asarray(-jnp.inf), dist_reduce_fx="max")
         elif isinstance(data_range, tuple):
             self.data_range = jnp.asarray(data_range[1] - data_range[0])
-            self._clamp = data_range
+            self.clamp_range = (float(data_range[0]), float(data_range[1]))
         else:
             self.data_range = jnp.asarray(float(data_range))
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
-        if self._clamp is not None:
-            preds = jnp.clip(preds, self._clamp[0], self._clamp[1])
-            target = jnp.clip(target, self._clamp[0], self._clamp[1])
+        if self.clamp_range is not None:
+            preds = jnp.clip(preds, self.clamp_range[0], self.clamp_range[1])
+            target = jnp.clip(target, self.clamp_range[0], self.clamp_range[1])
         sse, n = _psnr_update(preds, target, dim=self.dim)
         new = dict(state)
         if self.dim is None:

@@ -113,7 +113,7 @@ class BERTScore(Metric):
         pred_emb = jnp.asarray(self.embed_fn(jnp.asarray(p_ids), jnp.asarray(p_mask)))
         tgt_emb = jnp.asarray(self.embed_fn(jnp.asarray(t_ids), jnp.asarray(t_mask)))
 
-        if self._zero_special:
+        if self._zero_special:  # tmt: ignore[TMT011] -- produced by the same deterministic resolve_embedder call whose model_name_or_path result is mirrored publicly; same fingerprint implies same _zero_special
             p_mask = _process_special_tokens_mask(p_mask)
             t_mask = _process_special_tokens_mask(t_mask)
 

@@ -103,4 +103,8 @@ class SacreBLEUScore(BLEUScore):
         super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
         if tokenize not in AVAILABLE_TOKENIZERS:
             raise ValueError(f"Argument `tokenize` expected to be one of {list(AVAILABLE_TOKENIZERS)}")
+        # public mirrors fingerprint the tokenizer config (TMT011): without
+        # them two instances differing only in `tokenize` share a cache key
+        self.tokenize = tokenize
+        self.lowercase = lowercase
         self._tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

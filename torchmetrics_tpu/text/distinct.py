@@ -38,8 +38,12 @@ from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 #: sentinel token for invalid windows in the exact cat state — larger than
-#: any real int32 token id once compared as int64 column keys
-_SENTINEL = jnp.int32(-1)
+#: any real int32 token id once compared as int64 column keys.  A plain int
+#: (not a materialized ``jnp.int32`` array): creating a device array at
+#: import time would initialize the JAX backend before callers — notably
+#: ``python -m torchmetrics_tpu.analysis --audit-all`` — can configure the
+#: device topology via XLA_FLAGS.
+_SENTINEL = -1
 
 
 class DistinctNGrams(Metric):

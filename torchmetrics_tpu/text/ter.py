@@ -50,6 +50,11 @@ class TranslationEditRate(Metric):
         ):
             if not isinstance(val, bool):
                 raise ValueError(f"`{name}` must be a bool, got {val!r}.")
+        # public mirrors fingerprint the tokenizer config (TMT011)
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
         self._tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
         self.return_sentence_level_score = return_sentence_level_score
 
