@@ -1040,6 +1040,188 @@ def compressed_bench_child():
     print(json.dumps(out))
 
 
+def fleet_bench_child():
+    """Fleet telemetry plane acceptance leg on the 8-virtual-device mesh:
+
+    * identity — single-process ``fleet_report()`` must be byte-identical to
+      the local ``report()`` (the n=1 collapse the exporters rely on);
+    * merge timing — wall time of a mocked 4-process ``FleetView`` merge plus
+      its skew/straggler attribution over a real measured report;
+    * health overhead — per-step price of an armed :class:`HealthMonitor`
+      (bound + drift + nonfinite + staleness on the computed value) on the
+      jitted update path, with the retrace counter proving the monitor adds
+      zero compilations (it only ever sees host floats);
+    * alert path — a deterministic drift cliff must page exactly once through
+      a JSONL sink and the line must parse back via ``parse_export_line``.
+    """
+    import copy
+    import io
+
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+    from torchmetrics_tpu.observability.export import parse_export_line
+    from torchmetrics_tpu.observability.fleet import FleetView, fleet_report
+    from torchmetrics_tpu.observability.health import (
+        BoundRule,
+        DriftRule,
+        HealthMonitor,
+        JSONLAlertSink,
+        NonFiniteRule,
+        StalenessRule,
+    )
+    from torchmetrics_tpu.observability.registry import report as local_report
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+
+    try:
+        # --- seed a real report: measured sharded syncs on the dryrun mesh
+        obs.reset_telemetry()
+        obs.enable()
+        from torchmetrics_tpu.parallel import sharded_update
+
+        spec = NamedSharding(mesh, P("data"))
+        m = MulticlassAccuracy(num_classes=16, average="micro")
+        for _ in range(4):
+            preds = _jax.device_put(jnp.asarray(rng.integers(0, 16, 64)), spec)
+            tgt = _jax.device_put(jnp.asarray(rng.integers(0, 16, 64)), spec)
+            sharded_update(m, preds, tgt, mesh=mesh, axis_name="data")
+        base = local_report()
+
+        # --- identity: n=1 fleet_report collapses to the local report
+        t0 = time.perf_counter()
+        fr = fleet_report()
+        identity_us = (time.perf_counter() - t0) * 1e6
+        identity_ok = json.dumps(fr, sort_keys=True, default=str) == json.dumps(
+            local_report(), sort_keys=True, default=str
+        )
+
+        # --- mocked 4-process merge + skew/straggler attribution
+        reports = []
+        for i in range(4):
+            r = copy.deepcopy(base)
+            r["process"] = {"index": i, "count": 4}
+            if i == 2:  # injected straggler
+                row = r["metrics"]["_process"]["spans"]["sync_wait"]
+                row["total_us"] *= 3.0
+                row["max_us"] *= 3.0
+            reports.append(r)
+        reps = 20
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            view = FleetView(reports)
+            merged = view.report()
+        merge_us = (time.perf_counter() - t0) / reps * 1e6
+        skew = merged["fleet"]["skew"]
+        n_counters = sum(
+            len(row["counters"]) for row in merged["metrics"].values()
+        )
+
+        # --- armed health monitor per-step overhead + 0-retrace proof
+        preds = jnp.asarray(rng.integers(0, 16, 4096))
+        tgt = jnp.asarray(rng.integers(0, 16, 4096))
+
+        def step_us(monitor):
+            clear_compile_cache()
+            obs.reset_telemetry()
+            obs.enable()
+            mm = MulticlassAccuracy(num_classes=16, validate_args=False, jit=True)
+            mm.update(preds, tgt)  # compile
+            inner = 50
+            t0 = time.perf_counter()
+            for i in range(inner):
+                mm.update(preds, tgt)
+                if monitor is not None:
+                    monitor.observe("bench/acc", float(mm.compute()), step=i)
+                    monitor.advance(i)
+            _jax.block_until_ready(_jax.tree.leaves(mm._state))
+            return (time.perf_counter() - t0) / inner * 1e6, cache_stats()["traces"]
+
+        bare_us, bare_traces = step_us(None)
+        mon = HealthMonitor()
+        mon.watch(
+            "bench/acc",
+            BoundRule(min_value=0.0, max_value=1.0),
+            DriftRule(z_threshold=4.0, warmup=5),
+            NonFiniteRule(),
+            StalenessRule(10),
+        )
+        armed_us, armed_traces = step_us(mon)
+
+        # isolate the monitor itself: compute() dominates the armed loop, so
+        # also time observe+advance alone on a pre-built float stream
+        vals = [0.5 + 0.001 * (i % 7) for i in range(1000)]
+        mon2 = HealthMonitor()
+        mon2.watch(
+            "bench/stream",
+            BoundRule(min_value=0.0, max_value=1.0),
+            DriftRule(z_threshold=4.0, warmup=5),
+            NonFiniteRule(),
+            StalenessRule(10),
+        )
+        t0 = time.perf_counter()
+        for i, v in enumerate(vals):
+            mon2.observe("bench/stream", v, step=i)
+            mon2.advance(i)
+        observe_us = (time.perf_counter() - t0) / len(vals) * 1e6
+
+        # --- alert path smoke: drift cliff pages exactly once, line parses
+        buf = io.StringIO()
+        mon3 = HealthMonitor(sinks=[JSONLAlertSink(stream=buf)])
+        mon3.watch("bench/drift", DriftRule(z_threshold=4.0, alpha=0.1, warmup=10))
+        stream = [0.9 + 0.002 * (i % 5) for i in range(20)] + [0.1]
+        for i, v in enumerate(stream):
+            mon3.observe("bench/drift", v, step=i)
+        lines = buf.getvalue().splitlines()
+        parsed = [parse_export_line(ln) for ln in lines]
+        alert_ok = (
+            len(parsed) == 1
+            and parsed[0]["kind"] == "health_alert"
+            and parsed[0]["rule"] == "drift"
+            and parsed[0]["step"] == len(stream) - 1
+        )
+
+        out["fleet_telemetry"] = {
+            "identity_single_process_ok": bool(identity_ok),
+            "identity_report_us": round(identity_us, 1),
+            "merge_4proc_us": round(merge_us, 1),
+            "merged_counter_families": n_counters,
+            "skew": {
+                "straggler_process": skew["straggler"]["process"],
+                "straggler_expected": 2,
+                "straggler_ok": skew["straggler"]["process"] == 2,
+                "wait_skew_ratio": round(skew["sync_wait_us"]["skew_ratio"], 3),
+                "bytes_skew_ratio": round(skew["sync_bytes"]["skew_ratio"], 3),
+            },
+            "health_update_us_bare": round(bare_us, 1),
+            "health_update_us_armed": round(armed_us, 1),
+            "health_observe_advance_us": round(observe_us, 2),
+            "health_extra_retraces": armed_traces - bare_traces,  # must be 0
+            "alert_path": {
+                "jsonl_lines": len(lines),
+                "drift_paged_once_ok": bool(alert_ok),
+            },
+            "note": "health monitors consume host floats after compute; the "
+            "armed path adds zero retraces by construction and the fleet "
+            "merge is pure host-side dict arithmetic",
+        }
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+        clear_compile_cache()
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -1096,6 +1278,12 @@ def measured_sketch():
 def measured_compressed():
     return _run_cpu_mesh_child(
         "compressed", float(os.environ.get("BENCH_COMPRESS_TIMEOUT", 300))
+    )
+
+
+def measured_fleet():
+    return _run_cpu_mesh_child(
+        "fleet", float(os.environ.get("BENCH_FLEET_TIMEOUT", 300))
     )
 
 
@@ -1489,6 +1677,7 @@ def main():
     coalescing_measured = measured_coalescing()
     sketch_measured = measured_sketch()
     compressed_measured = measured_compressed()
+    fleet_measured = measured_fleet()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -1535,6 +1724,7 @@ def main():
             "coalescing": coalescing_measured,
             "sketch_states": sketch_measured,
             "compressed_sync": compressed_measured,
+            "fleet": fleet_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -1662,6 +1852,8 @@ if __name__ == "__main__":
         sketch_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "compressed":
         compressed_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "fleet":
+        fleet_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

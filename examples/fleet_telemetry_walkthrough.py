@@ -1,0 +1,144 @@
+"""Fleet telemetry walkthrough: the cross-host plane end to end.
+
+What this shows, in order:
+
+1. seed a real per-process report: measured sharded syncs on an 8-virtual-
+   device CPU mesh, landing per-process ``sync_wait`` digests;
+2. the single-process identity — ``fleet_report()`` collapses byte-for-byte
+   to the local ``report()`` when there is nothing to merge;
+3. a mocked 4-process fleet through the same injectable ``allgather`` seam
+   the sync planner uses: counters sum, histograms merge bucket-wise, and
+   the injected straggler is named with its skew ratio;
+4. ``SyncAdvisor.recommend(fleet=...)`` folding that skew into its advice;
+5. streaming health monitors: a drift cliff pages exactly once through a
+   JSONL sink, deterministically (step-indexed, no wall clock);
+6. merge-ready exports — ``process``-labeled Prometheus, per-process JSONL,
+   and Chrome traces whose ``pid`` is the jax process index so per-host
+   recordings concatenate into one Perfetto timeline.
+
+Run on anything: ``python examples/fleet_telemetry_walkthrough.py`` (CPU ok).
+"""
+
+from __future__ import annotations
+
+import copy
+import io
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.observability.export import parse_export_line
+from torchmetrics_tpu.parallel import SyncAdvisor, sharded_update
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+
+    # ------------------------------------------------------------------ 1
+    banner("1. seed a per-process report with measured syncs")
+    obs.enable()
+    obs.tracing.start(capacity=1024)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    spec = NamedSharding(mesh, P("data"))
+    m = MulticlassAccuracy(num_classes=10, average="micro")
+    for _ in range(4):
+        sp = jax.device_put(jnp.asarray(rng.integers(0, 10, 64)), spec)
+        st = jax.device_put(jnp.asarray(rng.integers(0, 10, 64)), spec)
+        sharded_update(m, sp, st, mesh=mesh, axis_name="data")
+    local = obs.report()
+    digest = obs.fleet.sync_wait_digest(local)
+    print(f"this process: index={local['process']['index']} "
+          f"count={local['process']['count']}")
+    print(f"sync-wait digest: {digest['count']} measured windows, "
+          f"{digest['total_us']:.1f} us total (source={digest['source']})")
+
+    # ------------------------------------------------------------------ 2
+    banner("2. single-process identity: fleet_report == report")
+    same = json.dumps(obs.fleet_report(), sort_keys=True, default=str) == \
+        json.dumps(obs.report(), sort_keys=True, default=str)
+    print(f"fleet_report() byte-identical to report(): {same}")
+
+    # ------------------------------------------------------------------ 3
+    banner("3. a mocked 4-process fleet: merge + straggler attribution")
+    reports = []
+    for i in range(4):
+        r = copy.deepcopy(local)
+        r["process"] = {"index": i, "count": 4}
+        if i == 2:  # host 2 is sick: triple its measured wait
+            row = r["metrics"]["_process"]["spans"]["sync_wait"]
+            row["total_us"] *= 3.0
+            row["max_us"] *= 3.0
+        reports.append(r)
+    view = obs.FleetView(reports)  # on a real pod: obs.FleetView.gather()
+    merged = view.report()
+    syncs = merged["global"]["counters"]["syncs"]
+    print(f"merged syncs counter: {syncs} "
+          f"(= 4 x {local['global']['counters']['syncs']})")
+    skew = view.skew()
+    print(f"straggler: process {skew['straggler']['process']} — "
+          f"wait skew ratio {skew['sync_wait_us']['skew_ratio']:.1f}x vs median "
+          f"(bytes skew {skew['sync_bytes']['skew_ratio']:.1f}x)")
+
+    # ------------------------------------------------------------------ 4
+    banner("4. SyncAdvisor folds fleet skew into its recommendation")
+    advisor = SyncAdvisor(
+        MulticlassAccuracy(num_classes=10, average="micro"),
+        mesh=mesh, candidates=(1, 2, 4),
+    )
+    advisor.profile(sp, st, steps=8, rounds=1)
+    rec = advisor.recommend(fleet=view)
+    print(f"every_n={rec['every_n']} (measured cut {rec['measured_cut']:.2f}x)")
+    print("fleet note:", rec["fleet"]["note"])
+
+    # ------------------------------------------------------------------ 5
+    banner("5. health monitors: a drift cliff pages exactly once")
+    alerts_log = io.StringIO()
+    mon = obs.HealthMonitor(sinks=[obs.JSONLAlertSink(stream=alerts_log)])
+    mon.watch("val/accuracy",
+              obs.BoundRule(min_value=0.0, max_value=1.0),
+              obs.DriftRule(z_threshold=4.0, alpha=0.1, warmup=10),
+              obs.NonFiniteRule(),
+              obs.StalenessRule(50))
+    stream = [0.90 + 0.002 * (i % 5) for i in range(20)] + [0.12]  # the cliff
+    for step, value in enumerate(stream):
+        mon.observe("val/accuracy", value, step=step)
+        mon.advance(step)
+    for line in alerts_log.getvalue().splitlines():
+        alert = parse_export_line(line)
+        print(f"  [{alert['severity']}] step {alert['step']}: {alert['message']}")
+    print("alert counts:", mon.alert_counts)
+
+    # ------------------------------------------------------------------ 6
+    banner("6. merge-ready exports")
+    prom = obs.export(merged, fmt="prometheus")
+    sample = next(ln for ln in prom.splitlines()
+                  if ln.startswith("tm_tpu_updates_total{"))
+    print("prometheus (merged):", sample)
+    jsonl = obs.export(local, fmt="jsonl", stream=io.StringIO())
+    print("jsonl process stamp:", json.loads(jsonl)["process"])
+    trace = json.loads(obs.export(fmt="chrome"))
+    metas = [ev for ev in trace["traceEvents"] if ev["ph"] == "M"]
+    print(f"chrome trace: pid={trace['otherData']['process_index']} on every "
+          f"event, {len(metas)} metadata label events — concatenate "
+          "traceEvents from every host for one Perfetto pod timeline")
+    obs.tracing.stop()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,69 @@ def test_armed_recorder_adds_zero_cache_entries():
     np.testing.assert_array_equal(result_on, result_off)
 
 
+def test_armed_health_monitor_keeps_jaxprs_bit_identical():
+    """A watching health monitor consumes host floats after the fact; arming
+    one (with telemetry on, mid-stream state trained) must leave every traced
+    graph bit-identical and add zero cache entries."""
+    from torchmetrics_tpu.core.compile import audit_step_fn
+    from torchmetrics_tpu.observability.health import (
+        BoundRule,
+        DriftRule,
+        HealthMonitor,
+        NonFiniteRule,
+        StalenessRule,
+    )
+
+    m = MulticlassAccuracy(num_classes=5)
+    step = audit_step_fn(m, "update")
+    state = m.init_state()
+    obs.disable()
+    baseline = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    result_off, traces_off, by_off = _jit_flow()
+
+    mon = HealthMonitor()
+    mon.watch(
+        "acc",
+        BoundRule(min_value=0.0, max_value=1.0),
+        DriftRule(warmup=2),
+        NonFiniteRule(),
+        StalenessRule(5),
+    )
+    obs.enable()
+    armed = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    clear_compile_cache()
+    m2 = MulticlassAccuracy(num_classes=5, jit=True)
+    for step_idx in range(3):
+        m2.update(PREDS, TARGET)
+        mon.observe("acc", float(m2.compute()), step=step_idx)
+        mon.advance(step_idx)
+    result_on = np.asarray(m2.compute())
+    stats = cache_stats()
+    traces_on, by_on = stats["traces"], stats["by_entrypoint"]
+
+    assert armed == baseline
+    assert traces_on == traces_off
+    assert by_on == by_off
+    np.testing.assert_array_equal(result_on, result_off)
+
+
+def test_fleet_gather_adds_zero_cache_entries():
+    """Single-process fleet_report (the always-on path) must not trace or
+    compile anything through the metric cache."""
+    from torchmetrics_tpu.observability.fleet import fleet_report
+
+    obs.disable()
+    result_off, traces_off, by_off = _jit_flow()
+    obs.enable()
+    result_on, traces_on, by_on = _jit_flow()
+    before = cache_stats()
+    fleet_report()
+    after = cache_stats()
+    assert after["traces"] == before["traces"] == traces_off
+    assert after["by_entrypoint"] == by_on == by_off
+    np.testing.assert_array_equal(result_on, result_off)
+
+
 def test_disabled_records_nothing():
     assert not obs.enabled()
     m = MulticlassAccuracy(num_classes=5, jit=True)

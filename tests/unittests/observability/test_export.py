@@ -68,6 +68,18 @@ def test_jsonl_carries_schema_version():
     assert json.loads(line)["schema_version"] == SCHEMA_VERSION
 
 
+def test_jsonl_carries_process_identity():
+    """Fleet merge: every JSONL line self-describes its producer process so
+    per-host logs concatenate without losing attribution."""
+    report = _activity()
+    line = export(report, fmt="jsonl", stream=io.StringIO())
+    assert json.loads(line)["process"] == {"index": 0, "count": 1}
+    # payloads that already carry one (e.g. a merged fleet report) win
+    stamped = export({"schema": 1, "process": {"index": 7, "count": 8}},
+                     fmt="jsonl", stream=io.StringIO())
+    assert json.loads(stamped)["process"] == {"index": 7, "count": 8}
+
+
 # -------------------------------------------------- versioned parse-back contract
 def test_parse_export_line_roundtrip():
     report = _activity()
@@ -157,6 +169,17 @@ def test_prometheus_exposition_lints():
         assert values == sorted(values), f"non-cumulative buckets in {key}"
         assert series[-1][0] == "+Inf"
         assert counts[key] == series[-1][1]
+
+
+def test_prometheus_every_family_carries_process_label():
+    """Host-blindness fix: a scraper federating several hosts must be able to
+    tell the samples apart, so every family labels its producer process."""
+    report = _activity()
+    text = export(report, fmt="prometheus")
+    for ln in text.splitlines():
+        if ln.startswith("#"):
+            continue
+        assert 'process="0"' in ln, f"sample missing process label: {ln!r}"
 
 
 def test_prometheus_label_escaping():

@@ -1,0 +1,266 @@
+"""Fleet telemetry plane: mocked multi-process aggregation through the
+injected allgather seam, single-process identity, per-replica skew and
+straggler attribution, and the SyncAdvisor fleet feed."""
+
+import copy
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.observability import registry
+from torchmetrics_tpu.observability.fleet import (
+    FleetView,
+    fleet_report,
+    gather_reports,
+    sync_wait_digest,
+)
+from torchmetrics_tpu.parallel import SyncAdvisor, sharded_update
+
+pytestmark = pytest.mark.fleet
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+def _batch(rng, n=16):
+    return (
+        jnp.asarray(rng.integers(0, 5, (n,))),
+        jnp.asarray(rng.integers(0, 5, (n,))),
+    )
+
+
+def _local_activity(mesh):
+    """Enable telemetry and run enough work to fill counters, spans, cache
+    stats, and the measured sync-wait digest."""
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(0)
+    sharded_update(m, *_batch(rng), mesh=mesh)
+    m2 = MulticlassAccuracy(num_classes=5, jit=True)
+    m2.update(PREDS, TARGET)
+    m2.compute()
+    return obs.report()
+
+
+def _mock_fleet(base, n=4, straggler=2, wait_factor=5.0):
+    """N per-process reports cloned from ``base``: each self-describes its
+    index; the straggler's sync-wait digest is inflated by ``wait_factor``."""
+    reports = []
+    for i in range(n):
+        r = copy.deepcopy(base)
+        r["process"] = {"index": i, "count": n}
+        if i == straggler:
+            digest = r["metrics"]["_process"]["spans"]["sync_wait"]
+            digest["total_us"] *= wait_factor
+            digest["max_us"] *= wait_factor
+        reports.append(r)
+    return reports
+
+
+# ---------------------------------------------------- single-process identity
+def test_single_process_fleet_report_is_byte_identical(mesh):
+    """The acceptance criterion: with one process, fleet_report IS the local
+    report — byte-for-byte on the wire."""
+    _local_activity(mesh)
+    a = json.dumps(fleet_report(), sort_keys=True, default=str)
+    b = json.dumps(registry.report(), sort_keys=True, default=str)
+    assert a == b
+
+
+def test_single_process_gather_is_local_list():
+    obs.enable()
+    MulticlassAccuracy(num_classes=5).update(PREDS, TARGET)
+    rep = registry.report()
+    (only,) = gather_reports(rep, n_processes=1)
+    assert only == dict(rep)
+
+
+def test_process_identity_in_report():
+    rep = registry.report()
+    assert rep["process"] == {"index": 0, "count": 1}
+
+
+# -------------------------------------------------- mocked 4-process gathering
+def test_gather_reports_through_injected_allgather(mesh):
+    """Mirror test_coalesce's injected-allgather pattern: the fake returns
+    the stacked per-process rows (lengths first, padded payloads second) and
+    gather_reports decodes every process's report exactly."""
+    base = _local_activity(mesh)
+    reports = _mock_fleet(base, n=4)
+    payloads = [
+        np.frombuffer(json.dumps(r, sort_keys=True, default=str).encode(), dtype=np.uint8)
+        for r in reports
+    ]
+    calls = []
+
+    def fake_allgather(x):
+        arr = np.asarray(x)
+        calls.append((arr.dtype.kind, arr.shape))
+        if arr.dtype == np.int32:  # first collective: the payload lengths
+            return np.stack([np.asarray([p.size], np.int32) for p in payloads])
+        width = max(max(p.size for p in payloads), arr.size)
+        rows = np.zeros((4, width), np.uint8)
+        for i, p in enumerate(payloads):
+            rows[i, : p.size] = p
+        return rows
+
+    got = gather_reports(reports[0], n_processes=4, allgather=fake_allgather)
+    assert len(calls) == 2  # one lengths gather + one payload gather
+    assert [r["process"]["index"] for r in got] == [0, 1, 2, 3]
+    assert got == reports
+
+
+def test_fleet_counters_sum_exactly(mesh):
+    """Every counter of every row sums across processes — no sampling, no
+    averaging, no drops."""
+    base = _local_activity(mesh)
+    view = FleetView(_mock_fleet(base, n=4))
+    merged = view.report()
+    for label, row in base["metrics"].items():
+        for name, val in row["counters"].items():
+            assert merged["metrics"][label]["counters"][name] == 4 * val, (label, name)
+    for name, val in base["global"]["counters"].items():
+        assert merged["global"]["counters"][name] == 4 * val, name
+    # compile-cache stats sum too, including the per-entrypoint breakdown
+    assert merged["compile_cache"]["traces"] == 4 * base["compile_cache"]["traces"]
+    for kind, slot in base["compile_cache"]["by_entrypoint"].items():
+        for field, n in slot.items():
+            assert merged["compile_cache"]["by_entrypoint"][kind][field] == 4 * n
+
+
+def test_fleet_histograms_merge_elementwise(mesh):
+    """SpanStats histograms share fixed bucket edges, so the merge is an
+    exact per-bucket sum (and count/total follow)."""
+    base = _local_activity(mesh)
+    view = FleetView(_mock_fleet(base, n=3, wait_factor=1.0))
+    merged = view.report()
+    for label, row in base["metrics"].items():
+        for sname, s in row["spans"].items():
+            ms = merged["metrics"][label]["spans"][sname]
+            assert ms["count"] == 3 * s["count"]
+            assert ms["total_us"] == pytest.approx(3 * s["total_us"])
+            got = {edge if edge is None else float(edge): n for edge, n in ms["buckets"]}
+            for edge, n in s["buckets"]:
+                key = edge if edge is None else float(edge)
+                assert got[key] == 3 * n, (label, sname, edge)
+
+
+def test_fleet_retains_per_process_breakdown(mesh):
+    base = _local_activity(mesh)
+    reports = _mock_fleet(base, n=4)
+    merged = FleetView(reports).report()
+    assert set(merged["per_process"]) == {"0", "1", "2", "3"}
+    assert merged["per_process"]["1"] == reports[1]
+    assert merged["fleet"]["n_processes"] == 4
+    # a merged exposition self-describes as such (exporters label it "fleet")
+    assert merged["process"]["index"] is None
+    assert merged["process"]["count"] == 4
+
+
+# ------------------------------------------------- skew / straggler attribution
+def test_straggler_attribution_names_slowest_process(mesh):
+    base = _local_activity(mesh)
+    view = FleetView(_mock_fleet(base, n=4, straggler=2, wait_factor=5.0))
+    skew = view.skew()
+    assert skew["straggler"]["process"] == 2
+    assert view.straggler() == 2
+    assert skew["sync_wait_us"]["max_process"] == 2
+    assert skew["sync_wait_us"]["skew_ratio"] == pytest.approx(5.0)
+    assert skew["straggler"]["vs_median"] == pytest.approx(5.0)
+    assert skew["straggler"]["source"] == "sync_wait"
+    # the other axes are flat in this mock
+    assert skew["sync_bytes"]["skew_ratio"] == pytest.approx(1.0)
+    assert skew["retraces"]["skew_ratio"] == pytest.approx(1.0)
+
+
+def test_sync_wait_digest_prefers_process_row(mesh):
+    rep = _local_activity(mesh)
+    digest = sync_wait_digest(rep)
+    assert digest["source"] == "sync_wait"
+    assert digest["count"] >= 1 and digest["total_us"] > 0.0
+    # measured window and digest agree: same spans, same totals
+    row = rep["metrics"]["_process"]["spans"]["sync_wait"]
+    assert digest["total_us"] == pytest.approx(row["total_us"])
+
+
+def test_sync_wait_digest_falls_back_to_sync_spans(mesh):
+    """Reports predating the _process digest (or with it stripped) still
+    rank by the per-metric sync spans."""
+    rep = _local_activity(mesh)
+    legacy = copy.deepcopy(rep)
+    del legacy["metrics"]["_process"]
+    digest = sync_wait_digest(legacy)
+    assert digest["source"] == "sync"
+    assert digest["count"] >= 1 and digest["total_us"] > 0.0
+
+
+def test_process_wait_digest_counts_measured_windows(mesh):
+    """Every measured sync (sharded_update under telemetry) lands exactly
+    one window in the process-wide digest."""
+    obs.enable()
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(1)
+    for _ in range(3):
+        sharded_update(m, *_batch(rng), mesh=mesh)
+    row = registry.report()["metrics"]["_process"]
+    assert row["spans"]["sync_wait"]["count"] == 3
+    # spans only: the synthetic row must not double-count any event counter
+    assert not any(row["counters"].values())
+
+
+def test_record_sync_wait_dark_when_disabled():
+    assert not obs.enabled()
+    registry.record_sync_wait(0.5)
+    obs.enable()
+    assert "_process" not in registry.report()["metrics"]
+
+
+# ------------------------------------------------------------ advisor fleet feed
+def test_sync_advisor_folds_fleet_skew(mesh):
+    base = _local_activity(mesh)
+    view = FleetView(_mock_fleet(base, n=4, straggler=3, wait_factor=4.0))
+    advisor = SyncAdvisor(
+        MulticlassAccuracy(num_classes=5, average="micro"), mesh=mesh, candidates=(1, 2)
+    )
+    rng = np.random.default_rng(2)
+    advisor.profile(*_batch(rng), steps=4, rounds=1)
+    rec = advisor.recommend(fleet=view)
+    assert rec["fleet"]["straggler"] == 3
+    assert rec["fleet"]["wait_skew_ratio"] == pytest.approx(4.0)
+    assert "investigate that host" in rec["fleet"]["note"]
+    # an already-built skew dict works too (no FleetView required)
+    rec2 = advisor.recommend(fleet=view.skew())
+    assert rec2["fleet"]["straggler"] == 3
+    # and without fleet context the recommendation shape is unchanged
+    assert "fleet" not in advisor.recommend()
+
+
+def test_sync_advisor_balanced_fleet_note(mesh):
+    base = _local_activity(mesh)
+    view = FleetView(_mock_fleet(base, n=4, wait_factor=1.0))
+    advisor = SyncAdvisor(
+        MulticlassAccuracy(num_classes=5, average="micro"), mesh=mesh, candidates=(1, 2)
+    )
+    rng = np.random.default_rng(3)
+    advisor.profile(*_batch(rng), steps=4, rounds=1)
+    rec = advisor.recommend(fleet=view)
+    assert rec["fleet"]["wait_skew_ratio"] == pytest.approx(1.0)
+    assert "balanced" in rec["fleet"]["note"]
+
+
+# ------------------------------------------------------------------ validation
+def test_fleet_view_rejects_empty():
+    with pytest.raises(ValueError, match="at least one"):
+        FleetView([])
+
+
+def test_fleet_merged_report_exports_with_fleet_process_label(mesh):
+    base = _local_activity(mesh)
+    merged = FleetView(_mock_fleet(base, n=2)).report()
+    text = obs.export(merged, fmt="prometheus")
+    assert 'process="fleet"' in text
+    assert 'process="0"' not in text

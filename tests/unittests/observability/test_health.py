@@ -1,0 +1,309 @@
+"""Streaming metric-health monitors: deterministic step-indexed rules,
+severity routing through the sinks, and the export front door."""
+
+import io
+import json
+import logging
+import math
+
+import pytest
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.observability import health
+from torchmetrics_tpu.observability.export import SCHEMA_VERSION, parse_export_line
+from torchmetrics_tpu.observability.health import (
+    Alert,
+    BoundRule,
+    CallbackAlertSink,
+    DriftRule,
+    HealthMonitor,
+    JSONLAlertSink,
+    LoggingAlertSink,
+    NonFiniteRule,
+    StalenessRule,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def _drift_stream():
+    """A stable stream around 0.9 followed by a cliff to 0.1."""
+    return [0.9, 0.91, 0.89, 0.9, 0.9, 0.91, 0.9, 0.89, 0.9, 0.9, 0.9, 0.91, 0.1]
+
+
+# -------------------------------------------------------------------- rules
+def test_bound_rule_fires_on_escape():
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(min_value=0.0, max_value=1.0))
+    assert mon.observe("acc", 0.5, step=0) == []
+    assert mon.observe("acc", 1.0, step=1) == []  # inclusive bounds
+    (alert,) = mon.observe("acc", 1.5, step=2)
+    assert alert.rule == "bound" and alert.severity == "critical"
+    assert alert.step == 2 and alert.value == 1.5
+    (alert,) = mon.observe("acc", -0.1, step=3)
+    assert "below min" in alert.message
+
+
+def test_bound_rule_ignores_nonfinite():
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(min_value=0.0, max_value=1.0))
+    assert mon.observe("acc", float("nan"), step=0) == []
+
+
+def test_bound_rule_validates():
+    with pytest.raises(ValueError, match="min_value and/or max_value"):
+        BoundRule()
+    with pytest.raises(ValueError, match="min_value"):
+        BoundRule(min_value=1.0, max_value=0.0)
+
+
+def test_drift_rule_flags_cliff_after_warmup():
+    mon = HealthMonitor()
+    mon.watch("acc", DriftRule(z_threshold=4.0, alpha=0.1, warmup=10))
+    raised = []
+    for step, v in enumerate(_drift_stream()):
+        raised.extend(mon.observe("acc", v, step=step))
+    (alert,) = raised
+    assert alert.rule == "drift" and alert.severity == "warning"
+    assert alert.step == len(_drift_stream()) - 1  # the cliff, not the warmup
+    assert abs(alert.details["z"]) >= 4.0
+
+
+def test_drift_rule_quiet_during_warmup():
+    mon = HealthMonitor()
+    mon.watch("acc", DriftRule(warmup=10))
+    # wild swings inside the warmup window train, never alert
+    for step, v in enumerate([0.1, 0.9, 0.2, 0.8, 0.3]):
+        assert mon.observe("acc", v, step=step) == []
+
+
+def test_drift_rule_state_is_per_series():
+    rule = DriftRule(z_threshold=4.0, alpha=0.1, warmup=10)
+    mon = HealthMonitor()
+    mon.watch("a", rule)
+    mon.watch("b", rule)
+    for step, v in enumerate(_drift_stream()[:-1]):
+        mon.observe("a", v, step=step)
+        mon.observe("b", 0.5, step=step)  # flat stream on b
+    assert mon.observe("a", 0.1, step=99)  # a drifts
+    assert mon.observe("b", 0.5, step=99) == []  # b does not
+
+
+def test_nonfinite_rule_counts_rate():
+    mon = HealthMonitor()
+    mon.watch("loss", NonFiniteRule())
+    assert mon.observe("loss", 1.0, step=0) == []
+    (alert,) = mon.observe("loss", float("nan"), step=1)
+    assert alert.rule == "nonfinite" and alert.severity == "critical"
+    assert alert.details == {"nonfinite": 1, "total": 2, "rate": 0.5}
+    (alert,) = mon.observe("loss", float("inf"), step=2)
+    assert alert.details["nonfinite"] == 2
+
+
+def test_nonfinite_rule_tolerates_rate_budget():
+    mon = HealthMonitor()
+    mon.watch("loss", NonFiniteRule(max_rate=0.5))
+    for step in range(9):
+        assert mon.observe("loss", 1.0, step=step) == []
+    # 1/10 non-finite: under the 0.5 budget, no alert
+    assert mon.observe("loss", float("nan"), step=9) == []
+
+
+def test_staleness_fires_once_per_episode():
+    mon = HealthMonitor()
+    mon.watch("acc", StalenessRule(max_stale_steps=3))
+    mon.observe("acc", 0.5, step=0)
+    assert mon.advance(3) == []  # exactly at the limit: still fresh
+    (alert,) = mon.advance(4)
+    assert alert.rule == "staleness" and alert.value is None
+    assert alert.details == {"stale_steps": 4, "last_step": 0}
+    assert mon.advance(5) == []  # latched: one page per episode
+    assert mon.advance(50) == []
+    mon.observe("acc", 0.6, step=51)  # observation clears the latch
+    (alert,) = mon.advance(60)
+    assert alert.details["last_step"] == 51
+
+
+def test_staleness_never_observed_measures_from_first_sweep():
+    mon = HealthMonitor()
+    mon.watch("acc", StalenessRule(max_stale_steps=2))
+    assert mon.advance(100) == []  # baseline, not an instant page
+    assert mon.advance(102) == []
+    (alert,) = mon.advance(103)
+    assert alert.details["last_step"] == 100
+
+
+def test_determinism_same_stream_same_alerts():
+    def run():
+        mon = HealthMonitor()
+        mon.watch(
+            "acc",
+            BoundRule(min_value=0.0, max_value=1.0),
+            DriftRule(z_threshold=4.0, warmup=10),
+            NonFiniteRule(),
+            StalenessRule(5),
+        )
+        for step, v in enumerate(_drift_stream() + [float("nan"), 1.7]):
+            mon.observe("acc", v, step=step)
+            mon.advance(step)
+        mon.advance(40)
+        return [a.as_dict() for a in mon.alerts()]
+
+    assert run() == run()
+    assert len(run()) == 4  # drift + nonfinite + bound + staleness
+
+
+# -------------------------------------------------------------------- sinks
+def test_min_severity_filters_per_sink():
+    everything, pages = [], []
+    mon = HealthMonitor(
+        sinks=[
+            CallbackAlertSink(everything.append),
+            CallbackAlertSink(pages.append, min_severity="critical"),
+        ]
+    )
+    mon.watch("acc", BoundRule(max_value=1.0), StalenessRule(1))
+    mon.observe("acc", 2.0, step=0)  # critical
+    mon.advance(5)  # warning
+    assert [a.severity for a in everything] == ["critical", "warning"]
+    assert [a.severity for a in pages] == ["critical"]
+
+
+def test_logging_sink_maps_severity_to_level(caplog):
+    mon = HealthMonitor(sinks=[LoggingAlertSink()])
+    mon.watch("acc", BoundRule(max_value=1.0), StalenessRule(1, severity="warning"))
+    with caplog.at_level(logging.INFO, logger="torchmetrics_tpu.observability"):
+        mon.observe("acc", 2.0, step=0)
+        mon.advance(5)
+    levels = [r.levelno for r in caplog.records]
+    assert levels == [logging.ERROR, logging.WARNING]
+    assert caplog.records[0].health_alert["rule"] == "bound"
+
+
+def test_jsonl_sink_lines_parse_through_front_door():
+    buf = io.StringIO()
+    mon = HealthMonitor(sinks=[JSONLAlertSink(stream=buf)])
+    mon.watch("loss", NonFiniteRule())
+    mon.observe("loss", float("nan"), step=7)
+    (line,) = buf.getvalue().splitlines()
+    parsed = parse_export_line(line)
+    assert parsed["kind"] == "health_alert"
+    assert parsed["schema_version"] == SCHEMA_VERSION
+    assert parsed["process"] == {"index": 0, "count": 1}
+    assert parsed["series"] == "loss" and parsed["step"] == 7
+    assert parsed["value"] == "nan"  # strict JSON: non-finite floats stringify
+
+
+def test_broken_sink_does_not_break_the_step_loop():
+    def boom(alert):
+        raise RuntimeError("pager down")
+
+    seen = []
+    mon = HealthMonitor(sinks=[CallbackAlertSink(boom), CallbackAlertSink(seen.append)])
+    mon.watch("acc", BoundRule(max_value=1.0))
+    (alert,) = mon.observe("acc", 2.0, step=0)
+    assert alert.rule == "bound"
+    assert len(seen) == 1  # later sinks still ran
+
+
+# ------------------------------------------------------------------ monitor
+def test_alert_ring_bounds_memory():
+    mon = HealthMonitor(max_alerts=4)
+    mon.watch("acc", BoundRule(max_value=1.0))
+    for step in range(10):
+        mon.observe("acc", 2.0, step=step)
+    assert len(mon.alerts()) == 4
+    assert [a.step for a in mon.alerts()] == [6, 7, 8, 9]
+    rep = mon.report()
+    assert rep["health"]["alerts_total"] == 10
+    assert rep["health"]["alerts_dropped"] == 6
+
+
+def test_alerts_filter_by_severity():
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(max_value=1.0), StalenessRule(1))
+    mon.observe("acc", 2.0, step=0)
+    mon.advance(5)
+    assert [a.rule for a in mon.alerts("critical")] == ["bound"]
+    assert [a.rule for a in mon.alerts("warning")] == ["staleness"]
+    assert mon.alert_counts == {"info": 0, "warning": 1, "critical": 1}
+    with pytest.raises(ValueError, match="severity"):
+        mon.alerts("loud")
+
+
+def test_report_structure():
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(min_value=0.0, max_value=1.0), DriftRule())
+    mon.watch("loss", NonFiniteRule())
+    mon.observe("acc", 0.5, step=3)
+    rep = mon.report()
+    assert rep["kind"] == "health" and rep["schema"] == 1 and rep["step"] == 3
+    acc = rep["health"]["series"]["acc"]
+    assert acc == {
+        "last_value": 0.5,
+        "last_step": 3,
+        "observations": 1,
+        "rules": ["bound", "drift"],
+        "alerts": {"info": 0, "warning": 0, "critical": 0},
+    }
+    assert rep["health"]["series"]["loss"]["observations"] == 0
+    json.dumps(rep)  # strict-JSON clean even before any alert
+
+
+def test_export_front_door_jsonl():
+    buf = io.StringIO()
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(max_value=1.0))
+    mon.observe("acc", 2.0, step=0)
+    mon.export(fmt="jsonl", stream=buf)
+    parsed = parse_export_line(buf.getvalue().splitlines()[0])
+    assert parsed["kind"] == "health"
+    assert parsed["health"]["alerts"]["critical"] == 1
+    assert parsed["health"]["recent"][0]["rule"] == "bound"
+
+
+def test_export_front_door_prometheus():
+    mon = HealthMonitor()
+    mon.watch("acc", BoundRule(max_value=1.0))
+    mon.watch("loss", NonFiniteRule())
+    mon.observe("acc", 2.0, step=0)
+    mon.observe("loss", float("nan"), step=0)
+    text = mon.export(fmt="prometheus")
+    assert (
+        'tm_tpu_health_alerts_total{series="acc",severity="critical",process="0"} 1'
+        in text
+    )
+    assert 'tm_tpu_health_observations_total{series="acc",process="0"} 1' in text
+    assert 'tm_tpu_health_last_value{series="acc",process="0"} 2.0' in text
+    # loss's last value is non-finite → stringified → gauge skipped, not emitted
+    assert 'tm_tpu_health_last_value{series="loss"' not in text
+    assert obs.export(mon.report(), fmt="prometheus") == text
+
+
+def test_nonfinite_values_json_safe_everywhere():
+    alert = Alert("s", "r", "info", 0, float("inf"), "m", {"z": float("nan"), "k": 1})
+    d = alert.as_dict()
+    assert d["value"] == "inf" and d["details"]["z"] == "nan" and d["details"]["k"] == 1
+    json.dumps(d)
+
+
+def test_monitor_validates():
+    with pytest.raises(ValueError, match="max_alerts"):
+        HealthMonitor(max_alerts=0)
+    with pytest.raises(ValueError, match="at least one rule"):
+        HealthMonitor().watch("acc")
+    with pytest.raises(ValueError, match="severity"):
+        Alert("s", "r", "loud", 0, 1.0, "m")
+    with pytest.raises(ValueError, match="alpha"):
+        DriftRule(alpha=0.0)
+    with pytest.raises(ValueError, match="z_threshold"):
+        DriftRule(z_threshold=-1.0)
+    with pytest.raises(ValueError, match="max_rate"):
+        NonFiniteRule(max_rate=1.0)
+    with pytest.raises(ValueError, match="max_stale_steps"):
+        StalenessRule(0)
+
+
+def test_health_names_reexported_from_package():
+    for name in health.__all__:
+        assert getattr(obs, name) is getattr(health, name)

@@ -149,24 +149,37 @@ def test_compile_cold_start_events_carry_cause(mesh):
 
 # --------------------------------------------------------- chrome trace schema
 def _validate_chrome(payload):
+    """Validate the payload; returns the *real* events (metadata "M" events —
+    process_name/thread_name labels for the fleet merge — are validated here
+    but not returned, so emptiness assertions see an empty timeline)."""
     assert set(payload) == {"traceEvents", "displayTimeUnit", "otherData"}
     assert payload["displayTimeUnit"] == "ms"
     meta = payload["otherData"]
     assert meta["schema_version"] == SCHEMA_VERSION
     assert meta["producer"] == "torchmetrics_tpu.observability.tracing"
     assert isinstance(meta["capacity"], int) and isinstance(meta["dropped"], int)
+    assert isinstance(meta["process_index"], int)
+    real = []
     for ev in payload["traceEvents"]:
+        assert isinstance(ev["pid"], int)
+        assert ev["pid"] == meta["process_index"]  # one pid per host recording
+        if ev["ph"] == "M":
+            assert ev["name"] in ("process_name", "thread_name")
+            assert isinstance(ev["args"]["name"], str) and ev["args"]["name"]
+            if ev["name"] == "thread_name":
+                assert ev["args"]["name"] == ev["tid"]
+            continue
         assert ev["ph"] in ("X", "i")
         assert isinstance(ev["name"], str) and ev["name"]
         assert ev["cat"] in CATEGORIES
         assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
-        assert isinstance(ev["pid"], int)
         assert isinstance(ev["tid"], str)
         if ev["ph"] == "X":
             assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
         else:
             assert ev["s"] == "t"
-    return payload["traceEvents"]
+        real.append(ev)
+    return real
 
 
 def test_chrome_trace_schema_roundtrip():
@@ -185,6 +198,39 @@ def test_chrome_trace_schema_roundtrip():
 def test_chrome_trace_empty_when_disarmed():
     payload = tracing.chrome_trace()
     assert _validate_chrome(payload) == []
+
+
+def test_chrome_trace_pid_is_process_index_not_os_pid():
+    """Fleet merge: pid must be the stable jax process_index (0 here), never
+    the OS pid, so per-host recordings concatenate into one Perfetto timeline."""
+    import os
+
+    obs.enable()
+    tracing.start(capacity=64)
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    payload = tracing.chrome_trace()
+    assert payload["otherData"]["process_index"] == 0
+    pids = {ev["pid"] for ev in payload["traceEvents"]}
+    assert pids == {0}
+    assert os.getpid() not in pids
+
+
+def test_chrome_trace_metadata_names_process_and_threads():
+    obs.enable()
+    rec = tracing.start(capacity=64)
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    m.update(PREDS, TARGET)
+    m.compute()
+    payload = tracing.chrome_trace()
+    metas = [ev for ev in payload["traceEvents"] if ev["ph"] == "M"]
+    procs = [ev for ev in metas if ev["name"] == "process_name"]
+    assert len(procs) == 1
+    assert procs[0]["args"]["name"] == "torchmetrics_tpu process 0"
+    named_tids = {ev["tid"] for ev in metas if ev["name"] == "thread_name"}
+    assert named_tids == {e.tid for e in rec.events()}
+    # metadata rides first so viewers label rows before any real event lands
+    assert [ev["ph"] for ev in payload["traceEvents"][: len(metas)]] == ["M"] * len(metas)
 
 
 def test_export_front_door_chrome(tmp_path):

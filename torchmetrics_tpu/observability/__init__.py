@@ -23,12 +23,17 @@ Quick tour::
         ...
     window.diff["global"]["counters"]["updates"]
 
+    obs.fleet_report()                    # pod-global merged report (identity
+                                          # on one process; skew + straggler
+                                          # attribution on many)
+    obs.HealthMonitor(...)                # streaming metric-health alerting
+
 The disabled fast path is a no-op: no compile-cache observer is registered,
 recording helpers return after one flag check, and nothing here touches
 cache keys — so telemetry can never cause a retrace.
 """
 
-from torchmetrics_tpu.observability import tracing
+from torchmetrics_tpu.observability import fleet, health, tracing
 from torchmetrics_tpu.observability.export import (
     ChromeTraceExporter,
     Exporter,
@@ -39,6 +44,27 @@ from torchmetrics_tpu.observability.export import (
     TraceJSONLinesExporter,
     export,
     parse_export_line,
+)
+from torchmetrics_tpu.observability.fleet import (
+    FleetView,
+    fleet_report,
+    gather_reports,
+    process_count,
+    process_index,
+)
+from torchmetrics_tpu.observability.health import (
+    Alert,
+    AlertSink,
+    BoundRule,
+    CallbackAlertSink,
+    DriftRule,
+    HealthMonitor,
+    HealthRule,
+    JSONLAlertSink,
+    LoggingAlertSink,
+    NonFiniteRule,
+    SEVERITIES,
+    StalenessRule,
 )
 from torchmetrics_tpu.observability.tracing import FlightRecorder, TraceEvent
 from torchmetrics_tpu.observability.registry import (
@@ -58,17 +84,30 @@ from torchmetrics_tpu.observability.registry import (
 )
 
 __all__ = [
+    "Alert",
+    "AlertSink",
+    "BoundRule",
     "COUNTER_NAMES",
+    "CallbackAlertSink",
     "ChromeTraceExporter",
+    "DriftRule",
     "Exporter",
+    "FleetView",
     "FlightRecorder",
+    "HealthMonitor",
+    "HealthRule",
+    "JSONLAlertSink",
     "JSONLinesExporter",
+    "LoggingAlertSink",
     "LoggingExporter",
     "MetricTelemetry",
+    "NonFiniteRule",
     "ObservationWindow",
     "PrometheusExporter",
     "SCHEMA_VERSION",
+    "SEVERITIES",
     "SPAN_BUCKETS_US",
+    "StalenessRule",
     "TraceEvent",
     "TraceJSONLinesExporter",
     "aggregate_telemetry",
@@ -77,8 +116,14 @@ __all__ = [
     "enable",
     "enabled",
     "export",
+    "fleet",
+    "fleet_report",
+    "gather_reports",
+    "health",
     "observe",
     "parse_export_line",
+    "process_count",
+    "process_index",
     "report",
     "reset_telemetry",
     "telemetry_for",

@@ -47,8 +47,14 @@ __all__ = [
 #: ``otherData``).  Major 1 = the PR 3 report layout; 1.1 added the
 #: ``schema_version`` field itself and the flight-recorder trace export; 1.2
 #: added compressed-collective accounting (``sync_bytes_raw``, per-bucket
-#: ``model_raw_bytes`` / quantization-error fields / ``compression`` mode).
-SCHEMA_VERSION = "1.2.0"
+#: ``model_raw_bytes`` / quantization-error fields / ``compression`` mode);
+#: 1.3 added the fleet telemetry plane — process identity on every payload
+#: (``process`` on JSONL lines and report dicts, a ``process`` label on every
+#: Prometheus family, ``pid = jax.process_index()`` plus
+#: ``process_name``/``thread_name`` metadata events in Chrome traces), the
+#: merged fleet report (``fleet``/``per_process`` blocks), and health-monitor
+#: payloads (``health`` block, ``health_alert`` JSONL lines).
+SCHEMA_VERSION = "1.3.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -79,6 +85,26 @@ def parse_export_line(line: str) -> Dict[str, Any]:
     return payload
 
 _log = logging.getLogger("torchmetrics_tpu.observability")
+
+
+def _local_process() -> Dict[str, int]:
+    """This process's identity, stamped on payloads that lack one."""
+    from torchmetrics_tpu.observability.fleet import process_count, process_index
+
+    return {"index": process_index(), "count": process_count()}
+
+
+def _process_label(report: Mapping[str, Any]) -> str:
+    """The ``process`` label value for a report: its own ``process.index``
+    when the payload self-describes (``None`` marks a fleet merge), else the
+    local process index."""
+    proc = report.get("process") if isinstance(report, Mapping) else None
+    if isinstance(proc, Mapping):
+        idx = proc.get("index")
+        return "fleet" if idx is None else str(idx)
+    if isinstance(proc, int):
+        return str(proc)
+    return str(_local_process()["index"])
 
 #: one-line docs for the Prometheus ``# HELP`` strings
 _COUNTER_HELP = {
@@ -151,6 +177,8 @@ class JSONLinesExporter(Exporter):
     def export(self, report: Mapping[str, Any]) -> str:
         payload = dict(report)
         payload.setdefault("schema_version", SCHEMA_VERSION)
+        # every line names its producing process so multi-host logs merge
+        payload.setdefault("process", _local_process())
         line = json.dumps(payload, sort_keys=True, separators=(",", ":"), default=str)
         if self.stream is not None:
             self.stream.write(line + "\n")
@@ -222,6 +250,7 @@ class PrometheusExporter(Exporter):
 
     def export(self, report: Mapping[str, Any]) -> str:
         ns = self.namespace
+        proc = _process_label(report)
         out: List[str] = []
         rows = dict(report.get("metrics", {}))
 
@@ -232,7 +261,7 @@ class PrometheusExporter(Exporter):
             for label, row in sorted(rows.items()):
                 val = int(row.get("counters", {}).get(name, 0))
                 out.append(
-                    f"{metric_name}{_labels(metric=label, **{'class': row.get('class', '')})} {val}"
+                    f"{metric_name}{_labels(metric=label, process=proc, **{'class': row.get('class', '')})} {val}"
                 )
 
         cache_name = f"{ns}_compile_cache_events_total"
@@ -242,7 +271,7 @@ class PrometheusExporter(Exporter):
             for kind, slot in sorted(row.get("cache", {}).items()):
                 for event in ("hits", "misses", "traces"):
                     out.append(
-                        f"{cache_name}{_labels(metric=label, entrypoint=kind, event=event)} "
+                        f"{cache_name}{_labels(metric=label, entrypoint=kind, event=event, process=proc)} "
                         f"{int(slot.get(event, 0))}"
                     )
 
@@ -256,14 +285,14 @@ class PrometheusExporter(Exporter):
                     cum += int(n)
                     le = "+Inf" if edge_us is None else repr(edge_us / 1e6)
                     out.append(
-                        f"{span_name}_bucket{_labels(metric=label, span=sname, le=le)} {cum}"
+                        f"{span_name}_bucket{_labels(metric=label, span=sname, le=le, process=proc)} {cum}"
                     )
                 out.append(
-                    f"{span_name}_sum{_labels(metric=label, span=sname)} "
+                    f"{span_name}_sum{_labels(metric=label, span=sname, process=proc)} "
                     f"{repr(float(s.get('total_us', 0.0)) / 1e6)}"
                 )
                 out.append(
-                    f"{span_name}_count{_labels(metric=label, span=sname)} {int(s.get('count', 0))}"
+                    f"{span_name}_count{_labels(metric=label, span=sname, process=proc)} {int(s.get('count', 0))}"
                 )
 
         bsync_name = f"{ns}_sync_bucket_measured_seconds_total"
@@ -275,7 +304,7 @@ class PrometheusExporter(Exporter):
         for label, row in sorted(rows.items()):
             for key, b in sorted(row.get("sync_buckets", {}).items()):
                 out.append(
-                    f"{bsync_name}{_labels(metric=label, bucket=key)} "
+                    f"{bsync_name}{_labels(metric=label, bucket=key, process=proc)} "
                     f"{repr(float(b.get('measured_us', 0.0)) / 1e6)}"
                 )
         bbytes_name = f"{ns}_sync_bucket_model_bytes_total"
@@ -293,7 +322,7 @@ class PrometheusExporter(Exporter):
                     ("raw", "model_raw_bytes"),
                 ):
                     out.append(
-                        f"{bbytes_name}{_labels(metric=label, bucket=key, model=model)} "
+                        f"{bbytes_name}{_labels(metric=label, bucket=key, model=model, process=proc)} "
                         f"{int(b.get(field, 0))}"
                     )
         bcomp_name = f"{ns}_sync_bucket_compression_info"
@@ -305,7 +334,7 @@ class PrometheusExporter(Exporter):
         for label, row in sorted(rows.items()):
             for key, b in sorted(row.get("sync_buckets", {}).items()):
                 mode = str(b.get("compression", "none"))
-                out.append(f"{bcomp_name}{_labels(metric=label, bucket=key, mode=mode)} 1")
+                out.append(f"{bcomp_name}{_labels(metric=label, bucket=key, mode=mode, process=proc)} 1")
         qerr_name = f"{ns}_sync_bucket_quant_rel_err"
         out.append(
             f"# HELP {qerr_name} Measured quantization relative error per compressed bucket "
@@ -317,11 +346,11 @@ class PrometheusExporter(Exporter):
                 if not int(b.get("quant_err_count", 0)):
                     continue
                 out.append(
-                    f"{qerr_name}_sum{_labels(metric=label, bucket=key)} "
+                    f"{qerr_name}_sum{_labels(metric=label, bucket=key, process=proc)} "
                     f"{repr(float(b.get('quant_rel_err_sum', 0.0)))}"
                 )
                 out.append(
-                    f"{qerr_name}_count{_labels(metric=label, bucket=key)} "
+                    f"{qerr_name}_count{_labels(metric=label, bucket=key, process=proc)} "
                     f"{int(b.get('quant_err_count', 0))}"
                 )
         bres_name = f"{ns}_sync_bucket_residual_bytes"
@@ -333,7 +362,7 @@ class PrometheusExporter(Exporter):
         for label, row in sorted(rows.items()):
             for key, b in sorted(row.get("sync_buckets", {}).items()):
                 out.append(
-                    f"{bres_name}{_labels(metric=label, bucket=key)} "
+                    f"{bres_name}{_labels(metric=label, bucket=key, process=proc)} "
                     f"{int(b.get('residual_bytes', 0))}"
                 )
 
@@ -343,7 +372,7 @@ class PrometheusExporter(Exporter):
         out.append(f"# TYPE {flat_name} counter")
         for event in ("hits", "misses", "traces", "evictions"):
             if event in cc:
-                out.append(f"{flat_name}{_labels(event=event)} {int(cc[event])}")
+                out.append(f"{flat_name}{_labels(event=event, process=proc)} {int(cc[event])}")
         by = cc.get("by_entrypoint", {})
         if by:
             ep_name = f"{ns}_compile_cache_entrypoint_total"
@@ -351,7 +380,39 @@ class PrometheusExporter(Exporter):
             out.append(f"# TYPE {ep_name} counter")
             for kind, slot in sorted(by.items()):
                 for event, val in sorted(slot.items()):
-                    out.append(f"{ep_name}{_labels(entrypoint=kind, event=event)} {int(val)}")
+                    out.append(f"{ep_name}{_labels(entrypoint=kind, event=event, process=proc)} {int(val)}")
+
+        # health-monitor payloads (observability/health.py reports) ride the
+        # same exposition: alert counters plus a last-value gauge per series
+        health = report.get("health")
+        if isinstance(health, Mapping):
+            h_series = health.get("series", {})
+            ha_name = f"{ns}_health_alerts_total"
+            out.append(f"# HELP {ha_name} Health-monitor alerts by series and severity.")
+            out.append(f"# TYPE {ha_name} counter")
+            for sname, row in sorted(h_series.items()):
+                for sev, n in sorted(row.get("alerts", {}).items()):
+                    out.append(
+                        f"{ha_name}{_labels(series=sname, severity=sev, process=proc)} {int(n)}"
+                    )
+            ho_name = f"{ns}_health_observations_total"
+            out.append(f"# HELP {ho_name} Health-monitor observations per series.")
+            out.append(f"# TYPE {ho_name} counter")
+            for sname, row in sorted(h_series.items()):
+                out.append(
+                    f"{ho_name}{_labels(series=sname, process=proc)} "
+                    f"{int(row.get('observations', 0))}"
+                )
+            hv_name = f"{ns}_health_last_value"
+            out.append(f"# HELP {hv_name} Last observed value per health series.")
+            out.append(f"# TYPE {hv_name} gauge")
+            for sname, row in sorted(h_series.items()):
+                val = row.get("last_value")
+                # non-finite values were stringified for JSON; skip them here
+                if isinstance(val, (int, float)) and not isinstance(val, bool):
+                    out.append(
+                        f"{hv_name}{_labels(series=sname, process=proc)} {repr(float(val))}"
+                    )
 
         text = "\n".join(out) + "\n"
         if self.path is not None:

@@ -1,0 +1,318 @@
+"""Fleet telemetry plane: cross-host aggregation of per-process registry
+snapshots.
+
+Every surface in ``observability/`` so far — the registry report, the flight
+recorder, the Prometheus/JSONL exporters — is strictly host-local.  On a
+multi-host pod that leaves an operator with one disjoint exposition per
+process and no answer to "which host is slow?".  This module closes the gap:
+
+* :func:`gather_reports` ships each process's :func:`registry.report`
+  snapshot across DCN (one allgather for the lengths, one for the padded
+  JSON payloads) and hands every process the full per-process list.
+* :class:`FleetView` merges those snapshots into one pod-global report:
+  counters sum exactly, the fixed-bucket :class:`registry.SpanStats`
+  histograms merge elementwise, compile-cache stats sum, and the
+  per-process originals are retained under ``per_process``.
+* :meth:`FleetView.skew` attributes per-replica imbalance: max/median/min of
+  the measured sync-wait digests (``record_sync_wait``), byte and retrace
+  skew, and the straggler process by name — the report
+  :class:`parallel.coalesce.SyncAdvisor` folds in via ``recommend(fleet=)``.
+
+Multi-host behavior is tier-1 testable on CPU through the same injectable
+``n_processes``/``allgather`` seam :func:`parallel.coalesce.coalesced_host_sync`
+uses; with one process everything collapses to the identity —
+:func:`fleet_report` returns the local :func:`registry.report` unchanged.
+
+Nothing here touches a traced graph: gathering runs eagerly at the host
+boundary on plain ``uint8`` payloads, so building a fleet view can never
+change a cache key or add a retrace.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from torchmetrics_tpu.observability.registry import aggregate_telemetry, report as _local_report
+
+__all__ = [
+    "FleetView",
+    "fleet_report",
+    "gather_reports",
+    "process_count",
+    "process_index",
+    "sync_wait_digest",
+]
+
+
+def process_index() -> int:
+    """``jax.process_index()``, or 0 when JAX/its backend is unavailable —
+    exports must stay usable from import-light host tooling."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+def process_count() -> int:
+    """``jax.process_count()``, or 1 when JAX/its backend is unavailable."""
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:
+        return 1
+
+
+# ------------------------------------------------------------------ gathering
+def gather_reports(
+    local: Optional[Mapping[str, Any]] = None,
+    *,
+    n_processes: Optional[int] = None,
+    allgather: Optional[Callable[[Any], Any]] = None,
+) -> List[Dict[str, Any]]:
+    """Every process's report snapshot, ordered by process index.
+
+    The local report is JSON-serialized to a ``uint8`` payload and moved with
+    two collectives: one allgather of the payload lengths, one of the
+    length-padded payloads — reports differ per process (different labels,
+    different cache churn), so shapes must be negotiated first.
+
+    ``n_processes``/``allgather`` are injectable for single-process testing,
+    exactly like :func:`parallel.coalesce.coalesced_host_sync`; by default
+    they resolve to ``jax.process_count()`` and
+    ``multihost_utils.process_allgather``.  With one process no collective
+    runs and the local report is returned as the only entry.
+    """
+    local_dict: Dict[str, Any] = dict(local) if local is not None else _local_report()
+    n_proc = process_count() if n_processes is None else int(n_processes)
+    if n_proc == 1:
+        return [local_dict]
+    if allgather is None:  # pragma: no cover - exercised on real multi-host
+        from jax.experimental import multihost_utils
+
+        allgather = multihost_utils.process_allgather
+    import jax.numpy as jnp
+
+    payload = np.frombuffer(
+        json.dumps(local_dict, sort_keys=True, default=str).encode("utf-8"), dtype=np.uint8
+    )
+    lengths = np.asarray(allgather(jnp.asarray([payload.size], dtype=jnp.int32)))
+    lengths = lengths.reshape(n_proc)
+    padded = np.zeros(int(lengths.max()), dtype=np.uint8)
+    padded[: payload.size] = payload
+    rows = np.asarray(allgather(jnp.asarray(padded)))
+    return [
+        json.loads(bytes(rows[p, : int(lengths[p])]).decode("utf-8")) for p in range(n_proc)
+    ]
+
+
+# ---------------------------------------------------------------- wait digests
+def sync_wait_digest(report: Mapping[str, Any]) -> Dict[str, Any]:
+    """One process's measured sync-wait summary out of its report.
+
+    Prefers the process-wide ``_process`` row that
+    :func:`registry.record_sync_wait` maintains (every measured
+    block-until-ready window, regardless of owning metric); falls back to
+    summing the per-metric ``sync`` spans for reports predating the digest.
+    """
+    row = report.get("metrics", {}).get("_process")
+    if isinstance(row, Mapping):
+        s = row.get("spans", {}).get("sync_wait")
+        if s:
+            return {
+                "count": int(s.get("count", 0)),
+                "total_us": float(s.get("total_us", 0.0)),
+                "max_us": float(s.get("max_us", 0.0)),
+                "source": "sync_wait",
+            }
+    count, total_us, max_us = 0, 0.0, 0.0
+    for row in report.get("metrics", {}).values():
+        s = row.get("spans", {}).get("sync")
+        if s:
+            count += int(s.get("count", 0))
+            total_us += float(s.get("total_us", 0.0))
+            max_us = max(max_us, float(s.get("max_us", 0.0)))
+    return {"count": count, "total_us": total_us, "max_us": max_us, "source": "sync"}
+
+
+def _axis_skew(per_process: Mapping[int, float]) -> Dict[str, Any]:
+    """Max/median/min summary of one per-process scalar, naming the max
+    process (ties break toward the lowest index) and the max/median ratio."""
+    values = [float(v) for v in per_process.values()]
+    peak = max(values)
+    med = float(statistics.median(values))
+    top = min(idx for idx, v in per_process.items() if float(v) == peak)
+    return {
+        "per_process": {str(idx): float(per_process[idx]) for idx in sorted(per_process)},
+        "max": peak,
+        "median": med,
+        "min": min(values),
+        "max_process": top,
+        # median 0 means no signal on the axis at all: report a flat 1.0
+        # rather than a JSON-hostile infinity
+        "skew_ratio": peak / med if med > 0 else 1.0,
+    }
+
+
+def _merge_cache_stats(parts: List[Mapping[str, Any]]) -> Dict[str, Any]:
+    """Sum ``compile_cache`` payloads (flat counters plus the two-level
+    ``by_entrypoint``/``miss_causes``/``cold_start`` sub-dicts)."""
+    out: Dict[str, Any] = {}
+    for part in parts:
+        for key, val in part.items():
+            if isinstance(val, Mapping):
+                slot = out.setdefault(key, {})
+                for k2, v2 in val.items():
+                    if isinstance(v2, Mapping):
+                        inner = slot.setdefault(k2, {})
+                        for k3, v3 in v2.items():
+                            if isinstance(v3, (int, float)):
+                                inner[k3] = inner.get(k3, 0) + v3
+                    elif isinstance(v2, (int, float)):
+                        slot[k2] = slot.get(k2, 0) + v2
+            elif isinstance(val, (int, float)) and not isinstance(val, bool):
+                out[key] = out.get(key, 0) + val
+    return out
+
+
+# ----------------------------------------------------------------- fleet view
+class FleetView:
+    """Per-process report snapshots plus the pod-global merge over them.
+
+    Construct directly from a list of reports (ordered by process index), or
+    gather live with :meth:`gather`.  Merge semantics:
+
+    * counters sum exactly — every count on every host is preserved,
+    * span histograms merge elementwise (the fixed ``SPAN_BUCKETS_US`` edges
+      make per-process histograms addable; EMA merges count-weighted),
+    * compile-cache stats sum, including ``by_entrypoint``/``miss_causes``,
+    * the untouched per-process reports ride along under ``per_process``.
+    """
+
+    def __init__(self, reports: List[Mapping[str, Any]]) -> None:
+        if not reports:
+            raise ValueError("FleetView needs at least one process report")
+        self.reports: List[Dict[str, Any]] = [dict(r) for r in reports]
+
+    @classmethod
+    def gather(
+        cls,
+        *,
+        n_processes: Optional[int] = None,
+        allgather: Optional[Callable[[Any], Any]] = None,
+    ) -> "FleetView":
+        """Gather every process's live report and build the view."""
+        return cls(gather_reports(n_processes=n_processes, allgather=allgather))
+
+    @property
+    def n_processes(self) -> int:
+        return len(self.reports)
+
+    def _index_of(self, position: int) -> int:
+        proc = self.reports[position].get("process")
+        if isinstance(proc, Mapping) and isinstance(proc.get("index"), int):
+            return int(proc["index"])
+        return position
+
+    # ------------------------------------------------------------- merging
+    def merged_metrics(self) -> Dict[str, Any]:
+        """Per-label telemetry rows merged across processes: the same label
+        on two hosts is the same logical (SPMD-replicated) metric."""
+        labels: List[str] = []
+        for r in self.reports:
+            for label in r.get("metrics", {}):
+                if label not in labels:
+                    labels.append(label)
+        out: Dict[str, Any] = {}
+        for label in labels:
+            rows = [r["metrics"][label] for r in self.reports if label in r.get("metrics", {})]
+            merged = aggregate_telemetry(rows)
+            merged["label"] = label
+            merged["class"] = rows[0].get("class", label)
+            out[label] = merged
+        return dict(sorted(out.items()))
+
+    # ---------------------------------------------------------------- skew
+    def skew(self) -> Dict[str, Any]:
+        """Per-replica imbalance: sync-wait, byte, and retrace skew, plus the
+        straggler process (the one that spent the most measured wall time
+        blocked in collectives)."""
+        waits: Dict[int, float] = {}
+        wait_digests: Dict[int, Dict[str, Any]] = {}
+        bytes_: Dict[int, float] = {}
+        traces: Dict[int, float] = {}
+        for pos, r in enumerate(self.reports):
+            idx = self._index_of(pos)
+            digest = sync_wait_digest(r)
+            wait_digests[idx] = digest
+            waits[idx] = digest["total_us"]
+            bytes_[idx] = float(
+                r.get("global", {}).get("counters", {}).get("sync_bytes", 0)
+            )
+            traces[idx] = float(r.get("compile_cache", {}).get("traces", 0))
+        wait_axis = _axis_skew(waits)
+        straggler = wait_axis["max_process"]
+        return {
+            "n_processes": self.n_processes,
+            "sync_wait_us": wait_axis,
+            "sync_bytes": _axis_skew(bytes_),
+            "retraces": _axis_skew(traces),
+            "straggler": {
+                "process": straggler,
+                "wait_total_us": waits[straggler],
+                "wait_count": wait_digests[straggler]["count"],
+                "vs_median": wait_axis["skew_ratio"],
+                "source": wait_digests[straggler]["source"],
+            },
+        }
+
+    def straggler(self) -> int:
+        """Index of the process with the largest measured sync wait."""
+        return int(self.skew()["straggler"]["process"])
+
+    # -------------------------------------------------------------- report
+    def report(self) -> Dict[str, Any]:
+        """The pod-global merged report (per-process breakdown retained)."""
+        merged = self.merged_metrics()
+        return {
+            "schema": 1,
+            "enabled": any(bool(r.get("enabled")) for r in self.reports),
+            "metrics": merged,
+            "global": aggregate_telemetry(merged.values()),
+            "compile_cache": _merge_cache_stats(
+                [r.get("compile_cache", {}) for r in self.reports]
+            ),
+            "fleet": {"n_processes": self.n_processes, "skew": self.skew()},
+            "per_process": {
+                str(self._index_of(pos)): dict(r) for pos, r in enumerate(self.reports)
+            },
+            # index None marks a merged exposition; exporters label it "fleet"
+            "process": {"index": None, "count": self.n_processes},
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"FleetView(n_processes={self.n_processes})"
+
+
+def fleet_report(
+    *,
+    n_processes: Optional[int] = None,
+    allgather: Optional[Callable[[Any], Any]] = None,
+) -> Dict[str, Any]:
+    """The pod-global telemetry report.
+
+    Single-process (the common case, and every CPU test) this IS the local
+    :func:`registry.report` — byte-identical, no collective, no extra keys.
+    Multi-process it gathers every process's snapshot and returns the
+    :class:`FleetView` merge.
+    """
+    n_proc = process_count() if n_processes is None else int(n_processes)
+    if n_proc == 1:
+        return _local_report()
+    return FleetView.gather(n_processes=n_proc, allgather=allgather).report()

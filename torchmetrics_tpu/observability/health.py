@@ -1,0 +1,538 @@
+"""Streaming metric-health monitors: deterministic, step-indexed alerting
+over computed metric values.
+
+The registry answers *what the library did*; this module watches *what the
+metrics said*.  A :class:`HealthMonitor` holds per-series streaming rules —
+
+* :class:`BoundRule` — value escaped ``[min_value, max_value]``,
+* :class:`DriftRule` — EMA z-score drift: the value sits ``z_threshold``
+  deviations from its exponentially-weighted mean/variance,
+* :class:`NonFiniteRule` — NaN/Inf observation rate above ``max_rate``,
+* :class:`StalenessRule` — a watched series not observed for more than
+  ``max_stale_steps`` steps (checked on :meth:`HealthMonitor.advance`),
+
+— and routes every violation as a severity-leveled :class:`Alert` to the
+configured sinks: :class:`LoggingAlertSink` (library logger),
+:class:`JSONLAlertSink` (one line per alert through the PR 3
+``JSONLinesExporter`` — each line carries ``schema_version`` and the process
+identity and parses back with ``export.parse_export_line``), and
+:class:`CallbackAlertSink`.
+
+Everything is **step-indexed and deterministic**: the monitor never reads a
+wall clock or RNG (TMT006-clean by construction), so the same value stream
+at the same steps produces the same alerts on every host and every rerun —
+replayable from a JSONL value log.  Nothing here enters a traced graph; the
+monitor consumes already-computed host values, so arming it can never change
+a cache key or add a retrace.
+
+Quick tour::
+
+    from torchmetrics_tpu.observability import health
+
+    mon = health.HealthMonitor(sinks=[health.LoggingAlertSink()])
+    mon.watch("val/accuracy", health.BoundRule(min_value=0.0, max_value=1.0),
+              health.DriftRule(z_threshold=4.0), health.StalenessRule(100))
+    for step in range(steps):
+        ...
+        mon.observe("val/accuracy", float(acc.compute()), step=step)
+        mon.advance(step)
+    mon.export(fmt="jsonl", stream=log)   # the report, via the front door
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from collections import deque
+from typing import Any, Callable, Dict, IO, List, Mapping, Optional, Tuple
+
+__all__ = [
+    "Alert",
+    "AlertSink",
+    "BoundRule",
+    "CallbackAlertSink",
+    "DriftRule",
+    "HealthMonitor",
+    "HealthRule",
+    "JSONLAlertSink",
+    "LoggingAlertSink",
+    "NonFiniteRule",
+    "SEVERITIES",
+    "StalenessRule",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+#: alert severities, mildest first; sinks filter with ``min_severity``
+SEVERITIES = ("info", "warning", "critical")
+
+
+def _severity_rank(severity: str) -> int:
+    try:
+        return SEVERITIES.index(severity)
+    except ValueError:
+        raise ValueError(
+            f"unknown alert severity {severity!r}; expected one of {SEVERITIES}"
+        ) from None
+
+
+def _json_safe(value: Optional[float]) -> Any:
+    """Floats for JSON lines: non-finite values become strings (strict JSON
+    has no NaN/Infinity literals)."""
+    if value is None:
+        return None
+    v = float(value)
+    return v if math.isfinite(v) else repr(v)
+
+
+class Alert:
+    """One rule violation: which series, which rule, at which step."""
+
+    __slots__ = ("series", "rule", "severity", "step", "value", "message", "details")
+
+    def __init__(
+        self,
+        series: str,
+        rule: str,
+        severity: str,
+        step: int,
+        value: Optional[float],
+        message: str,
+        details: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        _severity_rank(severity)  # validates
+        self.series = series
+        self.rule = rule
+        self.severity = severity
+        self.step = int(step)
+        self.value = value
+        self.message = message
+        self.details = dict(details) if details else {}
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": "health_alert",
+            "series": self.series,
+            "rule": self.rule,
+            "severity": self.severity,
+            "step": self.step,
+            "value": _json_safe(self.value),
+            "message": self.message,
+            "details": {k: _json_safe(v) if isinstance(v, float) else v
+                        for k, v in self.details.items()},
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"Alert({self.severity} {self.series}/{self.rule} @step {self.step}: {self.message})"
+
+
+# -------------------------------------------------------------------- sinks
+class AlertSink:
+    """Interface: subclasses implement :meth:`write`; :meth:`emit` applies
+    the ``min_severity`` filter shared by every sink."""
+
+    def __init__(self, min_severity: str = "info") -> None:
+        self._min_rank = _severity_rank(min_severity)
+
+    def emit(self, alert: Alert) -> None:
+        if _severity_rank(alert.severity) >= self._min_rank:
+            self.write(alert)
+
+    def write(self, alert: Alert) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class LoggingAlertSink(AlertSink):
+    """Route alerts through the library logger (silent until the application
+    configures handlers), mapping severity to the logging level."""
+
+    _LEVELS = {"info": logging.INFO, "warning": logging.WARNING, "critical": logging.ERROR}
+
+    def __init__(
+        self, logger: Optional[logging.Logger] = None, min_severity: str = "info"
+    ) -> None:
+        super().__init__(min_severity)
+        self.logger = logger if logger is not None else _log
+
+    def write(self, alert: Alert) -> None:
+        self.logger.log(
+            self._LEVELS[alert.severity],
+            "health[%s] %s/%s at step %d: %s",
+            alert.severity,
+            alert.series,
+            alert.rule,
+            alert.step,
+            alert.message,
+            extra={"health_alert": alert.as_dict()},
+        )
+
+
+class JSONLAlertSink(AlertSink):
+    """One JSON line per alert through the PR 3 ``JSONLinesExporter`` — the
+    existing export front door, so every line carries ``schema_version`` plus
+    the process identity and parses back via ``export.parse_export_line``."""
+
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        stream: Optional[IO[str]] = None,
+        min_severity: str = "info",
+    ) -> None:
+        super().__init__(min_severity)
+        from torchmetrics_tpu.observability.export import JSONLinesExporter
+
+        self._exporter = JSONLinesExporter(path=path, stream=stream)
+
+    def write(self, alert: Alert) -> None:
+        self._exporter.export(alert.as_dict())
+
+
+class CallbackAlertSink(AlertSink):
+    """Hand each alert to ``fn(alert)`` — pagers, test hooks, custom fanout."""
+
+    def __init__(self, fn: Callable[[Alert], None], min_severity: str = "info") -> None:
+        super().__init__(min_severity)
+        self._fn = fn
+
+    def write(self, alert: Alert) -> None:
+        self._fn(alert)
+
+
+# -------------------------------------------------------------------- rules
+class HealthRule:
+    """Interface for streaming per-series rules.
+
+    One rule instance may watch many series: state is keyed by series name.
+    :meth:`check` runs on every observation and returns an :class:`Alert` or
+    ``None``; :meth:`sweep` runs on :meth:`HealthMonitor.advance` for rules
+    (staleness) that fire on the *absence* of observations.
+    """
+
+    name = "rule"
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        return None
+
+    def sweep(self, series: str, step: int) -> Optional[Alert]:
+        return None
+
+
+class BoundRule(HealthRule):
+    """Value escaped ``[min_value, max_value]`` (either side optional)."""
+
+    name = "bound"
+
+    def __init__(
+        self,
+        min_value: Optional[float] = None,
+        max_value: Optional[float] = None,
+        severity: str = "critical",
+    ) -> None:
+        if min_value is None and max_value is None:
+            raise ValueError("BoundRule needs min_value and/or max_value")
+        if min_value is not None and max_value is not None and min_value > max_value:
+            raise ValueError(f"BoundRule: min_value {min_value} > max_value {max_value}")
+        self.min_value = min_value
+        self.max_value = max_value
+        self.severity = severity
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None  # NonFiniteRule's jurisdiction
+        if self.min_value is not None and value < self.min_value:
+            side, bound = "below min", self.min_value
+        elif self.max_value is not None and value > self.max_value:
+            side, bound = "above max", self.max_value
+        else:
+            return None
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"value {value!r} {side} bound {bound!r}",
+            {"min_value": self.min_value, "max_value": self.max_value},
+        )
+
+
+class DriftRule(HealthRule):
+    """EMA z-score drift: alert when a value lands ``z_threshold`` deviations
+    from its exponentially-weighted mean.
+
+    Mean and variance update with the standard EW recurrences
+    (``mean += alpha * delta``; ``var = (1-alpha) * (var + alpha * delta^2)``)
+    *after* the check, so a spike is judged against the history that preceded
+    it.  The first ``warmup`` finite observations only train the estimate.
+    """
+
+    name = "drift"
+
+    def __init__(
+        self,
+        z_threshold: float = 4.0,
+        alpha: float = 0.1,
+        warmup: int = 10,
+        severity: str = "warning",
+    ) -> None:
+        if not (0.0 < alpha <= 1.0):
+            raise ValueError(f"DriftRule alpha must be in (0, 1], got {alpha}")
+        if z_threshold <= 0.0:
+            raise ValueError(f"DriftRule z_threshold must be > 0, got {z_threshold}")
+        self.z_threshold = float(z_threshold)
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.severity = severity
+        # series -> (n_finite, ew_mean, ew_var)
+        self._series_state: Dict[str, Tuple[int, float, float]] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None
+        n, mean, var = self._series_state.get(series, (0, 0.0, 0.0))
+        alert = None
+        if n >= self.warmup and var > 0.0:
+            z = (value - mean) / math.sqrt(var)
+            if abs(z) >= self.z_threshold:
+                alert = Alert(
+                    series,
+                    self.name,
+                    self.severity,
+                    step,
+                    value,
+                    f"z-score {z:.2f} beyond ±{self.z_threshold:g} "
+                    f"(ema mean {mean:.6g}, ema std {math.sqrt(var):.3g})",
+                    {"z": z, "ema_mean": mean, "ema_var": var},
+                )
+        if n == 0:
+            mean, var = value, 0.0
+        else:
+            delta = value - mean
+            mean += self.alpha * delta
+            var = (1.0 - self.alpha) * (var + self.alpha * delta * delta)
+        self._series_state[series] = (n + 1, mean, var)
+        return alert
+
+
+class NonFiniteRule(HealthRule):
+    """NaN/Inf observation rate above ``max_rate`` (default 0: every
+    non-finite value alerts)."""
+
+    name = "nonfinite"
+
+    def __init__(self, max_rate: float = 0.0, severity: str = "critical") -> None:
+        if not (0.0 <= max_rate < 1.0):
+            raise ValueError(f"NonFiniteRule max_rate must be in [0, 1), got {max_rate}")
+        self.max_rate = float(max_rate)
+        self.severity = severity
+        # series -> (total, nonfinite)
+        self._series_state: Dict[str, Tuple[int, int]] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        total, bad = self._series_state.get(series, (0, 0))
+        total += 1
+        finite = math.isfinite(value)
+        if not finite:
+            bad += 1
+        self._series_state[series] = (total, bad)
+        rate = bad / total
+        if finite or rate <= self.max_rate:
+            return None
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"non-finite value ({bad}/{total} observations, "
+            f"rate {rate:.3f} > {self.max_rate:g})",
+            {"nonfinite": bad, "total": total, "rate": rate},
+        )
+
+
+class StalenessRule(HealthRule):
+    """Series not observed for more than ``max_stale_steps`` steps.
+
+    Fires once per staleness episode on :meth:`HealthMonitor.advance` (the
+    latch clears when the series is observed again), so a stalled producer
+    does not page on every step.
+    """
+
+    name = "staleness"
+
+    def __init__(self, max_stale_steps: int, severity: str = "warning") -> None:
+        if max_stale_steps < 1:
+            raise ValueError(f"StalenessRule max_stale_steps must be >= 1, got {max_stale_steps}")
+        self.max_stale_steps = int(max_stale_steps)
+        self.severity = severity
+        self._last_step: Dict[str, int] = {}
+        self._latched: Dict[str, bool] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        self._last_step[series] = step
+        self._latched[series] = False
+        return None
+
+    def sweep(self, series: str, step: int) -> Optional[Alert]:
+        last = self._last_step.get(series)
+        if last is None:
+            # never observed: measure staleness from the first sweep instead
+            self._last_step[series] = last = step
+            return None
+        stale = step - last
+        if stale <= self.max_stale_steps or self._latched.get(series):
+            return None
+        self._latched[series] = True
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            None,
+            f"no observation for {stale} steps (limit {self.max_stale_steps})",
+            {"stale_steps": stale, "last_step": last},
+        )
+
+
+# ------------------------------------------------------------------ monitor
+class HealthMonitor:
+    """Streaming health monitor over computed metric values.
+
+    ``watch`` registers a series with its rules; ``observe`` feeds one value
+    at one step (values must already be host floats — computing a metric is
+    the caller's business, the monitor never triggers device work);
+    ``advance`` runs the staleness sweep.  Alerts fan out to every sink and
+    land in a bounded ring (``max_alerts``) for :meth:`report`.
+    """
+
+    def __init__(
+        self,
+        sinks: Optional[List[AlertSink]] = None,
+        max_alerts: int = 1024,
+    ) -> None:
+        if max_alerts < 1:
+            raise ValueError(f"HealthMonitor max_alerts must be >= 1, got {max_alerts}")
+        self.sinks: List[AlertSink] = list(sinks) if sinks else []
+        self._rules: Dict[str, List[HealthRule]] = {}
+        self._last: Dict[str, Dict[str, Any]] = {}
+        self._alerts: "deque[Alert]" = deque(maxlen=max_alerts)
+        self._counts: Dict[str, int] = {sev: 0 for sev in SEVERITIES}
+        self._dropped = 0
+        self._step: Optional[int] = None
+
+    # ------------------------------------------------------------- wiring
+    def add_sink(self, sink: AlertSink) -> "HealthMonitor":
+        self.sinks.append(sink)
+        return self
+
+    def watch(self, series: str, *rules: HealthRule) -> "HealthMonitor":
+        """Register ``series`` with ``rules`` (appending on repeat calls)."""
+        if not rules:
+            raise ValueError(f"watch({series!r}) needs at least one rule")
+        self._rules.setdefault(series, []).extend(rules)
+        self._last.setdefault(
+            series, {"value": None, "step": None, "observations": 0}
+        )
+        return self
+
+    @property
+    def series(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._rules))
+
+    # ------------------------------------------------------------ feeding
+    def observe(self, series: str, value: Any, step: int) -> List[Alert]:
+        """Feed one observation; returns the alerts it raised (also routed
+        to the sinks and the ring)."""
+        v = float(value)
+        step = int(step)
+        slot = self._last.setdefault(
+            series, {"value": None, "step": None, "observations": 0}
+        )
+        slot["value"] = v
+        slot["step"] = step
+        slot["observations"] += 1
+        if self._step is None or step > self._step:
+            self._step = step
+        raised: List[Alert] = []
+        for rule in self._rules.get(series, ()):
+            alert = rule.check(series, step, v)
+            if alert is not None:
+                raised.append(alert)
+        for alert in raised:
+            self._record(alert)
+        return raised
+
+    def advance(self, step: int) -> List[Alert]:
+        """Mark the stream position and run the staleness sweep."""
+        step = int(step)
+        if self._step is None or step > self._step:
+            self._step = step
+        raised: List[Alert] = []
+        for series, rules in sorted(self._rules.items()):
+            for rule in rules:
+                alert = rule.sweep(series, step)
+                if alert is not None:
+                    raised.append(alert)
+        for alert in raised:
+            self._record(alert)
+        return raised
+
+    def _record(self, alert: Alert) -> None:
+        if len(self._alerts) == self._alerts.maxlen:
+            self._dropped += 1
+        self._alerts.append(alert)
+        self._counts[alert.severity] = self._counts.get(alert.severity, 0) + 1
+        for sink in self.sinks:
+            try:
+                sink.emit(alert)
+            except Exception:  # a broken pager must not break the step loop
+                _log.debug("health alert sink %r failed", sink, exc_info=True)
+
+    # ------------------------------------------------------------ reading
+    def alerts(self, severity: Optional[str] = None) -> List[Alert]:
+        """The retained alerts, oldest first (optionally one severity)."""
+        if severity is None:
+            return list(self._alerts)
+        _severity_rank(severity)  # validates
+        return [a for a in self._alerts if a.severity == severity]
+
+    @property
+    def alert_counts(self) -> Dict[str, int]:
+        return dict(self._counts)
+
+    def report(self) -> Dict[str, Any]:
+        """Structured snapshot: per-series state plus alert totals.  Shaped
+        for the export front door — ``export(monitor.report(), fmt=...)``
+        renders JSONL/log directly and Prometheus via the ``health`` block."""
+        series: Dict[str, Any] = {}
+        for name in sorted(set(self._rules) | set(self._last)):
+            slot = self._last.get(name, {"value": None, "step": None, "observations": 0})
+            sev_counts = {sev: 0 for sev in SEVERITIES}
+            for a in self._alerts:
+                if a.series == name:
+                    sev_counts[a.severity] += 1
+            series[name] = {
+                "last_value": _json_safe(slot["value"]),
+                "last_step": slot["step"],
+                "observations": slot["observations"],
+                "rules": [r.name for r in self._rules.get(name, ())],
+                "alerts": sev_counts,
+            }
+        return {
+            "schema": 1,
+            "kind": "health",
+            "step": self._step,
+            "health": {
+                "series": series,
+                "alerts": dict(self._counts),
+                "alerts_total": sum(self._counts.values()),
+                "alerts_dropped": self._dropped,
+                "recent": [a.as_dict() for a in list(self._alerts)[-16:]],
+            },
+        }
+
+    def export(self, fmt: str = "jsonl", **kwargs: Any) -> Any:
+        """Export :meth:`report` through ``observability.export.export``."""
+        from torchmetrics_tpu.observability.export import export as _export
+
+        return _export(self.report(), fmt=fmt, **kwargs)

@@ -55,6 +55,7 @@ __all__ = [
     "record_measured_sync",
     "record_quant_error",
     "record_sync",
+    "record_sync_wait",
     "report",
     "reset_telemetry",
     "set_trace_sinks",
@@ -330,6 +331,12 @@ _BY_ID: Dict[int, MetricTelemetry] = {}
 _CLASS_SEQ: Dict[str, int] = {}
 _RETIRED = MetricTelemetry("_retired", "_retired")
 _UNATTRIBUTED = MetricTelemetry("_unattributed", "_unattributed")
+#: process-wide sync-wait digest: every measured block-until-ready window
+#: lands here (span ``sync_wait``) regardless of owning metric, so the fleet
+#: plane (observability/fleet.py) can rank processes by how long they sat
+#: blocked in collectives.  Spans only — counters stay zero so the row never
+#: double-counts events in the global aggregate.
+_PROCESS = MetricTelemetry("_process", "_process")
 
 
 def _retire(oid: int) -> None:
@@ -630,6 +637,22 @@ def record_measured_sync(
         _SPAN_SINK(t.label, "sync_measured", seconds)
 
 
+def record_sync_wait(seconds: float) -> None:
+    """Fold one measured block-until-ready window into the process-wide
+    ``_process`` wait digest (span ``sync_wait``).
+
+    Callers are the two measured sync sites (``parallel/sync.py``'s dispatch
+    and ``SyncStepper.sync``), right after they attribute the same window
+    per-owner through :func:`record_measured_sync` — the digest answers
+    "how long did THIS process wait in collectives overall", which is what
+    :class:`observability.fleet.FleetView` compares across hosts to name the
+    straggler.  No-op while disabled."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _PROCESS.record_span("sync_wait", float(seconds))
+
+
 def record_quant_error(obj: Any, bucket_key: str, rel_err: float) -> None:
     """Fold one *measured* quantization relative error into ``obj``'s bucket
     row ``bucket_key`` (e.g. ``"float32/sum"``).  Callers measure against an
@@ -680,18 +703,26 @@ def report() -> Dict[str, Any]:
     Layout::
 
         {"schema": 1, "enabled": bool,
+         "process": {"index": int, "count": int},    # which host produced it
          "metrics": {label: telemetry-dict, ...},   # live + synthetic rows
          "global": telemetry-dict,                   # sum over all rows
          "compile_cache": cache_stats()}             # incl. by_entrypoint
+
+    Synthetic rows (``_retired``, ``_unattributed``, the ``_process`` wait
+    digest) appear only once active.  ``process`` self-describes the report
+    for fleet merges (observability/fleet.py) and process-labelled exports.
     """
+    from torchmetrics_tpu.observability.fleet import process_count, process_index
+
     with _LOCK:
         rows = {t.label: t.as_dict() for t in _BY_ID.values()}
-        for synth in (_RETIRED, _UNATTRIBUTED):
+        for synth in (_RETIRED, _UNATTRIBUTED, _PROCESS):
             if synth.active:
                 rows[synth.label] = synth.as_dict()
     out: Dict[str, Any] = {
         "schema": 1,
         "enabled": _ENABLED,
+        "process": {"index": process_index(), "count": process_count()},
         "metrics": dict(sorted(rows.items())),
         "global": aggregate_telemetry(rows.values()),
     }
@@ -784,6 +815,7 @@ def diff_report(before: Mapping[str, Any], after: Mapping[str, Any]) -> Dict[str
     return {
         "schema": after.get("schema", 1),
         "enabled": after.get("enabled", False),
+        "process": after.get("process"),
         "metrics": metrics,
         "global": _diff_tdict(after.get("global", {}), before.get("global")),
         "compile_cache": _diff_cache_stats(
@@ -800,6 +832,7 @@ def reset_telemetry() -> None:
             t.clear()
         _RETIRED.clear()
         _UNATTRIBUTED.clear()
+        _PROCESS.clear()
 
 
 # ------------------------------------------------------------------- observe

@@ -201,20 +201,51 @@ class FlightRecorder:
         Uses the object form (``{"traceEvents": [...], ...}``) so metadata —
         including the export ``schema_version`` — rides along; Perfetto and
         ``chrome://tracing`` both accept it.
+
+        ``pid`` is ``jax.process_index()`` (0 when uninitialized), NOT the OS
+        pid: per-host recordings then merge into one Perfetto timeline with
+        stable, non-colliding process tracks.  ``process_name``/
+        ``thread_name`` metadata events (phase ``"M"``) name those tracks.
         """
         from torchmetrics_tpu.observability.export import SCHEMA_VERSION
+        from torchmetrics_tpu.observability.fleet import process_index
 
-        pid = os.getpid()
+        pid = process_index()
         meta: Dict[str, Any] = {
             "schema_version": SCHEMA_VERSION,
             "producer": "torchmetrics_tpu.observability.tracing",
             "capacity": self.capacity,
             "dropped": self._dropped,
+            "process_index": pid,
         }
         if extra_metadata:
             meta.update(extra_metadata)
+        events = self.events()
+        chrome: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": f"torchmetrics_tpu process {pid}"},
+            }
+        ]
+        seen_tids: List[str] = []
+        for e in events:
+            if e.tid not in seen_tids:
+                seen_tids.append(e.tid)
+        for tid in seen_tids:
+            chrome.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {"name": tid},
+                }
+            )
+        chrome.extend(e.as_chrome(pid) for e in events)
         return {
-            "traceEvents": [e.as_chrome(pid) for e in self.events()],
+            "traceEvents": chrome,
             "displayTimeUnit": "ms",
             "otherData": meta,
         }

@@ -697,6 +697,9 @@ class SyncStepper:
                     measured_s,
                     compression=comp,
                 )
+                # same window, process-wide: the fleet plane's straggler
+                # attribution compares this digest across hosts
+                _telemetry.record_sync_wait(measured_s)
             if self.verify_consistency:
                 from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
 
@@ -1056,10 +1059,17 @@ class SyncAdvisor:
             "modes": modes,
         }
 
-    def recommend(self, target_cut: float = 3.5) -> Dict[str, Any]:
+    def recommend(self, target_cut: float = 3.5, fleet: Optional[Any] = None) -> Dict[str, Any]:
         """The smallest profiled cadence whose measured sync-time cut (vs the
         every-step baseline) reaches ``target_cut`` — or the best-measured
-        cadence when none does.  Report-only."""
+        cadence when none does.  Report-only.
+
+        ``fleet`` folds cross-host context into the advice: pass an
+        ``observability.fleet.FleetView`` (or its ``skew()`` dict) and the
+        recommendation gains a ``"fleet"`` block naming the straggler process
+        and its wait ratio — when one host dominates the measured sync wait,
+        cadence/compression tuning is the wrong lever and the note says so.
+        """
         if self._profile is None:
             raise RuntimeError("SyncAdvisor.recommend called before profile()")
         runs = self._profile["runs"]
@@ -1078,7 +1088,7 @@ class SyncAdvisor:
             if row.get("model_naive_bytes", 0)
             and row.get("model_ring_bytes", 0) >= 2 * row["model_naive_bytes"]
         )
-        return {
+        out = {
             "policy": "every_n",
             "every_n": best["every_n"],
             "measured_cut": best["measured_cut"],
@@ -1098,6 +1108,37 @@ class SyncAdvisor:
                 f"sync_policy=SyncPolicy.every_n({best['every_n']}))"
             ),
         }
+        if fleet is not None:
+            out["fleet"] = self._fleet_advice(fleet)
+        return out
+
+    @staticmethod
+    def _fleet_advice(fleet: Any) -> Dict[str, Any]:
+        """Cross-host context for the recommendation: straggler process and
+        wait skew out of an ``observability.fleet.FleetView`` (or an
+        already-built ``skew()`` mapping)."""
+        skew = fleet.skew() if hasattr(fleet, "skew") else dict(fleet)
+        straggler = skew.get("straggler", {})
+        ratio = float(straggler.get("vs_median", 1.0))
+        advice = {
+            "n_processes": skew.get("n_processes"),
+            "straggler": straggler.get("process"),
+            "wait_skew_ratio": ratio,
+            "sync_wait_us": skew.get("sync_wait_us"),
+        }
+        if ratio >= 2.0:
+            advice["note"] = (
+                f"process {straggler.get('process')} waits {ratio:.1f}x the fleet "
+                "median in collectives — investigate that host (data feed, thermal "
+                "throttle, neighbor load) before retuning cadence: a straggler "
+                "dominates every cadence equally"
+            )
+        else:
+            advice["note"] = (
+                "sync wait is balanced across processes; cadence/compression "
+                "tuning applies fleet-wide"
+            )
+        return advice
 
 
 def _span_delta(

@@ -212,6 +212,9 @@ def _measured_sync_dispatch(
         _telemetry.record_measured_sync(
             owner, entries, int(mesh.devices.size), measured_s, compression=compression
         )
+        # the same window also feeds the process-wide wait digest the fleet
+        # plane ranks hosts by (observability/fleet.py straggler attribution)
+        _telemetry.record_sync_wait(measured_s)
     return out
 
 

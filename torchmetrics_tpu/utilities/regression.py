@@ -72,6 +72,8 @@ _LOWER_BETTER = (
     "_collectives",
     "findings",
     "_err",  # sketch-vs-exact error legs (abs err, error bounds)
+    "skew",  # fleet skew ratios: growing imbalance is a regression
+    "alerts",  # health-monitor alert counts on the deterministic bench stream
 )
 #: keys where a HIGHER value is better (gate on decreases)
 _HIGHER_BETTER = ("cut", "speedup", "drop_pct", "fused_to", "prometheus_lines")
