@@ -1222,6 +1222,169 @@ def fleet_bench_child():
     print(json.dumps(out))
 
 
+def autotune_bench_child():
+    """Closed-loop autotuner acceptance leg on the 8-virtual-device mesh:
+
+    * convergence — from a naive every-step start the
+      ``SyncAutotuner`` (observe -> propose -> arm -> commit) must land
+      within 10% of the hand-tuned ``every_n=4`` stepper's measured sync
+      wall time, well under the naive baseline;
+    * transition retraces — the cadence commit reuses the compiled
+      step/sync verbatim: the ``retrace_report()`` audit over the cache
+      delta since commit must show zero extra traces/misses;
+    * compression transition — a budgeted tuner on a calibration metric
+      (4 KiB+ sum bucket) commits a quantized mode at the cost of exactly
+      one ``new-key`` miss on the cadence entrypoint, as ledgered in the
+      commit's ``expected_retraces``;
+    * observability smoke — the JSONL decision ledger parses back through
+      the export front door and the Prometheus exposition renders the
+      ``tm_tpu_autotune_*`` families.
+    """
+    import io
+
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import BinaryCalibrationError
+    from torchmetrics_tpu.observability import registry as _telemetry
+    from torchmetrics_tpu.observability.export import parse_export_line
+    from torchmetrics_tpu.parallel import SyncAutotuner, SyncPolicy, SyncStepper
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+    steps = int(os.environ.get("BENCH_AUTOTUNE_STEPS", 16))
+    reps = 3
+    batch = (
+        jnp.asarray(rng.integers(0, 5, (64,))),
+        jnp.asarray(rng.integers(0, 5, (64,))),
+    )
+
+    def acc():
+        return MulticlassAccuracy(num_classes=5, average="micro")
+
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        def sync_seconds(stepper):
+            """Min-of-reps measured sync wall time for one `steps`-update
+            pass + flush — the same block-until-ready span telemetry the
+            advisor profiles with."""
+            span_us = lambda: (
+                _telemetry.telemetry_for(stepper.target)
+                .as_dict()["spans"]
+                .get("sync_measured", {})
+                .get("total_us", 0.0)
+            )
+            best = None
+            for _ in range(reps):
+                stepper.reset()
+                before = span_us()
+                for _ in range(steps):
+                    stepper.update(*batch)
+                if stepper.pending:
+                    stepper.sync()
+                t = (span_us() - before) / 1e6
+                best = t if best is None else min(best, t)
+            return best
+
+        # --- the loop: naive start, measured observe, guarded commit
+        metric = acc()
+        stepper = SyncStepper(metric, mesh=mesh, policy=SyncPolicy())
+        tuner = SyncAutotuner(
+            stepper, candidates=(1, 2, 4), target_cut=3.5, report_only=False
+        )
+        tuner.observe(*batch, steps=steps, rounds=reps)
+        tuner.propose()
+        tuner.arm()
+        commit = tuner.commit()
+
+        autotuned_s = sync_seconds(stepper)
+        naive_s = sync_seconds(SyncStepper(acc(), mesh=mesh, policy=SyncPolicy()))
+        hand_s = sync_seconds(
+            SyncStepper(acc(), mesh=mesh, policy=SyncPolicy(every_n_steps=4))
+        )
+        audit = tuner.retrace_report()
+        out["sync_time"] = {
+            "steps_per_pass": steps,
+            "committed_every_n": commit["new_policy"]["every_n"],
+            "naive_sync_s": round(naive_s, 6),
+            "hand_tuned_sync_s": round(hand_s, 6),
+            "autotuned_sync_s": round(autotuned_s, 6),
+            "naive_over_autotuned_cut": round(naive_s / max(autotuned_s, 1e-9), 2),
+            "within_10pct_of_hand_tuned": bool(autotuned_s <= hand_s * 1.10),
+        }
+        out["transition_retraces"] = {
+            "extra_retraces": int(audit["extra_traces"]),
+            "extra_misses": int(audit["extra_misses"]),
+            "miss_causes": audit["miss_causes"],
+            "audit_ok": bool(audit["ok"]),
+        }
+
+        # --- compression transition: one ledgered new-key miss, no more
+        calib = BinaryCalibrationError(n_bins=1024)  # 4 KiB+ sum bucket
+        cbatch = (
+            jnp.asarray(rng.random((64,), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 2, (64,))),
+        )
+        cstep = SyncStepper(calib, mesh=mesh, policy=SyncPolicy(every_n_steps=4))
+        for _ in range(4):  # warm the exact-mode step + sync
+            cstep.update(*cbatch)
+        ctuner = SyncAutotuner(
+            cstep, candidates=(1, 4), error_budget=5e-2, report_only=False
+        )
+        ctuner.observe(*cbatch, steps=8, rounds=1)
+        ctuner.propose()
+        ctuner.arm()
+        centry = ctuner.commit()
+        k = centry["new_policy"]["every_n"] or 1
+        for _ in range(k):  # first window syncs under the committed mode
+            cstep.update(*cbatch)
+        if cstep.pending:
+            cstep.sync()
+        caudit = ctuner.retrace_report()
+        out["compression_transition"] = {
+            "committed_mode": centry["new_policy"]["compression"],
+            "expected_retraces": centry["expected_retraces"],
+            "extra_misses": int(caudit["extra_misses"]),
+            "miss_causes": caudit["miss_causes"],
+            "audit_ok": bool(caudit["ok"]),
+        }
+
+        # --- observability smoke: ledger parse-back + Prometheus families
+        buf = io.StringIO()
+        lines = tuner.export_ledger(stream=buf)
+        parsed = [parse_export_line(line) for line in lines]
+        report = _telemetry.report()
+        report["autotune"] = tuner.report()
+        prom = [
+            line
+            for line in obs.export(report, fmt="prometheus").splitlines()
+            if line.startswith("tm_tpu_autotune")
+        ]
+        out["observability"] = {
+            "ledger_lines": len(lines),
+            "ledger_parses_back": bool(
+                parsed and all(p["kind"] == "autotune_decision" for p in parsed)
+            ),
+            "actions": [p["action"] for p in parsed],
+            "prometheus_lines": len(prom),
+            "has_policy_info": any(
+                line.startswith("tm_tpu_autotune_policy_info") for line in prom
+            ),
+        }
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -1284,6 +1447,12 @@ def measured_compressed():
 def measured_fleet():
     return _run_cpu_mesh_child(
         "fleet", float(os.environ.get("BENCH_FLEET_TIMEOUT", 300))
+    )
+
+
+def measured_autotune():
+    return _run_cpu_mesh_child(
+        "autotune", float(os.environ.get("BENCH_AUTOTUNE_TIMEOUT", 300))
     )
 
 
@@ -1678,6 +1847,7 @@ def main():
     sketch_measured = measured_sketch()
     compressed_measured = measured_compressed()
     fleet_measured = measured_fleet()
+    autotune_measured = measured_autotune()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -1725,6 +1895,7 @@ def main():
             "sketch_states": sketch_measured,
             "compressed_sync": compressed_measured,
             "fleet": fleet_measured,
+            "autotune": autotune_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -1852,6 +2023,8 @@ if __name__ == "__main__":
         sketch_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "compressed":
         compressed_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "autotune":
+        autotune_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "fleet":
         fleet_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:

@@ -1,0 +1,139 @@
+"""Closed-loop sync autotuning walkthrough: the full control loop on an
+8-device host mesh.
+
+What this shows, in order:
+
+1. **observe → propose → arm → commit** — a `SyncAutotuner` measures the
+   candidate cadences on a live `SyncStepper`, proposes a policy (cadence +
+   compression within the error budget + the two-stage toggle), and commits
+   it to the running flow;
+2. **the trace-safety audit** — the cadence commit reused the compiled
+   step/sync verbatim (zero new compile-cache entries), proven against
+   `cache_stats()` miss-cause deltas;
+3. **a guardrail trip** — a `HealthMonitor` watching the training loss sees
+   a NaN *after* the commit and rolls the committed policy back, in-band,
+   with the alert payload on the ledger;
+4. **the observability surfaces** — the JSONL decision ledger through the
+   export front door, the `tm_tpu_autotune_*` Prometheus families, and the
+   flight recorder's `"policy"` events.
+
+Run with:  python examples/autotune_walkthrough.py
+"""
+
+import io
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+def main() -> None:
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.observability import tracing
+    from torchmetrics_tpu.observability.export import parse_export_line
+    from torchmetrics_tpu.parallel import (
+        SyncAutotuner,
+        SyncPolicy,
+        SyncStepper,
+        committed_policy,
+        metric_mesh,
+    )
+
+    obs.enable()
+    tracing.start(capacity=256)
+    mesh = metric_mesh(axis_name="data")
+    print(f"mesh: {mesh.devices.size} devices, axis 'data'")
+
+    rng = np.random.default_rng(0)
+    batch = lambda: (
+        jnp.asarray(rng.integers(0, 5, (64,))),
+        jnp.asarray(rng.integers(0, 5, (64,))),
+    )
+
+    # a live flow that starts on the naive policy: sync every step
+    metric = MulticlassAccuracy(num_classes=5, average="micro")
+    stepper = SyncStepper(metric, mesh=mesh, policy=SyncPolicy())
+
+    banner("1. observe -> propose -> arm -> commit")
+    tuner = SyncAutotuner(
+        stepper,
+        candidates=(1, 2, 4),
+        target_cut=1.5,
+        report_only=False,  # the explicit opt-in: commits actually apply
+    )
+    profile = tuner.observe(*batch(), steps=12, rounds=2)
+    for run in profile["runs"]:
+        print(
+            f"  every_n={run['every_n']}: {run['syncs']} syncs, "
+            f"{run['sync_s'] * 1e3:.2f} ms sync wall time"
+        )
+    tuner.propose()
+    print(f"  candidate: {tuner.candidate()['policy']}")
+    tuner.arm()  # guardrails may veto from here until commit
+    entry = tuner.commit()
+    print(f"  committed (applied={entry['applied']}): {entry['new_policy']}")
+    print(f"  expected retraces: {entry['expected_retraces']}")
+    assert stepper.policy.every_n_steps == entry["new_policy"]["every_n"]
+
+    banner("2. the committed cadence runs retrace-free")
+    for _ in range(8):  # two full windows under the committed policy
+        stepper.update(*batch())
+    audit = tuner.retrace_report()
+    print(f"  cache delta since commit: {audit['extra_misses']} misses, "
+          f"causes {audit['miss_causes']} -> ok={audit['ok']}")
+
+    banner("3. a health alert rolls the committed policy back")
+    monitor = obs.HealthMonitor()
+    monitor.watch("train/loss", obs.NonFiniteRule(severity="critical"))
+    monitor.add_sink(tuner.guardrail_sink())  # the guardrail wiring
+    print(f"  state before alert: {tuner.state!r}, "
+          f"policy every_n={stepper.policy.every_n_steps}")
+    monitor.observe("train/loss", float("nan"), step=13)  # the injected fault
+    print(f"  state after alert:  {tuner.state!r}, "
+          f"policy every_n={stepper.policy.every_n_steps}")
+    assert committed_policy(metric) == SyncPolicy()
+    rollback = tuner.decision_ledger()[-1]
+    print(f"  ledgered rollback: {rollback['rationale']}")
+    print(f"  triggering alert:  {rollback['alert']['series']} "
+          f"{rollback['alert']['severity']} at step {rollback['alert']['step']}")
+
+    banner("4. every decision, three observable ways")
+    buf = io.StringIO()
+    lines = tuner.export_ledger(stream=buf)
+    print(f"  JSONL ledger ({len(lines)} lines through the export front door):")
+    for line in lines:
+        p = parse_export_line(line)  # enforces the schema-version contract
+        print(f"    seq={p['seq']} {p['action']:>8}  "
+              f"{p['state_from']} -> {p['state_to']}  (schema {p['schema_version']})")
+
+    report = obs.registry.report()
+    report["autotune"] = tuner.report()
+    text = obs.export(report, fmt="prometheus")
+    print("  Prometheus autotune families:")
+    for line in text.splitlines():
+        if line.startswith("tm_tpu_autotune"):
+            print(f"    {line}")
+
+    policy_events = [e for e in tracing.events() if e.cat == "policy"]
+    print(f"  flight recorder: {len(policy_events)} 'policy' events")
+    for e in policy_events:
+        print(f"    {e.name}")
+
+    tracing.stop()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

@@ -92,6 +92,49 @@ def test_trace_contract_is_deterministic():
     assert a == b
 
 
+# ------------------------------------------- autotuned policy contract shapes
+def test_slate_includes_committed_policy_shapes():
+    """The slate snapshots each committed-policy shape the autotuner can
+    install: cadence-only, bf16, and int8 next to the exact baseline."""
+    slate = golden_metrics()
+    assert {
+        "BinaryCalibrationError1024",
+        "BinaryCalibrationError1024__bf16",
+        "BinaryCalibrationError1024__int8",
+        "MulticlassAccuracy__every4",
+    } <= set(slate)
+
+
+def test_committed_policy_never_changes_the_update_segment():
+    """A policy transition must only reshape the *sync* segment: the update
+    trace of every autotuned entry is identical to its exact baseline, and
+    the baseline goldens carry no policy key at all."""
+    load = lambda name: json.loads((contract_dir() / f"{name}.json").read_text())
+    base, bf16, int8 = (
+        load("BinaryCalibrationError1024"),
+        load("BinaryCalibrationError1024__bf16"),
+        load("BinaryCalibrationError1024__int8"),
+    )
+    assert "policy" not in base
+    assert bf16["policy"]["compression"] == "bf16"
+    assert int8["policy"]["compression"] == "int8"
+    up = lambda c: c["entrypoints"]["update"]
+    sync = lambda c: c["entrypoints"]["sync"]
+    assert up(base) == up(bf16) == up(int8)
+    # ...while the compressed sync segments genuinely lower differently
+    assert sync(bf16) != sync(base) and sync(int8) != sync(base)
+    assert sync(bf16) != sync(int8)
+    # a cadence-only policy is invisible to BOTH segments (every_n is host-side)
+    ev4, plain = load("MulticlassAccuracy__every4"), load("MulticlassAccuracy")
+    assert ev4["policy"] == {
+        "every_n": 4,
+        "at_compute": False,
+        "compression": "none",
+        "error_budget": None,
+    }
+    assert up(ev4) == up(plain) and sync(ev4) == sync(plain)
+
+
 # -------------------------------------------------------------- diff surface
 def _contract():
     metric, inputs = golden_metrics()["BinaryAccuracy"]()

@@ -1,0 +1,497 @@
+"""Closed-loop sync autotuning: the observe → candidate → trial → commit |
+rollback state machine, its health-monitor/divergence guardrails, the
+trace-safety audit (cadence commits retrace-free, compression commits cost
+exactly one ledgered new-key miss), and the three observability surfaces —
+flight-recorded ``policy`` events, the JSONL decision ledger through the
+export front door, and the ``tm_tpu_autotune_*`` Prometheus families."""
+
+import io
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import BinaryCalibrationError, MulticlassAccuracy
+from torchmetrics_tpu.core.compile import cache_stats
+from torchmetrics_tpu.observability import tracing
+from torchmetrics_tpu.observability.export import SCHEMA_VERSION, parse_export_line
+from torchmetrics_tpu.parallel import (
+    SyncAdvisor,
+    SyncAutotuner,
+    SyncPolicy,
+    SyncStepper,
+    committed_policy,
+    policy_dict,
+)
+from torchmetrics_tpu.parallel.autotune import (
+    AUTOTUNE_ACTIONS,
+    AUTOTUNE_STATES,
+    LEDGER_KIND,
+)
+from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+pytestmark = pytest.mark.autotune
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=5, average="micro")
+
+
+def _batch(rng, n=16):
+    return (
+        jnp.asarray(rng.integers(0, 5, (n,))),
+        jnp.asarray(rng.integers(0, 5, (n,))),
+    )
+
+
+def _calib():
+    # 2 x (1024,) float32 states = 4096-byte bucket: clears the compression
+    # floor, so a bf16/int8 policy genuinely changes the lowered sync
+    return BinaryCalibrationError(n_bins=1024)
+
+
+def _calib_batch(rng, n=16):
+    return (
+        jnp.asarray(rng.random((n,), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, 2, (n,))),
+    )
+
+
+def _run(n, sync_s, steps=8):
+    return {
+        "every_n": n,
+        "steps": steps,
+        "rounds": 1,
+        "syncs": steps // n,
+        "sync_s": sync_s,
+        "mean_sync_s": sync_s / max(steps // n, 1),
+        "sync_wire_bytes": 4096,
+        "sync_raw_bytes": 4096,
+        "mean_sync_bytes": 512.0,
+    }
+
+
+def _profile(*runs):
+    """A deterministic prebuilt profile — tests drive the state machine on
+    known measurements instead of CPU wall-clock noise."""
+    return {
+        "steps": 8,
+        "n_devices": NUM_DEVICES,
+        "runs": list(runs),
+        "buckets": {},
+    }
+
+
+#: every_n=1 takes 1.0s of sync, every_n=4 cuts it 4x: propose() picks 4
+FOUR_X = (_run(1, 1.0), _run(4, 0.25))
+
+
+def _tuner(mesh, metric=None, policy=None, **kw):
+    m = metric if metric is not None else _metric()
+    stepper = SyncStepper(
+        m, mesh=mesh, policy=policy if policy is not None else SyncPolicy()
+    )
+    kw.setdefault("candidates", (1, 4))
+    return SyncAutotuner(stepper, **kw), stepper
+
+
+# ------------------------------------------------------- satellite: baseline
+def test_advisor_rejects_baseline_less_candidates(mesh):
+    with pytest.raises(ValueError, match="must include 1"):
+        SyncAdvisor(_metric(), mesh=mesh, candidates=(4,))
+
+
+def test_profile_always_measures_the_baseline(mesh):
+    """Even when the candidate list is mangled after construction (config
+    override, deserialized state), profile() still measures every_n=1 —
+    every recommendation is judged against the every-step baseline."""
+    advisor = SyncAdvisor(_metric(), mesh=mesh, candidates=(1, 4))
+    advisor.candidates = (4,)
+    rng = np.random.default_rng(0)
+    profile = advisor.profile(*_batch(rng), steps=4, rounds=1)
+    assert [r["every_n"] for r in profile["runs"]] == [1, 4]
+    rec = advisor.recommend(target_cut=1.0)
+    assert rec["baseline_sync_s"] > 0.0
+
+
+def test_advisor_accepts_advice_only_error_budget(mesh):
+    """A budget WITHOUT a compression mode declares the tolerance the
+    compression advice is judged against — the profile runs exact."""
+    advisor = SyncAdvisor(_calib(), mesh=mesh, candidates=(1, 4), error_budget=5e-2)
+    advisor._profile = _profile(*FOUR_X)
+    comp = advisor.recommend(target_cut=3.5)["compression"]
+    assert comp["mode"] == "none" and comp["error_budget"] == 5e-2
+    assert comp["recommended_mode"] in ("bf16", "int8")
+
+
+def test_recommend_without_baseline_raises_clearly(mesh):
+    """A hand-built/deserialized profile missing the every_n=1 row fails with
+    a RuntimeError that names the problem — not a bare StopIteration."""
+    advisor = SyncAdvisor(_metric(), mesh=mesh)
+    advisor._profile = _profile(_run(4, 0.25))
+    with pytest.raises(RuntimeError, match="no every_n == 1 baseline"):
+        advisor.recommend(target_cut=2.0)
+
+
+# ----------------------------------------------------------- state machine
+def test_happy_path_report_only_by_default(mesh):
+    tuner, stepper = _tuner(mesh)
+    assert tuner.report_only and tuner.state == "observe"
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    assert tuner.state == "candidate"
+    assert tuner.candidate()["policy"]["every_n"] == 4
+    tuner.arm()
+    assert tuner.state == "trial"
+    entry = tuner.commit()
+    assert tuner.state == "committed"
+    # report-only: the decision is ledgered but nothing is touched
+    assert entry["applied"] is False
+    assert stepper.policy == SyncPolicy()
+    assert committed_policy(stepper.target) is None
+    assert [e["action"] for e in tuner.decision_ledger()] == [
+        "observe",
+        "propose",
+        "arm",
+        "commit",
+    ]
+    assert all(e["state_to"] in AUTOTUNE_STATES for e in tuner.decision_ledger())
+
+
+def test_arm_and_commit_enforce_order(mesh):
+    tuner, _ = _tuner(mesh)
+    with pytest.raises(RuntimeError, match="no candidate"):
+        tuner.arm()
+    with pytest.raises(RuntimeError, match="no staged trial"):
+        tuner.commit()
+
+
+def test_commit_applies_cadence_with_zero_retraces(mesh):
+    """An applied every_n commit switches the live stepper mid-stream and the
+    compile-cache delta since the commit is empty — cadence is host-side."""
+    tuner, stepper = _tuner(mesh, report_only=False)
+    rng = np.random.default_rng(1)
+    for _ in range(3):  # compile the cadence step + sync pre-commit
+        stepper.update(*_batch(rng))
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    entry = tuner.commit()
+    assert entry["applied"] is True
+    assert entry["expected_retraces"] == {
+        "new_keys": 0,
+        "cause": None,
+        "entrypoint": None,
+    }
+    assert stepper.policy.every_n_steps == 4
+    assert committed_policy(stepper.target).every_n_steps == 4
+    for _ in range(8):  # two full windows under the committed cadence
+        stepper.update(*_batch(rng))
+    audit = tuner.retrace_report()
+    assert audit["ok"], audit
+    assert audit["extra_misses"] == 0 and audit["miss_causes"] == {}
+    # the audit itself is a ledgered decision
+    assert tuner.decision_ledger()[-1]["action"] == "audit"
+
+
+def test_compression_commit_costs_exactly_one_new_key(mesh):
+    """A compression change re-keys the cadence sync: the audit attributes
+    exactly one new-key miss and nothing else."""
+    tuner, stepper = _tuner(
+        mesh, metric=_calib(), report_only=False, error_budget=5e-2
+    )
+    rng = np.random.default_rng(2)
+    for _ in range(2):  # compile the exact-mode step + sync pre-commit
+        stepper.update(*_calib_batch(rng))
+    stepper.sync()
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    mode = tuner.candidate()["policy"]["compression"]
+    assert mode in ("bf16", "int8")  # budget of 5e-2 admits a quantized mode
+    tuner.arm()
+    entry = tuner.commit()
+    assert entry["expected_retraces"] == {
+        "new_keys": 1,
+        "cause": "new-key",
+        "entrypoint": "cadence",
+    }
+    assert stepper.policy.compression == mode
+    for _ in range(4):  # one full window: first sync under the new mode
+        stepper.update(*_calib_batch(rng))
+    audit = tuner.retrace_report()
+    assert audit["ok"], audit
+    assert audit["extra_misses"] == 1
+    assert audit["miss_causes"] == {"new-key": 1}
+
+
+def test_compression_commit_flushes_the_open_window(mesh):
+    """Steps accumulated under the exact mode sync under the exact mode —
+    the policy switch flushes them rather than re-keying them mid-window."""
+    tuner, stepper = _tuner(
+        mesh,
+        metric=_calib(),
+        policy=SyncPolicy(every_n_steps=4),
+        report_only=False,
+        error_budget=5e-2,
+    )
+    rng = np.random.default_rng(3)
+    stepper.update(*_calib_batch(rng))
+    assert stepper.pending == 1
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    assert stepper.pending == 0  # the open window was flushed pre-switch
+
+
+def test_report_only_commit_refuses_retrace_report(mesh):
+    tuner, _ = _tuner(mesh)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    with pytest.raises(RuntimeError, match="no applied commit"):
+        tuner.retrace_report()
+
+
+# --------------------------------------------------------------- guardrails
+def _alerting_monitor(tuner, series="loss"):
+    monitor = obs.HealthMonitor()
+    monitor.watch(series, obs.NonFiniteRule(severity="critical"))
+    monitor.add_sink(tuner.guardrail_sink())
+    return monitor
+
+
+def test_health_alert_vetoes_pending_trial(mesh):
+    obs.enable()
+    tuner, stepper = _tuner(mesh, report_only=False)
+    monitor = _alerting_monitor(tuner)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    monitor.observe("loss", float("nan"), step=7)
+    # the alert landed in-band: trial vetoed before it ever applied
+    assert tuner.state == "observe"
+    assert stepper.policy == SyncPolicy()
+    assert committed_policy(stepper.target) is None
+    assert tuner.counts["vetoes"] == 1
+    with pytest.raises(RuntimeError, match="vetoed"):
+        tuner.commit()
+    veto = next(e for e in tuner.decision_ledger() if e["action"] == "veto")
+    assert veto["state_from"] == "trial" and veto["state_to"] == "observe"
+    assert veto["alert"]["kind"] == "health_alert"
+    assert veto["alert"]["series"] == "loss"
+    assert veto["new_policy"]["every_n"] == 4  # what was vetoed, on the record
+
+
+def test_health_alert_rolls_back_committed_policy(mesh):
+    obs.enable()
+    tuner, stepper = _tuner(mesh, report_only=False)
+    monitor = _alerting_monitor(tuner)
+    rng = np.random.default_rng(4)
+    stepper.update(*_batch(rng))
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    assert stepper.policy.every_n_steps == 4
+    monitor.observe("loss", float("inf"), step=11)
+    # committed policy rolled back to the pre-commit one, in-band
+    assert tuner.state == "observe"
+    assert stepper.policy == SyncPolicy()
+    assert committed_policy(stepper.target) == SyncPolicy()
+    assert tuner.counts["rollbacks"] == 1
+    rb = next(e for e in tuner.decision_ledger() if e["action"] == "rollback")
+    assert rb["applied"] is True
+    assert rb["old_policy"]["every_n"] == 4
+    assert rb["new_policy"]["every_n"] == 1  # every-step default restored
+    assert rb["alert"]["severity"] == "critical"
+
+
+def test_alert_below_veto_severity_is_ignored(mesh):
+    obs.enable()
+    tuner, stepper = _tuner(mesh, report_only=False, veto_severity="critical")
+    monitor = obs.HealthMonitor()
+    monitor.watch("loss", obs.NonFiniteRule(severity="warning"))
+    monitor.add_sink(tuner.guardrail_sink())
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    monitor.observe("loss", float("nan"), step=0)
+    assert tuner.state == "trial"  # warning < critical: no veto
+    tuner.commit()
+    assert stepper.policy.every_n_steps == 4
+
+
+def test_divergence_vetoes_trial_and_rolls_back_commit(mesh):
+    tuner, stepper = _tuner(mesh, report_only=False)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    entry = tuner.report_divergence(ReplicaDivergenceError("replica 3 drifted"))
+    assert entry["action"] == "veto" and "replica 3 drifted" in entry["error"]
+    assert tuner.state == "observe"
+    # ...and again for a committed policy: divergence rolls it back
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    assert stepper.policy.every_n_steps == 4
+    entry = tuner.report_divergence(ReplicaDivergenceError("replica 5 drifted"))
+    assert entry["action"] == "rollback"
+    assert stepper.policy == SyncPolicy()
+    # nothing staged, nothing committed: the verifier report is a no-op
+    assert tuner.report_divergence(ReplicaDivergenceError("idle")) is None
+
+
+# --------------------------------------- satellite: snapshot across transition
+def test_snapshot_restore_across_mid_window_policy_transition(mesh):
+    """A snapshot taken mid-window after an every_n commit restores into a
+    fresh stepper with no samples lost or double-counted, and the restored
+    stepper honors the committed cadence."""
+    rng = np.random.default_rng(5)
+    batches = [_batch(rng) for _ in range(8)]
+    m = _metric()
+    stepper = SyncStepper(m, mesh=mesh, policy=SyncPolicy(every_n_steps=8))
+    tuner = SyncAutotuner(stepper, report_only=False, candidates=(1, 4))
+    for b in batches[:3]:
+        stepper.update(*b)  # window open: 3 pending steps
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    assert stepper.policy.every_n_steps == 4
+    snap = stepper.snapshot()
+    assert snap["pending"] == 3  # the open window rode the transition
+
+    restored = SyncStepper(
+        _metric(), mesh=mesh, policy=committed_policy(m) or stepper.policy
+    )
+    restored.restore(snap)
+    assert restored.pending == 3 and restored.steps == 3
+    # the very next update closes the committed 4-step window
+    restored.update(*batches[3])
+    assert restored.pending == 0
+    for b in batches[4:]:
+        restored.update(*b)
+    # ground truth: every batch exactly once
+    ref = _metric()
+    state = ref.init_state()
+    for b in batches:
+        state = ref.update_state(state, *b)
+    assert float(restored.compute()) == pytest.approx(
+        float(ref.compute_state(state))
+    )
+
+
+# --------------------------------------------- satellite: export front door
+def test_ledger_exports_through_front_door_and_parses_back(mesh):
+    tuner, _ = _tuner(mesh, report_only=False)
+    monitor = _alerting_monitor(tuner)
+    obs.enable()
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    monitor.observe("loss", float("nan"), step=3)  # rollback, on the ledger
+    buf = io.StringIO()
+    lines = tuner.export_ledger(stream=buf)
+    assert buf.getvalue().splitlines() == lines
+    parsed = [parse_export_line(line) for line in lines]
+    assert [p["action"] for p in parsed] == [
+        "observe",
+        "propose",
+        "arm",
+        "commit",
+        "rollback",
+    ]
+    for p in parsed:
+        assert p["kind"] == LEDGER_KIND
+        assert p["action"] in AUTOTUNE_ACTIONS
+        assert p["schema_version"] == SCHEMA_VERSION
+        assert isinstance(p["process"]["index"], int)
+
+
+def test_recommendation_exports_through_front_door(mesh):
+    """SyncAdvisor.recommend lines ride the same JSONL front door: kind
+    stamp, schema version, process identity, all parse back."""
+    advisor = SyncAdvisor(_metric(), mesh=mesh, candidates=(1, 4))
+    advisor._profile = _profile(*FOUR_X)
+    rec = advisor.recommend(target_cut=3.5)
+    buf = io.StringIO()
+    line = obs.export(rec, fmt="jsonl", stream=buf)
+    parsed = parse_export_line(line)
+    assert parsed["kind"] == "sync_advice"
+    assert parsed["every_n"] == 4
+    assert parsed["schema_version"] == SCHEMA_VERSION
+    assert isinstance(parsed["process"]["index"], int)
+
+
+def test_flight_recorder_policy_category_events(mesh):
+    obs.enable()
+    tracing.start(capacity=256)
+    try:
+        tuner, _ = _tuner(mesh, report_only=False)
+        tuner.observe(profile=_profile(*FOUR_X))
+        tuner.propose()
+        tuner.arm()
+        tuner.commit()
+        tuner.rollback(reason="manual")
+        policy_events = [e for e in tracing.events() if e.cat == "policy"]
+        assert [e.name for e in policy_events] == [
+            "policy/observe",
+            "policy/propose",
+            "policy/arm",
+            "policy/commit",
+            "policy/rollback",
+        ]
+        commit = policy_events[3]
+        assert commit.args["new_policy"]["every_n"] == 4
+        assert commit.args["applied"] is True
+        assert commit.args["rationale"]
+    finally:
+        tracing.stop()
+
+
+def test_policy_events_dark_when_disabled(mesh):
+    """Off-by-default telemetry: a disarmed/disabled run ledgers decisions
+    but records no flight-recorder events."""
+    tuner, _ = _tuner(mesh)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    assert tracing.events() == []
+    assert len(tuner.decision_ledger()) == 2  # the ledger is always on
+
+
+def test_prometheus_autotune_families(mesh):
+    obs.enable()
+    tuner, _ = _tuner(mesh, report_only=False)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    report = obs.registry.report()
+    report["autotune"] = tuner.report()
+    text = obs.export(report, fmt="prometheus")
+    assert 'tm_tpu_autotune_policy_info{' in text
+    assert 'every_n="4"' in text and 'state="committed"' in text
+    assert 'tm_tpu_autotune_transitions_total{action="commits"' in text
+    assert "tm_tpu_autotune_vetoes_total" in text
+    assert "tm_tpu_autotune_rollbacks_total" in text
+
+
+def test_policy_counters_on_target_telemetry(mesh):
+    obs.enable()
+    tuner, stepper = _tuner(mesh, report_only=False)
+    tuner.observe(profile=_profile(*FOUR_X))
+    tuner.propose()
+    tuner.arm()
+    tuner.commit()
+    tuner.rollback(reason="manual")
+    counters = obs.registry.telemetry_for(stepper.target).as_dict()["counters"]
+    assert counters["policy_commits"] == 1
+    assert counters["policy_rollbacks"] == 1
+    assert counters["policy_vetoes"] == 0

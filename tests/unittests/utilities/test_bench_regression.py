@@ -232,3 +232,26 @@ def test_bytes_keys_gate_lower_is_better():
         assert band_for(key) == 0.01, key  # analytic: tight band
     assert direction_for("detail.compressed_sync.byte_model.int8_byte_cut") == "higher"
     assert direction_for("detail.bf16_byte_cut") == "higher"
+
+
+def test_autotune_keys_gate_lower_is_better():
+    """Satellite: the autotune leg's sync wall times gate lower-is-better in
+    the wide timing band, while its transition retrace counters are analytic
+    lower-is-better quantities in the tight band."""
+    for key in (
+        "detail.autotune.sync_time.naive_sync_s",
+        "detail.autotune.sync_time.hand_tuned_sync_s",
+        "detail.autotune.sync_time.autotuned_sync_s",
+    ):
+        assert direction_for(key) == "lower", key
+        assert band_for(key) >= 0.60, key  # wall clock: wide
+    for key in (
+        "detail.autotune.transition_retraces.extra_retraces",
+        "detail.autotune.transition_retraces.extra_misses",
+        "detail.autotune.compression_transition.extra_misses",
+    ):
+        assert direction_for(key) == "lower", key
+        assert band_for(key) == 0.01, key  # analytic: tight
+    # convergence ratio and ledger/export smoke counts: higher is better
+    assert direction_for("detail.autotune.sync_time.naive_over_autotuned_cut") == "higher"
+    assert direction_for("detail.autotune.observability.prometheus_lines") == "higher"

@@ -125,6 +125,7 @@ def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
         MulticlassConfusionMatrix,
         MulticlassJaccardIndex,
     )
+    from torchmetrics_tpu.parallel.coalesce import SyncPolicy
     from torchmetrics_tpu.image import PeakSignalNoiseRatio
     from torchmetrics_tpu.regression import (
         MeanSquaredError,
@@ -132,8 +133,40 @@ def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
         R2Score,
     )
 
+    def autotuned(ctor: Callable[[], Any], inputs: Callable[[], Tuple[Any, ...]], policy: SyncPolicy):
+        # a committed-policy entry: trace_contract shapes the sync segment
+        # from the policy the SyncAutotuner installed on the metric, so the
+        # snapshot proves a policy transition changes nothing outside it
+        def factory():
+            metric = ctor()
+            metric.__dict__["_autotuned_policy"] = policy
+            return metric, inputs()
+
+        return factory
+
+    # the calibration bins are sized so the float32 sum bucket clears the
+    # compression byte floor (2 x 1024 x 4 B >= DEFAULT_MIN_BUCKET_BYTES):
+    # the bf16/int8 snapshots then capture a genuinely compressed lowering
+    calib1024 = lambda: BinaryCalibrationError(n_bins=1024)
+
     return {
         "BinaryAccuracy": make(BinaryAccuracy, _binary_inputs),
+        "BinaryCalibrationError1024": make(calib1024, _binary_inputs),
+        "BinaryCalibrationError1024__bf16": autotuned(
+            calib1024,
+            _binary_inputs,
+            SyncPolicy(every_n_steps=4, compression="bf16", error_budget=5e-2),
+        ),
+        "BinaryCalibrationError1024__int8": autotuned(
+            calib1024,
+            _binary_inputs,
+            SyncPolicy(every_n_steps=4, compression="int8", error_budget=5e-2),
+        ),
+        "MulticlassAccuracy__every4": autotuned(
+            lambda: MulticlassAccuracy(num_classes=5),
+            _multiclass_inputs,
+            SyncPolicy(every_n_steps=4),
+        ),
         "BinaryAUROC": make(lambda: BinaryAUROC(thresholds=16), _binary_inputs),
         "BinaryCalibrationError": make(lambda: BinaryCalibrationError(n_bins=10), _binary_inputs),
         "BinaryConfusionMatrix": make(BinaryConfusionMatrix, _binary_inputs),
@@ -174,7 +207,16 @@ def trace_contract(
     mesh: Optional[Any] = None,
     axis_name: str = "data",
 ) -> Dict[str, Any]:
-    """The (update, sync) trace contract of one metric on one mesh."""
+    """The (update, sync) trace contract of one metric on one mesh.
+
+    A committed autotuner policy on the metric
+    (``metric.__dict__["_autotuned_policy"]``, the override
+    ``parallel/autotune.py`` installs) shapes the *sync* segment the way the
+    live flow would lower it — a compression mode traces the compressed
+    bucket plan — and is snapshotted under a ``"policy"`` key.  The update
+    segment never depends on the policy: that invariance is exactly what the
+    autotuned golden entries prove.
+    """
     from torchmetrics_tpu.analysis.audit import _default_mesh, _trace_sync
     from torchmetrics_tpu.analysis.donation import donation_mask
     from torchmetrics_tpu.analysis.uniformity import collective_sequence
@@ -184,13 +226,44 @@ def trace_contract(
     state = metric.update_state(metric.init_state(), *inputs)
 
     jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
-    jx_sync = _trace_sync(lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name)
+    policy = metric.__dict__.get("_autotuned_policy")
+    compression = policy.compression_config if policy is not None else None
+    if compression is None:
+        jx_sync = _trace_sync(
+            lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name
+        )
+    else:
+        from torchmetrics_tpu.parallel.coalesce import _metric_entry, coalesced_sync_state
+
+        reductions, sub = _metric_entry(metric, state)
+        keys = tuple(sub)
+        jx_sync = _trace_sync(
+            lambda st: coalesced_sync_state(
+                {k: st[k] for k in keys}, reductions, axis_name, compression=compression
+            ),
+            state,
+            the_mesh,
+            axis_name,
+        )
 
     mask = donation_mask(metric, "update", *inputs)
+    contract_policy = (
+        {}
+        if policy is None
+        else {
+            "policy": {
+                "every_n": None if policy.at_compute else policy.every_n_steps,
+                "at_compute": bool(policy.at_compute),
+                "compression": policy.compression,
+                "error_budget": policy.error_budget,
+            }
+        }
+    )
     return {
         "schema": CONTRACT_SCHEMA_VERSION,
         "metric": type(metric).__name__,
         "mesh": _mesh_descriptor(the_mesh, axis_name),
+        **contract_policy,
         "entrypoints": {
             "update": {
                 "primitives": _primitive_multiset(jx_update),

@@ -88,6 +88,7 @@ __all__ = [
     "cache_capacity",
     "cache_size",
     "cache_stats",
+    "cache_stats_since",
     "compile_time_by_fingerprint",
     "compile_timeline",
     "explain_retrace",
@@ -341,6 +342,43 @@ def cache_stats() -> Dict[str, Any]:
         out["miss_causes"] = dict(_MISS_CAUSE_COUNTS)
         out["cold_start"] = dict(_COLD_START_TOTALS)
         return out
+
+
+def cache_stats_since(baseline: Mapping[str, Any]) -> Dict[str, Any]:
+    """Compile-cache traffic since a :func:`cache_stats` ``baseline`` snapshot,
+    with per-cause miss attribution.
+
+    The observer-side primitive behind policy-transition audits: the
+    :class:`~torchmetrics_tpu.parallel.autotune.SyncAutotuner` snapshots a
+    baseline at commit time and judges the delta against the ledgered
+    expectation (an ``every_n`` change must show zero misses; a compression
+    change exactly one ``new-key`` miss on the ``cadence`` entrypoint).
+    ``miss_causes``/``by_entrypoint`` keep only the keys that moved.
+    """
+    now = cache_stats()
+    out: Dict[str, Any] = {
+        field: int(now.get(field, 0)) - int(baseline.get(field, 0))
+        for field in ("hits", "misses", "traces", "evictions")
+    }
+    base_causes = baseline.get("miss_causes", {})
+    out["miss_causes"] = {
+        cause: n - int(base_causes.get(cause, 0))
+        for cause, n in now.get("miss_causes", {}).items()
+        if n != int(base_causes.get(cause, 0))
+    }
+    base_kinds = baseline.get("by_entrypoint", {})
+    by_kind: Dict[str, Dict[str, int]] = {}
+    for kind, slot in now.get("by_entrypoint", {}).items():
+        base_slot = base_kinds.get(kind, {})
+        moved = {
+            event: int(n) - int(base_slot.get(event, 0))
+            for event, n in slot.items()
+            if int(n) != int(base_slot.get(event, 0))
+        }
+        if moved:
+            by_kind[kind] = moved
+    out["by_entrypoint"] = by_kind
+    return out
 
 
 def cache_size() -> int:
@@ -1394,5 +1432,8 @@ def compiled_cadence_sync(
         kind="cadence",
         owner=owner,
         fingerprint=fp,
-        residual=("cadence_sync", mesh, axis_name),
+        # compression joins the residual as well as the key: the first sync
+        # under a new mode is a new configuration ("new-key"), not a re-miss
+        # of the exact-mode entry ("eviction")
+        residual=("cadence_sync", mesh, axis_name, compression),
     )

@@ -53,8 +53,12 @@ __all__ = [
 #: Prometheus family, ``pid = jax.process_index()`` plus
 #: ``process_name``/``thread_name`` metadata events in Chrome traces), the
 #: merged fleet report (``fleet``/``per_process`` blocks), and health-monitor
-#: payloads (``health`` block, ``health_alert`` JSONL lines).
-SCHEMA_VERSION = "1.3.0"
+#: payloads (``health`` block, ``health_alert`` JSONL lines); 1.4 added the
+#: closed-loop autotuner — ``autotune_decision`` JSONL ledger lines,
+#: ``sync_advice`` recommendation lines, the ``autotune`` report block with
+#: its ``tm_tpu_autotune_*`` Prometheus families, and the ``policy``
+#: flight-recorder category.
+SCHEMA_VERSION = "1.4.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -121,6 +125,9 @@ _COUNTER_HELP = {
     "nonfinite_events": "Non-finite update batches observed by nan_strategy guards.",
     "snapshots": "Resilience snapshots taken.",
     "restores": "State restores (resilience restore / load_state_*).",
+    "policy_commits": "SyncAutotuner policy commits applied to this metric's sync path.",
+    "policy_vetoes": "SyncAutotuner pending commits vetoed by a guardrail.",
+    "policy_rollbacks": "SyncAutotuner committed policies rolled back.",
 }
 
 
@@ -413,6 +420,40 @@ class PrometheusExporter(Exporter):
                     out.append(
                         f"{hv_name}{_labels(series=sname, process=proc)} {repr(float(val))}"
                     )
+
+        # autotuner payloads (parallel/autotune.py reports) ride the same
+        # exposition: current policy as an info gauge, decision counters
+        autotune = report.get("autotune")
+        if isinstance(autotune, Mapping):
+            pol = autotune.get("policy") or {}
+            ap_name = f"{ns}_autotune_policy_info"
+            out.append(
+                f"# HELP {ap_name} Current sync policy under autotuner control "
+                "(info-style gauge: value is always 1, the policy rides the labels)."
+            )
+            out.append(f"# TYPE {ap_name} gauge")
+            out.append(
+                f"{ap_name}{_labels(every_n=pol.get('every_n'), at_compute=pol.get('at_compute'), compression=pol.get('compression'), state=autotune.get('state'), process=proc)} 1"
+            )
+            counts = autotune.get("counts", {})
+            at_name = f"{ns}_autotune_transitions_total"
+            out.append(
+                f"# HELP {at_name} Autotuner state-machine decisions by action "
+                "(commits count applied policy switches)."
+            )
+            out.append(f"# TYPE {at_name} counter")
+            for action in ("observations", "proposals", "trials", "commits", "transitions"):
+                out.append(
+                    f"{at_name}{_labels(action=action, process=proc)} {int(counts.get(action, 0))}"
+                )
+            av_name = f"{ns}_autotune_vetoes_total"
+            out.append(f"# HELP {av_name} Pending commits vetoed by a guardrail.")
+            out.append(f"# TYPE {av_name} counter")
+            out.append(f"{av_name}{_labels(process=proc)} {int(counts.get('vetoes', 0))}")
+            ar_name = f"{ns}_autotune_rollbacks_total"
+            out.append(f"# HELP {ar_name} Committed policies rolled back.")
+            out.append(f"# TYPE {ar_name} counter")
+            out.append(f"{ar_name}{_labels(process=proc)} {int(counts.get('rollbacks', 0))}")
 
         text = "\n".join(out) + "\n"
         if self.path is not None:

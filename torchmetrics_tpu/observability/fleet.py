@@ -276,6 +276,14 @@ class FleetView:
         """Index of the process with the largest measured sync wait."""
         return int(self.skew()["straggler"]["process"])
 
+    def straggler_bound(self, threshold: float = 2.0) -> bool:
+        """True when one process dominates the measured sync wait (its
+        wait is ``threshold``x the fleet median or more).  The
+        :class:`~torchmetrics_tpu.parallel.autotune.SyncAutotuner` consults
+        this before committing: a straggler-bound fleet gains nothing from
+        cadence/compression tuning — the straggling host is the lever."""
+        return float(self.skew()["straggler"]["vs_median"]) >= float(threshold)
+
     # -------------------------------------------------------------- report
     def report(self) -> Dict[str, Any]:
         """The pod-global merged report (per-process breakdown retained)."""

@@ -85,6 +85,9 @@ COUNTER_NAMES = (
     "nonfinite_events",
     "snapshots",
     "restores",
+    "policy_commits",
+    "policy_vetoes",
+    "policy_rollbacks",
 )
 
 #: Upper edges (microseconds) of the fixed span histogram; one overflow

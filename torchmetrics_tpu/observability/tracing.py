@@ -64,7 +64,7 @@ __all__ = [
 
 #: event categories the recorder emits (the ``cat`` field); Perfetto's track
 #: filter groups on these
-CATEGORIES = ("eager", "sync", "compile", "resilience", "guard")
+CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy")
 
 DEFAULT_CAPACITY = 4096
 

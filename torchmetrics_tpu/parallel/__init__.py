@@ -22,9 +22,20 @@ The reference's entire comm backend is ``gather_all_tensors``
 * :mod:`~torchmetrics_tpu.parallel.compress` — opt-in compressed collectives
   (:class:`CompressionConfig` / per-bucket :class:`CompressionSpec`): bf16 or
   two-phase int8 quantized bucket all-reduces and bitpacked ragged gathers,
-  surfaced through ``SyncPolicy(compression=..., error_budget=...)``.
+  surfaced through ``SyncPolicy(compression=..., error_budget=...)``;
+* :mod:`~torchmetrics_tpu.parallel.autotune` — the closed control loop over
+  all of the above (:class:`SyncAutotuner`): sets :class:`SyncPolicy` on
+  running flows from live telemetry through an observe → candidate → trial →
+  commit | rollback state machine, with flight-recorded decisions, a JSONL
+  decision ledger, and health-monitor/divergence guardrails.  Report-only by
+  default, like :class:`SyncAdvisor`.
 """
 
+from torchmetrics_tpu.parallel.autotune import (
+    SyncAutotuner,
+    committed_policy,
+    policy_dict,
+)
 from torchmetrics_tpu.parallel.compress import CompressionConfig, CompressionSpec
 from torchmetrics_tpu.parallel.coalesce import (
     SyncAdvisor,
@@ -60,6 +71,7 @@ __all__ = [
     "CompressionSpec",
     "DeferredRaggedSync",
     "SyncAdvisor",
+    "SyncAutotuner",
     "SyncPolicy",
     "SyncStepper",
     "apply_sync_plan",
@@ -69,11 +81,13 @@ __all__ = [
     "coalesced_host_sync",
     "coalesced_metric_sync",
     "coalesced_sync_state",
+    "committed_policy",
     "distributed_available",
     "flush_sync",
     "gather_all_arrays",
     "metric_mesh",
     "per_leaf_collective_count",
+    "policy_dict",
     "reduce_op",
     "sharded_collection_update",
     "sharded_list_update",

@@ -1,0 +1,670 @@
+"""Closed-loop sync autotuning: measured telemetry in, committed ``SyncPolicy`` out.
+
+:class:`~torchmetrics_tpu.parallel.coalesce.SyncAdvisor` (PR 6/8/10) measures
+candidate cadences, models per-mode wire bytes, and folds fleet skew — but it
+only *prints* advice.  :class:`SyncAutotuner` promotes that advice to an
+in-band controller that **sets** the policy on a running
+:class:`~torchmetrics_tpu.parallel.coalesce.SyncStepper` /
+``sharded_update(sync_policy=...)`` flow, through an explicit state machine
+whose every transition is itself an observable event::
+
+                 propose()              arm()                commit()
+    observe  ───────────────▶ candidate ──────▶ trial ─────────────────▶ committed
+       ▲                                          │                          │
+       │          veto (health alert / divergence │ / manual)               │
+       └──────────────────────────────────────────┘                          │
+       ◀──────────────────── rollback (guardrail / manual) ──────────────────┘
+
+Safety properties, in decreasing order of importance:
+
+* **Report-only by default.**  Like the advisor, a ``SyncAutotuner()`` never
+  mutates anything: ``commit()`` ledgers the decision with ``applied: false``.
+  Pass ``report_only=False`` to let commits actually set the policy.
+* **Guardrails are in-band.**  Wire ``monitor.add_sink(tuner.guardrail_sink())``
+  and any :class:`~torchmetrics_tpu.observability.health.HealthMonitor` alert
+  at/above ``veto_severity`` vetoes a pending trial or rolls back a committed
+  policy the moment it fires; a
+  :class:`~torchmetrics_tpu.utilities.exceptions.ReplicaDivergenceError` from
+  the divergence verifier does the same through :meth:`report_divergence`.
+  The veto/rollback is itself a ledgered decision.
+* **Trace-safe transitions.**  ``every_n`` is *not* part of the cadence
+  compile-cache keys (the pending counter is host-side), so cadence commits
+  reuse the existing carry with zero new compile-cache entries; a compression
+  change keys a new ``cadence_sync`` entry, so it is ledgered against its
+  known one-time ``new-key`` miss (``expected_retraces``) and
+  :meth:`retrace_report` proves the accounting against
+  ``cache_stats()['miss_causes']``.
+* **Every decision is observable** three ways: Chrome-trace instant events
+  under the ``"policy"`` category in the flight recorder, the queryable
+  :meth:`decision_ledger` (JSONL through the export front door, stamped with
+  ``schema_version`` + process identity), and ``tm_tpu_autotune_*``
+  Prometheus families rendered from :meth:`report`.
+"""
+
+import copy
+from typing import Any, Dict, List, Mapping, Optional, Sequence
+
+from torchmetrics_tpu.parallel.coalesce import (
+    SyncAdvisor,
+    SyncPolicy,
+    SyncStepper,
+)
+from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+__all__ = [
+    "AUTOTUNE_ACTIONS",
+    "AUTOTUNE_STATES",
+    "SyncAutotuner",
+    "committed_policy",
+    "policy_dict",
+]
+
+#: the state machine's states, in commit order
+AUTOTUNE_STATES = ("observe", "candidate", "trial", "committed")
+#: every action a ledger entry may carry
+AUTOTUNE_ACTIONS = (
+    "observe",
+    "propose",
+    "arm",
+    "commit",
+    "veto",
+    "rollback",
+    "audit",
+)
+
+#: ``kind`` stamp on every ledger entry (JSONL consumers filter on it)
+LEDGER_KIND = "autotune_decision"
+
+
+def policy_dict(policy: Optional[SyncPolicy]) -> Optional[Dict[str, Any]]:
+    """Stable JSON shape of a :class:`SyncPolicy` for ledger/export payloads."""
+    if policy is None:
+        return None
+    return {
+        "every_n": None if policy.at_compute else policy.every_n_steps,
+        "at_compute": bool(policy.at_compute),
+        "compression": policy.compression,
+        "error_budget": policy.error_budget,
+    }
+
+
+def committed_policy(target: Any) -> Optional[SyncPolicy]:
+    """The policy a :class:`SyncAutotuner` committed onto ``target`` —
+    ``sharded_update``/``sharded_collection_update`` consult this override
+    before the hand-passed ``sync_policy``.  ``None`` without a commit."""
+    return target.__dict__.get("_autotuned_policy")
+
+
+class SyncAutotuner:
+    """Drive :class:`SyncPolicy` for one metric/collection from live telemetry.
+
+    ``target`` is the metric or collection whose sync path is tuned, or a
+    :class:`SyncStepper` already driving it (the stepper's mesh/axis/policy
+    are then adopted).  The tuned knobs are the ``every_n`` cadence, the
+    compression mode within the declared ``error_budget``, and the ICI/DCN
+    two-stage host-sync toggle (decided from fleet skew + the DCN byte
+    model; exposed as :attr:`two_stage` for ``coalesced_host_sync`` callers).
+
+    Example (the walkthrough in ``examples/autotune_walkthrough.py``)::
+
+        tuner = SyncAutotuner(stepper, report_only=False, error_budget=1e-2)
+        monitor.add_sink(tuner.guardrail_sink())   # alerts veto/roll back
+
+        tuner.observe(preds, target, steps=16)     # measure candidates
+        tuner.propose()                            # pick a candidate policy
+        tuner.arm()                                # stage it for commit
+        tuner.commit()                             # guarded policy switch
+        tuner.decision_ledger()                    # every decision, queryable
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        mesh: Optional[Any] = None,
+        axis_name: str = "data",
+        candidates: Sequence[int] = (1, 2, 4, 8),
+        target_cut: float = 3.5,
+        max_staleness: int = 8,
+        error_budget: Optional[float] = None,
+        report_only: bool = True,
+        veto_severity: str = "warning",
+        in_specs: Optional[Any] = None,
+    ) -> None:
+        from torchmetrics_tpu.observability.health import _severity_rank
+        from torchmetrics_tpu.parallel.sync import metric_mesh
+
+        if isinstance(target, SyncStepper):
+            self._stepper: Optional[SyncStepper] = target
+            self.target = target.target
+            self.mesh = target.mesh
+            self.axis_name = target.axis_name
+            self.in_specs = target.in_specs
+        else:
+            self._stepper = None
+            self.target = target
+            self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
+            self.axis_name = axis_name
+            self.in_specs = in_specs
+        _severity_rank(veto_severity)  # validates
+        self.veto_severity = veto_severity
+        self.report_only = bool(report_only)
+        self.target_cut = float(target_cut)
+        self.error_budget = error_budget
+        self.advisor = SyncAdvisor(
+            self.target,
+            mesh=self.mesh,
+            axis_name=self.axis_name,
+            in_specs=self.in_specs,
+            candidates=candidates,
+            max_staleness=max_staleness,
+            error_budget=error_budget,
+        )
+        self.state = "observe"
+        #: committed two-stage ICI/DCN decision (None until a commit carries one)
+        self.two_stage: Optional[bool] = None
+        self._seq = 0
+        self._ledger: List[Dict[str, Any]] = []
+        self._candidate: Optional[Dict[str, Any]] = None
+        self._previous: Optional[SyncPolicy] = None  # policy to roll back to
+        self._commit_cache_baseline: Optional[Dict[str, Any]] = None
+        self._expected_retraces: Dict[str, Any] = {"new_keys": 0, "cause": None}
+        self.counts: Dict[str, int] = {
+            "observations": 0,
+            "proposals": 0,
+            "trials": 0,
+            "commits": 0,
+            "transitions": 0,
+            "vetoes": 0,
+            "rollbacks": 0,
+        }
+
+    # ------------------------------------------------------------- live flow
+    def _live_stepper(self) -> Optional[SyncStepper]:
+        """The stepper actually running: the explicit one, else the cadence
+        stepper ``sharded_update(sync_policy=...)`` cached on the target."""
+        if self._stepper is not None:
+            return self._stepper
+        return self.target.__dict__.get("_cadence_stepper")
+
+    def current_policy(self) -> SyncPolicy:
+        """The policy the live flow runs under right now."""
+        stepper = self._live_stepper()
+        if stepper is not None:
+            return stepper.policy
+        override = committed_policy(self.target)
+        return override if override is not None else SyncPolicy()
+
+    # ---------------------------------------------------------- state machine
+    def observe(
+        self,
+        *inputs: Any,
+        steps: int = 16,
+        rounds: int = 3,
+        profile: Optional[Mapping[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Measure the candidate cadences (``SyncAdvisor.profile``) — or adopt
+        a prebuilt profile dict — and (re)enter the ``observe`` state."""
+        if profile is None:
+            profile = self.advisor.profile(*inputs, steps=steps, rounds=rounds)
+        else:
+            self.advisor._profile = dict(profile)
+        prior = self.state
+        self.state = "observe"
+        self._candidate = None
+        self.counts["observations"] += 1
+        self._record(
+            "observe",
+            state_from=prior,
+            trigger={
+                "steps": profile.get("steps"),
+                "n_devices": profile.get("n_devices"),
+                "cadences": [r["every_n"] for r in profile.get("runs", ())],
+            },
+            rationale="measured candidate cadences under live telemetry",
+        )
+        return dict(profile)
+
+    def propose(
+        self, target_cut: Optional[float] = None, fleet: Optional[Any] = None
+    ) -> Dict[str, Any]:
+        """Derive the candidate policy from the measured profile: the advisor's
+        cadence pick, the strongest compression mode within ``error_budget``,
+        and the two-stage DCN toggle from fleet context."""
+        cut = self.target_cut if target_cut is None else float(target_cut)
+        rec = self.advisor.recommend(target_cut=cut, fleet=fleet)
+        mode = rec["compression"]["recommended_mode"]
+        policy = SyncPolicy(
+            every_n_steps=int(rec["every_n"]),
+            compression=mode,
+            error_budget=self.error_budget if mode != "none" else None,
+        )
+        two_stage = self._two_stage_advice(fleet)
+        if fleet is not None and hasattr(fleet, "straggler_bound"):
+            straggler_bound = bool(fleet.straggler_bound())
+        else:
+            straggler_bound = bool(
+                fleet is not None
+                and rec.get("fleet", {}).get("wait_skew_ratio", 1.0) >= 2.0
+            )
+        self._candidate = {
+            "policy": policy,
+            "two_stage": two_stage,
+            "recommendation": rec,
+            "straggler_bound": straggler_bound,
+        }
+        prior = self.state
+        self.state = "candidate"
+        self.counts["proposals"] += 1
+        self._record(
+            "propose",
+            state_from=prior,
+            old_policy=self.current_policy(),
+            new_policy=policy,
+            trigger={
+                "measured_cut": rec["measured_cut"],
+                "target_cut": cut,
+                "baseline_sync_s": rec["baseline_sync_s"],
+                "sync_s": rec["sync_s"],
+                "two_stage": two_stage,
+            },
+            rationale=(
+                f"smallest cadence with measured sync cut >= {cut}"
+                + (f"; compression {mode} within error budget" if mode != "none" else "")
+                + ("; straggler-bound fleet: cadence is the wrong lever" if straggler_bound else "")
+            ),
+        )
+        return self.candidate()
+
+    def candidate(self) -> Optional[Dict[str, Any]]:
+        """JSON view of the current candidate (``None`` outside candidate/trial)."""
+        if self._candidate is None:
+            return None
+        out = {
+            "policy": policy_dict(self._candidate["policy"]),
+            "two_stage": self._candidate["two_stage"],
+            "straggler_bound": self._candidate["straggler_bound"],
+        }
+        return out
+
+    def arm(self) -> Dict[str, Any]:
+        """Stage the candidate for commit: enter ``trial``, during which any
+        guardrail alert vetoes the pending policy before it ever applies."""
+        if self.state != "candidate" or self._candidate is None:
+            raise RuntimeError(
+                f"SyncAutotuner.arm: no candidate to stage (state {self.state!r}); "
+                "call propose() first"
+            )
+        self.state = "trial"
+        self.counts["trials"] += 1
+        return self._record(
+            "arm",
+            state_from="candidate",
+            old_policy=self.current_policy(),
+            new_policy=self._candidate["policy"],
+            rationale="candidate staged; guardrails may veto until commit()",
+        )
+
+    def commit(self) -> Dict[str, Any]:
+        """Apply the staged candidate to the live flow (or ledger it only, in
+        report-only mode).  A guardrail alert that fired during the trial has
+        already vetoed it — commit then raises.  Divergence during the policy
+        switch itself vetoes and re-raises."""
+        if self.state != "trial" or self._candidate is None:
+            raise RuntimeError(
+                f"SyncAutotuner.commit: no staged trial (state {self.state!r}) — "
+                "it may have been vetoed by a guardrail; check decision_ledger()"
+            )
+        policy = self._candidate["policy"]
+        old = self.current_policy()
+        expected = self._expected_retraces_for(old, policy)
+        applied = not self.report_only
+        if applied:
+            from torchmetrics_tpu.core.compile import cache_stats
+
+            self._commit_cache_baseline = cache_stats()
+            try:
+                self._apply(old, policy)
+            except ReplicaDivergenceError as err:
+                self._veto("divergence", error=str(err))
+                raise
+        self._previous = old
+        self._expected_retraces = expected
+        self.two_stage = bool(self._candidate["two_stage"]["enabled"])
+        self.state = "committed"
+        self.counts["commits"] += 1
+        if applied:
+            self.counts["transitions"] += 1
+        self._count_target("policy_commits")
+        entry = self._record(
+            "commit",
+            state_from="trial",
+            old_policy=old,
+            new_policy=policy,
+            applied=applied,
+            trigger=self._candidate_trigger(),
+            expected_retraces=expected,
+            rationale=(
+                "policy committed to live flow"
+                if applied
+                else "report-only: decision ledgered, policy untouched "
+                "(construct with report_only=False to apply)"
+            ),
+        )
+        self._candidate = None
+        return entry
+
+    def veto(self, reason: str = "manual", alert: Optional[Any] = None) -> Dict[str, Any]:
+        """Veto the pending trial (guardrails call this through
+        :meth:`guardrail_sink`; callers may veto manually)."""
+        if self.state != "trial":
+            raise RuntimeError(
+                f"SyncAutotuner.veto: no pending trial to veto (state {self.state!r})"
+            )
+        return self._veto(reason, alert=alert)
+
+    def rollback(
+        self,
+        reason: str = "manual",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Restore the pre-commit policy on the live flow and ledger why."""
+        if self.state != "committed" or self._previous is None:
+            raise RuntimeError(
+                f"SyncAutotuner.rollback: nothing committed to roll back "
+                f"(state {self.state!r})"
+            )
+        committed = self.current_policy() if not self.report_only else None
+        restore = self._previous
+        if not self.report_only:
+            self._apply(committed, restore)
+        self.counts["rollbacks"] += 1
+        self._count_target("policy_rollbacks")
+        entry = self._record(
+            "rollback",
+            state_from="committed",
+            state_to="observe",
+            old_policy=committed,
+            new_policy=restore,
+            applied=not self.report_only,
+            alert=alert,
+            error=error,
+            rationale=f"rolled back committed policy: {reason}",
+        )
+        self.state = "observe"
+        self._previous = None
+        self.two_stage = None
+        return entry
+
+    # ------------------------------------------------------------- guardrails
+    def guardrail_sink(self, min_severity: Optional[str] = None) -> Any:
+        """An ``AlertSink`` that wires :class:`HealthMonitor` alerts into the
+        control loop: ``monitor.add_sink(tuner.guardrail_sink())``.  Alerts
+        at/above ``min_severity`` (default: the tuner's ``veto_severity``)
+        veto a pending trial or roll back a committed policy, in-band."""
+        from torchmetrics_tpu.observability.health import CallbackAlertSink
+
+        return CallbackAlertSink(
+            self._on_alert,
+            min_severity=self.veto_severity if min_severity is None else min_severity,
+        )
+
+    def _on_alert(self, alert: Any) -> None:
+        if self.state == "trial":
+            self._veto("health_alert", alert=alert)
+        elif self.state == "committed" and self._previous is not None:
+            self.rollback(reason="health_alert", alert=alert)
+
+    def report_divergence(self, error: Exception) -> Optional[Dict[str, Any]]:
+        """Feed a :class:`ReplicaDivergenceError` raised by the divergence
+        verifier into the loop: veto the pending trial or roll back the
+        committed policy.  Returns the ledgered decision (``None`` when the
+        loop has nothing to act on)."""
+        if self.state == "trial":
+            return self._veto("divergence", error=str(error))
+        if self.state == "committed" and self._previous is not None:
+            return self.rollback(reason="divergence", error=str(error))
+        return None
+
+    def _veto(
+        self, reason: str, alert: Optional[Any] = None, error: Optional[str] = None
+    ) -> Dict[str, Any]:
+        vetoed = self._candidate["policy"] if self._candidate else None
+        self.counts["vetoes"] += 1
+        self._count_target("policy_vetoes")
+        entry = self._record(
+            "veto",
+            state_from=self.state,
+            state_to="observe",
+            old_policy=self.current_policy(),
+            new_policy=vetoed,
+            applied=False,
+            alert=alert,
+            error=error,
+            rationale=f"pending commit vetoed: {reason}",
+        )
+        self.state = "observe"
+        self._candidate = None
+        return entry
+
+    # ------------------------------------------------------------ application
+    def _apply(self, old: Optional[SyncPolicy], policy: SyncPolicy) -> None:
+        """Switch the live flow to ``policy``.
+
+        ``every_n``-only changes apply mid-window (the pending counter simply
+        compares against the new threshold; the cadence compile keys do not
+        contain ``every_n``, so the carry and its compiled step/sync are
+        reused verbatim).  A compression change first flushes the open window
+        so it syncs under the policy it accumulated under — the one new
+        ``cadence_sync`` key then keys the *next* window's sync.
+        """
+        stepper = self._live_stepper()
+        if stepper is not None:
+            if (
+                old is not None
+                and stepper.pending
+                and policy.compression != old.compression
+            ):
+                stepper.sync()  # may raise ReplicaDivergenceError -> veto in commit()
+            stepper.policy = policy
+        # future cadence_stepper resolutions (sharded_update flows) pick the
+        # committed policy up through this override, even when the caller
+        # still passes the stale hand-chosen one
+        self.target.__dict__["_autotuned_policy"] = policy
+
+    def _expected_retraces_for(
+        self, old: SyncPolicy, new: SyncPolicy
+    ) -> Dict[str, Any]:
+        if old.compression == new.compression:
+            return {"new_keys": 0, "cause": None, "entrypoint": None}
+        # compression joins the cadence_sync cache key: exactly one new-key
+        # miss when the first window under the new mode syncs
+        return {"new_keys": 1, "cause": "new-key", "entrypoint": "cadence"}
+
+    def retrace_report(self) -> Dict[str, Any]:
+        """Compile-cache delta since the last applied commit, judged against
+        the ledgered expectation — the proof that a cadence transition was
+        retrace-free and a compression transition cost exactly its known
+        ``new-key`` miss.  Ledgered as an ``audit`` decision."""
+        from torchmetrics_tpu.core.compile import cache_stats_since
+
+        if self._commit_cache_baseline is None:
+            raise RuntimeError(
+                "SyncAutotuner.retrace_report: no applied commit to audit "
+                "(report-only commits never touch the cache)"
+            )
+        delta = cache_stats_since(self._commit_cache_baseline)
+        delta_causes = delta["miss_causes"]
+        extra_traces = int(delta["traces"])
+        extra_misses = int(delta["misses"])
+        expected = self._expected_retraces
+        ok = (
+            extra_misses <= expected["new_keys"]
+            and sum(delta_causes.values()) <= expected["new_keys"]
+            and all(cause == expected["cause"] for cause in delta_causes)
+        )
+        audit = {
+            "extra_traces": extra_traces,
+            "extra_misses": extra_misses,
+            "miss_causes": delta_causes,
+            "expected": dict(expected),
+            "ok": bool(ok),
+        }
+        self._record(
+            "audit",
+            state_from=self.state,
+            state_to=self.state,
+            trigger=audit,
+            rationale=(
+                "trace-safety audit: cache delta since commit matches the "
+                "ledgered expectation"
+                if ok
+                else "trace-safety audit FAILED: unexpected compile-cache traffic "
+                "since commit"
+            ),
+        )
+        return audit
+
+    # ----------------------------------------------------------- observability
+    def decision_ledger(self) -> List[Dict[str, Any]]:
+        """Every decision this tuner took, oldest first — stable schema
+        (``kind == "autotune_decision"``), safe to mutate."""
+        return copy.deepcopy(self._ledger)
+
+    def export_ledger(
+        self, path: Optional[str] = None, stream: Optional[Any] = None
+    ) -> List[str]:
+        """Write the ledger through the export front door: one JSONL line per
+        decision, each stamped with ``schema_version`` + process identity and
+        parseable back via ``observability.parse_export_line``."""
+        from torchmetrics_tpu.observability.export import JSONLinesExporter
+
+        exporter = JSONLinesExporter(path=path, stream=stream)
+        return [exporter.export(entry) for entry in self._ledger]
+
+    def report(self) -> Dict[str, Any]:
+        """The ``autotune`` block for the export front door: merge it into a
+        telemetry report (``report["autotune"] = tuner.report()``) and the
+        Prometheus exporter renders the ``tm_tpu_autotune_*`` families."""
+        return {
+            "state": self.state,
+            "report_only": self.report_only,
+            "policy": policy_dict(self.current_policy()),
+            "two_stage": self.two_stage,
+            "counts": dict(self.counts),
+            "decisions": len(self._ledger),
+        }
+
+    # -------------------------------------------------------------- internals
+    def _candidate_trigger(self) -> Dict[str, Any]:
+        rec = self._candidate["recommendation"]
+        return {
+            "measured_cut": rec["measured_cut"],
+            "baseline_sync_s": rec["baseline_sync_s"],
+            "sync_s": rec["sync_s"],
+            "sync_wire_bytes": rec["sync_wire_bytes"],
+            "two_stage": self._candidate["two_stage"],
+        }
+
+    def _two_stage_advice(self, fleet: Optional[Any]) -> Dict[str, Any]:
+        """Decide the ICI/DCN two-stage toggle: pays only with >1 process, by
+        the DCN byte model (``two_stage_dcn_bytes``)."""
+        from torchmetrics_tpu.utilities.benchmark import two_stage_dcn_bytes
+
+        skew = None
+        if fleet is not None:
+            skew = fleet.skew() if hasattr(fleet, "skew") else dict(fleet)
+        n_proc = int(skew.get("n_processes", 1)) if skew else 1
+        if n_proc <= 1:
+            return {
+                "enabled": False,
+                "n_processes": n_proc,
+                "rationale": "single process: no DCN stage to coalesce",
+            }
+        flat = two = 0
+        n_local = max(int(self.mesh.devices.size) // n_proc, 1)
+        for m in self.advisor._member_metrics():
+            dcn = two_stage_dcn_bytes(
+                m._reductions, m._state, n_hosts=n_proc, n_local_devices=n_local
+            )
+            flat += dcn["flat"]
+            two += dcn["two_stage"]
+        enabled = two > 0 and flat > two
+        return {
+            "enabled": bool(enabled),
+            "n_processes": n_proc,
+            "model_flat_bytes": int(flat),
+            "model_two_stage_bytes": int(two),
+            "model_cut": round(flat / two, 2) if two else None,
+            "rationale": (
+                "two-stage ICI/DCN sync cuts modelled cross-host bytes"
+                if enabled
+                else "flat host sync is already minimal for this state"
+            ),
+        }
+
+    def _count_target(self, name: str) -> None:
+        from torchmetrics_tpu.observability import registry as _telemetry
+
+        _telemetry.count(self.target, name)
+
+    def _record(
+        self,
+        action: str,
+        state_from: str,
+        state_to: Optional[str] = None,
+        old_policy: Optional[SyncPolicy] = None,
+        new_policy: Optional[SyncPolicy] = None,
+        applied: Optional[bool] = None,
+        trigger: Optional[Mapping[str, Any]] = None,
+        rationale: str = "",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+        expected_retraces: Optional[Mapping[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        entry: Dict[str, Any] = {
+            "kind": LEDGER_KIND,
+            "seq": self._seq,
+            "action": action,
+            "state_from": state_from,
+            "state_to": self.state if state_to is None else state_to,
+            "old_policy": policy_dict(old_policy),
+            "new_policy": policy_dict(new_policy),
+            "applied": bool(applied) if applied is not None else None,
+            "report_only": self.report_only,
+            "trigger": dict(trigger) if trigger else {},
+            "rationale": rationale,
+        }
+        if alert is not None:
+            entry["alert"] = alert.as_dict() if hasattr(alert, "as_dict") else dict(alert)
+        if error is not None:
+            entry["error"] = error
+        if expected_retraces is not None:
+            entry["expected_retraces"] = dict(expected_retraces)
+        self._seq += 1
+        self._ledger.append(entry)
+        self._flight_record(entry)
+        return copy.deepcopy(entry)
+
+    def _flight_record(self, entry: Mapping[str, Any]) -> None:
+        """Chrome-trace instant under the ``policy`` category — old/new
+        policy, trigger measurement, and rationale ride the args."""
+        from torchmetrics_tpu.observability import tracing
+
+        if not tracing.active():
+            return
+        rec = tracing.recorder()
+        if rec is None:  # pragma: no cover - active() already checked
+            return
+        rec.instant(
+            f"policy/{entry['action']}",
+            "policy",
+            seq=entry["seq"],
+            state_from=entry["state_from"],
+            state_to=entry["state_to"],
+            old_policy=entry["old_policy"],
+            new_policy=entry["new_policy"],
+            applied=entry["applied"],
+            trigger=entry["trigger"],
+            rationale=entry["rationale"],
+        )

@@ -894,8 +894,13 @@ class SyncAdvisor:
 
         if 1 not in candidates:
             raise ValueError("SyncAdvisor candidates must include 1 (the measured baseline)")
-        # validates the mode/budget combination (raises ValueError on misuse)
-        CompressionConfig.from_mode(compression, error_budget)
+        # validates the profiling mode (raises ValueError on misuse); unlike a
+        # SyncPolicy, a budget WITHOUT a mode is meaningful here — it declares
+        # the tolerance the compression *advice* is judged against while the
+        # profile itself runs uncompressed (the autotuner's observe flow)
+        CompressionConfig.from_mode(
+            compression, error_budget if compression != "none" else None
+        )
         self.target = target
         self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
         self.axis_name = axis_name
@@ -941,10 +946,18 @@ class SyncAdvisor:
         if not was_enabled:
             _telemetry.enable()
         cands = [n for n in self.candidates if n <= steps and n <= self.max_staleness]
+        if 1 not in cands:
+            # the every-step baseline every recommendation is judged against:
+            # always measured, even when steps/max_staleness exclude it above
+            cands.insert(0, 1)
         totals: Dict[int, List[Dict[str, float]]] = {n: [] for n in cands}
         bytes_by_cand: Dict[int, Dict[str, int]] = {}
         policy_of = lambda n: SyncPolicy(
-            every_n_steps=n, compression=self.compression, error_budget=self.error_budget
+            every_n_steps=n,
+            compression=self.compression,
+            # an advice-only budget (compression "none") never reaches the
+            # measured policies — the profile runs exact
+            error_budget=self.error_budget if self.compression != "none" else None,
         )
         before_all = _telemetry.telemetry_for(self.target).as_dict()
         try:
@@ -1073,7 +1086,16 @@ class SyncAdvisor:
         if self._profile is None:
             raise RuntimeError("SyncAdvisor.recommend called before profile()")
         runs = self._profile["runs"]
-        base = next(r for r in runs if r["every_n"] == 1)
+        base = next((r for r in runs if r["every_n"] == 1), None)
+        if base is None:
+            # profile() always measures cadence 1, so this only fires on a
+            # hand-built/deserialized profile missing the baseline
+            raise RuntimeError(
+                "SyncAdvisor.recommend: the profile has no every_n == 1 baseline run "
+                f"(measured cadences: {sorted(r.get('every_n') for r in runs)}); every "
+                "measured_cut is relative to the every-step baseline — re-run profile(), "
+                "or include an every_n == 1 row in the supplied profile"
+            )
         base_s = max(base["sync_s"], 1e-9)
         for r in runs:
             r["measured_cut"] = base_s / max(r["sync_s"], 1e-9)
@@ -1089,6 +1111,9 @@ class SyncAdvisor:
             and row.get("model_ring_bytes", 0) >= 2 * row["model_naive_bytes"]
         )
         out = {
+            # export-front-door stamp: obs.export(rec, fmt="jsonl") lines are
+            # filterable by kind and parse back via parse_export_line
+            "kind": "sync_advice",
             "policy": "every_n",
             "every_n": best["every_n"],
             "measured_cut": best["measured_cut"],

@@ -267,6 +267,13 @@ def sharded_update(
         in_specs = P(axis_name)
 
     specs = tuple(in_specs for _ in inputs) if not isinstance(in_specs, tuple) else in_specs
+    # a SyncAutotuner commit (parallel/autotune.py) overrides the hand-passed
+    # policy: the committed policy wins until it is rolled back, so a running
+    # flow keeps calling with its original sync_policy= and still follows the
+    # autotuned cadence/compression
+    override = metric.__dict__.get("_autotuned_policy")
+    if override is not None:
+        sync_policy = override
     compression = sync_policy.compression_config if sync_policy is not None else None
 
     if sync_policy is not None and sync_policy.defers:
@@ -389,6 +396,10 @@ def sharded_collection_update(
         )
     if sync_policy is None:
         sync_policy = getattr(collection, "_sync_policy", None)
+    # committed SyncAutotuner policy wins over the hand-passed/constructed one
+    override = collection.__dict__.get("_autotuned_policy")
+    if override is not None:
+        sync_policy = override
     compression = sync_policy.compression_config if sync_policy is not None else None
     if sync_policy is not None and sync_policy.defers:
         stepper = cadence_stepper(
