@@ -118,6 +118,11 @@ def main() -> None:
     for step, value in enumerate(stream):
         mon.observe("val/accuracy", value, step=step)
         mon.advance(step)
+    # budget the live state HBM the armed memory plane reports: a growing
+    # cat-state metric pages once per breach episode, not every step
+    mon.watch("eval/fid_state_hbm", obs.MemoryBudgetRule(budget_bytes=32 << 20))
+    for step, current_bytes in enumerate([16 << 20, 30 << 20, 40 << 20, 41 << 20]):
+        mon.observe("eval/fid_state_hbm", current_bytes, step=100 + step)
     for line in alerts_log.getvalue().splitlines():
         alert = parse_export_line(line)
         print(f"  [{alert['severity']}] step {alert['step']}: {alert['message']}")

@@ -1,0 +1,143 @@
+"""Memory & cost observability walkthrough: the three attribution layers.
+
+What this shows, in order:
+
+1. arming the plane (double gate: telemetry on + memory telemetry on) and
+   live state-HBM accounting — per-leaf resident bytes, current/peak
+   watermarks, and the donated-vs-copied install split on a jitted metric;
+2. compiled-executable analysis — per-cache-entry ``memory_analysis()`` /
+   ``cost_analysis()`` rows keyed by config fingerprint, with the
+   per-entrypoint ``entry_bytes`` that make eviction-cause misses
+   attributable (graceful on CPU: sizes yes, peak HBM no);
+3. the proof the armed path is free: same trace count, same cache entries,
+   jaxpr-identical compiled graphs;
+4. exports through the front door — ``tm_tpu_memory_*`` Prometheus families
+   and a ``kind: "memory_report"`` JSONL line that parses back;
+5. the report-only ShardingAdvisor on a real FID+PSNR pair, reproducing the
+   bench's 33,570,840 replicated psum bytes and naming FID's covariance
+   state as the leaf worth sharding first.
+
+Run on anything: ``python examples/memory_observability_walkthrough.py``
+(CPU ok; step 5 builds a real InceptionV3-backed FID, give it a few seconds).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+from torchmetrics_tpu.observability.export import parse_export_line
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def main() -> None:
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 64, 1024))
+    target = jnp.asarray(rng.integers(0, 64, 1024))
+
+    # ------------------------------------------------------------------ 1
+    banner("1. live state-HBM accounting")
+    obs.enable()
+    obs.enable_memory_telemetry()  # or TM_TPU_MEMORY_TELEMETRY=1
+    m = MulticlassConfusionMatrix(num_classes=64, jit=True)
+    for _ in range(3):
+        m.update(preds, target)
+    mem = m.telemetry.as_dict()["memory"]
+    print(f"installs={mem['installs']}  current={mem['current_bytes']} B  "
+          f"peak={mem['peak_bytes']} B")
+    print(f"install split: donated={mem['donated_install_bytes']} B "
+          f"(jit path donates the old state), copied={mem['copied_install_bytes']} B")
+    for leaf, row in mem["leaves"].items():
+        print(f"  leaf {leaf:10s} resident={row['bytes']:7d} B "
+              f"logical={row['logical_bytes']:7d} B")
+
+    # ------------------------------------------------------------------ 2
+    banner("2. compiled-executable analysis, keyed by fingerprint")
+    for row in obs.memory_timeline():
+        print(f"entry {row['fingerprint_hash']} kind={row['kind']} "
+              f"backend={row['backend']}")
+        print(f"  memory_analysis: {row['memory']}  (no peak on CPU — "
+              "graceful degradation)")
+        print(f"  cost_analysis: flops={row['cost'].get('flops')} "
+              f"bytes_accessed={row['cost'].get('bytes_accessed')}")
+    print("per-fingerprint rollup:", json.dumps(obs.cost_by_fingerprint()))
+    print("update entry_bytes:",
+          cache_stats()["by_entrypoint"]["update"]["entry_bytes"])
+
+    # ------------------------------------------------------------------ 3
+    banner("3. the armed path is free: 0 retraces, 0 new entries")
+
+    def flow():
+        clear_compile_cache()
+        mm = MulticlassConfusionMatrix(num_classes=64, jit=True)
+        mm.update(preds, target)
+        stats = cache_stats()
+        return stats["traces"], stats["misses"]
+
+    obs.disable_memory_telemetry()
+    traces_off, misses_off = flow()
+    obs.enable_memory_telemetry()
+    traces_on, misses_on = flow()
+    print(f"traces: {traces_off} unarmed -> {traces_on} armed "
+          f"(+{traces_on - traces_off}); cache entries +{misses_on - misses_off}")
+
+    # ------------------------------------------------------------------ 4
+    banner("4. exports through the front door")
+    prom = obs.export(fmt="prometheus")
+    for ln in prom.splitlines():
+        if ln.startswith(("tm_tpu_memory_state_bytes{", "tm_tpu_memory_install_")):
+            print(" ", ln)
+
+    # ------------------------------------------------------------------ 5
+    banner("5. ShardingAdvisor: what is worth sharding, and why")
+    from torchmetrics_tpu.image import FrechetInceptionDistance, PeakSignalNoiseRatio
+    from torchmetrics_tpu.observability import memory as memplane
+
+    fid = FrechetInceptionDistance(feature=2048)
+    psnr = PeakSignalNoiseRatio()
+    # attribute their states live (no update needed: snapshot sizes them now)
+    memplane.snapshot_metric(fid)
+    memplane.snapshot_metric(psnr)
+
+    report = memplane.memory_report([fid, psnr], n_devices=8)
+    line = obs.export(report, fmt="jsonl", stream=io.StringIO())
+    back = parse_export_line(line)
+    print("jsonl kind:", back["kind"], " schema:", back["schema_version"])
+
+    advice = report["memory"]["advice"]
+    print(f"replicated psum state: {advice['total_psum_state_bytes']:,} B "
+          "(the bench's FID+PSNR figure)")
+    print(f"waste across 8 devices: {advice['total_replicated_waste_bytes']:,} B")
+    top = advice["candidates"][0]
+    print(f"shard first: {top['metric']}/{top['leaf']} "
+          f"({top['bytes']:,} B, source={top['source']})")
+    print(f"  per-chip wire: ring all-reduce {top['ring_allreduce_bytes_per_chip']:,} B "
+          f"-> reduce-scatter {top['reduce_scatter_bytes_per_chip']:,} B "
+          f"(saves {top['projected_wire_savings_bytes_per_chip']:,} B/combine)")
+    assert "cov_sum" in top["leaf"], "FID's covariance state should rank first"
+    print("=> FID's 2048x2048 covariance sums dominate — exactly the states "
+          "the cross-replica sharding planner should split")
+
+    obs.disable_memory_telemetry()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

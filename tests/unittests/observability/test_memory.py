@@ -1,0 +1,450 @@
+"""Memory & cost observability plane: live state-HBM attribution, compiled-
+executable analysis rows, the report-only ShardingAdvisor, and the armed
+path's zero-retrace / zero-new-entry contract."""
+
+import io
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+from torchmetrics_tpu.core.compile import (
+    cache_stats,
+    clear_compile_cache,
+    explain_retrace,
+    set_cache_capacity,
+)
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.observability import memory, registry
+from torchmetrics_tpu.observability.export import (
+    SCHEMA_MAJOR,
+    SCHEMA_VERSION,
+    JSONLinesExporter,
+    PrometheusExporter,
+    parse_export_line,
+)
+from torchmetrics_tpu.observability.health import Alert, CallbackAlertSink, HealthMonitor, MemoryBudgetRule
+from torchmetrics_tpu.observability.memory import ShardingAdvisor, leaf_resident_bytes
+from torchmetrics_tpu.utilities.regression import direction_for
+
+pytestmark = pytest.mark.memory
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.disable()
+    memory.disable_memory_telemetry()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    yield
+    obs.tracing.stop()
+    memory.disable_memory_telemetry()
+    obs.disable()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    set_cache_capacity(512)
+
+
+def _armed():
+    obs.enable()
+    memory.enable_memory_telemetry()
+
+
+# ------------------------------------------------------- live HBM accounting
+def test_install_accounting_watermarks_and_split():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    m.update(PREDS, TARGET)
+    mem = m.telemetry.as_dict()["memory"]
+    # (8, 8) float32 confmat + int32 _n scalar
+    assert mem["installs"] == 2
+    assert mem["current_bytes"] == 8 * 8 * 4 + 4
+    assert mem["peak_bytes"] == mem["current_bytes"]
+    assert mem["leaves"]["confmat"] == {"bytes": 256, "logical_bytes": 256}
+    # the jit path donates its previous state
+    assert mem["donated_install_bytes"] == 2 * mem["current_bytes"]
+    assert mem["copied_install_bytes"] == 0
+
+
+def test_eager_installs_count_as_copied():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8)  # eager path
+    m.update(PREDS, TARGET)
+    mem = m.telemetry.as_dict()["memory"]
+    assert mem["installs"] == 1
+    assert mem["copied_install_bytes"] == mem["current_bytes"] > 0
+    assert mem["donated_install_bytes"] == 0
+
+
+def test_unarmed_records_nothing():
+    obs.enable()  # telemetry on, memory plane NOT armed
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    mem = m.telemetry.as_dict()["memory"]
+    assert mem["installs"] == 0 and mem["current_bytes"] == 0
+    assert memory.memory_timeline() == []
+
+
+def test_snapshot_metric_records_without_install():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8)
+    m.update(PREDS, TARGET)
+    obs.reset_telemetry()
+    memory.snapshot_metric(m)
+    mem = m.telemetry.as_dict()["memory"]
+    assert mem["installs"] == 0 and mem["snapshots"] == 1
+    assert mem["current_bytes"] == 8 * 8 * 4 + 4
+    assert mem["donated_install_bytes"] == mem["copied_install_bytes"] == 0
+
+
+def test_restore_counts_as_copied_install():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8)
+    m.update(PREDS, TARGET)
+    before = m.telemetry.as_dict()["memory"]["installs"]
+    m.load_state_pytree(m.state_pytree())
+    mem = m.telemetry.as_dict()["memory"]
+    assert mem["installs"] == before + 1
+
+
+# -------------------------------------------------- sharded-aware leaf bytes
+def test_leaf_resident_bytes_replicated_vs_sharded(mesh):
+    x = jnp.zeros((NUM_DEVICES * 4, 16), jnp.float32)
+    logical = x.size * 4
+    replicated = jax.device_put(x, NamedSharding(mesh, P()))
+    sharded = jax.device_put(x, NamedSharding(mesh, P("data")))
+    res_rep, log_rep = leaf_resident_bytes(replicated)
+    res_shd, log_shd = leaf_resident_bytes(sharded)
+    assert log_rep == log_shd == logical
+    assert res_rep == NUM_DEVICES * logical  # every local device holds a copy
+    assert res_shd == logical  # shards tile the logical array exactly once
+
+
+def test_leaf_resident_bytes_fallbacks():
+    # plain numpy / ShapeDtypeStruct leaves fall back to logical bytes
+    assert leaf_resident_bytes(np.zeros((4, 4), np.float32)) == (64, 64)
+    spec = jax.ShapeDtypeStruct((8,), jnp.int32)
+    assert leaf_resident_bytes(spec) == (32, 32)
+    assert leaf_resident_bytes(3.5) == (0, 0)  # not array-like
+
+
+# ------------------------------------------------ executable analysis (CPU)
+def test_analysis_rows_captured_and_keyed():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    rows = memory.memory_timeline()
+    assert len(rows) == 1
+    (row,) = rows
+    assert row["kind"] == "update"
+    assert re.fullmatch(r"[0-9a-f]{12}", row["fingerprint_hash"])
+    assert row["backend"] == jax.default_backend()
+    # CPU reports sizes but no peak: graceful omission, not a crash
+    assert row["available"] is True
+    assert row["memory"]["argument_bytes"] > 0
+    assert row["total_bytes"] > 0
+    assert row["cost"]["flops"] >= 0.0
+    by_fp = memory.cost_by_fingerprint()
+    assert by_fp[row["fingerprint_hash"]]["entries"] == 1
+    assert by_fp[row["fingerprint_hash"]]["total_bytes"] == row["total_bytes"]
+
+
+def test_entry_bytes_in_cache_stats_and_explain_retrace():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True, validate_args=False)
+    m.update(PREDS, TARGET)
+    slot = cache_stats()["by_entrypoint"]["update"]
+    assert slot["entry_bytes"] > 0
+    # mutate a fingerprinted attr -> invalidation retrace; the explanation
+    # names the entry's byte size so the growth is attributable
+    m.ignore_index = 3
+    m.update(PREDS, TARGET)
+    why = explain_retrace(m)
+    assert why is not None
+    assert why["entry_bytes"]
+    assert all(b > 0 for b in why["entry_bytes"].values())
+
+
+def test_eviction_drops_analysis_rows_in_lockstep():
+    _armed()
+    set_cache_capacity(2)
+    metrics = [MulticlassConfusionMatrix(num_classes=n, jit=True) for n in (6, 7, 8)]
+    for m in metrics:
+        m.update(PREDS, TARGET)
+    rows = memory.memory_timeline()
+    assert len(rows) == 2  # oldest entry's analysis row evicted with it
+    stats = cache_stats()
+    assert stats["evictions"] >= 1
+    total_entry_bytes = sum(
+        slot["entry_bytes"] for slot in stats["by_entrypoint"].values()
+    )
+    assert total_entry_bytes == sum(r["total_bytes"] for r in rows)
+    clear_compile_cache()
+    assert memory.memory_timeline() == []
+
+
+# --------------------------------------------------- zero-perturbation proof
+def _jit_flow():
+    clear_compile_cache()
+    m = MulticlassAccuracy(num_classes=5, jit=True)
+    for _ in range(3):
+        m.update(PREDS, TARGET)
+    out = m.compute()
+    stats = cache_stats()
+    return np.asarray(out), stats["traces"], stats["misses"], stats["by_entrypoint"]
+
+
+def test_armed_memory_adds_zero_traces_and_entries():
+    obs.enable()
+    result_off, traces_off, misses_off, by_off = _jit_flow()
+    memory.enable_memory_telemetry()
+    result_on, traces_on, misses_on, by_on = _jit_flow()
+    assert traces_on == traces_off  # arming never enters a cache key
+    assert misses_on == misses_off  # and creates no new entries
+    np.testing.assert_array_equal(result_on, result_off)
+    # slots match except the armed run's analysis byte sizes
+    for kind, slot in by_off.items():
+        on = dict(by_on[kind])
+        on.pop("entry_bytes")
+        off = dict(slot)
+        off.pop("entry_bytes")
+        assert on == off
+
+
+def test_armed_memory_keeps_jaxprs_bit_identical():
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    m = MulticlassAccuracy(num_classes=5)
+    step = audit_step_fn(m, "update")
+    state = m.init_state()
+    obs.disable()
+    baseline = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    _armed()
+    armed = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    assert armed == baseline
+
+
+def test_memory_instants_reach_flight_recorder():
+    _armed()
+    obs.tracing.start(capacity=256)
+    try:
+        m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+        m.update(PREDS, TARGET)
+        events = [e for e in obs.tracing.events() if e.cat == "memory"]
+    finally:
+        obs.tracing.stop()
+    assert events
+    assert events[-1].args["current_bytes"] == 8 * 8 * 4 + 4
+
+
+# ------------------------------------------------------------ MemoryBudgetRule
+def test_memory_budget_rule_latches_per_episode():
+    rule = MemoryBudgetRule(budget_bytes=1000, severity="critical")
+    assert rule.check("fid/hbm", 0, 900.0) is None
+    first = rule.check("fid/hbm", 1, 1500.0)
+    assert isinstance(first, Alert)
+    assert first.severity == "critical"
+    assert first.details["over_bytes"] == 500.0
+    # latched: the plateau does not page again
+    assert rule.check("fid/hbm", 2, 1600.0) is None
+    # back under budget clears the latch; the next breach fires anew
+    assert rule.check("fid/hbm", 3, 800.0) is None
+    assert rule.check("fid/hbm", 4, 2000.0) is not None
+    # series latches are independent
+    assert rule.check("psnr/hbm", 5, 1200.0) is not None
+
+
+def test_memory_budget_rule_rides_monitor_and_sinks():
+    seen = []
+    mon = HealthMonitor(sinks=[CallbackAlertSink(seen.append, min_severity="warning")])
+    mon.watch("acc/hbm", MemoryBudgetRule(budget_bytes=100))
+    mon.observe("acc/hbm", 50, step=0)
+    mon.observe("acc/hbm", 260, step=1)
+    mon.observe("acc/hbm", 270, step=2)
+    assert [a.step for a in seen] == [1]
+    assert seen[0].rule == "memory_budget"
+    with pytest.raises(ValueError):
+        MemoryBudgetRule(budget_bytes=0)
+
+
+# ------------------------------------------------------------ ShardingAdvisor
+class _FakeMetric:
+    """State/reductions shaped exactly like the BENCH_r05 pair; zero-alloc
+    via ShapeDtypeStruct leaves."""
+
+    def __init__(self, leaves):
+        self._state = {
+            name: jax.ShapeDtypeStruct(shape, dtype) for name, (shape, dtype) in leaves.items()
+        }
+        self._reductions = {name: Reduce.SUM for name in leaves if name != "_n"}
+
+
+def _fid_psnr_pair():
+    fid = _FakeMetric(
+        {
+            "_n": ((), jnp.int32),
+            "real_features_sum": ((2048,), jnp.float32),
+            "real_features_cov_sum": ((2048, 2048), jnp.float32),
+            "real_features_num_samples": ((), jnp.float32),
+            "fake_features_sum": ((2048,), jnp.float32),
+            "fake_features_cov_sum": ((2048, 2048), jnp.float32),
+            "fake_features_num_samples": ((), jnp.float32),
+        }
+    )
+    psnr = _FakeMetric(
+        {
+            "_n": ((), jnp.int32),
+            "sum_squared_error": ((), jnp.float32),
+            "total": ((), jnp.float32),
+            "min_target": ((), jnp.float32),
+            "max_target": ((), jnp.float32),
+        }
+    )
+    return [("FrechetInceptionDistance", fid), ("PeakSignalNoiseRatio", psnr)]
+
+
+def test_sharding_advisor_reproduces_bench_r05_figure():
+    advice = ShardingAdvisor().advise(_fid_psnr_pair(), n_devices=8)
+    assert advice["total_psum_state_bytes"] == 33_570_840
+    assert advice["total_replicated_waste_bytes"] == 33_570_840 * 7
+    top = advice["candidates"][0]
+    # ranked by replicated waste: a (2048, 2048) covariance sum leads
+    assert top["leaf"].endswith("_cov_sum")
+    assert top["bytes"] == 2048 * 2048 * 4
+    assert top["replicated_waste_bytes"] == top["bytes"] * 7
+    # sharded, each combine pays exactly the scatter half of the ring
+    assert top["reduce_scatter_bytes_per_chip"] * 2 == top["ring_allreduce_bytes_per_chip"]
+    assert (
+        top["projected_wire_savings_bytes_per_chip"]
+        == top["ring_allreduce_bytes_per_chip"] - top["reduce_scatter_bytes_per_chip"]
+    )
+    # only the >=1 MiB covariance leaves make the short list
+    assert advice["recommended"] == [
+        "FrechetInceptionDistance/fake_features_cov_sum",
+        "FrechetInceptionDistance/real_features_cov_sum",
+    ]
+
+
+def test_sharding_advisor_prefers_live_registry_rows():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    advice = ShardingAdvisor().advise([m], n_devices=4)
+    (cand,) = advice["candidates"]
+    assert cand["source"] == "registry"
+    assert cand["bytes"] == 8 * 8 * 4
+    assert cand["replicated_waste_bytes"] == 256 * 3
+    # unobserved metrics fall back to sizing the state pytree directly
+    fresh = MulticlassConfusionMatrix(num_classes=8)
+    advice2 = ShardingAdvisor().advise([fresh], n_devices=4)
+    assert advice2["candidates"][0]["source"] == "state"
+    assert advice2["candidates"][0]["bytes"] == 256
+
+
+# -------------------------------------------------------- export & schema 1.5
+def test_schema_version_is_1_5():
+    assert SCHEMA_VERSION.split(".")[:2] == ["1", "5"]
+
+
+def test_memory_report_jsonl_parse_back():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    rep = memory.memory_report([m], n_devices=8)
+    buf = io.StringIO()
+    JSONLinesExporter(stream=buf).export(rep)
+    back = parse_export_line(buf.getvalue().strip())
+    assert back["kind"] == "memory_report"
+    assert back["schema_version"] == SCHEMA_VERSION
+    assert back["memory"]["advice"]["candidates"]
+    assert back["memory"]["executables"][0]["fingerprint_hash"]
+    label = next(iter(back["memory"]["metrics"]))
+    assert back["memory"]["metrics"][label]["current_bytes"] == 8 * 8 * 4 + 4
+
+
+def test_memory_report_unknown_major_rejected():
+    line = json.dumps(
+        {"schema_version": f"{SCHEMA_MAJOR + 1}.0.0", "kind": "memory_report", "memory": {}}
+    )
+    with pytest.raises(ValueError, match=f"major {SCHEMA_MAJOR} only"):
+        parse_export_line(line)
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?[0-9]+(\.[0-9]+(e[+-]?[0-9]+)?)?$"
+)
+
+
+def _lint(text):
+    helped, typed, samples = set(), set(), []
+    for ln in text.splitlines():
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+        elif ln.startswith("# TYPE "):
+            assert ln.split()[3] in ("counter", "histogram", "gauge", "summary")
+            typed.add(ln.split()[2])
+        else:
+            assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"
+            assert 'process="' in ln
+            samples.append(ln)
+    assert helped == typed and helped
+    return samples
+
+
+def test_prometheus_lint_memory_families():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    samples = _lint(obs.export(fmt="prometheus"))
+    names = {s.split("{")[0] for s in samples}
+    assert "tm_tpu_memory_state_bytes" in names
+    assert "tm_tpu_memory_state_leaf_bytes" in names
+    assert "tm_tpu_memory_install_bytes_total" in names
+    assert "tm_tpu_memory_cache_entry_bytes" in names
+
+    rep = memory.memory_report([m], n_devices=8)
+    samples = _lint(PrometheusExporter().export(rep))
+    names = {s.split("{")[0] for s in samples}
+    assert "tm_tpu_memory_executable_bytes" in names
+    assert "tm_tpu_cost_flops" in names
+    assert "tm_tpu_cost_bytes_accessed" in names
+    assert "tm_tpu_memory_replicated_waste_bytes" in names
+
+
+def test_fleet_single_process_byte_identity_with_memory_rows():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    fleet = json.dumps(obs.fleet_report(), sort_keys=True, default=str)
+    local = json.dumps(registry.report(), sort_keys=True, default=str)
+    assert fleet == local
+
+
+def test_fleet_skew_gains_hbm_axis():
+    _armed()
+    m = MulticlassConfusionMatrix(num_classes=8, jit=True)
+    m.update(PREDS, TARGET)
+    view = obs.FleetView([registry.report()])
+    skew = view.skew()
+    assert skew["hbm_bytes"]["max"] == 8 * 8 * 4 + 4
+    assert skew["hbm_bytes"]["max_process"] == 0
+
+
+# ----------------------------------------------------------- regression gate
+def test_waste_and_hbm_bytes_gate_lower_is_better():
+    assert direction_for("sharding_advisor.replicated_waste_bytes_8dev") == "lower"
+    assert direction_for("fleet.straggler_hbm_bytes") == "lower"
+    assert direction_for("memory_plane.update_us_memory_on") == "lower"

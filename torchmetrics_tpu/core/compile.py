@@ -78,8 +78,10 @@ __all__ = [
     "MISS_CAUSES",
     "add_cache_observer",
     "add_compile_timing_observer",
+    "analysis_capture_enabled",
     "remove_cache_observer",
     "remove_compile_timing_observer",
+    "set_analysis_capture",
     "shard_map",
     "abstract_signature",
     "audit_step_fn",
@@ -91,9 +93,11 @@ __all__ = [
     "cache_stats_since",
     "compile_time_by_fingerprint",
     "compile_timeline",
+    "cost_by_fingerprint",
     "explain_retrace",
     "fingerprint_diff",
     "measure_compile_phases",
+    "memory_timeline",
     "set_cache_capacity",
     "clear_compile_cache",
     "compiled_cadence_step",
@@ -209,6 +213,40 @@ class CompileRecord:
 #: ``_COLD_START_TOTALS`` so long jobs don't lose count to the ring
 _COMPILE_LOG: "deque[CompileRecord]" = deque(maxlen=512)
 _COLD_START_TOTALS = {"count": 0, "total_s": 0.0}
+
+# Per-entry executable analyses (``compiled.memory_analysis()`` /
+# ``cost_analysis()``), keyed by cache key so LRU eviction and
+# clear_compile_cache() drop rows in lockstep with their executables — the
+# table can never outgrow the cache.  Capture is off by default; the memory
+# plane's front door (observability/memory.py) arms it via
+# :func:`set_analysis_capture`.
+_ANALYSIS_CAPTURE = False
+_ANALYSIS_ROWS: "OrderedDict[Hashable, Dict[str, Any]]" = OrderedDict()
+
+#: CompiledMemoryStats attribute -> exported row key.  ``peak_bytes`` is
+#: absent on backends that don't report it (CPU) — graceful degradation.
+_MEMORY_ANALYSIS_FIELDS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ("peak_memory_in_bytes", "peak_bytes"),
+)
+
+
+def set_analysis_capture(enabled: bool = True) -> None:
+    """Arm (or disarm) per-entry executable memory/cost analysis capture.
+
+    Prefer :func:`observability.memory.enable_memory_telemetry`, which arms
+    this together with live state-HBM accounting."""
+    global _ANALYSIS_CAPTURE
+    with _LOCK:
+        _ANALYSIS_CAPTURE = bool(enabled)
+
+
+def analysis_capture_enabled() -> bool:
+    return _ANALYSIS_CAPTURE
 
 # Compile-timing observers: ``fn(record)`` fires once per completed cold
 # start, outside _LOCK (flight recorder + telemetry registry subscribe).
@@ -338,7 +376,15 @@ def cache_stats() -> Dict[str, Any]:
     """
     with _LOCK:
         out: Dict[str, Any] = dict(_STATS)
-        out["by_entrypoint"] = {kind: dict(slot) for kind, slot in _KIND_STATS.items()}
+        # per-kind resident executable bytes (0 until analysis capture is
+        # armed and the backend reports sizes) — names the entry point that
+        # grew the cache when a miss attributes to "eviction"
+        by_kind = {kind: {**slot, "entry_bytes": 0} for kind, slot in _KIND_STATS.items()}
+        for row in _ANALYSIS_ROWS.values():
+            slot = by_kind.get(row.get("kind"))
+            if slot is not None:
+                slot["entry_bytes"] += int(row.get("total_bytes") or 0)
+        out["by_entrypoint"] = by_kind
         out["miss_causes"] = dict(_MISS_CAUSE_COUNTS)
         out["cold_start"] = dict(_COLD_START_TOTALS)
         return out
@@ -415,6 +461,7 @@ def clear_compile_cache(reset_stats: bool = True) -> None:
     with _LOCK:
         _CACHE.clear()
         _ID_PINS.clear()
+        _ANALYSIS_ROWS.clear()
         # an explicit clear is not an LRU eviction: wipe the cause history so
         # re-misses after a clear attribute as new-key, not eviction
         _EVICTED.clear()
@@ -453,6 +500,7 @@ def mark_trace(
 def _note_eviction(key: Hashable) -> None:
     """Caller holds ``_LOCK``: remember an LRU-evicted key (bounded)."""
     _STATS["evictions"] += 1
+    _ANALYSIS_ROWS.pop(key, None)  # analysis rows live and die with their entry
     _EVICTED[key] = None
     _EVICTED.move_to_end(key)
     while len(_EVICTED) > _HISTORY_CAP:
@@ -537,10 +585,80 @@ def _timed_cold_start(key: Hashable, fn: Callable, record: CompileRecord) -> Cal
             _COLD_START_TOTALS["total_s"] += record.cold_start_s
             if _CACHE.get(key) is first_call:
                 _CACHE[key] = fn
+        if _ANALYSIS_CAPTURE:
+            row = _capture_entry_analysis(fn, args, kwargs, record)
+            with _LOCK:
+                if key in _CACHE:  # a concurrent eviction wins; rows track entries
+                    _ANALYSIS_ROWS[key] = row
         _notify_compile(record)
         return out
 
     return first_call
+
+
+def _capture_entry_analysis(
+    fn: Callable, args: Tuple[Any, ...], kwargs: Dict[str, Any], record: CompileRecord
+) -> Dict[str, Any]:
+    """Best-effort executable memory/cost analysis for a freshly compiled
+    entry, right after its first dispatch.
+
+    Walks jax's AOT pipeline on the already-dispatched callable: the traced
+    jaxpr is cached by jax, so the step body does NOT re-run — no
+    ``mark_trace``, no new cache entry, the armed path stays zero-retrace
+    (proven in tests/unittests/observability/test_memory.py) — at the cost of
+    one extra XLA compile per entry while armed.  Every phase degrades
+    independently: a backend that exposes neither analysis (or a non-jit
+    cached callable) still yields a row, so CPU tier-1 exercises the full
+    plumbing.  ``.lower()`` only reads avals, so donated (deleted) argument
+    buffers are fine."""
+    t0 = time.perf_counter()  # tmt: ignore[TMT006] -- off-path AOT analysis wall time; never traced
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+    except Exception:
+        compiled = None
+    mem: Dict[str, int] = {}
+    cost: Dict[str, float] = {}
+    if compiled is not None:
+        try:
+            stats = compiled.memory_analysis()
+            for attr, out_key in _MEMORY_ANALYSIS_FIELDS:
+                v = getattr(stats, attr, None)
+                if v is not None:
+                    mem[out_key] = int(v)
+        except Exception:
+            pass
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, Mapping):
+                if ca.get("flops") is not None:
+                    cost["flops"] = float(ca["flops"])
+                if ca.get("bytes accessed") is not None:
+                    cost["bytes_accessed"] = float(ca["bytes accessed"])
+        except Exception:
+            pass
+    try:
+        backend: Optional[str] = jax.default_backend()
+    except Exception:  # pragma: no cover
+        backend = None
+    total = sum(
+        mem.get(k, 0)
+        for k in ("argument_bytes", "output_bytes", "temp_bytes", "generated_code_bytes")
+    )
+    return {
+        "seq": record.seq,
+        "kind": record.kind,
+        "cause": record.cause,
+        "label": record.label,
+        "fingerprint_hash": record.fingerprint_hash,
+        "backend": backend,
+        "available": bool(mem),
+        "memory": mem,
+        "cost": cost,
+        "total_bytes": int(total),
+        "analysis_s": time.perf_counter() - t0,  # tmt: ignore[TMT006] -- off-path AOT analysis wall time; never traced
+    }
 
 
 def _lookup(
@@ -624,6 +742,51 @@ def compile_time_by_fingerprint() -> Dict[str, Dict[str, Any]]:
             slot["kinds"].append(rec["kind"])
         slot["count"] += 1
         slot["total_s"] += float(rec["cold_start_s"])
+    return out
+
+
+def memory_timeline() -> List[Dict[str, Any]]:
+    """Executable memory/cost analyses of the *live* cache entries, capture
+    order — the memory-side companion of :func:`compile_timeline`.
+
+    One row per analysed entry with the argument/output/temp/generated-code
+    byte split from ``compiled.memory_analysis()`` (plus ``peak_bytes`` on
+    backends that report peak HBM), the ``cost_analysis()`` FLOPs and bytes
+    accessed, and the owning entry's ``fingerprint_hash`` so rows join
+    :func:`compile_timeline` / :func:`compile_time_by_fingerprint`.  Rows are
+    keyed by cache entry: LRU eviction drops a row the moment its executable
+    is released, so the table is bounded by the cache capacity.  Empty unless
+    capture is armed (observability.memory.enable_memory_telemetry)."""
+    with _LOCK:
+        rows = [dict(r, memory=dict(r["memory"]), cost=dict(r["cost"])) for r in _ANALYSIS_ROWS.values()]
+    rows.sort(key=lambda r: r["seq"])
+    return rows
+
+
+def cost_by_fingerprint() -> Dict[str, Dict[str, Any]]:
+    """FLOPs / bytes-accessed / executable bytes aggregated per config
+    fingerprint hash — the cost-side companion of
+    :func:`compile_time_by_fingerprint`."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for row in memory_timeline():
+        key = row["fingerprint_hash"] or f"({row['kind'] or 'unkeyed'})"
+        slot = out.setdefault(
+            key,
+            {
+                "label": row["label"],
+                "kinds": [],
+                "entries": 0,
+                "flops": 0.0,
+                "bytes_accessed": 0.0,
+                "total_bytes": 0,
+            },
+        )
+        if row["kind"] and row["kind"] not in slot["kinds"]:
+            slot["kinds"].append(row["kind"])
+        slot["entries"] += 1
+        slot["flops"] += float(row["cost"].get("flops", 0.0))
+        slot["bytes_accessed"] += float(row["cost"].get("bytes_accessed", 0.0))
+        slot["total_bytes"] += int(row.get("total_bytes") or 0)
     return out
 
 
@@ -719,7 +882,7 @@ def explain_retrace(metric: Any = None) -> Optional[Dict[str, Any]]:
         summary = "; ".join(parts)
     else:
         summary = "fingerprints differ only in unhashed detail"
-    return {
+    out = {
         "seq": rec["seq"],
         "kind": rec["kind"],
         "label": rec["label"],
@@ -729,6 +892,18 @@ def explain_retrace(metric: Any = None) -> Optional[Dict[str, Any]]:
         "opaque": diff["opaque"],
         "summary": f"{rec['label']} retraced ({rec['kind']}): {summary}",
     }
+    # where analysis capture has sized this owner's live entries, attach the
+    # per-fingerprint executable bytes so an eviction-pressure retrace can be
+    # traced to the entry that grew the cache
+    with _LOCK:
+        entry_bytes = {}
+        for row in _ANALYSIS_ROWS.values():
+            if row["label"] == rec["label"] and row.get("total_bytes"):
+                fp = row["fingerprint_hash"] or f"({row['kind'] or 'unkeyed'})"
+                entry_bytes[fp] = entry_bytes.get(fp, 0) + int(row["total_bytes"])
+    if entry_bytes:
+        out["entry_bytes"] = entry_bytes
+    return out
 
 
 def measure_compile_phases(

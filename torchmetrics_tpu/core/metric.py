@@ -495,9 +495,11 @@ class Metric:
                 fn = compiled_update(self, args, kwargs, donate=donate)
                 self._state = fn(self._state, *args, **kwargs)
             _telemetry.count(self, "donated_installs" if donate else "copied_installs")
+            _telemetry.record_state_install(self, self._state, donated=donate)
         else:
             with _telemetry.span(self, "update"):
                 self._state = self.update_state(self._state, *args, **kwargs)
+            _telemetry.record_state_install(self, self._state, donated=False)
             # eager path: surface warn/error immediately (the state is host-
             # adjacent anyway); the jit path defers the readback to compute()
             self._check_nonfinite()
@@ -552,6 +554,7 @@ class Metric:
                     self._state, self._forward_cache = fn(self._state, *args, **kwargs)
                 self._computed = None
                 _telemetry.count(self, "donated_installs" if donate else "copied_installs")
+                _telemetry.record_state_install(self, self._state, donated=donate)
                 return self._forward_cache
         with _telemetry.span(self, "forward"):
             if self.full_state_update:
@@ -560,6 +563,7 @@ class Metric:
             else:
                 batch_state = self.update_state(self.init_state(), *args, **kwargs)
                 self._state = self.merge_states(self._state, batch_state)
+            _telemetry.record_state_install(self, self._state, donated=False)
             self._computed = None
             if self.dist_sync_on_step and self.distributed_available_fn():
                 batch_state = self.host_sync_states(batch_state)
@@ -668,6 +672,7 @@ class Metric:
         self._state_shared = False
         self._computed = None
         _telemetry.count(self, "restores")
+        _telemetry.record_state_install(self, self._state, donated=False)
 
     # pickling: state arrays -> numpy for portability (reference metric.py:713-732)
     def __getstate__(self) -> Dict[str, Any]:

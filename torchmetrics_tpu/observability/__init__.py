@@ -28,12 +28,16 @@ Quick tour::
                                           # attribution on many)
     obs.HealthMonitor(...)                # streaming metric-health alerting
 
+    obs.enable_memory_telemetry()         # arm the memory & cost plane:
+    obs.memory_report([fid, psnr])        # HBM watermarks, executable
+                                          # analyses, ShardingAdvisor advice
+
 The disabled fast path is a no-op: no compile-cache observer is registered,
 recording helpers return after one flag check, and nothing here touches
 cache keys — so telemetry can never cause a retrace.
 """
 
-from torchmetrics_tpu.observability import fleet, health, tracing
+from torchmetrics_tpu.observability import fleet, health, memory, tracing
 from torchmetrics_tpu.observability.export import (
     ChromeTraceExporter,
     Exporter,
@@ -62,9 +66,19 @@ from torchmetrics_tpu.observability.health import (
     HealthRule,
     JSONLAlertSink,
     LoggingAlertSink,
+    MemoryBudgetRule,
     NonFiniteRule,
     SEVERITIES,
     StalenessRule,
+)
+from torchmetrics_tpu.observability.memory import (
+    ShardingAdvisor,
+    cost_by_fingerprint,
+    disable_memory_telemetry,
+    enable_memory_telemetry,
+    memory_report,
+    memory_telemetry_enabled,
+    memory_timeline,
 )
 from torchmetrics_tpu.observability.tracing import FlightRecorder, TraceEvent
 from torchmetrics_tpu.observability.registry import (
@@ -100,6 +114,7 @@ __all__ = [
     "JSONLinesExporter",
     "LoggingAlertSink",
     "LoggingExporter",
+    "MemoryBudgetRule",
     "MetricTelemetry",
     "NonFiniteRule",
     "ObservationWindow",
@@ -107,19 +122,27 @@ __all__ = [
     "SCHEMA_VERSION",
     "SEVERITIES",
     "SPAN_BUCKETS_US",
+    "ShardingAdvisor",
     "StalenessRule",
     "TraceEvent",
     "TraceJSONLinesExporter",
     "aggregate_telemetry",
+    "cost_by_fingerprint",
     "diff_report",
     "disable",
+    "disable_memory_telemetry",
     "enable",
+    "enable_memory_telemetry",
     "enabled",
     "export",
     "fleet",
     "fleet_report",
     "gather_reports",
     "health",
+    "memory",
+    "memory_report",
+    "memory_telemetry_enabled",
+    "memory_timeline",
     "observe",
     "parse_export_line",
     "process_count",

@@ -57,8 +57,15 @@ __all__ = [
 #: closed-loop autotuner — ``autotune_decision`` JSONL ledger lines,
 #: ``sync_advice`` recommendation lines, the ``autotune`` report block with
 #: its ``tm_tpu_autotune_*`` Prometheus families, and the ``policy``
-#: flight-recorder category.
-SCHEMA_VERSION = "1.4.0"
+#: flight-recorder category; 1.5 added the memory & cost observability plane
+#: — a ``memory`` block on every metric row (live state-HBM watermarks,
+#: per-leaf resident bytes, donated-vs-copied install bytes), ``kind:
+#: "memory_report"`` payloads (executable memory/cost analyses plus the
+#: ShardingAdvisor's replication-waste advisory), the ``tm_tpu_memory_*`` /
+#: ``tm_tpu_cost_*`` Prometheus families, an ``entry_bytes`` gauge in
+#: ``compile_cache.by_entrypoint``, and the ``memory`` flight-recorder
+#: category.
+SCHEMA_VERSION = "1.5.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -373,6 +380,55 @@ class PrometheusExporter(Exporter):
                     f"{int(b.get('residual_bytes', 0))}"
                 )
 
+        # live state-HBM rows (observability/memory.py): only metrics with at
+        # least one recorded install or snapshot emit samples, so dark jobs
+        # add no noise
+        mem_rows = {
+            label: row["memory"]
+            for label, row in rows.items()
+            if isinstance(row.get("memory"), Mapping)
+            and (
+                int(row["memory"].get("installs", 0))
+                or int(row["memory"].get("snapshots", 0))
+            )
+        }
+        if mem_rows:
+            msb_name = f"{ns}_memory_state_bytes"
+            out.append(
+                f"# HELP {msb_name} Live metric-state HBM residency (addressable shard "
+                "bytes) by watermark: current = last install, peak = high watermark."
+            )
+            out.append(f"# TYPE {msb_name} gauge")
+            for label, mem in sorted(mem_rows.items()):
+                for watermark in ("current", "peak"):
+                    out.append(
+                        f"{msb_name}{_labels(metric=label, watermark=watermark, process=proc)} "
+                        f"{int(mem.get(f'{watermark}_bytes', 0))}"
+                    )
+            mlb_name = f"{ns}_memory_state_leaf_bytes"
+            out.append(
+                f"# HELP {mlb_name} Per-leaf resident state bytes as of the last install."
+            )
+            out.append(f"# TYPE {mlb_name} gauge")
+            for label, mem in sorted(mem_rows.items()):
+                for leaf, lrow in sorted(mem.get("leaves", {}).items()):
+                    out.append(
+                        f"{mlb_name}{_labels(metric=label, leaf=leaf, process=proc)} "
+                        f"{int(lrow.get('bytes', 0))}"
+                    )
+            mib_name = f"{ns}_memory_install_bytes_total"
+            out.append(
+                f"# HELP {mib_name} Cumulative state bytes installed, split by install "
+                "path (donated = in-place buffer reuse, copied = aliased state)."
+            )
+            out.append(f"# TYPE {mib_name} counter")
+            for label, mem in sorted(mem_rows.items()):
+                for path in ("donated", "copied"):
+                    out.append(
+                        f"{mib_name}{_labels(metric=label, path=path, process=proc)} "
+                        f"{int(mem.get(f'{path}_install_bytes', 0))}"
+                    )
+
         cc = report.get("compile_cache", {})
         flat_name = f"{ns}_compile_cache_total"
         out.append(f"# HELP {flat_name} Global compile-cache counters.")
@@ -387,7 +443,22 @@ class PrometheusExporter(Exporter):
             out.append(f"# TYPE {ep_name} counter")
             for kind, slot in sorted(by.items()):
                 for event, val in sorted(slot.items()):
+                    if event == "entry_bytes":  # resident size, not monotonic: gauge below
+                        continue
                     out.append(f"{ep_name}{_labels(entrypoint=kind, event=event, process=proc)} {int(val)}")
+            if any(int(slot.get("entry_bytes", 0)) for slot in by.values()):
+                eb_name = f"{ns}_memory_cache_entry_bytes"
+                out.append(
+                    f"# HELP {eb_name} Resident executable bytes of live compile-cache "
+                    "entries by entrypoint (from compiled.memory_analysis(); falls with "
+                    "LRU eviction)."
+                )
+                out.append(f"# TYPE {eb_name} gauge")
+                for kind, slot in sorted(by.items()):
+                    out.append(
+                        f"{eb_name}{_labels(entrypoint=kind, process=proc)} "
+                        f"{int(slot.get('entry_bytes', 0))}"
+                    )
 
         # health-monitor payloads (observability/health.py reports) ride the
         # same exposition: alert counters plus a last-value gauge per series
@@ -454,6 +525,65 @@ class PrometheusExporter(Exporter):
             out.append(f"# HELP {ar_name} Committed policies rolled back.")
             out.append(f"# TYPE {ar_name} counter")
             out.append(f"{ar_name}{_labels(process=proc)} {int(counts.get('rollbacks', 0))}")
+
+        # memory-report payloads (observability/memory.py memory_report()):
+        # executable analyses per fingerprint, aggregated cost, and the
+        # ShardingAdvisor's replication-waste advisory
+        memory = report.get("memory")
+        if isinstance(memory, Mapping) and (
+            memory.get("executables") or memory.get("cost") or memory.get("advice")
+        ):
+            mx_name = f"{ns}_memory_executable_bytes"
+            out.append(
+                f"# HELP {mx_name} Compiled-executable section sizes per cache entry "
+                "(compiled.memory_analysis(); peak section only on backends that report "
+                "peak HBM)."
+            )
+            out.append(f"# TYPE {mx_name} gauge")
+            for erow in memory.get("executables", []):
+                fp = erow.get("fingerprint_hash") or f"({erow.get('kind') or 'unkeyed'})"
+                for section, val in sorted(erow.get("memory", {}).items()):
+                    # argument_bytes -> section="argument"
+                    out.append(
+                        f"{mx_name}{_labels(fingerprint=fp, kind=erow.get('kind'), section=section.rsplit('_bytes', 1)[0], process=proc)} "
+                        f"{int(val)}"
+                    )
+            cost = memory.get("cost", {})
+            cf_name = f"{ns}_cost_flops"
+            out.append(
+                f"# HELP {cf_name} XLA cost_analysis() FLOPs of live cache entries per "
+                "config fingerprint."
+            )
+            out.append(f"# TYPE {cf_name} gauge")
+            for fp, slot in sorted(cost.items()):
+                out.append(
+                    f"{cf_name}{_labels(fingerprint=fp, process=proc)} "
+                    f"{repr(float(slot.get('flops', 0.0)))}"
+                )
+            cb_name = f"{ns}_cost_bytes_accessed"
+            out.append(
+                f"# HELP {cb_name} XLA cost_analysis() bytes accessed of live cache "
+                "entries per config fingerprint."
+            )
+            out.append(f"# TYPE {cb_name} gauge")
+            for fp, slot in sorted(cost.items()):
+                out.append(
+                    f"{cb_name}{_labels(fingerprint=fp, process=proc)} "
+                    f"{repr(float(slot.get('bytes_accessed', 0.0)))}"
+                )
+            advice = memory.get("advice")
+            if isinstance(advice, Mapping):
+                mw_name = f"{ns}_memory_replicated_waste_bytes"
+                out.append(
+                    f"# HELP {mw_name} Replicated psum-state HBM waste per candidate leaf "
+                    "(leaf bytes x (n_devices - 1)); the ShardingAdvisor's ranking."
+                )
+                out.append(f"# TYPE {mw_name} gauge")
+                for cand in advice.get("candidates", []):
+                    out.append(
+                        f"{mw_name}{_labels(metric=cand.get('metric'), leaf=cand.get('leaf'), process=proc)} "
+                        f"{int(cand.get('replicated_waste_bytes', 0))}"
+                    )
 
         text = "\n".join(out) + "\n"
         if self.path is not None:

@@ -240,13 +240,14 @@ class FleetView:
 
     # ---------------------------------------------------------------- skew
     def skew(self) -> Dict[str, Any]:
-        """Per-replica imbalance: sync-wait, byte, and retrace skew, plus the
-        straggler process (the one that spent the most measured wall time
-        blocked in collectives)."""
+        """Per-replica imbalance: sync-wait, byte, retrace, and live-HBM skew,
+        plus the straggler process (the one that spent the most measured wall
+        time blocked in collectives)."""
         waits: Dict[int, float] = {}
         wait_digests: Dict[int, Dict[str, Any]] = {}
         bytes_: Dict[int, float] = {}
         traces: Dict[int, float] = {}
+        hbm: Dict[int, float] = {}
         for pos, r in enumerate(self.reports):
             idx = self._index_of(pos)
             digest = sync_wait_digest(r)
@@ -256,6 +257,8 @@ class FleetView:
                 r.get("global", {}).get("counters", {}).get("sync_bytes", 0)
             )
             traces[idx] = float(r.get("compile_cache", {}).get("traces", 0))
+            mem = r.get("global", {}).get("memory")
+            hbm[idx] = float(mem.get("current_bytes", 0)) if isinstance(mem, Mapping) else 0.0
         wait_axis = _axis_skew(waits)
         straggler = wait_axis["max_process"]
         return {
@@ -263,6 +266,7 @@ class FleetView:
             "sync_wait_us": wait_axis,
             "sync_bytes": _axis_skew(bytes_),
             "retraces": _axis_skew(traces),
+            "hbm_bytes": _axis_skew(hbm),
             "straggler": {
                 "process": straggler,
                 "wait_total_us": waits[straggler],

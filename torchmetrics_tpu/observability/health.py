@@ -8,6 +8,8 @@ metrics said*.  A :class:`HealthMonitor` holds per-series streaming rules —
 * :class:`DriftRule` — EMA z-score drift: the value sits ``z_threshold``
   deviations from its exponentially-weighted mean/variance,
 * :class:`NonFiniteRule` — NaN/Inf observation rate above ``max_rate``,
+* :class:`MemoryBudgetRule` — live metric-state HBM (the armed memory
+  plane's ``current_bytes`` watermark) above a configured byte budget,
 * :class:`StalenessRule` — a watched series not observed for more than
   ``max_stale_steps`` steps (checked on :meth:`HealthMonitor.advance`),
 
@@ -56,6 +58,7 @@ __all__ = [
     "HealthRule",
     "JSONLAlertSink",
     "LoggingAlertSink",
+    "MemoryBudgetRule",
     "NonFiniteRule",
     "SEVERITIES",
     "StalenessRule",
@@ -391,6 +394,48 @@ class StalenessRule(HealthRule):
             None,
             f"no observation for {stale} steps (limit {self.max_stale_steps})",
             {"stale_steps": stale, "last_step": last},
+        )
+
+
+class MemoryBudgetRule(HealthRule):
+    """Live metric-state HBM above ``budget_bytes``.
+
+    Feed it the ``current_bytes`` watermark the armed memory plane records
+    (``metric.telemetry.as_dict()["memory"]["current_bytes"]``, or the
+    ``memory_report()`` rows) as the observed value.  Fires once per breach
+    episode — the latch clears the first time the series drops back to or
+    under budget — so a metric that plateaus above budget pages once, not
+    every step.
+    """
+
+    name = "memory_budget"
+
+    def __init__(self, budget_bytes: int, severity: str = "warning") -> None:
+        if budget_bytes <= 0:
+            raise ValueError(f"MemoryBudgetRule budget_bytes must be > 0, got {budget_bytes}")
+        self.budget_bytes = int(budget_bytes)
+        self.severity = severity
+        self._latched: Dict[str, bool] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None  # NonFiniteRule's jurisdiction
+        if value <= self.budget_bytes:
+            self._latched[series] = False
+            return None
+        if self._latched.get(series):
+            return None
+        self._latched[series] = True
+        over = value - self.budget_bytes
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"live state HBM {int(value)} bytes exceeds budget "
+            f"{self.budget_bytes} by {int(over)}",
+            {"budget_bytes": self.budget_bytes, "over_bytes": over},
         )
 
 

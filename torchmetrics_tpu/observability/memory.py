@@ -1,0 +1,411 @@
+"""Memory & cost observability plane: per-metric HBM attribution, compiled-
+executable memory/cost analysis, and a report-only :class:`ShardingAdvisor`.
+
+The sync planes (PRs 6-11) made *wire* bytes measurable; this module does the
+same for *resident* bytes, in three attribution layers:
+
+1. **Live state-HBM accounting** — every state install (the pytree rebound to
+   ``metric._state`` by update/forward/restore) is sized per-leaf and folded
+   into the telemetry registry as current/peak watermarks plus a
+   donated-vs-copied install byte split.  Sizing is *sharded-aware*: a leaf's
+   resident bytes are its per-shard bytes times its **addressable** device
+   count (what this host's HBM actually holds), not its logical bytes — a
+   replicated (2048, 2048) float32 on 8 local devices really occupies
+   8 x 16 MiB.  The sizer reads only aval metadata (shape/dtype/sharding),
+   never device buffers, so the armed path cannot retrace.
+2. **Compiled-executable analysis** — while armed, every compile-cache entry
+   in ``core/compile.py`` records ``compiled.memory_analysis()`` (argument /
+   output / temp / generated-code bytes, plus peak HBM where the backend
+   reports it) and ``cost_analysis()`` (FLOPs, bytes accessed), keyed by the
+   same 12-hex config fingerprints as ``compile_timeline()``.  Surfaced via
+   :func:`memory_timeline` / :func:`cost_by_fingerprint`; backends without
+   analyses (CPU reports no peak) degrade to whatever fields exist, with
+   ``available`` flagging rows where analysis failed entirely.
+3. **Replication-waste attribution** — each psum-family state leaf is
+   replicated across the mesh today, wasting ``leaf_bytes x (n_devices - 1)``
+   of cluster HBM.  The :class:`ShardingAdvisor` ranks those leaves and
+   quotes, per candidate, the granule-aware ring all-reduce bytes it pays now
+   versus the reduce-scatter bytes it would pay sharded (arxiv 2004.13336's
+   weight-update sharding applied to metric state) — the exact interface the
+   ROADMAP item-1 sharding planner will consume.  Report-only: nothing here
+   changes how state is placed.
+
+Everything is double-gated: :func:`enable_memory_telemetry` arms the plane,
+but nothing records until ``observability.enable()`` is also on (mirroring
+the flight recorder).  Arming adds **zero retraces and zero cache entries**:
+state sizing happens outside traced code, and executable analysis re-lowers
+through jax's jaxpr cache (the traced body does not re-run; the one-off cost
+is a second XLA compile per entry while armed).  Proven by the jaxpr
+bit-identity and ``cache_stats`` delta tests in ``test_memory.py``.
+
+Quick tour::
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.observability import memory
+
+    obs.enable()
+    memory.enable_memory_telemetry()      # or TM_TPU_MEMORY_TELEMETRY=1
+    ...                                   # train; installs are sized live
+    acc.telemetry.as_dict()["memory"]     # watermarks + per-leaf bytes
+    memory.memory_timeline()              # per-entry executable analyses
+    memory.cost_by_fingerprint()          # FLOPs/bytes by config fingerprint
+    advice = memory.ShardingAdvisor().advise([fid, psnr])
+    advice["candidates"][0]               # biggest replicated-waste leaf
+    obs.export(memory.memory_report([fid, psnr]), fmt="jsonl")
+
+A cheap, device-free example (the doctest tier-1 actually runs)::
+
+    >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    >>> from torchmetrics_tpu.observability.memory import ShardingAdvisor
+    >>> m = MulticlassConfusionMatrix(num_classes=64)
+    >>> advice = ShardingAdvisor().advise([m], n_devices=8)
+    >>> [c["leaf"] for c in advice["candidates"]]
+    ['confmat']
+    >>> advice["candidates"][0]["replicated_waste_bytes"] == 64 * 64 * 4 * 7
+    True
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.core import compile as _compile
+from torchmetrics_tpu.core.compile import cost_by_fingerprint, memory_timeline
+from torchmetrics_tpu.observability import registry
+from torchmetrics_tpu.utilities.benchmark import (
+    RING_GRANULE_BYTES,
+    _is_psum_shaped,
+    reduce_scatter_bytes,
+    ring_reduce_bytes,
+)
+
+__all__ = [
+    "ShardingAdvisor",
+    "cost_by_fingerprint",
+    "disable_memory_telemetry",
+    "enable_memory_telemetry",
+    "leaf_resident_bytes",
+    "memory_report",
+    "memory_telemetry_enabled",
+    "memory_timeline",
+    "snapshot_metric",
+    "state_memory_rows",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+
+# ---------------------------------------------------------------------------
+# layer 1: live state-HBM sizing
+# ---------------------------------------------------------------------------
+
+
+def leaf_resident_bytes(leaf: Any) -> Tuple[int, int]:
+    """``(resident_bytes, logical_bytes)`` of one array-like leaf.
+
+    Logical bytes are ``size x itemsize``.  Resident bytes are what this
+    host's HBM holds: per-shard bytes times the sharding's **addressable**
+    device count — so a fully replicated leaf on 8 local devices counts 8x
+    its logical bytes, while a leaf sharded 8 ways counts exactly once.
+    Falls back to logical bytes when the leaf has no sharding (tracers,
+    numpy, scalars mid-trace).  Reads only metadata, never device buffers.
+    """
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0, 0
+    try:
+        itemsize = int(dtype.itemsize)
+    except AttributeError:
+        import numpy as np
+
+        itemsize = int(np.dtype(dtype).itemsize)
+    logical = int(math.prod(shape)) * itemsize
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        try:
+            shard_shape = sharding.shard_shape(tuple(shape))
+            n_addressable = len(sharding.addressable_devices)
+            return int(math.prod(shard_shape)) * itemsize * n_addressable, logical
+        except Exception:  # tracers expose .sharding without a concrete mesh
+            pass
+    return logical, logical
+
+
+def state_memory_rows(state: Any) -> Tuple[Dict[str, Dict[str, int]], int]:
+    """Size a state pytree into ``({leaf_name: {"bytes", "logical_bytes"}},
+    resident_total)`` — the sizer the registry calls on every install.
+
+    Dict states (the ``Metric._state`` layout) keep their top-level names, so
+    leaf rows line up with the reduction table; nested pytree leaves (sketch
+    states) are summed under their top-level name.  Non-dict pytrees fall
+    back to jax tree-path names.
+    """
+    if isinstance(state, Mapping):
+        items: Iterable[Tuple[str, Any]] = state.items()
+    else:
+        items = [
+            (jax.tree_util.keystr(path), leaf)
+            for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]
+        ]
+    leaves: Dict[str, Dict[str, int]] = {}
+    resident_total = 0
+    for name, sub in items:
+        resident = logical = 0
+        for leaf in jax.tree.leaves(sub):
+            r, l = leaf_resident_bytes(leaf)
+            resident += r
+            logical += l
+        if resident or logical:
+            leaves[str(name)] = {"bytes": resident, "logical_bytes": logical}
+            resident_total += resident
+    return leaves, resident_total
+
+
+def snapshot_metric(metric: Any) -> None:
+    """Record ``metric``'s *current* state residency into the registry right
+    now, without waiting for the next install — useful when arming after the
+    metric already accumulated state.  Counted as a snapshot, not an install.
+    Same double gate as install accounting; a no-op while unarmed."""
+    state = getattr(metric, "_state", None)
+    if state:
+        registry.record_state_snapshot(metric, state)
+
+
+# ---------------------------------------------------------------------------
+# arming (the second half of the double gate)
+# ---------------------------------------------------------------------------
+
+
+def enable_memory_telemetry() -> None:
+    """Arm the memory plane: live install sizing in the registry plus
+    per-entry executable analysis capture in the compile cache.
+
+    Nothing records until ``observability.enable()`` is also on.  Arming
+    changes no cache key and adds no retrace: sizing reads aval metadata
+    outside traced code, and executable analysis re-lowers each entry through
+    jax's shared jaxpr cache (the Python body does not re-run; the cost is
+    one extra XLA compile per new entry while armed)."""
+    registry.set_memory_sizer(state_memory_rows)
+    registry.set_memory_armed(True)
+    _compile.set_analysis_capture(True)
+
+
+def disable_memory_telemetry() -> None:
+    """Disarm the memory plane.  Recorded watermarks and analysis rows are
+    kept (clear them with ``reset_telemetry()`` / ``clear_compile_cache()``);
+    new installs and new cache entries stop being sized."""
+    registry.set_memory_armed(False)
+    _compile.set_analysis_capture(False)
+
+
+def memory_telemetry_enabled() -> bool:
+    """True while the memory plane is armed (the registry gate; executable
+    capture is armed and disarmed in lockstep)."""
+    return registry.memory_armed()
+
+
+# ---------------------------------------------------------------------------
+# layer 3: replication-waste attribution
+# ---------------------------------------------------------------------------
+
+
+class ShardingAdvisor:
+    """Report-only advisor ranking the state leaves worth sharding.
+
+    For each psum-family leaf (the reductions ``core.reductions.sync_leaf``
+    lowers to a ring all-reduce) of each metric, computes:
+
+    * ``replicated_waste_bytes`` — ``leaf_bytes x (n_devices - 1)``, the
+      cluster HBM spent on redundant replicas today;
+    * ``ring_allreduce_bytes_per_chip`` — granule-aware per-chip wire bytes
+      one combine pays while replicated (``utilities.benchmark``'s model);
+    * ``reduce_scatter_bytes_per_chip`` — what the same combine would pay
+      with the leaf reduce-scattered (exactly the scatter half of the ring);
+    * ``projected_wire_savings_bytes_per_chip`` — the difference.
+
+    Leaf bytes come from the live registry rows when the memory plane has
+    recorded them (``source: "registry"`` — this is how the bench reproduces
+    BENCH_r05's FID+PSNR 33,570,840-byte figure from live attribution), else
+    from the metric's state pytree directly (``source: "state"``).  Gather-
+    family leaves (cat/reservoir/structural sketches) are excluded: they are
+    not replicated-by-sum, so sharding them is a different problem.
+
+    Report-only by construction: the advisor never touches placement.  Its
+    output dict is the interface the ROADMAP item-1 cross-replica sharding
+    planner will consume, and what ``memory_report()`` exports under
+    ``memory.advice``.
+    """
+
+    def __init__(
+        self,
+        n_devices: Optional[int] = None,
+        granule: int = RING_GRANULE_BYTES,
+        min_leaf_bytes: int = 1 << 20,
+    ) -> None:
+        self.n_devices = n_devices
+        self.granule = int(granule)
+        #: leaves at or above this size make the ``recommended`` short list;
+        #: below it the granule floor erodes the reduce-scatter win and the
+        #: HBM recovered is noise
+        self.min_leaf_bytes = int(min_leaf_bytes)
+
+    @staticmethod
+    def _label_for(metric: Any) -> str:
+        t = registry.telemetry_for(metric, create=False)
+        return t.label if t is not None else type(metric).__name__
+
+    @staticmethod
+    def _live_leaves_for(metric: Any) -> Optional[Dict[str, Dict[str, int]]]:
+        t = registry.telemetry_for(metric, create=False)
+        if t is None:
+            return None
+        leaves = t.memory.get("leaves")
+        return dict(leaves) if leaves else None
+
+    def advise(
+        self,
+        metrics: Iterable[Union[Any, Tuple[str, Any]]],
+        n_devices: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Rank every psum-family leaf of ``metrics`` by replicated waste.
+
+        ``metrics`` holds metric instances or ``(label, metric)`` pairs;
+        unlabelled metrics take their telemetry label (or class name).
+        ``n_devices`` defaults to the advisor's, then ``jax.device_count()``.
+        """
+        n = int(n_devices or self.n_devices or jax.device_count())
+        candidates: List[Dict[str, Any]] = []
+        total_psum = 0
+        for item in metrics:
+            if isinstance(item, tuple) and len(item) == 2 and isinstance(item[0], str):
+                label, metric = item
+            else:
+                label, metric = self._label_for(item), item
+            reductions = getattr(metric, "_reductions", None) or {}
+            state = getattr(metric, "_state", None) or {}
+            live = self._live_leaves_for(metric)
+            for name, reduce in sorted(reductions.items()):
+                if name not in state or not _is_psum_shaped(reduce):
+                    continue
+                row = (live or {}).get(name)
+                if row and row.get("logical_bytes"):
+                    nbytes = int(row["logical_bytes"])
+                    source = "registry"
+                else:
+                    nbytes = sum(
+                        leaf_resident_bytes(leaf)[1] for leaf in jax.tree.leaves(state[name])
+                    )
+                    source = "state"
+                if nbytes <= 0:
+                    continue
+                ring = ring_reduce_bytes(nbytes, n, self.granule)
+                scatter = reduce_scatter_bytes(nbytes, n, self.granule)
+                candidates.append(
+                    {
+                        "metric": label,
+                        "leaf": name,
+                        "bytes": nbytes,
+                        "source": source,
+                        "replicated_waste_bytes": nbytes * (n - 1),
+                        "ring_allreduce_bytes_per_chip": ring,
+                        "reduce_scatter_bytes_per_chip": scatter,
+                        "projected_wire_savings_bytes_per_chip": ring - scatter,
+                        "worth_sharding": nbytes >= self.min_leaf_bytes,
+                    }
+                )
+                total_psum += nbytes
+        candidates.sort(key=lambda c: (-c["replicated_waste_bytes"], c["metric"], c["leaf"]))
+        return {
+            "kind": "sharding_advice",
+            "n_devices": n,
+            "granule_bytes": self.granule,
+            "min_leaf_bytes": self.min_leaf_bytes,
+            "total_psum_state_bytes": total_psum,
+            "total_replicated_waste_bytes": total_psum * (n - 1),
+            "total_ring_allreduce_bytes_per_chip": sum(
+                c["ring_allreduce_bytes_per_chip"] for c in candidates
+            ),
+            "total_reduce_scatter_bytes_per_chip": sum(
+                c["reduce_scatter_bytes_per_chip"] for c in candidates
+            ),
+            "total_projected_wire_savings_bytes_per_chip": sum(
+                c["projected_wire_savings_bytes_per_chip"] for c in candidates
+            ),
+            "candidates": candidates,
+            "recommended": [
+                f"{c['metric']}/{c['leaf']}" for c in candidates if c["worth_sharding"]
+            ],
+            "note": (
+                "report-only: states stay replicated until the cross-replica "
+                "sharding planner lands; candidates ranked by replicated HBM waste"
+            ),
+        }
+
+
+# ---------------------------------------------------------------------------
+# the front-door report
+# ---------------------------------------------------------------------------
+
+
+def memory_report(
+    metrics: Optional[Iterable[Union[Any, Tuple[str, Any]]]] = None,
+    n_devices: Optional[int] = None,
+) -> Dict[str, Any]:
+    """One ``kind: "memory_report"`` payload tying all three layers together,
+    ready for ``observability.export`` (the JSONL line parses back through
+    ``parse_export_line``; the Prometheus exporter renders the
+    ``tm_tpu_memory_*`` / ``tm_tpu_cost_*`` families from it).
+
+    Layout::
+
+        {"schema": 1, "kind": "memory_report", "armed": bool,
+         "memory": {
+            "metrics": {label: memory-dict, ...},   # live watermark rows
+            "executables": [...],                   # memory_timeline()
+            "cost": {...},                          # cost_by_fingerprint()
+            "advice": {...}}}                       # iff metrics given
+
+    ``metrics`` (when given) additionally runs the :class:`ShardingAdvisor`
+    over those instances.
+    """
+    rep = registry.report()
+    mem_metrics = {
+        label: row["memory"]
+        for label, row in rep.get("metrics", {}).items()
+        if isinstance(row.get("memory"), Mapping)
+        and (row["memory"].get("installs") or row["memory"].get("snapshots"))
+    }
+    payload: Dict[str, Any] = {
+        "schema": 1,
+        "kind": "memory_report",
+        "armed": memory_telemetry_enabled(),
+        "enabled": registry.enabled(),
+        "memory": {
+            "metrics": mem_metrics,
+            "executables": memory_timeline(),
+            "cost": cost_by_fingerprint(),
+        },
+    }
+    if metrics is not None:
+        payload["memory"]["advice"] = ShardingAdvisor().advise(metrics, n_devices=n_devices)
+    return payload
+
+
+# the sizer is harmless to install eagerly (it only runs once armed), and
+# installing it here means arming via the registry flag alone also works
+registry.set_memory_sizer(state_memory_rows)
+
+# honour TM_TPU_MEMORY_TELEMETRY=1 the way registry honours TM_TPU_TELEMETRY
+if os.environ.get("TM_TPU_MEMORY_TELEMETRY", "").strip().lower() in (
+    "1",
+    "true",
+    "on",
+    "yes",
+):  # pragma: no cover - env-driven path
+    enable_memory_telemetry()

@@ -51,13 +51,19 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "memory_armed",
     "observe",
     "record_measured_sync",
     "record_quant_error",
+    "record_state_install",
+    "record_state_snapshot",
     "record_sync",
     "record_sync_wait",
     "report",
     "reset_telemetry",
+    "set_memory_armed",
+    "set_memory_sizer",
+    "set_memory_trace_sink",
     "set_trace_sinks",
     "span",
     "telemetry_for",
@@ -135,6 +141,47 @@ def set_trace_sinks(
         _COUNT_SINK = count_sink
 
 
+# Memory-plane hooks (observability/memory.py).  The sizer turns a state
+# pytree into per-leaf resident bytes without touching device buffers; the
+# trace sink mirrors installs into the flight recorder's "memory" category.
+# ``_MEMORY_ARMED`` is the second half of a double gate: live state-HBM
+# accounting records only while telemetry is enabled *and* the memory plane
+# is armed, so plain ``enable()`` keeps its existing cost profile.
+_MEMORY_ARMED = False
+_MEMORY_SIZER: Optional[Callable[[Any], Tuple[Dict[str, Dict[str, int]], int]]] = None
+_MEMORY_TRACE_SINK: Optional[Callable[[str, int, int, bool], None]] = None
+
+
+def set_memory_armed(armed: bool) -> None:
+    """Arm (or disarm) live state-HBM accounting.  Prefer the front door,
+    :func:`observability.memory.enable_memory_telemetry`, which also arms the
+    compile cache's executable-analysis capture."""
+    global _MEMORY_ARMED
+    with _LOCK:
+        _MEMORY_ARMED = bool(armed)
+
+
+def memory_armed() -> bool:
+    return _MEMORY_ARMED
+
+
+def set_memory_sizer(sizer: Optional[Callable[[Any], Tuple[Dict[str, Dict[str, int]], int]]]) -> None:
+    """Install the state-pytree sizer: ``sizer(state) -> (leaves, resident)``
+    where ``leaves`` maps leaf name to ``{"bytes", "logical_bytes"}`` and
+    ``resident`` is the addressable-shard byte total."""
+    global _MEMORY_SIZER
+    with _LOCK:
+        _MEMORY_SIZER = sizer
+
+
+def set_memory_trace_sink(sink: Optional[Callable[[str, int, int, bool], None]]) -> None:
+    """Install (or clear) the flight-recorder memory sink:
+    ``sink(label, current_bytes, peak_bytes, donated)`` fires per install."""
+    global _MEMORY_TRACE_SINK
+    with _LOCK:
+        _MEMORY_TRACE_SINK = sink
+
+
 class SpanStats:
     """Fixed-size latency accumulator: count/total/max, EMA, and a
     log-bucketed histogram.  O(1) memory regardless of sample count."""
@@ -189,7 +236,7 @@ class MetricTelemetry:
     """Counters, per-entrypoint cache stats, and timing spans for one metric
     instance (or one synthetic aggregate like ``_retired``)."""
 
-    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets")
+    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets", "memory")
 
     def __init__(self, label: str, cls: str) -> None:
         self.label = label
@@ -201,6 +248,21 @@ class MetricTelemetry:
         #: buckets) or ``"gather/dtype"`` (passthrough leaves); filled by
         #: :func:`record_measured_sync`
         self.sync_buckets: Dict[str, Dict[str, float]] = {}
+        #: live state-HBM watermarks, filled by :func:`record_state_install`
+        #: while the memory plane is armed (observability/memory.py)
+        self.memory: Dict[str, Any] = self._fresh_memory()
+
+    @staticmethod
+    def _fresh_memory() -> Dict[str, Any]:
+        return {
+            "current_bytes": 0,
+            "peak_bytes": 0,
+            "installs": 0,
+            "snapshots": 0,
+            "donated_install_bytes": 0,
+            "copied_install_bytes": 0,
+            "leaves": {},
+        }
 
     # -- mutation (callers hold _LOCK) -------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -264,6 +326,24 @@ class MetricTelemetry:
         row["quant_rel_err_sum"] = row.get("quant_rel_err_sum", 0.0) + float(rel_err)
         row["quant_err_count"] = row.get("quant_err_count", 0) + 1
 
+    def record_state_memory(
+        self,
+        leaves: Dict[str, Dict[str, int]],
+        resident: int,
+        donated: bool,
+        count_install: bool = True,
+    ) -> None:
+        mem = self.memory
+        mem["current_bytes"] = int(resident)
+        if resident > mem["peak_bytes"]:
+            mem["peak_bytes"] = int(resident)
+        if count_install:
+            mem["installs"] += 1
+            mem["donated_install_bytes" if donated else "copied_install_bytes"] += int(resident)
+        else:
+            mem["snapshots"] += 1
+        mem["leaves"] = leaves
+
     def absorb(self, other: "MetricTelemetry") -> None:
         for name, n in other.counters.items():
             self.counters[name] = self.counters.get(name, 0) + n
@@ -280,12 +360,23 @@ class MetricTelemetry:
                     mine[field] = n
                 else:
                     mine[field] = mine.get(field, 0) + n
+        # A retired metric's state is freed, so residency (current/leaves)
+        # does not carry over; the cumulative install bytes do, and the peak
+        # keeps high-watermark semantics.
+        om = other.memory
+        mem = self.memory
+        mem["peak_bytes"] = max(mem["peak_bytes"], om["peak_bytes"])
+        mem["installs"] += om["installs"]
+        mem["snapshots"] += om["snapshots"]
+        mem["donated_install_bytes"] += om["donated_install_bytes"]
+        mem["copied_install_bytes"] += om["copied_install_bytes"]
 
     def clear(self) -> None:
         self.counters = {name: 0 for name in COUNTER_NAMES}
         self.cache = {}
         self.spans = {}
         self.sync_buckets = {}
+        self.memory = self._fresh_memory()
 
     @property
     def active(self) -> bool:
@@ -294,6 +385,8 @@ class MetricTelemetry:
             or any(any(slot.values()) for slot in self.cache.values())
             or any(s.count for s in self.spans.values())
             or bool(self.sync_buckets)
+            or self.memory["installs"] > 0
+            or self.memory["snapshots"] > 0
         )
 
     @staticmethod
@@ -319,6 +412,12 @@ class MetricTelemetry:
                 "sync_buckets": {
                     key: self._bucket_row(row)
                     for key, row in sorted(self.sync_buckets.items())
+                },
+                "memory": {
+                    **{k: v for k, v in self.memory.items() if k != "leaves"},
+                    "leaves": {
+                        name: dict(leaf) for name, leaf in sorted(self.memory["leaves"].items())
+                    },
                 },
             }
 
@@ -656,6 +755,57 @@ def record_sync_wait(seconds: float) -> None:
         _PROCESS.record_span("sync_wait", float(seconds))
 
 
+def record_state_install(obj: Any, state: Any, donated: bool) -> None:
+    """Record one state install (the pytree rebound to ``metric._state``)
+    into the owner's live-HBM watermarks: per-leaf resident bytes
+    (addressable shard bytes, not logical bytes — observability/memory.py
+    owns the sizer), a current/peak watermark pair, and the donated-vs-copied
+    install byte split.
+
+    Double-gated: a no-op unless telemetry is enabled *and* the memory plane
+    is armed (:func:`observability.memory.enable_memory_telemetry`).  Reads
+    only aval metadata (shape/dtype/sharding), never device buffers, so the
+    armed path stays off the trace and adds no retraces.  Never raises."""
+    if not _ENABLED or not _MEMORY_ARMED:
+        return
+    sizer = _MEMORY_SIZER
+    if sizer is None:
+        return
+    try:
+        leaves, resident = sizer(state)
+    except Exception:
+        _log.debug("state memory accounting failed for %r", obj, exc_info=True)
+        return
+    with _LOCK:
+        t = telemetry_for(obj)
+        t.record_state_memory(leaves, resident, donated)
+        peak = t.memory["peak_bytes"]
+    sink = _MEMORY_TRACE_SINK
+    if sink is not None:
+        sink(t.label, resident, peak, donated)
+
+
+def record_state_snapshot(obj: Any, state: Any) -> None:
+    """Refresh ``obj``'s residency watermarks from ``state`` *on demand*,
+    without counting an install — how on-demand reports
+    (:func:`observability.memory.snapshot_metric`) attribute bytes of metrics
+    whose installs predate arming.  Counted under ``memory["snapshots"]``;
+    the donated/copied install byte split is untouched.  Same double gate as
+    :func:`record_state_install`.  Never raises."""
+    if not _ENABLED or not _MEMORY_ARMED:
+        return
+    sizer = _MEMORY_SIZER
+    if sizer is None:
+        return
+    try:
+        leaves, resident = sizer(state)
+    except Exception:
+        _log.debug("state memory snapshot failed for %r", obj, exc_info=True)
+        return
+    with _LOCK:
+        telemetry_for(obj).record_state_memory(leaves, resident, donated=False, count_install=False)
+
+
 def record_quant_error(obj: Any, bucket_key: str, rel_err: float) -> None:
     """Fold one *measured* quantization relative error into ``obj``'s bucket
     row ``bucket_key`` (e.g. ``"float32/sum"``).  Callers measure against an
@@ -697,6 +847,22 @@ def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
                     mine[field] = n
                 else:
                     mine[field] = mine.get(field, 0) + n
+        # Live aggregation (unlike retirement-time absorb) sums residency:
+        # the aggregate's current is total resident state across members, its
+        # peak the sum of member peaks — an upper bound on the simultaneous
+        # peak.  Leaf names collide across metrics, so leaves stay empty.
+        mem = part.get("memory")
+        if mem:
+            am = agg.memory
+            for field in (
+                "current_bytes",
+                "peak_bytes",
+                "installs",
+                "snapshots",
+                "donated_install_bytes",
+                "copied_install_bytes",
+            ):
+                am[field] += int(mem.get(field, 0))
     return agg.as_dict()
 
 
@@ -786,6 +952,21 @@ def _diff_tdict(after: Mapping[str, Any], before: Optional[Mapping[str, Any]]) -
     for key, row in after.get("sync_buckets", {}).items():
         prev = before.get("sync_buckets", {}).get(key, {})
         out["sync_buckets"][key] = {f: _diff_num(n, prev.get(f, 0)) for f, n in row.items()}
+    mem = after.get("memory")
+    if mem is not None:
+        prev_mem = before.get("memory", {})
+        out["memory"] = {
+            # cumulative fields diff; watermarks and leaves are point-in-time
+            # so the window keeps their end-of-window values
+            **{k: v for k, v in mem.items() if k != "leaves"},
+            "installs": int(mem.get("installs", 0)) - int(prev_mem.get("installs", 0)),
+            "snapshots": int(mem.get("snapshots", 0)) - int(prev_mem.get("snapshots", 0)),
+            "donated_install_bytes": int(mem.get("donated_install_bytes", 0))
+            - int(prev_mem.get("donated_install_bytes", 0)),
+            "copied_install_bytes": int(mem.get("copied_install_bytes", 0))
+            - int(prev_mem.get("copied_install_bytes", 0)),
+            "leaves": dict(mem.get("leaves", {})),
+        }
     return out
 
 

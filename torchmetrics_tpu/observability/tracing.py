@@ -64,7 +64,7 @@ __all__ = [
 
 #: event categories the recorder emits (the ``cat`` field); Perfetto's track
 #: filter groups on these
-CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy")
+CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy", "memory")
 
 DEFAULT_CAPACITY = 4096
 
@@ -374,6 +374,22 @@ def _count_sink(label: str, counter: str, n: int) -> None:
         rec.instant(f"{label}/{name}", cat, tid=label, count=n)
 
 
+def _memory_sink(label: str, current_bytes: int, peak_bytes: int, donated: bool) -> None:
+    """Registry state-install hook (armed memory plane): one instant per
+    sized install, carrying the watermarks so a trace shows residency steps."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.instant(
+        f"{label}/state_install",
+        "memory",
+        tid=label,
+        current_bytes=int(current_bytes),
+        peak_bytes=int(peak_bytes),
+        donated=bool(donated),
+    )
+
+
 def _compile_sink(record: Any) -> None:
     """Compile-cache timing hook (``core.compile.CompileRecord``)."""
     rec = _RECORDER
@@ -398,9 +414,11 @@ def _wire_sinks(arm: bool) -> None:
 
     if arm:
         _registry.set_trace_sinks(_span_sink, _count_sink)
+        _registry.set_memory_trace_sink(_memory_sink)
         _compile.add_compile_timing_observer(_compile_sink)
     else:
         _registry.set_trace_sinks(None, None)
+        _registry.set_memory_trace_sink(None)
         _compile.remove_compile_timing_observer(_compile_sink)
 
 

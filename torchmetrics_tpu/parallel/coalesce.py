@@ -1158,6 +1158,18 @@ class SyncAdvisor:
                 "throttle, neighbor load) before retuning cadence: a straggler "
                 "dominates every cadence equally"
             )
+            # cross-reference the memory plane: a straggler that also tops the
+            # live-HBM axis is likely paging/allocator-bound, not feed-bound
+            hbm = skew.get("hbm_bytes")
+            if isinstance(hbm, Mapping):
+                hbm_ratio = float(hbm.get("skew_ratio", 1.0))
+                if hbm.get("max_process") == straggler.get("process") and hbm_ratio >= 2.0:
+                    advice["footprint_note"] = (
+                        f"the straggler also holds {hbm_ratio:.1f}x the fleet-median "
+                        "live metric-state HBM — check its resident footprint "
+                        "(memory_report / tm_tpu_memory_state_bytes) before blaming "
+                        "the interconnect"
+                    )
         else:
             advice["note"] = (
                 "sync wait is balanced across processes; cadence/compression "

@@ -26,6 +26,7 @@ __all__ = [
     "coalesced_sync_bytes_per_chip",
     "collectives_per_sync",
     "per_leaf_sync_bytes_per_chip",
+    "reduce_scatter_bytes",
     "ring_reduce_bytes",
     "state_bytes",
     "sync_bytes_per_chip",
@@ -127,6 +128,24 @@ def ring_reduce_bytes(
         return 0
     chunk = math.ceil(buffer_bytes / (n_devices * granule)) * granule
     return int(2 * (n_devices - 1) * chunk)
+
+
+def reduce_scatter_bytes(
+    buffer_bytes: int, n_devices: int, granule: int = RING_GRANULE_BYTES
+) -> int:
+    """Granule-aware per-chip traffic of ONE ring reduce-scatter of
+    ``buffer_bytes``: ``(n-1) * ceil(B / (n*granule)) * granule`` — exactly
+    the scatter half of :func:`ring_reduce_bytes`.
+
+    This is what a sharded psum-family state pays per combine once its leaves
+    live reduce-scattered instead of replicated (arxiv 2004.13336's weight-
+    update sharding applied to metric state); the
+    :class:`observability.memory.ShardingAdvisor` quotes the difference as
+    the projected wire savings."""
+    if n_devices <= 1 or buffer_bytes <= 0:
+        return 0
+    chunk = math.ceil(buffer_bytes / (n_devices * granule)) * granule
+    return int((n_devices - 1) * chunk)
 
 
 def collectives_per_sync(reductions: Dict[str, Any], state: Dict[str, Any]) -> Dict[str, int]:

@@ -66,6 +66,8 @@ _LOWER_BETTER = (
     "_ms",
     "wall_s",
     "_bytes",
+    "_waste_bytes",  # ShardingAdvisor replicated-HBM waste (subsumed by _bytes;
+    "_hbm_bytes",  # listed with _hbm_bytes so the gate survives a _bytes edit)
     "overhead",
     "retraces",
     "_misses",
