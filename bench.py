@@ -1861,9 +1861,10 @@ def analysis_leg():
     budget so the CI gate stays cheap, plus one jaxpr contract audit proving
     the planner's collective count matches the lowered sync graph, plus the
     whole-program sanitizer (``--audit-all``: donation races, fingerprint
-    completeness, collective uniformity, golden trace contracts) timed as a
-    fresh subprocess — the honest CI cost, including interpreter start and
-    the 8-device host-platform bootstrap — against a 20 s budget.
+    completeness, collective uniformity, golden trace contracts, and the
+    tier-4 numerics pass TMT014-TMT017) timed as a fresh subprocess — the
+    honest CI cost, including interpreter start and the 8-device
+    host-platform bootstrap — against a 20 s budget.
     """
     import subprocess
     import sys as _sys
@@ -1914,7 +1915,8 @@ def analysis_leg():
         "note": "the lint gate runs in tier-1 CI (exit code 1 on any finding); "
         "the audit closes the loop between the coalescing planner's cost model "
         "and the collectives XLA actually lowers; audit_all times the full "
-        "whole-program sanitizer (TMT010-TMT013) as a cold subprocess",
+        "whole-program sanitizer (TMT010-TMT017, numerics included) as a "
+        "cold subprocess",
     }
 
 

@@ -1,0 +1,194 @@
+"""Tour of the tier-4 numerics sanitizer (TMT014-TMT017):
+
+1. saturation horizons — the ``--horizons`` table for a small metric slate,
+   and the float32 stagnation cliff demonstrated numerically (a counter
+   that silently stops counting at 2**24);
+2. an int16 accumulator driven *past* its statically predicted wrap, with
+   the observed overflow landing within one batch of the prediction;
+3. each rule firing on a deliberately broken metric: an unguarded divide
+   (TMT016), a non-inductive value_range declaration (TMT017), and an
+   exact counter committed to a quantized sync bucket (TMT015);
+4. the suppression grammar for a documented, justified horizon.
+
+Run with:  python examples/numerics_walkthrough.py
+"""
+
+import math
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp  # noqa: E402
+
+from torchmetrics_tpu.aggregation import MeanMetric  # noqa: E402
+from torchmetrics_tpu.analysis.numerics import (  # noqa: E402
+    NumericsAssumptions,
+    _compression_findings,
+    _divide_findings,
+    _horizon_findings,
+    _range_contract_findings,
+    _trace_update,
+    format_horizon_table,
+    predict_horizons,
+)
+from torchmetrics_tpu.classification import BinaryAccuracy  # noqa: E402
+from torchmetrics_tpu.core.metric import Metric  # noqa: E402
+from torchmetrics_tpu.image import PeakSignalNoiseRatio  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+# --------------------------------------------------------- 1. horizon table
+banner("1. Saturation horizons (the --horizons table)")
+
+assumptions = NumericsAssumptions(batch_size=4096, sample_budget=1e9)
+rows = []
+for metric, inputs in (
+    (BinaryAccuracy(), (jnp.zeros((32,)), jnp.zeros((32,), jnp.int32))),
+    (MeanMetric(), (jnp.zeros((32,)),)),
+    (PeakSignalNoiseRatio(data_range=1.0), (jnp.zeros((2, 8, 12)), jnp.zeros((2, 8, 12)))),
+):
+    rows.extend(predict_horizons(metric, *inputs, assumptions=assumptions))
+print(format_horizon_table(rows, assumptions))
+print(
+    "\nReading: PSNR counts 96 *pixels* per sample here, so its int32 pixel\n"
+    "counter saturates long before the per-sample counters do; MeanMetric's\n"
+    "float32 weight is the one stagnation row (see part 4)."
+)
+
+banner("1b. The float32 stagnation cliff, numerically")
+c = jnp.asarray(2.0**24, jnp.float32)
+print(f"2**24       = {c:.1f}")
+print(f"2**24 + 1.0 = {c + 1.0:.1f}   <- the +1 rounds to +0: the counter froze")
+print("No NaN, no warning, a plausible value. That silence is what TMT014 gates.")
+
+
+# ------------------------------------------------- 2. predicted vs observed
+banner("2. Predicted int16 wrap vs observed wrap")
+
+
+class TinyCounter(Metric):
+    """Deliberately undersized accumulator so the wrap is cheap to reach."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("count", jnp.zeros((), dtype=jnp.int16), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        ones = jnp.ones(x.shape, jnp.int16)
+        return {"count": state["count"] + jnp.sum(ones, dtype=jnp.int16)}
+
+    def _compute(self, state):
+        return state["count"]
+
+
+batch = 4096
+m = TinyCounter()
+x = jnp.zeros((batch,))
+row = next(r for r in predict_horizons(m, x) if r.leaf == "count")
+print(f"static prediction: {row.kind} after {row.horizon_samples:.0f} samples "
+      f"(~{row.horizon_samples / batch:.2f} updates at batch {batch})")
+
+state = m.init_state()
+for step in range(1, math.ceil(row.horizon_samples / batch) + 2):
+    state = m.update_state(state, x)
+    if int(state["count"]) < step * batch:
+        print(f"observed:          count wrapped to {int(state['count'])} on update {step}")
+        break
+print("prediction and observation agree to within one batch.")
+
+
+# ------------------------------------------------------ 3. the rule family
+banner("3. TMT016: a reachable divide-by-zero in compute")
+
+
+class UnguardedRate(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("hits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("misses", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        hit = jnp.sum((x >= 0).astype(jnp.float32))
+        return {"hits": state["hits"] + hit, "misses": state["misses"] + (x.shape[0] - hit)}
+
+    def _compute(self, state):
+        return state["hits"] / state["misses"]  # misses can be exactly 0
+
+
+bad = UnguardedRate()
+for f in _divide_findings(bad, _trace_update(bad, (x,))):
+    print(f"{f.rule}: {f.message}\n")
+print("Fix: _safe_divide(hits, misses) or jnp.maximum(misses, 1.0) — both are\n"
+      "recognized structurally and clear the finding.")
+
+banner("3b. TMT017: a value_range declaration that is not inductive")
+
+
+class BadRange(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        # signed inputs flow into a leaf declared nonnegative
+        self.add_state("acc", jnp.zeros(()), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
+
+    def _update(self, state, x):
+        return {"acc": state["acc"] + jnp.sum(x)}
+
+    def _compute(self, state):
+        return state["acc"]
+
+
+for f in _range_contract_findings(BadRange(), (x,)):
+    print(f"{f.rule}: {f.message}\n")
+
+banner("3c. TMT015: an exact counter committed to a quantized bucket")
+
+from torchmetrics_tpu.parallel.coalesce import SyncPolicy  # noqa: E402
+
+
+class WideCounter(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("counts", jnp.zeros((2048,), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"counts": state["counts"] + jnp.ones((2048,), jnp.float32)}
+
+    def _compute(self, state):
+        return state["counts"]
+
+
+w = WideCounter()
+w._autotuned_policy = SyncPolicy(compression="bf16")
+for f in _compression_findings(w, _trace_update(w, (x,))):
+    print(f"{f.rule}: {f.message}\n")
+print("The package-wide fix was registering counters as int32: integer\n"
+      "buckets never compress, so the finding family discharges by dtype.")
+
+
+# -------------------------------------------------------- 4. suppressions
+banner("4. Documented suppressions")
+
+mm = MeanMetric()
+findings = _horizon_findings(
+    mm, predict_horizons(mm, jnp.zeros((32,))), NumericsAssumptions()
+)
+print("MeanMetric.weight still *fires* TMT014 (float is mandatory — user\n"
+      "weights may be fractional):\n")
+for f in findings:
+    print(f"  {f.path}:{f.line} {f.rule} {f.message[:90]}...")
+print(
+    "\nIt ships suppressed at the registration site, justification required:\n\n"
+    '  self.add_state("weight", ...)  # tmt: ignore[TMT014] -- float weight sum:\n'
+    "      fractional weights are legal; f32 stagnates at 2**24 unit-weight\n"
+    "      values (documented)\n\n"
+    "python -m torchmetrics_tpu.analysis --audit-all runs TMT014-TMT017 over\n"
+    "the golden slate and exits 0 only when every finding is fixed or\n"
+    "justified like this."
+)

@@ -154,13 +154,16 @@ def test_audit_compressed_sync_contract(clf_batch):
     sync lowers exactly the planner's collective count, keeps host callbacks
     out of the trace, and confines dequantize ops to the sync graph — the
     update trace stays dequantize-free."""
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    # MSE with many outputs: `measure` is a float32 sum leaf big enough to
+    # clear the bucket-size floor (the confusion/stat counters are int32 now
+    # and integer buckets never compress)
     from torchmetrics_tpu.parallel.compress import CompressionConfig
+    from torchmetrics_tpu.regression import MeanSquaredError
 
     rng = np.random.default_rng(21)
-    preds = jnp.asarray(rng.integers(0, 64, (32,)))
-    target = jnp.asarray(rng.integers(0, 64, (32,)))
-    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    preds = jnp.asarray(rng.normal(size=(32, 2048)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(32, 2048)), jnp.float32)
+    m = MeanSquaredError(num_outputs=2048)
     rep = audit_metric(m, preds, target, compression=CompressionConfig("int8", 0.05))
     assert rep.ok, rep.violations
     comp = rep.compression

@@ -60,9 +60,26 @@ def test_list_rules_prints_registry():
 def test_list_rules_tags_whole_program_passes():
     proc = _run("--list-rules")
     assert proc.returncode == 0
-    for rid in ("TMT010", "TMT011", "TMT012", "TMT013"):
+    for rid in ("TMT010", "TMT011", "TMT012", "TMT013", "TMT014", "TMT015", "TMT016", "TMT017"):
         line = next(l for l in proc.stdout.splitlines() if l.startswith(rid))
         assert "[whole-program]" in line
+
+
+@pytest.mark.contracts
+def test_horizons_prints_saturation_table():
+    proc = _run("--horizons")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "horizon (samples)" in proc.stdout
+    # the two documented float/int accumulators appear with their kinds
+    assert "MeanMetric" in proc.stdout and "stagnation" in proc.stdout
+    assert "PeakSignalNoiseRatio" in proc.stdout and "saturation" in proc.stdout
+
+
+@pytest.mark.contracts
+def test_horizons_flags_change_the_rendered_assumptions():
+    proc = _run("--horizons", "--batch-size", "1024", "--sample-budget", "1e6")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "updates@1024" in proc.stdout
 
 
 def test_github_format_emits_error_annotations(tmp_path):

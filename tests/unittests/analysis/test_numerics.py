@@ -1,0 +1,382 @@
+"""Tier-4 numerics sanitizer tests (TMT014-TMT017).
+
+Covers the Abstract interval/exactness domain on real jaxprs, horizon
+prediction (including an *empirical* int16 wrap matching the static
+prediction within one batch), the four finding families on deliberately
+broken metrics, suppression/hygiene integration, and the value_range
+snapshot/fingerprint round-trip.
+"""
+
+import math
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.analysis.linter import apply_suppressions, lint_file
+from torchmetrics_tpu.analysis.numerics import (
+    NUMERICS_RULE_IDS,
+    Abstract,
+    NumericsAssumptions,
+    _compression_findings,
+    _divide_findings,
+    _horizon_findings,
+    _range_contract_findings,
+    _trace_update,
+    abstract_eval_jaxpr,
+    format_horizon_table,
+    mantissa_bits,
+    predict_horizons,
+)
+from torchmetrics_tpu.analysis.linter import all_rules
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+pytestmark = pytest.mark.numerics
+
+INF = float("inf")
+
+
+def _abstract_of(fn, *in_abstracts, example_args):
+    closed = jax.make_jaxpr(fn)(*example_args)
+    outs, _ev = abstract_eval_jaxpr(closed, list(in_abstracts))
+    return outs[0]
+
+
+# ------------------------------------------------------------ abstract domain
+def test_interval_add_sub_mul():
+    x = jnp.zeros((4,))
+    out = _abstract_of(lambda a, b: a + b, Abstract(0, 2, True), Abstract(1, 3, True), example_args=(x, x))
+    assert (out.lo, out.hi, out.integral) == (1, 5, True)
+    out = _abstract_of(lambda a, b: a - b, Abstract(0, 2, True), Abstract(1, 3, True), example_args=(x, x))
+    assert (out.lo, out.hi) == (-3, 1)
+    out = _abstract_of(lambda a, b: a * b, Abstract(-2, 3, True), Abstract(0, 4, True), example_args=(x, x))
+    assert (out.lo, out.hi, out.integral) == (-8, 12, True)
+
+
+def test_comparison_yields_unit_integral_indicator():
+    x = jnp.zeros((4,))
+    out = _abstract_of(lambda a, b: (a >= b).astype(jnp.float32), Abstract(-INF, INF, False),
+                       Abstract(-INF, INF, False), example_args=(x, x))
+    assert (out.lo, out.hi, out.integral) == (0, 1, True)
+
+
+def test_square_and_same_var_mul_are_nonnegative():
+    x = jnp.zeros((4,))
+    top = Abstract(-INF, INF, False)
+    assert _abstract_of(lambda a: jnp.square(a), top, example_args=(x,)).lo == 0
+    assert _abstract_of(lambda a: a * a, top, example_args=(x,)).lo == 0
+
+
+def test_reduce_sum_scales_by_element_count():
+    x = jnp.zeros((8,))
+    out = _abstract_of(lambda a: jnp.sum((a >= 0).astype(jnp.float32)), Abstract(-INF, INF, False),
+                       example_args=(x,))
+    assert (out.lo, out.hi, out.integral) == (0, 8, True)
+
+
+def test_clip_and_maximum_bound_the_interval():
+    x = jnp.zeros((4,))
+    top = Abstract(-INF, INF, False)
+    out = _abstract_of(lambda a: jnp.clip(a, 0.0, 1.0), top, example_args=(x,))
+    assert (out.lo, out.hi) == (0, 1)
+    out = _abstract_of(lambda a: jnp.maximum(a, 1.0), top, example_args=(x,))
+    assert out.lo == 1
+
+
+def test_int_cast_clamps_to_dtype_range():
+    x = jnp.zeros((4,))
+    out = _abstract_of(lambda a: a.astype(jnp.int8), Abstract(-INF, INF, False), example_args=(x,))
+    assert (out.lo, out.hi, out.integral) == (-128, 127, True)
+
+
+def test_mantissa_bits():
+    assert mantissa_bits(jnp.float32) == 24
+    assert mantissa_bits(jnp.bfloat16) == 8
+    assert mantissa_bits(jnp.float16) == 11
+
+
+# ----------------------------------------------------------- horizon metrics
+class _Counter(Metric):
+    """Counts elements into a configurable accumulator dtype."""
+
+    def __init__(self, dtype=jnp.float32, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("count", jnp.zeros((), dtype=dtype), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        # pin the sum dtype: jnp.sum would silently promote int16 to int32
+        ones = jnp.ones(x.shape, state["count"].dtype)
+        return {"count": state["count"] + jnp.sum(ones, dtype=state["count"].dtype)}
+
+    def _compute(self, state):
+        return state["count"]
+
+
+def _batch(n=32):
+    return (jnp.zeros((n,), jnp.float32),)
+
+
+def test_float32_counter_stagnates_at_2_pow_24():
+    rows = predict_horizons(_Counter(jnp.float32), *_batch())
+    row = next(r for r in rows if r.leaf == "count")
+    assert row.kind == "stagnation"
+    assert row.rate_per_sample == 1
+    assert row.horizon_samples == 2**24
+
+
+def test_int16_counter_saturates_at_iinfo_max():
+    rows = predict_horizons(_Counter(jnp.int16), *_batch())
+    row = next(r for r in rows if r.leaf == "count")
+    assert row.kind == "saturation"
+    assert row.horizon_samples == np.iinfo(np.int16).max
+
+
+def test_horizon_findings_respect_sample_budget():
+    m = _Counter(jnp.float32)
+    rows = predict_horizons(m, *_batch())
+    hot = NumericsAssumptions(sample_budget=1e9)
+    cold = NumericsAssumptions(sample_budget=1e6)
+    assert any(f.rule == "TMT014" for f in _horizon_findings(m, rows, hot))
+    assert not _horizon_findings(m, rows, cold)
+
+
+def test_int32_counter_clears_default_budget():
+    m = _Counter(jnp.int32)
+    rows = predict_horizons(m, *_batch())
+    assert not _horizon_findings(m, rows, NumericsAssumptions())
+
+
+def test_predicted_int16_horizon_matches_observed_wrap():
+    """Satellite: the static horizon is not just plausible — run a deliberately
+    small int16 accumulator to its predicted wrap and check the observed
+    overflow lands within one batch of the prediction."""
+    batch = 4096
+    m = _Counter(jnp.int16)
+    x = jnp.zeros((batch,), jnp.float32)
+    row = next(r for r in predict_horizons(m, x) if r.leaf == "count")
+    predicted_updates = math.ceil(row.horizon_samples / batch)
+
+    state = m.init_state()
+    observed = None
+    for step in range(1, predicted_updates + 2):
+        state = m.update_state(state, x)
+        if int(state["count"]) < step * batch:  # wrapped (or stuck): no longer exact
+            observed = step
+            break
+    assert observed is not None
+    assert abs(observed - row.horizon_samples / batch) <= 1.0
+
+
+# ----------------------------------------------------------- TMT016: divides
+class _UnguardedRate(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("hits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("misses", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        hit = jnp.sum((x >= 0).astype(jnp.float32))
+        return {"hits": state["hits"] + hit, "misses": state["misses"] + (x.shape[0] - hit)}
+
+    def _compute(self, state):
+        # misses can be exactly zero after updates: this divide is reachable
+        return state["hits"] / state["misses"]
+
+
+class _GuardedRate(_UnguardedRate):
+    def _compute(self, state):
+        return _safe_divide(state["hits"], state["misses"])
+
+
+class _MaxBoundedRate(_UnguardedRate):
+    def _compute(self, state):
+        return state["hits"] / jnp.maximum(state["misses"], 1.0)
+
+
+def test_unguarded_divide_fires_and_guards_clear_it():
+    bad = _UnguardedRate()
+    analysis = _trace_update(bad, _batch())
+    findings = _divide_findings(bad, analysis)
+    assert any(f.rule == "TMT016" for f in findings)
+    for cls in (_GuardedRate, _MaxBoundedRate):
+        m = cls()
+        assert not _divide_findings(m, _trace_update(m, _batch())), cls.__name__
+
+
+# ----------------------------------------------------- TMT017: range contract
+class _BadRange(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        # signed values flow into a leaf declared nonnegative: not inductive
+        self.add_state("acc", jnp.zeros(()), dist_reduce_fx="sum", value_range=(0.0, INF))
+
+    def _update(self, state, x):
+        return {"acc": state["acc"] + jnp.sum(x)}
+
+    def _compute(self, state):
+        return state["acc"]
+
+
+def test_range_contract_catches_non_inductive_declaration():
+    findings = _range_contract_findings(_BadRange(), _batch())
+    assert any(f.rule == "TMT017" for f in findings)
+
+
+def test_range_contract_accepts_inductive_declaration():
+    assert not _range_contract_findings(_Counter(jnp.int32), _batch())
+    # and metrics with no declarations are trivially clean
+    assert not _range_contract_findings(_UnguardedRate(), _batch())
+
+
+# --------------------------------------------------- TMT015: unsafe downcast
+class _WideCounter(Metric):
+    """2048-element float32 counter — big enough to clear the bucket floor."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("counts", jnp.zeros((2048,), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"counts": state["counts"] + jnp.ones((2048,), jnp.float32)}
+
+    def _compute(self, state):
+        return state["counts"]
+
+
+def test_exact_counter_in_quantized_bucket_fires():
+    from torchmetrics_tpu.parallel.coalesce import SyncPolicy
+
+    m = _WideCounter()
+    m._autotuned_policy = SyncPolicy(compression="bf16")
+    findings = _compression_findings(m, _trace_update(m, _batch()))
+    assert any(f.rule == "TMT015" and "exact counter" in f.message for f in findings)
+
+
+def test_infeasible_error_budget_fires():
+    from torchmetrics_tpu.parallel.coalesce import SyncPolicy
+    from torchmetrics_tpu.parallel.compress import predicted_error_bound
+
+    m = _WideCounter()
+    tiny = predicted_error_bound("int8", stages=2) / 10
+    m._autotuned_policy = SyncPolicy(compression="int8", error_budget=tiny)
+    findings = _compression_findings(m, _trace_update(m, _batch()))
+    assert any(f.rule == "TMT015" and "infeasible" in f.message for f in findings)
+
+
+def test_uncompressed_policy_is_exempt():
+    from torchmetrics_tpu.parallel.coalesce import SyncPolicy
+
+    m = _WideCounter()
+    m._autotuned_policy = SyncPolicy(every_n_steps=4)  # compression="none"
+    assert not _compression_findings(m, _trace_update(m, _batch()))
+
+
+# ------------------------------------------------- registry + suppressions
+def test_numerics_rules_are_registered_whole_program():
+    by_id = {r.id: r for r in all_rules()}
+    for rid in NUMERICS_RULE_IDS:
+        assert rid in by_id
+        assert by_id[rid].whole_program
+
+
+def test_suppression_filters_numerics_findings(tmp_path):
+    from torchmetrics_tpu.analysis.linter import Finding
+
+    src = tmp_path / "mod.py"
+    src.write_text(
+        "x = 1\n"
+        "y = 2  # tmt: ignore[TMT014] -- documented horizon\n"
+    )
+    findings = [
+        Finding("TMT014", "mod.py", 2, "suppressed"),
+        Finding("TMT014", "mod.py", 1, "survives"),
+        Finding("TMT016", "mod.py", 2, "wrong id, survives"),
+    ]
+    out = apply_suppressions(findings, root=tmp_path)
+    assert [(f.rule, f.line) for f in out] == [("TMT014", 1), ("TMT016", 2)]
+
+
+def test_hygiene_accepts_numerics_ids_without_staleness(tmp_path):
+    """TMT009 hygiene: per-line ignores naming whole-program numerics ids are
+    legal in per-file lint runs (their findings only exist in --audit-all),
+    but unknown ids and missing justifications still trip."""
+    good = tmp_path / "good.py"
+    good.write_text("state = 0  # tmt: ignore[TMT014] -- pixel counter, documented horizon\n")
+    assert lint_file(good, tmp_path) == []
+
+    nojust = tmp_path / "nojust.py"
+    nojust.write_text("state = 0  # tmt: ignore[TMT017]\n")
+    assert any(f.rule == "TMT009" and "justification" in f.message for f in lint_file(nojust, tmp_path))
+
+    unknown = tmp_path / "unknown.py"
+    unknown.write_text("state = 0  # tmt: ignore[TMT099] -- nope\n")
+    assert any(f.rule == "TMT009" and "unknown" in f.message for f in lint_file(unknown, tmp_path))
+
+
+# ------------------------------------------- value_range snapshot round-trip
+def test_value_range_survives_pickle():
+    m = _BadRange()
+    assert m._value_ranges == {"acc": (0.0, INF)}
+    m2 = pickle.loads(pickle.dumps(m))
+    assert m2._value_ranges == {"acc": (0.0, INF)}
+
+
+def test_setstate_defaults_value_ranges_for_old_pickles():
+    m = _Counter(jnp.int32)
+    state = m.__getstate__()
+    state.pop("_value_ranges", None)  # simulate a pre-value_range pickle
+    m2 = _Counter.__new__(_Counter)
+    m2.__setstate__(state)
+    assert m2._value_ranges == {}
+
+
+def test_value_range_participates_in_config_fingerprint():
+    from torchmetrics_tpu.core.compile import config_fingerprint
+
+    class _Ranged(Metric):
+        def __init__(self, hi, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("ids", [], dist_reduce_fx="cat", value_range=(0.0, float(hi)))
+
+        def _update(self, state, x):
+            return {"ids": tuple(state["ids"]) + (x.astype(jnp.int32),)}
+
+        def _compute(self, state):
+            return jnp.zeros(())
+
+    a, b, c = _Ranged(255), _Ranged(65535), _Ranged(255)
+    assert config_fingerprint(a) == config_fingerprint(c)
+    assert config_fingerprint(a) != config_fingerprint(b)
+
+
+# ----------------------------------------------------------- report surface
+def test_github_format_covers_numerics_rules():
+    from torchmetrics_tpu.analysis.linter import Finding, format_github
+
+    m = _Counter(jnp.float32)
+    rows = predict_horizons(m, *_batch())
+    findings = _horizon_findings(m, rows, NumericsAssumptions())
+    assert findings
+    text = format_github(findings + [Finding("TMT016", "a.py", 3, "divide")])
+    assert "title=TMT014" in text and "title=TMT016" in text
+    assert text.splitlines()[0].startswith("::error file=")
+
+
+def test_format_horizon_table_lists_rows():
+    rows = predict_horizons(_Counter(jnp.float32), *_batch())
+    text = format_horizon_table(rows, NumericsAssumptions(batch_size=4096))
+    assert "metric" in text and "horizon (samples)" in text
+    assert "_Counter" in text and "stagnation" in text
+
+
+@pytest.mark.contracts
+def test_golden_slate_is_numerics_clean():
+    """Dogfood acceptance: the shipped metrics carry no unsuppressed
+    TMT014-TMT017 findings (the two documented suppressions excepted)."""
+    from torchmetrics_tpu.analysis.numerics import run_numerics_pass
+
+    findings = apply_suppressions(run_numerics_pass())
+    assert findings == [], [f"{f.path}:{f.line} {f.rule} {f.message}" for f in findings]

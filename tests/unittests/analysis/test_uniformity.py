@@ -55,14 +55,26 @@ def _slate():
 
 # --------------------------------------------------------------- plain paths
 def test_metric_sync_plain_and_compressed_are_uniform():
-    report = verify_metric_sync(BinaryAccuracy(), *_binary_batch())
+    # MSE carries a float32 sum leaf (measure) — the compressed paths must
+    # engage the wire dtypes for it and stay uniform
+    report = verify_metric_sync(MeanSquaredError(), *_regression_batch())
     assert report.ok, report.problems
     assert report.sequences["sync"]  # plain path issues collectives
-    # compressed paths engage the wire dtypes and stay uniform
     int8_seq = " ".join(report.sequences["sync[int8]"])
     bf16_seq = " ".join(report.sequences["sync[bf16]"])
     assert "uint8" in int8_seq or "int8" in int8_seq
     assert "bfloat16" in bf16_seq
+
+
+def test_integer_counter_sync_never_quantizes():
+    # BinaryAccuracy's tp/fp/tn/fn are int32 counters (TMT014 widening):
+    # integer buckets must ride the plain psum even under a compression
+    # config — quantizing exact counts would corrupt them
+    report = verify_metric_sync(BinaryAccuracy(), *_binary_batch())
+    assert report.ok, report.problems
+    int8_seq = " ".join(report.sequences["sync[int8]"])
+    assert "uint8" not in int8_seq and "int8" not in int8_seq
+    assert "int32" in int8_seq
 
 
 def test_coalesced_and_cadence_flush_are_uniform():

@@ -186,14 +186,17 @@ def test_none_plan_identical_to_exact_planner(mesh):
     assert none == base
     assert all(b.compression is None for b in none.buckets)
     assert none.n_collectives == base.n_collectives
-    # the Acc+F1+AUROC f32 bucket sits under the default 4 KiB floor, so a
-    # default int8 config still yields the exact plan ...
+    # the stat counters are int32 now (TMT014 widening) and integer buckets
+    # never compress, so even a floor-0 int8 config keeps the exact plan ...
     assert build_sync_plan(entries, compression=CompressionConfig("int8", 0.05)) == base
-    # ... and dropping the floor genuinely compresses it
-    compressed = build_sync_plan(entries, compression=CompressionConfig("int8", min_bucket_bytes=0))
-    assert compressed != base
+    assert build_sync_plan(entries, compression=CompressionConfig("int8", min_bucket_bytes=0)) == base
+    # ... while a float sum leaf genuinely compresses once the floor drops
+    float_entry = ({"s": Reduce.SUM}, {"s": jnp.zeros((8,), jnp.float32), "_n": jnp.ones((), jnp.int32)})
+    float_base = build_sync_plan(entries + [float_entry])
+    compressed = build_sync_plan(entries + [float_entry], compression=CompressionConfig("int8", min_bucket_bytes=0))
+    assert compressed != float_base
     assert any(b.compression is not None for b in compressed.buckets)
-    assert compressed.n_collectives > base.n_collectives  # int8 = 2 per bucket
+    assert compressed.n_collectives > float_base.n_collectives  # int8 = 2 per bucket
 
 
 def _sync_jaxpr(mesh, table, state, compression):

@@ -226,18 +226,18 @@ def test_bucket_rows_aggregate_compression_fields(mesh):
     """absorb/aggregate must merge the compressed-bucket fields: numeric
     fields add, the compression mode string survives the merge, and
     sync_bytes_raw is a first-class counter."""
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
     from torchmetrics_tpu.observability import aggregate_telemetry, registry
     from torchmetrics_tpu.parallel import SyncPolicy
+    from torchmetrics_tpu.regression import MeanSquaredError
 
     assert "sync_bytes_raw" in COUNTER_NAMES
     obs.enable()
     rng = np.random.default_rng(17)
-    preds = jnp.asarray(rng.integers(0, 64, (64,)))
-    target = jnp.asarray(rng.integers(0, 64, (64,)))
+    preds = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
     policy = SyncPolicy(every_n_steps=1, compression="bf16", error_budget=0.05)
-    m1 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
-    m2 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    m1 = MeanSquaredError(num_outputs=2048)
+    m2 = MeanSquaredError(num_outputs=2048)
     sharded_update(m1, preds, target, mesh=mesh, sync_policy=policy)
     sharded_update(m2, preds, target, mesh=mesh, sync_policy=policy)
     key = next(

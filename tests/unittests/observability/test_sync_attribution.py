@@ -200,12 +200,15 @@ def test_advisor_reports_measured_bytes_and_compression(mesh):
     """Satellite: the advisor's per-cadence rows carry measured wire/raw bytes
     next to measured time, and recommend() folds per-mode compression advice
     (modelled byte cut + declared error bound) into the recommendation."""
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    # large float measure leaf (64 KiB) so the compressed plan has something
+    # to quantize and granule padding amortizes — integer counters never
+    # compress (TMT015)
+    from torchmetrics_tpu.regression import MeanSquaredError
 
-    m = MulticlassConfusionMatrix(num_classes=128, validate_args=False)
+    m = MeanSquaredError(num_outputs=16384)
     rng = np.random.default_rng(9)
-    preds = jnp.asarray(rng.integers(0, 128, (64,)))
-    target = jnp.asarray(rng.integers(0, 128, (64,)))
+    preds = jnp.asarray(rng.normal(size=(64, 16384)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(64, 16384)), jnp.float32)
     advisor = SyncAdvisor(m, mesh=mesh, candidates=(1, 4))
     prof = advisor.profile(preds, target, steps=4, rounds=1)
     for row in prof["runs"]:
@@ -236,14 +239,14 @@ def test_advisor_reports_measured_bytes_and_compression(mesh):
 def test_advisor_compression_respects_error_budget(mesh):
     """With a workable budget the strongest fitting mode is recommended; a
     budget tighter than every mode's bound keeps the advice exact."""
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.regression import MeanSquaredError
 
     rng = np.random.default_rng(10)
-    preds = jnp.asarray(rng.integers(0, 64, (64,)))
-    target = jnp.asarray(rng.integers(0, 64, (64,)))
+    preds = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
 
     def advice(budget):
-        m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+        m = MeanSquaredError(num_outputs=2048)
         advisor = SyncAdvisor(
             m, mesh=mesh, candidates=(1, 4), compression="bf16", error_budget=budget
         )
@@ -264,28 +267,28 @@ def test_compressed_sync_counts_wire_and_raw_bytes(mesh):
     """sync_bytes counts the compressed wire payload, sync_bytes_raw the exact
     plan's bytes — their ratio is the realized cut; exact syncs keep both
     counters equal (byte-identical to the pre-compression accounting)."""
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.regression import MeanSquaredError
     from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
 
     obs.enable()
     rng = np.random.default_rng(11)
-    preds = jnp.asarray(rng.integers(0, 64, (64,)))
-    target = jnp.asarray(rng.integers(0, 64, (64,)))
+    preds = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32)
 
-    m_exact = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    m_exact = MeanSquaredError(num_outputs=2048)
     sharded_update(m_exact, preds, target, mesh=mesh)
     row = m_exact.telemetry.as_dict()["counters"]
     assert row["sync_bytes"] == row["sync_bytes_raw"]
 
-    m_int8 = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    m_int8 = MeanSquaredError(num_outputs=2048)
     policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05)
     sharded_update(m_int8, preds, target, mesh=mesh, sync_policy=policy)
     row = m_int8.telemetry.as_dict()["counters"]
     assert row["sync_bytes"] < row["sync_bytes_raw"]
     assert row["sync_bytes_raw"] / row["sync_bytes"] >= 2.0
     # both counters match the plan-backed byte model exactly
-    sub = {"confmat": m_int8._state["confmat"], "_n": m_int8._state["_n"]}
-    table = {"confmat": m_int8._reductions["confmat"]}
+    sub = dict(m_int8._state)
+    table = dict(m_int8._reductions)
     assert row["sync_bytes"] == sync_wire_bytes_per_chip(
         table, sub, NUM_DEVICES, policy.compression_config
     )
@@ -309,20 +312,22 @@ def test_record_quant_error_lands_in_bucket_rows(mesh):
 
 
 def test_prometheus_exports_compression_families(mesh):
-    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.regression import MeanSquaredError
 
     obs.enable()
-    m = MulticlassConfusionMatrix(num_classes=64, validate_args=False)
+    m = MeanSquaredError(num_outputs=2048)
     rng = np.random.default_rng(13)
     policy = SyncPolicy(every_n_steps=1, compression="int8", error_budget=0.05)
     sharded_update(
         m,
-        jnp.asarray(rng.integers(0, 64, (64,))),
-        jnp.asarray(rng.integers(0, 64, (64,))),
+        jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32),
+        jnp.asarray(rng.normal(size=(64, 2048)), jnp.float32),
         mesh=mesh,
         sync_policy=policy,
     )
-    key = next(iter(m.telemetry.as_dict()["sync_buckets"]))
+    key = next(
+        k for k, b in m.telemetry.as_dict()["sync_buckets"].items() if b["compression"] == "int8"
+    )
     registry.record_quant_error(m, key, 0.004)
     text = obs.export(fmt="prometheus")
     assert "tm_tpu_sync_bytes_raw_total" in text

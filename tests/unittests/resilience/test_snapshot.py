@@ -149,7 +149,7 @@ def test_load_state_pytree_validates_before_install():
     m, m2 = _fresh_pair()
     good = m.state_pytree()
     bad = dict(good)
-    bad["confmat"] = jnp.zeros((4, 4), jnp.float32)
+    bad["confmat"] = jnp.zeros((4, 4), good["confmat"].dtype)
     with pytest.raises(StateRestoreError) as ei:
         m2.load_state_pytree(bad)
     assert ei.value.leaf == "confmat"

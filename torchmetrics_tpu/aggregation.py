@@ -173,7 +173,11 @@ class MeanMetric(BaseAggregator):
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("mean_value", jnp.zeros(()), "sum", nan_strategy, **kwargs)
-        self.add_state("weight", default=jnp.zeros(()), dist_reduce_fx="sum")
+        # weight stays float32: fractional user weights are legal, so int
+        # widening is off the table.  With unit weights the float32 sum
+        # stagnates at 2**24 (~16.7M values) — a documented limitation
+        # (README "Numerics analysis"), not a silent one.
+        self.add_state("weight", default=jnp.zeros(()), dist_reduce_fx="sum")  # tmt: ignore[TMT014] -- float weight sum: fractional weights are legal; f32 stagnates at 2**24 unit-weight values (documented)
         self.state_name = "mean_value"
 
     def _update(self, state: State, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> State:

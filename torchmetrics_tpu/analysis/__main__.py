@@ -10,8 +10,12 @@ emits ``::error file=...`` workflow annotations; ``--list-rules`` prints
 the registry with IDs and descriptions.
 
 ``--audit-all`` additionally runs the whole-program sanitizer passes
-(TMT010-TMT013: donation races, fingerprint completeness, collective
-uniformity, golden trace contracts).  These trace real jaxprs on an
+(TMT010-TMT017: donation races, fingerprint completeness, collective
+uniformity, golden trace contracts, and the tier-4 numerics pass —
+overflow horizons, unsafe downcasts, unguarded divides, range
+contracts).  ``--horizons`` prints the accumulator saturation table
+(:func:`~torchmetrics_tpu.analysis.numerics.horizon_report`) and exits.
+These trace real jaxprs on an
 8-device host-platform mesh, so the CLI pins ``JAX_PLATFORMS=cpu`` and
 ``--xla_force_host_platform_device_count=8`` *before* JAX initializes —
 unless the caller already configured a platform.  ``--update-contracts``
@@ -71,7 +75,24 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--audit-all",
         action="store_true",
-        help="also run the whole-program sanitizer passes (TMT010-TMT013)",
+        help="also run the whole-program sanitizer passes (TMT010-TMT017)",
+    )
+    parser.add_argument(
+        "--horizons",
+        action="store_true",
+        help="print the accumulator saturation-horizon table (TMT014 analysis) and exit",
+    )
+    parser.add_argument(
+        "--sample-budget",
+        type=float,
+        default=None,
+        help="sample budget for --horizons (default 1e9; findings fire below it)",
+    )
+    parser.add_argument(
+        "--batch-size",
+        type=int,
+        default=None,
+        help="batch size used to render --horizons in updates (default 4096)",
     )
     parser.add_argument(
         "--update-contracts",
@@ -108,6 +129,28 @@ def main(argv=None) -> int:
         from torchmetrics_tpu.analysis.contracts import contract_dir
 
         sys.stdout.write(f"golden contracts regenerated under {contract_dir()}\n")
+        return 0
+
+    if args.horizons:
+        _bootstrap_devices()
+        from torchmetrics_tpu.analysis.numerics import (
+            NumericsAssumptions,
+            format_horizon_table,
+            horizon_report,
+        )
+
+        kwargs = {}
+        if args.sample_budget is not None:
+            kwargs["sample_budget"] = args.sample_budget
+        if args.batch_size is not None:
+            kwargs["batch_size"] = args.batch_size
+        assumptions = NumericsAssumptions(**kwargs)
+        try:
+            rows = horizon_report(assumptions)
+        except Exception as err:
+            sys.stderr.write(f"--horizons failed in analysis/numerics.py: {type(err).__name__}: {err}\n")
+            return 2
+        sys.stdout.write(format_horizon_table(rows, assumptions) + "\n")
         return 0
 
     if args.paths:

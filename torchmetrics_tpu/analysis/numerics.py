@@ -1,0 +1,1053 @@
+"""Static numerics pass — tier 4 of the analysis subsystem (TMT014–TMT017).
+
+Tiers 1–3 prove trace *shape* (source lints, jaxpr contracts, golden trace
+snapshots); this tier proves trace *values*.  An abstract interpreter
+propagates interval/magnitude abstractions — seeded from declared sources:
+``add_state(value_range=...)``, dtype limits, and the slate's declared input
+contracts — through the update and compute jaxprs of the golden metric slate
+(:func:`~torchmetrics_tpu.analysis.contracts.golden_metrics`) and emits four
+whole-program findings:
+
+TMT014 **overflow-horizon**
+    Every sum-family accumulator gets a proven saturation horizon: int
+    leaves saturate at ``iinfo.max``; float leaves that the pass proves hold
+    *exact integer counts* (increments built from comparisons/indicators)
+    lose integer exactness at ``2**mantissa_bits`` — the float32 stagnation
+    cliff at 2**24 ≈ 16.7M samples.  A finding fires when the horizon is
+    shorter than the declared sample budget (default 1e9 samples).
+TMT015 **unsafe-downcast**
+    For slate entries with a committed ``SyncPolicy(compression=...)``, the
+    compressed bucket plan is checked statically: an exact-count (integral)
+    leaf riding a quantized float32 bucket is corrupted by sync once counts
+    exceed the mode's exact-integer limit, and a policy whose predicted
+    quantization error exceeds its own ``error_budget`` is a commit the
+    SyncAutotuner could never legally make.
+TMT016 **unguarded-divide**
+    Division-by-zero reachability at compute: a ``div`` whose denominator
+    interval contains 0 *and* is not structurally guarded (rewritten by a
+    ``select_n`` — the ``jnp.where(denom == 0, 1, denom)`` idiom — or
+    bounded away from zero by ``max``/``clip``, which interval arithmetic
+    proves directly).
+TMT017 **range-contract**
+    Leaves declared with ``add_state(value_range=(lo, hi))`` are verified
+    inductively: seeding every declared leaf *at* its declared range, no
+    reachable update may write one out of range.
+
+The abstraction is a classic interval domain plus one extra bit,
+``integral`` — "this value is provably an exact integer" — which is what
+lets the pass distinguish a *count* (comparisons yield ``[0, 1]`` integral;
+sums of indicators stay integral) from a generic float sum, without any
+runtime execution.  Loops (``scan``/``while``) and unknown primitives
+degrade soundly to the dtype's TOP.
+
+Horizon math: increments are measured per traced update (state seeded at
+its defaults, inputs at the slate contract), normalized by the traced batch
+size to a per-*sample* rate, so the horizon in samples is batch-invariant;
+``--horizons`` renders the table, :func:`horizon_report` is the API.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu.analysis.linter import Finding, package_root
+
+__all__ = [
+    "Abstract",
+    "HorizonRow",
+    "NumericsAssumptions",
+    "abstract_eval_jaxpr",
+    "format_horizon_table",
+    "horizon_report",
+    "predict_horizons",
+    "run_numerics_pass",
+]
+
+INF = math.inf
+
+#: ids this pass owns, in report order
+NUMERICS_RULE_IDS = ("TMT014", "TMT015", "TMT016", "TMT017")
+
+
+# ---------------------------------------------------------------- the domain
+@dataclass(frozen=True)
+class Abstract:
+    """Interval ``[lo, hi]`` plus the "provably an exact integer" bit."""
+
+    lo: float
+    hi: float
+    integral: bool = False
+
+    def hull(self, other: "Abstract") -> "Abstract":
+        return Abstract(
+            min(self.lo, other.lo), max(self.hi, other.hi), self.integral and other.integral
+        )
+
+    def contains_zero(self) -> bool:
+        return self.lo <= 0.0 <= self.hi
+
+    @property
+    def is_point(self) -> bool:
+        return self.lo == self.hi
+
+    def __repr__(self) -> str:  # compact in findings/tables
+        tag = "ℤ" if self.integral else ""
+        return f"[{_fmt(self.lo)}, {_fmt(self.hi)}]{tag}"
+
+
+TOP = Abstract(-INF, INF, False)
+
+
+def _fmt(x: float) -> str:
+    if x == INF:
+        return "inf"
+    if x == -INF:
+        return "-inf"
+    if float(x).is_integer() and abs(x) < 1e15:
+        return str(int(x))
+    return f"{x:.4g}"
+
+
+def _dtype_top(dtype: Any) -> Abstract:
+    """The weakest sound abstraction for a value of ``dtype``."""
+    dt = np.dtype(dtype) if not isinstance(dtype, np.dtype) else dtype
+    if dt.kind == "b":
+        return Abstract(0.0, 1.0, True)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return Abstract(float(info.min), float(info.max), True)
+    return TOP
+
+
+def _of_value(val: Any) -> Abstract:
+    """Abstraction of a concrete literal/const array."""
+    arr = np.asarray(val)
+    if arr.size == 0:
+        return Abstract(0.0, 0.0, True)
+    if arr.dtype.kind == "b":
+        return Abstract(float(arr.min()), float(arr.max()), True)
+    lo, hi = float(arr.min()), float(arr.max())
+    integral = arr.dtype.kind in "iu"
+    if not integral and np.isfinite(arr).all():
+        integral = bool(np.all(arr == np.floor(arr)))
+    return Abstract(lo, hi, integral)
+
+
+def mantissa_bits(dtype: Any) -> int:
+    """Significand precision in bits (incl. implicit bit): f32→24, bf16→8."""
+    import jax.numpy as jnp
+
+    return int(jnp.finfo(dtype).nmant) + 1
+
+
+# ------------------------------------------------------- interval arithmetic
+def _pmul(a: float, b: float) -> float:
+    # interval-arithmetic product convention: 0 * ±inf = 0
+    if a == 0.0 or b == 0.0:
+        return 0.0
+    return a * b
+
+def _mul(a: Abstract, b: Abstract) -> Abstract:
+    prods = [_pmul(a.lo, b.lo), _pmul(a.lo, b.hi), _pmul(a.hi, b.lo), _pmul(a.hi, b.hi)]
+    return Abstract(min(prods), max(prods), a.integral and b.integral)
+
+
+def _scale(a: Abstract, k: float) -> Abstract:
+    """``k`` non-negative copies summed: the reduce_sum/dot contraction bound."""
+    return Abstract(_pmul(k, a.lo), _pmul(k, a.hi), a.integral)
+
+
+def _add(a: Abstract, b: Abstract) -> Abstract:
+    return Abstract(a.lo + b.lo, a.hi + b.hi, a.integral and b.integral)
+
+
+def _sub(a: Abstract, b: Abstract) -> Abstract:
+    return Abstract(a.lo - b.hi, a.hi - b.lo, a.integral and b.integral)
+
+
+def _div(a: Abstract, b: Abstract) -> Abstract:
+    if b.contains_zero():
+        return TOP
+    quots = [a.lo / b.lo, a.lo / b.hi, a.hi / b.lo, a.hi / b.hi]
+    return Abstract(min(quots), max(quots), False)
+
+
+_BOOL = Abstract(0.0, 1.0, True)
+
+
+# ------------------------------------------------------------- the evaluator
+#: prims that forward their first operand's values unchanged (shape ops) —
+#: both for interval propagation and for the TMT016 guard-producer walk
+_PASSTHROUGH = frozenset(
+    {
+        "broadcast_in_dim",
+        "reshape",
+        "squeeze",
+        "expand_dims",
+        "transpose",
+        "rev",
+        "slice",
+        "dynamic_slice",
+        "gather",
+        "copy",
+        "stop_gradient",
+        "reduce_precision",
+        "sort",  # per-operand: sorting permutes, values unchanged
+        "optimization_barrier",
+    }
+)
+
+#: control-flow bodies the pass does not enter; outputs degrade to dtype TOP
+_OPAQUE = frozenset({"while", "scan", "cond"})
+
+
+@dataclass
+class _DivSite:
+    """One ``div`` whose denominator interval contains zero."""
+
+    denom: Abstract
+    guarded: bool
+    site: Optional[Tuple[str, int]]  # package-relative (path, line) if known
+
+
+class _Evaluator:
+    """Abstract interpreter over one closed jaxpr (recursing into calls)."""
+
+    def __init__(self) -> None:
+        self.env: Dict[int, Abstract] = {}
+        self.producer: Dict[int, Any] = {}  # id(var) -> producing eqn
+        self.alias: Dict[int, Any] = {}  # id(sub-jaxpr invar) -> outer var
+        self._keep: List[Any] = []  # keep vars alive so id() stays unique
+        self.div_sites: List[_DivSite] = []
+
+    # -- env -----------------------------------------------------------------
+    def read(self, var: Any) -> Abstract:
+        from jax.core import Literal
+
+        if isinstance(var, Literal):
+            return _of_value(var.val)
+        return self.env.get(id(var), _dtype_top(var.aval.dtype))
+
+    def write(self, var: Any, val: Abstract) -> None:
+        self._keep.append(var)
+        self.env[id(var)] = val
+
+    # -- guard detection -----------------------------------------------------
+    def _is_guarded(self, var: Any) -> bool:
+        """Structurally guarded: value flows (through shape ops) out of a
+        ``select_n`` — the lowered form of ``jnp.where(denom == 0, 1, d)``."""
+        from jax.core import Literal
+
+        seen = 0
+        while seen < 64:  # chains are short; bound the walk regardless
+            seen += 1
+            if isinstance(var, Literal):
+                return False
+            eqn = self.producer.get(id(var))
+            if eqn is None:
+                outer = self.alias.get(id(var))
+                if outer is None:
+                    return False
+                var = outer
+                continue
+            name = eqn.primitive.name
+            if name == "select_n":
+                return True
+            if name in _PASSTHROUGH or name == "convert_element_type":
+                var = eqn.invars[0]
+                continue
+            if name == "pjit":
+                # the value is the j-th output of a sub-jaxpr: follow it inside
+                j = list(eqn.outvars).index(var)
+                sub = eqn.params["jaxpr"].jaxpr
+                var = sub.outvars[j]
+                continue
+            return False
+        return False
+
+    # -- primitive rules -----------------------------------------------------
+    def eval_jaxpr(self, closed: Any, in_abstracts: Sequence[Abstract]) -> List[Abstract]:
+        jaxpr = getattr(closed, "jaxpr", closed)
+        consts = getattr(closed, "consts", [])
+        for var, val in zip(jaxpr.constvars, consts):
+            try:
+                self.write(var, _of_value(val))
+            except Exception:
+                self.write(var, _dtype_top(var.aval.dtype))
+        for var, ab in zip(jaxpr.invars, in_abstracts):
+            self.write(var, ab)
+        for eqn in jaxpr.eqns:
+            outs = self._eval_eqn(eqn)
+            for var, ab in zip(eqn.outvars, outs):
+                self.producer[id(var)] = eqn
+                self.write(var, ab)
+        return [self.read(v) for v in jaxpr.outvars]
+
+    def _recurse(self, eqn: Any, closed: Any, operands: Sequence[Any]) -> List[Abstract]:
+        jaxpr = getattr(closed, "jaxpr", closed)
+        for sub_var, outer in zip(jaxpr.invars, operands):
+            from jax.core import Literal
+
+            if not isinstance(outer, Literal):
+                self._keep.append(sub_var)
+                self.alias[id(sub_var)] = outer
+        return self.eval_jaxpr(closed, [self.read(v) for v in operands])
+
+    def _eval_eqn(self, eqn: Any) -> List[Abstract]:
+        name = eqn.primitive.name
+        ins = [self.read(v) for v in eqn.invars]
+        n_out = len(eqn.outvars)
+        tops = [_dtype_top(v.aval.dtype) for v in eqn.outvars]
+
+        # -- calls -----------------------------------------------------------
+        if name == "pjit":
+            return self._recurse(eqn, eqn.params["jaxpr"], eqn.invars)
+        if name in ("closed_call", "core_call", "remat", "checkpoint", "remat2", "custom_vjp_call_jaxpr"):
+            sub = eqn.params.get("call_jaxpr") or eqn.params.get("jaxpr") or eqn.params.get("fun_jaxpr")
+            if sub is not None:
+                return self._recurse(eqn, sub, eqn.invars)
+            return tops
+        if name in ("custom_jvp_call", "custom_vjp_call"):
+            sub = eqn.params.get("call_jaxpr")
+            if sub is not None:
+                n_consts = len(getattr(sub, "jaxpr", sub).invars) - len(eqn.invars)
+                ops = list(eqn.invars)
+                if n_consts:  # defensive; call_jaxpr arity normally matches
+                    return tops
+                return self._recurse(eqn, sub, ops)
+            return tops
+        if name in _OPAQUE:
+            return tops
+
+        # -- arithmetic ------------------------------------------------------
+        a = ins[0] if ins else TOP
+        b = ins[1] if len(ins) > 1 else TOP
+        if name == "add":
+            return [_add(a, b)]
+        if name == "sub":
+            return [_sub(a, b)]
+        if name == "mul":
+            out = _mul(a, b)
+            if eqn.invars[0] is eqn.invars[1]:  # x*x: provably nonnegative
+                out = Abstract(max(out.lo, 0.0), out.hi, out.integral)
+            return [out]
+        if name == "div":
+            if b.contains_zero():
+                self.div_sites.append(
+                    _DivSite(b, self._is_guarded(eqn.invars[1]), _eqn_site(eqn))
+                )
+            return [_div(a, b)]
+        if name == "neg":
+            return [Abstract(-a.hi, -a.lo, a.integral)]
+        if name == "abs":
+            lo = 0.0 if a.contains_zero() else min(abs(a.lo), abs(a.hi))
+            return [Abstract(lo, max(abs(a.lo), abs(a.hi)), a.integral)]
+        if name == "sign":
+            return [Abstract(-1.0, 1.0, True)]
+        if name == "max":
+            return [Abstract(max(a.lo, b.lo), max(a.hi, b.hi), a.integral and b.integral)]
+        if name == "min":
+            return [Abstract(min(a.lo, b.lo), min(a.hi, b.hi), a.integral and b.integral)]
+        if name == "clamp":  # clamp(lo, x, hi)
+            lo_b, x, hi_b = ins[0], ins[1], ins[2]
+            lo = min(max(x.lo, lo_b.lo), hi_b.hi)
+            hi = min(max(x.hi, lo_b.lo), hi_b.hi)
+            return [Abstract(lo, hi, x.integral and lo_b.integral and hi_b.integral)]
+        if name == "square":
+            hi = max(_ipow(abs(a.lo), 2), _ipow(abs(a.hi), 2))
+            lo = 0.0 if a.contains_zero() else min(_ipow(abs(a.lo), 2), _ipow(abs(a.hi), 2))
+            return [Abstract(lo, hi, a.integral)]
+        if name == "integer_pow":
+            y = int(eqn.params["y"])
+            if y >= 0 and y % 2 == 1:
+                return [Abstract(_ipow(a.lo, y), _ipow(a.hi, y), a.integral)]
+            if y >= 0:  # even
+                hi = max(_ipow(abs(a.lo), y), _ipow(abs(a.hi), y))
+                lo = 0.0 if a.contains_zero() else min(_ipow(abs(a.lo), y), _ipow(abs(a.hi), y))
+                return [Abstract(lo, hi, a.integral)]
+            return tops
+        if name == "sqrt":
+            return [Abstract(math.sqrt(max(a.lo, 0.0)), _monot(math.sqrt, max(a.hi, 0.0)), False)]
+        if name == "exp":
+            return [Abstract(_monot(math.exp, a.lo), _monot(math.exp, a.hi), False)]
+        if name in ("log", "log1p"):
+            fn = math.log if name == "log" else math.log1p
+            hi = _monot(fn, a.hi) if a.hi > (0.0 if name == "log" else -1.0) else INF
+            return [Abstract(-INF, hi, False)]
+        if name in ("tanh", "erf"):
+            return [Abstract(-1.0, 1.0, False)]
+        if name == "logistic":
+            return [Abstract(0.0, 1.0, False)]
+        if name in ("floor", "round"):
+            return [Abstract(math.floor(a.lo) if a.lo > -INF else -INF,
+                             math.floor(a.hi) if a.hi < INF else INF, True)]
+        if name == "ceil":
+            return [Abstract(math.ceil(a.lo) if a.lo > -INF else -INF,
+                             math.ceil(a.hi) if a.hi < INF else INF, True)]
+        if name == "rem":
+            bound = max(abs(b.lo), abs(b.hi))
+            return [Abstract(-bound, bound, a.integral and b.integral)]
+        if name == "is_finite":
+            return [_BOOL]
+        if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return [_BOOL]
+        if name in ("and", "or", "xor", "not"):
+            if all(np.dtype(v.aval.dtype).kind == "b" for v in eqn.outvars):
+                return [_BOOL] * n_out
+            return tops
+        if name == "convert_element_type":
+            return [_convert(a, eqn.outvars[0].aval.dtype)]
+
+        # -- structure -------------------------------------------------------
+        if name in _PASSTHROUGH:
+            if name == "sort":
+                return [ins[i] if i < len(ins) else t for i, t in enumerate(tops)]
+            return [ins[0]] * n_out
+        if name == "select_n":
+            out = ins[1]
+            for case in ins[2:]:
+                out = out.hull(case)
+            return [out]
+        if name == "concatenate":
+            out = ins[0]
+            for other in ins[1:]:
+                out = out.hull(other)
+            return [out]
+        if name == "pad":
+            return [ins[0].hull(ins[1])]
+        if name == "dynamic_update_slice":
+            return [ins[0].hull(ins[1])]
+        if name == "iota":
+            dim = int(eqn.params["dimension"])
+            size = eqn.outvars[0].aval.shape[dim] if eqn.outvars[0].aval.shape else 1
+            return [Abstract(0.0, float(max(size - 1, 0)), True)]
+        if name in ("argmax", "argmin"):
+            axes = eqn.params.get("axes", ())
+            size = 1
+            for ax in axes:
+                size *= eqn.invars[0].aval.shape[ax]
+            return [Abstract(0.0, float(max(size - 1, 0)), True)]
+
+        # -- reductions ------------------------------------------------------
+        if name == "reduce_sum":
+            k = _reduced_count(eqn)
+            return [_scale(a, float(k))]
+        if name in ("reduce_max", "reduce_min"):
+            return [a]
+        if name in ("reduce_and", "reduce_or"):
+            return [_BOOL]
+        if name == "cumsum":
+            axis = int(eqn.params.get("axis", 0))
+            shape = eqn.invars[0].aval.shape
+            k = float(shape[axis]) if shape else 1.0
+            s = _scale(a, k)
+            return [a.hull(s)]
+        if name == "dot_general":
+            dims = eqn.params["dimension_numbers"]
+            (lhs_contract, _), _ = dims
+            k = 1
+            for ax in lhs_contract:
+                k *= eqn.invars[0].aval.shape[ax]
+            return [_scale(_mul(a, b), float(k))]
+        if name in ("scatter-add", "scatter_add"):
+            operand, _idx, updates = ins[0], ins[1], ins[2]
+            n_upd = 1
+            for d in eqn.invars[2].aval.shape:
+                n_upd *= d
+            inc = Abstract(
+                _pmul(n_upd, min(0.0, updates.lo)),
+                _pmul(n_upd, max(0.0, updates.hi)),
+                updates.integral,
+            )
+            return [_add(operand, inc)]
+        if name.startswith("scatter"):
+            return [ins[0].hull(ins[2] if len(ins) > 2 else TOP)]
+
+        return tops
+
+
+def _ipow(x: float, y: int) -> float:
+    if abs(x) == INF:
+        return INF if (x > 0 or y % 2 == 0) else -INF
+    return float(x) ** y
+
+
+def _monot(fn: Callable[[float], float], x: float) -> float:
+    if x == INF:
+        return INF
+    if x == -INF:
+        return -INF
+    try:
+        return fn(x)
+    except (OverflowError, ValueError):
+        return INF
+
+
+def _convert(a: Abstract, dtype: Any) -> Abstract:
+    dt = np.dtype(dtype)
+    if dt.kind == "b":
+        return _BOOL
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        lo = math.floor(a.lo) if a.lo > -INF else -INF
+        hi = math.ceil(a.hi) if a.hi < INF else INF
+        if lo < info.min or hi > info.max:
+            return _dtype_top(dt)  # out-of-range int conversion wraps
+        return Abstract(lo, hi, True)
+    return Abstract(a.lo, a.hi, a.integral)
+
+
+def _reduced_count(eqn: Any) -> int:
+    in_shape = eqn.invars[0].aval.shape
+    out_shape = eqn.outvars[0].aval.shape
+    n_in = 1
+    for d in in_shape:
+        n_in *= d
+    n_out = 1
+    for d in out_shape:
+        n_out *= d
+    return max(n_in // max(n_out, 1), 1)
+
+
+def _eqn_site(eqn: Any) -> Optional[Tuple[str, int]]:
+    """Package-relative (path, line) of the user frame that built ``eqn``."""
+    try:
+        from jax._src import source_info_util
+
+        root = str(package_root())
+        for frame in source_info_util.user_frames(eqn.source_info):
+            fname = getattr(frame, "file_name", "")
+            if fname.startswith(root):
+                rel = fname[len(root) :].lstrip("/")
+                return rel, int(getattr(frame, "start_line", None) or frame.line_num)
+    except Exception:
+        return None
+    return None
+
+
+def abstract_eval_jaxpr(
+    closed: Any, in_abstracts: Sequence[Abstract]
+) -> Tuple[List[Abstract], "_Evaluator"]:
+    """Evaluate a closed jaxpr over :class:`Abstract` inputs.
+
+    Returns the output abstractions and the evaluator (which carries the
+    recorded division sites for TMT016).
+    """
+    ev = _Evaluator()
+    outs = ev.eval_jaxpr(closed, list(in_abstracts))
+    return outs, ev
+
+
+# ---------------------------------------------------------- metric interface
+@dataclass(frozen=True)
+class NumericsAssumptions:
+    """Declared workload bounds the horizon findings are judged against."""
+
+    #: production batch size used to render horizons in updates
+    batch_size: int = 4096
+    #: a finding fires when an accumulator's horizon is below this
+    sample_budget: float = 1e9
+
+
+@dataclass(frozen=True)
+class HorizonRow:
+    """One accumulator's saturation analysis (the ``--horizons`` table row)."""
+
+    metric: str
+    leaf: str
+    dtype: str
+    reduce: str
+    #: 'saturation' (int overflow), 'stagnation' (float count loses 1-ULP
+    #: exactness), 'data-dependent' (unbounded/non-integral float sum), or
+    #: 'static' (leaf provably does not accumulate)
+    kind: str
+    #: per-sample increment upper bound (inf for data-dependent)
+    rate_per_sample: float
+    #: samples until saturation/stagnation (inf when not applicable)
+    horizon_samples: float
+    note: str = ""
+
+    def horizon_updates(self, batch_size: int) -> float:
+        if not math.isfinite(self.horizon_samples):
+            return INF
+        return self.horizon_samples / max(batch_size, 1)
+
+
+def _named_leaves(tree: Any) -> List[Tuple[str, Any]]:
+    """Flatten a pytree into (dotted-name, leaf) pairs in flatten order."""
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for p in path:
+            key = getattr(p, "key", None)
+            if key is None:
+                key = getattr(p, "idx", None)
+            parts.append(str(key))
+        out.append((".".join(parts) if parts else "<root>", leaf))
+    return out
+
+
+def _slate_input_abstracts(metric: Any, inputs: Sequence[Any]) -> List[Abstract]:
+    """The slate's declared input contract, per flattened input leaf.
+
+    Float inputs are unconstrained (logits are legal everywhere — the
+    ``normalize_logits_if_needed`` idiom handles them); integer inputs are
+    class labels, declared ``[0, num_classes - 1]`` (binary: ``[0, 1]``).
+    Bool inputs are ``[0, 1]``.
+    """
+    out: List[Abstract] = []
+    n_classes = int(getattr(metric, "num_classes", 2) or 2)
+    for _name, leaf in _named_leaves(tuple(inputs)):
+        dt = np.dtype(leaf.dtype)
+        if dt.kind == "b":
+            out.append(_BOOL)
+        elif dt.kind in "iu":
+            out.append(Abstract(0.0, float(max(n_classes - 1, 1)), True))
+        else:
+            out.append(TOP)
+    return out
+
+
+def _leaf_seed(leaf: Any) -> Abstract:
+    """A state leaf at its default value (point interval over the array)."""
+    return _of_value(np.asarray(leaf))
+
+
+def _traced_batch(inputs: Sequence[Any]) -> int:
+    for leaf in inputs:
+        shape = getattr(leaf, "shape", ())
+        if shape:
+            return int(shape[0])
+    return 1
+
+
+@dataclass
+class _UpdateAnalysis:
+    """Per-leaf increment facts from one abstract update evaluation."""
+
+    metric: Any
+    inputs: Tuple[Any, ...]
+    batch: int
+    #: leaf name -> (seed, out, increment)
+    leaves: Dict[str, Tuple[Abstract, Abstract, Abstract]] = field(default_factory=dict)
+    evaluator: Optional[_Evaluator] = None
+
+
+def _trace_update(metric: Any, inputs: Sequence[Any], *, seed_at_range: bool = False) -> _UpdateAnalysis:
+    """Abstractly evaluate one update: state at defaults (or, for the
+    TMT017 inductive step, declared leaves at their declared range)."""
+    import jax
+
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    state0 = metric.init_state()
+    fn = audit_step_fn(metric, "update")
+    closed = jax.make_jaxpr(fn)(state0, *inputs)
+
+    ranges = dict(getattr(metric, "_value_ranges", {}) or {})
+    state_leaves = _named_leaves(state0)
+    seeds: List[Abstract] = []
+    for lname, leaf in state_leaves:
+        base = lname.split(".", 1)[0].strip("'\"")
+        if seed_at_range and base in ranges:
+            lo, hi = ranges[base]
+            seeds.append(Abstract(lo, hi, np.dtype(leaf.dtype).kind in "iu"))
+        else:
+            seeds.append(_leaf_seed(leaf))
+    in_abstracts = seeds + _slate_input_abstracts(metric, inputs)
+
+    n_invars = len(closed.jaxpr.invars)
+    if len(in_abstracts) != n_invars:  # pragma: no cover - structural guard
+        in_abstracts = (in_abstracts + [TOP] * n_invars)[:n_invars]
+
+    outs, ev = abstract_eval_jaxpr(closed, in_abstracts)
+
+    out_shape = jax.eval_shape(fn, state0, *inputs)
+    out_leaves = _named_leaves(out_shape)
+    analysis = _UpdateAnalysis(metric, tuple(inputs), _traced_batch(inputs), evaluator=ev)
+    seed_by_name = {n: s for (n, _), s in zip(state_leaves, seeds)}
+    for (lname, _leaf), out_ab in zip(out_leaves, outs):
+        seed = seed_by_name.get(lname, TOP)
+        analysis.leaves[lname] = (seed, out_ab, _sub(out_ab, seed))
+    return analysis
+
+
+def _sum_family_reduce(metric: Any, leaf: str) -> Optional[str]:
+    """'sum'/'mean'/'sketch-sum' when the leaf accumulates additively across
+    updates and merges additively across replicas, else None."""
+    from torchmetrics_tpu.core.reductions import accumulator_kind
+
+    base = leaf.split(".", 1)[0].strip("'\"")
+    if base in ("_n", "_nonfinite"):
+        return "sum"
+    return accumulator_kind(metric._reductions.get(base))
+
+
+def predict_horizons(
+    metric: Any,
+    *inputs: Any,
+    assumptions: Optional[NumericsAssumptions] = None,
+    analysis: Optional[_UpdateAnalysis] = None,
+) -> List[HorizonRow]:
+    """Saturation horizons for every sum-family accumulator of ``metric``.
+
+    The per-sample rate is the abstract per-update increment bound divided
+    by the traced batch size, so the horizon in *samples* does not depend on
+    the batch the metric was traced with.
+    """
+    assumptions = assumptions or NumericsAssumptions()
+    analysis = analysis or _trace_update(metric, inputs)
+    rows: List[HorizonRow] = []
+    mname = type(metric).__name__
+    state0 = metric.init_state()
+    dtypes = {n: str(l.dtype) for n, l in _named_leaves(state0)}
+    for leaf, (seed, _out, inc) in sorted(analysis.leaves.items()):
+        reduce = _sum_family_reduce(metric, leaf)
+        if reduce is None:
+            continue
+        dtype = dtypes.get(leaf, "?")
+        rate = inc.hi / max(analysis.batch, 1)
+        if inc.hi <= 0.0:
+            rows.append(HorizonRow(mname, leaf, dtype, reduce, "static", 0.0, INF,
+                                   "no positive increment reachable"))
+            continue
+        dt = np.dtype(dtype) if dtype != "?" else np.dtype("float32")
+        if dt.kind in "iu":
+            capacity = float(np.iinfo(dt).max) - seed.hi
+            horizon = capacity / rate if math.isfinite(rate) else 0.0
+            rows.append(
+                HorizonRow(mname, leaf, dtype, reduce, "saturation", rate, horizon,
+                           f"wraps at iinfo({dtype}).max = {_fmt(float(np.iinfo(dt).max))}")
+            )
+        elif inc.integral and math.isfinite(inc.hi):
+            quantum = float(2 ** mantissa_bits(dt))
+            horizon = (quantum - seed.hi) / rate
+            rows.append(
+                HorizonRow(mname, leaf, dtype, reduce, "stagnation", rate, horizon,
+                           f"exact integer count until 2**{mantissa_bits(dt)} = {_fmt(quantum)}")
+            )
+        else:
+            note = (
+                "unbounded per-update increment" if not math.isfinite(inc.hi)
+                else f"non-integral float sum (per-update increment <= {_fmt(inc.hi)})"
+            )
+            rows.append(HorizonRow(mname, leaf, dtype, reduce, "data-dependent", rate, INF, note))
+    return rows
+
+
+# ------------------------------------------------------------ finding makers
+def _anchor(metric: Any, leaf: str) -> Tuple[str, int]:
+    """(package-relative path, line) of the ``add_state`` call registering
+    ``leaf`` — searched across the MRO so findings land where suppressions
+    can be written; falls back to the defining class line."""
+    import inspect
+    import re
+
+    base = leaf.split(".", 1)[0].strip("'\"")
+    root = str(package_root())
+    pat = re.compile(r"""add_state\(\s*f?["']{0}["']""".format(re.escape(base)))
+    fallback: Optional[Tuple[str, int]] = None
+    for cls in type(metric).__mro__:
+        try:
+            path = inspect.getsourcefile(cls)
+            lines, start = inspect.getsourcelines(cls)
+        except (OSError, TypeError):
+            continue
+        if not path or not str(path).startswith(root):
+            continue
+        rel = str(path)[len(root) :].lstrip("/")
+        if fallback is None:
+            fallback = (rel, start)
+        for i, line in enumerate(lines):
+            if pat.search(line):
+                return rel, start + i
+    return fallback or ("core/metric.py", 1)
+
+
+def _horizon_findings(
+    metric: Any, rows: Sequence[HorizonRow], assumptions: NumericsAssumptions
+) -> List[Finding]:
+    out: List[Finding] = []
+    for row in rows:
+        if row.kind not in ("saturation", "stagnation"):
+            continue
+        if row.horizon_samples >= assumptions.sample_budget:
+            continue
+        path, line = _anchor(metric, row.leaf)
+        verb = "saturates" if row.kind == "saturation" else "loses integer exactness"
+        out.append(
+            Finding(
+                "TMT014",
+                path,
+                line,
+                f"{row.metric}.{row.leaf} ({row.dtype}, {row.reduce}-reduced) {verb} after "
+                f"~{_fmt(row.horizon_samples)} samples "
+                f"(~{_fmt(row.horizon_updates(assumptions.batch_size))} updates at batch "
+                f"{assumptions.batch_size}; {row.note}) — below the declared "
+                f"{_fmt(assumptions.sample_budget)}-sample budget; widen the accumulator "
+                "dtype or suppress with the documented horizon",
+            )
+        )
+    return out
+
+
+def _compression_findings(metric: Any, analysis: _UpdateAnalysis) -> List[Finding]:
+    """TMT015 over a committed sync policy's compressed bucket plan."""
+    from torchmetrics_tpu.parallel.compress import (
+        predicted_error_bound,
+        predicted_exact_int_limit,
+    )
+    from torchmetrics_tpu.parallel.coalesce import plan_for_metric
+
+    policy = metric.__dict__.get("_autotuned_policy")
+    if policy is None or policy.compression in (None, "none"):
+        return []
+    out: List[Finding] = []
+    mname = type(metric).__name__
+    stages = 2 if policy.compression == "int8" else 1
+    bound = predicted_error_bound(policy.compression, stages=stages)
+    budget = policy.error_budget
+    if budget is not None and bound > budget:
+        path, line = _anchor(metric, next(iter(metric._reductions), ""))
+        out.append(
+            Finding(
+                "TMT015",
+                path,
+                line,
+                f"{mname}: committed SyncPolicy(compression={policy.compression!r}, "
+                f"error_budget={budget:g}) is statically infeasible — predicted "
+                f"{stages}-stage quantization error {bound:g} exceeds the budget, so the "
+                "SyncAutotuner could never legally commit this policy (dead knob)",
+            )
+        )
+    state = metric.update_state(metric.init_state(), *analysis.inputs)
+    plan = plan_for_metric(metric, state, compression=policy.compression_config)
+    exact_limit = predicted_exact_int_limit(policy.compression)
+    for bucket in plan.buckets:
+        if bucket.compression is None:
+            continue
+        for slot in bucket.slots:
+            facts = analysis.leaves.get(slot.name)
+            if facts is None:
+                continue
+            _seed, _out_ab, inc = facts
+            if not (inc.integral and inc.hi > 0):
+                continue
+            path, line = _anchor(metric, slot.name)
+            out.append(
+                Finding(
+                    "TMT015",
+                    path,
+                    line,
+                    f"{mname}.{slot.name} is a proven exact counter (integral increments) "
+                    f"but rides a quantized {bucket.dtype}/{bucket.op} bucket "
+                    f"(mode {bucket.compression.mode!r}, exact-integer limit "
+                    f"{_fmt(float(exact_limit))}) — counts beyond the limit are corrupted "
+                    "by every compressed sync; register it as an integer dtype (integer "
+                    "buckets never compress) or keep it out of the compressed plan",
+                )
+            )
+    return out
+
+
+def _compute_seed(
+    metric: Any, leaf_name: str, leaf: Any, analysis: _UpdateAnalysis
+) -> Abstract:
+    """State abstraction at compute time: each leaf after >= 1 update.
+
+    Sum-family leaves sit at ``[default + inc.lo, inf)`` (documented
+    compute-after-one-update assumption — the reserved ``_n`` is then
+    ``>= 1``, and element counters are at least one batch's worth), MAX/MIN
+    leaves at the hull of default and one update, everything else at TOP.
+    """
+    from torchmetrics_tpu.core.reductions import Reduce
+
+    base = leaf_name.split(".", 1)[0].strip("'\"")
+    facts = analysis.leaves.get(leaf_name)
+    seed = _leaf_seed(leaf)
+    kind = _sum_family_reduce(metric, leaf_name)
+    ranges = dict(getattr(metric, "_value_ranges", {}) or {})
+    if base == "_n":
+        return Abstract(1.0, INF, True)
+    if kind is not None and facts is not None:
+        _s, out_ab, inc = facts
+        lo = seed.lo + max(inc.lo, 0.0)
+        ab = Abstract(lo, INF if inc.hi > 0 else seed.hi, inc.integral and seed.integral)
+    elif metric._reductions.get(base) in (Reduce.MAX, Reduce.MIN) and facts is not None:
+        ab = seed.hull(facts[1])
+    else:
+        dt = getattr(leaf, "dtype", None)
+        ab = _dtype_top(dt) if dt is not None else TOP
+    if base in ranges:
+        lo, hi = ranges[base]
+        ab = Abstract(max(ab.lo, lo), min(ab.hi, hi), ab.integral)
+    return ab
+
+
+def _divide_findings(metric: Any, analysis: _UpdateAnalysis) -> List[Finding]:
+    """TMT016: unguarded zero-containing denominators in the compute graph."""
+    import jax
+
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    state = metric.update_state(metric.init_state(), *analysis.inputs)
+    fn = audit_step_fn(metric, "compute")
+    try:
+        closed = jax.make_jaxpr(fn)(state)
+    except Exception:
+        return []  # host-side computes are audited by tier 2 as skips
+    seeds = [
+        _compute_seed(metric, lname, leaf, analysis) for lname, leaf in _named_leaves(state)
+    ]
+    _outs, ev = abstract_eval_jaxpr(closed, seeds)
+    out: List[Finding] = []
+    mname = type(metric).__name__
+    for site in ev.div_sites:
+        if site.guarded:
+            continue
+        if site.site is not None:
+            path, line = site.site
+        else:
+            path, line = _anchor(metric, "")
+        out.append(
+            Finding(
+                "TMT016",
+                path,
+                line,
+                f"{mname}.compute: divide whose denominator interval {site.denom} contains "
+                "0 with no structural guard — an empty or degenerate state reaches this "
+                "divide; rewrite via _safe_divide / jnp.where(denom == 0, ...) or bound "
+                "the denominator with jnp.maximum",
+            )
+        )
+    return out
+
+
+def _range_contract_findings(metric: Any, inputs: Sequence[Any]) -> List[Finding]:
+    """TMT017: inductive step — declared leaves seeded AT their declared
+    range must come out of any reachable update still inside it."""
+    ranges = dict(getattr(metric, "_value_ranges", {}) or {})
+    if not ranges:
+        return []
+    analysis = _trace_update(metric, inputs, seed_at_range=True)
+    out: List[Finding] = []
+    mname = type(metric).__name__
+    for leaf, (seed, out_ab, _inc) in sorted(analysis.leaves.items()):
+        base = leaf.split(".", 1)[0].strip("'\"")
+        if base not in ranges:
+            continue
+        lo, hi = ranges[base]
+        if out_ab.lo < lo or out_ab.hi > hi:
+            path, line = _anchor(metric, leaf)
+            out.append(
+                Finding(
+                    "TMT017",
+                    path,
+                    line,
+                    f"{mname}.{leaf} declares value_range=({_fmt(lo)}, {_fmt(hi)}) but a "
+                    f"reachable update writes {out_ab} — the declared range is not "
+                    "inductive; widen the declaration or guard the update",
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------- public pass
+def _numerics_slate() -> List[Tuple[str, Any, Tuple[Any, ...]]]:
+    from torchmetrics_tpu.analysis.contracts import golden_metrics
+
+    out = []
+    for name, factory in sorted(golden_metrics().items()):
+        metric, inputs = factory()
+        out.append((name, metric, tuple(inputs)))
+    return out
+
+
+def horizon_report(
+    assumptions: Optional[NumericsAssumptions] = None,
+) -> List[HorizonRow]:
+    """Saturation horizons for every sum-family accumulator in the golden
+    slate — the product surface behind ``--horizons``.  Deduplicated by
+    (metric class, leaf): slate variants of one class share the analysis."""
+    assumptions = assumptions or NumericsAssumptions()
+    rows: List[HorizonRow] = []
+    seen = set()
+    for _name, metric, inputs in _numerics_slate():
+        key0 = type(metric).__name__
+        analysis = _trace_update(metric, inputs)
+        for row in predict_horizons(metric, *inputs, assumptions=assumptions, analysis=analysis):
+            key = (key0, row.leaf)
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append(row)
+    return rows
+
+
+def format_horizon_table(
+    rows: Sequence[HorizonRow], assumptions: Optional[NumericsAssumptions] = None
+) -> str:
+    assumptions = assumptions or NumericsAssumptions()
+    headers = ("metric", "leaf", "dtype", "kind", "rate/sample",
+               "horizon (samples)", f"updates@{assumptions.batch_size}")
+    table: List[Tuple[str, ...]] = [headers]
+    for row in sorted(rows, key=lambda r: (r.horizon_samples, r.metric, r.leaf)):
+        table.append(
+            (
+                row.metric,
+                row.leaf,
+                row.dtype,
+                row.kind,
+                _fmt(row.rate_per_sample),
+                _fmt(row.horizon_samples),
+                _fmt(row.horizon_updates(assumptions.batch_size)),
+            )
+        )
+    widths = [max(len(r[i]) for r in table) for i in range(len(headers))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def run_numerics_pass(
+    select: Optional[Sequence[str]] = None,
+    assumptions: Optional[NumericsAssumptions] = None,
+) -> List[Finding]:
+    """TMT014–TMT017 over the golden slate.  ``select`` restricts to a
+    subset of the four ids; suppressions are applied by the caller
+    (:func:`~torchmetrics_tpu.analysis.sanitizer.audit_all`)."""
+    assumptions = assumptions or NumericsAssumptions()
+    wanted = set(select) if select is not None else set(NUMERICS_RULE_IDS)
+    findings: List[Finding] = []
+    seen = set()
+    analyzed_classes = set()
+    for name, metric, inputs in _numerics_slate():
+        analysis = _trace_update(metric, inputs)
+        cls = type(metric).__name__
+        if "TMT014" in wanted and cls not in analyzed_classes:
+            rows = predict_horizons(metric, *inputs, assumptions=assumptions, analysis=analysis)
+            findings.extend(_horizon_findings(metric, rows, assumptions))
+        if "TMT015" in wanted:
+            findings.extend(_compression_findings(metric, analysis))
+        if "TMT016" in wanted and cls not in analyzed_classes:
+            findings.extend(_divide_findings(metric, analysis))
+        if "TMT017" in wanted and cls not in analyzed_classes:
+            findings.extend(_range_contract_findings(metric, inputs))
+        analyzed_classes.add(cls)
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique

@@ -1,4 +1,4 @@
-"""Registered trace-safety rules (TMT001…TMT013).
+"""Registered trace-safety rules (TMT001…TMT017).
 
 Each rule encodes one way a metric implementation can silently break the
 trace contract this library's performance story depends on:
@@ -45,13 +45,28 @@ TMT013 trace-contract                 compiled-entrypoint jaxprs drifting
                                       from their committed golden contracts
                                       (primitive multiset, collective
                                       sequence, donation mask)
+TMT014 overflow-horizon               accumulators whose proven saturation
+                                      horizon (int wrap / float32 integer-
+                                      exactness cliff at 2**24) is shorter
+                                      than the declared sample budget
+TMT015 unsafe-downcast                exact-count leaves riding quantized
+                                      sync buckets, and committed sync
+                                      policies whose predicted quantization
+                                      error exceeds their own error_budget
+TMT016 unguarded-divide               compute-graph divides reachable with a
+                                      zero denominator (empty/degenerate
+                                      state) and no structural guard
+TMT017 range-contract                 updates that can write a declared
+                                      add_state(value_range=...) leaf out of
+                                      its declared range
 ====== ============================== =======================================
 
-TMT010–TMT013 are *whole-program* rules: their findings come from the
+TMT010–TMT017 are *whole-program* rules: their findings come from the
 sanitizer passes (:mod:`analysis.donation`, :mod:`analysis.fingerprint`,
-:mod:`analysis.uniformity`, :mod:`analysis.contracts`) run over live metric
-objects and traced jaxprs via ``--audit-all``, not from the per-file AST
-walk.  They are registered here so suppressions can name them, ``--select``
+:mod:`analysis.uniformity`, :mod:`analysis.contracts`, and the tier-4
+abstract-interpretation numerics pass :mod:`analysis.numerics` for
+TMT014–TMT017) run over live metric objects and traced jaxprs via
+``--audit-all``, not from the per-file AST walk.  They are registered here so suppressions can name them, ``--select``
 can filter them, and ``--list-rules`` documents them.
 
 TMT001/TMT002 are the two lints previously hard-coded in
@@ -78,10 +93,14 @@ __all__ = [
     "Float64LiteralRule",
     "HostSyncInTraceRule",
     "MaterializeInUpdateRule",
+    "OverflowHorizonRule",
+    "RangeContractRule",
     "StateMutationRule",
     "SuppressionHygieneRule",
     "TraceContractRule",
     "TracedBranchRule",
+    "UnguardedDivideRule",
+    "UnsafeDowncastRule",
     "WallClockRngRule",
 ]
 
@@ -672,4 +691,70 @@ class TraceContractRule(Rule):
         "mask per (metric, entrypoint, mesh)).  An unintended trace change fails with a "
         "primitive-level diff; intended changes are re-blessed via --update-contracts.  "
         "Driven by analysis/contracts.py."
+    )
+
+
+# --------------------------------------------------------------------- TMT014
+@register
+class OverflowHorizonRule(Rule):
+    id = "TMT014"
+    name = "overflow-horizon"
+    whole_program = True
+    description = (
+        "Every sum-family accumulator must outlive the declared sample budget: integer "
+        "leaves wrap at iinfo.max, and float leaves proven to hold exact integer counts "
+        "(increments built from comparisons/indicators) silently lose 1-ULP exactness at "
+        "2**mantissa_bits — the float32 stagnation cliff at 2**24 ~ 16.7M samples.  "
+        "Driven by the abstract-interpretation numerics pass (analysis/numerics.py) over "
+        "the golden slate's update jaxprs; the full table is `--horizons` / "
+        "horizon_report()."
+    )
+
+
+# --------------------------------------------------------------------- TMT015
+@register
+class UnsafeDowncastRule(Rule):
+    id = "TMT015"
+    name = "unsafe-downcast"
+    whole_program = True
+    description = (
+        "Compressed sync plans must be statically legal: a proven exact-count (integral) "
+        "leaf riding a quantized float32 bucket is corrupted once counts exceed the "
+        "mode's exact-integer limit (bf16: 2**8, int8: none), and a committed "
+        "SyncPolicy whose predicted quantization error exceeds its own error_budget is a "
+        "commit the SyncAutotuner could never legally make.  Driven by "
+        "analysis/numerics.py over plan_for_metric with the committed policy's "
+        "compression config and parallel/compress.py's declared error model."
+    )
+
+
+# --------------------------------------------------------------------- TMT016
+@register
+class UnguardedDivideRule(Rule):
+    id = "TMT016"
+    name = "unguarded-divide"
+    whole_program = True
+    description = (
+        "No compute-graph divide may be reachable with a zero denominator: with state "
+        "seeded at its post-one-update intervals, any `div` whose denominator interval "
+        "contains 0 must be structurally guarded — rewritten through a select_n "
+        "(jnp.where(denom == 0, ...) / _safe_divide) or bounded away from zero by "
+        "max/clip, which the interval analysis proves directly.  Driven by "
+        "analysis/numerics.py over the golden slate's compute jaxprs."
+    )
+
+
+# --------------------------------------------------------------------- TMT017
+@register
+class RangeContractRule(Rule):
+    id = "TMT017"
+    name = "range-contract"
+    whole_program = True
+    description = (
+        "add_state(value_range=...) declarations must be inductive: with every declared "
+        "leaf seeded AT its declared range (and inputs at the slate contract), no "
+        "reachable update may write a declared leaf outside its range — otherwise the "
+        "range is not a contract, and everything keyed on it (cat wire bitpacking, the "
+        "numerics seeds) is unsound.  Driven by analysis/numerics.py re-evaluating the "
+        "update jaxpr from range-seeded state."
     )

@@ -27,6 +27,7 @@ __all__ = [
     "run_contract_pass",
     "run_donation_pass",
     "run_fingerprint_pass",
+    "run_numerics_pass",
     "run_uniformity_pass",
 ]
 
@@ -137,6 +138,20 @@ def run_contract_pass(
     ]
 
 
+def run_numerics_pass(select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """TMT014–TMT017: the tier-4 abstract-interpretation numerics pass
+    (overflow horizons, unsafe downcasts, unguarded divides, range
+    contracts) over the golden slate.  One invocation covers all four ids —
+    the slate is traced once, not per-rule."""
+    from torchmetrics_tpu.analysis.numerics import run_numerics_pass as _run
+
+    return _run(select=select)
+
+
+#: ids served by one :func:`run_numerics_pass` invocation
+_NUMERICS_IDS = ("TMT014", "TMT015", "TMT016", "TMT017")
+
+
 def audit_all(
     mesh: Optional[Any] = None,
     axis_name: str = "data",
@@ -154,4 +169,7 @@ def audit_all(
         if select is not None and rule_id not in select:
             continue
         findings.extend(run())
+    numerics_ids = [i for i in _NUMERICS_IDS if select is None or i in select]
+    if numerics_ids:
+        findings.extend(run_numerics_pass(select=numerics_ids))
     return apply_suppressions(findings)

@@ -56,16 +56,25 @@ class _CalibrationErrorBase(Metric):
         self.n_bins = n_bins
         # n_bins + 1: the last bin holds conf == 1.0 exactly (reference
         # bucketize semantics, functional/classification/calibration_error.py:44-50)
+        # acc_sum/count are 0/1-indicator sums → int32 in exact mode so they
+        # neither stagnate at 2**24 (TMT014) nor ride a quantized sync bucket
+        # (TMT015); sketch mode keeps the sketch spec's float leaves.
+        count_default = (
+            jnp.zeros(n_bins + 1, dtype=jnp.int32) if self._sketch is None else jnp.zeros(n_bins + 1)
+        )
+        # conf_sum carries no value_range: confidences are only [0, 1] after
+        # the data-dependent logit normalization, which static interval
+        # analysis cannot bound (a declaration would fail TMT017)
         self.add_state("conf_sum", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
-        self.add_state("acc_sum", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
-        self.add_state("count", jnp.zeros(n_bins + 1), dist_reduce_fx=spec)
+        self.add_state("acc_sum", count_default, dist_reduce_fx=spec, value_range=(0.0, float("inf")))
+        self.add_state("count", count_default, dist_reduce_fx=spec, value_range=(0.0, float("inf")))
 
     def _accumulate(self, state: State, conf: Array, acc: Array, w: Array) -> State:
         cs, as_, ct = _bin_update(conf, acc, w, self.n_bins)
         return {
             "conf_sum": state["conf_sum"] + cs,
-            "acc_sum": state["acc_sum"] + as_,
-            "count": state["count"] + ct,
+            "acc_sum": state["acc_sum"] + as_.astype(state["acc_sum"].dtype),
+            "count": state["count"] + ct.astype(state["count"].dtype),
         }
 
     def _compute(self, state: State) -> Array:

@@ -42,11 +42,12 @@ class BinaryConfusionMatrix(_ConfusionMatrixBase):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((2, 2)), dist_reduce_fx="sum")
+        # int32 cell counts: float32 cells stagnate at 2**24 entries (TMT014)
+        self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         cm = _binary_confusion_matrix_update(preds, target, self.threshold, self.ignore_index)
-        return {"confmat": state["confmat"] + cm}
+        return {"confmat": state["confmat"] + cm.astype(state["confmat"].dtype)}
 
 
 class MulticlassConfusionMatrix(_ConfusionMatrixBase):
@@ -68,11 +69,14 @@ class MulticlassConfusionMatrix(_ConfusionMatrixBase):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+        self.add_state(
+            "confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum",
+            value_range=(0.0, float("inf")),
+        )
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         cm = _multiclass_confusion_matrix_update(preds, target, self.num_classes, self.ignore_index)
-        return {"confmat": state["confmat"] + cm}
+        return {"confmat": state["confmat"] + cm.astype(state["confmat"].dtype)}
 
 
 class MultilabelConfusionMatrix(_ConfusionMatrixBase):
@@ -84,11 +88,14 @@ class MultilabelConfusionMatrix(_ConfusionMatrixBase):
         self.normalize = normalize
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("confmat", jnp.zeros((num_labels, 2, 2)), dist_reduce_fx="sum")
+        self.add_state(
+            "confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum",
+            value_range=(0.0, float("inf")),
+        )
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         cm = _multilabel_confusion_matrix_update(preds, target, self.num_labels, self.threshold, self.ignore_index)
-        return {"confmat": state["confmat"] + cm}
+        return {"confmat": state["confmat"] + cm.astype(state["confmat"].dtype)}
 
 
 class ConfusionMatrix(_ClassificationTaskWrapper):

@@ -88,7 +88,16 @@ class _CurveBase(Metric):
             self.add_state("target", [], dist_reduce_fx="cat")
             self.add_state("weight", [], dist_reduce_fx="cat")
         else:
-            self.add_state("confmat", jnp.zeros((self.thresholds.shape[0], *confmat_shape, 2, 2)), dist_reduce_fx="sum")
+            # int32 cell counts (weights are 0/1 ignore-masks, so cells are
+            # integral): float32 cells stagnate at 2**24 entries (TMT014).
+            # No value_range: fn/tn cells are built by complement subtraction
+            # (total - pospred - fn), which interval analysis cannot prove
+            # nonnegative, so a (0, inf) declaration would fail TMT017.
+            self.add_state(
+                "confmat",
+                jnp.zeros((self.thresholds.shape[0], *confmat_shape, 2, 2), dtype=jnp.int32),
+                dist_reduce_fx="sum",
+            )
 
     @property
     def _binned_update_thresholds(self):
@@ -118,7 +127,7 @@ class _CurveBase(Metric):
                 "target": tuple(state["target"]) + (t,),
                 "weight": tuple(state["weight"]) + (w,),
             }
-        return {"confmat": state["confmat"] + binned}
+        return {"confmat": state["confmat"] + binned.astype(state["confmat"].dtype)}
 
     def compute_state(self, state: State):
         if self._sketch is not None:

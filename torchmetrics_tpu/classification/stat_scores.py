@@ -41,13 +41,15 @@ class _AbstractStatScores(Metric):
 
     def _create_state(self, size: int, multidim_average: str) -> None:
         if multidim_average == "samplewise":
-            default: Any = []
-            reduce = "cat"
-        else:
-            default = jnp.zeros(size, dtype=jnp.float32) if size > 1 else jnp.zeros((), dtype=jnp.float32)
-            reduce = "sum"
+            for name in ("tp", "fp", "tn", "fn"):
+                self.add_state(name, [], dist_reduce_fx="cat")
+            return
+        # int32, not float32: these are 0/1-indicator sums, and a float32
+        # counter silently stops incrementing once it crosses 2**24 (~16.7M
+        # samples).  int32 is exact to 2**31 (TMT014 horizon analysis).
+        default = jnp.zeros(size, dtype=jnp.int32) if size > 1 else jnp.zeros((), dtype=jnp.int32)
         for name in ("tp", "fp", "tn", "fn"):
-            self.add_state(name, default if isinstance(default, list) else default, dist_reduce_fx=reduce)
+            self.add_state(name, default, dist_reduce_fx="sum", value_range=(0.0, float("inf")))
 
     def _update_stats(self, state: State, tp, fp, tn, fn) -> State:
         if self.multidim_average == "samplewise":
@@ -57,11 +59,12 @@ class _AbstractStatScores(Metric):
                 "tn": tuple(state["tn"]) + (tn,),
                 "fn": tuple(state["fn"]) + (fn,),
             }
+        dtype = state["tp"].dtype
         return {
-            "tp": state["tp"] + tp,
-            "fp": state["fp"] + fp,
-            "tn": state["tn"] + tn,
-            "fn": state["fn"] + fn,
+            "tp": state["tp"] + tp.astype(dtype),
+            "fp": state["fp"] + fp.astype(dtype),
+            "tn": state["tn"] + tn.astype(dtype),
+            "fn": state["fn"] + fn.astype(dtype),
         }
 
     def _final_state(self, state: State) -> Tuple[Array, Array, Array, Array]:

@@ -1009,6 +1009,13 @@ def config_fingerprint(metric: Any) -> Hashable:
         if name.startswith("_") or name in exclude:
             continue
         items.append((name, _freeze_value(metric.__dict__[name])))
+    # declared value-range contracts are trace-influencing despite the private
+    # name: the ragged gather picks its wire dtype (uint8/uint16 bitpacking)
+    # from them, so two configs differing only in value_range must not share
+    # a compiled-step cache entry
+    ranges = metric.__dict__.get("_value_ranges") or {}
+    if ranges:
+        items.append(("__value_ranges__", tuple(sorted(ranges.items()))))
     return (type(metric).__module__, type(metric).__qualname__, tuple(items))
 
 

@@ -693,6 +693,7 @@ class Metric:
         self.__dict__.update(state)
         self.__dict__.setdefault("nan_strategy", "propagate")
         self.__dict__.setdefault("_nf_reported", 0)
+        self.__dict__.setdefault("_value_ranges", {})  # pickles from before value_range existed
         self._state = {
             k: tuple(jnp.asarray(x) for x in v) if isinstance(v, (list, tuple)) else jnp.asarray(v)
             for k, v in self._state.items()

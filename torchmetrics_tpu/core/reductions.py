@@ -89,6 +89,22 @@ def is_sketch_reduce(fx: Any) -> bool:
     return isinstance(fx, SketchReduce)
 
 
+def accumulator_kind(reduce: Any) -> Optional[str]:
+    """Classify a canonical reduce as an *additive accumulator* for the
+    numerics pass: leaves that grow monotonically across updates and merge
+    additively across replicas.  Returns ``"sum"``/``"mean"`` for the psum
+    family, ``"sketch-sum"`` for sum-bucketed sketches, ``None`` otherwise
+    (min/max, cat, passthrough, and custom merges have no overflow horizon
+    the interval analysis can bound)."""
+    if reduce is Reduce.SUM:
+        return "sum"
+    if reduce is Reduce.MEAN:
+        return "mean"
+    if isinstance(reduce, SketchReduce) and reduce.bucket_op == "sum":
+        return "sketch-sum"
+    return None
+
+
 ReduceFx = Union[Reduce, str, Callable, "SketchReduce", None]
 
 

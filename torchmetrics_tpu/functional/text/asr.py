@@ -117,7 +117,7 @@ def edit_distance(
     preds, target, substitution_cost: int = 1, reduction: Optional[str] = "mean"
 ) -> Array:
     """Char-level Levenshtein distance (reference functional/text/edit.py:79)."""
-    dists = jnp.asarray(_edit_update(preds, target, substitution_cost), dtype=jnp.float32)
+    dists = jnp.asarray(_edit_update(preds, target, substitution_cost), dtype=jnp.int32)
     if reduction == "mean":
         return dists.mean()
     if reduction == "sum":

