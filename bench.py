@@ -1553,6 +1553,47 @@ def resilience_leg():
 
     base_us, base_traces = guarded_step_us("propagate")
     guard_us, guard_traces = guarded_step_us("ignore")
+
+    # durable store: full commit-protocol save (write-ahead manifest +
+    # checksums + fsync + atomic rename) and verified restore, plus the
+    # per-step price of keeping an async checkpoint armed — with the retrace
+    # counter proving the background save never touches the compile cache
+    import tempfile
+
+    from torchmetrics_tpu.resilience import DurableSnapshotStore
+
+    with tempfile.TemporaryDirectory() as ckpt_root:
+        store = DurableSnapshotStore(os.path.join(ckpt_root, "ckpt"), keep_last_n=4)
+        store.save(m)  # warm the path once
+        dreps = 5
+        t0 = time.perf_counter()
+        for _ in range(dreps):
+            store.save(m)
+        durable_save_s = (time.perf_counter() - t0) / dreps
+        fresh = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False)
+        store.restore(fresh)
+        t0 = time.perf_counter()
+        for _ in range(dreps):
+            store.restore(fresh)
+        jax.block_until_ready(fresh._state["confmat"])
+        durable_restore_s = (time.perf_counter() - t0) / dreps
+
+        am = MulticlassConfusionMatrix(num_classes=n_cls, validate_args=False, jit=True)
+        am.update(preds, tgt)  # compile
+        traces_before = cache_stats()["traces"]
+        inner = 30
+        pending = []
+        t0 = time.perf_counter()
+        for i in range(inner):
+            am.update(preds, tgt)
+            if i % 5 == 0:
+                pending.append(store.save_async(am))
+        jax.block_until_ready(am._state["confmat"])
+        armed_us = (time.perf_counter() - t0) / inner * 1e6
+        for p in pending:
+            p.result()
+        async_extra_retraces = cache_stats()["traces"] - traces_before
+
     return {
         "metric": f"MulticlassConfusionMatrix({n_cls})",
         "state_bytes": state_bytes(m.init_state()),
@@ -1561,9 +1602,15 @@ def resilience_leg():
         "update_us_propagate": round(base_us, 1),
         "update_us_ignore": round(guard_us, 1),
         "ignore_extra_retraces": guard_traces - base_traces,  # must be 0
+        "durable_save_ckpt_s": round(durable_save_s, 6),
+        "durable_restore_ckpt_s": round(durable_restore_s, 6),
+        "update_us_armed_async": round(armed_us, 1),
+        "async_extra_retraces": async_extra_retraces,  # must be 0
         "note": "snapshot is a device->host copy plus spec build; restore is "
         "validate-then-install; the ignore guard fuses into the step and "
-        "adds no retrace",
+        "adds no retrace; durable_*_ckpt_s cover the full write-ahead commit "
+        "protocol (checksums + fsync + atomic rename) and verified restore, "
+        "and armed async checkpointing provably never retraces",
     }
 
 

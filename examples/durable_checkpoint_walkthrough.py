@@ -1,0 +1,223 @@
+"""Durable elastic checkpointing walkthrough: the full resilience story on
+an 8-device host mesh.
+
+What this shows, in order:
+
+1. **the commit protocol** — a `DurableSnapshotStore` writing generational
+   checkpoints (write-ahead manifest with per-leaf CRCs, staging dir,
+   atomic rename), sync and async (donation-safe, off the step path);
+2. **retry classification** — a transient NFS-style flake retried to a
+   durable commit under a bounded backoff policy, versus disk-full
+   surfacing immediately as permanent;
+3. **skip-back** — a torn payload write on the newest generation detected
+   by checksum on read, loudly skipped, and the previous generation
+   restored bit-exactly;
+4. **elastic restore** — a mid-window `SyncStepper` snapshot taken on 8
+   devices resumed on 4, bit-identical to an uninterrupted 4-device run;
+5. **degraded-mode evaluation** — a divergent replica quarantined out of
+   the psum via the in-graph mask, with the health alert and the
+   schema-1.6 ``quorum`` block on the telemetry report;
+6. **the kill → restore drill** — simulated process death between
+   write-ahead and commit, gc of the staging residue, and a bit-exact
+   resume from the newest valid generation.
+
+Run with:  python examples/durable_checkpoint_walkthrough.py
+"""
+
+import os
+import sys
+import tempfile
+import warnings
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+def _metric(seed: int):
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(seed)
+    m.update(jnp.asarray(rng.integers(0, 5, (64,))), jnp.asarray(rng.integers(0, 5, (64,))))
+    return m
+
+
+def _batches(seed: int, n: int, batch: int = 16):
+    rng = np.random.default_rng(seed)
+    return [
+        (jnp.asarray(rng.integers(0, 5, (batch,))), jnp.asarray(rng.integers(0, 5, (batch,))))
+        for _ in range(n)
+    ]
+
+
+def part1_commit_protocol(root: str) -> None:
+    from torchmetrics_tpu.resilience import DurableSnapshotStore
+
+    banner("1. The commit protocol: write-ahead manifest + atomic rename")
+    store = DurableSnapshotStore(root, keep_last_n=4)
+    m = _metric(0)
+    gen = store.save(m)
+    print(f"  committed generation {gen}: {sorted(os.listdir(os.path.join(root, f'gen-{gen:08d}')))}")
+
+    pending = store.save_async(m)  # host copy is eager: safe to keep stepping
+    m.update(jnp.asarray([1, 2, 3]), jnp.asarray([1, 2, 0]))  # mutate freely
+    print(f"  async save committed generation {pending.result()} off the step path")
+    print(f"  generations on disk (oldest first): {store.generations()}")
+
+
+def part2_retry_classification(root: str) -> None:
+    from torchmetrics_tpu.resilience import DurableSnapshotStore, FaultyBackend, RetryPolicy
+
+    banner("2. Retry classification: transient flakes retry, ENOSPC raises")
+    fast = RetryPolicy(base_delay_s=0.0, sleep=lambda _s: None)
+
+    flaky = FaultyBackend("transient", times=2)
+    store = DurableSnapshotStore(root, backend=flaky, retry=fast)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        gen = store.save(_metric(1))
+    print(f"  transient x2: {len(rec)} retry warning(s), then committed generation {gen}")
+
+    full = DurableSnapshotStore(root, backend=FaultyBackend("enospc"), retry=fast)
+    try:
+        full.save(_metric(2))
+    except OSError as err:
+        print(f"  ENOSPC is permanent — first attempt raised: {err.strerror} "
+              f"(injected {full.backend.injected}x, never retried)")
+
+
+def part3_skip_back(root: str) -> None:
+    from torchmetrics_tpu.resilience import DurableSnapshotStore, FaultyBackend
+
+    banner("3. Skip-back: a torn newest generation is skipped, loudly")
+    good = _metric(3)
+    DurableSnapshotStore(root).save(good)
+    torn_gen = DurableSnapshotStore(root, backend=FaultyBackend("torn_write")).save(_metric(4))
+    print(f"  generation {torn_gen} committed with a torn payload (post-commit corruption)")
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    fresh = MulticlassAccuracy(num_classes=5, average="micro")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        restored_gen = DurableSnapshotStore(root).restore(fresh)
+    print(f"  restore fell back to generation {restored_gen}: "
+          f"{[str(w.message)[:68] for w in rec if 'skipping back' in str(w.message)]}")
+    assert float(fresh.compute()) == float(good.compute())
+    print(f"  restored compute == pre-kill compute == {float(fresh.compute()):.6f} (bit-exact)")
+
+
+def part4_elastic_restore() -> None:
+    from torchmetrics_tpu.parallel import SyncPolicy, SyncStepper, metric_mesh
+    from torchmetrics_tpu.resilience import elastic_restore
+
+    banner("4. Elastic restore: snapshot on 8 devices, resume on 4")
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    def collection():
+        return MulticlassAccuracy(num_classes=5, average="micro")
+
+    policy = SyncPolicy(every_n_steps=4)
+    batches = _batches(7, 9)
+    first = SyncStepper(collection(), mesh=metric_mesh(8), policy=policy)
+    for preds, target in batches[:5]:
+        first.update(preds, target)
+    snap = first.snapshot()
+    print(f"  snapshot mid-window on 8 devices: steps={first.steps} pending={first.pending}")
+
+    resumed = SyncStepper(collection(), mesh=metric_mesh(4), policy=policy)
+    elastic_restore(resumed, snap)
+    for preds, target in batches[5:]:
+        resumed.update(preds, target)
+    got = float(resumed.compute())
+
+    ref = SyncStepper(collection(), mesh=metric_mesh(4), policy=policy)
+    for preds, target in batches:
+        ref.update(preds, target)
+    want = float(ref.compute())
+    assert got == want
+    print(f"  8-device carry re-bucketed onto 4 slots (j -> j mod 4, merged via "
+          f"merge_states)\n  resumed compute {got:.6f} == uninterrupted 4-device run {want:.6f}")
+
+
+def part5_quarantine() -> None:
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.observability.health import HealthMonitor
+    from torchmetrics_tpu.parallel import metric_mesh, sharded_update
+    from torchmetrics_tpu.resilience import attach_monitor, degradation_report, quarantine
+
+    banner("5. Degraded-mode evaluation: quarantine instead of crash")
+    mesh = metric_mesh(8)
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+    monitor = HealthMonitor()
+    series = attach_monitor(m, monitor)
+
+    quarantine(m, [3], reason="divergence: leaf 'tp' minority digest")
+    rng = np.random.default_rng(9)
+    preds = jnp.asarray(rng.integers(0, 5, (64,)))
+    target = jnp.asarray(rng.integers(0, 5, (64,)))
+    state = sharded_update(m, preds, target, mesh=mesh)
+    per = 64 // 8
+    survivors = np.concatenate([np.arange(64)[: 3 * per], np.arange(64)[4 * per :]])
+    ref = MulticlassAccuracy(num_classes=5, average="micro")
+    ref.update(jnp.asarray(np.asarray(preds)[survivors]), jnp.asarray(np.asarray(target)[survivors]))
+    got = float(m.compute_state(state))
+    assert got == float(ref.compute())
+    print(f"  replica 3 masked out in-graph; compute from the surviving quorum: "
+          f"{got:.6f} == eager update over the 7 surviving shards")
+    print(f"  health alert on {series!r}: "
+          f"{[a.message for a in monitor.alerts()]}")
+    print(f"  schema-1.6 quorum block: {degradation_report(m, n_devices=8)}")
+
+
+def part6_kill_restore_drill(root: str) -> None:
+    from torchmetrics_tpu.resilience import DurableSnapshotStore, FaultyBackend, SimulatedCrash
+
+    banner("6. The drill: kill between write-ahead and commit, then resume")
+    live = _metric(12)
+    healthy = DurableSnapshotStore(root)
+    gen = healthy.save(live)
+
+    live.update(jnp.asarray([0, 1]), jnp.asarray([0, 2]))  # progress past the checkpoint
+    try:
+        DurableSnapshotStore(root, backend=FaultyBackend("crash_before_rename")).save(live)
+    except SimulatedCrash as err:
+        print(f"  process 'died': {err}")
+    staging = [n for n in os.listdir(root) if n.startswith(".staging-")]
+    print(f"  staging residue on disk: {staging} — invisible to generations() "
+          f"{DurableSnapshotStore(root).generations()}")
+    DurableSnapshotStore(root).gc()
+    print(f"  gc swept the residue: {[n for n in os.listdir(root) if n.startswith('.staging-')]}")
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    revived = MulticlassAccuracy(num_classes=5, average="micro")
+    restored_gen = DurableSnapshotStore(root).restore(revived)
+    pre_kill = _metric(12)
+    assert float(revived.compute()) == float(pre_kill.compute())
+    print(f"  restored generation {restored_gen}; compute {float(revived.compute()):.6f} "
+          f"bit-exact to the last committed checkpoint — never a silent wrong answer")
+
+
+def main() -> None:
+    with tempfile.TemporaryDirectory() as tmp:
+        part1_commit_protocol(os.path.join(tmp, "p1"))
+        part2_retry_classification(os.path.join(tmp, "p2"))
+        part3_skip_back(os.path.join(tmp, "p3"))
+        part4_elastic_restore()
+        part5_quarantine()
+        part6_kill_restore_drill(os.path.join(tmp, "p6"))
+    print("\nAll six parts passed their assertions.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,417 @@
+"""Durable snapshot store: atomic generational commits, retrying I/O,
+skip-back restore, retention, and async saves that provably never retrace."""
+
+import json
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import BinaryAccuracy, BinaryPrecision, MulticlassConfusionMatrix
+from torchmetrics_tpu.parallel.autotune import policy_dict
+from torchmetrics_tpu.parallel.coalesce import SyncPolicy
+from torchmetrics_tpu.resilience import (
+    DurableSnapshotStore,
+    RetryPolicy,
+    StateRestoreError,
+    TransientIOError,
+)
+from torchmetrics_tpu.resilience.durable import MANIFEST_NAME, PAYLOAD_NAME
+pytestmark = pytest.mark.durability
+
+
+def _bitwise_equal(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    assert a.dtype == b.dtype and a.shape == b.shape
+    assert a.tobytes() == b.tobytes()
+
+
+def _acc_with_data():
+    m = BinaryAccuracy(validate_args=False)
+    m.update(jnp.asarray([0.9, 0.2, 0.8, 0.4]), jnp.asarray([1, 0, 0, 1]))
+    return m
+
+
+# --------------------------------------------------------------- round trips
+def test_metric_round_trip_bit_exact(tmp_path):
+    m = _acc_with_data()
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    gen = store.save(m)
+    fresh = BinaryAccuracy(validate_args=False)
+    assert store.restore(fresh) == gen
+    for name, leaf in m.state_pytree().items():
+        _bitwise_equal(leaf, fresh.state_pytree()[name])
+    _bitwise_equal(m.compute(), fresh.compute())
+
+
+def test_collection_round_trip_bit_exact(tmp_path):
+    def make():
+        return MetricCollection(
+            {
+                "acc": BinaryAccuracy(validate_args=False),
+                "cm": MulticlassConfusionMatrix(num_classes=2, validate_args=False),
+            }
+        )
+
+    col = make()
+    col.update(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(col)
+    fresh = make()
+    store.restore(fresh)
+    got, ref = fresh.compute(), col.compute()
+    assert set(got) == set(ref)
+    for key in ref:
+        _bitwise_equal(got[key], ref[key])
+
+
+def test_sketch_leaves_round_trip_bit_exact(tmp_path):
+    """Sketch-backed states (HLL registers) survive the durable path
+    bit-exactly — per-leaf crc32 covers them like any other leaf."""
+    from torchmetrics_tpu.text import DistinctNGrams
+
+    m = DistinctNGrams(ngram=1, approx="sketch", approx_error=0.05)
+    m.update(jnp.arange(512).reshape(4, 128) % 97)
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(m)
+    fresh = DistinctNGrams(ngram=1, approx="sketch", approx_error=0.05)
+    store.restore(fresh)
+    for name, leaf in m.state_pytree().items():
+        _bitwise_equal(leaf, fresh.state_pytree()[name])
+    _bitwise_equal(m.compute(), fresh.compute())
+
+
+def test_committed_autotuner_policy_round_trip(tmp_path):
+    """A committed SyncPolicy record (PR 11's autotuner output) rides the
+    same commit protocol as metric state via the raw-mapping save path."""
+    policy = SyncPolicy(every_n_steps=4, compression="bf16", error_budget=0.01)
+    record = {"kind": "aux", "name": "committed_sync_policy", "policy": policy_dict(policy)}
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    gen = store.save(record)
+    snap, got_gen = store.load()
+    assert got_gen == gen
+    assert snap == record
+    rebuilt = SyncPolicy(
+        every_n_steps=snap["policy"]["every_n"],
+        compression=snap["policy"]["compression"],
+        error_budget=snap["policy"]["error_budget"],
+    )
+    assert rebuilt == policy
+
+
+def test_mapping_save_records_mesh(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save({"kind": "aux", "x": np.arange(4)}, mesh_shape=(8,))
+    snap, _ = store.load()
+    assert snap["mesh"] == [8]
+
+
+# ----------------------------------------------------------- commit protocol
+def test_manifest_is_write_ahead_and_complete(tmp_path):
+    m = _acc_with_data()
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"), keep_last_n=None)
+    gen = store.save(m, mesh_shape=(8,))
+    gen_dir = tmp_path / "ckpt" / f"gen-{gen:08d}"
+    manifest = json.loads((gen_dir / MANIFEST_NAME).read_text())
+    payload = (gen_dir / PAYLOAD_NAME).read_bytes()
+    assert manifest["format"] == "tm-tpu-durable/1"
+    assert manifest["generation"] == gen
+    assert manifest["payload_bytes"] == len(payload)
+    assert manifest["mesh"] == [8]
+    assert manifest["schema_version"] == 1
+    # every state leaf is individually checksummed
+    state = pickle.loads(payload)["state"]
+    for leaf in state:
+        assert any(path.endswith(leaf) for path in manifest["leaves"])
+
+
+def test_no_staging_dirs_after_commit(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(_acc_with_data())
+    names = os.listdir(tmp_path / "ckpt")
+    assert names == ["gen-00000001"]
+
+
+def test_generations_monotonic_and_latest(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    assert store.latest() is None
+    m = _acc_with_data()
+    gens = [store.save(m) for _ in range(3)]
+    assert gens == [1, 2, 3]
+    assert store.generations() == [1, 2, 3]
+    assert store.latest() == 3
+
+
+# ----------------------------------------------------------------- skip-back
+def _corrupt_payload(root, gen):
+    path = os.path.join(root, f"gen-{gen:08d}", PAYLOAD_NAME)
+    with open(path, "r+b") as fh:
+        fh.truncate(max(1, os.path.getsize(path) // 2))
+
+
+def test_skip_back_past_corrupt_newest(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = BinaryAccuracy(validate_args=False)
+    m.update(jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))
+    g1 = store.save(m)
+    m.update(jnp.asarray([0.8]), jnp.asarray([0]))
+    g2 = store.save(m)
+    _corrupt_payload(str(tmp_path / "ckpt"), g2)
+    with pytest.warns(UserWarning, match="skipping back"):
+        snap, gen = store.load()
+    assert gen == g1
+    fresh = BinaryAccuracy(validate_args=False)
+    with pytest.warns(UserWarning, match="skipping back"):
+        assert store.restore(fresh) == g1
+    assert float(fresh.compute()) == 1.0  # the pre-corruption aggregate
+
+
+def test_all_generations_corrupt_raises(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    for _ in range(2):
+        store.save(m)
+    for gen in store.generations():
+        _corrupt_payload(str(tmp_path / "ckpt"), gen)
+    with pytest.warns(UserWarning, match="skipping back"):
+        with pytest.raises(StateRestoreError, match="Every committed generation"):
+            store.load()
+
+
+def test_explicit_generation_never_skips_back(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    g1 = store.save(m)
+    g2 = store.save(m)
+    _corrupt_payload(str(tmp_path / "ckpt"), g2)
+    with pytest.raises(StateRestoreError, match="torn write"):
+        store.load(generation=g2)
+    snap, gen = store.load(generation=g1)  # the older one is still explicit-loadable
+    assert gen == g1
+
+
+def test_missing_generation_is_structured(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    with pytest.raises(StateRestoreError, match="no committed generations"):
+        store.load()
+    store.save(_acc_with_data())
+    with pytest.raises(StateRestoreError, match="does not exist"):
+        store.load(generation=42)
+
+
+def test_leaf_bitflip_is_caught_by_manifest_crc(tmp_path):
+    """A single flipped byte inside one leaf (valid pickle, valid length)
+    trips the per-leaf crc recorded in the write-ahead manifest."""
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    gen = store.save(m)
+    gen_dir = tmp_path / "ckpt" / f"gen-{gen:08d}"
+    snap = pickle.loads((gen_dir / PAYLOAD_NAME).read_bytes())
+    leaf = sorted(snap["state"])[0]
+    arr = np.asarray(snap["state"][leaf]).copy()
+    arr.reshape(-1).view(np.uint8)[0] ^= 0xFF
+    snap["state"][leaf] = arr
+    evil = pickle.dumps(snap, protocol=pickle.HIGHEST_PROTOCOL)
+    (gen_dir / PAYLOAD_NAME).write_bytes(evil)
+    manifest = json.loads((gen_dir / MANIFEST_NAME).read_text())
+    manifest["payload_bytes"] = len(evil)
+    manifest["payload_crc32"] = __import__("zlib").crc32(evil)
+    (gen_dir / MANIFEST_NAME).write_text(json.dumps(manifest))
+    with pytest.raises(StateRestoreError, match="checksum mismatch"):
+        store.load(generation=gen)
+
+
+def test_restore_error_names_generation_and_mesh(tmp_path):
+    """Restore diagnostics: a failed install names schema version, producing
+    mesh, and generation id — both in the message and as attributes."""
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    gen = store.save(m, mesh_shape=(8,))
+    wrong = BinaryPrecision(validate_args=False)
+    with pytest.raises(StateRestoreError) as exc:
+        store.restore(wrong)
+    err = exc.value
+    assert err.generation == gen
+    assert err.mesh_shape == (8,)
+    assert err.schema_version == 1
+    assert f"generation={gen}" in str(err)
+    assert "mesh=(8,)" in str(err)
+
+
+# -------------------------------------------------------------------- retry
+def test_retry_policy_classification():
+    pol = RetryPolicy()
+    assert pol.is_transient(TransientIOError("flake"))
+    assert pol.is_transient(TimeoutError())
+    assert pol.is_transient(OSError(11, "EAGAIN"))
+    import errno
+
+    assert not pol.is_transient(OSError(errno.ENOSPC, "full"))
+    assert not pol.is_transient(ValueError("bad"))
+
+
+def test_retry_policy_backoff_curve_deterministic():
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.1, max_delay_s=0.5)
+    assert [pol.delay_s(a) for a in (1, 2, 3, 4)] == [0.1, 0.2, 0.4, 0.5]
+    jittered = RetryPolicy(base_delay_s=0.1, jitter=lambda d, a: d * 2)
+    assert jittered.delay_s(1) == pytest.approx(0.2)
+
+
+def test_retry_policy_retries_then_succeeds():
+    sleeps = []
+    pol = RetryPolicy(max_attempts=3, base_delay_s=0.01, sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientIOError("flake")
+        return "ok"
+
+    with pytest.warns(UserWarning, match="transient failure"):
+        assert pol.run(flaky) == "ok"
+    assert calls["n"] == 3
+    assert sleeps == [pytest.approx(0.01), pytest.approx(0.02)]
+
+
+def test_retry_policy_exhaustion_reraises():
+    pol = RetryPolicy(max_attempts=2, base_delay_s=0.0, sleep=lambda s: None)
+    with pytest.warns(UserWarning, match="transient failure"):
+        with pytest.raises(TransientIOError):
+            pol.run(lambda: (_ for _ in ()).throw(TransientIOError("always")))
+
+
+def test_retry_policy_permanent_fails_first_attempt():
+    calls = {"n": 0}
+
+    def enospc():
+        calls["n"] += 1
+        raise OSError(28, "No space left on device")
+
+    pol = RetryPolicy(max_attempts=5, base_delay_s=0.0, sleep=lambda s: None)
+    with pytest.raises(OSError):
+        pol.run(enospc)
+    assert calls["n"] == 1  # never retried
+
+
+def test_retry_policy_per_attempt_timeout():
+    import threading
+
+    pol = RetryPolicy(max_attempts=2, base_delay_s=0.0, timeout_s=0.05, sleep=lambda s: None)
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def slow_then_fast():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            release.wait(5.0)  # hung first attempt
+        return "ok"
+
+    with pytest.warns(UserWarning, match="transient failure"):
+        assert pol.run(slow_then_fast) == "ok"
+    release.set()
+    assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------- retention
+def test_gc_keeps_last_n(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"), keep_last_n=2)
+    m = _acc_with_data()
+    for _ in range(5):
+        store.save(m)
+    assert store.generations() == [4, 5]
+
+
+def test_gc_sweeps_staging_dirs(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(_acc_with_data())
+    stranded = tmp_path / "ckpt" / ".staging-gen-00000099"
+    stranded.mkdir()
+    (stranded / MANIFEST_NAME).write_text("{}")
+    store.gc()
+    assert not stranded.exists()
+    assert store.generations() == [1]  # committed data untouched
+
+
+def test_gc_explicit_keep(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    for _ in range(4):
+        store.save(m)
+    deleted = store.gc(keep_last_n=1)
+    assert deleted == [1, 2, 3]
+    assert store.generations() == [4]
+
+
+# -------------------------------------------------------------------- async
+def test_save_async_commits_and_round_trips(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    pending = store.save_async(m, mesh_shape=(8,))
+    gen = pending.result(timeout=30.0)
+    assert pending.done()
+    fresh = BinaryAccuracy(validate_args=False)
+    assert store.restore(fresh) == gen
+    _bitwise_equal(m.compute(), fresh.compute())
+
+
+def test_save_async_is_donation_safe(tmp_path):
+    """Mutating the metric immediately after save_async must not leak into
+    the committed snapshot: the host copy is taken eagerly."""
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = BinaryAccuracy(validate_args=False)
+    m.update(jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))
+    expected = float(m.compute())
+    pending = store.save_async(m)
+    m.update(jnp.asarray([0.9, 0.9, 0.9]), jnp.asarray([0, 0, 0]))  # poison after arm
+    pending.result(timeout=30.0)
+    fresh = BinaryAccuracy(validate_args=False)
+    store.restore(fresh)
+    assert float(fresh.compute()) == expected
+
+
+def test_save_async_failure_surfaces_in_result(tmp_path):
+    from torchmetrics_tpu.resilience import FaultyBackend
+
+    store = DurableSnapshotStore(
+        str(tmp_path / "ckpt"),
+        backend=FaultyBackend("enospc", times=10),
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0, sleep=lambda s: None),
+    )
+    pending = store.save_async(_acc_with_data())
+    with pytest.raises(OSError):
+        pending.result(timeout=30.0)
+
+
+def test_wait_drains_multiple_async_saves(tmp_path):
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = _acc_with_data()
+    p1 = store.save_async(m)
+    p2 = store.save_async(m)
+    store.wait(timeout=30.0)
+    assert sorted([p1.result(0), p2.result(0)]) == [1, 2]
+
+
+def test_armed_async_checkpoint_zero_retraces(tmp_path):
+    """The acceptance gate: running compiled updates with async saves armed
+    adds 0 retraces and 0 new compile-cache entries."""
+    from torchmetrics_tpu.core.compile import cache_stats
+
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    m = MulticlassConfusionMatrix(num_classes=4, validate_args=False, jit=True)
+    preds = jnp.asarray([0, 1, 2, 3, 1, 0])
+    tgt = jnp.asarray([0, 1, 2, 2, 1, 3])
+    m.update(preds, tgt)  # compile once
+    before = cache_stats()
+    pendings = []
+    for _ in range(6):
+        m.update(preds, tgt)
+        pendings.append(store.save_async(m))
+    for p in pendings:
+        p.result(timeout=30.0)
+    after = cache_stats()
+    assert after["traces"] == before["traces"]
+    assert after["misses"] == before["misses"]  # no new compile-cache entries

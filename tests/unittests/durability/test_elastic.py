@@ -1,0 +1,217 @@
+"""Elastic restore: a snapshot taken on an N-device mesh resumes on M devices
+with no sample lost and none double-counted — bit-identical to an
+uninterrupted run for integer-valued sum states."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.parallel import SyncPolicy, SyncStepper, metric_mesh
+from torchmetrics_tpu.resilience import (
+    DurableSnapshotStore,
+    StateRestoreError,
+    elastic_restore,
+    restack_carry,
+)
+
+pytestmark = pytest.mark.durability
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=5, average="micro")
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=5, average="micro"),
+            "f1": MulticlassF1Score(num_classes=5, average="macro"),
+        },
+        compute_groups=True,
+    )
+
+
+def _device_state(seed):
+    m = _metric()
+    rng = np.random.default_rng(seed)
+    m.update(jnp.asarray(rng.integers(0, 5, (8,))), jnp.asarray(rng.integers(0, 5, (8,))))
+    return {k: np.asarray(v) for k, v in m.state_pytree().items()}
+
+
+def _stack(states):
+    return {leaf: np.stack([s[leaf] for s in states]) for leaf in states[0]}
+
+
+def _batches(seed, n, batch=16):
+    rng = np.random.default_rng(seed)
+    return [
+        (jnp.asarray(rng.integers(0, 5, (batch,))), jnp.asarray(rng.integers(0, 5, (batch,))))
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------------- restack_carry
+def test_restack_shrink_folds_mod_m_exactly():
+    """8 per-device states onto 4 slots: new slot j is the merge of old
+    devices j and j+4 — sums add, so every leaf is exactly the pairwise sum."""
+    states = [_device_state(i) for i in range(8)]
+    stacked = _stack(states)
+    out = restack_carry(_metric(), stacked, 4)
+    for leaf, arr in out.items():
+        assert arr.shape[0] == 4
+        for j in range(4):
+            want = states[j][leaf] + states[j + 4][leaf]
+            np.testing.assert_array_equal(arr[j], want)
+        # total mass conserved: nothing lost, nothing double-counted
+        np.testing.assert_array_equal(arr.sum(axis=0), stacked[leaf].sum(axis=0))
+
+
+def test_restack_grow_pads_with_reduction_identity():
+    states = [_device_state(i) for i in range(4)]
+    stacked = _stack(states)
+    out = restack_carry(_metric(), stacked, 8)
+    identity = {k: np.asarray(v) for k, v in _metric().init_state().items()}
+    for leaf, arr in out.items():
+        assert arr.shape[0] == 8
+        for j in range(4):
+            np.testing.assert_array_equal(arr[j], states[j][leaf])
+        for j in range(4, 8):
+            np.testing.assert_array_equal(arr[j], identity[leaf])
+        np.testing.assert_array_equal(arr.sum(axis=0), stacked[leaf].sum(axis=0))
+
+
+def test_restack_rejects_inconsistent_leading_dims():
+    states = [_device_state(i) for i in range(4)]
+    stacked = _stack(states)
+    leaf = sorted(stacked)[0]
+    stacked[leaf] = stacked[leaf][:3]  # one leaf claims a 3-device mesh
+    with pytest.raises(StateRestoreError) as exc:
+        restack_carry(_metric(), stacked, 2)
+    assert exc.value.reason == "corrupt"
+    assert exc.value.leaf == leaf
+
+
+def test_restack_rejects_bad_new_n():
+    with pytest.raises(ValueError, match="new_n"):
+        restack_carry(_metric(), _stack([_device_state(0)]), 0)
+
+
+# ----------------------------------------------------- mesh-shape diagnostics
+def test_plain_restore_refuses_foreign_mesh(mesh):
+    """SyncStepper.restore validates-before-install: an 8-device carry aimed
+    at a 4-device stepper raises a structured mesh-shape error pointing at
+    elastic_restore, and nothing is installed."""
+    policy = SyncPolicy(every_n_steps=4)
+    big = SyncStepper(_collection(), mesh=mesh, policy=policy)
+    for preds, target in _batches(0, 2):
+        big.update(preds, target)
+    snap = big.snapshot()
+    small = SyncStepper(_collection(), mesh=metric_mesh(4), policy=policy)
+    with pytest.raises(StateRestoreError, match="elastic_restore") as exc:
+        small.restore(snap)
+    assert exc.value.reason == "mesh-shape"
+    assert exc.value.mesh_shape == (8,)
+    assert small.steps == 0 and small.pending == 0  # untouched
+
+
+# --------------------------------------------------------- end-to-end drills
+def _elastic_drill(mesh_a, mesh_b, n_total=9, cut=5, seed=7):
+    """Run ``cut`` steps on mesh_a, snapshot mid-window, elastically restore
+    onto mesh_b, finish there; return (resumed compute, uninterrupted-on-b
+    compute)."""
+    policy = SyncPolicy(every_n_steps=4)
+    batches = _batches(seed, n_total)
+    first = SyncStepper(_collection(), mesh=mesh_a, policy=policy)
+    for preds, target in batches[:cut]:
+        first.update(preds, target)
+    assert first.pending > 0  # mid-window: the carry holds deferred samples
+    snap = first.snapshot()
+
+    resumed = SyncStepper(_collection(), mesh=mesh_b, policy=policy)
+    elastic_restore(resumed, snap)
+    assert resumed.steps == cut and resumed.pending == first.pending
+    for preds, target in batches[cut:]:
+        resumed.update(preds, target)
+    got = {k: np.asarray(v) for k, v in resumed.compute().items()}
+
+    ref = SyncStepper(_collection(), mesh=mesh_b, policy=policy)
+    for preds, target in batches:
+        ref.update(preds, target)
+    want = {k: np.asarray(v) for k, v in ref.compute().items()}
+    return got, want
+
+
+def test_elastic_restore_shrink_bit_identical(mesh):
+    got, want = _elastic_drill(mesh, metric_mesh(4))
+    for name in want:
+        assert got[name].tobytes() == want[name].tobytes(), name
+
+
+def test_elastic_restore_grow_bit_identical(mesh):
+    got, want = _elastic_drill(metric_mesh(4), mesh)
+    for name in want:
+        assert got[name].tobytes() == want[name].tobytes(), name
+
+
+def test_elastic_restore_same_mesh_is_plain_restore(mesh):
+    got, want = _elastic_drill(mesh, mesh)
+    for name in want:
+        assert got[name].tobytes() == want[name].tobytes(), name
+
+
+def test_elastic_restore_through_durable_store(tmp_path, mesh):
+    """The full resume path: a mid-window stepper snapshot committed to the
+    durable store, loaded back, and elastically installed on a smaller mesh."""
+    policy = SyncPolicy(every_n_steps=4)
+    batches = _batches(11, 9)
+    first = SyncStepper(_collection(), mesh=mesh, policy=policy)
+    for preds, target in batches[:5]:
+        first.update(preds, target)
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(first.snapshot(), mesh_shape=(8,))
+
+    snap, _gen = store.load()
+    resumed = SyncStepper(_collection(), mesh=metric_mesh(4), policy=policy)
+    elastic_restore(resumed, snap)
+    for preds, target in batches[5:]:
+        resumed.update(preds, target)
+    got = {k: np.asarray(v) for k, v in resumed.compute().items()}
+
+    ref = SyncStepper(_collection(), mesh=metric_mesh(4), policy=policy)
+    for preds, target in batches:
+        ref.update(preds, target)
+    for name, want in {k: np.asarray(v) for k, v in ref.compute().items()}.items():
+        assert got[name].tobytes() == want.tobytes(), name
+
+
+def test_metric_snapshots_are_mesh_agnostic(tmp_path):
+    """Replicated metric state restores onto any mesh: elastic_restore
+    delegates to the plain path no matter what mesh the header records."""
+    m = BinaryAccuracy(validate_args=False)
+    m.update(jnp.asarray([0.9, 0.2, 0.7]), jnp.asarray([1, 0, 1]))
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    store.save(m, mesh_shape=(8,))
+    snap, _ = store.load()
+    assert snap["mesh"] == [8]
+    fresh = BinaryAccuracy(validate_args=False)
+    elastic_restore(fresh, snap)
+    assert float(fresh.compute()) == float(m.compute())
+
+
+def test_legacy_snapshot_without_n_devices_is_inferred(mesh):
+    """Pre-elastic stepper snapshots (no ``n_devices`` field) infer the
+    producing mesh from the carry's leading dim and still re-bucket."""
+    policy = SyncPolicy(every_n_steps=4)
+    stepper = SyncStepper(_collection(), mesh=mesh, policy=policy)
+    for preds, target in _batches(3, 3):
+        stepper.update(preds, target)
+    snap = dict(stepper.snapshot())
+    snap.pop("n_devices")
+    resumed = SyncStepper(_collection(), mesh=metric_mesh(4), policy=policy)
+    elastic_restore(resumed, snap)
+    assert resumed.pending == stepper.pending
+    got = {k: float(v) for k, v in resumed.compute().items()}
+    want = {k: float(v) for k, v in stepper.compute().items()}
+    assert got == want

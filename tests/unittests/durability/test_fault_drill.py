@@ -1,0 +1,206 @@
+"""The deterministic fault drill (ISSUE 14 acceptance invariant).
+
+Every injected durability fault must end in exactly one of two loud
+outcomes: the evaluation restores **bit-exactly** from the newest valid
+generation, or it degrades with a typed error / warning — never a silent
+wrong answer, never an unhandled crash."""
+
+import errno
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.observability.fleet import gather_reports
+from torchmetrics_tpu.resilience import (
+    IO_FAULT_MODES,
+    DurableSnapshotStore,
+    FaultyBackend,
+    RetryPolicy,
+    SimulatedCrash,
+    StateRestoreError,
+    TransientIOError,
+    lossy_allgather,
+)
+
+pytestmark = pytest.mark.durability
+
+
+def _fast_retry(**kwargs):
+    """Deterministic, wall-clock-free retry policy for drills."""
+    return RetryPolicy(base_delay_s=0.0, sleep=lambda _s: None, **kwargs)
+
+
+def _metric(seed):
+    m = BinaryAccuracy(validate_args=False)
+    rng = np.random.default_rng(seed)
+    m.update(jnp.asarray(rng.random(32)), jnp.asarray(rng.integers(0, 2, (32,))))
+    return m
+
+
+def _state_bytes(m):
+    return {k: np.asarray(v).tobytes() for k, v in m.state_pytree().items()}
+
+
+def _restored_bytes(root, generation=None):
+    """Restore through a fresh healthy store; (state bytes, generation)."""
+    fresh = BinaryAccuracy(validate_args=False)
+    gen = DurableSnapshotStore(root).restore(fresh, generation)
+    return _state_bytes(fresh), gen
+
+
+# ------------------------------------------------- committed-but-corrupt modes
+@pytest.mark.parametrize("mode", ["torn_write", "partial_manifest"])
+def test_corrupt_commit_skips_back_bit_exact(tmp_path, mode):
+    """A commit whose payload (torn sector) or manifest (garbled JSON) is
+    damaged still *looks* committed — load must detect it, warn, and fall
+    back to the previous generation bit-exactly."""
+    root = str(tmp_path / "ckpt")
+    a = _metric(0)
+    gen1 = DurableSnapshotStore(root).save(a)
+    faulty = DurableSnapshotStore(root, backend=FaultyBackend(mode))
+    gen2 = faulty.save(_metric(1))  # commit completes; generation is poison
+    assert gen2 == gen1 + 1
+    with pytest.warns(UserWarning, match="skipping back"):
+        got, gen = _restored_bytes(root)
+    assert gen == gen1
+    assert got == _state_bytes(a)
+
+
+@pytest.mark.parametrize("mode", ["torn_write", "partial_manifest"])
+def test_corrupt_commit_explicit_generation_raises(tmp_path, mode):
+    """Pinning the damaged generation explicitly must raise a structured
+    corruption error — skip-back is only for ``generation=None``."""
+    root = str(tmp_path / "ckpt")
+    DurableSnapshotStore(root).save(_metric(0))
+    gen2 = DurableSnapshotStore(root, backend=FaultyBackend(mode)).save(_metric(1))
+    with pytest.raises(StateRestoreError) as exc:
+        DurableSnapshotStore(root).load(gen2)
+    assert exc.value.reason == "corrupt"
+    assert exc.value.generation == gen2
+
+
+# ----------------------------------------------------------------- permanent
+def test_enospc_is_permanent_never_retried(tmp_path):
+    """Disk-full is not a flake: the OSError surfaces on the first attempt
+    (no backoff, no second injection) and prior checkpoints stay intact."""
+    root = str(tmp_path / "ckpt")
+    a = _metric(0)
+    gen1 = DurableSnapshotStore(root).save(a)
+    backend = FaultyBackend("enospc")
+    faulty = DurableSnapshotStore(root, backend=backend, retry=_fast_retry())
+    with pytest.raises(OSError) as exc:
+        faulty.save(_metric(1))
+    assert exc.value.errno == errno.ENOSPC
+    assert backend.injected == 1  # permanent: raised immediately, never retried
+    assert DurableSnapshotStore(root).generations() == [gen1]
+    got, gen = _restored_bytes(root)
+    assert gen == gen1 and got == _state_bytes(a)
+
+
+# -------------------------------------------------------- crash-before-rename
+def test_crash_before_rename_strands_staging_only(tmp_path):
+    """Dying between write-ahead and commit leaves a staging dir that is
+    invisible to readers, swept by gc, and never counted as a generation."""
+    root = str(tmp_path / "ckpt")
+    a = _metric(0)
+    gen1 = DurableSnapshotStore(root).save(a)
+    with pytest.raises(SimulatedCrash):
+        DurableSnapshotStore(root, backend=FaultyBackend("crash_before_rename")).save(
+            _metric(1)
+        )
+    survivor = DurableSnapshotStore(root)
+    assert survivor.generations() == [gen1]  # staging never becomes a generation
+    assert any(n.startswith(".staging-") for n in os.listdir(root))
+    assert survivor.gc() == []  # sweep touches no committed generation...
+    assert not any(n.startswith(".staging-") for n in os.listdir(root))  # ...only residue
+    got, gen = _restored_bytes(root)
+    assert gen == gen1 and got == _state_bytes(a)
+
+
+# ------------------------------------------------------------------ transient
+def test_transient_flake_retries_to_durable_commit(tmp_path):
+    """An NFS-style flake on the write path is warned about, retried under
+    the bounded policy, and converges to a fully verified commit."""
+    root = str(tmp_path / "ckpt")
+    backend = FaultyBackend("transient", times=2)
+    store = DurableSnapshotStore(root, backend=backend, retry=_fast_retry())
+    a = _metric(3)
+    with pytest.warns(UserWarning, match="transient failure during"):
+        gen = store.save(a)
+    assert backend.injected == 2  # both flakes consumed, third attempt landed
+    got, g = _restored_bytes(root)
+    assert g == gen and got == _state_bytes(a)
+
+
+def test_transient_exhaustion_raises_and_commits_nothing(tmp_path):
+    """When every attempt flakes, the typed error propagates and no
+    half-written generation becomes visible."""
+    root = str(tmp_path / "ckpt")
+    backend = FaultyBackend("transient", times=3)
+    store = DurableSnapshotStore(root, backend=backend, retry=_fast_retry(max_attempts=3))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(TransientIOError):
+            store.save(_metric(0))
+    assert DurableSnapshotStore(root).generations() == []
+
+
+# ------------------------------------------------------------------ host loss
+def test_host_loss_degrades_fleet_gather_not_eval(tmp_path):
+    """Losing a host mid-allgather degrades *observability* to the local
+    report (stamped + warned), instead of taking the evaluation down."""
+    report = {"schema_version": "1.6.0", "process_index": 0, "metrics": []}
+    with pytest.warns(UserWarning, match="degraded"):
+        rows = gather_reports(
+            report,
+            n_processes=4,
+            allgather=lossy_allgather(4, fail_on_call=2),
+            on_failure="local",
+        )
+    assert len(rows) == 1
+    stamp = rows[0]["degraded_gather"]
+    assert stamp["expected_processes"] == 4
+    assert stamp["gathered_processes"] == 1
+
+
+# ----------------------------------------------------- the umbrella invariant
+@pytest.mark.parametrize("mode", IO_FAULT_MODES)
+def test_drill_invariant_never_silent_never_unhandled(tmp_path, mode):
+    """For every fault mode: the save either raises a *typed* error or
+    commits; the subsequent restore always yields a bit-exact verified
+    state (pre- or post-fault, never a hybrid); and any fallback to an
+    older generation is announced with a warning."""
+    root = str(tmp_path / "ckpt")
+    a = _metric(10)
+    gen1 = DurableSnapshotStore(root).save(a)
+    b = _metric(11)
+    faulty = DurableSnapshotStore(
+        root, backend=FaultyBackend(mode), retry=_fast_retry()
+    )
+    raised = None
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        try:
+            faulty.save(b)
+        except (OSError, SimulatedCrash) as err:  # loud + typed, by contract
+            raised = err
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        got, gen = _restored_bytes(root)
+
+    want_a, want_b = _state_bytes(a), _state_bytes(b)
+    assert got in (want_a, want_b)  # verified state only — never a torn hybrid
+    if got == want_b:
+        assert gen == gen1 + 1  # the faulty save genuinely committed
+    else:
+        assert gen == gen1  # fell back to the newest valid generation
+        if raised is None:
+            # the save *looked* successful, so the fallback must be loud
+            assert any("skipping back" in str(w.message) for w in rec)
+    if mode in ("enospc", "crash_before_rename"):
+        assert raised is not None

@@ -1,0 +1,388 @@
+"""Degraded-mode evaluation: quarantined replicas are masked out of the
+collective (in-graph weight — no retrace), divergence escalates to
+quarantine under ``on_divergence="quarantine"``, health alerts fire, and the
+fleet view labels the partial merge."""
+
+import copy
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.aggregation import MaxMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.core.compile import cache_stats
+from torchmetrics_tpu.observability.fleet import FleetView, gather_reports
+from torchmetrics_tpu.observability.health import HealthMonitor
+from torchmetrics_tpu.parallel import (
+    SyncPolicy,
+    SyncStepper,
+    sharded_collection_update,
+    sharded_update,
+)
+from torchmetrics_tpu.resilience import (
+    ReplicaDivergenceError,
+    attach_monitor,
+    clear_quarantine,
+    degradation_report,
+    is_degraded,
+    lossy_allgather,
+    quarantine,
+    quarantine_mask,
+    quarantined_replicas,
+)
+pytestmark = pytest.mark.durability
+
+NUM_DEVICES = 8
+
+
+def _metric():
+    return MulticlassAccuracy(num_classes=5, average="micro")
+
+
+def _batch(seed, n=16):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.integers(0, 5, (n,))),
+        jnp.asarray(rng.integers(0, 5, (n,))),
+    )
+
+
+def _without_shard(arr, replica, n_devices=NUM_DEVICES):
+    """Drop ``replica``'s contiguous shard from a batch-axis array."""
+    arr = np.asarray(arr)
+    per = arr.shape[0] // n_devices
+    return np.concatenate([arr[: replica * per], arr[(replica + 1) * per :]])
+
+
+# --------------------------------------------------------- masked correctness
+def test_masked_sum_excludes_quarantined_shard(mesh):
+    """The quarantined replica's partial sums are weighted to zero: the
+    degraded aggregate equals an eager update over every *other* shard."""
+    preds, target = _batch(0)
+    m = _metric()
+    quarantine(m, [3], reason="test")
+    state = sharded_update(m, preds, target, mesh=mesh)
+    ref = _metric()
+    ref.update(jnp.asarray(_without_shard(preds, 3)), jnp.asarray(_without_shard(target, 3)))
+    for leaf, want in ref.state_pytree().items():
+        if leaf.startswith("_"):  # _n counts per-device update calls, not samples
+            continue
+        np.testing.assert_array_equal(np.asarray(state[leaf]), np.asarray(want), err_msg=leaf)
+    assert float(m.compute_state(state)) == float(ref.compute())
+
+
+def test_masked_multiple_quarantined_replicas(mesh):
+    preds, target = _batch(1)
+    m = _metric()
+    quarantine(m, [0, 7])
+    state = sharded_update(m, preds, target, mesh=mesh)
+    keep_preds = np.asarray(preds).reshape(NUM_DEVICES, -1)[1:7].reshape(-1)
+    keep_target = np.asarray(target).reshape(NUM_DEVICES, -1)[1:7].reshape(-1)
+    ref = _metric()
+    ref.update(jnp.asarray(keep_preds), jnp.asarray(keep_target))
+    for leaf, want in ref.state_pytree().items():
+        if leaf.startswith("_"):
+            continue
+        np.testing.assert_array_equal(np.asarray(state[leaf]), np.asarray(want), err_msg=leaf)
+
+
+def test_masked_max_substitutes_identity(mesh):
+    """Min/max buckets replace the quarantined replica's value with the
+    reduction identity — the global max comes from the survivors even when
+    the quarantined device held the true maximum."""
+    values = jnp.asarray([1.0, 2.0, 3.0, 4.0, 99.0, 5.0, 6.0, 7.0])  # device 4 holds 99
+    m = MaxMetric()
+    quarantine(m, [4])
+    state = sharded_update(m, values, mesh=mesh)
+    assert float(m.compute_state(state)) == 7.0
+
+
+def test_quarantine_flip_zero_retrace(mesh):
+    """Changing which replicas are quarantined re-runs the same masked
+    executable: the mask is a data input, so no retrace and no new cache
+    entry — the acceptance criterion for degraded-mode cost."""
+    preds, target = _batch(2)
+    m = _metric()
+    quarantine(m, [1])
+    sharded_update(m, preds, target, mesh=mesh)  # masked variant compiles once
+    before = cache_stats()
+    quarantine(m, [5])  # escalate: {1} -> {1, 5}
+    sharded_update(m, preds, target, mesh=mesh)
+    clear_quarantine(m, [1])  # partial recovery: {5}
+    sharded_update(m, preds, target, mesh=mesh)
+    after = cache_stats()
+    assert after["traces"] == before["traces"]
+    assert after["misses"] == before["misses"]
+
+
+def test_quarantine_mask_values_and_cache(mesh):
+    m = _metric()
+    quarantine(m, [2, 6])
+    mask = np.asarray(quarantine_mask(m, mesh))
+    np.testing.assert_array_equal(mask, [1, 1, 0, 1, 1, 1, 0, 1])
+    assert quarantine_mask(m, mesh) is quarantine_mask(m, mesh)  # cached
+    clear_quarantine(m)
+    np.testing.assert_array_equal(np.asarray(quarantine_mask(m, mesh)), np.ones(8))
+
+
+# ------------------------------------------------------- divergence escalation
+class _DivergeOnce:
+    """Monkeypatch stand-in for verify_replica_consistency: raises on the
+    first call naming ``replicas``, passes afterwards."""
+
+    def __init__(self, replicas, leaves=("tp",)):
+        self.replicas = replicas
+        self.leaves = leaves
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls == 1:
+            raise ReplicaDivergenceError(
+                "injected divergence", leaves=self.leaves, replicas=self.replicas
+            )
+
+
+def _patch_verify(monkeypatch, fake):
+    import torchmetrics_tpu.resilience.divergence as div
+
+    monkeypatch.setattr(div, "verify_replica_consistency", fake)
+    return fake
+
+
+def test_on_divergence_raise_is_fail_stop(mesh, monkeypatch):
+    _patch_verify(monkeypatch, _DivergeOnce([2]))
+    m = _metric()
+    preds, target = _batch(3)
+    with pytest.raises(ReplicaDivergenceError, match="injected divergence"):
+        sharded_update(m, preds, target, mesh=mesh, verify_consistency=True)
+    assert not is_degraded(m)  # raise policy never quarantines
+
+
+def test_on_divergence_quarantine_masks_and_redispatches(mesh, monkeypatch):
+    fake = _patch_verify(monkeypatch, _DivergeOnce([2]))
+    m = _metric()
+    preds, target = _batch(4)
+    with pytest.warns(UserWarning, match="quarantined"):
+        state = sharded_update(
+            m, preds, target, mesh=mesh, verify_consistency=True, on_divergence="quarantine"
+        )
+    assert quarantined_replicas(m) == (2,)
+    assert fake.calls == 2  # original verify + re-verify of the masked answer
+    ref = _metric()
+    ref.update(jnp.asarray(_without_shard(preds, 2)), jnp.asarray(_without_shard(target, 2)))
+    for leaf, want in ref.state_pytree().items():
+        if leaf.startswith("_"):
+            continue
+        np.testing.assert_array_equal(np.asarray(state[leaf]), np.asarray(want), err_msg=leaf)
+
+
+def test_unidentifiable_replicas_raise_even_under_quarantine(mesh, monkeypatch):
+    _patch_verify(monkeypatch, _DivergeOnce(None))
+    m = _metric()
+    preds, target = _batch(5)
+    with pytest.raises(ReplicaDivergenceError, match="could not identify"):
+        sharded_update(
+            m, preds, target, mesh=mesh, verify_consistency=True, on_divergence="quarantine"
+        )
+    assert not is_degraded(m)
+
+
+def test_zero_quorum_raises(mesh, monkeypatch):
+    _patch_verify(monkeypatch, _DivergeOnce(list(range(NUM_DEVICES))))
+    m = _metric()
+    preds, target = _batch(6)
+    with pytest.raises(ReplicaDivergenceError, match="no surviving quorum"):
+        sharded_update(
+            m, preds, target, mesh=mesh, verify_consistency=True, on_divergence="quarantine"
+        )
+
+
+def test_second_divergence_is_fail_stop(mesh, monkeypatch):
+    """The masked re-dispatch's answer must itself verify; a still-divergent
+    quorum raises regardless of policy — never a silent wrong answer."""
+
+    class AlwaysDiverge(_DivergeOnce):
+        def __call__(self, *args, **kwargs):
+            self.calls += 1
+            raise ReplicaDivergenceError(
+                "injected divergence", leaves=self.leaves, replicas=self.replicas
+            )
+
+    _patch_verify(monkeypatch, AlwaysDiverge([1]))
+    m = _metric()
+    preds, target = _batch(7)
+    with pytest.warns(UserWarning, match="quarantined"):
+        with pytest.raises(ReplicaDivergenceError):
+            sharded_update(
+                m, preds, target, mesh=mesh, verify_consistency=True, on_divergence="quarantine"
+            )
+
+
+def test_invalid_on_divergence_rejected(mesh):
+    with pytest.raises(ValueError, match="on_divergence"):
+        sharded_update(_metric(), *_batch(8), mesh=mesh, on_divergence="shrug")
+
+
+# ----------------------------------------------------- collection + stepper
+def test_collection_quarantine_path(mesh, monkeypatch):
+    fake = _patch_verify(monkeypatch, _DivergeOnce([6]))
+    col = MetricCollection({"acc": _metric()})
+    preds, target = _batch(9)
+    with pytest.warns(UserWarning, match="quarantined"):
+        states = sharded_collection_update(
+            col, preds, target, mesh=mesh, verify_consistency=True, on_divergence="quarantine"
+        )
+    assert quarantined_replicas(col) == (6,)
+    ref = _metric()
+    ref.update(jnp.asarray(_without_shard(preds, 6)), jnp.asarray(_without_shard(target, 6)))
+    for leaf, want in ref.state_pytree().items():
+        if leaf.startswith("_"):
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(states["acc"][leaf]), np.asarray(want), err_msg=leaf
+        )
+    assert fake.calls == 2
+
+
+def test_stepper_window_quarantine(mesh, monkeypatch):
+    """A mid-run divergence inside a cadenced window quarantines and re-syncs
+    the open carry through the masked graph; later windows stay degraded."""
+    fake = _patch_verify(monkeypatch, _DivergeOnce([4]))
+    col = MetricCollection({"acc": _metric()})
+    stepper = SyncStepper(
+        col,
+        mesh=mesh,
+        policy=SyncPolicy(every_n_steps=2),
+        verify_consistency=True,
+        on_divergence="quarantine",
+    )
+    with pytest.warns(UserWarning, match="quarantined"):
+        for seed in range(4):
+            stepper.update(*_batch(20 + seed))
+    assert quarantined_replicas(col) == (4,)
+    out = stepper.compute()
+    assert np.isfinite(float(out["acc"]))
+
+
+# --------------------------------------------------------- alerts + reporting
+def test_quarantine_rule_alert_fires():
+    m = _metric()
+    monitor = HealthMonitor()
+    series = attach_monitor(m, monitor)
+    assert series == "quarantine/MulticlassAccuracy"
+    quarantine(m, [3], step=7)
+    alerts = monitor.alerts()
+    assert any(a.series == series for a in alerts)
+    # escalation pages again; an idempotent re-quarantine does not
+    n = len(monitor.alerts())
+    quarantine(m, [3], step=8)
+    assert len(monitor.alerts()) == n
+    quarantine(m, [5], step=9)
+    assert len(monitor.alerts()) > n
+
+
+def test_degradation_report_contents():
+    m = _metric()
+    assert degradation_report(m) == {"degraded": False, "quarantined": [], "reasons": {}}
+    quarantine(m, [1, 4], reason="divergence")
+    rep = degradation_report(m, n_devices=8)
+    assert rep["degraded"] is True
+    assert rep["quarantined"] == [1, 4]
+    assert rep["reasons"] == {"1": "divergence", "4": "divergence"}
+    assert rep["n_devices"] == 8 and rep["surviving"] == 6
+    clear_quarantine(m)
+    assert degradation_report(m)["degraded"] is False
+
+
+def test_degradation_stamped_into_telemetry_export(mesh):
+    """compute() on a degraded metric surfaces the surviving quorum in the
+    telemetry export payload."""
+    obs.enable()
+    m = _metric()
+    quarantine(m, [2], reason="divergence")
+    preds, target = _batch(10)
+    state = sharded_update(m, preds, target, mesh=mesh)
+    m.compute_state(state)
+    rep = obs.report()
+    rows = [row for row in rep.get("metrics", {}).values() if row.get("quorum")]
+    assert rows, "degraded metric must stamp a quorum block into its telemetry row"
+    quorum = rows[0]["quorum"]
+    assert quorum["degraded"] is True and quorum["quarantined"] == [2]
+
+
+# ----------------------------------------------------------------- fleet view
+def _fake_reports(n=4):
+    base = {
+        "enabled": True,
+        "metrics": {
+            "_process": {
+                "spans": {
+                    "sync_wait": {
+                        "count": 1,
+                        "total_us": 10.0,
+                        "max_us": 10.0,
+                        "ema_us": 10.0,
+                        "mean_us": 10.0,
+                        "buckets": [],
+                    }
+                }
+            },
+            "m": {"class": "M", "counters": {"updates": 5}, "spans": {}},
+        },
+        "global": {"counters": {"sync_bytes": 100}},
+        "compile_cache": {"traces": 3},
+    }
+    out = []
+    for i in range(n):
+        r = copy.deepcopy(base)
+        r["process"] = {"index": i, "count": n}
+        out.append(r)
+    return out
+
+
+def test_fleet_view_excludes_quarantined_processes():
+    view = FleetView(_fake_reports(4), quarantined=[2])
+    merged = view.merged_metrics()
+    assert merged["m"]["counters"]["updates"] == 15  # 3 active x 5, not 20
+    rep = view.report()
+    assert rep["degraded"]["quarantined_processes"] == [2]
+    assert rep["degraded"]["active_processes"] == 3
+    assert rep["compile_cache"]["traces"] == 9
+    # the quarantined host's raw report still rides along for the post-mortem
+    assert set(rep["per_process"]) == {"0", "1", "2", "3"}
+    assert 2 not in {int(k) for k in view.skew()["sync_wait_us"]["per_process"]}
+
+
+def test_fleet_view_needs_a_survivor():
+    with pytest.raises(ValueError, match="no active process"):
+        FleetView(_fake_reports(2), quarantined=[0, 1])
+
+
+def test_gather_reports_host_loss_local_fallback():
+    """A host lost mid-gather degrades fleet telemetry to the local report
+    (stamped + warned) instead of taking the evaluation down."""
+    local = {"enabled": True, "metrics": {}, "process": {"index": 0, "count": 4}}
+    with pytest.warns(UserWarning, match="degraded"):
+        reports = gather_reports(
+            local,
+            n_processes=4,
+            allgather=lossy_allgather(4, fail_on_call=2),
+            on_failure="local",
+        )
+    assert len(reports) == 1
+    stamp = reports[0]["degraded_gather"]
+    assert stamp["expected_processes"] == 4 and stamp["gathered_processes"] == 1
+    view = FleetView(reports)
+    assert view.report()["degraded"]["gather"]["expected_processes"] == 4
+
+
+def test_gather_reports_host_loss_raise_policy():
+    local = {"enabled": True, "metrics": {}}
+    with pytest.raises(OSError):
+        gather_reports(
+            local, n_processes=4, allgather=lossy_allgather(4, fail_on_call=1), on_failure="raise"
+        )

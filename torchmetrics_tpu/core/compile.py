@@ -1229,14 +1229,35 @@ def compiled_forward(
     )
 
 
-def _frozen_sync_states(frozen: Any, st: Any, axis_name: str, compression: Any) -> Any:
+def _frozen_sync_states(
+    frozen: Any, st: Any, axis_name: str, compression: Any, weight: Any = None
+) -> Any:
     """Forward the compression config only to the standard planner-backed
-    ``sync_states``; overriding metrics keep their own exact aggregation."""
+    ``sync_states``; overriding metrics keep their own exact aggregation.
+    ``weight`` (the traced quarantine mask scalar) follows the same rule —
+    only passed when set, so the default call is byte-identical."""
     from torchmetrics_tpu.core.metric import Metric
 
+    if weight is not None:
+        return frozen.sync_states(st, axis_name, compression=compression, weight=weight)
     if compression is not None and type(frozen).sync_states is Metric.sync_states:
         return frozen.sync_states(st, axis_name, compression=compression)
     return frozen.sync_states(st, axis_name)
+
+
+def _mask_in_specs(specs: Any, args: Tuple[Any, ...], axis_name: str) -> Tuple[Any, ...]:
+    """Prepend the quarantine-mask spec to the input specs.
+
+    ``specs`` may be a single ``PartitionSpec`` acting as a pytree prefix for
+    every input; ``PartitionSpec`` subclasses ``tuple``, so plain
+    concatenation would splice its axis *names* in as strings — expand it to
+    one spec per input first.
+    """
+    if isinstance(specs, P) or not isinstance(specs, tuple):
+        per_input: Tuple[Any, ...] = tuple(specs for _ in args)
+    else:
+        per_input = specs
+    return (P(axis_name),) + per_input
 
 
 def compiled_sharded_update(
@@ -1246,6 +1267,7 @@ def compiled_sharded_update(
     specs: Tuple[Any, ...],
     args: Tuple[Any, ...],
     compression: Any = None,
+    masked: bool = False,
 ) -> Callable:
     """Compiled shard_map step for ``parallel.sync.sharded_update``.
 
@@ -1254,12 +1276,23 @@ def compiled_sharded_update(
     (the round-5 stale-trace fix).  An active compression config joins the
     key (it changes the traced sync graph); the default ``None`` leaves the
     key — and thus every pre-compression cache entry — byte-identical.
+
+    ``masked=True`` is the degraded-mode (quarantine) variant: the returned
+    callable takes a leading ``(n_devices,)`` float32 0/1 mask sharded over
+    ``axis_name`` — ``fn(mask, *inputs)`` — and each replica's contribution
+    is weighted by its mask scalar inside the coalesced sync.  The mask is a
+    *data* input: flipping which replicas are quarantined re-runs the same
+    executable with zero retraces.  The variant is its own cache entry
+    (``("masked",)`` joins the key), so the default unmasked graph stays
+    byte-identical to its golden trace contract.
     """
     fp = metric._config_fingerprint()
     sig = abstract_signature(args)
     key = ("sharded_update", fp, mesh, axis_name, specs, sig)
     if compression is not None:
         key = key + (compression,)
+    if masked:
+        key = key + ("masked",)
 
     owner_ref = weakref.ref(metric)
     scope = f"tm_tpu/{type(metric).__name__}/sharded_update"
@@ -1276,6 +1309,24 @@ def compiled_sharded_update(
                 # override sync_states with their own cross-shard aggregation
                 return _frozen_sync_states(frozen, st, axis_name, compression)
 
+        def masked_step(mask, *shards):
+            mark_trace("sharded", owner_ref)
+            with jax.named_scope(scope):
+                st = frozen.update_state(frozen.init_state(), *shards)
+                return _frozen_sync_states(
+                    frozen, st, axis_name, compression, weight=mask[0]
+                )
+
+        if masked:
+            return jax.jit(
+                shard_map(
+                    masked_step,
+                    mesh=mesh,
+                    in_specs=_mask_in_specs(specs, args, axis_name),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
         return jax.jit(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
         )
@@ -1286,7 +1337,7 @@ def compiled_sharded_update(
         kind="sharded",
         owner=metric,
         fingerprint=fp,
-        residual=("sharded_update", mesh, axis_name, specs, sig),
+        residual=("sharded_update", mesh, axis_name, specs, sig) + (("masked",) if masked else ()),
     )
 
 
@@ -1446,6 +1497,7 @@ def compiled_sharded_collection_update(
     specs: Tuple[Any, ...],
     args: Tuple[Any, ...],
     compression: Any = None,
+    masked: bool = False,
 ) -> Callable:
     """One fused shard_map graph: every leader updates from its input shard
     AND syncs across the mesh in a single compiled step.
@@ -1457,12 +1509,16 @@ def compiled_sharded_collection_update(
     whole collection syncs in as few collectives as it has distinct
     (dtype, reduction-class) pairs instead of one per leaf per metric.
     An active compression config joins the key; ``None`` leaves it unchanged.
+    ``masked=True`` returns the quarantine variant ``fn(mask, *inputs)``
+    (own cache entry; see :func:`compiled_sharded_update`).
     """
     fp = tuple((name, collection[name]._config_fingerprint()) for name in leader_names)
     sig = abstract_signature(args)
     key = ("sharded_collection_update", fp, mesh, axis_name, specs, sig)
     if compression is not None:
         key = key + (compression,)
+    if masked:
+        key = key + ("masked",)
 
     owner_ref = weakref.ref(collection)
 
@@ -1471,13 +1527,17 @@ def compiled_sharded_collection_update(
 
         frozen = {name: _frozen_clone(collection[name]) for name in leader_names}
 
+        def _locals(shards):
+            locals_ = {}
+            for name, m in frozen.items():
+                with jax.named_scope(f"tm_tpu/{type(m).__name__}/sharded_update"):
+                    locals_[name] = m.update_state(m.init_state(), *shards)
+            return locals_
+
         def step(*shards):
             mark_trace("sharded_collection", owner_ref)
             with jax.named_scope("tm_tpu/MetricCollection/sharded_collection_update"):
-                locals_ = {}
-                for name, m in frozen.items():
-                    with jax.named_scope(f"tm_tpu/{type(m).__name__}/sharded_update"):
-                        locals_[name] = m.update_state(m.init_state(), *shards)
+                locals_ = _locals(shards)
                 names = tuple(frozen)
                 synced = coalesced_metric_sync(
                     [frozen[n] for n in names],
@@ -1487,8 +1547,32 @@ def compiled_sharded_collection_update(
                 )
                 return dict(zip(names, synced))
 
+        def masked_step(mask, *shards):
+            mark_trace("sharded_collection", owner_ref)
+            with jax.named_scope("tm_tpu/MetricCollection/sharded_collection_update"):
+                locals_ = _locals(shards)
+                names = tuple(frozen)
+                synced = coalesced_metric_sync(
+                    [frozen[n] for n in names],
+                    [locals_[n] for n in names],
+                    axis_name,
+                    compression=compression,
+                    weight=mask[0],
+                )
+                return dict(zip(names, synced))
+
         # every leader state comes back fully replicated
         out_specs = {name: P() for name in frozen}
+        if masked:
+            return jax.jit(
+                shard_map(
+                    masked_step,
+                    mesh=mesh,
+                    in_specs=_mask_in_specs(specs, args, axis_name),
+                    out_specs=out_specs,
+                    check_vma=False,
+                )
+            )
         return jax.jit(
             shard_map(step, mesh=mesh, in_specs=specs, out_specs=out_specs, check_vma=False)
         )
@@ -1499,7 +1583,8 @@ def compiled_sharded_collection_update(
         kind="sharded_collection",
         owner=collection,
         fingerprint=fp,
-        residual=("sharded_collection_update", mesh, axis_name, specs, sig),
+        residual=("sharded_collection_update", mesh, axis_name, specs, sig)
+        + (("masked",) if masked else ()),
     )
 
 
@@ -1573,6 +1658,7 @@ def compiled_cadence_sync(
     mesh: Mesh,
     axis_name: str,
     compression: Any = None,
+    masked: bool = False,
 ) -> Callable:
     """The deferred collective for ``parallel.coalesce.SyncStepper``.
 
@@ -1581,11 +1667,16 @@ def compiled_cadence_sync(
     coalesced bucket plan (``coalesced_metric_sync``), exactly the sync the
     per-step path would have run — just ``k`` steps later.  An active
     compression config joins the key; ``None`` leaves it unchanged.
+    ``masked=True`` returns the quarantine variant ``fn(carry, mask)``
+    weighting each replica's window by its 0/1 mask scalar (own cache
+    entry; see :func:`compiled_sharded_update`).
     """
     fp = tuple((name, m._config_fingerprint()) for name, m in named_metrics)
     key = ("cadence_sync", fp, mesh, axis_name)
     if compression is not None:
         key = key + (compression,)
+    if masked:
+        key = key + ("masked",)
 
     owner_ref = weakref.ref(owner)
 
@@ -1604,6 +1695,30 @@ def compiled_cadence_sync(
                 )
                 return dict(zip(names, synced))
 
+        def masked_syncf(carry, mask):
+            mark_trace("cadence", owner_ref)
+            with jax.named_scope("tm_tpu/SyncStepper/cadence_sync"):
+                names = tuple(name for name, _ in frozen)
+                locals_ = [jax.tree.map(lambda x: x[0], carry[name]) for name in names]
+                synced = coalesced_metric_sync(
+                    [m for _, m in frozen],
+                    locals_,
+                    axis_name,
+                    compression=compression,
+                    weight=mask[0],
+                )
+                return dict(zip(names, synced))
+
+        if masked:
+            return jax.jit(
+                shard_map(
+                    masked_syncf,
+                    mesh=mesh,
+                    in_specs=(P(axis_name), P(axis_name)),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            )
         return jax.jit(
             shard_map(syncf, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
         )
@@ -1617,5 +1732,6 @@ def compiled_cadence_sync(
         # compression joins the residual as well as the key: the first sync
         # under a new mode is a new configuration ("new-key"), not a re-miss
         # of the exact-mode entry ("eviction")
-        residual=("cadence_sync", mesh, axis_name, compression),
+        residual=("cadence_sync", mesh, axis_name, compression)
+        + (("masked",) if masked else ()),
     )

@@ -408,7 +408,11 @@ class Metric:
         return out
 
     def sync_states(
-        self, state: State, axis_name: Optional[str] = None, compression: Optional[Any] = None
+        self,
+        state: State,
+        axis_name: Optional[str] = None,
+        compression: Optional[Any] = None,
+        weight: Optional[Any] = None,
     ) -> State:
         """In-graph cross-device sync (pure; call under shard_map/pmap).
 
@@ -424,13 +428,20 @@ class Metric:
         ``None`` for the default exact sync) opts eligible large float32 sum
         buckets into quantized wire payloads; the compiled entry points pass
         it through from ``SyncPolicy(compression=...)``.
+
+        ``weight`` (``None`` or a per-device 0/1 scalar, traced) masks this
+        replica's contribution out of the collective — the degraded-mode
+        quarantine path.  ``None`` lowers the exact graph shipped before
+        quarantine existed (bit-identical; golden trace contracts hold).
         """
         from torchmetrics_tpu.parallel.coalesce import coalesced_sync_state
 
         axis_name = axis_name or self.axis_name
         sub: State = {name: state[name] for name in self._reductions}
         sub[_N] = state[_N]
-        out = coalesced_sync_state(sub, self._reductions, axis_name, compression=compression)
+        out = coalesced_sync_state(
+            sub, self._reductions, axis_name, compression=compression, weight=weight
+        )
         if self._guard_strategy in ("warn", "error"):
             out[_NONFINITE] = count_nonfinite(out)
         return out
@@ -653,6 +664,23 @@ class Metric:
     def state_pytree(self) -> State:
         """Full state as a pytree for orbax checkpointing."""
         return self._state
+
+    def _install_restored_state(self, state: State) -> None:
+        """Install an already-validated state pytree (the restore boundary).
+
+        The single sanctioned place restored buffers land: every restore
+        surface (``resilience.restore``, the durable store, elastic restore)
+        funnels through here after validation, so the post-restore
+        invariants live in one spot — ``_state_shared`` cleared (fresh
+        buffers are donation-safe), memoised compute/forward caches dropped,
+        and the non-finite reporting watermark rewound.
+        """
+        _telemetry.count(self, "restores")
+        self._state = state
+        self._state_shared = False
+        self._computed = None
+        self._forward_cache = None
+        self._nf_reported = 0
 
     def load_state_pytree(self, state: State) -> None:
         """Install a full state pytree, validated against this metric's spec.

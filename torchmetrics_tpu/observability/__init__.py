@@ -68,6 +68,7 @@ from torchmetrics_tpu.observability.health import (
     LoggingAlertSink,
     MemoryBudgetRule,
     NonFiniteRule,
+    QuarantineRule,
     SEVERITIES,
     StalenessRule,
 )
@@ -119,6 +120,7 @@ __all__ = [
     "NonFiniteRule",
     "ObservationWindow",
     "PrometheusExporter",
+    "QuarantineRule",
     "SCHEMA_VERSION",
     "SEVERITIES",
     "SPAN_BUCKETS_US",

@@ -64,8 +64,13 @@ __all__ = [
 #: ShardingAdvisor's replication-waste advisory), the ``tm_tpu_memory_*`` /
 #: ``tm_tpu_cost_*`` Prometheus families, an ``entry_bytes`` gauge in
 #: ``compile_cache.by_entrypoint``, and the ``memory`` flight-recorder
-#: category.
-SCHEMA_VERSION = "1.5.0"
+#: category; 1.6 added the durability & degraded-mode plane — the
+#: ``durable_saves`` / ``durable_restores`` / ``io_retries`` / ``skipbacks``
+#: / ``quarantines`` counters (and their ``tm_tpu_*_total`` Prometheus
+#: families), an optional ``degraded`` block on fleet reports naming the
+#: quarantined processes excluded from the merge, and a ``quorum`` block on
+#: reports produced while replica quarantine is active.
+SCHEMA_VERSION = "1.6.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -135,6 +140,11 @@ _COUNTER_HELP = {
     "policy_commits": "SyncAutotuner policy commits applied to this metric's sync path.",
     "policy_vetoes": "SyncAutotuner pending commits vetoed by a guardrail.",
     "policy_rollbacks": "SyncAutotuner committed policies rolled back.",
+    "durable_saves": "Durable snapshot generations committed to a backend.",
+    "durable_restores": "Restores served from a durable snapshot generation.",
+    "io_retries": "Transient checkpoint I/O failures retried by a RetryPolicy.",
+    "skipbacks": "Durable restores that skipped a corrupt generation back to an older one.",
+    "quarantines": "Replicas quarantined out of the sync quorum.",
 }
 
 

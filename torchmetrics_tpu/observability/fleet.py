@@ -32,11 +32,12 @@ from __future__ import annotations
 
 import json
 import statistics
-from typing import Any, Callable, Dict, List, Mapping, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
 from torchmetrics_tpu.observability.registry import aggregate_telemetry, report as _local_report
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 __all__ = [
     "FleetView",
@@ -75,6 +76,7 @@ def gather_reports(
     *,
     n_processes: Optional[int] = None,
     allgather: Optional[Callable[[Any], Any]] = None,
+    on_failure: str = "raise",
 ) -> List[Dict[str, Any]]:
     """Every process's report snapshot, ordered by process index.
 
@@ -88,7 +90,16 @@ def gather_reports(
     they resolve to ``jax.process_count()`` and
     ``multihost_utils.process_allgather``.  With one process no collective
     runs and the local report is returned as the only entry.
+
+    ``on_failure`` is the host-loss policy: ``"raise"`` (default) propagates
+    a collective that dies mid-gather (a lost host hangs or faults DCN
+    gathers); ``"local"`` degrades instead — the local report is returned as
+    the only entry, stamped with a ``degraded_gather`` block naming the
+    failure, and a warning fires.  Observability degrades; it never takes
+    the evaluation down with it.
     """
+    if on_failure not in ("raise", "local"):
+        raise ValueError(f'on_failure must be "raise" or "local", got {on_failure!r}')
     local_dict: Dict[str, Any] = dict(local) if local is not None else _local_report()
     n_proc = process_count() if n_processes is None else int(n_processes)
     if n_proc == 1:
@@ -102,14 +113,30 @@ def gather_reports(
     payload = np.frombuffer(
         json.dumps(local_dict, sort_keys=True, default=str).encode("utf-8"), dtype=np.uint8
     )
-    lengths = np.asarray(allgather(jnp.asarray([payload.size], dtype=jnp.int32)))
-    lengths = lengths.reshape(n_proc)
-    padded = np.zeros(int(lengths.max()), dtype=np.uint8)
-    padded[: payload.size] = payload
-    rows = np.asarray(allgather(jnp.asarray(padded)))
-    return [
-        json.loads(bytes(rows[p, : int(lengths[p])]).decode("utf-8")) for p in range(n_proc)
-    ]
+    try:
+        lengths = np.asarray(allgather(jnp.asarray([payload.size], dtype=jnp.int32)))
+        lengths = lengths.reshape(n_proc)
+        padded = np.zeros(int(lengths.max()), dtype=np.uint8)
+        padded[: payload.size] = payload
+        rows = np.asarray(allgather(jnp.asarray(padded)))
+        return [
+            json.loads(bytes(rows[p, : int(lengths[p])]).decode("utf-8"))
+            for p in range(n_proc)
+        ]
+    except Exception as err:  # noqa: BLE001 - classified by the on_failure policy
+        if on_failure != "local":
+            raise
+        rank_zero_warn(
+            f"fleet gather failed mid-collective ({err!r}); continuing with the local "
+            f"report only — fleet telemetry is degraded to 1/{n_proc} processes"
+        )
+        degraded = dict(local_dict)
+        degraded["degraded_gather"] = {
+            "error": repr(err),
+            "expected_processes": n_proc,
+            "gathered_processes": 1,
+        }
+        return [degraded]
 
 
 # ---------------------------------------------------------------- wait digests
@@ -193,12 +220,29 @@ class FleetView:
       make per-process histograms addable; EMA merges count-weighted),
     * compile-cache stats sum, including ``by_entrypoint``/``miss_causes``,
     * the untouched per-process reports ride along under ``per_process``.
+
+    ``quarantined`` (process indices) excludes those hosts from every merge
+    and skew computation — a replica quarantined out of the *sync* quorum
+    must not keep polluting the fleet's merged counters or electing itself
+    straggler.  Its raw report still rides along under ``per_process`` for
+    the post-mortem, and the merged report carries a ``degraded`` block
+    naming the excluded processes.
     """
 
-    def __init__(self, reports: List[Mapping[str, Any]]) -> None:
+    def __init__(
+        self,
+        reports: List[Mapping[str, Any]],
+        quarantined: Optional[Sequence[int]] = None,
+    ) -> None:
         if not reports:
             raise ValueError("FleetView needs at least one process report")
         self.reports: List[Dict[str, Any]] = [dict(r) for r in reports]
+        self.quarantined: Tuple[int, ...] = tuple(sorted({int(q) for q in (quarantined or ())}))
+        if not self._active():
+            raise ValueError(
+                f"quarantining processes {list(self.quarantined)} leaves no active "
+                f"process in a {len(self.reports)}-report fleet view"
+            )
 
     @classmethod
     def gather(
@@ -206,9 +250,16 @@ class FleetView:
         *,
         n_processes: Optional[int] = None,
         allgather: Optional[Callable[[Any], Any]] = None,
+        on_failure: str = "raise",
+        quarantined: Optional[Sequence[int]] = None,
     ) -> "FleetView":
         """Gather every process's live report and build the view."""
-        return cls(gather_reports(n_processes=n_processes, allgather=allgather))
+        return cls(
+            gather_reports(
+                n_processes=n_processes, allgather=allgather, on_failure=on_failure
+            ),
+            quarantined=quarantined,
+        )
 
     @property
     def n_processes(self) -> int:
@@ -220,18 +271,27 @@ class FleetView:
             return int(proc["index"])
         return position
 
+    def _active(self) -> List[Tuple[int, Dict[str, Any]]]:
+        """(position, report) pairs for processes in the merge quorum."""
+        return [
+            (pos, r)
+            for pos, r in enumerate(self.reports)
+            if self._index_of(pos) not in self.quarantined
+        ]
+
     # ------------------------------------------------------------- merging
     def merged_metrics(self) -> Dict[str, Any]:
         """Per-label telemetry rows merged across processes: the same label
         on two hosts is the same logical (SPMD-replicated) metric."""
+        active = [r for _, r in self._active()]
         labels: List[str] = []
-        for r in self.reports:
+        for r in active:
             for label in r.get("metrics", {}):
                 if label not in labels:
                     labels.append(label)
         out: Dict[str, Any] = {}
         for label in labels:
-            rows = [r["metrics"][label] for r in self.reports if label in r.get("metrics", {})]
+            rows = [r["metrics"][label] for r in active if label in r.get("metrics", {})]
             merged = aggregate_telemetry(rows)
             merged["label"] = label
             merged["class"] = rows[0].get("class", label)
@@ -248,7 +308,7 @@ class FleetView:
         bytes_: Dict[int, float] = {}
         traces: Dict[int, float] = {}
         hbm: Dict[int, float] = {}
-        for pos, r in enumerate(self.reports):
+        for pos, r in self._active():
             idx = self._index_of(pos)
             digest = sync_wait_digest(r)
             wait_digests[idx] = digest
@@ -290,15 +350,20 @@ class FleetView:
 
     # -------------------------------------------------------------- report
     def report(self) -> Dict[str, Any]:
-        """The pod-global merged report (per-process breakdown retained)."""
+        """The pod-global merged report (per-process breakdown retained).
+
+        While any process is quarantined (or the gather itself degraded to
+        local-only), the report carries a ``degraded`` block — schema 1.6's
+        contract that a partial merge is always *labelled* partial.
+        """
         merged = self.merged_metrics()
-        return {
+        out: Dict[str, Any] = {
             "schema": 1,
-            "enabled": any(bool(r.get("enabled")) for r in self.reports),
+            "enabled": any(bool(r.get("enabled")) for _, r in self._active()),
             "metrics": merged,
             "global": aggregate_telemetry(merged.values()),
             "compile_cache": _merge_cache_stats(
-                [r.get("compile_cache", {}) for r in self.reports]
+                [r.get("compile_cache", {}) for _, r in self._active()]
             ),
             "fleet": {"n_processes": self.n_processes, "skew": self.skew()},
             "per_process": {
@@ -307,6 +372,22 @@ class FleetView:
             # index None marks a merged exposition; exporters label it "fleet"
             "process": {"index": None, "count": self.n_processes},
         }
+        degraded_gather = next(
+            (r["degraded_gather"] for r in self.reports if "degraded_gather" in r), None
+        )
+        if self.quarantined or degraded_gather is not None:
+            out["degraded"] = {
+                "quarantined_processes": list(self.quarantined),
+                "active_processes": len(self._active()),
+                "expected_processes": (
+                    int(degraded_gather["expected_processes"])
+                    if degraded_gather is not None
+                    else self.n_processes
+                ),
+            }
+            if degraded_gather is not None:
+                out["degraded"]["gather"] = dict(degraded_gather)
+        return out
 
     def __repr__(self) -> str:  # pragma: no cover - debugging nicety
         return f"FleetView(n_processes={self.n_processes})"
@@ -316,15 +397,21 @@ def fleet_report(
     *,
     n_processes: Optional[int] = None,
     allgather: Optional[Callable[[Any], Any]] = None,
+    on_failure: str = "raise",
+    quarantined: Optional[Sequence[int]] = None,
 ) -> Dict[str, Any]:
     """The pod-global telemetry report.
 
     Single-process (the common case, and every CPU test) this IS the local
     :func:`registry.report` — byte-identical, no collective, no extra keys.
     Multi-process it gathers every process's snapshot and returns the
-    :class:`FleetView` merge.
+    :class:`FleetView` merge; ``on_failure="local"`` survives a host lost
+    mid-gather (degraded local-only report), and ``quarantined`` excludes
+    those process indices from the merge (see :class:`FleetView`).
     """
     n_proc = process_count() if n_processes is None else int(n_processes)
     if n_proc == 1:
         return _local_report()
-    return FleetView.gather(n_processes=n_proc, allgather=allgather).report()
+    return FleetView.gather(
+        n_processes=n_proc, allgather=allgather, on_failure=on_failure, quarantined=quarantined
+    ).report()

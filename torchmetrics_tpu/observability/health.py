@@ -60,6 +60,7 @@ __all__ = [
     "LoggingAlertSink",
     "MemoryBudgetRule",
     "NonFiniteRule",
+    "QuarantineRule",
     "SEVERITIES",
     "StalenessRule",
 ]
@@ -436,6 +437,52 @@ class MemoryBudgetRule(HealthRule):
             f"live state HBM {int(value)} bytes exceeds budget "
             f"{self.budget_bytes} by {int(over)}",
             {"budget_bytes": self.budget_bytes, "over_bytes": over},
+        )
+
+
+class QuarantineRule(HealthRule):
+    """Replicas quarantined out of the sync quorum.
+
+    Feed it the quarantined-replica count (``resilience.quarantine`` does
+    this automatically through ``attach_monitor``).  Fires on every
+    *escalation* — each time the count rises past its previous alerted
+    level — and the latch rewinds when the count falls back, so a fleet
+    that loses one replica pages once, a fleet that keeps losing replicas
+    pages on each loss, and a recovered fleet can page again on the next
+    episode.  ``max_quarantined`` tolerates a baseline (default 0: any
+    quarantined replica alerts).
+    """
+
+    name = "quarantine"
+
+    def __init__(self, max_quarantined: int = 0, severity: str = "critical") -> None:
+        if max_quarantined < 0:
+            raise ValueError(
+                f"QuarantineRule max_quarantined must be >= 0, got {max_quarantined}"
+            )
+        self.max_quarantined = int(max_quarantined)
+        self.severity = severity
+        self._alerted: Dict[str, int] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None  # NonFiniteRule's jurisdiction
+        count = int(value)
+        prev = self._alerted.get(series, 0)
+        if count <= self.max_quarantined or count <= prev:
+            if count < prev:
+                self._alerted[series] = count
+            return None
+        self._alerted[series] = count
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"{count} replica(s) quarantined out of the sync quorum "
+            f"(tolerated {self.max_quarantined}); evaluation continues degraded",
+            {"quarantined": count, "max_quarantined": self.max_quarantined},
         )
 
 

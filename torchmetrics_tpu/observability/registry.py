@@ -94,6 +94,11 @@ COUNTER_NAMES = (
     "policy_commits",
     "policy_vetoes",
     "policy_rollbacks",
+    "durable_saves",
+    "durable_restores",
+    "io_retries",
+    "skipbacks",
+    "quarantines",
 )
 
 #: Upper edges (microseconds) of the fixed span histogram; one overflow
@@ -236,7 +241,7 @@ class MetricTelemetry:
     """Counters, per-entrypoint cache stats, and timing spans for one metric
     instance (or one synthetic aggregate like ``_retired``)."""
 
-    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets", "memory")
+    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets", "memory", "quorum")
 
     def __init__(self, label: str, cls: str) -> None:
         self.label = label
@@ -244,6 +249,10 @@ class MetricTelemetry:
         self.counters: Dict[str, int] = {name: 0 for name in COUNTER_NAMES}
         self.cache: Dict[str, Dict[str, int]] = {}
         self.spans: Dict[str, SpanStats] = {}
+        #: degraded-mode stamp (schema 1.6 ``quorum`` block): the owning
+        #: target's :func:`resilience.quarantine.degradation_report`, set on
+        #: quarantine transitions and absent while the full quorum is healthy
+        self.quorum: Optional[Dict[str, Any]] = None
         #: per-bucket measured-vs-model sync cost, keyed ``"dtype/op"`` (ring
         #: buckets) or ``"gather/dtype"`` (passthrough leaves); filled by
         #: :func:`record_measured_sync`
@@ -377,6 +386,7 @@ class MetricTelemetry:
         self.spans = {}
         self.sync_buckets = {}
         self.memory = self._fresh_memory()
+        self.quorum = None
 
     @property
     def active(self) -> bool:
@@ -403,7 +413,7 @@ class MetricTelemetry:
     # -- export -------------------------------------------------------------
     def as_dict(self) -> Dict[str, Any]:
         with _LOCK:
-            return {
+            out = {
                 "label": self.label,
                 "class": self.cls,
                 "counters": dict(self.counters),
@@ -420,6 +430,10 @@ class MetricTelemetry:
                     },
                 },
             }
+            # only while degraded: healthy reports stay byte-identical to 1.5
+            if self.quorum is not None:
+                out["quorum"] = dict(self.quorum)
+            return out
 
     # ``m.telemetry.snapshot()`` reads nicer than ``as_dict`` at call sites
     snapshot = as_dict
@@ -533,6 +547,21 @@ def count(obj: Any, name: str, n: int = 1) -> None:
         t.inc(name, n)
     if _COUNT_SINK is not None:
         _COUNT_SINK(t.label, name, n)
+
+
+def record_quorum(obj: Any, quorum: Optional[Mapping[str, Any]]) -> None:
+    """Stamp (or clear, with ``None``/non-degraded) the schema-1.6 ``quorum``
+    block on ``obj``'s telemetry row.  Called by
+    :mod:`torchmetrics_tpu.resilience.quarantine` on every quarantine
+    transition so degraded reports/exports always name the surviving quorum."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        t = telemetry_for(obj)
+        if quorum is None or not quorum.get("degraded"):
+            t.quorum = None
+        else:
+            t.quorum = dict(quorum)
 
 
 def count_existing(obj: Any, name: str, n: int = 1) -> None:

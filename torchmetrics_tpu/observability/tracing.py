@@ -350,6 +350,11 @@ _INSTANT_COUNTERS = {
     "snapshots": ("snapshot", "resilience"),
     "restores": ("restore", "resilience"),
     "nonfinite_events": ("nonfinite", "guard"),
+    "durable_saves": ("durable_save", "resilience"),
+    "durable_restores": ("durable_restore", "resilience"),
+    "io_retries": ("io_retry", "resilience"),
+    "skipbacks": ("skipback", "resilience"),
+    "quarantines": ("quarantine", "resilience"),
 }
 
 

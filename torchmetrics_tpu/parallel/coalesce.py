@@ -270,8 +270,21 @@ def build_sync_plan(
     )
 
 
+def _mask_identity(dtype: Any, op: str) -> Any:
+    """The reduction identity a quarantined replica contributes to a
+    min/max bucket: +inf/iinfo.max for min, -inf/iinfo.min for max."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, dt)
+    info = jnp.iinfo(dt)
+    return jnp.asarray(info.max if op == "min" else info.min, dt)
+
+
 def apply_sync_plan(
-    plan: SyncPlan, states: Sequence[Mapping[str, Any]], axis_name: str
+    plan: SyncPlan,
+    states: Sequence[Mapping[str, Any]],
+    axis_name: str,
+    weight: Optional[Any] = None,
 ) -> List[State]:
     """Run one coalesced sync (pure; call under shard_map/pmap).
 
@@ -280,11 +293,35 @@ def apply_sync_plan(
     ``jax.lax.psum(1, axis)`` constant-folds, and ``pmean`` itself lowers to
     exactly ``psum(x) / psum(1)``, so the result is bit-identical to the
     per-leaf ``pmean`` it replaces.
+
+    ``weight`` — ``None`` (default) or this replica's traced 0/1 scalar —
+    is the degraded-mode quarantine mask.  ``None`` traces exactly the graph
+    above.  With a weight: sum buckets contribute ``flat * w`` (a zeroed
+    replica adds the sum identity), min/max buckets contribute the
+    reduction identity where ``w == 0``, and MEAN slots divide by
+    ``psum(w)`` (clamped to 1) — the mean over *surviving* replicas.  The
+    mask is a data input, so flipping the quarantine set re-runs the same
+    executable: zero retraces.  Passthrough leaves (cat/custom/structural
+    sketch) have no maskable collective and are rejected.
     """
+    if weight is not None and plan.passthrough:
+        names = sorted({name for _, name, _ in plan.passthrough})
+        raise ValueError(
+            f"masked (quarantined) sync cannot exclude a replica from passthrough "
+            f"leaves {names}: cat/custom/structural-sketch leaves gather raw "
+            "per-replica payloads rather than reducing them. Quarantine supports "
+            "psum-family state only."
+        )
     outs: List[State] = [{} for _ in range(plan.n_entries)]
+    w = None if weight is None else jnp.asarray(weight).reshape(())
     for bucket in plan.buckets:
         parts = [states[s.entry][s.name].reshape((s.size,)) for s in bucket.slots]
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        if w is not None:
+            if bucket.op == "sum":
+                flat = flat * w.astype(flat.dtype)
+            else:
+                flat = jnp.where(w > 0, flat, _mask_identity(bucket.dtype, bucket.op))
         if bucket.compression is not None:
             with jax.named_scope(
                 f"tm_tpu/compress/{bucket.compression.mode}_{bucket.op}_{bucket.dtype}"
@@ -298,7 +335,11 @@ def apply_sync_plan(
             seg = red if len(bucket.slots) == 1 else jax.lax.slice_in_dim(red, offset, offset + s.size)
             seg = seg.reshape(s.shape)
             if s.mean:
-                seg = seg / jax.lax.psum(1, axis_name)
+                if w is None:
+                    seg = seg / jax.lax.psum(1, axis_name)
+                else:
+                    quorum = jax.lax.psum(w.astype(seg.dtype), axis_name)
+                    seg = seg / jnp.maximum(quorum, jnp.asarray(1, seg.dtype))
             outs[s.entry][s.name] = seg
             offset += s.size
     for e, name, reduce in plan.passthrough:
@@ -311,16 +352,18 @@ def coalesced_sync_state(
     reductions: Mapping[str, Union[Reduce, Callable]],
     axis_name: str = "data",
     compression: Optional[CompressionConfig] = None,
+    weight: Optional[Any] = None,
 ) -> State:
     """Bucketed replacement for the per-leaf sync loop (pure, in-graph).
 
     Every key of ``state`` must be in the reduction table or be a reserved
     counter (``_n``/``_nonfinite``, always summed) — the same contract the
     per-leaf ``sync_state`` enforced.  ``compression=None`` (the default)
-    traces the exact planner graph bit-for-bit.
+    traces the exact planner graph bit-for-bit.  ``weight`` is the
+    per-replica quarantine mask (see :func:`apply_sync_plan`).
     """
     plan = build_sync_plan([(reductions, state)], compression=compression)
-    return apply_sync_plan(plan, [state], axis_name)[0]
+    return apply_sync_plan(plan, [state], axis_name, weight=weight)[0]
 
 
 def _metric_entry(metric: Any, state: Mapping[str, Any]) -> Tuple[Mapping[str, Any], State]:
@@ -375,6 +418,7 @@ def coalesced_metric_sync(
     states: Sequence[Mapping[str, Any]],
     axis_name: str,
     compression: Optional[CompressionConfig] = None,
+    weight: Optional[Any] = None,
 ) -> List[State]:
     """Sync several metrics' states with ONE cross-metric bucket plan.
 
@@ -382,13 +426,14 @@ def coalesced_metric_sync(
     leaves + summed ``_n`` + recomputed ``_nonfinite`` for guarded metrics).
     Metrics that *override* ``sync_states`` (streaming moments, wrapper
     fan-out) keep their own aggregation and sync individually — coalescing
-    leaf-wise would be silently wrong for them.
+    leaf-wise would be silently wrong for them.  ``weight`` is the
+    per-replica quarantine mask (see :func:`apply_sync_plan`).
     """
     from torchmetrics_tpu.core.guards import count_nonfinite
 
     plan, standard = plan_for_metrics(metrics, states, compression=compression)
     entries = [_metric_entry(metrics[i], states[i]) for i in standard]
-    synced = apply_sync_plan(plan, [e[1] for e in entries], axis_name)
+    synced = apply_sync_plan(plan, [e[1] for e in entries], axis_name, weight=weight)
     out: List[Optional[State]] = [None] * len(metrics)
     for i, st in zip(standard, synced):
         if metrics[i]._guard_strategy in ("warn", "error"):
@@ -396,7 +441,10 @@ def coalesced_metric_sync(
         out[i] = st
     for i, m in enumerate(metrics):
         if out[i] is None:
-            out[i] = m.sync_states(states[i], axis_name)
+            if weight is None:
+                out[i] = m.sync_states(states[i], axis_name)
+            else:
+                out[i] = m.sync_states(states[i], axis_name, None, weight)
     return out  # type: ignore[return-value]
 
 
@@ -589,15 +637,21 @@ class SyncStepper:
         policy: Optional[SyncPolicy] = None,
         verify_consistency: bool = False,
         in_specs: Optional[Any] = None,
+        on_divergence: str = "raise",
     ) -> None:
         from torchmetrics_tpu.parallel.sync import metric_mesh
 
+        if on_divergence not in ("raise", "quarantine"):
+            raise ValueError(
+                f'on_divergence must be "raise" or "quarantine", got {on_divergence!r}'
+            )
         self.target = target
         self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
         self.axis_name = axis_name
         self.policy = policy if policy is not None else SyncPolicy()
         self.verify_consistency = verify_consistency
         self.in_specs = in_specs
+        self.on_divergence = on_divergence
         self._is_collection = hasattr(target, "_functional_groups")
         if self._is_collection:
             names = tuple(members[0] for members in target._functional_groups().values())
@@ -666,46 +720,87 @@ class SyncStepper:
             return self.sync()
         return None
 
-    def sync(self) -> Any:
-        """Flush the open window (if any) with one coalesced collective and
-        return the cumulative replicated state(s)."""
+    def _dispatch_window(self, comp: Optional[CompressionConfig]) -> Dict[str, State]:
+        """One coalesced collective over the open carry — masked (weighted by
+        the quarantine mask) whenever the target runs degraded."""
         from torchmetrics_tpu.core.compile import compiled_cadence_sync
         from torchmetrics_tpu.observability import registry as _telemetry
+        from torchmetrics_tpu.resilience.quarantine import is_degraded, quarantine_mask
 
+        degraded = is_degraded(self.target)
+        fn = compiled_cadence_sync(
+            self.target,
+            self._members,
+            self.mesh,
+            self.axis_name,
+            compression=comp,
+            masked=degraded,
+        )
+        measuring = _telemetry.enabled()
+        t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
+        with _telemetry.span(self.target, "sync"):
+            if degraded:
+                window = fn(self._local, quarantine_mask(self.target, self.mesh, self.axis_name))
+            else:
+                window = fn(self._local)
+            if measuring:
+                # block so the span/measurement covers the collective
+                # itself, not just its async dispatch
+                jax.block_until_ready(window)
+        n_dev = self._n_devices()
+        for name, m in self._members:
+            _telemetry.record_sync(m, m._reductions, window[name], n_dev, compression=comp)
+        if measuring:
+            measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
+            _telemetry.record_measured_sync(
+                self.target,
+                [(m._reductions, window[name]) for name, m in self._members],
+                n_dev,
+                measured_s,
+                compression=comp,
+            )
+            # same window, process-wide: the fleet plane's straggler
+            # attribution compares this digest across hosts
+            _telemetry.record_sync_wait(measured_s)
+        return window
+
+    def _verify_window(self, window: Dict[str, State]) -> None:
+        from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+
+        for name, m in self._members:
+            verify_replica_consistency(
+                m, mesh=self.mesh, state=window[name], axis_name=self.axis_name
+            )
+
+    def sync(self) -> Any:
+        """Flush the open window (if any) with one coalesced collective and
+        return the cumulative replicated state(s).
+
+        With ``verify_consistency=True`` and ``on_divergence="quarantine"``,
+        a window whose replicas diverged is re-synced through the masked
+        graph with the divergent replicas quarantined — the window's
+        contribution comes from the surviving quorum (the quarantined
+        devices' not-yet-synced carry is excluded, never silently summed).
+        """
         comp = self.policy.compression_config
         if self._local is not None:
-            fn = compiled_cadence_sync(
-                self.target, self._members, self.mesh, self.axis_name, compression=comp
-            )
-            measuring = _telemetry.enabled()
-            t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
-            with _telemetry.span(self.target, "sync"):
-                window = fn(self._local)
-                if measuring:
-                    # block so the span/measurement covers the collective
-                    # itself, not just its async dispatch
-                    jax.block_until_ready(window)
-            n_dev = self._n_devices()
-            for name, m in self._members:
-                _telemetry.record_sync(m, m._reductions, window[name], n_dev, compression=comp)
-            if measuring:
-                measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
-                _telemetry.record_measured_sync(
-                    self.target,
-                    [(m._reductions, window[name]) for name, m in self._members],
-                    n_dev,
-                    measured_s,
-                    compression=comp,
-                )
-                # same window, process-wide: the fleet plane's straggler
-                # attribution compares this digest across hosts
-                _telemetry.record_sync_wait(measured_s)
+            window = self._dispatch_window(comp)
             if self.verify_consistency:
-                from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+                from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
 
-                for name, m in self._members:
-                    verify_replica_consistency(
-                        m, mesh=self.mesh, state=window[name], axis_name=self.axis_name
+                try:
+                    self._verify_window(window)
+                except ReplicaDivergenceError as err:
+                    from torchmetrics_tpu.parallel.sync import _quarantine_and_redispatch
+
+                    window = _quarantine_and_redispatch(
+                        self.target,
+                        err,
+                        self.on_divergence,
+                        self.mesh,
+                        self.axis_name,
+                        lambda: self._dispatch_window(comp),
+                        verify=lambda w: self._verify_window(w),
                     )
             if self._synced is None:
                 self._synced = window
@@ -736,12 +831,19 @@ class SyncStepper:
     # ------------------------------------------------------------- resilience
     def snapshot(self) -> Dict[str, Any]:
         """Host-portable capture of cumulative + deferred-local state —
-        taking it mid-window preserves the not-yet-synced steps."""
+        taking it mid-window preserves the not-yet-synced steps.
+
+        ``n_devices`` records the producing mesh so a restore onto a
+        different mesh fails with a mesh-shape diagnostic (and so
+        ``resilience.elastic.elastic_restore`` can re-bucket the stacked
+        carry) instead of surfacing as a bare leading-dim mismatch.
+        """
         to_np = lambda tree: None if tree is None else jax.tree.map(np.asarray, tree)
         return {
             "version": self._SNAP_VERSION,
             "steps": self._steps,
             "pending": self._pending,
+            "n_devices": self._n_devices(),
             "synced": to_np(self._synced),
             "local": to_np(self._local),
         }
@@ -759,6 +861,7 @@ class SyncStepper:
             )
         n = self._n_devices()
         names = [name for name, _ in self._members]
+        snap_n = snap.get("n_devices")  # absent on pre-elastic (early v1) snapshots
 
         def check_tree(kind: str, tree: Any, stacked: bool) -> None:
             if tree is None:
@@ -775,6 +878,24 @@ class SyncStepper:
                     arr = np.asarray(tree[name][leaf])
                     want = (n, *default.shape) if stacked else tuple(default.shape)
                     if tuple(arr.shape) != want or arr.dtype != np.dtype(default.dtype):
+                        if (
+                            stacked
+                            and arr.dtype == np.dtype(default.dtype)
+                            and tuple(arr.shape[1:]) == tuple(default.shape)
+                            and arr.shape[0] != n
+                        ):
+                            # leading-dim-only mismatch: a carry from a
+                            # different mesh, not corruption
+                            produced = int(arr.shape[0]) if snap_n is None else int(snap_n)
+                            raise StateRestoreError(
+                                f"snapshot {kind}[{name!r}][{leaf!r}] carries per-device state "
+                                f"for a {produced}-device mesh, but this stepper runs on "
+                                f"{n} devices. Use resilience.elastic.elastic_restore to "
+                                "re-bucket the carry across the new mesh.",
+                                leaf=leaf,
+                                reason="mesh-shape",
+                                mesh_shape=(produced,),
+                            )
                         raise StateRestoreError(
                             f"snapshot {kind}[{name!r}][{leaf!r}] has shape {arr.shape}/"
                             f"{arr.dtype}, expected {want}/{np.dtype(default.dtype)}"
@@ -804,6 +925,7 @@ def cadence_stepper(
     policy: SyncPolicy,
     verify_consistency: bool = False,
     in_specs: Optional[Any] = None,
+    on_divergence: str = "raise",
 ) -> SyncStepper:
     """The implicit per-object :class:`SyncStepper` behind
     ``sharded_update(..., sync_policy=...)``.
@@ -820,6 +942,7 @@ def cadence_stepper(
             or stepper.axis_name != axis_name
             or stepper.policy != policy
             or stepper.verify_consistency != verify_consistency
+            or stepper.on_divergence != on_divergence
         ):
             raise ValueError(
                 "sync_policy cadence arguments changed mid-accumulation "
@@ -834,6 +957,7 @@ def cadence_stepper(
         policy=policy,
         verify_consistency=verify_consistency,
         in_specs=in_specs,
+        on_divergence=on_divergence,
     )
     target.__dict__["_cadence_stepper"] = stepper
     return stepper

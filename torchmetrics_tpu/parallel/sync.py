@@ -237,6 +237,7 @@ def sharded_update(
     in_specs: Optional[Any] = None,
     verify_consistency: bool = False,
     sync_policy: Optional[SyncPolicy] = None,
+    on_divergence: str = "raise",
     **kwargs: Array,
 ) -> State:
     """Run one metric ``update`` with inputs sharded over the mesh batch axis.
@@ -255,6 +256,15 @@ def sharded_update(
     :class:`~torchmetrics_tpu.utilities.exceptions.ReplicaDivergenceError`
     at sync time instead of producing a silently wrong aggregate.
 
+    ``on_divergence`` picks the failure policy when that check trips:
+    ``"raise"`` (default) is fail-stop; ``"quarantine"`` excludes the
+    divergent replicas from this and every subsequent sync (masked out of
+    the collective via an in-graph weight —
+    :mod:`torchmetrics_tpu.resilience.quarantine`), re-dispatches the same
+    inputs through the masked graph, and returns the surviving quorum's
+    answer — degraded, alerted, never silently wrong.  A metric already
+    running degraded keeps using the masked graph even on clean steps.
+
     With a deferring ``sync_policy`` (``SyncPolicy(every_n_steps=k)`` or
     ``at_compute=True``), repeated calls accumulate *locally* on each device
     and the coalesced collective runs only on sync steps: the call returns
@@ -262,6 +272,10 @@ def sharded_update(
     deferred ones; finish with
     :func:`~torchmetrics_tpu.parallel.coalesce.flush_sync`.
     """
+    if on_divergence not in ("raise", "quarantine"):
+        raise ValueError(
+            f'on_divergence must be "raise" or "quarantine", got {on_divergence!r}'
+        )
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     if in_specs is None:
         in_specs = P(axis_name)
@@ -290,9 +304,18 @@ def sharded_update(
             policy=sync_policy,
             verify_consistency=verify_consistency,
             in_specs=specs,
+            on_divergence=on_divergence,
         )
         return stepper.update(*inputs)
 
+    from torchmetrics_tpu.resilience.quarantine import is_degraded
+
+    if (on_divergence == "quarantine" or is_degraded(metric)) and kwargs:
+        raise ValueError(
+            "sharded_update(on_divergence='quarantine') needs positional inputs: the "
+            "masked (degraded-mode) step is a cached compiled variant, and kwargs "
+            "would be frozen as trace constants"
+        )
     # check_vma=False (inside compiled_sharded_update): all_gather-produced
     # leaves are replicated in value but the static VMA checker cannot infer
     # that, so replication is asserted, not checked.
@@ -339,15 +362,93 @@ def sharded_update(
     # ~1 s compile)
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
-    fn = compiled_sharded_update(metric, mesh, axis_name, specs, inputs, compression=compression)
-    out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
-    _telemetry.record_sync(
-        metric, metric._reductions, out, int(mesh.devices.size), compression=compression
-    )
+    def dispatch() -> State:
+        if is_degraded(metric):
+            from torchmetrics_tpu.resilience.quarantine import quarantine_mask
+
+            fn = compiled_sharded_update(
+                metric, mesh, axis_name, specs, inputs, compression=compression, masked=True
+            )
+            mask = quarantine_mask(metric, mesh, axis_name)
+            out = _measured_sync_dispatch(
+                metric, fn, (mask,) + inputs, mesh, compression=compression
+            )
+        else:
+            fn = compiled_sharded_update(
+                metric, mesh, axis_name, specs, inputs, compression=compression
+            )
+            out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
+        _telemetry.record_sync(
+            metric, metric._reductions, out, int(mesh.devices.size), compression=compression
+        )
+        return out
+
+    out = dispatch()
     if verify_consistency:
         from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+        from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
 
-        verify_replica_consistency(metric, mesh=mesh, state=out, axis_name=axis_name)
+        try:
+            verify_replica_consistency(metric, mesh=mesh, state=out, axis_name=axis_name)
+        except ReplicaDivergenceError as err:
+            out = _quarantine_and_redispatch(
+                metric, err, on_divergence, mesh, axis_name, dispatch
+            )
+    return out
+
+
+def _quarantine_and_redispatch(
+    target: Any,
+    err: Exception,
+    on_divergence: str,
+    mesh: Mesh,
+    axis_name: str,
+    dispatch: Callable[[], Any],
+    verify: Optional[Callable[[Any], None]] = None,
+) -> Any:
+    """The shared ``on_divergence="quarantine"`` handler.
+
+    Quarantines the replicas the divergence error names, re-runs the same
+    inputs through the masked graph, and re-verifies the surviving quorum's
+    answer.  Re-raises (never a silent wrong answer) when the policy is
+    ``"raise"``, when the divergent replicas cannot be identified, when no
+    quorum would survive, or when the masked re-dispatch still diverges.
+    """
+    from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+    from torchmetrics_tpu.resilience.quarantine import quarantine, quarantined_replicas
+    from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+    if on_divergence != "quarantine":
+        raise err
+    replicas = getattr(err, "replicas", None)
+    if not replicas:
+        raise ReplicaDivergenceError(
+            f"{err} (on_divergence='quarantine' needs the divergent replica indices to "
+            "mask them out, but the check could not identify them)",
+            leaves=getattr(err, "leaves", ()),
+        ) from err
+    quarantine(target, replicas, reason="divergence")
+    n = int(mesh.devices.size)
+    survivors = n - len(quarantined_replicas(target))
+    if survivors < 1:
+        raise ReplicaDivergenceError(
+            f"{err} (quarantining replicas {sorted(replicas)} would leave no surviving "
+            f"quorum on the {n}-device mesh)",
+            leaves=getattr(err, "leaves", ()),
+            replicas=replicas,
+        ) from err
+    rank_zero_warn(
+        f"{type(target).__name__}: replicas {sorted(int(r) for r in replicas)} diverged "
+        f"({sorted(getattr(err, 'leaves', ()))}); quarantined — evaluation continues on "
+        f"the surviving {survivors}/{n} replicas."
+    )
+    out = dispatch()
+    # the degraded answer must itself be consistent; a second divergence is
+    # fail-stop regardless of policy
+    if verify is not None:
+        verify(out)
+    else:
+        verify_replica_consistency(target, mesh=mesh, state=out, axis_name=axis_name)
     return out
 
 
@@ -358,6 +459,8 @@ def sharded_collection_update(
     axis_name: str = "data",
     in_specs: Optional[Any] = None,
     sync_policy: Optional[SyncPolicy] = None,
+    verify_consistency: bool = False,
+    on_divergence: str = "raise",
 ) -> Dict[str, State]:
     """One fused compiled step for a whole :class:`MetricCollection`.
 
@@ -376,11 +479,20 @@ def sharded_collection_update(
     return the cumulative states; finish with
     :func:`~torchmetrics_tpu.parallel.coalesce.flush_sync`.
 
+    ``verify_consistency`` / ``on_divergence`` mirror :func:`sharded_update`:
+    the returned replicated states are checksum-compared per leader, and
+    ``on_divergence="quarantine"`` masks divergent replicas out of every
+    member's sync instead of failing the run.
+
     Leaders with list (cat) states cannot ride the in-graph step path — use
     :class:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync` for those.
     """
     from torchmetrics_tpu.core.compile import compiled_sharded_collection_update
 
+    if on_divergence not in ("raise", "quarantine"):
+        raise ValueError(
+            f'on_divergence must be "raise" or "quarantine", got {on_divergence!r}'
+        )
     mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
     if in_specs is None:
         in_specs = P(axis_name)
@@ -407,28 +519,64 @@ def sharded_collection_update(
             mesh=mesh,
             axis_name=axis_name,
             policy=sync_policy,
+            verify_consistency=verify_consistency,
             in_specs=specs,
+            on_divergence=on_divergence,
         )
         return stepper.update(*inputs)
-    fn = compiled_sharded_collection_update(
-        collection, leaders, mesh, axis_name, specs, inputs, compression=compression
-    )
-    out = _measured_sync_dispatch(
-        collection,
-        fn,
-        inputs,
-        mesh,
-        entries_of=lambda o: [(collection[name]._reductions, o[name]) for name in leaders],
-        compression=compression,
-    )
-    if _telemetry.enabled():
-        n_dev = int(mesh.devices.size)
-        for name in leaders:
-            _telemetry.record_sync(
-                collection[name],
-                collection[name]._reductions,
-                out[name],
-                n_dev,
-                compression=compression,
+
+    from torchmetrics_tpu.resilience.quarantine import is_degraded
+
+    def dispatch() -> Dict[str, State]:
+        if is_degraded(collection):
+            from torchmetrics_tpu.resilience.quarantine import quarantine_mask
+
+            fn = compiled_sharded_collection_update(
+                collection, leaders, mesh, axis_name, specs, inputs,
+                compression=compression, masked=True,
+            )
+            mask = quarantine_mask(collection, mesh, axis_name)
+            call_inputs: Tuple[Any, ...] = (mask,) + inputs
+        else:
+            fn = compiled_sharded_collection_update(
+                collection, leaders, mesh, axis_name, specs, inputs, compression=compression
+            )
+            call_inputs = inputs
+        out = _measured_sync_dispatch(
+            collection,
+            fn,
+            call_inputs,
+            mesh,
+            entries_of=lambda o: [(collection[name]._reductions, o[name]) for name in leaders],
+            compression=compression,
+        )
+        if _telemetry.enabled():
+            n_dev = int(mesh.devices.size)
+            for name in leaders:
+                _telemetry.record_sync(
+                    collection[name],
+                    collection[name]._reductions,
+                    out[name],
+                    n_dev,
+                    compression=compression,
+                )
+        return out
+
+    out = dispatch()
+    if verify_consistency:
+        from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
+        from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
+
+        def verify(states: Dict[str, State]) -> None:
+            for name in leaders:
+                verify_replica_consistency(
+                    collection[name], mesh=mesh, state=states[name], axis_name=axis_name
+                )
+
+        try:
+            verify(out)
+        except ReplicaDivergenceError as err:
+            out = _quarantine_and_redispatch(
+                collection, err, on_divergence, mesh, axis_name, dispatch, verify=verify
             )
     return out

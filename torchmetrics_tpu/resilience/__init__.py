@@ -1,18 +1,31 @@
-"""Resilience layer: preemption-safe snapshots, validated restore, and
-cross-replica divergence detection.
+"""Resilience layer: preemption-safe snapshots, durable elastic
+checkpointing, degraded-mode (quarantine) evaluation, and cross-replica
+divergence detection.
 
-The three failure modes that kill long metric runs on preemptible pods —
-preemption mid-epoch, silently corrupted restores, and replica state drift —
-each get a first-class tool here:
+The failure modes that kill long metric runs on preemptible pods each get a
+first-class tool here:
 
 * :func:`snapshot` / :func:`restore` — versioned, self-describing host-numpy
   checkpoints, validated leaf-by-leaf *before* any state is installed
-  (``StateRestoreError`` names the offending leaf).
+  (``StateRestoreError`` names the offending leaf, schema version, producing
+  mesh and — for durable restores — generation id).
+* :class:`DurableSnapshotStore` — generational on-disk persistence with
+  write-ahead manifests, per-leaf checksums, atomic commit renames, retrying
+  I/O under a :class:`RetryPolicy`, skip-back past corrupt generations, and
+  double-buffered async saves off the step path.
+* :func:`elastic_restore` — resume a snapshot taken on an N-device mesh onto
+  M devices; mid-window per-device carries are re-bucketed exactly via the
+  metric's own ``merge_states`` (no sample lost, none double-counted).
+* :func:`quarantine` + ``on_divergence="quarantine"`` — degraded-mode
+  evaluation: divergent replicas are masked out of subsequent syncs by an
+  in-graph weight, a ``QuarantineRule`` health alert fires, and ``compute``
+  reports the surviving quorum instead of crashing the fleet.
 * :func:`verify_replica_consistency` — cheap per-leaf checksums compared
   with one ``pmin``/``pmax`` collective over the mesh axis
   (``ReplicaDivergenceError`` names the divergent leaves and replicas).
 * :mod:`torchmetrics_tpu.resilience.faults` — deterministic fault injection
-  (kill/restore, snapshot corruption, single-replica perturbation) for tests.
+  (kill/restore, snapshot corruption, torn writes, ENOSPC, crash-before-
+  commit, transient flakes, host loss mid-gather) for tests and drills.
 
 The jit-fused non-finite guards (``Metric(nan_strategy=...)``) live in
 ``core/guards.py`` so the core can apply them without importing this package.
@@ -22,11 +35,32 @@ from torchmetrics_tpu.resilience.divergence import (
     replica_digest_table,
     verify_replica_consistency,
 )
+from torchmetrics_tpu.resilience.durable import (
+    DurableSnapshotStore,
+    LocalFSBackend,
+    PendingSave,
+    RetryPolicy,
+    StorageBackend,
+)
+from torchmetrics_tpu.resilience.elastic import elastic_restore, restack_carry
 from torchmetrics_tpu.resilience.faults import (
     CORRUPTION_MODES,
+    FaultyBackend,
+    IO_FAULT_MODES,
+    SimulatedCrash,
     corrupt_snapshot,
+    lossy_allgather,
     perturb_replica,
     run_with_preemption,
+)
+from torchmetrics_tpu.resilience.quarantine import (
+    attach_monitor,
+    clear_quarantine,
+    degradation_report,
+    is_degraded,
+    quarantine,
+    quarantine_mask,
+    quarantined_replicas,
 )
 from torchmetrics_tpu.resilience.snapshot import (
     SCHEMA_VERSION,
@@ -35,27 +69,49 @@ from torchmetrics_tpu.resilience.snapshot import (
     snapshot,
     validate_state_leaf,
     validate_state_pytree,
+    with_snapshot_context,
 )
 from torchmetrics_tpu.utilities.exceptions import (
     NonFiniteStateError,
     ReplicaDivergenceError,
     StateRestoreError,
+    TransientIOError,
 )
 
 __all__ = [
     "CORRUPTION_MODES",
+    "DurableSnapshotStore",
+    "FaultyBackend",
+    "IO_FAULT_MODES",
+    "LocalFSBackend",
     "NonFiniteStateError",
+    "PendingSave",
     "ReplicaDivergenceError",
+    "RetryPolicy",
     "SCHEMA_VERSION",
+    "SimulatedCrash",
     "StateRestoreError",
+    "StorageBackend",
+    "TransientIOError",
+    "attach_monitor",
     "class_fingerprint",
+    "clear_quarantine",
     "corrupt_snapshot",
+    "degradation_report",
+    "elastic_restore",
+    "is_degraded",
+    "lossy_allgather",
     "perturb_replica",
+    "quarantine",
+    "quarantine_mask",
+    "quarantined_replicas",
     "replica_digest_table",
+    "restack_carry",
     "restore",
     "run_with_preemption",
     "snapshot",
     "validate_state_leaf",
     "validate_state_pytree",
     "verify_replica_consistency",
+    "with_snapshot_context",
 ]

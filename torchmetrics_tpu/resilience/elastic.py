@@ -1,0 +1,156 @@
+"""Elastic restore: resume a snapshot taken on an N-device mesh onto M devices.
+
+Preempted jobs rarely come back on the hardware they lost — a pod slice
+shrinks, a reservation grows, a host is swapped out.  *Replicated* metric
+state is mesh-agnostic (every device holds the same aggregate, so a plain
+:func:`~torchmetrics_tpu.resilience.snapshot.restore` broadcasts it onto any
+mesh), but **per-device carries are not**: a mid-window
+:class:`~torchmetrics_tpu.parallel.coalesce.SyncStepper` holds a
+leading-axis-stacked ``(n_devices, *shape)`` state per device, and naively
+installing an 8-row carry onto a 4-device mesh either crashes or — worse —
+drops half the deferred samples.
+
+The re-bucketing here is exact, built on the metric's own ``merge_states``:
+
+* **Shrink (N → M, N > M):** old device ``i``'s not-yet-synced state folds
+  into new device ``i % M`` — every group of rows is merged pairwise with
+  the same reduction table the eventual collective would have used, so no
+  sample is lost and none is double-counted.
+* **Grow (N → M, M > N):** the old rows land on the first ``N`` (mod-M)
+  devices and the remainder are padded with ``init_state()`` — the
+  reduction identity, invisible to the eventual sync.
+
+``elastic_restore`` is validate-before-install end to end: the restacked
+carry goes through :meth:`SyncStepper.restore`'s full shape/dtype checks
+before anything is touched, and failures carry the producing mesh shape in
+their :class:`~torchmetrics_tpu.utilities.exceptions.StateRestoreError`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+
+__all__ = ["elastic_restore", "restack_carry"]
+
+
+def restack_carry(metric: Any, stacked: Mapping[str, Any], new_n: int) -> Dict[str, np.ndarray]:
+    """Re-bucket one member's ``(old_n, *shape)`` stacked carry onto ``new_n``
+    devices, exactly.
+
+    Old device ``i``'s per-device state merges into new slot ``i % new_n``
+    via ``metric.merge_states`` (so sums add, mins min, counters count);
+    slots that receive no old device are padded with ``metric.init_state()``
+    — the reduction identity.  Returns a host-numpy stacked carry with
+    leading dim ``new_n``.
+    """
+    if new_n < 1:
+        raise ValueError(f"new_n must be >= 1, got {new_n}")
+    leaves = {name: np.asarray(v) for name, v in stacked.items()}
+    if not leaves:
+        raise StateRestoreError("cannot restack an empty carry", reason="structure")
+    old_n = next(iter(leaves.values())).shape[0] if next(iter(leaves.values())).ndim else 0
+    for name, arr in leaves.items():
+        if arr.ndim < 1 or arr.shape[0] != old_n:
+            raise StateRestoreError(
+                f"carry leaf {name!r} has leading dim "
+                f"{arr.shape[0] if arr.ndim else 'none'}, expected {old_n}: the stacked "
+                "carry's per-device axis is inconsistent (corrupted snapshot).",
+                leaf=name,
+                reason="corrupt",
+            )
+    per_device = [
+        {name: jnp.asarray(arr[i]) for name, arr in leaves.items()} for i in range(old_n)
+    ]
+    groups: List[List[Dict[str, Any]]] = [[] for _ in range(new_n)]
+    for i, state in enumerate(per_device):
+        groups[i % new_n].append(state)
+    merged: List[Mapping[str, Any]] = []
+    for group in groups:
+        if not group:
+            merged.append(metric.init_state())
+            continue
+        acc = group[0]
+        for state in group[1:]:
+            acc = metric.merge_states(acc, state)
+        merged.append(acc)
+    out: Dict[str, np.ndarray] = {}
+    for name in leaves:
+        out[name] = np.stack([np.asarray(state[name]) for state in merged])
+    return out
+
+
+def _restack_stepper_snapshot(stepper: Any, snap: Mapping[str, Any]) -> Dict[str, Any]:
+    """A copy of a stepper snapshot with its ``local`` carry re-bucketed for
+    this stepper's mesh (no-op when the device counts already agree)."""
+    n = stepper._n_devices()
+    local = snap.get("local")
+    if local is None:
+        out = dict(snap)
+        out["n_devices"] = n
+        return out
+    if not isinstance(local, Mapping):
+        raise StateRestoreError(
+            f"stepper snapshot 'local' must be a mapping, got {type(local).__name__}.",
+            reason="structure",
+        )
+    snap_n = snap.get("n_devices")
+    if snap_n is None:
+        # pre-elastic snapshot: infer the producing mesh from the carry itself
+        for member_state in local.values():
+            for leaf in member_state.values():
+                snap_n = int(np.asarray(leaf).shape[0])
+                break
+            break
+    produced = int(snap_n) if snap_n is not None else n
+    if produced == n:
+        out = dict(snap)
+        out["n_devices"] = n
+        return out
+    new_local: Dict[str, Any] = {}
+    for name, m in stepper._members:
+        if name not in local:
+            raise StateRestoreError(
+                f"stepper snapshot 'local' is missing member {name!r}.",
+                leaf=name,
+                reason="missing-leaf",
+                mesh_shape=(produced,),
+            )
+        new_local[name] = restack_carry(m, local[name], n)
+    out = dict(snap)
+    out["local"] = new_local
+    out["n_devices"] = n
+    return out
+
+
+def elastic_restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True) -> None:
+    """Restore ``snap`` into ``obj``, adapting per-device carries to the
+    current mesh size.
+
+    * For a :class:`~torchmetrics_tpu.parallel.coalesce.SyncStepper`, the
+      mid-window stacked carry is re-bucketed via :func:`restack_carry` when
+      the snapshot's producing mesh differs from the stepper's, then
+      installed through the stepper's own validate-before-install
+      :meth:`~torchmetrics_tpu.parallel.coalesce.SyncStepper.restore`.
+    * For a ``Metric``/``MetricCollection``, replicated state is
+      mesh-agnostic — this delegates to
+      :func:`torchmetrics_tpu.resilience.restore` unchanged, regardless of
+      the mesh recorded in the snapshot header.
+    """
+    from torchmetrics_tpu.parallel.coalesce import SyncStepper
+
+    if isinstance(obj, SyncStepper):
+        if not isinstance(snap, Mapping):
+            raise StateRestoreError(
+                f"stepper snapshot must be a mapping, got {type(snap).__name__}.",
+                reason="structure",
+            )
+        obj.restore(_restack_stepper_snapshot(obj, snap))
+        return
+    from torchmetrics_tpu.resilience.snapshot import restore
+
+    restore(obj, snap, strict_class=strict_class)

@@ -17,10 +17,23 @@ injects the same fault):
 * **Replica perturbation** — :func:`perturb_replica` flips exactly one leaf
   of exactly one replica's state.  The contract:
   ``verify_replica_consistency`` names that leaf and that replica.
+* **Durable-I/O faults** — :class:`FaultyBackend` is a
+  :class:`~torchmetrics_tpu.resilience.durable.LocalFSBackend` that injects
+  exactly one named storage failure (torn payload write, partial manifest,
+  ENOSPC, crash between manifest and commit rename, transient flake), armed
+  a fixed number of times.  The contract: the
+  :class:`~torchmetrics_tpu.resilience.durable.DurableSnapshotStore` either
+  retries to success, skips back to the newest valid generation, or raises
+  a classified error — never a silently wrong restore.
+* **Host loss mid-gather** — :func:`lossy_allgather` builds an injectable
+  ``allgather`` that dies on its N-th collective, the observable shape of a
+  host dropping out between the fleet plane's length and payload gathers.
 """
 
 from __future__ import annotations
 
+import errno
+import os
 import pickle
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
@@ -29,9 +42,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.core.guards import RESERVED_STATE_KEYS
+from torchmetrics_tpu.resilience.durable import LocalFSBackend, MANIFEST_NAME, PAYLOAD_NAME
 from torchmetrics_tpu.resilience.snapshot import restore, snapshot
+from torchmetrics_tpu.utilities.exceptions import TransientIOError
 
-__all__ = ["CORRUPTION_MODES", "corrupt_snapshot", "perturb_replica", "run_with_preemption"]
+__all__ = [
+    "CORRUPTION_MODES",
+    "FaultyBackend",
+    "IO_FAULT_MODES",
+    "SimulatedCrash",
+    "corrupt_snapshot",
+    "lossy_allgather",
+    "perturb_replica",
+    "run_with_preemption",
+]
 
 CORRUPTION_MODES = (
     "truncate",
@@ -201,3 +225,126 @@ def perturb_replica(
         else:
             st[name] = arr + jnp.asarray(delta, arr.dtype)
     return states
+
+
+# ------------------------------------------------------------ durable-I/O faults
+IO_FAULT_MODES = (
+    "torn_write",
+    "partial_manifest",
+    "enospc",
+    "crash_before_rename",
+    "transient",
+)
+
+
+class SimulatedCrash(RuntimeError):
+    """The process-death boundary for durability drills.
+
+    Raised by :class:`FaultyBackend` in ``crash_before_rename`` mode at the
+    exact instant a real crash would strand a staging directory: after the
+    write-ahead manifest and payload are durable but before the atomic
+    commit rename.  Tests catch it where a supervisor would restart the
+    process.
+    """
+
+
+class FaultyBackend(LocalFSBackend):
+    """A local-filesystem backend that injects one named durability fault.
+
+    Deterministic and bounded: the fault fires on the first ``times``
+    matching operations (no RNG, no wall clock) and the backend behaves
+    perfectly afterwards — so every drill pins down exactly which write or
+    read was damaged, and retry loops provably converge.
+
+    Modes:
+        * ``"torn_write"`` — the payload file is silently truncated to half
+          its bytes; the commit still completes, producing a committed
+          generation whose payload no longer matches its write-ahead crc
+          (what post-commit media corruption or a torn sector looks like).
+        * ``"partial_manifest"`` — the manifest lands garbled (truncated
+          JSON), the committed generation is unreadable by design.
+        * ``"enospc"`` — writes raise ``OSError(ENOSPC)``: a *permanent*
+          failure the retry policy must surface immediately, not back off on.
+        * ``"crash_before_rename"`` — the commit rename raises
+          :class:`SimulatedCrash`, stranding the staging directory exactly
+          like a process killed between write-ahead and commit.
+        * ``"transient"`` — reads and writes raise
+          :class:`~torchmetrics_tpu.utilities.exceptions.TransientIOError`
+          the first ``times`` calls (an NFS flake); retries succeed.
+    """
+
+    def __init__(self, mode: str, times: int = 1) -> None:
+        if mode not in IO_FAULT_MODES:
+            raise ValueError(f"mode must be one of {IO_FAULT_MODES}, got {mode!r}")
+        if times < 1:
+            raise ValueError(f"times must be >= 1, got {times}")
+        self.mode = mode
+        self.remaining = int(times)
+        self.injected = 0
+
+    def _arm(self) -> bool:
+        if self.remaining <= 0:
+            return False
+        self.remaining -= 1
+        self.injected += 1
+        return True
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        name = os.path.basename(path)
+        if self.mode == "torn_write" and name == PAYLOAD_NAME and self._arm():
+            super().write_bytes(path, data[: len(data) // 2])
+            return
+        if self.mode == "partial_manifest" and name == MANIFEST_NAME and self._arm():
+            super().write_bytes(path, data[: max(1, len(data) // 3)])
+            return
+        if self.mode == "enospc" and self._arm():
+            raise OSError(errno.ENOSPC, "No space left on device", path)
+        if self.mode == "transient" and self._arm():
+            raise TransientIOError(f"injected transient flake writing {name}")
+        super().write_bytes(path, data)
+
+    def read_bytes(self, path: str) -> bytes:
+        if self.mode == "transient" and self._arm():
+            raise TransientIOError(
+                f"injected transient flake reading {os.path.basename(path)}"
+            )
+        return super().read_bytes(path)
+
+    def commit_rename(self, src: str, dst: str) -> None:
+        if self.mode == "crash_before_rename" and self._arm():
+            raise SimulatedCrash(
+                f"simulated process death before committing {os.path.basename(dst)} "
+                "(write-ahead manifest and payload are durable in staging)"
+            )
+        super().commit_rename(src, dst)
+
+
+# ------------------------------------------------------------ host-loss faults
+def lossy_allgather(n_processes: int, fail_on_call: int = 2) -> Callable[[Any], Any]:
+    """An injectable ``allgather`` that loses a host mid-gather.
+
+    Calls before ``fail_on_call`` succeed by replicating the local payload
+    ``n_processes`` times (every healthy host contributed); the
+    ``fail_on_call``-th collective raises
+    :class:`~torchmetrics_tpu.utilities.exceptions.TransientIOError` — the
+    observable shape of a host dying between
+    :func:`~torchmetrics_tpu.observability.fleet.gather_reports`'s length
+    and payload gathers.  Deterministic: the failure always lands on the
+    same collective.
+    """
+    if n_processes < 1:
+        raise ValueError(f"n_processes must be >= 1, got {n_processes}")
+    if fail_on_call < 1:
+        raise ValueError(f"fail_on_call must be >= 1, got {fail_on_call}")
+    calls = {"n": 0}
+
+    def gather(x: Any) -> np.ndarray:
+        calls["n"] += 1
+        if calls["n"] >= fail_on_call:
+            raise TransientIOError(
+                f"injected host loss: a process stopped responding during collective "
+                f"#{calls['n']}"
+            )
+        return np.stack([np.asarray(x)] * n_processes)
+
+    return gather

@@ -1,0 +1,227 @@
+"""Degraded-mode evaluation: quarantine divergent replicas instead of
+crashing the fleet.
+
+PR 2's divergence detection is fail-stop: ``verify_replica_consistency``
+raises :class:`ReplicaDivergenceError` and the evaluation dies.  At pod
+scale that is the wrong default for long evaluations — one flaky host
+should cost *its* samples, not the run.  This module is the middle path:
+
+* :func:`quarantine` marks replicas as excluded.  The exclusion is an
+  **in-graph weight**: the sync path multiplies each replica's
+  contribution by its 0/1 mask scalar (sum buckets), substitutes the
+  reduction identity (min/max buckets), and divides MEAN slots by the
+  surviving quorum — see ``parallel.coalesce.apply_sync_plan``.  The mask
+  is a *data* input sharded over the mesh axis, so flipping the
+  quarantine set re-runs the same executable: zero retraces, zero new
+  compile-cache entries beyond the one-time masked variant.
+* ``sharded_update(..., on_divergence="quarantine")`` (``parallel/sync.py``)
+  catches the divergence error, quarantines the replicas it names, and
+  re-dispatches the same inputs through the masked graph — the step's
+  answer comes from the surviving quorum, never silently from a poisoned
+  sum.
+* :func:`attach_monitor` wires a :class:`~torchmetrics_tpu.observability.
+  health.HealthMonitor` so every quarantine transition fires a
+  :class:`~torchmetrics_tpu.observability.health.QuarantineRule` alert,
+  and :func:`degradation_report` stamps the surviving quorum into
+  telemetry/export payloads (schema 1.6's ``quorum`` block).
+
+Quarantine state lives on the target's ``__dict__`` (underscore-private,
+like the cadence stepper), so it never perturbs config fingerprints and is
+dropped on pickling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from torchmetrics_tpu.observability import registry as _telemetry
+
+__all__ = [
+    "QuarantineState",
+    "attach_monitor",
+    "clear_quarantine",
+    "degradation_report",
+    "is_degraded",
+    "quarantine",
+    "quarantine_mask",
+    "quarantined_replicas",
+]
+
+_ATTR = "_quarantine"
+_SERIES_PREFIX = "quarantine/"
+
+
+class QuarantineState:
+    """Per-target record of excluded replicas (+ the cached device mask).
+
+    Not constructed directly — :func:`quarantine` and friends manage one
+    instance per metric/collection on ``target.__dict__["_quarantine"]``.
+    """
+
+    __slots__ = ("replicas", "reasons", "monitor", "series", "_mask_key", "_mask")
+
+    def __init__(self) -> None:
+        self.replicas: set = set()
+        self.reasons: Dict[int, str] = {}
+        self.monitor: Optional[Any] = None
+        self.series: Optional[str] = None
+        self._mask_key: Optional[Tuple[Any, ...]] = None
+        self._mask: Optional[Any] = None
+
+    def invalidate(self) -> None:
+        self._mask_key = None
+        self._mask = None
+
+
+def _qstate(target: Any, create: bool = True) -> Optional[QuarantineState]:
+    qs = target.__dict__.get(_ATTR)
+    if qs is None and create:
+        qs = QuarantineState()
+        target.__dict__[_ATTR] = qs
+    return qs
+
+
+def _series_for(target: Any) -> str:
+    return f"{_SERIES_PREFIX}{type(target).__name__}"
+
+
+def attach_monitor(
+    target: Any,
+    monitor: Any,
+    series: Optional[str] = None,
+    rule: Optional[Any] = None,
+) -> str:
+    """Wire a :class:`HealthMonitor` to this target's quarantine events.
+
+    Registers ``series`` (default ``"quarantine/<ClassName>"``) with a
+    :class:`~torchmetrics_tpu.observability.health.QuarantineRule` (or the
+    passed ``rule``) and observes the quarantined-replica count on every
+    :func:`quarantine` / :func:`clear_quarantine` transition, so the alert
+    fires from the same deterministic step-indexed plane as every other
+    health rule.  Returns the series name.
+    """
+    from torchmetrics_tpu.observability.health import QuarantineRule
+
+    qs = _qstate(target)
+    name = series if series is not None else _series_for(target)
+    monitor.watch(name, rule if rule is not None else QuarantineRule())
+    qs.monitor = monitor
+    qs.series = name
+    return name
+
+
+def _observe(target: Any, qs: QuarantineState, step: Optional[int]) -> None:
+    if qs.monitor is not None:
+        qs.monitor.observe(
+            qs.series or _series_for(target),
+            float(len(qs.replicas)),
+            step=0 if step is None else int(step),
+        )
+
+
+def quarantine(
+    target: Any,
+    replicas: Iterable[int],
+    *,
+    reason: str = "divergence",
+    step: Optional[int] = None,
+) -> Tuple[int, ...]:
+    """Exclude ``replicas`` from this target's subsequent syncs.
+
+    Idempotent per replica.  Each *newly* quarantined replica bumps the
+    ``quarantines`` telemetry counter (flight recorder: a ``quarantine``
+    instant in the ``resilience`` category) and, when a monitor is
+    attached, re-observes the quarantine series so the
+    :class:`QuarantineRule` alert fires.  Returns the full quarantined set,
+    sorted.
+    """
+    qs = _qstate(target)
+    new = [int(r) for r in replicas if int(r) not in qs.replicas]
+    for r in new:
+        qs.replicas.add(r)
+        qs.reasons[r] = str(reason)
+        _telemetry.count(target, "quarantines")
+    if new:
+        qs.invalidate()
+        _observe(target, qs, step)
+        _telemetry.record_quorum(target, degradation_report(target))
+    return tuple(sorted(qs.replicas))
+
+
+def clear_quarantine(target: Any, replicas: Optional[Iterable[int]] = None) -> Tuple[int, ...]:
+    """Re-admit ``replicas`` (default: all) into the sync quorum."""
+    qs = _qstate(target, create=False)
+    if qs is None:
+        return ()
+    if replicas is None:
+        cleared = bool(qs.replicas)
+        qs.replicas.clear()
+        qs.reasons.clear()
+    else:
+        wanted = {int(r) for r in replicas}
+        cleared = bool(wanted & qs.replicas)
+        qs.replicas -= wanted
+        for r in wanted:
+            qs.reasons.pop(r, None)
+    if cleared:
+        qs.invalidate()
+        _observe(target, qs, None)
+        _telemetry.record_quorum(target, degradation_report(target))
+    return tuple(sorted(qs.replicas))
+
+
+def quarantined_replicas(target: Any) -> Tuple[int, ...]:
+    """The replicas currently excluded from this target's syncs, sorted."""
+    qs = _qstate(target, create=False)
+    return () if qs is None else tuple(sorted(qs.replicas))
+
+
+def is_degraded(target: Any) -> bool:
+    """True when at least one replica is quarantined."""
+    return bool(quarantined_replicas(target))
+
+
+def quarantine_mask(target: Any, mesh: Any, axis_name: str = "data") -> Any:
+    """The in-graph exclusion weight: a ``(n_devices,)`` float32 0/1 array
+    sharded over ``axis_name`` — each device reads its own scalar inside
+    the masked compiled step.
+
+    A plain data input, deliberately: the mask's *values* never enter a
+    trace, so changing which replicas are quarantined re-runs the same
+    executable.  Cached per (mesh, quarantine set); rebuilding costs one
+    tiny host-to-device transfer on transitions only.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    qs = _qstate(target)
+    n = int(mesh.devices.size)
+    key = (id(mesh), axis_name, n, tuple(sorted(qs.replicas)))
+    if qs._mask_key == key and qs._mask is not None:
+        return qs._mask
+    host = np.ones((n,), np.float32)
+    for r in qs.replicas:
+        if 0 <= r < n:
+            host[r] = 0.0
+    sharding = NamedSharding(mesh, PartitionSpec(axis_name))
+    qs._mask = jax.device_put(host, sharding)
+    qs._mask_key = key
+    return qs._mask
+
+
+def degradation_report(target: Any, n_devices: Optional[int] = None) -> Dict[str, Any]:
+    """The ``quorum`` block stamped into telemetry/export payloads while a
+    target runs degraded: who is out, why, and how many survive."""
+    qs = _qstate(target, create=False)
+    quarantined = [] if qs is None else sorted(qs.replicas)
+    out: Dict[str, Any] = {
+        "degraded": bool(quarantined),
+        "quarantined": quarantined,
+        "reasons": {} if qs is None else {str(r): qs.reasons.get(r, "") for r in quarantined},
+    }
+    if n_devices is not None:
+        out["n_devices"] = int(n_devices)
+        out["surviving"] = int(n_devices) - len(quarantined)
+    return out

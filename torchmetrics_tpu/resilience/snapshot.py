@@ -50,6 +50,7 @@ __all__ = [
     "snapshot",
     "validate_state_leaf",
     "validate_state_pytree",
+    "with_snapshot_context",
 ]
 
 SCHEMA_VERSION = 1
@@ -239,13 +240,19 @@ def _metric_snapshot(metric: Metric) -> Dict[str, Any]:
     }
 
 
-def snapshot(obj: Any) -> Dict[str, Any]:
+def snapshot(obj: Any, *, mesh_shape: Optional[Sequence[int]] = None) -> Dict[str, Any]:
     """Versioned host-numpy snapshot of a metric or collection.
 
     The result is self-describing (schema version, class fingerprint,
     per-leaf shape/dtype spec) so :func:`restore` can reject corruption or a
     config mismatch with a structured error instead of poisoning state.
     Plain dict/list/numpy payload: picklable and ``np.savez``/orbax-friendly.
+
+    ``mesh_shape`` optionally records the device mesh the state was produced
+    on (e.g. ``(8,)``).  Restore never *requires* it — replicated metric
+    state is mesh-agnostic — but when present it rides along in the header
+    so restore diagnostics (and the elastic-restore path) can name the
+    producing mesh instead of failing with only a bad leaf name.
     """
     from torchmetrics_tpu.collections import MetricCollection
 
@@ -253,16 +260,67 @@ def snapshot(obj: Any) -> Dict[str, Any]:
         groups: Optional[List[List[str]]] = None
         if obj._groups and obj._groups_checked:
             groups = [list(members) for members in obj._groups.values()]
-        return {
+        snap = {
             "schema_version": SCHEMA_VERSION,
             "kind": "collection",
             "class": class_fingerprint(obj),
             "groups": groups,
             "metrics": {key: _metric_snapshot(m) for key, m in obj.items(keep_base=True)},
         }
-    if isinstance(obj, Metric):
-        return _metric_snapshot(obj)
-    raise TypeError(f"snapshot() takes a Metric or MetricCollection, got {type(obj).__name__}")
+    elif isinstance(obj, Metric):
+        snap = _metric_snapshot(obj)
+    else:
+        raise TypeError(f"snapshot() takes a Metric or MetricCollection, got {type(obj).__name__}")
+    if mesh_shape is not None:
+        snap["mesh"] = [int(d) for d in mesh_shape]
+    return snap
+
+
+def with_snapshot_context(
+    err: StateRestoreError,
+    snap: Any,
+    *,
+    generation: Optional[int] = None,
+) -> StateRestoreError:
+    """Re-raiseable copy of ``err`` stamped with the snapshot's identity.
+
+    Restore failures deep in leaf validation only know the offending leaf;
+    the caller holding the snapshot header (and, for durable restores, the
+    generation id) uses this to produce the full diagnostic: schema version,
+    producing mesh shape, and generation, both as message text and as
+    structured attributes on the error.
+    """
+    schema = err.schema_version
+    mesh = err.mesh_shape
+    if isinstance(snap, Mapping):
+        if schema is None:
+            schema = snap.get("schema_version")
+        if mesh is None:
+            mesh = snap.get("mesh")
+    gen = err.generation if err.generation is not None else generation
+    parts = []
+    if schema is not None:
+        parts.append(f"schema_version={schema!r}")
+    if mesh is not None:
+        parts.append(f"mesh={tuple(mesh)!r}")
+    if gen is not None:
+        parts.append(f"generation={gen}")
+    message = str(err)
+    # idempotent: a previously-stamped context block is replaced, not stacked
+    idx = message.rfind(" [snapshot ")
+    if idx != -1 and message.endswith("]"):
+        message = message[:idx]
+    if parts:
+        message = f"{message} [snapshot {' '.join(parts)}]"
+    out = StateRestoreError(
+        message,
+        leaf=err.leaf,
+        reason=err.reason,
+        schema_version=schema,
+        mesh_shape=tuple(mesh) if mesh is not None else None,
+        generation=gen,
+    )
+    return out
 
 
 # ------------------------------------------------------------------- restore
@@ -351,14 +409,9 @@ def _restore_metric(metric: Metric, snap: Mapping[str, Any], strict_class: bool)
 
 
 def _install(metric: Metric, state: State) -> None:
-    from torchmetrics_tpu.observability import registry as _telemetry
-
-    _telemetry.count(metric, "restores")
-    metric._state = state  # tmt: ignore[TMT007] -- checkpoint restore installs state buffers — a sanctioned lifecycle boundary
-    metric._state_shared = False  # restored buffers are fresh — donation is safe again
-    metric._computed = None
-    metric._forward_cache = None
-    metric._nf_reported = 0
+    # the restore boundary lives on the Metric itself — one sanctioned place
+    # where restored buffers land and the post-restore invariants are reset
+    metric._install_restored_state(state)
 
 
 def restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True) -> None:
@@ -371,7 +424,19 @@ def restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True) -> Non
     re-established: members of a group share their leader's restored pytree
     and are re-marked as aliased (``_state_shared``) so compiled updates
     keep honoring the no-donate-aliased-state contract.
+
+    Any :class:`StateRestoreError` raised here is stamped with the
+    snapshot's identity (schema version, producing mesh shape when recorded)
+    via :func:`with_snapshot_context` so the diagnostic names *which*
+    checkpoint failed, not just the bad leaf.
     """
+    try:
+        _restore_validated(obj, snap, strict_class)
+    except StateRestoreError as err:
+        raise with_snapshot_context(err, snap) from None
+
+
+def _restore_validated(obj: Any, snap: Mapping[str, Any], strict_class: bool) -> None:
     from torchmetrics_tpu.collections import MetricCollection
 
     if isinstance(obj, MetricCollection):

@@ -32,13 +32,44 @@ class StateRestoreError(TorchMetricsUserError):
             class-level mismatches).
         reason: machine-readable mismatch category, e.g. ``"shape"``,
             ``"dtype"``, ``"missing-leaf"``, ``"unknown-leaf"``, ``"class"``,
-            ``"schema-version"``.
+            ``"schema-version"``, ``"mesh-shape"``.
+        schema_version: the failing snapshot's recorded schema version, when
+            known.
+        mesh_shape: the device count (or mesh tuple) the snapshot was
+            produced on, when the snapshot recorded it.
+        generation: the durable-store generation id the snapshot was loaded
+            from, when it came through a :class:`DurableSnapshotStore`.
     """
 
-    def __init__(self, message: str, *, leaf: Optional[str] = None, reason: Optional[str] = None) -> None:
+    def __init__(
+        self,
+        message: str,
+        *,
+        leaf: Optional[str] = None,
+        reason: Optional[str] = None,
+        schema_version: Optional[object] = None,
+        mesh_shape: Optional[object] = None,
+        generation: Optional[int] = None,
+    ) -> None:
         super().__init__(message)
         self.leaf = leaf
         self.reason = reason
+        self.schema_version = schema_version
+        self.mesh_shape = mesh_shape
+        self.generation = generation
+
+
+class TransientIOError(OSError):
+    """A checkpoint I/O failure worth retrying.
+
+    The durable store's :class:`~torchmetrics_tpu.resilience.durable.RetryPolicy`
+    classifies failures into *transient* (flaky network filesystem, a stolen
+    lease, an interrupted syscall — retry with backoff) and *permanent*
+    (``ENOSPC``, a read-only filesystem, a corrupt payload — retrying cannot
+    help, surface immediately).  Backends raise this directly for failures
+    they know to be transient; plain ``OSError`` subtypes are classified by
+    errno (see ``RetryPolicy.is_transient``).
+    """
 
 
 class ReplicaDivergenceError(TorchMetricsUserError):
