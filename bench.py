@@ -1790,6 +1790,126 @@ def memory_leg():
     }
 
 
+def accuracy_leg():
+    """Accuracy attestation plane: armed-vs-unarmed per-step price (plus the
+    shadow-audited path at sample_rate=1/64), the 0-retrace / 0-new-entry
+    proof on the primary path, and observed-vs-predicted error bounds for the
+    two sanctioned approximation paths (sketch AUROC, int8-quantized
+    calibration state).
+    """
+    import copy
+
+    import numpy as np
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import BinaryAUROC, BinaryCalibrationError
+    from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+    from torchmetrics_tpu.observability import accuracy
+    from torchmetrics_tpu.parallel.compress import (
+        host_dequantize_int8,
+        host_quantize_int8,
+        predicted_error_bound,
+    )
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(4096, dtype="float32"))
+    tgt = jnp.asarray(rng.integers(0, 2, 4096).astype("int32"))
+
+    def step_us(armed, shadow_rate=None):
+        """Per-step sketch-AUROC update price with telemetry on and the
+        accuracy plane armed/disarmed; ``shadow_rate`` routes updates through
+        a ShadowAuditor so the twin sees its deterministic sample."""
+        clear_compile_cache()
+        obs.reset_telemetry()
+        obs.enable()
+        (accuracy.enable_accuracy_telemetry if armed else accuracy.disable_accuracy_telemetry)()
+        m = BinaryAUROC(approx="sketch")
+        auditor = None
+        if shadow_rate is not None:
+            auditor = accuracy.ShadowAuditor(
+                m, BinaryAUROC(approx="sketch"), sample_rate=shadow_rate, seed=7
+            )
+        m.update(preds, tgt)  # compile
+        primary_before = cache_stats()
+        inner = 50
+        t0 = time.perf_counter()
+        for i in range(inner):
+            if auditor is not None:
+                auditor.update(preds, tgt, step=i)
+            else:
+                m.update(preds, tgt)
+        jax.block_until_ready(m._state)
+        # the twin owns its own cache entries; the primary-path proof compares
+        # the no-auditor armed run against the unarmed run
+        return (time.perf_counter() - t0) / inner * 1e6, primary_before, cache_stats()
+
+    try:
+        off_us, _, off_stats = step_us(False)
+        on_us, _, on_stats = step_us(True)
+        shadow_us, _, _ = step_us(True, shadow_rate=1.0 / 64.0)
+
+        # observed vs predicted, path 1: sketch AUROC against an exact twin
+        # fed every batch (sample_rate=1 — the audit is the measurement)
+        obs.enable()
+        accuracy.enable_accuracy_telemetry()
+        sk = BinaryAUROC(approx="sketch")
+        auditor = accuracy.ShadowAuditor(sk, BinaryAUROC(thresholds=None), sample_rate=1.0)
+        for i in range(4):
+            auditor.update(preds, tgt, step=i)
+        sk_audit = auditor.audit(step=4)
+
+        # path 2: int8-quantized BinaryCalibrationError state (the honest
+        # host round-trip a single-stage compressed sync applies)
+        cal = BinaryCalibrationError(n_bins=1024)
+        cal.update(preds, tgt)
+        twin = copy.deepcopy(cal)
+        flat = np.asarray(cal._state["conf_sum"]).reshape(-1)
+        packed = host_quantize_int8(flat)
+        cal._state = dict(
+            cal._state,
+            conf_sum=jnp.asarray(
+                host_dequantize_int8(packed, flat.size).reshape(
+                    cal._state["conf_sum"].shape
+                )
+            ),
+        )
+        cal_bound = predicted_error_bound("int8", stages=1)
+        cal_auditor = accuracy.ShadowAuditor(
+            cal, twin, sample_rate=1.0, predicted_bound=cal_bound
+        )
+        cal_audit = cal_auditor.audit(step=0)
+    finally:
+        accuracy.disable_accuracy_telemetry()
+        obs.disable()
+        obs.reset_telemetry()
+        clear_compile_cache()
+
+    return {
+        "metric": "BinaryAUROC(approx='sketch') jitted update, telemetry on",
+        "update_us_accuracy_off": round(off_us, 1),
+        "update_us_accuracy_on": round(on_us, 1),
+        "update_us_shadow_1_64": round(shadow_us, 1),
+        "armed_overhead_pct": round((on_us - off_us) / off_us * 100.0, 2),
+        "shadow_overhead_pct": round((shadow_us - off_us) / off_us * 100.0, 2),
+        # the armed plane must never change what the primary path compiles
+        "accuracy_extra_retraces": on_stats["traces"] - off_stats["traces"],  # must be 0
+        "accuracy_extra_cache_entries": on_stats["misses"] - off_stats["misses"],  # must be 0
+        "sketch_auroc": {
+            "observed_err": sk_audit["observed_rel"],
+            "predicted_bound": sk_audit["predicted_bound"],
+            "within_bound": not sk_audit["breach"],
+        },
+        "int8_calibration": {
+            "observed_err": cal_audit["observed_rel"],
+            "predicted_bound": cal_bound,
+            "within_bound": not cal_audit["breach"],
+        },
+        "note": "attestation reads host-side config only (0 extra retraces by "
+        "construction); the shadow twin owns its own cache entries and samples "
+        "deterministically from a seeded step hash",
+    }
+
+
 def kernel_vs_reference():
     """Opt-in head-to-head of our jitted kernels vs the installed torch
     reference (stat_scores / confusion_matrix / PSNR).  Skips cleanly —
@@ -2022,6 +2142,10 @@ def main():
         memory_plane = memory_leg()
     except Exception as err:  # noqa: BLE001
         memory_plane = {"error": f"memory leg failed: {err}"}
+    try:
+        accuracy_plane = accuracy_leg()
+    except Exception as err:  # noqa: BLE001
+        accuracy_plane = {"error": f"accuracy leg failed: {err}"}
 
     record = {
         "metric": "metric-accumulation overhead (Accuracy+F1+binned AUROC fused into jitted ResNet-50 train step)",
@@ -2056,6 +2180,7 @@ def main():
             "observability": observability,
             "analysis": analysis,
             "memory_plane": memory_plane,
+            "accuracy_plane": accuracy_plane,
             "state_reduce_bytes_1_to_64_chips": state_reduce_bytes_table(),
             "model": f"ResNet-50 ({n_params / 1e6:.1f}M params, bf16)",
             "batch": BATCH, "image": IMG, "num_classes": NUM_CLASSES,

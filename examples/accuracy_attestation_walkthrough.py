@@ -1,0 +1,179 @@
+"""Accuracy attestation walkthrough: value provenance, the error-budget
+ledger, and a shadow-exact audit closing the autotune loop.
+
+What this shows, in order:
+
+1. **value attestations** — arming the plane (double gate: telemetry on +
+   accuracy telemetry on) makes every `compute()` stamp a `ValueAttestation`
+   onto the registry row: the composed worst-case error bound plus the full
+   provenance chain (sketch grid, committed sync policy, quorum, config
+   fingerprint); exact-path metrics attest `exact=True` and leave their row
+   byte-identical to the pre-1.7 shape;
+2. **exports** — the `kind: "attestation"` JSONL line parses back through
+   `parse_export_line`, and the `tm_tpu_accuracy_*` Prometheus families
+   render the bound / budget-burn / within-budget gauges;
+3. **a clean shadow audit** — a `ShadowAuditor` feeds an exact twin a
+   deterministic sample of update batches (seeded step hash — no wall
+   clock, no RNG) and measures observed |approx - exact| against the
+   predicted bound: the sketch AUROC lands comfortably inside its
+   attested bound;
+4. **the loop closes** — a `SyncAutotuner` commits an int8-compressed sync
+   policy, a shadow audit armed with an (understated) predicted quant
+   bound catches the genuinely-injected int8 state error exceeding it, and
+   the resulting severity-critical alert rolls the committed policy back
+   through the guardrail sink — measured error, not modelled error, ends
+   the episode, with the whole story on the decision ledger and the flight
+   recorder's `accuracy` events.
+
+Run with:  python examples/accuracy_attestation_walkthrough.py
+"""
+
+import io
+import json
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def banner(title: str) -> None:
+    print(f"\n{'=' * 72}\n{title}\n{'=' * 72}")
+
+
+def main() -> None:
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import (
+        BinaryAccuracy,
+        BinaryAUROC,
+        BinaryCalibrationError,
+    )
+    from torchmetrics_tpu.observability import accuracy, tracing
+    from torchmetrics_tpu.observability.export import parse_export_line
+    from torchmetrics_tpu.parallel import (
+        SyncAutotuner,
+        SyncPolicy,
+        SyncStepper,
+        committed_policy,
+        metric_mesh,
+    )
+    from torchmetrics_tpu.parallel.compress import host_dequantize_int8, host_quantize_int8
+
+    obs.enable()
+    accuracy.enable_accuracy_telemetry()  # or TM_TPU_ACCURACY_TELEMETRY=1
+    tracing.start(capacity=512)
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(4096, dtype="float32"))
+    target = jnp.asarray(rng.integers(0, 2, 4096).astype("int32"))
+
+    banner("1. every compute() attests its value")
+    auroc = BinaryAUROC(approx="sketch")  # bounded state, declared approx_error
+    auroc.update(preds, target)
+    value = auroc.compute()
+    att = auroc.telemetry.as_dict()["attestation"]
+    print(f"  value={float(value):.5f}  attested bound={att['bound']:.3g}  "
+          f"fingerprint={att['fingerprint']}")
+    for row in att["ledger"]:
+        burn = f"{row['burn']:.0%} of budget {row['budget']}" if row.get("burn") else "no budget"
+        print(f"    source={row['source']:12s} bound={row['bound']:.3g}  ({burn})")
+
+    exact = BinaryAccuracy()
+    exact.update(preds, target)
+    exact.compute()
+    row = exact.telemetry.as_dict()
+    print(f"  exact-path metric: attestation slot untouched "
+          f"({'attestation' not in row}) — pre-1.7 reports stay byte-identical")
+    proof = accuracy.attest(exact)
+    print(f"  (attest() still answers: exact={proof.exact}, bound={proof.bound})")
+
+    banner("2. exports: JSONL attestation lines + tm_tpu_accuracy_* families")
+    report = accuracy.accuracy_report([auroc])
+    line = obs.export(report, fmt="jsonl", stream=io.StringIO())
+    back = parse_export_line(line)
+    print(f"  jsonl kind={back['kind']}  schema={back['schema_version']}")
+    text = obs.export(fmt="prometheus")
+    for ln in text.splitlines():
+        if ln.startswith("tm_tpu_accuracy_") and not ln.startswith("#"):
+            print(f"    {ln}")
+
+    banner("3. shadow-exact audit: the sketch honours its bound")
+    sk = BinaryAUROC(approx="sketch")
+    auditor = accuracy.ShadowAuditor(sk, BinaryAUROC(thresholds=None), sample_rate=1.0)
+    for step in range(4):
+        auditor.update(preds, target, step=step)
+    audit = auditor.audit(step=4)
+    print(f"  observed={audit['observed_rel']:.3g} vs predicted "
+          f"{audit['predicted_bound']:.3g}  breach={audit['breach']}")
+    assert not audit["breach"], "the sketch must live inside its attested bound"
+
+    banner("4. a shadow audit catches an out-of-budget int8 commit")
+    mesh = metric_mesh(axis_name="data")
+    cal = BinaryCalibrationError(n_bins=1024)
+    stepper = SyncStepper(cal, mesh=mesh, policy=SyncPolicy())
+    tuner = SyncAutotuner(
+        stepper,
+        candidates=(1, 4),
+        target_cut=1.5,
+        report_only=False,
+        error_budget=5e-2,  # admits int8's predicted two-stage bound (~0.031)
+    )
+    batch = lambda: (
+        jnp.asarray(rng.random(64, dtype="float32")),
+        jnp.asarray(rng.integers(0, 2, 64).astype("int32")),
+    )
+    stepper.update(*batch())  # compile the exact-mode step pre-commit
+    tuner.observe(*batch(), steps=8, rounds=2)
+    tuner.propose()
+    tuner.arm()
+    entry = tuner.commit()
+    print(f"  committed (applied={entry['applied']}): {entry['new_policy']}")
+
+    # wire the audit into the guardrail and feed primary + exact twin
+    auditor = tuner.attach_shadow_auditor(
+        BinaryCalibrationError(n_bins=1024),
+        sample_rate=1.0,
+        predicted_bound=1e-5,  # the injected fault: a wildly understated bound
+    )
+    for step in range(3):
+        auditor.update(*batch(), step=step)
+
+    # inject the real thing the understated bound pretends cannot happen:
+    # the primary's state rides an honest int8 quantize/dequantize round-trip
+    flat = np.asarray(cal._state["conf_sum"]).reshape(-1)
+    lossy = host_dequantize_int8(host_quantize_int8(flat), flat.size)
+    cal._state = dict(cal._state, conf_sum=jnp.asarray(lossy.reshape(flat.shape)))
+
+    print(f"  state before audit: {tuner.state!r}, "
+          f"compression={stepper.policy.compression!r}")
+    audit = auditor.audit(step=3)
+    print(f"  audit: observed={audit['observed_rel']:.3g} > predicted "
+          f"{audit['predicted_bound']:.3g} -> breach={audit['breach']}")
+    print(f"  state after audit:  {tuner.state!r}, "
+          f"compression={stepper.policy.compression!r}")
+    assert audit["breach"] and tuner.state == "observe"
+    assert committed_policy(cal) == SyncPolicy()  # the exact policy is back
+
+    rollback = tuner.decision_ledger()[-1]
+    print(f"  ledgered rollback: {rollback['rationale']}")
+    print(f"  triggering alert:  {rollback['alert']['series']} "
+          f"{rollback['alert']['severity']} at step {rollback['alert']['step']}")
+    acc_events = [e for e in tracing.events() if e.cat == "accuracy"]
+    print(f"  flight recorder: {len(acc_events)} 'accuracy' events, last: "
+          f"{acc_events[-1].name}")
+    print("  => the committed int8 policy was rolled back on *measured* "
+          "error, not the model's word for it")
+
+    print("\naudit trail:", json.dumps(auditor.report()["last"]))
+    tracing.stop()
+    accuracy.disable_accuracy_telemetry()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,19 @@ def test_committed_policy_never_changes_the_update_segment():
     assert up(ev4) == up(plain) and sync(ev4) == sync(plain)
 
 
+def test_armed_accuracy_plane_never_changes_either_segment():
+    """The attested golden entry (accuracy plane armed around the trace) is
+    byte-identical to the plain committed-policy entry in BOTH segments:
+    attestation reads host-side config only and must never reshape a trace."""
+    load = lambda name: json.loads((contract_dir() / f"{name}.json").read_text())
+    plain = load("BinaryCalibrationError1024__int8")
+    attested = load("BinaryCalibrationError1024__int8__attested")
+    assert "attested" not in plain
+    assert attested["attested"] is True
+    assert attested["policy"] == plain["policy"]
+    assert attested["entrypoints"] == plain["entrypoints"]
+
+
 # -------------------------------------------------------------- diff surface
 def _contract():
     metric, inputs = golden_metrics()["BinaryAccuracy"]()

@@ -1,0 +1,749 @@
+"""Accuracy attestation plane: value attestations with composed bounds and
+provenance chains, the error-budget ledger, deterministic shadow-exact
+audits (breach -> critical alert -> autotuner veto/rollback), the armed
+path's zero-retrace / byte-identity contracts, and the export surfaces
+(JSONL kinds, ``tm_tpu_accuracy_*`` families, README doc-drift)."""
+
+import copy
+import io
+import json
+import logging
+import re
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    BinaryCalibrationError,
+)
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.compile import audit_step_fn, cache_stats, clear_compile_cache
+from torchmetrics_tpu.observability import accuracy, registry
+from torchmetrics_tpu.observability.accuracy import (
+    ShadowAuditor,
+    attest,
+    compose_sources,
+    shadow_sampled,
+)
+from torchmetrics_tpu.observability.export import (
+    SCHEMA_MAJOR,
+    SCHEMA_VERSION,
+    JSONLinesExporter,
+    PrometheusExporter,
+    parse_export_line,
+    parse_stats,
+    reset_parse_stats,
+)
+from torchmetrics_tpu.observability.health import (
+    AccuracyBudgetRule,
+    Alert,
+    CallbackAlertSink,
+    HealthMonitor,
+)
+from torchmetrics_tpu.observability.registry import COUNTER_NAMES
+from torchmetrics_tpu.parallel import (
+    SyncAdvisor,
+    SyncAutotuner,
+    SyncPolicy,
+    SyncStepper,
+    committed_policy,
+)
+from torchmetrics_tpu.parallel.autotune import LEDGER_KIND
+from torchmetrics_tpu.parallel.compress import (
+    host_dequantize_int8,
+    host_quantize_int8,
+    predicted_error_bound,
+)
+from torchmetrics_tpu.utilities.regression import direction_for
+
+pytestmark = pytest.mark.accuracy
+
+rng = np.random.default_rng(0)
+PREDS = jnp.asarray(rng.random(512, dtype=np.float32))
+TARGET = jnp.asarray(rng.integers(0, 2, 512).astype(np.int32))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.disable()
+    accuracy.disable_accuracy_telemetry()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    yield
+    obs.tracing.stop()
+    accuracy.disable_accuracy_telemetry()
+    obs.disable()
+    obs.reset_telemetry()
+    clear_compile_cache()
+
+
+def _armed():
+    obs.enable()
+    accuracy.enable_accuracy_telemetry()
+
+
+# ------------------------------------------------------- value attestations
+def test_sketch_compute_attests_bound_and_provenance():
+    _armed()
+    m = BinaryAUROC(approx="sketch", approx_error=0.005)
+    m.update(PREDS, TARGET)
+    m.compute()
+    att = m.telemetry.as_dict()["attestation"]
+    assert att["kind"] == "attestation"
+    assert att["exact"] is False
+    assert re.fullmatch(r"[0-9a-f]{12}", att["fingerprint"])
+    (src,) = att["sources"]
+    assert src["source"] == "sketch"
+    # data-dependent AUC bound, tighter than the declared approx_error budget
+    assert 0.0 < att["bound"] <= m.approx_error
+    (row,) = att["ledger"]
+    assert row["budget"] == m.approx_error
+    assert row["burn"] == att["bound"] / m.approx_error
+    assert row["within_budget"] is True and att["within_budget"] is True
+
+
+def test_exact_compute_leaves_registry_row_untouched():
+    _armed()
+    m = BinaryAccuracy()
+    m.update(PREDS, TARGET)
+    m.compute()
+    assert "attestation" not in m.telemetry.as_dict()
+    # attest() still answers for exact metrics, it just never lands in a row
+    proof = attest(m)
+    assert proof.exact is True and proof.bound == 0.0 and proof.sources == []
+
+
+def test_unarmed_compute_records_nothing():
+    obs.enable()  # telemetry on, accuracy plane NOT armed
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    m.compute()
+    assert "attestation" not in m.telemetry.as_dict()
+
+
+def test_committed_policy_stacks_compression_onto_sketch_bound():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    policy = SyncPolicy(every_n_steps=4, compression="int8", error_budget=5e-2)
+    m.__dict__["_autotuned_policy"] = policy  # the autotuner's commit slot
+    att = attest(m)
+    assert [s["source"] for s in att.sources] == ["sketch", "compression"]
+    int8_bound = predicted_error_bound("int8", stages=2)
+    assert att.bound == pytest.approx(att.sources[0]["bound"] + int8_bound)
+    assert att.policy == {
+        "every_n": 4,
+        "at_compute": False,
+        "compression": "int8",
+        "error_budget": 5e-2,
+    }
+    comp_row = next(r for r in att.ledger if r["source"] == "compression")
+    assert comp_row["within_budget"] is True
+    assert comp_row["burn"] == pytest.approx(int8_bound / 5e-2)
+
+
+def test_quarantined_quorum_rides_the_provenance_chain():
+    from torchmetrics_tpu.resilience.quarantine import clear_quarantine, quarantine
+
+    _armed()
+    m = BinaryAccuracy()
+    m.update(PREDS, TARGET)
+    try:
+        quarantine(m, [3], reason="divergence")
+        att = attest(m, n_devices=NUM_DEVICES)
+        quorum = next(s for s in att.sources if s["source"] == "quorum")
+        # sample loss, not value error: the quorum source carries a zero bound
+        assert quorum["bound"] == 0.0 and quorum["quarantined"] == 1
+        assert att.quorum_fraction == (NUM_DEVICES - 1) / NUM_DEVICES
+        assert att.exact is False  # a degraded value is not the exact value
+    finally:
+        clear_quarantine(m)
+
+
+def test_collection_compute_attests_collection_level_sources():
+    _armed()
+    coll = MetricCollection([BinaryAccuracy(), BinaryAUROC(thresholds=None)])
+    coll.update(PREDS, TARGET)
+    # a committed policy lives on the collection, not on any one member
+    coll.__dict__["_autotuned_policy"] = SyncPolicy(
+        every_n_steps=2, compression="bf16", error_budget=1e-2
+    )
+    coll.compute()
+    att = registry.telemetry_for(coll).as_dict()["attestation"]
+    assert [s["source"] for s in att["sources"]] == ["compression"]
+    assert att["bound"] == pytest.approx(predicted_error_bound("bf16"))
+
+
+# --------------------------------------------------- composition & the ledger
+def test_compose_sources_sums_bounds_and_burns_budgets():
+    bound, ledger = compose_sources(
+        [
+            {"source": "sketch", "bound": 0.004, "budget": 0.005},
+            {"source": "compression", "bound": 0.03, "budget": 0.02},
+            {"source": "quorum", "bound": 0.0},
+        ]
+    )
+    assert bound == pytest.approx(0.034)
+    assert [r["within_budget"] for r in ledger] == [True, False, None]
+    assert ledger[0]["burn"] == pytest.approx(0.8)
+    assert ledger[1]["burn"] == pytest.approx(1.5)
+    assert "burn" not in ledger[2]  # no declared budget -> no burn to report
+
+
+def test_accuracy_budget_rule_latches_per_episode():
+    rule = AccuracyBudgetRule(budget=5e-2)
+    assert rule.severity == "critical"
+    assert rule.check("acc/bound", 0, 0.03) is None
+    first = rule.check("acc/bound", 1, 0.08)
+    assert isinstance(first, Alert)
+    assert first.severity == "critical"
+    assert first.details["over"] == pytest.approx(0.03)
+    # latched: the plateau does not page again
+    assert rule.check("acc/bound", 2, 0.09) is None
+    # back under budget clears the latch; the next breach fires anew
+    assert rule.check("acc/bound", 3, 0.01) is None
+    assert rule.check("acc/bound", 4, 0.2) is not None
+    # series latches are independent; non-finite is NonFiniteRule's job
+    assert rule.check("other/bound", 5, 0.1) is not None
+    assert rule.check("acc/bound", 6, float("nan")) is None
+    with pytest.raises(ValueError):
+        AccuracyBudgetRule(budget=0.0)
+
+
+def test_accuracy_budget_rule_rides_monitor_and_sinks():
+    seen = []
+    mon = HealthMonitor(sinks=[CallbackAlertSink(seen.append, min_severity="warning")])
+    mon.watch("auroc/bound", AccuracyBudgetRule(budget=1e-2))
+    mon.observe("auroc/bound", 5e-3, step=0)
+    mon.observe("auroc/bound", 5e-2, step=1)
+    mon.observe("auroc/bound", 6e-2, step=2)
+    assert [a.step for a in seen] == [1]
+    assert seen[0].rule == "accuracy_budget"
+
+
+def test_bound_and_err_keys_gate_lower_is_better():
+    assert direction_for("accuracy_plane.sketch_auroc.predicted_bound") == "lower"
+    assert direction_for("accuracy_plane.int8_calibration.observed_err") == "lower"
+    assert direction_for("update_us_accuracy_on") == "lower"
+
+
+# ------------------------------------------------------- shadow-exact audits
+def test_shadow_sampling_is_deterministic_and_honours_rate():
+    picks = [shadow_sampled(s, sample_rate=0.25, seed=3) for s in range(4096)]
+    assert picks == [shadow_sampled(s, sample_rate=0.25, seed=3) for s in range(4096)]
+    assert 0.2 < sum(picks) / len(picks) < 0.3
+    assert all(shadow_sampled(s, sample_rate=1.0) for s in range(64))
+    # a different seed samples a different (deterministic) subset
+    assert picks != [shadow_sampled(s, sample_rate=0.25, seed=4) for s in range(4096)]
+
+
+def test_shadow_auditor_validates_construction():
+    m = BinaryAUROC(approx="sketch")
+    with pytest.raises(ValueError, match="sample_rate"):
+        ShadowAuditor(m, BinaryAUROC(thresholds=None), sample_rate=0.0)
+    with pytest.raises(ValueError, match="distinct instance"):
+        ShadowAuditor(m, m)
+
+
+def test_shadow_audit_within_bound_folds_observed_into_attestation():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    auditor = ShadowAuditor(m, BinaryAUROC(thresholds=None), sample_rate=1.0)
+    for step in range(3):
+        assert auditor.update(PREDS, TARGET, step=step) is True
+    record = auditor.audit(step=3)
+    assert record["breach"] is False
+    assert record["observed_rel"] < record["predicted_bound"]
+    att = m.telemetry.as_dict()["attestation"]
+    assert att["observed_err"] == pytest.approx(record["observed_rel"])
+    rep = auditor.report()
+    assert rep["updates"] == rep["sampled_updates"] == 3
+    assert rep["audits"] == 1 and rep["breaches"] == 0
+
+
+def _calib_batch(gen, n=64):
+    return (
+        jnp.asarray(gen.random(n, dtype=np.float32)),
+        jnp.asarray(gen.integers(0, 2, n).astype(np.int32)),
+    )
+
+
+def _profile_runs():
+    """Deterministic prebuilt cadence profile: every_n=4 cuts sync 4x."""
+    runs = []
+    for every_n, sync_s in ((1, 1.0), (4, 0.25)):
+        runs.append(
+            {
+                "every_n": every_n,
+                "steps": 8,
+                "rounds": 1,
+                "syncs": 8 // every_n,
+                "sync_s": sync_s,
+                "mean_sync_s": sync_s / max(8 // every_n, 1),
+                "sync_wire_bytes": 4096,
+                "sync_raw_bytes": 4096,
+                "mean_sync_bytes": 512.0,
+            }
+        )
+    return {"steps": 8, "n_devices": NUM_DEVICES, "runs": runs, "buckets": {}}
+
+
+def _committed_int8_tuner(mesh):
+    """A live stepper with an applied int8 compression commit on a
+    calibration metric (the PR 11 happy path, deterministically driven)."""
+    gen = np.random.default_rng(7)
+    cal = BinaryCalibrationError(n_bins=1024)
+    stepper = SyncStepper(cal, mesh=mesh, policy=SyncPolicy())
+    tuner = SyncAutotuner(
+        stepper, candidates=(1, 4), report_only=False, error_budget=5e-2
+    )
+    for _ in range(2):
+        stepper.update(*_calib_batch(gen))
+    stepper.sync()
+    tuner.observe(profile=_profile_runs())
+    tuner.propose()
+    assert tuner.candidate()["policy"]["compression"] == "int8"
+    tuner.arm()
+    tuner.commit()
+    assert tuner.state == "committed" and stepper.policy.compression == "int8"
+    return tuner, stepper, cal, gen
+
+
+def _inject_int8_state_error(cal):
+    """The honest fault: the primary's state rides a real int8
+    quantize/dequantize round-trip (what a lossy compressed path applies)."""
+    flat = np.asarray(cal._state["conf_sum"]).reshape(-1)
+    lossy = host_dequantize_int8(host_quantize_int8(flat), flat.size)
+    cal._state = dict(cal._state, conf_sum=jnp.asarray(lossy.reshape(flat.shape)))
+
+
+def test_shadow_audit_breach_rolls_back_committed_policy(mesh):
+    """The acceptance path end-to-end: an understated predicted quant bound
+    + genuinely injected int8 state error -> ShadowAuditor breach -> critical
+    alert through the guardrail sink -> SyncAutotuner rolls the committed
+    compression policy back, flight-recorded."""
+    _armed()
+    obs.tracing.start(capacity=256)
+    tuner, stepper, cal, gen = _committed_int8_tuner(mesh)
+    auditor = tuner.attach_shadow_auditor(
+        BinaryCalibrationError(n_bins=1024),
+        sample_rate=1.0,
+        predicted_bound=1e-6,  # the injected lie: int8 really bounds ~1.6e-2
+    )
+    for step in range(3):
+        auditor.update(*_calib_batch(gen), step=step)
+    _inject_int8_state_error(cal)
+    record = auditor.audit(step=3)
+    assert record["breach"] is True
+    assert record["observed_rel"] > record["predicted_bound"]
+    # the rollback happened in-band, through the alert
+    assert tuner.state == "observe"
+    assert tuner.counts["rollbacks"] == 1
+    assert stepper.policy == SyncPolicy()
+    assert committed_policy(cal) == SyncPolicy()
+    rb = next(e for e in tuner.decision_ledger() if e["action"] == "rollback")
+    assert rb["alert"]["severity"] == "critical"
+    assert rb["alert"]["series"] == "accuracy/BinaryCalibrationError"
+    # measured error fed back to the plane: attestation + quant-err bucket
+    att = cal.telemetry.as_dict()["attestation"]
+    assert att["observed_err"] == pytest.approx(record["observed_rel"])
+    bucket = cal.telemetry.as_dict()["sync_buckets"]["float32/sum"]
+    assert bucket["quant_err_count"] >= 1
+    # and the whole story is on the flight recorder's accuracy category
+    events = [e for e in obs.tracing.events() if e.cat == "accuracy"]
+    assert any(e.name.endswith("/audit_breach") for e in events)
+
+
+def test_shadow_audit_breach_vetoes_pending_trial(mesh):
+    _armed()
+    gen = np.random.default_rng(11)
+    cal = BinaryCalibrationError(n_bins=1024)
+    stepper = SyncStepper(cal, mesh=mesh, policy=SyncPolicy())
+    tuner = SyncAutotuner(
+        stepper, candidates=(1, 4), report_only=False, error_budget=5e-2
+    )
+    tuner.observe(profile=_profile_runs())
+    tuner.propose()
+    tuner.arm()  # trial pending, nothing applied yet
+    auditor = tuner.attach_shadow_auditor(
+        BinaryCalibrationError(n_bins=1024), sample_rate=1.0, predicted_bound=1e-6
+    )
+    auditor.update(*_calib_batch(gen), step=0)
+    _inject_int8_state_error(cal)
+    assert auditor.audit(step=1)["breach"] is True
+    assert tuner.state == "observe" and tuner.counts["vetoes"] == 1
+    with pytest.raises(RuntimeError, match="vetoed"):
+        tuner.commit()
+
+
+# --------------------------------------------------- zero-perturbation proof
+def _sketch_flow():
+    clear_compile_cache()
+    m = BinaryAUROC(approx="sketch")
+    for _ in range(3):
+        m.update(PREDS, TARGET)
+    out = m.compute()
+    stats = cache_stats()
+    return np.asarray(out), stats["traces"], stats["misses"]
+
+
+def test_armed_accuracy_adds_zero_traces_and_entries():
+    obs.enable()
+    result_off, traces_off, misses_off = _sketch_flow()
+    accuracy.enable_accuracy_telemetry()
+    result_on, traces_on, misses_on = _sketch_flow()
+    assert traces_on == traces_off  # arming never enters a cache key
+    assert misses_on == misses_off  # and creates no new entries
+    np.testing.assert_array_equal(result_on, result_off)
+
+
+def test_armed_accuracy_keeps_jaxprs_bit_identical():
+    m = BinaryAUROC(approx="sketch")
+    step = audit_step_fn(m, "update")
+    state = m.init_state()
+    obs.disable()
+    baseline = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    _armed()
+    assert str(jax.make_jaxpr(step)(state, PREDS, TARGET)) == baseline
+
+
+def test_single_process_report_without_approximation_is_byte_identical():
+    """The armed plane must leave unapproximated reports byte-identical to
+    their schema-1.6 shape: an exact metric's compute attests, but the
+    registry row never grows an ``attestation`` key."""
+    _armed()
+    m = BinaryAccuracy()
+    m.update(PREDS, TARGET)
+    m.compute()
+    armed = json.dumps(registry.report(), sort_keys=True, default=str)
+    accuracy.disable_accuracy_telemetry()
+    disarmed = json.dumps(registry.report(), sort_keys=True, default=str)
+    assert armed == disarmed
+    assert '"attestation"' not in armed
+
+
+def test_attest_and_audit_events_reach_flight_recorder():
+    _armed()
+    obs.tracing.start(capacity=128)
+    try:
+        m = BinaryAUROC(approx="sketch")
+        auditor = ShadowAuditor(m, BinaryAUROC(thresholds=None), sample_rate=1.0)
+        auditor.update(PREDS, TARGET, step=0)
+        m.compute()
+        auditor.audit(step=1)
+        events = [e for e in obs.tracing.events() if e.cat == "accuracy"]
+    finally:
+        obs.tracing.stop()
+    names = {e.name.rsplit("/", 1)[-1] for e in events}
+    assert {"attest", "audit"} <= names
+    audit_ev = next(e for e in events if e.name.endswith("/audit"))
+    assert audit_ev.args["observed_rel"] <= audit_ev.args["predicted_bound"]
+
+
+# ------------------------------------------------- export & schema >= 1.7
+def test_schema_version_at_least_1_7():
+    major, minor = (int(p) for p in SCHEMA_VERSION.split(".")[:2])
+    assert major == 1 and minor >= 7
+
+
+def test_accuracy_report_jsonl_parse_back():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    m.compute()
+    rep = accuracy.accuracy_report([m, ("exact", BinaryAccuracy())])
+    buf = io.StringIO()
+    JSONLinesExporter(stream=buf).export(rep)
+    back = parse_export_line(buf.getvalue().strip())
+    assert back["kind"] == "attestation"
+    assert back["schema_version"] == SCHEMA_VERSION
+    atts = back["accuracy"]["attestations"]
+    assert atts["exact"]["exact"] is True and atts["exact"]["bound"] == 0.0
+    sketch_label = next(k for k in atts if k != "exact")
+    assert atts[sketch_label]["bound"] > 0.0
+    assert any(row["label"] == sketch_label for row in back["accuracy"]["ledger"])
+
+
+def test_registry_stamped_attestations_ride_default_report():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    m.compute()
+    rep = accuracy.accuracy_report()  # no metrics: read what the plane stamped
+    assert rep["armed"] is True and rep["enabled"] is True
+    att = rep["accuracy"]["attestations"][m.telemetry.as_dict()["label"]]
+    assert att["exact"] is False and att["bound"] > 0.0
+
+
+#: every JSONL ``kind`` the package exports, each with a minimal real payload
+_KIND_TABLE = [
+    ("attestation", lambda: accuracy.accuracy_report([])),
+    ("health_alert", lambda: Alert("s", "rule", "info", 0, 1.0, "msg", {}).as_dict()),
+    ("health", lambda: HealthMonitor().report()),
+    (LEDGER_KIND, lambda: {"kind": LEDGER_KIND, "seq": 0, "action": "observe"}),
+    ("sync_advice", lambda: {"kind": "sync_advice", "recommended": {"every_n": 4}}),
+    (
+        "memory_report",
+        lambda: __import__(
+            "torchmetrics_tpu.observability.memory", fromlist=["memory_report"]
+        ).memory_report([]),
+    ),
+]
+
+
+def test_kind_table_covers_every_exported_kind():
+    assert {k for k, _ in _KIND_TABLE} == {
+        "attestation",
+        "health_alert",
+        "health",
+        "autotune_decision",
+        "sync_advice",
+        "memory_report",
+    }
+
+
+@pytest.mark.parametrize("kind,factory", _KIND_TABLE, ids=[k for k, _ in _KIND_TABLE])
+def test_every_jsonl_kind_parses_back(kind, factory):
+    payload = factory()
+    assert payload.get("kind") == kind
+    buf = io.StringIO()
+    JSONLinesExporter(stream=buf).export(payload)
+    back = parse_export_line(buf.getvalue().strip())
+    assert back["kind"] == kind
+    assert back["schema_version"] == SCHEMA_VERSION
+    assert "process" in back  # every line names its producing process
+
+
+# ------------------------------------------------ parse_stats & the leniency
+def test_parse_stats_counts_and_one_time_legacy_debug(caplog):
+    reset_parse_stats()
+    try:
+        with caplog.at_level(logging.DEBUG, logger="torchmetrics_tpu"):
+            parse_export_line(json.dumps({"kind": "x", "schema_version": "1.2.0"}))
+            parse_export_line(json.dumps({"kind": "legacy-1"}))  # pre-1.1 line
+            parse_export_line(json.dumps({"kind": "legacy-2"}))
+            with pytest.raises(ValueError, match=f"major {SCHEMA_MAJOR} only"):
+                parse_export_line(json.dumps({"schema_version": "99.0.0"}))
+            with pytest.raises(ValueError, match="unparseable"):
+                parse_export_line(json.dumps({"schema_version": "not-semver"}))
+            with pytest.raises(ValueError):
+                parse_export_line("not json at all")
+            with pytest.raises(ValueError, match="not a JSON object"):
+                parse_export_line("[1, 2]")
+        assert parse_stats() == {"parsed": 1, "legacy_unversioned": 2, "rejected": 4}
+        legacy_logs = [r for r in caplog.records if "without schema_version" in r.message]
+        assert len(legacy_logs) == 1  # logged once, not per line
+        reset_parse_stats()
+        assert parse_stats() == {"parsed": 0, "legacy_unversioned": 0, "rejected": 0}
+    finally:
+        reset_parse_stats()
+
+
+# ------------------------------------- Prometheus lint & README doc-drift
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?[0-9]+(\.[0-9]+)?(e[+-]?[0-9]+)?$"
+)
+
+
+def _lint(text):
+    helped, typed, samples = set(), set(), []
+    for ln in text.splitlines():
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+        elif ln.startswith("# TYPE "):
+            assert ln.split()[3] in ("counter", "histogram", "gauge", "summary")
+            typed.add(ln.split()[2])
+        else:
+            assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"
+            assert 'process="' in ln
+            samples.append(ln)
+    assert helped == typed and helped
+    return helped, samples
+
+
+def _maximal_report():
+    """A synthetic report exercising every exposition branch, so the lint
+    sees every family the exporter can ever emit."""
+    fp = "ab12cd34ef56"
+    return {
+        "process": {"index": 0, "count": 1},
+        "global": {"counters": {}},
+        "metrics": {
+            "M#0": {
+                "class": "M",
+                "counters": {name: 1 for name in COUNTER_NAMES},
+                "cache": {"update": {"hits": 1, "misses": 1, "traces": 1}},
+                "spans": {
+                    "update": {"buckets": [[50, 1], [None, 0]], "total_us": 9.0, "count": 1}
+                },
+                "sync_buckets": {
+                    "float32/sum": {
+                        "syncs": 1,
+                        "measured_us": 3.0,
+                        "model_naive_bytes": 64,
+                        "model_ring_bytes": 96,
+                        "model_raw_bytes": 128,
+                        "residual_bytes": 32,
+                        "compression": "int8",
+                        "quant_rel_err_sum": 0.01,
+                        "quant_err_count": 1,
+                    }
+                },
+                "memory": {
+                    "installs": 1,
+                    "snapshots": 0,
+                    "current_bytes": 256,
+                    "peak_bytes": 256,
+                    "leaves": {"x": {"bytes": 256, "logical_bytes": 256}},
+                    "donated_install_bytes": 256,
+                    "copied_install_bytes": 0,
+                },
+                "attestation": {
+                    "exact": False,
+                    "bound": 0.01,
+                    "within_budget": True,
+                    "observed_err": 0.001,
+                    "ledger": [
+                        {"source": "sketch", "bound": 0.01, "budget": 0.02,
+                         "burn": 0.5, "within_budget": True}
+                    ],
+                },
+            }
+        },
+        "compile_cache": {
+            "hits": 1,
+            "misses": 1,
+            "traces": 1,
+            "evictions": 0,
+            "by_entrypoint": {"update": {"hits": 1, "entry_bytes": 128}},
+        },
+        "health": {
+            "series": {"s": {"alerts": {"critical": 1}, "observations": 2, "last_value": 1.0}}
+        },
+        "autotune": {
+            "policy": {"every_n": 4, "at_compute": False, "compression": "int8"},
+            "state": "committed",
+            "counts": {
+                "observations": 1, "proposals": 1, "trials": 1, "commits": 1,
+                "transitions": 4, "vetoes": 1, "rollbacks": 1,
+            },
+        },
+        "memory": {
+            "executables": [
+                {"fingerprint_hash": fp, "kind": "update", "memory": {"argument_bytes": 64}}
+            ],
+            "cost": {fp: {"flops": 1.0, "bytes_accessed": 2.0}},
+            "advice": {
+                "candidates": [{"metric": "M", "leaf": "x", "replicated_waste_bytes": 768}]
+            },
+        },
+        "accuracy": {
+            "attestations": {
+                "A#0": {"exact": False, "bound": 0.1, "within_budget": None, "ledger": []}
+            }
+        },
+    }
+
+
+def test_prometheus_lint_accuracy_families():
+    _armed()
+    m = BinaryAUROC(approx="sketch", approx_error=0.005)
+    auditor = ShadowAuditor(m, BinaryAUROC(thresholds=None), sample_rate=1.0)
+    auditor.update(PREDS, TARGET, step=0)
+    m.compute()
+    auditor.audit(step=1)
+    families, samples = _lint(obs.export(fmt="prometheus"))
+    names = {s.split("{")[0] for s in samples}
+    assert "tm_tpu_accuracy_error_bound" in names
+    assert "tm_tpu_accuracy_within_budget" in names
+    assert "tm_tpu_accuracy_observed_err" in names
+    assert {
+        "tm_tpu_accuracy_error_bound",
+        "tm_tpu_accuracy_budget_burn",
+        "tm_tpu_accuracy_within_budget",
+        "tm_tpu_accuracy_observed_err",
+    } <= families
+
+
+def test_every_family_has_help_type_and_a_readme_row():
+    """Doc-drift gate: the synthetic maximal report emits every family the
+    exporter knows; each must carry HELP/TYPE (set equality in ``_lint``)
+    and appear in the README's family reference table.  Lifecycle-counter
+    families are covered by the generic ``tm_tpu_<counter>_total`` row."""
+    families, _ = _lint(PrometheusExporter().export(_maximal_report()))
+    assert len(families) >= 28 + len(COUNTER_NAMES)
+    readme = (Path(__file__).parents[3] / "README.md").read_text(encoding="utf-8")
+    assert "tm_tpu_<counter>_total" in readme
+    counter_families = {f"tm_tpu_{name}_total" for name in COUNTER_NAMES}
+    missing = [
+        name
+        for name in sorted(families)
+        if name not in readme and name not in counter_families
+    ]
+    assert missing == [], f"families missing from the README table: {missing}"
+
+
+# --------------------------------------------------- fleet merge & the advisor
+def test_fleet_merges_attestations_pessimistically():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    m.compute()
+    rep0 = registry.report()
+    label = m.telemetry.as_dict()["label"]
+    rep1 = copy.deepcopy(rep0)
+    rep1["process"] = {"index": 1, "count": 2}
+    rep1["metrics"][label]["attestation"]["bound"] *= 10
+    rep1["metrics"][label]["attestation"]["observed_err"] = 0.5
+    view = obs.FleetView([rep0, rep1])
+    merged = view.merged_metrics()[label]["attestation"]
+    # pod bound = the WORST per-process bound, stamped with its process
+    assert merged["bound"] == rep1["metrics"][label]["attestation"]["bound"]
+    assert merged["worst_process"] == 1
+    assert merged["processes_attesting"] == 2
+    assert merged["observed_err"] == 0.5
+    skew = view.skew()
+    assert skew["observed_err"]["max"] == 0.5
+    assert skew["observed_err"]["max_process"] == 1
+
+
+def test_fleet_single_process_byte_identity_with_attestation_rows():
+    _armed()
+    m = BinaryAUROC(approx="sketch")
+    m.update(PREDS, TARGET)
+    m.compute()
+    fleet = json.dumps(obs.fleet_report(), sort_keys=True, default=str)
+    local = json.dumps(registry.report(), sort_keys=True, default=str)
+    assert fleet == local
+    assert '"attestation"' in local  # the sketch row genuinely attested
+
+
+def test_sync_advisor_strikes_mode_on_measured_over_budget_error(mesh):
+    """Measured evidence trumps the model: int8's predicted bound fits the
+    budget, but a shadow-audited observed error over budget strikes it from
+    ``recommended_mode`` eligibility."""
+    obs.enable()
+    m = BinaryCalibrationError(n_bins=1024)
+    advisor = SyncAdvisor(m, mesh=mesh, candidates=(1, 4), error_budget=5e-2)
+    advisor._profile = _profile_runs()
+    baseline = advisor.recommend(target_cut=3.5)["compression"]
+    assert baseline["recommended_mode"] == "int8"  # predicted bound fits
+    # fold a measured int8 error 4x over budget into the telemetry row
+    t = registry.telemetry_for(m)
+    t.record_bucket("float32/sum", 0, 0.0, 0, 0, compression="int8")
+    t.record_quant_error("float32/sum", 0.2)
+    comp = advisor.recommend(target_cut=3.5)["compression"]
+    row = comp["modes"]["int8"]
+    assert row["observed_rel_err"] == pytest.approx(0.2)
+    assert row["observed_samples"] == 1  # target counted once, not per-alias
+    assert row["observed_within_budget"] is False
+    assert comp["recommended_mode"] == "bf16"  # int8 struck on measured error

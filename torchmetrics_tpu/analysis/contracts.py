@@ -144,6 +144,17 @@ def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
 
         return factory
 
+    def attested(factory: Callable[[], Tuple[Any, Tuple[Any, ...]]]):
+        # an armed-accuracy-plane entry: trace_contract arms the plane around
+        # the trace, so the snapshot proves attestation leaves the update and
+        # sync segments byte-identical to the unattested entry's
+        def wrap():
+            metric, inputs = factory()
+            metric.__dict__["_attested"] = True
+            return metric, inputs
+
+        return wrap
+
     # the calibration bins are sized so the float32 sum bucket clears the
     # compression byte floor (2 x 1024 x 4 B >= DEFAULT_MIN_BUCKET_BYTES):
     # the bf16/int8 snapshots then capture a genuinely compressed lowering
@@ -161,6 +172,13 @@ def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
             calib1024,
             _binary_inputs,
             SyncPolicy(every_n_steps=4, compression="int8", error_budget=5e-2),
+        ),
+        "BinaryCalibrationError1024__int8__attested": attested(
+            autotuned(
+                calib1024,
+                _binary_inputs,
+                SyncPolicy(every_n_steps=4, compression="int8", error_budget=5e-2),
+            )
         ),
         "MulticlassAccuracy__every4": autotuned(
             lambda: MulticlassAccuracy(num_classes=5),
@@ -216,37 +234,58 @@ def trace_contract(
     bucket plan — and is snapshotted under a ``"policy"`` key.  The update
     segment never depends on the policy: that invariance is exactly what the
     autotuned golden entries prove.
+
+    A ``metric.__dict__["_attested"]`` stamp (the ``attested(...)`` slate
+    factory) arms the accuracy attestation plane around the trace — telemetry
+    enabled plus ``enable_accuracy_telemetry()``, restored afterwards — and
+    is snapshotted under an ``"attested"`` key.  The armed plane must leave
+    both segments byte-identical: attestation is host-side only.
     """
     from torchmetrics_tpu.analysis.audit import _default_mesh, _trace_sync
     from torchmetrics_tpu.analysis.donation import donation_mask
     from torchmetrics_tpu.analysis.uniformity import collective_sequence
     from torchmetrics_tpu.core.compile import audit_step_fn
+    from torchmetrics_tpu.observability import registry as _obs
 
     the_mesh = _default_mesh(mesh, axis_name)
-    state = metric.update_state(metric.init_state(), *inputs)
+    attested = bool(metric.__dict__.get("_attested"))
+    was_enabled = _obs.enabled()
+    was_armed = _obs.accuracy_armed()
+    if attested:
+        from torchmetrics_tpu.observability.accuracy import enable_accuracy_telemetry
 
-    jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
-    policy = metric.__dict__.get("_autotuned_policy")
-    compression = policy.compression_config if policy is not None else None
-    if compression is None:
-        jx_sync = _trace_sync(
-            lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name
-        )
-    else:
-        from torchmetrics_tpu.parallel.coalesce import _metric_entry, coalesced_sync_state
+        _obs.enable()
+        enable_accuracy_telemetry()
+    try:
+        state = metric.update_state(metric.init_state(), *inputs)
 
-        reductions, sub = _metric_entry(metric, state)
-        keys = tuple(sub)
-        jx_sync = _trace_sync(
-            lambda st: coalesced_sync_state(
-                {k: st[k] for k in keys}, reductions, axis_name, compression=compression
-            ),
-            state,
-            the_mesh,
-            axis_name,
-        )
+        jx_update = jax.make_jaxpr(audit_step_fn(metric, "update"))(metric.init_state(), *inputs)
+        policy = metric.__dict__.get("_autotuned_policy")
+        compression = policy.compression_config if policy is not None else None
+        if compression is None:
+            jx_sync = _trace_sync(
+                lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name
+            )
+        else:
+            from torchmetrics_tpu.parallel.coalesce import _metric_entry, coalesced_sync_state
 
-    mask = donation_mask(metric, "update", *inputs)
+            reductions, sub = _metric_entry(metric, state)
+            keys = tuple(sub)
+            jx_sync = _trace_sync(
+                lambda st: coalesced_sync_state(
+                    {k: st[k] for k in keys}, reductions, axis_name, compression=compression
+                ),
+                state,
+                the_mesh,
+                axis_name,
+            )
+
+        mask = donation_mask(metric, "update", *inputs)
+    finally:
+        if attested:
+            _obs.set_accuracy_armed(was_armed)
+            if not was_enabled:
+                _obs.disable()
     contract_policy = (
         {}
         if policy is None
@@ -264,6 +303,7 @@ def trace_contract(
         "metric": type(metric).__name__,
         "mesh": _mesh_descriptor(the_mesh, axis_name),
         **contract_policy,
+        **({"attested": True} if attested else {}),
         "entrypoints": {
             "update": {
                 "primitives": _primitive_multiset(jx_update),

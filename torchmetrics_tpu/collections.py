@@ -287,6 +287,10 @@ class MetricCollection(dict):
 
     def compute(self) -> Dict[str, Any]:
         res = {k: m.compute() for k, m in self.items(keep_base=True, copy_state=False)}
+        # each member attested itself inside its own compute(); this attests
+        # the collection-level sources (a committed SyncPolicy / quarantine
+        # quorum lives on the collection, not on any one member)
+        _telemetry.attest_compute(self)
         return self._to_renamed_dict(res)
 
     def reset(self) -> None:

@@ -540,6 +540,9 @@ class Metric:
             value = self.compute_state(state)
         if self.compute_with_cache:
             self._computed = value
+        # armed accuracy plane: attest the value's composed error bound and
+        # provenance (host-side config only — value itself is never inspected)
+        _telemetry.attest_compute(self)
         return value
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:

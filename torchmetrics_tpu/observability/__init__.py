@@ -32,12 +32,26 @@ Quick tour::
     obs.memory_report([fid, psnr])        # HBM watermarks, executable
                                           # analyses, ShardingAdvisor advice
 
+    obs.enable_accuracy_telemetry()       # arm the accuracy plane: every
+    obs.accuracy_report([auroc])          # compute() attests its composed
+                                          # error bound + provenance; shadow-
+                                          # exact audits check observed error
+
 The disabled fast path is a no-op: no compile-cache observer is registered,
 recording helpers return after one flag check, and nothing here touches
 cache keys — so telemetry can never cause a retrace.
 """
 
-from torchmetrics_tpu.observability import fleet, health, memory, tracing
+from torchmetrics_tpu.observability import accuracy, fleet, health, memory, tracing
+from torchmetrics_tpu.observability.accuracy import (
+    ShadowAuditor,
+    ValueAttestation,
+    accuracy_report,
+    accuracy_telemetry_enabled,
+    attest,
+    disable_accuracy_telemetry,
+    enable_accuracy_telemetry,
+)
 from torchmetrics_tpu.observability.export import (
     ChromeTraceExporter,
     Exporter,
@@ -48,6 +62,7 @@ from torchmetrics_tpu.observability.export import (
     TraceJSONLinesExporter,
     export,
     parse_export_line,
+    parse_stats,
 )
 from torchmetrics_tpu.observability.fleet import (
     FleetView,
@@ -57,6 +72,7 @@ from torchmetrics_tpu.observability.fleet import (
     process_index,
 )
 from torchmetrics_tpu.observability.health import (
+    AccuracyBudgetRule,
     Alert,
     AlertSink,
     BoundRule,
@@ -99,6 +115,7 @@ from torchmetrics_tpu.observability.registry import (
 )
 
 __all__ = [
+    "AccuracyBudgetRule",
     "Alert",
     "AlertSink",
     "BoundRule",
@@ -124,16 +141,24 @@ __all__ = [
     "SCHEMA_VERSION",
     "SEVERITIES",
     "SPAN_BUCKETS_US",
+    "ShadowAuditor",
     "ShardingAdvisor",
     "StalenessRule",
     "TraceEvent",
     "TraceJSONLinesExporter",
+    "ValueAttestation",
+    "accuracy",
+    "accuracy_report",
+    "accuracy_telemetry_enabled",
     "aggregate_telemetry",
+    "attest",
     "cost_by_fingerprint",
     "diff_report",
     "disable",
+    "disable_accuracy_telemetry",
     "disable_memory_telemetry",
     "enable",
+    "enable_accuracy_telemetry",
     "enable_memory_telemetry",
     "enabled",
     "export",
@@ -147,6 +172,7 @@ __all__ = [
     "memory_timeline",
     "observe",
     "parse_export_line",
+    "parse_stats",
     "process_count",
     "process_index",
     "report",

@@ -1,0 +1,615 @@
+"""Accuracy attestation plane: value provenance, an error-budget ledger, and
+shadow-exact audits.
+
+The four planes before this one (telemetry, flight recorder, fleet,
+memory/cost) attribute *cost*; this module attributes *accuracy*.  The
+library ships three sanctioned sources of inexactness — sketch states with
+data-dependent bounds (PR 7), int8/bf16 compressed collectives with
+predicted quantization bounds (PR 8), and quarantine-degraded quorums
+(PR 14) — whose bounds are declared or statically predicted but, until now,
+never stamped onto the values they affect nor verified at runtime.  Three
+layers close that gap:
+
+1. **Value attestations** — every ``Metric.compute()`` can emit a
+   :class:`ValueAttestation`: the composed worst-case error bound of the
+   reported value plus its full provenance chain (sketch grid geometry and
+   the data-dependent ``auc_error_bound`` where a curve histogram exists,
+   the committed ``SyncPolicy``'s compression mode with the predicted quant
+   bound from ``parallel/compress.py``, the surviving quorum fraction from
+   the schema-1.6 ``quorum`` block, the cadence policy, and the 12-hex
+   config fingerprint).  Attestations of *approximate* values land in the
+   telemetry registry (schema 1.7's ``attestation`` block), export as JSONL
+   kind ``"attestation"`` and ``tm_tpu_accuracy_*`` Prometheus families,
+   and mirror into the flight recorder's ``accuracy`` category.  Exact-path
+   metrics attest ``exact=True`` with a zero bound — and deliberately leave
+   the registry row untouched, so unapproximated reports stay byte-identical
+   to schema 1.6.
+2. **Error-budget ledger** — declared budgets (``approx_error``,
+   ``SyncPolicy.error_budget``) become a burn ledger: each provenance source
+   reports its predicted bound against its declared budget, and a latched
+   :class:`~torchmetrics_tpu.observability.health.AccuracyBudgetRule` fires
+   when the composed bound exceeds the declared budget (e.g. sketch eps
+   stacked on an int8 sync).
+3. **Shadow-exact audits** — a :class:`ShadowAuditor` keeps an exact twin of
+   an approximate/compressed metric, feeds it a *deterministic* sample of
+   update batches (seeded hash of a caller-supplied step index — no
+   wallclock, no RNG), and measures the *observed* ``|approx - exact|``
+   against the *predicted* bound.  Observed > predicted raises a
+   severity-critical health alert; wire the alert into
+   ``SyncAutotuner.guardrail_sink()`` and an out-of-budget compression
+   commit is vetoed or rolled back automatically.
+
+Everything is double-gated: :func:`enable_accuracy_telemetry` (or
+``TM_TPU_ACCURACY_TELEMETRY=1``) arms the plane, but nothing records until
+``observability.enable()`` is also on.  Arming adds **zero retraces and zero
+cache entries** on the primary update path: attestation reads only host-side
+config and telemetry (never traced values), and the shadow twin is a
+separate instance that owns its own cache entries.  Proven by the jaxpr
+bit-identity and ``cache_stats`` delta tests in ``test_accuracy.py``.
+
+Quick tour::
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.observability import accuracy
+
+    obs.enable()
+    accuracy.enable_accuracy_telemetry()   # or TM_TPU_ACCURACY_TELEMETRY=1
+    auroc = BinaryAUROC(approx="sketch")
+    ...                                    # train
+    auroc.compute()                        # attests itself into the registry
+    auroc.telemetry.as_dict()["attestation"]["bound"]
+    obs.export(accuracy.accuracy_report([auroc]), fmt="jsonl")
+
+    auditor = accuracy.ShadowAuditor(auroc, exact_twin, sample_rate=1 / 64,
+                                     sinks=[tuner.guardrail_sink()])
+    auditor.update(preds, target, step=step)   # twin sees a seeded sample
+    auditor.audit(step=step)                   # breach -> alert -> rollback
+
+A cheap, device-free example (the doctest tier-1 actually runs)::
+
+    >>> from torchmetrics_tpu.sketches.quantile import QuantileSketch
+    >>> from torchmetrics_tpu.observability.accuracy import compose_sources
+    >>> row = QuantileSketch(bins=200).provenance()
+    >>> bound, ledger = compose_sources([row])
+    >>> round(bound, 6)
+    0.005
+    >>> ledger[0]["source"]
+    'sketch'
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import math
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple, Union
+
+import numpy as np
+
+from torchmetrics_tpu.observability import registry
+
+__all__ = [
+    "ShadowAuditor",
+    "ValueAttestation",
+    "accuracy_report",
+    "accuracy_telemetry_enabled",
+    "attest",
+    "compose_sources",
+    "disable_accuracy_telemetry",
+    "enable_accuracy_telemetry",
+    "shadow_sampled",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+
+# ---------------------------------------------------------------------------
+# layer 1: provenance composition
+# ---------------------------------------------------------------------------
+
+
+def _committed_policy(metric: Any) -> Optional[Any]:
+    """The ``SyncPolicy`` the autotuner committed onto ``metric``, if any —
+    the same ``__dict__`` slot ``parallel/autotune.py`` installs (read
+    directly so the plane never imports the tuner)."""
+    d = getattr(metric, "__dict__", None)
+    return d.get("_autotuned_policy") if isinstance(d, dict) else None
+
+
+def _sketch_source(metric: Any) -> Optional[Dict[str, Any]]:
+    """Sketch provenance: grid geometry plus the data-dependent AUC bound
+    when the metric holds a ``(*prefix, 2, bins + 1)`` curve histogram."""
+    sketch = getattr(metric, "_sketch", None)
+    if sketch is None:
+        return None
+    hist = None
+    state = getattr(metric, "_state", None)
+    if isinstance(state, Mapping):
+        leaf = state.get("score_hist")
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and len(shape) >= 2 and shape[-2:] == (2, sketch.n_cells):
+            hist = leaf
+    row = sketch.provenance(hist)
+    row["budget"] = getattr(metric, "approx_error", None)
+    return row
+
+
+def _compression_source(metric: Any, policy: Any) -> Optional[Dict[str, Any]]:
+    if policy is None or policy.compression in (None, "none"):
+        return None
+    from torchmetrics_tpu.parallel.compress import compression_bound_provenance
+
+    return compression_bound_provenance(policy.compression, budget=policy.error_budget)
+
+
+def _quorum_source(metric: Any, n_devices: Optional[int]) -> Optional[Dict[str, Any]]:
+    """Quorum provenance: a degraded quorum is *sample-loss* provenance, not
+    an error bound — the surviving replicas' contributions are exact — so the
+    row carries ``bound`` 0 and names the fraction instead."""
+    t = registry.telemetry_for(metric, create=False)
+    quorum = t.quorum if t is not None else None
+    if quorum is None:
+        try:
+            from torchmetrics_tpu.resilience.quarantine import degradation_report, is_degraded
+
+            if not is_degraded(metric):
+                return None
+            quorum = degradation_report(metric, n_devices=n_devices)
+        except Exception:
+            return None
+    row: Dict[str, Any] = {
+        "source": "quorum",
+        "bound": 0.0,
+        "quarantined": len(quorum.get("quarantined", ())),
+    }
+    if quorum.get("quorum_fraction") is not None:
+        row["quorum_fraction"] = float(quorum["quorum_fraction"])
+    elif n_devices:
+        row["quorum_fraction"] = (int(n_devices) - row["quarantined"]) / int(n_devices)
+    return row
+
+
+def compose_sources(
+    sources: Iterable[Mapping[str, Any]],
+) -> Tuple[float, List[Dict[str, Any]]]:
+    """Fold provenance source rows into ``(composed_bound, ledger)``.
+
+    The composed worst-case bound is the *sum* of the per-source bounds
+    (approximation stages stack — a sketch eps on top of an int8 sync can at
+    worst add).  Each ledger row restates the source's bound against its
+    declared budget as a burn fraction; a missing budget leaves
+    ``within_budget`` at ``None`` rather than guessing.
+    """
+    bound = 0.0
+    ledger: List[Dict[str, Any]] = []
+    for src in sources:
+        b = float(src.get("bound", 0.0))
+        bound += b
+        budget = src.get("budget")
+        row: Dict[str, Any] = {"source": str(src.get("source", "?")), "bound": b, "budget": budget}
+        if budget is not None and float(budget) > 0.0:
+            row["burn"] = b / float(budget)
+            row["within_budget"] = b <= float(budget)
+        else:
+            row["within_budget"] = None
+        ledger.append(row)
+    return bound, ledger
+
+
+class ValueAttestation:
+    """The accuracy contract of one computed value: the composed worst-case
+    error bound, the provenance chain it came from, and the burn ledger of
+    every declared budget.  ``exact`` is True iff no approximation source is
+    active — a zero bound with an empty chain."""
+
+    __slots__ = (
+        "label",
+        "cls",
+        "fingerprint",
+        "exact",
+        "bound",
+        "sources",
+        "ledger",
+        "policy",
+        "quorum_fraction",
+        "within_budget",
+        "observed_err",
+        "step",
+    )
+
+    def __init__(
+        self,
+        label: str,
+        cls: str,
+        fingerprint: Optional[str],
+        sources: List[Dict[str, Any]],
+        policy: Optional[Dict[str, Any]] = None,
+        step: Optional[int] = None,
+    ) -> None:
+        self.label = label
+        self.cls = cls
+        self.fingerprint = fingerprint
+        self.sources = list(sources)
+        self.policy = dict(policy) if policy else None
+        self.step = None if step is None else int(step)
+        self.bound, self.ledger = compose_sources(self.sources)
+        self.exact = not self.sources
+        self.quorum_fraction = next(
+            (s.get("quorum_fraction") for s in self.sources if s.get("source") == "quorum"),
+            None,
+        )
+        judged = [r["within_budget"] for r in self.ledger if r["within_budget"] is not None]
+        self.within_budget = all(judged) if judged else None
+        #: measured ``|approx - exact|`` from the latest shadow audit, if one ran
+        self.observed_err: Optional[float] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "kind": "attestation",
+            "label": self.label,
+            "class": self.cls,
+            "fingerprint": self.fingerprint,
+            "exact": self.exact,
+            "bound": self.bound,
+            "sources": [dict(s) for s in self.sources],
+            "ledger": [dict(r) for r in self.ledger],
+            "within_budget": self.within_budget,
+        }
+        if self.policy is not None:
+            out["policy"] = dict(self.policy)
+        if self.quorum_fraction is not None:
+            out["quorum_fraction"] = self.quorum_fraction
+        if self.observed_err is not None:
+            out["observed_err"] = float(self.observed_err)
+        if self.step is not None:
+            out["step"] = self.step
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        tag = "exact" if self.exact else f"bound={self.bound:.3g}"
+        return f"ValueAttestation({self.label}, {tag}, sources={len(self.sources)})"
+
+
+def attest(
+    metric: Any,
+    *,
+    step: Optional[int] = None,
+    n_devices: Optional[int] = None,
+) -> ValueAttestation:
+    """Compose ``metric``'s :class:`ValueAttestation` from host-side config
+    and telemetry alone — sketch geometry, committed sync policy, quorum
+    block, config fingerprint.  Never reads traced values and never touches
+    compiled code, so calling it (or having ``compute()`` call it while the
+    plane is armed) cannot change a cache key or add a retrace."""
+    t = registry.telemetry_for(metric, create=False)
+    label = t.label if t is not None else type(metric).__name__
+    fingerprint = None
+    try:
+        from torchmetrics_tpu.core.compile import _fingerprint_hash, config_fingerprint
+
+        fingerprint = _fingerprint_hash(config_fingerprint(metric))
+    except Exception:
+        _log.debug("config fingerprint failed for %r", metric, exc_info=True)
+    policy = _committed_policy(metric)
+    policy_block = None
+    if policy is not None:
+        policy_block = {
+            "every_n": None if policy.at_compute else policy.every_n_steps,
+            "at_compute": bool(policy.at_compute),
+            "compression": policy.compression,
+            "error_budget": policy.error_budget,
+        }
+    sources = [
+        src
+        for src in (
+            _sketch_source(metric),
+            _compression_source(metric, policy),
+            _quorum_source(metric, n_devices),
+        )
+        if src is not None
+    ]
+    return ValueAttestation(
+        label, type(metric).__name__, fingerprint, sources, policy=policy_block, step=step
+    )
+
+
+def _attest_and_record(metric: Any) -> None:
+    """The registry's installed attestor: compose and stamp (approximate
+    values only — :func:`registry.record_attestation` clears the slot for
+    exact attestations, keeping unapproximated reports byte-identical)."""
+    registry.record_attestation(metric, attest(metric).as_dict())
+
+
+# ---------------------------------------------------------------------------
+# arming (the second half of the double gate)
+# ---------------------------------------------------------------------------
+
+
+def enable_accuracy_telemetry() -> None:
+    """Arm the accuracy plane: every ``Metric.compute()`` /
+    ``MetricCollection.compute()`` attests its value into the registry.
+
+    Nothing records until ``observability.enable()`` is also on.  Arming
+    changes no cache key and adds no retrace: attestation reads host-side
+    config/telemetry outside traced code."""
+    registry.set_accuracy_attestor(_attest_and_record)
+    registry.set_accuracy_armed(True)
+
+
+def disable_accuracy_telemetry() -> None:
+    """Disarm the accuracy plane.  Recorded attestations are kept (clear
+    them with ``reset_telemetry()``); new computes stop attesting."""
+    registry.set_accuracy_armed(False)
+
+
+def accuracy_telemetry_enabled() -> bool:
+    """True while the accuracy plane is armed (the registry gate)."""
+    return registry.accuracy_armed()
+
+
+# ---------------------------------------------------------------------------
+# layer 3: shadow-exact audits
+# ---------------------------------------------------------------------------
+
+
+def shadow_sampled(step: int, *, sample_rate: float, seed: int = 0) -> bool:
+    """Deterministically decide whether ``step`` is in the shadow sample.
+
+    A seeded SHA-256 of the caller-supplied step index, mapped to ``[0, 1)``
+    and compared against ``sample_rate`` — no wallclock, no RNG state, so the
+    same (seed, step) samples identically on every host and every rerun."""
+    digest = hashlib.sha256(f"{int(seed)}:{int(step)}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0**64 < sample_rate
+
+
+class ShadowAuditor:
+    """Exact twin + deterministic sampling + observed-vs-predicted audits.
+
+    ``metric`` is the approximate/compressed primary; ``exact_twin`` is an
+    exact-path instance of the same metric (the caller constructs it —
+    switching a sketch config back to exact is a construction-time decision
+    the auditor cannot deep-copy its way to).  ``update(..., step=N)``
+    always updates the primary and, on a :func:`shadow_sampled` step, the
+    twin; :meth:`audit` computes both and measures the observed
+    ``|approx - exact|`` (max over result leaves, absolute and relative)
+    against the predicted composed bound.
+
+    Observed > predicted raises a severity-``critical``
+    :class:`~torchmetrics_tpu.observability.health.Alert` through every
+    configured sink.  Pass ``tuner.guardrail_sink()`` as a sink and the
+    :class:`~torchmetrics_tpu.parallel.autotune.SyncAutotuner` vetoes a
+    trialling commit or rolls back a committed one — the audit closes the
+    PR 11 loop with *measured* error.  Audits also fold the observed
+    relative error into the primary's telemetry (the ``attestation`` slot's
+    ``observed_err``, plus the compressed bucket's ``quant_rel_err`` row
+    when a compression policy is committed) so ``SyncAdvisor.recommend``
+    and the fleet skew axis see it.
+
+    The primary's update path is untouched: the twin is a separate instance
+    owning its own compile-cache entries, and sampling is one hash on the
+    host.  Zero retraces on the primary by construction (proven in
+    ``test_accuracy.py``).
+    """
+
+    def __init__(
+        self,
+        metric: Any,
+        exact_twin: Any,
+        *,
+        sample_rate: float = 1.0 / 16.0,
+        seed: int = 0,
+        predicted_bound: Optional[float] = None,
+        sinks: Optional[List[Any]] = None,
+        series: Optional[str] = None,
+    ) -> None:
+        if not (0.0 < sample_rate <= 1.0):
+            raise ValueError(f"sample_rate must be in (0, 1], got {sample_rate}")
+        if exact_twin is metric:
+            raise ValueError("exact_twin must be a distinct instance, not the metric itself")
+        self.metric = metric
+        self.twin = exact_twin
+        self.sample_rate = float(sample_rate)
+        self.seed = int(seed)
+        #: explicit override of the composed predicted bound; ``None`` means
+        #: every audit re-composes :func:`attest` (so a policy change between
+        #: audits is judged against its own bound)
+        self.predicted_bound = predicted_bound
+        self.sinks: List[Any] = list(sinks) if sinks else []
+        self.series = series if series is not None else f"accuracy/{type(metric).__name__}"
+        self._updates = 0
+        self._sampled = 0
+        self._audits = 0
+        self._breaches = 0
+        self._last: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------- feeding
+    def sampled(self, step: int) -> bool:
+        return shadow_sampled(step, sample_rate=self.sample_rate, seed=self.seed)
+
+    def update(self, *args: Any, step: int, **kwargs: Any) -> bool:
+        """Update the primary (always) and the twin (on sampled steps).
+        Returns whether the twin saw this batch."""
+        self.metric.update(*args, **kwargs)
+        self._updates += 1
+        take = self.sampled(step)
+        if take:
+            self.twin.update(*args, **kwargs)
+            self._sampled += 1
+        return take
+
+    # ------------------------------------------------------------- auditing
+    @staticmethod
+    def _observed_error(approx: Any, exact: Any) -> Tuple[float, float]:
+        """``(abs_err, rel_err)`` over the result pytrees: max absolute leaf
+        deviation, and the same normalized by the exact result's magnitude."""
+        import jax
+
+        abs_err = 0.0
+        scale = 0.0
+        for a, b in zip(jax.tree.leaves(approx), jax.tree.leaves(exact)):
+            av = np.asarray(a, dtype=np.float64)
+            bv = np.asarray(b, dtype=np.float64)
+            if av.size == 0 or bv.size == 0 or av.shape != bv.shape:
+                continue
+            abs_err = max(abs_err, float(np.max(np.abs(av - bv))))
+            scale = max(scale, float(np.max(np.abs(bv))))
+        return abs_err, abs_err / max(scale, 1e-12)
+
+    def audit(self, step: int = 0) -> Dict[str, Any]:
+        """Compute both paths and judge observed against predicted.
+
+        Returns the audit record; a breach additionally emits the critical
+        alert through every sink and mirrors into the flight recorder."""
+        attestation = attest(self.metric, step=step)
+        predicted = (
+            float(self.predicted_bound)
+            if self.predicted_bound is not None
+            else attestation.bound
+        )
+        abs_err, rel_err = self._observed_error(self.metric.compute(), self.twin.compute())
+        observed = rel_err
+        breach = observed > predicted and math.isfinite(observed)
+        self._audits += 1
+        record = {
+            "step": int(step),
+            "observed_abs": abs_err,
+            "observed_rel": rel_err,
+            "predicted_bound": predicted,
+            "breach": breach,
+            "sampled_updates": self._sampled,
+            "updates": self._updates,
+        }
+        self._last = record
+        # fold the measurement back into the plane: the attestation slot's
+        # observed_err, and (under a committed compression policy) the
+        # compressed sum bucket's quant_rel_err row the SyncAdvisor reads
+        attestation.observed_err = observed
+        registry.record_attestation(self.metric, attestation.as_dict())
+        policy = _committed_policy(self.metric)
+        if policy is not None and policy.compression not in (None, "none"):
+            registry.record_quant_error(self.metric, "float32/sum", observed)
+        registry.accuracy_trace(
+            attestation.label,
+            "audit_breach" if breach else "audit",
+            {
+                "observed_rel": observed,
+                "predicted_bound": predicted,
+                "step": int(step),
+            },
+        )
+        if breach:
+            self._breaches += 1
+            from torchmetrics_tpu.observability.health import Alert
+
+            alert = Alert(
+                self.series,
+                "shadow_audit",
+                "critical",
+                step,
+                observed,
+                f"observed error {observed:.3g} exceeds predicted bound "
+                f"{predicted:.3g} (shadow-exact audit over {self._sampled} "
+                f"sampled of {self._updates} update batches)",
+                {
+                    "observed_abs": abs_err,
+                    "observed_rel": rel_err,
+                    "predicted_bound": predicted,
+                    "sample_rate": self.sample_rate,
+                },
+            )
+            for sink in self.sinks:
+                try:
+                    sink.emit(alert)
+                except Exception:  # a broken pager must not break the audit
+                    _log.debug("shadow audit sink %r failed", sink, exc_info=True)
+        return record
+
+    # ------------------------------------------------------------- reading
+    def report(self) -> Dict[str, Any]:
+        return {
+            "series": self.series,
+            "sample_rate": self.sample_rate,
+            "seed": self.seed,
+            "updates": self._updates,
+            "sampled_updates": self._sampled,
+            "audits": self._audits,
+            "breaches": self._breaches,
+            "last": dict(self._last) if self._last else None,
+        }
+
+
+# ---------------------------------------------------------------------------
+# the front-door report
+# ---------------------------------------------------------------------------
+
+
+def accuracy_report(
+    metrics: Optional[Iterable[Union[Any, Tuple[str, Any]]]] = None,
+    n_devices: Optional[int] = None,
+    auditors: Optional[Iterable[ShadowAuditor]] = None,
+) -> Dict[str, Any]:
+    """One ``kind: "attestation"`` payload tying the plane together, ready
+    for ``observability.export`` (the JSONL line parses back through
+    ``parse_export_line``; the Prometheus exporter renders the
+    ``tm_tpu_accuracy_*`` families from it).
+
+    Layout::
+
+        {"schema": 1, "kind": "attestation", "armed": bool, "enabled": bool,
+         "accuracy": {
+            "attestations": {label: attestation-dict, ...},
+            "ledger": [{"label", "source", "bound", "budget", ...}, ...],
+            "audits": [ShadowAuditor.report(), ...]}}      # iff given
+
+    ``metrics`` (when given) attests those instances explicitly — including
+    exact ones, which appear here with ``exact: true`` even though they never
+    occupy a registry slot.  Without ``metrics``, the report carries whatever
+    attestations the armed plane already stamped into the registry.
+    """
+    attestations: Dict[str, Any] = {}
+    if metrics is not None:
+        for item in metrics:
+            if isinstance(item, tuple) and len(item) == 2 and isinstance(item[0], str):
+                label, metric = item
+                att = attest(metric, n_devices=n_devices).as_dict()
+                att["label"] = label
+            else:
+                metric = item
+                att = attest(metric, n_devices=n_devices).as_dict()
+                label = att["label"]
+            attestations[label] = att
+    else:
+        rep = registry.report()
+        for label, row in rep.get("metrics", {}).items():
+            if isinstance(row.get("attestation"), Mapping):
+                attestations[label] = dict(row["attestation"])
+    ledger = [
+        {"label": label, **row}
+        for label, att in sorted(attestations.items())
+        for row in att.get("ledger", ())
+    ]
+    payload: Dict[str, Any] = {
+        "schema": 1,
+        "kind": "attestation",
+        "armed": accuracy_telemetry_enabled(),
+        "enabled": registry.enabled(),
+        "accuracy": {"attestations": attestations, "ledger": ledger},
+    }
+    if auditors is not None:
+        payload["accuracy"]["audits"] = [a.report() for a in auditors]
+    return payload
+
+
+# the attestor is harmless to install eagerly (it only runs once armed), and
+# installing it here means arming via the registry flag alone also works
+registry.set_accuracy_attestor(_attest_and_record)
+
+# honour TM_TPU_ACCURACY_TELEMETRY=1 the way registry honours TM_TPU_TELEMETRY
+if os.environ.get("TM_TPU_ACCURACY_TELEMETRY", "").strip().lower() in (
+    "1",
+    "true",
+    "on",
+    "yes",
+):  # pragma: no cover - env-driven path
+    enable_accuracy_telemetry()

@@ -41,6 +41,8 @@ __all__ = [
     "TraceJSONLinesExporter",
     "export",
     "parse_export_line",
+    "parse_stats",
+    "reset_parse_stats",
 ]
 
 #: Semver of the machine-readable export payloads (JSONL lines, Chrome-trace
@@ -69,9 +71,38 @@ __all__ = [
 #: / ``quarantines`` counters (and their ``tm_tpu_*_total`` Prometheus
 #: families), an optional ``degraded`` block on fleet reports naming the
 #: quarantined processes excluded from the merge, and a ``quorum`` block on
-#: reports produced while replica quarantine is active.
-SCHEMA_VERSION = "1.6.0"
+#: reports produced while replica quarantine is active; 1.7 added the
+#: accuracy attestation plane — an optional ``attestation`` block on metric
+#: rows (composed error bound + provenance chain + budget ledger, approximate
+#: values only), ``kind: "attestation"`` payloads from
+#: ``observability/accuracy.py``, the ``tm_tpu_accuracy_*`` Prometheus
+#: families, and the ``accuracy`` flight-recorder category.
+SCHEMA_VERSION = "1.7.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
+
+
+#: running tallies of :func:`parse_export_line` outcomes — the pre-1.1
+#: leniency is no longer silent: a consumer can audit how much of its input
+#: rode the legacy path (and the first legacy line logs once at DEBUG)
+_PARSE_STATS = {"parsed": 0, "legacy_unversioned": 0, "rejected": 0}
+_LEGACY_LOGGED = False
+
+
+def parse_stats() -> Dict[str, int]:
+    """Counters of :func:`parse_export_line` outcomes since import (or the
+    last :func:`reset_parse_stats`): ``parsed`` lines accepted with a
+    version, ``legacy_unversioned`` lines accepted through the pre-1.1
+    leniency, ``rejected`` lines that raised."""
+    return dict(_PARSE_STATS)
+
+
+def reset_parse_stats() -> None:
+    """Zero the :func:`parse_stats` counters (and re-arm the one-time
+    legacy-line debug log)."""
+    global _LEGACY_LOGGED
+    for key in _PARSE_STATS:
+        _PARSE_STATS[key] = 0
+    _LEGACY_LOGGED = False
 
 
 def parse_export_line(line: str) -> Dict[str, Any]:
@@ -79,25 +110,48 @@ def parse_export_line(line: str) -> Dict[str, Any]:
     the schema-version contract.
 
     Lines without a ``schema_version`` (pre-1.1 exports) are accepted as
-    legacy major 1.  A present-but-unparseable version, or a major version
-    other than ``SCHEMA_MAJOR``, raises ``ValueError`` — a consumer must not
-    silently misread a payload whose layout it cannot know.
+    legacy major 1 — counted in :func:`parse_stats` and logged once at DEBUG
+    so the leniency is auditable rather than silent.  A
+    present-but-unparseable version, or a major version other than
+    ``SCHEMA_MAJOR``, raises ``ValueError`` — a consumer must not silently
+    misread a payload whose layout it cannot know.
     """
-    payload = json.loads(line)
-    if not isinstance(payload, dict):
-        raise ValueError(f"telemetry export line is not a JSON object: {type(payload).__name__}")
+    global _LEGACY_LOGGED
+    try:
+        payload = json.loads(line)
+        if not isinstance(payload, dict):
+            raise ValueError(
+                f"telemetry export line is not a JSON object: {type(payload).__name__}"
+            )
+    except ValueError:
+        _PARSE_STATS["rejected"] += 1
+        raise
     version = payload.get("schema_version")
     if version is None:
-        return payload  # legacy pre-1.1 line: implied major 1
+        # legacy pre-1.1 line: implied major 1
+        _PARSE_STATS["legacy_unversioned"] += 1
+        if not _LEGACY_LOGGED:
+            _LEGACY_LOGGED = True
+            from torchmetrics_tpu.utilities.prints import rank_zero_debug
+
+            rank_zero_debug(
+                "parse_export_line: accepted a line without schema_version (legacy "
+                "pre-1.1 export); further legacy lines are counted in parse_stats() "
+                "without logging"
+            )
+        return payload
     try:
         major = int(str(version).split(".", 1)[0])
     except ValueError:
+        _PARSE_STATS["rejected"] += 1
         raise ValueError(f"unparseable telemetry schema_version {version!r}") from None
     if major != SCHEMA_MAJOR:
+        _PARSE_STATS["rejected"] += 1
         raise ValueError(
             f"unsupported telemetry schema_version {version!r}: this reader understands "
             f"major {SCHEMA_MAJOR} only"
         )
+    _PARSE_STATS["parsed"] += 1
     return payload
 
 _log = logging.getLogger("torchmetrics_tpu.observability")
@@ -594,6 +648,71 @@ class PrometheusExporter(Exporter):
                         f"{mw_name}{_labels(metric=cand.get('metric'), leaf=cand.get('leaf'), process=proc)} "
                         f"{int(cand.get('replicated_waste_bytes', 0))}"
                     )
+
+        # accuracy attestations (observability/accuracy.py): per-metric-row
+        # ``attestation`` blocks on registry reports, plus the attestations /
+        # ledger of a ``kind: "attestation"`` accuracy_report() payload
+        attestations: Dict[str, Mapping[str, Any]] = {
+            label: row["attestation"]
+            for label, row in rows.items()
+            if isinstance(row.get("attestation"), Mapping)
+        }
+        accuracy = report.get("accuracy")
+        if isinstance(accuracy, Mapping):
+            for label, att in accuracy.get("attestations", {}).items():
+                if isinstance(att, Mapping):
+                    attestations[str(label)] = att
+        if attestations:
+            ab_name = f"{ns}_accuracy_error_bound"
+            out.append(
+                f"# HELP {ab_name} Composed worst-case error bound attested for the "
+                "metric's last computed value (0 for exact-path values)."
+            )
+            out.append(f"# TYPE {ab_name} gauge")
+            for label, att in sorted(attestations.items()):
+                out.append(
+                    f"{ab_name}{_labels(metric=label, exact=str(bool(att.get('exact', False))).lower(), process=proc)} "
+                    f"{repr(float(att.get('bound', 0.0)))}"
+                )
+            burn_name = f"{ns}_accuracy_budget_burn"
+            out.append(
+                f"# HELP {burn_name} Error-budget burn per provenance source: predicted "
+                "bound over declared budget (1.0 = budget fully consumed)."
+            )
+            out.append(f"# TYPE {burn_name} gauge")
+            for label, att in sorted(attestations.items()):
+                for lrow in att.get("ledger", ()):
+                    if lrow.get("burn") is None:
+                        continue
+                    out.append(
+                        f"{burn_name}{_labels(metric=label, source=lrow.get('source'), process=proc)} "
+                        f"{repr(float(lrow['burn']))}"
+                    )
+            wb_name = f"{ns}_accuracy_within_budget"
+            out.append(
+                f"# HELP {wb_name} Whether every budgeted provenance source's predicted "
+                "bound fits its declared budget (1 = within, 0 = over; sources without "
+                "a declared budget emit nothing)."
+            )
+            out.append(f"# TYPE {wb_name} gauge")
+            for label, att in sorted(attestations.items()):
+                wb = att.get("within_budget")
+                if wb is None:
+                    continue
+                out.append(f"{wb_name}{_labels(metric=label, process=proc)} {int(bool(wb))}")
+            obs_name = f"{ns}_accuracy_observed_err"
+            out.append(
+                f"# HELP {obs_name} Observed |approx - exact| relative error from the "
+                "latest shadow-exact audit (only metrics with an audited attestation)."
+            )
+            out.append(f"# TYPE {obs_name} gauge")
+            for label, att in sorted(attestations.items()):
+                if att.get("observed_err") is None:
+                    continue
+                out.append(
+                    f"{obs_name}{_labels(metric=label, process=proc)} "
+                    f"{repr(float(att['observed_err']))}"
+                )
 
         text = "\n".join(out) + "\n"
         if self.path is not None:

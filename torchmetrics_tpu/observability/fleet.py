@@ -295,6 +295,31 @@ class FleetView:
             merged = aggregate_telemetry(rows)
             merged["label"] = label
             merged["class"] = rows[0].get("class", label)
+            # accuracy attestations merge pessimistically: the pod-level bound
+            # for a label is the WORST per-process composed bound (a value is
+            # only as trustworthy as its least-trustworthy replica), stamped
+            # with the process that attested it (aggregate_telemetry drops
+            # unknown keys, so the merge is explicit here)
+            attested = [
+                (pos, row["attestation"])
+                for pos, row in enumerate(rows)
+                if isinstance(row.get("attestation"), Mapping)
+            ]
+            if attested:
+                worst_pos, worst = max(
+                    attested, key=lambda pa: float(pa[1].get("bound", 0.0))
+                )
+                att = dict(worst)
+                att["worst_process"] = worst_pos
+                att["processes_attesting"] = len(attested)
+                observed = [
+                    float(a.get("observed_err"))
+                    for _, a in attested
+                    if a.get("observed_err") is not None
+                ]
+                if observed:
+                    att["observed_err"] = max(observed)
+                merged["attestation"] = att
             out[label] = merged
         return dict(sorted(out.items()))
 
@@ -308,6 +333,7 @@ class FleetView:
         bytes_: Dict[int, float] = {}
         traces: Dict[int, float] = {}
         hbm: Dict[int, float] = {}
+        observed: Dict[int, float] = {}
         for pos, r in self._active():
             idx = self._index_of(pos)
             digest = sync_wait_digest(r)
@@ -319,6 +345,18 @@ class FleetView:
             traces[idx] = float(r.get("compile_cache", {}).get("traces", 0))
             mem = r.get("global", {}).get("memory")
             hbm[idx] = float(mem.get("current_bytes", 0)) if isinstance(mem, Mapping) else 0.0
+            # worst shadow-audited error this process measured, any metric: a
+            # replica whose observed error runs away from the fleet's is
+            # drifting (stale twin, divergent state, bad link), not just slow
+            observed[idx] = max(
+                (
+                    float(row["attestation"]["observed_err"])
+                    for row in r.get("metrics", {}).values()
+                    if isinstance(row.get("attestation"), Mapping)
+                    and row["attestation"].get("observed_err") is not None
+                ),
+                default=0.0,
+            )
         wait_axis = _axis_skew(waits)
         straggler = wait_axis["max_process"]
         return {
@@ -327,6 +365,7 @@ class FleetView:
             "sync_bytes": _axis_skew(bytes_),
             "retraces": _axis_skew(traces),
             "hbm_bytes": _axis_skew(hbm),
+            "observed_err": _axis_skew(observed),
             "straggler": {
                 "process": straggler,
                 "wait_total_us": waits[straggler],

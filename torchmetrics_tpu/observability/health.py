@@ -10,6 +10,9 @@ metrics said*.  A :class:`HealthMonitor` holds per-series streaming rules —
 * :class:`NonFiniteRule` — NaN/Inf observation rate above ``max_rate``,
 * :class:`MemoryBudgetRule` — live metric-state HBM (the armed memory
   plane's ``current_bytes`` watermark) above a configured byte budget,
+* :class:`AccuracyBudgetRule` — composed worst-case error bound (the armed
+  accuracy plane's attested ``bound``, or a shadow audit's observed error)
+  above the declared error budget,
 * :class:`StalenessRule` — a watched series not observed for more than
   ``max_stale_steps`` steps (checked on :meth:`HealthMonitor.advance`),
 
@@ -49,6 +52,7 @@ from collections import deque
 from typing import Any, Callable, Dict, IO, List, Mapping, Optional, Tuple
 
 __all__ = [
+    "AccuracyBudgetRule",
     "Alert",
     "AlertSink",
     "BoundRule",
@@ -437,6 +441,50 @@ class MemoryBudgetRule(HealthRule):
             f"live state HBM {int(value)} bytes exceeds budget "
             f"{self.budget_bytes} by {int(over)}",
             {"budget_bytes": self.budget_bytes, "over_bytes": over},
+        )
+
+
+class AccuracyBudgetRule(HealthRule):
+    """Composed worst-case error bound above the declared error budget.
+
+    Feed it the composed predicted bound the armed accuracy plane attests
+    (``attestation["bound"]``, or a :class:`~torchmetrics_tpu.observability.
+    accuracy.ShadowAuditor`'s observed error) with ``budget`` set to the
+    declared budget it must stay under (``approx_error``,
+    ``SyncPolicy.error_budget``, or their sum for stacked sources).  Fires
+    once per breach episode — the latch clears the first time the series
+    drops back to or under budget — same latch discipline as
+    :class:`MemoryBudgetRule`.
+    """
+
+    name = "accuracy_budget"
+
+    def __init__(self, budget: float, severity: str = "critical") -> None:
+        if not (budget > 0.0) or not math.isfinite(budget):
+            raise ValueError(f"AccuracyBudgetRule budget must be a finite float > 0, got {budget}")
+        self.budget = float(budget)
+        self.severity = severity
+        self._latched: Dict[str, bool] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None  # NonFiniteRule's jurisdiction
+        if value <= self.budget:
+            self._latched[series] = False
+            return None
+        if self._latched.get(series):
+            return None
+        self._latched[series] = True
+        over = value - self.budget
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"error bound {value:.6g} exceeds declared budget "
+            f"{self.budget:.6g} by {over:.3g}",
+            {"budget": self.budget, "over": over},
         )
 
 

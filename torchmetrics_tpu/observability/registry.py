@@ -43,8 +43,11 @@ __all__ = [
     "MetricTelemetry",
     "ObservationWindow",
     "SPAN_BUCKETS_US",
+    "accuracy_armed",
+    "accuracy_trace",
     "aggregate_telemetry",
     "annotate",
+    "attest_compute",
     "count",
     "count_existing",
     "diff_report",
@@ -53,6 +56,7 @@ __all__ = [
     "enabled",
     "memory_armed",
     "observe",
+    "record_attestation",
     "record_measured_sync",
     "record_quant_error",
     "record_state_install",
@@ -61,6 +65,9 @@ __all__ = [
     "record_sync_wait",
     "report",
     "reset_telemetry",
+    "set_accuracy_armed",
+    "set_accuracy_attestor",
+    "set_accuracy_trace_sink",
     "set_memory_armed",
     "set_memory_sizer",
     "set_memory_trace_sink",
@@ -187,6 +194,47 @@ def set_memory_trace_sink(sink: Optional[Callable[[str, int, int, bool], None]])
         _MEMORY_TRACE_SINK = sink
 
 
+# Accuracy-plane hooks (observability/accuracy.py).  The attestor turns a
+# metric instance into a :class:`~torchmetrics_tpu.observability.accuracy.
+# ValueAttestation` from registry/policy/sketch state alone; the trace sink
+# mirrors attestation events into the flight recorder's "accuracy" category.
+# ``_ACCURACY_ARMED`` is the second half of the plane's double gate — value
+# attestations compose only while telemetry is enabled *and* the accuracy
+# plane is armed, so plain ``enable()`` keeps its existing cost profile.
+_ACCURACY_ARMED = False
+_ACCURACY_ATTESTOR: Optional[Callable[[Any], None]] = None
+_ACCURACY_TRACE_SINK: Optional[Callable[[str, str, Dict[str, Any]], None]] = None
+
+
+def set_accuracy_armed(armed: bool) -> None:
+    """Arm (or disarm) compute-time value attestations.  Prefer the front
+    door, :func:`observability.accuracy.enable_accuracy_telemetry`."""
+    global _ACCURACY_ARMED
+    with _LOCK:
+        _ACCURACY_ARMED = bool(armed)
+
+
+def accuracy_armed() -> bool:
+    return _ACCURACY_ARMED
+
+
+def set_accuracy_attestor(attestor: Optional[Callable[[Any], None]]) -> None:
+    """Install the compute-time attestor: ``attestor(metric)`` composes and
+    records the metric's :class:`ValueAttestation` (observability/accuracy.py
+    owns the composition; the registry only gates the call)."""
+    global _ACCURACY_ATTESTOR
+    with _LOCK:
+        _ACCURACY_ATTESTOR = attestor
+
+
+def set_accuracy_trace_sink(sink: Optional[Callable[[str, str, Dict[str, Any]], None]]) -> None:
+    """Install (or clear) the flight-recorder accuracy sink:
+    ``sink(label, event, payload)`` fires per attestation/audit event."""
+    global _ACCURACY_TRACE_SINK
+    with _LOCK:
+        _ACCURACY_TRACE_SINK = sink
+
+
 class SpanStats:
     """Fixed-size latency accumulator: count/total/max, EMA, and a
     log-bucketed histogram.  O(1) memory regardless of sample count."""
@@ -241,7 +289,17 @@ class MetricTelemetry:
     """Counters, per-entrypoint cache stats, and timing spans for one metric
     instance (or one synthetic aggregate like ``_retired``)."""
 
-    __slots__ = ("label", "cls", "counters", "cache", "spans", "sync_buckets", "memory", "quorum")
+    __slots__ = (
+        "label",
+        "cls",
+        "counters",
+        "cache",
+        "spans",
+        "sync_buckets",
+        "memory",
+        "quorum",
+        "attestation",
+    )
 
     def __init__(self, label: str, cls: str) -> None:
         self.label = label
@@ -260,6 +318,12 @@ class MetricTelemetry:
         #: live state-HBM watermarks, filled by :func:`record_state_install`
         #: while the memory plane is armed (observability/memory.py)
         self.memory: Dict[str, Any] = self._fresh_memory()
+        #: latest compute-time value attestation (schema 1.7 ``attestation``
+        #: block), stamped by :func:`record_attestation` while the accuracy
+        #: plane is armed and the value carries a nonzero bound — exact
+        #: computes leave the slot ``None`` so unapproximated reports stay
+        #: byte-identical to 1.6 (same contract as ``quorum``)
+        self.attestation: Optional[Dict[str, Any]] = None
 
     @staticmethod
     def _fresh_memory() -> Dict[str, Any]:
@@ -387,6 +451,7 @@ class MetricTelemetry:
         self.sync_buckets = {}
         self.memory = self._fresh_memory()
         self.quorum = None
+        self.attestation = None
 
     @property
     def active(self) -> bool:
@@ -433,6 +498,10 @@ class MetricTelemetry:
             # only while degraded: healthy reports stay byte-identical to 1.5
             if self.quorum is not None:
                 out["quorum"] = dict(self.quorum)
+            # only for approximate values: exact computes stay byte-identical
+            # to 1.6 (the attestor records them out-of-band instead)
+            if self.attestation is not None:
+                out["attestation"] = dict(self.attestation)
             return out
 
     # ``m.telemetry.snapshot()`` reads nicer than ``as_dict`` at call sites
@@ -845,6 +914,63 @@ def record_quant_error(obj: Any, bucket_key: str, rel_err: float) -> None:
     with _LOCK:
         t = telemetry_for(obj)
         t.record_quant_error(bucket_key, float(rel_err))
+
+
+def attest_compute(obj: Any) -> None:
+    """Compose and record ``obj``'s value attestation after a ``compute``.
+
+    Double-gated like :func:`record_state_install`: a no-op unless telemetry
+    is enabled *and* the accuracy plane is armed
+    (:func:`observability.accuracy.enable_accuracy_telemetry`).  The installed
+    attestor reads only host-side config/telemetry (sketch geometry, committed
+    sync policy, quorum block) — never device buffers or traced values — so
+    the armed path stays off the trace and adds no retraces.  Never raises."""
+    if not _ENABLED or not _ACCURACY_ARMED:
+        return
+    attestor = _ACCURACY_ATTESTOR
+    if attestor is None:
+        return
+    try:
+        attestor(obj)
+    except Exception:
+        _log.debug("value attestation failed for %r", obj, exc_info=True)
+
+
+def accuracy_trace(label: str, event: str, payload: Mapping[str, Any]) -> None:
+    """Mirror one accuracy-plane event (attest / audit / audit_breach) into
+    the flight recorder's "accuracy" category, when a recorder is armed.
+    Same double gate as :func:`record_attestation`."""
+    if not _ENABLED or not _ACCURACY_ARMED:
+        return
+    sink = _ACCURACY_TRACE_SINK
+    if sink is not None:
+        sink(label, event, dict(payload))
+
+
+def record_attestation(obj: Any, attestation: Optional[Mapping[str, Any]]) -> None:
+    """Stamp (or clear, with ``None``/exact) the schema-1.7 ``attestation``
+    block on ``obj``'s telemetry row and mirror the event into the flight
+    recorder's "accuracy" category.  Exact (zero-bound) attestations clear
+    the slot so unapproximated reports stay byte-identical to schema 1.6."""
+    if not _ENABLED or not _ACCURACY_ARMED:
+        return
+    with _LOCK:
+        t = telemetry_for(obj)
+        if attestation is None or attestation.get("exact", False):
+            t.attestation = None
+        else:
+            t.attestation = dict(attestation)
+    sink = _ACCURACY_TRACE_SINK
+    if sink is not None and attestation is not None:
+        sink(
+            t.label,
+            "attest",
+            {
+                "exact": bool(attestation.get("exact", False)),
+                "bound": float(attestation.get("bound", 0.0)),
+                "within_budget": attestation.get("within_budget"),
+            },
+        )
 
 
 # ------------------------------------------------------------------ reporting

@@ -64,7 +64,7 @@ __all__ = [
 
 #: event categories the recorder emits (the ``cat`` field); Perfetto's track
 #: filter groups on these
-CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy", "memory")
+CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy", "memory", "accuracy")
 
 DEFAULT_CAPACITY = 4096
 
@@ -395,6 +395,16 @@ def _memory_sink(label: str, current_bytes: int, peak_bytes: int, donated: bool)
     )
 
 
+def _accuracy_sink(label: str, event: str, payload: Mapping[str, Any]) -> None:
+    """Registry accuracy hook (armed accuracy plane): attestations and shadow
+    audits become instants, so a trace shows *when* a bound was stamped and
+    when an audit breached it."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.instant(f"{label}/{event}", "accuracy", tid=label, **payload)
+
+
 def _compile_sink(record: Any) -> None:
     """Compile-cache timing hook (``core.compile.CompileRecord``)."""
     rec = _RECORDER
@@ -420,10 +430,12 @@ def _wire_sinks(arm: bool) -> None:
     if arm:
         _registry.set_trace_sinks(_span_sink, _count_sink)
         _registry.set_memory_trace_sink(_memory_sink)
+        _registry.set_accuracy_trace_sink(_accuracy_sink)
         _compile.add_compile_timing_observer(_compile_sink)
     else:
         _registry.set_trace_sinks(None, None)
         _registry.set_memory_trace_sink(None)
+        _registry.set_accuracy_trace_sink(None)
         _compile.remove_compile_timing_observer(_compile_sink)
 
 

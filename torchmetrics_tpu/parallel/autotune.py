@@ -415,6 +415,31 @@ class SyncAutotuner:
         elif self.state == "committed" and self._previous is not None:
             self.rollback(reason="health_alert", alert=alert)
 
+    def attach_shadow_auditor(
+        self,
+        exact_twin: Any,
+        *,
+        sample_rate: float = 1.0 / 16.0,
+        seed: int = 0,
+        min_severity: Optional[str] = None,
+        **kwargs: Any,
+    ) -> Any:
+        """A :class:`~torchmetrics_tpu.observability.accuracy.ShadowAuditor`
+        on this tuner's target whose breach alerts feed straight into
+        :meth:`guardrail_sink` — the measured-error guardrail: a shadow-exact
+        audit observing more error than the committed policy's predicted
+        bound vetoes the trial or rolls the commit back, in-band."""
+        from torchmetrics_tpu.observability.accuracy import ShadowAuditor
+
+        return ShadowAuditor(
+            self.target,
+            exact_twin,
+            sample_rate=sample_rate,
+            seed=seed,
+            sinks=[self.guardrail_sink(min_severity)],
+            **kwargs,
+        )
+
     def report_divergence(self, error: Exception) -> Optional[Dict[str, Any]]:
         """Feed a :class:`ReplicaDivergenceError` raised by the divergence
         verifier into the loop: veto the pending trial or roll back the

@@ -1152,11 +1152,34 @@ class SyncAdvisor:
         declared error bound fits ``self.error_budget`` (and actually cuts
         bytes) is named ``recommended_mode``; with no budget declared the
         advice stays ``"none"`` — quantized syncs are an explicit opt-in.
+
+        Measured evidence trumps the model: when the accuracy plane has
+        recorded *observed* quantization error for a mode (shadow-exact
+        audits / ``record_quant_error`` rows on the target's sync buckets),
+        the mode's row carries the mean observed relative error, and a mode
+        observed over budget is struck from ``recommended_mode`` eligibility
+        even if its predicted bound fits.
         """
+        from torchmetrics_tpu.observability import registry as _telemetry
         from torchmetrics_tpu.utilities.benchmark import coalesced_sync_bytes_per_chip
 
         n_dev = int(self.mesh.devices.size)
         members = self._member_metrics()
+
+        # observed quantization error by mode, pooled over the target's (and
+        # members') compressed sync buckets
+        observed: Dict[str, List[float]] = {}
+        pool = {id(obj): obj for obj in (self.target, *members)}
+        for obj in pool.values():
+            row = _telemetry.telemetry_for(obj).as_dict()
+            for b in row.get("sync_buckets", {}).values():
+                mode = b.get("compression")
+                count = int(b.get("quant_err_count", 0))
+                if mode in (None, "none") or not count:
+                    continue
+                observed.setdefault(str(mode), []).append(
+                    float(b.get("quant_rel_err_sum", 0.0)) / count
+                )
 
         def model_bytes(cfg: Optional[CompressionConfig]) -> int:
             total = 0
@@ -1173,18 +1196,30 @@ class SyncAdvisor:
             cfg = CompressionConfig(mode=mode, error_budget=self.error_budget)
             wire = model_bytes(cfg)
             bound = predicted_error_bound(mode, stages=2 if mode == "int8" else 1)
-            modes[mode] = {
+            row = {
                 "model_wire_bytes": wire,
                 "model_byte_cut": exact / max(wire, 1),
                 "error_bound": bound,
                 "within_budget": self.error_budget is not None and bound <= self.error_budget,
             }
+            if mode in observed:
+                samples = observed[mode]
+                row["observed_rel_err"] = sum(samples) / len(samples)
+                row["observed_samples"] = len(samples)
+                row["observed_within_budget"] = (
+                    self.error_budget is not None
+                    and row["observed_rel_err"] <= self.error_budget
+                )
+            modes[mode] = row
         recommended = "none"
         if self.error_budget is not None:
             eligible = [
                 (row["model_byte_cut"], mode)
                 for mode, row in modes.items()
-                if row["within_budget"] and row["model_byte_cut"] > 1.0
+                if row["within_budget"]
+                and row["model_byte_cut"] > 1.0
+                # measured over-budget error disqualifies regardless of model
+                and row.get("observed_within_budget", True)
             ]
             if eligible:
                 recommended = max(eligible)[1]

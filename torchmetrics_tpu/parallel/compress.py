@@ -61,6 +61,7 @@ __all__ = [
     "SCALE_BYTES",
     "bucket_wire_bytes",
     "compressed_psum",
+    "compression_bound_provenance",
     "compression_spec_for",
     "host_compressed_payload_bytes",
     "host_dequantize_int8",
@@ -152,6 +153,25 @@ class CompressionSpec:
 def predicted_error_bound(mode: str, *, stages: int = 1) -> float:
     """Declared relative error bound for ``mode`` across ``stages`` stages."""
     return PREDICTED_REL_ERROR[mode] * stages
+
+
+def compression_bound_provenance(
+    mode: str, *, budget: Optional[float] = None
+) -> Dict[str, object]:
+    """One accuracy-plane provenance source for a committed compression mode:
+    the predicted end-to-end bound plus how it was derived (this module stays
+    the single authority on quantization bounds — the attestation plane in
+    ``observability/accuracy.py`` composes these rows, it never re-derives
+    them).  The device int8 path quantizes twice, so its bound is two stages.
+    """
+    stages = 2 if mode == "int8" else 1
+    return {
+        "source": "compression",
+        "mode": mode,
+        "stages": stages,
+        "bound": predicted_error_bound(mode, stages=stages),
+        "budget": budget,
+    }
 
 
 # Largest integer count a compressed wire format carries *exactly*.  bf16's

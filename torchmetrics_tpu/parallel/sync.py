@@ -415,7 +415,11 @@ def _quarantine_and_redispatch(
     quorum would survive, or when the masked re-dispatch still diverges.
     """
     from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
-    from torchmetrics_tpu.resilience.quarantine import quarantine, quarantined_replicas
+    from torchmetrics_tpu.resilience.quarantine import (
+        degradation_report,
+        quarantine,
+        quarantined_replicas,
+    )
     from torchmetrics_tpu.utilities.exceptions import ReplicaDivergenceError
 
     if on_divergence != "quarantine":
@@ -429,6 +433,9 @@ def _quarantine_and_redispatch(
         ) from err
     quarantine(target, replicas, reason="divergence")
     n = int(mesh.devices.size)
+    # re-stamp the quorum block knowing the mesh size, so the surviving
+    # fraction rides telemetry/attestations (quarantine() itself cannot know n)
+    _telemetry.record_quorum(target, degradation_report(target, n_devices=n))
     survivors = n - len(quarantined_replicas(target))
     if survivors < 1:
         raise ReplicaDivergenceError(

@@ -224,4 +224,7 @@ def degradation_report(target: Any, n_devices: Optional[int] = None) -> Dict[str
     if n_devices is not None:
         out["n_devices"] = int(n_devices)
         out["surviving"] = int(n_devices) - len(quarantined)
+        # the accuracy plane's quorum provenance source: what fraction of the
+        # declared quorum the reported value was actually computed over
+        out["quorum_fraction"] = out["surviving"] / int(n_devices) if n_devices else 0.0
     return out

@@ -181,6 +181,34 @@ class QuantileSketch:
         pred0 = jnp.moveaxis(total - tail, -1, 0)
         return jnp.stack([pred0, pred1], axis=-1)
 
+    def provenance(self, hist: Optional[Array] = None) -> dict:
+        """One accuracy-plane provenance source row for this sketch config.
+
+        Always carries the static grid geometry and its ``eps`` resolution
+        guarantee; given a ``(*prefix, 2, bins + 1)`` curve histogram it adds
+        the *data-dependent* :meth:`auc_error_bound` (the worst row, as a host
+        float) and reports that as the effective ``bound`` — the data bound is
+        exact for AUC while ``eps`` only bounds it under density assumptions.
+        Never raises: a histogram of the wrong shape falls back to ``eps``.
+        """
+        out = {
+            "source": "sketch",
+            "kind": "quantile",
+            "bins": self.bins,
+            "lo": self.lo,
+            "hi": self.hi,
+            "eps": float(self.eps),
+            "bound": float(self.eps),
+        }
+        if hist is not None:
+            try:
+                data_bound = float(np.max(np.asarray(self.auc_error_bound(jnp.asarray(hist)))))
+            except Exception:
+                return out
+            out["auc_bound"] = data_bound
+            out["bound"] = data_bound
+        return out
+
     def auc_error_bound(self, hist: Array) -> Array:
         """Data-dependent bound on ``|AUROC_sketch - AUROC_exact|``.
 

@@ -74,6 +74,7 @@ _LOWER_BETTER = (
     "_collectives",
     "findings",
     "_err",  # sketch-vs-exact error legs (abs err, error bounds)
+    "_bound",  # attested error bounds (accuracy plane): a growing bound is a regression
     "skew",  # fleet skew ratios: growing imbalance is a regression
     "alerts",  # health-monitor alert counts on the deterministic bench stream
     "_sync_s",  # autotune-leg sync wall times (naive/hand-tuned/autotuned)
