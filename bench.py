@@ -1040,6 +1040,213 @@ def compressed_bench_child():
     print(json.dumps(out))
 
 
+def sharding_bench_child():
+    """Sharded-state acceptance leg on the 8-virtual-device mesh:
+
+    * byte model — FID(2048)+PSNR per-chip sync wire and resident-HBM bytes,
+      replicated vs covariance-sharded, from the same ``bucket_wire_bytes``
+      model telemetry uses: the replicated psum-state figure must reproduce
+      the archived BENCH_r05 33,570,840 B and the sharded wire/HBM figures
+      must land strictly below it (>= ~2x wire cut, ~B/n HBM);
+    * measured — ``sharded_update`` over a real FID state on the mesh:
+      telemetry ``sync_bytes`` counters for the replicated vs sharded runs
+      must match the model, and ``compute()`` must stay bit-identical
+      (the deferred all-gather makes reduce-scatter exact, not approximate);
+    * advisor loop — ``ShardingAdvisor.recommend(apply=True)`` commits a
+      ShardSpec from live registry rows, the retrace audit passes (the one
+      re-trace is the expected fingerprint flip), steady-state steps add
+      zero compile-cache traces/misses, and the decision ledger parses back
+      through the JSONL front door.
+    """
+    import io
+
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.core.compile import cache_stats
+    from torchmetrics_tpu.core.reductions import Reduce, ShardSpec
+    from torchmetrics_tpu.image import FrechetInceptionDistance, PeakSignalNoiseRatio
+    from torchmetrics_tpu.observability import memory
+    from torchmetrics_tpu.observability.export import parse_export_line
+    from torchmetrics_tpu.parallel import sharded_update
+    from torchmetrics_tpu.utilities.benchmark import sync_wire_bytes_per_chip
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    out = {}
+
+    n_feat = int(os.environ.get("BENCH_SHARD_FEATURES", 2048))
+    cov_leaves = ("real_features_cov_sum", "fake_features_cov_sum")
+    cov_shardings = {leaf: ShardSpec(axis=0) for leaf in cov_leaves}
+
+    def extractor(x):
+        return x
+
+    extractor.num_features = n_feat
+
+    def make_fid(sharded):
+        fid = FrechetInceptionDistance(feature=extractor)
+        if sharded:
+            for leaf in cov_leaves:
+                fid.set_state_sharding(leaf, ShardSpec(axis=0))
+        return fid
+
+    # --- byte model: FID(n_feat)+PSNR, replicated vs covariance-sharded.
+    # Wire prices come from the planner's own bucket model (reduce-scatter
+    # moves (n-1)/n*B vs the ring all-reduce's 2(n-1)/n*B); HBM prices the
+    # shard-axis split directly.
+    def model_entry(metric):
+        st = metric.init_state()
+        table = {name: r for name, r in metric._reductions.items()}
+        table["_n"] = Reduce.SUM
+        return table, {name: st[name] for name in table}
+
+    def wire_model(metrics, shardings_by_metric):
+        total = 0
+        for metric, shardings in zip(metrics, shardings_by_metric):
+            table, sub = model_entry(metric)
+            total += sync_wire_bytes_per_chip(table, sub, n_dev, None, shardings)
+        return int(total)
+
+    def hbm_model(metrics, shardings_by_metric):
+        total = 0
+        for metric, shardings in zip(metrics, shardings_by_metric):
+            for name, leaf in metric.init_state().items():
+                arr = np.asarray(leaf)
+                nbytes = int(arr.size) * arr.dtype.itemsize
+                spec = (shardings or {}).get(name)
+                if spec is not None:
+                    dim = int(arr.shape[spec.axis])
+                    padded = -(-dim // n_dev) * n_dev
+                    nbytes = nbytes // dim * (padded // n_dev)
+                total += nbytes
+        return int(total)
+
+    fid_model, psnr_model = make_fid(False), PeakSignalNoiseRatio()
+    psum_state_b = sum(
+        int(np.asarray(st_leaf).size) * np.asarray(st_leaf).dtype.itemsize
+        for metric in (fid_model, psnr_model)
+        for name, st_leaf in metric.init_state().items()
+        if name in metric._reductions
+    )
+    repl_wire = wire_model([fid_model, psnr_model], [None, None])
+    shard_wire = wire_model([fid_model, psnr_model], [cov_shardings, None])
+    repl_hbm = hbm_model([fid_model, psnr_model], [None, None])
+    shard_hbm = hbm_model([fid_model, psnr_model], [cov_shardings, None])
+    out["byte_model_fid_psnr"] = {
+        "num_features": n_feat,
+        "n_devices": n_dev,
+        "replicated_psum_state_bytes": int(psum_state_b),
+        "matches_bench_r05": bool(
+            n_feat != 2048 or psum_state_b == BENCH_R05_FID_PSNR_PSUM_BYTES
+        ),
+        "replicated_wire_bytes_per_chip": repl_wire,
+        "sharded_wire_bytes_per_chip": shard_wire,
+        "wire_byte_cut": round(repl_wire / shard_wire, 2),
+        "meets_2x_wire_target": bool(repl_wire / shard_wire >= 1.9),
+        "replicated_hbm_bytes_per_chip": repl_hbm,
+        "sharded_hbm_bytes_per_chip": shard_hbm,
+        "hbm_byte_cut": round(repl_hbm / shard_hbm, 2),
+        "sharded_below_bench_r05": bool(
+            n_feat != 2048
+            or (
+                shard_wire < BENCH_R05_FID_PSNR_PSUM_BYTES
+                and shard_hbm < BENCH_R05_FID_PSNR_PSUM_BYTES
+            )
+        ),
+    }
+
+    # --- measured: telemetry counters + bit-for-bit compute parity on the
+    # mesh.  FID's static ``real`` flag rides the kwargs path, so this leg
+    # measures the uncached dispatch; the cached-path retrace proof is the
+    # advisor loop below.
+    real_feats = jnp.asarray(rng.standard_normal((16, n_feat)).astype(np.float32))
+    fake_feats = jnp.asarray(rng.standard_normal((16, n_feat)).astype(np.float32))
+
+    def measured_pass(sharded):
+        obs.reset_telemetry()
+        obs.enable()
+        try:
+            fid = make_fid(sharded)
+            st = sharded_update(fid, real_feats, mesh=mesh, real=True)
+            st2 = sharded_update(fid, fake_feats, mesh=mesh, real=False)
+            merged = fid.merge_states(st, st2)
+            value = np.asarray(fid.compute_state(merged))
+            counters = obs.report()["global"]["counters"]
+            return value, int(counters["sync_bytes"]), fid
+        finally:
+            obs.disable()
+            obs.reset_telemetry()
+
+    val_r, bytes_r, _ = measured_pass(False)
+    val_s, bytes_s, fid_s = measured_pass(True)
+    # mirror record_sync's per-path models exactly: the replicated run prices
+    # through the legacy ring model, the sharded run through the planner
+    from torchmetrics_tpu.utilities.benchmark import sync_bytes_per_chip
+
+    st_s = dict(fid_s.init_state())
+    table_raw = {name: r for name, r in fid_s._reductions.items() if name in st_s}
+    expect_r = 2 * int(sync_bytes_per_chip(table_raw, st_s, n_dev))
+    expect_s = 2 * int(
+        sync_wire_bytes_per_chip(table_raw, st_s, n_dev, None, cov_shardings)
+    )
+    out["measured_sync_fid"] = {
+        "num_features": n_feat,
+        "measured_replicated_sync_bytes": bytes_r,
+        "measured_sharded_sync_bytes": bytes_s,
+        "measured_byte_cut": round(bytes_r / bytes_s, 2) if bytes_s else None,
+        "counters_match_model": bool(bytes_r == expect_r and bytes_s == expect_s),
+        "compute_bit_identical": bool(np.array_equal(val_r, val_s)),
+    }
+
+    # --- advisor actuation loop on the cached compiled path
+    preds = jnp.asarray(rng.integers(0, 1024, 512))
+    tgt = jnp.asarray(rng.integers(0, 1024, 512))
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        m = MulticlassConfusionMatrix(num_classes=1024, validate_args=False)
+        sharded_update(m, preds, tgt, mesh=mesh)  # warm the replicated trace
+        memory.snapshot_metric(m)
+        advisor = memory.ShardingAdvisor()
+        rec = advisor.recommend([m], n_devices=n_dev, apply=True)
+        sharded_update(m, preds, tgt, mesh=mesh)  # the one expected re-trace
+        audit = advisor.retrace_report()
+        warm = cache_stats()
+        steady_steps = 4
+        for _ in range(steady_steps):
+            sharded_update(m, preds, tgt, mesh=mesh)
+        stats = cache_stats()
+        stream = io.StringIO()
+        advisor.export_ledger(stream=stream)
+        ledger_lines = [ln for ln in stream.getvalue().splitlines() if ln.strip()]
+        parsed = [parse_export_line(ln) for ln in ledger_lines]
+        ledger_ok = bool(parsed) and all(
+            p["kind"] == memory.SHARDING_LEDGER_KIND for p in parsed
+        )
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    out["advisor_loop"] = {
+        "applied": bool(rec["actuation"]["applied"]),
+        "committed": list(rec["actuation"]["targets"]),
+        "state": rec["actuation"]["state"],
+        "retrace_audit_ok": bool(audit["ok"]),
+        "steady_state_extra_traces": stats["traces"] - warm["traces"],  # must be 0
+        "steady_state_extra_misses": stats["misses"] - warm["misses"],  # must be 0
+        "ledger_lines": len(ledger_lines),
+        "ledger_parse_ok": ledger_ok,
+    }
+    print(json.dumps(out))
+
+
 def fleet_bench_child():
     """Fleet telemetry plane acceptance leg on the 8-virtual-device mesh:
 
@@ -1447,6 +1654,12 @@ def measured_compressed():
 def measured_fleet():
     return _run_cpu_mesh_child(
         "fleet", float(os.environ.get("BENCH_FLEET_TIMEOUT", 300))
+    )
+
+
+def measured_sharding():
+    return _run_cpu_mesh_child(
+        "sharding", float(os.environ.get("BENCH_SHARD_TIMEOUT", 300))
     )
 
 
@@ -2118,6 +2331,7 @@ def main():
     compressed_measured = measured_compressed()
     fleet_measured = measured_fleet()
     autotune_measured = measured_autotune()
+    sharding_measured = measured_sharding()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -2174,6 +2388,7 @@ def main():
             "compressed_sync": compressed_measured,
             "fleet": fleet_measured,
             "autotune": autotune_measured,
+            "sharded_state": sharding_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -2307,6 +2522,8 @@ if __name__ == "__main__":
         autotune_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "fleet":
         fleet_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "sharding":
+        sharding_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

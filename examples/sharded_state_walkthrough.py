@@ -1,0 +1,152 @@
+"""Sharded metric state walkthrough: reduce-scatter syncs, closed loop.
+
+What this shows, in order:
+
+1. the replicated baseline — FID's two ``(d, d)`` covariance accumulators
+   ride the ring all-reduce at ``2(n-1)/n * B`` per chip, measured by the
+   telemetry ``sync_bytes`` counter on a real 8-virtual-device mesh;
+2. the ShardingAdvisor closing its loop — ``advise()`` names the covariance
+   leaves as the waste, ``recommend(apply=True)`` stages and commits
+   ``ShardSpec(axis=0)`` onto the live metric through the
+   observe → candidate → trial → committed state machine, and the retrace
+   audit proves the transition's compile-cache cost;
+3. the sharded re-run — same inputs, bit-for-bit identical ``compute()``
+   (the all-gather is deferred to compute, making reduce-scatter exact,
+   not approximate), with the measured per-chip sync-byte cut printed;
+4. the paper trail — every transition exported as ``kind:
+   "sharding_decision"`` JSONL lines that parse back through the front door.
+
+Run on anything: ``python examples/sharded_state_walkthrough.py`` (CPU ok;
+the mesh is 8 virtual host devices).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.image import FrechetInceptionDistance
+from torchmetrics_tpu.observability.export import parse_export_line
+from torchmetrics_tpu.observability.memory import ShardingAdvisor
+from torchmetrics_tpu.parallel import sharded_update
+
+N_FEAT = 512  # cov leaves are (512, 512) float32 = 1 MiB each
+COV_LEAVES = ("real_features_cov_sum", "fake_features_cov_sum")
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def make_fid() -> FrechetInceptionDistance:
+    # a passthrough extractor: the walkthrough feeds feature vectors
+    # directly, so the whole story is about the metric *state*, not the
+    # backbone
+    def features(x):
+        return x
+
+    features.num_features = N_FEAT
+    return FrechetInceptionDistance(feature=features)
+
+
+def measured_pass(fid, mesh, real_feats, fake_feats):
+    """One epoch on the mesh; returns (compute value, per-run sync bytes)."""
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        st = sharded_update(fid, real_feats, mesh=mesh, real=True)
+        st2 = sharded_update(fid, fake_feats, mesh=mesh, real=False)
+        value = np.asarray(fid.compute_state(fid.merge_states(st, st2)))
+        return value, int(obs.report()["global"]["counters"]["sync_bytes"])
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+
+
+def main() -> None:
+    n_dev = 8
+    devices = jax.devices()
+    assert len(devices) >= n_dev, "expected 8 virtual devices (see XLA_FLAGS)"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+    rng = np.random.default_rng(0)
+    real_feats = jnp.asarray(rng.standard_normal((16, N_FEAT)).astype(np.float32))
+    fake_feats = jnp.asarray(rng.standard_normal((16, N_FEAT)).astype(np.float32))
+
+    # ------------------------------------------------------------------ 1
+    banner("1. replicated baseline: ring all-reduce bytes")
+    fid = make_fid()
+    value_repl, bytes_repl = measured_pass(fid, mesh, real_feats, fake_feats)
+    print(f"FID({N_FEAT}) over {n_dev} devices, every state leaf replicated")
+    print(f"  compute()            = {value_repl:.6f}")
+    print(f"  sync bytes per chip  = {bytes_repl:,}")
+
+    # ------------------------------------------------------------------ 2
+    banner("2. ShardingAdvisor: observe -> candidate -> trial -> committed")
+    fid = make_fid()  # the live metric the advisor will actuate
+    advisor = ShardingAdvisor()
+    advice = advisor.advise([fid], n_devices=n_dev)
+    print("advise() ranks the covariance leaves first:")
+    for cand in advice["candidates"][:3]:
+        print(
+            f"  {cand['metric']}/{cand['leaf']}: {cand['bytes']:,} B, "
+            f"replicated waste {cand['replicated_waste_bytes']:,} B, "
+            f"worth_sharding={cand['worth_sharding']}"
+        )
+
+    rec = advisor.recommend([fid], n_devices=n_dev, apply=True)
+    act = rec["actuation"]
+    print(f"recommend(apply=True): state={act['state']} applied={act['applied']}")
+    print(f"  committed targets  = {act['targets']}")
+    print(f"  installed specs    = {fid.state_shardings}")
+    assert act["applied"] and set(fid.state_shardings) == set(COV_LEAVES)
+
+    # ------------------------------------------------------------------ 3
+    banner("3. sharded re-run: reduce-scatter bytes, exact compute")
+    value_shard, bytes_shard = measured_pass(fid, mesh, real_feats, fake_feats)
+    audit = advisor.retrace_report()
+    print(f"  compute()            = {value_shard:.6f}")
+    print(f"  sync bytes per chip  = {bytes_shard:,}")
+    print(f"  measured byte cut    = {bytes_repl / bytes_shard:.2f}x")
+    print(f"  bit-identical        = {bool(np.array_equal(value_repl, value_shard))}")
+    print(
+        f"  retrace audit ok     = {audit['ok']} "
+        f"(misses={audit['extra_misses']}, expected<={audit['expected']['new_keys']})"
+    )
+    assert np.array_equal(value_repl, value_shard)
+    assert bytes_shard < bytes_repl and audit["ok"]
+
+    # ------------------------------------------------------------------ 4
+    banner("4. the paper trail: sharding_decision JSONL")
+    stream = io.StringIO()
+    advisor.export_ledger(stream=stream)
+    lines = [ln for ln in stream.getvalue().splitlines() if ln.strip()]
+    for line in lines:
+        row = parse_export_line(line)
+        print(f"  seq={row['seq']} {row['action']:<8} -> {row['state_to']}")
+    assert [parse_export_line(ln)["action"] for ln in lines][:3] == [
+        "propose",
+        "arm",
+        "commit",
+    ]
+
+    print(
+        f"\nDone: the advisor committed FID's covariance shards and cut the "
+        f"measured sync bytes {bytes_repl / bytes_shard:.2f}x "
+        f"({bytes_repl:,} -> {bytes_shard:,} B per chip) with compute() "
+        f"bit-identical."
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,400 @@
+"""Cross-replica sharded metric state: ShardSpec API, reduce-scatter sync
+parity, compile-cache fingerprinting, snapshot/elastic round-trips, and the
+per-chip memory/attestation story — all on the virtual 8-CPU-device mesh.
+
+The load-bearing invariant everywhere below: sharding is a *layout* choice,
+never a *value* choice.  ``psum_scatter`` of per-device partials is
+bit-for-bit the blockwise ``psum``, and ``compute()`` runs after one explicit
+deferred all-gather, so every sharded figure must equal its replicated twin
+exactly — no tolerance.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.core.compile import (
+    _fingerprint_hash,
+    cache_stats,
+    clear_compile_cache,
+    config_fingerprint,
+)
+from torchmetrics_tpu.core.reductions import Reduce, ShardSpec, canonical_sharding
+from torchmetrics_tpu.parallel import SyncPolicy, sharded_update
+from torchmetrics_tpu.resilience.durable import DurableSnapshotStore, MANIFEST_NAME
+from torchmetrics_tpu.resilience.elastic import elastic_restore
+from torchmetrics_tpu.resilience.snapshot import restore, snapshot
+
+pytestmark = pytest.mark.sharding
+
+
+class VecSum(Metric):
+    """dim-vector sum + scalar count; optionally sharded on the vector."""
+
+    def __init__(self, dim=64, sharding=None, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "vec", jnp.zeros((dim,), jnp.float32), dist_reduce_fx="sum",
+            state_sharding=sharding,
+        )
+        self.add_state("count", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"vec": state["vec"] + x.sum(axis=0), "count": state["count"] + x.shape[0]}
+
+    def _compute(self, state):
+        return state["vec"].sum() / state["count"]
+
+
+class CovSum(Metric):
+    """FID-shaped (dim, dim) covariance accumulator, optionally sharded."""
+
+    def __init__(self, dim=64, sharding=None, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "cov", jnp.zeros((dim, dim), jnp.float32), dist_reduce_fx="sum",
+            state_sharding=sharding,
+        )
+        self.add_state("n", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"cov": state["cov"] + x.T @ x, "n": state["n"] + x.shape[0]}
+
+    def _compute(self, state):
+        return state["cov"].sum() / state["n"]
+
+
+def _passthrough_extractor(dim):
+    def extractor(x):
+        return x
+
+    extractor.num_features = dim
+    return extractor
+
+
+# ----------------------------------------------------------------- API layer
+def test_canonical_sharding_forms():
+    assert canonical_sharding(None) is None
+    assert canonical_sharding("replicated") is None
+    assert canonical_sharding("sharded") == ShardSpec(axis=0)
+    assert canonical_sharding(ShardSpec(axis=1)) == ShardSpec(axis=1)
+    with pytest.raises(ValueError, match="state_sharding"):
+        canonical_sharding("diagonal")
+
+
+def test_add_state_and_setter_install_specs():
+    m = VecSum(sharding="sharded")
+    assert m.state_shardings == {"vec": ShardSpec(axis=0)}
+    m.set_state_sharding("vec", "replicated")
+    assert m.state_shardings == {}
+    m.set_state_sharding("vec", ShardSpec(axis=0))
+    assert m.state_shardings == {"vec": ShardSpec(axis=0)}
+    with pytest.raises(KeyError, match="not a registered state leaf"):
+        m.set_state_sharding("nope", "sharded")
+
+
+def test_sharding_restrictions():
+    class MaxState(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("m", jnp.zeros((8,)), dist_reduce_fx="max")
+
+        def _update(self, state, x):
+            return {"m": jnp.maximum(state["m"], x)}
+
+        def _compute(self, state):
+            return state["m"]
+
+    with pytest.raises(ValueError, match="dist_reduce_fx='sum'"):
+        MaxState().set_state_sharding("m", "sharded")
+    with pytest.raises(ValueError, match="out of range"):
+        VecSum().set_state_sharding("vec", ShardSpec(axis=1))
+    with pytest.raises(ValueError, match="nan_strategy"):
+        VecSum(nan_strategy="warn").set_state_sharding("vec", "sharded")
+
+
+def test_sharding_survives_pickle():
+    import pickle
+
+    m = VecSum(sharding="sharded")
+    clone = pickle.loads(pickle.dumps(m))
+    assert clone.state_shardings == {"vec": ShardSpec(axis=0)}
+    assert config_fingerprint(clone) == config_fingerprint(m)
+
+
+# ------------------------------------------------------------- sync lowering
+def test_sharded_sync_bit_parity(mesh):
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 64), dtype=np.float32))
+    m_r, m_s = VecSum(), VecSum(sharding="sharded")
+    out_r = sharded_update(m_r, x, mesh=mesh)
+    out_s = sharded_update(m_s, x, mesh=mesh)
+    # the sharded leaf lives scattered: per-chip HBM is B/n, not B
+    sharding = out_s["vec"].sharding
+    assert isinstance(sharding, NamedSharding) and tuple(sharding.spec) == ("data",)
+    assert out_s["vec"].addressable_shards[0].data.shape == (64 // 8,)
+    # ...but values are bit-for-bit the replicated sync's
+    assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_s["vec"]))
+    assert np.array_equal(
+        np.asarray(m_r.compute_state(out_r)), np.asarray(m_s.compute_state(out_s))
+    )
+
+
+def test_sharded_sync_padding_bit_parity(mesh):
+    # 10 % 8 != 0: the planner pads with the sum identity and unpads on read
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((16, 10), dtype=np.float32))
+    m_r, m_s = VecSum(dim=10), VecSum(dim=10, sharding="sharded")
+    out_r = sharded_update(m_r, x, mesh=mesh)
+    out_s = sharded_update(m_s, x, mesh=mesh)
+    unpadded_r = m_r.compute_state(out_r)
+    unpadded_s = m_s.compute_state(out_s)
+    assert np.array_equal(np.asarray(unpadded_r), np.asarray(unpadded_s))
+
+
+def test_fid_covariance_sharding_exact(mesh):
+    # the acceptance metric: FID with both covariance accumulators sharded
+    # must compute bit-for-bit the replicated answer (kwargs path: FID's
+    # ``real`` flag is static)
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+
+    rng = np.random.default_rng(2)
+    real = jnp.asarray(rng.standard_normal((16, 64), dtype=np.float32))
+    fake = jnp.asarray(rng.standard_normal((16, 64), dtype=np.float32))
+
+    def run(sharded):
+        fid = FrechetInceptionDistance(feature=_passthrough_extractor(64))
+        if sharded:
+            fid.set_state_sharding("real_features_cov_sum", "sharded")
+            fid.set_state_sharding("fake_features_cov_sum", ShardSpec(axis=0))
+        st = fid.merge_states(
+            sharded_update(fid, real, mesh=mesh, real=True),
+            sharded_update(fid, fake, mesh=mesh, real=False),
+        )
+        return np.asarray(fid.compute_state(st))
+
+    assert np.array_equal(run(False), run(True))
+
+
+def test_cadence_composes_with_sharding(mesh):
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((16, 64), dtype=np.float32))
+    policy = SyncPolicy(every_n_steps=2)
+    m_r, m_s = VecSum(), VecSum(sharding="sharded")
+    assert sharded_update(m_r, x, mesh=mesh, sync_policy=policy) is None
+    assert sharded_update(m_s, x, mesh=mesh, sync_policy=policy) is None
+    out_r = sharded_update(m_r, x, mesh=mesh, sync_policy=policy)
+    out_s = sharded_update(m_s, x, mesh=mesh, sync_policy=policy)
+    assert out_r is not None and out_s is not None
+    assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_s["vec"]))
+
+
+def test_compression_composes_with_sharding(mesh):
+    # bf16 wire on the scattered bucket: values match the *replicated bf16*
+    # sync exactly (same quantization, different collective), and stay within
+    # the declared budget of the exact sync
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((16, 64), dtype=np.float32))
+    policy = SyncPolicy(every_n_steps=1, compression="bf16", error_budget=0.05)
+    m_r, m_s = VecSum(), VecSum(sharding="sharded")
+    out_r = sharded_update(m_r, x, mesh=mesh, sync_policy=policy)
+    out_s = sharded_update(m_s, x, mesh=mesh, sync_policy=policy)
+    exact = sharded_update(VecSum(), x, mesh=mesh)
+    a, b, e = (np.asarray(o["vec"]) for o in (out_r, out_s, exact))
+    assert np.array_equal(a, b)
+    amax = np.abs(e).max() or 1.0
+    assert np.abs(b - e).max() / amax <= 0.05
+
+
+def test_quarantine_composes_with_sharding(mesh):
+    from torchmetrics_tpu.resilience.quarantine import clear_quarantine, quarantine
+
+    x = jnp.asarray(np.random.default_rng(5).standard_normal((16, 64), dtype=np.float32))
+    m_r, m_s = VecSum(), VecSum(sharding="sharded")
+    try:
+        quarantine(m_r, [3], reason="test")
+        quarantine(m_s, [3], reason="test")
+        out_r = sharded_update(m_r, x, mesh=mesh)
+        out_s = sharded_update(m_s, x, mesh=mesh)
+        assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_s["vec"]))
+        # the masked sum really excludes replica 3's shard
+        expected = np.asarray(x).reshape(8, 2, 64)[[i for i in range(8) if i != 3]].sum((0, 1))
+        np.testing.assert_allclose(np.asarray(out_s["vec"]), expected, rtol=1e-5)
+    finally:
+        clear_quarantine(m_r)
+        clear_quarantine(m_s)
+
+
+# -------------------------------------------------- compile-cache fingerprint
+def test_fingerprint_flips_and_never_reuses_stale_trace(mesh):
+    x = jnp.asarray(np.random.default_rng(6).standard_normal((16, 64), dtype=np.float32))
+    clear_compile_cache()
+    m = VecSum()
+    fp_repl = _fingerprint_hash(config_fingerprint(m))
+    out_r = sharded_update(m, x, mesh=mesh)  # compile the replicated trace
+    base = cache_stats()
+
+    m.set_state_sharding("vec", "sharded")
+    fp_shard = _fingerprint_hash(config_fingerprint(m))
+    assert fp_shard != fp_repl and len(fp_shard) == len(fp_repl) == 12
+    out_s = sharded_update(m, x, mesh=mesh)
+    after_shard = cache_stats()
+    # the resharded metric must NOT reuse the stale replicated trace...
+    assert after_shard["misses"] == base["misses"] + 1
+    # ...and the fresh trace computes the same bits
+    assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_s["vec"]))
+
+    m.set_state_sharding("vec", "replicated")
+    assert _fingerprint_hash(config_fingerprint(m)) == fp_repl
+    sharded_update(m, x, mesh=mesh)
+    after_back = cache_stats()
+    # rolling back re-hits the original replicated entry: no new compile
+    assert after_back["misses"] == after_shard["misses"]
+
+    # steady state: repeat sharded/replicated steps add zero traces
+    m.set_state_sharding("vec", "sharded")
+    sharded_update(m, x, mesh=mesh)
+    warm = cache_stats()
+    for _ in range(3):
+        sharded_update(m, x, mesh=mesh)
+    steady = cache_stats()
+    assert steady["traces"] == warm["traces"]
+    assert steady["misses"] == warm["misses"]
+
+
+# ------------------------------------------------------- snapshots & elastic
+def _installed_sharded(mesh, dim=64, cls=CovSum, x=None):
+    m = cls(dim=dim, sharding="sharded")
+    if x is None:
+        x = jnp.asarray(
+            np.random.default_rng(7).standard_normal((16, dim), dtype=np.float32)
+        )
+    m._state = dict(sharded_update(m, x, mesh=mesh))
+    return m, x
+
+
+def test_snapshot_stores_per_shard_payloads(mesh):
+    m, _ = _installed_sharded(mesh)
+    snap = snapshot(m)
+    spec = snap["spec"]["cov"]
+    assert spec["kind"] == "sharded"
+    assert spec["axis"] == 0 and spec["n_shards"] == 8
+    parts = snap["state"]["cov"]
+    assert isinstance(parts, list) and len(parts) == 8
+    assert all(p.shape == (8, 64) for p in parts)
+
+    fresh = CovSum()
+    restore(fresh, snap)
+    assert np.array_equal(np.asarray(fresh._state["cov"]), np.asarray(m._state["cov"]))
+    assert np.array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_durable_store_writes_per_shard_crcs(tmp_path, mesh):
+    m, _ = _installed_sharded(mesh)
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    gen = store.save(m)
+    import json
+
+    manifest = json.loads(
+        (tmp_path / "ckpt" / f"gen-{gen:08d}" / MANIFEST_NAME).read_text()
+    )
+    shard_paths = [p for p in manifest["leaves"] if p.startswith("state/cov/")]
+    assert sorted(shard_paths) == [f"state/cov/{i}" for i in range(8)]
+
+    fresh = CovSum()
+    store.restore(fresh)
+    assert np.array_equal(np.asarray(fresh._state["cov"]), np.asarray(m._state["cov"]))
+
+
+def test_durable_corrupt_shard_skips_back(tmp_path, mesh):
+    from torchmetrics_tpu.resilience.durable import PAYLOAD_NAME
+
+    m, x = _installed_sharded(mesh)
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"))
+    g1 = store.save(m)
+    m._state = dict(sharded_update(m, x, mesh=mesh))
+    g2 = store.save(m)
+    payload = tmp_path / "ckpt" / f"gen-{g2:08d}" / PAYLOAD_NAME
+    with open(payload, "r+b") as fh:
+        fh.truncate(max(1, os.path.getsize(payload) // 2))
+    with pytest.warns(UserWarning, match="skipping back"):
+        _, gen = store.load()
+    assert gen == g1
+
+
+def test_elastic_reshard_8_to_4_to_8_bit_identical(mesh):
+    # a pure re-shard round trip is lossless: the snapshot stores shards but
+    # restores the mesh-agnostic logical array, so an 8-shard snapshot lands
+    # on a 4-device mesh (and back) without touching a single bit
+    m8, x = _installed_sharded(mesh, dim=64)
+    reference = np.asarray(m8._state["cov"])
+    snap8 = snapshot(m8)
+
+    mesh4 = Mesh(np.asarray(jax.devices()[:4]).reshape(4), ("data",))
+    m4 = CovSum(sharding="sharded")
+    elastic_restore(m4, snap8)
+    assert np.array_equal(np.asarray(m4._state["cov"]), reference)
+    # re-scatter over the 4-device mesh (an empty batch is the full identity:
+    # zero rows add nothing to cov OR n): the next snapshot carries 4 shards
+    m4._state = m4.merge_states(
+        m4._state, sharded_update(m4, x[:0], mesh=mesh4)
+    )
+    snap4 = snapshot(m4)
+    assert snap4["spec"]["cov"]["n_shards"] == 4
+    assert np.array_equal(np.asarray(m4._state["cov"]), reference)
+
+    m8b = CovSum(sharding="sharded")
+    elastic_restore(m8b, snap4)
+    assert np.array_equal(np.asarray(m8b._state["cov"]), reference)
+    assert np.array_equal(np.asarray(m8b.compute()), np.asarray(m8.compute()))
+
+
+def test_interrupted_equals_uninterrupted_same_mesh(mesh):
+    rng = np.random.default_rng(8)
+    x1 = jnp.asarray(rng.standard_normal((16, 64), dtype=np.float32))
+    x2 = jnp.asarray(rng.standard_normal((16, 64), dtype=np.float32))
+
+    # uninterrupted: two batches merged live
+    m = CovSum(sharding="sharded")
+    st = sharded_update(m, x1, mesh=mesh)
+    st = m.merge_states(st, sharded_update(m, x2, mesh=mesh))
+    expected = np.asarray(m.compute_state(st))
+
+    # interrupted: snapshot+restore between the batches
+    m1 = CovSum(sharding="sharded")
+    m1._state = dict(sharded_update(m1, x1, mesh=mesh))
+    snap = snapshot(m1)
+    m2 = CovSum(sharding="sharded")
+    restore(m2, snap)
+    st2 = m2.merge_states(m2._state, sharded_update(m2, x2, mesh=mesh))
+    assert np.array_equal(np.asarray(m2.compute_state(st2)), expected)
+
+
+# -------------------------------------------------- memory & attestation
+def test_sharded_leaf_resident_bytes_is_b_over_n(mesh):
+    from torchmetrics_tpu.observability.memory import leaf_resident_bytes
+
+    m_r, x = _installed_sharded(mesh)
+    out_r = sharded_update(CovSum(), x, mesh=mesh)
+    resident_s, logical_s = leaf_resident_bytes(m_r._state["cov"])
+    resident_r, logical_r = leaf_resident_bytes(out_r["cov"])
+    assert logical_s == logical_r == 64 * 64 * 4
+    # replicated: every one of the 8 addressable devices holds B
+    assert resident_r == 8 * logical_r
+    # sharded: the 8 shards tile B exactly once — B/n per chip
+    assert resident_s == logical_s
+
+
+def test_attestation_carries_sharding_provenance():
+    from torchmetrics_tpu.observability.accuracy import attest
+
+    m = VecSum(sharding="sharded")
+    att = attest(m)
+    assert att.sharding == {"vec": 0}
+    assert att.as_dict()["sharding"] == {"vec": 0}
+    # sharding is provenance, never an approximation source
+    assert all(s.get("kind") != "sharding" for s in att.as_dict()["sources"])
+
+    plain = attest(VecSum())
+    assert plain.sharding is None and "sharding" not in plain.as_dict()

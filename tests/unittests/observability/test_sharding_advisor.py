@@ -1,0 +1,151 @@
+"""ShardingAdvisor actuation loop: recommend → arm → commit | veto |
+rollback, the retrace audit, guardrail vetoes, and the two export contracts
+(``sharding_advice`` recommendation payloads, ``sharding_decision`` ledger
+lines) through the JSONL front door."""
+
+import io
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric, observability as obs
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+from torchmetrics_tpu.core.reductions import ShardSpec
+from torchmetrics_tpu.observability import memory
+from torchmetrics_tpu.observability.export import SCHEMA_VERSION, parse_export_line
+from torchmetrics_tpu.parallel import sharded_update
+
+pytestmark = pytest.mark.sharding
+
+
+class BigVec(Metric):
+    def __init__(self, dim=4096, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vec", jnp.zeros((dim,), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"vec": state["vec"] + x.sum(axis=0)}
+
+    def _compute(self, state):
+        return state["vec"].sum()
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    obs.reset_telemetry()
+    obs.enable()
+    yield
+    obs.disable()
+    obs.reset_telemetry()
+
+
+def test_recommend_stamps_sharding_advice_kind():
+    m = BigVec()
+    advisor = memory.ShardingAdvisor(min_leaf_bytes=1024)
+    rec = advisor.recommend([m], n_devices=8)
+    assert rec["kind"] == "sharding_advice"
+    assert rec["actuation"]["state"] == "candidate"
+    assert rec["actuation"]["applied"] is False
+    assert [t.split("/", 1)[1] for t in rec["actuation"]["targets"]] == ["vec"]
+
+    # through the export front door and back
+    line = obs.export(rec, fmt="jsonl", stream=io.StringIO())
+    parsed = parse_export_line(line)
+    assert parsed["kind"] == "sharding_advice"
+    assert parsed["schema_version"] == SCHEMA_VERSION
+    assert "process" in parsed
+    assert parsed["actuation"]["targets"] == rec["actuation"]["targets"]
+
+
+def test_commit_installs_specs_and_audits_retraces(mesh):
+    clear_compile_cache()
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 4096), dtype=np.float32))
+    m = BigVec()
+    sharded_update(m, x, mesh=mesh)  # warm the replicated trace
+
+    advisor = memory.ShardingAdvisor(min_leaf_bytes=1024)
+    rec = advisor.recommend([m], n_devices=8, apply=True)
+    assert advisor.state == "committed"
+    assert rec["actuation"]["applied"] is True
+    assert m.state_shardings == {"vec": ShardSpec(axis=0)}
+    assert rec["actuation"]["expected_retraces"]["new_keys"] == 1
+
+    sharded_update(m, x, mesh=mesh)  # the ONE expected re-trace
+    audit = advisor.retrace_report()
+    assert audit["ok"] is True
+
+    warm = cache_stats()
+    for _ in range(3):
+        sharded_update(m, x, mesh=mesh)
+    steady = cache_stats()
+    assert steady["traces"] == warm["traces"]  # 0 steady-state retraces
+    assert steady["misses"] == warm["misses"]
+
+
+def test_rollback_restores_previous_specs(mesh):
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((8, 4096), dtype=np.float32))
+    m = BigVec()
+    advisor = memory.ShardingAdvisor(min_leaf_bytes=1024)
+    advisor.recommend([m], n_devices=8, apply=True)
+    assert m.state_shardings  # committed
+    advisor.rollback(reason="test rollback")
+    assert advisor.state == "observe"
+    assert m.state_shardings == {}
+    # the replicated graph still computes
+    out = sharded_update(m, x, mesh=mesh)
+    np.testing.assert_allclose(
+        np.asarray(out["vec"]), np.asarray(x).sum(axis=0), rtol=1e-5
+    )
+
+
+def test_guardrail_alert_vetoes_trial():
+    from torchmetrics_tpu.observability.health import Alert
+
+    m = BigVec()
+    advisor = memory.ShardingAdvisor(min_leaf_bytes=1024)
+    advisor.recommend([m], n_devices=8)
+    advisor.arm()
+    assert advisor.state == "trial"
+    sink = advisor.guardrail_sink()
+    sink.emit(
+        Alert(
+            series="tm_tpu/BigVec",
+            rule="drift",
+            severity="warning",
+            step=0,
+            value=None,
+            message="synthetic guardrail trip",
+        )
+    )
+    assert advisor.state == "observe"  # vetoed before commit
+    assert m.state_shardings == {}
+    actions = [row["action"] for row in advisor.decision_ledger()]
+    assert "veto" in actions
+
+
+def test_decision_ledger_parses_back_as_sharding_decisions():
+    m = BigVec()
+    advisor = memory.ShardingAdvisor(min_leaf_bytes=1024)
+    advisor.recommend([m], n_devices=8, apply=True)
+    advisor.rollback(reason="drain")
+
+    stream = io.StringIO()
+    advisor.export_ledger(stream=stream)
+    lines = [ln for ln in stream.getvalue().splitlines() if ln.strip()]
+    assert len(lines) == len(advisor.decision_ledger()) >= 4  # propose/arm/commit/rollback
+    parsed = [parse_export_line(ln) for ln in lines]
+    assert all(p["kind"] == memory.SHARDING_LEDGER_KIND for p in parsed)
+    assert all(p["schema_version"] == SCHEMA_VERSION for p in parsed)
+    seqs = [p["seq"] for p in parsed]
+    assert seqs == sorted(seqs)
+    actions = [p["action"] for p in parsed]
+    assert actions[0] == "propose" and "commit" in actions and "rollback" in actions
+    for p in parsed:
+        assert p["action"] in memory.SHARDING_ACTIONS
+        assert p["state_to"] in memory.SHARDING_STATES
+
+    # round-trip through a real JSON encode/decode preserves the row shape
+    row = json.loads(json.dumps(parsed[0]))
+    assert [t.split("/", 1)[1] for t in row["targets"]] == ["vec"]

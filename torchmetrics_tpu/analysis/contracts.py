@@ -106,6 +106,12 @@ def _value_inputs() -> Tuple[Any, ...]:
     return (jnp.asarray(_rng().random(16, dtype="float32")),)
 
 
+def _feature_inputs(dim: int = 64) -> Tuple[Any, ...]:
+    import jax.numpy as jnp
+
+    return (jnp.asarray(_rng().random((8, dim), dtype="float32")),)
+
+
 def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
     """name -> factory returning (metric, example update inputs) for every
     metric in the golden slate.  Deterministic: seeded inputs, fixed configs.
@@ -160,7 +166,46 @@ def golden_metrics() -> Dict[str, Callable[[], Tuple[Any, Tuple[Any, ...]]]]:
     # the bf16/int8 snapshots then capture a genuinely compressed lowering
     calib1024 = lambda: BinaryCalibrationError(n_bins=1024)
 
+    def sharded_fid():
+        # the reduce-scatter slate anchor: FID's two (64, 64) covariance
+        # accumulators carry ShardSpec(axis=0), so the sync segment must
+        # snapshot a reduce_scatter where every other entry shows psum
+        from torchmetrics_tpu.core.reductions import ShardSpec
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        def features(x):
+            return x
+
+        features.num_features = 64
+
+        class ShardedFID(FrechetInceptionDistance):
+            # positional-update adapter: FID's ``real`` flag is a static
+            # Python bool the contract tracer can't pass positionally, so
+            # the traced update pins the fake leg (the generative hot path)
+            def _update(self, state, feats):
+                return FrechetInceptionDistance._update(self, state, feats, False)
+
+        metric = ShardedFID(feature=features)
+        for leaf in ("real_features_cov_sum", "fake_features_cov_sum"):
+            metric.set_state_sharding(leaf, ShardSpec(axis=0))
+        return metric, _feature_inputs(64)
+
+    def sharded_fid_with(policy: SyncPolicy):
+        def factory():
+            metric, inputs = sharded_fid()
+            metric.__dict__["_autotuned_policy"] = policy
+            return metric, inputs
+
+        return factory
+
     return {
+        "ShardedFID64": sharded_fid,
+        "ShardedFID64__bf16": sharded_fid_with(
+            SyncPolicy(every_n_steps=4, compression="bf16", error_budget=5e-2)
+        ),
+        "ShardedFID64__int8": sharded_fid_with(
+            SyncPolicy(every_n_steps=4, compression="int8", error_budget=5e-2)
+        ),
         "BinaryAccuracy": make(BinaryAccuracy, _binary_inputs),
         "BinaryCalibrationError1024": make(calib1024, _binary_inputs),
         "BinaryCalibrationError1024__bf16": autotuned(
@@ -267,13 +312,22 @@ def trace_contract(
                 lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name
             )
         else:
-            from torchmetrics_tpu.parallel.coalesce import _metric_entry, coalesced_sync_state
+            from torchmetrics_tpu.parallel.coalesce import (
+                _metric_entry,
+                _metric_shardings,
+                coalesced_sync_state,
+            )
 
             reductions, sub = _metric_entry(metric, state)
             keys = tuple(sub)
+            shardings = _metric_shardings(metric)
             jx_sync = _trace_sync(
                 lambda st: coalesced_sync_state(
-                    {k: st[k] for k in keys}, reductions, axis_name, compression=compression
+                    {k: st[k] for k in keys},
+                    reductions,
+                    axis_name,
+                    compression=compression,
+                    shardings=shardings,
                 ),
                 state,
                 the_mesh,

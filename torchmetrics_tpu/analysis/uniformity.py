@@ -54,6 +54,7 @@ __all__ = [
     "verify_collection_sync",
     "verify_metric_sync",
     "verify_ragged_gather",
+    "verify_sharded_sync",
     "verify_uniform",
 ]
 
@@ -243,6 +244,102 @@ def verify_metric_sync(
                 f"{subject}/sync[{mode}]: plan compresses {n_compressed} bucket(s) but the "
                 "traced sync has no dequantize op — the compressed segment did not lower"
             )
+    return report
+
+
+#: collectives the sharded (reduce-scatter) bucket path may lower to
+_SCATTER_PRIMITIVES = frozenset({"psum_scatter", "reduce_scatter"})
+
+
+def verify_sharded_sync(
+    metric: Any,
+    *inputs: Any,
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    compressions: Sequence[str] = ("int8", "bf16"),
+) -> UniformityReport:
+    """TMT012 for the sharded-state plane: verify the reduce-scatter lowering.
+
+    Runs :func:`verify_metric_sync` (so every uniformity and
+    quantize-confinement check applies unchanged), then asserts the
+    *sharded* contract on top:
+
+    * the metric actually carries ``state_sharding`` specs — running this
+      driver on a replicated metric is a configuration error, not a pass;
+    * the plain sync lowers exactly one scatter-family collective
+      (``psum_scatter``) per sharded bucket in the plan — the wire-halving
+      path is in the graph, not silently falling back to ``psum``;
+    * a bf16-compressed sharded bucket lowers a ``bfloat16`` reduce-scatter,
+      and an int8-compressed one rides its two-phase ``all_to_all``
+      exchange (the quantized blocks cross the wire, the dequant-sum stays
+      local) — per-bucket compression composes with sharding.
+    """
+    from torchmetrics_tpu.parallel.coalesce import _metric_shardings, plan_for_metric
+    from torchmetrics_tpu.parallel.compress import CompressionConfig
+
+    subject = type(metric).__name__
+    report = verify_metric_sync(
+        metric, *inputs, mesh=mesh, axis_name=axis_name, compressions=compressions
+    )
+    if not _metric_shardings(metric):
+        report.problems.append(
+            f"{subject}: no state_sharding specs installed — nothing can lower to "
+            "psum_scatter; install a ShardSpec (add_state(state_sharding=...) or "
+            "set_state_sharding) before running the sharded driver"
+        )
+        return report
+    state = metric.update_state(metric.init_state(), *inputs)
+
+    def scatter_ops(label: str) -> List[str]:
+        return [
+            desc
+            for desc in report.sequences.get(label, ())
+            if desc.split("[", 1)[0] in _SCATTER_PRIMITIVES
+        ]
+
+    plan = plan_for_metric(metric, state)
+    n_sharded = sum(1 for b in plan.buckets if b.sharded)
+    if not n_sharded:
+        report.problems.append(
+            f"{subject}: sharding specs installed but the plan has no sharded "
+            "bucket — the specs name no sum-family leaf the planner accepts"
+        )
+    elif "sync" in report.sequences and len(scatter_ops("sync")) != n_sharded:
+        report.problems.append(
+            f"{subject}/sync: plan has {n_sharded} sharded bucket(s) but the traced "
+            f"sync lowers {len(scatter_ops('sync'))} scatter-family collective(s) — "
+            "the reduce-scatter path did not lower bucket-for-bucket"
+        )
+    for mode in compressions:
+        label = f"sync[{mode}]"
+        if label not in report.sequences:
+            continue
+        cfg = CompressionConfig(mode=mode, min_bucket_bytes=0)
+        cplan = plan_for_metric(metric, state, compression=cfg)
+        n_cs = sum(1 for b in cplan.buckets if b.sharded and b.compression is not None)
+        if not n_cs:
+            continue
+        seq = report.sequences[label]
+        if mode == "bf16":
+            n_bf16 = sum(
+                1
+                for desc in scatter_ops(label)
+                if desc.endswith(":bfloat16]")
+            )
+            if n_bf16 < n_cs:
+                report.problems.append(
+                    f"{subject}/{label}: plan bf16-compresses {n_cs} sharded bucket(s) "
+                    f"but the traced sync has {n_bf16} bfloat16 reduce-scatter(s) — "
+                    "the compressed scatter wire did not lower"
+                )
+        elif mode == "int8":
+            n_a2a = sum(1 for desc in seq if desc.split("[", 1)[0] == "all_to_all")
+            if n_a2a < n_cs:
+                report.problems.append(
+                    f"{subject}/{label}: plan int8-compresses {n_cs} sharded bucket(s) "
+                    f"but the traced sync has {n_a2a} all_to_all exchange(s) — the "
+                    "two-phase quantized scatter did not lower"
+                )
     return report
 
 

@@ -1016,6 +1016,14 @@ def config_fingerprint(metric: Any) -> Hashable:
     ranges = metric.__dict__.get("_value_ranges") or {}
     if ranges:
         items.append(("__value_ranges__", tuple(sorted(ranges.items()))))
+    # per-leaf sharding specs are trace-influencing despite the private name:
+    # a sharded leaf's sync lowers to psum_scatter with scattered out_specs,
+    # so a resharded metric must never reuse a stale replicated trace
+    shardings = metric.__dict__.get("_state_shardings") or {}
+    if shardings:
+        items.append(
+            ("__state_sharding__", tuple(sorted((k, int(v.axis)) for k, v in shardings.items())))
+        )
     return (type(metric).__module__, type(metric).__qualname__, tuple(items))
 
 
@@ -1317,18 +1325,21 @@ def compiled_sharded_update(
                     frozen, st, axis_name, compression, weight=mask[0]
                 )
 
+        # the bare P() object when nothing is sharded — byte-identical graphs
+        # for every pre-sharding config (golden trace contracts hold)
+        out_specs = frozen.sync_out_specs(axis_name)
         if masked:
             return jax.jit(
                 shard_map(
                     masked_step,
                     mesh=mesh,
                     in_specs=_mask_in_specs(specs, args, axis_name),
-                    out_specs=P(),
+                    out_specs=out_specs,
                     check_vma=False,
                 )
             )
         return jax.jit(
-            shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
+            shard_map(step, mesh=mesh, in_specs=specs, out_specs=out_specs, check_vma=False)
         )
 
     return _lookup(
@@ -1561,8 +1572,9 @@ def compiled_sharded_collection_update(
                 )
                 return dict(zip(names, synced))
 
-        # every leader state comes back fully replicated
-        out_specs = {name: P() for name in frozen}
+        # every leader state comes back fully replicated, except leaves a
+        # member declared sharded — those stay scattered on their shard axis
+        out_specs = {name: m.sync_out_specs(axis_name) for name, m in frozen.items()}
         if masked:
             return jax.jit(
                 shard_map(
@@ -1709,18 +1721,24 @@ def compiled_cadence_sync(
                 )
                 return dict(zip(names, synced))
 
+        # replicated P() per member unless a member declared sharded leaves —
+        # those stay scattered on their shard axis after the deferred sync
+        if any(getattr(m, "_state_shardings", None) for _, m in frozen):
+            out_specs: Any = {name: m.sync_out_specs(axis_name) for name, m in frozen}
+        else:
+            out_specs = P()
         if masked:
             return jax.jit(
                 shard_map(
                     masked_syncf,
                     mesh=mesh,
                     in_specs=(P(axis_name), P(axis_name)),
-                    out_specs=P(),
+                    out_specs=out_specs,
                     check_vma=False,
                 )
             )
         return jax.jit(
-            shard_map(syncf, mesh=mesh, in_specs=P(axis_name), out_specs=P(), check_vma=False)
+            shard_map(syncf, mesh=mesh, in_specs=P(axis_name), out_specs=out_specs, check_vma=False)
         )
 
     return _lookup(

@@ -65,8 +65,10 @@ from torchmetrics_tpu.core.guards import (
 )
 from torchmetrics_tpu.core.reductions import (
     Reduce,
+    ShardSpec,
     SketchReduce,
     canonical_reduce,
+    canonical_sharding,
     is_list_state,
     merge_leaf,
 )
@@ -79,6 +81,23 @@ State = Dict[str, Any]
 
 _N = "_n"  # reserved state key: int32 update counter, always psum/sum-merged
 _NONFINITE = "_nonfinite"  # reserved state key: int32 non-finite counter (nan_strategy warn/error)
+
+
+def _gather_replicated(leaf: Any) -> Any:
+    """The sharded-state plane's one deferred all-gather: re-lay a
+    device-scattered concrete array out replicated over its own mesh.
+    Tracers, non-device values, and already-replicated leaves pass through
+    untouched, so the pre-sharding paths see the identical object."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or not hasattr(leaf, "addressable_shards"):
+        return leaf
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(sharding, NamedSharding):
+        return leaf
+    if all(axes is None for axes in tuple(sharding.spec)):
+        return leaf  # already replicated over the mesh
+    return jax.device_put(leaf, NamedSharding(sharding.mesh, PartitionSpec()))
 
 # ctor kwargs consumed by Metric.__init__ — wrappers that forward leftover
 # kwargs elsewhere (e.g. PermutationInvariantTraining) split on this set
@@ -155,6 +174,9 @@ class Metric:
         # declared (lo, hi) per state leaf: lets the ragged gather bitpack
         # integer cat leaves to the narrowest sufficient wire dtype
         self._value_ranges: Dict[str, Tuple[float, float]] = {}
+        # cross-replica sharding spec per SUM tensor leaf: sharded leaves
+        # sync via psum_scatter and live scattered until compute() gathers
+        self._state_shardings: Dict[str, ShardSpec] = {}
         self._state: State = {_N: jnp.zeros((), dtype=jnp.int32)}
         # True once self._state may be aliased by another metric (compute
         # groups share one pytree across members): compiled paths must not
@@ -243,6 +265,13 @@ class Metric:
         d["_fingerprint_cache"] = (version, fp)
         return fp
 
+    def _note_config_change(self) -> None:
+        """Invalidate the config fingerprint after a *private* config
+        mutation (``__setattr__`` only versions public attrs)."""
+        d = self.__dict__
+        d["_config_version"] = d.get("_config_version", 0) + 1
+        d.pop("_fingerprint_cache", None)
+
     # ------------------------------------------------------------------ state
     def add_state(
         self,
@@ -251,6 +280,7 @@ class Metric:
         dist_reduce_fx: Optional[Union[str, Callable, SketchReduce]] = None,
         persistent: bool = False,
         value_range: Optional[Tuple[float, float]] = None,
+        state_sharding: Optional[Union[str, ShardSpec]] = None,
     ) -> None:
         """Register a state leaf (reference: metric.py:197-280).
 
@@ -267,6 +297,14 @@ class Metric:
         ``[0, 50k)`` cross as uint16, detection labels in ``[0, 80]`` as
         uint8) — lossless for in-range values; the declared range is a
         contract, values outside it would be truncated.
+
+        ``state_sharding`` (``"replicated"`` default | ``"sharded"`` |
+        :class:`~torchmetrics_tpu.core.reductions.ShardSpec`) shards a SUM
+        tensor leaf across the sync mesh axis: the cross-device sync lowers
+        to ``lax.psum_scatter`` (half the ring all-reduce's wire bytes) and
+        each chip keeps only its ``B/n`` block until ``compute()`` gathers.
+        Part of the compile-cache config fingerprint, so resharding never
+        reuses a stale replicated trace.
         """
         if name.startswith("_"):
             raise ValueError(f"State name {name!r} must not start with '_'")
@@ -300,6 +338,62 @@ class Metric:
             self._state[name] = arr.copy()
         self._reductions[name] = reduce
         self._persistent[name] = persistent
+        spec = canonical_sharding(state_sharding)
+        if spec is not None:
+            self._install_sharding(name, spec)
+
+    def _install_sharding(self, name: str, spec: ShardSpec) -> None:
+        """Validate + install one leaf's :class:`ShardSpec` and invalidate
+        the config fingerprint (sharding changes the traced sync graph)."""
+        reduce = self._reductions.get(name)
+        if reduce is not Reduce.SUM:
+            raise ValueError(
+                f"state_sharding requires dist_reduce_fx='sum' (leaf {name!r} has "
+                f"{reduce!r}): only sum-family leaves have a zero identity the "
+                "reduce-scatter padding and quarantine masking rely on"
+            )
+        default = self._defaults[name]
+        if is_list_state(default):
+            raise ValueError(f"state_sharding does not apply to list (cat) state {name!r}")
+        if spec.axis >= default.ndim:
+            raise ValueError(
+                f"ShardSpec.axis={spec.axis} out of range for state {name!r} "
+                f"with shape {tuple(default.shape)}"
+            )
+        if self._guard_strategy in ("warn", "error"):
+            raise ValueError(
+                "state_sharding is incompatible with nan_strategy 'warn'/'error': the "
+                "reserved non-finite counter is recomputed from the synced state and "
+                "must agree on every replica, but sharded leaves differ per device"
+            )
+        if type(self).sync_states is not Metric.sync_states:
+            raise ValueError(
+                f"{type(self).__name__} overrides sync_states with its own cross-shard "
+                "aggregation; state_sharding only applies to the standard coalesced sync"
+            )
+        self._state_shardings[name] = spec
+        self._note_config_change()
+
+    def set_state_sharding(self, name: str, sharding: Optional[Union[str, ShardSpec]]) -> None:
+        """Install (or clear, with ``None``/``"replicated"``) a leaf's
+        sharding spec on a constructed metric — the ShardingAdvisor's
+        actuation hook.  Flips the config fingerprint, so the next compiled
+        dispatch re-traces with the new sync lowering (exactly one new-key
+        cache miss per entrypoint) instead of reusing the replicated trace.
+        """
+        if name not in self._reductions:
+            raise KeyError(f"{name!r} is not a registered state leaf of {type(self).__name__}")
+        spec = canonical_sharding(sharding)
+        if spec is None:
+            if self._state_shardings.pop(name, None) is not None:
+                self._note_config_change()
+            return
+        self._install_sharding(name, spec)
+
+    @property
+    def state_shardings(self) -> Dict[str, ShardSpec]:
+        """Read-only copy of the per-leaf sharding specs."""
+        return dict(self._state_shardings)
 
     @property
     def _has_list_states(self) -> bool:
@@ -388,9 +482,62 @@ class Metric:
 
     def compute_state(self, state: State) -> Any:
         """Pure compute on a state pytree (named ``<ClassName>.compute`` in
-        profiles)."""
+        profiles).
+
+        Sharded leaves arrive here as device-scattered (possibly padded)
+        arrays; :meth:`_unpad_sharded` runs the ONE deferred all-gather of
+        the reduce-scatter sync path (re-laying each scattered leaf out
+        replicated) and slices the divisibility padding off, so ``_compute``
+        always consumes the exact replicated logical array — bit-for-bit the
+        value the replicated path computes on.  Metrics with no sharded
+        leaves trace the exact pre-sharding graph.
+        """
         with jax.named_scope(f"{type(self).__name__}.compute"):
-            return self._compute(state)
+            return self._compute(self._unpad_sharded(state))
+
+    def _unpad_sharded(self, state: State) -> State:
+        """Gather sharded leaves back to a replicated layout and slice the
+        reduce-scatter divisibility padding off (no-op — the same ``state``
+        object — when nothing is sharded).
+
+        The gather is explicit, not left to XLA: ``_compute`` reducing over a
+        device-partitioned layout may accumulate in a different order than
+        over the replicated array, and the sharded path promises *bit-for-bit*
+        compute parity, not just numerical closeness.
+        """
+        shardings = self.__dict__.get("_state_shardings") or {}
+        if not shardings:
+            return state
+        out = dict(state)
+        for name, spec in shardings.items():
+            leaf = out.get(name)
+            if leaf is None or isinstance(leaf, tuple):
+                continue
+            leaf = _gather_replicated(leaf)
+            dim = int(self._defaults[name].shape[spec.axis])
+            if leaf.ndim > spec.axis and int(leaf.shape[spec.axis]) != dim:
+                leaf = jax.lax.slice_in_dim(leaf, 0, dim, axis=spec.axis)
+            out[name] = leaf
+        return out
+
+    def _align_sharded(self, name: str, a_leaf: Any, b_leaf: Any) -> Tuple[Any, Any]:
+        """Zero-pad the smaller of two sharded-leaf operands on the shard
+        axis so a padded (synced) copy and a logical (local) copy merge
+        exactly — zeros are the SUM identity, so no value changes."""
+        spec = self._state_shardings.get(name)
+        if spec is None or isinstance(a_leaf, tuple):
+            return a_leaf, b_leaf
+        da, db = int(a_leaf.shape[spec.axis]), int(b_leaf.shape[spec.axis])
+        if da == db:
+            return a_leaf, b_leaf
+
+        def _pad(leaf: Any, to: int) -> Any:
+            widths = [(0, 0)] * leaf.ndim
+            widths[spec.axis] = (0, to - int(leaf.shape[spec.axis]))
+            return jnp.pad(leaf, widths)
+
+        to = max(da, db)
+        return (_pad(a_leaf, to) if da < to else a_leaf), (_pad(b_leaf, to) if db < to else b_leaf)
 
     def merge_states(self, a: State, b: State) -> State:
         """Combine two states under the per-leaf reduction table (pure).
@@ -400,8 +547,12 @@ class Metric:
         groups, and checkpoint joining.
         """
         out: State = {}
+        shardings = self.__dict__.get("_state_shardings") or {}
         for name, reduce in self._reductions.items():
-            out[name] = merge_leaf(reduce, a[name], b[name], n_a=a[_N], n_b=b[_N])
+            a_leaf, b_leaf = a[name], b[name]
+            if name in shardings:
+                a_leaf, b_leaf = self._align_sharded(name, a_leaf, b_leaf)
+            out[name] = merge_leaf(reduce, a_leaf, b_leaf, n_a=a[_N], n_b=b[_N])
         out[_N] = a[_N] + b[_N]
         if self._guard_strategy in ("warn", "error"):
             out[_NONFINITE] = count_nonfinite(out)
@@ -440,11 +591,44 @@ class Metric:
         sub: State = {name: state[name] for name in self._reductions}
         sub[_N] = state[_N]
         out = coalesced_sync_state(
-            sub, self._reductions, axis_name, compression=compression, weight=weight
+            sub,
+            self._reductions,
+            axis_name,
+            compression=compression,
+            weight=weight,
+            shardings=self.__dict__.get("_state_shardings") or None,
         )
         if self._guard_strategy in ("warn", "error"):
             out[_NONFINITE] = count_nonfinite(out)
         return out
+
+    def sync_out_specs(self, axis_name: Optional[str] = None) -> Any:
+        """``shard_map`` out_specs pytree for this metric's synced state:
+        ``P()`` (fully replicated — the historic contract) unless some leaf
+        carries a :class:`ShardSpec`, in which case that leaf stays
+        scattered on its shard axis and everything else is ``P()``.
+
+        Returning the bare ``P()`` object when nothing is sharded keeps the
+        compiled entry points' traced graphs bit-identical to the
+        pre-sharding ones (golden trace contracts hold).
+        """
+        from jax.sharding import PartitionSpec as P
+
+        shardings = self.__dict__.get("_state_shardings") or {}
+        if not shardings:
+            return P()
+        axis_name = axis_name or self.axis_name
+        specs: Dict[str, Any] = {}
+        for name in self._reductions:
+            spec = shardings.get(name)
+            if spec is None:
+                specs[name] = P()
+            else:
+                specs[name] = P(*([None] * spec.axis + [axis_name]))
+        specs[_N] = P()
+        if self._guard_strategy in ("warn", "error"):
+            specs[_NONFINITE] = P()
+        return specs
 
     def host_sync_states(self, state: State) -> State:
         """Cross-process (DCN, eager) sync — the host mirror of ``sync_states``.
@@ -725,6 +909,7 @@ class Metric:
         self.__dict__.setdefault("nan_strategy", "propagate")
         self.__dict__.setdefault("_nf_reported", 0)
         self.__dict__.setdefault("_value_ranges", {})  # pickles from before value_range existed
+        self.__dict__.setdefault("_state_shardings", {})  # pickles from before state_sharding existed
         self._state = {
             k: tuple(jnp.asarray(x) for x in v) if isinstance(v, (list, tuple)) else jnp.asarray(v)
             for k, v in self._state.items()

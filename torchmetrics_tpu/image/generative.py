@@ -199,10 +199,10 @@ class FrechetInceptionDistance(Metric):
         # the final mean/cov/Fréchet math runs in host float64 at compute
         self.add_state("real_features_sum", jnp.zeros(num_features), dist_reduce_fx="sum")
         self.add_state("real_features_cov_sum", jnp.zeros((num_features, num_features)), dist_reduce_fx="sum")
-        self.add_state("real_features_num_samples", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("real_features_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
         self.add_state("fake_features_sum", jnp.zeros(num_features), dist_reduce_fx="sum")
         self.add_state("fake_features_cov_sum", jnp.zeros((num_features, num_features)), dist_reduce_fx="sum")
-        self.add_state("fake_features_num_samples", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("fake_features_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _featurize(self, imgs: Array) -> Array:
         return jnp.asarray(self.inception(_maybe_to_uint8(imgs, self.normalize)), jnp.float32)

@@ -216,6 +216,7 @@ class ValueAttestation:
         "within_budget",
         "observed_err",
         "step",
+        "sharding",
     )
 
     def __init__(
@@ -226,12 +227,17 @@ class ValueAttestation:
         sources: List[Dict[str, Any]],
         policy: Optional[Dict[str, Any]] = None,
         step: Optional[int] = None,
+        sharding: Optional[Dict[str, int]] = None,
     ) -> None:
         self.label = label
         self.cls = cls
         self.fingerprint = fingerprint
         self.sources = list(sources)
         self.policy = dict(policy) if policy else None
+        #: installed ``state_sharding`` specs (``{leaf: shard_axis}``) —
+        #: provenance only: reduce-scatter sync is bit-for-bit exact, so
+        #: sharding never contributes an approximation source or bound
+        self.sharding = dict(sharding) if sharding else None
         self.step = None if step is None else int(step)
         self.bound, self.ledger = compose_sources(self.sources)
         self.exact = not self.sources
@@ -258,6 +264,8 @@ class ValueAttestation:
         }
         if self.policy is not None:
             out["policy"] = dict(self.policy)
+        if self.sharding is not None:
+            out["sharding"] = dict(self.sharding)
         if self.quorum_fraction is not None:
             out["quorum_fraction"] = self.quorum_fraction
         if self.observed_err is not None:
@@ -309,8 +317,20 @@ def attest(
         )
         if src is not None
     ]
+    shardings = getattr(metric, "_state_shardings", None) or None
+    sharding_block = (
+        {name: int(spec.axis) for name, spec in sorted(shardings.items())}
+        if shardings
+        else None
+    )
     return ValueAttestation(
-        label, type(metric).__name__, fingerprint, sources, policy=policy_block, step=step
+        label,
+        type(metric).__name__,
+        fingerprint,
+        sources,
+        policy=policy_block,
+        step=step,
+        sharding=sharding_block,
     )
 
 

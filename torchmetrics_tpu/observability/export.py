@@ -76,8 +76,16 @@ __all__ = [
 #: rows (composed error bound + provenance chain + budget ledger, approximate
 #: values only), ``kind: "attestation"`` payloads from
 #: ``observability/accuracy.py``, the ``tm_tpu_accuracy_*`` Prometheus
-#: families, and the ``accuracy`` flight-recorder category.
-SCHEMA_VERSION = "1.7.0"
+#: families, and the ``accuracy`` flight-recorder category; 1.8 added the
+#: cross-replica sharded-state plane — the ShardingAdvisor promoted to an
+#: actuator: ``kind: "sharding_advice"`` recommendation payloads exported
+#: standalone through the front door (previously only nested inside
+#: ``memory_report``), ``kind: "sharding_decision"`` JSONL ledger lines
+#: (``autotune_decision``-shaped rows for propose/arm/commit/veto/rollback/
+#: audit of per-leaf ``state_sharding`` specs), a ``/sharded`` suffix on
+#: measured per-bucket sync row keys, and sharding specs carried in
+#: attestation provenance.
+SCHEMA_VERSION = "1.8.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 

@@ -21,14 +21,20 @@ same for *resident* bytes, in three attribution layers:
    :func:`memory_timeline` / :func:`cost_by_fingerprint`; backends without
    analyses (CPU reports no peak) degrade to whatever fields exist, with
    ``available`` flagging rows where analysis failed entirely.
-3. **Replication-waste attribution** — each psum-family state leaf is
-   replicated across the mesh today, wasting ``leaf_bytes x (n_devices - 1)``
-   of cluster HBM.  The :class:`ShardingAdvisor` ranks those leaves and
-   quotes, per candidate, the granule-aware ring all-reduce bytes it pays now
-   versus the reduce-scatter bytes it would pay sharded (arxiv 2004.13336's
-   weight-update sharding applied to metric state) — the exact interface the
-   ROADMAP item-1 sharding planner will consume.  Report-only: nothing here
-   changes how state is placed.
+3. **Replication-waste attribution & actuation** — each psum-family state
+   leaf is replicated across the mesh by default, wasting
+   ``leaf_bytes x (n_devices - 1)`` of cluster HBM.  The
+   :class:`ShardingAdvisor` ranks those leaves and quotes, per candidate, the
+   granule-aware ring all-reduce bytes it pays now versus the reduce-scatter
+   bytes it would pay sharded (arxiv 2004.13336's weight-update sharding
+   applied to metric state).  ``advise()`` stays report-only;
+   :meth:`ShardingAdvisor.recommend` closes the loop: with ``apply=True`` it
+   drives a propose→arm→commit state machine (mirroring
+   :class:`~torchmetrics_tpu.parallel.autotune.SyncAutotuner`) that installs
+   ``state_sharding`` specs via ``Metric.set_state_sharding``, ledgers every
+   decision as ``kind: "sharding_decision"`` JSONL rows, audits the expected
+   one-time retraces against ``cache_stats_since``, and is veto-able /
+   roll-back-able through :meth:`ShardingAdvisor.guardrail_sink`.
 
 Everything is double-gated: :func:`enable_memory_telemetry` arms the plane,
 but nothing records until ``observability.enable()`` is also on (mirroring
@@ -85,6 +91,9 @@ from torchmetrics_tpu.utilities.benchmark import (
 )
 
 __all__ = [
+    "SHARDING_ACTIONS",
+    "SHARDING_LEDGER_KIND",
+    "SHARDING_STATES",
     "ShardingAdvisor",
     "cost_by_fingerprint",
     "disable_memory_telemetry",
@@ -98,6 +107,15 @@ __all__ = [
 ]
 
 _log = logging.getLogger("torchmetrics_tpu.observability")
+
+#: the actuation state machine's states, in commit order (mirrors
+#: ``parallel.autotune.AUTOTUNE_STATES``)
+SHARDING_STATES = ("observe", "candidate", "trial", "committed")
+#: every action a sharding ledger entry may carry
+SHARDING_ACTIONS = ("propose", "arm", "commit", "veto", "rollback", "audit")
+#: ``kind`` stamp on every sharding-decision ledger entry (JSONL consumers
+#: filter on it exactly like ``autotune_decision``)
+SHARDING_LEDGER_KIND = "sharding_decision"
 
 
 # ---------------------------------------------------------------------------
@@ -216,7 +234,8 @@ def memory_telemetry_enabled() -> bool:
 
 
 class ShardingAdvisor:
-    """Report-only advisor ranking the state leaves worth sharding.
+    """Advisor ranking the state leaves worth sharding — and, through
+    :meth:`recommend`, the actuator that installs the specs.
 
     For each psum-family leaf (the reductions ``core.reductions.sync_leaf``
     lowers to a ring all-reduce) of each metric, computes:
@@ -236,10 +255,17 @@ class ShardingAdvisor:
     family leaves (cat/reservoir/structural sketches) are excluded: they are
     not replicated-by-sum, so sharding them is a different problem.
 
-    Report-only by construction: the advisor never touches placement.  Its
-    output dict is the interface the ROADMAP item-1 cross-replica sharding
-    planner will consume, and what ``memory_report()`` exports under
-    ``memory.advice``.
+    :meth:`advise` is report-only by construction: it never touches
+    placement.  Its output dict is what ``memory_report()`` exports under
+    ``memory.advice``.  :meth:`recommend` wraps it in the actuation state
+    machine (``observe → candidate → trial → committed``, mirroring
+    :class:`~torchmetrics_tpu.parallel.autotune.SyncAutotuner`): a commit
+    installs each recommended leaf's :class:`~torchmetrics_tpu.core.reductions.ShardSpec`
+    on its metric, flips the metric's config fingerprint (one expected
+    ``new-key`` compile-cache miss per metric, audited by
+    :meth:`retrace_report`), and every transition lands in
+    :meth:`decision_ledger` as an ``autotune_decision``-shaped row with
+    ``kind: "sharding_decision"``.
     """
 
     def __init__(
@@ -247,6 +273,7 @@ class ShardingAdvisor:
         n_devices: Optional[int] = None,
         granule: int = RING_GRANULE_BYTES,
         min_leaf_bytes: int = 1 << 20,
+        veto_severity: str = "warning",
     ) -> None:
         self.n_devices = n_devices
         self.granule = int(granule)
@@ -254,6 +281,24 @@ class ShardingAdvisor:
         #: below it the granule floor erodes the reduce-scatter win and the
         #: HBM recovered is noise
         self.min_leaf_bytes = int(min_leaf_bytes)
+        #: health alerts at/above this severity veto a pending trial or roll
+        #: back a committed sharding (see :meth:`guardrail_sink`)
+        self.veto_severity = veto_severity
+        self.state = "observe"
+        self._seq = 0
+        self._ledger: List[Dict[str, Any]] = []
+        self._candidate: Optional[Dict[str, Any]] = None
+        #: per-leaf specs to restore on rollback: ``[(metric, label, leaf, old)]``
+        self._previous: Optional[List[Tuple[Any, str, str, Any]]] = None
+        self._commit_cache_baseline: Optional[Dict[str, Any]] = None
+        self._expected_retraces: Dict[str, Any] = {"new_keys": 0, "causes": []}
+        self.counts: Dict[str, int] = {
+            "proposals": 0,
+            "trials": 0,
+            "commits": 0,
+            "vetoes": 0,
+            "rollbacks": 0,
+        }
 
     @staticmethod
     def _label_for(metric: Any) -> str:
@@ -346,6 +391,385 @@ class ShardingAdvisor:
                 "sharding planner lands; candidates ranked by replicated HBM waste"
             ),
         }
+
+    # --------------------------------------------------------- actuation loop
+    def recommend(
+        self,
+        metrics: Iterable[Union[Any, Tuple[str, Any]]],
+        n_devices: Optional[int] = None,
+        apply: bool = False,
+        leaves: Optional[Iterable[str]] = None,
+        axis: int = 0,
+    ) -> Dict[str, Any]:
+        """:meth:`advise` promoted to a proposal: rank the leaves, stage the
+        ``worth_sharding`` short list as per-leaf
+        :class:`~torchmetrics_tpu.core.reductions.ShardSpec` candidates, and
+        (with ``apply=True``) arm and commit them onto the live metrics.
+
+        ``leaves`` restricts the staged set to the named ``"label/leaf"``
+        pairs (default: everything ``advise`` recommends); ``axis`` is the
+        shard axis every staged spec uses.  Returns the advice payload
+        (``kind: "sharding_advice"``, ready for the export front door — the
+        JSONL line picks up ``schema_version`` + process stamps and parses
+        back through ``parse_export_line``) extended with an ``actuation``
+        block recording the staged targets, state-machine state, and — after
+        an ``apply=True`` commit — the per-leaf install outcomes.
+
+        Without ``apply`` the state machine stops in ``candidate``: call
+        :meth:`arm` then :meth:`commit` to apply by hand, exactly like the
+        sync autotuner's staged flow.
+        """
+        from torchmetrics_tpu.core.reductions import ShardSpec
+
+        pairs: List[Tuple[str, Any]] = []
+        for item in metrics:
+            if isinstance(item, tuple) and len(item) == 2 and isinstance(item[0], str):
+                pairs.append(item)
+            else:
+                pairs.append((self._label_for(item), item))
+        advice = self.advise(pairs, n_devices=n_devices)
+        by_label = dict(pairs)
+        wanted = set(leaves) if leaves is not None else None
+        targets: List[Tuple[str, Any, str, ShardSpec]] = []
+        for key in advice["recommended"]:
+            if wanted is not None and key not in wanted:
+                continue
+            label, leaf = key.rsplit("/", 1)
+            metric = by_label.get(label)
+            if metric is not None:
+                targets.append((label, metric, leaf, ShardSpec(axis=axis)))
+        prior = self.state
+        self._candidate = {
+            "advice": advice,
+            "targets": targets,
+            "n_devices": advice["n_devices"],
+        }
+        self.state = "candidate"
+        self.counts["proposals"] += 1
+        self._record(
+            "propose",
+            state_from=prior,
+            targets=[f"{label}/{leaf}" for label, _, leaf, _ in targets],
+            trigger={
+                "n_devices": advice["n_devices"],
+                "total_replicated_waste_bytes": advice["total_replicated_waste_bytes"],
+                "projected_wire_savings_bytes_per_chip": advice[
+                    "total_projected_wire_savings_bytes_per_chip"
+                ],
+            },
+            rationale=(
+                f"staged {len(targets)} leaf spec(s) at/above "
+                f"{self.min_leaf_bytes} bytes, ranked by replicated HBM waste"
+            ),
+        )
+        out = dict(advice)
+        out["actuation"] = {
+            "state": self.state,
+            "targets": [f"{label}/{leaf}" for label, _, leaf, _ in targets],
+            "applied": False,
+        }
+        if apply:
+            self.arm()
+            entry = self.commit()
+            out["actuation"] = {
+                "state": self.state,
+                "targets": entry["targets"],
+                "applied": bool(entry["applied"]),
+                "skipped": entry["trigger"].get("skipped", []),
+                "expected_retraces": entry.get("expected_retraces"),
+            }
+        return out
+
+    def arm(self) -> Dict[str, Any]:
+        """Stage the proposed specs for commit: enter ``trial``, during which
+        any guardrail alert vetoes the pending sharding before it applies."""
+        if self.state != "candidate" or self._candidate is None:
+            raise RuntimeError(
+                f"ShardingAdvisor.arm: no candidate to stage (state {self.state!r}); "
+                "call recommend() first"
+            )
+        self.state = "trial"
+        self.counts["trials"] += 1
+        return self._record(
+            "arm",
+            state_from="candidate",
+            targets=[f"{l}/{leaf}" for l, _, leaf, _ in self._candidate["targets"]],
+            rationale="candidate specs staged; guardrails may veto until commit()",
+        )
+
+    def commit(self) -> Dict[str, Any]:
+        """Install the staged specs on the live metrics.
+
+        Each install goes through ``Metric.set_state_sharding`` — a leaf the
+        metric refuses (non-SUM reduction, guarded nan strategy, custom
+        ``sync_states``) is skipped and recorded, never silently forced.  The
+        compile-cache baseline is captured first so :meth:`retrace_report`
+        can prove the transition cost exactly its expected one ``new-key``
+        miss per re-fingerprinted metric and nothing more (0 steady-state
+        retraces).
+        """
+        if self.state != "trial" or self._candidate is None:
+            raise RuntimeError(
+                f"ShardingAdvisor.commit: no staged trial (state {self.state!r}) — "
+                "it may have been vetoed by a guardrail; check decision_ledger()"
+            )
+        from torchmetrics_tpu.core.compile import cache_stats
+
+        self._commit_cache_baseline = cache_stats()
+        previous: List[Tuple[Any, str, str, Any]] = []
+        applied: List[str] = []
+        skipped: List[Dict[str, str]] = []
+        for label, metric, leaf, spec in self._candidate["targets"]:
+            old = metric.state_shardings.get(leaf)
+            try:
+                metric.set_state_sharding(leaf, spec)
+            except (ValueError, KeyError) as err:
+                skipped.append({"target": f"{label}/{leaf}", "error": str(err)})
+                continue
+            previous.append((metric, label, leaf, old))
+            applied.append(f"{label}/{leaf}")
+        expected = {
+            "new_keys": len({id(m) for m, _, _, _ in previous}),
+            # a re-fingerprint of an already-compiled metric attributes as
+            # "invalidation" (same entrypoint+signature, new config); a metric
+            # first compiled after the commit attributes as "new-key"
+            "causes": ["invalidation", "new-key"] if previous else [],
+            "entrypoint": None,  # whichever entrypoint next runs the metric
+        }
+        self._previous = previous
+        self._expected_retraces = expected
+        self.state = "committed"
+        self.counts["commits"] += 1
+        entry = self._record(
+            "commit",
+            state_from="trial",
+            targets=applied,
+            applied=bool(applied),
+            trigger={
+                "applied": applied,
+                "skipped": skipped,
+                "n_devices": self._candidate["n_devices"],
+            },
+            expected_retraces=expected,
+            rationale=(
+                f"installed {len(applied)} sharding spec(s); each re-fingerprints "
+                "its metric for exactly one new-key compile per entrypoint"
+                if applied
+                else "no leaf accepted a spec; nothing installed"
+            ),
+        )
+        self._candidate = None
+        return entry
+
+    def veto(self, reason: str = "manual", alert: Optional[Any] = None) -> Dict[str, Any]:
+        """Veto the pending trial (guardrails call this through
+        :meth:`guardrail_sink`; callers may veto manually)."""
+        if self.state != "trial":
+            raise RuntimeError(
+                f"ShardingAdvisor.veto: no pending trial to veto (state {self.state!r})"
+            )
+        return self._veto(reason, alert=alert)
+
+    def rollback(
+        self,
+        reason: str = "manual",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Restore every committed leaf's previous sharding (usually
+        ``"replicated"``) and ledger why.  The restore re-fingerprints the
+        metrics again — the replicated traces are still cached, so going back
+        is hit-only."""
+        if self.state != "committed" or self._previous is None:
+            raise RuntimeError(
+                f"ShardingAdvisor.rollback: nothing committed to roll back "
+                f"(state {self.state!r})"
+            )
+        restored = []
+        for metric, label, leaf, old in self._previous:
+            metric.set_state_sharding(leaf, old if old is not None else "replicated")
+            restored.append(f"{label}/{leaf}")
+        self.counts["rollbacks"] += 1
+        entry = self._record(
+            "rollback",
+            state_from="committed",
+            state_to="observe",
+            targets=restored,
+            applied=True,
+            alert=alert,
+            error=error,
+            rationale=f"rolled back committed sharding: {reason}",
+        )
+        self.state = "observe"
+        self._previous = None
+        return entry
+
+    def guardrail_sink(self, min_severity: Optional[str] = None) -> Any:
+        """An ``AlertSink`` wiring :class:`~torchmetrics_tpu.observability.health.HealthMonitor`
+        alerts into the loop: ``monitor.add_sink(advisor.guardrail_sink())``.
+        Alerts at/above ``min_severity`` (default: the advisor's
+        ``veto_severity``) veto a pending trial or roll back a committed
+        sharding, in-band — the same guardrail contract as the sync
+        autotuner's."""
+        from torchmetrics_tpu.observability.health import CallbackAlertSink, _severity_rank
+
+        severity = self.veto_severity if min_severity is None else min_severity
+        _severity_rank(severity)  # validates
+        return CallbackAlertSink(self._on_alert, min_severity=severity)
+
+    def _on_alert(self, alert: Any) -> None:
+        if self.state == "trial":
+            self._veto("health_alert", alert=alert)
+        elif self.state == "committed" and self._previous is not None:
+            self.rollback(reason="health_alert", alert=alert)
+
+    def _veto(
+        self, reason: str, alert: Optional[Any] = None, error: Optional[str] = None
+    ) -> Dict[str, Any]:
+        staged = self._candidate["targets"] if self._candidate else []
+        self.counts["vetoes"] += 1
+        entry = self._record(
+            "veto",
+            state_from=self.state,
+            state_to="observe",
+            targets=[f"{l}/{leaf}" for l, _, leaf, _ in staged],
+            applied=False,
+            alert=alert,
+            error=error,
+            rationale=f"pending sharding vetoed: {reason}",
+        )
+        self.state = "observe"
+        self._candidate = None
+        return entry
+
+    def retrace_report(self) -> Dict[str, Any]:
+        """Compile-cache delta since the last commit, judged against the
+        ledgered expectation — the proof that a sharding transition costs
+        exactly one ``new-key`` miss per re-fingerprinted metric and that
+        steady state re-traces **zero** times.  Ledgered as an ``audit``
+        decision."""
+        from torchmetrics_tpu.core.compile import cache_stats_since
+
+        if self._commit_cache_baseline is None:
+            raise RuntimeError(
+                "ShardingAdvisor.retrace_report: no commit to audit"
+            )
+        delta = cache_stats_since(self._commit_cache_baseline)
+        delta_causes = delta["miss_causes"]
+        extra_misses = int(delta["misses"])
+        expected = self._expected_retraces
+        ok = (
+            extra_misses <= expected["new_keys"]
+            and sum(delta_causes.values()) <= expected["new_keys"]
+            and all(cause in expected["causes"] for cause in delta_causes)
+        )
+        audit = {
+            "extra_traces": int(delta["traces"]),
+            "extra_misses": extra_misses,
+            "miss_causes": delta_causes,
+            "expected": dict(expected),
+            "ok": bool(ok),
+        }
+        self._record(
+            "audit",
+            state_from=self.state,
+            state_to=self.state,
+            trigger=audit,
+            rationale=(
+                "trace-safety audit: cache delta since commit matches the "
+                "ledgered expectation"
+                if ok
+                else "trace-safety audit FAILED: unexpected compile-cache "
+                "traffic since sharding commit"
+            ),
+        )
+        return audit
+
+    def decision_ledger(self) -> List[Dict[str, Any]]:
+        """Every decision this advisor took, oldest first — stable schema
+        (``kind == "sharding_decision"``), safe to mutate."""
+        import copy
+
+        return copy.deepcopy(self._ledger)
+
+    def export_ledger(
+        self, path: Optional[str] = None, stream: Optional[Any] = None
+    ) -> List[str]:
+        """Write the ledger through the export front door: one JSONL line per
+        decision, stamped with ``schema_version`` + process identity and
+        parseable back via ``observability.parse_export_line`` — the same
+        contract as ``SyncAutotuner.export_ledger``."""
+        from torchmetrics_tpu.observability.export import JSONLinesExporter
+
+        exporter = JSONLinesExporter(path=path, stream=stream)
+        return [exporter.export(entry) for entry in self._ledger]
+
+    def report(self) -> Dict[str, Any]:
+        """The ``sharding`` block for the export front door."""
+        return {
+            "state": self.state,
+            "counts": dict(self.counts),
+            "decisions": len(self._ledger),
+            "expected_retraces": dict(self._expected_retraces),
+        }
+
+    def _record(
+        self,
+        action: str,
+        state_from: str,
+        state_to: Optional[str] = None,
+        targets: Optional[List[str]] = None,
+        applied: Optional[bool] = None,
+        trigger: Optional[Mapping[str, Any]] = None,
+        rationale: str = "",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+        expected_retraces: Optional[Mapping[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        import copy
+
+        entry: Dict[str, Any] = {
+            "kind": SHARDING_LEDGER_KIND,
+            "seq": self._seq,
+            "action": action,
+            "state_from": state_from,
+            "state_to": self.state if state_to is None else state_to,
+            "targets": list(targets or []),
+            "applied": bool(applied) if applied is not None else None,
+            "trigger": dict(trigger) if trigger else {},
+            "rationale": rationale,
+        }
+        if alert is not None:
+            entry["alert"] = alert.as_dict() if hasattr(alert, "as_dict") else dict(alert)
+        if error is not None:
+            entry["error"] = error
+        if expected_retraces is not None:
+            entry["expected_retraces"] = dict(expected_retraces)
+        self._seq += 1
+        self._ledger.append(entry)
+        self._flight_record(entry)
+        return copy.deepcopy(entry)
+
+    def _flight_record(self, entry: Mapping[str, Any]) -> None:
+        """Chrome-trace instant under the ``policy`` category, beside the
+        autotuner's — one timeline shows both control loops."""
+        from torchmetrics_tpu.observability import tracing
+
+        if not tracing.active():
+            return
+        rec = tracing.recorder()
+        if rec is None:  # pragma: no cover - active() already checked
+            return
+        rec.instant(
+            f"sharding/{entry['action']}",
+            "policy",
+            seq=entry["seq"],
+            state_from=entry["state_from"],
+            state_to=entry["state_to"],
+            targets=entry["targets"],
+            applied=entry["applied"],
+            rationale=entry["rationale"],
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -729,6 +729,7 @@ def record_sync(
     state: Mapping[str, Any],
     n_devices: int,
     compression: Any = None,
+    shardings: Any = None,
 ) -> None:
     """Record one cross-device sync for ``obj``: bumps ``syncs``, adds the
     modelled per-chip traffic to ``sync_bytes`` (compressed wire bytes when a
@@ -736,7 +737,10 @@ def record_sync(
     ``utilities.benchmark.sync_bytes_per_chip`` otherwise), the uncompressed
     model to ``sync_bytes_raw``, and the planner's fused collective count
     (``parallel.coalesce.bucketed_collective_count``) to ``collectives``.
-    Never raises — telemetry must not break a sync."""
+    ``shardings`` prices sharded buckets at the reduce-scatter wire rate
+    while ``sync_bytes_raw`` keeps the replicated model, so the two counters
+    diff into the sharding savings.  Never raises — telemetry must not
+    break a sync."""
     if not _ENABLED:
         return
     wire = 0
@@ -751,13 +755,19 @@ def record_sync(
 
         state = dict(state)
         table = {name: r for name, r in reductions.items() if name in state}
-        if compression is None:
+        if compression is None and not shardings:
             wire = raw = int(sync_bytes_per_chip(table, state, int(n_devices)))
         else:
             # same plan-based model for both, so wire/raw diff cleanly
-            wire = int(sync_wire_bytes_per_chip(table, state, int(n_devices), compression))
+            wire = int(
+                sync_wire_bytes_per_chip(
+                    table, state, int(n_devices), compression, shardings=shardings
+                )
+            )
             raw = int(sync_wire_bytes_per_chip(table, state, int(n_devices), None))
-        n_collectives = int(bucketed_collective_count(table, state, compression))
+        n_collectives = int(
+            bucketed_collective_count(table, state, compression, shardings=shardings)
+        )
     except Exception:
         _log.debug("sync byte accounting failed for %r", obj, exc_info=True)
     with _LOCK:
@@ -774,6 +784,7 @@ def record_measured_sync(
     n_devices: int,
     seconds: float,
     compression: Any = None,
+    shardings: Any = None,
 ) -> None:
     """Attribute one *measured* coalesced sync (block-until-ready wall time
     at the host boundary) to ``obj``'s per-bucket table.
@@ -793,24 +804,30 @@ def record_measured_sync(
     try:
         import numpy as _np
 
-        from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+        from torchmetrics_tpu.parallel.coalesce import bucket_scatter_size, build_sync_plan
         from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
         from torchmetrics_tpu.utilities.benchmark import RING_GRANULE_BYTES, ring_reduce_bytes
 
         entries = [(dict(r), dict(s)) for r, s in entries]
-        plan = build_sync_plan(entries, compression=compression)
+        plan = build_sync_plan(entries, compression=compression, shardings=shardings)
         n = max(int(n_devices), 1)
         for bucket in plan.buckets:
             itemsize = _np.dtype(bucket.dtype).itemsize
-            payload = bucket.size * itemsize
+            wire_size = bucket_scatter_size(bucket, n)
+            payload = wire_size * itemsize
             spec = bucket.compression
-            naive_b = int(bucket_wire_bytes(bucket.size, itemsize, n, spec, None))
-            ring_b = int(bucket_wire_bytes(bucket.size, itemsize, n, spec, RING_GRANULE_BYTES))
-            raw_b = int(ring_reduce_bytes(payload, n))
-            mode = spec.mode if spec is not None else "none"
-            rows.append(
-                (f"{bucket.dtype}/{bucket.op}", int(bucket.size), naive_b, ring_b, raw_b, mode)
+            naive_b = int(
+                bucket_wire_bytes(wire_size, itemsize, n, spec, None, sharded=bucket.sharded)
             )
+            ring_b = int(
+                bucket_wire_bytes(
+                    wire_size, itemsize, n, spec, RING_GRANULE_BYTES, sharded=bucket.sharded
+                )
+            )
+            raw_b = int(ring_reduce_bytes(payload, n))
+            key = f"{bucket.dtype}/{bucket.op}" + ("/sharded" if bucket.sharded else "")
+            mode = spec.mode if spec is not None else "none"
+            rows.append((key, int(bucket.size), naive_b, ring_b, raw_b, mode))
         for e, name, _reduce in plan.passthrough:
             leaf = entries[e][1][name]
             import jax as _jax

@@ -68,6 +68,7 @@ from torchmetrics_tpu.parallel.compress import (
     CompressionConfig,
     CompressionSpec,
     compressed_psum,
+    compressed_psum_scatter,
     compression_spec_for,
     host_dequantize_int8,
     host_quantize_int8,
@@ -119,6 +120,9 @@ class _Slot:
     shape: Tuple[int, ...]
     size: int
     mean: bool  # MEAN leaf riding the sum bucket: divide by axis size after
+    #: leaf dimension scattered across the sync axis (sharded SUM leaves
+    #: riding a reduce-scatter bucket); ``None`` for replicated leaves
+    shard_axis: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -135,6 +139,11 @@ class Bucket:
     op: str  # "sum" | "min" | "max"
     slots: Tuple[_Slot, ...]
     compression: Optional[CompressionSpec] = None
+    #: sharded buckets lower to ``lax.psum_scatter`` — each replica keeps
+    #: only its block of the sum ((n-1)/n·B wire bytes instead of the ring
+    #: all-reduce's 2(n-1)/n·B, B/n resident HBM); always ``False`` for
+    #: plans built without sharding specs (field-for-field identical plans)
+    sharded: bool = False
 
     @property
     def size(self) -> int:
@@ -142,7 +151,11 @@ class Bucket:
 
     @property
     def n_collectives(self) -> int:
-        """Collectives this bucket issues (the int8 exchange is two-phase)."""
+        """Collectives this bucket issues (the int8 exchange is two-phase;
+        sharded buckets always issue exactly one — the int8 reduce-scatter
+        drops the replicating ``all_gather`` phase)."""
+        if self.sharded:
+            return 1
         return 1 if self.compression is None else self.compression.n_collectives
 
 
@@ -174,6 +187,23 @@ class SyncPlan:
         return {f"{b.dtype}/{b.op}": b.size for b in self.buckets}
 
 
+def bucket_scatter_size(bucket: Bucket, n_devices: int) -> int:
+    """Element count a bucket actually moves: its logical size for
+    replicated buckets, the divisibility-padded size for sharded buckets
+    (each slot's shard dimension rounds up to a multiple of ``n_devices``
+    before the ``psum_scatter``)."""
+    if not bucket.sharded:
+        return bucket.size
+    n = max(int(n_devices), 1)
+    total = 0
+    for s in bucket.slots:
+        ax = s.shard_axis or 0
+        dim = s.shape[ax]
+        tail = s.size // max(dim, 1)
+        total += (-(-dim // n) * n) * tail
+    return total
+
+
 def _reduce_for(name: str, reductions: Mapping[str, Any]) -> Any:
     if name in _RESERVED:  # reserved counters: always summed
         return Reduce.SUM
@@ -189,6 +219,7 @@ def _reduce_for(name: str, reductions: Mapping[str, Any]) -> Any:
 def build_sync_plan(
     entries: Sequence[Tuple[Mapping[str, Any], Mapping[str, Any]]],
     compression: Optional[CompressionConfig] = None,
+    shardings: Optional[Sequence[Optional[Mapping[str, Any]]]] = None,
 ) -> SyncPlan:
     """Plan one coalesced sync over ``entries`` = [(reduction table, state), ...].
 
@@ -204,8 +235,14 @@ def build_sync_plan(
     :class:`CompressionSpec`; integer (count) buckets, min/max buckets, and
     every passthrough leaf always stay exact.  ``None`` (the default) yields
     a plan identical to the pre-compression planner.
+
+    ``shardings`` (aligned with ``entries``; each element ``None`` or a
+    ``{leaf: ShardSpec}`` mapping) routes sharded SUM leaves into dedicated
+    ``(dtype, op, sharded)`` buckets lowered to ``lax.psum_scatter`` —
+    every replica keeps only its block of the sum.  ``None`` (the default)
+    yields plans field-for-field identical to the pre-sharding planner.
     """
-    groups: Dict[Tuple[str, str], List[_Slot]] = {}
+    groups: Dict[Tuple[str, str, bool], List[_Slot]] = {}
     passthrough: List[Tuple[int, str, Any]] = []
     n_pass = 0
     for e, (reductions, state) in enumerate(entries):
@@ -229,7 +266,9 @@ def build_sync_plan(
                         size=int(np.prod(shape, dtype=np.int64)),
                         mean=False,
                     )
-                    groups.setdefault((str(jnp.dtype(value.dtype)), reduce.bucket_op), []).append(slot)
+                    groups.setdefault(
+                        (str(jnp.dtype(value.dtype)), reduce.bucket_op, False), []
+                    ).append(slot)
                 else:
                     passthrough.append((e, name, reduce))
                     n_pass += reduce.n_sync_gathers
@@ -248,19 +287,25 @@ def build_sync_plan(
                 n_pass += 1
                 continue
             shape = tuple(int(d) for d in value.shape)
+            shard_spec = None
+            if reduce == Reduce.SUM and shardings is not None and shardings[e]:
+                shard_spec = shardings[e].get(name)
             slot = _Slot(
                 entry=e,
                 name=name,
                 shape=shape,
                 size=int(np.prod(shape, dtype=np.int64)),
                 mean=reduce == Reduce.MEAN,
+                shard_axis=None if shard_spec is None else int(shard_spec.axis),
             )
-            groups.setdefault((str(dtype), _OP_OF[reduce]), []).append(slot)
+            groups.setdefault((str(dtype), _OP_OF[reduce], shard_spec is not None), []).append(slot)
     buckets = []
-    for (dt, op), slots in sorted(groups.items()):
+    for (dt, op, sharded), slots in sorted(groups.items()):
         nbytes = sum(s.size for s in slots) * jnp.dtype(dt).itemsize
         spec = compression_spec_for(dt, op, nbytes, compression)
-        buckets.append(Bucket(dtype=dt, op=op, slots=tuple(slots), compression=spec))
+        buckets.append(
+            Bucket(dtype=dt, op=op, slots=tuple(slots), compression=spec, sharded=sharded)
+        )
     buckets = tuple(buckets)
     return SyncPlan(
         buckets=buckets,
@@ -268,6 +313,66 @@ def build_sync_plan(
         n_entries=len(entries),
         n_passthrough_collectives=n_pass,
     )
+
+
+def _apply_sharded_bucket(
+    bucket: Bucket,
+    states: Sequence[Mapping[str, Any]],
+    axis_name: str,
+    w: Optional[Any],
+    outs: List[State],
+) -> None:
+    """Lower one sharded SUM bucket to a single ``lax.psum_scatter``.
+
+    Per slot: move the shard axis to the front, zero-pad it (the SUM
+    identity) to a multiple of the mesh-axis size ``n``, and view it as
+    ``(n, k)`` — row ``i`` is the flattened block device ``i`` will own.
+    Slots concatenate along the block dimension so the whole bucket rides
+    ONE collective; ``psum_scatter`` leaves device ``i`` holding the exact
+    cross-replica sum of block ``i``, which slices back into per-slot shard
+    shapes.  Wire bytes per chip: ``(n-1)/n·B`` instead of the ring
+    all-reduce's ``2(n-1)/n·B``; resident HBM per chip: ``B/n``.
+
+    The quarantine mask multiplies the contribution before the collective
+    (zeros are the SUM identity), and bf16/int8 compression applies to the
+    scatter payload per-bucket exactly as on the all-reduce path
+    (:func:`~torchmetrics_tpu.parallel.compress.compressed_psum_scatter`).
+    """
+    # Under shard_map the axis size constant-folds to a concrete Python int.
+    n = jax.lax.psum(1, axis_name)
+    mats = []
+    layout = []  # (slot, moved_tail_shape, padded_dim, block_cols)
+    for s in bucket.slots:
+        x = states[s.entry][s.name]
+        ax = s.shard_axis or 0
+        x = jnp.moveaxis(x, ax, 0)
+        d = int(x.shape[0])
+        pad = (-d) % n
+        if pad:
+            widths = [(0, 0)] * x.ndim
+            widths[0] = (0, pad)
+            x = jnp.pad(x, widths)
+        tail = tuple(int(t) for t in x.shape[1:])
+        mat = x.reshape((n, -1))
+        mats.append(mat)
+        layout.append((s, tail, d + pad, int(mat.shape[1])))
+    mat = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
+    if w is not None:
+        mat = mat * w.astype(mat.dtype)
+    if bucket.compression is not None:
+        with jax.named_scope(
+            f"tm_tpu/compress/{bucket.compression.mode}_scatter_{bucket.dtype}"
+        ):
+            red = compressed_psum_scatter(mat, axis_name, bucket.compression)
+    else:
+        with jax.named_scope(f"tm_tpu/coalesce/scatter_{bucket.dtype}"):
+            red = jax.lax.psum_scatter(mat, axis_name, scatter_dimension=0, tiled=False)
+    offset = 0
+    for s, tail, padded_dim, cols in layout:
+        seg = red if len(layout) == 1 else jax.lax.slice_in_dim(red, offset, offset + cols)
+        seg = seg.reshape((padded_dim // n,) + tail)
+        outs[s.entry][s.name] = jnp.moveaxis(seg, 0, s.shard_axis or 0)
+        offset += cols
 
 
 def _mask_identity(dtype: Any, op: str) -> Any:
@@ -315,6 +420,9 @@ def apply_sync_plan(
     outs: List[State] = [{} for _ in range(plan.n_entries)]
     w = None if weight is None else jnp.asarray(weight).reshape(())
     for bucket in plan.buckets:
+        if bucket.sharded:
+            _apply_sharded_bucket(bucket, states, axis_name, w, outs)
+            continue
         parts = [states[s.entry][s.name].reshape((s.size,)) for s in bucket.slots]
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         if w is not None:
@@ -353,6 +461,7 @@ def coalesced_sync_state(
     axis_name: str = "data",
     compression: Optional[CompressionConfig] = None,
     weight: Optional[Any] = None,
+    shardings: Optional[Mapping[str, Any]] = None,
 ) -> State:
     """Bucketed replacement for the per-leaf sync loop (pure, in-graph).
 
@@ -361,8 +470,14 @@ def coalesced_sync_state(
     per-leaf ``sync_state`` enforced.  ``compression=None`` (the default)
     traces the exact planner graph bit-for-bit.  ``weight`` is the
     per-replica quarantine mask (see :func:`apply_sync_plan`).
+    ``shardings`` (``{leaf: ShardSpec}``) routes sharded SUM leaves to the
+    reduce-scatter lowering — those come back shard-shaped per device.
     """
-    plan = build_sync_plan([(reductions, state)], compression=compression)
+    plan = build_sync_plan(
+        [(reductions, state)],
+        compression=compression,
+        shardings=None if not shardings else [shardings],
+    )
     return apply_sync_plan(plan, [state], axis_name, weight=weight)[0]
 
 
@@ -372,6 +487,11 @@ def _metric_entry(metric: Any, state: Mapping[str, Any]) -> Tuple[Mapping[str, A
     sub: State = {name: state[name] for name in metric._reductions}
     sub[_N] = state[_N]
     return metric._reductions, sub
+
+
+def _metric_shardings(metric: Any) -> Optional[Mapping[str, Any]]:
+    """The metric's per-leaf ShardSpec table, or ``None`` when unsharded."""
+    return getattr(metric, "_state_shardings", None) or None
 
 
 def plan_for_metric(
@@ -389,7 +509,11 @@ def plan_for_metric(
     """
     if state is None:
         state = metric._state
-    return build_sync_plan([_metric_entry(metric, state)], compression=compression)
+    return build_sync_plan(
+        [_metric_entry(metric, state)],
+        compression=compression,
+        shardings=[_metric_shardings(metric)],
+    )
 
 
 def plan_for_metrics(
@@ -410,7 +534,10 @@ def plan_for_metrics(
         i for i, m in enumerate(metrics) if type(m).sync_states is Metric.sync_states
     )
     entries = [_metric_entry(metrics[i], states[i]) for i in standard]
-    return build_sync_plan(entries, compression=compression), standard
+    shardings = [_metric_shardings(metrics[i]) for i in standard]
+    if not any(shardings):
+        shardings = None  # pre-sharding plans stay field-for-field identical
+    return build_sync_plan(entries, compression=compression, shardings=shardings), standard
 
 
 def coalesced_metric_sync(
@@ -453,9 +580,14 @@ def bucketed_collective_count(
     reductions: Mapping[str, Any],
     state: Mapping[str, Any],
     compression: Optional[CompressionConfig] = None,
+    shardings: Optional[Mapping[str, Any]] = None,
 ) -> int:
     """Collectives one coalesced sync of ``state`` launches (telemetry model)."""
-    return build_sync_plan([(reductions, state)], compression=compression).n_collectives
+    return build_sync_plan(
+        [(reductions, state)],
+        compression=compression,
+        shardings=None if not shardings else [shardings],
+    ).n_collectives
 
 
 def per_leaf_collective_count(

@@ -281,6 +281,52 @@ def compressed_psum(flat: jnp.ndarray, axis_name: str, spec: CompressionSpec) ->
     raise ValueError(f"unknown compression mode {spec.mode!r}")
 
 
+def psum_scatter_bf16(mat: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Reduce-scatter ``(n, K) -> (K,)`` with a bfloat16 wire payload."""
+    return jax.lax.psum_scatter(
+        mat.astype(jnp.bfloat16), axis_name, scatter_dimension=0, tiled=False
+    ).astype(mat.dtype)
+
+
+def psum_scatter_int8(
+    mat: jnp.ndarray, axis_name: str, chunk: int = DEFAULT_CHUNK
+) -> jnp.ndarray:
+    """Reduce-scatter leg of the int8 exchange: ``(n, K) -> (K,)``.
+
+    Exactly :func:`psum_int8`'s phases 1-2 — quantize the ``n`` destination
+    blocks locally, ``all_to_all`` so each device holds every sender's copy
+    of its own block, dequantize and sum exactly in fp32 — with the
+    replicating requantize+``all_gather`` phases dropped: a sharded bucket
+    keeps the block resident per device, so the partial sum IS the result.
+    One collective, one quantization stage (senders only; the sum itself is
+    never requantized), ``(n-1)`` packed-block hops per chip instead of the
+    all-reduce's ``2(n-1)``.
+    """
+    orig_dtype = mat.dtype
+    mat = mat.astype(jnp.float32)
+    n, k = int(mat.shape[0]), int(mat.shape[1])
+    n_chunks = -(-k // chunk)
+    blocks = jnp.pad(mat, ((0, 0), (0, n_chunks * chunk - k)))
+    packed = jnp.stack([_quantize_chunks(blocks[j], n_chunks, chunk) for j in range(n)])
+    received = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0)
+    partial = jnp.stack(
+        [_dequantize_chunks(received[j], n_chunks, chunk) for j in range(n)]
+    ).sum(axis=0)
+    return partial[:k].astype(orig_dtype)
+
+
+def compressed_psum_scatter(
+    mat: jnp.ndarray, axis_name: str, spec: CompressionSpec
+) -> jnp.ndarray:
+    """Dispatch a sharded bucket's ``(n, K) -> (K,)`` reduce-scatter through
+    the spec's compression mode."""
+    if spec.mode == "bf16":
+        return psum_scatter_bf16(mat, axis_name)
+    if spec.mode == "int8":
+        return psum_scatter_int8(mat, axis_name, spec.chunk)
+    raise ValueError(f"unknown compression mode {spec.mode!r}")
+
+
 # ---------------------------------------------------------------------------
 # Wire-byte models (consumed by utilities/benchmark.py and telemetry)
 # ---------------------------------------------------------------------------
@@ -304,6 +350,7 @@ def bucket_wire_bytes(
     n_devices: int,
     spec: Optional[CompressionSpec],
     granule: Optional[int] = None,
+    sharded: bool = False,
 ) -> int:
     """Modelled per-chip wire bytes for one bucket all-reduce.
 
@@ -313,6 +360,11 @@ def bucket_wire_bytes(
     follow the ring schedule (2(n-1) payload-chunk hops per chip); the int8
     two-phase exchange moves 2(n-1) packed blocks per chip (n-1 in the
     ``all_to_all`` scatter phase, n-1 in the ``all_gather`` phase).
+
+    ``sharded=True`` prices a reduce-scatter bucket: the replicating second
+    half of the ring schedule (and the int8 ``all_gather`` phase) is
+    dropped, so every mode moves exactly half the hops — ``(n-1)`` instead
+    of ``2(n-1)`` — per chip.
     """
     n = int(n_devices)
     if n <= 1:
@@ -323,12 +375,14 @@ def bucket_wire_bytes(
         payload = size * 2
     elif spec.mode == "int8":
         block = int8_block_bytes(size, n, spec.chunk)
-        return 2 * (n - 1) * _granule_ceil(block, granule)
+        hops = (n - 1) if sharded else 2 * (n - 1)
+        return hops * _granule_ceil(block, granule)
     else:
         raise ValueError(f"unknown compression mode {spec.mode!r}")
+    hops = (n - 1) if sharded else 2 * (n - 1)
     if granule is None:
-        return int(round(2 * (n - 1) / n * payload))
-    return 2 * (n - 1) * _granule_ceil(-(-payload // n), granule)
+        return int(round(hops / n * payload))
+    return hops * _granule_ceil(-(-payload // n), granule)
 
 
 def host_compressed_payload_bytes(size: int, itemsize: int, spec: Optional[CompressionSpec]) -> int:

@@ -191,6 +191,7 @@ def _measured_sync_dispatch(
     mesh: Mesh,
     entries_of: Optional[Callable[[Any], Any]] = None,
     compression: Optional[CompressionConfig] = None,
+    shardings: Any = None,
 ) -> Any:
     """Dispatch one compiled sharded sync under the owner's ``"sync"`` span.
 
@@ -210,7 +211,12 @@ def _measured_sync_dispatch(
         measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured sync cost at the host boundary; outside any traced graph
         entries = entries_of(out) if entries_of is not None else [(owner._reductions, out)]
         _telemetry.record_measured_sync(
-            owner, entries, int(mesh.devices.size), measured_s, compression=compression
+            owner,
+            entries,
+            int(mesh.devices.size),
+            measured_s,
+            compression=compression,
+            shardings=shardings,
         )
         # the same window also feeds the process-wide wait digest the fleet
         # plane ranks hosts by (observability/fleet.py straggler attribution)
@@ -343,10 +349,29 @@ def sharded_update(
 
         from torchmetrics_tpu.core.compile import shard_map
 
-        fn = shard_map(step, mesh=mesh, in_specs=specs, out_specs=P(), check_vma=False)
-        out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
+        sharding_table = metric.__dict__.get("_state_shardings") or None
+        fn = shard_map(
+            step,
+            mesh=mesh,
+            in_specs=specs,
+            out_specs=metric.sync_out_specs(axis_name),
+            check_vma=False,
+        )
+        out = _measured_sync_dispatch(
+            metric,
+            fn,
+            inputs,
+            mesh,
+            compression=compression,
+            shardings=None if not sharding_table else [sharding_table],
+        )
         _telemetry.record_sync(
-            metric, metric._reductions, out, int(mesh.devices.size), compression=compression
+            metric,
+            metric._reductions,
+            out,
+            int(mesh.devices.size),
+            compression=compression,
+            shardings=sharding_table,
         )
         if verify_consistency:
             from torchmetrics_tpu.resilience.divergence import verify_replica_consistency
@@ -362,7 +387,10 @@ def sharded_update(
     # ~1 s compile)
     from torchmetrics_tpu.core.compile import compiled_sharded_update
 
+    sharding_table = metric.__dict__.get("_state_shardings") or None
+
     def dispatch() -> State:
+        measured_shardings = None if not sharding_table else [sharding_table]
         if is_degraded(metric):
             from torchmetrics_tpu.resilience.quarantine import quarantine_mask
 
@@ -371,15 +399,27 @@ def sharded_update(
             )
             mask = quarantine_mask(metric, mesh, axis_name)
             out = _measured_sync_dispatch(
-                metric, fn, (mask,) + inputs, mesh, compression=compression
+                metric,
+                fn,
+                (mask,) + inputs,
+                mesh,
+                compression=compression,
+                shardings=measured_shardings,
             )
         else:
             fn = compiled_sharded_update(
                 metric, mesh, axis_name, specs, inputs, compression=compression
             )
-            out = _measured_sync_dispatch(metric, fn, inputs, mesh, compression=compression)
+            out = _measured_sync_dispatch(
+                metric, fn, inputs, mesh, compression=compression, shardings=measured_shardings
+            )
         _telemetry.record_sync(
-            metric, metric._reductions, out, int(mesh.devices.size), compression=compression
+            metric,
+            metric._reductions,
+            out,
+            int(mesh.devices.size),
+            compression=compression,
+            shardings=sharding_table,
         )
         return out
 
@@ -549,6 +589,9 @@ def sharded_collection_update(
                 collection, leaders, mesh, axis_name, specs, inputs, compression=compression
             )
             call_inputs = inputs
+        leader_shardings = [
+            collection[name].__dict__.get("_state_shardings") or None for name in leaders
+        ]
         out = _measured_sync_dispatch(
             collection,
             fn,
@@ -556,16 +599,18 @@ def sharded_collection_update(
             mesh,
             entries_of=lambda o: [(collection[name]._reductions, o[name]) for name in leaders],
             compression=compression,
+            shardings=leader_shardings if any(leader_shardings) else None,
         )
         if _telemetry.enabled():
             n_dev = int(mesh.devices.size)
-            for name in leaders:
+            for name, sharding_table in zip(leaders, leader_shardings):
                 _telemetry.record_sync(
                     collection[name],
                     collection[name]._reductions,
                     out[name],
                     n_dev,
                     compression=compression,
+                    shardings=sharding_table,
                 )
         return out
 

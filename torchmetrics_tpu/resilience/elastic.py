@@ -139,7 +139,12 @@ def elastic_restore(obj: Any, snap: Mapping[str, Any], strict_class: bool = True
     * For a ``Metric``/``MetricCollection``, replicated state is
       mesh-agnostic — this delegates to
       :func:`torchmetrics_tpu.resilience.restore` unchanged, regardless of
-      the mesh recorded in the snapshot header.
+      the mesh recorded in the snapshot header.  Leaves snapshotted as
+      per-shard payloads (``state_sharding`` states, spec kind
+      ``"sharded"``) are reassembled to their mesh-agnostic logical array by
+      that same restore, so an 8-shard snapshot restores onto a 4-device
+      mesh (and back) bit-identically; the next sharded sync re-scatters the
+      leaf over whatever mesh is current.
     """
     from torchmetrics_tpu.parallel.coalesce import SyncStepper
 

@@ -14,9 +14,22 @@ touched**:
         "schema_version": 1,
         "kind": "metric",
         "class": "torchmetrics_tpu.classification...BinaryAccuracy",
-        "spec": {leaf: {"kind": "array", "shape": [...], "dtype": "..."} | {"kind": "list", ...}},
+        "spec": {leaf: {"kind": "array", "shape": [...], "dtype": "..."}
+                       | {"kind": "list", ...}
+                       | {"kind": "sharded", "axis": ..., "n_shards": ...,
+                          "shapes": [...], "logical_shape": [...], "dtype": "..."}},
         "state": {leaf: np.ndarray | [np.ndarray, ...]},   # host numpy pytree
     }
+
+A leaf carrying a ``state_sharding`` spec whose live value is genuinely
+device-sharded (``add_state(..., state_sharding="sharded")`` after a
+reduce-scatter sync) is stored as its **per-shard** payloads, in shard-axis
+order — each shard is a separate array in the payload list, so the durable
+store's per-array CRC walk covers every shard independently.  Restore
+reassembles the shards (concatenate along the shard axis, slice padding back
+to the recorded ``logical_shape``) into a plain mesh-agnostic logical array
+before validation, which is what makes elastic 8→4→8 restores bit-identical:
+the installed state never depends on the producing mesh size.
 
 ``snapshot(collection)`` wraps one metric snapshot per member plus the
 compute-group partition, so restore re-establishes state aliasing exactly
@@ -127,8 +140,11 @@ def validate_state_leaf(metric: Metric, name: str, value: Any) -> Any:
             leaf=name,
             reason="kind",
         )
-    arr = np.asarray(value)
-    if arr.dtype != np.asarray(default).dtype:
+    # a jnp leaf passes through untouched: checks read only shape/dtype
+    # metadata, so a device-sharded value keeps its placement (a numpy
+    # round-trip would gather every shard to host and re-replicate)
+    arr = value if isinstance(value, jnp.ndarray) else np.asarray(value)
+    if np.dtype(arr.dtype) != np.asarray(default).dtype:
         raise StateRestoreError(
             f"State leaf {name!r} of {type(metric).__name__} has dtype {arr.dtype}, "
             f"expected {np.asarray(default).dtype}.",
@@ -144,13 +160,39 @@ def validate_state_leaf(metric: Metric, name: str, value: Any) -> Any:
                 reason="shape",
             )
     elif tuple(arr.shape) != tuple(np.asarray(default).shape):
-        raise StateRestoreError(
-            f"State leaf {name!r} of {type(metric).__name__} has shape {tuple(arr.shape)}, "
-            f"expected {tuple(np.asarray(default).shape)}.",
-            leaf=name,
-            reason="shape",
-        )
+        sliced = _slice_sharding_padding(metric, name, arr)
+        if sliced is None:
+            raise StateRestoreError(
+                f"State leaf {name!r} of {type(metric).__name__} has shape {tuple(arr.shape)}, "
+                f"expected {tuple(np.asarray(default).shape)}.",
+                leaf=name,
+                reason="shape",
+            )
+        arr = sliced
     return jnp.asarray(arr)
+
+
+def _slice_sharding_padding(metric: Metric, name: str, arr: Any) -> Optional[Any]:
+    """A sharded leaf's live value may carry divisibility padding (identity
+    zeros) on its shard axis; accept it by slicing back to the logical dim.
+    Returns ``None`` unless ``arr`` matches the default everywhere except an
+    oversized shard axis on a leaf with an installed ``state_sharding``."""
+    spec = (getattr(metric, "_state_shardings", None) or {}).get(name)
+    if spec is None:
+        return None
+    default_shape = tuple(np.asarray(metric._defaults[name]).shape)
+    axis = spec.axis
+    if arr.ndim != len(default_shape) or axis >= arr.ndim:
+        return None
+    if arr.shape[axis] < default_shape[axis]:
+        return None
+    if any(
+        arr.shape[d] != default_shape[d] for d in range(arr.ndim) if d != axis
+    ):
+        return None
+    index = [slice(None)] * arr.ndim
+    index[axis] = slice(0, default_shape[axis])
+    return arr[tuple(index)]
 
 
 def validate_state_pytree(metric: Metric, state: Mapping[str, Any]) -> State:
@@ -218,14 +260,50 @@ def _leaf_spec(leaf: Any) -> Dict[str, Any]:
     return {"kind": "array", "shape": list(arr.shape), "dtype": str(arr.dtype)}
 
 
+def _shard_payload(leaf: Any, axis: int) -> Optional[List[np.ndarray]]:
+    """Per-shard numpy payloads of a genuinely device-sharded array, in
+    shard-axis order; ``None`` when the leaf holds one (replicated) shard or
+    is not a device array.  Shards are deduplicated by their index window
+    (replicas of the same window are one payload)."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if not shards:
+        return None
+    by_window: Dict[Tuple, Any] = {}
+    for shard in shards:
+        index = tuple(
+            (s.start if s.start is not None else 0, s.stop) for s in shard.index
+        )
+        by_window.setdefault(index, shard)
+    if len(by_window) <= 1:
+        return None
+    ordered = sorted(by_window.items(), key=lambda kv: kv[0][axis][0])
+    return [np.asarray(shard.data) for _, shard in ordered]
+
+
 def _metric_snapshot(metric: Metric) -> Dict[str, Any]:
     from torchmetrics_tpu.observability import registry as _telemetry
 
     _telemetry.count(metric, "snapshots")
     state = metric.state_pytree()
+    shardings = getattr(metric, "_state_shardings", None) or {}
     payload: Dict[str, Any] = {}
     spec: Dict[str, Any] = {}
     for name, leaf in state.items():
+        shard_spec = shardings.get(name)
+        parts = (
+            _shard_payload(leaf, shard_spec.axis) if shard_spec is not None else None
+        )
+        if parts is not None:
+            spec[name] = {
+                "kind": "sharded",
+                "axis": int(shard_spec.axis),
+                "n_shards": len(parts),
+                "shapes": [list(p.shape) for p in parts],
+                "logical_shape": list(np.asarray(metric._defaults[name]).shape),
+                "dtype": str(parts[0].dtype),
+            }
+            payload[name] = parts
+            continue
         spec[name] = _leaf_spec(leaf)
         if isinstance(leaf, (tuple, list)):
             payload[name] = [np.asarray(x) for x in leaf]
@@ -375,6 +453,9 @@ def _check_payload_matches_spec(snap: Mapping[str, Any]) -> None:
                 leaf=name,
                 reason="corrupt",
             )
+        if entry.get("kind") == "sharded":
+            _check_sharded_payload(name, entry, leaf)
+            continue
         actual = _leaf_spec(leaf)
         if entry.get("kind") != actual["kind"]:
             raise StateRestoreError(
@@ -401,11 +482,62 @@ def _check_payload_matches_spec(snap: Mapping[str, Any]) -> None:
             )
 
 
+def _check_sharded_payload(name: str, entry: Mapping[str, Any], leaf: Any) -> None:
+    """Spec/payload agreement for one ``kind: "sharded"`` leaf: a sequence of
+    exactly ``n_shards`` arrays whose per-shard shapes and shared dtype match
+    what the snapshot recorded."""
+    if not isinstance(leaf, (list, tuple)):
+        raise StateRestoreError(
+            f"Snapshot sharded leaf {name!r} payload must be a sequence of per-shard "
+            f"arrays; got {type(leaf).__name__} (corrupted snapshot).",
+            leaf=name,
+            reason="corrupt",
+        )
+    parts = [np.asarray(p) for p in leaf]
+    if len(parts) != int(entry.get("n_shards", -1)):
+        raise StateRestoreError(
+            f"Snapshot sharded leaf {name!r} payload holds {len(parts)} shard(s) but its "
+            f"spec records {entry.get('n_shards')} (corrupted snapshot).",
+            leaf=name,
+            reason="corrupt",
+        )
+    if [list(p.shape) for p in parts] != list(entry.get("shapes", [])) or any(
+        str(p.dtype) != entry.get("dtype") for p in parts
+    ):
+        raise StateRestoreError(
+            f"Snapshot sharded leaf {name!r} per-shard shapes/dtype do not match its "
+            "recorded spec (corrupted snapshot).",
+            leaf=name,
+            reason="corrupt",
+        )
+
+
+def _reassemble_sharded(name: str, entry: Mapping[str, Any], parts: Sequence[Any]) -> np.ndarray:
+    """Concatenate per-shard payloads along the shard axis and slice any
+    divisibility padding back off, yielding the mesh-agnostic logical array.
+    Mesh-size independence is the point: 8 shards from an 8-device run and
+    4 shards from a 4-device run reassemble to the identical logical value."""
+    axis = int(entry.get("axis", 0))
+    full = np.concatenate([np.asarray(p) for p in parts], axis=axis)
+    logical = entry.get("logical_shape")
+    if logical is not None and full.shape[axis] > int(logical[axis]):
+        index = [slice(None)] * full.ndim
+        index[axis] = slice(0, int(logical[axis]))
+        full = full[tuple(index)]
+    return full
+
+
 def _restore_metric(metric: Metric, snap: Mapping[str, Any], strict_class: bool) -> State:
     """Validate a metric snapshot fully; return the installable state."""
     _check_header(snap, "metric", metric, strict_class)
     _check_payload_matches_spec(snap)
-    return validate_state_pytree(metric, snap["state"])
+    state: Dict[str, Any] = dict(snap["state"])
+    spec = snap.get("spec")
+    if isinstance(spec, Mapping):
+        for name, entry in spec.items():
+            if isinstance(entry, Mapping) and entry.get("kind") == "sharded":
+                state[name] = _reassemble_sharded(name, entry, state[name])
+    return validate_state_pytree(metric, state)
 
 
 def _install(metric: Metric, state: State) -> None:

@@ -189,6 +189,7 @@ def coalesced_sync_bytes_per_chip(
     n_devices: int,
     granule: int = RING_GRANULE_BYTES,
     compression: Any = None,
+    shardings: Any = None,
 ) -> int:
     """Granule-aware per-chip traffic of the coalesced sync: one ring
     all-reduce per planner bucket (the granule floor amortized over every
@@ -200,15 +201,31 @@ def coalesced_sync_bytes_per_chip(
     same per-bucket :func:`parallel.compress.bucket_wire_bytes` model the
     telemetry counters use.  ``None`` reproduces the exact byte model
     bit-for-bit (``bucket_wire_bytes`` with no spec IS the ring formula).
+
+    ``shardings`` (``{leaf: ShardSpec}``) prices sharded SUM buckets at the
+    reduce-scatter rate — ``(n-1)`` hops instead of the ring's ``2(n-1)``
+    over the divisibility-padded payload — matching the ``psum_scatter``
+    lowering those buckets actually trace.
     """
-    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    from torchmetrics_tpu.parallel.coalesce import bucket_scatter_size, build_sync_plan
     from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
 
-    plan = build_sync_plan([(reductions, state)], compression=compression)
+    plan = build_sync_plan(
+        [(reductions, state)],
+        compression=compression,
+        shardings=None if not shardings else [shardings],
+    )
     total = 0
     for bucket in plan.buckets:
         itemsize = np.dtype(bucket.dtype).itemsize
-        total += bucket_wire_bytes(bucket.size, itemsize, n_devices, bucket.compression, granule)
+        total += bucket_wire_bytes(
+            bucket_scatter_size(bucket, n_devices),
+            itemsize,
+            n_devices,
+            bucket.compression,
+            granule,
+            sharded=bucket.sharded,
+        )
     for _, name, _ in plan.passthrough:
         leaf = state[name]
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
@@ -221,19 +238,32 @@ def sync_wire_bytes_per_chip(
     state: Dict[str, Any],
     n_devices: int,
     compression: Any = None,
+    shardings: Any = None,
 ) -> int:
     """Granule-free per-chip *wire* traffic of one coalesced sync under an
     optional compression config — the compressed counterpart of
     :func:`sync_bytes_per_chip`, used by telemetry's ``sync_bytes`` counter
-    so compressed and raw counters diff cleanly (both granule-free)."""
-    from torchmetrics_tpu.parallel.coalesce import build_sync_plan
+    so compressed and raw counters diff cleanly (both granule-free).
+    ``shardings`` prices sharded buckets at the reduce-scatter rate."""
+    from torchmetrics_tpu.parallel.coalesce import bucket_scatter_size, build_sync_plan
     from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
 
-    plan = build_sync_plan([(reductions, state)], compression=compression)
+    plan = build_sync_plan(
+        [(reductions, state)],
+        compression=compression,
+        shardings=None if not shardings else [shardings],
+    )
     total = 0
     for bucket in plan.buckets:
         itemsize = np.dtype(bucket.dtype).itemsize
-        total += bucket_wire_bytes(bucket.size, itemsize, n_devices, bucket.compression, None)
+        total += bucket_wire_bytes(
+            bucket_scatter_size(bucket, n_devices),
+            itemsize,
+            n_devices,
+            bucket.compression,
+            None,
+            sharded=bucket.sharded,
+        )
     for _, name, _ in plan.passthrough:
         leaf = state[name]
         nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
