@@ -1592,7 +1592,50 @@ def autotune_bench_child():
     print(json.dumps(out))
 
 
-def _run_cpu_mesh_child(mode, timeout_s):
+def warmstart_bench_child():
+    """One leg of the crash-safe warm-start A/B: arm the durable executable
+    cache at ``TM_TPU_WARMSTART_DIR`` (set by the parent, shared by both
+    legs), then measure time-to-first-step for a small jitted metric slate.
+    The cold leg compiles and exports; the warm leg — a brand-new process —
+    must reach its first step faster with a cache-delta showing only
+    ``warmstart-hit`` misses, zero traces, and bit-identical values."""
+    import numpy as np
+
+    from torchmetrics_tpu.classification import BinaryAccuracy
+    from torchmetrics_tpu.core import compile as _compile
+    from torchmetrics_tpu.core.warmstart import warm_start, warmstart_stats
+
+    leg = os.environ.get("BENCH_WARMSTART_LEG", "cold")
+    warm_start(os.environ["TM_TPU_WARMSTART_DIR"])
+    rng = np.random.default_rng(0)
+    bin_preds = jnp.asarray(rng.random((512,)).astype(np.float32))
+    bin_target = jnp.asarray((rng.random((512,)) > 0.5).astype(np.int32))
+    mc_preds = jnp.asarray(rng.random((256, 10)).astype(np.float32))
+    mc_target = jnp.asarray(rng.integers(0, 10, (256,)).astype(np.int32))
+    base = _compile.cache_stats()
+    t0 = time.perf_counter()
+    bacc = BinaryAccuracy(jit=True)
+    bacc.update(bin_preds, bin_target)
+    macc = MulticlassAccuracy(num_classes=10, average="micro", jit=True)
+    macc.update(mc_preds, mc_target)
+    jax.block_until_ready((bacc.metric_state, macc.metric_state))
+    first_step_s = time.perf_counter() - t0
+    delta = _compile.cache_stats_since(base)
+    print(
+        json.dumps(
+            {
+                "leg": leg,
+                "first_step_s": round(first_step_s, 4),
+                "values": [float(bacc.compute()), float(macc.compute())],
+                "miss_causes": delta["miss_causes"],
+                "traces": delta["traces"],
+                "warmstart": warmstart_stats(),
+            }
+        )
+    )
+
+
+def _run_cpu_mesh_child(mode, timeout_s, extra_env=None):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
     die red because a child did)."""
@@ -1610,6 +1653,8 @@ def _run_cpu_mesh_child(mode, timeout_s):
     env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
     env["BENCH_CHILD_MODE"] = mode
     env.pop("BENCH_BACKEND_CHECKED", None)
+    if extra_env:
+        env.update(extra_env)
     try:
         res = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -1667,6 +1712,49 @@ def measured_autotune():
     return _run_cpu_mesh_child(
         "autotune", float(os.environ.get("BENCH_AUTOTUNE_TIMEOUT", 300))
     )
+
+
+def measured_warmstart():
+    """Crash-safe AOT warm start: the same metric slate in two fresh
+    subprocesses sharing one durable executable store.  The warm leg must be
+    measurably faster to its first step, retrace-free (cache delta shows only
+    ``warmstart-hit``), and bit-identical — ``cold_start_s`` /
+    ``warm_start_s`` are both regression-gated lower-better."""
+    import shutil
+    import tempfile
+
+    timeout = float(os.environ.get("BENCH_WARMSTART_TIMEOUT", 300))
+    root = tempfile.mkdtemp(prefix="tm-tpu-warmstart-bench-")
+    try:
+        cold = _run_cpu_mesh_child(
+            "warmstart",
+            timeout,
+            extra_env={"TM_TPU_WARMSTART_DIR": root, "BENCH_WARMSTART_LEG": "cold"},
+        )
+        warm = _run_cpu_mesh_child(
+            "warmstart",
+            timeout,
+            extra_env={"TM_TPU_WARMSTART_DIR": root, "BENCH_WARMSTART_LEG": "warm"},
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    if "error" in cold or "error" in warm:
+        return {"cold": cold, "warm": warm}
+    warm_causes = warm.get("miss_causes") or {}
+    return {
+        "cold_start_s": cold["first_step_s"],
+        "warm_start_s": warm["first_step_s"],
+        "speedup": round(cold["first_step_s"] / max(warm["first_step_s"], 1e-9), 2),
+        "warm_faster": bool(warm["first_step_s"] < cold["first_step_s"]),
+        "zero_retrace": bool(
+            warm.get("traces") == 0 and set(warm_causes) <= {"warmstart-hit"}
+        ),
+        "values_identical": cold["values"] == warm["values"],
+        "cold_miss_causes": cold.get("miss_causes") or {},
+        "warm_miss_causes": warm_causes,
+        "executables_exported": cold["warmstart"]["exports"],
+        "warm_hits": warm["warmstart"]["hits"],
+    }
 
 
 def donation_leg():
@@ -2332,6 +2420,7 @@ def main():
     fleet_measured = measured_fleet()
     autotune_measured = measured_autotune()
     sharding_measured = measured_sharding()
+    warmstart_measured = measured_warmstart()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -2389,6 +2478,7 @@ def main():
             "fleet": fleet_measured,
             "autotune": autotune_measured,
             "sharded_state": sharding_measured,
+            "warmstart": warmstart_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -2524,6 +2614,8 @@ if __name__ == "__main__":
         fleet_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "sharding":
         sharding_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "warmstart":
+        warmstart_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

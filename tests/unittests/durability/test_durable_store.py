@@ -346,6 +346,72 @@ def test_gc_explicit_keep(tmp_path):
     assert store.generations() == [4]
 
 
+def test_gc_crash_mid_delete_leaves_tombstone_next_sweep_removes(tmp_path):
+    """A crash *during* gc itself (after the tombstone rename, before the
+    delete) must strand only a ``.staging-`` dir — never a half-deleted
+    ``gen-*`` a reader could list — and the next sweep removes it."""
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.observability.registry import telemetry_for
+    from torchmetrics_tpu.resilience import LocalFSBackend, SimulatedCrash
+
+    class CrashOnDelete(LocalFSBackend):
+        def __init__(self):
+            self.armed = True
+
+        def remove_tree(self, path):
+            if self.armed:
+                self.armed = False
+                raise SimulatedCrash(f"killed mid-gc deleting {path}")
+            super().remove_tree(path)
+
+    fast = RetryPolicy(base_delay_s=0.0, sleep=lambda _s: None)
+    store = DurableSnapshotStore(
+        str(tmp_path / "ckpt"), backend=CrashOnDelete(), retry=fast, keep_last_n=1
+    )
+    m = _acc_with_data()
+    store.save(m)
+    with pytest.raises(SimulatedCrash):  # gen 2's gc pass dies mid-delete
+        store.save(m)
+    names = os.listdir(tmp_path / "ckpt")
+    assert any(n.startswith(".staging-") for n in names)  # tombstone, not half-gen
+    assert "gen-00000001" not in names  # the doomed gen is gone from readers
+
+    # "restart": a fresh store restores fine and its sweep clears the residue
+    store2 = DurableSnapshotStore(str(tmp_path / "ckpt"), retry=fast)
+    fresh = BinaryAccuracy(validate_args=False)
+    assert store2.restore(fresh) == 2
+    _bitwise_equal(m.compute(), fresh.compute())
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        store2.gc()
+        assert telemetry_for(store2).counters["staging_sweeps"] == 1
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    assert not any(n.startswith(".staging-") for n in os.listdir(tmp_path / "ckpt"))
+    assert store2.generations() == [2]
+
+
+def test_restore_retries_transient_listdir_flake(tmp_path):
+    """Generation discovery (``listdir``/``exists`` probes) runs under the
+    shared RetryPolicy: an NFS flake during restore costs a retry, not the
+    checkpoint."""
+    from torchmetrics_tpu.resilience import FaultyBackend
+
+    m = _acc_with_data()
+    DurableSnapshotStore(str(tmp_path / "ckpt")).save(m)
+
+    backend = FaultyBackend("transient", times=1)
+    fast = RetryPolicy(base_delay_s=0.0, sleep=lambda _s: None)
+    store = DurableSnapshotStore(str(tmp_path / "ckpt"), backend=backend, retry=fast)
+    fresh = BinaryAccuracy(validate_args=False)
+    with pytest.warns(UserWarning, match="transient failure"):
+        assert store.restore(fresh) == 1
+    assert backend.injected >= 1  # the flake genuinely hit the probe path
+    _bitwise_equal(m.compute(), fresh.compute())
+
+
 # -------------------------------------------------------------------- async
 def test_save_async_commits_and_round_trips(tmp_path):
     store = DurableSnapshotStore(str(tmp_path / "ckpt"))

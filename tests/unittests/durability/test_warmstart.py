@@ -1,0 +1,453 @@
+"""Crash-safe AOT warm start (ISSUE 17 acceptance invariants).
+
+The durable executable store must never change an answer and never crash a
+restart: a warm install is proven retrace-free and bit-identical, and every
+damaged or skewed entry — torn blob, garbled manifest, version/mesh skew,
+deserialize or first-dispatch failure — ends in a loud quarantine and a
+successful fresh compile with the correct ``miss_causes`` attribution."""
+
+import json
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric, observability as obs
+from torchmetrics_tpu.classification import BinaryAccuracy
+from torchmetrics_tpu.core import compile as _compile
+from torchmetrics_tpu.core.warmstart import (
+    DurableExecutableStore,
+    MANIFEST_NAME,
+    PAYLOAD_NAME,
+    WarmStartManager,
+    disable_warm_start,
+    warm_start,
+    warmstart_report,
+    warmstart_stats,
+)
+from torchmetrics_tpu.observability import registry as _telemetry
+from torchmetrics_tpu.observability import tracing
+from torchmetrics_tpu.observability.export import parse_export_line
+from torchmetrics_tpu.parallel import sharded_update
+from torchmetrics_tpu.parallel.sync import metric_mesh
+from torchmetrics_tpu.resilience import (
+    EXE_FAULT_MODES,
+    FaultyBackend,
+    RetryPolicy,
+    StateRestoreError,
+)
+
+pytestmark = [pytest.mark.durability, pytest.mark.warmstart]
+
+PREDS = jnp.asarray(np.random.default_rng(0).random(64, dtype=np.float32))
+TARGET = jnp.asarray((np.random.default_rng(1).random(64) > 0.5).astype(np.int32))
+
+
+def _fast_retry(**kwargs):
+    return RetryPolicy(base_delay_s=0.0, sleep=lambda _s: None, **kwargs)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_warmstart():
+    """Each test gets a cold compile cache and no armed manager, and leaves
+    none behind."""
+    disable_warm_start()
+    _compile.clear_compile_cache()
+    yield
+    disable_warm_start()
+    _compile.clear_compile_cache()
+
+
+def _jit_binary_value():
+    """One jitted BinaryAccuracy step on the fixed batch; returns compute()."""
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    m.update(PREDS, TARGET)
+    return float(m.compute())
+
+
+class VecSum(Metric):
+    """dim-vector sum + count, optionally sharded (the elastic drills)."""
+
+    def __init__(self, dim=64, sharding=None, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state(
+            "vec", jnp.zeros((dim,), jnp.float32), dist_reduce_fx="sum",
+            state_sharding=sharding,
+        )
+        self.add_state("count", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"vec": state["vec"] + x.sum(axis=0), "count": state["count"] + x.shape[0]}
+
+    def _compute(self, state):
+        return state["vec"].sum() / state["count"]
+
+
+# ------------------------------------------------------------------ the store
+def test_store_round_trip_and_manifest_contract(tmp_path):
+    store = DurableExecutableStore(str(tmp_path / "exe"), retry=_fast_retry())
+    strong, weak = "ab" * 8, "cd" * 8
+    payload = b"\x00executable bytes\xff" * 64
+    envelope = {"jax_version": "1.2.3", "mesh_shape": [["data", 8]]}
+    gen = store.put(strong, weak, payload, envelope)
+    assert gen == 1
+    assert store.entries() == [(1, strong)]
+    assert store.has(strong) and store.has(strong, generation=1)
+    assert not store.has(weak)
+    manifest, got = store.read(1, strong)
+    assert got == payload
+    assert manifest["format"] == "tm-tpu-warmstart/1"
+    assert manifest["strong_key"] == strong and manifest["weak_key"] == weak
+    assert manifest["payload"] == PAYLOAD_NAME
+    assert manifest["payload_bytes"] == len(payload)
+    assert manifest["envelope"]["jax_version"] == "1.2.3"
+    # the on-disk layout is the documented one
+    entry = tmp_path / "exe" / f"exe-{gen:08d}-{strong}"
+    assert (entry / MANIFEST_NAME).exists() and (entry / PAYLOAD_NAME).exists()
+
+
+def test_store_read_rejects_torn_payload(tmp_path):
+    store = DurableExecutableStore(str(tmp_path / "exe"), retry=_fast_retry())
+    strong = "ef" * 8
+    store.put(strong, "00" * 8, b"x" * 256, {})
+    blob = tmp_path / "exe" / f"exe-00000001-{strong}" / PAYLOAD_NAME
+    blob.write_bytes(blob.read_bytes()[:100])
+    with pytest.raises(StateRestoreError) as exc:
+        store.read(1, strong)
+    assert exc.value.reason == "corrupt"
+    assert "torn write" in str(exc.value)
+
+
+def test_store_gc_keeps_last_n_per_strong_key(tmp_path):
+    store = DurableExecutableStore(str(tmp_path / "exe"), retry=_fast_retry())
+    a, b = "aa" * 8, "bb" * 8
+    for _ in range(3):
+        store.put(a, "00" * 8, b"A", {})
+    store.put(b, "00" * 8, b"B", {})
+    removed = store.gc(keep_last_n=1)
+    # retention is per executable, not global: b's only generation survives
+    assert sorted(removed) == [f"exe-0000000{g}-{a}" for g in (1, 2)]
+    assert store.entries() == [(3, a), (4, b)]
+    assert not any(n.startswith(".staging-") for n in os.listdir(tmp_path / "exe"))
+
+
+def test_store_gc_sweeps_staging_and_counts(tmp_path):
+    store = DurableExecutableStore(str(tmp_path / "exe"), retry=_fast_retry())
+    store.put("cc" * 8, "00" * 8, b"C", {})
+    stranded = tmp_path / "exe" / ".staging-exe-00000099-dd00dd00dd00dd00"
+    stranded.mkdir()
+    (stranded / MANIFEST_NAME).write_text("{}")
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        store.gc()
+        assert _telemetry.telemetry_for(store).counters["staging_sweeps"] == 1
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+    assert not stranded.exists()
+    assert store.entries() == [(1, "cc" * 8)]
+
+
+# ------------------------------------------------------- the install lifecycle
+def test_export_then_warm_hit_zero_retrace_bit_identical(tmp_path):
+    root = str(tmp_path / "exe")
+    warm_start(root, retry=_fast_retry())
+    cold_value = _jit_binary_value()
+    assert warmstart_stats()["exports"] == 1
+
+    # "restart": cold registry, fresh manager over the same store
+    _compile.clear_compile_cache()
+    disable_warm_start()
+    mgr = warm_start(root, retry=_fast_retry())
+    assert mgr.stats()["ready"] == 1
+    base = _compile.cache_stats()
+    warm_value = _jit_binary_value()
+    delta = _compile.cache_stats_since(base)
+    assert delta["miss_causes"] == {"warmstart-hit": 1}  # and NO new-key
+    assert delta["traces"] == 0  # proven zero-retrace
+    assert warm_value == cold_value  # bit-identical
+    assert warmstart_stats()["hits"] == 1
+
+
+def test_export_dedupes_repeat_steps(tmp_path):
+    warm_start(str(tmp_path / "exe"), retry=_fast_retry())
+    m = BinaryAccuracy(validate_args=False, jit=True)
+    for _ in range(3):
+        m.update(PREDS, TARGET)
+    store = DurableExecutableStore(str(tmp_path / "exe"), retry=_fast_retry())
+    assert len(store.entries()) == 1  # one executable, not one per step
+    assert warmstart_stats()["exports"] == 1
+
+
+def test_env_var_arms_warm_start_lazily(tmp_path, monkeypatch):
+    root = str(tmp_path / "exe")
+    warm_start(root, retry=_fast_retry())
+    cold_value = _jit_binary_value()
+    _compile.clear_compile_cache()
+    disable_warm_start()
+
+    monkeypatch.setenv("TM_TPU_WARMSTART_DIR", root)
+    monkeypatch.setattr(_compile, "_WARMSTART_ENV_PENDING", True)
+    base = _compile.cache_stats()
+    assert _jit_binary_value() == cold_value
+    delta = _compile.cache_stats_since(base)
+    assert delta["miss_causes"] == {"warmstart-hit": 1}
+    assert warmstart_stats()["hits"] == 1  # the env probe built a real manager
+
+
+# ------------------------------------------------------------ quarantine paths
+def test_first_dispatch_failure_quarantines_and_recompiles(tmp_path):
+    """An executable that deserializes but dies on dispatch is the nastiest
+    poison: it must be quarantined, re-attributed ``warmstart-corrupt``, and
+    transparently replaced by a fresh compile mid-call."""
+    root = str(tmp_path / "exe")
+    warm_start(root, retry=_fast_retry())
+    cold_value = _jit_binary_value()
+    _compile.clear_compile_cache()
+    disable_warm_start()
+
+    mgr = warm_start(root, retry=_fast_retry())
+    (strong,) = list(mgr._ready)
+
+    def boom(*_args, **_kwargs):
+        raise RuntimeError("poisoned executable")
+
+    mgr._ready[strong]["fn"] = boom
+    mgr._ready[strong]["payload"] = None
+    base = _compile.cache_stats()
+    with pytest.warns(UserWarning, match="quarantined"):
+        value = _jit_binary_value()
+    delta = _compile.cache_stats_since(base)
+    assert value == cold_value  # the fallback compile answered correctly
+    assert delta["miss_causes"] == {"warmstart-corrupt": 1}  # re-attributed
+    assert mgr._quarantined[strong] == "first-dispatch failure"
+    stats = mgr.stats()
+    assert stats["quarantines"] == 1 and stats["corrupt_misses"] == 1
+    # quarantined means never re-read: a fresh instance re-hits the (now
+    # cached) fresh entry without consulting the store again
+    _jit_binary_value()
+    assert mgr.stats()["corrupt_misses"] == 1
+
+
+def test_skip_back_past_damaged_newest_generation(tmp_path):
+    """Newest generation torn + older generation healthy: load quarantines
+    the damaged one, installs the older, and the lookup still hits."""
+    root = str(tmp_path / "exe")
+    warm_start(root, retry=_fast_retry())
+    cold_value = _jit_binary_value()
+    store = DurableExecutableStore(root, retry=_fast_retry())
+    ((gen, strong),) = store.entries()
+    manifest, payload = store.read(gen, strong)
+    store.put(strong, manifest["weak_key"], payload, manifest["envelope"])  # gen 2
+    blob = tmp_path / "exe" / f"exe-00000002-{strong}" / PAYLOAD_NAME
+    blob.write_bytes(payload[: len(payload) // 2])
+
+    _compile.clear_compile_cache()
+    disable_warm_start()
+    with pytest.warns(UserWarning, match="skipping back"):
+        mgr = warm_start(root, retry=_fast_retry())
+    stats = mgr.stats()
+    assert stats["ready"] == 1 and stats["quarantines"] == 1
+    base = _compile.cache_stats()
+    assert _jit_binary_value() == cold_value
+    assert _compile.cache_stats_since(base)["miss_causes"] == {"warmstart-hit": 1}
+
+
+# ------------------------------------------------------ the umbrella invariant
+#: what each injected fault must be attributed as on the restarted process
+_EXPECTED_CAUSE = {
+    "torn_write": "warmstart-corrupt",  # committed entry fails its crc
+    "partial_manifest": "warmstart-corrupt",  # manifest garbled
+    "enospc": "new-key",  # publish failed loudly; nothing durable
+    "crash_before_rename": "new-key",  # staging stranded; nothing committed
+    "transient": "warmstart-hit",  # flake retried; publish converged
+    "stale_version": "warmstart-stale",  # envelope skew, checksums intact
+}
+
+
+@pytest.mark.parametrize("mode", EXE_FAULT_MODES)
+def test_exe_drill_invariant_never_silent_never_unhandled(tmp_path, mode):
+    """For every executable-store fault mode: the export either publishes a
+    verified entry or degrades loudly; the restarted process always reaches
+    a correct first step (warm install or fresh compile — never a wrong
+    executable, never an unhandled exception) with the documented
+    ``miss_causes`` attribution."""
+    root = str(tmp_path / "exe")
+    backend = FaultyBackend(mode)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        warm_start(root, backend=backend, retry=_fast_retry())
+        cold_value = _jit_binary_value()  # the faulty export must not break the step
+    assert backend.injected >= 1  # the drill genuinely fired
+
+    _compile.clear_compile_cache()
+    disable_warm_start()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        mgr = warm_start(root, retry=_fast_retry())
+        base = _compile.cache_stats()
+        value = _jit_binary_value()
+    delta = _compile.cache_stats_since(base)
+
+    assert value == cold_value  # never a silently wrong answer
+    expected = _EXPECTED_CAUSE[mode]
+    assert delta["miss_causes"] == {expected: 1}
+    assert delta["traces"] == (0 if expected == "warmstart-hit" else 1)
+
+    stats = mgr.stats()
+    if expected == "warmstart-corrupt":
+        # loud: quarantined at load, never installed
+        assert stats["quarantines"] == 1 and stats["corrupt_misses"] == 1
+        assert mgr._quarantined  # never re-read this process
+        assert any("quarantined" in str(w.message) for w in rec)
+    elif expected == "warmstart-stale":
+        assert stats["stale"] == 1 and stats["stale_misses"] == 1
+        (row,) = [r for r in mgr.entries_report() if r["state"] == "stale"]
+        assert "jax_version skew" in row["reason"]
+    elif mode == "transient":
+        assert stats["hits"] == 1
+    else:  # nothing durable landed; the fresh process compiled from scratch
+        assert stats["scanned"] == 0 and stats["ready"] == 0
+    if mode == "crash_before_rename":
+        # the stranded staging dir is invisible to load and swept by gc
+        assert any(n.startswith(".staging-") for n in os.listdir(root))
+        DurableExecutableStore(root, retry=_fast_retry()).gc()
+        assert not any(n.startswith(".staging-") for n in os.listdir(root))
+
+
+def test_transient_listdir_flake_does_not_skip_warm_entries(tmp_path):
+    """The generation-discovery probes (``listdir``) run under the shared
+    RetryPolicy: an NFS hiccup during load() must not cost the warm hit."""
+    root = str(tmp_path / "exe")
+    warm_start(root, retry=_fast_retry())
+    cold_value = _jit_binary_value()
+    _compile.clear_compile_cache()
+    disable_warm_start()
+
+    backend = FaultyBackend("transient", times=2)
+    with pytest.warns(UserWarning, match="transient failure"):
+        mgr = warm_start(root, backend=backend, retry=_fast_retry())
+    assert backend.injected == 2  # flakes consumed by retries, not skipped past
+    assert mgr.stats()["ready"] == 1
+    base = _compile.cache_stats()
+    assert _jit_binary_value() == cold_value
+    assert _compile.cache_stats_since(base)["miss_causes"] == {"warmstart-hit": 1}
+
+
+# ------------------------------------------------------------ elastic interplay
+def test_mesh_resize_rejects_warm_executable_as_stale(tmp_path, mesh):
+    """An executable compiled for the 8-device world must never install
+    after a 4-device restart: envelope mesh-shape mismatch → ``warmstart-
+    stale`` → fresh compile."""
+    root = str(tmp_path / "exe")
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((16, 64), dtype=np.float32))
+    warm_start(root, retry=_fast_retry())
+    sharded_update(VecSum(), x, mesh=mesh)  # 8-device export
+    assert warmstart_stats()["exports"] >= 1
+
+    _compile.clear_compile_cache()
+    disable_warm_start()
+    mgr = warm_start(root, retry=_fast_retry())
+    base = _compile.cache_stats()
+    out4 = sharded_update(VecSum(), x, mesh=metric_mesh(4))  # "restarted" smaller
+    delta = _compile.cache_stats_since(base)
+    assert delta["miss_causes"].get("warmstart-stale", 0) >= 1
+    assert delta["miss_causes"].get("warmstart-hit", 0) == 0  # nothing installed
+    # the stale reason names the mesh disagreement
+    assert mgr.stats()["stale_misses"] >= 1
+    # and the fresh 4-device compile computes the right totals
+    np.testing.assert_allclose(
+        np.asarray(out4["vec"]), np.asarray(x).sum(axis=0), rtol=1e-5
+    )
+
+
+def test_sharding_policy_flip_keys_distinct_entries(tmp_path, mesh):
+    """``set_state_sharding`` flips the config fingerprint, so replicated and
+    sharded variants get distinct durable entries — a warm start can never
+    reuse a stale replicated executable for a sharded metric."""
+    root = str(tmp_path / "exe")
+    x = jnp.asarray(np.random.default_rng(3).standard_normal((16, 64), dtype=np.float32))
+    warm_start(root, retry=_fast_retry())
+    out_r = sharded_update(VecSum(), x, mesh=mesh)
+    out_s = sharded_update(VecSum(sharding="sharded"), x, mesh=mesh)
+    assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_s["vec"]))
+    store = DurableExecutableStore(root, retry=_fast_retry())
+    strongs = {strong for _, strong in store.entries()}
+    assert len(strongs) == len(store.entries()) >= 2  # distinct keys, no overwrite
+
+    # a warm restart hits each variant's own entry with zero retraces
+    _compile.clear_compile_cache()
+    disable_warm_start()
+    warm_start(root, retry=_fast_retry())
+    base = _compile.cache_stats()
+    out_r2 = sharded_update(VecSum(), x, mesh=mesh)
+    out_s2 = sharded_update(VecSum(sharding="sharded"), x, mesh=mesh)
+    delta = _compile.cache_stats_since(base)
+    assert delta["miss_causes"] == {"warmstart-hit": 2}
+    assert delta["traces"] == 0
+    assert np.array_equal(np.asarray(out_r["vec"]), np.asarray(out_r2["vec"]))
+    assert np.array_equal(np.asarray(out_s["vec"]), np.asarray(out_s2["vec"]))
+
+
+# -------------------------------------------------------------- observability
+def test_report_parses_back_and_prometheus_families(tmp_path):
+    root = str(tmp_path / "exe")
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        warm_start(root, retry=_fast_retry())
+        _jit_binary_value()
+        _compile.clear_compile_cache()
+        disable_warm_start()
+        warm_start(root, retry=_fast_retry())
+        _jit_binary_value()
+
+        report = warmstart_report()
+        assert report["kind"] == "warmstart_report" and report["armed"]
+        assert report["schema_version"].startswith("1.9")
+        assert report["stats"]["hits"] == 1
+        (row,) = report["entries"]
+        assert row["state"] == "ready" and row["kind"] == "update"
+        assert len(row["strong_key"]) == 16
+        assert row["fingerprint_hash"] and len(row["fingerprint_hash"]) == 12
+        # the JSONL front door round-trips it under the schema contract
+        parsed = parse_export_line(json.dumps(report))
+        assert parsed["kind"] == "warmstart_report"
+
+        prom = obs.export(_telemetry.report(), fmt="prometheus")
+        assert "tm_tpu_warmstart_hits_total" in prom
+        assert "tm_tpu_warmstart_exports_total" in prom
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+
+
+def test_flight_recorder_warmstart_instants(tmp_path):
+    root = str(tmp_path / "exe")
+    obs.reset_telemetry()
+    obs.enable()
+    try:
+        warm_start(root, retry=_fast_retry())
+        _jit_binary_value()
+        _compile.clear_compile_cache()
+        disable_warm_start()
+        with tracing.recording(capacity=128) as rec:
+            warm_start(root, retry=_fast_retry())
+            _jit_binary_value()
+        warm_events = [e for e in rec.events() if e.cat == "warmstart"]
+        assert any(e.name.endswith("/warmstart_hit") for e in warm_events)
+        for e in warm_events:
+            assert e.cat in tracing.CATEGORIES
+    finally:
+        obs.disable()
+        obs.reset_telemetry()
+
+
+def test_disarmed_stats_are_zero_and_report_says_so():
+    stats = warmstart_stats()
+    assert set(stats) and not any(stats.values())
+    report = warmstart_report()
+    assert report["armed"] is False and report["kind"] == "warmstart_report"

@@ -2,4 +2,16 @@ from torchmetrics_tpu.core.composition import CompositionalMetric
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.core.reductions import Reduce
 
-__all__ = ["CompositionalMetric", "Metric", "Reduce"]
+__all__ = ["CompositionalMetric", "Metric", "Reduce", "disable_warm_start", "warm_start"]
+
+_WARMSTART_EXPORTS = ("warm_start", "disable_warm_start", "warmstart_report", "warmstart_stats")
+
+
+def __getattr__(name):
+    # Lazy (PEP 562): warmstart pulls in the resilience layer, which imports
+    # back into core — resolving it on first touch keeps package import acyclic.
+    if name in _WARMSTART_EXPORTS:
+        from torchmetrics_tpu.core import warmstart
+
+        return getattr(warmstart, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -82,6 +82,7 @@ __all__ = [
     "remove_cache_observer",
     "remove_compile_timing_observer",
     "set_analysis_capture",
+    "set_warmstart_hooks",
     "shard_map",
     "abstract_signature",
     "audit_step_fn",
@@ -157,8 +158,24 @@ _STATS = {"hits": 0, "misses": 0, "traces": 0, "evictions": 0}
 #: fingerprint (an attribute mutation forced the retrace — see
 #: :func:`explain_retrace` for *which* attribute);
 #: ``donate-variant`` — same entry point + signature + fingerprint compiled
-#: under a different donation flag (aliased vs exclusive state).
-MISS_CAUSES = ("new-key", "eviction", "invalidation", "donate-variant")
+#: under a different donation flag (aliased vs exclusive state);
+#: ``warmstart-hit`` — the miss was served by a deserialized durable
+#: executable (:mod:`torchmetrics_tpu.core.warmstart`) instead of a trace;
+#: ``warmstart-stale`` — a durable executable existed for this configuration
+#: but its compatibility envelope no longer matches (mesh/version/flags
+#: skew), so the entry was rejected and compiled fresh;
+#: ``warmstart-corrupt`` — a durable executable existed but failed
+#: verification (CRC, truncated blob, deserialize error), was quarantined,
+#: and the entry compiled fresh.
+MISS_CAUSES = (
+    "new-key",
+    "eviction",
+    "invalidation",
+    "donate-variant",
+    "warmstart-hit",
+    "warmstart-stale",
+    "warmstart-corrupt",
+)
 _MISS_CAUSE_COUNTS = {cause: 0 for cause in MISS_CAUSES}
 
 # Bounded lookup history backing the cause attribution.  ``_EVICTED`` is an
@@ -179,7 +196,16 @@ class CompileRecord:
     """One cold start: the first dispatch of a freshly built cache entry,
     which pays trace + lower + XLA compile synchronously under ``jax.jit``."""
 
-    __slots__ = ("seq", "kind", "cause", "label", "fingerprint_hash", "cold_start_s", "owner_ref")
+    __slots__ = (
+        "seq",
+        "kind",
+        "cause",
+        "label",
+        "fingerprint_hash",
+        "cold_start_s",
+        "owner_ref",
+        "durable",
+    )
 
     def __init__(
         self,
@@ -197,6 +223,9 @@ class CompileRecord:
         self.fingerprint_hash = fingerprint_hash
         self.cold_start_s = 0.0
         self.owner_ref = owner_ref
+        # durable strong/weak key identity, set only for freshly built
+        # exportable entries while a warm-start sink is installed
+        self.durable: Optional[Dict[str, Any]] = None
 
     def as_dict(self) -> Dict[str, Any]:
         return {
@@ -562,6 +591,222 @@ def _owner_label(owner: Any, kind: Optional[str]) -> str:
     return kind or "unattributed"
 
 
+# ----------------------------------------------------------------- warm start
+# Durable-executable warm start (core/warmstart.py) plugs in through two
+# hooks: a *resolver* consulted on every cache miss (it may substitute a
+# deserialized AOT executable for a fresh trace, or re-attribute the miss to
+# a warmstart cause) and an export *sink* fired after a freshly built entry's
+# first dispatch (it may persist the executable durably).  Both are optional,
+# both run OUTSIDE _LOCK, and both degrade to no-ops on any failure: warm
+# start can change *when* compilation happens, never whether a lookup
+# succeeds or what it computes.
+_WARMSTART_RESOLVER: Optional[Callable[..., Any]] = None
+_WARMSTART_SINK: Optional[Callable[..., None]] = None
+_WARMSTART_ENV_PENDING = True  # TM_TPU_WARMSTART_DIR is probed at most once
+
+
+def set_warmstart_hooks(
+    resolver: Optional[Callable[..., Any]], sink: Optional[Callable[..., None]]
+) -> None:
+    """Install (or, with ``None``/``None``, clear) the warm-start hooks.
+
+    ``resolver(durable_key, record)`` is consulted on each miss whose key has
+    a stable cross-process identity and returns ``None`` (no durable entry),
+    ``("hit", callable)``, ``("stale", reason)`` or ``("corrupt", reason)``;
+    ``resolver(durable_key, record, quarantine=True)`` reports a first-
+    dispatch failure of an installed executable.  ``sink(fn, args, kwargs,
+    record)`` fires once after a fresh exportable entry's first dispatch.
+    Called by :func:`torchmetrics_tpu.core.warmstart.warm_start`.
+    """
+    global _WARMSTART_RESOLVER, _WARMSTART_SINK
+    with _LOCK:
+        _WARMSTART_RESOLVER = resolver
+        _WARMSTART_SINK = sink
+
+
+def _maybe_env_warmstart() -> None:
+    """One-time lazy ``TM_TPU_WARMSTART_DIR`` auto-load on the first miss.
+
+    Deferred to the first lookup (not import time) so merely importing the
+    package never touches the filesystem, and lazily imported so the
+    compile <-> warmstart module cycle stays one-directional at import."""
+    global _WARMSTART_ENV_PENDING
+    if not _WARMSTART_ENV_PENDING:
+        return
+    _WARMSTART_ENV_PENDING = False
+    root = os.environ.get("TM_TPU_WARMSTART_DIR")
+    if not root or _WARMSTART_RESOLVER is not None:
+        return
+    try:
+        from torchmetrics_tpu.core.warmstart import warm_start
+
+        warm_start(root)
+    except Exception:
+        _OBS_LOG.warning(
+            "TM_TPU_WARMSTART_DIR=%r warm start failed; compiling fresh", root, exc_info=True
+        )
+
+
+class _Unportable(Exception):
+    """This cache key has no process-independent identity."""
+
+
+def _canon_key(obj: Any, weak: bool) -> Any:
+    """Canonicalize one cache-key component into a cross-process-stable
+    structure whose ``repr`` can be hashed.
+
+    ``weak=False`` (the *strong* form) must preserve every trace-relevant
+    detail — it names exactly one executable.  ``weak=True`` erases the mesh
+    topology and concrete array shapes: the loose identity the warm-start
+    layer uses purely for *attribution* (a durable entry that weakly matches
+    a miss but strongly differs names it ``warmstart-stale`` — same
+    configuration, different mesh/shape world).  Raises :class:`_Unportable`
+    for components with no stable identity (id-pinned callables/objects,
+    default ``object.__repr__`` values): such keys are neither exported nor
+    resolved — a recycled id must never replay another process's trace.
+    """
+    if isinstance(obj, Mesh):
+        if weak:
+            return ("mesh",)
+        return (
+            "mesh",
+            tuple(
+                (str(axis), int(size))
+                for axis, size in zip(obj.axis_names, obj.devices.shape)
+            ),
+        )
+    if isinstance(obj, P):
+        return ("pspec", tuple(_canon_key(x, weak) for x in obj))
+    if isinstance(obj, tuple):
+        if (
+            len(obj) == 4
+            and obj[0] in ("fn", "obj")
+            and isinstance(obj[1], str)
+            and isinstance(obj[2], str)
+            and isinstance(obj[3], int)
+        ):
+            # id-pinned fingerprint component (_freeze_value): process-local
+            raise _Unportable(f"id-pinned {obj[0]} component {obj[2]!r}")
+        if (
+            weak
+            and len(obj) == 3
+            and obj[0] == "arr"
+            and isinstance(obj[1], tuple)
+            and isinstance(obj[2], str)
+        ):
+            return ("arr", obj[2])  # input-leaf signature: erase the shape
+        return tuple(_canon_key(x, weak) for x in obj)
+    if isinstance(obj, (str, int, float, bool, bytes, type(None))):
+        return obj
+    if isinstance(obj, list):
+        return tuple(_canon_key(x, weak) for x in obj)
+    if isinstance(obj, (set, frozenset)):
+        return ("set", tuple(sorted(repr(_canon_key(x, weak)) for x in obj)))
+    if type(obj).__name__ == "PyTreeDef":
+        return ("treedef", str(obj))
+    r = repr(obj)
+    if " at 0x" in r:
+        raise _Unportable(f"process-local repr for {type(obj).__name__}")
+    return ("repr", type(obj).__name__, r)
+
+
+def _canon_mesh_shape(node: Any) -> Optional[Tuple[Tuple[str, int], ...]]:
+    """The ``("mesh", axes)`` component of a strong canonical key, if any."""
+    if isinstance(node, tuple):
+        if len(node) == 2 and node[0] == "mesh" and isinstance(node[1], tuple):
+            return node[1]
+        for item in node:
+            found = _canon_mesh_shape(item)
+            if found is not None:
+                return found
+    return None
+
+
+def _durable_keys(key: Hashable, kind: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Cross-process-stable identity of a cache key: ``{"strong", "weak",
+    "mesh_shape"}`` (16-hex sha1 digests), or ``None`` when the key has no
+    stable form — then warm start neither exports nor resolves it."""
+    if kind is None:
+        return None
+    try:
+        strong = _canon_key(key, weak=False)
+        weak = _canon_key(key, weak=True)
+    except _Unportable:
+        return None
+    except Exception:  # never let key canonicalization break a lookup
+        _OBS_LOG.debug("durable-key canonicalization failed", exc_info=True)
+        return None
+    import hashlib
+
+    return {
+        "strong": hashlib.sha1(repr(strong).encode()).hexdigest()[:16],
+        "weak": hashlib.sha1(repr(weak).encode()).hexdigest()[:16],
+        "mesh_shape": _canon_mesh_shape(strong),
+    }
+
+
+def _reattribute_miss(record: CompileRecord, cause: str) -> None:
+    """Re-label one miss after the warm-start resolver weighed in.
+
+    Still exactly one miss: the original cause's count is handed to the
+    warmstart cause, preserving ``sum(miss_causes) == misses``."""
+    with _LOCK:
+        _MISS_CAUSE_COUNTS[record.cause] -= 1
+        _MISS_CAUSE_COUNTS[cause] += 1
+        record.cause = cause
+
+
+def _warm_wrapper(
+    key: Hashable,
+    loaded: Callable,
+    build: Callable[[], Callable],
+    record: CompileRecord,
+    durable_key: Mapping[str, Any],
+) -> Callable:
+    """Wrap a deserialized warm-start executable so its first (not yet
+    validated) dispatch can still fall back to a fresh trace.
+
+    Deserialization already succeeded, so this catches only damage the
+    envelope cannot see — an executable the runtime refuses at dispatch.  On
+    any first-call failure the durable entry is quarantined, the miss is
+    re-attributed ``warmstart-corrupt``, and the caller's dispatch is served
+    by a freshly built step: degraded and loud, never a wrong result, never
+    an unhandled crash.  After one success the wrapper delegates directly.
+    """
+    state: Dict[str, Optional[Callable]] = {"fn": None}
+
+    def warm_call(*args: Any, **kwargs: Any) -> Any:
+        settled = state["fn"]
+        if settled is not None:
+            return settled(*args, **kwargs)
+        try:
+            out = loaded(*args, **kwargs)
+        except Exception as err:
+            _OBS_LOG.warning(
+                "warm-started executable for %s failed its first dispatch (%r); "
+                "quarantining the durable entry and recompiling fresh",
+                record.label,
+                err,
+            )
+            _reattribute_miss(record, "warmstart-corrupt")
+            resolver = _WARMSTART_RESOLVER
+            if resolver is not None:
+                try:
+                    resolver(durable_key, record, quarantine=True)
+                except Exception:
+                    _OBS_LOG.debug("warm-start quarantine hook failed", exc_info=True)
+            fresh = build()
+            state["fn"] = fresh
+            with _LOCK:
+                if _CACHE.get(key) is warm_call:
+                    _CACHE[key] = fresh
+            return fresh(*args, **kwargs)
+        state["fn"] = loaded
+        return out
+
+    return warm_call
+
+
 def _timed_cold_start(key: Hashable, fn: Callable, record: CompileRecord) -> Callable:
     """Wrap a freshly built entry so its FIRST dispatch — the call that pays
     trace + lower + XLA compile synchronously — is wall-timed.
@@ -590,6 +835,14 @@ def _timed_cold_start(key: Hashable, fn: Callable, record: CompileRecord) -> Cal
             with _LOCK:
                 if key in _CACHE:  # a concurrent eviction wins; rows track entries
                     _ANALYSIS_ROWS[key] = row
+        sink = _WARMSTART_SINK
+        if sink is not None and record.durable is not None:
+            try:
+                sink(fn, args, kwargs, record)
+            except Exception:
+                _OBS_LOG.warning(
+                    "warm-start executable export failed for %s", record.label, exc_info=True
+                )
         _notify_compile(record)
         return out
 
@@ -709,7 +962,35 @@ def _lookup(
     _notify("hit" if hit else "miss", kind, owner)
     if hit:
         return fn
-    fn = build()  # build outside the lock: tracing can be slow
+    # Warm-start consultation (all outside the lock: resolvers do I/O and
+    # deserialize executables).  A resolver "hit" substitutes a durable AOT
+    # executable for the trace; "stale"/"corrupt" only re-attribute the miss
+    # cause — the build below runs fresh either way.
+    fn = None
+    _maybe_env_warmstart()
+    resolver, sink = _WARMSTART_RESOLVER, _WARMSTART_SINK
+    durable_key = (
+        _durable_keys(key, kind) if (resolver is not None or sink is not None) else None
+    )
+    if resolver is not None and durable_key is not None:
+        try:
+            resolution = resolver(durable_key, record)
+        except Exception:
+            _OBS_LOG.warning(
+                "warm-start resolver failed for %s; compiling fresh",
+                record.label,
+                exc_info=True,
+            )
+            resolution = None
+        if resolution is not None:
+            verdict = resolution[0]
+            _reattribute_miss(record, f"warmstart-{verdict}")
+            if verdict == "hit":
+                fn = _warm_wrapper(key, resolution[1], build, record, durable_key)
+    if fn is None:
+        fn = build()  # build outside the lock: tracing can be slow
+        if durable_key is not None and sink is not None:
+            record.durable = durable_key  # export after the first dispatch
     fn = _timed_cold_start(key, fn, record)
     with _LOCK:
         fn = _CACHE.setdefault(key, fn)

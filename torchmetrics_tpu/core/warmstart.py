@@ -1,0 +1,819 @@
+"""Durable AOT warm start: compiled executables that survive the process.
+
+PR 14 made metric *state* survive preemption (the durable snapshot store);
+this module extends restart survival to the *executables*.  A preempted,
+restarted, or newly scaled worker normally pays the full trace + lower +
+XLA-compile bill for every metric before its first step — the dominant
+restart overhead the serving papers flag at scale.  Here that bill is paid
+once, serialized (``jax.experimental.serialize_executable``), and published
+through the same pluggable :class:`~torchmetrics_tpu.resilience.durable.
+StorageBackend` + write-ahead commit protocol as checkpoints:
+
+* **Generational entries.**  Each executable lands as
+  ``exe-NNNNNNNN-<strongkey>/`` — a write-ahead ``MANIFEST.json`` (payload
+  byte count + crc32, the entry's strong/weak durable keys, and a
+  *compatibility envelope*: config fingerprint hash, entry-point kind, jax /
+  jaxlib versions, platform, device count, mesh shape, XLA-flags hash)
+  written and fsync'd *before* the payload, both staged in a hidden
+  ``.staging-`` dir and published by one atomic rename.  Every read, write,
+  probe and gc runs under one shared
+  :class:`~torchmetrics_tpu.resilience.durable.RetryPolicy`.
+* **Verified install.**  :func:`warm_start` scans the store once, verifies
+  every entry (manifest structure, payload length + crc), and stages the
+  survivors keyed by the compile registry's cross-process *strong key*.  A
+  subsequent cache miss whose strong key matches installs the deserialized
+  executable — ``cache_stats()`` attributes the miss ``warmstart-hit`` and
+  **zero** traces run.
+* **Graceful degradation, never a wrong executable.**  Any mismatch or
+  damage — CRC failure, truncated blob, version/flags/platform skew, a mesh
+  shape from a world that no longer exists, a blob that will not
+  deserialize — is warned about once, counted
+  (``warmstart_stale`` / ``warmstart_corrupt`` / ``warmstart_quarantines``),
+  quarantined (never re-read this process), and answered with a fresh
+  compile.  A poisoned cache can slow a restart down; it can never change a
+  metric value or crash the run.
+* **Export on first dispatch.**  While armed (``export=True``), every
+  freshly compiled cache entry whose key has a stable cross-process identity
+  is AOT-serialized right after its first dispatch and published — so the
+  *next* restart warm-starts from this run's work.  Entries whose
+  fingerprint embeds process-local identity (id-pinned callables) are never
+  exported: a recycled id must never replay another process's trace.
+
+Enable with :func:`warm_start` (or ``TM_TPU_WARMSTART_DIR``, probed lazily
+on the first cache miss)::
+
+    from torchmetrics_tpu.core.warmstart import warm_start
+    warm_start("/ckpt/warmstart")      # pre-installs + arms export
+    acc.update(preds, target)          # warmstart-hit: no trace, no compile
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import threading
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu.core import compile as _compile
+from torchmetrics_tpu.observability import registry as _telemetry
+from torchmetrics_tpu.resilience.durable import (
+    LocalFSBackend,
+    RetryPolicy,
+    StorageBackend,
+    _STAGING_PREFIX,
+    build_wire_manifest,
+    parse_wire_manifest,
+    verify_wire_payload,
+)
+from torchmetrics_tpu.utilities.exceptions import StateRestoreError
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = [
+    "DurableExecutableStore",
+    "ENVELOPE_FIELDS",
+    "MANIFEST_FORMAT",
+    "MANIFEST_NAME",
+    "PAYLOAD_NAME",
+    "WarmStartManager",
+    "current_environment",
+    "disable_warm_start",
+    "manager",
+    "warm_start",
+    "warmstart_report",
+    "warmstart_stats",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+PAYLOAD_NAME = "executable.bin"
+MANIFEST_FORMAT = "tm-tpu-warmstart/1"
+
+_ENTRY_RE = re.compile(r"^exe-(\d{8})-([0-9a-f]{16})$")
+
+#: the compatibility envelope every entry carries; ANY field disagreeing
+#: with the restarted process (or, for ``mesh_shape``, with the looked-up
+#: key) rejects the entry as ``warmstart-stale``
+ENVELOPE_FIELDS = (
+    "fingerprint_hash",
+    "kind",
+    "label",
+    "jax_version",
+    "jaxlib_version",
+    "platform",
+    "n_devices",
+    "mesh_shape",
+    "xla_flags_hash",
+)
+
+#: envelope fields compared against the *current process* at load time
+#: (``mesh_shape`` is per-lookup and compared at resolve time instead)
+_PROCESS_ENV_FIELDS = (
+    "jax_version",
+    "jaxlib_version",
+    "platform",
+    "n_devices",
+    "xla_flags_hash",
+)
+
+
+def _xla_flags_hash() -> str:
+    """8-hex digest of the compile-relevant environment flags."""
+    blob = os.environ.get("XLA_FLAGS", "") + "\x00" + os.environ.get("LIBTPU_INIT_ARGS", "")
+    return hashlib.sha1(blob.encode()).hexdigest()[:8]
+
+
+def current_environment() -> Dict[str, Any]:
+    """The process-level half of the compatibility envelope."""
+    try:
+        import jaxlib
+
+        jaxlib_version = getattr(jaxlib, "__version__", "unknown")
+    except Exception:  # pragma: no cover - jaxlib always ships with jax
+        jaxlib_version = "unknown"
+    try:
+        platform = jax.default_backend()
+    except Exception:  # pragma: no cover
+        platform = "unknown"
+    try:
+        n_devices = int(jax.device_count())
+    except Exception:  # pragma: no cover
+        n_devices = 0
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": jaxlib_version,
+        "platform": platform,
+        "n_devices": n_devices,
+        "xla_flags_hash": _xla_flags_hash(),
+    }
+
+
+def _serde():
+    from jax.experimental import serialize_executable
+
+    return serialize_executable
+
+
+def _norm_mesh(mesh_shape: Any) -> Optional[Tuple[Tuple[str, int], ...]]:
+    """Canonical ``((axis, size), ...)`` form for envelope/key mesh shapes
+    (JSON round-trips tuples to lists)."""
+    if not mesh_shape:
+        return None
+    try:
+        return tuple((str(axis), int(size)) for axis, size in mesh_shape)
+    except Exception:  # noqa: BLE001 - malformed envelope field
+        return None
+
+
+# ---------------------------------------------------------------- the store
+class DurableExecutableStore:
+    """Generational durable store for serialized AOT executables.
+
+    Layout under ``root``::
+
+        root/
+          exe-00000001-<strongkey16>/MANIFEST.json   # write-ahead: crc + envelope
+          exe-00000001-<strongkey16>/executable.bin  # pickled serialize() triple
+          .staging-exe-00000002-.../                 # in progress; invisible
+
+    The same commit discipline as the snapshot store: manifest before
+    payload, both durable before the atomic publish rename, every backend
+    call (including ``listdir``/``exists`` discovery probes) under the
+    shared :class:`RetryPolicy`.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        backend: Optional[StorageBackend] = None,
+        retry: Optional[RetryPolicy] = None,
+        keep_last_n: Optional[int] = None,
+    ) -> None:
+        if keep_last_n is not None and keep_last_n < 1:
+            raise ValueError(f"keep_last_n must be >= 1, got {keep_last_n}")
+        self.root = str(root)
+        self.backend = backend if backend is not None else LocalFSBackend()
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.keep_last_n = keep_last_n
+        self._commit_lock = threading.Lock()
+        self.retry.run(
+            lambda: self.backend.makedirs(self.root), describe="executable store init", owner=self
+        )
+
+    # -- discovery --------------------------------------------------------
+    def entries(self) -> List[Tuple[int, str]]:
+        """Committed ``(generation, strong_key)`` pairs, oldest first.
+        Staging dirs are invisible; probes are retried."""
+        names = self.retry.run(
+            lambda: self.backend.listdir(self.root),
+            describe="list executable entries",
+            owner=self,
+        )
+        out = []
+        for name in names:
+            m = _ENTRY_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), m.group(2)))
+        return sorted(out)
+
+    def has(self, strong_key: str, generation: Optional[int] = None) -> bool:
+        """Whether an entry exists for ``strong_key`` (any generation, or one
+        specific generation — the latter is a single retried ``exists``)."""
+        if generation is not None:
+            return bool(
+                self.retry.run(
+                    lambda: self.backend.exists(self._entry_dir(generation, strong_key)),
+                    describe="executable entry probe",
+                    owner=self,
+                )
+            )
+        return any(strong == strong_key for _, strong in self.entries())
+
+    def _entry_name(self, generation: int, strong_key: str) -> str:
+        return f"exe-{generation:08d}-{strong_key}"
+
+    def _entry_dir(self, generation: int, strong_key: str) -> str:
+        return os.path.join(self.root, self._entry_name(generation, strong_key))
+
+    def _next_generation(self) -> int:
+        names = self.retry.run(
+            lambda: self.backend.listdir(self.root),
+            describe="list executable entries",
+            owner=self,
+        )
+        newest = 0
+        for name in names:
+            if name.startswith(_STAGING_PREFIX):
+                name = name[len(_STAGING_PREFIX):]
+            m = _ENTRY_RE.match(name)
+            if m:
+                newest = max(newest, int(m.group(1)))
+        return newest + 1
+
+    # -- publish ----------------------------------------------------------
+    def put(
+        self,
+        strong_key: str,
+        weak_key: str,
+        payload: bytes,
+        envelope: Mapping[str, Any],
+    ) -> int:
+        """Stage + atomically publish one serialized executable; returns its
+        generation id."""
+        with self._commit_lock:
+            generation = self._next_generation()
+            name = self._entry_name(generation, strong_key)
+            staging = os.path.join(self.root, _STAGING_PREFIX + name)
+            final = os.path.join(self.root, name)
+            manifest = build_wire_manifest(
+                MANIFEST_FORMAT,
+                PAYLOAD_NAME,
+                payload,
+                extra={
+                    "generation": generation,
+                    "strong_key": strong_key,
+                    "weak_key": weak_key,
+                    "envelope": dict(envelope),
+                },
+            )
+            run = self.retry.run
+            run(
+                lambda: self.backend.makedirs(staging),
+                describe="executable staging mkdir",
+                owner=self,
+            )
+            # write-ahead: the manifest (checksums + envelope) is durable
+            # before a single payload byte lands, both before the publish
+            run(
+                lambda: self.backend.write_bytes(os.path.join(staging, MANIFEST_NAME), manifest),
+                describe="executable manifest write",
+                owner=self,
+            )
+            run(
+                lambda: self.backend.write_bytes(os.path.join(staging, PAYLOAD_NAME), payload),
+                describe="executable payload write",
+                owner=self,
+            )
+            run(
+                lambda: self.backend.commit_rename(staging, final),
+                describe="executable commit",
+                owner=self,
+            )
+        if self.keep_last_n is not None:
+            self.gc(self.keep_last_n)
+        return generation
+
+    # -- verified read ----------------------------------------------------
+    def read(self, generation: int, strong_key: str) -> Tuple[Dict[str, Any], bytes]:
+        """Fully verify one committed entry; returns ``(manifest, payload)``.
+
+        Raises :class:`StateRestoreError` (reason ``"corrupt"``/``"io"``) on
+        any damage: unreadable/garbled manifest, a manifest whose recorded
+        strong key disagrees with its entry name, payload length or crc32
+        mismatch (torn blob)."""
+        entry = self._entry_dir(generation, strong_key)
+
+        def _corrupt(detail: str) -> StateRestoreError:
+            return StateRestoreError(
+                f"Durable executable entry {self._entry_name(generation, strong_key)} "
+                f"failed verification: {detail}",
+                reason="corrupt",
+                generation=generation,
+            )
+
+        try:
+            manifest_bytes = self.retry.run(
+                lambda: self.backend.read_bytes(os.path.join(entry, MANIFEST_NAME)),
+                describe=f"executable manifest read (gen {generation})",
+                owner=self,
+            )
+        except OSError as err:
+            raise StateRestoreError(
+                f"Durable executable entry {self._entry_name(generation, strong_key)} "
+                f"manifest is unreadable: {err}",
+                reason="io",
+                generation=generation,
+            ) from err
+        manifest = parse_wire_manifest(
+            manifest_bytes,
+            MANIFEST_FORMAT,
+            _corrupt,
+            required=("strong_key", "weak_key", "envelope"),
+        )
+        if manifest.get("strong_key") != strong_key:
+            raise _corrupt(
+                f"manifest strong key {manifest.get('strong_key')!r} does not match "
+                "its entry name"
+            )
+        try:
+            payload = self.retry.run(
+                lambda: self.backend.read_bytes(os.path.join(entry, PAYLOAD_NAME)),
+                describe=f"executable payload read (gen {generation})",
+                owner=self,
+            )
+        except OSError as err:
+            raise StateRestoreError(
+                f"Durable executable entry {self._entry_name(generation, strong_key)} "
+                f"payload is unreadable: {err}",
+                reason="io",
+                generation=generation,
+            ) from err
+        verify_wire_payload(manifest, payload, _corrupt)
+        return dict(manifest), payload
+
+    # -- retention --------------------------------------------------------
+    def gc(self, keep_last_n: Optional[int] = None) -> List[str]:
+        """Sweep abandoned ``.staging-`` dirs (``staging_sweeps`` counter) and
+        keep only the newest ``keep_last_n`` generations *per strong key*
+        (tombstone-then-delete, so a crash mid-gc strands only a staging dir
+        the next sweep removes).  Returns the removed entry names."""
+        with self._commit_lock:
+            names = self.retry.run(
+                lambda: self.backend.listdir(self.root), describe="gc scan", owner=self
+            )
+            for name in names:
+                if name.startswith(_STAGING_PREFIX):
+                    self.retry.run(
+                        lambda n=name: self.backend.remove_tree(os.path.join(self.root, n)),
+                        describe=f"gc staging {name}",
+                        owner=self,
+                    )
+                    _telemetry.count(self, "staging_sweeps")
+            n = keep_last_n if keep_last_n is not None else self.keep_last_n
+            if n is None:
+                return []
+            if n < 1:
+                raise ValueError(f"keep_last_n must be >= 1, got {n}")
+            by_strong: Dict[str, List[int]] = {}
+            for generation, strong in self.entries():
+                by_strong.setdefault(strong, []).append(generation)
+            removed: List[str] = []
+            for strong, generations in sorted(by_strong.items()):
+                for generation in sorted(generations)[:-n]:
+                    name = self._entry_name(generation, strong)
+                    tomb = os.path.join(self.root, _STAGING_PREFIX + name)
+                    self.retry.run(
+                        lambda s=name, t=tomb: self.backend.commit_rename(
+                            os.path.join(self.root, s), t
+                        ),
+                        describe=f"gc tombstone {name}",
+                        owner=self,
+                    )
+                    self.retry.run(
+                        lambda t=tomb: self.backend.remove_tree(t),
+                        describe=f"gc executable {name}",
+                        owner=self,
+                    )
+                    removed.append(name)
+            return removed
+
+
+# -------------------------------------------------------------- the manager
+class WarmStartManager:
+    """Wires a :class:`DurableExecutableStore` into the compile registry.
+
+    One instance per process (:func:`warm_start`).  :meth:`load` scans and
+    verifies the store once, staging each strong key's newest readable entry
+    (skip-back past damaged generations) as *ready* (envelope matches this
+    process) or *stale* (version/flags/platform/device skew — kept only so
+    later misses attribute ``warmstart-stale``).  :meth:`resolve` answers
+    the registry's miss-time consultation; :meth:`export` persists fresh
+    executables after their first dispatch.  Damaged or refused entries are
+    quarantined: never re-read, never re-tried, within this process.
+    """
+
+    def __init__(
+        self,
+        store: DurableExecutableStore,
+        export: bool = True,
+        environment: Optional[Mapping[str, Any]] = None,
+    ) -> None:
+        self.store = store
+        self.export_enabled = bool(export)
+        self.environment = (
+            dict(environment) if environment is not None else current_environment()
+        )
+        self._lock = threading.RLock()
+        self._ready: Dict[str, Dict[str, Any]] = {}
+        self._stale: Dict[str, Dict[str, Any]] = {}
+        self._weak_index: Dict[str, List[str]] = {}
+        self._quarantined: Dict[str, str] = {}
+        self._exported: set = set()
+        self._stats = {
+            "scanned": 0,
+            "ready": 0,
+            "stale": 0,
+            "corrupt": 0,
+            "hits": 0,
+            "stale_misses": 0,
+            "corrupt_misses": 0,
+            "exports": 0,
+            "export_failures": 0,
+            "quarantines": 0,
+        }
+
+    # -- load -------------------------------------------------------------
+    def load(self) -> Dict[str, int]:
+        """Scan + verify every store entry; returns a stats snapshot."""
+        by_strong: Dict[str, List[int]] = {}
+        for generation, strong in self.store.entries():
+            by_strong.setdefault(strong, []).append(generation)
+        for strong, generations in sorted(by_strong.items()):
+            chosen = None
+            last_reason = "no readable generation"
+            for generation in sorted(generations, reverse=True):  # newest first
+                with self._lock:
+                    self._stats["scanned"] += 1
+                try:
+                    manifest, payload = self.store.read(generation, strong)
+                except Exception as err:  # noqa: BLE001 - any damage quarantines
+                    last_reason = f"failed verification ({err})"
+                    self._quarantine_entry(
+                        strong,
+                        last_reason,
+                        announce=f"warm-start entry exe-{generation:08d}-{strong} failed "
+                        f"verification and is quarantined (skipping back): {err}",
+                    )
+                    continue
+                chosen = (generation, manifest, payload)
+                break
+            if chosen is None:
+                with self._lock:
+                    self._stats["corrupt"] += 1
+                    self._quarantined.setdefault(strong, last_reason)
+                continue
+            generation, manifest, payload = chosen
+            envelope = dict(manifest.get("envelope") or {})
+            weak = str(manifest.get("weak_key") or "")
+            record = {
+                "generation": generation,
+                "strong": strong,
+                "weak": weak,
+                "envelope": envelope,
+                "payload": payload,
+                "fn": None,
+            }
+            skew = self._process_skew(envelope)
+            with self._lock:
+                if skew is not None:
+                    record["reason"] = skew
+                    self._stale[strong] = record
+                    self._stats["stale"] += 1
+                else:
+                    self._ready[strong] = record
+                    self._stats["ready"] += 1
+                if weak:
+                    self._weak_index.setdefault(weak, []).append(strong)
+        return self.stats()
+
+    def _process_skew(self, envelope: Mapping[str, Any]) -> Optional[str]:
+        """Name the first process-level envelope mismatch, or ``None``."""
+        for field in _PROCESS_ENV_FIELDS:
+            ours = self.environment.get(field)
+            theirs = envelope.get(field)
+            if theirs != ours:
+                return f"{field} skew (entry {theirs!r}, process {ours!r})"
+        return None
+
+    @staticmethod
+    def _mesh_skew(envelope: Mapping[str, Any], durable_key: Mapping[str, Any]) -> str:
+        entry_mesh = _norm_mesh(envelope.get("mesh_shape"))
+        lookup_mesh = _norm_mesh(durable_key.get("mesh_shape"))
+        if entry_mesh != lookup_mesh:
+            return f"mesh-shape skew (entry {entry_mesh}, lookup {lookup_mesh})"
+        return "input-signature skew (same configuration, different shapes)"
+
+    # -- quarantine -------------------------------------------------------
+    def _quarantine_entry(self, strong: str, reason: str, announce: str) -> None:
+        rank_zero_warn(announce)
+        with self._lock:
+            self._ready.pop(strong, None)
+            self._stale.pop(strong, None)
+            self._quarantined[strong] = reason
+            self._stats["quarantines"] += 1
+        _telemetry.count(self, "warmstart_quarantines")
+
+    def _miss(self, verdict: str) -> None:
+        with self._lock:
+            self._stats[f"{verdict}_misses"] += 1
+        _telemetry.count(self, f"warmstart_{verdict}")
+
+    # -- resolve (the registry's miss-time hook) --------------------------
+    def resolve(
+        self,
+        durable_key: Mapping[str, Any],
+        record: Any,
+        quarantine: bool = False,
+    ) -> Optional[Tuple[str, Any]]:
+        """Answer one compile-cache miss (see
+        :func:`torchmetrics_tpu.core.compile.set_warmstart_hooks`).
+
+        With ``quarantine=True`` this is the registry reporting that an
+        installed executable failed its first dispatch — the entry is
+        quarantined and the (already re-attributed) miss counted."""
+        strong = str(durable_key["strong"])
+        weak = str(durable_key["weak"])
+        if quarantine:
+            self._quarantine_entry(
+                strong,
+                "first-dispatch failure",
+                announce=f"warm-started executable {strong} failed its first dispatch; "
+                "quarantined for this process (recompiled fresh)",
+            )
+            self._miss("corrupt")
+            return None
+        with self._lock:
+            quarantined_reason = self._quarantined.get(strong)
+            ready = self._ready.get(strong)
+        if ready is not None:
+            fn = self._materialize(ready, strong)
+            if fn is None:
+                with self._lock:
+                    reason = self._quarantined.get(strong, "deserialize failure")
+                self._miss("corrupt")
+                return ("corrupt", reason)
+            with self._lock:
+                self._stats["hits"] += 1
+            _telemetry.count(self, "warmstart_hits")
+            return ("hit", fn)
+        if quarantined_reason is not None:
+            self._miss("corrupt")
+            return ("corrupt", quarantined_reason)
+        with self._lock:
+            stale = self._stale.get(strong)
+            weak_peers = tuple(self._weak_index.get(weak, ()))
+        if stale is not None:
+            self._miss("stale")
+            return ("stale", stale["reason"])
+        # weak-key attribution: a durable entry exists for this exact
+        # configuration under a different mesh/shape world — the elastic
+        # restart case.  Attribution only; nothing is ever installed here.
+        for peer in weak_peers:
+            if peer == strong:
+                continue
+            with self._lock:
+                peer_record = self._ready.get(peer) or self._stale.get(peer)
+            if peer_record is None:
+                continue
+            reason = peer_record.get("reason") or self._mesh_skew(
+                peer_record["envelope"], durable_key
+            )
+            self._miss("stale")
+            return ("stale", reason)
+        return None
+
+    def _materialize(self, record: Dict[str, Any], strong: str) -> Optional[Callable]:
+        """Deserialize a ready entry's payload (once, lazily); quarantine on
+        any failure."""
+        with self._lock:
+            fn = record.get("fn")
+            payload = record.get("payload")
+        if fn is not None:
+            return fn
+        if payload is None:
+            return None
+        try:
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            fn = _serde().deserialize_and_load(serialized, in_tree, out_tree)
+        except Exception as err:  # noqa: BLE001 - any failure is a corrupt entry
+            self._quarantine_entry(
+                strong,
+                f"deserialize failure ({err!r})",
+                announce=f"warm-start entry {strong} passed its checksums but failed to "
+                f"deserialize; quarantined for this process ({err!r})",
+            )
+            return None
+        with self._lock:
+            record["fn"] = fn
+            record["payload"] = None  # the blob is dead weight once loaded
+        return fn
+
+    # -- export (the registry's first-dispatch sink) ----------------------
+    def export(self, fn: Callable, args: Tuple, kwargs: Dict[str, Any], record: Any) -> None:
+        """AOT-serialize and publish one freshly compiled entry (dedup'd per
+        strong key; every failure is counted and warned, never raised)."""
+        durable_key = getattr(record, "durable", None)
+        if durable_key is None or not self.export_enabled:
+            return
+        strong = str(durable_key["strong"])
+        weak = str(durable_key["weak"])
+        with self._lock:
+            if strong in self._exported or strong in self._ready:
+                return
+            self._exported.add(strong)
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+            payload = pickle.dumps(
+                _serde().serialize(compiled), protocol=pickle.HIGHEST_PROTOCOL
+            )
+        except Exception as err:  # noqa: BLE001 - export is best-effort
+            with self._lock:
+                self._stats["export_failures"] += 1
+            rank_zero_warn(
+                f"warm-start export skipped for {record.label}: executable did not "
+                f"serialize ({err!r})"
+            )
+            return
+        envelope = dict(self.environment)
+        envelope["fingerprint_hash"] = record.fingerprint_hash
+        envelope["kind"] = record.kind
+        envelope["label"] = record.label
+        mesh_shape = durable_key.get("mesh_shape")
+        envelope["mesh_shape"] = (
+            [[axis, size] for axis, size in mesh_shape] if mesh_shape else None
+        )
+        try:
+            self.store.put(strong, weak, payload, envelope)
+        except Exception as err:  # noqa: BLE001 - a failed publish degrades, loudly
+            with self._lock:
+                self._stats["export_failures"] += 1
+            rank_zero_warn(
+                f"warm-start publish failed for {record.label}: {err!r} (the entry "
+                "will be recompiled on the next restart)"
+            )
+            return
+        with self._lock:
+            self._stats["exports"] += 1
+        _telemetry.count(self, "warmstart_exports")
+
+    # -- introspection ----------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._stats)
+
+    def entries_report(self) -> List[Dict[str, Any]]:
+        """One row per known strong key: its state and why."""
+        rows: List[Dict[str, Any]] = []
+        with self._lock:
+            for strong, record in sorted(self._ready.items()):
+                envelope = record["envelope"]
+                rows.append(
+                    {
+                        "strong_key": strong,
+                        "weak_key": record["weak"],
+                        "generation": record["generation"],
+                        "state": "ready",
+                        "kind": envelope.get("kind"),
+                        "label": envelope.get("label"),
+                        "fingerprint_hash": envelope.get("fingerprint_hash"),
+                    }
+                )
+            for strong, record in sorted(self._stale.items()):
+                envelope = record["envelope"]
+                rows.append(
+                    {
+                        "strong_key": strong,
+                        "weak_key": record["weak"],
+                        "generation": record["generation"],
+                        "state": "stale",
+                        "reason": record["reason"],
+                        "kind": envelope.get("kind"),
+                        "label": envelope.get("label"),
+                        "fingerprint_hash": envelope.get("fingerprint_hash"),
+                    }
+                )
+            for strong, reason in sorted(self._quarantined.items()):
+                rows.append(
+                    {"strong_key": strong, "state": "quarantined", "reason": reason}
+                )
+        return rows
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "root": self.store.root,
+            "export_enabled": self.export_enabled,
+            "environment": dict(self.environment),
+            "stats": self.stats(),
+            "entries": self.entries_report(),
+        }
+
+
+# ------------------------------------------------------------ the singleton
+_MANAGER: Optional[WarmStartManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def manager() -> Optional[WarmStartManager]:
+    """The armed :class:`WarmStartManager`, or ``None``."""
+    return _MANAGER
+
+
+def warm_start(
+    root: Optional[str] = None,
+    backend: Optional[StorageBackend] = None,
+    retry: Optional[RetryPolicy] = None,
+    export: bool = True,
+    keep_last_n: Optional[int] = None,
+) -> WarmStartManager:
+    """Arm durable warm start rooted at ``root`` (default:
+    ``TM_TPU_WARMSTART_DIR``).
+
+    Scans + verifies the store once, pre-installing every compatible
+    executable into the compile registry's resolver, and (with
+    ``export=True``) publishes freshly compiled entries after their first
+    dispatch.  Returns the manager; call :func:`disable_warm_start` to
+    disarm."""
+    global _MANAGER
+    if root is None:
+        root = os.environ.get("TM_TPU_WARMSTART_DIR")
+    if not root:
+        raise ValueError(
+            "warm_start needs a store root: pass `root=` or set TM_TPU_WARMSTART_DIR"
+        )
+    with _MANAGER_LOCK:
+        store = DurableExecutableStore(
+            root, backend=backend, retry=retry, keep_last_n=keep_last_n
+        )
+        mgr = WarmStartManager(store, export=export)
+        mgr.load()
+        _MANAGER = mgr
+        _compile.set_warmstart_hooks(mgr.resolve, mgr.export)
+    return mgr
+
+
+def disable_warm_start() -> None:
+    """Disarm warm start: clear the registry hooks and drop the manager."""
+    global _MANAGER
+    with _MANAGER_LOCK:
+        _MANAGER = None
+        _compile.set_warmstart_hooks(None, None)
+
+
+def warmstart_stats() -> Dict[str, int]:
+    """The armed manager's counters (all-zero when disarmed)."""
+    mgr = _MANAGER
+    if mgr is None:
+        return {
+            "scanned": 0,
+            "ready": 0,
+            "stale": 0,
+            "corrupt": 0,
+            "hits": 0,
+            "stale_misses": 0,
+            "corrupt_misses": 0,
+            "exports": 0,
+            "export_failures": 0,
+            "quarantines": 0,
+        }
+    return mgr.stats()
+
+
+def warmstart_report() -> Dict[str, Any]:
+    """A ``kind: "warmstart_report"`` export payload (JSONL front door):
+    the store root, compatibility environment, counters, and one row per
+    known entry with its state (ready / stale / quarantined) and reason."""
+    from torchmetrics_tpu.observability.export import SCHEMA_VERSION
+
+    out: Dict[str, Any] = {
+        "kind": "warmstart_report",
+        "schema_version": SCHEMA_VERSION,
+        "armed": _MANAGER is not None,
+    }
+    mgr = _MANAGER
+    if mgr is not None:
+        out.update(mgr.report())
+    else:
+        out["stats"] = warmstart_stats()
+    return out

@@ -84,8 +84,16 @@ __all__ = [
 #: (``autotune_decision``-shaped rows for propose/arm/commit/veto/rollback/
 #: audit of per-leaf ``state_sharding`` specs), a ``/sharded`` suffix on
 #: measured per-bucket sync row keys, and sharding specs carried in
-#: attestation provenance.
-SCHEMA_VERSION = "1.8.0"
+#: attestation provenance; 1.9 added the executable warm-start plane — the
+#: ``warmstart_hits`` / ``warmstart_stale`` / ``warmstart_corrupt`` /
+#: ``warmstart_exports`` / ``warmstart_quarantines`` / ``staging_sweeps``
+#: counters (and their ``tm_tpu_*_total`` Prometheus families), ``kind:
+#: "warmstart_report"`` payloads from ``core/warmstart.py`` (store root,
+#: compatibility environment, per-entry ready/stale/quarantined states),
+#: three ``miss_causes`` attributions (``warmstart-hit`` /
+#: ``warmstart-stale`` / ``warmstart-corrupt``) in ``compile_cache`` blocks,
+#: and the ``warmstart`` flight-recorder category.
+SCHEMA_VERSION = "1.9.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -207,6 +215,12 @@ _COUNTER_HELP = {
     "io_retries": "Transient checkpoint I/O failures retried by a RetryPolicy.",
     "skipbacks": "Durable restores that skipped a corrupt generation back to an older one.",
     "quarantines": "Replicas quarantined out of the sync quorum.",
+    "staging_sweeps": "Orphaned durable .staging- dirs removed by a gc sweep.",
+    "warmstart_hits": "Compile-cache misses served by a warm-started durable executable.",
+    "warmstart_stale": "Warm-start entries refused for envelope skew (version/flags/mesh).",
+    "warmstart_corrupt": "Warm-start entries refused as damaged (CRC/deserialize/dispatch).",
+    "warmstart_exports": "Freshly compiled executables published to the durable store.",
+    "warmstart_quarantines": "Warm-start entries quarantined (never re-read this process).",
 }
 
 

@@ -106,6 +106,12 @@ COUNTER_NAMES = (
     "io_retries",
     "skipbacks",
     "quarantines",
+    "staging_sweeps",
+    "warmstart_hits",
+    "warmstart_stale",
+    "warmstart_corrupt",
+    "warmstart_exports",
+    "warmstart_quarantines",
 )
 
 #: Upper edges (microseconds) of the fixed span histogram; one overflow

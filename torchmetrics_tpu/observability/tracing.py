@@ -64,7 +64,17 @@ __all__ = [
 
 #: event categories the recorder emits (the ``cat`` field); Perfetto's track
 #: filter groups on these
-CATEGORIES = ("eager", "sync", "compile", "resilience", "guard", "policy", "memory", "accuracy")
+CATEGORIES = (
+    "eager",
+    "sync",
+    "compile",
+    "resilience",
+    "guard",
+    "policy",
+    "memory",
+    "accuracy",
+    "warmstart",
+)
 
 DEFAULT_CAPACITY = 4096
 
@@ -355,6 +365,12 @@ _INSTANT_COUNTERS = {
     "io_retries": ("io_retry", "resilience"),
     "skipbacks": ("skipback", "resilience"),
     "quarantines": ("quarantine", "resilience"),
+    "staging_sweeps": ("staging_sweep", "resilience"),
+    "warmstart_hits": ("warmstart_hit", "warmstart"),
+    "warmstart_stale": ("warmstart_stale", "warmstart"),
+    "warmstart_corrupt": ("warmstart_corrupt", "warmstart"),
+    "warmstart_exports": ("warmstart_export", "warmstart"),
+    "warmstart_quarantines": ("warmstart_quarantine", "warmstart"),
 }
 
 

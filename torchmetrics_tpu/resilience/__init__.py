@@ -25,7 +25,14 @@ first-class tool here:
   (``ReplicaDivergenceError`` names the divergent leaves and replicas).
 * :mod:`torchmetrics_tpu.resilience.faults` — deterministic fault injection
   (kill/restore, snapshot corruption, torn writes, ENOSPC, crash-before-
-  commit, transient flakes, host loss mid-gather) for tests and drills.
+  commit, transient flakes, stale executable envelopes, host loss
+  mid-gather) for tests and drills.
+
+The same durable substrate (``StorageBackend`` + ``RetryPolicy`` +
+write-ahead crc manifests, shared through ``build_wire_manifest`` /
+``parse_wire_manifest`` / ``verify_wire_payload``) also carries AOT-compiled
+*executables* across restarts — see
+:mod:`torchmetrics_tpu.core.warmstart`.
 
 The jit-fused non-finite guards (``Metric(nan_strategy=...)``) live in
 ``core/guards.py`` so the core can apply them without importing this package.
@@ -41,10 +48,14 @@ from torchmetrics_tpu.resilience.durable import (
     PendingSave,
     RetryPolicy,
     StorageBackend,
+    build_wire_manifest,
+    parse_wire_manifest,
+    verify_wire_payload,
 )
 from torchmetrics_tpu.resilience.elastic import elastic_restore, restack_carry
 from torchmetrics_tpu.resilience.faults import (
     CORRUPTION_MODES,
+    EXE_FAULT_MODES,
     FaultyBackend,
     IO_FAULT_MODES,
     SimulatedCrash,
@@ -81,6 +92,7 @@ from torchmetrics_tpu.utilities.exceptions import (
 __all__ = [
     "CORRUPTION_MODES",
     "DurableSnapshotStore",
+    "EXE_FAULT_MODES",
     "FaultyBackend",
     "IO_FAULT_MODES",
     "LocalFSBackend",
@@ -94,6 +106,7 @@ __all__ = [
     "StorageBackend",
     "TransientIOError",
     "attach_monitor",
+    "build_wire_manifest",
     "class_fingerprint",
     "clear_quarantine",
     "corrupt_snapshot",
@@ -101,6 +114,7 @@ __all__ = [
     "elastic_restore",
     "is_degraded",
     "lossy_allgather",
+    "parse_wire_manifest",
     "perturb_replica",
     "quarantine",
     "quarantine_mask",
@@ -113,5 +127,6 @@ __all__ = [
     "validate_state_leaf",
     "validate_state_pytree",
     "verify_replica_consistency",
+    "verify_wire_payload",
     "with_snapshot_context",
 ]

@@ -71,6 +71,9 @@ __all__ = [
     "PendingSave",
     "RetryPolicy",
     "StorageBackend",
+    "build_wire_manifest",
+    "parse_wire_manifest",
+    "verify_wire_payload",
 ]
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -288,6 +291,70 @@ class LocalFSBackend(StorageBackend):
             os.close(fd)
 
 
+# ----------------------------------------------------- shared wire helpers
+# The write-ahead commit protocol is payload-agnostic: a manifest records the
+# payload's byte count and crc32 *before* the payload lands, and readers
+# verify both before trusting a byte.  These helpers are shared between the
+# snapshot store below and the executable store
+# (:mod:`torchmetrics_tpu.core.warmstart`) so both payload classes ride one
+# torn-write detector.
+def build_wire_manifest(
+    fmt: str,
+    payload_name: str,
+    payload: bytes,
+    extra: Optional[Mapping[str, Any]] = None,
+) -> bytes:
+    """Serialize a write-ahead manifest for one staged payload blob."""
+    manifest: Dict[str, Any] = {
+        "format": fmt,
+        "payload": payload_name,
+        "payload_bytes": len(payload),
+        "payload_crc32": zlib.crc32(payload),
+    }
+    if extra:
+        manifest.update(extra)
+    return json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8")
+
+
+def parse_wire_manifest(
+    manifest_bytes: bytes,
+    fmt: str,
+    on_corrupt: Callable[[str], Exception],
+    required: Tuple[str, ...] = (),
+) -> Dict[str, Any]:
+    """Decode + structurally validate a manifest; damage raises via
+    ``on_corrupt(detail)`` (so each store keeps its own typed error).
+    ``required`` names store-specific records beyond the payload checksums."""
+    try:
+        manifest = json.loads(manifest_bytes.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as err:
+        raise on_corrupt(f"partial or garbled manifest ({err})") from err
+    if not isinstance(manifest, dict) or manifest.get("format") != fmt:
+        raise on_corrupt(
+            f"unrecognized manifest format "
+            f"{manifest.get('format') if isinstance(manifest, dict) else manifest!r}"
+        )
+    for key in ("payload_crc32", "payload_bytes") + tuple(required):
+        if key not in manifest:
+            raise on_corrupt(f"manifest is missing its {key!r} record")
+    return manifest
+
+
+def verify_wire_payload(
+    manifest: Mapping[str, Any],
+    payload: bytes,
+    on_corrupt: Callable[[str], Exception],
+) -> None:
+    """Torn-write detection: byte count, then crc32, against the manifest."""
+    if len(payload) != int(manifest["payload_bytes"]):
+        raise on_corrupt(
+            f"payload is {len(payload)} bytes but the manifest recorded "
+            f"{manifest['payload_bytes']} (torn write)"
+        )
+    if zlib.crc32(payload) != int(manifest["payload_crc32"]):
+        raise on_corrupt("payload crc32 does not match the manifest (torn write)")
+
+
 # ------------------------------------------------------------ checksumming
 def _walk_arrays(node: Any, prefix: str = "") -> Iterator[Tuple[str, np.ndarray]]:
     """Yield ``(path, host_array)`` for every array leaf in a snapshot-like
@@ -457,19 +524,19 @@ class DurableSnapshotStore:
 
     def _build_manifest(self, snap: Mapping[str, Any], payload: bytes, generation: int) -> bytes:
         leaves = {path: _leaf_crc(arr) for path, arr in _walk_arrays(snap)}
-        manifest = {
-            "format": _MANIFEST_FORMAT,
-            "generation": generation,
-            "payload": PAYLOAD_NAME,
-            "payload_bytes": len(payload),
-            "payload_crc32": zlib.crc32(payload),
-            "schema_version": snap.get("schema_version"),
-            "kind": snap.get("kind"),
-            "class": snap.get("class"),
-            "mesh": snap.get("mesh"),
-            "leaves": leaves,
-        }
-        return json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8")
+        return build_wire_manifest(
+            _MANIFEST_FORMAT,
+            PAYLOAD_NAME,
+            payload,
+            extra={
+                "generation": generation,
+                "schema_version": snap.get("schema_version"),
+                "kind": snap.get("kind"),
+                "class": snap.get("class"),
+                "mesh": snap.get("mesh"),
+                "leaves": leaves,
+            },
+        )
 
     def _write_generation(self, snap: Mapping[str, Any]) -> int:
         """The commit protocol.  Caller holds ``_commit_lock``."""
@@ -580,15 +647,9 @@ class DurableSnapshotStore:
                 reason="io",
                 generation=generation,
             ) from err
-        try:
-            manifest = json.loads(manifest_bytes.decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as err:
-            raise _corrupt(f"partial or garbled manifest ({err})") from err
-        if not isinstance(manifest, dict) or manifest.get("format") != _MANIFEST_FORMAT:
-            raise _corrupt(f"unrecognized manifest format {manifest.get('format') if isinstance(manifest, dict) else manifest!r}")
-        for key in ("payload_crc32", "payload_bytes", "leaves"):
-            if key not in manifest:
-                raise _corrupt(f"manifest is missing its {key!r} record")
+        manifest = parse_wire_manifest(
+            manifest_bytes, _MANIFEST_FORMAT, _corrupt, required=("leaves",)
+        )
 
         try:
             payload = self.retry.run(
@@ -602,13 +663,7 @@ class DurableSnapshotStore:
                 reason="io",
                 generation=generation,
             ) from err
-        if len(payload) != int(manifest["payload_bytes"]):
-            raise _corrupt(
-                f"payload is {len(payload)} bytes but the manifest recorded "
-                f"{manifest['payload_bytes']} (torn write)"
-            )
-        if zlib.crc32(payload) != int(manifest["payload_crc32"]):
-            raise _corrupt("payload crc32 does not match the manifest (torn write)")
+        verify_wire_payload(manifest, payload, _corrupt)
         try:
             snap = pickle.loads(payload)
         except Exception as err:  # noqa: BLE001 - any unpickling failure is corruption
@@ -694,8 +749,19 @@ class DurableSnapshotStore:
         gens = self.generations()
         doomed = gens[:-keep_last_n] if keep_last_n < len(gens) else []
         for gen in doomed:
+            # Tombstone-then-delete: the doomed generation is first renamed
+            # (atomically) into the `.staging-` namespace, THEN removed.  A
+            # crash at any point mid-gc therefore leaves either a committed
+            # generation or an orphaned staging dir the next sweep removes —
+            # never a half-deleted gen-* a reader could list and fail on.
+            tomb = self._staging_dir(gen)
             self.retry.run(
-                lambda g=gen: self.backend.remove_tree(self._gen_dir(g)),
+                lambda g=gen, t=tomb: self.backend.commit_rename(self._gen_dir(g), t),
+                describe=f"gc tombstone generation {gen}",
+                owner=self,
+            )
+            self.retry.run(
+                lambda t=tomb: self.backend.remove_tree(t),
                 describe=f"gc generation {gen}",
                 owner=self,
             )
@@ -703,8 +769,10 @@ class DurableSnapshotStore:
 
     def gc(self, keep_last_n: Optional[int] = None) -> List[int]:
         """Delete old generations (keeping the newest ``keep_last_n``) and
-        sweep abandoned staging directories (crash-before-rename residue).
-        Returns the deleted generation ids."""
+        sweep abandoned staging directories — both crash-before-rename
+        residue and tombstones stranded by a crash *during* a previous gc
+        (each sweep bumps the ``staging_sweeps`` counter).  Returns the
+        deleted generation ids."""
         with self._commit_lock:
             names = self.retry.run(
                 lambda: self.backend.listdir(self.root), describe="gc scan", owner=self
@@ -716,6 +784,7 @@ class DurableSnapshotStore:
                         describe=f"gc staging {name}",
                         owner=self,
                     )
+                    _telemetry.count(self, "staging_sweeps")
             n = keep_last_n if keep_last_n is not None else self.keep_last_n
             if n is None:
                 return []

@@ -48,6 +48,7 @@ from torchmetrics_tpu.utilities.exceptions import TransientIOError
 
 __all__ = [
     "CORRUPTION_MODES",
+    "EXE_FAULT_MODES",
     "FaultyBackend",
     "IO_FAULT_MODES",
     "SimulatedCrash",
@@ -236,6 +237,39 @@ IO_FAULT_MODES = (
     "transient",
 )
 
+#: the executable-store drill adds one mode the snapshot store has no
+#: equivalent for: a manifest whose compatibility *envelope* records a
+#: different jax/jaxlib version (structurally valid, checksums intact — the
+#: entry must be rejected as *stale*, not corrupt)
+EXE_FAULT_MODES = IO_FAULT_MODES + ("stale_version",)
+
+
+def _exe_payload_name() -> str:
+    # lazy: faults must stay importable without pulling the (jax-heavy)
+    # warm-start module until an executable drill actually runs
+    from torchmetrics_tpu.core.warmstart import PAYLOAD_NAME as exe_payload_name
+
+    return exe_payload_name
+
+
+def _stale_envelope(manifest_bytes: bytes) -> Optional[bytes]:
+    """Rewrite an executable manifest's envelope to claim an old jax; returns
+    ``None`` (don't inject) for manifests without an envelope."""
+    import json
+
+    try:
+        manifest = json.loads(manifest_bytes.decode("utf-8"))
+    except Exception:  # noqa: BLE001 - not a JSON manifest; leave untouched
+        return None
+    if not isinstance(manifest, Mapping) or "envelope" not in manifest:
+        return None
+    manifest = dict(manifest)
+    envelope = dict(manifest["envelope"] or {})
+    envelope["jax_version"] = "0.0.0-stale"
+    envelope["jaxlib_version"] = "0.0.0-stale"
+    manifest["envelope"] = envelope
+    return json.dumps(manifest, indent=1, sort_keys=True).encode("utf-8")
+
 
 class SimulatedCrash(RuntimeError):
     """The process-death boundary for durability drills.
@@ -268,14 +302,19 @@ class FaultyBackend(LocalFSBackend):
         * ``"crash_before_rename"`` — the commit rename raises
           :class:`SimulatedCrash`, stranding the staging directory exactly
           like a process killed between write-ahead and commit.
-        * ``"transient"`` — reads and writes raise
+        * ``"transient"`` — reads, writes *and* directory probes
+          (``listdir``/``exists`` — the generation-discovery path) raise
           :class:`~torchmetrics_tpu.utilities.exceptions.TransientIOError`
           the first ``times`` calls (an NFS flake); retries succeed.
+        * ``"stale_version"`` (executable store only) — the manifest's
+          compatibility envelope is rewritten to claim jax ``0.0.0-stale``;
+          checksums stay intact, so the entry must be rejected as *stale*
+          (envelope skew), never installed and never called corrupt.
     """
 
     def __init__(self, mode: str, times: int = 1) -> None:
-        if mode not in IO_FAULT_MODES:
-            raise ValueError(f"mode must be one of {IO_FAULT_MODES}, got {mode!r}")
+        if mode not in EXE_FAULT_MODES:
+            raise ValueError(f"mode must be one of {EXE_FAULT_MODES}, got {mode!r}")
         if times < 1:
             raise ValueError(f"times must be >= 1, got {times}")
         self.mode = mode
@@ -291,12 +330,21 @@ class FaultyBackend(LocalFSBackend):
 
     def write_bytes(self, path: str, data: bytes) -> None:
         name = os.path.basename(path)
-        if self.mode == "torn_write" and name == PAYLOAD_NAME and self._arm():
+        if (
+            self.mode == "torn_write"
+            and name in (PAYLOAD_NAME, _exe_payload_name())
+            and self._arm()
+        ):
             super().write_bytes(path, data[: len(data) // 2])
             return
         if self.mode == "partial_manifest" and name == MANIFEST_NAME and self._arm():
             super().write_bytes(path, data[: max(1, len(data) // 3)])
             return
+        if self.mode == "stale_version" and name == MANIFEST_NAME:
+            mutated = _stale_envelope(data)
+            if mutated is not None and self._arm():
+                super().write_bytes(path, mutated)
+                return
         if self.mode == "enospc" and self._arm():
             raise OSError(errno.ENOSPC, "No space left on device", path)
         if self.mode == "transient" and self._arm():
@@ -309,6 +357,20 @@ class FaultyBackend(LocalFSBackend):
                 f"injected transient flake reading {os.path.basename(path)}"
             )
         return super().read_bytes(path)
+
+    def listdir(self, path: str) -> List[str]:
+        if self.mode == "transient" and self._arm():
+            raise TransientIOError(
+                f"injected transient flake listing {os.path.basename(path) or path}"
+            )
+        return super().listdir(path)
+
+    def exists(self, path: str) -> bool:
+        if self.mode == "transient" and self._arm():
+            raise TransientIOError(
+                f"injected transient flake probing {os.path.basename(path)}"
+            )
+        return super().exists(path)
 
     def commit_rename(self, src: str, dst: str) -> None:
         if self.mode == "crash_before_rename" and self._arm():

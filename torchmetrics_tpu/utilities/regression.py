@@ -79,6 +79,7 @@ _LOWER_BETTER = (
     "alerts",  # health-monitor alert counts on the deterministic bench stream
     "_sync_s",  # autotune-leg sync wall times (naive/hand-tuned/autotuned)
     "_ckpt_s",  # durable checkpoint save/restore wall times (commit protocol + verified read)
+    "_start_s",  # warm-start leg time-to-first-step (cold_start_s / warm_start_s)
 )
 #: keys where a HIGHER value is better (gate on decreases)
 _HIGHER_BETTER = ("cut", "speedup", "drop_pct", "fused_to", "prometheus_lines")
