@@ -1635,6 +1635,144 @@ def warmstart_bench_child():
     )
 
 
+def gathers_bench_child():
+    """Gather-plane observability leg on an 8-virtual-device CPU mesh:
+    run BENCH_r05's mAP workload (8 devices x 4 images/step, 100 dets each)
+    through ``DeferredRaggedSync`` with the gather plane armed and report
+
+    * the live per-step cat growth and its pod-scale projection — the
+      64-chip figure must reproduce BENCH_r05's archived 5,402,880
+      bytes/chip/step exactly (asserted, not just reported);
+    * the measured ragged gather (block-until-ready ``measured_us`` per
+      leaf) next to the naive/tiled-ring byte models and their residual;
+    * the armed-path cost: wall-clock overhead vs the unarmed run plus the
+      zero-retrace / zero-new-cache-entry proof;
+    * the GatherAdvisor's 64-chip ranking (report-only).
+    """
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.core import compile as _compile
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.observability import registry
+    from torchmetrics_tpu.observability.gathers import GatherAdvisor
+    from torchmetrics_tpu.parallel.ragged import DeferredRaggedSync
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+
+    def map_batch(rng, k=4):
+        preds = [
+            {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (100, 4)), jnp.float32),
+                "scores": jnp.asarray(rng.uniform(0, 1, (100,)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (100,))),
+            }
+            for _ in range(k)
+        ]
+        target = [
+            {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (10, 4)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (10,))),
+            }
+            for _ in range(k)
+        ]
+        return preds, target
+
+    def run_once(steps=2):
+        rng = np.random.default_rng(0)
+        m = MeanAveragePrecision()
+        acc = DeferredRaggedSync(m, mesh=mesh)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            acc.update([map_batch(rng) for _ in range(n_dev)])
+        acc.compute()
+        return m, time.perf_counter() - t0
+
+    # warm the pad-shape / jit caches so both measured legs are steady-state
+    obs.disable()
+    run_once()
+
+    # --- unarmed reference: telemetry on, gather plane dark
+    obs.enable()
+    base = _compile.cache_stats()
+    _, unarmed_wall = run_once()
+    unarmed_delta = _compile.cache_stats_since(base)
+
+    # --- armed leg: the whole gather plane live
+    obs.enable_gather_telemetry()
+    base = _compile.cache_stats()
+    m, armed_wall = run_once()
+    armed_delta = _compile.cache_stats_since(base)
+
+    g = registry.telemetry_for(m, create=False).gathers
+    bytes_per_step = int(round(int(g["cat_bytes"]) / max(int(g["steps"]), 1)))
+    proj = {
+        n: obs.project_gather_bytes(n)["total_bytes_per_chip_per_step"]
+        for n in (8, 16, 64)
+    }
+    # the acceptance figure: live telemetry must land on BENCH_r05's archived
+    # 64-chip mAP row exactly, not approximately
+    assert proj[64] == 5_402_880, f"BENCH_r05 64-chip figure drifted: {proj[64]}"
+
+    buckets = m.telemetry.as_dict()["sync_buckets"]
+    leaves = {}
+    measured_us_total = 0.0
+    for name, row in sorted(buckets.items()):
+        if not name.startswith("gather/"):
+            continue
+        measured_us_total += row["measured_us"]
+        leaves[name.split("/", 1)[1]] = {
+            "measured_us": round(row["measured_us"], 1),
+            "model_naive_bytes": row["model_naive_bytes"],
+            "model_ring_bytes": row["model_ring_bytes"],
+            "residual_bytes": row["residual_bytes"],
+        }
+
+    advice = GatherAdvisor(n_chips=64).advise()
+    top = advice["candidates"][0]
+
+    out = {
+        "workload": "BENCH_r05 mAP: 8 dev x 4 img/step, 100 det/img, 2 steps",
+        "map_gather_bytes": bytes_per_step,
+        "ew_gather_bytes": int(round(g["ew_bytes_per_step"])),
+        "hwm_gather_bytes": int(g["hwm_bytes"]),
+        "projected_8chip_gather_bytes": proj[8],
+        "projected_16chip_gather_bytes": proj[16],
+        "projected_64chip_gather_bytes": proj[64],
+        "bench_r05_reproduced": bool(proj[64] == 5_402_880),
+        "measured_gather_s": round(measured_us_total / 1e6, 6),
+        "gather_leaves": leaves,
+        "sync_gather_bytes": obs.report()["global"]["counters"]["sync_gather_bytes"],
+        "armed": {
+            "unarmed_wall_s": round(unarmed_wall, 4),
+            "armed_wall_s": round(armed_wall, 4),
+            "armed_overhead_pct": round(
+                (armed_wall - unarmed_wall) / max(unarmed_wall, 1e-9) * 100.0, 2
+            ),
+            "armed_retraces": armed_delta["traces"],
+            "armed_new_cache_entries": armed_delta["misses"],
+            "unarmed_retraces": unarmed_delta["traces"],
+            "zero_retrace": bool(
+                armed_delta["traces"] == 0 and armed_delta["misses"] == 0
+            ),
+        },
+        "advice": {
+            "top": top["metric"],
+            "recommendation": top["recommendation"],
+            "two_stage_cut_gather_bytes": top["two_stage_cut_bytes_per_chip_per_step"],
+            "sketch_cut_gather_bytes": top["sketch_cut_bytes_per_chip_per_step"],
+            "sketch_alternative": top["sketch_alternative"],
+        },
+    }
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s, extra_env=None):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -1755,6 +1893,16 @@ def measured_warmstart():
         "executables_exported": cold["warmstart"]["exports"],
         "warm_hits": warm["warmstart"]["hits"],
     }
+
+
+def measured_gathers():
+    """Gather-plane observability leg: live cat-state attribution, measured
+    ragged gathers, the exact BENCH_r05 64-chip projection, and the armed
+    path's zero-retrace proof — ``*_gather_bytes`` / ``*_gather_s`` keys are
+    regression-gated lower-better."""
+    return _run_cpu_mesh_child(
+        "gathers", float(os.environ.get("BENCH_GATHER_TIMEOUT", 300))
+    )
 
 
 def donation_leg():
@@ -2421,6 +2569,7 @@ def main():
     autotune_measured = measured_autotune()
     sharding_measured = measured_sharding()
     warmstart_measured = measured_warmstart()
+    gathers_measured = measured_gathers()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -2479,6 +2628,7 @@ def main():
             "autotune": autotune_measured,
             "sharded_state": sharding_measured,
             "warmstart": warmstart_measured,
+            "gather_plane": gathers_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -2616,6 +2766,8 @@ if __name__ == "__main__":
         sharding_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "warmstart":
         warmstart_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "gathers":
+        gathers_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

@@ -1,0 +1,195 @@
+"""Gather-plane observability walkthrough: cat states, pods, and advice.
+
+What this shows, in order:
+
+1. arming the plane (double gate: telemetry on + gather telemetry on) and
+   live cat-state attribution — per-metric, per-leaf growth rows fed from
+   ``DeferredRaggedSync``: bytes/step, the EMA growth rate, and the
+   accumulated-state high-water mark;
+2. measured ragged gathers — ``compute()`` times the host gather
+   block-until-ready and lands ``gather/<leaf>`` bucket rows with
+   ``measured_us`` next to the naive/tiled-ring byte models, plus the
+   ``sync_gather_bytes`` counter split out of the psum traffic;
+3. pod-scale projection — ``project_gather_bytes(n_chips)`` reproduces
+   BENCH_r05's archived mAP figure, 5,402,880 bytes/chip/step at 64 chips,
+   from two live steps of the same workload;
+4. exports through the front door — ``tm_tpu_gather_*`` Prometheus families
+   and a ``kind: "gather_report"`` JSONL line that parses back;
+5. the proof the armed path is free: same trace count, same cache entries;
+6. the report-only GatherAdvisor ranking cat-state consumers and naming
+   MeanAveragePrecision sketch-first at 64 chips.
+
+Run on anything: ``python examples/gather_observability_walkthrough.py``
+(CPU ok; the workload is BENCH_r05's mAP shapes on an 8-device host mesh).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.core.compile import cache_stats, clear_compile_cache
+from torchmetrics_tpu.observability.export import parse_export_line
+from torchmetrics_tpu.observability.gathers import GatherAdvisor
+from torchmetrics_tpu.parallel.ragged import DeferredRaggedSync
+
+N_DEV = 8
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def map_batch(rng: np.random.Generator, k: int):
+    """One device's batch of BENCH_r05's mAP workload: ``k`` images with 100
+    predicted and 10 ground-truth boxes each."""
+    preds = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (100, 4)), jnp.float32),
+            "scores": jnp.asarray(rng.uniform(0, 1, (100,)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (100,))),
+        }
+        for _ in range(k)
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (10, 4)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (10,))),
+        }
+        for _ in range(k)
+    ]
+    return preds, target
+
+
+def map_workload(mesh: Mesh, steps: int = 2):
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(0)
+    m = MeanAveragePrecision()
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    for _ in range(steps):
+        acc.update([map_batch(rng, 4) for _ in range(N_DEV)])
+    return m, acc
+
+
+def main() -> None:
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("data",))
+
+    # ------------------------------------------------------------------ 1
+    banner("1. live cat-state attribution")
+    obs.enable()
+    obs.enable_gather_telemetry()  # or TM_TPU_GATHER_TELEMETRY=1
+    m, acc = map_workload(mesh, steps=2)
+    g = m.telemetry.as_dict()["gathers"]
+    print(f"steps={g['steps']}  cat_bytes={g['cat_bytes']:,} B  "
+          f"ew={g['ew_bytes_per_step']:,.0f} B/step  hwm={g['hwm_bytes']:,} B")
+    for leaf, row in sorted(g["leaves"].items()):
+        print(f"  leaf {leaf:22s} {row['bytes']:7,} B over {row['steps']} steps")
+    bps = g["cat_bytes"] // g["steps"]
+    print(f"=> 8 devices x 4 images/step, 100 dets each: {bps:,} unpadded "
+          "cat bytes grow per step — unbounded, unlike any psum state")
+
+    # ------------------------------------------------------------------ 2
+    banner("2. measured ragged gathers + the counter split")
+    acc.compute()  # the ragged host gather runs here, timed block-until-ready
+    buckets = m.telemetry.as_dict()["sync_buckets"]
+    for name in sorted(b for b in buckets if b.startswith("gather/")):
+        row = buckets[name]
+        print(f"  {name:28s} measured={row['measured_us']:9.1f} us  "
+              f"naive={row['model_naive_bytes']:7,} B  "
+              f"ring={row['model_ring_bytes']:7,} B  "
+              f"residual={row['residual_bytes']:+,} B")
+    counters = obs.report()["global"]["counters"]
+    print(f"sync_gather_bytes={counters['sync_gather_bytes']:,} B split from "
+          f"sync_bytes={counters['sync_bytes']:,} B "
+          '(family="gather" vs family="reduce" in Prometheus)')
+
+    # ------------------------------------------------------------------ 3
+    banner("3. pod-scale projection: the BENCH_r05 figure")
+    for n_chips in (8, 16, 64):
+        proj = obs.project_gather_bytes(n_chips)
+        print(f"  {n_chips:3d} chips -> "
+              f"{proj['total_bytes_per_chip_per_step']:,} gather B/chip/step")
+    proj64 = obs.project_gather_bytes(64)
+    assert proj64["total_bytes_per_chip_per_step"] == 5_402_880, (
+        "two live steps must reproduce BENCH_r05's archived 64-chip figure"
+    )
+    print("=> (64-1) x 85,760 B/step = 5,402,880 — exactly BENCH_r05's "
+          "archived mAP row, reproduced from live telemetry")
+
+    # ------------------------------------------------------------------ 4
+    banner("4. exports through the front door")
+    report = obs.gather_report()
+    prom = obs.export(report, fmt="prometheus")
+    for ln in prom.splitlines():
+        if ln.startswith(("tm_tpu_gather_cat_bytes_total{",
+                          "tm_tpu_gather_projected_bytes_per_chip_per_step{",
+                          "tm_tpu_gather_advice_info{")):
+            print(" ", ln)
+    line = obs.export(report, fmt="jsonl", stream=io.StringIO())
+    back = parse_export_line(line)
+    print("jsonl kind:", back["kind"], " schema:", back["schema_version"])
+
+    # ------------------------------------------------------------------ 5
+    banner("5. the armed path is free: 0 retraces, 0 new entries")
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.integers(0, 8, 256))
+    target = jnp.asarray(rng.integers(0, 8, 256))
+
+    def flow():
+        clear_compile_cache()
+        mm = MulticlassAccuracy(num_classes=8, jit=True)
+        mm.update(preds, target)
+        stats = cache_stats()
+        return stats["traces"], stats["misses"]
+
+    obs.disable_gather_telemetry()
+    traces_off, misses_off = flow()
+    obs.enable_gather_telemetry()
+    traces_on, misses_on = flow()
+    print(f"traces: {traces_off} unarmed -> {traces_on} armed "
+          f"(+{traces_on - traces_off}); cache entries +{misses_on - misses_off}")
+
+    # ------------------------------------------------------------------ 6
+    banner("6. GatherAdvisor: what to do about it, report-only")
+    advisor = GatherAdvisor(n_chips=64)
+    advice = advisor.advise()
+    top = advice["candidates"][0]
+    print(f"top consumer: {top['metric']} ({top['class']})")
+    print(f"  flat all-gather at 64 chips: "
+          f"{top['projected_flat_bytes_per_chip_per_step']:,} B/chip/step")
+    print(f"  two-stage ICI->DCN route:    "
+          f"{top['two_stage_dcn_bytes_per_chip_per_step']:,} B/chip/step over DCN "
+          f"(cuts {top['two_stage_cut_bytes_per_chip_per_step']:,} B)")
+    print(f"  fixed-shape sketch state:    0 B/chip/step "
+          f"(cuts {top['sketch_cut_bytes_per_chip_per_step']:,} B)")
+    print(f"  existing alternative: {top['sketch_alternative']} "
+          "(none shipped for mAP yet — ROADMAP open item 5)")
+    for ledger_line in advisor.export_ledger(stream=io.StringIO()):
+        kind = parse_export_line(ledger_line)["kind"]
+    print(f"advice landed in the decision ledger as kind={kind!r}")
+    assert top["class"] == "MeanAveragePrecision"
+    assert top["recommendation"] == "sketch-first"
+    print(f"=> at 64 chips the advisor names {top['class']} "
+          f"{top['recommendation']}: two-stage still ships every byte once per "
+          "step; only a sketch caps the linear-in-steps cat growth")
+
+    obs.disable_gather_telemetry()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

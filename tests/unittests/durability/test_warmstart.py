@@ -407,7 +407,9 @@ def test_report_parses_back_and_prometheus_families(tmp_path):
 
         report = warmstart_report()
         assert report["kind"] == "warmstart_report" and report["armed"]
-        assert report["schema_version"].startswith("1.9")
+        from torchmetrics_tpu.observability.export import SCHEMA_VERSION
+
+        assert report["schema_version"] == SCHEMA_VERSION
         assert report["stats"]["hits"] == 1
         (row,) = report["entries"]
         assert row["state"] == "ready" and row["kind"] == "update"

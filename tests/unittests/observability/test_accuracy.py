@@ -651,6 +651,29 @@ def _maximal_report():
                 "A#0": {"exact": False, "bound": 0.1, "within_budget": None, "ledger": []}
             }
         },
+        "gather": {
+            "metrics": {
+                "M#0": {"steps": 2, "cat_elements": 32, "cat_bytes": 256,
+                        "ew_bytes_per_step": 128.0, "hwm_bytes": 256, "leaves": {}}
+            },
+            "projection": {
+                "64": {
+                    "n_chips": 64,
+                    "model": "flat",
+                    "metrics": {"M#0": {"projected_bytes_per_chip_per_step": 8064}},
+                    "total_bytes_per_chip_per_step": 8064,
+                }
+            },
+            "advice": {
+                "kind": "gather_advice",
+                "n_chips": 64,
+                "candidates": [
+                    {"metric": "M#0", "recommendation": "two-stage",
+                     "two_stage_cut_bytes_per_chip_per_step": 7000,
+                     "sketch_cut_bytes_per_chip_per_step": 8064}
+                ],
+            },
+        },
     }
 
 

@@ -1,0 +1,692 @@
+"""Gather-plane observability: live cat-state growth attribution, pod-scale
+projection (the BENCH_r05 mAP exact-figure reproduction), the report-only
+GatherAdvisor, measured ragged/DCN gather buckets, and the armed path's
+zero-retrace / zero-new-entry contract."""
+
+import copy
+import io
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import Metric, observability as obs
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.core.compile import (
+    cache_stats,
+    clear_compile_cache,
+    set_cache_capacity,
+)
+from torchmetrics_tpu.core.reductions import Reduce
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.observability import gathers, registry
+from torchmetrics_tpu.observability.export import (
+    SCHEMA_VERSION,
+    JSONLinesExporter,
+    PrometheusExporter,
+    parse_export_line,
+)
+from torchmetrics_tpu.observability.gathers import (
+    GATHER_LEDGER_KIND,
+    GATHER_REPORT_KIND,
+    GatherAdvisor,
+    cat_growth_rows,
+    project_gather_bytes,
+    sketch_alternative_for,
+)
+from torchmetrics_tpu.observability.health import (
+    Alert,
+    CallbackAlertSink,
+    CatStateBudgetRule,
+    HealthMonitor,
+)
+from torchmetrics_tpu.parallel.coalesce import build_sync_plan, coalesced_host_sync
+from torchmetrics_tpu.parallel.ragged import DeferredRaggedSync
+from torchmetrics_tpu.utilities.benchmark import (
+    tiled_allgather_bytes,
+    two_stage_gather_bytes,
+)
+from torchmetrics_tpu.utilities.regression import direction_for
+
+pytestmark = pytest.mark.gathers
+
+PREDS = jnp.asarray([0, 1, 2, 3, 4, 0, 1, 2])
+TARGET = jnp.asarray([0, 1, 2, 3, 4, 1, 1, 0])
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.disable()
+    gathers.disable_gather_telemetry()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    yield
+    obs.tracing.stop()
+    gathers.disable_gather_telemetry()
+    obs.disable()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    set_cache_capacity(512)
+
+
+def _armed():
+    obs.enable()
+    gathers.enable_gather_telemetry()
+
+
+class CatItems(Metric):
+    """Minimal gather-family metric: every update appends one item tuple."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("items", [], dist_reduce_fx="cat")
+
+    def _update(self, state, x):
+        return {"items": state["items"] + (x,)}
+
+    def _compute(self, state):
+        return sum(float(np.asarray(v).sum()) for v in state["items"])
+
+
+def _cat_steps(mesh, steps=2, width=3):
+    """``steps`` DeferredRaggedSync updates of one ``(width,)`` float32 item
+    per device: width*4 bytes/device/step, NUM_DEVICES*width*4 bytes/step."""
+    m = CatItems()
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    for _ in range(steps):
+        acc.update([(jnp.ones((width,), jnp.float32),) for _ in range(NUM_DEVICES)])
+    return m, acc
+
+
+def _map_batch(rng, k):
+    preds = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (100, 4)), jnp.float32),
+            "scores": jnp.asarray(rng.uniform(0, 1, (100,)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (100,))),
+        }
+        for _ in range(k)
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (10, 4)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (10,))),
+        }
+        for _ in range(k)
+    ]
+    return preds, target
+
+
+def _map_workload(mesh, steps=2):
+    """BENCH_r05's mAP workload: 8 devices x 4 images/step, 100 dets each —
+    32 images and 85,760 unpadded cat bytes per step."""
+    rng = np.random.default_rng(0)
+    m = MeanAveragePrecision()
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    for _ in range(steps):
+        acc.update([_map_batch(rng, 4) for _ in range(NUM_DEVICES)])
+    return m, acc
+
+
+# ------------------------------------------------- live cat-state attribution
+def test_cat_growth_rows_sizes_gather_leaves_only():
+    class Fake:
+        _reductions = {"items": Reduce.CAT, "_n": Reduce.SUM, "hits": Reduce.SUM}
+
+    partial = [
+        {"items": (np.zeros((3,), np.float32),), "_n": np.int32(1), "hits": np.int32(2)},
+        {"items": (np.zeros((5,), np.float32),), "_n": np.int32(1), "hits": np.int32(0)},
+    ]
+    acc = [{"items": (np.zeros((16,), np.float32),)}, {"items": ()}]
+    rows = cat_growth_rows(Fake(), partial, acc)
+    # psum-shaped SUM leaves never enter the gather family
+    assert set(rows) == {"items"}
+    assert rows["items"]["elements"] == 8
+    assert rows["items"]["bytes"] == 8 * 4
+    assert rows["items"]["total_bytes"] == 16 * 4
+
+
+def test_live_growth_accounting_with_cat_metric(mesh):
+    _armed()
+    m, _ = _cat_steps(mesh, steps=2, width=3)
+    g = registry.telemetry_for(m, create=False).gathers
+    step_bytes = NUM_DEVICES * 3 * 4
+    assert g["steps"] == 2
+    assert g["cat_elements"] == 2 * NUM_DEVICES * 3
+    assert g["cat_bytes"] == 2 * step_bytes
+    assert g["ew_bytes_per_step"] == pytest.approx(float(step_bytes))
+    # hwm tracks the running (accumulated) cat size, not the per-step delta
+    assert g["hwm_bytes"] == 2 * step_bytes
+    leaf = g["leaves"]["items"]
+    assert leaf["steps"] == 2 and leaf["bytes"] == 2 * step_bytes
+    # the block exports on the metric row once a step has been recorded
+    row = m.telemetry.as_dict()
+    assert row["gathers"]["cat_bytes"] == 2 * step_bytes
+
+
+def test_unarmed_as_dict_has_no_gathers_key(mesh):
+    obs.enable()  # telemetry on, gather plane NOT armed
+    m, _ = _cat_steps(mesh, steps=1)
+    row = m.telemetry.as_dict()
+    assert "gathers" not in row  # 1.9 byte-identity for unarmed reports
+    assert project_gather_bytes(64)["total_bytes_per_chip_per_step"] == 0
+
+
+def test_gather_plane_dark_without_enable(mesh):
+    gathers.enable_gather_telemetry()  # armed, but telemetry disabled
+    assert gathers.gather_telemetry_enabled()
+    assert not obs.enabled()
+    m, _ = _cat_steps(mesh, steps=1)
+    assert registry.telemetry_for(m, create=False) is None
+
+
+class _Owner:
+    """Weakref-able stand-in owner: a bare ``object()`` can't be weakref'd,
+    so its registry entry would outlive the test and pollute later reports."""
+
+
+def test_ew_growth_rate_and_watermark_track_steps():
+    _armed()
+    owner = _Owner()
+    registry.record_cat_growth(
+        owner, {"items": {"elements": 10, "bytes": 100, "total_bytes": 100}}
+    )
+    registry.record_cat_growth(
+        owner, {"items": {"elements": 20, "bytes": 200, "total_bytes": 300}}
+    )
+    g = registry.telemetry_for(owner, create=False).gathers
+    assert g["cat_bytes"] == 300 and g["cat_elements"] == 30
+    # EMA: first step seeds raw, second folds at EMA_ALPHA=0.1
+    assert g["ew_bytes_per_step"] == pytest.approx(0.1 * 200 + 0.9 * 100)
+    assert g["hwm_bytes"] == 300
+
+
+def test_disarm_keeps_rows_reset_clears():
+    _armed()
+    owner = _Owner()
+    registry.record_cat_growth(owner, {"items": {"elements": 1, "bytes": 8}})
+    gathers.disable_gather_telemetry()
+    g = registry.telemetry_for(owner, create=False).gathers
+    assert g["steps"] == 1  # disarm stops recording, keeps what's there
+    registry.record_cat_growth(owner, {"items": {"elements": 1, "bytes": 8}})
+    assert g["steps"] == 1
+    obs.reset_telemetry()
+    t = registry.telemetry_for(owner, create=False)
+    assert t is None or t.gathers["steps"] == 0
+
+
+# ------------------------------------- exact-figure pod projection and advice
+def test_projection_reproduces_bench_r05_map_figure(mesh):
+    """The acceptance criterion: two live steps of BENCH_r05's mAP workload
+    (85,760 unpadded cat bytes/step) project to exactly the archived
+    5,402,880 bytes/chip/step at 64 chips."""
+    _armed()
+    m, _ = _map_workload(mesh, steps=2)
+    g = registry.telemetry_for(m, create=False).gathers
+    assert g["steps"] == 2
+    assert g["cat_bytes"] == 2 * 85_760
+    assert g["ew_bytes_per_step"] == pytest.approx(85_760.0)
+    label = m.telemetry.label
+    for n_chips, want in ((8, 7 * 85_760), (16, 15 * 85_760), (64, 5_402_880)):
+        proj = project_gather_bytes(n_chips)
+        assert proj["metrics"][label]["projected_bytes_per_chip_per_step"] == want
+        assert proj["total_bytes_per_chip_per_step"] == want
+    proj64 = project_gather_bytes(64)
+    assert proj64["metrics"][label]["bytes_per_step"] == 85_760
+    # per-leaf projections sum to the metric row
+    leaves = proj64["metrics"][label]["leaves"]
+    assert sum(r["projected_bytes_per_chip_per_step"] for r in leaves.values()) == 5_402_880
+
+
+def test_advisor_names_map_sketch_first_at_64_chips(mesh):
+    _armed()
+    m, acc = _map_workload(mesh, steps=2)  # held live: telemetry stays attributed
+    advisor = GatherAdvisor(n_chips=64)
+    advice = advisor.advise()
+    top = advice["candidates"][0]
+    assert top["class"] == "MeanAveragePrecision"
+    assert top["projected_flat_bytes_per_chip_per_step"] == 5_402_880
+    assert top["recommendation"] == "sketch-first"
+    assert top["sketch_alternative"] is None  # ROADMAP open item 5
+    assert advice["kind"] == GATHER_LEDGER_KIND
+    assert f"{top['metric']}: sketch-first" in advice["recommended"]
+
+
+def test_measured_ragged_gather_buckets(mesh):
+    _armed()
+    m, acc = _map_workload(mesh, steps=1)
+    acc.compute()
+    t = registry.telemetry_for(m, create=False)
+    buckets = t.as_dict()["sync_buckets"]
+    for leaf in ("detection_boxes", "detection_scores", "groundtruth_labels", "shapes"):
+        row = buckets[f"gather/{leaf}"]
+        assert row["syncs"] == 1
+        assert row["measured_us"] > 0.0
+        assert row["model_naive_bytes"] > 0
+        # the tiled ring model never undercuts the flat (n-1)*B prediction
+        assert row["residual_bytes"] == row["model_ring_bytes"] - row["model_naive_bytes"]
+        assert row["residual_bytes"] >= 0
+    # the whole window lands in the owner's span stats too
+    assert t.as_dict()["spans"]["gather_measured"]["count"] == 1
+
+
+def test_sync_gather_bytes_counter_split(mesh):
+    """Satellite: gather-family wire traffic leaves ``sync_bytes`` and lands
+    in ``sync_gather_bytes`` — the BENCH_r05 workload's local shard is
+    21,440 B/device, so the flat 8-chip model prices 7x that."""
+    _armed()
+    m, acc = _map_workload(mesh, steps=1)
+    acc.compute()
+    counters = registry.telemetry_for(m, create=False).counters
+    assert counters["sync_gather_bytes"] == 7 * (85_760 // NUM_DEVICES)
+    # the reduce-family counter no longer double-counts the gather share
+    assert counters.get("sync_bytes", 0) < counters["sync_gather_bytes"]
+    assert "sync_gather_bytes" in registry.COUNTER_NAMES
+
+
+# ---------------------------------------------------------- advisor modelling
+def _synthetic_report():
+    return {
+        "metrics": {
+            "MeanAveragePrecision#0": {
+                "class": "MeanAveragePrecision",
+                "gathers": {
+                    "steps": 2,
+                    "cat_elements": 42_880,
+                    "cat_bytes": 171_520,
+                    "ew_bytes_per_step": 85_760.0,
+                    "hwm_bytes": 171_520,
+                    "leaves": {},
+                },
+            },
+            "ROUGEScore#0": {
+                "class": "ROUGEScore",
+                "gathers": {
+                    "steps": 4,
+                    "cat_elements": 1_536,
+                    "cat_bytes": 6_144,
+                    "ew_bytes_per_step": 1_536.0,
+                    "hwm_bytes": 6_144,
+                    "leaves": {},
+                },
+            },
+        }
+    }
+
+
+def test_advisor_ranks_and_models_both_routes():
+    advice = GatherAdvisor(n_chips=64, n_local_devices=8).advise(report=_synthetic_report())
+    assert [c["metric"] for c in advice["candidates"]] == [
+        "MeanAveragePrecision#0",
+        "ROUGEScore#0",
+    ]
+    big, small = advice["candidates"]
+    # the two-stage route crosses DCN once per host, not once per chip
+    stages = two_stage_gather_bytes(85_760, n_hosts=8, n_local_devices=8)
+    assert big["projected_flat_bytes_per_chip_per_step"] == stages["flat"] == 5_402_880
+    assert big["two_stage_dcn_bytes_per_chip_per_step"] == stages["two_stage"]
+    assert big["two_stage_cut_bytes_per_chip_per_step"] == stages["flat"] - stages["two_stage"]
+    assert big["two_stage_ici_bytes_per_chip_per_step"] == stages["ici"]
+    assert big["projected_tiled_bytes_per_chip_per_step"] == tiled_allgather_bytes(85_760, 64)
+    # a sketch cut removes the whole projected gather
+    assert big["sketch_cut_bytes_per_chip_per_step"] == 5_402_880
+    assert big["recommendation"] == "sketch-first"
+    # small consumers stay raw: two-stage already caps their DCN cost
+    assert small["projected_flat_bytes_per_chip_per_step"] == 63 * 1_536
+    assert small["recommendation"] == "two-stage"
+    assert advice["n_hosts"] == 8 and advice["n_local_devices"] == 8
+    assert advice["total_projected_flat_bytes_per_chip_per_step"] == 5_402_880 + 63 * 1_536
+
+
+def test_advisor_quotes_existing_sketch_alternatives():
+    rep = {
+        "metrics": {
+            "BinaryAUROC#0": {
+                "class": "BinaryAUROC",
+                "gathers": {"steps": 1, "cat_elements": 1 << 18, "cat_bytes": 1 << 20,
+                            "ew_bytes_per_step": float(1 << 20), "hwm_bytes": 1 << 20,
+                            "leaves": {}},
+            }
+        }
+    }
+    (cand,) = GatherAdvisor(n_chips=64).advise(report=rep)["candidates"]
+    assert "thresholds=N" in cand["sketch_alternative"]
+    for cls in ("BinaryAUROC", "MulticlassAveragePrecision", "MultilabelROC",
+                "BinaryPrecisionRecallCurve"):
+        assert "thresholds=N" in sketch_alternative_for(cls)
+    assert sketch_alternative_for("MeanAveragePrecision") is None
+    assert sketch_alternative_for("ROUGEScore") is None
+
+
+def test_advisor_ledger_exports_jsonl_parse_back():
+    advisor = GatherAdvisor(n_chips=64)
+    advisor.advise(report=_synthetic_report())
+    advisor.advise(report=_synthetic_report(), n_chips=16)
+    ledger = advisor.decision_ledger()
+    assert [e["seq"] for e in ledger] == [0, 1]
+    assert ledger[1]["n_chips"] == 16
+    buf = io.StringIO()
+    lines = advisor.export_ledger(stream=buf)
+    assert len(lines) == 2
+    for ln in buf.getvalue().strip().splitlines():
+        back = parse_export_line(ln)
+        assert back["kind"] == GATHER_LEDGER_KIND
+        assert back["schema_version"] == SCHEMA_VERSION
+        assert back["candidates"]
+
+
+# --------------------------------------------------- export & schema >= 1.10
+def test_schema_version_at_least_1_10():
+    major, minor = (int(p) for p in SCHEMA_VERSION.split(".")[:2])
+    assert major == 1 and minor >= 10
+
+
+def test_gather_report_jsonl_parse_back(mesh):
+    _armed()
+    _cat_steps(mesh, steps=2)
+    rep = gathers.gather_report()
+    assert rep["kind"] == GATHER_REPORT_KIND and rep["armed"]
+    assert set(rep["gather"]["projection"]) == {"8", "16", "64"}
+    buf = io.StringIO()
+    JSONLinesExporter(stream=buf).export(rep)
+    back = parse_export_line(buf.getvalue().strip())
+    assert back["kind"] == GATHER_REPORT_KIND
+    assert back["schema_version"] == SCHEMA_VERSION
+    label = next(iter(back["gather"]["metrics"]))
+    assert back["gather"]["metrics"][label]["cat_bytes"] == 2 * NUM_DEVICES * 3 * 4
+    assert back["gather"]["advice"]["candidates"]
+
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*"
+    r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?"
+    r" -?[0-9]+(\.[0-9]+(e[+-]?[0-9]+)?)?$"
+)
+
+
+def _lint(text):
+    helped, typed, samples = set(), set(), []
+    for ln in text.splitlines():
+        if ln.startswith("# HELP "):
+            helped.add(ln.split()[2])
+        elif ln.startswith("# TYPE "):
+            assert ln.split()[3] in ("counter", "histogram", "gauge", "summary")
+            typed.add(ln.split()[2])
+        else:
+            assert _SAMPLE_RE.match(ln), f"malformed sample line: {ln!r}"
+            assert 'process="' in ln
+            samples.append(ln)
+    assert helped == typed and helped
+    return samples
+
+
+def test_prometheus_lint_gather_families(mesh):
+    _armed()
+    _, acc = _cat_steps(mesh, steps=2)
+    acc.compute()
+    samples = _lint(PrometheusExporter().export(gathers.gather_report()))
+    names = {s.split("{")[0] for s in samples}
+    assert "tm_tpu_gather_cat_bytes_total" in names
+    assert "tm_tpu_gather_cat_ew_bytes_per_step" in names
+    assert "tm_tpu_gather_cat_hwm_bytes" in names
+    assert "tm_tpu_gather_projected_bytes_per_chip_per_step" in names
+    assert "tm_tpu_gather_advice_info" in names
+    assert "tm_tpu_gather_advice_cut_bytes_per_chip_per_step" in names
+    routes = {s for s in samples if s.startswith("tm_tpu_gather_advice_cut")}
+    assert any('route="two_stage"' in s for s in routes)
+    assert any('route="sketch"' in s for s in routes)
+
+
+def test_prometheus_sync_counters_carry_family_label(mesh):
+    """Satellite: the sync-byte families separate reduce (psum) traffic from
+    gather traffic with a ``family`` label; other counters stay label-free."""
+    _armed()
+    _, acc = _cat_steps(mesh, steps=1)
+    acc.compute()
+    samples = _lint(obs.export(fmt="prometheus"))
+    gather_lines = [s for s in samples if s.startswith("tm_tpu_sync_gather_bytes_total")]
+    assert gather_lines and all('family="gather"' in s for s in gather_lines)
+    reduce_lines = [s for s in samples if s.startswith("tm_tpu_sync_bytes_total")]
+    assert reduce_lines and all('family="reduce"' in s for s in reduce_lines)
+    update_lines = [s for s in samples if s.startswith("tm_tpu_updates_total")]
+    assert update_lines and all("family=" not in s for s in update_lines)
+
+
+# --------------------------------------------------- zero-perturbation proof
+def _ragged_flow(mesh):
+    clear_compile_cache()
+    m, acc = _cat_steps(mesh, steps=2)
+    out = acc.compute()
+    stats = cache_stats()
+    return float(out), stats["traces"], stats["misses"]
+
+
+def test_armed_gathers_adds_zero_traces_and_entries(mesh):
+    obs.enable()
+    result_off, traces_off, misses_off = _ragged_flow(mesh)
+    gathers.enable_gather_telemetry()
+    result_on, traces_on, misses_on = _ragged_flow(mesh)
+    assert traces_on == traces_off  # arming never enters a cache key
+    assert misses_on == misses_off  # and creates no new entries
+    assert result_on == result_off
+
+
+def test_armed_gathers_keeps_jaxprs_bit_identical():
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    m = MulticlassAccuracy(num_classes=5)
+    step = audit_step_fn(m, "update")
+    state = m.init_state()
+    obs.disable()
+    baseline = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    _armed()
+    armed = str(jax.make_jaxpr(step)(state, PREDS, TARGET))
+    assert armed == baseline
+
+
+# ------------------------------------------------------------ flight recorder
+def test_gather_instants_reach_flight_recorder(mesh):
+    _armed()
+    obs.tracing.start(capacity=256)
+    try:
+        m, acc = _cat_steps(mesh, steps=2)
+        acc.compute()
+        GatherAdvisor(n_chips=64).advise()
+        events = [e for e in obs.tracing.events() if e.cat == "gather"]
+    finally:
+        obs.tracing.stop()
+    assert events
+    names = {e.name for e in events}
+    label = m.telemetry.label
+    assert f"{label}/cat_growth" in names
+    assert f"{label}/measured" in names
+    assert f"{label}/advice" in names
+    growth = next(e for e in events if e.name == f"{label}/cat_growth")
+    assert growth.args["step_bytes"] == NUM_DEVICES * 3 * 4
+
+
+def test_chrome_trace_concat_keeps_gather_spans_per_process(mesh):
+    """Satellite: per-host recordings concatenate into one Perfetto timeline
+    — gather events ride the stable process_index pid with process_name
+    metadata, so a mocked second host's events stay attributed to it."""
+    _armed()
+    obs.tracing.start(capacity=256)
+    m, acc = _cat_steps(mesh, steps=1)
+    acc.compute()
+    payload0 = json.loads(json.dumps(obs.tracing.chrome_trace()))
+    obs.tracing.stop()
+    gather0 = [e for e in payload0["traceEvents"] if e.get("cat") == "gather"]
+    assert gather0 and {e["pid"] for e in gather0} == {0}
+    # mock host 1: same recording, re-stamped with its process index
+    payload1 = copy.deepcopy(payload0)
+    payload1["otherData"]["process_index"] = 1
+    for ev in payload1["traceEvents"]:
+        ev["pid"] = 1
+        if ev.get("ph") == "M" and ev["name"] == "process_name":
+            ev["args"]["name"] = "torchmetrics_tpu process 1"
+    merged = payload0["traceEvents"] + payload1["traceEvents"]
+    by_pid = {}
+    for ev in merged:
+        if ev.get("cat") == "gather":
+            by_pid.setdefault(ev["pid"], []).append(ev)
+    assert set(by_pid) == {0, 1}
+    assert len(by_pid[0]) == len(by_pid[1]) == len(gather0)
+    for pid in (0, 1):
+        procs = [
+            ev for ev in merged
+            if ev.get("ph") == "M" and ev["name"] == "process_name" and ev["pid"] == pid
+        ]
+        assert len(procs) == 1
+        assert procs[0]["args"]["name"] == f"torchmetrics_tpu process {pid}"
+
+
+# --------------------------------------------------------- CatStateBudgetRule
+def test_cat_state_budget_rule_latches_per_episode():
+    rule = CatStateBudgetRule(budget_bytes=1000, severity="critical")
+    assert rule.check("map/cat", 0, 900.0) is None
+    first = rule.check("map/cat", 1, 1500.0)
+    assert isinstance(first, Alert)
+    assert first.severity == "critical"
+    assert first.rule == "cat_state_budget"
+    assert first.details["over_bytes"] == 500.0
+    # latched: the plateau does not page again
+    assert rule.check("map/cat", 2, 1600.0) is None
+    # back under budget clears the latch; the next breach fires anew
+    assert rule.check("map/cat", 3, 800.0) is None
+    assert rule.check("map/cat", 4, 2000.0) is not None
+    # series latches are independent
+    assert rule.check("rouge/cat", 5, 1200.0) is not None
+
+
+def test_cat_state_budget_rule_rides_monitor_and_sinks():
+    seen = []
+    mon = HealthMonitor(sinks=[CallbackAlertSink(seen.append, min_severity="warning")])
+    mon.watch("map/cat", CatStateBudgetRule(budget_bytes=100))
+    mon.observe("map/cat", 50, step=0)
+    mon.observe("map/cat", 260, step=1)
+    mon.observe("map/cat", 270, step=2)
+    assert [a.step for a in seen] == [1]
+    assert seen[0].rule == "cat_state_budget"
+    with pytest.raises(ValueError):
+        CatStateBudgetRule(budget_bytes=0)
+
+
+# ------------------------------------------------------- fleet merge and skew
+def _mock_fleet(base, n=4, straggler=2, factor=5.0):
+    reports = []
+    for i in range(n):
+        r = copy.deepcopy(base)
+        r["process"] = {"index": i, "count": n}
+        if i == straggler:
+            r["global"]["counters"]["sync_gather_bytes"] = int(
+                r["global"]["counters"]["sync_gather_bytes"] * factor
+            )
+            for row in r["metrics"].values():
+                if row["counters"].get("sync_gather_bytes"):
+                    row["counters"]["sync_gather_bytes"] = int(
+                        row["counters"]["sync_gather_bytes"] * factor
+                    )
+        reports.append(r)
+    return reports
+
+
+def test_fleet_merge_sums_gather_telemetry_and_names_straggler(mesh):
+    """Satellite: a mocked 4-process fleet — gather counters and growth rows
+    sum exactly, and the gather-byte skew axis names the over-shipping host."""
+    _armed()
+    m, acc = _cat_steps(mesh, steps=2)
+    acc.compute()
+    base = registry.report()
+    label = m.telemetry.label
+    base_gather = base["global"]["counters"]["sync_gather_bytes"]
+    assert base_gather > 0
+    reports = _mock_fleet(base, n=4, straggler=2, factor=5.0)
+    view = obs.FleetView(reports)
+    merged = view.report()
+    want = sum(r["global"]["counters"]["sync_gather_bytes"] for r in reports)
+    assert merged["global"]["counters"]["sync_gather_bytes"] == want
+    # the per-metric gathers block merges cumulatively too
+    assert merged["metrics"][label]["gathers"]["cat_bytes"] == 4 * 2 * NUM_DEVICES * 3 * 4
+    assert merged["metrics"][label]["gathers"]["steps"] == 8
+    skew = view.skew()
+    assert skew["gather_bytes"]["max_process"] == 2
+    assert skew["gather_bytes"]["skew_ratio"] == pytest.approx(5.0)
+    # the reduce-byte axis is untouched by the gather inflation
+    assert skew["sync_bytes"]["skew_ratio"] == pytest.approx(1.0)
+
+
+def test_fleet_single_process_byte_identity_with_gather_rows(mesh):
+    _armed()
+    _, acc = _cat_steps(mesh, steps=1)
+    acc.compute()
+    fleet = json.dumps(obs.fleet_report(), sort_keys=True, default=str)
+    local = json.dumps(registry.report(), sort_keys=True, default=str)
+    assert fleet == local
+
+
+# --------------------------------------------- DCN passthrough measurement
+def test_coalesced_host_sync_owner_attributes_passthrough():
+    _armed()
+    owner = CatItems()
+    table = {"s": Reduce.SUM, "raw": Reduce.CAT}
+    state = {
+        "s": jnp.asarray([1.0, 2.0]),
+        "raw": jnp.asarray(np.arange(6, dtype=np.float32)),
+        "_n": jnp.asarray(3, jnp.int32),
+    }
+    plan = build_sync_plan([(table, state)])
+    assert [name for _, name, _ in plan.passthrough] == ["raw"]
+
+    def fake_allgather(flat):
+        return np.stack([np.asarray(flat), np.asarray(flat)])
+
+    out = coalesced_host_sync(
+        state, table, n_processes=2, allgather=fake_allgather, owner=owner
+    )
+    np.testing.assert_allclose(np.asarray(out["s"]), [2.0, 4.0])
+    t = registry.telemetry_for(owner, create=False)
+    row = t.as_dict()["sync_buckets"]["gather/raw"]
+    assert row["syncs"] == 1 and row["measured_us"] > 0.0
+    assert row["model_naive_bytes"] == (2 - 1) * 6 * 4
+    assert row["model_ring_bytes"] == tiled_allgather_bytes(6 * 4, 2)
+    assert t.as_dict()["spans"]["gather_measured"]["count"] == 1
+
+
+def test_coalesced_host_sync_without_owner_records_nothing():
+    _armed()
+    table = {"raw": Reduce.CAT, "_n": Reduce.SUM}
+    state = {"raw": jnp.ones((4,)), "_n": jnp.asarray(1, jnp.int32)}
+
+    def fake_allgather(flat):
+        return np.stack([np.asarray(flat), np.asarray(flat)])
+
+    coalesced_host_sync(state, table, n_processes=2, allgather=fake_allgather)
+    assert "gather/raw" not in registry.report().get("metrics", {})
+
+
+# ----------------------------------------------------- update-shape validation
+def test_update_batch_count_error_names_metric_and_devices(mesh):
+    """Satellite: the per-step batch-count check names the offending metric
+    class, its registered name, and exactly which device indices are off."""
+    acc = DeferredRaggedSync(mesh=mesh)
+    acc.register(CatItems(), "det")
+    with pytest.raises(ValueError) as too_few:
+        acc.update_for("det", [(jnp.ones((2,)),)] * 5)
+    msg = str(too_few.value)
+    assert "CatItems (registered as 'det')" in msg
+    assert "got 5 batches for 8 devices" in msg
+    assert "devices [5, 6, 7] would receive no batch" in msg
+    with pytest.raises(ValueError) as too_many:
+        acc.update_for("det", [(jnp.ones((2,)),)] * 10)
+    assert "batches [8, 9] have no device" in str(too_many.value)
+
+
+# ----------------------------------------------------------- regression gate
+def test_gather_bench_keys_gate_lower_is_better():
+    assert direction_for("gather_plane.map_gather_bytes") == "lower"
+    assert direction_for("gather_plane.measured_gather_s") == "lower"
+    assert direction_for("bench.projected_64chip_gather_bytes") == "lower"

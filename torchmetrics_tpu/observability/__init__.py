@@ -37,12 +37,16 @@ Quick tour::
                                           # error bound + provenance; shadow-
                                           # exact audits check observed error
 
+    obs.enable_gather_telemetry()         # arm the gather plane: cat-state
+    obs.gather_report()                   # growth attribution, pod-scale
+                                          # projections, GatherAdvisor advice
+
 The disabled fast path is a no-op: no compile-cache observer is registered,
 recording helpers return after one flag check, and nothing here touches
 cache keys — so telemetry can never cause a retrace.
 """
 
-from torchmetrics_tpu.observability import accuracy, fleet, health, memory, tracing
+from torchmetrics_tpu.observability import accuracy, fleet, gathers, health, memory, tracing
 from torchmetrics_tpu.observability.accuracy import (
     ShadowAuditor,
     ValueAttestation,
@@ -64,6 +68,14 @@ from torchmetrics_tpu.observability.export import (
     parse_export_line,
     parse_stats,
 )
+from torchmetrics_tpu.observability.gathers import (
+    GatherAdvisor,
+    disable_gather_telemetry,
+    enable_gather_telemetry,
+    gather_report,
+    gather_telemetry_enabled,
+    project_gather_bytes,
+)
 from torchmetrics_tpu.observability.fleet import (
     FleetView,
     fleet_report,
@@ -77,6 +89,7 @@ from torchmetrics_tpu.observability.health import (
     AlertSink,
     BoundRule,
     CallbackAlertSink,
+    CatStateBudgetRule,
     DriftRule,
     HealthMonitor,
     HealthRule,
@@ -121,11 +134,13 @@ __all__ = [
     "BoundRule",
     "COUNTER_NAMES",
     "CallbackAlertSink",
+    "CatStateBudgetRule",
     "ChromeTraceExporter",
     "DriftRule",
     "Exporter",
     "FleetView",
     "FlightRecorder",
+    "GatherAdvisor",
     "HealthMonitor",
     "HealthRule",
     "JSONLAlertSink",
@@ -156,15 +171,20 @@ __all__ = [
     "diff_report",
     "disable",
     "disable_accuracy_telemetry",
+    "disable_gather_telemetry",
     "disable_memory_telemetry",
     "enable",
     "enable_accuracy_telemetry",
+    "enable_gather_telemetry",
     "enable_memory_telemetry",
     "enabled",
     "export",
     "fleet",
     "fleet_report",
+    "gather_report",
     "gather_reports",
+    "gather_telemetry_enabled",
+    "gathers",
     "health",
     "memory",
     "memory_report",
@@ -175,6 +195,7 @@ __all__ = [
     "parse_stats",
     "process_count",
     "process_index",
+    "project_gather_bytes",
     "report",
     "reset_telemetry",
     "telemetry_for",

@@ -92,8 +92,18 @@ __all__ = [
 #: compatibility environment, per-entry ready/stale/quarantined states),
 #: three ``miss_causes`` attributions (``warmstart-hit`` /
 #: ``warmstart-stale`` / ``warmstart-corrupt``) in ``compile_cache`` blocks,
-#: and the ``warmstart`` flight-recorder category.
-SCHEMA_VERSION = "1.9.0"
+#: and the ``warmstart`` flight-recorder category; 1.10 added the
+#: gather-plane observability — the ``sync_gather_bytes`` counter splitting
+#: gather-family traffic out of ``sync_bytes`` (the sync-byte Prometheus
+#: families gained a ``family="reduce"|"gather"`` label), an optional
+#: ``gathers`` block on metric rows (per-leaf cat-state growth: elements and
+#: bytes per step, EW growth rate, high-watermark), ``gather/<leaf>``
+#: measured per-bucket rows with flat-vs-tiled byte models, ``kind:
+#: "gather_report"`` payloads from ``observability/gathers.py`` (live
+#: attribution, 8/16/64-chip projections, GatherAdvisor advice), ``kind:
+#: "gather_advice"`` JSONL ledger lines, the ``tm_tpu_gather_*`` Prometheus
+#: families, and the ``gather`` flight-recorder category.
+SCHEMA_VERSION = "1.10.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 
@@ -201,6 +211,7 @@ _COUNTER_HELP = {
     "syncs": "Cross-device/host state synchronisations.",
     "sync_bytes": "Modelled per-chip sync wire traffic in bytes (compressed when active).",
     "sync_bytes_raw": "Modelled per-chip sync traffic in bytes before compression.",
+    "sync_gather_bytes": "Modelled per-chip gather-family sync traffic in bytes (cat/ragged all-gathers, never compressed).",
     "collectives": "Fused (bucketed) collective launches.",
     "donated_installs": "Compiled state installs with buffer donation.",
     "copied_installs": "Compiled state installs without donation (aliased state).",
@@ -221,6 +232,14 @@ _COUNTER_HELP = {
     "warmstart_corrupt": "Warm-start entries refused as damaged (CRC/deserialize/dispatch).",
     "warmstart_exports": "Freshly compiled executables published to the durable store.",
     "warmstart_quarantines": "Warm-start entries quarantined (never re-read this process).",
+}
+
+#: sync-byte counters carry a collective-family label so reduce (psum) and
+#: gather traffic separate cleanly on one dashboard
+_COUNTER_FAMILY = {
+    "sync_bytes": "reduce",
+    "sync_bytes_raw": "reduce",
+    "sync_gather_bytes": "gather",
 }
 
 
@@ -358,10 +377,11 @@ class PrometheusExporter(Exporter):
             metric_name = f"{ns}_{name}_total"
             out.append(f"# HELP {metric_name} {_COUNTER_HELP.get(name, name)}")
             out.append(f"# TYPE {metric_name} counter")
+            family = _COUNTER_FAMILY.get(name)
             for label, row in sorted(rows.items()):
                 val = int(row.get("counters", {}).get(name, 0))
                 out.append(
-                    f"{metric_name}{_labels(metric=label, process=proc, **{'class': row.get('class', '')})} {val}"
+                    f"{metric_name}{_labels(metric=label, process=proc, family=family, **{'class': row.get('class', '')})} {val}"
                 )
 
         cache_name = f"{ns}_compile_cache_events_total"
@@ -670,6 +690,89 @@ class PrometheusExporter(Exporter):
                         f"{mw_name}{_labels(metric=cand.get('metric'), leaf=cand.get('leaf'), process=proc)} "
                         f"{int(cand.get('replicated_waste_bytes', 0))}"
                     )
+
+        # gather-report payloads (observability/gathers.py gather_report()):
+        # live cat-state growth, pod-scale projections, and advisor advice
+        gather = report.get("gather")
+        if isinstance(gather, Mapping) and (
+            gather.get("metrics") or gather.get("projection") or gather.get("advice")
+        ):
+            gb_name = f"{ns}_gather_cat_bytes_total"
+            out.append(
+                f"# HELP {gb_name} Cumulative unpadded cat-state bytes appended per "
+                "metric (live gather-plane attribution)."
+            )
+            out.append(f"# TYPE {gb_name} counter")
+            for label, g in sorted(gather.get("metrics", {}).items()):
+                out.append(
+                    f"{gb_name}{_labels(metric=label, process=proc)} "
+                    f"{int(g.get('cat_bytes', 0))}"
+                )
+            ge_name = f"{ns}_gather_cat_ew_bytes_per_step"
+            out.append(
+                f"# HELP {ge_name} Exponentially-weighted cat-state growth rate in "
+                "bytes per update step."
+            )
+            out.append(f"# TYPE {ge_name} gauge")
+            for label, g in sorted(gather.get("metrics", {}).items()):
+                out.append(
+                    f"{ge_name}{_labels(metric=label, process=proc)} "
+                    f"{repr(float(g.get('ew_bytes_per_step', 0.0)))}"
+                )
+            gh_name = f"{ns}_gather_cat_hwm_bytes"
+            out.append(
+                f"# HELP {gh_name} Cat-state high-watermark: the largest running "
+                "unpadded cat size observed."
+            )
+            out.append(f"# TYPE {gh_name} gauge")
+            for label, g in sorted(gather.get("metrics", {}).items()):
+                out.append(
+                    f"{gh_name}{_labels(metric=label, process=proc)} "
+                    f"{int(g.get('hwm_bytes', 0))}"
+                )
+            gp_name = f"{ns}_gather_projected_bytes_per_chip_per_step"
+            out.append(
+                f"# HELP {gp_name} Pod-scale flat all-gather projection of live "
+                "cat-state attribution, per metric and mesh size."
+            )
+            out.append(f"# TYPE {gp_name} gauge")
+            for n_chips, proj in sorted(
+                gather.get("projection", {}).items(), key=lambda kv: int(kv[0])
+            ):
+                for label, mrow in sorted(proj.get("metrics", {}).items()):
+                    out.append(
+                        f"{gp_name}{_labels(metric=label, n_chips=n_chips, process=proc)} "
+                        f"{int(mrow.get('projected_bytes_per_chip_per_step', 0))}"
+                    )
+            advice = gather.get("advice")
+            if isinstance(advice, Mapping):
+                ga_name = f"{ns}_gather_advice_info"
+                out.append(
+                    f"# HELP {ga_name} GatherAdvisor recommendation per cat-state "
+                    "consumer (info-style gauge: value is always 1, the "
+                    "recommendation rides the labels)."
+                )
+                out.append(f"# TYPE {ga_name} gauge")
+                for cand in advice.get("candidates", []):
+                    out.append(
+                        f"{ga_name}{_labels(metric=cand.get('metric'), recommendation=cand.get('recommendation'), n_chips=str(advice.get('n_chips')), process=proc)} 1"
+                    )
+                gc_name = f"{ns}_gather_advice_cut_bytes_per_chip_per_step"
+                out.append(
+                    f"# HELP {gc_name} Modelled per-chip byte cut per advisor route: "
+                    "two_stage = flat minus the DCN-exchange cost, sketch = the whole "
+                    "projected gather (a fixed-shape state rides the psum family)."
+                )
+                out.append(f"# TYPE {gc_name} gauge")
+                for cand in advice.get("candidates", []):
+                    for route, field in (
+                        ("two_stage", "two_stage_cut_bytes_per_chip_per_step"),
+                        ("sketch", "sketch_cut_bytes_per_chip_per_step"),
+                    ):
+                        out.append(
+                            f"{gc_name}{_labels(metric=cand.get('metric'), route=route, process=proc)} "
+                            f"{int(cand.get(field, 0))}"
+                        )
 
         # accuracy attestations (observability/accuracy.py): per-metric-row
         # ``attestation`` blocks on registry reports, plus the attestations /

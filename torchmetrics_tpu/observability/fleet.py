@@ -325,12 +325,13 @@ class FleetView:
 
     # ---------------------------------------------------------------- skew
     def skew(self) -> Dict[str, Any]:
-        """Per-replica imbalance: sync-wait, byte, retrace, and live-HBM skew,
-        plus the straggler process (the one that spent the most measured wall
-        time blocked in collectives)."""
+        """Per-replica imbalance: sync-wait, reduce-byte, gather-byte,
+        retrace, and live-HBM skew, plus the straggler process (the one that
+        spent the most measured wall time blocked in collectives)."""
         waits: Dict[int, float] = {}
         wait_digests: Dict[int, Dict[str, Any]] = {}
         bytes_: Dict[int, float] = {}
+        gbytes: Dict[int, float] = {}
         traces: Dict[int, float] = {}
         hbm: Dict[int, float] = {}
         observed: Dict[int, float] = {}
@@ -341,6 +342,9 @@ class FleetView:
             waits[idx] = digest["total_us"]
             bytes_[idx] = float(
                 r.get("global", {}).get("counters", {}).get("sync_bytes", 0)
+            )
+            gbytes[idx] = float(
+                r.get("global", {}).get("counters", {}).get("sync_gather_bytes", 0)
             )
             traces[idx] = float(r.get("compile_cache", {}).get("traces", 0))
             mem = r.get("global", {}).get("memory")
@@ -363,6 +367,7 @@ class FleetView:
             "n_processes": self.n_processes,
             "sync_wait_us": wait_axis,
             "sync_bytes": _axis_skew(bytes_),
+            "gather_bytes": _axis_skew(gbytes),
             "retraces": _axis_skew(traces),
             "hbm_bytes": _axis_skew(hbm),
             "observed_err": _axis_skew(observed),

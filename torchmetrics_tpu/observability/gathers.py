@@ -1,0 +1,544 @@
+"""Gather-plane observability: live cat-state attribution, pod-scale
+projection, and a report-only :class:`GatherAdvisor`.
+
+The psum family is fully instrumented (per-bucket measured timing, ring and
+two-stage byte models, residuals, ShardingAdvisor); this module does the same
+for the *gather family* — the cat/reservoir/structural leaves that
+``core.reductions.sync_leaf`` lowers to padded all-gathers and that grow
+per step instead of combining.  Three layers:
+
+1. **Live cat-state growth accounting** — every
+   :meth:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync.update_for`
+   step sizes the gather-family leaves it appended (:func:`cat_growth_rows`,
+   unpadded item bytes summed over the local mesh — the same whole-update
+   accounting ``bench.py``'s ``cat_state_bytes_per_step`` uses) and folds
+   them into the telemetry registry: per-leaf elements/bytes per step, an
+   exponentially-weighted growth rate, and the cat-state high-watermark.
+   The deferred gather itself is timed block-until-ready at the host
+   boundary and lands in per-bucket ``measured_us`` rows
+   (``registry.record_measured_gather``) exactly the way coalesced psum
+   buckets already do, with the flat ``(n-1)*B`` and granule-tiled
+   (``utilities.benchmark.tiled_allgather_bytes``) byte models alongside so
+   exporters can show the model-vs-measured residual.
+2. **Pod-scale projection** — :func:`project_gather_bytes` extrapolates the
+   live per-step attribution to 8/16/64-chip meshes with the flat all-gather
+   model.  This is how the bench reproduces BENCH_r05's mAP figure of
+   5,402,880 bytes/chip/step at 64 chips from *live* data (the gather
+   family's counterpart of the ShardingAdvisor's 33,570,840 psum-byte
+   reproduction).
+3. **Report-only advice** — :class:`GatherAdvisor` ranks cat-state consumers
+   by projected pod-scale bytes and models both escape hatches: the
+   two-stage ICI-gather→DCN-exchange route (cross-host bytes scale with
+   hosts, not chips — ``utilities.benchmark.two_stage_gather_bytes``, after
+   arxiv 2204.06514) and the sketch-mode cut (a fixed-shape state rides the
+   psum family instead; where the sketch layer already ships one — e.g.
+   AUROC's ``thresholds=N`` binned mode — the advisor quotes it by name).
+   Every ``advise()`` lands in a ledger as a ``kind: "gather_advice"`` row,
+   exportable through the JSONL front door.
+
+Everything is double-gated: :func:`enable_gather_telemetry` arms the plane,
+but nothing records until ``observability.enable()`` is also on (mirroring
+the memory and accuracy planes).  Arming adds **zero retraces and zero cache
+entries**: growth sizing reads host-side shapes the update already computed,
+and the measured gather timing wraps a collective that already runs —
+proven by the jaxpr bit-identity and ``cache_stats`` delta tests in
+``test_gathers.py``.
+
+Quick tour::
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.observability import gathers
+
+    obs.enable()
+    gathers.enable_gather_telemetry()     # or TM_TPU_GATHER_TELEMETRY=1
+    acc = DeferredRaggedSync(map_metric, mesh=mesh)
+    ...                                   # update steps are sized live
+    map_metric.telemetry.as_dict()["gathers"]   # growth rows + watermark
+    gathers.project_gather_bytes(64)      # pod-scale flat projection
+    advice = gathers.GatherAdvisor().advise()
+    advice["candidates"][0]               # biggest projected consumer
+    obs.export(gathers.gather_report(), fmt="jsonl")
+
+A cheap, device-free example (the doctest tier-1 actually runs) — two steps
+of BENCH_r05's mAP workload at 85,760 cat bytes/step project to exactly the
+archived 5,402,880 bytes/chip/step at 64 chips, and the advisor names the
+sketch route first::
+
+    >>> from torchmetrics_tpu.observability.gathers import (
+    ...     GatherAdvisor, project_gather_bytes)
+    >>> rows = {"MeanAveragePrecision#0": {
+    ...     "class": "MeanAveragePrecision",
+    ...     "gathers": {"steps": 2, "cat_elements": 13440,
+    ...                 "cat_bytes": 171520, "ew_bytes_per_step": 85760.0,
+    ...                 "hwm_bytes": 171520, "leaves": {}}}}
+    >>> proj = project_gather_bytes(64, report={"metrics": rows})
+    >>> proj["metrics"]["MeanAveragePrecision#0"]["projected_bytes_per_chip_per_step"]
+    5402880
+    >>> advice = GatherAdvisor(n_chips=64).advise(report={"metrics": rows})
+    >>> advice["candidates"][0]["recommendation"]
+    'sketch-first'
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu.observability import registry
+from torchmetrics_tpu.utilities.benchmark import (
+    RING_GRANULE_BYTES,
+    _is_psum_shaped,
+    tiled_allgather_bytes,
+    two_stage_gather_bytes,
+)
+
+__all__ = [
+    "GATHER_LEDGER_KIND",
+    "GATHER_REPORT_KIND",
+    "GatherAdvisor",
+    "SKETCH_ALTERNATIVES",
+    "cat_growth_rows",
+    "disable_gather_telemetry",
+    "enable_gather_telemetry",
+    "gather_report",
+    "gather_telemetry_enabled",
+    "project_gather_bytes",
+    "sketch_alternative_for",
+]
+
+_log = logging.getLogger("torchmetrics_tpu.observability")
+
+#: ``kind`` stamp on every advisor ledger entry (JSONL consumers filter on it
+#: exactly like ``sharding_decision`` / ``autotune_decision``)
+GATHER_LEDGER_KIND = "gather_advice"
+#: ``kind`` stamp on the front-door report payload
+GATHER_REPORT_KIND = "gather_report"
+
+#: The sketch layer's existing fixed-shape alternatives, by base metric name
+#: (Binary/Multiclass/Multilabel prefixes are stripped by
+#: :func:`sketch_alternative_for`).  Each alternative replaces an unbounded
+#: cat state with a fixed-shape state that rides the psum family — per-step
+#: gather bytes drop to zero.
+SKETCH_ALTERNATIVES: Dict[str, str] = {
+    "AUROC": (
+        "thresholds=N binned mode: fixed-shape confmat state rides the psum "
+        "family instead of gathering raw scores"
+    ),
+    "AveragePrecision": (
+        "thresholds=N binned mode: fixed-shape confmat state rides the psum "
+        "family instead of gathering raw scores"
+    ),
+    "PrecisionRecallCurve": (
+        "thresholds=N binned mode: fixed-shape confmat state rides the psum "
+        "family instead of gathering raw scores"
+    ),
+    "ROC": (
+        "thresholds=N binned mode: fixed-shape confmat state rides the psum "
+        "family instead of gathering raw scores"
+    ),
+}
+
+
+def sketch_alternative_for(cls_name: str) -> Optional[str]:
+    """The sketch layer's fixed-shape alternative for metric class
+    ``cls_name``, or ``None`` when none ships yet (mAP, ROUGE — ROADMAP
+    open item 5's sketch-backed variants)."""
+    base = cls_name
+    for prefix in ("Binary", "Multiclass", "Multilabel"):
+        if base.startswith(prefix):
+            base = base[len(prefix) :]
+            break
+    return SKETCH_ALTERNATIVES.get(base)
+
+
+# ---------------------------------------------------------------------------
+# layer 1: live cat-state growth sizing
+# ---------------------------------------------------------------------------
+
+
+def _leaf_sizes(leaf: Any) -> Tuple[int, int]:
+    """``(elements, bytes)`` of one state leaf's unpadded items — the same
+    per-item ``size * itemsize`` accounting ``split_state_bytes`` uses, so
+    live growth rows reconcile exactly with the bench's analytic tables."""
+    elements = nbytes = 0
+    for v in jax.tree.leaves(leaf):
+        size = int(getattr(v, "size", 1))
+        dtype = getattr(v, "dtype", None)
+        itemsize = int(getattr(dtype, "itemsize", 8))
+        elements += size
+        nbytes += size * itemsize
+    return elements, nbytes
+
+
+def cat_growth_rows(
+    metric: Any,
+    partial_states: Iterable[Mapping[str, Any]],
+    accumulated_states: Optional[Iterable[Mapping[str, Any]]] = None,
+) -> Dict[str, Dict[str, int]]:
+    """Size one update step's gather-family growth for ``metric``.
+
+    ``partial_states`` holds this step's freshly-updated per-device states;
+    ``accumulated_states`` (when given) the running per-device states after
+    the merge.  For every leaf in ``metric._reductions`` that syncs by
+    gather (cat/None/callable/structural — everything
+    ``_is_psum_shaped`` excludes), returns the *unpadded* appended
+    ``{"elements", "bytes"}`` summed over all devices' partials — matching
+    the whole-update ``cat_state_bytes_per_step`` accounting bench.py's
+    ``state_reduce_bytes_table`` archives — plus ``total_bytes`` (the
+    running cat size, for the high-watermark) from the accumulated states.
+
+    Pure host-side sizing: reads shapes/dtypes only, never device buffers,
+    so feeding the registry from an update loop cannot retrace anything.
+    """
+    reductions = getattr(metric, "_reductions", None) or {}
+    partials = list(partial_states)
+    accumulated = list(accumulated_states) if accumulated_states is not None else None
+    rows: Dict[str, Dict[str, int]] = {}
+    for name, reduce in sorted(reductions.items()):
+        if _is_psum_shaped(reduce):
+            continue
+        elements = nbytes = 0
+        for st in partials:
+            if name not in st:
+                continue
+            e, b = _leaf_sizes(st[name])
+            elements += e
+            nbytes += b
+        row = {"elements": elements, "bytes": nbytes}
+        if accumulated is not None:
+            total = 0
+            for st in accumulated:
+                if name in st:
+                    total += _leaf_sizes(st[name])[1]
+            row["total_bytes"] = total
+        rows[name] = row
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# arming (the second half of the double gate)
+# ---------------------------------------------------------------------------
+
+
+def enable_gather_telemetry() -> None:
+    """Arm the gather plane: live cat-state growth accounting in
+    ``DeferredRaggedSync.update`` plus block-until-ready measured timing of
+    the deferred ragged gather.
+
+    Nothing records until ``observability.enable()`` is also on.  Arming
+    changes no cache key and adds no retrace: growth sizing reads host-side
+    shapes the update already computed, and the measured timing waits on a
+    collective that already runs (the wait is observation cost at the host
+    boundary, not graph change)."""
+    registry.set_gather_armed(True)
+
+
+def disable_gather_telemetry() -> None:
+    """Disarm the gather plane.  Recorded growth rows and measured buckets
+    are kept (clear them with ``reset_telemetry()``); new steps stop being
+    sized and the gather stops being block-until-ready timed."""
+    registry.set_gather_armed(False)
+
+
+def gather_telemetry_enabled() -> bool:
+    """True while the gather plane is armed (the registry gate)."""
+    return registry.gather_armed()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: pod-scale projection
+# ---------------------------------------------------------------------------
+
+
+def _gather_rows(report: Optional[Mapping[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """``{label: {"class", "gathers"}}`` for every metric row carrying live
+    cat-growth attribution, from ``report`` (default: the live registry)."""
+    rep = report if report is not None else registry.report()
+    out: Dict[str, Dict[str, Any]] = {}
+    for label, row in rep.get("metrics", {}).items():
+        g = row.get("gathers")
+        if isinstance(g, Mapping) and int(g.get("steps", 0)) > 0:
+            out[label] = {"class": row.get("class", label), "gathers": g}
+    return out
+
+
+def project_gather_bytes(
+    n_chips: int, report: Optional[Mapping[str, Any]] = None
+) -> Dict[str, Any]:
+    """Extrapolate live cat-state attribution to an ``n_chips`` mesh with
+    the flat all-gather model: each chip receives every other chip's
+    per-step cat shard, so per-chip traffic is
+    ``(n_chips - 1) x mean bytes/step``.
+
+    ``report`` defaults to the live registry report; pass an archived one to
+    project old runs.  Under BENCH_r05's mAP workload (85,760 cat
+    bytes/step) this reproduces the archive's 5,402,880 bytes/chip/step at
+    64 chips exactly — the exact-figure contract ``test_gathers.py`` and the
+    bench's gather leg both assert.
+
+    Returns per-metric rows (mean ``bytes_per_step``, the EW growth rate,
+    per-leaf projections) plus ``total_bytes_per_chip_per_step``.
+    """
+    n = int(n_chips)
+    metrics: Dict[str, Dict[str, Any]] = {}
+    total = 0
+    for label, row in sorted(_gather_rows(report).items()):
+        g = row["gathers"]
+        steps = max(int(g["steps"]), 1)
+        bps = int(round(int(g["cat_bytes"]) / steps))
+        projected = max(n - 1, 0) * bps
+        leaves = {}
+        for name, leaf in sorted(dict(g.get("leaves", {})).items()):
+            lsteps = max(int(leaf.get("steps", steps)), 1)
+            lbps = int(round(int(leaf.get("bytes", 0)) / lsteps))
+            leaves[name] = {
+                "bytes_per_step": lbps,
+                "projected_bytes_per_chip_per_step": max(n - 1, 0) * lbps,
+            }
+        metrics[label] = {
+            "class": row["class"],
+            "steps": int(g["steps"]),
+            "bytes_per_step": bps,
+            "ew_bytes_per_step": float(g.get("ew_bytes_per_step", 0.0)),
+            "hwm_bytes": int(g.get("hwm_bytes", 0)),
+            "projected_bytes_per_chip_per_step": projected,
+            "leaves": leaves,
+        }
+        total += projected
+    return {
+        "n_chips": n,
+        "model": "flat",
+        "metrics": metrics,
+        "total_bytes_per_chip_per_step": total,
+    }
+
+
+# ---------------------------------------------------------------------------
+# layer 3: report-only advice
+# ---------------------------------------------------------------------------
+
+
+class GatherAdvisor:
+    """Report-only advisor ranking cat-state consumers by projected
+    pod-scale gather bytes.
+
+    For each metric with live cat-growth attribution, :meth:`advise`
+    projects the flat all-gather cost at ``n_chips`` (linear in chip count —
+    the MLPerf pod paper's scaling cap, arxiv 1909.09756) and models both
+    escape hatches:
+
+    * ``two_stage`` — gather over ICI inside each host, exchange one
+      aggregated copy per host over DCN
+      (``utilities.benchmark.two_stage_gather_bytes``): cross-host bytes
+      scale with hosts, not chips, an ``~n_local_devices x`` DCN cut;
+    * ``sketch`` — replace the cat leaf with a fixed-shape sketch state that
+      rides the psum family: per-step gather bytes drop to zero.  Where the
+      sketch layer already ships the alternative (AUROC / AveragePrecision /
+      ROC / PrecisionRecallCurve ``thresholds=N`` binned modes) the advisor
+      quotes it by name; for mAP/ROUGE the recommendation points at ROADMAP
+      open item 5's sketch-backed variants.
+
+    Candidates at or above ``sketch_first_bytes`` projected flat bytes are
+    recommended ``"sketch-first"`` (the two-stage route still moves every
+    byte once — only a sketch caps the linear-in-steps growth); smaller
+    consumers get ``"two-stage"``.  Advice never touches metric config:
+    actuation is ROADMAP open item 5.  Every :meth:`advise` lands in
+    :meth:`decision_ledger` as a ``kind: "gather_advice"`` row and mirrors
+    into the flight recorder's ``gather`` category when armed.
+    """
+
+    def __init__(
+        self,
+        n_chips: int = 64,
+        n_local_devices: int = 8,
+        granule: int = RING_GRANULE_BYTES,
+        sketch_first_bytes: int = 1 << 20,
+    ) -> None:
+        self.n_chips = int(n_chips)
+        #: chips per host in the projected mesh (v4-8 host granularity);
+        #: hosts = ceil(n_chips / n_local_devices)
+        self.n_local_devices = max(int(n_local_devices), 1)
+        self.granule = int(granule)
+        #: projected flat bytes/chip/step at/above this make the candidate
+        #: sketch-first: two-stage still ships every byte once per step,
+        #: only a fixed-shape sketch kills the linear-in-steps growth
+        self.sketch_first_bytes = int(sketch_first_bytes)
+        self._seq = 0
+        self._ledger: List[Dict[str, Any]] = []
+
+    def advise(
+        self,
+        report: Optional[Mapping[str, Any]] = None,
+        n_chips: Optional[int] = None,
+    ) -> Dict[str, Any]:
+        """Rank every live cat-state consumer by projected pod-scale bytes.
+
+        ``report`` defaults to the live registry report (pass an archived
+        one to re-advise old runs); ``n_chips`` defaults to the advisor's.
+        """
+        n = int(n_chips or self.n_chips)
+        n_local = min(self.n_local_devices, n)
+        n_hosts = max(1, -(-n // n_local))
+        candidates: List[Dict[str, Any]] = []
+        total_flat = total_two_stage = 0
+        for label, row in sorted(_gather_rows(report).items()):
+            g = row["gathers"]
+            steps = max(int(g["steps"]), 1)
+            bps = int(round(int(g["cat_bytes"]) / steps))
+            if bps <= 0:
+                continue
+            flat = max(n - 1, 0) * bps
+            tiled = int(tiled_allgather_bytes(bps, n, self.granule))
+            stages = two_stage_gather_bytes(bps, n_hosts, n_local, self.granule)
+            alternative = sketch_alternative_for(str(row["class"]))
+            recommendation = (
+                "sketch-first" if flat >= self.sketch_first_bytes else "two-stage"
+            )
+            candidates.append(
+                {
+                    "metric": label,
+                    "class": row["class"],
+                    "steps": int(g["steps"]),
+                    "bytes_per_step": bps,
+                    "ew_bytes_per_step": float(g.get("ew_bytes_per_step", 0.0)),
+                    "hwm_bytes": int(g.get("hwm_bytes", 0)),
+                    "projected_flat_bytes_per_chip_per_step": flat,
+                    "projected_tiled_bytes_per_chip_per_step": tiled,
+                    "two_stage_dcn_bytes_per_chip_per_step": stages["two_stage"],
+                    "two_stage_ici_bytes_per_chip_per_step": stages["ici"],
+                    "two_stage_cut_bytes_per_chip_per_step": stages["flat"]
+                    - stages["two_stage"],
+                    # a sketch state is fixed-shape psum: the whole projected
+                    # gather cost goes away, bounded-error attested
+                    "sketch_cut_bytes_per_chip_per_step": flat,
+                    "sketch_alternative": alternative,
+                    "recommendation": recommendation,
+                }
+            )
+            total_flat += flat
+            total_two_stage += stages["two_stage"]
+        candidates.sort(
+            key=lambda c: (-c["projected_flat_bytes_per_chip_per_step"], c["metric"])
+        )
+        advice = {
+            "kind": GATHER_LEDGER_KIND,
+            "seq": self._seq,
+            "n_chips": n,
+            "n_hosts": n_hosts,
+            "n_local_devices": n_local,
+            "granule_bytes": self.granule,
+            "sketch_first_bytes": self.sketch_first_bytes,
+            "total_projected_flat_bytes_per_chip_per_step": total_flat,
+            "total_two_stage_dcn_bytes_per_chip_per_step": total_two_stage,
+            "candidates": candidates,
+            "recommended": [
+                f"{c['metric']}: {c['recommendation']}" for c in candidates
+            ],
+            "note": (
+                "report-only: cat states stay raw until open item 5's "
+                "sketch-backed variants / two-stage ragged topology land; "
+                "candidates ranked by projected flat bytes/chip/step"
+            ),
+        }
+        self._seq += 1
+        self._ledger.append(advice)
+        if candidates:
+            top = candidates[0]
+            registry.gather_trace(
+                top["metric"],
+                "advice",
+                {
+                    "seq": advice["seq"],
+                    "n_chips": n,
+                    "recommendation": top["recommendation"],
+                    "projected_flat_bytes_per_chip_per_step": top[
+                        "projected_flat_bytes_per_chip_per_step"
+                    ],
+                    "candidates": len(candidates),
+                },
+            )
+        import copy
+
+        return copy.deepcopy(advice)
+
+    def decision_ledger(self) -> List[Dict[str, Any]]:
+        """Every advice payload this advisor produced, oldest first —
+        stable schema (``kind == "gather_advice"``), safe to mutate."""
+        import copy
+
+        return copy.deepcopy(self._ledger)
+
+    def export_ledger(
+        self, path: Optional[str] = None, stream: Optional[Any] = None
+    ) -> List[str]:
+        """Write the ledger through the export front door: one JSONL line
+        per advice, stamped with ``schema_version`` + process identity and
+        parseable back via ``observability.parse_export_line`` — the same
+        contract as ``ShardingAdvisor.export_ledger``."""
+        from torchmetrics_tpu.observability.export import JSONLinesExporter
+
+        exporter = JSONLinesExporter(path=path, stream=stream)
+        return [exporter.export(entry) for entry in self._ledger]
+
+
+# ---------------------------------------------------------------------------
+# the front-door report
+# ---------------------------------------------------------------------------
+
+
+def gather_report(
+    n_chips: Iterable[int] = (8, 16, 64),
+    advise_at: Optional[int] = 64,
+    report: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, Any]:
+    """One ``kind: "gather_report"`` payload tying all three layers
+    together, ready for ``observability.export`` (the JSONL line parses back
+    through ``parse_export_line``; the Prometheus exporter renders the
+    ``tm_tpu_gather_*`` families from it).
+
+    Layout::
+
+        {"schema": 1, "kind": "gather_report", "armed": bool,
+         "gather": {
+            "metrics": {label: gathers-block, ...},   # live growth rows
+            "projection": {"8": ..., "16": ..., "64": ...},
+            "advice": {...}}}                         # iff advise_at
+
+    ``n_chips`` picks the projected mesh sizes; ``advise_at`` the mesh the
+    advisor ranks against (``None`` skips advice).
+    """
+    rep = report if report is not None else registry.report()
+    payload: Dict[str, Any] = {
+        "schema": 1,
+        "kind": GATHER_REPORT_KIND,
+        "armed": gather_telemetry_enabled(),
+        "enabled": registry.enabled(),
+        "gather": {
+            "metrics": {
+                label: dict(row["gathers"])
+                for label, row in sorted(_gather_rows(rep).items())
+            },
+            "projection": {
+                str(int(n)): project_gather_bytes(int(n), report=rep)
+                for n in n_chips
+            },
+        },
+    }
+    if advise_at is not None:
+        payload["gather"]["advice"] = GatherAdvisor(n_chips=int(advise_at)).advise(
+            report=rep
+        )
+    return payload
+
+
+# honour TM_TPU_GATHER_TELEMETRY=1 the way registry honours TM_TPU_TELEMETRY
+if os.environ.get("TM_TPU_GATHER_TELEMETRY", "").strip().lower() in (
+    "1",
+    "true",
+    "on",
+    "yes",
+):  # pragma: no cover - env-driven path
+    enable_gather_telemetry()

@@ -13,6 +13,9 @@ metrics said*.  A :class:`HealthMonitor` holds per-series streaming rules —
 * :class:`AccuracyBudgetRule` — composed worst-case error bound (the armed
   accuracy plane's attested ``bound``, or a shadow audit's observed error)
   above the declared error budget,
+* :class:`CatStateBudgetRule` — cat-state bytes (the armed gather plane's
+  ``hwm_bytes`` high-watermark, or a ``project_gather_bytes`` pod-scale
+  projection) above a configured byte budget,
 * :class:`StalenessRule` — a watched series not observed for more than
   ``max_stale_steps`` steps (checked on :meth:`HealthMonitor.advance`),
 
@@ -57,6 +60,7 @@ __all__ = [
     "AlertSink",
     "BoundRule",
     "CallbackAlertSink",
+    "CatStateBudgetRule",
     "DriftRule",
     "HealthMonitor",
     "HealthRule",
@@ -485,6 +489,54 @@ class AccuracyBudgetRule(HealthRule):
             f"error bound {value:.6g} exceeds declared budget "
             f"{self.budget:.6g} by {over:.3g}",
             {"budget": self.budget, "over": over},
+        )
+
+
+class CatStateBudgetRule(HealthRule):
+    """Cat-state size (or its pod-scale projection) above ``budget_bytes``.
+
+    Feed it the gather plane's live attribution — the ``hwm_bytes``
+    high-watermark from ``metric.telemetry.as_dict()["gathers"]``, or a
+    ``project_gather_bytes(n_chips)`` per-chip projection — as the observed
+    value.  Cat states grow linearly with steps *and* with chip count
+    (BENCH_r05: mAP at 5,402,880 bytes/chip/step on 64 chips), so this is
+    the rule that pages before an eval loop gathers itself out of HBM or
+    DCN headroom.  Fires once per breach episode — the latch clears the
+    first time the series drops back to or under budget (a reset/retire
+    shrinking the cat) — same latch discipline as :class:`MemoryBudgetRule`,
+    and fleet-mergeable the same way (per-series state keys the latch).
+    """
+
+    name = "cat_state_budget"
+
+    def __init__(self, budget_bytes: int, severity: str = "warning") -> None:
+        if budget_bytes <= 0:
+            raise ValueError(
+                f"CatStateBudgetRule budget_bytes must be > 0, got {budget_bytes}"
+            )
+        self.budget_bytes = int(budget_bytes)
+        self.severity = severity
+        self._latched: Dict[str, bool] = {}
+
+    def check(self, series: str, step: int, value: float) -> Optional[Alert]:
+        if not math.isfinite(value):
+            return None  # NonFiniteRule's jurisdiction
+        if value <= self.budget_bytes:
+            self._latched[series] = False
+            return None
+        if self._latched.get(series):
+            return None
+        self._latched[series] = True
+        over = value - self.budget_bytes
+        return Alert(
+            series,
+            self.name,
+            self.severity,
+            step,
+            value,
+            f"cat-state bytes {int(value)} exceed budget "
+            f"{self.budget_bytes} by {int(over)}",
+            {"budget_bytes": self.budget_bytes, "over_bytes": over},
         )
 
 

@@ -54,9 +54,13 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "gather_armed",
+    "gather_trace",
     "memory_armed",
     "observe",
     "record_attestation",
+    "record_cat_growth",
+    "record_measured_gather",
     "record_measured_sync",
     "record_quant_error",
     "record_state_install",
@@ -68,6 +72,8 @@ __all__ = [
     "set_accuracy_armed",
     "set_accuracy_attestor",
     "set_accuracy_trace_sink",
+    "set_gather_armed",
+    "set_gather_trace_sink",
     "set_memory_armed",
     "set_memory_sizer",
     "set_memory_trace_sink",
@@ -81,9 +87,12 @@ _log = logging.getLogger("torchmetrics_tpu.observability")
 _LOCK = threading.RLock()
 
 #: Counter slots every :class:`MetricTelemetry` starts from.  ``sync_bytes``
-#: is the modelled per-chip *wire* traffic (compressed when a compression
-#: config is active), ``sync_bytes_raw`` the same model before compression
-#: (the two are equal for exact syncs); everything else is an event count.
+#: is the modelled per-chip *wire* traffic of the psum family (compressed
+#: when a compression config is active), ``sync_bytes_raw`` the same model
+#: before compression (the two are equal for exact syncs);
+#: ``sync_gather_bytes`` is the gather family's modelled per-chip wire
+#: traffic (ragged/cat-state all-gathers are never compressed, so the family
+#: has no raw twin); everything else is an event count.
 COUNTER_NAMES = (
     "updates",
     "computes",
@@ -92,6 +101,7 @@ COUNTER_NAMES = (
     "syncs",
     "sync_bytes",
     "sync_bytes_raw",
+    "sync_gather_bytes",
     "collectives",
     "donated_installs",
     "copied_installs",
@@ -200,6 +210,37 @@ def set_memory_trace_sink(sink: Optional[Callable[[str, int, int, bool], None]])
         _MEMORY_TRACE_SINK = sink
 
 
+# Gather-plane hooks (observability/gathers.py).  ``_GATHER_ARMED`` is the
+# second half of the plane's double gate: live cat-state growth attribution
+# and measured-gather rows record only while telemetry is enabled *and* the
+# gather plane is armed, so plain ``enable()`` keeps its existing cost
+# profile.  The trace sink mirrors cat-growth/measured-gather events into
+# the flight recorder's "gather" category.
+_GATHER_ARMED = False
+_GATHER_TRACE_SINK: Optional[Callable[[str, str, Dict[str, Any]], None]] = None
+
+
+def set_gather_armed(armed: bool) -> None:
+    """Arm (or disarm) live cat-state growth attribution.  Prefer the front
+    door, :func:`observability.gathers.enable_gather_telemetry`."""
+    global _GATHER_ARMED
+    with _LOCK:
+        _GATHER_ARMED = bool(armed)
+
+
+def gather_armed() -> bool:
+    return _GATHER_ARMED
+
+
+def set_gather_trace_sink(sink: Optional[Callable[[str, str, Dict[str, Any]], None]]) -> None:
+    """Install (or clear) the flight-recorder gather sink:
+    ``sink(label, event, payload)`` fires per cat-growth/measured-gather
+    event."""
+    global _GATHER_TRACE_SINK
+    with _LOCK:
+        _GATHER_TRACE_SINK = sink
+
+
 # Accuracy-plane hooks (observability/accuracy.py).  The attestor turns a
 # metric instance into a :class:`~torchmetrics_tpu.observability.accuracy.
 # ValueAttestation` from registry/policy/sketch state alone; the trace sink
@@ -303,6 +344,7 @@ class MetricTelemetry:
         "spans",
         "sync_buckets",
         "memory",
+        "gathers",
         "quorum",
         "attestation",
     )
@@ -324,6 +366,11 @@ class MetricTelemetry:
         #: live state-HBM watermarks, filled by :func:`record_state_install`
         #: while the memory plane is armed (observability/memory.py)
         self.memory: Dict[str, Any] = self._fresh_memory()
+        #: per-leaf cat-state growth attribution (schema 1.10 ``gathers``
+        #: block), filled by :func:`record_cat_growth` while the gather plane
+        #: is armed (observability/gathers.py); exported only once a step has
+        #: been recorded so unarmed reports stay byte-identical to 1.9
+        self.gathers: Dict[str, Any] = self._fresh_gathers()
         #: latest compute-time value attestation (schema 1.7 ``attestation``
         #: block), stamped by :func:`record_attestation` while the accuracy
         #: plane is armed and the value carries a nonzero bound — exact
@@ -341,6 +388,27 @@ class MetricTelemetry:
             "donated_install_bytes": 0,
             "copied_install_bytes": 0,
             "leaves": {},
+        }
+
+    @staticmethod
+    def _fresh_gathers() -> Dict[str, Any]:
+        return {
+            "steps": 0,
+            "cat_elements": 0,
+            "cat_bytes": 0,
+            "ew_bytes_per_step": 0.0,
+            "hwm_bytes": 0,
+            "leaves": {},
+        }
+
+    @staticmethod
+    def _fresh_cat_leaf() -> Dict[str, Any]:
+        return {
+            "steps": 0,
+            "elements": 0,
+            "bytes": 0,
+            "ew_bytes_per_step": 0.0,
+            "hwm_bytes": 0,
         }
 
     # -- mutation (callers hold _LOCK) -------------------------------------
@@ -423,6 +491,42 @@ class MetricTelemetry:
             mem["snapshots"] += 1
         mem["leaves"] = leaves
 
+    def record_cat_growth(self, rows: Mapping[str, Mapping[str, int]]) -> None:
+        """Fold one update step's per-leaf cat-state growth into the
+        ``gathers`` block.  ``rows`` maps leaf name to ``{"elements",
+        "bytes"}`` deltas appended this step, plus optional ``total_bytes``
+        (the leaf's running cat-state size, for the high-watermark)."""
+        g = self.gathers
+        g["steps"] += 1
+        step_bytes = 0
+        step_elements = 0
+        total_bytes = 0
+        for leaf, r in rows.items():
+            row = g["leaves"].get(leaf)
+            if row is None:
+                row = g["leaves"][leaf] = self._fresh_cat_leaf()
+            d_e = int(r.get("elements", 0))
+            d_b = int(r.get("bytes", 0))
+            row["steps"] += 1
+            row["elements"] += d_e
+            row["bytes"] += d_b
+            row["ew_bytes_per_step"] = float(d_b) if row["steps"] == 1 else (
+                EMA_ALPHA * d_b + (1.0 - EMA_ALPHA) * row["ew_bytes_per_step"]
+            )
+            tot = int(r.get("total_bytes", row["bytes"]))
+            if tot > row["hwm_bytes"]:
+                row["hwm_bytes"] = tot
+            step_bytes += d_b
+            step_elements += d_e
+            total_bytes += tot
+        g["cat_elements"] += step_elements
+        g["cat_bytes"] += step_bytes
+        g["ew_bytes_per_step"] = float(step_bytes) if g["steps"] == 1 else (
+            EMA_ALPHA * step_bytes + (1.0 - EMA_ALPHA) * g["ew_bytes_per_step"]
+        )
+        if total_bytes > g["hwm_bytes"]:
+            g["hwm_bytes"] = total_bytes
+
     def absorb(self, other: "MetricTelemetry") -> None:
         for name, n in other.counters.items():
             self.counters[name] = self.counters.get(name, 0) + n
@@ -449,6 +553,20 @@ class MetricTelemetry:
         mem["snapshots"] += om["snapshots"]
         mem["donated_install_bytes"] += om["donated_install_bytes"]
         mem["copied_install_bytes"] += om["copied_install_bytes"]
+        # A retired metric's cat state is freed, but its recorded growth and
+        # high-watermark keep their cumulative semantics.  Leaf names collide
+        # across metrics, so per-leaf rows stay with the original row.
+        og = other.gathers
+        g = self.gathers
+        if og["steps"]:
+            total = g["steps"] + og["steps"]
+            g["ew_bytes_per_step"] = (
+                g["steps"] * g["ew_bytes_per_step"] + og["steps"] * og["ew_bytes_per_step"]
+            ) / total
+            g["steps"] = total
+            g["cat_elements"] += og["cat_elements"]
+            g["cat_bytes"] += og["cat_bytes"]
+            g["hwm_bytes"] = max(g["hwm_bytes"], og["hwm_bytes"])
 
     def clear(self) -> None:
         self.counters = {name: 0 for name in COUNTER_NAMES}
@@ -456,6 +574,7 @@ class MetricTelemetry:
         self.spans = {}
         self.sync_buckets = {}
         self.memory = self._fresh_memory()
+        self.gathers = self._fresh_gathers()
         self.quorum = None
         self.attestation = None
 
@@ -468,6 +587,7 @@ class MetricTelemetry:
             or bool(self.sync_buckets)
             or self.memory["installs"] > 0
             or self.memory["snapshots"] > 0
+            or self.gathers["steps"] > 0
         )
 
     @staticmethod
@@ -501,6 +621,16 @@ class MetricTelemetry:
                     },
                 },
             }
+            # only once the gather plane recorded a step: unarmed reports
+            # stay byte-identical to 1.9 (same contract as quorum)
+            if self.gathers["steps"] > 0:
+                out["gathers"] = {
+                    **{k: v for k, v in self.gathers.items() if k != "leaves"},
+                    "leaves": {
+                        name: dict(leaf)
+                        for name, leaf in sorted(self.gathers["leaves"].items())
+                    },
+                }
             # only while degraded: healthy reports stay byte-identical to 1.5
             if self.quorum is not None:
                 out["quorum"] = dict(self.quorum)
@@ -738,39 +868,52 @@ def record_sync(
     shardings: Any = None,
 ) -> None:
     """Record one cross-device sync for ``obj``: bumps ``syncs``, adds the
-    modelled per-chip traffic to ``sync_bytes`` (compressed wire bytes when a
+    psum family's modelled per-chip traffic to ``sync_bytes`` (compressed
+    wire bytes when a
     :class:`~torchmetrics_tpu.parallel.compress.CompressionConfig` is active,
     ``utilities.benchmark.sync_bytes_per_chip`` otherwise), the uncompressed
-    model to ``sync_bytes_raw``, and the planner's fused collective count
+    psum model to ``sync_bytes_raw``, the gather family's flat all-gather
+    model (``(n-1) * local cat bytes``) to ``sync_gather_bytes``, and the
+    planner's fused collective count
     (``parallel.coalesce.bucketed_collective_count``) to ``collectives``.
     ``shardings`` prices sharded buckets at the reduce-scatter wire rate
     while ``sync_bytes_raw`` keeps the replicated model, so the two counters
-    diff into the sharding savings.  Never raises — telemetry must not
-    break a sync."""
+    diff into the sharding savings.  Gather traffic never lands in
+    ``sync_bytes``: the two families split so exporters can label them
+    ``family="reduce"`` / ``family="gather"``.  Never raises — telemetry
+    must not break a sync."""
     if not _ENABLED:
         return
     wire = 0
     raw = 0
+    gather_wire = 0
     n_collectives = 0
     try:
         from torchmetrics_tpu.parallel.coalesce import bucketed_collective_count
         from torchmetrics_tpu.utilities.benchmark import (
+            split_state_bytes,
             sync_bytes_per_chip,
             sync_wire_bytes_per_chip,
         )
 
         state = dict(state)
         table = {name: r for name, r in reductions.items() if name in state}
+        n = max(int(n_devices), 1)
+        _, gather_local = split_state_bytes(table, state)
+        gather_wire = (n - 1) * int(gather_local)
         if compression is None and not shardings:
-            wire = raw = int(sync_bytes_per_chip(table, state, int(n_devices)))
+            wire = raw = int(sync_bytes_per_chip(table, state, int(n_devices))) - gather_wire
         else:
             # same plan-based model for both, so wire/raw diff cleanly
-            wire = int(
-                sync_wire_bytes_per_chip(
-                    table, state, int(n_devices), compression, shardings=shardings
+            wire = (
+                int(
+                    sync_wire_bytes_per_chip(
+                        table, state, int(n_devices), compression, shardings=shardings
+                    )
                 )
+                - gather_wire
             )
-            raw = int(sync_wire_bytes_per_chip(table, state, int(n_devices), None))
+            raw = int(sync_wire_bytes_per_chip(table, state, int(n_devices), None)) - gather_wire
         n_collectives = int(
             bucketed_collective_count(table, state, compression, shardings=shardings)
         )
@@ -781,6 +924,7 @@ def record_sync(
         t.inc("syncs")
         t.inc("sync_bytes", wire)
         t.inc("sync_bytes_raw", raw)
+        t.inc("sync_gather_bytes", gather_wire)
         t.inc("collectives", n_collectives)
 
 
@@ -812,7 +956,11 @@ def record_measured_sync(
 
         from torchmetrics_tpu.parallel.coalesce import bucket_scatter_size, build_sync_plan
         from torchmetrics_tpu.parallel.compress import bucket_wire_bytes
-        from torchmetrics_tpu.utilities.benchmark import RING_GRANULE_BYTES, ring_reduce_bytes
+        from torchmetrics_tpu.utilities.benchmark import (
+            RING_GRANULE_BYTES,
+            ring_reduce_bytes,
+            tiled_allgather_bytes,
+        )
 
         entries = [(dict(r), dict(s)) for r, s in entries]
         plan = build_sync_plan(entries, compression=compression, shardings=shardings)
@@ -840,8 +988,12 @@ def record_measured_sync(
 
             nbytes = sum(int(v.size) * v.dtype.itemsize for v in _jax.tree.leaves(leaf))
             elems = sum(int(v.size) for v in _jax.tree.leaves(leaf))
-            gather_b = (n - 1) * nbytes  # no granule model for gathers
-            rows.append((f"gather/{name}", elems, gather_b, gather_b, gather_b, "none"))
+            # naive: flat (n-1)*B all-gather; ring: the granule-tiled model
+            # (utilities.benchmark.tiled_allgather_bytes), so the exported
+            # residual_bytes is the tiling overhead the flat model misses
+            naive_b = (n - 1) * nbytes
+            ring_b = int(tiled_allgather_bytes(nbytes, n))
+            rows.append((f"gather/{name}", elems, naive_b, ring_b, ring_b, "none"))
     except Exception:
         _log.debug("measured sync attribution failed for %r", obj, exc_info=True)
     total_ring = sum(r[3] for r in rows)
@@ -925,6 +1077,101 @@ def record_state_snapshot(obj: Any, state: Any) -> None:
         return
     with _LOCK:
         telemetry_for(obj).record_state_memory(leaves, resident, donated=False, count_install=False)
+
+
+def record_cat_growth(obj: Any, rows: Mapping[str, Mapping[str, int]]) -> None:
+    """Attribute one update step's cat-state growth to ``obj``: per-leaf
+    appended elements/bytes, the EW bytes-per-step growth rate, and the
+    cat-state high-watermark (``rows`` maps leaf name to ``{"elements",
+    "bytes"[, "total_bytes"]}`` — observability/gathers.py owns the sizing).
+
+    Double-gated like :func:`record_state_install`: a no-op unless telemetry
+    is enabled *and* the gather plane is armed
+    (:func:`observability.gathers.enable_gather_telemetry`).  Reads only
+    host-side sizes the caller already computed — never device buffers or
+    traced values — so the armed path stays off the trace and adds no
+    retraces.  Never raises."""
+    if not _ENABLED or not _GATHER_ARMED:
+        return
+    try:
+        with _LOCK:
+            t = telemetry_for(obj)
+            t.record_cat_growth(rows)
+            g = t.gathers
+            payload = {
+                "step_bytes": sum(int(r.get("bytes", 0)) for r in rows.values()),
+                "cat_bytes": int(g["cat_bytes"]),
+                "hwm_bytes": int(g["hwm_bytes"]),
+            }
+    except Exception:
+        _log.debug("cat-state growth accounting failed for %r", obj, exc_info=True)
+        return
+    sink = _GATHER_TRACE_SINK
+    if sink is not None:
+        sink(t.label, "cat_growth", payload)
+
+
+def record_measured_gather(
+    obj: Any,
+    leaf_sizes: Mapping[str, Tuple[int, int]],
+    n_devices: int,
+    seconds: float,
+) -> None:
+    """Attribute one *measured* ragged gather window (block-until-ready wall
+    time at the host boundary) to ``obj``'s per-bucket table, the way
+    :func:`record_measured_sync` already does for coalesced psum buckets.
+
+    ``leaf_sizes`` maps leaf name to ``(elements, nbytes)`` of the local
+    shard the gather shipped.  Each ``gather/<leaf>`` row gets its
+    byte-share of ``seconds`` plus both byte models — the flat ``(n-1)*B``
+    prediction and the granule-tiled ring model
+    (``utilities.benchmark.tiled_allgather_bytes``) — so exporters can show
+    the measured-vs-model residual per gather bucket.  The whole window also
+    lands in the owner's span stats as ``gather_measured``.  Same double
+    gate as :func:`record_cat_growth`.  Never raises."""
+    if not _ENABLED or not _GATHER_ARMED:
+        return
+    rows: List[Tuple[str, int, int, int]] = []
+    try:
+        from torchmetrics_tpu.utilities.benchmark import tiled_allgather_bytes
+
+        n = max(int(n_devices), 1)
+        for leaf, (elems, nbytes) in leaf_sizes.items():
+            naive_b = (n - 1) * int(nbytes)
+            ring_b = int(tiled_allgather_bytes(int(nbytes), n))
+            rows.append((f"gather/{leaf}", int(elems), naive_b, ring_b))
+    except Exception:
+        _log.debug("measured gather attribution failed for %r", obj, exc_info=True)
+    total_ring = sum(r[3] for r in rows)
+    with _LOCK:
+        t = telemetry_for(obj)
+        t.record_span("gather_measured", seconds)
+        for key, elements, naive_b, ring_b in rows:
+            if total_ring > 0:
+                share = seconds * ring_b / total_ring
+            else:  # degenerate (1 device / empty leaves): split evenly
+                share = seconds / len(rows)
+            t.record_bucket(key, elements, share, naive_b, ring_b, raw_bytes=ring_b)
+    if _SPAN_SINK is not None:
+        _SPAN_SINK(t.label, "gather_measured", seconds)
+    sink = _GATHER_TRACE_SINK
+    if sink is not None:
+        sink(
+            t.label,
+            "measured",
+            {"us": seconds * 1e6, "ring_bytes": total_ring, "leaves": len(rows)},
+        )
+
+
+def gather_trace(label: str, event: str, payload: Mapping[str, Any]) -> None:
+    """Mirror one gather-plane event (advice / projection) into the flight
+    recorder's "gather" category, when a recorder is armed.  Same double
+    gate as :func:`record_cat_growth`."""
+    if not _ENABLED or not _GATHER_ARMED:
+        return
+    sink = _GATHER_TRACE_SINK
+    if sink is not None:
+        sink(label, event, dict(payload))
 
 
 def record_quant_error(obj: Any, bucket_key: str, rel_err: float) -> None:
@@ -1041,6 +1288,23 @@ def aggregate_telemetry(parts: Iterable[Mapping[str, Any]]) -> Dict[str, Any]:
                 "copied_install_bytes",
             ):
                 am[field] += int(mem.get(field, 0))
+        # Gather blocks merge the same way: cumulative fields sum, the
+        # high-watermark keeps max semantics, the EW rate merges weighted by
+        # step count, and colliding leaf names keep leaves out of aggregates.
+        gb = part.get("gathers")
+        if gb:
+            ag = agg.gathers
+            steps = int(gb.get("steps", 0))
+            total = ag["steps"] + steps
+            if total:
+                ag["ew_bytes_per_step"] = (
+                    ag["steps"] * ag["ew_bytes_per_step"]
+                    + steps * float(gb.get("ew_bytes_per_step", 0.0))
+                ) / total
+            ag["steps"] = total
+            ag["cat_elements"] += int(gb.get("cat_elements", 0))
+            ag["cat_bytes"] += int(gb.get("cat_bytes", 0))
+            ag["hwm_bytes"] = max(ag["hwm_bytes"], int(gb.get("hwm_bytes", 0)))
     return agg.as_dict()
 
 
@@ -1144,6 +1408,18 @@ def _diff_tdict(after: Mapping[str, Any], before: Optional[Mapping[str, Any]]) -
             "copied_install_bytes": int(mem.get("copied_install_bytes", 0))
             - int(prev_mem.get("copied_install_bytes", 0)),
             "leaves": dict(mem.get("leaves", {})),
+        }
+    gb = after.get("gathers")
+    if gb is not None:
+        prev_gb = before.get("gathers", {})
+        out["gathers"] = {
+            # cumulative fields diff; the EW rate and high-watermark are
+            # point-in-time so the window keeps their end-of-window values
+            **{k: v for k, v in gb.items() if k != "leaves"},
+            "steps": int(gb.get("steps", 0)) - int(prev_gb.get("steps", 0)),
+            "cat_elements": int(gb.get("cat_elements", 0)) - int(prev_gb.get("cat_elements", 0)),
+            "cat_bytes": int(gb.get("cat_bytes", 0)) - int(prev_gb.get("cat_bytes", 0)),
+            "leaves": dict(gb.get("leaves", {})),
         }
     return out
 

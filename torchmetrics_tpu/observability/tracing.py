@@ -74,6 +74,7 @@ CATEGORIES = (
     "memory",
     "accuracy",
     "warmstart",
+    "gather",
 )
 
 DEFAULT_CAPACITY = 4096
@@ -421,6 +422,16 @@ def _accuracy_sink(label: str, event: str, payload: Mapping[str, Any]) -> None:
     rec.instant(f"{label}/{event}", "accuracy", tid=label, **payload)
 
 
+def _gather_sink(label: str, event: str, payload: Mapping[str, Any]) -> None:
+    """Registry gather hook (armed gather plane): cat-growth steps, measured
+    ragged gathers, and advisor advice become instants, so a trace shows the
+    cat state growing and the deferred gather paying for it."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    rec.instant(f"{label}/{event}", "gather", tid=label, **payload)
+
+
 def _compile_sink(record: Any) -> None:
     """Compile-cache timing hook (``core.compile.CompileRecord``)."""
     rec = _RECORDER
@@ -447,11 +458,13 @@ def _wire_sinks(arm: bool) -> None:
         _registry.set_trace_sinks(_span_sink, _count_sink)
         _registry.set_memory_trace_sink(_memory_sink)
         _registry.set_accuracy_trace_sink(_accuracy_sink)
+        _registry.set_gather_trace_sink(_gather_sink)
         _compile.add_compile_timing_observer(_compile_sink)
     else:
         _registry.set_trace_sinks(None, None)
         _registry.set_memory_trace_sink(None)
         _registry.set_accuracy_trace_sink(None)
+        _registry.set_gather_trace_sink(None)
         _compile.remove_compile_timing_observer(_compile_sink)
 
 

@@ -616,6 +616,7 @@ def coalesced_host_sync(
     n_processes: Optional[int] = None,
     allgather: Optional[Callable[[Any], Any]] = None,
     compression: Optional[CompressionConfig] = None,
+    owner: Optional[Any] = None,
 ) -> State:
     """Cross-process (DCN) sync with one ``process_allgather`` per bucket.
 
@@ -633,6 +634,14 @@ def coalesced_host_sync(
     ``n_processes``/``allgather`` are injectable for single-process testing;
     by default they resolve to ``jax.process_count()`` and
     ``multihost_utils.process_allgather``.
+
+    ``owner`` (a metric, optional) attributes the *passthrough* leg — the
+    gather-family leaves that cross DCN raw instead of reducing — to that
+    metric's telemetry: while the gather plane is armed
+    (``observability.gathers.enable_gather_telemetry``) the passthrough loop
+    is timed block-until-ready and lands in per-bucket ``gather/<leaf>``
+    ``measured_us`` rows with the flat and granule-tiled byte models, the
+    same contract as the deferred ragged gather's measurement hook.
     """
     plan = build_sync_plan([(reductions, state)], compression=compression)  # validates leaf names
     n_proc = jax.process_count() if n_processes is None else int(n_processes)
@@ -670,8 +679,29 @@ def coalesced_host_sync(
                 seg = seg / n_proc
             out[s.name] = seg
             offset += s.size
-    for _, name, reduce in plan.passthrough:
-        out[name] = host_sync_leaf(reduce, state[name])
+    if plan.passthrough:
+        from torchmetrics_tpu.observability import registry as _telemetry
+
+        measuring = (
+            owner is not None and _telemetry.enabled() and _telemetry.gather_armed()
+        )
+        t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured DCN gather cost at the host boundary; outside any traced graph
+        for _, name, reduce in plan.passthrough:
+            out[name] = host_sync_leaf(reduce, state[name])
+        if measuring:
+            jax.block_until_ready({name: out[name] for _, name, _ in plan.passthrough})
+            measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured DCN gather cost at the host boundary; outside any traced graph
+            leaf_sizes = {}
+            for _, name, _ in plan.passthrough:
+                elems = nbytes = 0
+                for v in jax.tree.leaves(state[name]):
+                    elems += int(getattr(v, "size", 1))
+                    nbytes += int(getattr(v, "size", 1)) * int(
+                        getattr(getattr(v, "dtype", None), "itemsize", 8)
+                    )
+                leaf_sizes[name] = (elems, nbytes)
+            _telemetry.record_measured_gather(owner, leaf_sizes, n_proc, measured_s)
+            _telemetry.record_sync_wait(measured_s)
     return out
 
 

@@ -37,6 +37,7 @@ Example::
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import jax
@@ -325,11 +326,37 @@ def sync_ragged_states(
 
     scalar_reduces = tuple(sorted(((n, reductions[n]) for n in scalar_names), key=lambda kv: kv[0]))
     fn = compiled_ragged_gather(mesh, axis_name, scalar_reduces, tuple(sorted(flats_jnp)), owner=owner)
+    # while the gather plane is armed, block inside the span so the measured
+    # window covers the collective itself (the way SyncStepper's psum windows
+    # already measure), then land per-leaf gather/<leaf> measured_us rows
+    measuring = _telemetry.enabled() and _telemetry.gather_armed()
+    t0 = time.perf_counter() if measuring else 0.0  # tmt: ignore[TMT006] -- measured gather cost at the host boundary; outside any traced graph
     with _telemetry.span(owner, "sync"):
         g_scalars, g_n, g_flats = fn(scalar_stacks, n_stack, flats_jnp)
+        if measuring:
+            jax.block_until_ready((g_scalars, g_n, g_flats))
     # `owner=None` lands the sync in the `_unattributed` telemetry row rather
     # than double-counting against a metric some outer caller already credits
     _telemetry.record_sync(owner, reductions, dict(per_device_states[0]), n_dev)
+    if measuring:
+        measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured gather cost at the host boundary; outside any traced graph
+        # one row per ragged leaf, sized at its per-chip padded wire block
+        # (what the tiled all_gather actually ships), plus the shared shape
+        # table — keys match record_measured_sync's gather/<leaf> rows
+        leaf_sizes: Dict[str, Tuple[int, int]] = {
+            name: (
+                block_size[name],
+                block_size[name] * packed[name][0].dtype.itemsize,
+            )
+            for name in sorted_ragged
+        }
+        if sorted_ragged:
+            tab = sum(shape_block[nm] for nm in sorted_ragged)
+            leaf_sizes["shapes"] = (tab, tab * 4)
+        _telemetry.record_measured_gather(owner, leaf_sizes, n_dev, measured_s)
+        # same window, process-wide: the fleet plane's straggler
+        # attribution compares this digest across hosts
+        _telemetry.record_sync_wait(measured_s)
 
     # ---- carve each name's per-device blocks back out of the gathered flats
     g_host = {key: np.asarray(v) for key, v in g_flats.items()}
@@ -539,12 +566,18 @@ class DeferredRaggedSync:
             raise KeyError(f"no metric registered under {name!r} (have {sorted(self._members)})")
         # validated on EVERY step: the merge below zips against the running
         # per-device states, and a silent zip-truncation would drop data
-        if len(per_device_batches) != int(self.mesh.devices.size):
-            raise ValueError(
-                f"need one batch per mesh device: got {len(per_device_batches)} for "
-                f"{int(self.mesh.devices.size)} devices"
-            )
         m = self._members[name]
+        n_dev = int(self.mesh.devices.size)
+        got = len(per_device_batches)
+        if got != n_dev:
+            if got < n_dev:
+                detail = f"devices {list(range(got, n_dev))} would receive no batch"
+            else:
+                detail = f"batches {list(range(n_dev, got))} have no device"
+            raise ValueError(
+                f"{type(m).__name__} (registered as {name!r}) needs one batch per mesh "
+                f"device: got {got} batches for {n_dev} devices — {detail}"
+            )
         partial = [m.update_state(m.init_state(), *batch) for batch in per_device_batches]
         if self._per_device[name] is None:
             self._per_device[name] = partial
@@ -552,6 +585,16 @@ class DeferredRaggedSync:
             self._per_device[name] = [
                 m.merge_states(acc, new) for acc, new in zip(self._per_device[name], partial)
             ]
+        if _telemetry.enabled() and _telemetry.gather_armed():
+            # live cat-state attribution: this step's appended elements/bytes
+            # per gather-family leaf (summed over the local mesh — matching
+            # the bench's whole-update cat_state_bytes_per_step accounting)
+            # plus the running totals for the high-watermark
+            from torchmetrics_tpu.observability.gathers import cat_growth_rows
+
+            _telemetry.record_cat_growth(
+                m, cat_growth_rows(m, partial, self._per_device[name])
+            )
 
     def sync(self) -> Union[State, Dict[str, State]]:
         """The one deferred collective: pad-gather-trim every accumulated

@@ -25,13 +25,17 @@ __all__ = [
     "cache_stats_delta",
     "coalesced_sync_bytes_per_chip",
     "collectives_per_sync",
+    "gather_wire_bytes_per_chip",
     "per_leaf_sync_bytes_per_chip",
     "reduce_scatter_bytes",
     "ring_reduce_bytes",
+    "split_state_bytes",
     "state_bytes",
     "sync_bytes_per_chip",
     "sync_wire_bytes_per_chip",
+    "tiled_allgather_bytes",
     "two_stage_dcn_bytes",
+    "two_stage_gather_bytes",
 ]
 
 
@@ -302,6 +306,80 @@ def two_stage_dcn_bytes(
     return {
         "flat": int(n_local_devices * per_host_ring),
         "two_stage": int(per_host_ring),
+    }
+
+
+def tiled_allgather_bytes(
+    buffer_bytes: int, n_devices: int, granule: int = RING_GRANULE_BYTES
+) -> int:
+    """Granule-aware per-chip traffic of ONE ring all-gather of a
+    ``buffer_bytes`` local shard: ``(n-1) * ceil(B / granule) * granule``.
+
+    A ring all-gather forwards each of the ``n-1`` foreign shards once, and
+    real interconnects ship each shard in granule-sized tiles — so a tiny
+    ragged carry still pays a full tile per hop.  Reduces to the flat
+    ``(n-1) * B`` as ``B >> granule``; this is the gather family's
+    counterpart of :func:`ring_reduce_bytes` (which models the psum family).
+    """
+    if n_devices <= 1 or buffer_bytes <= 0:
+        return 0
+    tile = math.ceil(buffer_bytes / granule) * granule
+    return int((n_devices - 1) * tile)
+
+
+def gather_wire_bytes_per_chip(
+    reductions: Dict[str, Any],
+    state: Dict[str, Any],
+    n_devices: int,
+    granule: int = RING_GRANULE_BYTES,
+) -> int:
+    """Granule-tiled per-chip traffic of the *gather family* of one sync:
+    one ring all-gather per cat/None/callable leaf (each paying its own tile
+    floor, :func:`tiled_allgather_bytes`); psum-family leaves contribute
+    nothing here (they are priced by :func:`sync_bytes_per_chip` /
+    :func:`ring_reduce_bytes`)."""
+    total = 0
+    for name, reduce in reductions.items():
+        if _is_psum_shaped(reduce):
+            continue
+        leaf = state[name]
+        nbytes = sum(int(v.size) * v.dtype.itemsize for v in jax.tree.leaves(leaf))
+        total += tiled_allgather_bytes(nbytes, n_devices, granule)
+    return int(total)
+
+
+def two_stage_gather_bytes(
+    buffer_bytes: int,
+    n_hosts: int,
+    n_local_devices: int,
+    granule: int = RING_GRANULE_BYTES,
+) -> Dict[str, int]:
+    """Cross-host (DCN) traffic model of one ragged all-gather of a per-chip
+    ``buffer_bytes`` cat shard over an ``(n_hosts, n_local_devices)`` mesh:
+    ``flat`` gathers over all ``n_hosts * n_local_devices`` participants in
+    one ring whose inter-host hops carry every foreign shard — per chip,
+    ``(n-1)`` tiles cross DCN — vs ``two_stage`` which all-gathers over ICI
+    inside each host first, then exchanges ONE aggregated copy per host over
+    DCN, so each chip's amortized DCN share is ``(n_hosts - 1)`` tiles: an
+    ``~n_local_devices x`` cut (cross-host bytes scale with hosts, not
+    chips — arxiv 2204.06514's topology-aware collective layout applied to
+    the gather family).  Unlike the psum family's
+    :func:`two_stage_dcn_bytes`, nothing reduces: every byte is distinct, so
+    the cut comes purely from moving the fan-out onto ICI.  ``ici`` reports
+    the ICI bytes the two-stage route pays per chip (the local gather plus
+    redistribution of the foreign hosts' aggregates)."""
+    n = int(n_hosts) * int(n_local_devices)
+    if n <= 1 or buffer_bytes <= 0:
+        return {"flat": 0, "two_stage": 0, "ici": 0}
+    tile = math.ceil(buffer_bytes / granule) * granule
+    if n_hosts <= 1:  # single host: everything rides ICI
+        return {"flat": 0, "two_stage": 0, "ici": int((n - 1) * tile)}
+    return {
+        "flat": int((n - 1) * tile),
+        "two_stage": int((n_hosts - 1) * tile),
+        "ici": int(
+            (n_local_devices - 1) * tile + (n_hosts - 1) * n_local_devices * tile
+        ),
     }
 
 

@@ -80,6 +80,8 @@ _LOWER_BETTER = (
     "_sync_s",  # autotune-leg sync wall times (naive/hand-tuned/autotuned)
     "_ckpt_s",  # durable checkpoint save/restore wall times (commit protocol + verified read)
     "_start_s",  # warm-start leg time-to-first-step (cold_start_s / warm_start_s)
+    "_gather_bytes",  # gather-leg modelled/projected cat-state traffic (subsumed by
+    "_gather_s",  # _bytes; listed with _gather_s so the gate survives a _bytes edit)
 )
 #: keys where a HIGHER value is better (gate on decreases)
 _HIGHER_BETTER = ("cut", "speedup", "drop_pct", "fused_to", "prometheus_lines")
