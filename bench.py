@@ -1773,6 +1773,183 @@ def gathers_bench_child():
     print(json.dumps(out))
 
 
+def catstate_bench_child():
+    """Pod-scale cat-state killer leg on an 8-virtual-device CPU mesh.
+
+    Runs BENCH_r05's mAP workload three ways and proves the escape hatches
+    actually kill the 64-chip cat-state figure:
+
+    * **exact route** — reproduces the archived 5,402,880 bytes/chip/step
+      flat projection (the number being killed);
+    * **sketch route** — ``MeanAveragePrecision(approx="sketch")``: psum-only
+      states project ZERO gather bytes at any chip count (>= 10x cut,
+      asserted) and the |sketch - exact| mAP error sits within the attested
+      bound;
+    * **two-stage route** — modeled DCN bytes scale with hosts, not chips
+      (asserted at 8 vs 16 hosts);
+
+    then drives the loop end to end: ``GatherAdvisor.recommend(apply=True)``
+    commits mAP to sketch, the ``gather_decision`` ledger records
+    propose→arm→commit, the measured post-commit growth is zero, and the
+    retrace audit proves the conversion cost at most its one expected
+    new-key compile — 0 steady-state retraces.
+    """
+    import numpy as np
+
+    import jax as _jax
+    from jax.sharding import Mesh
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.core import compile as _compile
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.observability import registry
+    from torchmetrics_tpu.observability.gathers import GATHER_DECISION_KIND, GatherAdvisor
+    from torchmetrics_tpu.parallel.ragged import DeferredRaggedSync
+    from torchmetrics_tpu.utilities.benchmark import two_stage_gather_bytes
+
+    n_dev = 8
+    devices = _jax.devices()
+    assert len(devices) >= n_dev, f"child expected {n_dev} virtual devices, got {len(devices)}"
+    mesh = Mesh(np.asarray(devices[:n_dev]).reshape(n_dev), ("data",))
+
+    def map_batch(rng, k=4):
+        preds = [
+            {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (100, 4)), jnp.float32),
+                "scores": jnp.asarray(rng.uniform(0, 1, (100,)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (100,))),
+            }
+            for _ in range(k)
+        ]
+        target = [
+            {
+                "boxes": jnp.asarray(rng.uniform(0, 200, (10, 4)), jnp.float32),
+                "labels": jnp.asarray(rng.integers(0, 80, (10,))),
+            }
+            for _ in range(k)
+        ]
+        return preds, target
+
+    obs.enable()
+    obs.enable_gather_telemetry()
+
+    # --- exact route: the archived figure being killed
+    rng = np.random.default_rng(0)
+    m_exact = MeanAveragePrecision()
+    acc = DeferredRaggedSync(m_exact, mesh=mesh)
+    steps = 2
+    for _ in range(steps):
+        acc.update([map_batch(rng) for _ in range(n_dev)])
+    exact_values = acc.compute()
+    exact_proj64 = obs.project_gather_bytes(64)["total_bytes_per_chip_per_step"]
+    assert exact_proj64 == 5_402_880, f"BENCH_r05 64-chip figure drifted: {exact_proj64}"
+
+    # --- two-stage route model: DCN bytes scale with hosts, not chips
+    g = registry.telemetry_for(m_exact, create=False).gathers
+    bps = int(round(int(g["cat_bytes"]) / max(int(g["steps"]), 1)))
+    dcn_8hosts = two_stage_gather_bytes(bps, 8, 8)["two_stage"]
+    dcn_16hosts = two_stage_gather_bytes(bps, 16, 8)["two_stage"]
+    assert dcn_8hosts == 7 * (dcn_16hosts // 15), "DCN share stopped scaling with hosts"
+
+    # --- sketch route: same data, psum-only states, bounded error
+    rng = np.random.default_rng(0)
+    m_sketch = MeanAveragePrecision(approx="sketch")
+    acc_sketch = DeferredRaggedSync(m_sketch, mesh=mesh)
+    for _ in range(steps):
+        acc_sketch.update([map_batch(rng) for _ in range(n_dev)])
+    sketch_values = acc_sketch.compute()
+    g_sketch = registry.telemetry_for(m_sketch, create=False).gathers
+    sketch_bps = int(round(int(g_sketch["cat_bytes"]) / max(int(g_sketch["steps"]), 1)))
+    sketch_proj64 = max(64 - 1, 0) * sketch_bps
+    map_err = abs(float(sketch_values["map"]) - float(exact_values["map"]))
+    bound = float(m_sketch._gather_approx_provenance()["bound"])
+    assert map_err <= bound + 1e-6, f"sketch mAP error {map_err} breaches attested bound {bound}"
+
+    # non-degenerate value parity: half the detections overlap their targets,
+    # so mAP is well off zero and the attested bound does real work
+    rng_v = np.random.default_rng(3)
+    m_exact_v = MeanAveragePrecision()
+    m_sketch_v = MeanAveragePrecision(approx="sketch")
+    for _ in range(3):
+        tboxes = rng_v.uniform(0, 180, (12, 4)).astype("float32")
+        tboxes[:, 2:] = tboxes[:, :2] + 20
+        tlabels = rng_v.integers(0, 5, (12,))
+        pboxes = np.concatenate([tboxes[:6] + rng_v.uniform(-2, 2, (6, 4)), rng_v.uniform(0, 200, (18, 4))])
+        preds_v = [{
+            "boxes": jnp.asarray(pboxes, jnp.float32),
+            "scores": jnp.asarray(rng_v.uniform(0.2, 1, (24,)), jnp.float32),
+            "labels": jnp.asarray(np.concatenate([tlabels[:6], rng_v.integers(0, 5, (18,))])),
+        }]
+        target_v = [{"boxes": jnp.asarray(tboxes, jnp.float32), "labels": jnp.asarray(tlabels)}]
+        m_exact_v.update(preds_v, target_v)
+        m_sketch_v.update(preds_v, target_v)
+    map_exact_v = float(m_exact_v.compute()["map"])
+    map_sketch_v = float(m_sketch_v.compute()["map"])
+    err_v = abs(map_sketch_v - map_exact_v)
+    bound_v = float(m_sketch_v._gather_approx_provenance()["bound"])
+    assert map_exact_v > 0.05, f"parity workload degenerate: exact mAP {map_exact_v}"
+    assert err_v <= bound_v + 1e-6, f"sketch mAP error {err_v} breaches attested bound {bound_v}"
+    # the acceptance bar: strictly below the archived figure, >= 10x cut
+    assert sketch_proj64 < exact_proj64, "sketch route did not cut the 64-chip figure"
+    assert sketch_proj64 * 10 <= exact_proj64, "sketch route cut is under 10x"
+
+    # --- actuation: advisor converts the exact metric, audited end to end
+    advisor = GatherAdvisor(n_chips=64)
+    out = advisor.recommend([m_exact], apply=True, accumulator=acc)
+    assert advisor.state == "committed" and out["actuation"]["applied"]
+    rng_post = np.random.default_rng(1)
+    # first post-commit crossing absorbs the conversion's one expected
+    # new-key compile ...
+    acc.update([map_batch(rng_post) for _ in range(n_dev)])
+    acc.compute()
+    audit = advisor.retrace_report()
+    # ... then steady state must re-trace zero times
+    steady_base = _compile.cache_stats()
+    acc.update([map_batch(rng_post) for _ in range(n_dev)])
+    acc.compute()
+    steady = _compile.cache_stats_since(steady_base)
+    advice = advisor.advise()
+    (commit_label,) = advice["commits"]
+    cut = advice["commits"][commit_label]
+    decisions = [
+        e["action"] for e in advisor.decision_ledger() if e["kind"] == GATHER_DECISION_KIND
+    ]
+
+    out = {
+        "workload": "BENCH_r05 mAP: 8 dev x 4 img/step, 100 det/img, 2 steps/route",
+        "exact_64chip_gather_bytes": exact_proj64,
+        "sketch_64chip_gather_bytes": sketch_proj64,
+        "sketch_cut_x": round(exact_proj64 / max(sketch_proj64, 1), 1)
+        if sketch_proj64
+        else 64 * 1000.0,
+        "sketch_cut_at_least_10x": bool(sketch_proj64 * 10 <= exact_proj64),
+        "two_stage_dcn_8hosts_gather_bytes": dcn_8hosts,
+        "two_stage_dcn_16hosts_gather_bytes": dcn_16hosts,
+        "dcn_scales_with_hosts": bool(dcn_8hosts == 7 * (dcn_16hosts // 15)),
+        "map_exact": round(map_exact_v, 6),
+        "map_sketch": round(map_sketch_v, 6),
+        "map_sketch_err": round(err_v, 6),
+        "map_sketch_bound": round(bound_v, 6),
+        "sketch_within_bound": bool(err_v <= bound_v + 1e-6 and map_err <= bound + 1e-6),
+        "actuation": {
+            "decisions": decisions,
+            "committed": cut["action"],
+            "measured_cut": bool(cut["measured"]),
+            "post_commit_gather_bytes_per_step": int(cut["post_bytes_per_step"] or 0),
+            "measured_cut_bytes_per_step": int(cut["cut_bytes_per_step"] or 0),
+            "retrace_audit_ok": bool(audit["ok"]),
+            "expected_new_keys": audit["expected"]["new_keys"],
+            "extra_misses": audit["extra_misses"],
+            "steady_state_retraces": int(steady["traces"]),
+            "zero_steady_state_retraces": bool(steady["traces"] == 0),
+        },
+    }
+    assert out["actuation"]["post_commit_gather_bytes_per_step"] == 0
+    assert out["actuation"]["retrace_audit_ok"]
+    assert out["actuation"]["zero_steady_state_retraces"]
+    print(json.dumps(out))
+
+
 def _run_cpu_mesh_child(mode, timeout_s, extra_env=None):
     """Spawn this script as an 8-virtual-device CPU child in ``mode`` and
     return its last-stdout-line JSON (or an error record — the bench must not
@@ -1902,6 +2079,17 @@ def measured_gathers():
     regression-gated lower-better."""
     return _run_cpu_mesh_child(
         "gathers", float(os.environ.get("BENCH_GATHER_TIMEOUT", 300))
+    )
+
+
+def measured_catstate():
+    """Cat-state killer leg: sketch-route 64-chip projection (>= 10x under
+    the archived 5,402,880 exact figure), sketch-mAP error vs its attested
+    bound, host-scaled two-stage DCN model, and the GatherAdvisor
+    commit→ledger→retrace-audit loop with 0 steady-state retraces —
+    ``*_gather_bytes`` keys are regression-gated lower-better."""
+    return _run_cpu_mesh_child(
+        "catstate", float(os.environ.get("BENCH_CATSTATE_TIMEOUT", 300))
     )
 
 
@@ -2570,6 +2758,7 @@ def main():
     sharding_measured = measured_sharding()
     warmstart_measured = measured_warmstart()
     gathers_measured = measured_gathers()
+    catstate_measured = measured_catstate()
     try:
         donation = donation_leg()
     except Exception as err:  # noqa: BLE001 — diagnostic record, never fatal
@@ -2629,6 +2818,7 @@ def main():
             "sharded_state": sharding_measured,
             "warmstart": warmstart_measured,
             "gather_plane": gathers_measured,
+            "catstate": catstate_measured,
             "donation": donation,
             "kernel_vs_reference": kernel_ref,
             "resilience": resilience,
@@ -2768,6 +2958,8 @@ if __name__ == "__main__":
         warmstart_bench_child()
     elif os.environ.get("BENCH_CHILD_MODE") == "gathers":
         gathers_bench_child()
+    elif os.environ.get("BENCH_CHILD_MODE") == "catstate":
+        catstate_bench_child()
     elif "--check-regressions" in _sys.argv[1:]:
         check_regressions_cli()
     else:

@@ -1,0 +1,225 @@
+"""Cat-state killers walkthrough: bounded states, two-stage gathers, actuation.
+
+The gather-observability walkthrough ends with the advisor *naming* mAP
+sketch-first at 64 chips.  This one makes the advisor *do* it.  In order:
+
+1. the exact route — BENCH_r05's mAP workload reproduces the archived
+   5,402,880 gather bytes/chip/step flat projection at 64 chips, the number
+   being killed;
+2. sketch-backed mAP — ``MeanAveragePrecision(approx="sketch")`` swaps the
+   unbounded score/label cat states for fixed-shape psum histograms: ZERO
+   projected gather bytes at any chip count, and |sketch - exact| mAP error
+   inside the attested bound the histogram occupancy stamps into the
+   accuracy plane;
+3. reservoir text corpora — ``ROUGEScore(approx="reservoir",
+   sample_size=k)`` keeps a
+   deterministic bottom-k-by-hash corpus sample: exact below capacity
+   (bound 0), bounded by the discarded fraction past it;
+4. the two-stage ICI->DCN route — modeled cross-host bytes scale with
+   hosts, not chips, and flipping ``DeferredRaggedSync.set_route`` compiles
+   nothing;
+5. actuation — ``GatherAdvisor.recommend(apply=True)`` commits the exact
+   mAP metric to sketch at 64 chips, the ``gather_decision`` ledger records
+   propose -> arm -> commit, the next ``advise()`` quotes the *measured*
+   post-commit cut, and ``retrace_report()`` audits the compile-cache delta
+   down to the one expected new key.
+
+Run on anything: ``python examples/catstate_killers_walkthrough.py``
+(CPU ok; the workload is BENCH_r05's mAP shapes on an 8-device host mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# runnable straight from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.core.compile import cache_stats, cache_stats_since
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.observability.gathers import GATHER_DECISION_KIND, GatherAdvisor
+from torchmetrics_tpu.parallel.ragged import DeferredRaggedSync
+from torchmetrics_tpu.text.rouge import ROUGEScore
+from torchmetrics_tpu.utilities.benchmark import two_stage_gather_bytes
+
+N_DEV = 8
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} " + "=" * max(0, 60 - len(title)))
+
+
+def map_batch(rng: np.random.Generator, k: int = 4):
+    """One device's batch of BENCH_r05's mAP workload: ``k`` images with 100
+    predicted and 10 ground-truth boxes each."""
+    preds = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (100, 4)), jnp.float32),
+            "scores": jnp.asarray(rng.uniform(0, 1, (100,)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (100,))),
+        }
+        for _ in range(k)
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (10, 4)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 80, (10,))),
+        }
+        for _ in range(k)
+    ]
+    return preds, target
+
+
+def main() -> None:
+    mesh = Mesh(np.asarray(jax.devices()[:N_DEV]), ("data",))
+    obs.enable()
+    obs.enable_gather_telemetry()
+
+    # ------------------------------------------------------------------ 1
+    banner("1. the exact route: the figure being killed")
+    rng = np.random.default_rng(0)
+    m_exact = MeanAveragePrecision()
+    acc = DeferredRaggedSync(m_exact, mesh=mesh)
+    for _ in range(2):
+        acc.update([map_batch(rng) for _ in range(N_DEV)])
+    acc.compute()
+    proj64 = obs.project_gather_bytes(64)["total_bytes_per_chip_per_step"]
+    assert proj64 == 5_402_880
+    print(f"flat all-gather at 64 chips: {proj64:,} B/chip/step — "
+          "and the cat states keep growing every step")
+
+    # ------------------------------------------------------------------ 2
+    banner("2. sketch-backed mAP: psum-only, bounded error")
+    rng = np.random.default_rng(0)  # same data
+    m_sketch = MeanAveragePrecision(approx="sketch")
+    acc_sketch = DeferredRaggedSync(m_sketch, mesh=mesh)
+    for _ in range(2):
+        acc_sketch.update([map_batch(rng) for _ in range(N_DEV)])
+    acc_sketch.compute()
+    g = m_sketch.telemetry.as_dict()["gathers"]
+    print(f"cat-state growth rows: {g['cat_bytes']} B (fixed-shape states "
+          "ride the psum family — the TMT013 SketchMAPSync golden pins a "
+          "psum-only sync)")
+    print(f"projected gather bytes at 64 chips: 0 (was {proj64:,})")
+
+    # value parity on a workload where mAP is well off zero: half the
+    # detections overlap their targets
+    rng_v = np.random.default_rng(3)
+    m_exact_v, m_sketch_v = MeanAveragePrecision(), MeanAveragePrecision(approx="sketch")
+    for _ in range(3):
+        tboxes = rng_v.uniform(0, 180, (12, 4)).astype("float32")
+        tboxes[:, 2:] = tboxes[:, :2] + 20
+        tlabels = rng_v.integers(0, 5, (12,))
+        pboxes = np.concatenate(
+            [tboxes[:6] + rng_v.uniform(-2, 2, (6, 4)), rng_v.uniform(0, 200, (18, 4))]
+        )
+        preds_v = [{
+            "boxes": jnp.asarray(pboxes, jnp.float32),
+            "scores": jnp.asarray(rng_v.uniform(0.2, 1, (24,)), jnp.float32),
+            "labels": jnp.asarray(np.concatenate([tlabels[:6], rng_v.integers(0, 5, (18,))])),
+        }]
+        target_v = [{"boxes": jnp.asarray(tboxes, jnp.float32), "labels": jnp.asarray(tlabels)}]
+        m_exact_v.update(preds_v, target_v)
+        m_sketch_v.update(preds_v, target_v)
+    map_exact = float(m_exact_v.compute()["map"])
+    map_sketch = float(m_sketch_v.compute()["map"])
+    err = abs(map_sketch - map_exact)
+    prov = m_sketch_v._gather_approx_provenance()
+    print(f"mAP exact {map_exact:.4f} vs sketch {map_sketch:.4f}: "
+          f"|err| = {err:.6f} <= attested bound {float(prov['bound']):.6f} "
+          f"(provenance kind {prov['kind']!r})")
+    assert map_exact > 0.05 and err <= float(prov["bound"]) + 1e-6
+
+    # ------------------------------------------------------------------ 3
+    banner("3. reservoir text corpora: exact until capacity")
+    small = ROUGEScore(rouge_keys="rouge1", approx="reservoir", sample_size=8)
+    exact_r = ROUGEScore(rouge_keys="rouge1")
+    lines = [f"the quick brown fox number {i} jumps" for i in range(6)]
+    refs = [f"the quick brown fox number {i} leaps high" for i in range(6)]
+    small.update(lines, refs)
+    exact_r.update(lines, refs)
+    small_f = float(small.compute()["rouge1_fmeasure"])
+    exact_f = float(exact_r.compute()["rouge1_fmeasure"])
+    below = float(small._gather_approx_provenance()["bound"])  # stamped at compute
+    print(f"6 pairs into a 8-slot reservoir: bound {below} (exact), "
+          f"rouge1_f parity {small_f:.4f} == {exact_f:.4f}")
+    over = ROUGEScore(rouge_keys="rouge1", approx="reservoir", sample_size=4)
+    over.update(lines, refs)
+    over.compute()
+    past = float(over._gather_approx_provenance()["bound"])
+    print(f"6 pairs into a 4-slot reservoir: bound {past:.4f} — scales with "
+          "the discarded fraction; selection is content-keyed, identical on "
+          "every host and replay")
+    assert below == 0.0 and past > 0.0
+
+    # ------------------------------------------------------------------ 4
+    banner("4. two-stage ICI->DCN: cross-host bytes scale with hosts")
+    gex = m_exact.telemetry.as_dict()["gathers"]
+    bps = int(round(int(gex["cat_bytes"]) / max(int(gex["steps"]), 1)))
+    for n_hosts in (8, 16, 64):
+        model = two_stage_gather_bytes(bps, n_hosts, 8)
+        print(f"  {n_hosts:3d} hosts x 8 chips: flat {model['flat']:>12,} B  "
+              f"two-stage DCN {model['two_stage']:>11,} B")
+    print("=> the route is host-side routing: the compiled gather's cache "
+          "key excludes it, so DeferredRaggedSync.set_route compiles "
+          "nothing (TMT012 verify_two_stage_gather)")
+
+    # ------------------------------------------------------------------ 5
+    banner("5. actuation: the advisor commits mAP to sketch at 64 chips")
+    advisor = GatherAdvisor(n_chips=64)
+    out = advisor.recommend([m_exact], apply=True, accumulator=acc)
+    act = out["actuation"]
+    print(f"state={advisor.state}  targets={act['targets']}  "
+          f"expected retraces: {act['expected_retraces']['new_keys']} new key")
+    assert advisor.state == "committed" and act["applied"]
+    assert m_exact.approx == "sketch"
+
+    # post-commit steps accrue under the new layout; the first crossing
+    # absorbs the conversion's one expected new-key compile ...
+    rng_post = np.random.default_rng(1)
+    acc.update([map_batch(rng_post) for _ in range(N_DEV)])
+    acc.compute()
+    audit = advisor.retrace_report()
+    print(f"retrace audit: extra_misses={audit['extra_misses']} vs expected "
+          f"new_keys={audit['expected']['new_keys']}  ok={audit['ok']}")
+    assert audit["ok"]
+
+    # ... and steady state re-traces zero times
+    base = cache_stats()
+    acc.update([map_batch(rng_post) for _ in range(N_DEV)])
+    acc.compute()
+    steady = cache_stats_since(base)
+    print(f"steady-state retraces: {steady['traces']}")
+    assert steady["traces"] == 0
+
+    advice = advisor.advise()
+    (label,) = advice["commits"]
+    cut = advice["commits"][label]
+    decisions = [
+        e["action"] for e in advisor.decision_ledger() if e["kind"] == GATHER_DECISION_KIND
+    ]
+    committed_line = next(
+        ln for ln in advice["recommended"] if "committed — measured cut" in ln
+    )
+    print(f"decision ledger: {' -> '.join(decisions)}")
+    print(f"advice line: {committed_line!r}")
+    print(f"=> measured cut {int(cut['cut_bytes_per_step']):,} B/step off the "
+          "wire; post-commit growth "
+          f"{int(cut['post_bytes_per_step'] or 0)} B/step")
+    assert decisions == ["propose", "arm", "commit", "audit"]
+    assert cut["measured"] and int(cut["post_bytes_per_step"] or 0) == 0
+
+    obs.disable_gather_telemetry()
+    obs.disable()
+
+
+if __name__ == "__main__":
+    main()

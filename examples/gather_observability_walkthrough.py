@@ -177,7 +177,7 @@ def main() -> None:
     print(f"  fixed-shape sketch state:    0 B/chip/step "
           f"(cuts {top['sketch_cut_bytes_per_chip_per_step']:,} B)")
     print(f"  existing alternative: {top['sketch_alternative']} "
-          "(none shipped for mAP yet — ROADMAP open item 5)")
+          "(shipped — examples/catstate_killers_walkthrough.py commits it)")
     for ledger_line in advisor.export_ledger(stream=io.StringIO()):
         kind = parse_export_line(ledger_line)["kind"]
     print(f"advice landed in the decision ledger as kind={kind!r}")

@@ -17,6 +17,7 @@ from torchmetrics_tpu.analysis.contracts import (
     check_contracts,
     contract_dir,
     diff_contracts,
+    golden_graphs,
     golden_metrics,
     trace_contract,
     write_contracts,
@@ -35,6 +36,26 @@ def test_golden_slate_covers_at_least_12_metrics():
 def test_snapshots_exist_for_every_slate_entry():
     on_disk = {p.stem for p in contract_dir().glob("*.json")}
     assert set(golden_metrics()) <= on_disk
+    assert set(golden_graphs()) <= on_disk
+
+
+@pytest.mark.catstate
+def test_sketch_map_sync_golden_is_gather_free():
+    golden = json.loads((contract_dir() / "SketchMAPSync.json").read_text())
+    colls = golden["entrypoints"]["sync"]["collectives"]
+    assert colls and all("psum" in c for c in colls)
+    assert not any("gather" in c for c in colls)
+
+
+@pytest.mark.catstate
+def test_two_stage_golden_pins_byte_model_and_gather():
+    golden = json.loads((contract_dir() / "RaggedGatherTwoStageICI.json").read_text())
+    colls = golden["entrypoints"]["sync"]["collectives"]
+    assert any("all_gather" in c or "pgather" in c for c in colls)
+    model = golden["byte_model"]
+    # the 8x8 reference: cross-host bytes scale with hosts, not chips
+    assert 0 < model["two_stage"] < model["flat"]
+    assert model["flat"] == 9 * model["two_stage"]  # (n-1)/(n_hosts-1) = 63/7
 
 
 def test_snapshot_shape():

@@ -19,6 +19,7 @@ from torchmetrics_tpu.analysis.uniformity import (
     verify_collection_sync,
     verify_metric_sync,
     verify_ragged_gather,
+    verify_two_stage_gather,
     verify_uniform,
 )
 from torchmetrics_tpu.classification import BinaryAccuracy
@@ -98,6 +99,16 @@ def test_ragged_gather_is_uniform_and_gathers():
     assert report.ok, report.problems
     joined = " ".join(seq for seqs in report.sequences.values() for seq in seqs)
     assert "all_gather" in joined or "pgather" in joined
+
+
+@pytest.mark.catstate
+def test_two_stage_gather_ici_is_uniform_and_route_free():
+    report = verify_two_stage_gather()
+    assert report.ok, report.problems
+    # the device-side stage gathers; the DCN stage is recorded as host-side
+    assert any("all_gather" in d or "pgather" in d for d in report.sequences["ici-stage"])
+    (dcn,) = report.sequences["dcn-stage"]
+    assert dcn.startswith("host:process_allgather")
 
 
 # ------------------------------------------------------- synthetic violation

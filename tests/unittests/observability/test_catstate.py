@@ -1,0 +1,374 @@
+"""Pod-scale cat-state killers: sketch-backed mAP/text approximations,
+the two-stage ICI→DCN ragged route, and GatherAdvisor actuation
+(observe→trial→commit|rollback, guardrail vetoes, retrace audits,
+``gather_decision`` ledger lines at schema 1.11)."""
+
+import io
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import NUM_DEVICES
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.core.compile import clear_compile_cache
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.observability import gathers, registry
+from torchmetrics_tpu.observability.export import (
+    SCHEMA_VERSION,
+    JSONLinesExporter,
+    parse_export_line,
+)
+from torchmetrics_tpu.observability.gathers import (
+    APPROX_COMMITS,
+    GATHER_DECISION_KIND,
+    GatherAdvisor,
+)
+from torchmetrics_tpu.observability.health import Alert
+from torchmetrics_tpu.parallel.ragged import GATHER_ROUTES, DeferredRaggedSync
+from torchmetrics_tpu.text import BLEUScore, ROUGEScore, SacreBLEUScore
+
+pytestmark = pytest.mark.catstate
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    obs.disable()
+    gathers.disable_gather_telemetry()
+    obs.reset_telemetry()
+    clear_compile_cache()
+    yield
+    gathers.disable_gather_telemetry()
+    obs.disable()
+    obs.reset_telemetry()
+    clear_compile_cache()
+
+
+def _armed():
+    obs.enable()
+    gathers.enable_gather_telemetry()
+
+
+def _rouge_steps(acc, steps, tag=""):
+    for step in range(steps):
+        acc.update(
+            [
+                (f"the cat sat on the mat {tag}{step}d{d}", "a cat is on the mat")
+                for d in range(NUM_DEVICES)
+            ]
+        )
+
+
+# ------------------------------------------------- idempotent register (S1)
+def test_register_same_metric_is_noop(mesh):
+    m = ROUGEScore(rouge_keys="rouge1")
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    # setup re-running (snapshot restore path): same object, both spellings
+    assert acc.register(m) == "ROUGEScore"
+    assert acc.register(m, "ROUGEScore") == "ROUGEScore"
+    _rouge_steps(acc, 1)
+    # the no-op kept the accumulated per-device states (one sample/device)
+    assert acc.steps == 1
+
+
+def test_register_different_metric_same_name_raises(mesh):
+    acc = DeferredRaggedSync(ROUGEScore(rouge_keys="rouge1"), mesh=mesh)
+    with pytest.raises(ValueError, match="different"):
+        acc.register(ROUGEScore(rouge_keys="rouge1"), "ROUGEScore")
+
+
+def test_register_auto_name_never_collides(mesh):
+    acc = DeferredRaggedSync(mesh=mesh)
+    a, b = ROUGEScore(rouge_keys="rouge1"), ROUGEScore(rouge_keys="rouge1")
+    assert acc.register(a) == "ROUGEScore"
+    assert acc.register(b) != "ROUGEScore"  # second instance gets a suffix
+    assert acc.register(a) == "ROUGEScore"  # still idempotent for the first
+
+
+# ------------------------------------------------------- two-stage route (b)
+def test_two_stage_route_matches_flat_per_host(mesh):
+    n_hosts = 4
+    stub = lambda x: np.stack([np.asarray(x)] * n_hosts)  # noqa: E731
+    flat = DeferredRaggedSync(ROUGEScore(rouge_keys="rouge1"), mesh=mesh)
+    two = DeferredRaggedSync(
+        ROUGEScore(rouge_keys="rouge1"),
+        mesh=mesh,
+        route="two_stage",
+        n_processes=n_hosts,
+        dcn_allgather=stub,
+    )
+    _rouge_steps(flat, 2)
+    _rouge_steps(two, 2)
+    st_flat, st_two = flat.sync(), two.sync()
+    # every "host" contributed this host's items: hosts x local total
+    assert int(st_two["_n"]) == n_hosts * int(st_flat["_n"])
+    got = len(st_two["rouge1_fmeasure"])
+    assert got == n_hosts * len(st_flat["rouge1_fmeasure"])
+    # host-major order: the first local-count items are this host's, exact
+    for a, b in zip(st_flat["rouge1_fmeasure"], st_two["rouge1_fmeasure"]):
+        assert np.allclose(np.asarray(a), np.asarray(b))
+    # identical corpus per host => identical score
+    assert np.allclose(
+        float(flat.metric.compute_state(st_flat)["rouge1_fmeasure"]),
+        float(two.metric.compute_state(st_two)["rouge1_fmeasure"]),
+    )
+
+
+def test_two_stage_scalar_leaves_re_reduce_across_hosts(mesh):
+    n_hosts = 2
+    stub = lambda x: np.stack([np.asarray(x)] * n_hosts)  # noqa: E731
+    acc = DeferredRaggedSync(
+        BLEUScore(n_gram=2),
+        mesh=mesh,
+        route="two_stage",
+        n_processes=n_hosts,
+        dcn_allgather=stub,
+    )
+    acc.update(
+        [("the cat is on the mat", ["a cat is on the mat"]) for _ in range(NUM_DEVICES)]
+    )
+    st = acc.sync()
+    # SUM leaves re-reduce over the host axis: 2 hosts x 8 devices x 6 tokens
+    assert float(st["preds_len"]) == n_hosts * NUM_DEVICES * 6
+    assert float(acc.metric.compute_state(st)) > 0.0
+
+
+def test_route_validation_and_set_route_token(mesh):
+    acc = DeferredRaggedSync(ROUGEScore(rouge_keys="rouge1"), mesh=mesh)
+    with pytest.raises(ValueError, match="route"):
+        DeferredRaggedSync(ROUGEScore(rouge_keys="rouge1"), mesh=mesh, route="warp")
+    with pytest.raises(ValueError, match="route"):
+        acc.set_route("warp")
+    assert acc.route == "flat" and "two_stage" in GATHER_ROUTES
+    assert acc.set_route("two_stage") == "flat"  # the rollback token
+    assert acc.set_route("flat") == "two_stage"
+
+
+def test_reset_for_drops_one_member_only(mesh):
+    acc = DeferredRaggedSync(mesh=mesh)
+    a = ROUGEScore(rouge_keys="rouge1")
+    b = ROUGEScore(rouge_keys="rouge1")
+    na, nb = acc.register(a), acc.register(b)
+    for name in (na, nb):
+        acc.update_for(
+            name, [(f"pred {d}", "target") for d in range(NUM_DEVICES)]
+        )
+    acc.reset_for(na)
+    assert acc._per_device[na] is None
+    assert acc._per_device[nb] is not None
+    with pytest.raises(KeyError):
+        acc.reset_for("nope")
+
+
+# --------------------------------------------------- sketch / reservoir (a)
+def _map_batch(rng, k=2, dets=40):
+    preds = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (dets, 4)), jnp.float32),
+            "scores": jnp.asarray(rng.uniform(0, 1, (dets,)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 4, (dets,))),
+        }
+        for _ in range(k)
+    ]
+    target = [
+        {
+            "boxes": jnp.asarray(rng.uniform(0, 200, (8, 4)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 4, (8,))),
+        }
+        for _ in range(k)
+    ]
+    return preds, target
+
+
+def test_sketch_map_within_attested_bound():
+    rng = np.random.default_rng(7)
+    exact = MeanAveragePrecision()
+    sketch = MeanAveragePrecision(approx="sketch")
+    for _ in range(3):
+        preds, target = _map_batch(rng)
+        exact.update(preds, target)
+        sketch.update(preds, target)
+    v_exact = float(exact.compute()["map"])
+    v_sketch = float(sketch.compute()["map"])
+    prov = sketch._gather_approx_provenance()
+    assert prov["source"] == "gather_approx" and prov["kind"] == "sketch-map"
+    assert abs(v_sketch - v_exact) <= prov["bound"] + 1e-6
+    # the sketch states are all psum-shaped: zero gather-family growth
+    from torchmetrics_tpu.observability.gathers import cat_growth_rows
+
+    partial = [sketch.update_state(sketch.init_state(), *_map_batch(rng))]
+    assert cat_growth_rows(sketch, partial, partial) == {}
+
+
+def test_reservoir_text_exact_at_capacity():
+    base = dict(rouge_keys="rouge1")
+    exact = ROUGEScore(**base)
+    approx = ROUGEScore(**base, approx="reservoir", sample_size=64)
+    preds = [f"the cat number {i} sat on the mat" for i in range(20)]
+    targets = ["a cat is on the mat"] * 20
+    exact.update(preds, targets)
+    approx.update(preds, targets)
+    # corpus fits the reservoir: estimator exact, bound zero
+    assert np.isclose(
+        float(exact.compute()["rouge1_fmeasure"]),
+        float(approx.compute()["rouge1_fmeasure"]),
+    )
+    assert approx._gather_approx_provenance()["bound"] == 0.0
+
+
+@pytest.mark.parametrize("cls", [BLEUScore, SacreBLEUScore])
+def test_reservoir_bleu_exact_at_capacity(cls):
+    exact, approx = cls(n_gram=2), cls(n_gram=2, approx="reservoir", sample_size=32)
+    preds = [f"the cat {i} is on the mat" for i in range(10)]
+    targets = [["a cat is on the mat"]] * 10
+    exact.update(preds, targets)
+    approx.update(preds, targets)
+    assert np.isclose(float(exact.compute()), float(approx.compute()))
+    assert approx._gather_approx_provenance()["bound"] == 0.0
+
+
+def test_reservoir_bound_nonzero_past_capacity():
+    approx = ROUGEScore(rouge_keys="rouge1", approx="reservoir", sample_size=4)
+    approx.update([f"pred number {i}" for i in range(16)], ["target text"] * 16)
+    approx.compute()
+    bound = approx._gather_approx_provenance()["bound"]
+    assert 0.0 < bound <= (16 - 4) / 16
+
+
+# ----------------------------------------------------- advisor actuation (c)
+def _committed_advisor(mesh):
+    """A ROUGE workload committed to reservoir via recommend(apply=True)."""
+    _armed()
+    m = ROUGEScore(rouge_keys="rouge1")
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    _rouge_steps(acc, 3)
+    adv = GatherAdvisor(n_chips=64, sketch_first_bytes=1)  # force sketch-first
+    out = adv.recommend([m], apply=True, accumulator=acc)
+    return m, acc, adv, out
+
+
+def test_recommend_apply_commits_and_ledgers(mesh):
+    m, acc, adv, out = _committed_advisor(mesh)
+    assert adv.state == "committed"
+    assert out["actuation"]["applied"] is True
+    assert m.approx == APPROX_COMMITS["ROUGEScore"] == "reservoir"
+    actions = [e.get("action") for e in adv.decision_ledger() if e["kind"] == GATHER_DECISION_KIND]
+    assert actions == ["propose", "arm", "commit"]
+    assert adv.counts["commits"] == 1
+    # post-conversion updates merge cleanly (old-layout partials dropped)
+    _rouge_steps(acc, 1, tag="post")
+    assert float(acc.compute()["rouge1_fmeasure"]) > 0.0
+
+
+def test_retrace_audit_passes_after_commit(mesh):
+    m, acc, adv, _ = _committed_advisor(mesh)
+    _rouge_steps(acc, 2, tag="post")
+    acc.compute()
+    audit = adv.retrace_report()
+    # the conversion costs at most its one expected new-key miss; steady
+    # state re-traces zero times
+    assert audit["ok"], audit
+    assert audit["expected"]["new_keys"] == 1
+    assert all(c in ("invalidation", "new-key") for c in audit["miss_causes"])
+    audit_entries = [e for e in adv.decision_ledger() if e.get("action") == "audit"]
+    assert audit_entries and audit_entries[-1]["trigger"]["ok"]
+
+
+def test_committed_cut_advice_line_parses_back(mesh):
+    """Satellite: the committed-cut advice line ships through the JSONL
+    front door at the bumped schema and parses back with its measured cut."""
+    m, acc, adv, _ = _committed_advisor(mesh)
+    _rouge_steps(acc, 2, tag="post")
+    advice = adv.advise()
+    (label,) = advice["commits"]
+    cut = advice["commits"][label]
+    assert cut["measured"] is True
+    line = next(r for r in advice["recommended"] if "committed" in r)
+    assert f"measured cut {int(cut['cut_bytes_per_step'])} B/step" in line
+    assert SCHEMA_VERSION.split(".")[:2] == ["1", "11"]
+    buf = io.StringIO()
+    JSONLinesExporter(stream=buf).export(advice)
+    back = parse_export_line(buf.getvalue().strip())
+    assert back["schema_version"] == SCHEMA_VERSION
+    assert back["commits"][label]["cut_bytes_per_step"] == cut["cut_bytes_per_step"]
+    assert line in back["recommended"]
+
+
+def test_guardrail_alert_rolls_back_commit(mesh):
+    m, acc, adv, _ = _committed_advisor(mesh)
+    sink = adv.guardrail_sink()
+    sink.emit(
+        Alert(
+            series="shadow_exact/ROUGEScore",
+            rule="error_bound",
+            severity="critical",
+            step=3,
+            value=0.4,
+            message="sketch error bound breached",
+        )
+    )
+    assert adv.state == "observe"
+    assert adv.counts["rollbacks"] == 1
+    assert m.approx is None  # restored to exact
+    roll = next(e for e in adv.decision_ledger() if e.get("action") == "rollback")
+    assert roll["alert"]["severity"] == "critical"
+    # post-rollback updates merge cleanly against the restored exact layout
+    _rouge_steps(acc, 1, tag="rolled")
+    assert float(acc.compute()["rouge1_fmeasure"]) > 0.0
+
+
+def test_guardrail_alert_vetoes_pending_trial(mesh):
+    _armed()
+    m = ROUGEScore(rouge_keys="rouge1")
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    _rouge_steps(acc, 2)
+    adv = GatherAdvisor(n_chips=64, sketch_first_bytes=1)
+    adv.recommend([m], accumulator=acc)  # no apply: stop in candidate
+    assert adv.state == "candidate"
+    adv.arm()
+    adv.guardrail_sink("warning").emit(
+        Alert(
+            series="sync_wait",
+            rule="stall",
+            severity="warning",
+            step=4,
+            value=9.0,
+            message="host sync stall",
+        )
+    )
+    assert adv.state == "observe"
+    assert adv.counts["vetoes"] == 1
+    assert m.approx is None  # never applied
+    with pytest.raises(RuntimeError, match="vetoed|no staged"):
+        adv.commit()
+
+
+def test_route_commit_expects_zero_retraces(mesh):
+    _armed()
+    m = ROUGEScore(rouge_keys="rouge1")
+    acc = DeferredRaggedSync(m, mesh=mesh)
+    _rouge_steps(acc, 2)
+    adv = GatherAdvisor(n_chips=64, sketch_first_bytes=1 << 40)  # force two-stage
+    out = adv.recommend([m], apply=True, accumulator=acc)
+    assert out["actuation"]["targets"] == [f"{out['candidates'][0]['metric']}:route=two_stage"]
+    assert acc.route == "two_stage"
+    # route flips are host-side: the audit expectation is zero new keys
+    assert adv.retrace_report()["expected"]["new_keys"] == 0
+    adv.rollback("drill")
+    assert acc.route == "flat"
+
+
+def test_state_machine_guards():
+    adv = GatherAdvisor()
+    with pytest.raises(RuntimeError, match="no candidate"):
+        adv.arm()
+    with pytest.raises(RuntimeError, match="no staged"):
+        adv.commit()
+    with pytest.raises(RuntimeError, match="no pending trial"):
+        adv.veto()
+    with pytest.raises(RuntimeError, match="nothing committed"):
+        adv.rollback()
+    with pytest.raises(RuntimeError, match="no commit"):
+        adv.retrace_report()
+    with pytest.raises(ValueError, match="severity"):
+        adv.guardrail_sink("catastrophic")

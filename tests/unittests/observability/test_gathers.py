@@ -251,7 +251,7 @@ def test_advisor_names_map_sketch_first_at_64_chips(mesh):
     assert top["class"] == "MeanAveragePrecision"
     assert top["projected_flat_bytes_per_chip_per_step"] == 5_402_880
     assert top["recommendation"] == "sketch-first"
-    assert top["sketch_alternative"] is None  # ROADMAP open item 5
+    assert 'approx="sketch"' in top["sketch_alternative"]
     assert advice["kind"] == GATHER_LEDGER_KIND
     assert f"{top['metric']}: sketch-first" in advice["recommended"]
 
@@ -270,8 +270,39 @@ def test_measured_ragged_gather_buckets(mesh):
         # the tiled ring model never undercuts the flat (n-1)*B prediction
         assert row["residual_bytes"] == row["model_ring_bytes"] - row["model_naive_bytes"]
         assert row["residual_bytes"] >= 0
+        # flat route: no DCN share, route label says so
+        assert row["route"] == "flat"
+        assert row["model_dcn_bytes"] == 0
     # the whole window lands in the owner's span stats too
     assert t.as_dict()["spans"]["gather_measured"]["count"] == 1
+
+
+def test_measured_bucket_rows_follow_route_switch(mesh):
+    """Satellite: flipping the accumulator to the two-stage route re-prices
+    the ``gather/<leaf>`` rows with the two-stage model — the route label
+    flips and the DCN share appears, scaled by hosts rather than chips."""
+    _armed()
+    n_hosts = 4
+    stub = lambda x: np.stack([np.asarray(x)] * n_hosts)  # noqa: E731
+    m, acc = _map_workload(mesh, steps=1)
+    acc.compute()  # flat crossing first: route="flat", dcn=0
+    assert acc.set_route("two_stage") == "flat"
+    acc.n_processes = n_hosts
+    acc.dcn_allgather = stub
+    acc.compute()  # same states, two-stage crossing
+    t = registry.telemetry_for(m, create=False)
+    buckets = t.as_dict()["sync_buckets"]
+    for leaf in ("detection_boxes", "detection_scores"):
+        row = buckets[f"gather/{leaf}"]
+        assert row["syncs"] == 2
+        assert row["route"] == "two_stage"  # latest crossing wins the label
+        assert row["model_dcn_bytes"] > 0
+        # cross-host share stays a strict subset of the total two-stage bytes
+        assert row["model_dcn_bytes"] < row["model_ring_bytes"]
+    # round-trip: back to flat, label follows
+    assert acc.set_route("flat") == "two_stage"
+    acc.compute()
+    assert t.as_dict()["sync_buckets"]["gather/detection_boxes"]["route"] == "flat"
 
 
 def test_sync_gather_bytes_counter_split(mesh):
@@ -358,8 +389,9 @@ def test_advisor_quotes_existing_sketch_alternatives():
     for cls in ("BinaryAUROC", "MulticlassAveragePrecision", "MultilabelROC",
                 "BinaryPrecisionRecallCurve"):
         assert "thresholds=N" in sketch_alternative_for(cls)
-    assert sketch_alternative_for("MeanAveragePrecision") is None
-    assert sketch_alternative_for("ROUGEScore") is None
+    assert 'approx="sketch"' in sketch_alternative_for("MeanAveragePrecision")
+    for cls in ("ROUGEScore", "BLEUScore", "SacreBLEUScore"):
+        assert 'approx="reservoir"' in sketch_alternative_for(cls)
 
 
 def test_advisor_ledger_exports_jsonl_parse_back():

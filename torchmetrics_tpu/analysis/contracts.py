@@ -40,6 +40,7 @@ __all__ = [
     "check_contracts",
     "contract_dir",
     "diff_contracts",
+    "golden_graphs",
     "golden_metrics",
     "trace_contract",
     "write_contracts",
@@ -376,6 +377,99 @@ def trace_contract(
     }
 
 
+# ----------------------------------------------------------- graph contracts
+def sketch_map_sync_contract(
+    mesh: Optional[Any] = None, axis_name: str = "data"
+) -> Dict[str, Any]:
+    """Trace contract of the sketch-mAP sync segment.
+
+    ``MeanAveragePrecision(approx="sketch")`` replaces the ragged cat states
+    with fixed-shape score histograms whose whole point is to ride the psum
+    family — the contract pins that: the sync graph must hold reduce-family
+    collectives only, and any gather-family primitive appearing here is the
+    regression the sketch mode exists to prevent.  (The update segment is
+    host-side COCO matching — no device graph to snapshot.)
+    """
+    from torchmetrics_tpu.analysis.audit import _default_mesh, _trace_sync
+    from torchmetrics_tpu.analysis.uniformity import collective_sequence
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    the_mesh = _default_mesh(mesh, axis_name)
+    metric = MeanAveragePrecision(approx="sketch")
+    state = metric.init_state()
+    jx = _trace_sync(
+        lambda st: metric.sync_states(st, axis_name), state, the_mesh, axis_name
+    )
+    return {
+        "schema": CONTRACT_SCHEMA_VERSION,
+        "metric": "MeanAveragePrecision[approx=sketch]",
+        "mesh": _mesh_descriptor(the_mesh, axis_name),
+        "entrypoints": {
+            "sync": {
+                "primitives": _primitive_multiset(jx),
+                "collectives": [op.describe() for op in collective_sequence(jx)],
+            },
+        },
+    }
+
+
+def ragged_two_stage_contract(
+    mesh: Optional[Any] = None, axis_name: str = "data"
+) -> Dict[str, Any]:
+    """Trace contract of the two-stage ragged gather's device-side segment.
+
+    The ICI stage is the SAME compiled graph as the flat route (the DCN
+    exchange is host-side, outside XLA) — the snapshot pins the gather-family
+    lowering, and the ``byte_model`` block pins the deterministic
+    :func:`~torchmetrics_tpu.utilities.benchmark.two_stage_gather_bytes`
+    numbers at a reference (1 MiB shard, 8 hosts x 8 chips) so a model
+    regression diffs like any other golden change.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.analysis.audit import _default_mesh
+    from torchmetrics_tpu.analysis.uniformity import collective_sequence
+    from torchmetrics_tpu.core.compile import compiled_ragged_gather
+    from torchmetrics_tpu.core.reductions import Reduce
+    from torchmetrics_tpu.utilities.benchmark import two_stage_gather_bytes
+
+    the_mesh = _default_mesh(mesh, axis_name)
+    n_dev = int(the_mesh.devices.size)
+    fn = compiled_ragged_gather(
+        the_mesh, axis_name, (("total", Reduce.SUM),), ("rag0_data_f32", "rag0_shapes_i32")
+    )
+    jx = jax.make_jaxpr(fn)(
+        {"total": jnp.zeros((n_dev,), jnp.float32)},
+        jnp.zeros((n_dev,), jnp.int32),
+        {
+            "rag0_data_f32": jnp.zeros((n_dev, 64), jnp.float32),
+            "rag0_shapes_i32": jnp.zeros((n_dev, 6), jnp.float32),
+        },
+    )
+    return {
+        "schema": CONTRACT_SCHEMA_VERSION,
+        "metric": "RaggedGather[two_stage/ici]",
+        "mesh": _mesh_descriptor(the_mesh, axis_name),
+        "byte_model": two_stage_gather_bytes(1 << 20, n_hosts=8, n_local_devices=8),
+        "entrypoints": {
+            "sync": {
+                "primitives": _primitive_multiset(jx),
+                "collectives": [op.describe() for op in collective_sequence(jx)],
+            },
+        },
+    }
+
+
+def golden_graphs() -> Dict[str, Callable[..., Dict[str, Any]]]:
+    """name -> tracer for lowering paths with no single-metric update
+    entrypoint (host-side updates, shared-accumulator gathers).  Same
+    snapshot / diff / ``--update-contracts`` flow as :func:`golden_metrics`."""
+    return {
+        "SketchMAPSync": sketch_map_sync_contract,
+        "RaggedGatherTwoStageICI": ragged_two_stage_contract,
+    }
+
+
 # -------------------------------------------------------------- diff / gate
 def diff_contracts(golden: Dict[str, Any], current: Dict[str, Any]) -> List[str]:
     """Primitive-level differences, golden vs freshly traced.  Empty = pass."""
@@ -383,6 +477,11 @@ def diff_contracts(golden: Dict[str, Any], current: Dict[str, Any]) -> List[str]
     diffs: List[str] = []
     if golden.get("mesh") != current.get("mesh"):
         diffs.append(f"{name}: mesh changed {golden.get('mesh')!r} -> {current.get('mesh')!r}")
+    if golden.get("byte_model") != current.get("byte_model"):
+        diffs.append(
+            f"{name}: byte model changed {golden.get('byte_model')} -> "
+            f"{current.get('byte_model')}"
+        )
     for entry in ("update", "sync"):
         g = golden.get("entrypoints", {}).get(entry, {})
         c = current.get("entrypoints", {}).get(entry, {})
@@ -416,9 +515,13 @@ def write_contracts(
     directory.mkdir(parents=True, exist_ok=True)
     written: List[Path] = []
     slate = golden_metrics()
-    for name in sorted(names or slate):
-        metric, inputs = slate[name]()
-        contract = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+    graphs = golden_graphs()
+    for name in sorted(names or {**slate, **graphs}):
+        if name in slate:
+            metric, inputs = slate[name]()
+            contract = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+        else:
+            contract = graphs[name](mesh=mesh, axis_name=axis_name)
         path = directory / f"{name}.json"
         path.write_text(json.dumps(contract, indent=2, sort_keys=True) + "\n")
         written.append(path)
@@ -439,16 +542,20 @@ def check_contracts(
     """
     directory = Path(directory) if directory is not None else contract_dir()
     slate = golden_metrics()
+    graphs = golden_graphs()
     diffs: List[str] = []
     on_disk = {p.stem: p for p in sorted(directory.glob("*.json"))} if directory.is_dir() else {}
-    for name in sorted(slate):
+    for name in sorted({**slate, **graphs}):
         path = on_disk.pop(name, None)
         if path is None:
             diffs.append(f"{name}: no golden snapshot — run --update-contracts")
             continue
         golden = json.loads(path.read_text())
-        metric, inputs = slate[name]()
-        current = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+        if name in slate:
+            metric, inputs = slate[name]()
+            current = trace_contract(metric, *inputs, mesh=mesh, axis_name=axis_name)
+        else:
+            current = graphs[name](mesh=mesh, axis_name=axis_name)
         diffs.extend(diff_contracts(golden, current))
     for name in sorted(on_disk):
         diffs.append(f"{name}: stale snapshot (metric no longer in the golden slate)")

@@ -55,6 +55,7 @@ __all__ = [
     "verify_metric_sync",
     "verify_ragged_gather",
     "verify_sharded_sync",
+    "verify_two_stage_gather",
     "verify_uniform",
 ]
 
@@ -477,5 +478,79 @@ def verify_ragged_gather(
         report.problems.append(
             "ragged-gather: no gather-family collective in the traced graph — the "
             "ragged crossing did not lower"
+        )
+    return report
+
+
+def verify_two_stage_gather(
+    mesh: Optional[Any] = None,
+    axis_name: str = "data",
+    n_items: int = 3,
+) -> UniformityReport:
+    """Verify the two-stage ICI→DCN ragged route's device-side segment.
+
+    The two-stage lowering (``parallel/ragged.py``, ``route="two_stage"``)
+    runs the SAME compiled in-mesh gather as the flat route — the DCN stage
+    is one host-side ``process_allgather`` per dtype, outside XLA — so the
+    uniformity obligation is twofold:
+
+    1. the ICI segment must be uniform (no guard-dominated collectives, the
+       TMT012 hazard) and must actually contain a gather-family collective;
+    2. the ICI jaxpr must be **identical** to the flat route's — flipping
+       ``DeferredRaggedSync.set_route`` at runtime may not introduce a new
+       device graph (that identity is what makes the flip compile-free,
+       the property ``GatherAdvisor.commit`` relies on for its
+       ``new_keys=0`` retrace expectation on route targets).
+    """
+    from torchmetrics_tpu.core.compile import compiled_ragged_gather
+    from torchmetrics_tpu.core.reductions import Reduce
+
+    report = UniformityReport("two-stage-gather")
+    the_mesh = _default_mesh(mesh, axis_name)
+    n_dev = int(the_mesh.devices.size)
+
+    scalar_reduces = (("total", Reduce.SUM),)
+    flat_keys = ("rag0_data_f32", "rag0_shapes_i32")
+    # both routes compile through the same entrypoint with the same key: two
+    # calls must hit one cache entry and trace one bit-identical graph
+    fn_flat = compiled_ragged_gather(the_mesh, axis_name, scalar_reduces, flat_keys)
+    fn_two_stage = compiled_ragged_gather(the_mesh, axis_name, scalar_reduces, flat_keys)
+    scalars = {"total": jnp.zeros((n_dev,), jnp.float32)}
+    n = jnp.zeros((n_dev,), jnp.int32)
+    flats = {
+        "rag0_data_f32": jnp.zeros((n_dev, 64), jnp.float32),
+        "rag0_shapes_i32": jnp.zeros((n_dev, 2 * n_items), jnp.int32).astype(jnp.float32),
+    }
+    jx_ici = jax.make_jaxpr(fn_two_stage)(scalars, n, flats)
+    _record(report, "ici-stage", jx_ici)
+    if not any("all_gather" in d or "pgather" in d for d in report.sequences["ici-stage"]):
+        report.problems.append(
+            "two-stage-gather/ici-stage: no gather-family collective — the in-mesh "
+            "stage did not lower"
+        )
+    if fn_flat is not fn_two_stage:
+        report.problems.append(
+            "two-stage-gather: the two routes resolved different compiled gathers — "
+            "the route leaked into the compile key, so a runtime flip would retrace"
+        )
+    jx_flat = jax.make_jaxpr(fn_flat)(scalars, n, flats)
+    if str(jx_flat) != str(jx_ici):
+        report.problems.append(
+            "two-stage-gather: ICI jaxpr differs from the flat route's — the "
+            "device-side segment must be route-independent (the DCN exchange is "
+            "host-side only)"
+        )
+    # the host-side stage has no jaxpr; record its byte-model shape so the
+    # report shows WHY the route exists (cross-host bytes scale with hosts)
+    from torchmetrics_tpu.utilities.benchmark import two_stage_gather_bytes
+
+    model = two_stage_gather_bytes(1 << 20, n_hosts=8, n_local_devices=n_dev)
+    report.sequences["dcn-stage"] = (
+        f"host:process_allgather bytes={model['two_stage']} (flat={model['flat']})",
+    )
+    if 0 < model["flat"] <= model["two_stage"]:
+        report.problems.append(
+            "two-stage-gather/dcn-stage: modeled cross-host bytes do not undercut "
+            "the flat route at 8 hosts — the byte model regressed"
         )
     return report

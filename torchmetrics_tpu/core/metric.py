@@ -99,6 +99,29 @@ def _gather_replicated(leaf: Any) -> Any:
         return leaf  # already replicated over the mesh
     return jax.device_put(leaf, NamedSharding(sharding.mesh, PartitionSpec()))
 
+#: approximation modes a metric may opt into: ``"sketch"`` replaces cat
+#: states with fixed-shape mergeable summaries (histograms/HLL), and
+#: ``"reservoir"`` keeps a deterministic bottom-k-by-hash corpus sample
+APPROX_MODES = (None, "sketch", "reservoir")
+
+
+def _validate_approx(
+    approx: Optional[str], approx_error: Optional[float]
+) -> Tuple[Optional[str], Optional[float]]:
+    """Shared ctor/``set_approx`` validation of the approximation config."""
+    if approx not in APPROX_MODES:
+        raise ValueError(
+            f"Arg `approx` must be None, 'sketch' or 'reservoir', got {approx!r}"
+        )
+    if approx_error is not None:
+        if approx is None:
+            raise ValueError("`approx_error` requires `approx='sketch'` or `approx='reservoir'`")
+        approx_error = float(approx_error)
+        if not (0.0 < approx_error <= 0.5):
+            raise ValueError(f"`approx_error` must be in (0, 0.5], got {approx_error}")
+    return approx, approx_error
+
+
 # ctor kwargs consumed by Metric.__init__ — wrappers that forward leftover
 # kwargs elsewhere (e.g. PermutationInvariantTraining) split on this set
 METRIC_BASE_KWARGS = frozenset(
@@ -221,15 +244,8 @@ class Metric:
             )
         kwargs.pop("compute_on_cpu", None)  # accepted for API parity; host state is the default here
         approx = kwargs.pop("approx", None)
-        if approx not in (None, "sketch"):
-            raise ValueError(f"Arg `approx` must be None or 'sketch', got {approx!r}")
         approx_error = kwargs.pop("approx_error", None)
-        if approx_error is not None:
-            if approx is None:
-                raise ValueError("`approx_error` requires `approx='sketch'`")
-            approx_error = float(approx_error)
-            if not (0.0 < approx_error <= 0.5):
-                raise ValueError(f"`approx_error` must be in (0, 0.5], got {approx_error}")
+        approx, approx_error = _validate_approx(approx, approx_error)
         # public attrs: part of the compile-cache config fingerprint, so an
         # exact and a sketch instance of one metric class never share traces
         self.approx: Optional[str] = approx
@@ -394,6 +410,40 @@ class Metric:
     def state_shardings(self) -> Dict[str, ShardSpec]:
         """Read-only copy of the per-leaf sharding specs."""
         return dict(self._state_shardings)
+
+    def set_approx(self, approx: Optional[str], approx_error: Optional[float] = None) -> None:
+        """Switch a constructed metric between its exact and approximate
+        state layouts — the GatherAdvisor's actuation hook (the gather-family
+        counterpart of :meth:`set_state_sharding`).
+
+        Only metrics that implement ``_install_approx_states`` (re-register
+        their state leaves under the current ``approx`` config) support the
+        switch; everything else keeps its ctor-time layout.  Accumulated
+        state is discarded — the old layout's buffers cannot be reinterpreted
+        under the new one — and the public ``approx``/``approx_error``
+        writes flip the config fingerprint, so the next compiled dispatch
+        re-traces with the new state layout (exactly one new-key cache miss
+        per entrypoint) instead of reusing the exact-layout trace.
+        """
+        approx, approx_error = _validate_approx(approx, approx_error)
+        rebuild = getattr(self, "_install_approx_states", None)
+        if rebuild is None:
+            raise ValueError(
+                f"{type(self).__name__} does not support runtime approx switching: "
+                "it defines no _install_approx_states re-registration hook. "
+                "Construct a fresh instance with approx=... instead."
+            )
+        # public writes: each bumps _config_version → new compile-cache key
+        self.approx = approx
+        self.approx_error = approx_error
+        for name in list(self._reductions):
+            del self._reductions[name]
+            self._defaults.pop(name, None)
+            self._persistent.pop(name, None)
+            self._value_ranges.pop(name, None)
+            self._state_shardings.pop(name, None)
+        rebuild()
+        self.reset()
 
     @property
     def _has_list_states(self) -> bool:

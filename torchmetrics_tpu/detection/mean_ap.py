@@ -10,6 +10,20 @@ proves this is semantically reachable without the C backend.
 
 States are per-image variable-length arrays kept as list ("cat") states, as in
 the reference (mean_ap.py:470-512).
+
+``approx="sketch"`` swaps those unbounded cat states for fixed-shape score
+histograms per (class, IoU threshold) built on
+:class:`~torchmetrics_tpu.sketches.QuantileSketch`: COCO matching is
+per-image-independent, so the greedy match runs *at update time* (protocol
+exact) and only the matched/unmatched score histograms accumulate.  The
+histogram leaves merge elementwise (``psum`` family), so sketch-mode mAP
+leaves the gather family entirely and rides the coalesce planner's fused sum
+buckets — bounded bytes per chip regardless of sample count or chip count.
+Cell boundary counts are exact, so every reported operating point lies on the
+exact PR curve; the only loss is *within*-cell score ordering, and
+``_compute_sketch`` derives the data-dependent bound
+``max_b (pmax_b - pmin_b)`` per (class, threshold) that the attestation
+plane stamps (one-sided: sketch mAP never exceeds exact mAP).
 """
 
 from __future__ import annotations
@@ -22,6 +36,7 @@ from jax import Array
 
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.detection.box_ops import box_convert
+from torchmetrics_tpu.sketches.quantile import QuantileSketch
 
 _AREA_RANGES = {
     "all": (0.0, 1e10),
@@ -165,6 +180,7 @@ class MeanAveragePrecision(Metric):
         extended_summary: bool = False,
         average: str = "macro",
         backend: str = "native",
+        sketch_classes: int = 91,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -200,14 +216,55 @@ class MeanAveragePrecision(Metric):
         if backend not in ("native", "native_numpy"):
             raise ValueError(f"Expected argument `backend` to be one of ('native', 'native_numpy') but got {backend}")
         self.backend = backend
+        if not (isinstance(sketch_classes, int) and sketch_classes >= 1):
+            raise ValueError(f"Argument `sketch_classes` must be a positive int, got {sketch_classes!r}")
+        #: fixed class-id space of the sketch-mode histograms (labels must lie
+        #: in [0, sketch_classes)); default 91 covers the COCO category ids
+        self.sketch_classes = sketch_classes
+        self._install_approx_states()
 
+    def _install_approx_states(self) -> None:
+        """(Re-)register the state leaves for the current ``approx`` config —
+        the :meth:`~torchmetrics_tpu.core.metric.Metric.set_approx` hook."""
+        if self.approx == "sketch":
+            if "segm" in self.iou_types:
+                raise ValueError(
+                    "MeanAveragePrecision(approx='sketch') supports iou_type='bbox' only: "
+                    "mask states cannot be histogram-summarized"
+                )
+            if self.extended_summary:
+                raise ValueError(
+                    "MeanAveragePrecision(approx='sketch') does not keep the raw "
+                    "per-detection arrays `extended_summary` reports; use the exact path"
+                )
+            self._map_sketch = QuantileSketch.for_error(self.approx_error)
+            K, T = self.sketch_classes, len(self.iou_thresholds)
+            M = len(self.max_detection_thresholds)
+            # matched at update time (per-image matching is image-independent
+            # in the COCO protocol): TP/FP score histograms per (class, thr)
+            # at the largest maxDets cap, exact TP counts per smaller cap
+            # (recall needs only the final cumulative TP), and the exact
+            # valid-gt count per class — all fixed-shape psum-family leaves
+            self.add_state(
+                "score_hist_tp", self._map_sketch.init((K, T)),
+                dist_reduce_fx=self._map_sketch.reduce_spec,
+            )
+            self.add_state(
+                "score_hist_fp", self._map_sketch.init((K, T)),
+                dist_reduce_fx=self._map_sketch.reduce_spec,
+            )
+            self.add_state("tp_count", jnp.zeros((M, K, T)), dist_reduce_fx="sum")
+            self.add_state("gt_total", jnp.zeros((self.sketch_classes,)), dist_reduce_fx="sum")
+            self.add_state("det_total", jnp.zeros((self.sketch_classes,)), dist_reduce_fx="sum")
+            return
+        self._map_sketch = None
         # per-image variable-length states (reference mean_ap.py:470-512);
         # box and mask item states coexist when iou_types has both
         names = ["detection_scores", "detection_labels", "groundtruth_labels",
                  "groundtruth_crowds", "groundtruth_area"]
-        if "bbox" in iou_types:
+        if "bbox" in self.iou_types:
             names += ["detection_boxes", "groundtruth_boxes"]
-        if "segm" in iou_types:
+        if "segm" in self.iou_types:
             names += ["detection_masks", "groundtruth_masks"]
         for name in names:
             self.add_state(name, [], dist_reduce_fx=None)
@@ -227,6 +284,9 @@ class MeanAveragePrecision(Metric):
             for k in item_keys + ["labels"]:
                 if k not in t:
                     raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+        if self._map_sketch is not None:
+            return self._update_sketch(state, preds, target)
 
         new = {k: state[k] for k in state}
         for p, t in zip(preds, target):
@@ -261,6 +321,74 @@ class MeanAveragePrecision(Metric):
         if item.size == 0:
             return jnp.zeros(0)
         return ((item[:, 2] - item[:, 0]) * (item[:, 3] - item[:, 1])).astype(jnp.float32)
+
+    # ------------------------------------------------------------ sketch mode
+    def _update_sketch(
+        self, state: State, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]
+    ) -> State:
+        """Match each image now (area range "all", largest maxDets cap) and
+        fold only the TP/FP score histograms + exact counters in."""
+        sketch = self._map_sketch
+        K = self.sketch_classes
+        T = len(self.iou_thresholds)
+        max_dets = self.max_detection_thresholds
+        h_tp = np.asarray(state["score_hist_tp"], np.float32).copy()
+        h_fp = np.asarray(state["score_hist_fp"], np.float32).copy()
+        tp_count = np.asarray(state["tp_count"], np.float32).copy()
+        gt_total = np.asarray(state["gt_total"], np.float32).copy()
+        det_total = np.asarray(state["det_total"], np.float32).copy()
+        arng = _AREA_RANGES["all"]
+        for p, t in zip(preds, target):
+            det_boxes = np.asarray(self._convert_boxes(p["boxes"])).reshape(-1, 4)
+            gt_boxes = np.asarray(self._convert_boxes(t["boxes"])).reshape(-1, 4)
+            det_scores = np.asarray(p["scores"], np.float32).reshape(-1)
+            det_labels = np.asarray(p["labels"]).reshape(-1).astype(np.int64)
+            gt_labels = np.asarray(t["labels"]).reshape(-1).astype(np.int64)
+            n_gt = gt_labels.shape[0]
+            crowds = np.asarray(t.get("iscrowd", np.zeros(n_gt, np.int64))).reshape(-1).astype(bool)
+            user_area = (
+                np.asarray(t["area"], np.float32).reshape(-1)
+                if "area" in t and t["area"] is not None and np.asarray(t["area"]).size == n_gt
+                else np.full((n_gt,), -1.0, np.float32)
+            )
+            derived = np.asarray(self._item_area(jnp.asarray(gt_boxes), "bbox")).reshape(-1)
+            gt_area = np.where(user_area > 0, user_area, derived) if user_area.size else derived
+            det_area = np.asarray(self._item_area(jnp.asarray(det_boxes), "bbox")).reshape(-1)
+            if self.average == "micro":
+                det_labels = np.zeros_like(det_labels)
+                gt_labels = np.zeros_like(gt_labels)
+            for arr, what in ((det_labels, "preds"), (gt_labels, "target")):
+                if arr.size and (arr.min() < 0 or arr.max() >= K):
+                    raise ValueError(
+                        f"approx='sketch' holds per-class histograms over a fixed class "
+                        f"space [0, {K}); got a `{what}` label {int(arr.min()) if arr.min() < 0 else int(arr.max())}. "
+                        "Raise `sketch_classes` to cover the label space."
+                    )
+            for cls in np.union1d(det_labels, gt_labels):
+                d_sel = det_labels == cls
+                g_sel = gt_labels == cls
+                ious = _box_iou_crowd(det_boxes[d_sel], gt_boxes[g_sel], crowds[g_sel])
+                tp, ig, sc, nv = _evaluate_image(
+                    ious, det_scores[d_sel], crowds[g_sel], gt_area[g_sel],
+                    det_area[d_sel], self.iou_thresholds, arng, max_dets[-1],
+                )
+                gt_total[cls] += nv
+                det_total[cls] += sc.shape[0]
+                if sc.shape[0]:
+                    idx = np.asarray(sketch.cell_index(jnp.asarray(sc)))  # (D',)
+                    ti = np.broadcast_to(np.arange(T)[:, None], tp.shape)
+                    ci = np.broadcast_to(idx[None, :], tp.shape)
+                    np.add.at(h_tp[cls], (ti, ci), (tp & ~ig).astype(np.float32))
+                    np.add.at(h_fp[cls], (ti, ci), (~tp & ~ig).astype(np.float32))
+                for mi, mdet in enumerate(max_dets):
+                    tp_count[mi, cls] += (tp[:, :mdet] & ~ig[:, :mdet]).sum(axis=1)
+        return {
+            "score_hist_tp": jnp.asarray(h_tp),
+            "score_hist_fp": jnp.asarray(h_fp),
+            "tp_count": jnp.asarray(tp_count),
+            "gt_total": jnp.asarray(gt_total),
+            "det_total": jnp.asarray(det_total),
+        }
 
     # ---------------------------------------------------------- coco file io
     @staticmethod
@@ -318,6 +446,8 @@ class MeanAveragePrecision(Metric):
 
     # -------------------------------------------------------------- compute
     def _compute(self, state: State) -> Dict[str, Array]:
+        if self._map_sketch is not None:
+            return self._compute_sketch(state)
         out: Dict[str, Array] = {}
         for i_type in self.iou_types:
             prefix = "" if len(self.iou_types) == 1 else f"{i_type}_"
@@ -569,3 +699,129 @@ class MeanAveragePrecision(Metric):
                 for ii in range(len(images))
             }
         return out
+
+    def _compute_sketch(self, state: State) -> Dict[str, Array]:
+        """mAP/mAR from the fixed-shape sketch state.
+
+        Every histogram cell boundary is an exact operating point of the
+        exact PR curve (boundary counts are exact — ``QuantileSketch``
+        guarantee), so the interpolated AP over boundary points can only
+        *underestimate* the exact envelope, by at most
+        ``max_b (pmax_b - pmin_b)`` per (class, thr) where ``pmax_b`` removes
+        cell ``b``'s own FP mass from the denominator — the data-dependent
+        bound stamped into the attestation plane.  Area-banded keys
+        (``map_small``/... ) are not derivable from the histograms and
+        return the -1.0 sentinel.
+        """
+        h_tp = np.asarray(state["score_hist_tp"], np.float64)  # (K, T, C)
+        h_fp = np.asarray(state["score_hist_fp"], np.float64)
+        tp_count = np.asarray(state["tp_count"], np.float64)  # (M, K, T)
+        gt_total = np.asarray(state["gt_total"], np.float64)  # (K,)
+        det_total = np.asarray(state["det_total"], np.float64)
+        rec_thrs = self.rec_thresholds
+        iou_thrs = self.iou_thresholds
+        mdt = self.max_detection_thresholds
+        K, T, R = h_tp.shape[0], h_tp.shape[1], len(rec_thrs)
+        # cumulative counts from the top score cell down: column j covers
+        # scores >= edges[C-1-j] — exact boundary counts
+        tp_rev = h_tp[..., ::-1]
+        fp_rev = h_fp[..., ::-1]
+        TPc = np.cumsum(tp_rev, axis=-1)  # (K, T, C)
+        FPc = np.cumsum(fp_rev, axis=-1)
+        valid_cls = gt_total > 0
+        npig = np.maximum(gt_total, 1.0)[:, None, None]
+        rc = TPc / npig  # nondecreasing along the cell axis
+        pr = TPc / np.maximum(TPc + FPc, np.spacing(1))
+        # monotone precision envelope from the right (pycocotools accumulate)
+        pr_env = np.flip(np.maximum.accumulate(np.flip(pr, axis=-1), axis=-1), axis=-1)
+        C = pr.shape[-1]
+        precision = -np.ones((T, R, K))
+        recall = -np.ones((T, K))
+        for ki in range(K):
+            if not valid_cls[ki]:
+                continue
+            for ti in range(T):
+                inds = np.searchsorted(rc[ki, ti], rec_thrs, side="left")
+                hit = inds < C
+                safe = np.minimum(inds, C - 1)
+                precision[ti, :, ki] = np.where(hit, pr_env[ki, ti, safe], 0.0)
+            recall[:, ki] = tp_count[-1, ki] / gt_total[ki]
+        # data-dependent bound: within cell b the exact envelope can exceed
+        # the boundary precision by at most pmax_b - pmin_b (all of cell b's
+        # FP mass could sort below all of its TP mass)
+        denom_max = np.maximum(TPc + FPc - fp_rev, np.spacing(1))
+        diff = np.where(TPc + FPc > 0, TPc / denom_max - pr, 0.0)
+        per_kt = diff.max(axis=-1)  # (K, T)
+        bound = float(per_kt[valid_cls].mean()) if valid_cls.any() else 0.0
+        self.__dict__["_sketch_map_bound"] = bound
+
+        def _ap(sel: Optional[np.ndarray] = None) -> float:
+            s = precision if sel is None else precision[sel]
+            valid = s[s > -1]
+            return float(valid.mean()) if valid.size else -1.0
+
+        def _ar(tpc_row: np.ndarray) -> float:
+            # tpc_row: (K, T) — recall per class/thr at one maxDets cap
+            rr = np.where(gt_total[:, None] > 0, tpc_row / np.maximum(gt_total[:, None], 1.0), -1.0)
+            valid = rr[rr > -1]
+            return float(valid.mean()) if valid.size else -1.0
+
+        res: Dict[str, Any] = {
+            "map": _ap(),
+            "map_50": -1.0,
+            "map_75": -1.0,
+            "map_small": -1.0,
+            "map_medium": -1.0,
+            "map_large": -1.0,
+            f"mar_{mdt[0]}": _ar(tp_count[0]),
+            f"mar_{mdt[1]}": _ar(tp_count[1]),
+            f"mar_{mdt[2]}": _ar(tp_count[2]),
+            "mar_small": -1.0,
+            "mar_medium": -1.0,
+            "mar_large": -1.0,
+        }
+        for thr, key in ((0.5, "map_50"), (0.75, "map_75")):
+            sel = np.where(np.isclose(iou_thrs, thr))[0]
+            if len(sel):
+                res[key] = _ap(sel)
+
+        map_per_class: Union[float, np.ndarray] = -1.0
+        mar_per_class: Union[float, np.ndarray] = -1.0
+        if self.class_metrics and valid_cls.any():
+            per_cls_ap, per_cls_ar = [], []
+            for ki in np.where(valid_cls | (det_total > 0))[0]:
+                p = precision[:, :, ki]
+                valid = p[p > -1]
+                per_cls_ap.append(float(valid.mean()) if valid.size else -1.0)
+                rr = recall[:, ki]
+                valid_r = rr[rr > -1]
+                per_cls_ar.append(float(valid_r.mean()) if valid_r.size else -1.0)
+            map_per_class = np.asarray(per_cls_ap, np.float32)
+            mar_per_class = np.asarray(per_cls_ar, np.float32)
+
+        observed = np.where(valid_cls | (det_total > 0))[0]
+        out = {k: jnp.asarray(v, jnp.float32) for k, v in res.items()}
+        out["map_per_class"] = jnp.asarray(map_per_class, jnp.float32)
+        out[f"mar_{mdt[-1]}_per_class"] = jnp.asarray(mar_per_class, jnp.float32)
+        out["classes"] = (
+            jnp.asarray(observed.astype(np.int32).squeeze())
+            if observed.size
+            else jnp.asarray([], jnp.int32)
+        )
+        return out
+
+    def _gather_approx_provenance(self) -> Optional[Dict[str, Any]]:
+        """Accuracy-plane hook: the sketch route's provenance row with the
+        data-dependent mAP bound from the last ``compute()`` (grid ``eps``
+        until one has run)."""
+        if self._map_sketch is None:
+            return None
+        sketch = self._map_sketch
+        return {
+            "source": "gather_approx",
+            "kind": "sketch-map",
+            "bins": sketch.bins,
+            "eps": float(sketch.eps),
+            "classes": self.sketch_classes,
+            "bound": float(self.__dict__.get("_sketch_map_bound", sketch.eps)),
+        }

@@ -135,6 +135,29 @@ def _sketch_source(metric: Any) -> Optional[Dict[str, Any]]:
     return row
 
 
+def _gather_approx_source(metric: Any) -> Optional[Dict[str, Any]]:
+    """Gather-family approximation provenance (``approx="sketch"`` mAP,
+    ``approx="reservoir"`` text corpora): the metric itself owns the
+    data-dependent bound derivation, so the plane only asks for the row via
+    the ``_gather_approx_provenance`` hook and stamps the declared
+    ``approx_error`` as its budget.  Never raises — a hook failure simply
+    drops the source (the attestation stays conservative elsewhere)."""
+    hook = getattr(metric, "_gather_approx_provenance", None)
+    if hook is None:
+        return None
+    try:
+        row = hook()
+    except Exception:
+        _log.debug("gather_approx provenance failed for %r", metric, exc_info=True)
+        return None
+    if not row:
+        return None
+    row = dict(row)
+    row["source"] = "gather_approx"
+    row.setdefault("budget", getattr(metric, "approx_error", None))
+    return row
+
+
 def _compression_source(metric: Any, policy: Any) -> Optional[Dict[str, Any]]:
     if policy is None or policy.compression in (None, "none"):
         return None
@@ -312,6 +335,7 @@ def attest(
         src
         for src in (
             _sketch_source(metric),
+            _gather_approx_source(metric),
             _compression_source(metric, policy),
             _quorum_source(metric, n_devices),
         )

@@ -102,8 +102,17 @@ __all__ = [
 #: "gather_report"`` payloads from ``observability/gathers.py`` (live
 #: attribution, 8/16/64-chip projections, GatherAdvisor advice), ``kind:
 #: "gather_advice"`` JSONL ledger lines, the ``tm_tpu_gather_*`` Prometheus
-#: families, and the ``gather`` flight-recorder category.
-SCHEMA_VERSION = "1.10.0"
+#: families, and the ``gather`` flight-recorder category; 1.11 added the
+#: gather-plane *actuation* layer — ``kind: "gather_decision"`` ledger lines
+#: (GatherAdvisor propose/arm/commit/veto/rollback/audit transitions,
+#: interleaved seq-ordered with its ``gather_advice`` lines), a ``commits``
+#: block on advice payloads carrying measured post-commit byte cuts,
+#: committed-cut advice lines (``"<label>: <action> committed — measured
+#: cut <N> B/step"``), ``route``/``model_dcn_bytes`` fields on
+#: ``gather/<leaf>`` sync-bucket rows (two-stage ICI→DCN lowering), and the
+#: ``gather_approx`` attestation provenance source (sketch-mAP histogram
+#: and reservoir corpus-sample error bounds).
+SCHEMA_VERSION = "1.11.0"
 SCHEMA_MAJOR = int(SCHEMA_VERSION.split(".", 1)[0])
 
 

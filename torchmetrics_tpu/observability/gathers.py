@@ -1,5 +1,5 @@
 """Gather-plane observability: live cat-state attribution, pod-scale
-projection, and a report-only :class:`GatherAdvisor`.
+projection, and an actuating :class:`GatherAdvisor`.
 
 The psum family is fully instrumented (per-bucket measured timing, ring and
 two-stage byte models, residuals, ShardingAdvisor); this module does the same
@@ -26,15 +26,24 @@ per step instead of combining.  Three layers:
    5,402,880 bytes/chip/step at 64 chips from *live* data (the gather
    family's counterpart of the ShardingAdvisor's 33,570,840 psum-byte
    reproduction).
-3. **Report-only advice** — :class:`GatherAdvisor` ranks cat-state consumers
-   by projected pod-scale bytes and models both escape hatches: the
-   two-stage ICI-gather→DCN-exchange route (cross-host bytes scale with
+3. **Advice and actuation** — :class:`GatherAdvisor` ranks cat-state
+   consumers by projected pod-scale bytes and models both escape hatches:
+   the two-stage ICI-gather→DCN-exchange route (cross-host bytes scale with
    hosts, not chips — ``utilities.benchmark.two_stage_gather_bytes``, after
    arxiv 2204.06514) and the sketch-mode cut (a fixed-shape state rides the
-   psum family instead; where the sketch layer already ships one — e.g.
-   AUROC's ``thresholds=N`` binned mode — the advisor quotes it by name).
-   Every ``advise()`` lands in a ledger as a ``kind: "gather_advice"`` row,
-   exportable through the JSONL front door.
+   psum family instead; where the sketch layer already ships one — AUROC's
+   ``thresholds=N`` binned mode, mAP's ``approx="sketch"`` histograms, the
+   text metrics' ``approx="reservoir"`` corpus sample — the advisor quotes
+   it by name).  Every ``advise()`` lands in a ledger as a
+   ``kind: "gather_advice"`` row, exportable through the JSONL front door;
+   :meth:`GatherAdvisor.recommend` with ``apply=True`` promotes the advice
+   to an audited commit (observe→candidate→trial→committed, mirroring the
+   ShardingAdvisor): sketch-first candidates convert via
+   ``metric.set_approx``, two-stage candidates flip the accumulator route,
+   every transition lands as a ``kind: "gather_decision"`` row with a
+   rollback token behind it, ``guardrail_sink()`` wires health alerts to
+   veto or roll back, and ``retrace_report()`` audits the compile-cache
+   delta against the commit's expected new keys.
 
 Everything is double-gated: :func:`enable_gather_telemetry` arms the plane,
 but nothing records until ``observability.enable()`` is also on (mirroring
@@ -96,6 +105,8 @@ from torchmetrics_tpu.utilities.benchmark import (
 )
 
 __all__ = [
+    "APPROX_COMMITS",
+    "GATHER_DECISION_KIND",
     "GATHER_LEDGER_KIND",
     "GATHER_REPORT_KIND",
     "GatherAdvisor",
@@ -114,10 +125,14 @@ _log = logging.getLogger("torchmetrics_tpu.observability")
 #: ``kind`` stamp on every advisor ledger entry (JSONL consumers filter on it
 #: exactly like ``sharding_decision`` / ``autotune_decision``)
 GATHER_LEDGER_KIND = "gather_advice"
+#: ``kind`` stamp on every actuation state-machine transition the advisor
+#: ledgers (propose/arm/commit/veto/rollback/audit — the gather plane's
+#: counterpart of ``sharding_decision``)
+GATHER_DECISION_KIND = "gather_decision"
 #: ``kind`` stamp on the front-door report payload
 GATHER_REPORT_KIND = "gather_report"
 
-#: The sketch layer's existing fixed-shape alternatives, by base metric name
+#: The sketch layer's shipped fixed-shape alternatives, by base metric name
 #: (Binary/Multiclass/Multilabel prefixes are stripped by
 #: :func:`sketch_alternative_for`).  Each alternative replaces an unbounded
 #: cat state with a fixed-shape state that rides the psum family — per-step
@@ -139,13 +154,39 @@ SKETCH_ALTERNATIVES: Dict[str, str] = {
         "thresholds=N binned mode: fixed-shape confmat state rides the psum "
         "family instead of gathering raw scores"
     ),
+    "MeanAveragePrecision": (
+        'approx="sketch" score-histogram mode: fixed-shape per-(class, '
+        "IoU-bucket) histograms ride the psum family, bounded-error attested"
+    ),
+    "ROUGEScore": (
+        'approx="reservoir" bottom-k-by-hash corpus sample: ONE fixed-shape '
+        "gather regardless of corpus size, unsampled-mass bound attested"
+    ),
+    "BLEUScore": (
+        'approx="reservoir" bottom-k-by-hash corpus sample: fixed-shape '
+        "sentence-stat reservoir, unsampled-mass bound attested"
+    ),
+    "SacreBLEUScore": (
+        'approx="reservoir" bottom-k-by-hash corpus sample: fixed-shape '
+        "sentence-stat reservoir, unsampled-mass bound attested"
+    ),
+}
+
+#: The runtime switch :meth:`GatherAdvisor.commit` applies per metric class:
+#: ``Metric.set_approx(mode)`` converts the cat states to the sketch-backed
+#: fixed-shape family (one expected new-key compile miss per metric).
+APPROX_COMMITS: Dict[str, str] = {
+    "MeanAveragePrecision": "sketch",
+    "ROUGEScore": "reservoir",
+    "BLEUScore": "reservoir",
+    "SacreBLEUScore": "reservoir",
 }
 
 
 def sketch_alternative_for(cls_name: str) -> Optional[str]:
-    """The sketch layer's fixed-shape alternative for metric class
-    ``cls_name``, or ``None`` when none ships yet (mAP, ROUGE — ROADMAP
-    open item 5's sketch-backed variants)."""
+    """The sketch layer's shipped fixed-shape alternative for metric class
+    ``cls_name``, or ``None`` when none exists (the ``approx="sketch"`` /
+    ``approx="reservoir"`` modes cover mAP and the corpus text metrics)."""
     base = cls_name
     for prefix in ("Binary", "Multiclass", "Multilabel"):
         if base.startswith(prefix):
@@ -344,10 +385,23 @@ class GatherAdvisor:
     Candidates at or above ``sketch_first_bytes`` projected flat bytes are
     recommended ``"sketch-first"`` (the two-stage route still moves every
     byte once — only a sketch caps the linear-in-steps growth); smaller
-    consumers get ``"two-stage"``.  Advice never touches metric config:
-    actuation is ROADMAP open item 5.  Every :meth:`advise` lands in
+    consumers get ``"two-stage"``.  Every :meth:`advise` lands in
     :meth:`decision_ledger` as a ``kind: "gather_advice"`` row and mirrors
     into the flight recorder's ``gather`` category when armed.
+
+    :meth:`advise` never touches metric config.  :meth:`recommend` wraps it
+    in the established actuation state machine (``observe → candidate →
+    trial → committed``, mirroring :class:`~torchmetrics_tpu.observability.memory.ShardingAdvisor`):
+    a commit applies each sketch-first candidate's shipped runtime switch
+    (``Metric.set_approx`` per :data:`APPROX_COMMITS` — one expected
+    ``new-key`` compile miss per converted metric, audited by
+    :meth:`retrace_report`) and flips two-stage candidates' shared
+    :class:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync` onto the
+    ICI→DCN route (no new compile key — the crossing is host-side).  Health
+    alerts wired through :meth:`guardrail_sink` (including the accuracy
+    plane's shadow-exact audit breaches) veto a pending trial or roll back
+    a commit; every transition lands in the ledger as a
+    ``kind: "gather_decision"`` row.
     """
 
     def __init__(
@@ -356,6 +410,7 @@ class GatherAdvisor:
         n_local_devices: int = 8,
         granule: int = RING_GRANULE_BYTES,
         sketch_first_bytes: int = 1 << 20,
+        veto_severity: str = "warning",
     ) -> None:
         self.n_chips = int(n_chips)
         #: chips per host in the projected mesh (v4-8 host granularity);
@@ -366,8 +421,30 @@ class GatherAdvisor:
         #: sketch-first: two-stage still ships every byte once per step,
         #: only a fixed-shape sketch kills the linear-in-steps growth
         self.sketch_first_bytes = int(sketch_first_bytes)
+        #: health alerts at/above this severity veto a pending trial or roll
+        #: back a committed conversion (see :meth:`guardrail_sink`)
+        self.veto_severity = veto_severity
+        self.state = "observe"
         self._seq = 0
         self._ledger: List[Dict[str, Any]] = []
+        #: staged proposal: {"targets": [(label, obj, action, arg, pre_bps)]}
+        self._candidate: Optional[Dict[str, Any]] = None
+        #: rollback tokens for the committed targets
+        self._previous: Optional[List[Tuple[str, Any, str, Any]]] = None
+        #: the shared accumulator the last commit converted against, if any
+        self._commit_accumulator: Optional[Any] = None
+        self._commit_cache_baseline: Optional[Dict[str, Any]] = None
+        self._expected_retraces: Dict[str, Any] = {"new_keys": 0, "causes": []}
+        #: measured post-commit byte cuts, by metric label (advice lines
+        #: quoting a shipped alternative carry these once measured)
+        self._committed_cuts: Dict[str, Dict[str, Any]] = {}
+        self.counts: Dict[str, int] = {
+            "proposals": 0,
+            "trials": 0,
+            "commits": 0,
+            "vetoes": 0,
+            "rollbacks": 0,
+        }
 
     def advise(
         self,
@@ -382,9 +459,11 @@ class GatherAdvisor:
         n = int(n_chips or self.n_chips)
         n_local = min(self.n_local_devices, n)
         n_hosts = max(1, -(-n // n_local))
+        rows = _gather_rows(report)
+        commits = self._measure_commits(rows)
         candidates: List[Dict[str, Any]] = []
         total_flat = total_two_stage = 0
-        for label, row in sorted(_gather_rows(report).items()):
+        for label, row in sorted(rows.items()):
             g = row["gathers"]
             steps = max(int(g["steps"]), 1)
             bps = int(round(int(g["cat_bytes"]) / steps))
@@ -423,6 +502,24 @@ class GatherAdvisor:
         candidates.sort(
             key=lambda c: (-c["projected_flat_bytes_per_chip_per_step"], c["metric"])
         )
+        # advice lines: one per live candidate, plus one per committed
+        # conversion quoting a shipped alternative — the committed lines
+        # carry the measured post-commit byte cut once post-commit steps
+        # have been observed
+        recommended = [f"{c['metric']}: {c['recommendation']}" for c in candidates]
+        for label, cut in sorted(commits.items()):
+            if not cut.get("alternative"):
+                continue
+            if cut.get("measured"):
+                recommended.append(
+                    f"{label}: {cut['action']} committed — measured cut "
+                    f"{int(cut['cut_bytes_per_step'])} B/step"
+                )
+            else:
+                recommended.append(
+                    f"{label}: {cut['action']} committed — cut pending "
+                    "post-commit steps"
+                )
         advice = {
             "kind": GATHER_LEDGER_KIND,
             "seq": self._seq,
@@ -434,13 +531,13 @@ class GatherAdvisor:
             "total_projected_flat_bytes_per_chip_per_step": total_flat,
             "total_two_stage_dcn_bytes_per_chip_per_step": total_two_stage,
             "candidates": candidates,
-            "recommended": [
-                f"{c['metric']}: {c['recommendation']}" for c in candidates
-            ],
+            "commits": commits,
+            "recommended": recommended,
             "note": (
-                "report-only: cat states stay raw until open item 5's "
-                "sketch-backed variants / two-stage ragged topology land; "
-                "candidates ranked by projected flat bytes/chip/step"
+                "actuation via recommend(apply=True): sketch-first candidates "
+                "convert through Metric.set_approx, two-stage candidates flip "
+                "the shared DeferredRaggedSync route; candidates ranked by "
+                "projected flat bytes/chip/step"
             ),
         }
         self._seq += 1
@@ -464,9 +561,427 @@ class GatherAdvisor:
 
         return copy.deepcopy(advice)
 
+    def _measure_commits(
+        self, rows: Mapping[str, Mapping[str, Any]]
+    ) -> Dict[str, Dict[str, Any]]:
+        """Refresh each committed conversion's measured post-commit byte cut
+        from the current growth rows: cut = pre-commit bytes/step minus the
+        bytes/step observed *since* the commit (0 new gather bytes for a
+        sketch conversion — its states ride the psum family).  Returns a
+        deep-copyable ``{label: cut}`` block for the advice payload."""
+        for label, cut in self._committed_cuts.items():
+            row = rows.get(label)
+            steps_now = bytes_now = 0
+            if row is not None:
+                g = row["gathers"]
+                steps_now = int(g.get("steps", 0))
+                bytes_now = int(g.get("cat_bytes", 0))
+            d_steps = steps_now - int(cut["steps_at_commit"])
+            if d_steps > 0:
+                post = int(round((bytes_now - int(cut["bytes_at_commit"])) / d_steps))
+                cut["post_bytes_per_step"] = post
+                cut["cut_bytes_per_step"] = int(cut["pre_bytes_per_step"]) - post
+                cut["measured"] = True
+        import copy
+
+        return copy.deepcopy(self._committed_cuts)
+
+    # --------------------------------------------------------- actuation loop
+    def recommend(
+        self,
+        metrics: Iterable[Any],
+        n_chips: Optional[int] = None,
+        apply: bool = False,
+        targets: Optional[Iterable[str]] = None,
+        report: Optional[Mapping[str, Any]] = None,
+        accumulator: Optional[Any] = None,
+    ) -> Dict[str, Any]:
+        """:meth:`advise` promoted to a proposal: rank the candidates, stage
+        each one's shipped escape hatch, and (with ``apply=True``) arm and
+        commit them onto the live metrics.
+
+        ``metrics`` holds metric instances or ``(label, metric)`` pairs
+        (unlabelled metrics take their telemetry label); only candidates
+        matching a provided metric are staged.  ``targets`` restricts the
+        staged set to the named labels.  Sketch-first candidates whose class
+        ships a runtime switch (:data:`APPROX_COMMITS`) stage a
+        ``set_approx`` conversion; two-stage candidates stage a route flip
+        on ``accumulator`` (the shared
+        :class:`~torchmetrics_tpu.parallel.ragged.DeferredRaggedSync`) when
+        one is given.  Returns the advice payload extended with an
+        ``actuation`` block.  Without ``apply`` the machine stops in
+        ``candidate``: call :meth:`arm` then :meth:`commit` by hand, exactly
+        like the sharding advisor's staged flow.
+        """
+        pairs: List[Tuple[str, Any]] = []
+        for item in metrics:
+            if isinstance(item, tuple) and len(item) == 2 and isinstance(item[0], str):
+                pairs.append(item)
+            else:
+                t = registry.telemetry_for(item, create=False)
+                pairs.append((t.label if t is not None else type(item).__name__, item))
+        advice = self.advise(report=report, n_chips=n_chips)
+        by_label = dict(pairs)
+        wanted = set(targets) if targets is not None else None
+        staged: List[Tuple[str, Any, str, Any, int]] = []
+        route_staged = False
+        for c in advice["candidates"]:
+            label = c["metric"]
+            if wanted is not None and label not in wanted:
+                continue
+            metric = by_label.get(label)
+            if metric is None:
+                continue
+            mode = APPROX_COMMITS.get(str(c["class"]))
+            if c["recommendation"] == "sketch-first" and mode is not None:
+                staged.append((label, metric, "approx", mode, c["bytes_per_step"]))
+            elif c["recommendation"] == "two-stage" and accumulator is not None:
+                # the route is a property of the shared accumulator, not of
+                # any one metric: flip it once, attributed to the biggest
+                # two-stage consumer
+                if not route_staged:
+                    staged.append(
+                        (label, accumulator, "route", "two_stage", c["bytes_per_step"])
+                    )
+                    route_staged = True
+        prior = self.state
+        self._candidate = {"advice": advice, "targets": staged, "accumulator": accumulator}
+        self.state = "candidate"
+        self.counts["proposals"] += 1
+        self._record(
+            "propose",
+            state_from=prior,
+            targets=[f"{label}:{action}={arg}" for label, _, action, arg, _ in staged],
+            trigger={
+                "n_chips": advice["n_chips"],
+                "total_projected_flat_bytes_per_chip_per_step": advice[
+                    "total_projected_flat_bytes_per_chip_per_step"
+                ],
+            },
+            rationale=(
+                f"staged {len(staged)} gather escape hatch(es): sketch-first "
+                "converts via set_approx, two-stage flips the deferred-gather route"
+            ),
+        )
+        out = dict(advice)
+        out["actuation"] = {
+            "state": self.state,
+            "targets": [f"{label}:{action}={arg}" for label, _, action, arg, _ in staged],
+            "applied": False,
+        }
+        if apply:
+            self.arm()
+            entry = self.commit()
+            out["actuation"] = {
+                "state": self.state,
+                "targets": entry["targets"],
+                "applied": bool(entry["applied"]),
+                "skipped": entry["trigger"].get("skipped", []),
+                "expected_retraces": entry.get("expected_retraces"),
+            }
+        return out
+
+    def arm(self) -> Dict[str, Any]:
+        """Stage the proposed conversions for commit: enter ``trial``, during
+        which any guardrail alert vetoes the pending actuation."""
+        if self.state != "candidate" or self._candidate is None:
+            raise RuntimeError(
+                f"GatherAdvisor.arm: no candidate to stage (state {self.state!r}); "
+                "call recommend() first"
+            )
+        self.state = "trial"
+        self.counts["trials"] += 1
+        return self._record(
+            "arm",
+            state_from="candidate",
+            targets=[
+                f"{label}:{action}={arg}"
+                for label, _, action, arg, _ in self._candidate["targets"]
+            ],
+            rationale="candidate conversions staged; guardrails may veto until commit()",
+        )
+
+    def commit(self) -> Dict[str, Any]:
+        """Apply the staged conversions to the live objects.
+
+        ``approx`` targets go through ``Metric.set_approx`` — a metric that
+        refuses (no runtime-switch hook, invalid mode for its config) is
+        skipped and recorded, never silently forced; ``route`` targets flip
+        the shared accumulator's gather route.  The compile-cache baseline
+        is captured first so :meth:`retrace_report` can prove the transition
+        cost exactly its expected one ``new-key`` miss per converted metric
+        (route flips are host-side and expect none) and nothing more — 0
+        steady-state retraces."""
+        if self.state != "trial" or self._candidate is None:
+            raise RuntimeError(
+                f"GatherAdvisor.commit: no staged trial (state {self.state!r}) — "
+                "it may have been vetoed by a guardrail; check decision_ledger()"
+            )
+        from torchmetrics_tpu.core.compile import cache_stats
+
+        self._commit_cache_baseline = cache_stats()
+        rows = _gather_rows(None)
+        previous: List[Tuple[str, Any, str, Any]] = []
+        applied: List[str] = []
+        skipped: List[Dict[str, str]] = []
+        converted: set = set()
+        accumulator = self._candidate.get("accumulator")
+        for label, obj, action, arg, pre_bps in self._candidate["targets"]:
+            try:
+                if action == "approx":
+                    old = (obj.approx, obj.approx_error)
+                    obj.set_approx(arg)
+                    converted.add(id(obj))
+                    if accumulator is not None:
+                        # the old-layout exact partials cannot merge with
+                        # post-conversion updates; drop them at the boundary
+                        for key, member in accumulator._members.items():
+                            if member is obj:
+                                accumulator.reset_for(key)
+                else:
+                    old = obj.set_route(arg)
+            except (ValueError, KeyError) as err:
+                skipped.append({"target": f"{label}:{action}={arg}", "error": str(err)})
+                continue
+            previous.append((label, obj, action, old))
+            applied.append(f"{label}:{action}={arg}")
+            if action == "approx":
+                alternative = sketch_alternative_for(type(obj).__name__)
+            else:
+                alternative = (
+                    "two-stage route: in-host ICI all-gather then one per-host "
+                    "DCN exchange (cross-host bytes scale with hosts, not chips)"
+                )
+            g = rows.get(label, {}).get("gathers", {})
+            self._committed_cuts[label] = {
+                "action": f"{action}={arg}",
+                "alternative": alternative,
+                "pre_bytes_per_step": int(pre_bps),
+                "steps_at_commit": int(g.get("steps", 0)),
+                "bytes_at_commit": int(g.get("cat_bytes", 0)),
+                "post_bytes_per_step": None,
+                "cut_bytes_per_step": None,
+                "measured": False,
+            }
+        expected = {
+            # set_approx re-registers leaves and bumps the config
+            # fingerprint: exactly one new-key/invalidation miss per
+            # converted metric; route flips change no compile key
+            "new_keys": len(converted),
+            "causes": ["invalidation", "new-key"] if converted else [],
+            "entrypoint": None,
+        }
+        self._previous = previous
+        self._commit_accumulator = accumulator
+        self._expected_retraces = expected
+        self.state = "committed"
+        self.counts["commits"] += 1
+        entry = self._record(
+            "commit",
+            state_from="trial",
+            targets=applied,
+            applied=bool(applied),
+            trigger={"applied": applied, "skipped": skipped},
+            expected_retraces=expected,
+            rationale=(
+                f"applied {len(applied)} gather escape hatch(es); each approx "
+                "conversion re-fingerprints its metric for exactly one new-key "
+                "compile per entrypoint"
+                if applied
+                else "no target accepted a conversion; nothing applied"
+            ),
+        )
+        self._candidate = None
+        return entry
+
+    def veto(self, reason: str = "manual", alert: Optional[Any] = None) -> Dict[str, Any]:
+        """Veto the pending trial (guardrails call this through
+        :meth:`guardrail_sink`; callers may veto manually)."""
+        if self.state != "trial":
+            raise RuntimeError(
+                f"GatherAdvisor.veto: no pending trial to veto (state {self.state!r})"
+            )
+        return self._veto(reason, alert=alert)
+
+    def rollback(
+        self,
+        reason: str = "manual",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Restore every committed target's previous config (``set_approx``
+        back to the exact cat states, the accumulator back to its previous
+        route) and ledger why.  A shadow-exact audit breach arriving through
+        :meth:`guardrail_sink` lands here — sketch commits whose error
+        attestation fails roll back to exact."""
+        if self.state != "committed" or self._previous is None:
+            raise RuntimeError(
+                f"GatherAdvisor.rollback: nothing committed to roll back "
+                f"(state {self.state!r})"
+            )
+        restored = []
+        accumulator = getattr(self, "_commit_accumulator", None)
+        for label, obj, action, old in self._previous:
+            if action == "approx":
+                obj.set_approx(old[0], old[1])
+                if accumulator is not None:
+                    # post-conversion sketch partials cannot merge with the
+                    # restored exact layout either — same boundary, reversed
+                    for key, member in accumulator._members.items():
+                        if member is obj:
+                            accumulator.reset_for(key)
+            else:
+                obj.set_route(old)
+            self._committed_cuts.pop(label, None)
+            restored.append(f"{label}:{action}")
+        self.counts["rollbacks"] += 1
+        entry = self._record(
+            "rollback",
+            state_from="committed",
+            state_to="observe",
+            targets=restored,
+            applied=True,
+            alert=alert,
+            error=error,
+            rationale=f"rolled back committed gather conversion(s): {reason}",
+        )
+        self.state = "observe"
+        self._previous = None
+        return entry
+
+    def guardrail_sink(self, min_severity: Optional[str] = None) -> Any:
+        """An ``AlertSink`` wiring :class:`~torchmetrics_tpu.observability.health.HealthMonitor`
+        alerts into the loop: alerts at/above ``min_severity`` (default the
+        advisor's ``veto_severity``) veto a pending trial or roll back a
+        committed conversion, in-band — the same guardrail contract as the
+        sharding advisor's.  Shadow-exact audit breaches surfaced as health
+        alerts flow through the same sink."""
+        from torchmetrics_tpu.observability.health import CallbackAlertSink, _severity_rank
+
+        severity = self.veto_severity if min_severity is None else min_severity
+        _severity_rank(severity)  # validates
+        return CallbackAlertSink(self._on_alert, min_severity=severity)
+
+    def _on_alert(self, alert: Any) -> None:
+        if self.state == "trial":
+            self._veto("health_alert", alert=alert)
+        elif self.state == "committed" and self._previous is not None:
+            self.rollback(reason="health_alert", alert=alert)
+
+    def _veto(
+        self, reason: str, alert: Optional[Any] = None, error: Optional[str] = None
+    ) -> Dict[str, Any]:
+        staged = self._candidate["targets"] if self._candidate else []
+        self.counts["vetoes"] += 1
+        entry = self._record(
+            "veto",
+            state_from=self.state,
+            state_to="observe",
+            targets=[f"{label}:{action}={arg}" for label, _, action, arg, _ in staged],
+            applied=False,
+            alert=alert,
+            error=error,
+            rationale=f"pending gather conversion vetoed: {reason}",
+        )
+        self.state = "observe"
+        self._candidate = None
+        return entry
+
+    def retrace_report(self) -> Dict[str, Any]:
+        """Compile-cache delta since the last commit, judged against the
+        ledgered expectation — the proof that a gather conversion costs
+        exactly one ``new-key`` miss per converted metric and that steady
+        state re-traces **zero** times.  Ledgered as an ``audit`` decision."""
+        from torchmetrics_tpu.core.compile import cache_stats_since
+
+        if self._commit_cache_baseline is None:
+            raise RuntimeError("GatherAdvisor.retrace_report: no commit to audit")
+        delta = cache_stats_since(self._commit_cache_baseline)
+        delta_causes = delta["miss_causes"]
+        extra_misses = int(delta["misses"])
+        expected = self._expected_retraces
+        ok = (
+            extra_misses <= expected["new_keys"]
+            and sum(delta_causes.values()) <= expected["new_keys"]
+            and all(cause in expected["causes"] for cause in delta_causes)
+        )
+        audit = {
+            "extra_traces": int(delta["traces"]),
+            "extra_misses": extra_misses,
+            "miss_causes": delta_causes,
+            "expected": dict(expected),
+            "ok": bool(ok),
+        }
+        self._record(
+            "audit",
+            state_from=self.state,
+            state_to=self.state,
+            trigger=audit,
+            rationale=(
+                "trace-safety audit: cache delta since commit matches the "
+                "ledgered expectation"
+                if ok
+                else "trace-safety audit FAILED: unexpected compile-cache "
+                "traffic since gather conversion commit"
+            ),
+        )
+        return audit
+
+    def _record(
+        self,
+        action: str,
+        state_from: str,
+        state_to: Optional[str] = None,
+        targets: Optional[List[str]] = None,
+        applied: Optional[bool] = None,
+        trigger: Optional[Mapping[str, Any]] = None,
+        rationale: str = "",
+        alert: Optional[Any] = None,
+        error: Optional[str] = None,
+        expected_retraces: Optional[Mapping[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        import copy
+
+        entry: Dict[str, Any] = {
+            "kind": GATHER_DECISION_KIND,
+            "seq": self._seq,
+            "action": action,
+            "state_from": state_from,
+            "state_to": self.state if state_to is None else state_to,
+            "targets": list(targets or []),
+            "applied": bool(applied) if applied is not None else None,
+            "trigger": dict(trigger) if trigger else {},
+            "rationale": rationale,
+        }
+        if alert is not None:
+            entry["alert"] = alert.as_dict() if hasattr(alert, "as_dict") else dict(alert)
+        if error is not None:
+            entry["error"] = error
+        if expected_retraces is not None:
+            entry["expected_retraces"] = dict(expected_retraces)
+        self._seq += 1
+        self._ledger.append(entry)
+        registry.gather_trace(
+            "_advisor",
+            f"decision/{action}",
+            {"seq": entry["seq"], "state_to": entry["state_to"], "targets": entry["targets"]},
+        )
+        return copy.deepcopy(entry)
+
+    def report(self) -> Dict[str, Any]:
+        """The actuation block for the export front door."""
+        return {
+            "state": self.state,
+            "counts": dict(self.counts),
+            "decisions": len(self._ledger),
+            "expected_retraces": dict(self._expected_retraces),
+        }
+
     def decision_ledger(self) -> List[Dict[str, Any]]:
-        """Every advice payload this advisor produced, oldest first —
-        stable schema (``kind == "gather_advice"``), safe to mutate."""
+        """Every entry this advisor produced, oldest first — advice payloads
+        (``kind == "gather_advice"``) interleaved with actuation transitions
+        (``kind == "gather_decision"``) in one seq-ordered stream, safe to
+        mutate."""
         import copy
 
         return copy.deepcopy(self._ledger)

@@ -436,9 +436,11 @@ class MetricTelemetry:
             "model_naive_bytes": 0,
             "model_ring_bytes": 0,
             "model_raw_bytes": 0,
+            "model_dcn_bytes": 0,
             "quant_rel_err_sum": 0.0,
             "quant_err_count": 0,
             "compression": "none",
+            "route": "flat",
         }
 
     def record_bucket(
@@ -1116,6 +1118,9 @@ def record_measured_gather(
     leaf_sizes: Mapping[str, Tuple[int, int]],
     n_devices: int,
     seconds: float,
+    route: str = "flat",
+    n_hosts: Optional[int] = None,
+    n_local_devices: Optional[int] = None,
 ) -> None:
     """Attribute one *measured* ragged gather window (block-until-ready wall
     time at the host boundary) to ``obj``'s per-bucket table, the way
@@ -1128,30 +1133,54 @@ def record_measured_gather(
     (``utilities.benchmark.tiled_allgather_bytes``) — so exporters can show
     the measured-vs-model residual per gather bucket.  The whole window also
     lands in the owner's span stats as ``gather_measured``.  Same double
-    gate as :func:`record_cat_growth`.  Never raises."""
+    gate as :func:`record_cat_growth`.  Never raises.
+
+    ``route`` stamps the lowering the sync committed to.  Under
+    ``route="two_stage"`` (``parallel.ragged``'s ICI→DCN lowering;
+    ``n_hosts``/``n_local_devices`` describe the topology) the row's wire
+    model switches to ``utilities.benchmark.two_stage_gather_bytes``: the
+    ring model becomes the two-stage total (ICI + DCN per chip) and the DCN
+    share lands in ``model_dcn_bytes`` — cross-host bytes scale with hosts,
+    not chips — so the residual against ``measured_us`` prices the route
+    actually taken."""
     if not _ENABLED or not _GATHER_ARMED:
         return
-    rows: List[Tuple[str, int, int, int]] = []
+    rows: List[Tuple[str, int, int, int, int]] = []
     try:
-        from torchmetrics_tpu.utilities.benchmark import tiled_allgather_bytes
+        from torchmetrics_tpu.utilities.benchmark import (
+            tiled_allgather_bytes,
+            two_stage_gather_bytes,
+        )
 
         n = max(int(n_devices), 1)
+        two_stage = route == "two_stage" and n_hosts is not None and n_local_devices
         for leaf, (elems, nbytes) in leaf_sizes.items():
             naive_b = (n - 1) * int(nbytes)
-            ring_b = int(tiled_allgather_bytes(int(nbytes), n))
-            rows.append((f"gather/{leaf}", int(elems), naive_b, ring_b))
+            if two_stage:
+                stages = two_stage_gather_bytes(
+                    int(nbytes), max(int(n_hosts), 1), int(n_local_devices)
+                )
+                ring_b = int(stages["two_stage"]) + int(stages["ici"])
+                dcn_b = int(stages["two_stage"])
+            else:
+                ring_b = int(tiled_allgather_bytes(int(nbytes), n))
+                dcn_b = 0
+            rows.append((f"gather/{leaf}", int(elems), naive_b, ring_b, dcn_b))
     except Exception:
         _log.debug("measured gather attribution failed for %r", obj, exc_info=True)
     total_ring = sum(r[3] for r in rows)
     with _LOCK:
         t = telemetry_for(obj)
         t.record_span("gather_measured", seconds)
-        for key, elements, naive_b, ring_b in rows:
+        for key, elements, naive_b, ring_b, dcn_b in rows:
             if total_ring > 0:
                 share = seconds * ring_b / total_ring
             else:  # degenerate (1 device / empty leaves): split evenly
                 share = seconds / len(rows)
             t.record_bucket(key, elements, share, naive_b, ring_b, raw_bytes=ring_b)
+            row = t.sync_buckets[key]
+            row["route"] = str(route)
+            row["model_dcn_bytes"] = int(row.get("model_dcn_bytes", 0)) + dcn_b
     if _SPAN_SINK is not None:
         _SPAN_SINK(t.label, "gather_measured", seconds)
     sink = _GATHER_TRACE_SINK

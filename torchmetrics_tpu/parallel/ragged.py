@@ -53,6 +53,45 @@ State = Dict[str, Any]
 _N = "_n"
 _NONFINITE = "_nonfinite"
 
+#: gather-family lowering routes: ``"flat"`` crosses every chip's shard in
+#: one mesh-wide tiled all-gather; ``"two_stage"`` all-gathers over ICI
+#: inside each host first, then exchanges ONE aggregated copy per host over
+#: DCN — cross-host bytes scale with hosts, not chips
+#: (``utilities.benchmark.two_stage_gather_bytes``, arxiv 2204.06514).
+GATHER_ROUTES = ("flat", "two_stage")
+
+
+def _host_combine(reduce: Any, gathered: np.ndarray) -> Any:
+    """Apply one leaf's reduction to its DCN-gathered ``(n_hosts, ...)``
+    stack — the injectable-allgather counterpart of
+    :func:`core.reductions.host_sync_leaf` (which hardwires
+    ``process_allgather``)."""
+    from torchmetrics_tpu.core.reductions import SketchReduce
+
+    g = jnp.asarray(gathered)
+    if isinstance(reduce, SketchReduce):
+        if reduce.bucket_op == "sum":
+            return g.sum(0)
+        if reduce.bucket_op == "max":
+            return g.max(0)
+        if reduce.bucket_op == "min":
+            return g.min(0)
+        return reduce.combine_stacked(g)
+    if callable(reduce) and not isinstance(reduce, Reduce):
+        return reduce(g)
+    if reduce == Reduce.SUM:
+        return g.sum(0)
+    if reduce == Reduce.MEAN:
+        return g.mean(0)
+    if reduce == Reduce.MAX:
+        return g.max(0)
+    if reduce == Reduce.MIN:
+        return g.min(0)
+    raise ValueError(
+        f"two-stage DCN exchange cannot combine scalar reduction {reduce!r}; "
+        "gather-family leaves cross as flat buffers, not scalars"
+    )
+
 
 def _pack_items(
     items: Sequence[Any], max_trailing: Tuple[int, ...], dtype
@@ -150,6 +189,9 @@ def sync_ragged_states(
     verify_consistency: bool = False,
     owner: Any = None,
     value_ranges: Optional[Mapping[str, Tuple[float, float]]] = None,
+    route: str = "flat",
+    n_processes: Optional[int] = None,
+    dcn_allgather: Optional[Callable[[Any], Any]] = None,
 ) -> State:
     """Combine per-device states whose list leaves are ragged, via one
     in-graph pad-gather-trim per state name.
@@ -174,7 +216,25 @@ def sync_ragged_states(
     — derived from the declaration, never the data — so the gather trace
     stays cache-stable; declared ranges are a contract, validated against
     the data only under ``verify_consistency=True``.
+
+    ``route`` picks the gather lowering (:data:`GATHER_ROUTES`).  ``"flat"``
+    (default) crosses every chip's shard in the mesh-wide tiled all-gather
+    above.  ``"two_stage"`` keeps that gather *inside the host* (ICI) and
+    follows it with ONE per-host exchange over DCN: each host ships its
+    aggregated copy once, so cross-host bytes scale with hosts, not chips
+    (``utilities.benchmark.two_stage_gather_bytes``'s model; scalar leaves
+    re-reduce host-side the way ``coalesced_host_sync`` does).
+    ``n_processes``/``dcn_allgather`` are injectable for single-process
+    testing, defaulting to ``jax.process_count()`` and
+    ``multihost_utils.process_allgather``; with one process the DCN stage
+    is skipped and both routes lower identically.
     """
+    if route not in GATHER_ROUTES:
+        raise ValueError(f"Arg `route` must be one of {GATHER_ROUTES}, got {route!r}")
+    if route == "two_stage":
+        n_proc = jax.process_count() if n_processes is None else int(n_processes)
+    else:
+        n_proc = 1
     n_dev = int(mesh.devices.size)
     if int(mesh.shape[axis_name]) != n_dev:
         # the gather shards stacked buffers over axis_name only; on a
@@ -338,6 +398,30 @@ def sync_ragged_states(
     # `owner=None` lands the sync in the `_unattributed` telemetry row rather
     # than double-counting against a metric some outer caller already credits
     _telemetry.record_sync(owner, reductions, dict(per_device_states[0]), n_dev)
+
+    # ---- stage 2 (two_stage route): ONE aggregated copy per host over DCN —
+    # the gather-family counterpart of coalesced_host_sync's bucket exchange.
+    # Scalar leaves are already ICI-reduced, so they re-reduce host-side;
+    # flat buffers concatenate host-major, extending the device-major carve
+    # below to world rank order.
+    g_host = {key: np.asarray(v) for key, v in g_flats.items()}
+    n_total = n_dev
+    if n_proc > 1:
+        if dcn_allgather is None:  # pragma: no cover - exercised on real multi-host
+            from jax.experimental import multihost_utils
+
+            dcn_allgather = multihost_utils.process_allgather
+        g_host = {
+            key: np.asarray(dcn_allgather(buf)).reshape(-1) for key, buf in g_host.items()
+        }
+        g_scalars = {
+            name: _host_combine(
+                reductions[name], np.asarray(dcn_allgather(np.asarray(g_scalars[name])))
+            )
+            for name in scalar_names
+        }
+        g_n = jnp.asarray(np.asarray(dcn_allgather(np.asarray(g_n))).sum(0))
+        n_total = n_dev * n_proc
     if measuring:
         measured_s = time.perf_counter() - t0  # tmt: ignore[TMT006] -- measured gather cost at the host boundary; outside any traced graph
         # one row per ragged leaf, sized at its per-chip padded wire block
@@ -353,21 +437,28 @@ def sync_ragged_states(
         if sorted_ragged:
             tab = sum(shape_block[nm] for nm in sorted_ragged)
             leaf_sizes["shapes"] = (tab, tab * 4)
-        _telemetry.record_measured_gather(owner, leaf_sizes, n_dev, measured_s)
+        _telemetry.record_measured_gather(
+            owner,
+            leaf_sizes,
+            n_total,
+            measured_s,
+            route=route,
+            n_hosts=n_proc,
+            n_local_devices=n_dev,
+        )
         # same window, process-wide: the fleet plane's straggler
         # attribution compares this digest across hosts
         _telemetry.record_sync_wait(measured_s)
 
     # ---- carve each name's per-device blocks back out of the gathered flats
-    g_host = {key: np.asarray(v) for key, v in g_flats.items()}
     rebuilt: Dict[str, np.ndarray] = {}
     for dtype_str, group in sorted(by_dtype.items()):
         seg_len = sum(block_size[nm] for nm in group)
         flat = g_host[f"items_{dtype_str}"]
         for nm in group:
             trail = packed[nm][0].shape[1:]
-            rebuilt[nm] = np.empty((n_dev * packed[nm][2], *trail), np.dtype(dtype_str))
-        for d in range(n_dev):
+            rebuilt[nm] = np.empty((n_total * packed[nm][2], *trail), np.dtype(dtype_str))
+        for d in range(n_total):
             off = d * seg_len
             for nm in group:
                 L = packed[nm][2]
@@ -380,8 +471,8 @@ def sync_ragged_states(
         tab_len = sum(shape_block[nm] for nm in sorted_ragged)
         shp = g_host["shapes"]
         for nm in sorted_ragged:
-            shape_tabs[nm] = np.empty((n_dev * packed[nm][3], packed[nm][1].shape[1]), np.int32)
-        for d in range(n_dev):
+            shape_tabs[nm] = np.empty((n_total * packed[nm][3], packed[nm][1].shape[1]), np.int32)
+        for d in range(n_total):
             off = d * tab_len
             for nm in sorted_ragged:
                 K, ndim = packed[nm][3], packed[nm][1].shape[1]
@@ -403,7 +494,7 @@ def sync_ragged_states(
             buf = buf.astype(unpacked_dtype[name])
         shape_tab = shape_tabs[name]
         items: List[np.ndarray] = []
-        for d in range(n_dev):
+        for d in range(n_total):
             dev_shapes = shape_tab[d * K : (d + 1) * K]
             dev_shapes = dev_shapes[dev_shapes[:, 0] >= 0]
             offset = d * L
@@ -498,19 +589,47 @@ class DeferredRaggedSync:
         mesh: Optional[Mesh] = None,
         axis_name: str = "data",
         verify_consistency: bool = False,
+        route: str = "flat",
+        n_processes: Optional[int] = None,
+        dcn_allgather: Optional[Callable[[Any], Any]] = None,
     ) -> None:
         from torchmetrics_tpu.parallel.sync import metric_mesh
 
         self.mesh = mesh if mesh is not None else metric_mesh(axis_name=axis_name)
         self.axis_name = axis_name
         self.verify_consistency = verify_consistency
+        if route not in GATHER_ROUTES:
+            raise ValueError(f"Arg `route` must be one of {GATHER_ROUTES}, got {route!r}")
+        #: gather lowering for :meth:`sync` — ``"flat"`` or ``"two_stage"``
+        #: (:data:`GATHER_ROUTES`); flip at runtime with :meth:`set_route`
+        self.route = route
+        #: injectable DCN seam (``coalesced_host_sync``'s contract): default
+        #: ``jax.process_count()`` / ``multihost_utils.process_allgather``
+        self.n_processes = n_processes
+        self.dcn_allgather = dcn_allgather
         self._members: Dict[str, Any] = {}  # insertion-ordered
         self._per_device: Dict[str, Optional[List[State]]] = {}
         if metric is not None:
             self.register(metric)
 
+    def set_route(self, route: str) -> str:
+        """Switch the gather lowering for subsequent :meth:`sync` calls;
+        returns the previous route (the GatherAdvisor's rollback token).
+        Accumulated per-device states are untouched — only the crossing
+        changes."""
+        if route not in GATHER_ROUTES:
+            raise ValueError(f"Arg `route` must be one of {GATHER_ROUTES}, got {route!r}")
+        previous, self.route = self.route, route
+        return previous
+
     def register(self, metric: "Metric", name: Optional[str] = None) -> str:  # noqa: F821
-        """Add a metric to the shared deferred gather; returns its key."""
+        """Add a metric to the shared deferred gather; returns its key.
+
+        Idempotent per metric object: registering the SAME metric again
+        under its existing name (a snapshot→restore path re-running setup)
+        is a no-op returning the original key — the accumulated per-device
+        states are kept and nothing double-gathers.  Registering a
+        *different* metric under an occupied name raises."""
         from torchmetrics_tpu.core.metric import Metric
 
         if type(metric).sync_states is not Metric.sync_states:
@@ -520,11 +639,15 @@ class DeferredRaggedSync:
             )
         if name is None:
             name = type(metric).__name__
-            if name in self._members:
+            if name in self._members and self._members[name] is not metric:
                 name = f"{name}_{len(self._members)}"
         if name in self._members:
+            if self._members[name] is metric:
+                return name  # same metric, same name: setup re-ran, keep state
             raise ValueError(
-                f"a metric is already registered under {name!r}; pass an explicit unique name"
+                f"a different {type(self._members[name]).__name__} is already registered "
+                f"under {name!r}; pass an explicit unique name (re-registering the SAME "
+                "metric object is a no-op, but two metrics cannot share a telemetry owner name)"
             )
         if "::" in name:
             raise ValueError(f"metric name {name!r} may not contain '::' (the namespace separator)")
@@ -622,6 +745,9 @@ class DeferredRaggedSync:
                 verify_consistency=self.verify_consistency,
                 owner=m,
                 value_ranges=getattr(m, "_value_ranges", None),
+                route=self.route,
+                n_processes=self.n_processes,
+                dcn_allgather=self.dcn_allgather,
             )
         n_dev = int(self.mesh.devices.size)
         if self.verify_consistency:
@@ -646,7 +772,15 @@ class DeferredRaggedSync:
         # owner=None: the sync spans several metrics, so it lands in the
         # `_unattributed` telemetry row instead of crediting one of them
         synced = sync_ragged_states(
-            table, combined, self.mesh, self.axis_name, owner=None, value_ranges=ranges
+            table,
+            combined,
+            self.mesh,
+            self.axis_name,
+            owner=None,
+            value_ranges=ranges,
+            route=self.route,
+            n_processes=self.n_processes,
+            dcn_allgather=self.dcn_allgather,
         )
         out: Dict[str, State] = {}
         for key in self._members:
@@ -665,3 +799,13 @@ class DeferredRaggedSync:
 
     def reset(self) -> None:
         self._per_device = {key: None for key in self._members}
+
+    def reset_for(self, name: str) -> None:
+        """Drop one member's accumulated per-device states (the others keep
+        theirs).  The GatherAdvisor calls this when committing an approx
+        conversion mid-run: ``set_approx`` rebuilds the metric's leaves, so
+        the exact partials accumulated under the old layout cannot merge
+        with post-conversion updates."""
+        if name not in self._members:
+            raise KeyError(f"no metric registered under {name!r} (have {sorted(self._members)})")
+        self._per_device[name] = None

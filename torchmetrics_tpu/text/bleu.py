@@ -1,8 +1,18 @@
-"""BLEU / SacreBLEU modular metrics (reference: text/bleu.py:33, text/sacre_bleu.py:34)."""
+"""BLEU / SacreBLEU modular metrics (reference: text/bleu.py:33, text/sacre_bleu.py:34).
+
+Exact BLEU is already gather-free — its states are fixed-shape per-order
+sums.  ``approx="reservoir"`` additionally bounds the *per-sentence* stat
+rows at ``sample_size`` via a deterministic bottom-k-by-hash corpus sample
+and estimates the corpus sums by reweighting the kept rows with
+``total_seen / kept`` — useful when the corpus-sample provenance (which
+sentences drove the score) must ship along with the value.  The stamped
+data-dependent bound is the unsampled-mass fraction ``(n - k)/n`` (0 while
+the corpus fits the reservoir).
+"""
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Union
+from typing import Any, Dict, Optional, Sequence, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -11,6 +21,7 @@ from jax import Array
 from torchmetrics_tpu.core.metric import Metric, State
 from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
 from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from torchmetrics_tpu.sketches.reservoir import ReservoirSketch
 
 
 class BLEUScore(Metric):
@@ -37,6 +48,7 @@ class BLEUScore(Metric):
         n_gram: int = 4,
         smooth: bool = False,
         weights: Optional[Sequence[float]] = None,
+        sample_size: int = 1024,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,17 +58,39 @@ class BLEUScore(Metric):
             raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
         self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
         self._tokenizer = _tokenize_fn
+        if not (isinstance(sample_size, int) and sample_size >= 1):
+            raise ValueError(f"Argument `sample_size` must be a positive int, got {sample_size!r}")
+        #: reservoir capacity under ``approx="reservoir"`` (sentence rows kept)
+        self.sample_size = sample_size
+        self._install_approx_states()
 
+    def _install_approx_states(self) -> None:
+        """(Re-)register state leaves for the current ``approx`` config —
+        the :meth:`~torchmetrics_tpu.core.metric.Metric.set_approx` hook."""
+        if self.approx == "reservoir":
+            # one row per sentence: [preds_len, target_len, numerator(n), denominator(n)]
+            self._reservoir = ReservoirSketch(
+                capacity=self.sample_size, fields=2 + 2 * self.n_gram
+            )
+            self.add_state(
+                "corpus_sample", self._reservoir.init(),
+                dist_reduce_fx=self._reservoir.reduce_spec,
+            )
+            self.add_state("samples_total", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            return
+        self._reservoir = None
         self.add_state("preds_len", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("target_len", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("numerator", jnp.zeros(n_gram), dist_reduce_fx="sum")
-        self.add_state("denominator", jnp.zeros(n_gram), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
 
     def _update(self, state: State, preds: Union[str, Sequence[str]], target: Sequence) -> State:
         preds_ = [preds] if isinstance(preds, str) else list(preds)
         target_ = [[t] if isinstance(t, str) else list(t) for t in target]
         if len(preds_) != len(target_):
             raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        if self._reservoir is not None:
+            return self._update_reservoir(state, preds_, target_)
         numerator = np.asarray(state["numerator"]).copy()  # tmt: ignore[TMT003] -- host-side text metric: n-gram counting runs on host arrays
         denominator = np.asarray(state["denominator"]).copy()  # tmt: ignore[TMT003] -- host-side text metric: n-gram counting runs on host arrays
         preds_len, target_len = _bleu_score_update(
@@ -71,12 +105,66 @@ class BLEUScore(Metric):
             "denominator": jnp.asarray(denominator),
         }
 
+    def _update_reservoir(self, state: State, preds_: list, target_: list) -> State:
+        from torchmetrics_tpu.text.rouge import content_key
+
+        n = len(preds_)
+        records = np.zeros((n, self._reservoir.fields), np.float32)
+        keys = np.zeros((n,), np.uint32)
+        for i, (p, t) in enumerate(zip(preds_, target_)):
+            num = np.zeros(self.n_gram)
+            den = np.zeros(self.n_gram)
+            p_len, t_len = _bleu_score_update(
+                [p], [t], num, den, 0.0, 0.0, self.n_gram, self._tokenizer
+            )
+            records[i] = np.concatenate([[p_len, t_len], num, den])
+            keys[i] = content_key(p)
+        return {
+            "corpus_sample": self._reservoir.insert_batch(
+                state["corpus_sample"], jnp.asarray(records), jnp.asarray(keys)
+            ),
+            "samples_total": state["samples_total"] + n,
+        }
+
     def _compute(self, state: State) -> Array:
+        if self._reservoir is not None:
+            res = self._reservoir
+            sample = state["corpus_sample"]
+            mask = np.asarray(res.valid_mask(sample))  # tmt: ignore[TMT003] -- host-side text metric: the reservoir estimate runs on host arrays
+            payload = np.asarray(res.payload(sample), np.float64)  # tmt: ignore[TMT003] -- host-side text metric: the reservoir estimate runs on host arrays
+            kept = int(mask.sum())  # tmt: ignore[TMT003] -- host-side text metric: the reservoir estimate runs on host arrays
+            total = int(state["samples_total"])  # tmt: ignore[TMT003] -- host-side text metric: the reservoir estimate runs on host arrays
+            # Horvitz–Thompson-style estimate of each corpus sum: the kept
+            # rows are a deterministic uniform-over-keys sample, so every sum
+            # scales by total/kept; the stamped bound is the unsampled-mass
+            # fraction (0 while the corpus fits the reservoir)
+            scale = (total / kept) if kept else 0.0
+            self.__dict__["_reservoir_bound"] = ((total - kept) / total) if total > kept else 0.0
+            sums = payload[mask].sum(axis=0) * scale
+            g = self.n_gram
+            return _bleu_score_compute(
+                jnp.asarray(sums[0]), jnp.asarray(sums[1]),
+                jnp.asarray(sums[2 : 2 + g]), jnp.asarray(sums[2 + g : 2 + 2 * g]),
+                self.n_gram, self.weights, self.smooth,
+            )
         return _bleu_score_compute(
             state["preds_len"], state["target_len"],
             state["numerator"], state["denominator"],
             self.n_gram, self.weights, self.smooth,
         )
+
+    def _gather_approx_provenance(self) -> Optional[Dict[str, Any]]:
+        """Accuracy-plane hook: reservoir provenance with the unsampled-mass
+        bound from the last ``compute()`` (0 until one has run)."""
+        if self._reservoir is None:
+            return None
+        return {
+            "source": "gather_approx",
+            "kind": "reservoir",
+            "capacity": self._reservoir.capacity,
+            "fields": self._reservoir.fields,
+            "bound": float(self.__dict__.get("_reservoir_bound", 0.0)),
+        }
 
 
 class SacreBLEUScore(BLEUScore):
