@@ -2665,10 +2665,14 @@ def analysis_leg():
     budget so the CI gate stays cheap, plus one jaxpr contract audit proving
     the planner's collective count matches the lowered sync graph, plus the
     whole-program sanitizer (``--audit-all``: donation races, fingerprint
-    completeness, collective uniformity, golden trace contracts, and the
-    tier-4 numerics pass TMT014-TMT017) timed as a fresh subprocess — the
+    completeness, collective uniformity, golden trace contracts, the
+    tier-4 numerics pass TMT014-TMT017, and the tier-5 batchability pass
+    TMT018-TMT021 over the golden slate) timed as a fresh subprocess — the
     honest CI cost, including interpreter start and the 8-device
-    host-platform bootstrap — against a 20 s budget.
+    host-platform bootstrap — against a 20 s budget, plus the full-slate
+    fleet certification (``--certify-fleet``, 200+ metrics vmap-lifted and
+    diffed against the golden certificate) as its own cold subprocess
+    against a 120 s budget.
     """
     import subprocess
     import sys as _sys
@@ -2699,6 +2703,15 @@ def analysis_leg():
     )
     audit_all_s = time.perf_counter() - t0
 
+    t0 = time.perf_counter()
+    certify = subprocess.run(
+        [_sys.executable, "-m", "torchmetrics_tpu.analysis", "--certify-fleet"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    certify_s = time.perf_counter() - t0
+
     return {
         "metric": f"full-package lint ({n_files} files, {len(all_rules())} rules)",
         "lint_wall_s": round(lint_s, 3),
@@ -2716,11 +2729,17 @@ def analysis_leg():
         "audit_all_within_budget": bool(audit_all_s < 20.0),
         "audit_all_exit": proc.returncode,
         "audit_all_clean": bool(proc.returncode == 0),
+        "certify_wall_s": round(certify_s, 3),
+        "certify_budget_s": 120.0,
+        "certify_within_budget": bool(certify_s < 120.0),
+        "certify_exit": certify.returncode,
+        "certify_clean": bool(certify.returncode == 0),
         "note": "the lint gate runs in tier-1 CI (exit code 1 on any finding); "
         "the audit closes the loop between the coalescing planner's cost model "
         "and the collectives XLA actually lowers; audit_all times the full "
-        "whole-program sanitizer (TMT010-TMT017, numerics included) as a "
-        "cold subprocess",
+        "whole-program sanitizer (TMT010-TMT021, numerics and the golden-slate "
+        "batchability pass included) as a cold subprocess; certify times the "
+        "full-slate fleet certification (--certify-fleet) the same way",
     }
 
 

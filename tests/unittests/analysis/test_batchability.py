@@ -1,0 +1,352 @@
+"""Tier-5 batchability certifier tests (TMT018–TMT021).
+
+Each seeded-broken metric below violates exactly one reason code; the
+certifier must reject every one of them (no false negatives), and the
+runtime cross-check must confirm sampled ``liftable`` verdicts by actual
+vmap-stacked parity against a Python loop (no false positives).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu.analysis.batchability import (
+    BATCHABILITY_RULE_IDS,
+    CERTIFICATE_SCHEMA_VERSION,
+    certificate_path,
+    certify_live,
+    certify_metric,
+    diff_certificate,
+    fleet_slate,
+    runtime_crosscheck,
+    tenant_flow,
+)
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.core.reductions import Reduce, SketchReduce, reduce_identity
+
+pytestmark = pytest.mark.batchability
+
+
+def _x(n: int = 16):
+    return (jnp.linspace(0.0, 1.0, n, dtype=jnp.float32),)
+
+
+def _codes(cert):
+    return {(r.rule, r.code) for r in cert.reasons}
+
+
+# ------------------------------------------------------------ reduce_identity
+def test_reduce_identity_elementwise_families():
+    assert float(reduce_identity(Reduce.SUM, jnp.float32)) == 0.0
+    assert float(reduce_identity(Reduce.MEAN, jnp.float32)) == 0.0
+    assert float(reduce_identity(Reduce.MAX, jnp.float32)) == float("-inf")
+    assert float(reduce_identity(Reduce.MIN, jnp.float32)) == float("inf")
+    # integer leaves narrow to the iinfo bound — that bound IS absorbing
+    assert int(reduce_identity(Reduce.MAX, jnp.int32)) == jnp.iinfo(jnp.int32).min
+    assert int(reduce_identity(Reduce.MIN, jnp.int32)) == jnp.iinfo(jnp.int32).max
+    assert bool(reduce_identity(Reduce.MAX, jnp.bool_)) is False
+    assert bool(reduce_identity(Reduce.MIN, jnp.bool_)) is True
+
+
+def test_reduce_identity_has_none_for_unmergeable_families():
+    # CAT concatenates, NONE concatenates under merge_leaf, structural
+    # sketches and callables have no elementwise algebra at all
+    assert reduce_identity(Reduce.CAT, jnp.float32) is None
+    assert reduce_identity(Reduce.NONE, jnp.float32) is None
+    assert reduce_identity(lambda s: s[0], jnp.float32) is None
+    structural = SketchReduce("t", bucket_op=None, combine_stacked=lambda s: s[0])
+    assert reduce_identity(structural, jnp.float32) is None
+    summing = SketchReduce("t", bucket_op="sum", combine_stacked=jnp.sum)
+    assert float(reduce_identity(summing, jnp.float32)) == 0.0
+
+
+# ----------------------------------------------- TMT018: seeded-broken lifts
+class _CatState(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("values", [], dist_reduce_fx="cat")
+
+    def _update(self, state, x):
+        return {"values": state["values"] + (x,)}
+
+    def _compute(self, state):
+        return jnp.concatenate(state["values"]).mean()
+
+
+def test_tmt018_cat_state_rejected():
+    cert = certify_live("CatState", _CatState(), _x())
+    assert cert.verdict == "unliftable"
+    assert ("TMT018", "cat-state") in _codes(cert)
+
+
+class _Callback(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        import numpy as np
+
+        host_sum = jax.pure_callback(
+            lambda a: np.sum(a, dtype=np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            x,
+            vmap_method="sequential",
+        )
+        return {"total": state["total"] + host_sum}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+def test_tmt018_pure_callback_rejected():
+    cert = certify_live("Callback", _Callback(), _x())
+    assert cert.verdict == "unliftable"
+    assert ("TMT018", "pure-callback") in _codes(cert)
+
+
+class _MaskIndex(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        kept = x[x > 0.5]  # data-dependent output shape
+        return {"total": state["total"] + kept.sum()}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+def test_tmt018_data_dependent_shape_rejected():
+    cert = certify_live("MaskIndex", _MaskIndex(), _x())
+    assert cert.verdict == "unliftable"
+    assert ("TMT018", "data-dependent-shape") in _codes(cert)
+
+
+class _Branch(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        if x.sum() > 0:  # Python branch on tenant data
+            return {"total": state["total"] + x.sum()}
+        return {"total": state["total"]}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+def test_tmt018_traced_branch_rejected():
+    cert = certify_live("Branch", _Branch(), _x())
+    assert cert.verdict == "unliftable"
+    assert ("TMT018", "traced-branch") in _codes(cert)
+
+
+# --------------------------------------------- TMT019: tenant independence
+class _AliasedLeaves(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("a", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("b", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        s = state["a"] + x.sum()
+        return {"a": s, "b": s}  # one buffer serving two leaves
+
+    def _compute(self, state):
+        return state["a"] + state["b"]
+
+
+def test_tmt019_aliased_state_leaves_rejected():
+    cert = certify_live("AliasedLeaves", _AliasedLeaves(), _x())
+    assert cert.verdict == "unliftable"
+    assert ("TMT019", "aliased-state-leaves") in _codes(cert)
+
+
+def test_tenant_flow_flags_cross_tenant_reduction():
+    # a stacked-level graph that sums over the tenant axis — exactly what a
+    # buggy fleet aggregation would lower
+    jx = jax.make_jaxpr(lambda s: jnp.sum(s, axis=0))(jnp.zeros((3, 8)))
+    status, problems = tenant_flow(jx)
+    assert any("reduces over the tenant axis" in p for p in problems)
+
+
+def test_tenant_flow_tracks_clean_per_tenant_graph():
+    jx = jax.make_jaxpr(lambda s, x: s + x.sum(axis=1, keepdims=False))(
+        jnp.zeros((3,)), jnp.zeros((3, 8))
+    )
+    status, problems = tenant_flow(jx)
+    assert status == "tracked"
+    assert problems == []
+
+
+def test_tenant_flow_flags_moved_output_axis():
+    jx = jax.make_jaxpr(lambda s: jnp.transpose(s))(jnp.zeros((3, 8)))
+    status, problems = tenant_flow(jx)
+    assert any("tenant axis at dim" in p for p in problems)
+
+
+# ------------------------------------------------- TMT020: reset soundness
+class _BadReset(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        # max-reduced leaf seeded at 0: merge(state, init) clamps at 0, and
+        # evicting a tenant by writing the identity (-inf) is NOT init state
+        self.add_state("peak", jnp.zeros(()), dist_reduce_fx="max")
+
+    def _update(self, state, x):
+        return {"peak": jnp.maximum(state["peak"], x.max())}
+
+    def _compute(self, state):
+        return state["peak"]
+
+
+def test_tmt020_reset_not_identity_demotes_to_masking():
+    cert = certify_live("BadReset", _BadReset(), _x(), check_sync=False)
+    assert cert.verdict == "liftable-with-masking"
+    assert ("TMT020", "reset-not-identity") in _codes(cert)
+    assert cert.leaves["peak"]["reset"] == "init-constant"
+
+
+def test_tmt020_identity_reset_stays_liftable():
+    class _GoodReset(_BadReset):
+        def __init__(self, **kw):
+            Metric.__init__(self, **kw)
+            self.add_state("peak", jnp.full((), -jnp.inf), dist_reduce_fx="max")
+
+    cert = certify_live("GoodReset", _GoodReset(), _x(), check_sync=False)
+    assert cert.verdict == "liftable"
+    assert cert.leaves["peak"]["reset"] == "identity"
+
+
+# ----------------------------------------------- TMT021: padding soundness
+class _ClippedIdentity(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        # MIN identity is +inf, but the declared range tops out at 1.0: an
+        # identity-padded row would violate the range contract
+        self.add_state("low", jnp.ones(()), dist_reduce_fx="min", value_range=(0.0, 1.0))
+
+    def _update(self, state, x):
+        return {"low": jnp.minimum(state["low"], x.min())}
+
+    def _compute(self, state):
+        return state["low"]
+
+
+def test_tmt021_identity_out_of_range_demotes_to_masking():
+    cert = certify_live("ClippedIdentity", _ClippedIdentity(), _x(), check_sync=False)
+    assert cert.verdict == "liftable-with-masking"
+    assert ("TMT021", "identity-out-of-range") in _codes(cert)
+
+
+class _PerturbingMerge(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _update(self, state, x):
+        return {"total": state["total"] + x.sum()}
+
+    def _compute(self, state):
+        return state["total"]
+
+    def merge_states(self, a, b):
+        merged = super().merge_states(a, b)
+        merged["total"] = merged["total"] + 1.0  # identity rows do not absorb
+        return merged
+
+
+def test_tmt021_padding_perturbs_state_rejected():
+    cert = certify_live("PerturbingMerge", _PerturbingMerge(), _x(), check_sync=False)
+    assert cert.verdict == "unliftable"
+    assert ("TMT021", "padding-perturbs-state") in _codes(cert)
+
+
+def test_tmt021_no_identity_on_none_reduced_array_leaf():
+    # RunningSum's ring buffer is dist_reduce_fx=None: merge_leaf
+    # concatenates it, so there is no absorbing identity and no certificate
+    cert = certify_metric("RunningSum", fleet_slate()["RunningSum"])
+    assert cert.verdict == "unliftable"
+    assert ("TMT021", "no-identity") in _codes(cert)
+
+
+# ------------------------------------------------------- the certificate
+def _golden() -> dict:
+    path = certificate_path()
+    assert path.is_file(), "golden FleetCertificate.json missing — run --certify-fleet --update-contracts"
+    return json.loads(path.read_text())
+
+
+def test_golden_certificate_schema_and_consistency():
+    doc = _golden()
+    assert doc["schema"] == CERTIFICATE_SCHEMA_VERSION
+    assert doc["certifier"] == "tm-tpu-fleet-cert/1"
+    metrics = doc["metrics"]
+    assert doc["summary"]["total"] == len(metrics) >= 200
+    # eligibility lists are exactly the verdict partitions
+    assert doc["eligible"]["direct"] == sorted(
+        n for n, e in metrics.items() if e["verdict"] == "liftable"
+    )
+    assert doc["eligible"]["masked"] == sorted(
+        n for n, e in metrics.items() if e["verdict"] == "liftable-with-masking"
+    )
+    assert len(doc["eligible"]["direct"]) >= 80
+    # no internal certifier errors anywhere in the slate
+    assert not [
+        n for n, e in metrics.items() if any(r["code"] == "certifier-error" for r in e["reasons"])
+    ]
+    # every non-liftable verdict carries at least one structured reason
+    for name, entry in metrics.items():
+        if entry["verdict"] != "liftable":
+            assert entry["reasons"], name
+        for reason in entry["reasons"]:
+            assert reason["rule"] in BATCHABILITY_RULE_IDS
+
+
+def test_certificate_diff_is_reflexive_and_detects_drift():
+    doc = _golden()
+    assert diff_certificate(doc, doc) == []
+    tampered = json.loads(json.dumps(doc))
+    name = doc["eligible"]["direct"][0]
+    tampered["metrics"][name]["verdict"] = "unliftable"
+    tampered["metrics"][name]["evidence"]["update_primitives"]["add"] = 999
+    diffs = diff_certificate(doc, tampered)
+    assert any("verdict changed" in d for d in diffs)
+    assert any("primitive 'add'" in d for d in diffs)
+
+
+def test_golden_certificate_names_known_classifications():
+    doc = _golden()
+    m = doc["metrics"]
+    # the dogfooded classifications this PR surfaced, pinned
+    assert m["PeakSignalNoiseRatioWithBlockedEffect"]["verdict"] == "liftable-with-masking"
+    assert m["PearsonCorrCoef"]["verdict"] == "liftable-with-masking"
+    assert m["RunningMean"]["verdict"] == "unliftable"
+    assert m["BinaryAccuracy"]["verdict"] == "liftable"
+    assert m["MeanSquaredError"]["verdict"] == "liftable"
+    assert m["CatMetric"]["verdict"] == "unliftable"
+    assert m["FrechetInceptionDistance"]["verdict"] == "unevaluated"
+
+
+# --------------------------------------------------- runtime cross-check
+def test_runtime_crosscheck_confirms_sampled_liftable_verdicts():
+    checked, problems = runtime_crosscheck(_golden(), sample_size=6)
+    assert problems == []
+    assert len(checked) == 6
+
+
+def test_runtime_crosscheck_spreads_the_sample():
+    doc = _golden()
+    checked, _ = runtime_crosscheck(doc, sample_size=4)
+    # deterministic spread across the liftable list, not a prefix
+    liftable = doc["eligible"]["direct"]
+    assert checked[0] == liftable[0]
+    assert checked[-1] != liftable[3]

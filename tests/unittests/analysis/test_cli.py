@@ -15,13 +15,13 @@ pytestmark = pytest.mark.lint
 _ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
 
 
-def _run(*args):
+def _run(*args, timeout=120):
     return subprocess.run(
         [sys.executable, "-m", "torchmetrics_tpu.analysis", *args],
         capture_output=True,
         text=True,
         env=_ENV,
-        timeout=120,
+        timeout=timeout,
     )
 
 
@@ -60,7 +60,10 @@ def test_list_rules_prints_registry():
 def test_list_rules_tags_whole_program_passes():
     proc = _run("--list-rules")
     assert proc.returncode == 0
-    for rid in ("TMT010", "TMT011", "TMT012", "TMT013", "TMT014", "TMT015", "TMT016", "TMT017"):
+    for rid in (
+        "TMT010", "TMT011", "TMT012", "TMT013", "TMT014", "TMT015", "TMT016", "TMT017",
+        "TMT018", "TMT019", "TMT020", "TMT021",
+    ):
         line = next(l for l in proc.stdout.splitlines() if l.startswith(rid))
         assert "[whole-program]" in line
 
@@ -106,6 +109,36 @@ def test_missing_path_is_usage_error(tmp_path):
     proc = _run(str(tmp_path / "nope.py"))
     assert proc.returncode == 2
     assert "no such path" in proc.stderr
+
+
+@pytest.mark.batchability
+def test_certify_fleet_exit_code_contract():
+    """0 when the slate matches the golden certificate; 1 on drift, with a
+    primitive/verdict-level diff rendered as findings (github annotations
+    included); the golden file is restored afterwards."""
+    from torchmetrics_tpu.analysis.batchability import certificate_path
+
+    path = certificate_path()
+    assert path.is_file(), "golden FleetCertificate.json missing"
+    golden_text = path.read_text()
+
+    proc = _run("--certify-fleet", "--format", "json", timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout)
+    assert report["n_findings"] == 0
+
+    tampered = json.loads(golden_text)
+    name = tampered["eligible"]["direct"][0]
+    tampered["metrics"][name]["verdict"] = "unliftable"
+    try:
+        path.write_text(json.dumps(tampered, indent=2, sort_keys=True) + "\n")
+        proc = _run("--certify-fleet", "--format", "github", timeout=240)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "::error file=" in proc.stdout
+        assert "title=TMT018" in proc.stdout
+        assert "verdict changed" in proc.stdout
+    finally:
+        path.write_text(golden_text)
 
 
 @pytest.mark.contracts

@@ -371,3 +371,29 @@ def test_whole_program_rules_registered_and_inert_per_file(tmp_path):
         assert get_rule(rid).whole_program
     src = 'x = 1  # tmt: ignore[TMT011] -- whole-program suppression, never stale per-file\n'
     assert _lint(tmp_path, src) == []
+
+
+def test_tmt018_suppression_recognized_and_never_stale(tmp_path):
+    # tier-5 batchability ids are whole-program: a suppression naming them is
+    # known to TMT009 (not "unknown rule") and exempt from stale detection
+    assert get_rule("TMT018").whole_program
+    src = 'x = 1  # tmt: ignore[TMT018] -- host-side compute by design; certificate classifies it\n'
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt019_suppression_recognized_and_never_stale(tmp_path):
+    assert get_rule("TMT019").whole_program
+    src = 'x = 1  # tmt: ignore[TMT019] -- cross-tenant mixing is the point of this aggregate\n'
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt020_suppression_recognized_and_never_stale(tmp_path):
+    assert get_rule("TMT020").whole_program
+    src = 'x = 1  # tmt: ignore[TMT020] -- eviction handled via stashed init constants\n'
+    assert _lint(tmp_path, src) == []
+
+
+def test_tmt021_suppression_recognized_and_never_stale(tmp_path):
+    assert get_rule("TMT021").whole_program
+    src = 'x = 1  # tmt: ignore[TMT021] -- padding handled by explicit masking, not identity rows\n'
+    assert _lint(tmp_path, src) == []

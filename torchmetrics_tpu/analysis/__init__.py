@@ -14,6 +14,13 @@ Two tiers guard the trace contract the library's performance depends on:
   state leaf registered for reduction, no float64 leaks, and the number of
   collective primitives in the sharded sync jaxpr equal to the coalescing
   planner's bucket count.
+
+Tiers 3–5 live in their own modules and run via ``--audit-all``: golden
+trace contracts (:mod:`analysis.contracts`, TMT013), the
+abstract-interpretation numerics pass (:mod:`analysis.numerics`,
+TMT014–TMT017), and the batchability certifier
+(:mod:`analysis.batchability`, TMT018–TMT021, plus the full-slate
+``--certify-fleet`` eligibility certificate).
 """
 
 from torchmetrics_tpu.analysis.audit import (
@@ -22,6 +29,13 @@ from torchmetrics_tpu.analysis.audit import (
     TraceContractError,
     audit_collection,
     audit_metric,
+)
+from torchmetrics_tpu.analysis.batchability import (
+    MetricCertificate,
+    build_certificate,
+    certify_metric,
+    check_certificate,
+    runtime_crosscheck,
 )
 from torchmetrics_tpu.analysis.linter import (
     Finding,
@@ -41,11 +55,15 @@ __all__ = [
     "AuditReport",
     "AuditViolation",
     "Finding",
+    "MetricCertificate",
     "Rule",
     "TraceContractError",
     "all_rules",
     "audit_collection",
     "audit_metric",
+    "build_certificate",
+    "certify_metric",
+    "check_certificate",
     "format_json",
     "format_text",
     "get_rule",
@@ -53,4 +71,5 @@ __all__ = [
     "lint_package",
     "lint_paths",
     "package_root",
+    "runtime_crosscheck",
 ]

@@ -10,16 +10,24 @@ emits ``::error file=...`` workflow annotations; ``--list-rules`` prints
 the registry with IDs and descriptions.
 
 ``--audit-all`` additionally runs the whole-program sanitizer passes
-(TMT010-TMT017: donation races, fingerprint completeness, collective
-uniformity, golden trace contracts, and the tier-4 numerics pass —
+(TMT010-TMT021: donation races, fingerprint completeness, collective
+uniformity, golden trace contracts, the tier-4 numerics pass —
 overflow horizons, unsafe downcasts, unguarded divides, range
-contracts).  ``--horizons`` prints the accumulator saturation table
+contracts — and the tier-5 batchability certifier over the golden
+slate).  ``--horizons`` prints the accumulator saturation table
 (:func:`~torchmetrics_tpu.analysis.numerics.horizon_report`) and exits.
 These trace real jaxprs on an
 8-device host-platform mesh, so the CLI pins ``JAX_PLATFORMS=cpu`` and
 ``--xla_force_host_platform_device_count=8`` *before* JAX initializes —
 unless the caller already configured a platform.  ``--update-contracts``
 regenerates the golden snapshots after an intentional graph change.
+
+``--certify-fleet`` certifies the *full* public metric slate for
+tenant-axis stacking (TMT018-TMT021) and diffs the result against the
+golden ``FleetCertificate.json`` — exit 1 on drift, with per-metric
+verdict/reason/primitive-level diffs as findings.  Combine with
+``--update-contracts`` to regenerate the certificate after an
+intentional eligibility change.
 """
 
 from __future__ import annotations
@@ -75,7 +83,7 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--audit-all",
         action="store_true",
-        help="also run the whole-program sanitizer passes (TMT010-TMT017)",
+        help="also run the whole-program sanitizer passes (TMT010-TMT021)",
     )
     parser.add_argument(
         "--horizons",
@@ -97,7 +105,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--update-contracts",
         action="store_true",
-        help="regenerate the golden trace-contract snapshots (TMT013) and exit",
+        help="regenerate the golden trace-contract snapshots (TMT013) and exit; "
+        "with --certify-fleet, regenerate the fleet certificate instead",
+    )
+    parser.add_argument(
+        "--certify-fleet",
+        action="store_true",
+        help="certify the full public metric slate for tenant-axis stacking "
+        "(TMT018-TMT021) and diff against the golden FleetCertificate.json",
     )
     args = parser.parse_args(argv)
 
@@ -116,6 +131,52 @@ def main(argv=None) -> int:
         if unknown:
             sys.stderr.write(f"unknown rule id(s): {unknown} (known: {sorted(known)})\n")
             return 2
+
+    if args.certify_fleet:
+        _bootstrap_devices()
+        from torchmetrics_tpu.analysis.batchability import (
+            certificate_path,
+            check_certificate,
+            write_certificate,
+        )
+
+        if args.update_contracts:
+            try:
+                path = write_certificate()
+            except Exception as err:
+                sys.stderr.write(
+                    f"--certify-fleet --update-contracts failed in analysis/batchability.py: "
+                    f"{type(err).__name__}: {err}\n"
+                )
+                return 2
+            sys.stdout.write(f"fleet certificate regenerated at {path}\n")
+            return 0
+        try:
+            diffs = check_certificate()
+        except Exception as err:
+            tb = err.__traceback__
+            site = "<unknown>"
+            while tb is not None:
+                site = f"{tb.tb_frame.f_code.co_filename}:{tb.tb_lineno}"
+                tb = tb.tb_next
+            sys.stderr.write(
+                f"--certify-fleet internal error at {site}: {type(err).__name__}: {err}\n"
+            )
+            return 2
+        from torchmetrics_tpu.analysis.linter import Finding
+
+        findings = [Finding("TMT018", "analysis/batchability.py", 1, diff) for diff in diffs]
+        if args.format == "json":
+            sys.stdout.write(format_json(findings, n_files=1) + "\n")
+        elif args.format == "github":
+            sys.stdout.write(format_github(findings) + "\n")
+        else:
+            sys.stdout.write(format_text(findings) + "\n")
+            if not findings:
+                sys.stdout.write(
+                    f"fleet certificate verified against {certificate_path()}\n"
+                )
+        return 1 if findings else 0
 
     if args.update_contracts:
         _bootstrap_devices()

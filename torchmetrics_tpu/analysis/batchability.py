@@ -1,0 +1,1248 @@
+"""Tier-5 batchability certifier — rules TMT018–TMT021 and ``--certify-fleet``.
+
+ROADMAP item 2 (the multi-tenant vmapped ``MetricFleet``) is only safe for
+metrics whose functional core provably lifts under a leading *tenant* axis.
+This module proves that property statically, per metric, over the whole
+public slate, and emits a versioned fleet-eligibility certificate the
+eventual MetricFleet consumes instead of a hand-curated allowlist:
+
+* **TMT018 vmap-liftability** — abstract-trace ``update_state`` and
+  ``compute_state`` under ``jax.vmap`` over tenant-stacked state pytrees and
+  classify every metric ``liftable`` / ``liftable-with-masking`` /
+  ``unliftable``, with structured reason codes (cat/list state,
+  pure_callback, data-dependent output shape, traced branch on tenant data,
+  host numpy, facade-only wrappers) and the lifted jaxpr's primitive
+  multiset attached as evidence.
+* **TMT019 tenant-independence** — dataflow over the lifted jaxpr proving no
+  primitive reduces, contracts, or concatenates across the tenant axis
+  (reusing the TMT012 collective-sequence machinery for the in-graph
+  collective scan and the tenant-lifted sync comparison), and no state-leaf
+  buffer aliasing that a donated fleet step would turn into cross-tenant
+  leakage (the PR 9 donation hazard, at the jaxpr level: one output buffer
+  serving two leaves).
+* **TMT020 masked-reset soundness** — per-tenant reset/eviction must be
+  expressible as an in-graph ``where`` against the reduction-table identity
+  (the PR 14 quarantine pattern): every leaf's init default is compared to
+  :func:`~torchmetrics_tpu.core.reductions.reduce_identity`; a mismatch
+  (e.g. a max-reduced leaf seeded at 0) means eviction needs stashed
+  init-constant rows instead of a pure identity write.
+* **TMT021 padding-identity soundness** — pow2-bucketed ragged tenant
+  batches are padded with identity rows; the pass verifies from the
+  reduction table + ``value_range`` declarations that the identity exists,
+  is representable, and is not clipped by a declared range (min/max need
+  ±inf, MEAN rides zero-weight ``_n`` rows), and *proves the absorption
+  numerically*: ``merge_states(state, init_state)`` must equal ``state``
+  leaf-for-leaf, both orders.
+
+``--certify-fleet`` (the CLI mode) classifies the full public metric slate
+— every concrete exported Metric subclass, auto-instantiated with
+deterministic ctor/input heuristics — and diffs the result against the
+golden snapshot ``FleetCertificate.json`` under the contracts directory
+(regenerate with ``--certify-fleet --update-contracts``).
+:func:`runtime_crosscheck` is the harness that keeps the certifier honest:
+every sampled ``liftable`` verdict is re-proven at runtime by vmap-stacked
+parity against a Python loop over independent per-tenant instances.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.analysis.linter import Finding, package_root
+
+__all__ = [
+    "BATCHABILITY_RULE_IDS",
+    "CERTIFICATE_SCHEMA_VERSION",
+    "MetricCertificate",
+    "Reason",
+    "build_certificate",
+    "certificate_path",
+    "certify_live",
+    "certify_metric",
+    "check_certificate",
+    "diff_certificate",
+    "fleet_slate",
+    "run_batchability_pass",
+    "runtime_crosscheck",
+    "tenant_flow",
+    "write_certificate",
+]
+
+CERTIFICATE_SCHEMA_VERSION = 1
+CERTIFIER = "tm-tpu-fleet-cert/1"
+BATCHABILITY_RULE_IDS = ("TMT018", "TMT019", "TMT020", "TMT021")
+
+#: tenant-axis width used for the lifting traces; a small prime so the
+#: tenant dimension is recognizable in shape evidence
+TENANTS = 3
+
+#: verdicts, in decreasing eligibility
+VERDICTS = ("liftable", "liftable-with-masking", "unliftable", "unevaluated")
+
+#: reason codes that demote to ``liftable-with-masking`` (fleet-stackable,
+#: but eviction/padding needs masking machinery beyond pure identity writes)
+_MASKING_CODES = frozenset({"reset-not-identity", "identity-out-of-range"})
+
+#: reason codes that are *violations* when they fire on the golden slate —
+#: structural classifications (cat-state, facade-only, custom-merge masking
+#: demotions, ...) are legitimate metric designs and never become findings
+_VIOLATION_CODES = frozenset(
+    {
+        "traced-branch",
+        "data-dependent-shape",
+        "host-numpy",
+        "pure-callback",
+        "trace-error",
+        "collective-in-lift",
+        "cross-tenant-reduction",
+        "tenant-axis-dropped",
+        "aliased-state-leaves",
+        "sync-sequence-divergence",
+        "padding-perturbs-state",
+    }
+)
+
+#: model-port metrics whose default construction builds a (stand-in) network
+#: — certifying them would time the feature extractor, not the metric; they
+#: are recorded in the certificate as unevaluated with this reason
+_HEAVYWEIGHT = frozenset(
+    {
+        "BERTScore",
+        "CLIPImageQualityAssessment",
+        "CLIPScore",
+        "FrechetInceptionDistance",
+        "InceptionScore",
+        "InfoLM",
+        "KernelInceptionDistance",
+        "LearnedPerceptualImagePatchSimilarity",
+        "MemorizationInformedFrechetInceptionDistance",
+        "PerceptualPathLength",
+    }
+)
+
+
+@dataclass(frozen=True)
+class Reason:
+    """One structured reason code attached to a verdict.
+
+    ``site`` (a package-relative ``(path, line)``) anchors the audit-all
+    finding at the failing source line — so the per-line ``# tmt: ignore``
+    suppression mechanism applies — but is deliberately *excluded* from the
+    certificate JSON: line numbers churn with every edit, and the golden
+    diff keys on (rule, code) pairs and primitive evidence instead.
+    """
+
+    rule: str  # TMT018..TMT021
+    code: str
+    detail: str = ""
+    leaf: Optional[str] = None
+    site: Optional[Tuple[str, int]] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"rule": self.rule, "code": self.code}
+        if self.detail:
+            out["detail"] = self.detail
+        if self.leaf is not None:
+            out["leaf"] = self.leaf
+        return out
+
+
+@dataclass
+class MetricCertificate:
+    """The per-metric slice of the fleet-eligibility certificate."""
+
+    name: str
+    module: str
+    qualname: str
+    verdict: str
+    input_kind: Optional[str] = None
+    reasons: List[Reason] = field(default_factory=list)
+    #: leaf -> {reduce, dtype, shape, identity, reset, padding}
+    leaves: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+    #: lifted-jaxpr evidence: primitive multisets, collective sequences,
+    #: tenant-flow status
+    evidence: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "module": self.module,
+            "qualname": self.qualname,
+            "verdict": self.verdict,
+            "reasons": [r.to_json() for r in sorted(self.reasons, key=lambda r: (r.rule, r.code, r.leaf or ""))],
+        }
+        if self.input_kind is not None:
+            out["input_kind"] = self.input_kind
+        if self.leaves:
+            out["leaves"] = self.leaves
+        if self.evidence:
+            out["evidence"] = self.evidence
+        return out
+
+
+# ------------------------------------------------------------------ the slate
+def fleet_slate() -> Dict[str, type]:
+    """Every concrete public Metric subclass, keyed by class name,
+    deterministically ordered (the fingerprint pass's enumeration)."""
+    from torchmetrics_tpu.analysis.fingerprint import iter_package_metric_classes
+
+    slate: Dict[str, type] = {}
+    for cls in iter_package_metric_classes():
+        if inspect.isabstract(cls) or cls.__name__.startswith("_"):
+            continue
+        slate.setdefault(cls.__name__, cls)
+    return dict(sorted(slate.items()))
+
+
+#: deterministic fills for required constructor parameters
+_CTOR_HINTS: Dict[str, Any] = {
+    "num_classes": 5,
+    "num_labels": 4,
+    "task": "binary",
+    "beta": 1.0,
+    "min_value": 0.5,
+    "num_groups": 2,
+    "threshold": 0.5,
+    "p": 2.0,
+    "num_outputs": 3,
+    "fs": 8000,
+    "sample_rate": 8000,
+    "things": (1, 2),
+    "stuffs": (3,),
+}
+
+
+def build_metric(cls: type) -> Any:
+    """Construct ``cls`` with deterministic heuristics for its required
+    parameters.  Raises (with the offending parameter named) when no
+    heuristic applies — the caller records the metric as unevaluated."""
+    params: Dict[str, inspect.Parameter] = {}
+    for fn in (cls.__new__, cls.__init__):
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            continue
+        for pname, p in sig.parameters.items():
+            if pname in ("self", "cls") or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                continue
+            params.setdefault(pname, p)
+    kwargs: Dict[str, Any] = {}
+    for pname, p in params.items():
+        required = p.default is inspect.Parameter.empty
+        if pname == "task":
+            # task dispatchers reject their default (None): always pin binary
+            kwargs[pname] = "binary"
+        elif not required:
+            continue
+        elif pname in _CTOR_HINTS:
+            kwargs[pname] = _CTOR_HINTS[pname]
+        elif pname in ("metric", "base_metric"):
+            from torchmetrics_tpu.classification import BinaryAccuracy
+
+            kwargs[pname] = BinaryAccuracy()
+        elif pname == "metrics":
+            from torchmetrics_tpu.classification import BinaryAccuracy
+
+            kwargs[pname] = [BinaryAccuracy()]
+        elif pname == "task_metrics":
+            from torchmetrics_tpu.classification import BinaryAccuracy
+
+            kwargs[pname] = {"task": BinaryAccuracy()}
+        else:
+            raise TypeError(f"no constructor heuristic for required parameter {pname!r}")
+    return cls(**kwargs)
+
+
+# ------------------------------------------------------- example input search
+def _make_inputs(kind: str, seed: int) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+    """Deterministic example inputs of one ``kind``; ``seed`` varies the
+    draw (the runtime cross-check feeds each tenant a different seed)."""
+    import numpy as np
+
+    r = np.random.default_rng(seed)
+    f32 = lambda a: jnp.asarray(np.asarray(a, "float32"))
+    i32 = lambda a: jnp.asarray(np.asarray(a, "int32"))
+    if kind == "binary":
+        return (f32(r.random(64)), i32(r.integers(0, 2, 64))), {}
+    if kind == "multiclass_logits":
+        return (f32(r.normal(size=(64, 5))), i32(r.integers(0, 5, 64))), {}
+    if kind == "multiclass_probs":
+        p = r.random((64, 5))
+        return (f32(p / p.sum(-1, keepdims=True)), i32(r.integers(0, 5, 64))), {}
+    if kind == "multilabel":
+        return (f32(r.random((64, 4))), i32(r.integers(0, 2, (64, 4)))), {}
+    if kind == "regression":
+        return (f32(r.normal(size=64)), f32(r.normal(size=64))), {}
+    if kind == "regression2d":
+        return (f32(r.normal(size=(64, 3))), f32(r.normal(size=(64, 3)))), {}
+    if kind == "labels_pair":
+        return (i32(r.integers(0, 4, 64)), i32(r.integers(0, 4, 64))), {}
+    if kind == "clustering_data":
+        return (f32(r.normal(size=(64, 3))), i32(r.integers(0, 4, 64))), {}
+    if kind == "value":
+        return (f32(r.random(64)),), {}
+    if kind == "image":
+        return (f32(r.random((2, 3, 16, 16))), f32(r.random((2, 3, 16, 16)))), {}
+    if kind == "image_single":
+        return (f32(r.random((2, 3, 16, 16))),), {}
+    if kind == "image_large":
+        return (f32(r.random((1, 3, 192, 192))), f32(r.random((1, 3, 192, 192)))), {}
+    if kind == "image_gray":
+        return (f32(r.random((2, 1, 16, 16))), f32(r.random((2, 1, 16, 16)))), {}
+    if kind == "audio":
+        return (f32(r.normal(size=(2, 400))), f32(r.normal(size=(2, 400)))), {}
+    if kind == "audio_complex":
+        c = r.normal(size=(2, 400)) + 1j * r.normal(size=(2, 400))
+        z = jnp.asarray(np.asarray(c, "complex64"))
+        return (z, z + jnp.asarray(0.1 + 0.0j, "complex64")), {}
+    if kind == "seg_masks":
+        return (i32(r.integers(0, 5, (2, 16, 16))), i32(r.integers(0, 5, (2, 16, 16)))), {}
+    if kind == "retrieval":
+        return (
+            (f32(r.random(64)), i32(r.integers(0, 2, 64))),
+            {"indexes": i32(r.integers(0, 8, 64))},
+        )
+    raise KeyError(f"unknown input kind {kind!r}")
+
+
+#: subpackage -> candidate kinds tried first (the generic tail follows)
+_KIND_ORDER: Dict[str, Tuple[str, ...]] = {
+    "classification": ("binary", "multiclass_logits", "multiclass_probs", "multilabel"),
+    "regression": ("regression", "regression2d", "binary"),
+    "image": ("image", "image_single", "image_gray", "image_large", "regression"),
+    "audio": ("audio", "audio_complex", "regression"),
+    "clustering": ("labels_pair", "clustering_data"),
+    "nominal": ("labels_pair", "multiclass_logits"),
+    "retrieval": ("retrieval",),
+    "segmentation": ("seg_masks", "multilabel"),
+    "aggregation": ("value", "regression"),
+}
+
+_GENERIC_KINDS = (
+    "binary",
+    "multiclass_logits",
+    "multiclass_probs",
+    "multilabel",
+    "regression",
+    "regression2d",
+    "labels_pair",
+    "clustering_data",
+    "value",
+    "image",
+    "image_single",
+    "image_gray",
+    "audio",
+    "audio_complex",
+    "seg_masks",
+    "retrieval",
+)
+
+
+def _candidate_kinds(metric: Any) -> Tuple[str, ...]:
+    parts = type(metric).__module__.split(".")
+    family = parts[1] if len(parts) > 1 and parts[0] == "torchmetrics_tpu" else ""
+    head = _KIND_ORDER.get(family, ())
+    return head + tuple(k for k in _GENERIC_KINDS if k not in head)
+
+
+def find_example_inputs(metric: Any) -> Tuple[Optional[str], Tuple[Any, ...], Dict[str, Any]]:
+    """First input kind the metric's eager ``update_state`` accepts.
+
+    Returns ``(kind, args, kwargs)``; ``kind`` is ``None`` when every array
+    candidate is rejected (host-side / structured-input metrics), and the
+    special marker ``"facade-only"`` when the metric has no functional core
+    at all (wrapper classes whose ``_update`` raises NotImplementedError).
+    """
+    facade_only = True
+    for kind in _candidate_kinds(metric):
+        args, kwargs = _make_inputs(kind, seed=0)
+        try:
+            state = metric.update_state(metric.init_state(), *args, **kwargs)
+            jax.block_until_ready(
+                [x for x in jax.tree_util.tree_leaves(state) if hasattr(x, "block_until_ready")]
+            )
+            return kind, args, kwargs
+        except NotImplementedError:
+            continue
+        except Exception:
+            facade_only = False
+            continue
+    return ("facade-only" if facade_only else None), (), {}
+
+
+# ----------------------------------------------------------- TMT018: the lift
+def _stack(tree: Any, tenants: int) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(jnp.asarray(x)[None], (tenants, *jnp.shape(x))), tree
+    )
+
+
+def _classify_trace_error(err: BaseException) -> Tuple[str, str]:
+    """Map a vmap-trace failure onto a TMT018 reason code."""
+    import jax.errors as jerr
+
+    detail = f"{type(err).__name__}: {str(err).splitlines()[0][:200]}"
+    if isinstance(err, jerr.TracerBoolConversionError):
+        return "traced-branch", detail
+    if isinstance(err, (jerr.NonConcreteBooleanIndexError, jerr.TracerIntegerConversionError)):
+        return "data-dependent-shape", detail
+    if isinstance(err, jerr.TracerArrayConversionError):
+        return "host-numpy", detail
+    if isinstance(err, jerr.ConcretizationTypeError):
+        # float(x)/int(x) on a tracer is a host readback, not a shape issue
+        if "`float` function" in str(err) or "`int` function" in str(err):
+            return "host-numpy", detail
+        return "data-dependent-shape", detail
+    return "trace-error", detail
+
+
+def _error_site(err: BaseException) -> Optional[Tuple[str, int]]:
+    """Innermost traceback frame inside the package (analysis/ excluded):
+    the source line that aborted the lift, for finding anchoring."""
+    import traceback
+
+    root = package_root().resolve()
+    site: Optional[Tuple[str, int]] = None
+    for frame in traceback.extract_tb(err.__traceback__):
+        try:
+            rel = Path(frame.filename).resolve().relative_to(root).as_posix()
+        except ValueError:
+            continue
+        if rel.startswith("analysis/"):
+            continue
+        site = (rel, frame.lineno or 1)
+    return site
+
+
+def lift_jaxprs(
+    metric: Any, args: Tuple[Any, ...], kwargs: Mapping[str, Any], tenants: int = TENANTS
+) -> Tuple[Any, Any]:
+    """``make_jaxpr(vmap(update))`` and ``make_jaxpr(vmap(compute))`` over
+    tenant-stacked state + inputs.  Raises the underlying trace error."""
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    kw_names = tuple(sorted(kwargs))
+    update = audit_step_fn(metric, "update")
+    compute = audit_step_fn(metric, "compute")
+
+    def update_pos(state, *flat):
+        pos, kws = flat[: len(args)], flat[len(args) :]
+        return update(state, *pos, **dict(zip(kw_names, kws)))
+
+    state0 = metric.init_state()
+    flat_inputs = tuple(args) + tuple(kwargs[k] for k in kw_names)
+    stacked_state = _stack(state0, tenants)
+    stacked_inputs = tuple(_stack(x, tenants) for x in flat_inputs)
+    jx_update = jax.make_jaxpr(jax.vmap(update_pos))(stacked_state, *stacked_inputs)
+    state1 = metric.update_state(state0, *args, **kwargs)
+    jx_compute = jax.make_jaxpr(jax.vmap(compute))(_stack(state1, tenants))
+    return jx_update, jx_compute
+
+
+# -------------------------------------------------- TMT019: tenant dataflow
+_REDUCE_PRIMS = frozenset(
+    {
+        "reduce_sum",
+        "reduce_max",
+        "reduce_min",
+        "reduce_prod",
+        "reduce_and",
+        "reduce_or",
+        "reduce_xor",
+        "argmax",
+        "argmin",
+    }
+)
+_FLOW_LOST = object()
+
+
+def _flow_eqn(eqn: Any, dims: Dict[Any, int], problems: List[str]) -> None:
+    """Propagate tenant-axis positions through one equation.
+
+    Tracked = we know which output dim carries the tenant axis; a reduce /
+    contraction / concatenation that *consumes* a tracked tenant dim is a
+    cross-tenant mixing finding.  Losing track (gathers, scans, exotic
+    reshapes) degrades to untracked silently — vmap's semantics are the
+    backstop; this dataflow only ever *adds* evidence, never excuses it.
+    """
+    name = eqn.primitive.name
+    in_dims: List[Optional[int]] = []
+    for var in eqn.invars:
+        if isinstance(var, jax.core.Literal):
+            in_dims.append(None)
+        else:
+            d = dims.get(var)
+            in_dims.append(None if d is _FLOW_LOST else d)
+    tracked = [(i, d) for i, d in enumerate(in_dims) if d is not None]
+
+    def set_out(dim: Optional[Any]) -> None:
+        for var in eqn.outvars:
+            dims[var] = _FLOW_LOST if dim is None else dim
+
+    if not tracked:
+        set_out(None)
+        return
+
+    if name in _REDUCE_PRIMS:
+        axes = tuple(eqn.params.get("axes", ()))
+        i, d = tracked[0]
+        if d in axes:
+            problems.append(
+                f"{name} reduces over the tenant axis (operand dim {d}, "
+                f"shape {tuple(getattr(eqn.invars[i], 'aval', None).shape)})"
+            )
+            set_out(None)
+            return
+        set_out(d - sum(1 for a in axes if a < d))
+        return
+    if name == "broadcast_in_dim":
+        bdims = tuple(eqn.params.get("broadcast_dimensions", ()))
+        _, d = tracked[0]
+        set_out(bdims[d] if d < len(bdims) else None)
+        return
+    if name == "transpose":
+        perm = tuple(eqn.params.get("permutation", ()))
+        _, d = tracked[0]
+        set_out(perm.index(d) if d in perm else None)
+        return
+    if name == "squeeze":
+        dimensions = tuple(eqn.params.get("dimensions", ()))
+        _, d = tracked[0]
+        set_out(None if d in dimensions else d - sum(1 for a in dimensions if a < d))
+        return
+    if name == "reshape":
+        i, d = tracked[0]
+        src = tuple(getattr(eqn.invars[i], "aval").shape)
+        dst = tuple(eqn.params.get("new_sizes", ()))
+        set_out(d if src[: d + 1] == dst[: d + 1] else None)
+        return
+    if name == "concatenate":
+        cat_dim = eqn.params.get("dimension")
+        for i, d in tracked:
+            if d == cat_dim:
+                problems.append(
+                    f"concatenate joins operands along the tenant axis (dim {d})"
+                )
+                set_out(None)
+                return
+        ds = {d for _, d in tracked}
+        set_out(ds.pop() if len(ds) == 1 else None)
+        return
+    if name == "dot_general":
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        for i, d in tracked:
+            contracting = lc if i == 0 else rc
+            batching = lb if i == 0 else rb
+            if d in contracting:
+                problems.append(f"dot_general contracts over the tenant axis (operand {i}, dim {d})")
+                set_out(None)
+                return
+            if d in batching:
+                set_out(list(batching).index(d))
+                return
+        set_out(None)
+        return
+    if name in ("pjit", "closed_call", "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr"):
+        sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+        sub = getattr(sub, "jaxpr", sub)
+        if sub is not None and len(sub.invars) == len(eqn.invars):
+            sub_dims: Dict[Any, int] = {}
+            for var, d in zip(sub.invars, in_dims):
+                if d is not None:
+                    sub_dims[var] = d
+            for sub_eqn in sub.eqns:
+                _flow_eqn(sub_eqn, sub_dims, problems)
+            for out_var, sub_out in zip(eqn.outvars, sub.outvars):
+                d = None
+                if not isinstance(sub_out, jax.core.Literal):
+                    d = sub_dims.get(sub_out)
+                    d = None if d is _FLOW_LOST else d
+                dims[out_var] = _FLOW_LOST if d is None else d
+            return
+        set_out(None)
+        return
+    if name in ("select_n", "clamp", "convert_element_type", "add", "sub", "mul", "div",
+                "max", "min", "pow", "rem", "and", "or", "xor", "not", "neg", "sign",
+                "exp", "log", "log1p", "tanh", "sqrt", "rsqrt", "abs", "floor", "ceil",
+                "round", "is_finite", "integer_pow", "logistic", "erf",
+                "eq", "ne", "lt", "le", "gt", "ge", "nextafter", "atan2", "copy",
+                "stop_gradient", "cos", "sin", "tan", "expm1", "cbrt", "square"):
+        ds = {d for _, d in tracked}
+        set_out(ds.pop() if len(ds) == 1 else None)
+        return
+    # unknown primitive (gather/scatter/sort/scan/...): lose the track
+    set_out(None)
+
+
+def tenant_flow(closed_jaxpr: Any) -> Tuple[str, List[str]]:
+    """Batch-axis dataflow over a tenant-lifted jaxpr.
+
+    Seeds every input at tenant dim 0 (that is how the certifier stacks
+    them) and propagates through the graph.  Returns ``(status, problems)``
+    where status is ``"tracked"`` when every output still carries the
+    tenant axis at dim 0, ``"partial"`` when some track was lost to an
+    unmodeled primitive, and problems list every positive cross-tenant
+    mixing detection (reduce/contract/concat over a tracked tenant dim,
+    or an output whose tenant axis provably moved)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    problems: List[str] = []
+    dims: Dict[Any, int] = {var: 0 for var in jaxpr.invars}
+    for eqn in jaxpr.eqns:
+        _flow_eqn(eqn, dims, problems)
+    status = "tracked"
+    for i, var in enumerate(jaxpr.outvars):
+        if isinstance(var, jax.core.Literal):
+            continue
+        d = dims.get(var, _FLOW_LOST)
+        if d is _FLOW_LOST or d is None:
+            status = "partial"
+        elif d != 0:
+            problems.append(f"output {i} carries the tenant axis at dim {d}, expected 0")
+    return status, problems
+
+
+def _alias_problems(closed_jaxpr: Any, leaf_names: Sequence[str]) -> List[str]:
+    """Duplicate output buffers in a lifted update: two state leaves bound
+    to ONE jaxpr var means one donated fleet buffer serves both — writing a
+    tenant row through one leaf mutates the other (the PR 9 aliased-donation
+    hazard, stacked)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    seen: Dict[Any, int] = {}
+    problems: List[str] = []
+    for i, var in enumerate(jaxpr.outvars):
+        if isinstance(var, jax.core.Literal):
+            continue
+        if var in seen:
+            a = leaf_names[seen[var]] if seen[var] < len(leaf_names) else f"output {seen[var]}"
+            b = leaf_names[i] if i < len(leaf_names) else f"output {i}"
+            problems.append(f"state leaves {a!r} and {b!r} alias one output buffer")
+        else:
+            seen[var] = i
+    return problems
+
+
+def _lifted_sync_divergence(metric: Any, state: Any, tenants: int = TENANTS) -> List[str]:
+    """Tenant-lift the sharded sync and compare its collective sequence
+    (TMT012 machinery) against the unlifted sync's: same primitives in the
+    same order, payloads scaled by the tenant count.  A divergence means the
+    sync lowering entangles the tenant axis with the mesh axis."""
+    from torchmetrics_tpu.analysis.audit import _default_mesh, _trace_sync
+    from torchmetrics_tpu.analysis.uniformity import collective_sequence
+
+    axis = "data"
+    try:
+        mesh = _default_mesh(None, axis)
+        jx1 = _trace_sync(lambda st: metric.sync_states(st, axis), state, mesh, axis)
+        stacked = _stack(state, tenants)
+        jxT = _trace_sync(lambda st: metric.sync_states(st, axis), stacked, mesh, axis)
+    except Exception as err:  # unsyncable states were classified upstream
+        return [f"sync not tenant-liftable ({type(err).__name__}: {str(err).splitlines()[0][:160]})"]
+    seq1 = [op.primitive for op in collective_sequence(jx1)]
+    seqT = [op.primitive for op in collective_sequence(jxT)]
+    if seq1 != seqT:
+        return [f"tenant-lifted sync collective sequence {seqT} != per-tenant sequence {seq1}"]
+    return []
+
+
+# ------------------------------------------- TMT020/TMT021: identity algebra
+def _leaf_reduce(metric: Any, leaf: str) -> Any:
+    from torchmetrics_tpu.core.reductions import Reduce
+
+    if leaf in ("_n", "_nonfinite"):
+        return Reduce.SUM  # reserved counters merge additively
+    return metric._reductions.get(leaf)
+
+
+def _reduce_name(reduce: Any) -> str:
+    from torchmetrics_tpu.core.reductions import Reduce, SketchReduce
+
+    if isinstance(reduce, SketchReduce):
+        return f"sketch:{reduce.bucket_op or 'structural'}"
+    if isinstance(reduce, Reduce):
+        return reduce.value
+    if callable(reduce):
+        return "callable"
+    return str(reduce)
+
+
+def _identity_certificates(metric: Any, state1: Any) -> Tuple[Dict[str, Dict[str, Any]], List[Reason]]:
+    """Per-leaf TMT020 (reset) and TMT021 (padding) verdicts.
+
+    Returns the leaf table plus reasons: ``no-identity`` leaves (callable /
+    structural-sketch reductions) make the metric unliftable;
+    ``reset-not-identity`` (init default != reduction identity) and
+    ``identity-out-of-range`` (declared value_range clips the identity)
+    demote to liftable-with-masking; ``padding-perturbs-state`` (the
+    numeric absorption proof failed) is a hard violation."""
+    import numpy as np
+
+    from torchmetrics_tpu.core.metric import Metric
+    from torchmetrics_tpu.core.reductions import Reduce, reduce_identity
+
+    state0 = metric.init_state()
+    # a custom merge_states override (PearsonCorrCoef's pairwise moment
+    # aggregation) makes leaf-wise identity algebra moot — the numeric
+    # absorption proof below is the authority there
+    custom_merge = type(metric).merge_states is not Metric.merge_states
+    leaves: Dict[str, Dict[str, Any]] = {}
+    reasons: List[Reason] = []
+    provable = True
+    for leaf in sorted(state0):
+        val = state0[leaf]
+        red = _leaf_reduce(metric, leaf)
+        entry: Dict[str, Any] = {"reduce": _reduce_name(red)}
+        if isinstance(val, tuple):  # cat/list state: classified by TMT018
+            entry.update({"identity": None, "reset": "none", "padding": "none"})
+            leaves[leaf] = entry
+            provable = False
+            continue
+        arr = np.asarray(val)
+        entry.update({"dtype": str(arr.dtype), "shape": list(arr.shape)})
+        ident = reduce_identity(red, arr.dtype)
+        if ident is None:
+            if custom_merge:
+                # eviction/padding mask against stashed init constants; the
+                # absorption proof certifies those constants actually absorb
+                entry.update(
+                    {"identity": None, "reset": "init-constant", "padding": "custom-merge"}
+                )
+                reasons.append(
+                    Reason(
+                        "TMT020",
+                        "reset-not-identity",
+                        f"custom merge_states with no reduction-table identity "
+                        f"({_reduce_name(red)}) — eviction masks against stashed "
+                        "init constants, absorption proven numerically below",
+                        leaf=leaf,
+                    )
+                )
+                leaves[leaf] = entry
+                continue
+            entry.update({"identity": None, "reset": "none", "padding": "none"})
+            provable = False
+            reasons.append(
+                Reason(
+                    "TMT021",
+                    "no-identity",
+                    f"reduction {_reduce_name(red)!r} has no elementwise identity — "
+                    "padded tenant rows cannot absorb "
+                    "(NONE leaves concatenate under merge_leaf)",
+                    leaf=leaf,
+                )
+            )
+            leaves[leaf] = entry
+            continue
+        ident_f = float(np.asarray(ident))
+        entry["identity"] = repr(ident_f) if not np.isfinite(ident_f) else ident_f
+        if np.all(arr == np.asarray(ident)):
+            entry["reset"] = "identity"
+        else:
+            entry["reset"] = "init-constant"
+            reasons.append(
+                Reason(
+                    "TMT020",
+                    "reset-not-identity",
+                    f"init default != reduction identity ({_reduce_name(red)}) — "
+                    "zero-retrace eviction must mask against stashed init constants, "
+                    "not a pure identity write",
+                    leaf=leaf,
+                )
+            )
+        entry["padding"] = "zero-weight-row" if red is Reduce.MEAN else "identity"
+        vr = (getattr(metric, "_value_ranges", None) or {}).get(leaf)
+        if vr is not None and not (vr[0] <= ident_f <= vr[1]):
+            reasons.append(
+                Reason(
+                    "TMT021",
+                    "identity-out-of-range",
+                    f"identity {ident_f!r} outside declared value_range {vr} — "
+                    "identity-padded rows would violate the range contract "
+                    "(and its quantized wire encodings)",
+                    leaf=leaf,
+                )
+            )
+        leaves[leaf] = entry
+
+    # the numeric absorption proof: merging an init (identity/padded) state
+    # into a real one must be a no-op, both orders
+    if provable:
+        try:
+            for label, merged in (
+                ("merge(state, init)", metric.merge_states(state1, state0)),
+                ("merge(init, state)", metric.merge_states(state0, state1)),
+            ):
+                for leaf in sorted(state1):
+                    a, b = np.asarray(state1[leaf]), np.asarray(merged[leaf])
+                    ok = (
+                        np.array_equal(a, b)
+                        if a.dtype.kind in "iub"
+                        else np.allclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True)
+                    )
+                    if not ok:
+                        reasons.append(
+                            Reason(
+                                "TMT021",
+                                "padding-perturbs-state",
+                                f"{label} changed leaf {leaf!r} — identity rows are not "
+                                "absorbing under this metric's merge",
+                                leaf=leaf,
+                            )
+                        )
+        except Exception as err:
+            reasons.append(
+                Reason(
+                    "TMT021",
+                    "padding-perturbs-state",
+                    f"absorption proof failed to run ({type(err).__name__}: "
+                    f"{str(err).splitlines()[0][:160]})",
+                )
+            )
+    return leaves, reasons
+
+
+# --------------------------------------------------------------- per-metric
+def _primitive_multiset(closed_jaxpr: Any) -> Dict[str, int]:
+    from collections import Counter
+
+    from torchmetrics_tpu.analysis.audit import iter_eqns
+
+    return dict(sorted(Counter(e.primitive.name for e in iter_eqns(closed_jaxpr)).items()))
+
+
+def certify_live(
+    name: str,
+    metric: Any,
+    args: Tuple[Any, ...],
+    kwargs: Optional[Mapping[str, Any]] = None,
+    *,
+    input_kind: Optional[str] = None,
+    tenants: int = TENANTS,
+    check_sync: bool = True,
+) -> MetricCertificate:
+    """Certify one constructed metric with known-good example inputs."""
+    from torchmetrics_tpu.analysis.audit import CALLBACK_PRIMITIVES, count_primitives
+    from torchmetrics_tpu.analysis.uniformity import collective_sequence
+    from torchmetrics_tpu.core.reductions import Reduce
+
+    kwargs = dict(kwargs or {})
+    cls = type(metric)
+    cert = MetricCertificate(
+        name=name, module=cls.__module__, qualname=cls.__qualname__, verdict="liftable",
+        input_kind=input_kind,
+    )
+
+    # TMT018 static half: cat/list states can never stack along a tenant axis
+    state0 = metric.init_state()
+    cat_leaves = sorted(
+        leaf
+        for leaf in state0
+        if isinstance(state0[leaf], tuple) or _leaf_reduce(metric, leaf) is Reduce.CAT
+    )
+    for leaf in cat_leaves:
+        cert.reasons.append(
+            Reason(
+                "TMT018",
+                "cat-state",
+                "cat/list state grows with data — no fixed tenant-stacked shape exists",
+                leaf=leaf,
+            )
+        )
+
+    state1 = metric.update_state(state0, *args, **kwargs)
+    leaves, identity_reasons = _identity_certificates(metric, state1)
+    cert.leaves = leaves
+    cert.reasons.extend(identity_reasons)
+
+    if not cat_leaves:
+        # TMT018 dynamic half: the vmap lift itself
+        try:
+            jx_update, jx_compute = lift_jaxprs(metric, args, kwargs, tenants=tenants)
+        except Exception as err:  # noqa: BLE001 — every trace error is a verdict
+            code, detail = _classify_trace_error(err)
+            cert.reasons.append(Reason("TMT018", code, detail, site=_error_site(err)))
+        else:
+            cert.evidence["update_primitives"] = _primitive_multiset(jx_update)
+            cert.evidence["compute_primitives"] = _primitive_multiset(jx_compute)
+            for label, jx in (("update", jx_update), ("compute", jx_compute)):
+                n_cb = count_primitives(jx, CALLBACK_PRIMITIVES)
+                if n_cb:
+                    cert.reasons.append(
+                        Reason(
+                            "TMT018",
+                            "pure-callback",
+                            f"lifted {label} lowers {n_cb} host callback primitive(s) — "
+                            "the host function would see all tenants' rows in one call",
+                        )
+                    )
+                # TMT019a: collectives inside the lifted per-tenant graph
+                seq = [op.describe() for op in collective_sequence(jx)]
+                if seq:
+                    cert.reasons.append(
+                        Reason(
+                            "TMT019",
+                            "collective-in-lift",
+                            f"lifted {label} issues collectives {seq} — mesh-axis "
+                            "reductions inside a tenant-lifted graph entangle tenants "
+                            "with replicas",
+                        )
+                    )
+                # TMT019b: batch-axis dataflow
+                status, problems = tenant_flow(jx)
+                cert.evidence[f"{label}_tenant_flow"] = status
+                for problem in problems:
+                    code = (
+                        "tenant-axis-dropped"
+                        if problem.startswith("output ")
+                        else "cross-tenant-reduction"
+                    )
+                    cert.reasons.append(Reason("TMT019", code, f"lifted {label}: {problem}"))
+            # TMT019c: aliased state-leaf buffers in the lifted update
+            for problem in _alias_problems(jx_update, sorted(state1)):
+                cert.reasons.append(Reason("TMT019", "aliased-state-leaves", problem))
+            # TMT019d: the tenant-lifted sync must keep the TMT012 sequence
+            if check_sync and not any(r.code == "no-identity" for r in cert.reasons):
+                for problem in _lifted_sync_divergence(metric, state1, tenants=tenants):
+                    cert.reasons.append(Reason("TMT019", "sync-sequence-divergence", problem))
+
+    codes = {r.code for r in cert.reasons}
+    if codes - _MASKING_CODES:
+        cert.verdict = "unliftable"
+    elif codes & _MASKING_CODES:
+        cert.verdict = "liftable-with-masking"
+    return cert
+
+
+def certify_metric(name: str, cls: type, *, tenants: int = TENANTS) -> MetricCertificate:
+    """Certify one slate class: auto-construct, find example inputs, lift.
+
+    Warnings are silenced for the duration: input probing intentionally
+    feeds wrong-shaped candidates, and the resulting chatter (nan
+    strategies, short audio signals) is probe noise, not user signal.
+    """
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return _certify_metric(name, cls, tenants=tenants)
+
+
+def _certify_metric(name: str, cls: type, *, tenants: int = TENANTS) -> MetricCertificate:
+    if cls.__name__ in _HEAVYWEIGHT:
+        return MetricCertificate(
+            name=name,
+            module=cls.__module__,
+            qualname=cls.__qualname__,
+            verdict="unevaluated",
+            reasons=[
+                Reason(
+                    "TMT018",
+                    "heavyweight-model-port",
+                    "default construction builds a feature-extractor network; "
+                    "certify explicitly with a lightweight feature callable",
+                )
+            ],
+        )
+    try:
+        metric = build_metric(cls)
+    except Exception as err:  # noqa: BLE001 — recorded, never raised
+        return MetricCertificate(
+            name=name,
+            module=cls.__module__,
+            qualname=cls.__qualname__,
+            verdict="unevaluated",
+            reasons=[
+                Reason(
+                    "TMT018",
+                    "no-auto-constructor",
+                    f"{type(err).__name__}: {str(err).splitlines()[0][:160]}",
+                )
+            ],
+        )
+    kind, args, kwargs = find_example_inputs(metric)
+    if kind == "facade-only":
+        return MetricCertificate(
+            name=name,
+            module=cls.__module__,
+            qualname=cls.__qualname__,
+            verdict="unliftable",
+            reasons=[
+                Reason(
+                    "TMT018",
+                    "facade-only",
+                    "no functional core: update_state raises NotImplementedError — "
+                    "the wrapper orchestrates host-side and cannot stack",
+                )
+            ],
+        )
+    if kind is None:
+        return MetricCertificate(
+            name=name,
+            module=cls.__module__,
+            qualname=cls.__qualname__,
+            verdict="unevaluated",
+            reasons=[
+                Reason(
+                    "TMT018",
+                    "no-array-example",
+                    "eager update rejects every array input candidate — host-side "
+                    "(text/detection) or structured inputs",
+                )
+            ],
+        )
+    try:
+        return certify_live(name, metric, args, kwargs, input_kind=kind, tenants=tenants)
+    except Exception as err:  # noqa: BLE001 — the zero-internal-error contract
+        return MetricCertificate(
+            name=name,
+            module=cls.__module__,
+            qualname=cls.__qualname__,
+            verdict="unevaluated",
+            reasons=[
+                Reason(
+                    "TMT018",
+                    "certifier-error",
+                    f"{type(err).__name__}: {str(err).splitlines()[0][:160]}",
+                )
+            ],
+        )
+
+
+# ------------------------------------------------------------ the certificate
+def build_certificate(
+    slate: Optional[Mapping[str, type]] = None, *, tenants: int = TENANTS
+) -> Dict[str, Any]:
+    """Certify the whole slate into the versioned certificate document."""
+    if slate is None:
+        slate = fleet_slate()
+    metrics: Dict[str, Any] = {}
+    counts = {v: 0 for v in VERDICTS}
+    for name in sorted(slate):
+        cert = certify_metric(name, slate[name], tenants=tenants)
+        metrics[name] = cert.to_json()
+        counts[cert.verdict] += 1
+    eligible = {
+        "direct": sorted(n for n, e in metrics.items() if e["verdict"] == "liftable"),
+        "masked": sorted(n for n, e in metrics.items() if e["verdict"] == "liftable-with-masking"),
+    }
+    return {
+        "schema": CERTIFICATE_SCHEMA_VERSION,
+        "certifier": CERTIFIER,
+        "tenants": tenants,
+        "summary": {"total": len(metrics), **{v.replace("-", "_"): counts[v] for v in VERDICTS}},
+        "eligible": eligible,
+        "metrics": metrics,
+    }
+
+
+def certificate_path(directory: Optional[Path] = None) -> Path:
+    from torchmetrics_tpu.analysis.contracts import contract_dir
+
+    directory = Path(directory) if directory is not None else contract_dir()
+    return directory / "FleetCertificate.json"
+
+
+def write_certificate(directory: Optional[Path] = None, *, tenants: int = TENANTS) -> Path:
+    path = certificate_path(directory)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    doc = build_certificate(tenants=tenants)
+    path.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    return path
+
+
+def diff_certificate(golden: Mapping[str, Any], current: Mapping[str, Any]) -> List[str]:
+    """Human-readable certificate drift, golden vs freshly certified.
+
+    Verdict flips, reason-code churn, and primitive-level evidence diffs per
+    metric; added/removed metrics; header changes.  Empty = pass."""
+    diffs: List[str] = []
+    for key in ("schema", "certifier", "tenants"):
+        if golden.get(key) != current.get(key):
+            diffs.append(f"certificate {key} changed {golden.get(key)!r} -> {current.get(key)!r}")
+    g_metrics, c_metrics = golden.get("metrics", {}), current.get("metrics", {})
+    for name in sorted(set(g_metrics) | set(c_metrics)):
+        g, c = g_metrics.get(name), c_metrics.get(name)
+        if g is None:
+            diffs.append(f"{name}: new metric, not in the golden certificate — regenerate")
+            continue
+        if c is None:
+            diffs.append(f"{name}: in the golden certificate but no longer in the slate")
+            continue
+        if g.get("verdict") != c.get("verdict"):
+            diffs.append(f"{name}: verdict changed {g.get('verdict')!r} -> {c.get('verdict')!r}")
+        g_codes = sorted({(r["rule"], r["code"]) for r in g.get("reasons", ())})
+        c_codes = sorted({(r["rule"], r["code"]) for r in c.get("reasons", ())})
+        if g_codes != c_codes:
+            diffs.append(f"{name}: reason codes changed {g_codes} -> {c_codes}")
+        for ep in ("update_primitives", "compute_primitives"):
+            gp = (g.get("evidence") or {}).get(ep, {})
+            cp = (c.get("evidence") or {}).get(ep, {})
+            for prim in sorted(set(gp) | set(cp)):
+                if gp.get(prim, 0) != cp.get(prim, 0):
+                    diffs.append(
+                        f"{name} {ep}: primitive '{prim}' count "
+                        f"{gp.get(prim, 0)} -> {cp.get(prim, 0)}"
+                    )
+    return diffs
+
+
+def check_certificate(directory: Optional[Path] = None, *, tenants: int = TENANTS) -> List[str]:
+    """Re-certify the slate and diff against the golden snapshot on disk."""
+    path = certificate_path(directory)
+    if not path.is_file():
+        return [f"no golden fleet certificate at {path} — run --certify-fleet --update-contracts"]
+    golden = json.loads(path.read_text())
+    return diff_certificate(golden, build_certificate(tenants=tenants))
+
+
+# -------------------------------------------------------- audit-all findings
+def _metric_anchor(metric_or_cls: Any) -> Tuple[str, int]:
+    cls = metric_or_cls if isinstance(metric_or_cls, type) else type(metric_or_cls)
+    try:
+        path = Path(inspect.getsourcefile(cls)).resolve()
+        rel = path.relative_to(package_root().resolve()).as_posix()
+        _, line = inspect.getsourcelines(cls)
+        return rel, line
+    except Exception:
+        return "analysis/batchability.py", 1
+
+
+def _reason_anchor(metric: Any, reason: Reason) -> Tuple[str, int]:
+    if reason.site is not None:
+        return reason.site
+    if reason.leaf is not None and reason.leaf not in ("_n", "_nonfinite"):
+        from torchmetrics_tpu.analysis.numerics import _anchor
+
+        try:
+            return _anchor(metric, reason.leaf)
+        except Exception:
+            pass
+    return _metric_anchor(metric)
+
+
+def run_batchability_pass(select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """TMT018–TMT021 over the golden slate (the base entries — policy/
+    compression variants lift identically).  One invocation serves all four
+    ids: the slate is certified once, findings filter by rule.  Structural
+    classifications (cat states, facade-only wrappers) are verdicts, not
+    findings; only violation-grade codes fire."""
+    from torchmetrics_tpu.analysis.contracts import golden_metrics
+
+    wanted = set(select) if select is not None else set(BATCHABILITY_RULE_IDS)
+    findings: List[Finding] = []
+    for name, factory in sorted(golden_metrics().items()):
+        if "__" in name:
+            continue
+        metric, inputs = factory()
+        cert = certify_live(name, metric, tuple(inputs), input_kind="golden")
+        for reason in cert.reasons:
+            if reason.rule not in wanted or reason.code not in _VIOLATION_CODES:
+                continue
+            path, line = _reason_anchor(metric, reason)
+            where = f" (leaf {reason.leaf!r})" if reason.leaf else ""
+            findings.append(
+                Finding(
+                    reason.rule,
+                    path,
+                    line,
+                    f"{name}{where}: [{reason.code}] {reason.detail}",
+                )
+            )
+    return findings
+
+
+# --------------------------------------------------- runtime cross-check
+def _tree_problems(label: str, a: Any, b: Any) -> List[str]:
+    import numpy as np
+
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    if len(la) != len(lb):
+        return [f"{label}: tree arity {len(la)} != {len(lb)}"]
+    out: List[str] = []
+    for i, (x, y) in enumerate(zip(la, lb)):
+        x, y = np.asarray(x), np.asarray(y)
+        ok = (
+            np.array_equal(x, y)
+            if x.dtype.kind in "iub"
+            else np.allclose(x, y, rtol=1e-4, atol=1e-5, equal_nan=True)
+        )
+        if not ok:
+            out.append(f"{label}: leaf {i} diverges (max abs diff {np.max(np.abs(x - y)):.3g})")
+    return out
+
+
+def runtime_crosscheck(
+    certificate: Optional[Mapping[str, Any]] = None,
+    *,
+    sample_size: int = 15,
+    tenants: int = TENANTS,
+) -> Tuple[List[str], List[str]]:
+    """Prove sampled ``liftable`` verdicts at runtime: vmap over stacked
+    per-tenant states/inputs must match a Python loop over ``tenants``
+    independent metric instances fed *different* data.
+
+    Returns ``(checked_names, problems)``; empty problems = zero false
+    positives in the sample."""
+    from torchmetrics_tpu.core.compile import audit_step_fn
+
+    if certificate is None:
+        certificate = build_certificate(tenants=tenants)
+    liftable = sorted(
+        name
+        for name, entry in certificate.get("metrics", {}).items()
+        if entry.get("verdict") == "liftable" and entry.get("input_kind")
+    )
+    step = max(1, len(liftable) // max(1, sample_size))
+    sample = liftable[::step][:sample_size]
+    slate = fleet_slate()
+    checked: List[str] = []
+    problems: List[str] = []
+    for name in sample:
+        cls = slate.get(name)
+        if cls is None:
+            problems.append(f"{name}: certified but not in the slate")
+            continue
+        entry = certificate["metrics"][name]
+        kind = entry["input_kind"]
+        try:
+            metric = build_metric(cls)
+        except Exception as err:  # noqa: BLE001
+            problems.append(f"{name}: construction failed ({type(err).__name__}: {err})")
+            continue
+        per_tenant = [_make_inputs(kind, seed=7 + t) for t in range(tenants)]
+        kw_names = tuple(sorted(per_tenant[0][1]))
+        update = audit_step_fn(metric, "update")
+        compute = audit_step_fn(metric, "compute")
+
+        def update_pos(state, *flat, _update=update, _kw=kw_names, _n=len(per_tenant[0][0])):
+            pos, kws = flat[:_n], flat[_n:]
+            return _update(state, *pos, **dict(zip(_kw, kws)))
+
+        # the loop: N independent instances, one per tenant
+        loop_states, loop_outs = [], []
+        for args, kwargs in per_tenant:
+            st = update(metric.init_state(), *args, **kwargs)
+            loop_states.append(st)
+            loop_outs.append(compute(st))
+        # the lift: one vmapped update/compute over stacked everything
+        stacked_inputs = [
+            jnp.stack([jnp.asarray(pt[0][i]) for pt in per_tenant])
+            for i in range(len(per_tenant[0][0]))
+        ] + [
+            jnp.stack([jnp.asarray(pt[1][k]) for pt in per_tenant]) for k in kw_names
+        ]
+        stacked_state0 = _stack(metric.init_state(), tenants)
+        stacked_state1 = jax.vmap(update_pos)(stacked_state0, *stacked_inputs)
+        stacked_out = jax.vmap(compute)(stacked_state1)
+        for t in range(tenants):
+            row_state = jax.tree_util.tree_map(lambda x: x[t], stacked_state1)
+            row_out = jax.tree_util.tree_map(lambda x: x[t], stacked_out)
+            problems.extend(_tree_problems(f"{name}[tenant {t}] state", loop_states[t], row_state))
+            problems.extend(_tree_problems(f"{name}[tenant {t}] compute", loop_outs[t], row_out))
+        checked.append(name)
+    return checked, problems

@@ -1,4 +1,4 @@
-"""Registered trace-safety rules (TMT001…TMT017).
+"""Registered trace-safety rules (TMT001…TMT021).
 
 Each rule encodes one way a metric implementation can silently break the
 trace contract this library's performance story depends on:
@@ -59,14 +59,34 @@ TMT016 unguarded-divide               compute-graph divides reachable with a
 TMT017 range-contract                 updates that can write a declared
                                       add_state(value_range=...) leaf out of
                                       its declared range
+TMT018 vmap-liftability               metrics whose update/compute fail to
+                                      abstract-trace under a tenant-leading
+                                      ``jax.vmap`` (cat states, host
+                                      callbacks, traced branches, data-
+                                      dependent shapes)
+TMT019 tenant-independence            primitives that reduce/contract/concat
+                                      across the tenant axis of a lifted
+                                      graph, aliased state-leaf output
+                                      buffers, and tenant-lifted syncs whose
+                                      collective sequence diverges
+TMT020 masked-reset                   per-tenant eviction not expressible as
+                                      an in-graph ``where`` against the
+                                      reduction-table identity (init default
+                                      != identity → stashed init constants)
+TMT021 padding-identity               ragged tenant buckets whose identity
+                                      padding is missing, clipped by a
+                                      declared value_range, or provably not
+                                      absorbed by the metric's merge
 ====== ============================== =======================================
 
-TMT010–TMT017 are *whole-program* rules: their findings come from the
+TMT010–TMT021 are *whole-program* rules: their findings come from the
 sanitizer passes (:mod:`analysis.donation`, :mod:`analysis.fingerprint`,
-:mod:`analysis.uniformity`, :mod:`analysis.contracts`, and the tier-4
+:mod:`analysis.uniformity`, :mod:`analysis.contracts`, the tier-4
 abstract-interpretation numerics pass :mod:`analysis.numerics` for
-TMT014–TMT017) run over live metric objects and traced jaxprs via
-``--audit-all``, not from the per-file AST walk.  They are registered here so suppressions can name them, ``--select``
+TMT014–TMT017, and the tier-5 batchability certifier
+:mod:`analysis.batchability` for TMT018–TMT021) run over live metric
+objects and traced jaxprs via ``--audit-all``, not from the per-file AST
+walk.  They are registered here so suppressions can name them, ``--select``
 can filter them, and ``--list-rules`` documents them.
 
 TMT001/TMT002 are the two lints previously hard-coded in
@@ -92,15 +112,19 @@ __all__ = [
     "FingerprintCompletenessRule",
     "Float64LiteralRule",
     "HostSyncInTraceRule",
+    "MaskedResetRule",
     "MaterializeInUpdateRule",
     "OverflowHorizonRule",
+    "PaddingIdentityRule",
     "RangeContractRule",
     "StateMutationRule",
     "SuppressionHygieneRule",
+    "TenantIndependenceRule",
     "TraceContractRule",
     "TracedBranchRule",
     "UnguardedDivideRule",
     "UnsafeDowncastRule",
+    "VmapLiftabilityRule",
     "WallClockRngRule",
 ]
 
@@ -757,4 +781,70 @@ class RangeContractRule(Rule):
         "range is not a contract, and everything keyed on it (cat wire bitpacking, the "
         "numerics seeds) is unsound.  Driven by analysis/numerics.py re-evaluating the "
         "update jaxpr from range-seeded state."
+    )
+
+
+# --------------------------------------------------------------------- TMT018
+@register
+class VmapLiftabilityRule(Rule):
+    id = "TMT018"
+    name = "vmap-liftability"
+    whole_program = True
+    description = (
+        "A fleet-stackable metric must abstract-trace under a tenant-leading jax.vmap "
+        "over stacked state pytrees: cat/list states have no fixed stacked shape, "
+        "pure_callback hands all tenants' rows to one host call, and traced branches / "
+        "data-dependent shapes / host numpy conversions abort the lift outright.  Every "
+        "public metric is classified liftable / liftable-with-masking / unliftable with "
+        "structured reason codes and jaxpr evidence.  Driven by analysis/batchability.py "
+        "(--certify-fleet certifies the full slate; --audit-all covers the golden slate)."
+    )
+
+
+# --------------------------------------------------------------------- TMT019
+@register
+class TenantIndependenceRule(Rule):
+    id = "TMT019"
+    name = "tenant-independence"
+    whole_program = True
+    description = (
+        "No primitive in a tenant-lifted graph may mix tenants: a batch-axis dataflow "
+        "over the lifted jaxpr flags reductions/contractions/concatenations that consume "
+        "the tenant axis and outputs whose tenant axis moved; duplicate output buffers "
+        "(two state leaves aliasing one jaxpr outvar) would leak state between stacked "
+        "tenants under donation; and the tenant-lifted sync must issue the same "
+        "collective sequence as the per-tenant sync (the TMT012 machinery).  Driven by "
+        "analysis/batchability.py."
+    )
+
+
+# --------------------------------------------------------------------- TMT020
+@register
+class MaskedResetRule(Rule):
+    id = "TMT020"
+    name = "masked-reset"
+    whole_program = True
+    description = (
+        "Zero-retrace tenant eviction must be expressible as an in-graph where() against "
+        "the reduction-table identity (the quarantine masking pattern): every state "
+        "leaf's init default is compared to reduce_identity(reduce, dtype).  A mismatch "
+        "(e.g. a max-reduced leaf seeded at 0) or a custom merge_states means eviction "
+        "masks against stashed init constants instead — the metric is demoted to "
+        "liftable-with-masking.  Driven by analysis/batchability.py."
+    )
+
+
+# --------------------------------------------------------------------- TMT021
+@register
+class PaddingIdentityRule(Rule):
+    id = "TMT021"
+    name = "padding-identity"
+    whole_program = True
+    description = (
+        "Pow2-bucketed ragged tenant batches are padded with identity rows, so each "
+        "leaf's reduction identity must exist (min/max need ±inf, MEAN rides zero-weight "
+        "_n rows; NONE leaves concatenate under merge and have none), fit the declared "
+        "value_range, and be proven absorbing numerically: merge_states(state, "
+        "init_state) must equal state leaf-for-leaf, both orders.  Driven by "
+        "analysis/batchability.py."
     )

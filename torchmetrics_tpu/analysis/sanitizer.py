@@ -1,8 +1,10 @@
 """Whole-program sanitizer driver: the ``--audit-all`` entry point.
 
-Runs the four whole-program passes — donation/aliasing races (TMT010),
+Runs the whole-program passes — donation/aliasing races (TMT010),
 fingerprint completeness (TMT011), collective uniformity (TMT012), golden
-trace contracts (TMT013) — and renders their results as linter
+trace contracts (TMT013), the tier-4 numerics pass (TMT014–TMT017), and
+the tier-5 batchability certifier (TMT018–TMT021) — and renders their
+results as linter
 :class:`~torchmetrics_tpu.analysis.linter.Finding` objects so CLI
 formatting, exit codes, and per-line ``# tmt: ignore[TMT01x] -- why``
 suppressions all behave exactly like the per-file rules.
@@ -24,6 +26,7 @@ from torchmetrics_tpu.analysis.linter import Finding, apply_suppressions
 
 __all__ = [
     "audit_all",
+    "run_batchability_pass",
     "run_contract_pass",
     "run_donation_pass",
     "run_fingerprint_pass",
@@ -148,8 +151,21 @@ def run_numerics_pass(select: Optional[Sequence[str]] = None) -> List[Finding]:
     return _run(select=select)
 
 
+def run_batchability_pass(select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """TMT018–TMT021: the tier-5 batchability certifier (vmap liftability,
+    tenant independence, masked reset, padding identity) over the golden
+    slate.  One invocation covers all four ids — the slate is certified
+    once, not per-rule.  The full-slate certificate is ``--certify-fleet``."""
+    from torchmetrics_tpu.analysis.batchability import run_batchability_pass as _run
+
+    return _run(select=select)
+
+
 #: ids served by one :func:`run_numerics_pass` invocation
 _NUMERICS_IDS = ("TMT014", "TMT015", "TMT016", "TMT017")
+
+#: ids served by one :func:`run_batchability_pass` invocation
+_BATCHABILITY_IDS = ("TMT018", "TMT019", "TMT020", "TMT021")
 
 
 def audit_all(
@@ -172,4 +188,7 @@ def audit_all(
     numerics_ids = [i for i in _NUMERICS_IDS if select is None or i in select]
     if numerics_ids:
         findings.extend(run_numerics_pass(select=numerics_ids))
+    batchability_ids = [i for i in _BATCHABILITY_IDS if select is None or i in select]
+    if batchability_ids:
+        findings.extend(run_batchability_pass(select=batchability_ids))
     return apply_suppressions(findings)

@@ -105,6 +105,43 @@ def accumulator_kind(reduce: Any) -> Optional[str]:
     return None
 
 
+def reduce_identity(reduce: Any, dtype: Any) -> Optional[Any]:
+    """The absorbing identity of a canonical reduce, as a ``dtype`` scalar.
+
+    This is the value a masked row may hold without perturbing any combine:
+    ``merge(x, identity) == x`` for the elementwise families.  SUM/MEAN get
+    0 (MEAN additionally relies on a zero ``_n`` weight row — ``merge_leaf``
+    weights means by update counts, so a zero-weight row is absorbing);
+    MAX/MIN get ∓inf, narrowed to ``iinfo.min``/``iinfo.max`` on integer
+    leaves where that bound *is* the absorbing element.  CAT, NONE,
+    structural sketches, and callable reductions have no elementwise
+    identity — ``None`` — which is exactly what makes them ineligible for
+    identity-padded tenant stacking (rule TMT021).  NONE is *not* "never
+    combined": ``merge_leaf`` concatenates NONE leaves like CAT, so an
+    array-shaped NONE leaf changes shape under merge and only a custom
+    ``merge_states`` override (e.g. PearsonCorrCoef's pairwise moment
+    aggregation) can make such a metric mergeable at all.
+    """
+    dt = jnp.dtype(dtype)
+    if isinstance(reduce, SketchReduce):
+        op = reduce.bucket_op
+        if op is None:
+            return None
+        reduce = {"sum": Reduce.SUM, "max": Reduce.MAX, "min": Reduce.MIN}[op]
+    if not isinstance(reduce, Reduce):
+        return None  # callable / unknown: no provable identity
+    if reduce in (Reduce.SUM, Reduce.MEAN):
+        return jnp.zeros((), dt)
+    if reduce in (Reduce.MAX, Reduce.MIN):
+        if jnp.issubdtype(dt, jnp.integer):
+            info = jnp.iinfo(dt)
+            return jnp.asarray(info.min if reduce is Reduce.MAX else info.max, dt)
+        if jnp.issubdtype(dt, jnp.bool_):
+            return jnp.asarray(reduce is Reduce.MIN, dt)
+        return jnp.asarray(-jnp.inf if reduce is Reduce.MAX else jnp.inf, dt)
+    return None  # CAT/NONE: merge concatenates — no elementwise identity
+
+
 ReduceFx = Union[Reduce, str, Callable, "SketchReduce", None]
 
 

@@ -219,7 +219,7 @@ class FrechetInceptionDistance(Metric):
     def _compute(self, state: State) -> Array:
         import numpy as np
 
-        if float(state["real_features_num_samples"]) < 2 or float(state["fake_features_num_samples"]) < 2:  # tmt: ignore[TMT003, TMT004] -- host-side FID compute: sample-count sanity check before np sqrtm path
+        if float(state["real_features_num_samples"]) < 2 or float(state["fake_features_num_samples"]) < 2:  # tmt: ignore[TMT003, TMT004, TMT018] -- host-side FID compute: sample-count sanity check before np sqrtm path; vmap-unliftable by design (fleet certificate classifies FID unliftable)
             raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
         mu_real, cov_real = _mean_cov(
             np.asarray(state["real_features_sum"], np.float64),  # tmt: ignore[TMT003] -- host-side FID compute: covariance math in np.float64 on host
